@@ -1,0 +1,25 @@
+"""TPU-native parameter-server distributed training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the C++/gRPC
+parameter-server reference (araju6/parameter-server-distributed):
+
+- control plane: coordinator (registration / heartbeats / stale eviction /
+  PS discovery) and parameter-server RPC surface (push / pull / sync-status /
+  checkpoint save-load), wire-compatible with the reference's proto3 services
+  (reference: proto/parameter_server.proto, proto/coordinator.proto).
+- data plane: jitted SPMD train steps over a `jax.sharding.Mesh`; gradient
+  mean via `psum`/`pmean` over ICI replaces the NCCL all-reduce
+  (reference: src/nccl_manager.cpp); ZeRO-style sharded parameter/optimizer
+  state with reduce-scatter + all-gather replaces the PS push/pull data path
+  (reference: src/parameter_server.cpp).
+- extensions beyond the reference: async / bounded-staleness SGD, elastic
+  barrier width, real model zoo (MLP / ResNet / Transformer), ring attention
+  for sequence parallelism, pallas kernels, benchmarks and tests.
+
+Import as ``import parameter_server_distributed_tpu as pst``.
+"""
+
+__version__ = "0.1.0"
+
+# Keep the top-level import light: no jax import here so that control-plane
+# tooling (coordinator CLI, wire codec) can run without touching a device.

@@ -1,0 +1,123 @@
+"""Typed configuration for the whole framework.
+
+The reference spreads configuration across three untyped layers: positional
+argv on each binary (reference: src/parameter_main.cpp:10-18,
+src/coordinator_main.cpp:10-20, src/worker_main.cpp:13-18), env vars in the
+start scripts (reference: scripts/README.md:13-36), and Terraform variables
+(reference: terraform/variables.tf).  Here a single set of dataclasses covers
+all of it plus the TPU-side knobs (mesh shape, staleness bound, dtype), with
+defaults matching the reference's observable behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Sequence
+
+# Defaults mirroring the reference
+DEFAULT_PS_PORT = 50051          # reference: src/parameter_main.cpp:7
+DEFAULT_COORDINATOR_PORT = 50052  # reference: scripts/start_coordinator.sh
+DEFAULT_TOTAL_WORKERS = 2        # reference: src/parameter_main.cpp:14
+DEFAULT_CHECKPOINT_INTERVAL = 10  # iterations/epoch — src/parameter_main.cpp:8
+HEARTBEAT_PERIOD_S = 5.0         # reference: src/worker.cpp:233
+STALE_TIMEOUT_S = 30.0           # reference: src/coordinator.cpp:52
+REAP_PERIOD_S = 10.0             # reference: src/coordinator_service.cpp:104-105
+AUTOSAVE_CHECK_PERIOD_S = 5.0    # reference: src/parameter_server_service.cpp:152
+SYNC_POLL_PERIOD_S = 0.05        # reference: src/worker.cpp:372
+SYNC_POLL_MAX = 200              # reference: src/worker.cpp:373
+SYNC_OUTER_RETRIES = 3           # reference: src/worker.cpp:334
+RETRY_MAX_ATTEMPTS = 5           # reference: src/worker.cpp:130
+RETRY_BASE_DELAY_S = 0.1         # reference: src/worker.cpp:135 (100ms * 2^n)
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinatorConfig:
+    bind_address: str = "0.0.0.0"
+    port: int = DEFAULT_COORDINATOR_PORT
+    ps_address: str = "127.0.0.1"
+    ps_port: int = DEFAULT_PS_PORT
+    stale_timeout_s: float = STALE_TIMEOUT_S
+    reap_period_s: float = REAP_PERIOD_S
+
+
+@dataclasses.dataclass(frozen=True)
+class ParameterServerConfig:
+    bind_address: str = "0.0.0.0"
+    port: int = DEFAULT_PS_PORT
+    total_workers: int = DEFAULT_TOTAL_WORKERS
+    checkpoint_interval: int = DEFAULT_CHECKPOINT_INTERVAL
+    checkpoint_dir: str = "."
+    autosave_period_s: float = AUTOSAVE_CHECK_PERIOD_S
+    learning_rate: float = 1.0   # reference applies param -= mean_grad (lr=1.0)
+    # extensions beyond the reference:
+    optimizer: str = "sgd"       # sgd | momentum | adam
+    momentum: float = 0.9
+    staleness_bound: int = 0     # 0 = strictly synchronous (reference behavior)
+    elastic: bool = False        # True: barrier width tracks live registrations
+    gc_iterations: int = 64      # retain at most this many iteration states
+    checkpoint_keep: int = 0     # retention: keep newest N checkpoint files (0 = keep all)
+
+    @property
+    def synchronous(self) -> bool:
+        return self.staleness_bound == 0
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerConfig:
+    coordinator_address: str = "127.0.0.1:50052"
+    worker_id: int = 0
+    iterations: int = 10
+    address: str = "127.0.0.1"
+    port: int = 50060
+    checkpoint_path: str = ""
+    heartbeat_period_s: float = HEARTBEAT_PERIOD_S
+    retry_max_attempts: int = RETRY_MAX_ATTEMPTS
+    retry_base_delay_s: float = RETRY_BASE_DELAY_S
+    sync_poll_period_s: float = SYNC_POLL_PERIOD_S
+    sync_poll_max: int = SYNC_POLL_MAX
+    sync_outer_retries: int = SYNC_OUTER_RETRIES
+    batch_size: int = 32
+    model: str = "mnist_mlp"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh for the SPMD data plane.
+
+    Axes follow the scaling-book convention: data / fsdp (ZeRO param-shard,
+    the 'ps_shard' analogue) / tensor / sequence / pipeline / expert.  Any
+    axis of size 1 is collapsed when the mesh is built.
+    """
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    pipeline: int = 1
+    expert: int = 1
+
+    @property
+    def axis_sizes(self) -> dict[str, int]:
+        return {"data": self.data, "fsdp": self.fsdp, "tensor": self.tensor,
+                "sequence": self.sequence, "pipeline": self.pipeline,
+                "expert": self.expert}
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for v in self.axis_sizes.values():
+            n *= v
+        return n
+
+
+def env_or(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def parse_host_port(addr: str, default_port: int) -> tuple[str, int]:
+    """Split 'host:port' like the reference coordinator main
+    (reference: src/coordinator_main.cpp:12-18)."""
+    if ":" in addr:
+        host, _, port = addr.rpartition(":")
+        return host, int(port)
+    return addr, default_port

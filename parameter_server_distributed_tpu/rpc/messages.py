@@ -1,0 +1,221 @@
+"""Wire-compatible message schemas for the two control-plane services.
+
+Field numbers, types, and service/method names mirror the reference IDL so
+that this framework's control plane interoperates at the wire level with the
+reference's C++ clients and servers:
+
+- ParameterServer service (5 RPCs): reference proto/parameter_server.proto:5-11
+- Coordinator service (4 RPCs):     reference proto/coordinator.proto:5-10
+
+Messages are declared with the declarative codec in `wire.py` rather than
+protoc gencode.  `Tensor.data` is held as a numpy float32 array end-to-end
+(packed `repeated float` on the wire — reference proto/parameter_server.proto:22),
+so tensor payloads never pass through per-element Python objects.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .wire import Field, Message
+
+# --------------------------------------------------------------------------
+# parameter_server package
+# --------------------------------------------------------------------------
+
+DTYPE_FLOAT32 = 0
+DTYPE_FLOAT64 = 1  # declared by the reference IDL, never used by its runtime
+
+
+class Tensor(Message):
+    """Named dense tensor (reference proto/parameter_server.proto:19-24)."""
+    FIELDS = (
+        Field(1, "name", "string"),
+        Field(2, "shape", "int32", repeated=True),
+        Field(3, "data", "float", repeated=True),
+        Field(4, "dtype", "int32"),
+    )
+
+    @classmethod
+    def from_array(cls, name: str, array: np.ndarray) -> "Tensor":
+        arr = np.asarray(array, dtype=np.float32)
+        return cls(name=name, shape=list(arr.shape), data=arr.reshape(-1),
+                   dtype=DTYPE_FLOAT32)
+
+    def to_array(self) -> np.ndarray:
+        arr = np.asarray(self.data, dtype=np.float32)
+        if self.shape:
+            arr = arr.reshape(self.shape)
+        return arr
+
+
+class GradientUpdate(Message):
+    FIELDS = (
+        Field(1, "worker_id", "int32"),
+        Field(2, "iteration", "int32"),
+        Field(3, "gradients", "message", message_type=Tensor, repeated=True),
+    )
+
+
+class PushResponse(Message):
+    FIELDS = (
+        Field(1, "success", "bool"),
+        Field(2, "message", "string"),
+        Field(3, "iteration", "int32"),
+        Field(4, "aggregation_complete", "bool"),
+        Field(5, "workers_received", "int32"),
+        Field(6, "total_workers", "int32"),
+    )
+
+
+class PullRequest(Message):
+    FIELDS = (
+        Field(1, "worker_id", "int32"),
+        Field(2, "iteration", "int32"),
+    )
+
+
+class ParameterUpdate(Message):
+    FIELDS = (
+        Field(1, "iteration", "int32"),
+        Field(2, "parameters", "message", message_type=Tensor, repeated=True),
+        Field(3, "ready", "bool"),
+    )
+
+
+class SyncStatusRequest(Message):
+    FIELDS = (Field(1, "iteration", "int32"),)
+
+
+class SyncStatusResponse(Message):
+    FIELDS = (
+        Field(1, "iteration", "int32"),
+        Field(2, "ready", "bool"),
+        Field(3, "workers_received", "int32"),
+        Field(4, "total_workers", "int32"),
+    )
+
+
+class SaveCheckpointRequest(Message):
+    FIELDS = (
+        Field(1, "epoch", "int32"),
+        Field(2, "path", "string"),
+    )
+
+
+class SaveCheckpointResponse(Message):
+    FIELDS = (
+        Field(1, "success", "bool"),
+        Field(2, "message", "string"),
+        Field(3, "checkpoint_path", "string"),
+    )
+
+
+class LoadCheckpointRequest(Message):
+    FIELDS = (Field(1, "path", "string"),)
+
+
+class LoadCheckpointResponse(Message):
+    FIELDS = (
+        Field(1, "success", "bool"),
+        Field(2, "message", "string"),
+        Field(3, "epoch", "int32"),
+        Field(4, "parameters", "message", message_type=Tensor, repeated=True),
+    )
+
+
+# --------------------------------------------------------------------------
+# coordinator package
+# --------------------------------------------------------------------------
+
+class WorkerStatus:
+    """Enum (reference proto/coordinator.proto:31-36)."""
+    IDLE = 0
+    TRAINING = 1
+    CHECKPOINTING = 2
+    ERROR = 3
+
+    _NAMES = {0: "IDLE", 1: "TRAINING", 2: "CHECKPOINTING", 3: "ERROR"}
+
+    @classmethod
+    def name(cls, value: int) -> str:
+        return cls._NAMES.get(value, f"UNKNOWN({value})")
+
+
+class WorkerInfo(Message):
+    FIELDS = (
+        Field(1, "worker_id", "int32"),
+        Field(2, "address", "string"),
+        Field(3, "port", "int32"),
+        Field(4, "hostname", "string"),
+    )
+
+
+class RegisterResponse(Message):
+    FIELDS = (
+        Field(1, "success", "bool"),
+        Field(2, "message", "string"),
+        Field(3, "parameter_server_address", "string"),
+        Field(4, "total_workers", "int32"),
+    )
+
+
+class HeartbeatRequest(Message):
+    FIELDS = (
+        Field(1, "worker_id", "int32"),
+        Field(2, "status", "enum"),
+    )
+
+
+class HeartbeatResponse(Message):
+    FIELDS = (
+        Field(1, "success", "bool"),
+        Field(2, "timestamp", "int64"),
+    )
+
+
+class ListWorkersRequest(Message):
+    FIELDS = ()
+
+
+class ListWorkersResponse(Message):
+    FIELDS = (
+        Field(1, "workers", "message", message_type=WorkerInfo, repeated=True),
+        Field(2, "total_workers", "int32"),
+    )
+
+
+class GetPSAddressRequest(Message):
+    FIELDS = ()
+
+
+class GetPSAddressResponse(Message):
+    FIELDS = (
+        Field(1, "address", "string"),
+        Field(2, "port", "int32"),
+    )
+
+
+# --------------------------------------------------------------------------
+# gRPC method tables (service and method names must match the reference IDL
+# for wire-level interop: /parameter_server.ParameterServer/<M>,
+# /coordinator.Coordinator/<M>)
+# --------------------------------------------------------------------------
+
+PARAMETER_SERVER_SERVICE = "parameter_server.ParameterServer"
+COORDINATOR_SERVICE = "coordinator.Coordinator"
+
+PARAMETER_SERVER_METHODS = {
+    "ReceiveGradients": (GradientUpdate, PushResponse),
+    "ServeParameters": (PullRequest, ParameterUpdate),
+    "CheckSyncStatus": (SyncStatusRequest, SyncStatusResponse),
+    "SaveCheckpoint": (SaveCheckpointRequest, SaveCheckpointResponse),
+    "LoadCheckpoint": (LoadCheckpointRequest, LoadCheckpointResponse),
+}
+
+COORDINATOR_METHODS = {
+    "RegisterWorker": (WorkerInfo, RegisterResponse),
+    "Heartbeat": (HeartbeatRequest, HeartbeatResponse),
+    "ListWorkers": (ListWorkersRequest, ListWorkersResponse),
+    "GetParameterServerAddress": (GetPSAddressRequest, GetPSAddressResponse),
+}

@@ -1,0 +1,346 @@
+"""Minimal proto3 wire-format codec.
+
+The reference ships two proto3 IDL files (proto/parameter_server.proto,
+proto/coordinator.proto) compiled with protoc + grpc_cpp_plugin
+(reference: CMakeLists.txt:87-113).  This framework stays wire-compatible
+with those services without depending on protoc/grpc_tools gencode: messages
+are declared in Python (`messages.py`) and encoded/decoded by this codec.
+
+Only the subset of proto3 used by the reference schemas is implemented:
+
+- varint scalar fields: int32, int64, bool, enum (wire type 0)
+- fixed32 float fields (wire type 5)
+- length-delimited: string, embedded messages, packed repeated scalars
+  (wire type 2)
+- repeated messages (one length-delimited record per element)
+- packed repeated float / int32 — with the proto3 requirement that decoders
+  accept both packed and unpacked encodings of repeated scalars
+- proto3 default-value elision on encode; unknown-field skipping on decode
+
+Packed `repeated float` payloads (the tensor data plane of the reference's
+`Tensor` message — proto/parameter_server.proto:19-24) are moved as raw
+little-endian buffers through numpy, i.e. memcpy-speed, with an optional
+native C++ fast path (see native/).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable
+
+import numpy as np
+
+# Wire types
+WT_VARINT = 0
+WT_FIXED64 = 1
+WT_LEN = 2
+WT_FIXED32 = 5
+
+_U64_MASK = (1 << 64) - 1
+
+
+def encode_varint(value: int) -> bytes:
+    """Encode a non-negative (or two's-complement 64-bit wrapped) varint."""
+    value &= _U64_MASK
+    out = bytearray()
+    while True:
+        byte = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            return bytes(out)
+
+
+def decode_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    """Decode a varint at ``pos``; returns (value, new_pos)."""
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        byte = buf[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result & _U64_MASK, pos
+        shift += 7
+        if shift >= 70:
+            raise ValueError("varint too long")
+
+
+def _signed32(value: int) -> int:
+    """Interpret a decoded varint as int32 (two's complement, per proto3)."""
+    value &= 0xFFFFFFFF
+    if value >= 1 << 31:
+        value -= 1 << 32
+    return value
+
+
+def _signed64(value: int) -> int:
+    value &= _U64_MASK
+    if value >= 1 << 63:
+        value -= 1 << 64
+    return value
+
+
+def _tag(field_number: int, wire_type: int) -> bytes:
+    return encode_varint((field_number << 3) | wire_type)
+
+
+def _skip_field(buf: bytes, pos: int, wire_type: int) -> int:
+    if wire_type == WT_VARINT:
+        _, pos = decode_varint(buf, pos)
+    elif wire_type == WT_FIXED64:
+        pos += 8
+    elif wire_type == WT_LEN:
+        length, pos = decode_varint(buf, pos)
+        pos += length
+    elif wire_type == WT_FIXED32:
+        pos += 4
+    else:
+        raise ValueError(f"unsupported wire type {wire_type}")
+    if pos > len(buf):
+        raise ValueError("truncated field")
+    return pos
+
+
+class Field:
+    """Declarative spec for one proto3 field."""
+
+    __slots__ = ("number", "name", "kind", "message_type", "repeated")
+
+    def __init__(self, number: int, name: str, kind: str,
+                 message_type: type | None = None, repeated: bool = False):
+        self.number = number
+        self.name = name
+        self.kind = kind  # int32|int64|bool|enum|string|float|message
+        self.message_type = message_type
+        self.repeated = repeated
+
+
+class Message:
+    """Base class for declarative proto3 messages.
+
+    Subclasses define ``FIELDS: tuple[Field, ...]`` and plain attributes.
+    """
+
+    FIELDS: tuple[Field, ...] = ()
+
+    def __init__(self, **kwargs: Any):
+        for f in self.FIELDS:
+            setattr(self, f.name, kwargs.pop(f.name, _default_for(f)))
+        if kwargs:
+            raise TypeError(f"unknown fields for {type(self).__name__}: {sorted(kwargs)}")
+
+    # -- encoding ---------------------------------------------------------
+    def encode(self) -> bytes:
+        out = bytearray()
+        for f in self.FIELDS:
+            value = getattr(self, f.name)
+            _encode_field(out, f, value)
+        return bytes(out)
+
+    # -- decoding ---------------------------------------------------------
+    @classmethod
+    def decode(cls, buf: bytes | memoryview):
+        msg = cls()
+        buf = bytes(buf) if isinstance(buf, memoryview) else buf
+        by_number = cls._fields_by_number()
+        pos = 0
+        n = len(buf)
+        while pos < n:
+            key, pos = decode_varint(buf, pos)
+            field_number = key >> 3
+            wire_type = key & 0x7
+            f = by_number.get(field_number)
+            if f is None:
+                pos = _skip_field(buf, pos, wire_type)
+                continue
+            pos = _decode_field(msg, buf, pos, f, wire_type)
+        return msg
+
+    _BY_NUMBER_CACHE: dict[type, dict[int, Field]] = {}
+
+    @classmethod
+    def _fields_by_number(cls) -> dict[int, Field]:
+        cached = Message._BY_NUMBER_CACHE.get(cls)
+        if cached is None:
+            cached = {f.number: f for f in cls.FIELDS}
+            Message._BY_NUMBER_CACHE[cls] = cached
+        return cached
+
+    # -- misc -------------------------------------------------------------
+    def __repr__(self) -> str:
+        parts = []
+        for f in self.FIELDS:
+            v = getattr(self, f.name)
+            if isinstance(v, np.ndarray):
+                v = f"<float32[{v.size}]>"
+            parts.append(f"{f.name}={v!r}")
+        return f"{type(self).__name__}({', '.join(parts)})"
+
+    def __eq__(self, other: object) -> bool:
+        if type(other) is not type(self):
+            return NotImplemented
+        for f in self.FIELDS:
+            a, b = getattr(self, f.name), getattr(other, f.name)
+            if f.kind == "float" and f.repeated:
+                if not np.array_equal(np.asarray(a, np.float32), np.asarray(b, np.float32)):
+                    return False
+            elif a != b:
+                return False
+        return True
+
+
+def _default_for(f: Field) -> Any:
+    if f.repeated:
+        return np.zeros((0,), np.float32) if f.kind == "float" else []
+    return {
+        "int32": 0, "int64": 0, "enum": 0, "bool": False,
+        "string": "", "float": 0.0,
+    }.get(f.kind) if f.kind != "message" else None
+
+
+def _encode_field(out: bytearray, f: Field, value: Any) -> None:
+    kind = f.kind
+    if f.repeated:
+        if kind == "message":
+            for item in value:
+                body = item.encode()
+                out += _tag(f.number, WT_LEN)
+                out += encode_varint(len(body))
+                out += body
+        elif kind == "float":
+            arr = np.asarray(value, dtype="<f4")
+            if arr.size:
+                body = arr.tobytes()
+                out += _tag(f.number, WT_LEN)
+                out += encode_varint(len(body))
+                out += body
+        elif kind in ("int32", "int64", "enum", "bool"):
+            if value:
+                body = bytearray()
+                for item in value:
+                    body += encode_varint(int(item))
+                out += _tag(f.number, WT_LEN)
+                out += encode_varint(len(body))
+                out += body
+        elif kind == "string":
+            for item in value:
+                data = item.encode("utf-8")
+                out += _tag(f.number, WT_LEN)
+                out += encode_varint(len(data))
+                out += data
+        else:
+            raise TypeError(f"unsupported repeated kind {kind}")
+        return
+
+    if kind in ("int32", "int64", "enum"):
+        if value:
+            out += _tag(f.number, WT_VARINT)
+            out += encode_varint(int(value))
+    elif kind == "bool":
+        if value:
+            out += _tag(f.number, WT_VARINT)
+            out += b"\x01"
+    elif kind == "string":
+        if value:
+            data = value.encode("utf-8")
+            out += _tag(f.number, WT_LEN)
+            out += encode_varint(len(data))
+            out += data
+    elif kind == "float":
+        if value:
+            out += _tag(f.number, WT_FIXED32)
+            out += struct.pack("<f", value)
+    elif kind == "message":
+        if value is not None:
+            body = value.encode()
+            out += _tag(f.number, WT_LEN)
+            out += encode_varint(len(body))
+            out += body
+    else:
+        raise TypeError(f"unsupported kind {kind}")
+
+
+def _decode_field(msg: Message, buf: bytes, pos: int, f: Field, wire_type: int) -> int:
+    kind = f.kind
+    if f.repeated:
+        if kind == "message":
+            if wire_type != WT_LEN:
+                raise ValueError(f"field {f.name}: bad wire type {wire_type}")
+            length, pos = decode_varint(buf, pos)
+            end = pos + length
+            getattr(msg, f.name).append(f.message_type.decode(buf[pos:end]))
+            return end
+        if kind == "float":
+            if wire_type == WT_LEN:  # packed
+                length, pos = decode_varint(buf, pos)
+                end = pos + length
+                arr = np.frombuffer(buf, dtype="<f4", count=length // 4, offset=pos)
+                existing = getattr(msg, f.name)
+                setattr(msg, f.name,
+                        arr if existing.size == 0 else np.concatenate([existing, arr]))
+                return end
+            if wire_type == WT_FIXED32:  # unpacked element
+                val = struct.unpack_from("<f", buf, pos)[0]
+                existing = getattr(msg, f.name)
+                setattr(msg, f.name, np.append(existing, np.float32(val)))
+                return pos + 4
+            raise ValueError(f"field {f.name}: bad wire type {wire_type}")
+        if kind in ("int32", "int64", "enum", "bool"):
+            sign = _signed32 if kind == "int32" else _signed64
+            if wire_type == WT_LEN:  # packed
+                length, pos = decode_varint(buf, pos)
+                end = pos + length
+                lst = getattr(msg, f.name)
+                while pos < end:
+                    v, pos = decode_varint(buf, pos)
+                    lst.append(bool(v) if kind == "bool" else sign(v))
+                return end
+            if wire_type == WT_VARINT:
+                v, pos = decode_varint(buf, pos)
+                getattr(msg, f.name).append(bool(v) if kind == "bool" else sign(v))
+                return pos
+            raise ValueError(f"field {f.name}: bad wire type {wire_type}")
+        if kind == "string":
+            length, pos = decode_varint(buf, pos)
+            end = pos + length
+            getattr(msg, f.name).append(buf[pos:end].decode("utf-8"))
+            return end
+        raise TypeError(f"unsupported repeated kind {kind}")
+
+    if kind in ("int32", "int64", "enum"):
+        v, pos = decode_varint(buf, pos)
+        setattr(msg, f.name, _signed64(v) if kind == "int64" else _signed32(v))
+        return pos
+    if kind == "bool":
+        v, pos = decode_varint(buf, pos)
+        setattr(msg, f.name, bool(v))
+        return pos
+    if kind == "string":
+        length, pos = decode_varint(buf, pos)
+        end = pos + length
+        setattr(msg, f.name, buf[pos:end].decode("utf-8"))
+        return end
+    if kind == "float":
+        setattr(msg, f.name, struct.unpack_from("<f", buf, pos)[0])
+        return pos + 4
+    if kind == "message":
+        length, pos = decode_varint(buf, pos)
+        end = pos + length
+        setattr(msg, f.name, f.message_type.decode(buf[pos:end]))
+        return end
+    raise TypeError(f"unsupported kind {kind}")
+
+
+def serializer(cls: type[Message]) -> Callable[[Message], bytes]:
+    """gRPC request/response serializer for a message class."""
+    return lambda msg: msg.encode()
+
+
+def deserializer(cls: type[Message]) -> Callable[[bytes], Message]:
+    """gRPC request/response deserializer for a message class."""
+    return cls.decode

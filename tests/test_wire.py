@@ -1,0 +1,134 @@
+"""Wire-format codec tests.
+
+Golden byte vectors were produced with protoc-generated Python gencode for
+the reference IDL (proto/parameter_server.proto, proto/coordinator.proto) and
+verified byte-identical in both directions; they are embedded here so the
+test suite needs no protoc/grpc_tools at runtime.
+"""
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.rpc import messages as m
+from parameter_server_distributed_tpu.rpc import wire
+
+GOLDENS = {
+    "tensor": "0a086c61796572302f77120202031a180000c03f000010c0000000000000704095bfd633000000bf",
+    "gradient_update": "080310111a280a086c61796572302f77120202031a180000c03f000010c0000000000000704095bfd633000000bf1a140a01621201031a0ccdcccc3dcdcc4c3e9a99993e",
+    "push_response": "080112026f6b1811200128043004",
+    "pull_negative": "08ffffffffffffffffff01",
+    "worker_info": "0807120831302e302e302e35189687032208776f726b65722d37",
+    "heartbeat": "08071002",
+    "heartbeat_resp": "080110bb948ba98533",
+    "list_workers": "0a1a0807120831302e302e302e35189687032208776f726b65722d371001",
+    "load_ckpt": "080112066c6f61646564180322280a086c61796572302f77120202031a180000c03f000010c0000000000000704095bfd633000000bf",
+}
+
+
+def _tensor():
+    return m.Tensor(name="layer0/w", shape=[2, 3],
+                    data=np.array([1.5, -2.25, 0.0, 3.75, 1e-7, -0.5], np.float32),
+                    dtype=0)
+
+
+def _golden_msgs():
+    t = _tensor()
+    return {
+        "tensor": t,
+        "gradient_update": m.GradientUpdate(
+            worker_id=3, iteration=17,
+            gradients=[t, m.Tensor.from_array("b", np.array([0.1, 0.2, 0.3], np.float32))]),
+        "push_response": m.PushResponse(success=True, message="ok", iteration=17,
+                                        aggregation_complete=True, workers_received=4,
+                                        total_workers=4),
+        "pull_negative": m.PullRequest(worker_id=-1, iteration=0),
+        "worker_info": m.WorkerInfo(worker_id=7, address="10.0.0.5", port=50070,
+                                    hostname="worker-7"),
+        "heartbeat": m.HeartbeatRequest(worker_id=7, status=m.WorkerStatus.CHECKPOINTING),
+        "heartbeat_resp": m.HeartbeatResponse(success=True, timestamp=1753775000123),
+        "list_workers": m.ListWorkersResponse(
+            workers=[m.WorkerInfo(worker_id=7, address="10.0.0.5", port=50070,
+                                  hostname="worker-7")],
+            total_workers=1),
+        "load_ckpt": m.LoadCheckpointResponse(success=True, message="loaded", epoch=3,
+                                              parameters=[t]),
+    }
+
+
+@pytest.mark.parametrize("key", sorted(GOLDENS))
+def test_encode_matches_protoc_golden(key):
+    msg = _golden_msgs()[key]
+    assert msg.encode().hex() == GOLDENS[key]
+
+
+@pytest.mark.parametrize("key", sorted(GOLDENS))
+def test_decode_golden_roundtrip(key):
+    msg = _golden_msgs()[key]
+    decoded = type(msg).decode(bytes.fromhex(GOLDENS[key]))
+    assert decoded == msg
+    assert decoded.encode().hex() == GOLDENS[key]
+
+
+def test_varint_roundtrip():
+    for v in [0, 1, 127, 128, 300, 2**31 - 1, 2**63 - 1, 2**64 - 1]:
+        buf = wire.encode_varint(v)
+        out, pos = wire.decode_varint(buf, 0)
+        assert out == v and pos == len(buf)
+
+
+def test_negative_int32_ten_byte_varint():
+    req = m.PullRequest(worker_id=-1)
+    assert req.encode() == bytes.fromhex("08ffffffffffffffffff01")
+    assert m.PullRequest.decode(req.encode()).worker_id == -1
+
+
+def test_default_elision():
+    # proto3: default-valued scalar fields are omitted
+    assert m.PushResponse().encode() == b""
+    assert m.PullRequest(worker_id=0, iteration=0).encode() == b""
+
+
+def test_unknown_field_skipped():
+    # field 99 varint prepended — decoder must skip it
+    extra = wire.encode_varint((99 << 3) | 0) + wire.encode_varint(42)
+    body = extra + m.PullRequest(worker_id=5, iteration=2).encode()
+    msg = m.PullRequest.decode(body)
+    assert msg.worker_id == 5 and msg.iteration == 2
+
+
+def test_unpacked_repeated_scalars_accepted():
+    # proto3 decoders must accept unpacked encodings of packed fields:
+    # shape as two separate varint fields, data as two separate fixed32 fields
+    import struct
+    body = b"".join([
+        wire.encode_varint((2 << 3) | 0), wire.encode_varint(2),
+        wire.encode_varint((2 << 3) | 0), wire.encode_varint(3),
+        wire.encode_varint((3 << 3) | 5), struct.pack("<f", 1.5),
+        wire.encode_varint((3 << 3) | 5), struct.pack("<f", 2.5),
+    ])
+    t = m.Tensor.decode(body)
+    assert t.shape == [2, 3]
+    np.testing.assert_array_equal(np.asarray(t.data), np.array([1.5, 2.5], np.float32))
+
+
+def test_tensor_array_roundtrip(rng):
+    arr = rng.standard_normal((4, 8, 3)).astype(np.float32)
+    t = m.Tensor.from_array("x", arr)
+    rt = m.Tensor.decode(t.encode())
+    np.testing.assert_array_equal(rt.to_array(), arr)
+    assert rt.name == "x" and rt.shape == [4, 8, 3]
+
+
+def test_large_tensor_fast_path(rng):
+    arr = rng.standard_normal((512, 512)).astype(np.float32)
+    t = m.Tensor.from_array("big", arr)
+    encoded = t.encode()
+    rt = m.Tensor.decode(encoded)
+    np.testing.assert_array_equal(rt.to_array(), arr)
+    # wire size ≈ 4 bytes/element + small header
+    assert len(encoded) < arr.size * 4 + 64
+
+
+def test_empty_messages():
+    assert m.ListWorkersRequest().encode() == b""
+    assert isinstance(m.ListWorkersRequest.decode(b""), m.ListWorkersRequest)

@@ -1,0 +1,86 @@
+"""Coordinator membership registry.
+
+Re-design of the reference's `CoordinatorCore`
+(reference: src/coordinator.cpp, include/coordinator.h:10-37): a
+mutex-guarded map worker_id -> registry entry with heartbeat timestamps,
+stale-worker eviction, and static PS address config.  Extended with a
+`live_worker_count` used as the elastic barrier width by
+`ParameterServerCore` (the reference instead restarts the PS with a new
+TOTAL_WORKERS — scripts/scale_workers.sh:137-144).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable
+
+from ..rpc.messages import WorkerStatus
+
+
+@dataclasses.dataclass
+class WorkerRegistryEntry:
+    """reference: include/coordinator.h:10-17."""
+    worker_id: int
+    address: str
+    port: int
+    hostname: str
+    status: int = WorkerStatus.IDLE
+    last_heartbeat: float = 0.0
+
+
+class CoordinatorCore:
+    def __init__(self, ps_address: str, ps_port: int,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self._ps_address = ps_address
+        self._ps_port = int(ps_port)
+        self._workers: dict[int, WorkerRegistryEntry] = {}
+        self._lock = threading.Lock()
+        self._time = time_fn
+
+    def register_worker(self, worker_id: int, address: str, port: int,
+                        hostname: str) -> int:
+        """Upsert + heartbeat stamp (reference: src/coordinator.cpp:7-17).
+        Returns the total registered worker count."""
+        now = self._time()
+        with self._lock:
+            self._workers[worker_id] = WorkerRegistryEntry(
+                worker_id=worker_id, address=address, port=int(port),
+                hostname=hostname, status=WorkerStatus.IDLE, last_heartbeat=now)
+            return len(self._workers)
+
+    def update_heartbeat(self, worker_id: int, status: int) -> bool:
+        """Refresh timestamp + status; False if unknown worker
+        (reference: src/coordinator.cpp:19-31)."""
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is None:
+                return False
+            entry.last_heartbeat = self._time()
+            entry.status = status
+            return True
+
+    def list_workers(self) -> list[WorkerRegistryEntry]:
+        with self._lock:
+            return [dataclasses.replace(e) for e in self._workers.values()]
+
+    def live_worker_count(self) -> int:
+        with self._lock:
+            return len(self._workers)
+
+    def get_parameter_server_address(self) -> tuple[str, int]:
+        """Static config echo (reference: src/coordinator.cpp:46-50)."""
+        return self._ps_address, self._ps_port
+
+    def remove_stale_workers(self, timeout_s: float = 30.0) -> list[int]:
+        """Evict workers silent for > timeout_s
+        (reference: src/coordinator.cpp:52-67).  Returns evicted ids."""
+        now = self._time()
+        evicted: list[int] = []
+        with self._lock:
+            for wid in list(self._workers):
+                if now - self._workers[wid].last_heartbeat > timeout_s:
+                    del self._workers[wid]
+                    evicted.append(wid)
+        return evicted

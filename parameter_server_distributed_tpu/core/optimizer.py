@@ -1,0 +1,123 @@
+"""Host-side optimizers for the parameter-server update path.
+
+The reference applies a bare SGD step with an implicit learning rate of 1.0
+inside its aggregation routine ("param -= avg_grad",
+reference: src/parameter_server.cpp:77-91 with the comment "can add learning
+rate here" at :87).  Here the update rule is factored out and extended with
+momentum and Adam.  These run on the PS host over numpy stores — the
+device-side SPMD train path uses optax under jit instead
+(see parallel/train_step.py).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+from .tensor import TensorStore
+
+
+class HostOptimizer:
+    """Stateful optimizer over a named-tensor store."""
+
+    def __init__(self, learning_rate: float = 1.0):
+        self.learning_rate = learning_rate
+
+    def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
+        raise NotImplementedError
+
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, state: dict) -> None:
+        pass
+
+
+class SGD(HostOptimizer):
+    """param -= lr * grad — the reference's rule at lr=1.0."""
+
+    def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
+        lr = np.float32(self.learning_rate)
+        return {name: np.asarray(p, np.float32) - lr * np.asarray(grads[name], np.float32)
+                if name in grads else np.asarray(p, np.float32)
+                for name, p in params.items()}
+
+
+class Momentum(HostOptimizer):
+    def __init__(self, learning_rate: float = 1.0, momentum: float = 0.9):
+        super().__init__(learning_rate)
+        self.momentum = momentum
+        self.velocity: TensorStore = {}
+
+    def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
+        lr = np.float32(self.learning_rate)
+        mu = np.float32(self.momentum)
+        out: TensorStore = {}
+        for name, p in params.items():
+            p = np.asarray(p, np.float32)
+            if name not in grads:
+                out[name] = p
+                continue
+            g = np.asarray(grads[name], np.float32)
+            v = self.velocity.get(name)
+            v = mu * v + g if v is not None else g
+            self.velocity[name] = v
+            out[name] = p - lr * v
+        return out
+
+    def state_dict(self) -> dict:
+        return {"velocity": dict(self.velocity)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.velocity = dict(state.get("velocity", {}))
+
+
+class Adam(HostOptimizer):
+    def __init__(self, learning_rate: float = 1e-3, b1: float = 0.9,
+                 b2: float = 0.999, eps: float = 1e-8):
+        super().__init__(learning_rate)
+        self.b1, self.b2, self.eps = b1, b2, eps
+        self.m: TensorStore = {}
+        self.v: TensorStore = {}
+        self.step = 0
+
+    def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
+        self.step += 1
+        b1, b2 = np.float32(self.b1), np.float32(self.b2)
+        lr = np.float32(self.learning_rate)
+        bc1 = 1.0 - self.b1 ** self.step
+        bc2 = 1.0 - self.b2 ** self.step
+        out: TensorStore = {}
+        for name, p in params.items():
+            p = np.asarray(p, np.float32)
+            if name not in grads:
+                out[name] = p
+                continue
+            g = np.asarray(grads[name], np.float32)
+            m = self.m.get(name, np.zeros_like(g))
+            v = self.v.get(name, np.zeros_like(g))
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * (g * g)
+            self.m[name], self.v[name] = m, v
+            out[name] = p - lr * (m / bc1) / (np.sqrt(v / bc2) + self.eps)
+        return out
+
+    def state_dict(self) -> dict:
+        return {"m": dict(self.m), "v": dict(self.v), "step": self.step}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.m = dict(state.get("m", {}))
+        self.v = dict(state.get("v", {}))
+        self.step = int(state.get("step", 0))
+
+
+def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9) -> HostOptimizer:
+    name = name.lower()
+    if name == "sgd":
+        return SGD(learning_rate)
+    if name == "momentum":
+        return Momentum(learning_rate, momentum)
+    if name == "adam":
+        return Adam(learning_rate)
+    raise ValueError(f"unknown optimizer {name!r}")

@@ -1,0 +1,309 @@
+"""Parameter-server aggregation state machine.
+
+TPU-native re-design of the reference's `ParameterServerCore`
+(reference: src/parameter_server.cpp, include/parameter_server.h:23-52).
+Pure host-side logic — no I/O, no RPC — so it is unit-testable the way the
+reference never was.  Observable semantics preserved from the reference:
+
+- synchronous barrier: a gradient push is buffered per (iteration, worker);
+  when the number of distinct contributors reaches the barrier width the
+  per-element **mean over actual contributors** is taken and applied
+  (reference: src/parameter_server.cpp:18-75).
+- late pushes to an already-aggregated iteration succeed without
+  contributing (reference: src/parameter_server.cpp:28-30).
+- bootstrap: if the server holds no parameters, the first aggregated mean
+  gradient *becomes* the parameters (reference: src/parameter_server.cpp:78-81).
+- `serve_parameters` ignores the requested iteration and returns the latest
+  full parameter copy (reference: src/parameter_server.cpp:93-97).
+- `current_iteration` is the monotone max of iterations seen
+  (reference: src/parameter_server.cpp:22-24).
+
+Deliberate departures (bug fixes / extensions, flagged in SURVEY.md §7):
+
+- iteration states are garbage-collected (the reference grows
+  `iteration_states_` without bound).
+- the barrier width may be **elastic**: a live-worker provider (usually the
+  coordinator registry) can shrink/grow the barrier without restarting the
+  process (the reference restarts the PS on scale events —
+  scripts/scale_workers.sh:137-144 — losing in-memory state).
+- optional bounded-staleness asynchronous mode (staleness_bound > 0):
+  updates apply on arrival, gated on `current_iteration - iteration <= bound`;
+  the synchronous protocol is the special case bound == 0.
+- pluggable optimizer (the reference hardcodes lr=1.0 SGD).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Mapping
+
+import numpy as np
+
+from .optimizer import HostOptimizer, SGD
+from .tensor import TensorStore, tree_like
+
+
+class IterationState:
+    __slots__ = ("worker_gradients", "aggregated", "workers_at_aggregation")
+
+    def __init__(self):
+        self.worker_gradients: dict[int, TensorStore] = {}
+        self.aggregated = False
+        self.workers_at_aggregation = 0
+
+
+class PushResult:
+    """Result of a gradient push (mirrors PushResponse fields —
+    reference: proto/parameter_server.proto:26-33)."""
+    __slots__ = ("success", "message", "iteration", "aggregation_complete",
+                 "workers_received", "total_workers")
+
+    def __init__(self, success: bool, message: str, iteration: int,
+                 aggregation_complete: bool, workers_received: int,
+                 total_workers: int):
+        self.success = success
+        self.message = message
+        self.iteration = iteration
+        self.aggregation_complete = aggregation_complete
+        self.workers_received = workers_received
+        self.total_workers = total_workers
+
+
+class ParameterServerCore:
+    def __init__(self,
+                 total_workers: int = 2,
+                 optimizer: HostOptimizer | None = None,
+                 staleness_bound: int = 0,
+                 live_workers_fn: Callable[[], int] | None = None,
+                 gc_iterations: int = 64):
+        self._params: TensorStore = {}
+        self._params_lock = threading.Lock()   # reference: params_mutex_ (h:44)
+        self._state_lock = threading.Lock()    # reference: state_mutex_ (h:52)
+        self._iteration_states: "OrderedDict[int, IterationState]" = OrderedDict()
+        self._static_total_workers = int(total_workers)
+        self._live_workers_fn = live_workers_fn
+        self._optimizer = optimizer or SGD(learning_rate=1.0)
+        self._staleness_bound = int(staleness_bound)
+        self._gc_iterations = int(gc_iterations)
+        self._current_iteration = 0
+        self._epoch = 0
+        self._applied_updates = 0  # async mode: count of applied pushes
+        # Highest iteration whose aggregation has completed.  Needed so a
+        # straggler push for a GC'd iteration is recognized as late (no-op)
+        # instead of re-buffering a stale gradient into a fresh state.
+        self._aggregated_watermark = -1
+        # Lock order: _state_lock before _params_lock, everywhere.
+
+    # ------------------------------------------------------------------ props
+    @property
+    def synchronous(self) -> bool:
+        return self._staleness_bound == 0
+
+    @property
+    def current_iteration(self) -> int:
+        return self._current_iteration
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @epoch.setter
+    def epoch(self, value: int) -> None:
+        self._epoch = int(value)
+
+    def barrier_width(self) -> int:
+        """Current barrier width.  Elastic when a live-worker provider is
+        installed: the barrier follows live registrations instead of a
+        process-lifetime constant (reference fixes it at startup —
+        src/parameter_main.cpp:14-15)."""
+        if self._live_workers_fn is not None:
+            live = int(self._live_workers_fn())
+            if live > 0:
+                return live
+        return self._static_total_workers
+
+    def set_total_workers(self, n: int) -> None:
+        self._static_total_workers = int(n)
+
+    # ----------------------------------------------------------------- params
+    def initialize_parameters(self, params: Mapping[str, np.ndarray]) -> None:
+        with self._params_lock:
+            self._params = tree_like(params)
+
+    def get_parameters(self) -> TensorStore:
+        with self._params_lock:
+            return dict(self._params)
+
+    def serve_parameters(self, iteration: int = 0) -> tuple[int, TensorStore, bool]:
+        """Return (current_iteration, params copy, ready).  The iteration
+        argument is accepted and ignored, matching the reference
+        (src/parameter_server.cpp:93-97)."""
+        with self._params_lock:
+            params = dict(self._params)
+        return self._current_iteration, params, True
+
+    # ------------------------------------------------------------------- push
+    def receive_gradients(self, worker_id: int, iteration: int,
+                          gradients: Mapping[str, np.ndarray]) -> PushResult:
+        if self.synchronous:
+            return self._receive_sync(worker_id, iteration, gradients)
+        return self._receive_async(worker_id, iteration, gradients)
+
+    def _receive_sync(self, worker_id: int, iteration: int,
+                      gradients: Mapping[str, np.ndarray]) -> PushResult:
+        total = self.barrier_width()
+        with self._state_lock:
+            self._current_iteration = max(self._current_iteration, iteration)
+            state = self._iteration_states.get(iteration)
+            if state is None:
+                if iteration <= self._aggregated_watermark:
+                    # straggler push for a GC'd, already-aggregated iteration:
+                    # succeed without contributing (late-push invariant holds
+                    # across GC)
+                    return PushResult(True, "iteration already aggregated",
+                                      iteration, True, total, total)
+                state = IterationState()
+                self._iteration_states[iteration] = state
+                self._gc_locked()
+            if state.aggregated:
+                # late push: succeed without contributing
+                # (reference: src/parameter_server.cpp:28-30)
+                return PushResult(True, "iteration already aggregated", iteration,
+                                  True, state.workers_at_aggregation, total)
+            state.worker_gradients[worker_id] = tree_like(gradients)
+            received = self._maybe_aggregate_locked(iteration, state, total)
+            if state.aggregated:
+                return PushResult(True, "aggregation complete", iteration,
+                                  True, received, total)
+            return PushResult(True, "gradient received", iteration,
+                              False, received, total)
+
+    def _maybe_aggregate_locked(self, iteration: int, state: IterationState,
+                                total: int) -> int:
+        """Fire the barrier if the contributor count has reached the current
+        width.  Called from push AND from sync-status polls so that an
+        elastic barrier shrink (worker evicted mid-iteration) releases
+        already-buffered iterations instead of stranding them.  Caller holds
+        _state_lock.  Returns the contributor count."""
+        received = len(state.worker_gradients)
+        if not state.aggregated and received >= total and received > 0:
+            mean = _mean_over_workers(state.worker_gradients)
+            self._apply_update(mean)
+            state.aggregated = True
+            state.workers_at_aggregation = received
+            state.worker_gradients.clear()  # free gradient memory promptly
+            self._aggregated_watermark = max(self._aggregated_watermark, iteration)
+        return state.workers_at_aggregation if state.aggregated else received
+
+    def _receive_async(self, worker_id: int, iteration: int,
+                       gradients: Mapping[str, np.ndarray]) -> PushResult:
+        """Bounded-staleness apply-on-arrival (extension; no reference
+        analogue — the reference protocol is strictly synchronous)."""
+        with self._state_lock:
+            staleness = self._current_iteration - iteration
+            if staleness > self._staleness_bound:
+                return PushResult(False,
+                                  f"stale push: worker iteration {iteration} is "
+                                  f"{staleness} behind bound {self._staleness_bound}",
+                                  self._current_iteration, False, 0,
+                                  self.barrier_width())
+            self._apply_update(tree_like(gradients))
+            self._applied_updates += 1
+            # current_iteration stays the monotone max of worker iterations
+            # seen (matching the sync path); the applied-update count is the
+            # PS "version" and is tracked separately.
+            self._current_iteration = max(self._current_iteration, iteration)
+            return PushResult(True, "update applied", self._current_iteration,
+                              True, 1, self.barrier_width())
+
+    @property
+    def applied_updates(self) -> int:
+        """Async mode: number of updates applied (the PS version counter)."""
+        return self._applied_updates
+
+    def _apply_update(self, mean_grads: TensorStore) -> None:
+        with self._params_lock:
+            if not self._params:
+                # bootstrap quirk preserved from the reference (cpp:78-81)
+                self._params = dict(mean_grads)
+                return
+            self._params = self._optimizer.apply(self._params, mean_grads)
+
+    # ------------------------------------------------------------------- sync
+    def check_sync_status(self, iteration: int) -> tuple[int, bool, int, int]:
+        """Returns (iteration, ready, workers_received, total_workers)
+        (reference: src/parameter_server.cpp:99-110)."""
+        total = self.barrier_width()
+        if not self.synchronous:
+            return iteration, True, 1, total
+        with self._state_lock:
+            state = self._iteration_states.get(iteration)
+            if state is None:
+                if iteration <= self._aggregated_watermark:
+                    # aggregated long ago, state GC'd
+                    return iteration, True, total, total
+                return iteration, False, 0, total
+            # Re-evaluate the barrier here too: if the width shrank (worker
+            # evicted mid-iteration) a fully-buffered iteration must fire on
+            # the next poll rather than strand the surviving workers.
+            received = self._maybe_aggregate_locked(iteration, state, total)
+            if state.aggregated:
+                return iteration, True, state.workers_at_aggregation, total
+            return iteration, False, received, total
+
+    # --------------------------------------------------------------------- gc
+    def _gc_locked(self) -> None:
+        while len(self._iteration_states) > self._gc_iterations:
+            self._iteration_states.popitem(last=False)
+
+    @property
+    def tracked_iterations(self) -> int:
+        with self._state_lock:
+            return len(self._iteration_states)
+
+    # ------------------------------------------------------------- checkpoint
+    def snapshot(self) -> tuple[int, int, TensorStore]:
+        """Consistent (epoch, current_iteration, params) snapshot.  Takes
+        _state_lock before _params_lock so a concurrent push cannot produce a
+        torn view (iteration bumped but its update not yet applied)."""
+        with self._state_lock:
+            with self._params_lock:
+                return self._epoch, self._current_iteration, dict(self._params)
+
+    def optimizer_state(self) -> dict:
+        """Optimizer slot state (Momentum velocity / Adam moments), for
+        checkpointing alongside :meth:`snapshot`."""
+        with self._state_lock:
+            with self._params_lock:
+                return self._optimizer.state_dict()
+
+    def restore(self, epoch: int, iteration: int,
+                params: Mapping[str, np.ndarray],
+                optimizer_state: dict | None = None) -> None:
+        with self._state_lock:
+            with self._params_lock:
+                self._params = tree_like(params)
+                if optimizer_state is not None:
+                    self._optimizer.load_state_dict(optimizer_state)
+            self._epoch = int(epoch)
+            self._current_iteration = int(iteration)
+            self._iteration_states.clear()
+            self._aggregated_watermark = -1
+
+
+def _mean_over_workers(worker_gradients: Mapping[int, TensorStore]) -> TensorStore:
+    """Element-wise mean over the gradients of the workers that actually
+    contributed (reference: src/parameter_server.cpp:40-63 — sum then divide
+    by contributor count, NOT by configured total)."""
+    count = len(worker_gradients)
+    acc: TensorStore = {}
+    for grads in worker_gradients.values():
+        for name, g in grads.items():
+            g = np.asarray(g, np.float32)
+            if name in acc:
+                acc[name] = acc[name] + g
+            else:
+                acc[name] = g.copy()
+    inv = np.float32(1.0 / count)
+    return {name: g * inv for name, g in acc.items()}

@@ -1,0 +1,65 @@
+"""Unit tests for the coordinator membership registry."""
+
+from parameter_server_distributed_tpu.core.coordinator_core import CoordinatorCore
+from parameter_server_distributed_tpu.rpc.messages import WorkerStatus
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def make():
+    clock = FakeClock()
+    return CoordinatorCore("10.0.0.2", 50051, time_fn=clock), clock
+
+
+def test_register_upsert_and_count():
+    c, _ = make()
+    assert c.register_worker(0, "127.0.0.1", 50060, "h0") == 1
+    assert c.register_worker(1, "127.0.0.1", 50061, "h1") == 2
+    # re-register same id is an upsert, not a duplicate
+    assert c.register_worker(0, "127.0.0.1", 50070, "h0b") == 2
+    entries = {e.worker_id: e for e in c.list_workers()}
+    assert entries[0].port == 50070 and entries[0].hostname == "h0b"
+
+
+def test_heartbeat_updates_status_and_unknown_worker_fails():
+    c, clock = make()
+    c.register_worker(3, "a", 1, "h")
+    assert c.update_heartbeat(3, WorkerStatus.TRAINING)
+    assert c.list_workers()[0].status == WorkerStatus.TRAINING
+    assert not c.update_heartbeat(99, WorkerStatus.IDLE)
+
+
+def test_stale_eviction():
+    c, clock = make()
+    c.register_worker(0, "a", 1, "h0")
+    c.register_worker(1, "a", 2, "h1")
+    clock.t += 20
+    c.update_heartbeat(1, WorkerStatus.TRAINING)  # keep worker 1 fresh
+    clock.t += 15  # worker 0 now 35s stale, worker 1 15s
+    evicted = c.remove_stale_workers(timeout_s=30)
+    assert evicted == [0]
+    assert c.live_worker_count() == 1
+
+
+def test_ps_address_static_echo():
+    c, _ = make()
+    assert c.get_parameter_server_address() == ("10.0.0.2", 50051)
+
+
+def test_live_count_feeds_elastic_barrier():
+    from parameter_server_distributed_tpu.core.ps_core import ParameterServerCore
+    import numpy as np
+    c, clock = make()
+    c.register_worker(0, "a", 1, "h0")
+    c.register_worker(1, "a", 2, "h1")
+    ps = ParameterServerCore(total_workers=99, live_workers_fn=c.live_worker_count)
+    ps.initialize_parameters({"w": np.zeros(1, np.float32)})
+    ps.receive_gradients(0, 1, {"w": np.ones(1, np.float32)})
+    r = ps.receive_gradients(1, 1, {"w": np.ones(1, np.float32)})
+    assert r.aggregation_complete and r.total_workers == 2

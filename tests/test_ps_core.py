@@ -1,0 +1,255 @@
+"""Unit tests for the PS aggregation state machine.
+
+Covers the behaviors SURVEY.md §4 calls out as untested in the reference:
+barrier counting, mean-over-contributors, late-push idempotence, bootstrap
+from gradients, serve-latest semantics, iteration GC, elastic barrier width,
+and bounded-staleness async mode.
+"""
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.core.optimizer import SGD, Adam, Momentum, make_optimizer
+from parameter_server_distributed_tpu.core.ps_core import ParameterServerCore
+
+
+def store(**kw):
+    return {k: np.asarray(v, np.float32) for k, v in kw.items()}
+
+
+def test_barrier_counts_and_aggregates_at_width():
+    ps = ParameterServerCore(total_workers=3)
+    ps.initialize_parameters(store(w=[10.0, 10.0]))
+    r1 = ps.receive_gradients(0, 1, store(w=[1.0, 2.0]))
+    assert r1.success and not r1.aggregation_complete
+    assert r1.workers_received == 1 and r1.total_workers == 3
+    r2 = ps.receive_gradients(1, 1, store(w=[3.0, 4.0]))
+    assert not r2.aggregation_complete and r2.workers_received == 2
+    r3 = ps.receive_gradients(2, 1, store(w=[5.0, 6.0]))
+    assert r3.aggregation_complete and r3.workers_received == 3
+    # mean = [3, 4]; param -= mean (lr=1.0)
+    np.testing.assert_allclose(ps.get_parameters()["w"], [7.0, 6.0])
+
+
+def test_mean_over_actual_contributors_not_configured_total():
+    # If the barrier fires with duplicates-free count == width, mean divides
+    # by contributors (reference divides by gradient count, cpp:59-63)
+    ps = ParameterServerCore(total_workers=2)
+    ps.initialize_parameters(store(w=[0.0]))
+    ps.receive_gradients(0, 1, store(w=[2.0]))
+    ps.receive_gradients(1, 1, store(w=[4.0]))
+    np.testing.assert_allclose(ps.get_parameters()["w"], [-3.0])
+
+
+def test_same_worker_push_overwrites_not_double_counts():
+    ps = ParameterServerCore(total_workers=2)
+    ps.initialize_parameters(store(w=[0.0]))
+    ps.receive_gradients(0, 1, store(w=[100.0]))
+    r = ps.receive_gradients(0, 1, store(w=[2.0]))  # overwrite, still 1 worker
+    assert not r.aggregation_complete and r.workers_received == 1
+    ps.receive_gradients(1, 1, store(w=[4.0]))
+    np.testing.assert_allclose(ps.get_parameters()["w"], [-3.0])
+
+
+def test_late_push_succeeds_without_contributing():
+    ps = ParameterServerCore(total_workers=2)
+    ps.initialize_parameters(store(w=[0.0]))
+    ps.receive_gradients(0, 1, store(w=[2.0]))
+    ps.receive_gradients(1, 1, store(w=[4.0]))
+    before = ps.get_parameters()["w"].copy()
+    late = ps.receive_gradients(2, 1, store(w=[999.0]))
+    assert late.success and late.aggregation_complete
+    np.testing.assert_array_equal(ps.get_parameters()["w"], before)
+
+
+def test_bootstrap_params_from_first_aggregation():
+    # reference: src/parameter_server.cpp:78-81
+    ps = ParameterServerCore(total_workers=2)
+    ps.receive_gradients(0, 0, store(w=[2.0, 4.0]))
+    ps.receive_gradients(1, 0, store(w=[4.0, 8.0]))
+    np.testing.assert_allclose(ps.get_parameters()["w"], [3.0, 6.0])
+
+
+def test_serve_parameters_ignores_iteration_returns_latest():
+    # reference: src/parameter_server.cpp:93-97
+    ps = ParameterServerCore(total_workers=1)
+    ps.initialize_parameters(store(w=[1.0]))
+    ps.receive_gradients(0, 5, store(w=[1.0]))
+    it, params, ready = ps.serve_parameters(iteration=12345)
+    assert ready and it == 5
+    np.testing.assert_allclose(params["w"], [0.0])
+
+
+def test_current_iteration_monotone_max():
+    ps = ParameterServerCore(total_workers=2)
+    ps.receive_gradients(0, 7, store(w=[1.0]))
+    assert ps.current_iteration == 7
+    ps.receive_gradients(0, 3, store(w=[1.0]))
+    assert ps.current_iteration == 7
+
+
+def test_check_sync_status_lifecycle():
+    ps = ParameterServerCore(total_workers=2)
+    it, ready, recv, total = ps.check_sync_status(9)
+    assert (ready, recv, total) == (False, 0, 2)
+    ps.receive_gradients(0, 9, store(w=[1.0]))
+    _, ready, recv, _ = ps.check_sync_status(9)
+    assert (ready, recv) == (False, 1)
+    ps.receive_gradients(1, 9, store(w=[1.0]))
+    _, ready, recv, _ = ps.check_sync_status(9)
+    assert (ready, recv) == (True, 2)
+
+
+def test_iteration_state_gc_bounds_memory():
+    # the reference never GCs iteration_states_ (unbounded growth)
+    ps = ParameterServerCore(total_workers=1, gc_iterations=8)
+    for it in range(100):
+        ps.receive_gradients(0, it, store(w=[0.0]))
+    assert ps.tracked_iterations <= 8
+
+
+def test_elastic_barrier_width_tracks_live_workers():
+    live = {"n": 3}
+    ps = ParameterServerCore(total_workers=5, live_workers_fn=lambda: live["n"])
+    ps.initialize_parameters(store(w=[0.0]))
+    ps.receive_gradients(0, 1, store(w=[3.0]))
+    ps.receive_gradients(1, 1, store(w=[3.0]))
+    r = ps.receive_gradients(2, 1, store(w=[3.0]))
+    assert r.aggregation_complete  # barrier = 3 live, not 5 configured
+    live["n"] = 1
+    r2 = ps.receive_gradients(0, 2, store(w=[1.0]))
+    assert r2.aggregation_complete  # barrier shrank without restart
+
+
+def test_multiple_tensors_and_shapes():
+    ps = ParameterServerCore(total_workers=2)
+    ps.initialize_parameters(store(w=np.ones((2, 2)), b=np.zeros(3)))
+    ps.receive_gradients(0, 1, store(w=np.full((2, 2), 2.0), b=[1.0, 1.0, 1.0]))
+    ps.receive_gradients(1, 1, store(w=np.full((2, 2), 4.0), b=[3.0, 3.0, 3.0]))
+    p = ps.get_parameters()
+    np.testing.assert_allclose(p["w"], np.full((2, 2), -2.0))
+    np.testing.assert_allclose(p["b"], [-2.0, -2.0, -2.0])
+
+
+def test_snapshot_restore_roundtrip():
+    ps = ParameterServerCore(total_workers=1)
+    ps.initialize_parameters(store(w=[1.0, 2.0]))
+    ps.receive_gradients(0, 4, store(w=[0.5, 0.5]))
+    ps.epoch = 2
+    epoch, it, params = ps.snapshot()
+    ps2 = ParameterServerCore(total_workers=1)
+    ps2.restore(epoch, it, params)
+    assert ps2.epoch == 2 and ps2.current_iteration == 4
+    np.testing.assert_allclose(ps2.get_parameters()["w"], [0.5, 1.5])
+
+
+def test_elastic_shrink_releases_buffered_iteration_via_poll():
+    # Barrier=3; two workers push, then the third dies and the barrier
+    # shrinks to 2.  The next sync-status poll must fire the aggregation
+    # rather than strand the survivors.
+    live = {"n": 3}
+    ps = ParameterServerCore(total_workers=3, live_workers_fn=lambda: live["n"])
+    ps.initialize_parameters(store(w=[0.0]))
+    ps.receive_gradients(0, 1, store(w=[2.0]))
+    ps.receive_gradients(1, 1, store(w=[4.0]))
+    _, ready, _, _ = ps.check_sync_status(1)
+    assert not ready
+    live["n"] = 2  # worker 2 evicted
+    _, ready, recv, total = ps.check_sync_status(1)
+    assert ready and recv == 2 and total == 2
+    np.testing.assert_allclose(ps.get_parameters()["w"], [-3.0])
+
+
+def test_straggler_push_after_gc_is_noop():
+    # A push for a long-GC'd aggregated iteration must not re-apply a stale
+    # gradient through a freshly-created state.
+    ps = ParameterServerCore(total_workers=1, gc_iterations=4)
+    ps.initialize_parameters(store(w=[0.0]))
+    for it in range(20):
+        ps.receive_gradients(0, it, store(w=[0.0]))
+    before = ps.get_parameters()["w"].copy()
+    r = ps.receive_gradients(1, 2, store(w=[1000.0]))  # iteration 2 was GC'd
+    assert r.success and r.aggregation_complete
+    np.testing.assert_array_equal(ps.get_parameters()["w"], before)
+    # and its sync status reads ready, not stuck-forever
+    _, ready, _, _ = ps.check_sync_status(2)
+    assert ready
+
+
+def test_optimizer_state_survives_snapshot_restore():
+    opt = Adam(0.1)
+    ps = ParameterServerCore(total_workers=1, optimizer=opt)
+    ps.initialize_parameters(store(w=[1.0]))
+    ps.receive_gradients(0, 1, store(w=[0.5]))
+    epoch, it, params = ps.snapshot()
+    opt_state = ps.optimizer_state()
+    assert opt_state["step"] == 1
+
+    opt2 = Adam(0.1)
+    ps2 = ParameterServerCore(total_workers=1, optimizer=opt2)
+    ps2.restore(epoch, it, params, optimizer_state=opt_state)
+    ps.receive_gradients(0, 2, store(w=[0.5]))
+    ps2.receive_gradients(0, 2, store(w=[0.5]))
+    np.testing.assert_allclose(ps2.get_parameters()["w"],
+                               ps.get_parameters()["w"])
+
+
+# ---------------------------------------------------------------- async mode
+
+def test_async_applies_on_arrival():
+    ps = ParameterServerCore(total_workers=4, staleness_bound=2,
+                             optimizer=SGD(0.5))
+    ps.initialize_parameters(store(w=[10.0]))
+    r = ps.receive_gradients(0, 0, store(w=[2.0]))
+    assert r.success and r.aggregation_complete
+    np.testing.assert_allclose(ps.get_parameters()["w"], [9.0])
+    # current_iteration stays the monotone max of worker iterations seen;
+    # the applied-update count is the PS version
+    assert ps.current_iteration == 0 and ps.applied_updates == 1
+
+
+def test_async_rejects_stale_push():
+    ps = ParameterServerCore(total_workers=2, staleness_bound=1)
+    ps.initialize_parameters(store(w=[0.0]))
+    for i in range(5):
+        ps.receive_gradients(0, i, store(w=[0.0]))
+    stale = ps.receive_gradients(1, 0, store(w=[100.0]))
+    assert not stale.success and "stale" in stale.message
+    fresh_it = ps.current_iteration
+    ok = ps.receive_gradients(1, fresh_it, store(w=[1.0]))
+    assert ok.success
+
+
+def test_async_sync_status_always_ready():
+    ps = ParameterServerCore(total_workers=2, staleness_bound=3)
+    _, ready, _, _ = ps.check_sync_status(0)
+    assert ready
+
+
+# ---------------------------------------------------------------- optimizers
+
+def test_sgd_momentum_adam_steps():
+    p = store(w=[1.0])
+    g = store(w=[1.0])
+    sgd = SGD(0.1)
+    np.testing.assert_allclose(sgd.apply(p, g)["w"], [0.9])
+    mom = Momentum(0.1, momentum=0.5)
+    p1 = mom.apply(p, g)
+    p2 = mom.apply(p1, g)  # velocity = 1, then 1.5
+    np.testing.assert_allclose(p2["w"], [0.9 - 0.15], rtol=1e-6)
+    adam = Adam(0.1)
+    pa = adam.apply(p, g)
+    assert pa["w"][0] < 1.0
+    # state round-trips
+    st = adam.state_dict()
+    adam2 = Adam(0.1)
+    adam2.load_state_dict(st)
+    np.testing.assert_allclose(adam2.apply(pa, g)["w"], adam.apply(pa, g)["w"])
+
+
+def test_make_optimizer_factory():
+    assert isinstance(make_optimizer("sgd", 1.0), SGD)
+    assert isinstance(make_optimizer("momentum", 1.0), Momentum)
+    assert isinstance(make_optimizer("adam", 1e-3), Adam)
+    with pytest.raises(ValueError):
+        make_optimizer("lion", 1.0)

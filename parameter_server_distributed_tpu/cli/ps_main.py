@@ -1,0 +1,83 @@
+"""Parameter-server process entry point.
+
+Argv contract mirrors the reference (reference: src/parameter_main.cpp:6-18):
+
+    python -m parameter_server_distributed_tpu.cli.ps_main \
+        [bind_addr] [total_workers] [checkpoint_interval] [flags...]
+
+    bind_addr            default 0.0.0.0:50051
+    total_workers        default 2
+    checkpoint_interval  default 10 (iterations per checkpoint epoch)
+
+Extension flags beyond the reference:
+    --lr=F          learning rate (default 1.0, the reference's implicit lr)
+    --optimizer=S   sgd | momentum | adam
+    --staleness=N   bounded-staleness async mode (0 = synchronous)
+    --elastic       barrier width follows live registrations (needs
+                    --coordinator=ADDR to poll the registry)
+    --ckpt-dir=D    checkpoint directory (default .)
+    --keep=N        checkpoint retention
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from ..config import (DEFAULT_PS_PORT, ParameterServerConfig, parse_argv,
+                      parse_host_port)
+from ..server.ps_service import ParameterServer
+
+
+def build_config(argv: list[str]) -> tuple[ParameterServerConfig, str | None]:
+    positional, flags = parse_argv(argv)
+    bind = positional[0] if len(positional) > 0 else f"0.0.0.0:{DEFAULT_PS_PORT}"
+    host, port = parse_host_port(bind, DEFAULT_PS_PORT)
+    config = ParameterServerConfig(
+        bind_address=host, port=port,
+        total_workers=int(positional[1]) if len(positional) > 1 else 2,
+        checkpoint_interval=int(positional[2]) if len(positional) > 2 else 10,
+        learning_rate=float(flags.get("lr", 1.0)),
+        optimizer=flags.get("optimizer", "sgd"),
+        staleness_bound=int(flags.get("staleness", 0)),
+        elastic="elastic" in flags,
+        checkpoint_dir=flags.get("ckpt-dir", "."),
+        checkpoint_keep=int(flags.get("keep", 0)),
+    )
+    return config, flags.get("coordinator")
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    config, coordinator_addr = build_config(argv)
+
+    live_fn = None
+    if config.elastic and coordinator_addr:
+        from ..rpc import messages as m
+        from ..rpc.service import RpcClient
+        client = RpcClient(coordinator_addr, m.COORDINATOR_SERVICE,
+                           m.COORDINATOR_METHODS)
+
+        def live_fn() -> int:
+            try:
+                resp = client.call("ListWorkers", m.ListWorkersRequest(),
+                                   timeout=2.0)
+                return resp.total_workers
+            except Exception:  # noqa: BLE001 — registry unreachable: fall back
+                return 0
+
+    ps = ParameterServer(config, live_workers_fn=live_fn)
+    ps.start()
+    print(f"Parameter server listening on {config.bind_address}:{config.port}",
+          flush=True)
+    try:
+        ps.wait()
+    except KeyboardInterrupt:
+        ps.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

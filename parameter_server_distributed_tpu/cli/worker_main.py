@@ -1,0 +1,75 @@
+"""Worker process entry point.
+
+Argv contract mirrors the reference (reference: src/worker_main.cpp:6-18):
+
+    python -m parameter_server_distributed_tpu.cli.worker_main \
+        [coordinator_addr] [worker_id] [iterations] [worker_addr]
+        [worker_port] [checkpoint_path] [flags...]
+
+A non-empty checkpoint_path triggers a restore request at startup, tolerant
+of failure (reference: src/worker_main.cpp:28-38).
+
+Extension flags:
+    --model=NAME     model from the registry (default mnist_mlp)
+    --batch=N        per-worker batch size (default 32)
+    --seed=N         data seed (defaults to worker_id so shards differ)
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from ..config import WorkerConfig, parse_argv
+from ..data.synthetic import synthetic_mnist
+from ..models.mlp import MODEL_REGISTRY
+from ..worker.trainer import Trainer
+from ..worker.worker import Worker
+
+
+def build_worker(config: WorkerConfig, seed: int | None = None) -> Worker:
+    model = MODEL_REGISTRY[config.model]()
+    trainer = Trainer(model)
+    data_seed = config.worker_id if seed is None else seed
+    dataset = synthetic_mnist(seed=data_seed)
+    batches = dataset.batch_stream(config.batch_size, seed=data_seed)
+    return Worker(config, trainer, batches)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    positional, flags = parse_argv(argv)
+    config = WorkerConfig(
+        coordinator_address=positional[0] if len(positional) > 0 else "127.0.0.1:50052",
+        worker_id=int(positional[1]) if len(positional) > 1 else 0,
+        iterations=int(positional[2]) if len(positional) > 2 else 10,
+        address=positional[3] if len(positional) > 3 else "127.0.0.1",
+        port=int(positional[4]) if len(positional) > 4 else 50060,
+        checkpoint_path=positional[5] if len(positional) > 5 else "",
+        model=flags.get("model", "mnist_mlp"),
+        batch_size=int(flags.get("batch", 32)),
+    )
+    worker = build_worker(config, seed=int(flags["seed"]) if "seed" in flags else None)
+    worker.initialize()
+
+    if config.checkpoint_path:
+        # tolerant of failure, like the reference (src/worker_main.cpp:28-38)
+        try:
+            worker.load_checkpoint_from_server(config.checkpoint_path)
+        except Exception as exc:  # noqa: BLE001
+            logging.warning("checkpoint restore failed (continuing): %s", exc)
+
+    try:
+        for it in range(config.iterations):
+            loss = worker.run_iteration(it)
+            print(f"Worker {config.worker_id} completed iteration {it} "
+                  f"(loss {loss:.4f})", flush=True)
+    finally:
+        worker.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

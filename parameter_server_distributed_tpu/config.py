@@ -55,6 +55,7 @@ class ParameterServerConfig:
     momentum: float = 0.9
     staleness_bound: int = 0     # 0 = strictly synchronous (reference behavior)
     elastic: bool = False        # True: barrier width tracks live registrations
+    live_workers_ttl_s: float = 1.0  # cache TTL for the live-worker lookup
     gc_iterations: int = 64      # retain at most this many iteration states
     checkpoint_keep: int = 0     # retention: keep newest N checkpoint files (0 = keep all)
 
@@ -112,6 +113,15 @@ class MeshConfig:
 
 def env_or(name: str, default: str) -> str:
     return os.environ.get(name, default)
+
+
+def parse_argv(argv: Sequence[str]) -> tuple[list[str], dict[str, str]]:
+    """Split argv into (positional, flags): ``--k=v`` -> flags[k]=v,
+    bare ``--k`` -> flags[k]="1".  Shared by all CLI mains."""
+    positional = [a for a in argv if not a.startswith("--")]
+    flags = dict(f.lstrip("-").split("=", 1) if "=" in f else (f.lstrip("-"), "1")
+                 for f in argv if f.startswith("--"))
+    return positional, flags
 
 
 def parse_host_port(addr: str, default_port: int) -> tuple[str, int]:

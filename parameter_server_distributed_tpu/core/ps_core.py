@@ -35,6 +35,7 @@ Deliberate departures (bug fixes / extensions, flagged in SURVEY.md §7):
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Mapping
 
@@ -76,6 +77,7 @@ class ParameterServerCore:
                  optimizer: HostOptimizer | None = None,
                  staleness_bound: int = 0,
                  live_workers_fn: Callable[[], int] | None = None,
+                 live_workers_ttl_s: float = 0.0,
                  gc_iterations: int = 64):
         self._params: TensorStore = {}
         self._params_lock = threading.Lock()   # reference: params_mutex_ (h:44)
@@ -83,6 +85,8 @@ class ParameterServerCore:
         self._iteration_states: "OrderedDict[int, IterationState]" = OrderedDict()
         self._static_total_workers = int(total_workers)
         self._live_workers_fn = live_workers_fn
+        self._live_ttl = float(live_workers_ttl_s)
+        self._live_cache: tuple[int, float] = (0, 0.0)  # (value, expiry)
         self._optimizer = optimizer or SGD(learning_rate=1.0)
         self._staleness_bound = int(staleness_bound)
         self._gc_iterations = int(gc_iterations)
@@ -93,6 +97,9 @@ class ParameterServerCore:
         # straggler push for a GC'd iteration is recognized as late (no-op)
         # instead of re-buffering a stale gradient into a fresh state.
         self._aggregated_watermark = -1
+        # Async mode: iteration of the bootstrap push, so racing duplicate
+        # init pushes from other workers are recognized and dropped.
+        self._bootstrap_iteration: int | None = None
         # Lock order: _state_lock before _params_lock, everywhere.
 
     # ------------------------------------------------------------------ props
@@ -118,7 +125,13 @@ class ParameterServerCore:
         process-lifetime constant (reference fixes it at startup —
         src/parameter_main.cpp:14-15)."""
         if self._live_workers_fn is not None:
-            live = int(self._live_workers_fn())
+            live, expiry = self._live_cache
+            if self._live_ttl <= 0 or time.monotonic() >= expiry:
+                # TTL cache: the provider may be a remote registry RPC; the
+                # barrier width is read on every push and 20 Hz sync poll, so
+                # don't issue hot-path I/O for a value that changes in seconds
+                live = int(self._live_workers_fn())
+                self._live_cache = (live, time.monotonic() + self._live_ttl)
             if live > 0:
                 return live
         return self._static_total_workers
@@ -201,6 +214,25 @@ class ParameterServerCore:
         """Bounded-staleness apply-on-arrival (extension; no reference
         analogue — the reference protocol is strictly synchronous)."""
         with self._state_lock:
+            with self._params_lock:
+                params_empty = not self._params
+            if params_empty:
+                # bootstrap: the pushed payload becomes the parameters
+                self._apply_update(tree_like(gradients))
+                self._bootstrap_iteration = iteration
+                self._current_iteration = max(self._current_iteration, iteration)
+                return PushResult(True, "bootstrap applied",
+                                  self._current_iteration, True, 1,
+                                  self.barrier_width())
+            if (self._bootstrap_iteration is not None
+                    and iteration <= self._bootstrap_iteration):
+                # another worker raced the same bootstrap init push: without
+                # the sync barrier to dedup it, applying it as a gradient
+                # would compute params - lr*init (zero at the reference's
+                # lr=1.0).  Drop it; the worker re-pulls real params next.
+                return PushResult(True, "bootstrap duplicate ignored",
+                                  self._current_iteration, True, 0,
+                                  self.barrier_width())
             staleness = self._current_iteration - iteration
             if staleness > self._staleness_bound:
                 return PushResult(False,
@@ -290,6 +322,7 @@ class ParameterServerCore:
             self._current_iteration = int(iteration)
             self._iteration_states.clear()
             self._aggregated_watermark = -1
+            self._bootstrap_iteration = None
 
 
 def _mean_over_workers(worker_gradients: Mapping[int, TensorStore]) -> TensorStore:

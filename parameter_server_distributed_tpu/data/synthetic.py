@@ -1,0 +1,78 @@
+"""Synthetic in-memory datasets.
+
+The reference has no data pipeline (SURVEY.md §1: "no data pipeline").
+The framework's loaders are synthetic-by-default (this image has no
+network egress for dataset downloads) but deterministic and structured:
+class-conditional clusters so that training measurably reduces loss —
+enough signal for convergence tests and benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+class ClassClusterDataset:
+    """Gaussian class-cluster classification data (MNIST/CIFAR stand-in)."""
+
+    def __init__(self, num_features: int, num_classes: int,
+                 num_examples: int = 4096, seed: int = 0, scale: float = 2.0):
+        rng = np.random.default_rng(seed)
+        self.num_features = num_features
+        self.num_classes = num_classes
+        self.centers = rng.standard_normal((num_classes, num_features)).astype(np.float32)
+        labels = rng.integers(0, num_classes, size=num_examples)
+        noise = rng.standard_normal((num_examples, num_features)).astype(np.float32)
+        self.x = (scale * self.centers[labels] + noise).astype(np.float32)
+        self.y = labels.astype(np.int32)
+
+    def __len__(self) -> int:
+        return len(self.y)
+
+    def batches(self, batch_size: int, seed: int = 0,
+                drop_remainder: bool = True) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """One epoch of shuffled batches."""
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(self.y))
+        end = (len(order) // batch_size) * batch_size if drop_remainder else len(order)
+        for start in range(0, end, batch_size):
+            idx = order[start:start + batch_size]
+            yield self.x[idx], self.y[idx]
+
+    def batch_stream(self, batch_size: int, seed: int = 0
+                     ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Endless stream of batches (re-shuffles each epoch)."""
+        epoch = 0
+        while True:
+            yield from self.batches(batch_size, seed=seed + epoch)
+            epoch += 1
+
+
+def synthetic_mnist(num_examples: int = 4096, seed: int = 0) -> ClassClusterDataset:
+    return ClassClusterDataset(784, 10, num_examples, seed)
+
+
+def synthetic_cifar10(num_examples: int = 4096, seed: int = 0) -> ClassClusterDataset:
+    """Flat 32*32*3 features; image models reshape to NHWC."""
+    return ClassClusterDataset(32 * 32 * 3, 10, num_examples, seed)
+
+
+def synthetic_image_batches(batch_size: int, image_size: int = 32,
+                            channels: int = 3, num_classes: int = 10,
+                            seed: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Endless NHWC image batches for conv models."""
+    ds = ClassClusterDataset(image_size * image_size * channels, num_classes,
+                             num_examples=64 * batch_size if batch_size < 64 else 4096,
+                             seed=seed)
+    for x, y in ds.batch_stream(batch_size, seed=seed):
+        yield x.reshape(-1, image_size, image_size, channels), y
+
+
+def synthetic_tokens(batch_size: int, seq_len: int, vocab: int = 32000,
+                     seed: int = 0) -> Iterator[np.ndarray]:
+    """Endless [batch, seq_len] int32 token batches for LM training."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield rng.integers(0, vocab, size=(batch_size, seq_len), dtype=np.int32)

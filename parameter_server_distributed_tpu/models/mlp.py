@@ -1,0 +1,108 @@
+"""MLP model family.
+
+The reference has no model layer at all — its gradient computation is a
+stub filling every element with 0.01 (reference: src/worker.cpp:316-329).
+This framework replaces the stub with real jitted forward/backward.  The
+MLP family spans the MNIST-scale config (BASELINE config 1) up to the
+1B-parameter MLP used by the MFU target (BASELINE configs 3 and 5).
+
+Parameters live in a flat named store (dict[str, Array]) so they flow
+directly through the PS wire protocol, the checkpoint codec, and jitted
+steps without conversion.  Matmuls accumulate in float32 on the MXU via
+``preferred_element_type``; activations can be bfloat16.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MLP:
+    """Plain MLP with ReLU hidden layers and softmax cross-entropy loss."""
+
+    def __init__(self, layer_sizes: tuple[int, ...] = (784, 256, 10),
+                 dtype=jnp.float32):
+        if len(layer_sizes) < 2:
+            raise ValueError("need at least input and output sizes")
+        self.layer_sizes = tuple(int(s) for s in layer_sizes)
+        self.dtype = dtype
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.layer_sizes) - 1
+
+    def param_shapes(self) -> dict[str, tuple[int, ...]]:
+        shapes: dict[str, tuple[int, ...]] = {}
+        for i, (fan_in, fan_out) in enumerate(
+                zip(self.layer_sizes[:-1], self.layer_sizes[1:])):
+            shapes[f"layer{i}/w"] = (fan_in, fan_out)
+            shapes[f"layer{i}/b"] = (fan_out,)
+        return shapes
+
+    def num_params(self) -> int:
+        return sum(math.prod(s) for s in self.param_shapes().values())
+
+    def init_params(self, rng: jax.Array | int = 0) -> dict[str, jax.Array]:
+        if isinstance(rng, int):
+            rng = jax.random.key(rng)
+        params: dict[str, jax.Array] = {}
+        for name, shape in self.param_shapes().items():
+            rng, sub = jax.random.split(rng)
+            if name.endswith("/w"):
+                scale = math.sqrt(2.0 / shape[0])  # He init for ReLU
+                params[name] = (scale *
+                                jax.random.normal(sub, shape, self.dtype))
+            else:
+                params[name] = jnp.zeros(shape, self.dtype)
+        return params
+
+    def apply(self, params: Mapping[str, jax.Array], x: jax.Array) -> jax.Array:
+        """Forward pass -> logits.  x: [batch, features]."""
+        h = x.astype(self.dtype)
+        for i in range(self.num_layers):
+            w = params[f"layer{i}/w"]
+            b = params[f"layer{i}/b"]
+            h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b.astype(jnp.float32)
+            if i < self.num_layers - 1:
+                h = jax.nn.relu(h).astype(self.dtype)
+        return h  # float32 logits
+
+    def loss(self, params: Mapping[str, jax.Array], batch: tuple) -> jax.Array:
+        """Mean softmax cross-entropy over the batch."""
+        x, y = batch
+        logits = self.apply(params, x)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None].astype(jnp.int32), axis=-1)
+        return jnp.mean(nll)
+
+    def accuracy(self, params: Mapping[str, jax.Array], batch: tuple) -> jax.Array:
+        x, y = batch
+        return jnp.mean((jnp.argmax(self.apply(params, x), -1) == y)
+                        .astype(jnp.float32))
+
+
+def mnist_mlp() -> MLP:
+    """BASELINE config 1 model: 784-256-10 MNIST MLP."""
+    return MLP((784, 256, 10))
+
+
+def billion_param_mlp(hidden: int = 16384, layers: int = 4,
+                      dtype=jnp.bfloat16) -> MLP:
+    """~1B-parameter MLP for the MFU target (BASELINE configs 3/5).
+
+    4 hidden layers of 16384 units: 4 * 16384^2 + edges ≈ 1.1e9 params.
+    bfloat16 activations/weights with float32 MXU accumulation.
+    """
+    sizes = (hidden,) + (hidden,) * layers + (hidden,)
+    return MLP(sizes, dtype=dtype)
+
+
+MODEL_REGISTRY = {
+    "mnist_mlp": mnist_mlp,
+    "mlp_1b": billion_param_mlp,
+}

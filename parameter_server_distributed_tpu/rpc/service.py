@@ -1,0 +1,79 @@
+"""gRPC plumbing: generic service binding + typed clients.
+
+The reference generates C++ service/stub classes with grpc_cpp_plugin
+(reference: CMakeLists.txt:87-113).  Here the equivalent binding is done at
+runtime through gRPC's generic-handler API with the wire codec from
+`wire.py`, so no gencode is needed while remaining wire-compatible with the
+reference's services (method paths `/parameter_server.ParameterServer/<M>`
+and `/coordinator.Coordinator/<M>`).
+
+One deliberate departure: the reference opens a **fresh channel per call**
+on the worker hot path (reference: src/worker.cpp:241, 255, 275, 219) —
+connection setup per RPC.  Clients here hold one persistent channel.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any, Callable, Mapping
+
+import grpc
+
+from .wire import Message
+
+
+def bind_service(server: grpc.Server, service_name: str,
+                 methods: Mapping[str, tuple[type[Message], type[Message]]],
+                 impl: Any) -> None:
+    """Register ``impl`` on ``server``: for each method M, ``impl.M(request,
+    context)`` must exist and return the response message."""
+    handlers = {}
+    for method, (req_cls, resp_cls) in methods.items():
+        handlers[method] = grpc.unary_unary_rpc_method_handler(
+            getattr(impl, method),
+            request_deserializer=req_cls.decode,
+            response_serializer=lambda msg: msg.encode(),
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(service_name, handlers),))
+
+
+def make_server(max_workers: int = 8) -> grpc.Server:
+    return grpc.server(
+        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_send_message_length", 1 << 30),
+            ("grpc.max_receive_message_length", 1 << 30),
+        ])
+
+
+class RpcClient:
+    """Typed unary-unary client over one persistent insecure channel
+    (the reference uses insecure channels throughout —
+    src/worker.cpp:143, parameter_server_service.cpp:181)."""
+
+    def __init__(self, target: str, service_name: str,
+                 methods: Mapping[str, tuple[type[Message], type[Message]]]):
+        self._channel = grpc.insecure_channel(target, options=[
+            ("grpc.max_send_message_length", 1 << 30),
+            ("grpc.max_receive_message_length", 1 << 30),
+        ])
+        self._calls: dict[str, Callable] = {}
+        for method, (req_cls, resp_cls) in methods.items():
+            self._calls[method] = self._channel.unary_unary(
+                f"/{service_name}/{method}",
+                request_serializer=lambda msg: msg.encode(),
+                response_deserializer=resp_cls.decode,
+            )
+
+    def call(self, method: str, request: Message, timeout: float | None = None):
+        return self._calls[method](request, timeout=timeout)
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

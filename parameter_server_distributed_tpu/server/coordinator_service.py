@@ -1,0 +1,102 @@
+"""Coordinator gRPC service + stale-worker reaper.
+
+Wraps `CoordinatorCore` in the 4-RPC service of the reference
+(reference: src/coordinator_service.cpp:26-112, proto/coordinator.proto:5-10)
+and runs the cleanup thread (every 10 s evict workers silent > 30 s —
+reference: src/coordinator_service.cpp:102-107).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import grpc
+
+from ..config import CoordinatorConfig
+from ..core.coordinator_core import CoordinatorCore
+from ..rpc import messages as m
+from ..rpc.service import bind_service, make_server
+
+log = logging.getLogger("pst.coordinator")
+
+
+class CoordinatorService:
+    def __init__(self, core: CoordinatorCore):
+        self.core = core
+
+    # reference: src/coordinator_service.cpp:39-61
+    def RegisterWorker(self, request: m.WorkerInfo, context) -> m.RegisterResponse:
+        total = self.core.register_worker(request.worker_id, request.address,
+                                          request.port, request.hostname)
+        ps_addr, ps_port = self.core.get_parameter_server_address()
+        log.info("registered worker %d (%s:%d), total=%d",
+                 request.worker_id, request.address, request.port, total)
+        return m.RegisterResponse(success=True, message="registered",
+                                  parameter_server_address=f"{ps_addr}:{ps_port}",
+                                  total_workers=total)
+
+    # reference: src/coordinator_service.cpp:63-72
+    def Heartbeat(self, request: m.HeartbeatRequest, context) -> m.HeartbeatResponse:
+        ok = self.core.update_heartbeat(request.worker_id, request.status)
+        return m.HeartbeatResponse(success=ok, timestamp=int(time.time() * 1000))
+
+    # reference: src/coordinator_service.cpp:74-88
+    def ListWorkers(self, request: m.ListWorkersRequest, context) -> m.ListWorkersResponse:
+        entries = self.core.list_workers()
+        return m.ListWorkersResponse(
+            workers=[m.WorkerInfo(worker_id=e.worker_id, address=e.address,
+                                  port=e.port, hostname=e.hostname)
+                     for e in entries],
+            total_workers=len(entries))
+
+    # reference: src/coordinator_service.cpp:90-99
+    def GetParameterServerAddress(self, request: m.GetPSAddressRequest,
+                                  context) -> m.GetPSAddressResponse:
+        addr, port = self.core.get_parameter_server_address()
+        return m.GetPSAddressResponse(address=addr, port=port)
+
+
+class Coordinator:
+    """Process-level assembly (reference: run_coordinator_server at
+    src/coordinator_service.cpp:114-126)."""
+
+    def __init__(self, config: CoordinatorConfig):
+        self.config = config
+        self.core = CoordinatorCore(config.ps_address, config.ps_port)
+        self.service = CoordinatorService(self.core)
+        self._server: grpc.Server | None = None
+        self._stop = threading.Event()
+        self._reaper: threading.Thread | None = None
+
+    def start(self) -> int:
+        self._server = make_server()
+        bind_service(self._server, m.COORDINATOR_SERVICE,
+                     m.COORDINATOR_METHODS, self.service)
+        addr = f"{self.config.bind_address}:{self.config.port}"
+        self._port = self._server.add_insecure_port(addr)
+        if self._port == 0:
+            raise RuntimeError(f"could not bind {addr}")
+        self._server.start()
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
+                                        name="coordinator-reaper")
+        self._reaper.start()
+        log.info("coordinator listening on %s (ps=%s:%d)", addr,
+                 self.config.ps_address, self.config.ps_port)
+        return self._port
+
+    def _reap_loop(self) -> None:
+        while not self._stop.wait(self.config.reap_period_s):
+            evicted = self.core.remove_stale_workers(self.config.stale_timeout_s)
+            for wid in evicted:
+                log.warning("evicted stale worker %d", wid)
+
+    def wait(self) -> None:
+        assert self._server is not None
+        self._server.wait_for_termination()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(grace).wait()

@@ -1,0 +1,142 @@
+"""Parameter-server gRPC service.
+
+Wraps `ParameterServerCore` in the 5-RPC service of the reference
+(reference: src/parameter_server_service.cpp, proto/parameter_server.proto:5-11)
+and runs the periodic checkpoint daemon
+(reference: src/parameter_server_service.cpp:150-169) via CheckpointManager.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable
+
+import grpc
+
+from ..checkpoint.manager import CheckpointManager
+from ..config import ParameterServerConfig
+from ..core.optimizer import make_optimizer
+from ..core.ps_core import ParameterServerCore
+from ..core.tensor import from_wire, to_wire
+from ..rpc import messages as m
+from ..rpc.service import bind_service, make_server
+
+log = logging.getLogger("pst.ps")
+
+
+class ParameterServerService:
+    """RPC handlers (reference: parameter_server_service_impl,
+    src/parameter_server_service.cpp:15-175)."""
+
+    def __init__(self, core: ParameterServerCore, ckpt: CheckpointManager):
+        self.core = core
+        self.ckpt = ckpt
+
+    # RPC: push gradients (reference: src/parameter_server_service.cpp:32-59)
+    def ReceiveGradients(self, request: m.GradientUpdate, context) -> m.PushResponse:
+        grads = from_wire(request.gradients)
+        result = self.core.receive_gradients(request.worker_id,
+                                             request.iteration, grads)
+        return m.PushResponse(
+            success=result.success,
+            message=result.message,
+            iteration=result.iteration,
+            aggregation_complete=result.aggregation_complete,
+            workers_received=result.workers_received,
+            total_workers=result.total_workers,
+        )
+
+    # RPC: pull parameters (reference: src/parameter_server_service.cpp:62-84)
+    def ServeParameters(self, request: m.PullRequest, context) -> m.ParameterUpdate:
+        iteration, params, ready = self.core.serve_parameters(request.iteration)
+        return m.ParameterUpdate(iteration=iteration,
+                                 parameters=to_wire(params), ready=ready)
+
+    # RPC: barrier poll (reference: src/parameter_server_service.cpp:85-95)
+    def CheckSyncStatus(self, request: m.SyncStatusRequest, context) -> m.SyncStatusResponse:
+        iteration, ready, received, total = self.core.check_sync_status(request.iteration)
+        return m.SyncStatusResponse(iteration=iteration, ready=ready,
+                                    workers_received=received, total_workers=total)
+
+    # RPC: on-demand save (reference: src/parameter_server_service.cpp:97-115)
+    def SaveCheckpoint(self, request: m.SaveCheckpointRequest, context) -> m.SaveCheckpointResponse:
+        try:
+            saved = self.ckpt.save(epoch=request.epoch if request.epoch else None,
+                                   path=request.path or None)
+            return m.SaveCheckpointResponse(success=True, message="checkpoint saved",
+                                            checkpoint_path=saved)
+        except Exception as exc:  # noqa: BLE001 — report failure over RPC
+            log.exception("SaveCheckpoint failed")
+            return m.SaveCheckpointResponse(success=False, message=str(exc))
+
+    # RPC: load into the PS; response ships the params back as the reference
+    # does (src/parameter_server_service.cpp:126-137) even though its worker
+    # discards them (src/worker.cpp:311-313)
+    def LoadCheckpoint(self, request: m.LoadCheckpointRequest, context) -> m.LoadCheckpointResponse:
+        try:
+            epoch, _iteration = self.ckpt.load(request.path)
+            _, params, _ = self.core.serve_parameters()
+            return m.LoadCheckpointResponse(success=True, message="checkpoint loaded",
+                                            epoch=epoch, parameters=to_wire(params))
+        except Exception as exc:  # noqa: BLE001
+            log.exception("LoadCheckpoint failed")
+            return m.LoadCheckpointResponse(success=False, message=str(exc))
+
+
+class ParameterServer:
+    """Process-level assembly: core + checkpoint daemon + gRPC server
+    (reference: run_server at src/parameter_server_service.cpp:177-191)."""
+
+    def __init__(self, config: ParameterServerConfig,
+                 live_workers_fn: Callable[[], int] | None = None):
+        self.config = config
+        optimizer = make_optimizer(config.optimizer, config.learning_rate,
+                                   config.momentum)
+        self.core = ParameterServerCore(
+            total_workers=config.total_workers,
+            optimizer=optimizer,
+            staleness_bound=config.staleness_bound,
+            live_workers_fn=live_workers_fn if config.elastic else None,
+            live_workers_ttl_s=config.live_workers_ttl_s,
+            gc_iterations=config.gc_iterations,
+        )
+        self.ckpt = CheckpointManager(
+            self.core,
+            directory=config.checkpoint_dir,
+            checkpoint_interval=config.checkpoint_interval,
+            check_period_s=config.autosave_period_s,
+            keep=config.checkpoint_keep,
+        )
+        self.service = ParameterServerService(self.core, self.ckpt)
+        self._server: grpc.Server | None = None
+
+    @property
+    def bound_port(self) -> int:
+        return self._port
+
+    def start(self) -> int:
+        """Start serving; returns the bound port (0 in config = ephemeral)."""
+        self._server = make_server()
+        bind_service(self._server, m.PARAMETER_SERVER_SERVICE,
+                     m.PARAMETER_SERVER_METHODS, self.service)
+        addr = f"{self.config.bind_address}:{self.config.port}"
+        self._port = self._server.add_insecure_port(addr)
+        if self._port == 0:
+            raise RuntimeError(f"could not bind {addr}")
+        self._server.start()
+        self.ckpt.start()
+        log.info("parameter server listening on %s (total_workers=%d, "
+                 "checkpoint_interval=%d)", addr, self.config.total_workers,
+                 self.config.checkpoint_interval)
+        return self._port
+
+    def wait(self) -> None:
+        assert self._server is not None
+        self._server.wait_for_termination()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self.ckpt.stop()
+        if self._server is not None:
+            self._server.stop(grace).wait()

@@ -1,0 +1,266 @@
+"""Worker runtime: discovery, registration, heartbeats, train loop.
+
+Re-design of the reference `Worker` (reference: src/worker.cpp,
+include/worker.h:25-33).  Protocol behavior preserved:
+
+- discovery: ask the coordinator for the PS address, then register
+  (reference: src/worker.cpp:108-122, 141-186)
+- `query_with_retry`: up to 5 attempts, exponential backoff 100 ms * 2^n
+  (reference: src/worker.cpp:129-139)
+- heartbeat thread every 5 s reporting WorkerStatus
+  (reference: src/worker.cpp:231-238)
+- run_iteration: pull -> compute -> push -> poll sync status every 50 ms up
+  to 200 polls, 3 outer retries (reference: src/worker.cpp:331-406)
+- `reconnect()` re-runs discovery+registration (reference: src/worker.cpp:124-127)
+- checkpoint restore request at startup (reference: src/worker.cpp:289-314)
+
+Departures:
+
+- gradients come from a real jitted model step (Trainer), not the 0.01 stub;
+- when the PS holds no parameters yet, the worker seeds it with a
+  deterministic model init instead of fabricating a dummy 10x10 tensor
+  (reference: src/worker.cpp:346-353);
+- one persistent channel per peer instead of a fresh channel per call.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Callable, Iterator
+
+import grpc
+import numpy as np
+
+from ..config import WorkerConfig
+from ..core.tensor import TensorStore, from_wire, to_wire
+from ..rpc import messages as m
+from ..rpc.service import RpcClient
+
+log = logging.getLogger("pst.worker")
+
+
+class WorkerError(RuntimeError):
+    pass
+
+
+class Worker:
+    def __init__(self, config: WorkerConfig, trainer,
+                 batches: Iterator, start_heartbeat: bool = True):
+        self.config = config
+        self.trainer = trainer
+        self.batches = batches
+        self.status = m.WorkerStatus.IDLE
+        self.iteration = 0
+        self.last_loss = float("nan")
+        self._coordinator = RpcClient(config.coordinator_address,
+                                      m.COORDINATOR_SERVICE, m.COORDINATOR_METHODS)
+        self._ps: RpcClient | None = None
+        self._ps_address: str | None = None
+        self._total_workers = 0
+        self._stop = threading.Event()
+        self._heartbeat_thread: threading.Thread | None = None
+        if start_heartbeat:
+            self._heartbeat_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name=f"worker-{config.worker_id}-heartbeat")
+            self._heartbeat_thread.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def initialize(self) -> None:
+        """Discover PS + register (reference: src/worker.cpp:108-122)."""
+        self._discover_parameter_server()
+        self._register()
+
+    def reconnect(self) -> None:
+        """reference: src/worker.cpp:124-127."""
+        self.initialize()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._heartbeat_thread is not None:
+            self._heartbeat_thread.join(timeout=2.0)
+        self._coordinator.close()
+        if self._ps is not None:
+            self._ps.close()
+
+    # ------------------------------------------------------------ discovery
+    def _discover_parameter_server(self) -> None:
+        resp = self.query_with_retry(
+            lambda: self._coordinator.call("GetParameterServerAddress",
+                                           m.GetPSAddressRequest(), timeout=5.0))
+        self._ps_address = f"{resp.address}:{resp.port}"
+        if self._ps is not None:
+            self._ps.close()
+        self._ps = RpcClient(self._ps_address, m.PARAMETER_SERVER_SERVICE,
+                             m.PARAMETER_SERVER_METHODS)
+        log.info("worker %d: PS at %s", self.config.worker_id, self._ps_address)
+
+    def _register(self) -> None:
+        info = m.WorkerInfo(worker_id=self.config.worker_id,
+                            address=self.config.address,
+                            port=self.config.port,
+                            hostname=socket.gethostname())
+        resp = self.query_with_retry(
+            lambda: self._coordinator.call("RegisterWorker", info, timeout=5.0))
+        if not resp.success:
+            raise WorkerError(f"registration rejected: {resp.message}")
+        self._total_workers = resp.total_workers
+        log.info("worker %d registered (%d total)", self.config.worker_id,
+                 resp.total_workers)
+
+    # -------------------------------------------------------------- retries
+    def query_with_retry(self, fn: Callable, attempts: int | None = None):
+        """Exponential backoff wrapper (reference: src/worker.cpp:129-139)."""
+        attempts = attempts or self.config.retry_max_attempts
+        delay = self.config.retry_base_delay_s
+        last_exc: Exception | None = None
+        for attempt in range(attempts):
+            try:
+                return fn()
+            except grpc.RpcError as exc:
+                last_exc = exc
+                if attempt < attempts - 1:
+                    time.sleep(delay)
+                    delay *= 2
+        raise WorkerError(f"RPC failed after {attempts} attempts: {last_exc}")
+
+    # ------------------------------------------------------------ heartbeat
+    def _heartbeat_loop(self) -> None:
+        """reference: src/worker.cpp:231-238.  Extension: if the coordinator
+        no longer knows this worker (evicted after a long jit compile or a
+        coordinator restart), re-register so the elastic barrier counts us
+        again — the reference never calls its own reconnect()."""
+        while not self._stop.wait(self.config.heartbeat_period_s):
+            ok = self.send_heartbeat()
+            if ok is False and self._total_workers > 0:
+                log.warning("worker %d: heartbeat rejected, re-registering",
+                            self.config.worker_id)
+                try:
+                    self._register()
+                except WorkerError as exc:
+                    log.warning("worker %d: re-registration failed: %s",
+                                self.config.worker_id, exc)
+
+    def send_heartbeat(self) -> bool | None:
+        """True = accepted, False = coordinator rejected (unknown worker),
+        None = coordinator unreachable."""
+        try:
+            resp = self._coordinator.call(
+                "Heartbeat",
+                m.HeartbeatRequest(worker_id=self.config.worker_id,
+                                   status=self.status),
+                timeout=5.0)
+            return resp.success
+        except grpc.RpcError:
+            return None
+
+    # ------------------------------------------------------------ data plane
+    def pull_parameters(self, iteration: int) -> tuple[int, TensorStore]:
+        """reference: src/worker.cpp:240-252."""
+        resp = self.query_with_retry(
+            lambda: self._ps.call("ServeParameters",
+                                  m.PullRequest(worker_id=self.config.worker_id,
+                                                iteration=iteration),
+                                  timeout=30.0))
+        return resp.iteration, from_wire(resp.parameters)
+
+    def push_gradients(self, iteration: int, grads: TensorStore) -> m.PushResponse:
+        """reference: src/worker.cpp:254-272."""
+        update = m.GradientUpdate(worker_id=self.config.worker_id,
+                                  iteration=iteration,
+                                  gradients=to_wire(grads))
+        return self.query_with_retry(
+            lambda: self._ps.call("ReceiveGradients", update, timeout=30.0))
+
+    def check_sync_ready(self, iteration: int) -> m.SyncStatusResponse:
+        """reference: src/worker.cpp:274-287."""
+        return self.query_with_retry(
+            lambda: self._ps.call("CheckSyncStatus",
+                                  m.SyncStatusRequest(iteration=iteration),
+                                  timeout=5.0))
+
+    # ------------------------------------------------------------ train loop
+    def run_iteration(self, iteration: int) -> float:
+        """One pull -> compute -> push -> barrier cycle
+        (reference: src/worker.cpp:331-406).  Returns the loss."""
+        self.status = m.WorkerStatus.TRAINING
+        try:
+            _, params = self.pull_parameters(iteration)
+            if not params:
+                # PS empty: every worker pushes the same deterministic init;
+                # the PS bootstrap rule (first aggregated payload *becomes*
+                # the parameters — reference src/parameter_server.cpp:78-81)
+                # then lands exactly the init.  Replaces the reference's
+                # dummy 10x10 fallback (src/worker.cpp:346-353).
+                init = self.trainer.init_params(seed=0)
+                log.info("worker %d: PS empty, pushing deterministic init",
+                         self.config.worker_id)
+                push = self.push_gradients(iteration, init)
+                if not push.success:
+                    raise WorkerError(f"bootstrap push rejected: {push.message}")
+                if not push.aggregation_complete:
+                    self._await_barrier(iteration)
+                self.iteration = iteration
+                return float("nan")
+
+            batch = next(self.batches)
+            grads, loss = self.trainer.compute_gradients(params, batch)
+            self.last_loss = loss
+
+            push = self.push_gradients(iteration, grads)
+            if not push.success:
+                raise WorkerError(f"push rejected: {push.message}")
+            if not push.aggregation_complete:
+                self._await_barrier(iteration)
+            self.iteration = iteration
+            return loss
+        finally:
+            self.status = m.WorkerStatus.IDLE
+
+    def _await_barrier(self, iteration: int) -> None:
+        """Poll CheckSyncStatus: 50 ms period, <=200 polls, 3 outer retries
+        (reference: src/worker.cpp:372-389)."""
+        for outer in range(self.config.sync_outer_retries):
+            for _ in range(self.config.sync_poll_max):
+                resp = self.check_sync_ready(iteration)
+                if resp.ready:
+                    return
+                time.sleep(self.config.sync_poll_period_s)
+            log.warning("worker %d: barrier timeout at iteration %d "
+                        "(%d/%d received), retry %d",
+                        self.config.worker_id, iteration,
+                        resp.workers_received, resp.total_workers, outer + 1)
+            time.sleep(0.5)
+        raise WorkerError(f"barrier never completed for iteration {iteration}")
+
+    def run(self, iterations: int | None = None) -> None:
+        """Full training run (reference: src/worker_main.cpp:40-43)."""
+        total = iterations if iterations is not None else self.config.iterations
+        for it in range(total):
+            loss = self.run_iteration(it)
+            log.info("worker %d iteration %d loss %.4f",
+                     self.config.worker_id, it, loss)
+
+    # ------------------------------------------------------------ checkpoint
+    def load_checkpoint_from_server(self, path: str) -> bool:
+        """Ask the PS to load a checkpoint into itself
+        (reference: src/worker.cpp:289-314 — the worker does not keep the
+        returned parameter copy)."""
+        self.status = m.WorkerStatus.CHECKPOINTING
+        try:
+            resp = self.query_with_retry(
+                lambda: self._ps.call("LoadCheckpoint",
+                                      m.LoadCheckpointRequest(path=path),
+                                      timeout=60.0))
+            if resp.success:
+                log.info("worker %d: PS restored checkpoint %s (epoch %d)",
+                         self.config.worker_id, path, resp.epoch)
+            else:
+                log.warning("worker %d: checkpoint restore failed: %s",
+                            self.config.worker_id, resp.message)
+            return resp.success
+        finally:
+            self.status = m.WorkerStatus.IDLE

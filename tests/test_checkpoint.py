@@ -1,0 +1,126 @@
+"""Checkpoint codec + manager tests.
+
+The binary layout must match the reference's custom format
+(reference: src/parameter_server.cpp:112-188) byte-for-byte:
+epoch(i32) iter(i32) n(u64) then per tensor
+name_len(u64)+name shape_len(u64)+shape(i32[]) dtype(i32) data_len(u64)+f32[].
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.checkpoint import codec
+from parameter_server_distributed_tpu.checkpoint.manager import (
+    CheckpointManager, checkpoint_filename)
+from parameter_server_distributed_tpu.core.optimizer import Adam
+from parameter_server_distributed_tpu.core.ps_core import ParameterServerCore
+
+
+def test_layout_matches_reference_format():
+    params = {"w": np.array([[1.5, 2.5]], np.float32)}
+    blob = codec.dumps(epoch=3, iteration=42, params=params)
+    expected = b"".join([
+        struct.pack("<i", 3),
+        struct.pack("<i", 42),
+        struct.pack("<Q", 1),
+        struct.pack("<Q", 1), b"w",
+        struct.pack("<Q", 2), struct.pack("<i", 1), struct.pack("<i", 2),
+        struct.pack("<i", 0),
+        struct.pack("<Q", 2), np.array([1.5, 2.5], "<f4").tobytes(),
+    ])
+    assert blob == expected
+
+
+def test_roundtrip_multi_tensor(rng):
+    params = {
+        "layer0/w": rng.standard_normal((8, 4)).astype(np.float32),
+        "layer0/b": rng.standard_normal(4).astype(np.float32),
+        "scalarish": np.array([7.0], np.float32),
+    }
+    epoch, it, out = codec.loads(codec.dumps(11, 230, params))
+    assert (epoch, it) == (11, 230)
+    assert set(out) == set(params)
+    for k in params:
+        np.testing.assert_array_equal(out[k], params[k])
+        assert out[k].shape == params[k].shape
+
+
+def test_truncated_checkpoint_rejected():
+    blob = codec.dumps(1, 2, {"w": np.ones(5, np.float32)})
+    with pytest.raises(ValueError, match="truncated"):
+        codec.loads(blob[:-4])
+
+
+def test_bad_dtype_rejected():
+    blob = bytearray(codec.dumps(1, 2, {"w": np.ones(1, np.float32)}))
+    # dtype field sits after: 4+4+8 + 8+1 + 8+4 = 37
+    blob[37:41] = struct.pack("<i", 9)
+    with pytest.raises(ValueError, match="dtype"):
+        codec.loads(bytes(blob))
+
+
+def test_atomic_save_leaves_no_tmp(tmp_path):
+    path = str(tmp_path / "c.ckpt")
+    codec.save(path, 1, 2, {"w": np.ones(3, np.float32)})
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+    epoch, it, params = codec.load(path)
+    assert (epoch, it) == (1, 2)
+
+
+def make_core_with_params(iteration=0):
+    core = ParameterServerCore(total_workers=1)
+    core.initialize_parameters({"w": np.array([1.0, 2.0], np.float32)})
+    if iteration:
+        core.receive_gradients(0, iteration, {"w": np.zeros(2, np.float32)})
+    return core
+
+
+def test_manager_autosave_epoch_math(tmp_path):
+    core = make_core_with_params(iteration=25)
+    mgr = CheckpointManager(core, directory=str(tmp_path), checkpoint_interval=10)
+    path = mgr.maybe_autosave()  # epoch = 25 // 10 = 2
+    assert path and path.endswith(checkpoint_filename(2))
+    assert os.path.exists(path)
+    # no epoch advance -> no new save
+    assert mgr.maybe_autosave() is None
+    # advance past epoch 3
+    core.receive_gradients(0, 31, {"w": np.zeros(2, np.float32)})
+    path2 = mgr.maybe_autosave()
+    assert path2 and path2.endswith(checkpoint_filename(3))
+
+
+def test_manager_retention_keeps_newest(tmp_path):
+    core = make_core_with_params()
+    mgr = CheckpointManager(core, directory=str(tmp_path),
+                            checkpoint_interval=1, keep=2)
+    for epoch in range(5):
+        mgr.save(epoch=epoch)
+    remaining = sorted(os.listdir(tmp_path))
+    assert remaining == [checkpoint_filename(3), checkpoint_filename(4)]
+    assert mgr.latest().endswith(checkpoint_filename(4))
+
+
+def test_manager_load_restores_core_and_optimizer(tmp_path):
+    opt = Adam(0.1)
+    core = ParameterServerCore(total_workers=1, optimizer=opt)
+    core.initialize_parameters({"w": np.array([5.0], np.float32)})
+    core.receive_gradients(0, 9, {"w": np.array([1.0], np.float32)})
+    mgr = CheckpointManager(core, directory=str(tmp_path), checkpoint_interval=3)
+    path = mgr.save()
+    assert os.path.exists(path + ".opt.npz")
+
+    core2 = ParameterServerCore(total_workers=1, optimizer=Adam(0.1))
+    mgr2 = CheckpointManager(core2, directory=str(tmp_path), checkpoint_interval=3)
+    epoch, it = mgr2.load(path)
+    assert it == 9
+    np.testing.assert_allclose(core2.get_parameters()["w"],
+                               core.get_parameters()["w"])
+    # identical post-restore updates => identical Adam trajectories
+    core.receive_gradients(0, 10, {"w": np.array([1.0], np.float32)})
+    core2.receive_gradients(0, 10, {"w": np.array([1.0], np.float32)})
+    np.testing.assert_allclose(core2.get_parameters()["w"],
+                               core.get_parameters()["w"])

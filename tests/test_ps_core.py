@@ -220,6 +220,21 @@ def test_async_rejects_stale_push():
     assert ok.success
 
 
+def test_async_bootstrap_race_does_not_zero_params():
+    # Two workers race identical init pushes at an empty async PS; the
+    # second must be dropped, not applied as a gradient (params - lr*init
+    # would be exactly zero at lr=1.0).
+    ps = ParameterServerCore(total_workers=2, staleness_bound=2)
+    init = store(w=[3.0, -1.0])
+    r1 = ps.receive_gradients(0, 0, init)
+    r2 = ps.receive_gradients(1, 0, init)
+    assert r1.success and r2.success
+    np.testing.assert_allclose(ps.get_parameters()["w"], [3.0, -1.0])
+    # real gradients after bootstrap still apply
+    ps.receive_gradients(0, 1, store(w=[1.0, 1.0]))
+    np.testing.assert_allclose(ps.get_parameters()["w"], [2.0, -2.0])
+
+
 def test_async_sync_status_always_ready():
     ps = ParameterServerCore(total_workers=2, staleness_bound=3)
     _, ready, _, _ = ps.check_sync_status(0)

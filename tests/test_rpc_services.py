@@ -1,0 +1,118 @@
+"""RPC-level tests: drive the two gRPC services over real sockets."""
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.config import (CoordinatorConfig,
+                                                     ParameterServerConfig)
+from parameter_server_distributed_tpu.rpc import messages as m
+from parameter_server_distributed_tpu.rpc.service import RpcClient
+from parameter_server_distributed_tpu.server.coordinator_service import Coordinator
+from parameter_server_distributed_tpu.server.ps_service import ParameterServer
+
+
+@pytest.fixture
+def ps(tmp_path):
+    server = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=2,
+        checkpoint_interval=2, checkpoint_dir=str(tmp_path),
+        learning_rate=1.0, autosave_period_s=60.0))
+    port = server.start()
+    yield server, port
+    server.stop()
+
+
+@pytest.fixture
+def coordinator():
+    server = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0,
+        ps_address="10.1.2.3", ps_port=50051, reap_period_s=60.0))
+    port = server.start()
+    yield server, port
+    server.stop()
+
+
+def ps_client(port):
+    return RpcClient(f"127.0.0.1:{port}", m.PARAMETER_SERVER_SERVICE,
+                     m.PARAMETER_SERVER_METHODS)
+
+
+def coord_client(port):
+    return RpcClient(f"127.0.0.1:{port}", m.COORDINATOR_SERVICE,
+                     m.COORDINATOR_METHODS)
+
+
+def test_push_pull_sync_over_wire(ps):
+    server, port = ps
+    server.core.initialize_parameters({"w": np.array([1.0, 2.0], np.float32)})
+    with ps_client(port) as client:
+        # pull
+        resp = client.call("ServeParameters", m.PullRequest(worker_id=0, iteration=1))
+        assert resp.ready
+        np.testing.assert_allclose(resp.parameters[0].to_array(), [1.0, 2.0])
+        # push worker 0: barrier incomplete
+        grads = [m.Tensor.from_array("w", np.array([0.5, 0.5], np.float32))]
+        push = client.call("ReceiveGradients",
+                           m.GradientUpdate(worker_id=0, iteration=1, gradients=grads))
+        assert push.success and not push.aggregation_complete
+        assert push.workers_received == 1 and push.total_workers == 2
+        # sync poll: not ready
+        sync = client.call("CheckSyncStatus", m.SyncStatusRequest(iteration=1))
+        assert not sync.ready
+        # push worker 1: aggregation fires
+        push2 = client.call("ReceiveGradients",
+                            m.GradientUpdate(worker_id=1, iteration=1, gradients=grads))
+        assert push2.aggregation_complete
+        sync2 = client.call("CheckSyncStatus", m.SyncStatusRequest(iteration=1))
+        assert sync2.ready and sync2.workers_received == 2
+        # params moved by lr=1.0 * mean([0.5,0.5])
+        resp2 = client.call("ServeParameters", m.PullRequest(worker_id=0, iteration=2))
+        np.testing.assert_allclose(resp2.parameters[0].to_array(), [0.5, 1.5])
+
+
+def test_checkpoint_save_load_over_wire(ps, tmp_path):
+    server, port = ps
+    server.core.initialize_parameters({"w": np.array([3.0], np.float32)})
+    with ps_client(port) as client:
+        save = client.call("SaveCheckpoint",
+                           m.SaveCheckpointRequest(epoch=7, path=""))
+        assert save.success, save.message
+        assert "checkpoint_epoch_7.ckpt" in save.checkpoint_path
+        # mutate params, then restore
+        server.core.initialize_parameters({"w": np.array([-99.0], np.float32)})
+        load = client.call("LoadCheckpoint",
+                           m.LoadCheckpointRequest(path=save.checkpoint_path))
+        assert load.success and load.epoch == 7
+        np.testing.assert_allclose(load.parameters[0].to_array(), [3.0])
+        np.testing.assert_allclose(server.core.get_parameters()["w"], [3.0])
+
+
+def test_load_checkpoint_missing_file_reports_failure(ps):
+    server, port = ps
+    with ps_client(port) as client:
+        load = client.call("LoadCheckpoint",
+                           m.LoadCheckpointRequest(path="/nonexistent/x.ckpt"))
+        assert not load.success and load.message
+
+
+def test_coordinator_register_discover_heartbeat_list(coordinator):
+    server, port = coordinator
+    with coord_client(port) as client:
+        addr = client.call("GetParameterServerAddress", m.GetPSAddressRequest())
+        assert (addr.address, addr.port) == ("10.1.2.3", 50051)
+        reg = client.call("RegisterWorker",
+                          m.WorkerInfo(worker_id=0, address="127.0.0.1",
+                                       port=50060, hostname="h0"))
+        assert reg.success and reg.total_workers == 1
+        assert reg.parameter_server_address == "10.1.2.3:50051"
+        hb = client.call("Heartbeat",
+                         m.HeartbeatRequest(worker_id=0,
+                                            status=m.WorkerStatus.TRAINING))
+        assert hb.success and hb.timestamp > 0
+        unknown = client.call("Heartbeat",
+                              m.HeartbeatRequest(worker_id=42,
+                                                 status=m.WorkerStatus.IDLE))
+        assert not unknown.success
+        lst = client.call("ListWorkers", m.ListWorkersRequest())
+        assert lst.total_workers == 1
+        assert lst.workers[0].worker_id == 0 and lst.workers[0].port == 50060

@@ -1,0 +1,80 @@
+"""Device-mesh construction.
+
+The reference's process topology (1 PS + N workers over TCP, NCCL ranks
+within a node — reference: src/nccl_manager.cpp:28-85) maps on TPU to one
+logical `jax.sharding.Mesh` whose axes express every parallelism dimension:
+
+- ``data``  — data parallelism (the N-workers axis; gradient mean via psum)
+- ``fsdp``  — parameter/optimizer-state sharding (the "PS shard" axis of
+  BASELINE config 3: reduce-scatter grads + all-gather params, ZeRO-style)
+- ``tensor`` — tensor parallelism (intra-layer sharding)
+- ``seq``   — sequence/context parallelism (ring attention)
+- ``pipe``  — pipeline parallelism
+- ``expert`` — expert parallelism (MoE)
+
+Collectives ride ICI when axes are laid out along physical neighbors; XLA
+handles that given the device order from `jax.devices()`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import MeshConfig
+
+AXIS_NAMES = ("data", "fsdp", "tensor", "seq", "pipe", "expert")
+
+
+def build_mesh(config: MeshConfig | None = None,
+               devices: Sequence | None = None) -> Mesh:
+    """Build the full 6-axis mesh.  Axes default to size 1; the product must
+    equal the device count."""
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = (config.data, config.fsdp, config.tensor, config.sequence,
+             config.pipeline, config.expert)
+    total = math.prod(sizes)
+    if total != len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(AXIS_NAMES, sizes))} needs {total} devices, "
+            f"have {len(devices)}")
+    array = np.array(devices).reshape(sizes)
+    return Mesh(array, AXIS_NAMES)
+
+
+def default_mesh_config(n_devices: int, tensor: int = 1, sequence: int = 1,
+                        pipeline: int = 1, expert: int = 1,
+                        fsdp: int | None = None) -> MeshConfig:
+    """Factorize ``n_devices`` into a sensible mesh: model axes as given,
+    remaining devices split between fsdp and data (fsdp preferred — it is
+    almost always the better first axis for memory)."""
+    denom = tensor * sequence * pipeline * expert
+    if n_devices % denom:
+        raise ValueError(f"{n_devices} devices not divisible by model axes {denom}")
+    rest = n_devices // denom
+    if fsdp is None:
+        fsdp = rest
+    if rest % fsdp:
+        raise ValueError(f"residual {rest} not divisible by fsdp={fsdp}")
+    return MeshConfig(data=rest // fsdp, fsdp=fsdp, tensor=tensor,
+                      sequence=sequence, pipeline=pipeline, expert=expert)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis over every data-parallel dimension.
+    fsdp is also a data axis in ZeRO-style training — each shard-group
+    member sees different examples."""
+    return NamedSharding(mesh, PartitionSpec(("data", "fsdp")))
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    return mesh.shape["data"] * mesh.shape["fsdp"]

@@ -1,0 +1,95 @@
+"""Parameter-sharding rules (the TPU-native "PS shard table").
+
+The reference's parameter server holds the single global parameter copy and
+ships it whole over TCP on every pull (reference: src/parameter_server.cpp:93-97,
+proto `repeated float` tensors).  On TPU the parameter store is instead a
+pytree of `jax.Array`s whose shardings place each tensor across the mesh:
+
+- fsdp axis: ZeRO-style — each device holds 1/N of every parameter and of
+  its optimizer state; XLA inserts all-gather (params, forward/backward) and
+  reduce-scatter (grads) automatically from the sharding annotations.
+- tensor axis: intra-layer (Megatron-style) sharding for matmul weights.
+
+Rules are name/shape based so they apply to any flat named store (MLP,
+ResNet, Transformer all export flat stores).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+ShardingRule = Callable[[str, tuple[int, ...]], PartitionSpec]
+
+
+def choose_shard_axis(shape: tuple[int, ...], divisor: int,
+                      avoid: set[int] = frozenset()) -> int | None:
+    """Pick the largest dim divisible by ``divisor`` (excluding ``avoid``),
+    or None if nothing divides."""
+    best, best_size = None, 0
+    for axis, size in enumerate(shape):
+        if axis in avoid or divisor <= 1:
+            continue
+        if size % divisor == 0 and size > best_size:
+            best, best_size = axis, size
+    return best
+
+
+def fsdp_rule(mesh: Mesh) -> ShardingRule:
+    """Shard every parameter's largest divisible dim over fsdp."""
+    n = mesh.shape["fsdp"]
+
+    def rule(name: str, shape: tuple[int, ...]) -> PartitionSpec:
+        axis = choose_shard_axis(shape, n)
+        if axis is None:
+            return PartitionSpec()
+        spec: list = [None] * len(shape)
+        spec[axis] = "fsdp"
+        return PartitionSpec(*spec)
+
+    return rule
+
+
+def fsdp_tp_rule(mesh: Mesh) -> ShardingRule:
+    """Combined fsdp + tensor sharding for 2D weights: tensor axis on the
+    output dim (Megatron column-parallel default), fsdp on the input dim.
+    1D tensors shard over fsdp only."""
+    n_fsdp = mesh.shape["fsdp"]
+    n_tp = mesh.shape["tensor"]
+
+    def rule(name: str, shape: tuple[int, ...]) -> PartitionSpec:
+        if len(shape) >= 2:
+            spec: list = [None] * len(shape)
+            if n_tp > 1 and shape[-1] % n_tp == 0:
+                spec[-1] = "tensor"
+            axis = choose_shard_axis(shape, n_fsdp, avoid={len(shape) - 1})
+            if axis is not None:
+                spec[axis] = "fsdp"
+            return PartitionSpec(*spec)
+        axis = choose_shard_axis(shape, n_fsdp)
+        if axis is None:
+            return PartitionSpec()
+        spec = [None] * len(shape)
+        spec[axis] = "fsdp"
+        return PartitionSpec(*spec)
+
+    return rule
+
+
+def store_shardings(mesh: Mesh, shapes: Mapping[str, tuple[int, ...]],
+                    rule: ShardingRule) -> dict[str, NamedSharding]:
+    return {name: NamedSharding(mesh, rule(name, tuple(shape)))
+            for name, shape in shapes.items()}
+
+
+def shard_store(store: Mapping[str, jax.Array], mesh: Mesh,
+                rule: ShardingRule) -> dict[str, jax.Array]:
+    """Place a host/device store onto the mesh under ``rule``."""
+    out = {}
+    for name, arr in store.items():
+        sharding = NamedSharding(mesh, rule(name, tuple(np.shape(arr))))
+        out[name] = jax.device_put(arr, sharding)
+    return out

@@ -1,0 +1,145 @@
+"""SPMD train steps: the TPU-native data plane.
+
+This module is the direct replacement for the reference's entire data path:
+
+- the gRPC push/pull of float tensors (reference: src/worker.cpp:240-272,
+  src/parameter_server.cpp:18-97) becomes sharding annotations on one
+  jitted step — XLA inserts all-gather/reduce-scatter over ICI;
+- the NCCL all-reduce (reference: src/nccl_manager.cpp:102-121) becomes the
+  implicit gradient mean of a batch sharded over the data axes;
+- the PS's "apply mean gradient" update (reference: src/parameter_server.cpp:77-91)
+  becomes an optax update with donated buffers so HBM stays flat.
+
+Sync-mode semantics preserved: one barrier per step (the compiled collective
+itself), mean over contributors, `params <- params - lr * mean_grad` for the
+SGD config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import batch_sharding, replicated
+from .sharding import ShardingRule, store_shardings
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    """Parameters + optimizer state + step counter, all device-resident.
+    The sharded TrainState *is* the parameter server's shard table."""
+    params: dict[str, jax.Array]
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params: Mapping[str, jax.Array],
+               optimizer: optax.GradientTransformation) -> "TrainState":
+        params = dict(params)
+        return cls(params=params, opt_state=optimizer.init(params),
+                   step=jnp.zeros((), jnp.int32))
+
+
+def make_optimizer(name: str = "sgd", learning_rate: float = 1.0,
+                   momentum: float = 0.9) -> optax.GradientTransformation:
+    """Device-side optimizer matching the host-side ones in core/optimizer.py
+    (the reference applies bare SGD at lr=1.0 — src/parameter_server.cpp:87)."""
+    name = name.lower()
+    if name == "sgd":
+        return optax.sgd(learning_rate)
+    if name == "momentum":
+        return optax.sgd(learning_rate, momentum=momentum)
+    if name == "adam":
+        return optax.adam(learning_rate)
+    if name == "adamw":
+        return optax.adamw(learning_rate)
+    raise ValueError(f"unknown optimizer {name!r}")
+
+
+def make_train_step(loss_fn: Callable,
+                    optimizer: optax.GradientTransformation) -> Callable:
+    """Build a pure (state, batch) -> (state, metrics) step function."""
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(params=new_params, opt_state=new_opt,
+                               step=state.step + 1)
+        grad_norm = optax.global_norm(grads)
+        return new_state, {"loss": loss, "grad_norm": grad_norm}
+
+    return step
+
+
+def state_shardings(state: TrainState, mesh: Mesh,
+                    rule: ShardingRule) -> TrainState:
+    """Sharding pytree matching a TrainState: params (and any optimizer slot
+    with a matching shape) sharded by ``rule``; scalars replicated."""
+    param_shardings = store_shardings(
+        mesh, {k: tuple(v.shape) for k, v in state.params.items()}, rule)
+
+    def opt_leaf(leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        for name, sharding in param_shardings.items():
+            if shape == tuple(state.params[name].shape):
+                # momentum/adam slots mirror their parameter's sharding;
+                # shape collisions across params resolve to identical specs
+                # under shape-based rules, so any match is correct
+                return sharding
+        return replicated(mesh)
+
+    opt_shardings = jax.tree.map(opt_leaf, state.opt_state)
+    return TrainState(params=param_shardings, opt_state=opt_shardings,
+                      step=replicated(mesh))
+
+
+class ShardedTrainer:
+    """Compiled SPMD training: state sharded per ``rule`` over ``mesh``,
+    batch sharded over the data axes, donated buffers.
+
+    This is BASELINE config 3's "4 PS shards / 8 workers" shape: mesh
+    fsdp=4 x data=2 gives 4-way parameter sharding with 8-way data
+    parallelism, all inside one XLA program.
+    """
+
+    def __init__(self, loss_fn: Callable, mesh: Mesh, rule: ShardingRule,
+                 optimizer: optax.GradientTransformation | None = None):
+        self.mesh = mesh
+        self.rule = rule
+        self.optimizer = optimizer or make_optimizer("sgd", 1.0)
+        self._raw_step = make_train_step(loss_fn, self.optimizer)
+        self._compiled: Callable | None = None
+        self._shardings: TrainState | None = None
+
+    def init_state(self, params: Mapping[str, jax.Array]) -> TrainState:
+        """Create and shard the train state (host arrays OK)."""
+        state = TrainState.create(params, self.optimizer)
+        self._shardings = state_shardings(state, self.mesh, self.rule)
+        put = lambda leaf, sh: jax.device_put(leaf, sh)
+        return jax.tree.map(put, state, self._shardings)
+
+    def step_fn(self) -> Callable:
+        if self._compiled is None:
+            if self._shardings is None:
+                raise RuntimeError("call init_state first")
+            metrics_sharding = {"loss": replicated(self.mesh),
+                                "grad_norm": replicated(self.mesh)}
+            self._compiled = jax.jit(
+                self._raw_step,
+                in_shardings=(self._shardings, batch_sharding(self.mesh)),
+                out_shardings=(self._shardings, metrics_sharding),
+                donate_argnums=0,
+            )
+        return self._compiled
+
+    def step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
+        batch = jax.device_put(batch, batch_sharding(self.mesh))
+        return self.step_fn()(state, batch)

@@ -8,10 +8,17 @@ multi-node protocol with multi-process-on-localhost
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Force CPU via jax.config: the session may register a TPU PJRT plugin at
+# interpreter startup (sitecustomize) that overrides the JAX_PLATFORMS env
+# var, so the env-var route is not reliable here.  config.update after
+# import wins as long as no backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -21,19 +21,16 @@ import logging
 import sys
 
 from ..config import WorkerConfig, parse_argv
-from ..data.synthetic import synthetic_mnist
-from ..models.mlp import MODEL_REGISTRY
+from ..models.registry import get_model_and_batches
 from ..worker.trainer import Trainer
 from ..worker.worker import Worker
 
 
 def build_worker(config: WorkerConfig, seed: int | None = None) -> Worker:
-    model = MODEL_REGISTRY[config.model]()
-    trainer = Trainer(model)
     data_seed = config.worker_id if seed is None else seed
-    dataset = synthetic_mnist(seed=data_seed)
-    batches = dataset.batch_stream(config.batch_size, seed=data_seed)
-    return Worker(config, trainer, batches)
+    model, batches = get_model_and_batches(config.model, config.batch_size,
+                                           seed=data_seed)
+    return Worker(config, Trainer(model), batches)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,0 +1,59 @@
+"""Model registry: name -> (model factory, data factory).
+
+Gives every CLI/benchmark entry point a single switch for the BASELINE
+configs: MNIST MLP (config 1), CIFAR ResNet-18 (config 2), 1B MLP
+(configs 3/5), ResNet-50 (config 4), plus the transformer LM flagship.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+from ..data.synthetic import (synthetic_image_batches, synthetic_mnist,
+                              synthetic_tokens)
+from .mlp import MLP, billion_param_mlp, mnist_mlp
+from .resnet import resnet18, resnet50
+from .transformer import small_lm
+
+
+def _mnist_batches(batch_size: int, seed: int) -> Iterator:
+    return synthetic_mnist(seed=seed).batch_stream(batch_size, seed=seed)
+
+
+def _cifar_batches(batch_size: int, seed: int) -> Iterator:
+    return synthetic_image_batches(batch_size, image_size=32, seed=seed)
+
+
+def _imagenet_batches(batch_size: int, seed: int) -> Iterator:
+    return synthetic_image_batches(batch_size, image_size=224,
+                                   num_classes=1000, seed=seed)
+
+
+def _lm_batches(batch_size: int, seed: int) -> Iterator:
+    return synthetic_tokens(batch_size, seq_len=256, vocab=1024, seed=seed)
+
+
+def _mlp_1b_batches(batch_size: int, seed: int) -> Iterator:
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    hidden = 16384
+    while True:
+        x = rng.standard_normal((batch_size, hidden)).astype(np.float32)
+        y = rng.integers(0, hidden, batch_size).astype(np.int32)
+        yield x, y
+
+
+REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator]]] = {
+    "mnist_mlp": (mnist_mlp, _mnist_batches),
+    "resnet18_cifar": (lambda: resnet18(num_classes=10), _cifar_batches),
+    "resnet50_imagenet": (lambda: resnet50(num_classes=1000), _imagenet_batches),
+    "small_lm": (lambda: small_lm(vocab=1024, seq=256), _lm_batches),
+    "mlp_1b": (billion_param_mlp, _mlp_1b_batches),
+}
+
+
+def get_model_and_batches(name: str, batch_size: int, seed: int = 0):
+    if name not in REGISTRY:
+        raise ValueError(f"unknown model {name!r}; have {sorted(REGISTRY)}")
+    model_fn, data_fn = REGISTRY[name]
+    return model_fn(), data_fn(batch_size, seed)

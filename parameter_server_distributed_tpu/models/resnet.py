@@ -1,0 +1,202 @@
+"""ResNet family (ResNet-18 / ResNet-50) in plain JAX, NHWC.
+
+Targets BASELINE configs 2 (CIFAR-10 ResNet-18 async-SGD) and 4 (sync
+all-reduce ResNet-50).  The reference has no model layer (its gradients are
+a 0.01 stub — reference: src/worker.cpp:316-329); these models give the
+framework real conv workloads that map onto the MXU (convs lower to large
+matmuls under XLA:TPU; float32 accumulation via preferred_element_type).
+
+Design notes:
+- Parameters are a flat named store (dict[str, Array]) like every model in
+  this framework, so ResNets flow through the PS wire protocol, checkpoint
+  codec, and ShardedTrainer unchanged.
+- Normalization is batch-statistics normalization in train mode without
+  running-average state (scale/bias are learned parameters).  This keeps
+  the training step pure (no mutable batch_stats side-channel) — the right
+  trade for a distributed-training framework whose benchmarks measure
+  training; eval reuses batch stats.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _conv(x: Array, w: Array, stride: int = 1) -> Array:
+    """NHWC x HWIO -> NHWC, SAME padding, f32 accumulation."""
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+
+
+def _norm(x: Array, scale: Array, bias: Array, eps: float = 1e-5) -> Array:
+    """Per-channel batch-statistics normalization (train-mode BN)."""
+    mean = jnp.mean(x, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(x, axis=(0, 1, 2), keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    return (x - mean) * inv * scale + bias
+
+
+class ResNet:
+    """Configurable ResNet.  stages: blocks per stage; bottleneck: False for
+    ResNet-18/34 basic blocks, True for ResNet-50-style 1-3-1 bottlenecks."""
+
+    def __init__(self, stages: tuple[int, ...] = (2, 2, 2, 2),
+                 bottleneck: bool = False, num_classes: int = 10,
+                 width: int = 64, input_channels: int = 3,
+                 small_inputs: bool = True, dtype=jnp.float32):
+        self.stages = stages
+        self.bottleneck = bottleneck
+        self.num_classes = num_classes
+        self.width = width
+        self.input_channels = input_channels
+        # small_inputs: CIFAR-style 3x3 stem, no initial pool (vs 7x7/s2 stem)
+        self.small_inputs = small_inputs
+        self.dtype = dtype
+        self._shapes = self._build_shapes()
+
+    # ------------------------------------------------------------ structure
+    def _block_names(self) -> list[tuple[str, int, int, int, bool]]:
+        """(block_prefix, in_ch, out_ch, stride, has_projection) per block."""
+        blocks = []
+        expansion = 4 if self.bottleneck else 1
+        in_ch = self.width
+        for stage_idx, num_blocks in enumerate(self.stages):
+            base = self.width * (2 ** stage_idx)
+            out_ch = base * expansion
+            for block_idx in range(num_blocks):
+                stride = 2 if (block_idx == 0 and stage_idx > 0) else 1
+                needs_proj = (in_ch != out_ch) or stride != 1
+                blocks.append((f"stage{stage_idx}/block{block_idx}",
+                               in_ch, base, stride, needs_proj))
+                in_ch = out_ch
+        return blocks
+
+    def _build_shapes(self) -> dict[str, tuple[int, ...]]:
+        shapes: dict[str, tuple[int, ...]] = {}
+        stem_k = 3 if self.small_inputs else 7
+        shapes["stem/conv/w"] = (stem_k, stem_k, self.input_channels, self.width)
+        shapes["stem/norm/scale"] = (self.width,)
+        shapes["stem/norm/bias"] = (self.width,)
+        expansion = 4 if self.bottleneck else 1
+        for prefix, in_ch, base, stride, needs_proj in self._block_names():
+            out_ch = base * expansion
+            if self.bottleneck:
+                shapes[f"{prefix}/conv1/w"] = (1, 1, in_ch, base)
+                shapes[f"{prefix}/conv2/w"] = (3, 3, base, base)
+                shapes[f"{prefix}/conv3/w"] = (1, 1, base, out_ch)
+                for i, ch in ((1, base), (2, base), (3, out_ch)):
+                    shapes[f"{prefix}/norm{i}/scale"] = (ch,)
+                    shapes[f"{prefix}/norm{i}/bias"] = (ch,)
+            else:
+                shapes[f"{prefix}/conv1/w"] = (3, 3, in_ch, base)
+                shapes[f"{prefix}/conv2/w"] = (3, 3, base, base)
+                for i in (1, 2):
+                    shapes[f"{prefix}/norm{i}/scale"] = (base,)
+                    shapes[f"{prefix}/norm{i}/bias"] = (base,)
+            if needs_proj:
+                shapes[f"{prefix}/proj/w"] = (1, 1, in_ch, out_ch)
+                shapes[f"{prefix}/proj_norm/scale"] = (out_ch,)
+                shapes[f"{prefix}/proj_norm/bias"] = (out_ch,)
+        final_ch = self.width * (2 ** (len(self.stages) - 1)) * expansion
+        shapes["head/w"] = (final_ch, self.num_classes)
+        shapes["head/b"] = (self.num_classes,)
+        return shapes
+
+    def param_shapes(self) -> dict[str, tuple[int, ...]]:
+        return dict(self._shapes)
+
+    def num_params(self) -> int:
+        return sum(math.prod(s) for s in self._shapes.values())
+
+    # ----------------------------------------------------------------- init
+    def init_params(self, rng: jax.Array | int = 0) -> dict[str, Array]:
+        if isinstance(rng, int):
+            rng = jax.random.key(rng)
+        params: dict[str, Array] = {}
+        for name, shape in self._shapes.items():
+            rng, sub = jax.random.split(rng)
+            if name.endswith("conv/w") or "/conv" in name or "/proj/w" in name:
+                fan_in = math.prod(shape[:-1])
+                params[name] = (math.sqrt(2.0 / fan_in) *
+                                jax.random.normal(sub, shape, self.dtype))
+            elif name.endswith("/scale"):
+                params[name] = jnp.ones(shape, self.dtype)
+            elif name.endswith("/bias") or name.endswith("/b"):
+                params[name] = jnp.zeros(shape, self.dtype)
+            elif name == "head/w":
+                params[name] = (math.sqrt(1.0 / shape[0]) *
+                                jax.random.normal(sub, shape, self.dtype))
+            else:
+                raise AssertionError(f"unhandled param {name}")
+        return params
+
+    # -------------------------------------------------------------- forward
+    def apply(self, params: Mapping[str, Array], x: Array) -> Array:
+        p = params
+        h = _conv(x.astype(self.dtype), p["stem/conv/w"],
+                  stride=1 if self.small_inputs else 2)
+        h = _norm(h, p["stem/norm/scale"], p["stem/norm/bias"])
+        h = jax.nn.relu(h)
+        if not self.small_inputs:
+            h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max,
+                                      (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+        for prefix, in_ch, base, stride, needs_proj in self._block_names():
+            shortcut = h
+            if self.bottleneck:
+                out = _conv(h, p[f"{prefix}/conv1/w"])
+                out = jax.nn.relu(_norm(out, p[f"{prefix}/norm1/scale"],
+                                        p[f"{prefix}/norm1/bias"]))
+                out = _conv(out, p[f"{prefix}/conv2/w"], stride=stride)
+                out = jax.nn.relu(_norm(out, p[f"{prefix}/norm2/scale"],
+                                        p[f"{prefix}/norm2/bias"]))
+                out = _conv(out, p[f"{prefix}/conv3/w"])
+                out = _norm(out, p[f"{prefix}/norm3/scale"],
+                            p[f"{prefix}/norm3/bias"])
+            else:
+                out = _conv(h, p[f"{prefix}/conv1/w"], stride=stride)
+                out = jax.nn.relu(_norm(out, p[f"{prefix}/norm1/scale"],
+                                        p[f"{prefix}/norm1/bias"]))
+                out = _conv(out, p[f"{prefix}/conv2/w"])
+                out = _norm(out, p[f"{prefix}/norm2/scale"],
+                            p[f"{prefix}/norm2/bias"])
+            if needs_proj:
+                shortcut = _conv(h, p[f"{prefix}/proj/w"], stride=stride)
+                shortcut = _norm(shortcut, p[f"{prefix}/proj_norm/scale"],
+                                 p[f"{prefix}/proj_norm/bias"])
+            h = jax.nn.relu(out + shortcut)
+        h = jnp.mean(h, axis=(1, 2))  # global average pool
+        return jnp.dot(h, p["head/w"],
+                       preferred_element_type=jnp.float32) + p["head/b"]
+
+    def loss(self, params: Mapping[str, Array], batch: tuple) -> Array:
+        x, y = batch
+        logits = self.apply(params, x)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None].astype(jnp.int32), axis=-1)
+        return jnp.mean(nll)
+
+    def accuracy(self, params: Mapping[str, Array], batch: tuple) -> Array:
+        x, y = batch
+        return jnp.mean((jnp.argmax(self.apply(params, x), -1) == y)
+                        .astype(jnp.float32))
+
+
+def resnet18(num_classes: int = 10, small_inputs: bool = True,
+             dtype=jnp.float32) -> ResNet:
+    return ResNet((2, 2, 2, 2), bottleneck=False, num_classes=num_classes,
+                  small_inputs=small_inputs, dtype=dtype)
+
+
+def resnet50(num_classes: int = 1000, small_inputs: bool = False,
+             dtype=jnp.bfloat16) -> ResNet:
+    return ResNet((3, 4, 6, 3), bottleneck=True, num_classes=num_classes,
+                  small_inputs=small_inputs, dtype=dtype)

@@ -1,0 +1,237 @@
+"""Decoder-only Transformer LM, TPU-first.
+
+The framework's flagship long-context model.  The reference has no model or
+sequence dimension at all (SURVEY.md §5) — this model is what makes the
+mesh's ``tensor`` and ``seq`` axes real:
+
+- tensor parallelism: Megatron-style column-parallel wq/wk/wv/w1 and
+  row-parallel wo/w2 (one all-reduce per residual branch, inserted by XLA
+  from the shardings);
+- sequence parallelism: activations sharded [batch, seq, d] with seq on the
+  ``seq`` axis; attention either all-gathers K/V (default GSPMD path) or
+  runs ring attention (ops/ring_attention.py) with K/V blocks rotating over
+  the ring — O(seq/N) memory per device;
+- RoPE positions (no learned position table) so sequence shards are
+  position-exact regardless of placement;
+- bfloat16 weights/activations, float32 MXU accumulation, float32 softmax.
+
+Parameters are a flat named store like every model here, so the same
+transformer flows through the PS protocol, checkpointing, and ShardedTrainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: object = jnp.bfloat16
+    rope_theta: float = 10000.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def rms_norm(x: Array, scale: Array, eps: float = 1e-6) -> Array:
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * inv * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope(x: Array, positions: Array, theta: float = 10000.0) -> Array:
+    """Rotary position embedding.  x: [..., seq, heads, head_dim]."""
+    head_dim = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, head_dim // 2, dtype=jnp.float32)
+                      / (head_dim // 2))
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., S, D/2]
+    cos = jnp.cos(angles)[..., :, None, :]  # [..., S, 1, D/2]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def causal_attention(q: Array, k: Array, v: Array) -> Array:
+    """Reference einsum attention.  q,k,v: [B, S, H, D] -> [B, S, H, D].
+    float32 logits/softmax for stability."""
+    head_dim = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(head_dim)
+    s_q, s_k = q.shape[1], k.shape[1]
+    mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_))
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                      preferred_element_type=jnp.float32).astype(v.dtype)
+
+
+class Transformer:
+    def __init__(self, config: TransformerConfig,
+                 attention_fn: Callable | None = None,
+                 mesh: Mesh | None = None):
+        if config.d_model % config.n_heads:
+            raise ValueError("d_model must divide by n_heads")
+        self.config = config
+        self.attention_fn = attention_fn or causal_attention
+        self.mesh = mesh  # when set, activations get sharding constraints
+
+    # ------------------------------------------------------------- shapes
+    def param_shapes(self) -> dict[str, tuple[int, ...]]:
+        c = self.config
+        shapes: dict[str, tuple[int, ...]] = {"embed/tok": (c.vocab, c.d_model)}
+        for i in range(c.n_layers):
+            p = f"layer{i}"
+            shapes[f"{p}/ln1/scale"] = (c.d_model,)
+            shapes[f"{p}/attn/wq"] = (c.d_model, c.d_model)
+            shapes[f"{p}/attn/wk"] = (c.d_model, c.d_model)
+            shapes[f"{p}/attn/wv"] = (c.d_model, c.d_model)
+            shapes[f"{p}/attn/wo"] = (c.d_model, c.d_model)
+            shapes[f"{p}/ln2/scale"] = (c.d_model,)
+            shapes[f"{p}/mlp/w1"] = (c.d_model, c.d_ff)
+            shapes[f"{p}/mlp/w2"] = (c.d_ff, c.d_model)
+        shapes["final_ln/scale"] = (c.d_model,)
+        shapes["lm_head/w"] = (c.d_model, c.vocab)
+        return shapes
+
+    def num_params(self) -> int:
+        return sum(math.prod(s) for s in self.param_shapes().values())
+
+    def init_params(self, rng: jax.Array | int = 0) -> dict[str, Array]:
+        c = self.config
+        if isinstance(rng, int):
+            rng = jax.random.key(rng)
+        params: dict[str, Array] = {}
+        for name, shape in self.param_shapes().items():
+            rng, sub = jax.random.split(rng)
+            if name.endswith("/scale"):
+                params[name] = jnp.ones(shape, c.dtype)
+            elif name == "embed/tok":
+                params[name] = jax.random.normal(sub, shape, c.dtype) * 0.02
+            else:
+                scale = 1.0 / math.sqrt(shape[0])
+                # residual-output projections get depth-scaled init
+                if name.endswith("attn/wo") or name.endswith("mlp/w2"):
+                    scale /= math.sqrt(2.0 * c.n_layers)
+                params[name] = jax.random.normal(sub, shape, c.dtype) * scale
+        return params
+
+    # ------------------------------------------------------------ forward
+    def _constrain(self, x: Array, *spec) -> Array:
+        if self.mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, PartitionSpec(*spec)))
+
+    def apply(self, params: Mapping[str, Array], tokens: Array) -> Array:
+        """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+        c = self.config
+        batch, seq = tokens.shape
+        h = jnp.take(params["embed/tok"], tokens, axis=0)
+        h = self._constrain(h, ("data", "fsdp"), "seq", None)
+        positions = jnp.arange(seq, dtype=jnp.int32)[None, :].repeat(batch, 0)
+        for i in range(c.n_layers):
+            p = f"layer{i}"
+            # attention branch
+            x = rms_norm(h, params[f"{p}/ln1/scale"])
+            dot = partial(jnp.dot, preferred_element_type=jnp.float32)
+            q = dot(x, params[f"{p}/attn/wq"]).astype(c.dtype)
+            k = dot(x, params[f"{p}/attn/wk"]).astype(c.dtype)
+            v = dot(x, params[f"{p}/attn/wv"]).astype(c.dtype)
+            q = q.reshape(batch, seq, c.n_heads, c.head_dim)
+            k = k.reshape(batch, seq, c.n_heads, c.head_dim)
+            v = v.reshape(batch, seq, c.n_heads, c.head_dim)
+            q = rope(q, positions, c.rope_theta)
+            k = rope(k, positions, c.rope_theta)
+            attn = self.attention_fn(q, k, v)
+            attn = attn.reshape(batch, seq, c.d_model)
+            h = h + dot(attn, params[f"{p}/attn/wo"]).astype(c.dtype)
+            h = self._constrain(h, ("data", "fsdp"), "seq", None)
+            # mlp branch
+            x = rms_norm(h, params[f"{p}/ln2/scale"])
+            ff = dot(x, params[f"{p}/mlp/w1"]).astype(c.dtype)
+            ff = jax.nn.gelu(ff)
+            h = h + dot(ff, params[f"{p}/mlp/w2"]).astype(c.dtype)
+            h = self._constrain(h, ("data", "fsdp"), "seq", None)
+        h = rms_norm(h, params["final_ln/scale"])
+        return jnp.dot(h, params["lm_head/w"],
+                       preferred_element_type=jnp.float32)
+
+    def loss(self, params: Mapping[str, Array], batch) -> Array:
+        """Next-token cross-entropy.  batch: [B, S] int32 tokens (or a
+        (tokens,) tuple)."""
+        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        # run the full sequence (keeps the seq length shard-divisible for
+        # sequence parallelism) and drop the last position's logits
+        logits = self.apply(params, tokens)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        targets = tokens[:, 1:]
+        nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
+                                   axis=-1)
+        return jnp.mean(nll)
+
+
+def transformer_rule(mesh: Mesh):
+    """Sharding rule for transformer stores: Megatron TP + fsdp.
+
+    column-parallel (tensor on output dim): wq wk wv w1 lm_head
+    row-parallel  (tensor on input dim):    wo w2
+    vocab-sharded embedding; norm scales replicated (fsdp if divisible).
+    """
+    n_fsdp = mesh.shape["fsdp"]
+    n_tp = mesh.shape["tensor"]
+
+    def rule(name: str, shape: tuple[int, ...]) -> PartitionSpec:
+        def fsdp_on(axis: int, taken: int | None) -> list:
+            spec: list = [None] * len(shape)
+            if taken is not None:
+                spec[taken] = "tensor"
+            if n_fsdp > 1 and shape[axis] % n_fsdp == 0 and axis != taken:
+                spec[axis] = "fsdp"
+            return spec
+
+        if name.endswith(("attn/wq", "attn/wk", "attn/wv", "mlp/w1", "lm_head/w")):
+            taken = len(shape) - 1 if n_tp > 1 and shape[-1] % n_tp == 0 else None
+            return PartitionSpec(*fsdp_on(0, taken))
+        if name.endswith(("attn/wo", "mlp/w2")):
+            taken = 0 if n_tp > 1 and shape[0] % n_tp == 0 else None
+            return PartitionSpec(*fsdp_on(len(shape) - 1, taken))
+        if name == "embed/tok":
+            taken = 0 if n_tp > 1 and shape[0] % n_tp == 0 else None
+            return PartitionSpec(*fsdp_on(1, taken))
+        if name.endswith("/scale"):
+            return PartitionSpec()
+        # fallback: fsdp on largest divisible dim
+        spec: list = [None] * len(shape)
+        for axis in sorted(range(len(shape)), key=lambda a: -shape[a]):
+            if n_fsdp > 1 and shape[axis] % n_fsdp == 0:
+                spec[axis] = "fsdp"
+                break
+        return PartitionSpec(*spec)
+
+    return rule
+
+
+def small_lm(vocab: int = 1024, seq: int = 256) -> Transformer:
+    """Test-scale LM."""
+    return Transformer(TransformerConfig(
+        vocab=vocab, d_model=128, n_heads=4, n_layers=2, d_ff=512,
+        max_seq=seq, dtype=jnp.float32))

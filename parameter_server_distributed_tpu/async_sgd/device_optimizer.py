@@ -1,0 +1,85 @@
+"""Device-resident optimizer for the async parameter server.
+
+In bounded-staleness mode updates apply on arrival (no barrier), so the
+apply path is the PS hot loop.  The host optimizers in core/optimizer.py
+walk numpy arrays on the CPU — fine for MNIST, not for a 1B-param store.
+This optimizer keeps parameters and slots as jax Arrays on the accelerator
+and applies updates under jit with donated buffers: the PS's HBM footprint
+stays flat and the apply is one fused XLA program per push.
+
+Drops into `ParameterServerCore(optimizer=...)` unchanged — it satisfies the
+HostOptimizer protocol (apply/state_dict/load_state_dict).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.optimizer import HostOptimizer
+
+
+class DeviceOptimizer(HostOptimizer):
+    def __init__(self, transformation: optax.GradientTransformation,
+                 learning_rate: float = 0.0):
+        super().__init__(learning_rate)
+        self._tx = transformation
+        self._opt_state = None
+
+        def apply(params, grads, opt_state):
+            updates, new_opt = self._tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        self._apply = jax.jit(apply, donate_argnums=(0, 2))
+
+    @classmethod
+    def sgd(cls, learning_rate: float = 1.0) -> "DeviceOptimizer":
+        return cls(optax.sgd(learning_rate), learning_rate)
+
+    @classmethod
+    def momentum(cls, learning_rate: float = 1.0,
+                 momentum: float = 0.9) -> "DeviceOptimizer":
+        return cls(optax.sgd(learning_rate, momentum=momentum), learning_rate)
+
+    @classmethod
+    def adam(cls, learning_rate: float = 1e-3) -> "DeviceOptimizer":
+        return cls(optax.adam(learning_rate), learning_rate)
+
+    def apply(self, params: Mapping[str, np.ndarray],
+              grads: Mapping[str, np.ndarray]) -> dict:
+        device_params = {k: jnp.asarray(v) for k, v in params.items()}
+        device_grads = {k: jnp.asarray(np.asarray(grads[k], np.float32))
+                        if k in grads else jnp.zeros_like(device_params[k])
+                        for k in device_params}
+        if self._opt_state is None:
+            self._opt_state = self._tx.init(device_params)
+        new_params, self._opt_state = self._apply(device_params, device_grads,
+                                                  self._opt_state)
+        return new_params
+
+    def state_dict(self) -> dict:
+        """Checkpoint-codec-friendly: a single uint8 'pickle' entry holding
+        (leaves-as-numpy, treedef) so the optimizer sidecar (an npz) can
+        store it without knowing optax's pytree structure."""
+        import pickle
+
+        if self._opt_state is None:
+            return {}
+        leaves, treedef = jax.tree.flatten(self._opt_state)
+        blob = pickle.dumps(([np.asarray(leaf) for leaf in leaves], treedef))
+        return {"pickle": np.frombuffer(blob, dtype=np.uint8)}
+
+    def load_state_dict(self, state: dict) -> None:
+        import pickle
+
+        if not state or "pickle" not in state:
+            self._opt_state = None
+            return
+        leaves, treedef = pickle.loads(np.asarray(state["pickle"],
+                                                  np.uint8).tobytes())
+        self._opt_state = jax.tree.unflatten(
+            treedef, [jnp.asarray(leaf) for leaf in leaves])

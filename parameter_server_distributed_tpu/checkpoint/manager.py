@@ -166,7 +166,8 @@ def _load_optimizer_sidecar(path: str) -> dict | None:
         for key in npz.files:
             slot, _, name = key.partition("/")
             if slot == "__scalar__":
-                state[name] = npz[key].item()
+                value = npz[key]
+                state[name] = value.item() if value.ndim == 0 else value
             else:
                 state.setdefault(slot, {})[name] = npz[key]
     return state

@@ -59,7 +59,8 @@ def main(argv: list[str] | None = None) -> int:
             logging.warning("checkpoint restore failed (continuing): %s", exc)
 
     try:
-        for it in range(config.iterations):
+        for i in range(config.iterations):
+            it = max(i, worker.iteration + 1)
             loss = worker.run_iteration(it)
             print(f"Worker {config.worker_id} completed iteration {it} "
                   f"(loss {loss:.4f})", flush=True)

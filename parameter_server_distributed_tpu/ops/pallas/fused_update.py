@@ -1,0 +1,156 @@
+"""Fused optimizer-update pallas kernels.
+
+The PS update path (reference: the aggregation loop + `param -= avg_grad`
+at src/parameter_server.cpp:40-91, single-threaded C++ over every element)
+becomes one pallas pass per tensor: read param/grad (and slots), write the
+updated values, all in VMEM-resident tiles with in-place aliasing — no
+intermediate HBM round-trips between optimizer sub-ops.
+
+Arrays are processed as (rows, 128) tiles (padded as needed).  On non-TPU
+backends kernels run in interpret mode so the same code path is tested on
+CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+SUBLANE = 8
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _sgd_kernel(lr_ref, p_ref, g_ref, out_ref):
+    out_ref[:] = p_ref[:] - lr_ref[0] * g_ref[:]
+
+
+def _momentum_kernel(scalar_ref, p_ref, g_ref, vel_ref, p_out, vel_out):
+    lr, mu = scalar_ref[0], scalar_ref[1]
+    v_new = mu * vel_ref[:] + g_ref[:]
+    vel_out[:] = v_new
+    p_out[:] = p_ref[:] - lr * v_new
+
+
+def _adam_kernel(scalar_ref, p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out):
+    lr, b1, b2, eps, bc1, bc2 = (scalar_ref[0], scalar_ref[1], scalar_ref[2],
+                                 scalar_ref[3], scalar_ref[4], scalar_ref[5])
+    g = g_ref[:]
+    m_new = b1 * m_ref[:] + (1.0 - b1) * g
+    v_new = b2 * v_ref[:] + (1.0 - b2) * g * g
+    m_out[:] = m_new
+    v_out[:] = v_new
+    m_hat = m_new / bc1
+    v_hat = v_new / bc2
+    p_out[:] = p_ref[:] - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+
+
+def _as_tiles(arr: jax.Array) -> tuple[jax.Array, int]:
+    """Flatten + pad to a (rows, LANE) float32 tile layout."""
+    flat = arr.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    rows = -(-n // LANE)
+    rows = -(-rows // SUBLANE) * SUBLANE  # round rows to sublane multiple
+    padded = jnp.zeros((rows * LANE,), jnp.float32).at[:n].set(flat)
+    return padded.reshape(rows, LANE), n
+
+
+def _from_tiles(tiles: jax.Array, n: int, shape, dtype) -> jax.Array:
+    return tiles.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def fused_sgd(params: Mapping[str, jax.Array],
+              grads: Mapping[str, jax.Array], lr: float,
+              interpret: bool | None = None) -> dict[str, jax.Array]:
+    """param <- param - lr * grad, one fused pass per tensor."""
+    interpret = _interpret_default() if interpret is None else interpret
+    scalars = jnp.asarray([lr], jnp.float32)
+    out = {}
+    for name, p in params.items():
+        if name not in grads:
+            out[name] = p
+            continue
+        tiles_p, n = _as_tiles(p)
+        tiles_g, _ = _as_tiles(grads[name])
+        rows = tiles_p.shape[0]
+        block = pl.BlockSpec((rows, LANE), lambda: (0, 0))
+        (res,) = pl.pallas_call(
+            _sgd_kernel,
+            out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)],
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), block, block],
+            out_specs=[block],
+            interpret=interpret,
+        )(scalars, tiles_p, tiles_g)
+        out[name] = _from_tiles(res, n, np.shape(p), p.dtype)
+    return out
+
+
+def fused_momentum(params: Mapping[str, jax.Array],
+                   grads: Mapping[str, jax.Array],
+                   velocity: Mapping[str, jax.Array], lr: float,
+                   mu: float = 0.9, interpret: bool | None = None):
+    """Fused momentum SGD: returns (new_params, new_velocity)."""
+    interpret = _interpret_default() if interpret is None else interpret
+    scalars = jnp.asarray([lr, mu], jnp.float32)
+    new_p, new_v = {}, {}
+    for name, p in params.items():
+        if name not in grads:
+            new_p[name], new_v[name] = p, velocity.get(name)
+            continue
+        tiles = [_as_tiles(x) for x in (p, grads[name], velocity[name])]
+        n = tiles[0][1]
+        rows = tiles[0][0].shape[0]
+        block = pl.BlockSpec((rows, LANE), lambda: (0, 0))
+        res = pl.pallas_call(
+            _momentum_kernel,
+            out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)] * 2,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + [block] * 3,
+            out_specs=[block] * 2,
+            interpret=interpret,
+        )(scalars, *[t for t, _ in tiles])
+        new_p[name] = _from_tiles(res[0], n, np.shape(p), p.dtype)
+        new_v[name] = _from_tiles(res[1], n, np.shape(p), jnp.float32)
+    return new_p, new_v
+
+
+def fused_adam(params: Mapping[str, jax.Array],
+               grads: Mapping[str, jax.Array],
+               m: Mapping[str, jax.Array], v: Mapping[str, jax.Array],
+               step: int, lr: float = 1e-3, b1: float = 0.9,
+               b2: float = 0.999, eps: float = 1e-8,
+               interpret: bool | None = None):
+    """Fused Adam: returns (new_params, new_m, new_v)."""
+    interpret = _interpret_default() if interpret is None else interpret
+    bc1 = 1.0 - b1 ** step
+    bc2 = 1.0 - b2 ** step
+    scalars = jnp.asarray([lr, b1, b2, eps, bc1, bc2], jnp.float32)
+    new_p, new_m, new_v = {}, {}, {}
+    for name, p in params.items():
+        if name not in grads:
+            new_p[name], new_m[name], new_v[name] = p, m.get(name), v.get(name)
+            continue
+        tiles = [_as_tiles(x) for x in
+                 (p, grads[name], m[name], v[name])]
+        n = tiles[0][1]
+        rows = tiles[0][0].shape[0]
+        block = pl.BlockSpec((rows, LANE), lambda: (0, 0))
+        res = pl.pallas_call(
+            _adam_kernel,
+            out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)] * 3,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + [block] * 4,
+            out_specs=[block] * 3,
+            interpret=interpret,
+        )(scalars, *[t for t, _ in tiles])
+        new_p[name] = _from_tiles(res[0], n, np.shape(p), p.dtype)
+        new_m[name] = _from_tiles(res[1], n, np.shape(p), jnp.float32)
+        new_v[name] = _from_tiles(res[2], n, np.shape(p), jnp.float32)
+    return new_p, new_m, new_v

@@ -1,0 +1,123 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Long-context training shards the sequence axis across the mesh's ``seq``
+axis.  Causal attention then needs cross-device K/V:
+
+- **Ring attention** (`make_ring_attention`): K/V blocks rotate around the
+  ring via `ppermute` while each device accumulates its queries' output
+  with an online (flash-style) softmax — O(seq/N) activation memory per
+  device and compute overlapped with ICI transfers.  The blockwise-
+  parallel-transformer / ring-attention construction, in shard_map.
+- **Ulysses all-to-all** (`make_ulysses_attention`): `all_to_all` swaps the
+  sharded axis from sequence to heads, each device runs dense causal
+  attention on the full sequence for its head subset, then swaps back.
+  Cheaper at moderate sequence lengths, needs heads % seq_axis == 0.
+
+Both return an ``attention_fn(q, k, v) -> out`` with the same signature as
+`models.transformer.causal_attention` ([B, S, H, D] -> [B, S, H, D]), so the
+Transformer takes them as drop-in `attention_fn`.  There is no reference
+analogue — the reference has no model, no sequence axis (SURVEY.md §5);
+this is required TPU-native scale capability.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30  # avoid true -inf: exp/where arithmetic stays NaN-free
+
+
+def _block_attention_update(q32, k_blk, v_blk, q_pos, k_pos, m, l, acc):
+    """One online-softmax accumulation step over a K/V block.
+
+    q32 [B,H,Sq,D] f32; k_blk/v_blk [B,Sk,H,D]; m,l [B,H,Sq]; acc [B,H,Sq,D].
+    """
+    d = q32.shape[-1]
+    k32 = k_blk.astype(jnp.float32)
+    v32 = v_blk.astype(jnp.float32)
+    scores = jnp.einsum("bhqd,bkhd->bhqk", q32, k32) / math.sqrt(d)
+    mask = q_pos[:, None] >= k_pos[None, :]           # causal [Sq, Sk]
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    s_max = jnp.max(scores, axis=-1)                   # [B,H,Sq]
+    m_new = jnp.maximum(m, s_max)
+    # rows with no visible keys yet keep m == NEG_INF; exp underflows to 0
+    p = jnp.exp(scores - m_new[..., None])
+    p = jnp.where(mask[None, None], p, 0.0)
+    alpha = jnp.exp(m - m_new)                         # [B,H,Sq]
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v32)
+    return m_new, l_new, acc_new
+
+
+def _finalize(acc, l):
+    out = acc / jnp.maximum(l, 1e-30)[..., None]       # [B,H,Sq,D]
+    return jnp.transpose(out, (0, 2, 1, 3))            # -> [B,Sq,H,D]
+
+
+def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
+                        batch_axes: tuple[str, ...] = ("data", "fsdp"),
+                        head_axis: str = "tensor"):
+    """Causal ring attention over ``mesh``'s sequence axis."""
+    n = mesh.shape[seq_axis]
+    heads_spec = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    spec = P(batch_axes, seq_axis, heads_spec, None)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def ring(q, k, v):
+        b, s_loc, h, d = q.shape
+        my = jax.lax.axis_index(seq_axis)
+        q32 = jnp.transpose(q.astype(jnp.float32), (0, 2, 1, 3))  # [B,H,Sq,D]
+        q_pos = my * s_loc + jnp.arange(s_loc)
+        m = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+        l = jnp.zeros((b, h, s_loc), jnp.float32)
+        acc = jnp.zeros((b, h, s_loc, d), jnp.float32)
+        k_cur, v_cur = k, v
+        for step in range(n):
+            src = (my - step) % n                      # origin of k_cur block
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            m, l, acc = _block_attention_update(q32, k_cur, v_cur,
+                                                q_pos, k_pos, m, l, acc)
+            if step < n - 1:
+                k_cur = jax.lax.ppermute(k_cur, seq_axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, seq_axis, perm)
+        return _finalize(acc, l).astype(q.dtype)
+
+    return ring
+
+
+def make_ulysses_attention(mesh: Mesh, seq_axis: str = "seq",
+                           batch_axes: tuple[str, ...] = ("data", "fsdp"),
+                           head_axis: str = "tensor"):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism: swap the
+    sharded axis seq -> heads, run dense causal attention over the full
+    sequence, swap back.  Heads (after any tensor sharding) must divide by
+    the seq-axis size."""
+    from ..models.transformer import causal_attention
+
+    n = mesh.shape[seq_axis]
+    heads_spec = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    spec = P(batch_axes, seq_axis, heads_spec, None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def ulysses(q, k, v):
+        def gather_seq(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
+            return jax.lax.all_to_all(x, seq_axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        def scatter_seq(x):  # [B, S, H/n, D] -> [B, S/n, H, D]
+            return jax.lax.all_to_all(x, seq_axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        out = causal_attention(gather_seq(q), gather_seq(k), gather_seq(v))
+        return scatter_seq(out)
+
+    return ulysses

@@ -53,7 +53,7 @@ class Worker:
         self.trainer = trainer
         self.batches = batches
         self.status = m.WorkerStatus.IDLE
-        self.iteration = 0
+        self.iteration = -1  # last completed iteration
         self.last_loss = float("nan")
         self._coordinator = RpcClient(config.coordinator_address,
                                       m.COORDINATOR_SERVICE, m.COORDINATOR_METHODS)
@@ -206,16 +206,30 @@ class Worker:
                 self.iteration = iteration
                 return float("nan")
 
-            batch = next(self.batches)
-            grads, loss = self.trainer.compute_gradients(params, batch)
-            self.last_loss = loss
+            effective_it = iteration
+            for attempt in range(3):
+                batch = next(self.batches)
+                grads, loss = self.trainer.compute_gradients(params, batch)
+                self.last_loss = loss
 
-            push = self.push_gradients(iteration, grads)
-            if not push.success:
+                push = self.push_gradients(effective_it, grads)
+                if push.success:
+                    break
+                if "stale" in push.message and attempt < 2:
+                    # bounded-staleness rejection (async mode): fast-forward
+                    # to the PS's current iteration, re-pull fresh params,
+                    # recompute, retry — no reference analogue (its protocol
+                    # is strictly synchronous)
+                    log.info("worker %d: stale at iteration %d, "
+                             "fast-forwarding to %d", self.config.worker_id,
+                             effective_it, push.iteration)
+                    effective_it = max(push.iteration, effective_it + 1)
+                    _, params = self.pull_parameters(effective_it)
+                    continue
                 raise WorkerError(f"push rejected: {push.message}")
             if not push.aggregation_complete:
-                self._await_barrier(iteration)
-            self.iteration = iteration
+                self._await_barrier(effective_it)
+            self.iteration = effective_it
             return loss
         finally:
             self.status = m.WorkerStatus.IDLE
@@ -239,7 +253,10 @@ class Worker:
     def run(self, iterations: int | None = None) -> None:
         """Full training run (reference: src/worker_main.cpp:40-43)."""
         total = iterations if iterations is not None else self.config.iterations
-        for it in range(total):
+        for i in range(total):
+            # async fast-forwards may skip numbers; never re-push a completed
+            # iteration
+            it = max(i, self.iteration + 1)
             loss = self.run_iteration(it)
             log.info("worker %d iteration %d loss %.4f",
                      self.config.worker_id, it, loss)

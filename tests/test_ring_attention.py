@@ -1,0 +1,91 @@
+"""Ring / Ulysses sequence-parallel attention vs dense causal attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.config import MeshConfig
+from parameter_server_distributed_tpu.models.transformer import (
+    Transformer, TransformerConfig, causal_attention)
+from parameter_server_distributed_tpu.ops.ring_attention import (
+    make_ring_attention, make_ulysses_attention)
+from parameter_server_distributed_tpu.parallel.mesh import build_mesh
+from parameter_server_distributed_tpu.parallel.train_step import (
+    ShardedTrainer, make_optimizer)
+from parameter_server_distributed_tpu.models.transformer import transformer_rule
+
+
+def qkv(rng, b=4, s=32, h=4, d=16):
+    shape = (b, s, h, d)
+    return (rng.standard_normal(shape).astype(np.float32),
+            rng.standard_normal(shape).astype(np.float32),
+            rng.standard_normal(shape).astype(np.float32))
+
+
+@pytest.mark.parametrize("seq_shards", [2, 4, 8])
+def test_ring_matches_dense(seq_shards, rng):
+    mesh = build_mesh(MeshConfig(sequence=seq_shards,
+                                 data=8 // seq_shards))
+    q, k, v = qkv(rng)
+    dense = np.asarray(causal_attention(*map(jnp.asarray, (q, k, v))))
+    ring = make_ring_attention(mesh)
+    out = np.asarray(jax.jit(ring)(q, k, v))
+    np.testing.assert_allclose(out, dense, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_with_tensor_parallel_heads(rng):
+    mesh = build_mesh(MeshConfig(sequence=2, tensor=2, data=2))
+    q, k, v = qkv(rng)
+    dense = np.asarray(causal_attention(*map(jnp.asarray, (q, k, v))))
+    out = np.asarray(jax.jit(make_ring_attention(mesh))(q, k, v))
+    np.testing.assert_allclose(out, dense, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("seq_shards", [2, 4])
+def test_ulysses_matches_dense(seq_shards, rng):
+    mesh = build_mesh(MeshConfig(sequence=seq_shards,
+                                 data=8 // seq_shards))
+    q, k, v = qkv(rng)
+    dense = np.asarray(causal_attention(*map(jnp.asarray, (q, k, v))))
+    out = np.asarray(jax.jit(make_ulysses_attention(mesh))(q, k, v))
+    np.testing.assert_allclose(out, dense, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_long_sequence_gradients(rng):
+    """Gradients must flow through the ring (backward ppermutes)."""
+    mesh = build_mesh(MeshConfig(sequence=4, data=2))
+    q, k, v = qkv(rng, b=2, s=64, h=2, d=8)
+    ring = make_ring_attention(mesh)
+
+    def f_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def f_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    g_ring = jax.jit(jax.grad(f_ring))(q, k, v)
+    g_dense = jax.jit(jax.grad(f_dense))(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
+                               rtol=5e-4, atol=5e-5)
+
+
+def test_transformer_with_ring_attention_end_to_end(rng):
+    """Full sharded LM step with ring attention == dense-attention loss."""
+    mesh = build_mesh(MeshConfig(data=2, sequence=4))
+    config = TransformerConfig(vocab=64, d_model=64, n_heads=4, n_layers=2,
+                               d_ff=128, max_seq=64, dtype=jnp.float32)
+    tokens = rng.integers(0, 64, (2, 64)).astype(np.int32)
+
+    plain = Transformer(config)
+    params = plain.init_params(0)
+    base_loss = float(plain.loss(params, jnp.asarray(tokens)))
+
+    ring_model = Transformer(config, attention_fn=make_ring_attention(mesh),
+                             mesh=mesh)
+    trainer = ShardedTrainer(ring_model.loss, mesh, transformer_rule(mesh),
+                             make_optimizer("sgd", 0.1))
+    state = trainer.init_state(params)
+    state, metrics = trainer.step(state, tokens)
+    np.testing.assert_allclose(float(metrics["loss"]), base_loss, rtol=2e-4)

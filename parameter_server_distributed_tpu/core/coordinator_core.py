@@ -73,6 +73,12 @@ class CoordinatorCore:
         """Static config echo (reference: src/coordinator.cpp:46-50)."""
         return self._ps_address, self._ps_port
 
+    def set_parameter_server_address(self, address: str, port: int) -> None:
+        """Re-point discovery (extension: the reference address is fixed at
+        construction; needed for ephemeral ports and PS failover)."""
+        self._ps_address = address
+        self._ps_port = int(port)
+
     def remove_stale_workers(self, timeout_s: float = 30.0) -> list[int]:
         """Evict workers silent for > timeout_s
         (reference: src/coordinator.cpp:52-67).  Returns evicted ids."""

@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Elastic scaling of local workers — analogue of the reference's
+# scripts/scale_workers.sh, minus its biggest flaw: the reference KILLS AND
+# RESTARTS the parameter server with a new TOTAL_WORKERS on every scale
+# event (losing all in-memory parameters; reference
+# scripts/scale_workers.sh:137-144).  Here the PS runs with --elastic and
+# its barrier width follows the coordinator registry, so scaling is purely
+# starting/stopping workers.
+#
+#   scale_workers.sh up N    start workers so that N are running
+#   scale_workers.sh down N  stop workers so that N remain
+#
+# Env: COORDINATOR_ADDR, ITERATIONS, MODEL, BATCH, PID_DIR, LOG_DIR
+set -euo pipefail
+cd "$(dirname "$0")/.."
+ACTION="${1:?usage: scale_workers.sh up|down N}"
+TARGET="${2:?usage: scale_workers.sh up|down N}"
+PID_DIR="${PID_DIR:-./run}"
+LOG_DIR="${LOG_DIR:-.}"
+COORDINATOR_ADDR="${COORDINATOR_ADDR:-127.0.0.1:50052}"
+ITERATIONS="${ITERATIONS:-1000000}"
+MODEL="${MODEL:-mnist_mlp}"
+BATCH="${BATCH:-32}"
+mkdir -p "$PID_DIR"
+
+running_ids() {
+  for f in "$PID_DIR"/worker_*.pid; do
+    [ -e "$f" ] || continue
+    pid=$(cat "$f")
+    if kill -0 "$pid" 2>/dev/null; then
+      basename "$f" | sed 's/worker_\([0-9]*\)\.pid/\1/'
+    else
+      rm -f "$f"
+    fi
+  done
+}
+
+CURRENT=($(running_ids))
+COUNT=${#CURRENT[@]}
+echo "currently running: $COUNT worker(s) [${CURRENT[*]:-}]"
+
+case "$ACTION" in
+  up)
+    NEXT_ID=0
+    for (( ; COUNT < TARGET; COUNT++ )); do
+      while printf '%s\n' "${CURRENT[@]:-}" | grep -qx "$NEXT_ID"; do
+        NEXT_ID=$((NEXT_ID + 1))
+      done
+      WORKER_ID="$NEXT_ID" ITERATIONS="$ITERATIONS" MODEL="$MODEL" \
+        BATCH="$BATCH" COORDINATOR_ADDR="$COORDINATOR_ADDR" \
+        PID_DIR="$PID_DIR" LOG_FILE="$LOG_DIR/worker_${NEXT_ID}.log" \
+        bash scripts/start_worker.sh
+      CURRENT+=("$NEXT_ID")
+    done
+    ;;
+  down)
+    # stop the highest-numbered workers first; the coordinator reaper evicts
+    # them after the 30 s heartbeat timeout and the elastic barrier shrinks
+    mapfile -t SORTED < <(printf '%s\n' "${CURRENT[@]}" | sort -n -r)
+    for id in "${SORTED[@]}"; do
+      [ "$COUNT" -le "$TARGET" ] && break
+      pid=$(cat "$PID_DIR/worker_${id}.pid")
+      echo "stopping worker $id (pid $pid)"
+      kill "$pid" 2>/dev/null || true
+      rm -f "$PID_DIR/worker_${id}.pid"
+      COUNT=$((COUNT - 1))
+    done
+    ;;
+  *)
+    echo "unknown action $ACTION"; exit 1;;
+esac
+echo "now targeting $TARGET worker(s)"

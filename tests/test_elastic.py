@@ -1,0 +1,111 @@
+"""Elastic scaling end-to-end: barrier width follows the live registry,
+with no PS restart (the reference restarts the PS and loses its in-memory
+parameters on every scale event — scripts/scale_workers.sh:137-144)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.cli.worker_main import build_worker
+from parameter_server_distributed_tpu.config import (CoordinatorConfig,
+                                                     ParameterServerConfig,
+                                                     WorkerConfig)
+from parameter_server_distributed_tpu.server.coordinator_service import Coordinator
+from parameter_server_distributed_tpu.server.ps_service import ParameterServer
+
+
+@pytest.fixture
+def elastic_cluster(tmp_path):
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0,
+        ps_address="127.0.0.1", ps_port=1, reap_period_s=600.0))
+    coord_port = coordinator.start()
+    ps = ParameterServer(
+        ParameterServerConfig(
+            bind_address="127.0.0.1", port=0, total_workers=99,
+            checkpoint_interval=100, checkpoint_dir=str(tmp_path),
+            learning_rate=0.05, elastic=True, live_workers_ttl_s=0.0,
+            autosave_period_s=600.0),
+        live_workers_fn=coordinator.core.live_worker_count)
+    ps_port = ps.start()
+    # late-bind the PS address the coordinator hands out
+    coordinator.core.set_parameter_server_address("127.0.0.1", ps_port)
+    yield ps, coordinator, coord_port
+    coordinator.stop()
+    ps.stop()
+
+
+def _worker(coord_port, wid):
+    w = build_worker(WorkerConfig(
+        coordinator_address=f"127.0.0.1:{coord_port}", worker_id=wid,
+        address="127.0.0.1", port=50080 + wid, batch_size=16,
+        heartbeat_period_s=600.0))
+    w.initialize()
+    return w
+
+
+def test_scale_down_without_ps_restart(elastic_cluster):
+    ps, coordinator, coord_port = elastic_cluster
+    w0, w1 = _worker(coord_port, 0), _worker(coord_port, 1)
+    try:
+        # both run 3 lockstep iterations at barrier width 2
+        done = []
+
+        def loop(w):
+            for it in range(3):
+                w.run_iteration(it)
+            done.append(w.config.worker_id)
+
+        threads = [threading.Thread(target=loop, args=(w,)) for w in (w0, w1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert sorted(done) == [0, 1]
+        params_before = ps.core.get_parameters()
+        assert params_before  # PS holds state
+
+        # worker 1 leaves; coordinator evicts it; w0 continues ALONE at the
+        # same PS (barrier shrank 2 -> 1, params preserved)
+        w1.shutdown()
+        evicted = coordinator.core.remove_stale_workers(timeout_s=-1)
+        assert 1 in evicted
+        coordinator.core.register_worker(0, "127.0.0.1", 50080, "h0")
+        for it in range(3, 5):
+            w0.run_iteration(it)
+        assert ps.core.current_iteration == 4
+    finally:
+        w0.shutdown()
+
+
+def test_scale_up_widens_barrier(elastic_cluster):
+    ps, coordinator, coord_port = elastic_cluster
+    w0 = _worker(coord_port, 0)
+    try:
+        w0.run_iteration(0)  # bootstrap alone (barrier 1)
+        w0.run_iteration(1)
+        # scale up: worker 2 joins -> barrier width 2
+        w2 = _worker(coord_port, 2)
+        try:
+            results = {}
+
+            def loop(w, start):
+                for it in range(start, start + 2):
+                    results.setdefault(w.config.worker_id, []).append(
+                        w.run_iteration(it))
+
+            t0 = threading.Thread(target=loop, args=(w0, 2))
+            t2 = threading.Thread(target=loop, args=(w2, 2))
+            t0.start(); t2.start()
+            t0.join(timeout=60); t2.join(timeout=60)
+            assert len(results[0]) == 2 and len(results[2]) == 2
+            # barrier now requires both: a lone push at iteration 99 parks
+            r = ps.core.receive_gradients(0, 99, {
+                k: np.zeros_like(v) for k, v in
+                ps.core.get_parameters().items()})
+            assert not r.aggregation_complete and r.total_workers == 2
+        finally:
+            w2.shutdown()
+    finally:
+        w0.shutdown()

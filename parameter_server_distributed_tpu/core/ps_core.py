@@ -328,15 +328,24 @@ class ParameterServerCore:
 def _mean_over_workers(worker_gradients: Mapping[int, TensorStore]) -> TensorStore:
     """Element-wise mean over the gradients of the workers that actually
     contributed (reference: src/parameter_server.cpp:40-63 — sum then divide
-    by contributor count, NOT by configured total)."""
-    count = len(worker_gradients)
-    acc: TensorStore = {}
+    by contributor count, NOT by configured total).  Uses the fused native
+    C++ kernel when available (native/psdt_native.cpp psdt_mean), numpy
+    otherwise."""
+    from ..native import mean_over_workers_native
+
+    by_name: dict[str, list[np.ndarray]] = {}
     for grads in worker_gradients.values():
         for name, g in grads.items():
-            g = np.asarray(g, np.float32)
-            if name in acc:
-                acc[name] = acc[name] + g
-            else:
-                acc[name] = g.copy()
-    inv = np.float32(1.0 / count)
-    return {name: g * inv for name, g in acc.items()}
+            by_name.setdefault(name, []).append(np.asarray(g, np.float32))
+
+    out: TensorStore = {}
+    for name, arrays in by_name.items():
+        native = mean_over_workers_native(arrays)
+        if native is not None:
+            out[name] = native
+            continue
+        acc = arrays[0].copy()
+        for g in arrays[1:]:
+            acc += g
+        out[name] = acc * np.float32(1.0 / len(arrays))
+    return out

@@ -1,0 +1,135 @@
+"""Native C++ host kernels with automatic build + Python fallback.
+
+`lib()` returns the ctypes-bound shared library, compiling it with g++ on
+first use (cached under native/build/).  Every consumer must handle
+``lib() is None`` (no compiler available) by falling back to numpy — the
+framework is fully functional without the native path, just slower on the
+host-side PS hot loops.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("pst.native")
+
+_SRC = os.path.join(os.path.dirname(__file__), "psdt_native.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "build")
+_SO_PATH = os.path.join(_BUILD_DIR, "libpsdt_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+
+
+def _build() -> str | None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if (os.path.exists(_SO_PATH)
+            and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC)):
+        return _SO_PATH
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO_PATH, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO_PATH
+    except (OSError, subprocess.SubprocessError) as exc:
+        log.warning("native build failed (%s); using numpy fallback", exc)
+        return None
+
+
+def _bind(path: str) -> ctypes.CDLL:
+    lib = ctypes.CDLL(path)
+    i64, i32, f32 = ctypes.c_int64, ctypes.c_int32, ctypes.c_float
+    pp = ctypes.POINTER(_F32P)
+    lib.psdt_mean.argtypes = [pp, i32, i64, _F32P]
+    lib.psdt_sgd.argtypes = [_F32P, _F32P, i64, f32]
+    lib.psdt_momentum.argtypes = [_F32P, _F32P, _F32P, i64, f32, f32]
+    lib.psdt_adam.argtypes = [_F32P, _F32P, _F32P, _F32P, i64, f32, f32, f32,
+                              f32, f32, f32]
+    lib.psdt_mean_sgd.argtypes = [_F32P, pp, i32, i64, f32]
+    lib.psdt_pack_floats.argtypes = [_F32P, i64,
+                                     ctypes.POINTER(ctypes.c_uint8)]
+    lib.psdt_pack_floats.restype = i64
+    lib.psdt_varint_encode.argtypes = [ctypes.c_uint64,
+                                       ctypes.POINTER(ctypes.c_uint8)]
+    lib.psdt_varint_encode.restype = i32
+    lib.psdt_varint_decode.argtypes = [ctypes.POINTER(ctypes.c_uint8), i64,
+                                       ctypes.POINTER(ctypes.c_uint64)]
+    lib.psdt_varint_decode.restype = i32
+    return lib
+
+
+def lib() -> ctypes.CDLL | None:
+    """The bound native library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is None and not _tried:
+            _tried = True
+            path = _build()
+            if path is not None:
+                try:
+                    _lib = _bind(path)
+                except OSError as exc:
+                    log.warning("native load failed: %s", exc)
+    return _lib
+
+
+def _fptr(arr: np.ndarray) -> _F32P:
+    return arr.ctypes.data_as(_F32P)
+
+
+def mean_over_workers_native(arrays: list[np.ndarray]) -> np.ndarray | None:
+    """Fused mean of equally-shaped float32 arrays; None if no native lib or
+    arrays unsuitable."""
+    native = lib()
+    if native is None or not arrays:
+        return None
+    first = arrays[0]
+    if np.asarray(first).dtype != np.float32:
+        return None
+    contig = [np.ascontiguousarray(a, np.float32) for a in arrays]
+    if any(c.shape != contig[0].shape for c in contig):
+        return None
+    out = np.empty_like(contig[0])
+    ptrs = (_F32P * len(contig))(*[_fptr(c) for c in contig])
+    native.psdt_mean(ptrs, len(contig), contig[0].size, _fptr(out))
+    return out
+
+
+def sgd_native(param: np.ndarray, grad: np.ndarray, lr: float) -> bool:
+    """In-place param -= lr*grad; returns False if native path unavailable."""
+    native = lib()
+    if (native is None or param.dtype != np.float32
+            or not param.flags.c_contiguous
+            or param.shape != np.shape(grad)):
+        return False
+    grad_c = np.ascontiguousarray(grad, np.float32)
+    native.psdt_sgd(_fptr(param), _fptr(grad_c), param.size,
+                    ctypes.c_float(lr))
+    return True
+
+
+def mean_sgd_native(param: np.ndarray, grads: list[np.ndarray],
+                    lr: float) -> bool:
+    """In-place fused param -= lr*mean(grads)."""
+    native = lib()
+    if (native is None or not grads or param.dtype != np.float32
+            or not param.flags.c_contiguous):
+        return False
+    contig = [np.ascontiguousarray(g, np.float32) for g in grads]
+    if any(c.shape != param.shape for c in contig):
+        return False
+    ptrs = (_F32P * len(contig))(*[_fptr(c) for c in contig])
+    native.psdt_mean_sgd(_fptr(param), ptrs, len(contig), param.size,
+                         ctypes.c_float(lr))
+    return True

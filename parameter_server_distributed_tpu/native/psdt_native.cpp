@@ -1,0 +1,122 @@
+// Native host-side kernels for the parameter-server control plane.
+//
+// The reference implements its entire PS runtime in C++ — in particular the
+// aggregation hot loop (sum over workers x tensors x elements, then the SGD
+// apply; reference: src/parameter_server.cpp:40-91).  In this framework the
+// *device* data plane is XLA-compiled, but the host-side PS (async mode,
+// RPC-fed) still sums worker gradients and applies updates on the CPU.
+// These kernels do that GIL-free (callers release the GIL via ctypes), with
+// a fused single pass per tensor instead of numpy temporaries per operand.
+//
+// Also: a proto3 packed-float codec helper used by the wire layer for
+// zero-copy float packing (proto/parameter_server.proto:22 `repeated float
+// data` is a length-delimited little-endian blob).
+//
+// Build: native/build.py (g++ -O3 -shared), loaded via ctypes with a pure
+// Python/numpy fallback when no compiler is available.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// out[i] = sum_w srcs[w][i] / count   (the barrier mean,
+// mean-over-actual-contributors semantics)
+void psdt_mean(const float** srcs, int32_t count, const int64_t n,
+               float* out) {
+    if (count <= 0) return;
+    const float inv = 1.0f / static_cast<float>(count);
+    // first source initializes, remaining accumulate, single store pass
+    for (int64_t i = 0; i < n; ++i) {
+        float acc = srcs[0][i];
+        for (int32_t w = 1; w < count; ++w) acc += srcs[w][i];
+        out[i] = acc * inv;
+    }
+}
+
+// param -= lr * grad   (the reference's update rule at lr=1.0)
+void psdt_sgd(float* param, const float* grad, const int64_t n,
+              const float lr) {
+    for (int64_t i = 0; i < n; ++i) param[i] -= lr * grad[i];
+}
+
+// velocity = mu * velocity + grad; param -= lr * velocity  (one pass)
+void psdt_momentum(float* param, const float* grad, float* velocity,
+                   const int64_t n, const float lr, const float mu) {
+    for (int64_t i = 0; i < n; ++i) {
+        const float v = mu * velocity[i] + grad[i];
+        velocity[i] = v;
+        param[i] -= lr * v;
+    }
+}
+
+// Adam fused pass.  bc1/bc2 are the bias-correction denominators.
+void psdt_adam(float* param, const float* grad, float* m, float* v,
+               const int64_t n, const float lr, const float b1,
+               const float b2, const float eps, const float bc1,
+               const float bc2) {
+    for (int64_t i = 0; i < n; ++i) {
+        const float g = grad[i];
+        const float m_new = b1 * m[i] + (1.0f - b1) * g;
+        const float v_new = b2 * v[i] + (1.0f - b2) * g * g;
+        m[i] = m_new;
+        v[i] = v_new;
+        const float m_hat = m_new / bc1;
+        const float v_hat = v_new / bc2;
+        param[i] -= lr * m_hat / (__builtin_sqrtf(v_hat) + eps);
+    }
+}
+
+// Fused mean + SGD: param -= lr * mean(srcs) with no intermediate buffer.
+void psdt_mean_sgd(float* param, const float** srcs, int32_t count,
+                   const int64_t n, const float lr) {
+    if (count <= 0) return;
+    const float scale = lr / static_cast<float>(count);
+    for (int64_t i = 0; i < n; ++i) {
+        float acc = srcs[0][i];
+        for (int32_t w = 1; w < count; ++w) acc += srcs[w][i];
+        param[i] -= scale * acc;
+    }
+}
+
+// --------------------------------------------------------------------------
+// proto3 varint + packed-float helpers (wire layer fast path)
+// --------------------------------------------------------------------------
+
+// Encode a varint; returns bytes written (buffer must have >= 10 bytes).
+int32_t psdt_varint_encode(uint64_t value, uint8_t* out) {
+    int32_t i = 0;
+    while (value >= 0x80) {
+        out[i++] = static_cast<uint8_t>(value) | 0x80;
+        value >>= 7;
+    }
+    out[i++] = static_cast<uint8_t>(value);
+    return i;
+}
+
+// Decode a varint; writes value, returns bytes consumed (0 on error).
+int32_t psdt_varint_decode(const uint8_t* buf, const int64_t len,
+                           uint64_t* value) {
+    uint64_t result = 0;
+    int32_t shift = 0;
+    for (int32_t i = 0; i < len && i < 10; ++i) {
+        result |= static_cast<uint64_t>(buf[i] & 0x7F) << shift;
+        if (!(buf[i] & 0x80)) {
+            *value = result;
+            return i + 1;
+        }
+        shift += 7;
+    }
+    return 0;
+}
+
+// Write the length-delimited packed-float field body (field tag handled by
+// caller): varint(byte length) + raw LE floats.  Returns bytes written.
+int64_t psdt_pack_floats(const float* data, const int64_t n, uint8_t* out) {
+    const int64_t payload = n * 4;
+    int32_t header = psdt_varint_encode(static_cast<uint64_t>(payload), out);
+    std::memcpy(out + header, data, static_cast<size_t>(payload));
+    return header + payload;
+}
+
+}  // extern "C"

@@ -3,8 +3,12 @@
 The PS update path (reference: the aggregation loop + `param -= avg_grad`
 at src/parameter_server.cpp:40-91, single-threaded C++ over every element)
 becomes one pallas pass per tensor: read param/grad (and slots), write the
-updated values, all in VMEM-resident tiles with in-place aliasing — no
-intermediate HBM round-trips between optimizer sub-ops.
+updated values, all in VMEM-resident tiles — no intermediate HBM
+round-trips between optimizer sub-ops.
+
+Hyperparameters (lr, betas, ...) are compile-time constants baked into the
+kernel (they change at most a handful of times per run; each distinct value
+costs one recompile and zero per-step scalar traffic).
 
 Arrays are processed as (rows, 128) tiles (padded as needed).  On non-TPU
 backends kernels run in interpret mode so the same code path is tested on
@@ -20,7 +24,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
 SUBLANE = 8
@@ -30,28 +33,26 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _sgd_kernel(lr_ref, p_ref, g_ref, out_ref):
-    out_ref[:] = p_ref[:] - lr_ref[0] * g_ref[:]
+def _sgd_kernel(p_ref, g_ref, out_ref, *, lr: float):
+    out_ref[:] = p_ref[:] - lr * g_ref[:]
 
 
-def _momentum_kernel(scalar_ref, p_ref, g_ref, vel_ref, p_out, vel_out):
-    lr, mu = scalar_ref[0], scalar_ref[1]
+def _momentum_kernel(p_ref, g_ref, vel_ref, p_out, vel_out, *, lr: float,
+                     mu: float):
     v_new = mu * vel_ref[:] + g_ref[:]
     vel_out[:] = v_new
     p_out[:] = p_ref[:] - lr * v_new
 
 
-def _adam_kernel(scalar_ref, p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out):
-    lr, b1, b2, eps, bc1, bc2 = (scalar_ref[0], scalar_ref[1], scalar_ref[2],
-                                 scalar_ref[3], scalar_ref[4], scalar_ref[5])
+def _adam_kernel(p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out, *,
+                 lr: float, b1: float, b2: float, eps: float, bc1: float,
+                 bc2: float):
     g = g_ref[:]
     m_new = b1 * m_ref[:] + (1.0 - b1) * g
     v_new = b2 * v_ref[:] + (1.0 - b2) * g * g
     m_out[:] = m_new
     v_out[:] = v_new
-    m_hat = m_new / bc1
-    v_hat = v_new / bc2
-    p_out[:] = p_ref[:] - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    p_out[:] = p_ref[:] - lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
 
 
 def _as_tiles(arr: jax.Array) -> tuple[jax.Array, int]:
@@ -68,12 +69,26 @@ def _from_tiles(tiles: jax.Array, n: int, shape, dtype) -> jax.Array:
     return tiles.reshape(-1)[:n].reshape(shape).astype(dtype)
 
 
+def _run(kernel, arrays: list[jax.Array], num_outputs: int,
+         interpret: bool) -> list[jax.Array]:
+    rows = arrays[0].shape[0]
+    block = pl.BlockSpec((rows, LANE), lambda: (0, 0))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)] * num_outputs,
+        in_specs=[block] * len(arrays),
+        out_specs=[block] * num_outputs,
+        interpret=interpret,
+    )(*arrays)
+    return list(out)
+
+
 def fused_sgd(params: Mapping[str, jax.Array],
               grads: Mapping[str, jax.Array], lr: float,
               interpret: bool | None = None) -> dict[str, jax.Array]:
     """param <- param - lr * grad, one fused pass per tensor."""
     interpret = _interpret_default() if interpret is None else interpret
-    scalars = jnp.asarray([lr], jnp.float32)
+    kernel = functools.partial(_sgd_kernel, lr=float(lr))
     out = {}
     for name, p in params.items():
         if name not in grads:
@@ -81,15 +96,7 @@ def fused_sgd(params: Mapping[str, jax.Array],
             continue
         tiles_p, n = _as_tiles(p)
         tiles_g, _ = _as_tiles(grads[name])
-        rows = tiles_p.shape[0]
-        block = pl.BlockSpec((rows, LANE), lambda: (0, 0))
-        (res,) = pl.pallas_call(
-            _sgd_kernel,
-            out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)],
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), block, block],
-            out_specs=[block],
-            interpret=interpret,
-        )(scalars, tiles_p, tiles_g)
+        (res,) = _run(kernel, [tiles_p, tiles_g], 1, interpret)
         out[name] = _from_tiles(res, n, np.shape(p), p.dtype)
     return out
 
@@ -100,7 +107,7 @@ def fused_momentum(params: Mapping[str, jax.Array],
                    mu: float = 0.9, interpret: bool | None = None):
     """Fused momentum SGD: returns (new_params, new_velocity)."""
     interpret = _interpret_default() if interpret is None else interpret
-    scalars = jnp.asarray([lr, mu], jnp.float32)
+    kernel = functools.partial(_momentum_kernel, lr=float(lr), mu=float(mu))
     new_p, new_v = {}, {}
     for name, p in params.items():
         if name not in grads:
@@ -108,15 +115,7 @@ def fused_momentum(params: Mapping[str, jax.Array],
             continue
         tiles = [_as_tiles(x) for x in (p, grads[name], velocity[name])]
         n = tiles[0][1]
-        rows = tiles[0][0].shape[0]
-        block = pl.BlockSpec((rows, LANE), lambda: (0, 0))
-        res = pl.pallas_call(
-            _momentum_kernel,
-            out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)] * 2,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + [block] * 3,
-            out_specs=[block] * 2,
-            interpret=interpret,
-        )(scalars, *[t for t, _ in tiles])
+        res = _run(kernel, [t for t, _ in tiles], 2, interpret)
         new_p[name] = _from_tiles(res[0], n, np.shape(p), p.dtype)
         new_v[name] = _from_tiles(res[1], n, np.shape(p), jnp.float32)
     return new_p, new_v
@@ -130,26 +129,18 @@ def fused_adam(params: Mapping[str, jax.Array],
                interpret: bool | None = None):
     """Fused Adam: returns (new_params, new_m, new_v)."""
     interpret = _interpret_default() if interpret is None else interpret
-    bc1 = 1.0 - b1 ** step
-    bc2 = 1.0 - b2 ** step
-    scalars = jnp.asarray([lr, b1, b2, eps, bc1, bc2], jnp.float32)
+    kernel = functools.partial(
+        _adam_kernel, lr=float(lr), b1=float(b1), b2=float(b2),
+        eps=float(eps), bc1=float(1.0 - b1 ** step),
+        bc2=float(1.0 - b2 ** step))
     new_p, new_m, new_v = {}, {}, {}
     for name, p in params.items():
         if name not in grads:
             new_p[name], new_m[name], new_v[name] = p, m.get(name), v.get(name)
             continue
-        tiles = [_as_tiles(x) for x in
-                 (p, grads[name], m[name], v[name])]
+        tiles = [_as_tiles(x) for x in (p, grads[name], m[name], v[name])]
         n = tiles[0][1]
-        rows = tiles[0][0].shape[0]
-        block = pl.BlockSpec((rows, LANE), lambda: (0, 0))
-        res = pl.pallas_call(
-            _adam_kernel,
-            out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)] * 3,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + [block] * 4,
-            out_specs=[block] * 3,
-            interpret=interpret,
-        )(scalars, *[t for t, _ in tiles])
+        res = _run(kernel, [t for t, _ in tiles], 3, interpret)
         new_p[name] = _from_tiles(res[0], n, np.shape(p), p.dtype)
         new_m[name] = _from_tiles(res[1], n, np.shape(p), jnp.float32)
         new_v[name] = _from_tiles(res[2], n, np.shape(p), jnp.float32)

@@ -1,0 +1,177 @@
+"""Benchmark entry point — prints ONE JSON line to stdout.
+
+Headline metric: MFU of the sharded training step on an MLP sized for the
+available accelerator (the BASELINE.md north-star metric; the reference
+publishes no numbers — BASELINE.json "published": {} — so vs_baseline is
+reported against the 45% MFU target).
+
+Secondary metrics (stderr): step time, grad-samples/sec/chip, and the PS
+control-plane push/pull p50 latency over real gRPC on localhost.
+
+Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
+(mfu | samples | pushpull; default mfu).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(*args) -> None:
+    print(*args, file=sys.stderr, flush=True)
+
+
+# bf16 peak FLOP/s per chip by device kind (dense)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_for(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for name, peak in PEAK_FLOPS.items():
+        if kind.startswith(name) or name.startswith(kind):
+            return peak
+    return None
+
+
+def bench_mfu() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from parameter_server_distributed_tpu.models.mlp import MLP
+    from parameter_server_distributed_tpu.parallel.mesh import build_mesh
+    from parameter_server_distributed_tpu.parallel.sharding import fsdp_rule
+    from parameter_server_distributed_tpu.parallel.train_step import (
+        ShardedTrainer, make_optimizer)
+    from parameter_server_distributed_tpu.config import MeshConfig
+    import numpy as np
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    if on_tpu:
+        hidden, layers, batch = 8192, 4, 2048
+        dtype = jnp.bfloat16
+    else:  # CPU smoke shape
+        hidden, layers, batch = 256, 2, 256
+        dtype = jnp.float32
+
+    model = MLP((hidden,) * (layers + 2), dtype=dtype)
+    n_params = model.num_params()
+    log(f"bench_mfu: device={device.device_kind} params={n_params/1e6:.1f}M "
+        f"batch={batch}")
+
+    mesh = build_mesh(MeshConfig(), devices=[device])
+    trainer = ShardedTrainer(model.loss, mesh, fsdp_rule(mesh),
+                             make_optimizer("sgd", 0.01))
+    state = trainer.init_state(model.init_params(0))
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, hidden)).astype(np.float32)
+    y = rng.integers(0, hidden, batch).astype(np.int32)
+    batch_data = (x, y)
+
+    step = trainer.step_fn()
+    import jax as _jax
+    batch_dev = _jax.device_put(batch_data)
+
+    # warmup / compile
+    for _ in range(3):
+        state, metrics = step(state, batch_dev)
+    _jax.block_until_ready(metrics["loss"])
+
+    steps = int(os.environ.get("PSDT_BENCH_STEPS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_dev)
+    _jax.block_until_ready(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    # fwd+bwd+update: ~6 matmul flops per param per sample
+    flops_per_step = 6.0 * n_params * batch
+    achieved = flops_per_step / dt
+    samples_per_sec = batch / dt
+    log(f"bench_mfu: step={dt*1e3:.2f}ms samples/s/chip={samples_per_sec:,.0f} "
+        f"achieved={achieved/1e12:.2f} TFLOP/s")
+
+    peak = peak_for(device) if on_tpu else None
+    if peak:
+        mfu = achieved / peak
+        log(f"bench_mfu: MFU={mfu*100:.1f}% (peak {peak/1e12:.0f} TFLOP/s)")
+        return {"metric": "mlp_train_mfu", "value": round(mfu, 4),
+                "unit": "fraction_of_peak",
+                "vs_baseline": round(mfu / 0.45, 3)}
+    return {"metric": "mlp_train_samples_per_sec_chip",
+            "value": round(samples_per_sec, 1), "unit": "samples/sec",
+            "vs_baseline": 1.0}
+
+
+def bench_pushpull() -> dict:
+    """p50 latency of PS push+pull round-trips over localhost gRPC
+    (BASELINE.md 'push/pull p50' metric)."""
+    import numpy as np
+
+    from parameter_server_distributed_tpu.config import ParameterServerConfig
+    from parameter_server_distributed_tpu.core.tensor import to_wire
+    from parameter_server_distributed_tpu.rpc import messages as m
+    from parameter_server_distributed_tpu.rpc.service import RpcClient
+    from parameter_server_distributed_tpu.server.ps_service import ParameterServer
+
+    ps = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=1,
+        autosave_period_s=3600.0, checkpoint_dir="/tmp"))
+    port = ps.start()
+    rng = np.random.default_rng(0)
+    params = {"w": rng.standard_normal((1024, 256)).astype(np.float32)}
+    ps.core.initialize_parameters(params)
+    grads = to_wire({"w": rng.standard_normal((1024, 256)).astype(np.float32)})
+
+    client = RpcClient(f"127.0.0.1:{port}", m.PARAMETER_SERVER_SERVICE,
+                       m.PARAMETER_SERVER_METHODS)
+    push_times, pull_times = [], []
+    for it in range(60):
+        t0 = time.perf_counter()
+        client.call("ReceiveGradients",
+                    m.GradientUpdate(worker_id=0, iteration=it,
+                                     gradients=grads))
+        push_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        client.call("ServeParameters", m.PullRequest(worker_id=0, iteration=it))
+        pull_times.append(time.perf_counter() - t0)
+    client.close()
+    ps.stop()
+    push_p50 = sorted(push_times)[len(push_times) // 2] * 1e3
+    pull_p50 = sorted(pull_times)[len(pull_times) // 2] * 1e3
+    log(f"bench_pushpull: 1M-param store push_p50={push_p50:.2f}ms "
+        f"pull_p50={pull_p50:.2f}ms")
+    return {"metric": "ps_pushpull_p50", "value": round(push_p50 + pull_p50, 2),
+            "unit": "ms_roundtrip", "vs_baseline": 1.0}
+
+
+def main() -> int:
+    mode = os.environ.get("PSDT_BENCH_MODE", "mfu")
+    try:
+        if mode == "pushpull":
+            result = bench_pushpull()
+        else:
+            result = bench_mfu()
+    except Exception as exc:  # noqa: BLE001 — always emit the JSON line
+        log(f"bench failed: {exc!r}")
+        result = {"metric": "bench_error", "value": 0.0, "unit": "error",
+                  "vs_baseline": 0.0}
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.exit(main())

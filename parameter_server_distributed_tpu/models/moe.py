@@ -1,0 +1,129 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Switch-style top-1 routing with capacity: router picks one expert per
+token, tokens beyond an expert's capacity are dropped (pass through the
+residual), and dispatch/combine are expressed as einsums so that with the
+expert dimension of w1/w2 sharded over the mesh's ``expert`` axis, GSPMD
+lowers dispatch to an all-to-all over ICI — no manual collective code.
+
+Load-balancing auxiliary loss per Switch Transformer: E * sum_e f_e * p_e
+(fraction routed * mean router prob).  No reference analogue (SURVEY.md
+§2: expert parallelism absent from the reference).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 128
+    d_ff: int = 512
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    dtype: object = jnp.float32
+
+
+class MoELayer:
+    def __init__(self, config: MoEConfig):
+        self.config = config
+
+    def param_shapes(self) -> dict[str, tuple[int, ...]]:
+        c = self.config
+        return {
+            "moe/router/w": (c.d_model, c.num_experts),
+            "moe/w1": (c.num_experts, c.d_model, c.d_ff),
+            "moe/w2": (c.num_experts, c.d_ff, c.d_model),
+        }
+
+    def init_params(self, rng: jax.Array | int = 0,
+                    prefix: str = "") -> dict[str, Array]:
+        c = self.config
+        if isinstance(rng, int):
+            rng = jax.random.key(rng)
+        k1, k2, k3 = jax.random.split(rng, 3)
+        return {
+            f"{prefix}moe/router/w": jax.random.normal(
+                k1, (c.d_model, c.num_experts), c.dtype) * 0.02,
+            f"{prefix}moe/w1": jax.random.normal(
+                k2, (c.num_experts, c.d_model, c.d_ff), c.dtype)
+                / math.sqrt(c.d_model),
+            f"{prefix}moe/w2": jax.random.normal(
+                k3, (c.num_experts, c.d_ff, c.d_model), c.dtype)
+                / math.sqrt(c.d_ff),
+        }
+
+    def capacity(self, num_tokens: int) -> int:
+        c = self.config
+        return max(1, int(math.ceil(
+            num_tokens / c.num_experts * c.capacity_factor)))
+
+    def apply(self, params: Mapping[str, Array], x: Array,
+              prefix: str = "") -> tuple[Array, Array]:
+        """x: [B, S, D] -> (out [B, S, D], aux_loss scalar).
+
+        Dropped tokens (over capacity) contribute zero output — callers add
+        the residual connection."""
+        c = self.config
+        b, s, d = x.shape
+        tokens = x.reshape(b * s, d)
+        n = b * s
+        cap = self.capacity(n)
+
+        logits = jnp.dot(tokens.astype(jnp.float32),
+                         params[f"{prefix}moe/router/w"].astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)            # [N, E]
+        expert_idx = jnp.argmax(probs, axis=-1)            # [N]
+        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+
+        # position of each token within its expert's queue
+        onehot = jax.nn.one_hot(expert_idx, c.num_experts, dtype=jnp.int32)
+        position = jnp.cumsum(onehot, axis=0) * onehot     # [N, E], 1-based
+        pos_in_expert = jnp.sum(position, axis=-1) - 1     # [N]
+        keep = pos_in_expert < cap
+
+        # dispatch tensor [N, E, C]: token n -> slot (e, c)
+        dispatch = (jax.nn.one_hot(expert_idx, c.num_experts, dtype=x.dtype)
+                    [:, :, None]
+                    * jax.nn.one_hot(jnp.where(keep, pos_in_expert, cap),
+                                     cap + 1, dtype=x.dtype)[:, None, :cap])
+        # expert inputs [E, C, D] — with w1/w2 sharded over 'expert', GSPMD
+        # turns this einsum contraction into the dispatch all-to-all
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)
+        h = jnp.einsum("ecd,edf->ecf", expert_in, params[f"{prefix}moe/w1"])
+        h = jax.nn.gelu(h)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, params[f"{prefix}moe/w2"])
+        combined = jnp.einsum("nec,ecd->nd", dispatch, expert_out)
+        out = combined * (gate * keep).astype(x.dtype)[:, None]
+
+        # Switch load-balancing aux: E * sum_e (fraction of tokens to e) *
+        # (mean router prob of e)
+        frac = jnp.mean(jax.nn.one_hot(expert_idx, c.num_experts,
+                                       dtype=jnp.float32), axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        aux = c.num_experts * jnp.sum(frac * mean_prob)
+        return out.reshape(b, s, d), aux
+
+
+def moe_sharding_rule(mesh: Mesh):
+    """Shard the expert dimension over ``expert``; router replicated."""
+    n_exp = mesh.shape["expert"]
+
+    def rule(name: str, shape: tuple[int, ...]) -> PartitionSpec:
+        if "/moe/w" in name or name.startswith("moe/w"):
+            spec: list = [None] * len(shape)
+            if n_exp > 1 and shape[0] % n_exp == 0:
+                spec[0] = "expert"
+            return PartitionSpec(*spec)
+        return PartitionSpec()
+
+    return rule

@@ -1,0 +1,100 @@
+"""Pipeline parallelism over the mesh's ``pipe`` axis.
+
+GPipe-style microbatch pipelining in shard_map: stage parameters live on
+their pipe rank (leading axis sharded over ``pipe``), activations flow rank
+-> rank via `ppermute` once per tick, and microbatches stream through so
+all stages work concurrently after the fill phase.  The schedule runs
+M + P - 1 ticks for M microbatches over P stages (bubble fraction
+(P-1)/(M+P-1)).
+
+Differentiable end-to-end (ppermute transposes to the reverse rotation), so
+`jax.grad` of a pipelined loss gives exact gradients — no reference
+analogue (the reference has no model layer at all; SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def num_pipeline_stages(mesh: Mesh) -> int:
+    return mesh.shape["pipe"]
+
+
+def stack_stage_params(per_stage_params: list[dict], mesh: Mesh) -> dict:
+    """Stack per-stage param stores along a leading [P] axis and shard it
+    over ``pipe``: stage i's weights live on pipe rank i."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+    sharding = NamedSharding(mesh, P("pipe"))
+
+    def place(x):
+        spec = P("pipe", *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, stacked)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
+                   mesh: Mesh, num_microbatches: int,
+                   batch_axes: tuple[str, ...] = ("data", "fsdp")) -> jax.Array:
+    """Run ``x`` through P pipelined stages.
+
+    stage_fn(params_i, h) -> h applies ONE stage.  stage_params is the
+    stacked store from :func:`stack_stage_params` ([P, ...] leading axis).
+    x: [B, ...] with B divisible by num_microbatches (and by the data axes).
+    Shape-preserving stages (d_in == d_out), the usual transformer-block
+    case.
+    """
+    n_pipe = mesh.shape["pipe"]
+    if n_pipe == 1:
+        params0 = jax.tree.map(lambda p: p[0], stage_params)
+        return stage_fn(params0, x)
+
+    dp = 1
+    for axis in batch_axes:
+        dp *= mesh.shape.get(axis, 1)
+    local_batch, rem = divmod(x.shape[0], dp)
+    if rem or local_batch % num_microbatches:
+        raise ValueError(
+            f"per-device batch {x.shape[0]}/{dp} must divide by "
+            f"num_microbatches={num_microbatches}")
+    mb = local_batch // num_microbatches
+
+    param_specs = jax.tree.map(
+        lambda p: P("pipe", *([None] * (p.ndim - 1))), stage_params)
+    x_spec = P(batch_axes, *([None] * (x.ndim - 1)))
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(param_specs, x_spec), out_specs=x_spec,
+             check_vma=False)
+    def run(params, x_local):
+        my = jax.lax.axis_index("pipe")
+        my_params = jax.tree.map(lambda p: p[0], params)  # [1,...] -> [...]
+        x_mb = x_local.reshape(num_microbatches, mb, *x_local.shape[1:])
+        state = jnp.zeros_like(x_mb[0])
+        out = jnp.zeros_like(x_mb)
+        fwd = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
+        for t in range(num_microbatches + n_pipe - 1):
+            # stage 0 injects microbatch t during the fill phase
+            if t < num_microbatches:
+                state = jnp.where(my == 0, x_mb[t], state)
+            state = stage_fn(my_params, state)
+            # last stage emits microbatch t-(P-1) during the drain phase
+            out_idx = t - (n_pipe - 1)
+            if 0 <= out_idx < num_microbatches:
+                emit = jnp.where(my == n_pipe - 1, state, jnp.zeros_like(state))
+                out = out.at[out_idx].set(emit)
+            if t < num_microbatches + n_pipe - 2:
+                state = jax.lax.ppermute(state, "pipe", fwd)
+        # outputs live on the last rank; share them with every rank so the
+        # loss (and its gradient) is computed replicated over pipe
+        out = jax.lax.psum(out, "pipe")
+        return out.reshape(x_local.shape)
+
+    return run(stage_params, x)

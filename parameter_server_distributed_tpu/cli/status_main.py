@@ -1,0 +1,55 @@
+"""Cluster status CLI: the observability surface the reference only exposed
+as raw RPCs (Coordinator.ListWorkers — proto/coordinator.proto:8; PS
+CheckSyncStatus — proto/parameter_server.proto:7).
+
+    python -m parameter_server_distributed_tpu.cli.status_main \
+        [coordinator_addr] [--iteration=N]
+
+Prints the worker registry (id/address/hostname) and the PS sync state for
+the given iteration (default: 0).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..config import parse_argv
+from ..rpc import messages as m
+from ..rpc.service import RpcClient
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    positional, flags = parse_argv(argv)
+    coordinator_addr = positional[0] if positional else "127.0.0.1:50052"
+
+    with RpcClient(coordinator_addr, m.COORDINATOR_SERVICE,
+                   m.COORDINATOR_METHODS) as coord:
+        workers = coord.call("ListWorkers", m.ListWorkersRequest(), timeout=5.0)
+        ps_addr = coord.call("GetParameterServerAddress",
+                             m.GetPSAddressRequest(), timeout=5.0)
+
+    print(f"coordinator: {coordinator_addr}")
+    print(f"parameter server: {ps_addr.address}:{ps_addr.port}")
+    print(f"registered workers: {workers.total_workers}")
+    for w in workers.workers:
+        print(f"  worker {w.worker_id}: {w.address}:{w.port} ({w.hostname})")
+
+    iteration = int(flags.get("iteration", 0))
+    try:
+        with RpcClient(f"{ps_addr.address}:{ps_addr.port}",
+                       m.PARAMETER_SERVER_SERVICE,
+                       m.PARAMETER_SERVER_METHODS) as ps:
+            sync = ps.call("CheckSyncStatus",
+                           m.SyncStatusRequest(iteration=iteration),
+                           timeout=5.0)
+        print(f"sync status @ iteration {sync.iteration}: "
+              f"ready={sync.ready} received={sync.workers_received}/"
+              f"{sync.total_workers}")
+    except Exception as exc:  # noqa: BLE001
+        print(f"parameter server unreachable: {exc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Multi-host distributed initialization.
+
+The reference scales across nodes with per-process gRPC plumbing and NCCL
+inside each node (SURVEY.md §5 "Distributed communication backend").  The
+TPU-native equivalent is JAX's multi-controller runtime: every host runs
+the same SPMD program, `jax.distributed.initialize` wires the hosts, and
+the global mesh spans all chips — intra-slice collectives ride ICI, the
+cross-slice/DCN dimension is just an outer mesh axis.
+
+`initialize_multihost()` wraps the three environments:
+
+- TPU pods: zero-config (coordinator resolved from TPU metadata);
+- explicit clusters: coordinator address + process count + index, exactly
+  the role the reference coordinator's address-handout plays
+  (reference: src/coordinator.cpp:46-50);
+- single process: no-op.
+
+`hybrid_mesh_config` builds the canonical DCN x ICI factorization: data
+parallelism outermost (over DCN), model axes innermost (over ICI) —
+collectives that need bandwidth stay on ICI.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+from ..config import MeshConfig
+
+log = logging.getLogger("pst.distributed")
+
+
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> bool:
+    """Initialize the JAX distributed runtime.  Returns True if multi-host
+    was initialized, False for single-process runs."""
+    if num_processes is None:
+        num_processes = int(os.environ.get("PSDT_NUM_PROCESSES", "1"))
+    if num_processes <= 1 and coordinator_address is None:
+        log.info("single-process run; skipping jax.distributed")
+        return False
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = {
+            "coordinator_address": coordinator_address,
+            "num_processes": num_processes,
+            "process_id": (process_id if process_id is not None
+                           else int(os.environ.get("PSDT_PROCESS_ID", "0"))),
+        }
+    jax.distributed.initialize(**kwargs)
+    log.info("jax.distributed initialized: process %d/%d, %d/%d devices local",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+    return True
+
+
+def hybrid_mesh_config(tensor: int = 1, sequence: int = 1, pipeline: int = 1,
+                       expert: int = 1, fsdp: int | None = None) -> MeshConfig:
+    """Factorize the GLOBAL device count with model axes sized to fit within
+    one host's chips (ICI) and the data axis spanning hosts (DCN)."""
+    total = jax.device_count()
+    local = jax.local_device_count()
+    model = tensor * sequence * pipeline * expert
+    if model > local:
+        log.warning("model axes (%d) exceed local chips (%d): model "
+                    "collectives will cross DCN", model, local)
+    if total % model:
+        raise ValueError(f"{total} devices not divisible by model axes {model}")
+    rest = total // model
+    if fsdp is None:
+        # fsdp within what remains of the host, data across hosts
+        fsdp = max(1, min(rest, local // model if model else local))
+        while rest % fsdp:
+            fsdp -= 1
+    return MeshConfig(data=rest // fsdp, fsdp=fsdp, tensor=tensor,
+                      sequence=sequence, pipeline=pipeline, expert=expert)

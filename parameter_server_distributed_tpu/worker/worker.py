@@ -26,6 +26,7 @@ Departures:
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import threading
 import time
@@ -38,6 +39,7 @@ from ..config import WorkerConfig
 from ..core.tensor import TensorStore, from_wire, to_wire
 from ..rpc import messages as m
 from ..rpc.service import RpcClient
+from ..utils.metrics import MetricsLogger, StepTimer
 
 log = logging.getLogger("pst.worker")
 
@@ -55,6 +57,10 @@ class Worker:
         self.status = m.WorkerStatus.IDLE
         self.iteration = -1  # last completed iteration
         self.last_loss = float("nan")
+        metrics_path = os.environ.get("PSDT_METRICS_FILE") or None
+        self.metrics = MetricsLogger(
+            metrics_path and metrics_path.replace("%d", str(config.worker_id)))
+        self.step_timer = StepTimer()
         self._coordinator = RpcClient(config.coordinator_address,
                                       m.COORDINATOR_SERVICE, m.COORDINATOR_METHODS)
         self._ps: RpcClient | None = None
@@ -187,6 +193,7 @@ class Worker:
         """One pull -> compute -> push -> barrier cycle
         (reference: src/worker.cpp:331-406).  Returns the loss."""
         self.status = m.WorkerStatus.TRAINING
+        self.step_timer.__enter__()
         try:
             _, params = self.pull_parameters(iteration)
             if not params:
@@ -233,6 +240,9 @@ class Worker:
             return loss
         finally:
             self.status = m.WorkerStatus.IDLE
+            self.step_timer.__exit__()
+            self.metrics.log(step=self.iteration, loss=self.last_loss,
+                             step_time_s=self.step_timer.summary().get("last_s"))
 
     def _await_barrier(self, iteration: int) -> None:
         """Poll CheckSyncStatus: 50 ms period, <=200 polls, 3 outer retries

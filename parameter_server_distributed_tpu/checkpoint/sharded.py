@@ -1,0 +1,69 @@
+"""Sharded checkpointing for the SPMD data plane (orbax-backed).
+
+The host-side manager (manager.py) serializes the PS's host store in the
+reference's binary format.  The SPMD path's TrainState is a pytree of
+*sharded* jax Arrays — saving it through the host codec would gather every
+shard to one host.  Orbax writes each shard from the device that owns it
+and restores into any mesh/sharding, which is also what makes elastic
+resharding cheap (SURVEY.md §7 "hard parts": checkpoint-restore into the
+new mesh).
+
+Layout per step: ``<dir>/step_<N>/`` (orbax tree) and the same epoch-style
+naming contract as the host manager for discovery.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"step_(\d+)$")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def save_sharded(directory: str, step: int, state: Any) -> str:
+    """Save a (possibly sharded) pytree; returns the checkpoint path."""
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    _checkpointer().save(path, state, force=True)
+    return path
+
+
+def restore_sharded(path: str, template: Any | None = None) -> Any:
+    """Restore a pytree.  With ``template`` (a pytree of sharded arrays or
+    jax.ShapeDtypeStruct with shardings), shards land directly on their
+    owning devices — pass the target TrainState to reshard on restore."""
+    import orbax.checkpoint as ocp
+
+    checkpointer = _checkpointer()
+    if template is None:
+        return checkpointer.restore(path)
+
+    def as_restore_type(leaf):
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            return ocp.ArrayRestoreArgs(sharding=leaf.sharding,
+                                        global_shape=leaf.shape)
+        return ocp.RestoreArgs()
+
+    restore_args = jax.tree.map(as_restore_type, template)
+    return checkpointer.restore(path, item=template,
+                                restore_args=restore_args)
+
+
+def latest_step(directory: str) -> int | None:
+    best = None
+    if not os.path.isdir(directory):
+        return None
+    for name in os.listdir(directory):
+        match = _STEP_RE.search(name)
+        if match:
+            step = int(match.group(1))
+            best = step if best is None else max(best, step)
+    return best

@@ -1,0 +1,73 @@
+"""SPMD training CLI (pure-collectives mode, no PS process).
+
+    python -m parameter_server_distributed_tpu.cli.train_main \
+        --model=mnist_mlp --steps=100 --batch=64 --optimizer=adam --lr=1e-3 \
+        --mesh=data:2,fsdp:2,tensor:2 --ckpt-dir=/tmp/ckpt --ckpt-every=50 \
+        --resume --metrics=/tmp/metrics.jsonl
+
+The mesh spec names axes explicitly; unnamed axes default to 1.  For
+multi-host runs set --coordinator=HOST:PORT --num-processes=N
+--process-id=I (or run on a TPU pod where jax.distributed auto-configures).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+
+from ..config import MeshConfig, parse_argv
+
+
+def parse_mesh(spec: str) -> MeshConfig:
+    if not spec:
+        return MeshConfig()
+    names = {"data", "fsdp", "tensor", "sequence", "pipeline", "expert",
+             "seq", "pipe"}
+    alias = {"seq": "sequence", "pipe": "pipeline"}
+    kwargs = {}
+    for part in spec.split(","):
+        name, _, size = part.partition(":")
+        name = name.strip()
+        if name not in names:
+            raise ValueError(f"unknown mesh axis {name!r}")
+        kwargs[alias.get(name, name)] = int(size)
+    return MeshConfig(**kwargs)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    _, flags = parse_argv(argv)
+
+    if "coordinator" in flags or int(flags.get("num-processes", 1)) > 1:
+        from ..parallel.distributed import initialize_multihost
+        initialize_multihost(
+            coordinator_address=flags.get("coordinator"),
+            num_processes=int(flags.get("num-processes", 1)),
+            process_id=int(flags.get("process-id", 0)))
+
+    from ..parallel.train_loop import TrainLoopConfig, run_training
+
+    config = TrainLoopConfig(
+        model=flags.get("model", "mnist_mlp"),
+        batch_size=int(flags.get("batch", 64)),
+        steps=int(flags.get("steps", 100)),
+        optimizer=flags.get("optimizer", "adam"),
+        learning_rate=float(flags.get("lr", 1e-3)),
+        mesh=parse_mesh(flags.get("mesh", "")),
+        checkpoint_dir=flags.get("ckpt-dir", ""),
+        checkpoint_every=int(flags.get("ckpt-every", 0)),
+        log_every=int(flags.get("log-every", 10)),
+        seed=int(flags.get("seed", 0)),
+        resume="resume" in flags,
+        metrics_path=flags.get("metrics", ""),
+    )
+    summary = run_training(config)
+    print(json.dumps(summary, default=float), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

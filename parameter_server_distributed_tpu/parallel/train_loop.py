@@ -1,0 +1,111 @@
+"""High-level SPMD training loop: the pure-collectives training mode.
+
+This is "sync all-reduce mode" (BASELINE config 4) as a first-class entry
+point: no PS process, no RPC on the data path — the sharded TrainState IS
+the parameter server, the compiled step's collectives are the barrier, and
+the coordinator/PS control plane is only needed for multi-process
+elasticity (not for single-controller SPMD).
+
+Features: donated-buffer steps, JSONL metrics (loss, step time, samples/s/
+chip), periodic sharded checkpoints with resume, profiler hook.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Iterator
+
+import jax
+import numpy as np
+
+from ..config import MeshConfig
+from ..checkpoint import sharded as sharded_ckpt
+from ..models.registry import get_model_and_batches
+from ..utils.metrics import MetricsLogger, StepTimer, profile_trace
+from .mesh import build_mesh, data_parallel_size
+from .sharding import fsdp_rule, fsdp_tp_rule
+from .train_step import ShardedTrainer, make_optimizer
+
+log = logging.getLogger("pst.train")
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainLoopConfig:
+    model: str = "mnist_mlp"
+    batch_size: int = 64          # global batch
+    steps: int = 100
+    optimizer: str = "adam"
+    learning_rate: float = 1e-3
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0     # steps; 0 = disabled
+    log_every: int = 10
+    seed: int = 0
+    resume: bool = False
+    metrics_path: str = ""
+
+
+def _pick_rule(model_name: str, mesh):
+    if "lm" in model_name or "transformer" in model_name:
+        from ..models.transformer import transformer_rule
+        return transformer_rule(mesh)
+    if mesh.shape["tensor"] > 1:
+        return fsdp_tp_rule(mesh)
+    return fsdp_rule(mesh)
+
+
+def run_training(config: TrainLoopConfig) -> dict:
+    # use the first N devices when the mesh is smaller than the machine
+    devices = jax.devices()[:config.mesh.num_devices]
+    mesh = build_mesh(config.mesh, devices=devices)
+    model, batches = get_model_and_batches(config.model, config.batch_size,
+                                           seed=config.seed)
+    trainer = ShardedTrainer(
+        model.loss, mesh, _pick_rule(config.model, mesh),
+        make_optimizer(config.optimizer, config.learning_rate))
+    state = trainer.init_state(model.init_params(config.seed))
+
+    start_step = 0
+    if config.resume and config.checkpoint_dir:
+        last = sharded_ckpt.latest_step(config.checkpoint_dir)
+        if last is not None:
+            state = sharded_ckpt.restore_sharded(
+                f"{config.checkpoint_dir}/step_{last}", template=state)
+            start_step = int(np.asarray(state.step))
+            log.info("resumed from step %d", start_step)
+
+    metrics_log = MetricsLogger(config.metrics_path or None)
+    timer = StepTimer()
+    n_chips = mesh.devices.size
+    last_loss = float("nan")
+
+    with profile_trace("train_loop"):
+        for step_idx in range(start_step, config.steps):
+            batch = next(batches)
+            t0 = time.perf_counter()
+            state, metrics = trainer.step(state, batch)
+            if (step_idx + 1) % config.log_every == 0 or step_idx == config.steps - 1:
+                last_loss = float(metrics["loss"])  # device sync point
+                dt = time.perf_counter() - t0
+                timer.record(dt)
+                metrics_log.log(step=step_idx + 1, loss=last_loss,
+                                step_time_s=dt,
+                                samples_per_sec_chip=config.batch_size / dt / n_chips,
+                                grad_norm=float(metrics["grad_norm"]))
+                log.info("step %d loss %.4f (%.1f ms)", step_idx + 1,
+                         last_loss, dt * 1e3)
+            if (config.checkpoint_every
+                    and (step_idx + 1) % config.checkpoint_every == 0):
+                path = sharded_ckpt.save_sharded(config.checkpoint_dir,
+                                                 step_idx + 1, state)
+                log.info("checkpoint %s", path)
+
+    jax.block_until_ready(state.params)
+    summary = {"final_loss": last_loss, "steps": config.steps,
+               "dp_size": data_parallel_size(mesh), **timer.summary()}
+    if config.checkpoint_every and config.checkpoint_dir:
+        summary["checkpoint"] = sharded_ckpt.save_sharded(
+            config.checkpoint_dir, config.steps, state)
+    return summary

@@ -31,6 +31,10 @@ def parse_mesh(spec: str) -> MeshConfig:
         name = name.strip()
         if name not in names:
             raise ValueError(f"unknown mesh axis {name!r}")
+        if not size.strip().isdigit():
+            raise ValueError(
+                f"mesh axis {name!r} needs an integer size, e.g. "
+                f"'{name}:2' (got {part!r})")
         kwargs[alias.get(name, name)] = int(size)
     return MeshConfig(**kwargs)
 

@@ -14,8 +14,8 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 import time
-from typing import Iterator
 
 import jax
 import numpy as np
@@ -23,7 +23,8 @@ import numpy as np
 from ..config import MeshConfig
 from ..checkpoint import sharded as sharded_ckpt
 from ..models.registry import get_model_and_batches
-from ..utils.metrics import MetricsLogger, StepTimer, profile_trace
+from ..utils.metrics import (MetricsLogger, StepTimer, profile_trace,
+                             samples_per_sec)
 from .mesh import build_mesh, data_parallel_size
 from .sharding import fsdp_rule, fsdp_tp_rule
 from .train_step import ShardedTrainer, make_optimizer
@@ -81,31 +82,45 @@ def run_training(config: TrainLoopConfig) -> dict:
     n_chips = mesh.devices.size
     last_loss = float("nan")
 
+    last_saved_step = -1
+    window_t0 = time.perf_counter()
+    window_steps = 0
     with profile_trace("train_loop"):
         for step_idx in range(start_step, config.steps):
             batch = next(batches)
-            t0 = time.perf_counter()
             state, metrics = trainer.step(state, batch)
+            window_steps += 1
             if (step_idx + 1) % config.log_every == 0 or step_idx == config.steps - 1:
                 last_loss = float(metrics["loss"])  # device sync point
-                dt = time.perf_counter() - t0
+                # Steps dispatch asynchronously; the sync above drains the
+                # whole window, so per-step time is window wall time / steps.
+                dt = (time.perf_counter() - window_t0) / window_steps
                 timer.record(dt)
                 metrics_log.log(step=step_idx + 1, loss=last_loss,
                                 step_time_s=dt,
-                                samples_per_sec_chip=config.batch_size / dt / n_chips,
+                                samples_per_sec_chip=samples_per_sec(
+                                    config.batch_size, dt, n_chips),
                                 grad_norm=float(metrics["grad_norm"]))
                 log.info("step %d loss %.4f (%.1f ms)", step_idx + 1,
                          last_loss, dt * 1e3)
+                window_t0 = time.perf_counter()
+                window_steps = 0
             if (config.checkpoint_every
                     and (step_idx + 1) % config.checkpoint_every == 0):
                 path = sharded_ckpt.save_sharded(config.checkpoint_dir,
                                                  step_idx + 1, state)
+                last_saved_step = step_idx + 1
                 log.info("checkpoint %s", path)
 
     jax.block_until_ready(state.params)
-    summary = {"final_loss": last_loss, "steps": config.steps,
+    end_step = max(start_step, config.steps)
+    summary = {"final_loss": last_loss, "steps": end_step,
                "dp_size": data_parallel_size(mesh), **timer.summary()}
-    if config.checkpoint_every and config.checkpoint_dir:
+    if math.isnan(summary["final_loss"]):
+        summary["final_loss"] = None  # keep the summary strict-JSON safe
+    if (config.checkpoint_every and config.checkpoint_dir
+            and start_step < config.steps
+            and last_saved_step != config.steps):
         summary["checkpoint"] = sharded_ckpt.save_sharded(
             config.checkpoint_dir, config.steps, state)
     return summary

@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Provision the TPU cluster and start all roles — analogue of the
+# reference's scripts/deploy.sh (build -> terraform apply -> wait for ssh ->
+# scp binaries -> start coordinator -> PS -> workers), adapted to GCP TPU
+# VMs.  There is no build step: the "binaries" are the Python package (the
+# C++ host kernels compile on first use on each node).
+#
+#   deploy/deploy.sh apply    # terraform apply + ship package + start roles
+#   deploy/deploy.sh ship     # re-ship package + restart roles (no apply)
+#   deploy/deploy.sh destroy
+#
+# Requires: terraform, gcloud (authenticated), TF_VAR_project set.
+set -euo pipefail
+cd "$(dirname "$0")"
+REPO_ROOT="$(cd .. && pwd)"
+ACTION="${1:-apply}"
+
+if [ "$ACTION" = "destroy" ]; then
+  terraform -chdir=terraform destroy -auto-approve
+  exit 0
+fi
+
+if [ "$ACTION" = "apply" ]; then
+  terraform -chdir=terraform init -input=false
+  terraform -chdir=terraform apply -auto-approve
+fi
+
+OUT="$(terraform -chdir=terraform output -json)"
+ZONE="$(jq -r .zone.value <<<"$OUT")"
+COORD_VM="$(jq -r '.worker_names.value[0]' <<<"$OUT" | sed 's/-worker-0$/-coordinator/')"
+mapfile -t WORKERS < <(jq -r '.worker_names.value[]' <<<"$OUT")
+
+ship_gce() { # ship package to the control-plane VM over plain ssh
+  gcloud compute scp --recurse --zone="$ZONE" \
+    "$REPO_ROOT/parameter_server_distributed_tpu" "$1:/tmp/psdt-pkg"
+  gcloud compute ssh --zone="$ZONE" "$1" --command \
+    "sudo rsync -a --delete /tmp/psdt-pkg/ /opt/psdt/parameter_server_distributed_tpu/ \
+     && sudo systemctl enable --now psdt-coordinator psdt-ps \
+     && sudo systemctl restart psdt-coordinator psdt-ps"
+}
+
+ship_tpu() { # ship package to every host of a TPU slice
+  gcloud compute tpus tpu-vm scp --recurse --worker=all --zone="$ZONE" \
+    "$REPO_ROOT/parameter_server_distributed_tpu" "$1:/tmp/psdt-pkg"
+  gcloud compute tpus tpu-vm ssh --worker=all --zone="$ZONE" "$1" --command \
+    "sudo rsync -a --delete /tmp/psdt-pkg/ /opt/psdt/parameter_server_distributed_tpu/ \
+     && sudo systemctl enable --now psdt-worker && sudo systemctl restart psdt-worker"
+}
+
+echo "== shipping package to control plane ($COORD_VM)"
+ship_gce "$COORD_VM"
+
+# start order mirrors the reference: coordinator -> PS -> workers
+for w in "${WORKERS[@]}"; do
+  echo "== shipping package to worker slice $w"
+  ship_tpu "$w"
+done
+
+echo "== cluster up; check status with:"
+echo "   gcloud compute ssh --zone=$ZONE $COORD_VM --command \\"
+echo "     'PYTHONPATH=/opt/psdt /opt/psdt-venv/bin/python -m parameter_server_distributed_tpu.cli.status_main 127.0.0.1:50052'"

@@ -1,0 +1,93 @@
+// TPU cluster for parameter_server_distributed_tpu.
+//
+// TPU-native analogue of the reference's AWS deployment
+// (reference terraform/main.tf: 1 coordinator t3.medium + 1 PS g4dn.xlarge
+// + N g4dn.xlarge GPU workers, security group opening 50051/50052/22).
+// Role mapping on TPU:
+//   - coordinator + parameter server  -> one CPU-only control-plane VM
+//     (the PS data plane is host RAM + gRPC; it needs cores and network,
+//     not an accelerator)
+//   - GPU workers + NCCL              -> TPU VM slices; intra-slice
+//     gradient aggregation is XLA ICI collectives, so one "worker" here is
+//     a whole slice, not a single device
+//   - security group                  -> VPC firewall on 50051/50052/22
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project
+  zone    = var.zone
+}
+
+resource "google_compute_firewall" "psdt_control_plane" {
+  name    = "${var.cluster_name}-control-plane"
+  network = var.network
+
+  allow {
+    protocol = "tcp"
+    ports    = [tostring(var.coordinator_port), tostring(var.ps_port), "22"]
+  }
+
+  // control-plane RPC is cluster-internal + operator SSH
+  source_ranges = ["10.0.0.0/8", "35.235.240.0/20"]
+  target_tags   = ["${var.cluster_name}-node"]
+}
+
+resource "google_compute_instance" "coordinator" {
+  name         = "${var.cluster_name}-coordinator"
+  machine_type = var.coordinator_machine_type
+  tags         = ["${var.cluster_name}-node"]
+
+  boot_disk {
+    initialize_params {
+      image = "debian-cloud/debian-12"
+      size  = 100
+    }
+  }
+
+  network_interface {
+    network = var.network
+    access_config {} // ephemeral public IP for deploy.sh scp
+  }
+
+  metadata_startup_script = templatefile("${path.module}/startup.sh", {
+    role             = "control-plane"
+    coordinator_port = var.coordinator_port
+    ps_port          = var.ps_port
+    coordinator_host = "" // self
+    total_workers    = var.worker_slice_count
+  })
+}
+
+resource "google_tpu_v2_vm" "worker" {
+  count            = var.worker_slice_count
+  name             = "${var.cluster_name}-worker-${count.index}"
+  zone             = var.zone
+  accelerator_type = var.accelerator_type
+  runtime_version  = var.tpu_runtime_version
+
+  tags = ["${var.cluster_name}-node"]
+
+  network_config {
+    network             = var.network
+    enable_external_ips = true
+  }
+
+  metadata = {
+    startup-script = templatefile("${path.module}/startup.sh", {
+      role             = "worker"
+      coordinator_port = var.coordinator_port
+      ps_port          = var.ps_port
+      coordinator_host = google_compute_instance.coordinator.network_interface[0].network_ip
+      total_workers    = var.worker_slice_count
+    })
+    worker-id = tostring(count.index)
+  }
+}

@@ -1,0 +1,28 @@
+// Outputs consumed by ../deploy.sh via `terraform output -json`
+// (same contract as the reference's terraform/outputs.tf -> deploy.sh:45-50).
+
+output "coordinator_external_ip" {
+  value = google_compute_instance.coordinator.network_interface[0].access_config[0].nat_ip
+}
+
+output "coordinator_internal_ip" {
+  value = google_compute_instance.coordinator.network_interface[0].network_ip
+}
+
+output "coordinator_address" {
+  description = "host:port the workers register against"
+  value       = "${google_compute_instance.coordinator.network_interface[0].network_ip}:${var.coordinator_port}"
+}
+
+output "worker_names" {
+  description = "TPU VM names, for `gcloud compute tpus tpu-vm ssh/scp`"
+  value       = [for w in google_tpu_v2_vm.worker : w.name]
+}
+
+output "worker_slice_count" {
+  value = var.worker_slice_count
+}
+
+output "zone" {
+  value = var.zone
+}

@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Instance startup: installs the runtime and writes systemd units for the
+# node's role.  TPU-native analogue of the reference's terraform/user_data.sh
+# (which installs CUDA 12.3 + NCCL 2.20.3 + gRPC and writes units for the
+# three C++ binaries).  On TPU there is nothing like the CUDA stack to
+# install — jax[tpu] wheels carry libtpu — and all three roles are entry
+# points of one Python package, shipped by deploy.sh to /opt/psdt.
+#
+# Terraform templatefile() substitutes: role, coordinator_host,
+# coordinator_port, ps_port, total_workers.
+set -euo pipefail
+
+ROLE="${role}"
+COORDINATOR_HOST="${coordinator_host}"
+COORDINATOR_PORT="${coordinator_port}"
+PS_PORT="${ps_port}"
+TOTAL_WORKERS="${total_workers}"
+
+export DEBIAN_FRONTEND=noninteractive
+apt-get update -y && apt-get install -y python3-pip python3-venv rsync
+
+install -d /opt/psdt /var/lib/psdt/checkpoints
+python3 -m venv /opt/psdt-venv
+if [ "$ROLE" = "worker" ]; then
+  /opt/psdt-venv/bin/pip install -q 'jax[tpu]' flax optax orbax-checkpoint
+else
+  /opt/psdt-venv/bin/pip install -q jax flax optax orbax-checkpoint
+fi
+
+unit() { # name, description, exec
+  cat > "/etc/systemd/system/$1.service" <<UNIT
+[Unit]
+Description=$2
+After=network-online.target
+
+[Service]
+Environment=PYTHONPATH=/opt/psdt
+WorkingDirectory=/var/lib/psdt
+ExecStart=$3
+Restart=always
+RestartSec=5
+
+[Install]
+WantedBy=multi-user.target
+UNIT
+}
+
+if [ "$ROLE" = "control-plane" ]; then
+  unit psdt-coordinator "psdt coordinator (membership/heartbeats)" \
+    "/opt/psdt-venv/bin/python -m parameter_server_distributed_tpu.cli.coordinator_main 0.0.0.0:$COORDINATOR_PORT 127.0.0.1 $PS_PORT"
+  unit psdt-ps "psdt parameter server (async/bounded-staleness mode)" \
+    "/opt/psdt-venv/bin/python -m parameter_server_distributed_tpu.cli.ps_main 0.0.0.0:$PS_PORT $TOTAL_WORKERS 10 --elastic --coordinator=127.0.0.1:$COORDINATOR_PORT --checkpoint-dir=/var/lib/psdt/checkpoints"
+  systemctl daemon-reload
+  # deploy.sh enables these after rsyncing the package into /opt/psdt
+else
+  WORKER_ID="$(curl -fs -H 'Metadata-Flavor: Google' \
+    http://metadata.google.internal/computeMetadata/v1/instance/attributes/worker-id || echo 0)"
+  unit psdt-worker "psdt training worker (slice host)" \
+    "/opt/psdt-venv/bin/python -m parameter_server_distributed_tpu.cli.worker_main $COORDINATOR_HOST:$COORDINATOR_PORT $WORKER_ID 1000000 0.0.0.0 $((50060 + WORKER_ID)) ''"
+  systemctl daemon-reload
+fi

@@ -1,0 +1,60 @@
+// Input variables for the TPU cluster (analogue of the reference's AWS
+// terraform/variables.tf:18-40 — instance types/counts become TPU
+// accelerator types and slice topology).
+
+variable "project" {
+  description = "GCP project id"
+  type        = string
+}
+
+variable "zone" {
+  description = "Zone with the requested TPU capacity"
+  type        = string
+  default     = "us-east5-a"
+}
+
+variable "cluster_name" {
+  description = "Prefix for all resources"
+  type        = string
+  default     = "psdt"
+}
+
+variable "accelerator_type" {
+  description = "TPU slice type for the worker pool (e.g. v5litepod-8, v5p-32)"
+  type        = string
+  default     = "v5litepod-8"
+}
+
+variable "tpu_runtime_version" {
+  description = "TPU VM runtime image"
+  type        = string
+  default     = "v2-alpha-tpuv5-lite"
+}
+
+variable "worker_slice_count" {
+  description = "Number of independent TPU slices in the worker pool (async/PS mode runs one worker process per slice host; sync SPMD mode uses a single multi-host slice)"
+  type        = number
+  default     = 1
+}
+
+variable "coordinator_machine_type" {
+  description = "Machine type for the coordinator + PS control-plane VM (no accelerator — the data plane lives on the TPUs)"
+  type        = string
+  default     = "e2-standard-4"
+}
+
+variable "coordinator_port" {
+  type    = number
+  default = 50052
+}
+
+variable "ps_port" {
+  type    = number
+  default = 50051
+}
+
+variable "network" {
+  description = "VPC network name"
+  type        = string
+  default     = "default"
+}

@@ -58,27 +58,43 @@ def bench_mfu() -> dict:
 
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
-    if on_tpu:
+    model_name = os.environ.get("PSDT_BENCH_MODEL", "")
+    flops_known = not model_name  # 6*P*B holds for the dense MLP only
+
+    if model_name:
+        from parameter_server_distributed_tpu.models.registry import (
+            get_model_and_batches)
+        batch = int(os.environ.get("PSDT_BENCH_BATCH",
+                                   "256" if on_tpu else "32"))
+        model, batches = get_model_and_batches(model_name, batch)
+        batch_data = next(batches)
+        n_params = model.num_params()
+        # MFU only where 6*P*B is the true cost and the model is big enough
+        # to be compute-bound; small models report samples/s instead.
+        flops_known = model_name == "mlp_1b"
+    elif on_tpu:
         hidden, layers, batch = 8192, 4, 2048
-        dtype = jnp.bfloat16
+        model = MLP((hidden,) * (layers + 2), dtype=jnp.bfloat16)
     else:  # CPU smoke shape
         hidden, layers, batch = 256, 2, 256
-        dtype = jnp.float32
+        model = MLP((hidden,) * (layers + 2), dtype=jnp.float32)
 
-    model = MLP((hidden,) * (layers + 2), dtype=dtype)
-    n_params = model.num_params()
-    log(f"bench_mfu: device={device.device_kind} params={n_params/1e6:.1f}M "
+    if not model_name:
+        n_params = model.num_params()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((batch, hidden)).astype(np.float32)
+        y = rng.integers(0, hidden, batch).astype(np.int32)
+        batch_data = (x, y)
+
+    log(f"bench_mfu: device={device.device_kind} "
+        f"model={model_name or 'bench_mlp'} params={n_params/1e6:.1f}M "
         f"batch={batch}")
 
     mesh = build_mesh(MeshConfig(), devices=[device])
+    opt = os.environ.get("PSDT_BENCH_OPT", "sgd")
     trainer = ShardedTrainer(model.loss, mesh, fsdp_rule(mesh),
-                             make_optimizer("sgd", 0.01))
+                             make_optimizer(opt, 0.01))
     state = trainer.init_state(model.init_params(0))
-
-    rng = np.random.default_rng(0)
-    x = rng.standard_normal((batch, hidden)).astype(np.float32)
-    y = rng.integers(0, hidden, batch).astype(np.int32)
-    batch_data = (x, y)
 
     step = trainer.step_fn()
     import jax as _jax
@@ -119,21 +135,21 @@ def bench_mfu() -> dict:
             "host too noisy for a valid measurement")
     dt = (t2 - t1) / (n2 - n1)
 
-    # fwd+bwd+update: ~6 matmul flops per param per sample
-    flops_per_step = 6.0 * n_params * batch
-    achieved = flops_per_step / dt
     samples_per_sec = batch / dt
-    log(f"bench_mfu: step={dt*1e3:.2f}ms samples/s/chip={samples_per_sec:,.0f} "
-        f"achieved={achieved/1e12:.2f} TFLOP/s")
+    log(f"bench_mfu: step={dt*1e3:.2f}ms samples/s/chip={samples_per_sec:,.0f}")
 
     peak = peak_for(device) if on_tpu else None
-    if peak:
+    if peak and flops_known:
+        # fwd+bwd+update: ~6 matmul flops per param per sample (dense MLP)
+        achieved = 6.0 * n_params * batch / dt
         mfu = achieved / peak
-        log(f"bench_mfu: MFU={mfu*100:.1f}% (peak {peak/1e12:.0f} TFLOP/s)")
+        log(f"bench_mfu: achieved={achieved/1e12:.2f} TFLOP/s "
+            f"MFU={mfu*100:.1f}% (peak {peak/1e12:.0f} TFLOP/s)")
         return {"metric": "mlp_train_mfu", "value": round(mfu, 4),
                 "unit": "fraction_of_peak",
                 "vs_baseline": round(mfu / 0.45, 3)}
-    return {"metric": "mlp_train_samples_per_sec_chip",
+    name = model_name or "mlp"
+    return {"metric": f"{name}_train_samples_per_sec_chip",
             "value": round(samples_per_sec, 1), "unit": "samples/sec",
             "vs_baseline": 1.0}
 

@@ -30,19 +30,25 @@ Array = jax.Array
 
 
 def _conv(x: Array, w: Array, stride: int = 1) -> Array:
-    """NHWC x HWIO -> NHWC, SAME padding, f32 accumulation."""
+    """NHWC x HWIO -> NHWC, SAME padding.
+
+    Inputs are cast to the weight dtype (MXU compute precision — bf16 for
+    ResNet-50).  XLA:TPU accumulates convs in f32 internally regardless of
+    the storage dtype, and `_norm` lifts back to f32, so the only bf16
+    rounding is at conv boundaries."""
     return jax.lax.conv_general_dilated(
-        x, w, window_strides=(stride, stride), padding="SAME",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32)
+        x.astype(w.dtype), w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def _norm(x: Array, scale: Array, bias: Array, eps: float = 1e-5) -> Array:
-    """Per-channel batch-statistics normalization (train-mode BN)."""
+    """Per-channel batch-statistics normalization (train-mode BN), in f32."""
+    x = x.astype(jnp.float32)
     mean = jnp.mean(x, axis=(0, 1, 2), keepdims=True)
     var = jnp.var(x, axis=(0, 1, 2), keepdims=True)
     inv = jax.lax.rsqrt(var + eps)
-    return (x - mean) * inv * scale + bias
+    return ((x - mean) * inv * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32))
 
 
 class ResNet:
@@ -142,7 +148,7 @@ class ResNet:
     # -------------------------------------------------------------- forward
     def apply(self, params: Mapping[str, Array], x: Array) -> Array:
         p = params
-        h = _conv(x.astype(self.dtype), p["stem/conv/w"],
+        h = _conv(x, p["stem/conv/w"],
                   stride=1 if self.small_inputs else 2)
         h = _norm(h, p["stem/norm/scale"], p["stem/norm/bias"])
         h = jax.nn.relu(h)

@@ -58,6 +58,21 @@ def test_tiny_bottleneck_resnet_forward():
     assert model.apply(params, x).shape == (2, 4)
 
 
+def test_bf16_resnet_trains_with_f32_inputs():
+    """ResNet-50's mixed-precision path: bf16 weights, f32 images —
+    regression for a dtype mismatch at the second conv (f32 conv output
+    fed to a bf16-weight conv)."""
+    model = ResNet(stages=(1, 1), bottleneck=True, num_classes=4, width=8,
+                   small_inputs=False, dtype=jnp.bfloat16)
+    params = model.init_params(0)
+    x = np.random.default_rng(0).standard_normal((2, 16, 16, 3)).astype(np.float32)
+    y = np.array([1, 2], np.int32)
+    loss, grads = jax.value_and_grad(model.loss)(params, (x, y))
+    assert np.isfinite(float(loss))
+    assert grads["stem/conv/w"].dtype == jnp.bfloat16
+    assert np.isfinite(np.float32(np.asarray(grads["head/w"]))).all()
+
+
 def test_transformer_shapes_and_loss_at_init():
     model = small_lm(vocab=64, seq=32)
     params = model.init_params(0)

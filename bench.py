@@ -196,11 +196,77 @@ def bench_pushpull() -> dict:
             "unit": "ms_roundtrip", "vs_baseline": 1.0}
 
 
+def bench_async() -> dict:
+    """End-to-end async/bounded-staleness throughput: real PS + coordinator
+    over localhost gRPC, N worker threads training a real model on the
+    shared device (BASELINE configs 2/5 shape).  Reports aggregate
+    grad-samples/sec across workers."""
+    import threading
+
+    from parameter_server_distributed_tpu.cli.worker_main import build_worker
+    from parameter_server_distributed_tpu.config import (
+        CoordinatorConfig, ParameterServerConfig, WorkerConfig)
+    from parameter_server_distributed_tpu.server.coordinator_service import (
+        Coordinator)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+
+    n_workers = int(os.environ.get("PSDT_BENCH_WORKERS", "4"))
+    iters = int(os.environ.get("PSDT_BENCH_STEPS", "20"))
+    model = os.environ.get("PSDT_BENCH_MODEL", "mnist_mlp")
+    batch = int(os.environ.get("PSDT_BENCH_BATCH", "256"))
+
+    ps = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=n_workers,
+        staleness_bound=4, autosave_period_s=3600.0, checkpoint_dir="/tmp"))
+    ps_port = ps.start()
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+        ps_port=ps_port, reap_period_s=3600.0))
+    coord_port = coordinator.start()
+
+    workers = [build_worker(WorkerConfig(
+        coordinator_address=f"127.0.0.1:{coord_port}", worker_id=i,
+        address="127.0.0.1", port=51060 + i, model=model, batch_size=batch,
+        heartbeat_period_s=3600.0)) for i in range(n_workers)]
+    for w in workers:
+        w.initialize()
+        w.run_iteration(max(0, w.iteration + 1))  # bootstrap + compile
+
+    def run(w):
+        for _ in range(iters):
+            w.run_iteration(w.iteration + 1)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run, args=(w,)) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+
+    for w in workers:
+        w.shutdown()
+    coordinator.stop()
+    ps.stop()
+
+    total_samples = n_workers * iters * batch
+    agg = total_samples / dt
+    log(f"bench_async: {n_workers} workers x {iters} iters, model={model} "
+        f"batch={batch}: {agg:,.0f} grad-samples/s aggregate "
+        f"({ps.core.applied_updates} updates applied)")
+    return {"metric": "async_sgd_grad_samples_per_sec",
+            "value": round(agg, 1), "unit": "samples/sec",
+            "vs_baseline": 1.0}
+
+
 def main() -> int:
     mode = os.environ.get("PSDT_BENCH_MODE", "mfu")
     try:
         if mode == "pushpull":
             result = bench_pushpull()
+        elif mode == "async":
+            result = bench_async()
         else:
             result = bench_mfu()
     except Exception as exc:  # noqa: BLE001 — always emit the JSON line

@@ -18,6 +18,7 @@ Extension flags:
 from __future__ import annotations
 
 import logging
+import math
 import sys
 
 from ..config import WorkerConfig, parse_argv
@@ -62,8 +63,10 @@ def main(argv: list[str] | None = None) -> int:
         for i in range(config.iterations):
             it = max(i, worker.iteration + 1)
             loss = worker.run_iteration(it)
+            desc = "bootstrap: seeded PS init" if math.isnan(loss) \
+                else f"loss {loss:.4f}"
             print(f"Worker {config.worker_id} completed iteration {it} "
-                  f"(loss {loss:.4f})", flush=True)
+                  f"({desc})", flush=True)
     finally:
         worker.shutdown()
     return 0

@@ -156,7 +156,9 @@ def bench_mfu() -> dict:
 
 def bench_pushpull() -> dict:
     """p50 latency of PS push+pull round-trips over localhost gRPC
-    (BASELINE.md 'push/pull p50' metric)."""
+    (BASELINE.md 'push/pull p50' metric).  PSDT_BENCH_WIRE selects the
+    tensor payload encoding: f32 (reference repeated-float, default),
+    raw (f32 bytes), bf16 (half the bytes)."""
     import numpy as np
 
     from parameter_server_distributed_tpu.config import ParameterServerConfig
@@ -165,6 +167,12 @@ def bench_pushpull() -> dict:
     from parameter_server_distributed_tpu.rpc.service import RpcClient
     from parameter_server_distributed_tpu.server.ps_service import ParameterServer
 
+    wire_name = os.environ.get("PSDT_BENCH_WIRE", "f32")
+    if wire_name not in m.WIRE_DTYPE_NAMES:
+        raise ValueError(f"PSDT_BENCH_WIRE={wire_name!r}; "
+                         f"options: {sorted(m.WIRE_DTYPE_NAMES)}")
+    wire_dtype = m.WIRE_DTYPE_NAMES[wire_name]
+
     ps = ParameterServer(ParameterServerConfig(
         bind_address="127.0.0.1", port=0, total_workers=1,
         autosave_period_s=3600.0, checkpoint_dir="/tmp"))
@@ -172,7 +180,8 @@ def bench_pushpull() -> dict:
     rng = np.random.default_rng(0)
     params = {"w": rng.standard_normal((1024, 256)).astype(np.float32)}
     ps.core.initialize_parameters(params)
-    grads = to_wire({"w": rng.standard_normal((1024, 256)).astype(np.float32)})
+    grads = to_wire({"w": rng.standard_normal((1024, 256)).astype(np.float32)},
+                    wire_dtype)
 
     client = RpcClient(f"127.0.0.1:{port}", m.PARAMETER_SERVER_SERVICE,
                        m.PARAMETER_SERVER_METHODS)
@@ -184,15 +193,19 @@ def bench_pushpull() -> dict:
                                      gradients=grads))
         push_times.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        client.call("ServeParameters", m.PullRequest(worker_id=0, iteration=it))
+        client.call("ServeParameters",
+                    m.PullRequest(worker_id=0, iteration=it,
+                                  wire_dtype=wire_dtype))
         pull_times.append(time.perf_counter() - t0)
     client.close()
     ps.stop()
     push_p50 = sorted(push_times)[len(push_times) // 2] * 1e3
     pull_p50 = sorted(pull_times)[len(pull_times) // 2] * 1e3
-    log(f"bench_pushpull: 1M-param store push_p50={push_p50:.2f}ms "
-        f"pull_p50={pull_p50:.2f}ms")
-    return {"metric": "ps_pushpull_p50", "value": round(push_p50 + pull_p50, 2),
+    log(f"bench_pushpull: 1M-param store wire={wire_name} "
+        f"push_p50={push_p50:.2f}ms pull_p50={pull_p50:.2f}ms")
+    metric = ("ps_pushpull_p50" if wire_name == "f32"
+              else f"ps_pushpull_p50_{wire_name}")
+    return {"metric": metric, "value": round(push_p50 + pull_p50, 2),
             "unit": "ms_roundtrip", "vs_baseline": 1.0}
 
 

@@ -13,12 +13,14 @@ Extension flags:
     --model=NAME     model from the registry (default mnist_mlp)
     --batch=N        per-worker batch size (default 32)
     --seed=N         data seed (defaults to worker_id so shards differ)
+    --wire=ENC       tensor payload encoding: f32 (reference-compatible,
+                     default), raw, or bf16 (half the push/pull bytes;
+                     requires a framework PS)
 """
 
 from __future__ import annotations
 
 import logging
-import math
 import sys
 
 from ..config import WorkerConfig, parse_argv
@@ -48,6 +50,7 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_path=positional[5] if len(positional) > 5 else "",
         model=flags.get("model", "mnist_mlp"),
         batch_size=int(flags.get("batch", 32)),
+        wire_dtype=flags.get("wire", "f32"),
     )
     worker = build_worker(config, seed=int(flags["seed"]) if "seed" in flags else None)
     worker.initialize()
@@ -63,7 +66,7 @@ def main(argv: list[str] | None = None) -> int:
         for i in range(config.iterations):
             it = max(i, worker.iteration + 1)
             loss = worker.run_iteration(it)
-            desc = "bootstrap: seeded PS init" if math.isnan(loss) \
+            desc = "bootstrap: seeded PS init" if worker.last_bootstrap \
                 else f"loss {loss:.4f}"
             print(f"Worker {config.worker_id} completed iteration {it} "
                   f"({desc})", flush=True)

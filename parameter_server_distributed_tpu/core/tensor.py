@@ -21,9 +21,12 @@ from ..rpc.messages import Tensor
 TensorStore = dict[str, np.ndarray]
 
 
-def to_wire(store: Mapping[str, np.ndarray]) -> list[Tensor]:
-    """Store -> wire messages (reference: src/worker.cpp:40-52 to_proto)."""
-    return [Tensor.from_array(name, np.asarray(arr)) for name, arr in store.items()]
+def to_wire(store: Mapping[str, np.ndarray], wire_dtype: int = 0) -> list[Tensor]:
+    """Store -> wire messages (reference: src/worker.cpp:40-52 to_proto).
+    `wire_dtype` selects the payload encoding (messages.WIRE_*); the default
+    is the reference-compatible packed repeated-float."""
+    return [Tensor.from_array(name, np.asarray(arr), wire_dtype=wire_dtype)
+            for name, arr in store.items()]
 
 
 def from_wire(tensors: Iterable[Tensor]) -> TensorStore:

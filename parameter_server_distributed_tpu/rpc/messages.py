@@ -26,24 +26,61 @@ from .wire import Field, Message
 DTYPE_FLOAT32 = 0
 DTYPE_FLOAT64 = 1  # declared by the reference IDL, never used by its runtime
 
+# Wire encodings for Tensor payloads.  WIRE_F32 is the reference encoding
+# (packed `repeated float`, field 3).  The packed encodings are a framework
+# extension carried in fields 5/6, which reference peers skip per proto3
+# unknown-field rules; they are only emitted when a peer asks for them.
+WIRE_F32 = 0       # repeated float field 3 (reference-compatible, default)
+WIRE_RAW_F32 = 1   # raw little-endian float32 bytes in field 5
+WIRE_BF16 = 2      # raw bfloat16 bytes in field 5 — half the payload
+
+WIRE_DTYPE_NAMES = {"f32": WIRE_F32, "raw": WIRE_RAW_F32, "bf16": WIRE_BF16}
+
+
+def _bf16_dtype():
+    import ml_dtypes  # ships with jax
+    return ml_dtypes.bfloat16
+
 
 class Tensor(Message):
-    """Named dense tensor (reference proto/parameter_server.proto:19-24)."""
+    """Named dense tensor (reference proto/parameter_server.proto:19-24).
+
+    Fields 1-4 mirror the reference IDL.  Fields 5/6 are the packed-payload
+    extension: when `packed_dtype` != WIRE_F32 the flat data rides in the
+    `packed` bytes blob (bf16 halves push/pull bytes) and field 3 is empty.
+    """
     FIELDS = (
         Field(1, "name", "string"),
         Field(2, "shape", "int32", repeated=True),
         Field(3, "data", "float", repeated=True),
         Field(4, "dtype", "int32"),
+        Field(5, "packed", "bytes"),
+        Field(6, "packed_dtype", "int32"),
     )
 
     @classmethod
-    def from_array(cls, name: str, array: np.ndarray) -> "Tensor":
+    def from_array(cls, name: str, array: np.ndarray,
+                   wire_dtype: int = WIRE_F32) -> "Tensor":
         arr = np.asarray(array, dtype=np.float32)
-        return cls(name=name, shape=list(arr.shape), data=arr.reshape(-1),
-                   dtype=DTYPE_FLOAT32)
+        if wire_dtype == WIRE_RAW_F32:
+            payload = np.ascontiguousarray(arr.reshape(-1), "<f4").tobytes()
+        elif wire_dtype == WIRE_BF16:
+            payload = arr.reshape(-1).astype(_bf16_dtype()).tobytes()
+        else:
+            return cls(name=name, shape=list(arr.shape),
+                       data=arr.reshape(-1), dtype=DTYPE_FLOAT32)
+        return cls(name=name, shape=list(arr.shape), dtype=DTYPE_FLOAT32,
+                   packed=payload, packed_dtype=wire_dtype)
 
     def to_array(self) -> np.ndarray:
-        arr = np.asarray(self.data, dtype=np.float32)
+        if self.packed_dtype == WIRE_BF16 and self.packed:
+            arr = np.frombuffer(self.packed, dtype=_bf16_dtype()).astype(
+                np.float32)
+        elif self.packed_dtype == WIRE_RAW_F32 and self.packed:
+            arr = np.frombuffer(self.packed, dtype="<f4").astype(
+                np.float32, copy=False)
+        else:
+            arr = np.asarray(self.data, dtype=np.float32)
         if self.shape:
             arr = arr.reshape(self.shape)
         return arr
@@ -69,9 +106,13 @@ class PushResponse(Message):
 
 
 class PullRequest(Message):
+    """Field 3 is a framework extension: the wire encoding the client wants
+    served parameters in (WIRE_*).  Reference servers skip it and serve
+    repeated-float; reference clients never set it and get the default."""
     FIELDS = (
         Field(1, "worker_id", "int32"),
         Field(2, "iteration", "int32"),
+        Field(3, "wire_dtype", "int32"),
     )
 
 

@@ -10,8 +10,8 @@ Only the subset of proto3 used by the reference schemas is implemented:
 
 - varint scalar fields: int32, int64, bool, enum (wire type 0)
 - fixed32 float fields (wire type 5)
-- length-delimited: string, embedded messages, packed repeated scalars
-  (wire type 2)
+- length-delimited: string, bytes, embedded messages, packed repeated
+  scalars (wire type 2)
 - repeated messages (one length-delimited record per element)
 - packed repeated float / int32 — with the proto3 requirement that decoders
   accept both packed and unpacked encodings of repeated scalars
@@ -115,7 +115,7 @@ class Field:
                  message_type: type | None = None, repeated: bool = False):
         self.number = number
         self.name = name
-        self.kind = kind  # int32|int64|bool|enum|string|float|message
+        self.kind = kind  # int32|int64|bool|enum|string|bytes|float|message
         self.message_type = message_type
         self.repeated = repeated
 
@@ -199,7 +199,7 @@ def _default_for(f: Field) -> Any:
         return np.zeros((0,), np.float32) if f.kind == "float" else []
     return {
         "int32": 0, "int64": 0, "enum": 0, "bool": False,
-        "string": "", "float": 0.0,
+        "string": "", "bytes": b"", "float": 0.0,
     }.get(f.kind) if f.kind != "message" else None
 
 
@@ -251,6 +251,11 @@ def _encode_field(out: bytearray, f: Field, value: Any) -> None:
             out += _tag(f.number, WT_LEN)
             out += encode_varint(len(data))
             out += data
+    elif kind == "bytes":
+        if value:
+            out += _tag(f.number, WT_LEN)
+            out += encode_varint(len(value))
+            out += value
     elif kind == "float":
         if value:
             out += _tag(f.number, WT_FIXED32)
@@ -324,6 +329,11 @@ def _decode_field(msg: Message, buf: bytes, pos: int, f: Field, wire_type: int) 
         length, pos = decode_varint(buf, pos)
         end = pos + length
         setattr(msg, f.name, buf[pos:end].decode("utf-8"))
+        return end
+    if kind == "bytes":
+        length, pos = decode_varint(buf, pos)
+        end = pos + length
+        setattr(msg, f.name, buf[pos:end])
         return end
     if kind == "float":
         setattr(msg, f.name, struct.unpack_from("<f", buf, pos)[0])

@@ -49,10 +49,14 @@ class ParameterServerService:
         )
 
     # RPC: pull parameters (reference: src/parameter_server_service.cpp:62-84)
+    # Serves in the encoding the client requested (request.wire_dtype, a
+    # framework extension; reference clients leave it 0 = repeated float).
     def ServeParameters(self, request: m.PullRequest, context) -> m.ParameterUpdate:
         iteration, params, ready = self.core.serve_parameters(request.iteration)
-        return m.ParameterUpdate(iteration=iteration,
-                                 parameters=to_wire(params), ready=ready)
+        return m.ParameterUpdate(
+            iteration=iteration,
+            parameters=to_wire(params, wire_dtype=request.wire_dtype),
+            ready=ready)
 
     # RPC: barrier poll (reference: src/parameter_server_service.cpp:85-95)
     def CheckSyncStatus(self, request: m.SyncStatusRequest, context) -> m.SyncStatusResponse:

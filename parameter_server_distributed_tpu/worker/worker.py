@@ -66,6 +66,17 @@ class Worker:
         self._ps: RpcClient | None = None
         self._ps_address: str | None = None
         self._total_workers = 0
+        if config.wire_dtype not in m.WIRE_DTYPE_NAMES:
+            raise ValueError(
+                f"unknown wire_dtype {config.wire_dtype!r}; "
+                f"options: {sorted(m.WIRE_DTYPE_NAMES)}")
+        self._wire_dtype = m.WIRE_DTYPE_NAMES[config.wire_dtype]
+        # Packed pushes start only after the PS proves it honors the packed
+        # extension (first non-empty pull served packed).  A reference PS
+        # skips the extension fields entirely, so pushing packed at it would
+        # silently aggregate empty gradients.
+        self._peer_packed_ok = self._wire_dtype == m.WIRE_F32
+        self.last_bootstrap = False  # True iff the last iteration seeded the PS
         self._stop = threading.Event()
         self._heartbeat_thread: threading.Thread | None = None
         if start_heartbeat:
@@ -169,15 +180,30 @@ class Worker:
         resp = self.query_with_retry(
             lambda: self._ps.call("ServeParameters",
                                   m.PullRequest(worker_id=self.config.worker_id,
-                                                iteration=iteration),
+                                                iteration=iteration,
+                                                wire_dtype=self._wire_dtype),
                                   timeout=30.0))
+        if not self._peer_packed_ok and resp.parameters:
+            if any(t.packed_dtype != m.WIRE_F32 for t in resp.parameters):
+                self._peer_packed_ok = True
+            else:
+                # Server ignored the extension (reference PS): stay on the
+                # reference-compatible f32 encoding rather than pushing
+                # payloads the server cannot see.
+                log.warning(
+                    "worker %d: PS does not support wire_dtype=%s, "
+                    "falling back to f32", self.config.worker_id,
+                    self.config.wire_dtype)
+                self._wire_dtype = m.WIRE_F32
+                self._peer_packed_ok = True
         return resp.iteration, from_wire(resp.parameters)
 
     def push_gradients(self, iteration: int, grads: TensorStore) -> m.PushResponse:
         """reference: src/worker.cpp:254-272."""
+        push_dtype = self._wire_dtype if self._peer_packed_ok else m.WIRE_F32
         update = m.GradientUpdate(worker_id=self.config.worker_id,
                                   iteration=iteration,
-                                  gradients=to_wire(grads))
+                                  gradients=to_wire(grads, push_dtype))
         return self.query_with_retry(
             lambda: self._ps.call("ReceiveGradients", update, timeout=30.0))
 
@@ -194,6 +220,7 @@ class Worker:
         (reference: src/worker.cpp:331-406).  Returns the loss."""
         self.status = m.WorkerStatus.TRAINING
         self.step_timer.__enter__()
+        self.last_bootstrap = False
         try:
             _, params = self.pull_parameters(iteration)
             if not params:
@@ -211,6 +238,7 @@ class Worker:
                 if not push.aggregation_complete:
                     self._await_barrier(iteration)
                 self.iteration = iteration
+                self.last_bootstrap = True
                 return float("nan")
 
             effective_it = iteration

@@ -122,3 +122,89 @@ def test_worker_reconnect_after_coordinator_restart(cluster):
         assert coordinator.core.live_worker_count() == 1
     finally:
         worker.shutdown()
+
+
+def test_bf16_wire_training_loss_decreases(cluster):
+    """Workers configured with --wire=bf16 train end to end; the PS decodes
+    the packed payloads transparently and learning still happens."""
+    ps, coordinator, coord_port, _ = cluster
+    workers = []
+    for wid in range(2):
+        config = WorkerConfig(
+            coordinator_address=f"127.0.0.1:{coord_port}",
+            worker_id=wid, iterations=5,
+            address="127.0.0.1", port=50060 + wid,
+            batch_size=16, model="mnist_mlp",
+            heartbeat_period_s=600.0, wire_dtype="bf16")
+        w = build_worker(config)
+        w.initialize()
+        workers.append(w)
+    try:
+        losses = run_workers(workers, 5)
+        for wid, series in losses.items():
+            real = [x for x in series if np.isfinite(x)]
+            assert len(real) >= 3
+            assert real[-1] < real[0], f"worker {wid} loss did not decrease"
+    finally:
+        for w in workers:
+            w.shutdown()
+
+
+def test_unknown_wire_dtype_rejected(cluster):
+    _, _, coord_port, _ = cluster
+    with pytest.raises(ValueError, match="wire_dtype"):
+        build_worker(WorkerConfig(
+            coordinator_address=f"127.0.0.1:{coord_port}", worker_id=0,
+            wire_dtype="fp16"))
+
+
+def test_bf16_worker_falls_back_against_f32_only_ps(tmp_path):
+    """A PS that ignores the packed extension (the reference's behavior: it
+    skips unknown fields) must not receive packed pushes — the worker detects
+    the f32-only response on its first pull and downgrades itself."""
+    ps = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=2,
+        checkpoint_interval=100, checkpoint_dir=str(tmp_path),
+        learning_rate=0.05, autosave_period_s=600.0))
+    seen_encodings = []
+    orig_serve = type(ps.service).ServeParameters
+    orig_recv = type(ps.service).ReceiveGradients
+
+    def serve_f32_only(request, context):
+        request.wire_dtype = 0  # a reference PS never sees field 3
+        return orig_serve(ps.service, request, context)
+
+    def recording_recv(request, context):
+        seen_encodings.extend(t.packed_dtype for t in request.gradients)
+        return orig_recv(ps.service, request, context)
+
+    # patch BEFORE start(): bind_service captures bound methods at bind time
+    ps.service.ServeParameters = serve_f32_only
+    ps.service.ReceiveGradients = recording_recv
+    ps_port = ps.start()
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0,
+        ps_address="127.0.0.1", ps_port=ps_port, reap_period_s=600.0))
+    coord_port = coordinator.start()
+
+    workers = []
+    try:
+        for wid in range(2):
+            w = build_worker(WorkerConfig(
+                coordinator_address=f"127.0.0.1:{coord_port}",
+                worker_id=wid, iterations=3, address="127.0.0.1",
+                port=50060 + wid, batch_size=16, model="mnist_mlp",
+                heartbeat_period_s=600.0, wire_dtype="bf16"))
+            w.initialize()
+            workers.append(w)
+        losses = run_workers(workers, 3)
+        # every push that reached the PS was plain f32 (no invisible payloads)
+        assert seen_encodings and all(e == 0 for e in seen_encodings)
+        for wid, series in losses.items():
+            real = [x for x in series if np.isfinite(x)]
+            assert real and real[-1] < real[0]
+    finally:
+        for w in workers:
+            w.shutdown()
+        coordinator.stop()
+        ps.stop()

@@ -116,3 +116,35 @@ def test_coordinator_register_discover_heartbeat_list(coordinator):
         lst = client.call("ListWorkers", m.ListWorkersRequest())
         assert lst.total_workers == 1
         assert lst.workers[0].worker_id == 0 and lst.workers[0].port == 50060
+
+
+def test_serve_parameters_in_requested_wire_dtype(ps):
+    """PullRequest.wire_dtype (framework extension) selects the payload
+    encoding; default stays reference-compatible repeated-float."""
+    server, port = ps
+    w = np.linspace(-2, 2, 1024).astype(np.float32)
+    server.core.initialize_parameters({"w": w})
+    with ps_client(port) as client:
+        plain = client.call("ServeParameters",
+                            m.PullRequest(worker_id=0, iteration=0))
+        packed = client.call("ServeParameters",
+                             m.PullRequest(worker_id=0, iteration=0,
+                                           wire_dtype=m.WIRE_BF16))
+        t_plain, t_packed = plain.parameters[0], packed.parameters[0]
+        assert t_plain.packed_dtype == m.WIRE_F32 and not t_plain.packed
+        assert t_packed.packed_dtype == m.WIRE_BF16
+        assert len(t_packed.encode()) < len(t_plain.encode()) * 0.55
+        # linspace over [-2,2] at 1024 points is bf16-representable enough
+        np.testing.assert_allclose(t_packed.to_array(), w, rtol=8e-3)
+        # pushes in bf16 aggregate fine (PS decodes transparently)
+        grads = [m.Tensor.from_array("w", np.full_like(w, 0.25),
+                                     wire_dtype=m.WIRE_BF16)]
+        for wid in (0, 1):
+            push = client.call("ReceiveGradients",
+                               m.GradientUpdate(worker_id=wid, iteration=1,
+                                                gradients=grads))
+        assert push.aggregation_complete
+        after = client.call("ServeParameters",
+                            m.PullRequest(worker_id=0, iteration=1))
+        np.testing.assert_allclose(after.parameters[0].to_array(), w - 0.25,
+                                   rtol=1e-2, atol=1e-3)

@@ -132,3 +132,53 @@ def test_large_tensor_fast_path(rng):
 def test_empty_messages():
     assert m.ListWorkersRequest().encode() == b""
     assert isinstance(m.ListWorkersRequest.decode(b""), m.ListWorkersRequest)
+
+
+# ---------------------------------------------------------------------------
+# Packed-payload transport extension (Tensor fields 5/6, PullRequest field 3)
+# ---------------------------------------------------------------------------
+
+def test_raw_f32_packed_roundtrip_exact(rng):
+    arr = rng.standard_normal((64, 32)).astype(np.float32)
+    t = m.Tensor.from_array("x", arr, wire_dtype=m.WIRE_RAW_F32)
+    rt = m.Tensor.decode(t.encode())
+    np.testing.assert_array_equal(rt.to_array(), arr)
+    assert rt.packed_dtype == m.WIRE_RAW_F32
+    assert np.asarray(rt.data).size == 0  # payload rides in field 5 only
+
+
+def test_bf16_packed_halves_bytes_and_rounds_rne(rng):
+    import ml_dtypes
+
+    arr = rng.standard_normal((256, 64)).astype(np.float32)
+    f32 = m.Tensor.from_array("x", arr).encode()
+    bf16 = m.Tensor.from_array("x", arr, wire_dtype=m.WIRE_BF16).encode()
+    assert len(bf16) < len(f32) * 0.55  # ~half the payload
+    rt = m.Tensor.decode(bf16).to_array()
+    expected = arr.astype(ml_dtypes.bfloat16).astype(np.float32)
+    np.testing.assert_array_equal(rt, expected)
+    # bf16 keeps 8 exponent bits: values survive with ~3 decimal digits
+    np.testing.assert_allclose(rt, arr, rtol=8e-3)
+
+
+def test_reference_schema_skips_packed_fields(rng):
+    """A reference peer (fields 1-4 only) must skip fields 5/6 cleanly per
+    proto3 unknown-field rules."""
+
+    class ReferenceTensor(wire.Message):
+        FIELDS = m.Tensor.FIELDS[:4]
+
+    arr = rng.standard_normal((8,)).astype(np.float32)
+    encoded = m.Tensor.from_array("x", arr, wire_dtype=m.WIRE_BF16).encode()
+    ref = ReferenceTensor.decode(encoded)
+    assert ref.name == "x" and ref.shape == [8]
+    assert np.asarray(ref.data).size == 0  # payload invisible, no crash
+
+
+def test_pull_request_wire_dtype_default_elided():
+    # a default-encoding PullRequest stays byte-identical to the reference's
+    assert m.PullRequest(worker_id=1, iteration=2).encode() == \
+        m.PullRequest(worker_id=1, iteration=2, wire_dtype=m.WIRE_F32).encode()
+    rt = m.PullRequest.decode(
+        m.PullRequest(worker_id=1, iteration=2, wire_dtype=m.WIRE_BF16).encode())
+    assert rt.wire_dtype == m.WIRE_BF16

@@ -37,9 +37,15 @@ WIRE_BF16 = 2      # raw bfloat16 bytes in field 5 — half the payload
 WIRE_DTYPE_NAMES = {"f32": WIRE_F32, "raw": WIRE_RAW_F32, "bf16": WIRE_BF16}
 
 
+_BF16 = None
+
+
 def _bf16_dtype():
-    import ml_dtypes  # ships with jax
-    return ml_dtypes.bfloat16
+    global _BF16
+    if _BF16 is None:
+        import ml_dtypes  # ships with jax
+        _BF16 = ml_dtypes.bfloat16
+    return _BF16
 
 
 class Tensor(Message):

@@ -51,6 +51,10 @@ class WorkerError(RuntimeError):
 class Worker:
     def __init__(self, config: WorkerConfig, trainer,
                  batches: Iterator, start_heartbeat: bool = True):
+        if config.wire_dtype not in m.WIRE_DTYPE_NAMES:
+            raise ValueError(
+                f"unknown wire_dtype {config.wire_dtype!r}; "
+                f"options: {sorted(m.WIRE_DTYPE_NAMES)}")
         self.config = config
         self.trainer = trainer
         self.batches = batches
@@ -66,15 +70,14 @@ class Worker:
         self._ps: RpcClient | None = None
         self._ps_address: str | None = None
         self._total_workers = 0
-        if config.wire_dtype not in m.WIRE_DTYPE_NAMES:
-            raise ValueError(
-                f"unknown wire_dtype {config.wire_dtype!r}; "
-                f"options: {sorted(m.WIRE_DTYPE_NAMES)}")
-        self._wire_dtype = m.WIRE_DTYPE_NAMES[config.wire_dtype]
         # Packed pushes start only after the PS proves it honors the packed
         # extension (first non-empty pull served packed).  A reference PS
         # skips the extension fields entirely, so pushing packed at it would
-        # silently aggregate empty gradients.
+        # silently aggregate empty gradients.  Re-negotiated per PS
+        # connection (_discover_parameter_server): the replacement PS after
+        # a crash may not honor what the previous one did.
+        self._requested_wire_dtype = m.WIRE_DTYPE_NAMES[config.wire_dtype]
+        self._wire_dtype = self._requested_wire_dtype
         self._peer_packed_ok = self._wire_dtype == m.WIRE_F32
         self.last_bootstrap = False  # True iff the last iteration seeded the PS
         self._stop = threading.Event()
@@ -113,6 +116,9 @@ class Worker:
             self._ps.close()
         self._ps = RpcClient(self._ps_address, m.PARAMETER_SERVER_SERVICE,
                              m.PARAMETER_SERVER_METHODS)
+        # new PS connection: re-negotiate the packed encoding from scratch
+        self._wire_dtype = self._requested_wire_dtype
+        self._peer_packed_ok = self._wire_dtype == m.WIRE_F32
         log.info("worker %d: PS at %s", self.config.worker_id, self._ps_address)
 
     def _register(self) -> None:

@@ -1,0 +1,321 @@
+"""Fault injection: crash, outage, and flaky-network scenarios.
+
+The reference has no fault injection anywhere (SURVEY.md §5); its recovery
+machinery (heartbeat eviction — reference src/coordinator_service.cpp:102-107,
+retry backoff — src/worker.cpp:129-139, systemd Restart=always units —
+terraform/user_data.sh:35-80) is only ever exercised by real outages.  These
+tests inject the faults deliberately:
+
+1. worker crash mid-barrier -> eviction shrinks the elastic barrier and the
+   survivors' buffered iteration fires (no PS restart, unlike the reference's
+   scale scripts which drop in-memory state);
+2. transient RPC failures -> query_with_retry's exponential backoff recovers;
+3. PS process crash -> restart from checkpoint, workers reconnect and resume
+   (the systemd Restart=always story, in-process);
+4. coordinator outage -> data plane (train loop against the PS) keeps going,
+   heartbeats degrade gracefully.
+"""
+
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.cli.worker_main import build_worker
+from parameter_server_distributed_tpu.config import (CoordinatorConfig,
+                                                     ParameterServerConfig,
+                                                     WorkerConfig)
+from parameter_server_distributed_tpu.server.coordinator_service import Coordinator
+from parameter_server_distributed_tpu.server.ps_service import ParameterServer
+
+
+def make_ps(tmp_path, coordinator=None, port=0):
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["live_workers_fn"] = coordinator.core.live_worker_count
+    return ParameterServer(
+        ParameterServerConfig(
+            bind_address="127.0.0.1", port=port, total_workers=2,
+            checkpoint_interval=2, checkpoint_dir=str(tmp_path),
+            learning_rate=0.05, autosave_period_s=600.0,
+            elastic=coordinator is not None, live_workers_ttl_s=0.0),
+        **kwargs)
+
+
+def make_worker(coord_port, wid, **overrides):
+    config = WorkerConfig(
+        coordinator_address=f"127.0.0.1:{coord_port}", worker_id=wid,
+        address="127.0.0.1", port=50090 + wid, batch_size=16,
+        model="mnist_mlp", heartbeat_period_s=600.0, **overrides)
+    w = build_worker(config)
+    w.initialize()
+    return w
+
+
+def test_worker_crash_mid_barrier_releases_survivor(tmp_path):
+    """Worker 1 dies after worker 0 already pushed: the coordinator evicts
+    it, the barrier shrinks 2 -> 1, and worker 0's sync poll fires the
+    buffered aggregation instead of stranding it for the full timeout."""
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1", ps_port=1,
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    ps = make_ps(tmp_path, coordinator)
+    ps_port = ps.start()
+    coordinator.core.set_parameter_server_address("127.0.0.1", ps_port)
+    w0 = w1 = None
+    try:
+        w0 = make_worker(coord_port, 0)
+        w1 = make_worker(coord_port, 1)
+        # both complete a lockstep iteration so the PS holds params
+        t0 = threading.Thread(target=w0.run_iteration, args=(0,))
+        t1 = threading.Thread(target=w1.run_iteration, args=(0,))
+        t0.start(); t1.start(); t0.join(60); t1.join(60)
+        assert ps.core.get_parameters()
+
+        # worker 0 pushes for iteration 1; barrier (width 2) incomplete
+        _, params = w0.pull_parameters(1)
+        batch = next(w0.batches)
+        grads, _ = w0.trainer.compute_gradients(params, batch)
+        push = w0.push_gradients(1, grads)
+        assert not push.aggregation_complete and push.workers_received == 1
+
+        # CRASH: worker 1 dies without pushing; reaper evicts it
+        w1.shutdown()
+        w1 = None
+        evicted = coordinator.core.remove_stale_workers(timeout_s=-1)
+        assert 1 in evicted
+        coordinator.core.register_worker(0, "127.0.0.1", 50090, "h0")
+
+        # survivor's normal barrier poll must release iteration 1
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            resp = w0.check_sync_ready(1)
+            if resp.ready:
+                break
+            time.sleep(0.05)
+        assert resp.ready, "buffered iteration never fired after eviction"
+        assert resp.total_workers == 1
+        assert ps.core.current_iteration == 1
+
+        # and the survivor keeps training alone
+        loss = w0.run_iteration(2)
+        assert np.isfinite(loss)
+    finally:
+        for w in (w0, w1):
+            if w is not None:
+                w.shutdown()
+        coordinator.stop()
+        ps.stop()
+
+
+def test_transient_rpc_failures_recovered_by_retry(tmp_path):
+    """First two attempts of every data-plane call fail; the reference-shape
+    retry loop (5 attempts, exponential backoff — src/worker.cpp:129-139)
+    must absorb them with no training-visible effect."""
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1", ps_port=1,
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    ps = make_ps(tmp_path, coordinator)
+    ps_port = ps.start()
+    coordinator.core.set_parameter_server_address("127.0.0.1", ps_port)
+    w = None
+    try:
+        w = make_worker(coord_port, 0, retry_base_delay_s=0.01)
+        w.run_iteration(0)  # bootstrap cleanly
+
+        real_call = w._ps.call
+        fail_counts: dict[str, int] = {}
+
+        def flaky_call(method, request, timeout=None):
+            n = fail_counts.get(method, 0)
+            if n < 2:
+                fail_counts[method] = n + 1
+                raise grpc.RpcError(f"injected fault #{n + 1} on {method}")
+            return real_call(method, request, timeout=timeout)
+
+        w._ps.call = flaky_call
+        loss = w.run_iteration(1)
+        assert np.isfinite(loss)
+        assert ps.core.current_iteration == 1
+        # the injection actually hit the pull and push paths
+        assert fail_counts["ServeParameters"] == 2
+        assert fail_counts["ReceiveGradients"] == 2
+    finally:
+        if w is not None:
+            w.shutdown()
+        coordinator.stop()
+        ps.stop()
+
+
+def test_rpc_outage_exhausts_retries_with_clear_error(tmp_path):
+    """A hard outage (every attempt fails) surfaces as WorkerError after the
+    configured attempts, not a hang or a silent skip."""
+    from parameter_server_distributed_tpu.worker.worker import WorkerError
+
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1", ps_port=1,
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    ps = make_ps(tmp_path, coordinator)
+    ps_port = ps.start()
+    coordinator.core.set_parameter_server_address("127.0.0.1", ps_port)
+    w = None
+    try:
+        w = make_worker(coord_port, 0, retry_base_delay_s=0.01,
+                        retry_max_attempts=3)
+        attempts = []
+
+        def dead_call(method, request, timeout=None):
+            attempts.append(method)
+            raise grpc.RpcError("injected outage")
+
+        w._ps.call = dead_call
+        with pytest.raises(WorkerError, match="after 3 attempts"):
+            w.run_iteration(0)
+        assert len(attempts) == 3
+    finally:
+        if w is not None:
+            w.shutdown()
+        coordinator.stop()
+        ps.stop()
+
+
+def test_ps_crash_restart_restores_from_checkpoint(tmp_path):
+    """PS process dies and is replaced (the reference's systemd
+    Restart=always story): the new process restores the checkpoint, the
+    coordinator hands out the new address, workers reconnect and training
+    resumes from the saved state instead of from scratch."""
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1", ps_port=1,
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    ps = make_ps(tmp_path, coordinator)
+    ps_port = ps.start()
+    coordinator.core.set_parameter_server_address("127.0.0.1", ps_port)
+    w = ps2 = None
+    try:
+        w = make_worker(coord_port, 0)
+        for it in range(3):
+            w.run_iteration(it)
+        saved_path = ps.ckpt.save()
+        saved_iteration = ps.core.current_iteration
+        saved_params = ps.core.get_parameters()
+        assert saved_iteration == 2
+
+        # CRASH the PS
+        ps.stop()
+
+        # replacement process: restore checkpoint, re-publish address
+        ps2 = make_ps(tmp_path, coordinator)
+        ps2_port = ps2.start()
+        epoch, iteration = ps2.ckpt.load(saved_path)
+        assert iteration == saved_iteration
+        coordinator.core.set_parameter_server_address("127.0.0.1", ps2_port)
+
+        restored = ps2.core.get_parameters()
+        for name, arr in saved_params.items():
+            np.testing.assert_array_equal(restored[name], arr)
+
+        # worker notices the outage, reconnects via the coordinator, resumes
+        w.reconnect()
+        loss = w.run_iteration(saved_iteration + 1)
+        assert np.isfinite(loss)
+        assert ps2.core.current_iteration == saved_iteration + 1
+    finally:
+        if w is not None:
+            w.shutdown()
+        coordinator.stop()
+        if ps2 is not None:
+            ps2.stop()
+
+
+def test_coordinator_outage_does_not_block_training(tmp_path):
+    """The coordinator is discovery/membership only: once a worker holds the
+    PS address, a coordinator outage degrades heartbeats (None = unreachable)
+    but the pull/push/barrier data plane keeps working."""
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1", ps_port=1,
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    # static (non-elastic) barrier of 1: no live-registry dependency
+    ps = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=1,
+        checkpoint_interval=100, checkpoint_dir=str(tmp_path),
+        learning_rate=0.05, autosave_period_s=600.0))
+    ps_port = ps.start()
+    coordinator.core.set_parameter_server_address("127.0.0.1", ps_port)
+    w = None
+    try:
+        w = make_worker(coord_port, 0)
+        w.run_iteration(0)
+
+        coordinator.stop()  # OUTAGE
+
+        assert w.send_heartbeat() is None  # degraded, not crashed
+        for it in (1, 2):
+            loss = w.run_iteration(it)
+        assert np.isfinite(loss)
+        assert ps.core.current_iteration == 2
+    finally:
+        if w is not None:
+            w.shutdown()
+        ps.stop()
+
+
+def test_packed_wire_renegotiated_after_ps_replacement(tmp_path):
+    """A bf16 worker that negotiated packed pushes against a framework PS
+    must re-negotiate when the PS is replaced: if the replacement ignores
+    the packed extension (reference behavior), pushes drop back to f32
+    instead of silently shipping payloads the new PS cannot see."""
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1", ps_port=1,
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    ps = make_ps(tmp_path, coordinator)
+    ps_port = ps.start()
+    coordinator.core.set_parameter_server_address("127.0.0.1", ps_port)
+    w = ps2 = None
+    try:
+        w = make_worker(coord_port, 0, wire_dtype="bf16")
+        for it in range(2):
+            w.run_iteration(it)
+        assert w._peer_packed_ok and w._wire_dtype != 0  # negotiated packed
+        saved_path = ps.ckpt.save()
+        ps.stop()
+
+        # replacement PS that ignores the packed extension (reference-like)
+        ps2 = make_ps(tmp_path, coordinator)
+        seen_encodings = []
+        orig_serve = type(ps2.service).ServeParameters
+        orig_recv = type(ps2.service).ReceiveGradients
+
+        def serve_f32_only(request, context):
+            request.wire_dtype = 0
+            return orig_serve(ps2.service, request, context)
+
+        def recording_recv(request, context):
+            seen_encodings.extend(t.packed_dtype for t in request.gradients)
+            return orig_recv(ps2.service, request, context)
+
+        ps2.service.ServeParameters = serve_f32_only
+        ps2.service.ReceiveGradients = recording_recv
+        ps2_port = ps2.start()
+        ps2.ckpt.load(saved_path)
+        coordinator.core.set_parameter_server_address("127.0.0.1", ps2_port)
+
+        w.reconnect()
+        loss = w.run_iteration(ps2.core.current_iteration + 1)
+        assert np.isfinite(loss)
+        # every push at the replacement PS was plain f32
+        assert seen_encodings and all(e == 0 for e in seen_encodings)
+        assert w._wire_dtype == 0  # downgraded for this connection
+    finally:
+        if w is not None:
+            w.shutdown()
+        coordinator.stop()
+        if ps2 is not None:
+            ps2.stop()

@@ -69,6 +69,35 @@ def rope(x: Array, positions: Array, theta: float = 10000.0) -> Array:
     return out.astype(x.dtype)
 
 
+def flash_attention_auto(q: Array, k: Array, v: Array) -> Array:
+    """Causal attention that uses the pallas flash kernels
+    (ops/pallas/flash_attention.py — blockwise fwd+bwd, O(S) residual
+    memory) when the sequence is block-divisible, falling back to the dense
+    einsum otherwise.  On non-TPU backends the kernels run in interpret
+    mode, so this is only worth selecting on TPU; pass it explicitly as
+    ``Transformer(config, attention_fn=flash_attention_auto)`` or set
+    ``PSDT_FLASH_ATTENTION=1`` to make it the model default."""
+    from ..ops.pallas.flash_attention import flash_attention
+
+    seq = q.shape[1]
+    if seq % 128 == 0:
+        return flash_attention(q, k, v, block_q=128, block_k=128)
+    return causal_attention(q, k, v)
+
+
+def _default_attention() -> Callable:
+    """PSDT_FLASH_ATTENTION=1 opts the model default into the pallas flash
+    path — on TPU only: on other backends the kernels run in interpret mode
+    (orders of magnitude slower than the einsum), which is for tests to opt
+    into explicitly, never a shared launch env flag."""
+    import os
+
+    if (os.environ.get("PSDT_FLASH_ATTENTION", "") not in ("", "0")
+            and jax.default_backend() == "tpu"):
+        return flash_attention_auto
+    return causal_attention
+
+
 def causal_attention(q: Array, k: Array, v: Array) -> Array:
     """Reference einsum attention.  q,k,v: [B, S, H, D] -> [B, S, H, D].
     float32 logits/softmax for stability."""
@@ -91,7 +120,11 @@ class Transformer:
         if config.d_model % config.n_heads:
             raise ValueError("d_model must divide by n_heads")
         self.config = config
-        self.attention_fn = attention_fn or causal_attention
+        # The flash kernels are single-device (per-shard) compute; with a
+        # mesh, attention stays on the GSPMD einsum path (or the ring/Ulysses
+        # fn the caller passes) so XLA can partition it.
+        self.attention_fn = attention_fn or (
+            _default_attention() if mesh is None else causal_attention)
         self.mesh = mesh  # when set, activations get sharding constraints
 
     # ------------------------------------------------------------- shapes

@@ -1,16 +1,27 @@
-"""Pallas TPU flash attention (causal, forward) with custom VJP.
+"""Pallas TPU flash attention (causal) — blockwise forward AND backward.
 
 Blockwise attention computed entirely in VMEM with online softmax — the
 single-device analogue of ring attention (ops/ring_attention.py): same
 accumulation math, but blocks stream from HBM instead of rotating over ICI.
-Grid: (batch*heads, q-blocks); inner fori_loop walks K/V blocks up to the
-causal frontier, so the wasted upper-triangle work of the dense einsum path
-is skipped entirely.
 
-Backward currently recomputes dense attention under the standard JAX VJP
-(O(S^2) memory in the backward only); a blockwise backward kernel is the
-known next step.  On non-TPU backends the kernel runs in interpret mode, so
-tests exercise identical code paths on CPU.
+All kernels stream K/V (or Q/dO) through the innermost grid dimension, so
+VMEM residency per step is O(block^2) regardless of sequence length — no
+full-sequence tensor is ever resident.  Running state (online-softmax
+m/l/acc, grad accumulators) lives in revisited output blocks whose index
+map is constant over the streaming dimension; TPU grids execute
+sequentially, so the block stays in VMEM across the inner loop and is
+written back once (the standard pallas accumulation pattern).  Blocks
+entirely outside the causal triangle are skipped with `pl.when`.
+
+Backward is the standard two-kernel flash decomposition: the forward saves
+only O and the per-row logsumexp (O(S) residuals, not the O(S^2) attention
+matrix), probabilities are recomputed blockwise from them:
+
+- dQ kernel: grid (BH, q-blocks, k-blocks), K/V streaming innermost;
+- dK/dV kernel: grid (BH, k-blocks, q-blocks), Q/dO streaming innermost.
+
+On non-TPU backends the kernels run in interpret mode, so tests exercise
+identical code paths on CPU.
 """
 
 from __future__ import annotations
@@ -25,89 +36,184 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
-                      block_k: int, scale: float):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [block_q, D]
-    d = q.shape[-1]
-    q_start = qi * block_q
-    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+def _iota_pos(start, rows: int, cols: int, axis: int):
+    return start + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), axis)
 
-    # walk K/V blocks only up to the causal frontier
-    num_kb = (q_start + block_q + block_k - 1) // block_k
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_k), 1)
-        mask = q_pos >= k_pos                          # [block_q, block_k]
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                      l_ref, *, block_q: int, block_k: int, scale: float):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    q_start, k_start = qi * block_q, kj * block_k
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(k_start < q_start + block_q)  # block touches causal triangle
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale       # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)               # [block_k, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        mask = (_iota_pos(q_start, block_q, 1, 0)
+                >= _iota_pos(k_start, 1, block_k, 1))
         s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_prev = m_ref[0][:, None]                     # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(p, v_blk,
-                                        preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[0] = m_new[:, 0]
+        l_ref[0] = l_ref[0] * alpha[:, 0] + jnp.sum(p, axis=-1)
+        acc_ref[0] = acc_ref[0] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(kj == pl.num_programs(2) - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[0][:, None], 1e-30)
+        o_ref[0] = (acc_ref[0] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[0] + jnp.log(l[:, 0])
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, block_q: int,
-               block_k: int, interpret: bool) -> jax.Array:
-    """q,k,v: [BH, S, D] -> [BH, S, D]."""
+               block_k: int, interpret: bool) -> tuple[jax.Array, jax.Array]:
+    """q,k,v: [BH, S, D] -> (o [BH, S, D], lse [BH, S])."""
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    grid = (bh, s // block_q)
     kernel = functools.partial(_flash_fwd_kernel, block_q=block_q,
                                block_k=block_k, scale=scale)
-    return pl.pallas_call(
+    qblk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    qrow = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    kblk = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    o, lse, _, _, _ = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype),      # o
+                   jax.ShapeDtypeStruct((bh, s), jnp.float32),     # lse
+                   jax.ShapeDtypeStruct((bh, s, d), jnp.float32),  # acc state
+                   jax.ShapeDtypeStruct((bh, s), jnp.float32),     # m state
+                   jax.ShapeDtypeStruct((bh, s), jnp.float32)],    # l state
+        grid=(bh, s // block_q, s // block_k),
+        in_specs=[qblk, kblk, kblk],
+        out_specs=[qblk, qrow, qblk, qrow, qrow],
         interpret=interpret,
     )(q, k, v)
+    return o, lse
 
 
-def _dense_reference(q, k, v):
-    """Dense causal attention used by the VJP backward (recompute)."""
-    d = q.shape[-1]
-    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) / math.sqrt(d)
-    s_q, s_k = q.shape[1], k.shape[1]
-    mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_))
-    s = jnp.where(mask, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bqk,bkd->bqd", p,
-                      v.astype(jnp.float32)).astype(v.dtype)
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_q: int, block_k: int, scale: float):
+    """dQ for one q block, K/V streaming over the inner grid dimension.
+    ds = p * (dp - delta); dq = scale * ds @ K."""
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    q_start, k_start = qi * block_q, kj * block_k
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    @pl.when(k_start < q_start + block_q)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        mask = (_iota_pos(q_start, block_q, 1, 0)
+                >= _iota_pos(k_start, 1, block_k, 1))
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_ref[0] += jnp.dot(ds, k,
+                             preferred_element_type=jnp.float32) * scale
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, block_k: int,
+                          scale: float):
+    """dK/dV for one k block, Q/dO streaming over the inner grid dimension.
+    dv = p^T @ dO; dk = scale * ds^T @ Q."""
+    ki, qj = pl.program_id(1), pl.program_id(2)
+    k_start, q_start = ki * block_k, qj * block_q
+
+    @pl.when(qj == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    @pl.when(q_start + block_q > k_start)  # q block reaches this k block
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        mask = (_iota_pos(q_start, block_q, 1, 0)
+                >= _iota_pos(k_start, 1, block_k, 1))
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)   # [block_q, block_k]
+        dv_ref[0] += jnp.dot(p.T, g, preferred_element_type=jnp.float32)
+        dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_ref[0] += jnp.dot(ds.T, q,
+                             preferred_element_type=jnp.float32) * scale
+
+
+def _flash_bwd(q, k, v, o, lse, g, block_q: int, block_k: int,
+               interpret: bool):
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    # delta_i = sum_d g_id * o_id — the softmax-jacobian row correction
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    qblk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    qrow = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    kblk = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        grid=(bh, s // block_q, s // block_k),
+        in_specs=[qblk, kblk, kblk, qblk, qrow, qrow],
+        out_specs=qblk,
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # streaming roles swap: k blocks are the outer (revisited) dimension
+    kout = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    qstream = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    qstream_row = pl.BlockSpec((1, block_q), lambda b, i, j: (b, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, scale=scale),
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, s, d), jnp.float32)],
+        grid=(bh, s // block_k, s // block_q),
+        in_specs=[qstream, kout, kout, qstream, qstream_row, qstream_row],
+        out_specs=[kout, kout],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, block_q, block_k, interpret)
+    o, _ = _flash_fwd(q, k, v, block_q, block_k, interpret)
+    return o
 
 
 def _flash_vjp_fwd(q, k, v, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, block_q, block_k, interpret), (q, k, v)
+    o, lse = _flash_fwd(q, k, v, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(_dense_reference, q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = residuals
+    return _flash_bwd(q, k, v, o, lse, g, block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

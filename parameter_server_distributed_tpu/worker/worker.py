@@ -70,15 +70,8 @@ class Worker:
         self._ps: RpcClient | None = None
         self._ps_address: str | None = None
         self._total_workers = 0
-        # Packed pushes start only after the PS proves it honors the packed
-        # extension (first non-empty pull served packed).  A reference PS
-        # skips the extension fields entirely, so pushing packed at it would
-        # silently aggregate empty gradients.  Re-negotiated per PS
-        # connection (_discover_parameter_server): the replacement PS after
-        # a crash may not honor what the previous one did.
         self._requested_wire_dtype = m.WIRE_DTYPE_NAMES[config.wire_dtype]
-        self._wire_dtype = self._requested_wire_dtype
-        self._peer_packed_ok = self._wire_dtype == m.WIRE_F32
+        self._reset_wire_negotiation()
         self.last_bootstrap = False  # True iff the last iteration seeded the PS
         self._stop = threading.Event()
         self._heartbeat_thread: threading.Thread | None = None
@@ -116,10 +109,17 @@ class Worker:
             self._ps.close()
         self._ps = RpcClient(self._ps_address, m.PARAMETER_SERVER_SERVICE,
                              m.PARAMETER_SERVER_METHODS)
-        # new PS connection: re-negotiate the packed encoding from scratch
+        self._reset_wire_negotiation()  # a new PS must re-prove packed support
+        log.info("worker %d: PS at %s", self.config.worker_id, self._ps_address)
+
+    def _reset_wire_negotiation(self) -> None:
+        """Packed pushes start only after the connected PS proves it honors
+        the packed extension (first non-empty pull served packed).  A
+        reference PS skips the extension fields entirely, so pushing packed
+        at it would silently aggregate empty gradients; the replacement PS
+        after a crash may not honor what the previous one did."""
         self._wire_dtype = self._requested_wire_dtype
         self._peer_packed_ok = self._wire_dtype == m.WIRE_F32
-        log.info("worker %d: PS at %s", self.config.worker_id, self._ps_address)
 
     def _register(self) -> None:
         info = m.WorkerInfo(worker_id=self.config.worker_id,

@@ -2,6 +2,7 @@
 
     python -m parameter_server_distributed_tpu.cli.train_main \
         --model=mnist_mlp --steps=100 --batch=64 --optimizer=adam --lr=1e-3 \
+        --schedule=cosine --warmup=10 --clip-norm=1.0 --accum=2 \
         --mesh=data:2,fsdp:2,tensor:2 --ckpt-dir=/tmp/ckpt --ckpt-every=50 \
         --resume --metrics=/tmp/metrics.jsonl
 
@@ -60,6 +61,10 @@ def main(argv: list[str] | None = None) -> int:
         steps=int(flags.get("steps", 100)),
         optimizer=flags.get("optimizer", "adam"),
         learning_rate=float(flags.get("lr", 1e-3)),
+        schedule=flags.get("schedule", "constant"),
+        warmup_steps=int(flags.get("warmup", 0)),
+        clip_norm=float(flags.get("clip-norm", 0.0)),
+        accum_steps=int(flags.get("accum", 1)),
         mesh=parse_mesh(flags.get("mesh", "")),
         checkpoint_dir=flags.get("ckpt-dir", ""),
         checkpoint_every=int(flags.get("ckpt-every", 0)),

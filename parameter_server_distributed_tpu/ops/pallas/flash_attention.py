@@ -7,11 +7,14 @@ accumulation math, but blocks stream from HBM instead of rotating over ICI.
 All kernels stream K/V (or Q/dO) through the innermost grid dimension, so
 VMEM residency per step is O(block^2) regardless of sequence length — no
 full-sequence tensor is ever resident.  Running state (online-softmax
-m/l/acc, grad accumulators) lives in revisited output blocks whose index
-map is constant over the streaming dimension; TPU grids execute
-sequentially, so the block stays in VMEM across the inner loop and is
-written back once (the standard pallas accumulation pattern).  Blocks
-entirely outside the causal triangle are skipped with `pl.when`.
+m/l/acc) lives in VMEM scratch that persists across the sequential TPU
+grid; grad accumulators live in revisited output blocks whose index map is
+constant over the streaming dimension (the standard pallas accumulation
+pattern).  Blocks entirely outside the causal triangle are skipped twice
+over: `pl.when` skips the compute, and the streaming index_map CLAMPS the
+block index to the causal frontier so consecutive out-of-range steps
+revisit the same resident block and trigger no HBM DMA — block fetch count
+matches the old per-kernel fori_loop frontier exactly.
 
 Backward is the standard two-kernel flash decomposition: the forward saves
 only O and the per-row logsumexp (O(S) residuals, not the O(S^2) attention
@@ -32,12 +35,38 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
 
 def _iota_pos(start, rows: int, cols: int, axis: int):
     return start + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), axis)
+
+
+def _kv_frontier_spec(block: int, block_q: int, block_k: int, d: int | None):
+    """BlockSpec for a K/V operand streamed over inner grid dim j, with the
+    block index clamped to the causal frontier of q block i: steps past the
+    frontier revisit the resident block (no DMA) and `pl.when` skips their
+    compute."""
+    def clamp(i, j):
+        return jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
+
+    if d is None:
+        return pl.BlockSpec((1, block), lambda b, i, j: (b, clamp(i, j)))
+    return pl.BlockSpec((1, block, d), lambda b, i, j: (b, clamp(i, j), 0))
+
+
+def _q_frontier_spec(block: int, block_q: int, block_k: int, d: int | None):
+    """BlockSpec for a Q/dO operand streamed over inner grid dim j in the
+    dK/dV kernel: indices before this k block's first attending q block are
+    clamped up to it."""
+    def clamp(i, j):
+        return jnp.maximum(j, (i * block_k) // block_q)
+
+    if d is None:
+        return pl.BlockSpec((1, block), lambda b, i, j: (b, clamp(i, j)))
+    return pl.BlockSpec((1, block, d), lambda b, i, j: (b, clamp(i, j), 0))
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
@@ -60,20 +89,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         mask = (_iota_pos(q_start, block_q, 1, 0)
                 >= _iota_pos(k_start, 1, block_k, 1))
         s = jnp.where(mask, s, NEG_INF)
-        m_prev = m_ref[0][:, None]                     # [block_q, 1]
+        m_prev = m_ref[...]                            # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
-        m_ref[0] = m_new[:, 0]
-        l_ref[0] = l_ref[0] * alpha[:, 0] + jnp.sum(p, axis=-1)
-        acc_ref[0] = acc_ref[0] * alpha + jnp.dot(
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
             p, v, preferred_element_type=jnp.float32)
 
     @pl.when(kj == pl.num_programs(2) - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[0][:, None], 1e-30)
-        o_ref[0] = (acc_ref[0] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[0] + jnp.log(l[:, 0])
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, block_q: int,
@@ -85,17 +114,17 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, block_q: int,
                                block_k=block_k, scale=scale)
     qblk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     qrow = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
-    kblk = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    o, lse, _, _, _ = pl.pallas_call(
+    kblk = _kv_frontier_spec(block_k, block_q, block_k, d)
+    o, lse = pl.pallas_call(
         kernel,
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype),      # o
-                   jax.ShapeDtypeStruct((bh, s), jnp.float32),     # lse
-                   jax.ShapeDtypeStruct((bh, s, d), jnp.float32),  # acc state
-                   jax.ShapeDtypeStruct((bh, s), jnp.float32),     # m state
-                   jax.ShapeDtypeStruct((bh, s), jnp.float32)],    # l state
+                   jax.ShapeDtypeStruct((bh, s), jnp.float32)],    # lse
         grid=(bh, s // block_q, s // block_k),
         in_specs=[qblk, kblk, kblk],
-        out_specs=[qblk, qrow, qblk, qrow, qrow],
+        out_specs=[qblk, qrow],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),   # acc
+                        pltpu.VMEM((block_q, 1), jnp.float32),   # m
+                        pltpu.VMEM((block_q, 1), jnp.float32)],  # l
         interpret=interpret,
     )(q, k, v)
     return o, lse
@@ -171,7 +200,7 @@ def _flash_bwd(q, k, v, o, lse, g, block_q: int, block_k: int,
 
     qblk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     qrow = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
-    kblk = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    kblk = _kv_frontier_spec(block_k, block_q, block_k, d)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
@@ -185,8 +214,8 @@ def _flash_bwd(q, k, v, o, lse, g, block_q: int, block_k: int,
 
     # streaming roles swap: k blocks are the outer (revisited) dimension
     kout = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
-    qstream = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
-    qstream_row = pl.BlockSpec((1, block_q), lambda b, i, j: (b, j))
+    qstream = _q_frontier_spec(block_q, block_q, block_k, d)
+    qstream_row = _q_frontier_spec(block_q, block_q, block_k, None)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, scale=scale),

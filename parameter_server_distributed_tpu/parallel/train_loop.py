@@ -39,6 +39,10 @@ class TrainLoopConfig:
     steps: int = 100
     optimizer: str = "adam"
     learning_rate: float = 1e-3
+    schedule: str = "constant"    # constant | cosine | linear (+ warmup)
+    warmup_steps: int = 0
+    clip_norm: float = 0.0        # 0 = no gradient clipping
+    accum_steps: int = 1          # microbatch gradient accumulation
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     checkpoint_dir: str = ""
     checkpoint_every: int = 0     # steps; 0 = disabled
@@ -65,7 +69,12 @@ def run_training(config: TrainLoopConfig) -> dict:
                                            seed=config.seed)
     trainer = ShardedTrainer(
         model.loss, mesh, _pick_rule(config.model, mesh),
-        make_optimizer(config.optimizer, config.learning_rate))
+        make_optimizer(config.optimizer, config.learning_rate,
+                       schedule=config.schedule,
+                       warmup_steps=config.warmup_steps,
+                       total_steps=config.steps,
+                       clip_norm=config.clip_norm),
+        accum_steps=config.accum_steps)
     state = trainer.init_state(model.init_params(config.seed))
 
     start_step = 0

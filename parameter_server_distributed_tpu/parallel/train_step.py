@@ -47,28 +47,102 @@ class TrainState:
                    step=jnp.zeros((), jnp.int32))
 
 
+def make_lr_schedule(learning_rate: float, schedule: str = "constant",
+                     warmup_steps: int = 0, total_steps: int = 0):
+    """LR schedule: "constant", "cosine", or "linear" decay, with optional
+    linear warmup from zero.  Returns a float (constant, no warmup) or an
+    optax schedule fn."""
+    schedule = schedule.lower()
+    if schedule == "constant":
+        if warmup_steps <= 0:
+            return learning_rate
+        return optax.linear_schedule(0.0, learning_rate, warmup_steps)
+    if total_steps <= warmup_steps:
+        raise ValueError(f"{schedule} decay needs total_steps > warmup_steps "
+                         f"({total_steps} vs {warmup_steps})")
+    decay_steps = total_steps - warmup_steps
+    if schedule == "cosine":
+        decay = optax.cosine_decay_schedule(learning_rate, decay_steps)
+    elif schedule == "linear":
+        decay = optax.linear_schedule(learning_rate, 0.0, decay_steps)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if warmup_steps <= 0:
+        return decay
+    warmup = optax.linear_schedule(0.0, learning_rate, warmup_steps)
+    return optax.join_schedules([warmup, decay], [warmup_steps])
+
+
 def make_optimizer(name: str = "sgd", learning_rate: float = 1.0,
-                   momentum: float = 0.9) -> optax.GradientTransformation:
+                   momentum: float = 0.9, *,
+                   schedule: str = "constant", warmup_steps: int = 0,
+                   total_steps: int = 0, clip_norm: float = 0.0,
+                   weight_decay: float = 1e-4) -> optax.GradientTransformation:
     """Device-side optimizer matching the host-side ones in core/optimizer.py
-    (the reference applies bare SGD at lr=1.0 — src/parameter_server.cpp:87)."""
+    (the reference applies bare SGD at lr=1.0 — src/parameter_server.cpp:87).
+    Extensions beyond the reference: LR schedules (warmup + cosine/linear
+    decay) and global-norm gradient clipping, composed the optax way."""
     name = name.lower()
+    lr = make_lr_schedule(learning_rate, schedule, warmup_steps, total_steps)
     if name == "sgd":
-        return optax.sgd(learning_rate)
-    if name == "momentum":
-        return optax.sgd(learning_rate, momentum=momentum)
-    if name == "adam":
-        return optax.adam(learning_rate)
-    if name == "adamw":
-        return optax.adamw(learning_rate)
-    raise ValueError(f"unknown optimizer {name!r}")
+        opt = optax.sgd(lr)
+    elif name == "momentum":
+        opt = optax.sgd(lr, momentum=momentum)
+    elif name == "adam":
+        opt = optax.adam(lr)
+    elif name == "adamw":
+        opt = optax.adamw(lr, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    if clip_norm and clip_norm > 0:
+        return optax.chain(optax.clip_by_global_norm(clip_norm), opt)
+    return opt
 
 
 def make_train_step(loss_fn: Callable,
-                    optimizer: optax.GradientTransformation) -> Callable:
-    """Build a pure (state, batch) -> (state, metrics) step function."""
+                    optimizer: optax.GradientTransformation,
+                    accum_steps: int = 1) -> Callable:
+    """Build a pure (state, batch) -> (state, metrics) step function.
+
+    ``accum_steps > 1`` splits the batch's leading axis into that many
+    microbatches and accumulates gradients in float32 under `lax.scan` —
+    one optimizer update per step, activation memory of one microbatch.
+    """
+
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def grads_of(params, batch):
+        return jax.value_and_grad(loss_fn)(params, batch)
+
+    def _microbatch(x):
+        if x.shape[0] % accum_steps:
+            raise ValueError(
+                f"batch leading dim {x.shape[0]} does not divide by "
+                f"accum_steps={accum_steps}")
+        return x.reshape(accum_steps, x.shape[0] // accum_steps, *x.shape[1:])
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        if accum_steps == 1:
+            loss, grads = grads_of(state.params, batch)
+        else:
+            micro = jax.tree.map(_microbatch, batch)
+
+            def body(carry, mb):
+                loss_sum, acc = carry
+                l, g = grads_of(state.params, mb)
+                acc = jax.tree.map(
+                    lambda a, gi: a + gi.astype(jnp.float32), acc, g)
+                return (loss_sum + l.astype(jnp.float32), acc), None
+
+            zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                 state.params)
+            (loss_sum, gsum), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zeros), micro)
+            loss = loss_sum / accum_steps
+            grads = jax.tree.map(
+                lambda g, p: (g / accum_steps).astype(p.dtype), gsum,
+                state.params)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(params=new_params, opt_state=new_opt,
@@ -111,11 +185,13 @@ class ShardedTrainer:
     """
 
     def __init__(self, loss_fn: Callable, mesh: Mesh, rule: ShardingRule,
-                 optimizer: optax.GradientTransformation | None = None):
+                 optimizer: optax.GradientTransformation | None = None,
+                 accum_steps: int = 1):
         self.mesh = mesh
         self.rule = rule
         self.optimizer = optimizer or make_optimizer("sgd", 1.0)
-        self._raw_step = make_train_step(loss_fn, self.optimizer)
+        self._raw_step = make_train_step(loss_fn, self.optimizer,
+                                         accum_steps=accum_steps)
         self._compiled: Callable | None = None
         self._shardings: TrainState | None = None
 

@@ -133,3 +133,102 @@ def test_sharded_mlp_training_loss_decreases():
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0] * 0.7, losses
     assert int(state.step) == 10
+
+
+# ---------------------------------------------------------------------------
+# Trainer extensions: LR schedules, gradient clipping, accumulation
+# ---------------------------------------------------------------------------
+
+def test_lr_schedule_shapes():
+    from parameter_server_distributed_tpu.parallel.train_step import (
+        make_lr_schedule)
+
+    assert make_lr_schedule(0.1) == 0.1
+    warm = make_lr_schedule(0.1, warmup_steps=10)
+    assert float(warm(0)) == 0.0
+    assert float(warm(5)) == pytest.approx(0.05)
+    assert float(warm(10)) == pytest.approx(0.1)
+    assert float(warm(100)) == pytest.approx(0.1)
+
+    cos = make_lr_schedule(0.1, "cosine", warmup_steps=10, total_steps=110)
+    assert float(cos(0)) == 0.0
+    assert float(cos(10)) == pytest.approx(0.1)
+    assert float(cos(60)) < 0.1  # decaying
+    assert float(cos(110)) == pytest.approx(0.0, abs=1e-6)
+
+    lin = make_lr_schedule(0.2, "linear", warmup_steps=0, total_steps=10)
+    assert float(lin(5)) == pytest.approx(0.1)
+    with pytest.raises(ValueError, match="total_steps"):
+        make_lr_schedule(0.1, "cosine", warmup_steps=5, total_steps=5)
+    with pytest.raises(ValueError, match="unknown schedule"):
+        make_lr_schedule(0.1, "exponential", total_steps=10)
+
+
+def test_gradient_clipping_bounds_update():
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((8, 4)).astype(np.float32)
+    x = rng.standard_normal((16, 8)).astype(np.float32) * 100.0  # huge grads
+    y = rng.standard_normal((16, 4)).astype(np.float32)
+
+    opt = make_optimizer("sgd", 1.0, clip_norm=0.5)
+    step = make_train_step(_loss_quadratic, opt)
+    state = TrainState.create({"w": jnp.asarray(w)}, opt)
+    new_state, metrics = jax.jit(step)(state, (jnp.asarray(x), jnp.asarray(y)))
+    assert float(metrics["grad_norm"]) > 0.5  # raw grads exceed the clip
+    update_norm = float(jnp.linalg.norm(new_state.params["w"] - w))
+    assert update_norm <= 0.5 * 1.01  # lr=1: update norm == clipped norm
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=4 over a batch of 32 must equal one full-batch step
+    (mean-based loss => mean of microbatch grads == full-batch grad)."""
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    x = rng.standard_normal((32, 16)).astype(np.float32)
+    y = rng.standard_normal((32, 8)).astype(np.float32)
+    batch = (jnp.asarray(x), jnp.asarray(y))
+
+    opt = make_optimizer("sgd", 0.1)
+    full = jax.jit(make_train_step(_loss_quadratic, opt))
+    accum = jax.jit(make_train_step(_loss_quadratic, opt, accum_steps=4))
+    s_full, m_full = full(TrainState.create({"w": jnp.asarray(w)}, opt), batch)
+    s_acc, m_acc = accum(TrainState.create({"w": jnp.asarray(w)}, opt), batch)
+
+    np.testing.assert_allclose(float(m_acc["loss"]), float(m_full["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(s_acc.params["w"]),
+                               np.asarray(s_full.params["w"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_trainer_with_accumulation_and_schedule():
+    """Accumulation + warmup-cosine + clipping all compose inside the
+    sharded SPMD step on the 8-device mesh."""
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    x = rng.standard_normal((32, 16)).astype(np.float32)
+    y = rng.standard_normal((32, 8)).astype(np.float32)
+
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    trainer = ShardedTrainer(
+        _loss_quadratic, mesh, fsdp_tp_rule(mesh),
+        make_optimizer("adam", 1e-2, schedule="cosine", warmup_steps=2,
+                       total_steps=10, clip_norm=1.0),
+        accum_steps=2)
+    state = trainer.init_state({"w": w})
+    losses = []
+    for _ in range(4):
+        state, metrics = trainer.step(state, (x, y))
+        losses.append(float(metrics["loss"]))
+    assert int(state.step) == 4
+    assert losses[-1] < losses[0]  # learning after warmup
+
+
+def test_accum_steps_validation():
+    opt = make_optimizer("sgd", 0.1)
+    with pytest.raises(ValueError, match="accum_steps"):
+        make_train_step(_loss_quadratic, opt, accum_steps=0)
+    step = jax.jit(make_train_step(_loss_quadratic, opt, accum_steps=3))
+    state = TrainState.create({"w": jnp.zeros((16, 8))}, opt)
+    with pytest.raises(ValueError, match="does not divide"):
+        step(state, (jnp.zeros((32, 16)), jnp.zeros((32, 8))))

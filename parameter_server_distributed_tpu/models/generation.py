@@ -1,0 +1,169 @@
+"""Autoregressive generation for the decoder Transformer, KV-cached.
+
+The reference has no inference path at all (it has no model — SURVEY.md §1:
+gradient computation is a 0.01-constant stub, reference src/worker.cpp:316-329);
+a complete training framework with an LM flagship needs one.  TPU-first
+design:
+
+- one jitted **prefill** over the whole prompt (full-sequence forward via
+  ``Transformer.apply_collect_kv``, MXU-shaped) that seeds the cache;
+- one jitted **decode loop** (`lax.scan` over steps) where each step runs a
+  single-token forward against the cache — static shapes throughout: the
+  cache is pre-allocated at prompt_len + max_new_tokens and masked by
+  position, so nothing retraces as generation proceeds;
+- greedy or temperature/top-k sampling via `jax.random.categorical`.
+
+The decode step calls the same layer helpers as the training forward
+(``Transformer.qkv`` / ``attn_residual`` / ``mlp_residual`` /
+``final_logits`` — the layer math exists exactly once); only the attention
+itself differs: a dense dot against the cache, masked to positions <=
+current — the cache analogue of models/transformer.py ``causal_attention``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import Transformer
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Per-layer key/value cache.  k/v: [L, B, max_len, H, D]; length is the
+    number of valid positions (a traced scalar so decode never retraces)."""
+    k: Array
+    v: Array
+    length: Array
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(model: Transformer, batch: int, max_len: int) -> KVCache:
+    c = model.config
+    shape = (c.n_layers, batch, max_len, c.n_heads, c.head_dim)
+    return KVCache(k=jnp.zeros(shape, c.dtype), v=jnp.zeros(shape, c.dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def prefill(model: Transformer, params: Mapping[str, Array], tokens: Array,
+            max_len: int) -> tuple[Array, KVCache]:
+    """Run the prompt through the full-sequence forward; returns the last
+    position's logits [B, vocab] and a cache holding the prompt's K/V."""
+    batch, prompt_len = tokens.shape
+    if prompt_len > max_len:
+        raise ValueError(f"prompt {prompt_len} exceeds cache {max_len}")
+    logits, kvs = model.apply_collect_kv(params, tokens)
+    cache = init_cache(model, batch, max_len)
+    k = jnp.stack([k for k, _ in kvs])        # [L, B, S, H, D]
+    v = jnp.stack([v for _, v in kvs])
+    cache = KVCache(
+        k=jax.lax.dynamic_update_slice(cache.k, k.astype(cache.k.dtype),
+                                       (0, 0, 0, 0, 0)),
+        v=jax.lax.dynamic_update_slice(cache.v, v.astype(cache.v.dtype),
+                                       (0, 0, 0, 0, 0)),
+        length=jnp.asarray(prompt_len, jnp.int32))
+    return logits[:, -1], cache
+
+
+def decode_step(model: Transformer, params: Mapping[str, Array],
+                token: Array, cache: KVCache) -> tuple[Array, KVCache]:
+    """One single-token forward against the cache.  token: [B] int32 ->
+    (logits [B, vocab] float32, updated cache)."""
+    c = model.config
+    batch = token.shape[0]
+    pos = cache.length                                   # scalar int32
+    h = jnp.take(params["embed/tok"], token[:, None], axis=0)  # [B, 1, d]
+    positions = jnp.full((batch, 1), pos, jnp.int32)
+    # valid cache positions for this step: 0..pos inclusive
+    mask = (jnp.arange(cache.max_len) <= pos)[None, None, None, :]
+    new_k, new_v = cache.k, cache.v
+    for i in range(c.n_layers):
+        p = f"layer{i}"
+        q, k, v = model.qkv(params, p, h, positions)     # [B, 1, H, D]
+        new_k = jax.lax.dynamic_update_slice(
+            new_k, k[None].astype(new_k.dtype), (i, 0, pos, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(
+            new_v, v[None].astype(new_v.dtype), (i, 0, pos, 0, 0))
+        # dense attention against the cache, f32 softmax
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, new_k[i],
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
+        scores = jnp.where(mask, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, new_v[i],
+                          preferred_element_type=jnp.float32).astype(c.dtype)
+        h = model.attn_residual(params, p, h, attn)
+        h = model.mlp_residual(params, p, h)
+    logits = model.final_logits(params, h)
+    return logits[:, 0], KVCache(k=new_k, v=new_v, length=pos + 1)
+
+
+def sample_token(logits: Array, rng: Array, temperature: float = 0.0,
+                 top_k: int = 0) -> Array:
+    """Greedy when temperature == 0; otherwise temperature softmax sampling,
+    optionally truncated to the top_k logits."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    top_k = min(top_k, logits.shape[-1])  # top_k > vocab = no truncation
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+# Compiled runner cache: one jitted wrapper per (model, generation config);
+# the closure keeps the model alive, so its id cannot be reused while the
+# entry exists.  jax.jit's own cache then handles distinct prompt shapes.
+_RUNNERS: dict[tuple, object] = {}
+
+
+def _runner(model: Transformer, max_new_tokens: int, temperature: float,
+            top_k: int):
+    key = (id(model), max_new_tokens, temperature, top_k)
+    run = _RUNNERS.get(key)
+    if run is None:
+        @jax.jit
+        def run(params, prompt, rng):
+            max_len = prompt.shape[1] + max_new_tokens
+            logits, cache = prefill(model, params, prompt, max_len)
+            rng0, rng = jax.random.split(rng)
+            first = sample_token(logits, rng0, temperature, top_k)
+
+            def body(carry, _):
+                token, cache, rng = carry
+                rng, sub = jax.random.split(rng)
+                logits, cache = decode_step(model, params, token, cache)
+                nxt = sample_token(logits, sub, temperature, top_k)
+                return (nxt, cache, rng), token
+
+            (_, _, _), tokens = jax.lax.scan(
+                body, (first, cache, rng), None, length=max_new_tokens)
+            return jnp.swapaxes(tokens, 0, 1)      # [B, max_new]
+
+        _RUNNERS[key] = run
+    return run
+
+
+def generate(model: Transformer, params: Mapping[str, Array],
+             prompt: Array, max_new_tokens: int, *,
+             temperature: float = 0.0, top_k: int = 0,
+             rng: Array | int = 0) -> Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` [B, S] int32.
+    Returns [B, max_new_tokens].  Prefill and the whole decode scan are
+    jitted with static shapes; the compiled runner is cached per
+    (model, max_new_tokens, temperature, top_k), so repeated calls with the
+    same shapes do not retrace."""
+    if isinstance(rng, int):
+        rng = jax.random.key(rng)
+    return _runner(model, max_new_tokens, temperature, top_k)(
+        params, prompt, rng)

@@ -176,37 +176,75 @@ class Transformer:
 
     def apply(self, params: Mapping[str, Array], tokens: Array) -> Array:
         """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+        return self._forward(params, tokens, collect_kv=False)[0]
+
+    def apply_collect_kv(self, params: Mapping[str, Array],
+                         tokens: Array) -> tuple[Array, list]:
+        """Forward that also returns each layer's post-rope (k, v) — the
+        prefill half of KV-cached generation (models/generation.py)."""
+        return self._forward(params, tokens, collect_kv=True)
+
+    # --- shared layer pieces (used by _forward AND generation.decode_step,
+    # so the layer math exists exactly once) -----------------------------
+    def qkv(self, params: Mapping[str, Array], prefix: str, h: Array,
+            positions: Array) -> tuple[Array, Array, Array]:
+        """ln1 -> q/k/v projections -> head split -> rope.  h: [B, S, d]."""
+        c = self.config
+        batch, seq = h.shape[:2]
+        x = rms_norm(h, params[f"{prefix}/ln1/scale"])
+        dot = partial(jnp.dot, preferred_element_type=jnp.float32)
+        q = dot(x, params[f"{prefix}/attn/wq"]).astype(c.dtype)
+        k = dot(x, params[f"{prefix}/attn/wk"]).astype(c.dtype)
+        v = dot(x, params[f"{prefix}/attn/wv"]).astype(c.dtype)
+        q = q.reshape(batch, seq, c.n_heads, c.head_dim)
+        k = k.reshape(batch, seq, c.n_heads, c.head_dim)
+        v = v.reshape(batch, seq, c.n_heads, c.head_dim)
+        return (rope(q, positions, c.rope_theta),
+                rope(k, positions, c.rope_theta), v)
+
+    def attn_residual(self, params: Mapping[str, Array], prefix: str,
+                      h: Array, attn: Array) -> Array:
+        """h + wo(attn).  attn: [B, S, H, D]."""
+        c = self.config
+        batch, seq = h.shape[:2]
+        out = jnp.dot(attn.reshape(batch, seq, c.d_model),
+                      params[f"{prefix}/attn/wo"],
+                      preferred_element_type=jnp.float32)
+        return h + out.astype(c.dtype)
+
+    def mlp_residual(self, params: Mapping[str, Array], prefix: str,
+                     h: Array) -> Array:
+        """h + w2(gelu(w1(ln2(h))))."""
+        c = self.config
+        dot = partial(jnp.dot, preferred_element_type=jnp.float32)
+        x = rms_norm(h, params[f"{prefix}/ln2/scale"])
+        ff = jax.nn.gelu(dot(x, params[f"{prefix}/mlp/w1"]).astype(c.dtype))
+        return h + dot(ff, params[f"{prefix}/mlp/w2"]).astype(c.dtype)
+
+    def final_logits(self, params: Mapping[str, Array], h: Array) -> Array:
+        h = rms_norm(h, params["final_ln/scale"])
+        return jnp.dot(h, params["lm_head/w"],
+                       preferred_element_type=jnp.float32)
+
+    def _forward(self, params: Mapping[str, Array], tokens: Array,
+                 collect_kv: bool) -> tuple[Array, list]:
         c = self.config
         batch, seq = tokens.shape
         h = jnp.take(params["embed/tok"], tokens, axis=0)
         h = self._constrain(h, ("data", "fsdp"), "seq", None)
         positions = jnp.arange(seq, dtype=jnp.int32)[None, :].repeat(batch, 0)
+        kvs: list = []
         for i in range(c.n_layers):
             p = f"layer{i}"
-            # attention branch
-            x = rms_norm(h, params[f"{p}/ln1/scale"])
-            dot = partial(jnp.dot, preferred_element_type=jnp.float32)
-            q = dot(x, params[f"{p}/attn/wq"]).astype(c.dtype)
-            k = dot(x, params[f"{p}/attn/wk"]).astype(c.dtype)
-            v = dot(x, params[f"{p}/attn/wv"]).astype(c.dtype)
-            q = q.reshape(batch, seq, c.n_heads, c.head_dim)
-            k = k.reshape(batch, seq, c.n_heads, c.head_dim)
-            v = v.reshape(batch, seq, c.n_heads, c.head_dim)
-            q = rope(q, positions, c.rope_theta)
-            k = rope(k, positions, c.rope_theta)
+            q, k, v = self.qkv(params, p, h, positions)
+            if collect_kv:
+                kvs.append((k, v))
             attn = self.attention_fn(q, k, v)
-            attn = attn.reshape(batch, seq, c.d_model)
-            h = h + dot(attn, params[f"{p}/attn/wo"]).astype(c.dtype)
+            h = self.attn_residual(params, p, h, attn)
             h = self._constrain(h, ("data", "fsdp"), "seq", None)
-            # mlp branch
-            x = rms_norm(h, params[f"{p}/ln2/scale"])
-            ff = dot(x, params[f"{p}/mlp/w1"]).astype(c.dtype)
-            ff = jax.nn.gelu(ff)
-            h = h + dot(ff, params[f"{p}/mlp/w2"]).astype(c.dtype)
+            h = self.mlp_residual(params, p, h)
             h = self._constrain(h, ("data", "fsdp"), "seq", None)
-        h = rms_norm(h, params["final_ln/scale"])
-        return jnp.dot(h, params["lm_head/w"],
-                       preferred_element_type=jnp.float32)
+        return self.final_logits(params, h), kvs
 
     def loss(self, params: Mapping[str, Array], batch) -> Array:
         """Next-token cross-entropy.  batch: [B, S] int32 tokens (or a

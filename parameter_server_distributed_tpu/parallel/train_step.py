@@ -53,6 +53,8 @@ def make_lr_schedule(learning_rate: float, schedule: str = "constant",
     linear warmup from zero.  Returns a float (constant, no warmup) or an
     optax schedule fn."""
     schedule = schedule.lower()
+    if schedule not in ("constant", "cosine", "linear"):
+        raise ValueError(f"unknown schedule {schedule!r}")
     if schedule == "constant":
         if warmup_steps <= 0:
             return learning_rate
@@ -63,10 +65,8 @@ def make_lr_schedule(learning_rate: float, schedule: str = "constant",
     decay_steps = total_steps - warmup_steps
     if schedule == "cosine":
         decay = optax.cosine_decay_schedule(learning_rate, decay_steps)
-    elif schedule == "linear":
-        decay = optax.linear_schedule(learning_rate, 0.0, decay_steps)
     else:
-        raise ValueError(f"unknown schedule {schedule!r}")
+        decay = optax.linear_schedule(learning_rate, 0.0, decay_steps)
     if warmup_steps <= 0:
         return decay
     warmup = optax.linear_schedule(0.0, learning_rate, warmup_steps)

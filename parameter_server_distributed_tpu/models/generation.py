@@ -14,10 +14,14 @@ design:
 - greedy or temperature/top-k sampling via `jax.random.categorical`.
 
 The decode step calls the same layer helpers as the training forward
-(``Transformer.qkv`` / ``attn_residual`` / ``mlp_residual`` /
+(``Transformer.qkv`` / ``attn_residual`` / ``ffn_residual`` /
 ``final_logits`` — the layer math exists exactly once); only the attention
 itself differs: a dense dot against the cache, masked to positions <=
 current — the cache analogue of models/transformer.py ``causal_attention``.
+MoE layers decode drop-free (see ``Transformer.ffn_residual``): training's
+capacity dropping is batch-global, so for tokens the training forward
+dropped, cached decode legitimately differs; for all kept tokens the paths
+are token-exact.
 """
 
 from __future__ import annotations
@@ -102,7 +106,8 @@ def decode_step(model: Transformer, params: Mapping[str, Array],
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, new_v[i],
                           preferred_element_type=jnp.float32).astype(c.dtype)
         h = model.attn_residual(params, p, h, attn)
-        h = model.mlp_residual(params, p, h)
+        # MoE-aware, drop-free at decode time; aux loss unused here
+        h, _ = model.ffn_residual(params, i, h, decode=True)
     logits = model.final_logits(params, h)
     return logits[:, 0], KVCache(k=new_k, v=new_v, length=pos + 1)
 

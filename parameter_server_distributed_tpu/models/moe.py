@@ -68,16 +68,22 @@ class MoELayer:
             num_tokens / c.num_experts * c.capacity_factor)))
 
     def apply(self, params: Mapping[str, Array], x: Array,
-              prefix: str = "") -> tuple[Array, Array]:
+              prefix: str = "",
+              capacity_override: int | None = None) -> tuple[Array, Array]:
         """x: [B, S, D] -> (out [B, S, D], aux_loss scalar).
 
         Dropped tokens (over capacity) contribute zero output — callers add
-        the residual connection."""
+        the residual connection.  ``capacity_override`` replaces the
+        factor-derived capacity; pass the token count for drop-free
+        inference (capacity dropping is a batch-global training-time
+        mechanism: which token drops depends on every other token in the
+        batch, so it cannot be reproduced causally at decode time)."""
         c = self.config
         b, s, d = x.shape
         tokens = x.reshape(b * s, d)
         n = b * s
-        cap = self.capacity(n)
+        cap = capacity_override if capacity_override is not None \
+            else self.capacity(n)
 
         logits = jnp.dot(tokens.astype(jnp.float32),
                          params[f"{prefix}moe/router/w"].astype(jnp.float32))

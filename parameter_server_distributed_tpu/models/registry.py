@@ -13,7 +13,7 @@ from ..data.synthetic import (synthetic_image_batches, synthetic_mnist,
                               synthetic_tokens)
 from .mlp import MLP, billion_param_mlp, mnist_mlp
 from .resnet import resnet18, resnet50
-from .transformer import small_lm
+from .transformer import moe_lm, small_lm
 
 
 def _mnist_batches(batch_size: int, seed: int) -> Iterator:
@@ -48,6 +48,7 @@ REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator]]] = {
     "resnet18_cifar": (lambda: resnet18(num_classes=10), _cifar_batches),
     "resnet50_imagenet": (lambda: resnet50(num_classes=1000), _imagenet_batches),
     "small_lm": (lambda: small_lm(vocab=1024, seq=256), _lm_batches),
+    "moe_lm": (lambda: moe_lm(vocab=1024, seq=256), _lm_batches),
     "mlp_1b": (billion_param_mlp, _mlp_1b_batches),
 }
 

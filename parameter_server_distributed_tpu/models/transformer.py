@@ -44,10 +44,21 @@ class TransformerConfig:
     max_seq: int = 2048
     dtype: object = jnp.bfloat16
     rope_theta: float = 10000.0
+    # Mixture-of-experts: every ``moe_every``-th layer (1-based; 0 = dense
+    # everywhere) swaps its FFN for a Switch-routed MoE (models/moe.py) with
+    # ``moe_experts`` experts; the load-balancing aux loss is added to the
+    # LM loss scaled by ``moe_aux_coef``.
+    moe_every: int = 0
+    moe_experts: int = 8
+    moe_capacity: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    def is_moe_layer(self, i: int) -> bool:
+        return self.moe_every > 0 and (i + 1) % self.moe_every == 0
 
 
 def rms_norm(x: Array, scale: Array, eps: float = 1e-6) -> Array:
@@ -120,6 +131,14 @@ class Transformer:
         if config.d_model % config.n_heads:
             raise ValueError("d_model must divide by n_heads")
         self.config = config
+        if config.moe_every > 0:
+            from .moe import MoEConfig, MoELayer
+            self._moe = MoELayer(MoEConfig(
+                d_model=config.d_model, d_ff=config.d_ff,
+                num_experts=config.moe_experts,
+                capacity_factor=config.moe_capacity, dtype=config.dtype))
+        else:
+            self._moe = None
         # The flash kernels are single-device (per-shard) compute; with a
         # mesh, attention stays on the GSPMD einsum path (or the ring/Ulysses
         # fn the caller passes) so XLA can partition it.
@@ -139,8 +158,13 @@ class Transformer:
             shapes[f"{p}/attn/wv"] = (c.d_model, c.d_model)
             shapes[f"{p}/attn/wo"] = (c.d_model, c.d_model)
             shapes[f"{p}/ln2/scale"] = (c.d_model,)
-            shapes[f"{p}/mlp/w1"] = (c.d_model, c.d_ff)
-            shapes[f"{p}/mlp/w2"] = (c.d_ff, c.d_model)
+            if c.is_moe_layer(i):
+                shapes[f"{p}/moe/router/w"] = (c.d_model, c.moe_experts)
+                shapes[f"{p}/moe/w1"] = (c.moe_experts, c.d_model, c.d_ff)
+                shapes[f"{p}/moe/w2"] = (c.moe_experts, c.d_ff, c.d_model)
+            else:
+                shapes[f"{p}/mlp/w1"] = (c.d_model, c.d_ff)
+                shapes[f"{p}/mlp/w2"] = (c.d_ff, c.d_model)
         shapes["final_ln/scale"] = (c.d_model,)
         shapes["lm_head/w"] = (c.d_model, c.vocab)
         return shapes
@@ -160,9 +184,12 @@ class Transformer:
             elif name == "embed/tok":
                 params[name] = jax.random.normal(sub, shape, c.dtype) * 0.02
             else:
-                scale = 1.0 / math.sqrt(shape[0])
+                # fan-in: leading dim for 2D weights, middle dim for the
+                # per-expert [E, in, out] MoE weights
+                fan_in = shape[-2] if len(shape) == 3 else shape[0]
+                scale = 1.0 / math.sqrt(fan_in)
                 # residual-output projections get depth-scaled init
-                if name.endswith("attn/wo") or name.endswith("mlp/w2"):
+                if name.endswith(("attn/wo", "mlp/w2", "moe/w2")):
                     scale /= math.sqrt(2.0 * c.n_layers)
                 params[name] = jax.random.normal(sub, shape, c.dtype) * scale
         return params
@@ -182,7 +209,8 @@ class Transformer:
                          tokens: Array) -> tuple[Array, list]:
         """Forward that also returns each layer's post-rope (k, v) — the
         prefill half of KV-cached generation (models/generation.py)."""
-        return self._forward(params, tokens, collect_kv=True)
+        logits, kvs, _ = self._forward(params, tokens, collect_kv=True)
+        return logits, kvs
 
     # --- shared layer pieces (used by _forward AND generation.decode_step,
     # so the layer math exists exactly once) -----------------------------
@@ -221,19 +249,36 @@ class Transformer:
         ff = jax.nn.gelu(dot(x, params[f"{prefix}/mlp/w1"]).astype(c.dtype))
         return h + dot(ff, params[f"{prefix}/mlp/w2"]).astype(c.dtype)
 
+    def ffn_residual(self, params: Mapping[str, Array], layer: int,
+                     h: Array, decode: bool = False) -> tuple[Array, Array]:
+        """The layer's FFN branch: dense MLP or Switch MoE per the config.
+        Returns (new_h, aux_loss) — aux is 0 for dense layers.  ``decode``
+        runs MoE drop-free (capacity = token count): capacity dropping is a
+        batch-global training mechanism and cannot be reproduced causally
+        during KV-cached decoding."""
+        p = f"layer{layer}"
+        if not self.config.is_moe_layer(layer):
+            return self.mlp_residual(params, p, h), jnp.zeros((), jnp.float32)
+        x = rms_norm(h, params[f"{p}/ln2/scale"])
+        cap = h.shape[0] * h.shape[1] if decode else None
+        moe_out, aux = self._moe.apply(params, x, prefix=f"{p}/",
+                                       capacity_override=cap)
+        return h + moe_out.astype(self.config.dtype), aux
+
     def final_logits(self, params: Mapping[str, Array], h: Array) -> Array:
         h = rms_norm(h, params["final_ln/scale"])
         return jnp.dot(h, params["lm_head/w"],
                        preferred_element_type=jnp.float32)
 
     def _forward(self, params: Mapping[str, Array], tokens: Array,
-                 collect_kv: bool) -> tuple[Array, list]:
+                 collect_kv: bool) -> tuple[Array, list, Array]:
         c = self.config
         batch, seq = tokens.shape
         h = jnp.take(params["embed/tok"], tokens, axis=0)
         h = self._constrain(h, ("data", "fsdp"), "seq", None)
         positions = jnp.arange(seq, dtype=jnp.int32)[None, :].repeat(batch, 0)
         kvs: list = []
+        aux_total = jnp.zeros((), jnp.float32)
         for i in range(c.n_layers):
             p = f"layer{i}"
             q, k, v = self.qkv(params, p, h, positions)
@@ -242,35 +287,47 @@ class Transformer:
             attn = self.attention_fn(q, k, v)
             h = self.attn_residual(params, p, h, attn)
             h = self._constrain(h, ("data", "fsdp"), "seq", None)
-            h = self.mlp_residual(params, p, h)
+            h, aux = self.ffn_residual(params, i, h)
+            aux_total = aux_total + aux
             h = self._constrain(h, ("data", "fsdp"), "seq", None)
-        return self.final_logits(params, h), kvs
+        return self.final_logits(params, h), kvs, aux_total
 
     def loss(self, params: Mapping[str, Array], batch) -> Array:
-        """Next-token cross-entropy.  batch: [B, S] int32 tokens (or a
-        (tokens,) tuple)."""
+        """Next-token cross-entropy (+ MoE load-balance aux when
+        configured).  batch: [B, S] int32 tokens (or a (tokens,) tuple)."""
         tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
         # run the full sequence (keeps the seq length shard-divisible for
         # sequence parallelism) and drop the last position's logits
-        logits = self.apply(params, tokens)
+        logits, _, aux = self._forward(params, tokens, collect_kv=False)
         logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
         targets = tokens[:, 1:]
         nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
                                    axis=-1)
-        return jnp.mean(nll)
+        return jnp.mean(nll) + self.config.moe_aux_coef * aux
 
 
 def transformer_rule(mesh: Mesh):
-    """Sharding rule for transformer stores: Megatron TP + fsdp.
+    """Sharding rule for transformer stores: Megatron TP + fsdp (+ EP).
 
     column-parallel (tensor on output dim): wq wk wv w1 lm_head
     row-parallel  (tensor on input dim):    wo w2
-    vocab-sharded embedding; norm scales replicated (fsdp if divisible).
+    vocab-sharded embedding; norm scales replicated (fsdp if divisible);
+    MoE expert weights sharded over the ``expert`` axis (router replicated).
     """
     n_fsdp = mesh.shape["fsdp"]
     n_tp = mesh.shape["tensor"]
+    n_exp = mesh.shape.get("expert", 1)
 
     def rule(name: str, shape: tuple[int, ...]) -> PartitionSpec:
+        if "/moe/router/" in name:
+            return PartitionSpec()
+        if "/moe/w" in name:
+            spec: list = [None] * len(shape)
+            if n_exp > 1 and shape[0] % n_exp == 0:
+                spec[0] = "expert"
+            if n_fsdp > 1 and shape[-1] % n_fsdp == 0:
+                spec[-1] = "fsdp"
+            return PartitionSpec(*spec)
         def fsdp_on(axis: int, taken: int | None) -> list:
             spec: list = [None] * len(shape)
             if taken is not None:
@@ -306,3 +363,10 @@ def small_lm(vocab: int = 1024, seq: int = 256) -> Transformer:
     return Transformer(TransformerConfig(
         vocab=vocab, d_model=128, n_heads=4, n_layers=2, d_ff=512,
         max_seq=seq, dtype=jnp.float32))
+
+
+def moe_lm(vocab: int = 1024, seq: int = 256) -> Transformer:
+    """Test-scale MoE LM: every 2nd layer is a Switch-routed FFN."""
+    return Transformer(TransformerConfig(
+        vocab=vocab, d_model=128, n_heads=4, n_layers=4, d_ff=512,
+        max_seq=seq, dtype=jnp.float32, moe_every=2, moe_experts=4))

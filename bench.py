@@ -209,6 +209,40 @@ def bench_pushpull() -> dict:
             "unit": "ms_roundtrip", "vs_baseline": 1.0}
 
 
+def bench_generate() -> dict:
+    """KV-cached decode throughput (tokens/sec/chip) for the LM flagship.
+    PSDT_BENCH_MODEL picks the registry LM (small_lm | moe_lm); batch and
+    new-token count via PSDT_BENCH_BATCH / PSDT_BENCH_STEPS."""
+    import numpy as np
+
+    from parameter_server_distributed_tpu.models.generation import generate
+    from parameter_server_distributed_tpu.models.registry import (
+        get_model_and_batches)
+
+    name = os.environ.get("PSDT_BENCH_MODEL", "small_lm")
+    batch = int(os.environ.get("PSDT_BENCH_BATCH", "8"))
+    max_new = int(os.environ.get("PSDT_BENCH_STEPS", "64"))
+    model, _ = get_model_and_batches(name, batch)
+    params = model.init_params(0)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, model.config.vocab, (batch, 32)).astype(np.int32)
+
+    out = generate(model, params, prompt, max_new)  # compile
+    np.asarray(out)
+    t0 = time.perf_counter()
+    reps = 3
+    for i in range(reps):
+        out = generate(model, params, prompt, max_new, rng=i + 1,
+                       temperature=0.7, top_k=40)
+    np.asarray(out)
+    dt = (time.perf_counter() - t0) / reps
+    tps = batch * max_new / dt
+    log(f"bench_generate: model={name} batch={batch} new={max_new} "
+        f"{tps:,.0f} tokens/s ({dt*1e3/max_new:.2f} ms/token-step)")
+    return {"metric": f"{name}_decode_tokens_per_sec", "value": round(tps, 1),
+            "unit": "tokens/sec", "vs_baseline": 1.0}
+
+
 def bench_async() -> dict:
     """End-to-end async/bounded-staleness throughput: real PS + coordinator
     over localhost gRPC, N worker threads training a real model on the
@@ -280,6 +314,8 @@ def main() -> int:
             result = bench_pushpull()
         elif mode == "async":
             result = bench_async()
+        elif mode == "generate":
+            result = bench_generate()
         else:
             result = bench_mfu()
     except Exception as exc:  # noqa: BLE001 — always emit the JSON line

@@ -14,9 +14,10 @@ and reads it back in `load_checkpoint` (:146-188):
       data_len  size_t | data float32[data_len]
 
 This module reproduces that layout byte-for-byte (a checkpoint written by
-the reference loads here and vice versa), adds integrity-preserving atomic
-writes (tmp file + rename — the reference writes in place), and an optional
-native C++ fast path for the bulk float I/O (see native/).
+the reference loads here and vice versa) and adds integrity-preserving
+atomic writes (tmp file + rename — the reference writes in place).  The
+bulk float I/O is numpy tobytes/frombuffer, i.e. already memcpy-speed; no
+native path is needed.
 """
 
 from __future__ import annotations

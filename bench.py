@@ -227,7 +227,10 @@ def bench_generate() -> dict:
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, model.config.vocab, (batch, 32)).astype(np.int32)
 
-    out = generate(model, params, prompt, max_new)  # compile
+    # warm up the EXACT runner the timed loop uses — the compiled-runner
+    # cache keys on (model, max_new, temperature, top_k)
+    out = generate(model, params, prompt, max_new, rng=0,
+                   temperature=0.7, top_k=40)
     np.asarray(out)
     t0 = time.perf_counter()
     reps = 3

@@ -58,6 +58,7 @@ def main(argv: list[str] | None = None) -> int:
     config = TrainLoopConfig(
         model=flags.get("model", "mnist_mlp"),
         batch_size=int(flags.get("batch", 64)),
+        data_path=flags.get("data", ""),
         steps=int(flags.get("steps", 100)),
         optimizer=flags.get("optimizer", "adam"),
         learning_rate=float(flags.get("lr", 1e-3)),

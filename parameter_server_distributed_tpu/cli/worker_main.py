@@ -13,6 +13,8 @@ Extension flags:
     --model=NAME     model from the registry (default mnist_mlp)
     --batch=N        per-worker batch size (default 32)
     --seed=N         data seed (defaults to worker_id so shards differ)
+    --data=PATH      file-backed dataset (token .bin for LMs, npz x/y
+                     otherwise); default synthetic
     --wire=ENC       tensor payload encoding: f32 (reference-compatible,
                      default), raw, or bf16 (half the push/pull bytes;
                      requires a framework PS)
@@ -32,7 +34,8 @@ from ..worker.worker import Worker
 def build_worker(config: WorkerConfig, seed: int | None = None) -> Worker:
     data_seed = config.worker_id if seed is None else seed
     model, batches = get_model_and_batches(config.model, config.batch_size,
-                                           seed=data_seed)
+                                           seed=data_seed,
+                                           data_path=config.data_path)
     return Worker(config, Trainer(model), batches)
 
 
@@ -50,6 +53,7 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_path=positional[5] if len(positional) > 5 else "",
         model=flags.get("model", "mnist_mlp"),
         batch_size=int(flags.get("batch", 32)),
+        data_path=flags.get("data", ""),
         wire_dtype=flags.get("wire", "f32"),
     )
     worker = build_worker(config, seed=int(flags["seed"]) if "seed" in flags else None)

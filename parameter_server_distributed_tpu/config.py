@@ -80,6 +80,9 @@ class WorkerConfig:
     sync_outer_retries: int = SYNC_OUTER_RETRIES
     batch_size: int = 32
     model: str = "mnist_mlp"
+    # File-backed dataset (data/files.py): token shard for LMs, npz
+    # elsewhere.  Empty = synthetic loaders.
+    data_path: str = ""
     # Tensor payload encoding on push/pull: "f32" (reference-compatible
     # repeated float), "raw" (f32 bytes blob), or "bf16" (half the bytes;
     # TPU-native number format).  Requires a framework PS for raw/bf16.

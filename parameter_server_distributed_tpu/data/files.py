@@ -1,0 +1,88 @@
+"""File-backed datasets: memmap token shards and npz example sets.
+
+The reference has no data pipeline at all (SURVEY.md §1); synthetic.py
+covers hermetic tests and benchmarks.  This module is the real-data path:
+
+- **Token shards** (`token_stream`): flat binary files of token ids
+  (uint16/uint32 little-endian — the standard GPT-style ``.bin`` layout),
+  opened with ``np.memmap`` so multi-GB corpora stream from page cache
+  without loading into RAM.  Batches are random [seq_len] crops.
+- **Example sets** (`npz_stream`): an ``.npz`` with arrays ``x`` and ``y``
+  (any model input/label pair), shuffled each epoch.
+
+Worker sharding: pass a distinct ``seed`` per worker (the CLIs already
+default seed to worker_id) so workers draw different crops/orders, the
+same contract the synthetic loaders follow.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import numpy as np
+
+
+def load_tokens(path: str, dtype: str | None = None) -> np.ndarray:
+    """Memmap a flat binary token file.  dtype auto-detection: ``.u16``/
+    ``.u32`` extension wins, else uint16 (the common GPT shard format)."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"token file {path!r} does not exist")
+    if dtype is None:
+        dtype = {"u32": "<u4", ".u32": "<u4"}.get(
+            os.path.splitext(path)[1], "<u2")
+    tokens = np.memmap(path, dtype=dtype, mode="r")
+    if tokens.size == 0:
+        raise ValueError(f"token file {path!r} is empty")
+    return tokens
+
+
+def token_stream(path: str, batch_size: int, seq_len: int,
+                 seed: int = 0, dtype: str | None = None
+                 ) -> Iterator[np.ndarray]:
+    """Endless [batch, seq_len] int32 batches of random crops from a token
+    shard — drop-in for synthetic.synthetic_tokens."""
+    tokens = load_tokens(path, dtype)
+    if tokens.size < seq_len:
+        raise ValueError(
+            f"token file {path!r} has {tokens.size} tokens, need at least "
+            f"seq_len = {seq_len}")
+    rng = np.random.default_rng(seed)
+    high = tokens.size - seq_len + 1  # inclusive of the final full crop
+    while True:
+        starts = rng.integers(0, high, size=batch_size)
+        yield np.stack([tokens[s:s + seq_len] for s in starts]).astype(
+            np.int32)
+
+
+def npz_stream(path: str, batch_size: int, seed: int = 0,
+               drop_remainder: bool = True
+               ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Endless shuffled (x, y) batches from an npz with arrays 'x' and 'y'
+    — drop-in for synthetic.ClassClusterDataset.batch_stream."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"dataset {path!r} does not exist")
+    with np.load(path) as data:
+        missing = {"x", "y"} - set(data.files)
+        if missing:
+            raise ValueError(f"{path!r} lacks arrays {sorted(missing)} "
+                             f"(has {sorted(data.files)})")
+        x, y = np.asarray(data["x"]), np.asarray(data["y"])
+    if len(x) != len(y):
+        raise ValueError(f"{path!r}: len(x)={len(x)} != len(y)={len(y)}")
+    if len(x) < batch_size and drop_remainder:
+        raise ValueError(f"{path!r} has {len(x)} examples < batch_size "
+                         f"{batch_size}")
+    epoch = 0
+    while True:
+        # seed as a sequence: default_rng([seed, epoch]) — scalar seed+epoch
+        # would collide across workers seeded by worker_id (worker 1 epoch 0
+        # == worker 0 epoch 1)
+        rng = np.random.default_rng([seed, epoch])
+        order = rng.permutation(len(x))
+        end = (len(order) // batch_size) * batch_size if drop_remainder \
+            else len(order)
+        for start in range(0, end, batch_size):
+            idx = order[start:start + batch_size]
+            yield x[idx], y[idx]
+        epoch += 1

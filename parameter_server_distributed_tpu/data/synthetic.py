@@ -43,10 +43,12 @@ class ClassClusterDataset:
 
     def batch_stream(self, batch_size: int, seed: int = 0
                      ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        """Endless stream of batches (re-shuffles each epoch)."""
+        """Endless stream of batches (re-shuffles each epoch).  Epoch seeds
+        use a (seed, epoch) sequence so worker-id-derived seeds never
+        replay a neighbor's epoch order."""
         epoch = 0
         while True:
-            yield from self.batches(batch_size, seed=seed + epoch)
+            yield from self.batches(batch_size, seed=[seed, epoch])
             epoch += 1
 
 

@@ -43,18 +43,35 @@ def _mlp_1b_batches(batch_size: int, seed: int) -> Iterator:
         yield x, y
 
 
-REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator]]] = {
-    "mnist_mlp": (mnist_mlp, _mnist_batches),
-    "resnet18_cifar": (lambda: resnet18(num_classes=10), _cifar_batches),
-    "resnet50_imagenet": (lambda: resnet50(num_classes=1000), _imagenet_batches),
-    "small_lm": (lambda: small_lm(vocab=1024, seq=256), _lm_batches),
-    "moe_lm": (lambda: moe_lm(vocab=1024, seq=256), _lm_batches),
-    "mlp_1b": (billion_param_mlp, _mlp_1b_batches),
+# name -> (model factory, synthetic data factory, file-data kind)
+# file-data kind: "tokens" (memmap .bin shard, data/files.token_stream) or
+# "xy" (npz with x/y arrays, data/files.npz_stream)
+REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator], str]] = {
+    "mnist_mlp": (mnist_mlp, _mnist_batches, "xy"),
+    "resnet18_cifar": (lambda: resnet18(num_classes=10), _cifar_batches, "xy"),
+    "resnet50_imagenet": (lambda: resnet50(num_classes=1000),
+                          _imagenet_batches, "xy"),
+    "small_lm": (lambda: small_lm(vocab=1024, seq=256), _lm_batches, "tokens"),
+    "moe_lm": (lambda: moe_lm(vocab=1024, seq=256), _lm_batches, "tokens"),
+    "mlp_1b": (billion_param_mlp, _mlp_1b_batches, "xy"),
 }
 
 
-def get_model_and_batches(name: str, batch_size: int, seed: int = 0):
+def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
+                          data_path: str = ""):
+    """Build (model, batch iterator).  ``data_path`` switches from the
+    synthetic loaders to file-backed data (data/files.py), dispatched by
+    the registry entry's declared file-data kind."""
     if name not in REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {sorted(REGISTRY)}")
-    model_fn, data_fn = REGISTRY[name]
-    return model_fn(), data_fn(batch_size, seed)
+    model_fn, data_fn, file_kind = REGISTRY[name]
+    model = model_fn()
+    if not data_path:
+        return model, data_fn(batch_size, seed)
+    from ..data.files import npz_stream, token_stream
+    if file_kind == "tokens":
+        batches = token_stream(data_path, batch_size,
+                               seq_len=model.config.max_seq, seed=seed)
+    else:
+        batches = npz_stream(data_path, batch_size, seed=seed)
+    return model, batches

@@ -36,6 +36,7 @@ log = logging.getLogger("pst.train")
 class TrainLoopConfig:
     model: str = "mnist_mlp"
     batch_size: int = 64          # global batch
+    data_path: str = ""           # file-backed data; empty = synthetic
     steps: int = 100
     optimizer: str = "adam"
     learning_rate: float = 1e-3
@@ -66,7 +67,8 @@ def run_training(config: TrainLoopConfig) -> dict:
     devices = jax.devices()[:config.mesh.num_devices]
     mesh = build_mesh(config.mesh, devices=devices)
     model, batches = get_model_and_batches(config.model, config.batch_size,
-                                           seed=config.seed)
+                                           seed=config.seed,
+                                           data_path=config.data_path)
     trainer = ShardedTrainer(
         model.loss, mesh, _pick_rule(config.model, mesh),
         make_optimizer(config.optimizer, config.learning_rate,

@@ -3,8 +3,13 @@
     python -m parameter_server_distributed_tpu.cli.train_main \
         --model=mnist_mlp --steps=100 --batch=64 --optimizer=adam --lr=1e-3 \
         --schedule=cosine --warmup=10 --clip-norm=1.0 --accum=2 \
+        --data=/data/train.npz \
         --mesh=data:2,fsdp:2,tensor:2 --ckpt-dir=/tmp/ckpt --ckpt-every=50 \
         --resume --metrics=/tmp/metrics.jsonl
+
+``--data`` switches from synthetic loaders to file-backed data
+(data/files.py): a token shard (.bin/.u32 memmap) for LM models, an npz
+with x/y arrays otherwise.
 
 The mesh spec names axes explicitly; unnamed axes default to 1.  For
 multi-host runs set --coordinator=HOST:PORT --num-processes=N

@@ -16,8 +16,9 @@ Extension flags:
     --data=PATH      file-backed dataset (token .bin for LMs, npz x/y
                      otherwise); default synthetic
     --wire=ENC       tensor payload encoding: f32 (reference-compatible,
-                     default), raw, or bf16 (half the push/pull bytes;
-                     requires a framework PS)
+                     default), raw, bf16 (half the push/pull bytes), or
+                     int8 (quarter-size error-feedback gradient pushes,
+                     bf16 pulls; requires a framework PS)
 """
 
 from __future__ import annotations

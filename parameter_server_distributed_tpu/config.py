@@ -84,8 +84,10 @@ class WorkerConfig:
     # elsewhere.  Empty = synthetic loaders.
     data_path: str = ""
     # Tensor payload encoding on push/pull: "f32" (reference-compatible
-    # repeated float), "raw" (f32 bytes blob), or "bf16" (half the bytes;
-    # TPU-native number format).  Requires a framework PS for raw/bf16.
+    # repeated float), "raw" (f32 bytes blob), "bf16" (half the bytes;
+    # TPU-native number format), or "int8" (quarter-size gradient pushes
+    # with error feedback; pulls stay bf16).  Packed encodings require a
+    # framework PS (negotiated; falls back to f32 against the reference).
     wire_dtype: str = "f32"
 
 

@@ -29,8 +29,7 @@ def load_tokens(path: str, dtype: str | None = None) -> np.ndarray:
     if not os.path.exists(path):
         raise FileNotFoundError(f"token file {path!r} does not exist")
     if dtype is None:
-        dtype = {"u32": "<u4", ".u32": "<u4"}.get(
-            os.path.splitext(path)[1], "<u2")
+        dtype = {".u32": "<u4"}.get(os.path.splitext(path)[1], "<u2")
     tokens = np.memmap(path, dtype=dtype, mode="r")
     if tokens.size == 0:
         raise ValueError(f"token file {path!r} is empty")
@@ -38,10 +37,13 @@ def load_tokens(path: str, dtype: str | None = None) -> np.ndarray:
 
 
 def token_stream(path: str, batch_size: int, seq_len: int,
-                 seed: int = 0, dtype: str | None = None
-                 ) -> Iterator[np.ndarray]:
+                 seed: int = 0, dtype: str | None = None,
+                 vocab: int | None = None) -> Iterator[np.ndarray]:
     """Endless [batch, seq_len] int32 batches of random crops from a token
-    shard — drop-in for synthetic.synthetic_tokens."""
+    shard — drop-in for synthetic.synthetic_tokens.  ``vocab`` validates
+    every batch's ids: under jit, out-of-range embedding lookups CLAMP
+    instead of erroring, so a shard from a different tokenizer would
+    otherwise train on silently-mangled data."""
     tokens = load_tokens(path, dtype)
     if tokens.size < seq_len:
         raise ValueError(
@@ -51,8 +53,13 @@ def token_stream(path: str, batch_size: int, seq_len: int,
     high = tokens.size - seq_len + 1  # inclusive of the final full crop
     while True:
         starts = rng.integers(0, high, size=batch_size)
-        yield np.stack([tokens[s:s + seq_len] for s in starts]).astype(
+        batch = np.stack([tokens[s:s + seq_len] for s in starts]).astype(
             np.int32)
+        if vocab is not None and batch.max() >= vocab:
+            raise ValueError(
+                f"token file {path!r} has id {int(batch.max())} >= model "
+                f"vocab {vocab} — wrong tokenizer/shard for this model")
+        yield batch
 
 
 def npz_stream(path: str, batch_size: int, seed: int = 0,
@@ -73,16 +80,6 @@ def npz_stream(path: str, batch_size: int, seed: int = 0,
     if len(x) < batch_size and drop_remainder:
         raise ValueError(f"{path!r} has {len(x)} examples < batch_size "
                          f"{batch_size}")
-    epoch = 0
-    while True:
-        # seed as a sequence: default_rng([seed, epoch]) — scalar seed+epoch
-        # would collide across workers seeded by worker_id (worker 1 epoch 0
-        # == worker 0 epoch 1)
-        rng = np.random.default_rng([seed, epoch])
-        order = rng.permutation(len(x))
-        end = (len(order) // batch_size) * batch_size if drop_remainder \
-            else len(order)
-        for start in range(0, end, batch_size):
-            idx = order[start:start + batch_size]
-            yield x[idx], y[idx]
-        epoch += 1
+    from .synthetic import xy_batch_stream
+    return xy_batch_stream(x, y, batch_size, seed=seed,
+                           drop_remainder=drop_remainder)

@@ -14,6 +14,25 @@ from typing import Iterator
 import numpy as np
 
 
+def xy_batch_stream(x: np.ndarray, y: np.ndarray, batch_size: int,
+                    seed: int = 0, drop_remainder: bool = True
+                    ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Endless shuffled (x, y) batches, re-shuffled each epoch.  Epoch rngs
+    seed from the (seed, epoch) sequence so worker-id-derived seeds never
+    replay a neighbor's epoch order.  Shared by the synthetic datasets and
+    the file-backed npz loader (data/files.py)."""
+    epoch = 0
+    while True:
+        rng = np.random.default_rng([seed, epoch])
+        order = rng.permutation(len(y))
+        end = (len(order) // batch_size) * batch_size if drop_remainder \
+            else len(order)
+        for start in range(0, end, batch_size):
+            idx = order[start:start + batch_size]
+            yield x[idx], y[idx]
+        epoch += 1
+
+
 class ClassClusterDataset:
     """Gaussian class-cluster classification data (MNIST/CIFAR stand-in)."""
 
@@ -43,13 +62,8 @@ class ClassClusterDataset:
 
     def batch_stream(self, batch_size: int, seed: int = 0
                      ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        """Endless stream of batches (re-shuffles each epoch).  Epoch seeds
-        use a (seed, epoch) sequence so worker-id-derived seeds never
-        replay a neighbor's epoch order."""
-        epoch = 0
-        while True:
-            yield from self.batches(batch_size, seed=[seed, epoch])
-            epoch += 1
+        """Endless stream of batches (re-shuffles each epoch)."""
+        return xy_batch_stream(self.x, self.y, batch_size, seed=seed)
 
 
 def synthetic_mnist(num_examples: int = 4096, seed: int = 0) -> ClassClusterDataset:

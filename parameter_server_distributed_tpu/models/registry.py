@@ -71,7 +71,8 @@ def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
     from ..data.files import npz_stream, token_stream
     if file_kind == "tokens":
         batches = token_stream(data_path, batch_size,
-                               seq_len=model.config.max_seq, seed=seed)
+                               seq_len=model.config.max_seq, seed=seed,
+                               vocab=model.config.vocab)
     else:
         batches = npz_stream(data_path, batch_size, seed=seed)
     return model, batches

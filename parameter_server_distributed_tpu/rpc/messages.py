@@ -33,8 +33,12 @@ DTYPE_FLOAT64 = 1  # declared by the reference IDL, never used by its runtime
 WIRE_F32 = 0       # repeated float field 3 (reference-compatible, default)
 WIRE_RAW_F32 = 1   # raw little-endian float32 bytes in field 5
 WIRE_BF16 = 2      # raw bfloat16 bytes in field 5 — half the payload
+WIRE_INT8 = 3      # f32 max-abs scale + int8 bytes in field 5 — quarter
+                   # the payload (EQuARX-style quantized transport; pair
+                   # with error feedback for gradients — worker/worker.py)
 
-WIRE_DTYPE_NAMES = {"f32": WIRE_F32, "raw": WIRE_RAW_F32, "bf16": WIRE_BF16}
+WIRE_DTYPE_NAMES = {"f32": WIRE_F32, "raw": WIRE_RAW_F32, "bf16": WIRE_BF16,
+                    "int8": WIRE_INT8}
 
 
 _BF16 = None
@@ -72,6 +76,12 @@ class Tensor(Message):
             payload = np.ascontiguousarray(arr.reshape(-1), "<f4").tobytes()
         elif wire_dtype == WIRE_BF16:
             payload = arr.reshape(-1).astype(_bf16_dtype()).tobytes()
+        elif wire_dtype == WIRE_INT8:
+            flat = arr.reshape(-1)
+            max_abs = float(np.max(np.abs(flat))) if flat.size else 0.0
+            scale = max_abs / 127.0 if max_abs > 0 else 1.0
+            q = np.clip(np.rint(flat / scale), -127, 127).astype(np.int8)
+            payload = np.float32(scale).tobytes() + q.tobytes()
         else:
             return cls(name=name, shape=list(arr.shape),
                        data=arr.reshape(-1), dtype=DTYPE_FLOAT32)
@@ -85,6 +95,10 @@ class Tensor(Message):
         elif self.packed_dtype == WIRE_RAW_F32 and self.packed:
             arr = np.frombuffer(self.packed, dtype="<f4").astype(
                 np.float32, copy=False)
+        elif self.packed_dtype == WIRE_INT8 and self.packed:
+            scale = np.frombuffer(self.packed, dtype="<f4", count=1)[0]
+            arr = np.frombuffer(self.packed, dtype=np.int8,
+                                offset=4).astype(np.float32) * scale
         else:
             arr = np.asarray(self.data, dtype=np.float32)
         if self.shape:

@@ -120,6 +120,18 @@ class Worker:
         after a crash may not honor what the previous one did."""
         self._wire_dtype = self._requested_wire_dtype
         self._peer_packed_ok = self._wire_dtype == m.WIRE_F32
+        # int8 pushes carry quantization error forward (error feedback);
+        # residuals are per-PS-connection state
+        self._ef_residual: dict[str, np.ndarray] = {}
+
+    def _pull_wire_dtype(self) -> int:
+        """Encoding requested for served parameters.  int8 is for gradient
+        pushes only — error feedback corrects its bias push-over-push, but
+        repeatedly quantizing the *parameters* on every pull would compound
+        irrecoverable error, so int8 workers pull bf16."""
+        if self._wire_dtype == m.WIRE_INT8:
+            return m.WIRE_BF16
+        return self._wire_dtype
 
     def _register(self) -> None:
         info = m.WorkerInfo(worker_id=self.config.worker_id,
@@ -187,7 +199,7 @@ class Worker:
             lambda: self._ps.call("ServeParameters",
                                   m.PullRequest(worker_id=self.config.worker_id,
                                                 iteration=iteration,
-                                                wire_dtype=self._wire_dtype),
+                                                wire_dtype=self._pull_wire_dtype()),
                                   timeout=30.0))
         if not self._peer_packed_ok and resp.parameters:
             if any(t.packed_dtype != m.WIRE_F32 for t in resp.parameters):
@@ -207,11 +219,36 @@ class Worker:
     def push_gradients(self, iteration: int, grads: TensorStore) -> m.PushResponse:
         """reference: src/worker.cpp:254-272."""
         push_dtype = self._wire_dtype if self._peer_packed_ok else m.WIRE_F32
+        new_residual = None
+        if push_dtype == m.WIRE_INT8:
+            tensors, new_residual = self._quantize_with_feedback(grads)
+        else:
+            tensors = to_wire(grads, push_dtype)
         update = m.GradientUpdate(worker_id=self.config.worker_id,
-                                  iteration=iteration,
-                                  gradients=to_wire(grads, push_dtype))
-        return self.query_with_retry(
+                                  iteration=iteration, gradients=tensors)
+        resp = self.query_with_retry(
             lambda: self._ps.call("ReceiveGradients", update, timeout=30.0))
+        if new_residual is not None and resp.success:
+            # commit the carried error only for pushes the PS accepted — a
+            # rejected (stale) push's gradient was discarded whole, so its
+            # quantization error must not leak into the next push
+            self._ef_residual = new_residual
+        return resp
+
+    def _quantize_with_feedback(
+            self, grads: TensorStore) -> tuple[list, dict]:
+        """int8 quantization with error feedback (1-bit-SGD/EF-SGD style):
+        each push sends quantize(grad + residual) and carries the rounding
+        error into the next push, so quantization bias cancels over time
+        instead of accumulating."""
+        adjusted = {}
+        for name, g in grads.items():
+            g = np.asarray(g, np.float32)
+            prev = self._ef_residual.get(name)
+            adjusted[name] = g + prev if prev is not None else g
+        tensors = to_wire(adjusted, m.WIRE_INT8)
+        residual = {t.name: adjusted[t.name] - t.to_array() for t in tensors}
+        return tensors, residual
 
     def check_sync_ready(self, iteration: int) -> m.SyncStatusResponse:
         """reference: src/worker.cpp:274-287."""

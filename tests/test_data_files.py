@@ -124,3 +124,10 @@ def test_token_stream_final_crop_reachable(tmp_path):
     batch = next(token_stream(path, batch_size=3, seq_len=16))
     for row in batch:
         np.testing.assert_array_equal(row, tokens.astype(np.int32))
+
+
+def test_token_stream_vocab_validation(tmp_path):
+    path = str(tmp_path / "oov.bin")
+    np.full(100, 5000, dtype="<u2").tofile(path)
+    with pytest.raises(ValueError, match="wrong tokenizer"):
+        next(token_stream(path, batch_size=2, seq_len=16, vocab=1024))

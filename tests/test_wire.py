@@ -182,3 +182,18 @@ def test_pull_request_wire_dtype_default_elided():
     rt = m.PullRequest.decode(
         m.PullRequest(worker_id=1, iteration=2, wire_dtype=m.WIRE_BF16).encode())
     assert rt.wire_dtype == m.WIRE_BF16
+
+
+def test_int8_packed_quarter_bytes_and_error_bound(rng):
+    arr = rng.standard_normal((128, 64)).astype(np.float32) * 3.0
+    f32 = m.Tensor.from_array("g", arr).encode()
+    int8 = m.Tensor.from_array("g", arr, wire_dtype=m.WIRE_INT8).encode()
+    assert len(int8) < len(f32) * 0.3  # ~quarter the payload
+    rt = m.Tensor.decode(int8).to_array()
+    scale = np.abs(arr).max() / 127.0
+    assert np.abs(rt - arr).max() <= scale * 0.5 + 1e-7  # round-to-nearest
+    # zeros encode/decode cleanly (scale guard)
+    z = m.Tensor.from_array("z", np.zeros(16, np.float32),
+                            wire_dtype=m.WIRE_INT8)
+    np.testing.assert_array_equal(m.Tensor.decode(z.encode()).to_array(),
+                                  np.zeros(16, np.float32))

@@ -8,20 +8,57 @@ reported against the 45% MFU target).
 Secondary metrics (stderr): step time, grad-samples/sec/chip, and the PS
 control-plane push/pull p50 latency over real gRPC on localhost.
 
+Robustness: the tunneled TPU backend ('axon' PJRT plugin) is intermittently
+unavailable and its init can HANG rather than fail.  The top-level process
+therefore orchestrates the actual measurement in child subprocesses with
+hard wall-clock timeouts: up to PSDT_BENCH_TPU_ATTEMPTS tries on the TPU
+backend, then an explicitly-labeled CPU fallback, so a round never records
+a bare 0.0.  The final stdout is always exactly one JSON line; failures
+carry the exception text in a "note" field.
+
 Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
-(mfu | samples | pushpull; default mfu).
+(mfu | samples | pushpull | async | generate; default mfu),
+PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
+(default 2), PSDT_BENCH_CPU_TIMEOUT (s, default 420).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 
 def log(*args) -> None:
     print(*args, file=sys.stderr, flush=True)
+
+
+def _is_tpu(device) -> bool:
+    return (device.platform in ("tpu", "axon")
+            or device.device_kind.upper().startswith("TPU"))
+
+
+def _configure_platform() -> None:
+    """In a child process: pin the JAX platform before any backend init.
+
+    The session's sitecustomize registers the TPU plugin and overrides the
+    JAX_PLATFORMS env var, so forcing CPU requires jax.config (the
+    tests/conftest.py recipe).  For the TPU attempt we leave the session
+    default in place but verify post-init that a TPU actually came up, so a
+    silent host fallback can never be recorded under the TPU metric name.
+    """
+    import jax
+
+    if os.environ.get("PSDT_BENCH_PLATFORM") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return
+    device = jax.devices()[0]
+    if not _is_tpu(device):
+        raise RuntimeError(
+            f"requested TPU but backend came up as {device.platform}/"
+            f"{device.device_kind}")
 
 
 # bf16 peak FLOP/s per chip by device kind (dense)
@@ -57,7 +94,7 @@ def bench_mfu() -> dict:
     import numpy as np
 
     device = jax.devices()[0]
-    on_tpu = device.platform == "tpu"
+    on_tpu = _is_tpu(device)
     model_name = os.environ.get("PSDT_BENCH_MODEL", "")
     flops_known = not model_name  # 6*P*B holds for the dense MLP only
 
@@ -310,8 +347,9 @@ def bench_async() -> dict:
             "vs_baseline": 1.0}
 
 
-def main() -> int:
-    mode = os.environ.get("PSDT_BENCH_MODE", "mfu")
+def child_main(mode: str) -> int:
+    """Run ONE measurement in-process (called in a subprocess by main)."""
+    _configure_platform()
     try:
         if mode == "pushpull":
             result = bench_pushpull()
@@ -322,10 +360,84 @@ def main() -> int:
         else:
             result = bench_mfu()
     except Exception as exc:  # noqa: BLE001 — always emit the JSON line
-        log(f"bench failed: {exc!r}")
+        log(f"bench child failed: {exc!r}")
         result = {"metric": "bench_error", "value": 0.0, "unit": "error",
-                  "vs_baseline": 0.0}
+                  "vs_baseline": 0.0, "note": repr(exc)[:500]}
+        print(json.dumps(result), flush=True)
+        return 1
     print(json.dumps(result), flush=True)
+    return 0
+
+
+def _run_child(mode: str, platform: str, timeout_s: float) -> tuple[dict | None, str]:
+    """Launch one measurement subprocess; returns (result_json, error)."""
+    env = dict(os.environ)
+    env["PSDT_BENCH_CHILD"] = "1"
+    env["PSDT_BENCH_PLATFORM"] = platform
+    # PSDT_PLATFORM (the package-level pin, e.g. exported by
+    # scripts/test_local.sh) would defeat a TPU attempt if inherited.
+    if platform == "cpu":
+        env["PSDT_PLATFORM"] = "cpu"
+    else:
+        env.pop("PSDT_PLATFORM", None)
+    cmd = [sys.executable, os.path.abspath(__file__)]
+    log(f"bench: attempt platform={platform} timeout={timeout_s:.0f}s")
+    try:
+        proc = subprocess.run(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=None,
+            timeout=timeout_s, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, f"{platform} attempt timed out after {timeout_s:.0f}s"
+    out = proc.stdout.decode(errors="replace").strip().splitlines()
+    for line in reversed(out):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                result = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if result.get("metric") == "bench_error":
+                return None, result.get("note", "child error")
+            return result, ""
+    return None, f"{platform} child rc={proc.returncode}, no JSON emitted"
+
+
+def main() -> int:
+    """Orchestrate: TPU attempts with hard timeouts, then CPU fallback."""
+    mode = os.environ.get("PSDT_BENCH_MODE", "mfu")
+    if os.environ.get("PSDT_BENCH_CHILD"):
+        return child_main(mode)
+
+    tpu_timeout = float(os.environ.get("PSDT_BENCH_TPU_TIMEOUT", "240"))
+    cpu_timeout = float(os.environ.get("PSDT_BENCH_CPU_TIMEOUT", "420"))
+    tpu_attempts = int(os.environ.get("PSDT_BENCH_TPU_ATTEMPTS", "2"))
+
+    # Host-only benches never need the accelerator — run them on CPU
+    # directly rather than risking a flaky TPU init.
+    plans: list[tuple[str, float]]
+    if mode == "pushpull":
+        plans = [("cpu", cpu_timeout)]
+    else:
+        plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]
+
+    errors: list[str] = []
+    for i, (platform, timeout_s) in enumerate(plans):
+        if i > 0:
+            time.sleep(min(10.0 * i, 30.0))  # backoff between attempts
+        result, err = _run_child(mode, platform, timeout_s)
+        if result is not None:
+            if platform == "cpu" and errors:
+                # Honest labeling: the TPU was unavailable; this number is
+                # a host-CPU measurement, not the headline TPU metric.
+                result["metric"] = f"{result['metric']}_cpu_fallback"
+                result["note"] = "; ".join(errors)[:800]
+            print(json.dumps(result), flush=True)
+            return 0
+        errors.append(err)
+        log(f"bench: attempt failed: {err}")
+    print(json.dumps({
+        "metric": "bench_error", "value": 0.0, "unit": "error",
+        "vs_baseline": 0.0, "note": "; ".join(errors)[:1000]}), flush=True)
     return 0
 
 

@@ -19,7 +19,19 @@ parameter-server reference (araju6/parameter-server-distributed):
 Import as ``import parameter_server_distributed_tpu as pst``.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 # Keep the top-level import light: no jax import here so that control-plane
 # tooling (coordinator CLI, wire codec) can run without touching a device.
+
+import os as _os
+
+if _os.environ.get("PSDT_PLATFORM"):
+    # Opt-in platform pin.  Some environments register an accelerator PJRT
+    # plugin via sitecustomize and override the JAX_PLATFORMS env var, so
+    # the only reliable way for a subprocess (CLI worker, smoke test) to
+    # force a backend is jax.config before backend init.  Only done when
+    # explicitly requested, to keep the default import device-free.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["PSDT_PLATFORM"])

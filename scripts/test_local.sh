@@ -6,6 +6,9 @@
 # script asserts worker exit codes and grep-checks the learning signal.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# PSDT_PLATFORM pins the JAX backend in-process (reliable even where a
+# sitecustomize PJRT plugin overrides the JAX_PLATFORMS env var).
+export PSDT_PLATFORM="${PSDT_PLATFORM:-cpu}"
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 export PYTHONUNBUFFERED=1
 

@@ -240,10 +240,65 @@ def bench_pushpull() -> dict:
     pull_p50 = sorted(pull_times)[len(pull_times) // 2] * 1e3
     log(f"bench_pushpull: 1M-param store wire={wire_name} "
         f"push_p50={push_p50:.2f}ms pull_p50={pull_p50:.2f}ms")
+    _ab_host_optimizer()
     metric = ("ps_pushpull_p50" if wire_name == "f32"
               else f"ps_pushpull_p50_{wire_name}")
     return {"metric": metric, "value": round(push_p50 + pull_p50, 2),
             "unit": "ms_roundtrip", "vs_baseline": 1.0}
+
+
+def _ab_host_optimizer() -> None:
+    """A/B timing (stderr): native C++ fused optimizer kernels vs the numpy
+    fallback on the PS host update path — the kernels' production role
+    (core/optimizer.py, ps_core._apply_fused_mean_sgd)."""
+    import numpy as np
+
+    from parameter_server_distributed_tpu import native
+    from parameter_server_distributed_tpu.core.optimizer import make_optimizer
+    from parameter_server_distributed_tpu.core.ps_core import (
+        ParameterServerCore)
+
+    if native.lib() is None:
+        log("bench_ab: native lib unavailable; skipping A/B")
+        return
+    rng = np.random.default_rng(1)
+    params = {"w": rng.standard_normal((4096, 256)).astype(np.float32)}
+    grads = {"w": rng.standard_normal((4096, 256)).astype(np.float32)}
+    worker_grads = [{"w": rng.standard_normal((4096, 256)).astype(np.float32)}
+                    for _ in range(4)]
+    for opt_name in ("sgd", "momentum", "adam"):
+        times = {}
+        for enabled in (True, False):
+            native.set_enabled(enabled)
+            try:
+                opt = make_optimizer(opt_name, 0.1)
+                cur = dict(params)
+                cur = opt.apply(cur, grads)  # warm allocator / slot init
+                t0 = time.perf_counter()
+                for _ in range(10):
+                    cur = opt.apply(cur, grads)
+                times[enabled] = (time.perf_counter() - t0) / 10
+            finally:
+                native.set_enabled(True)
+        log(f"bench_ab: host {opt_name} 1M params: "
+            f"native={times[True]*1e3:.2f}ms numpy={times[False]*1e3:.2f}ms "
+            f"({times[False]/times[True]:.2f}x)")
+    times = {}
+    for enabled in (True, False):
+        native.set_enabled(enabled)
+        try:
+            ps = ParameterServerCore(total_workers=len(worker_grads))
+            ps.initialize_parameters(params)
+            t0 = time.perf_counter()
+            for it in range(1, 11):
+                for wid, g in enumerate(worker_grads):
+                    ps.receive_gradients(wid, it, g)
+            times[enabled] = (time.perf_counter() - t0) / 10
+        finally:
+            native.set_enabled(True)
+    log(f"bench_ab: barrier mean+sgd 4 workers x 1M params: "
+        f"native={times[True]*1e3:.2f}ms numpy={times[False]*1e3:.2f}ms "
+        f"({times[False]/times[True]:.2f}x)")
 
 
 def bench_generate() -> dict:
@@ -302,10 +357,14 @@ def bench_async() -> dict:
     iters = int(os.environ.get("PSDT_BENCH_STEPS", "20"))
     model = os.environ.get("PSDT_BENCH_MODEL", "mnist_mlp")
     batch = int(os.environ.get("PSDT_BENCH_BATCH", "256"))
+    # PS apply-path A/B: sgd|momentum|adam (host numpy/native C++),
+    # device_* (optax under jit), pallas_* (fused pallas kernels)
+    ps_opt = os.environ.get("PSDT_BENCH_PS_OPT", "sgd")
 
     ps = ParameterServer(ParameterServerConfig(
         bind_address="127.0.0.1", port=0, total_workers=n_workers,
-        staleness_bound=4, autosave_period_s=3600.0, checkpoint_dir="/tmp"))
+        staleness_bound=4, optimizer=ps_opt,
+        autosave_period_s=3600.0, checkpoint_dir="/tmp"))
     ps_port = ps.start()
     coordinator = Coordinator(CoordinatorConfig(
         bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",

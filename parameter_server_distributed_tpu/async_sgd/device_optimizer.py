@@ -1,14 +1,25 @@
-"""Device-resident optimizer for the async parameter server.
+"""Device-resident optimizers for the async parameter server.
 
 In bounded-staleness mode updates apply on arrival (no barrier), so the
 apply path is the PS hot loop.  The host optimizers in core/optimizer.py
 walk numpy arrays on the CPU — fine for MNIST, not for a 1B-param store.
-This optimizer keeps parameters and slots as jax Arrays on the accelerator
-and applies updates under jit with donated buffers: the PS's HBM footprint
-stays flat and the apply is one fused XLA program per push.
+These optimizers keep parameters and slots as jax Arrays on the accelerator
+and apply updates under jit, donating the optimizer slot buffers.  Params
+are deliberately NOT donated: ps_core keeps serving previously-returned
+param dicts concurrently and those may alias the apply inputs, so each
+apply transiently holds old+new param buffers (~2x the store) before the
+old copy is released.
 
-Drops into `ParameterServerCore(optimizer=...)` unchanged — it satisfies the
-HostOptimizer protocol (apply/state_dict/load_state_dict).
+Two apply backends, A/B-comparable via ``PSDT_BENCH_PS_OPT`` in bench.py:
+
+- :class:`DeviceOptimizer` — optax transformation under jit (XLA fuses it).
+- :class:`PallasOptimizer` — the hand-fused pallas kernels from
+  ops/pallas/fused_update.py (one VMEM-tiled pass per tensor).
+
+Both drop into `ParameterServerCore(optimizer=...)` unchanged — they satisfy
+the HostOptimizer protocol (apply/state_dict/load_state_dict) and are
+selected by name through `core.optimizer.make_optimizer`
+(``device_*`` / ``pallas_*``).
 """
 
 from __future__ import annotations
@@ -34,7 +45,11 @@ class DeviceOptimizer(HostOptimizer):
             updates, new_opt = self._tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), new_opt
 
-        self._apply = jax.jit(apply, donate_argnums=(0, 2))
+        # Donate the opt state (private to this object) but NOT params:
+        # ps_core keeps serving previously-returned param dicts concurrently,
+        # and under async pushes those alias the apply inputs — donating
+        # them would invalidate in-flight pull snapshots.
+        self._apply = jax.jit(apply, donate_argnums=(2,))
 
     @classmethod
     def sgd(cls, learning_rate: float = 1.0) -> "DeviceOptimizer":
@@ -83,3 +98,95 @@ class DeviceOptimizer(HostOptimizer):
                                                   np.uint8).tobytes())
         self._opt_state = jax.tree.unflatten(
             treedef, [jnp.asarray(leaf) for leaf in leaves])
+
+
+class PallasOptimizer(HostOptimizer):
+    """Device-resident PS optimizer whose apply path is the fused pallas
+    update kernels (ops/pallas/fused_update.py) instead of an optax chain.
+    One jit-compiled, buffer-donating program per rule; Adam's per-step bias
+    corrections ride in as data (SMEM scalars), so stepping never
+    recompiles."""
+
+    RULES = ("sgd", "momentum", "adam")
+
+    def __init__(self, rule: str = "sgd", learning_rate: float = 1.0,
+                 momentum: float = 0.9, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8):
+        super().__init__(learning_rate)
+        if rule not in self.RULES:
+            raise ValueError(f"unknown pallas rule {rule!r}; options {self.RULES}")
+        self.rule = rule
+        self.momentum = momentum
+        self.b1, self.b2, self.eps = b1, b2, eps
+        self._slots: dict[str, jax.Array] = {}   # vel/<n>, m/<n>, v/<n>
+        self.step = 0
+        from ..ops.pallas import fused_update as fu
+
+        # Donate slot buffers (private to this object) but NOT params — see
+        # DeviceOptimizer: served param snapshots may alias apply inputs.
+        if rule == "sgd":
+            def apply_fn(params, grads):
+                return fu.fused_sgd(params, grads, lr=learning_rate), {}
+            donate = ()
+        elif rule == "momentum":
+            def apply_fn(params, grads, velocity):
+                new_p, new_v = fu.fused_momentum(
+                    params, grads, velocity, lr=learning_rate, mu=momentum)
+                return new_p, {"vel": new_v}
+            donate = (2,)
+        else:
+            def apply_fn(params, grads, m, v, step):
+                new_p, new_m, new_v = fu.fused_adam(
+                    params, grads, m, v, step, lr=learning_rate, b1=b1,
+                    b2=b2, eps=eps)
+                return new_p, {"m": new_m, "v": new_v}
+            donate = (2, 3)
+        self._apply = jax.jit(apply_fn, donate_argnums=donate)
+
+    def apply(self, params: Mapping[str, np.ndarray],
+              grads: Mapping[str, np.ndarray]) -> dict:
+        device_params = {k: jnp.asarray(v) for k, v in params.items()}
+        device_grads = {k: jnp.asarray(np.asarray(v, np.float32))
+                        for k, v in grads.items() if k in device_params}
+        self.step += 1
+        if self.rule == "sgd":
+            new_params, _ = self._apply(device_params, device_grads)
+        elif self.rule == "momentum":
+            vel = {k: self._slots.get(f"vel/{k}")
+                   if f"vel/{k}" in self._slots
+                   else jnp.zeros(np.shape(p), jnp.float32)
+                   for k, p in device_params.items()}
+            new_params, slots = self._apply(device_params, device_grads, vel)
+            self._slots = {f"vel/{k}": v for k, v in slots["vel"].items()}
+        else:
+            # independent zero buffers per slot — both m and v are donated,
+            # so they must never alias
+            m = {k: self._slots.get(f"m/{k}")
+                 if f"m/{k}" in self._slots
+                 else jnp.zeros(np.shape(p), jnp.float32)
+                 for k, p in device_params.items()}
+            v = {k: self._slots.get(f"v/{k}")
+                 if f"v/{k}" in self._slots
+                 else jnp.zeros(np.shape(p), jnp.float32)
+                 for k, p in device_params.items()}
+            new_params, slots = self._apply(device_params, device_grads, m, v,
+                                            jnp.int32(self.step))
+            self._slots = {
+                **{f"m/{k}": x for k, x in slots["m"].items()},
+                **{f"v/{k}": x for k, x in slots["v"].items()},
+            }
+        return new_params
+
+    def state_dict(self) -> dict:
+        out = {k: np.asarray(v) for k, v in self._slots.items()
+               if v is not None}
+        if self.step:
+            out["step"] = np.asarray([self.step], np.int64)
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        state = dict(state or {})
+        step = state.pop("step", None)
+        self.step = int(np.asarray(step)[0]) if step is not None else 0
+        self._slots = {k: jnp.asarray(np.asarray(v, np.float32))
+                       for k, v in state.items()}

@@ -11,7 +11,10 @@ Argv contract mirrors the reference (reference: src/parameter_main.cpp:6-18):
 
 Extension flags beyond the reference:
     --lr=F          learning rate (default 1.0, the reference's implicit lr)
-    --optimizer=S   sgd | momentum | adam
+    --optimizer=S   sgd | momentum | adam (host numpy/native-C++), or
+                    device_{sgd,momentum,adam} (optax under jit) /
+                    pallas_{sgd,momentum,adam} (fused pallas kernels) for a
+                    device-resident store
     --staleness=N   bounded-staleness async mode (0 = synchronous)
     --elastic       barrier width follows live registrations (needs
                     --coordinator=ADDR to poll the registry)

@@ -7,6 +7,13 @@ rate here" at :87).  Here the update rule is factored out and extended with
 momentum and Adam.  These run on the PS host over numpy stores — the
 device-side SPMD train path uses optax under jit instead
 (see parallel/train_step.py).
+
+Each optimizer applies its update through the fused native C++ kernels
+(native/psdt_native.cpp — the analogue of the reference's C++ hot loop at
+src/parameter_server.cpp:40-91) when the library is available, falling back
+to numpy otherwise.  The native pass is single-sweep and GIL-free; the
+numpy path materializes one temporary per sub-op.  Outputs are always fresh
+arrays — previously served parameter copies are never mutated.
 """
 
 from __future__ import annotations
@@ -15,6 +22,7 @@ from typing import Mapping
 
 import numpy as np
 
+from ..native import adam_native, lib as native_lib, momentum_native, sgd_native
 from .tensor import TensorStore
 
 
@@ -39,9 +47,20 @@ class SGD(HostOptimizer):
 
     def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
         lr = np.float32(self.learning_rate)
-        return {name: np.asarray(p, np.float32) - lr * np.asarray(grads[name], np.float32)
-                if name in grads else np.asarray(p, np.float32)
-                for name, p in params.items()}
+        use_native = native_lib() is not None
+        out: TensorStore = {}
+        for name, p in params.items():
+            if name not in grads:
+                out[name] = np.asarray(p, np.float32)
+                continue
+            g = np.asarray(grads[name], np.float32)
+            if use_native:
+                p_new = np.array(p, np.float32)  # fresh contiguous copy
+                if sgd_native(p_new, g, float(lr)):
+                    out[name] = p_new
+                    continue
+            out[name] = np.asarray(p, np.float32) - lr * g
+        return out
 
 
 class Momentum(HostOptimizer):
@@ -53,6 +72,7 @@ class Momentum(HostOptimizer):
     def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
         lr = np.float32(self.learning_rate)
         mu = np.float32(self.momentum)
+        use_native = native_lib() is not None
         out: TensorStore = {}
         for name, p in params.items():
             p = np.asarray(p, np.float32)
@@ -60,8 +80,18 @@ class Momentum(HostOptimizer):
                 out[name] = p
                 continue
             g = np.asarray(grads[name], np.float32)
-            v = self.velocity.get(name)
-            v = mu * v + g if v is not None else g
+            v_prev = self.velocity.get(name)
+            if use_native:
+                # Fresh copies so state_dict snapshots taken earlier stay
+                # valid (the native kernel updates in place).
+                p_new = np.array(p, np.float32)
+                v_new = (np.array(v_prev, np.float32) if v_prev is not None
+                         else np.zeros_like(g))
+                if momentum_native(p_new, g, v_new, float(lr), float(mu)):
+                    self.velocity[name] = v_new
+                    out[name] = p_new
+                    continue
+            v = mu * v_prev + g if v_prev is not None else g
             self.velocity[name] = v
             out[name] = p - lr * v
         return out
@@ -88,6 +118,7 @@ class Adam(HostOptimizer):
         lr = np.float32(self.learning_rate)
         bc1 = 1.0 - self.b1 ** self.step
         bc2 = 1.0 - self.b2 ** self.step
+        use_native = native_lib() is not None
         out: TensorStore = {}
         for name, p in params.items():
             p = np.asarray(p, np.float32)
@@ -97,6 +128,17 @@ class Adam(HostOptimizer):
             g = np.asarray(grads[name], np.float32)
             m = self.m.get(name, np.zeros_like(g))
             v = self.v.get(name, np.zeros_like(g))
+            if use_native:
+                # Fresh copies so state_dict snapshots taken earlier stay
+                # valid (the native kernel updates in place).
+                p_new = np.array(p, np.float32)
+                m_new = np.array(m, np.float32)
+                v_new = np.array(v, np.float32)
+                if adam_native(p_new, g, m_new, v_new, float(lr), self.b1,
+                               self.b2, self.eps, self.step):
+                    self.m[name], self.v[name] = m_new, v_new
+                    out[name] = p_new
+                    continue
             m = b1 * m + (1 - b1) * g
             v = b2 * v + (1 - b2) * (g * g)
             self.m[name], self.v[name] = m, v
@@ -113,6 +155,10 @@ class Adam(HostOptimizer):
 
 
 def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9) -> HostOptimizer:
+    """PS optimizer by name.  Plain names (`sgd|momentum|adam`) are the
+    host-side numpy/native-C++ optimizers above; `device_*` selects the
+    accelerator-resident optax path and `pallas_*` the fused pallas-kernel
+    path (async_sgd/device_optimizer.py)."""
     name = name.lower()
     if name == "sgd":
         return SGD(learning_rate)
@@ -120,4 +166,15 @@ def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9) -> Ho
         return Momentum(learning_rate, momentum)
     if name == "adam":
         return Adam(learning_rate)
+    if name.startswith("device_") or name.startswith("pallas_"):
+        kind, _, rule = name.partition("_")
+        from ..async_sgd.device_optimizer import DeviceOptimizer, PallasOptimizer
+        if kind == "pallas":
+            return PallasOptimizer(rule, learning_rate, momentum)
+        if rule == "sgd":
+            return DeviceOptimizer.sgd(learning_rate)
+        if rule == "momentum":
+            return DeviceOptimizer.momentum(learning_rate, momentum)
+        if rule == "adam":
+            return DeviceOptimizer.adam(learning_rate)
     raise ValueError(f"unknown optimizer {name!r}")

@@ -201,8 +201,9 @@ class ParameterServerCore:
         _state_lock.  Returns the contributor count."""
         received = len(state.worker_gradients)
         if not state.aggregated and received >= total and received > 0:
-            mean = _mean_over_workers(state.worker_gradients)
-            self._apply_update(mean)
+            if not self._apply_fused_mean_sgd(state.worker_gradients):
+                mean = _mean_over_workers(state.worker_gradients)
+                self._apply_update(mean)
             state.aggregated = True
             state.workers_at_aggregation = received
             state.worker_gradients.clear()  # free gradient memory promptly
@@ -253,6 +254,44 @@ class ParameterServerCore:
     def applied_updates(self) -> int:
         """Async mode: number of updates applied (the PS version counter)."""
         return self._applied_updates
+
+    def _apply_fused_mean_sgd(self, worker_gradients: Mapping[int, TensorStore]) -> bool:
+        """Single-sweep native mean+SGD barrier apply (psdt_mean_sgd —
+        native/psdt_native.cpp): `param -= lr * mean(worker grads)` without
+        materializing the mean, mirroring the reference's fused C++
+        aggregation loop (src/parameter_server.cpp:40-91).  Returns False —
+        requesting the generic mean-then-optimizer path — for non-SGD
+        optimizers, an uninitialized store (bootstrap needs the mean itself),
+        or when the native library is unavailable.  Caller holds _state_lock.
+        """
+        from ..native import lib, mean_sgd_native
+
+        if type(self._optimizer) is not SGD or lib() is None:
+            return False
+        by_name: dict[str, list[np.ndarray]] = {}
+        for grads in worker_gradients.values():
+            for name, g in grads.items():
+                by_name.setdefault(name, []).append(
+                    np.ascontiguousarray(g, np.float32))
+        lr = float(self._optimizer.learning_rate)
+        with self._params_lock:
+            if not self._params:
+                return False
+            new_params: TensorStore = {}
+            for name, p in self._params.items():
+                arrays = by_name.get(name)
+                if not arrays:
+                    new_params[name] = np.asarray(p, np.float32)
+                    continue
+                p_new = np.array(p, np.float32)  # fresh contiguous copy
+                if not mean_sgd_native(p_new, arrays, lr):
+                    acc = arrays[0].copy()
+                    for g in arrays[1:]:
+                        acc += g
+                    p_new = p_new - np.float32(lr / len(arrays)) * acc
+                new_params[name] = p_new
+            self._params = new_params
+        return True
 
     def _apply_update(self, mean_grads: TensorStore) -> None:
         with self._params_lock:

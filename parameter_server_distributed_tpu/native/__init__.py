@@ -5,6 +5,15 @@ first use (cached under native/build/).  Every consumer must handle
 ``lib() is None`` (no compiler available) by falling back to numpy — the
 framework is fully functional without the native path, just slower on the
 host-side PS hot loops.
+
+Production callers (reference analogue: the C++ aggregation + SGD hot loop
+at src/parameter_server.cpp:40-91):
+
+- core/optimizer.py — SGD / Momentum / Adam host optimizers
+- core/ps_core.py — fused barrier mean+SGD (`psdt_mean_sgd`)
+
+Set ``PSDT_NATIVE=0`` (or call :func:`set_enabled`) to force the numpy
+fallback — the bench A/B knob.
 """
 
 from __future__ import annotations
@@ -55,21 +64,23 @@ def _bind(path: str) -> ctypes.CDLL:
     lib.psdt_adam.argtypes = [_F32P, _F32P, _F32P, _F32P, i64, f32, f32, f32,
                               f32, f32, f32]
     lib.psdt_mean_sgd.argtypes = [_F32P, pp, i32, i64, f32]
-    lib.psdt_pack_floats.argtypes = [_F32P, i64,
-                                     ctypes.POINTER(ctypes.c_uint8)]
-    lib.psdt_pack_floats.restype = i64
-    lib.psdt_varint_encode.argtypes = [ctypes.c_uint64,
-                                       ctypes.POINTER(ctypes.c_uint8)]
-    lib.psdt_varint_encode.restype = i32
-    lib.psdt_varint_decode.argtypes = [ctypes.POINTER(ctypes.c_uint8), i64,
-                                       ctypes.POINTER(ctypes.c_uint64)]
-    lib.psdt_varint_decode.restype = i32
     return lib
 
 
+_enabled = os.environ.get("PSDT_NATIVE", "1").lower() not in ("0", "false")
+
+
+def set_enabled(value: bool) -> None:
+    """Enable/disable the native path at runtime (bench A/B knob)."""
+    global _enabled
+    _enabled = bool(value)
+
+
 def lib() -> ctypes.CDLL | None:
-    """The bound native library, or None if unavailable."""
+    """The bound native library, or None if unavailable/disabled."""
     global _lib, _tried
+    if not _enabled:
+        return None
     if _lib is not None or _tried:
         return _lib
     with _lock:
@@ -132,4 +143,44 @@ def mean_sgd_native(param: np.ndarray, grads: list[np.ndarray],
     ptrs = (_F32P * len(contig))(*[_fptr(c) for c in contig])
     native.psdt_mean_sgd(_fptr(param), ptrs, len(contig), param.size,
                          ctypes.c_float(lr))
+    return True
+
+
+def momentum_native(param: np.ndarray, grad: np.ndarray,
+                    velocity: np.ndarray, lr: float, mu: float) -> bool:
+    """In-place fused velocity = mu*velocity + grad; param -= lr*velocity.
+    Both param and velocity are updated in place."""
+    native = lib()
+    if (native is None
+            or param.dtype != np.float32 or not param.flags.c_contiguous
+            or velocity.dtype != np.float32
+            or not velocity.flags.c_contiguous
+            or param.shape != np.shape(grad)
+            or param.shape != velocity.shape):
+        return False
+    grad_c = np.ascontiguousarray(grad, np.float32)
+    native.psdt_momentum(_fptr(param), _fptr(grad_c), _fptr(velocity),
+                         param.size, ctypes.c_float(lr), ctypes.c_float(mu))
+    return True
+
+
+def adam_native(param: np.ndarray, grad: np.ndarray, m: np.ndarray,
+                v: np.ndarray, lr: float, b1: float, b2: float, eps: float,
+                step: int) -> bool:
+    """In-place fused Adam pass (param, m, v all updated in place); ``step``
+    is the 1-based update count used for bias correction."""
+    native = lib()
+    arrays = (param, m, v)
+    if (native is None or step < 1
+            or any(a.dtype != np.float32 or not a.flags.c_contiguous
+                   for a in arrays)
+            or param.shape != np.shape(grad)
+            or any(a.shape != param.shape for a in (m, v))):
+        return False
+    grad_c = np.ascontiguousarray(grad, np.float32)
+    native.psdt_adam(_fptr(param), _fptr(grad_c), _fptr(m), _fptr(v),
+                     param.size, ctypes.c_float(lr), ctypes.c_float(b1),
+                     ctypes.c_float(b2), ctypes.c_float(eps),
+                     ctypes.c_float(1.0 - b1 ** step),
+                     ctypes.c_float(1.0 - b2 ** step))
     return True

@@ -7,16 +7,14 @@
 // RPC-fed) still sums worker gradients and applies updates on the CPU.
 // These kernels do that GIL-free (callers release the GIL via ctypes), with
 // a fused single pass per tensor instead of numpy temporaries per operand.
+// Production callers: core/optimizer.py (SGD/Momentum/Adam host optimizers)
+// and core/ps_core.py (fused barrier mean+SGD apply).
 //
-// Also: a proto3 packed-float codec helper used by the wire layer for
-// zero-copy float packing (proto/parameter_server.proto:22 `repeated float
-// data` is a length-delimited little-endian blob).
-//
-// Build: native/build.py (g++ -O3 -shared), loaded via ctypes with a pure
-// Python/numpy fallback when no compiler is available.
+// Build: native/__init__.py (g++ -O3 -shared), loaded via ctypes with a
+// pure Python/numpy fallback when no compiler is available.  Disable with
+// PSDT_NATIVE=0 (the bench A/B knob).
 
 #include <cstdint>
-#include <cstring>
 
 extern "C" {
 
@@ -77,46 +75,6 @@ void psdt_mean_sgd(float* param, const float** srcs, int32_t count,
         for (int32_t w = 1; w < count; ++w) acc += srcs[w][i];
         param[i] -= scale * acc;
     }
-}
-
-// --------------------------------------------------------------------------
-// proto3 varint + packed-float helpers (wire layer fast path)
-// --------------------------------------------------------------------------
-
-// Encode a varint; returns bytes written (buffer must have >= 10 bytes).
-int32_t psdt_varint_encode(uint64_t value, uint8_t* out) {
-    int32_t i = 0;
-    while (value >= 0x80) {
-        out[i++] = static_cast<uint8_t>(value) | 0x80;
-        value >>= 7;
-    }
-    out[i++] = static_cast<uint8_t>(value);
-    return i;
-}
-
-// Decode a varint; writes value, returns bytes consumed (0 on error).
-int32_t psdt_varint_decode(const uint8_t* buf, const int64_t len,
-                           uint64_t* value) {
-    uint64_t result = 0;
-    int32_t shift = 0;
-    for (int32_t i = 0; i < len && i < 10; ++i) {
-        result |= static_cast<uint64_t>(buf[i] & 0x7F) << shift;
-        if (!(buf[i] & 0x80)) {
-            *value = result;
-            return i + 1;
-        }
-        shift += 7;
-    }
-    return 0;
-}
-
-// Write the length-delimited packed-float field body (field tag handled by
-// caller): varint(byte length) + raw LE floats.  Returns bytes written.
-int64_t psdt_pack_floats(const float* data, const int64_t n, uint8_t* out) {
-    const int64_t payload = n * 4;
-    int32_t header = psdt_varint_encode(static_cast<uint64_t>(payload), out);
-    std::memcpy(out + header, data, static_cast<size_t>(payload));
-    return header + payload;
 }
 
 }  // extern "C"

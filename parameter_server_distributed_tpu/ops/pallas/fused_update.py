@@ -6,9 +6,14 @@ becomes one pallas pass per tensor: read param/grad (and slots), write the
 updated values, all in VMEM-resident tiles — no intermediate HBM
 round-trips between optimizer sub-ops.
 
-Hyperparameters (lr, betas, ...) are compile-time constants baked into the
-kernel (they change at most a handful of times per run; each distinct value
-costs one recompile and zero per-step scalar traffic).
+Production caller: async_sgd.PallasOptimizer (the device-resident PS
+optimizer selected via ``optimizer=pallas_sgd|pallas_momentum|pallas_adam``)
+— see async_sgd/device_optimizer.py.
+
+Hyperparameters that are constant for a run (lr, betas, eps) are
+compile-time constants baked into the kernel; Adam's per-step bias
+corrections change every update, so they enter as SMEM scalars — zero
+recompiles across steps.
 
 Arrays are processed as (rows, 128) tiles (padded as needed).  On non-TPU
 backends kernels run in interpret mode so the same code path is tested on
@@ -24,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
 SUBLANE = 8
@@ -44,9 +50,11 @@ def _momentum_kernel(p_ref, g_ref, vel_ref, p_out, vel_out, *, lr: float,
     p_out[:] = p_ref[:] - lr * v_new
 
 
-def _adam_kernel(p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out, *,
-                 lr: float, b1: float, b2: float, eps: float, bc1: float,
-                 bc2: float):
+def _adam_kernel(bc_ref, p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out, *,
+                 lr: float, b1: float, b2: float, eps: float):
+    # bc_ref (SMEM) holds the per-step bias corrections [1-b1^t, 1-b2^t] so
+    # the kernel compiles once per shape, not once per step.
+    bc1, bc2 = bc_ref[0], bc_ref[1]
     g = g_ref[:]
     m_new = b1 * m_ref[:] + (1.0 - b1) * g
     v_new = b2 * v_ref[:] + (1.0 - b2) * g * g
@@ -70,16 +78,21 @@ def _from_tiles(tiles: jax.Array, n: int, shape, dtype) -> jax.Array:
 
 
 def _run(kernel, arrays: list[jax.Array], num_outputs: int,
-         interpret: bool) -> list[jax.Array]:
+         interpret: bool, scalars: jax.Array | None = None) -> list[jax.Array]:
     rows = arrays[0].shape[0]
     block = pl.BlockSpec((rows, LANE), lambda: (0, 0))
+    in_specs = [block] * len(arrays)
+    operands = list(arrays)
+    if scalars is not None:
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
+        operands = [scalars] + operands
     out = pl.pallas_call(
         kernel,
         out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)] * num_outputs,
-        in_specs=[block] * len(arrays),
+        in_specs=in_specs,
         out_specs=[block] * num_outputs,
         interpret=interpret,
-    )(*arrays)
+    )(*operands)
     return list(out)
 
 
@@ -124,15 +137,18 @@ def fused_momentum(params: Mapping[str, jax.Array],
 def fused_adam(params: Mapping[str, jax.Array],
                grads: Mapping[str, jax.Array],
                m: Mapping[str, jax.Array], v: Mapping[str, jax.Array],
-               step: int, lr: float = 1e-3, b1: float = 0.9,
+               step: int | jax.Array, lr: float = 1e-3, b1: float = 0.9,
                b2: float = 0.999, eps: float = 1e-8,
                interpret: bool | None = None):
-    """Fused Adam: returns (new_params, new_m, new_v)."""
+    """Fused Adam: returns (new_params, new_m, new_v).  ``step`` (1-based)
+    may be a Python int or a traced scalar — bias corrections enter the
+    kernel as SMEM data, so stepping never recompiles."""
     interpret = _interpret_default() if interpret is None else interpret
-    kernel = functools.partial(
-        _adam_kernel, lr=float(lr), b1=float(b1), b2=float(b2),
-        eps=float(eps), bc1=float(1.0 - b1 ** step),
-        bc2=float(1.0 - b2 ** step))
+    kernel = functools.partial(_adam_kernel, lr=float(lr), b1=float(b1),
+                               b2=float(b2), eps=float(eps))
+    step_f = jnp.asarray(step, jnp.float32)
+    bc = jnp.stack([1.0 - jnp.float32(b1) ** step_f,
+                    1.0 - jnp.float32(b2) ** step_f])
     new_p, new_m, new_v = {}, {}, {}
     for name, p in params.items():
         if name not in grads:
@@ -140,7 +156,7 @@ def fused_adam(params: Mapping[str, jax.Array],
             continue
         tiles = [_as_tiles(x) for x in (p, grads[name], m[name], v[name])]
         n = tiles[0][1]
-        res = _run(kernel, [t for t, _ in tiles], 3, interpret)
+        res = _run(kernel, [t for t, _ in tiles], 3, interpret, scalars=bc)
         new_p[name] = _from_tiles(res[0], n, np.shape(p), p.dtype)
         new_m[name] = _from_tiles(res[1], n, np.shape(p), jnp.float32)
         new_v[name] = _from_tiles(res[2], n, np.shape(p), jnp.float32)

@@ -1,7 +1,5 @@
 """Native C++ kernel tests (skipped when g++ is unavailable)."""
 
-import ctypes
-
 import numpy as np
 import pytest
 
@@ -44,27 +42,85 @@ def test_native_rejects_unsuitable_inputs(rng):
     assert native.mean_over_workers_native([]) is None
 
 
-def test_native_varint_roundtrip():
-    lib = native.lib()
-    buf = (ctypes.c_uint8 * 10)()
-    for value in [0, 1, 127, 128, 300, 2**32, 2**64 - 1]:
-        n = lib.psdt_varint_encode(ctypes.c_uint64(value), buf)
-        out = ctypes.c_uint64()
-        consumed = lib.psdt_varint_decode(buf, 10, ctypes.byref(out))
-        assert consumed == n and out.value == value
+def test_native_momentum_matches_numpy(rng):
+    p = rng.standard_normal(513).astype(np.float32)
+    g = rng.standard_normal(513).astype(np.float32)
+    v = rng.standard_normal(513).astype(np.float32)
+    expect_v = 0.9 * v + g
+    expect_p = p - 0.05 * expect_v
+    assert native.momentum_native(p, g, v, 0.05, 0.9)
+    np.testing.assert_allclose(v, expect_v, rtol=1e-6)
+    np.testing.assert_allclose(p, expect_p, rtol=1e-5, atol=1e-6)
 
 
-def test_native_pack_floats_wire_compatible(rng):
-    """Native packed-float body == the Python wire codec's encoding."""
-    from parameter_server_distributed_tpu.rpc import wire
-    lib = native.lib()
-    data = rng.standard_normal(100).astype(np.float32)
-    out = (ctypes.c_uint8 * (data.nbytes + 10))()
-    n = lib.psdt_pack_floats(
-        data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), data.size, out)
-    native_bytes = bytes(out[:n])
-    expected = wire.encode_varint(data.nbytes) + data.tobytes()
-    assert native_bytes == expected
+def test_native_adam_matches_numpy(rng):
+    p = rng.standard_normal(257).astype(np.float32)
+    g = rng.standard_normal(257).astype(np.float32)
+    m = rng.standard_normal(257).astype(np.float32) * 0.1
+    v = np.abs(rng.standard_normal(257)).astype(np.float32) * 0.1
+    step, lr, b1, b2, eps = 3, 1e-3, 0.9, 0.999, 1e-8
+    em = b1 * m + (1 - b1) * g
+    ev = b2 * v + (1 - b2) * g * g
+    ep = p - lr * (em / (1 - b1**step)) / (np.sqrt(ev / (1 - b2**step)) + eps)
+    assert native.adam_native(p, g, m, v, lr, b1, b2, eps, step)
+    np.testing.assert_allclose(m, em, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(v, ev, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(p, ep, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["sgd", "momentum", "adam"])
+def test_host_optimizer_native_and_numpy_paths_agree(rng, name):
+    """Multi-step optimizer trajectories must be identical (to f32 tolerance)
+    with the native path on and off — the bench A/B contract."""
+    from parameter_server_distributed_tpu.core.optimizer import make_optimizer
+
+    params = {"w": rng.standard_normal((17, 9)).astype(np.float32),
+              "b": rng.standard_normal(23).astype(np.float32)}
+    grad_seq = [{"w": rng.standard_normal((17, 9)).astype(np.float32),
+                 "b": rng.standard_normal(23).astype(np.float32)}
+                for _ in range(4)]
+    results = {}
+    for enabled in (True, False):
+        native.set_enabled(enabled)
+        try:
+            opt = make_optimizer(name, 0.1)
+            cur = dict(params)
+            for grads in grad_seq:
+                cur = opt.apply(cur, grads)
+            results[enabled] = cur
+        finally:
+            native.set_enabled(True)
+    for key in params:
+        np.testing.assert_allclose(results[True][key], results[False][key],
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_ps_core_fused_mean_sgd_agrees_with_numpy_path(rng):
+    """The sync barrier (fused psdt_mean_sgd apply) must produce the same
+    parameters with the native path on and off."""
+    from parameter_server_distributed_tpu.core.optimizer import SGD
+    from parameter_server_distributed_tpu.core.ps_core import ParameterServerCore
+
+    init = {"w": rng.standard_normal(128).astype(np.float32)}
+    grads = [{"w": rng.standard_normal(128).astype(np.float32)}
+             for _ in range(3)]
+    results = {}
+    for enabled in (True, False):
+        native.set_enabled(enabled)
+        try:
+            ps = ParameterServerCore(total_workers=3,
+                                     optimizer=SGD(learning_rate=0.5))
+            ps.initialize_parameters(init)
+            for wid, g in enumerate(grads):
+                ps.receive_gradients(wid, 1, g)
+            results[enabled] = ps.get_parameters()
+        finally:
+            native.set_enabled(True)
+    np.testing.assert_allclose(results[True]["w"], results[False]["w"],
+                               rtol=1e-5, atol=1e-6)
+    expect = init["w"] - 0.5 * np.mean([g["w"] for g in grads], axis=0)
+    np.testing.assert_allclose(results[True]["w"], expect, rtol=1e-5,
+                               atol=1e-6)
 
 
 def test_ps_core_native_mean_agrees_with_numpy_path(rng):

@@ -51,15 +51,21 @@ def token_stream(path: str, batch_size: int, seq_len: int,
             f"seq_len = {seq_len}")
     rng = np.random.default_rng(seed)
     high = tokens.size - seq_len + 1  # inclusive of the final full crop
+    # Validate BEFORE the int32 conversion: a corrupt/mismatched shard with
+    # ids >= 2^31 would wrap negative under astype and then clamp silently
+    # inside the jitted embedding lookup — the exact failure this check
+    # exists to catch.
+    limit = vocab if vocab is not None else np.int64(1) << 31
     while True:
         starts = rng.integers(0, high, size=batch_size)
-        batch = np.stack([tokens[s:s + seq_len] for s in starts]).astype(
-            np.int32)
-        if vocab is not None and batch.max() >= vocab:
+        batch = np.stack([tokens[s:s + seq_len] for s in starts])
+        if batch.max() >= limit:
+            what = (f"model vocab {vocab}" if vocab is not None
+                    else "int32 range")
             raise ValueError(
-                f"token file {path!r} has id {int(batch.max())} >= model "
-                f"vocab {vocab} — wrong tokenizer/shard for this model")
-        yield batch
+                f"token file {path!r} has id {int(batch.max())} >= {what} "
+                f"— wrong tokenizer/shard for this model")
+        yield batch.astype(np.int32)
 
 
 def npz_stream(path: str, batch_size: int, seed: int = 0,

@@ -27,6 +27,8 @@ are token-exact.
 from __future__ import annotations
 
 import dataclasses
+import threading
+from collections import OrderedDict
 from typing import Mapping
 
 import jax
@@ -129,13 +131,21 @@ def sample_token(logits: Array, rng: Array, temperature: float = 0.0,
 # Compiled runner cache: one jitted wrapper per (model, generation config);
 # the closure keeps the model alive, so its id cannot be reused while the
 # entry exists.  jax.jit's own cache then handles distinct prompt shapes.
-_RUNNERS: dict[tuple, object] = {}
+# Bounded LRU: a long-lived service sweeping generation settings would
+# otherwise pin compiled executables (and their models) for process
+# lifetime.  Lock-guarded — concurrent generate() calls share the cache.
+_RUNNERS: "OrderedDict[tuple, object]" = OrderedDict()
+_RUNNERS_MAX = 32
+_RUNNERS_LOCK = threading.Lock()
 
 
 def _runner(model: Transformer, max_new_tokens: int, temperature: float,
             top_k: int):
     key = (id(model), max_new_tokens, temperature, top_k)
-    run = _RUNNERS.get(key)
+    with _RUNNERS_LOCK:
+        run = _RUNNERS.get(key)
+        if run is not None:
+            _RUNNERS.move_to_end(key)
     if run is None:
         @jax.jit
         def run(params, prompt, rng):
@@ -155,7 +165,10 @@ def _runner(model: Transformer, max_new_tokens: int, temperature: float,
                 body, (first, cache, rng), None, length=max_new_tokens)
             return jnp.swapaxes(tokens, 0, 1)      # [B, max_new]
 
-        _RUNNERS[key] = run
+        with _RUNNERS_LOCK:
+            _RUNNERS[key] = run
+            while len(_RUNNERS) > _RUNNERS_MAX:
+                _RUNNERS.popitem(last=False)
     return run
 
 

@@ -71,7 +71,14 @@ class Tensor(Message):
     @classmethod
     def from_array(cls, name: str, array: np.ndarray,
                    wire_dtype: int = WIRE_F32) -> "Tensor":
-        arr = np.asarray(array, dtype=np.float32)
+        # float64 inputs are marked dtype=1 (the reference IDL's declared
+        # float64 — proto/parameter_server.proto:23) but still ride the
+        # wire as `repeated float`, exactly as a reference peer would emit
+        # them (its tensor struct stores vector<float> regardless of dtype).
+        src = np.asarray(array)
+        dtype_tag = (DTYPE_FLOAT64 if src.dtype == np.float64
+                     else DTYPE_FLOAT32)
+        arr = src.astype(np.float32, copy=False)  # zero-copy for f32 input
         if wire_dtype == WIRE_RAW_F32:
             payload = np.ascontiguousarray(arr.reshape(-1), "<f4").tobytes()
         elif wire_dtype == WIRE_BF16:
@@ -84,8 +91,8 @@ class Tensor(Message):
             payload = np.float32(scale).tobytes() + q.tobytes()
         else:
             return cls(name=name, shape=list(arr.shape),
-                       data=arr.reshape(-1), dtype=DTYPE_FLOAT32)
-        return cls(name=name, shape=list(arr.shape), dtype=DTYPE_FLOAT32,
+                       data=arr.reshape(-1), dtype=dtype_tag)
+        return cls(name=name, shape=list(arr.shape), dtype=dtype_tag,
                    packed=payload, packed_dtype=wire_dtype)
 
     def to_array(self) -> np.ndarray:
@@ -101,6 +108,15 @@ class Tensor(Message):
                                 offset=4).astype(np.float32) * scale
         else:
             arr = np.asarray(self.data, dtype=np.float32)
+        if self.dtype == DTYPE_FLOAT64:
+            # honor the reference IDL's declared float64 tag: upcast so a
+            # dtype=1 tensor round-trips at the precision the sender marked
+            # (wire payload itself is float-precision, as in the reference)
+            arr = arr.astype(np.float64)
+        if not arr.flags.writeable:
+            # decode paths can yield frombuffer views (zero-copy); callers
+            # get writable arrays so in-place aggregation works uniformly
+            arr = arr.copy()
         if self.shape:
             arr = arr.reshape(self.shape)
         return arr

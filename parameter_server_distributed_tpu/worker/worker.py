@@ -214,6 +214,22 @@ class Worker:
                     self.config.wire_dtype)
                 self._wire_dtype = m.WIRE_F32
                 self._peer_packed_ok = True
+        elif self._peer_packed_ok and self._wire_dtype != m.WIRE_F32:
+            # Negotiation was proven against the PREVIOUS process at this
+            # address.  A PS that crashed and restarted is reached again via
+            # transparent gRPC channel reconnection — never re-entering
+            # _discover_parameter_server — so stale proof must be dropped
+            # whenever a pull stops looking packed: an empty pull (restarted
+            # PS lost its store; our next push may seed it and must not be
+            # quantized) or a non-empty pull served entirely unpacked (a
+            # replacement PS that ignores the extension would silently see
+            # empty gradients in our packed pushes).
+            if not resp.parameters or all(
+                    t.packed_dtype == m.WIRE_F32 for t in resp.parameters):
+                log.warning(
+                    "worker %d: pull no longer packed (PS restart?), "
+                    "re-negotiating wire encoding", self.config.worker_id)
+                self._reset_wire_negotiation()
         return resp.iteration, from_wire(resp.parameters)
 
     def push_gradients(self, iteration: int, grads: TensorStore) -> m.PushResponse:

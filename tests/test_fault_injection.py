@@ -319,3 +319,51 @@ def test_packed_wire_renegotiated_after_ps_replacement(tmp_path):
         coordinator.stop()
         if ps2 is not None:
             ps2.stop()
+
+
+def test_packed_wire_renegotiated_after_same_address_restart(tmp_path):
+    """A PS restarted at the SAME address is reached via transparent gRPC
+    channel reconnection — the worker never re-runs discovery — so proven
+    packed negotiation must be dropped as soon as a pull stops looking
+    packed.  Here the restarted PS comes back EMPTY: the worker's next push
+    seeds the store and must be full-precision f32, not bf16-quantized."""
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1", ps_port=1,
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    ps = make_ps(tmp_path, coordinator)
+    ps_port = ps.start()
+    coordinator.core.set_parameter_server_address("127.0.0.1", ps_port)
+    w = ps2 = None
+    try:
+        w = make_worker(coord_port, 0, wire_dtype="bf16")
+        for it in range(2):
+            w.run_iteration(it)
+        assert w._peer_packed_ok and w._wire_dtype != 0  # negotiated packed
+        ps.stop()
+
+        # restart EMPTY at the same port; worker keeps its channel
+        ps2 = make_ps(tmp_path, coordinator, port=ps_port)
+        seen_encodings = []
+        orig_recv = type(ps2.service).ReceiveGradients
+
+        def recording_recv(request, context):
+            seen_encodings.extend(t.packed_dtype for t in request.gradients)
+            return orig_recv(ps2.service, request, context)
+
+        ps2.service.ReceiveGradients = recording_recv
+        ps2.start()
+
+        # NO w.reconnect(): the stale negotiation must self-heal on pull
+        w.run_iteration(3)  # bootstrap iterations return NaN by design
+        assert w.last_bootstrap  # the restarted PS was empty and got seeded
+        assert seen_encodings and all(e == 0 for e in seen_encodings), (
+            f"bootstrap push after PS restart was packed: {seen_encodings}")
+        # params seeded at full precision on the new PS
+        assert ps2.core.get_parameters()
+    finally:
+        if w is not None:
+            w.shutdown()
+        coordinator.stop()
+        if ps2 is not None:
+            ps2.stop()

@@ -197,3 +197,31 @@ def test_int8_packed_quarter_bytes_and_error_bound(rng):
                             wire_dtype=m.WIRE_INT8)
     np.testing.assert_array_equal(m.Tensor.decode(z.encode()).to_array(),
                                   np.zeros(16, np.float32))
+
+
+def test_float64_dtype_tag_roundtrip(rng):
+    """The reference IDL declares dtype=1 float64 (proto:23) while carrying
+    data as `repeated float`; from_array marks float64 inputs and to_array
+    honors the tag by upcasting, so a dtype=1 tensor round-trips at the
+    declared dtype instead of being silently retyped float32."""
+    arr = rng.standard_normal((4, 3))  # float64
+    t = m.Tensor.from_array("w", arr)
+    assert t.dtype == m.DTYPE_FLOAT64
+    rt = m.Tensor.decode(t.encode())
+    out = rt.to_array()
+    assert out.dtype == np.float64
+    np.testing.assert_allclose(out, arr, rtol=1e-6)  # f32 wire precision
+    # float32 input keeps dtype=0 and decodes float32
+    t32 = m.Tensor.from_array("w", arr.astype(np.float32))
+    assert t32.dtype == m.DTYPE_FLOAT32
+    assert m.Tensor.decode(t32.encode()).to_array().dtype == np.float32
+
+
+def test_raw_f32_decode_is_writable(rng):
+    """Every decode path returns a writable array (frombuffer views are
+    read-only; in-place aggregation must work on any encoding)."""
+    arr = rng.standard_normal(32).astype(np.float32)
+    for wd in (m.WIRE_F32, m.WIRE_RAW_F32, m.WIRE_BF16, m.WIRE_INT8):
+        out = m.Tensor.decode(
+            m.Tensor.from_array("w", arr, wire_dtype=wd).encode()).to_array()
+        out += 1.0  # raises on read-only arrays

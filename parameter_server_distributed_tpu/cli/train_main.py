@@ -7,6 +7,16 @@
         --mesh=data:2,fsdp:2,tensor:2 --ckpt-dir=/tmp/ckpt --ckpt-every=50 \
         --resume --metrics=/tmp/metrics.jsonl
 
+``--attention=dense|flash|ring|ulysses`` selects the attention
+implementation for transformer models: flash = pallas kernels (shard_mapped
+over batch/head shards when the mesh is >1 device), ring/ulysses = sequence
+parallelism over the mesh's seq axis (pair with --mesh=seq:N).
+
+``--mesh=pipe:P`` trains transformer models with GPipe pipeline
+parallelism (parallel/pipeline.py): layer blocks live on their pipe rank,
+microbatches stream through; ``--microbatches=M`` sets the schedule depth
+(default P).  Requires n_layers divisible by P; combine with data:N.
+
 ``--data`` switches from synthetic loaders to file-backed data
 (data/files.py): a token shard (.bin/.u32 memmap) for LM models, an npz
 with x/y arrays otherwise.
@@ -64,6 +74,8 @@ def main(argv: list[str] | None = None) -> int:
         model=flags.get("model", "mnist_mlp"),
         batch_size=int(flags.get("batch", 64)),
         data_path=flags.get("data", ""),
+        attention=flags.get("attention", "dense"),
+        microbatches=int(flags.get("microbatches", 0)),
         steps=int(flags.get("steps", 100)),
         optimizer=flags.get("optimizer", "adam"),
         learning_rate=float(flags.get("lr", 1e-3)),

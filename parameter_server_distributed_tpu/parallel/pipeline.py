@@ -15,7 +15,7 @@ analogue (the reference has no model layer at all; SURVEY.md §1).
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable
+from typing import Callable, Mapping
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,135 @@ def stack_stage_params(per_stage_params: list[dict], mesh: Mesh) -> dict:
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(place, stacked)
+
+
+class PipelinedTransformerLM:
+    """A Transformer LM trained with pipeline parallelism over ``pipe``.
+
+    Layer blocks are stacked ``[P, L/P, ...]`` and sharded over the pipe
+    axis (stage s holds layers s*L/P .. (s+1)*L/P-1); activations stream
+    through :func:`pipeline_apply`'s GPipe schedule.  The embedding and LM
+    head run OUTSIDE the pipeline, replicated over ``pipe`` — that lifts
+    the shape-preserving restriction to the full embed -> blocks -> head
+    model while keeping the pipelined middle shape-preserving, which is
+    what the schedule requires.
+
+    Drop-in for the plain Transformer in ShardedTrainer/run_training:
+    exposes ``config``, ``init_params``, ``num_params``, ``loss``.
+    Gradients are exact (ppermute differentiates to the reverse rotation),
+    so a pipelined run matches the non-pipelined model step for step —
+    verified in tests/test_pipeline.py.
+    """
+
+    BLOCK_PREFIX = "blocks/"
+    _STAGE_KEY = "blk"  # reuse Transformer block methods with this prefix
+
+    def __init__(self, inner, mesh: Mesh, num_microbatches: int = 0):
+        from ..models.transformer import Transformer
+
+        if not isinstance(inner, Transformer):
+            raise ValueError("pipeline parallelism wraps a Transformer LM")
+        if inner.config.moe_every > 0:
+            raise ValueError("pipeline + MoE is not supported yet")
+        n_pipe = mesh.shape["pipe"]
+        if inner.config.n_layers % n_pipe:
+            raise ValueError(
+                f"n_layers={inner.config.n_layers} must divide by the "
+                f"pipe axis ({n_pipe})")
+        self.inner = inner
+        self.config = inner.config
+        self.mesh = mesh
+        self.n_pipe = n_pipe
+        self.layers_per_stage = inner.config.n_layers // n_pipe
+        self.num_microbatches = num_microbatches or n_pipe
+
+    # ---------------------------------------------------------------- params
+    def _is_block_param(self, name: str) -> bool:
+        return name.startswith("layer")
+
+    def _block_suffix(self, name: str) -> str:
+        return name.split("/", 1)[1]  # "layer3/attn/wq" -> "attn/wq"
+
+    def init_params(self, rng=0) -> dict:
+        """Flat transformer store restacked: per-layer params become
+        ``blocks/<suffix>`` with leading [P, L/P] axes."""
+        flat = self.inner.init_params(rng)
+        out: dict = {}
+        by_suffix: dict[str, list] = {}
+        for i in range(self.config.n_layers):
+            for name, value in flat.items():
+                if name.startswith(f"layer{i}/"):
+                    by_suffix.setdefault(self._block_suffix(name),
+                                         []).append(value)
+        for suffix, values in by_suffix.items():
+            stacked = jnp.stack(values)  # [L, ...]
+            out[self.BLOCK_PREFIX + suffix] = stacked.reshape(
+                self.n_pipe, self.layers_per_stage, *stacked.shape[1:])
+        for name, value in flat.items():
+            if not self._is_block_param(name):
+                out[name] = value
+        return out
+
+    def num_params(self) -> int:
+        return self.inner.num_params()
+
+    def param_shapes(self) -> dict:
+        shapes: dict = {}
+        for name, shape in self.inner.param_shapes().items():
+            if self._is_block_param(name):
+                if name.startswith("layer0/"):
+                    shapes[self.BLOCK_PREFIX + self._block_suffix(name)] = (
+                        self.n_pipe, self.layers_per_stage, *shape)
+            else:
+                shapes[name] = shape
+        return shapes
+
+    # --------------------------------------------------------------- forward
+    def _stage_fn(self, stage_params: dict, h: jax.Array) -> jax.Array:
+        """Apply this stage's L/P transformer blocks.  stage_params values
+        have a leading [L/P] axis; the loop is static (unrolled by trace)."""
+        model = self.inner
+        key = self._STAGE_KEY
+        seq = h.shape[1]
+        positions = jnp.arange(seq, dtype=jnp.int32)
+        for j in range(self.layers_per_stage):
+            blk = {f"{key}/{suffix[len(self.BLOCK_PREFIX):]}": value[j]
+                   for suffix, value in stage_params.items()}
+            q, k, v = model.qkv(blk, key, h, positions)
+            attn = model.attention_fn(q, k, v)
+            h = model.attn_residual(blk, key, h, attn)
+            h = model.mlp_residual(blk, key, h)
+        return h
+
+    def loss(self, params: Mapping, batch) -> jax.Array:
+        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        h = jnp.take(params["embed/tok"], tokens, axis=0)
+        stage_params = {name: value for name, value in params.items()
+                        if name.startswith(self.BLOCK_PREFIX)}
+        h = pipeline_apply(self._stage_fn, stage_params, h, self.mesh,
+                           self.num_microbatches)
+        logits = self.inner.final_logits(params, h)
+        from ..models.transformer import next_token_nll
+        return next_token_nll(logits, tokens)
+
+
+def pipeline_rule(mesh: Mesh):
+    """Sharding rule for a PipelinedTransformerLM store: ``blocks/*`` get
+    ``pipe`` on the stage axis (stage s's weights live on pipe rank s);
+    everything else is replicated over pipe and falls through to the plain
+    transformer rule (embed/head/norms).  Block trailing dims stay unsharded
+    so the shard_map stage sees whole per-layer weights — combine pipe with
+    data parallelism, not TP/fsdp-in-block (see pipeline_apply)."""
+    from ..models.transformer import transformer_rule
+
+    base = transformer_rule(mesh)
+
+    def rule(name: str, shape: tuple) -> P:
+        if name.startswith(PipelinedTransformerLM.BLOCK_PREFIX):
+            return P("pipe", *([None] * (len(shape) - 1)))
+        return base(name, shape)
+
+    return rule
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,

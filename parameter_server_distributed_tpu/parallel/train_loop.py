@@ -37,6 +37,8 @@ class TrainLoopConfig:
     model: str = "mnist_mlp"
     batch_size: int = 64          # global batch
     data_path: str = ""           # file-backed data; empty = synthetic
+    attention: str = "dense"      # dense | flash | ring | ulysses (LM models)
+    microbatches: int = 0         # pipeline microbatches (0 = pipe size)
     steps: int = 100
     optimizer: str = "adam"
     learning_rate: float = 1e-3
@@ -54,6 +56,9 @@ class TrainLoopConfig:
 
 
 def _pick_rule(model_name: str, mesh):
+    if mesh.shape["pipe"] > 1:
+        from .pipeline import pipeline_rule
+        return pipeline_rule(mesh)
     if "lm" in model_name or "transformer" in model_name:
         from ..models.transformer import transformer_rule
         return transformer_rule(mesh)
@@ -69,6 +74,41 @@ def run_training(config: TrainLoopConfig) -> dict:
     model, batches = get_model_and_batches(config.model, config.batch_size,
                                            seed=config.seed,
                                            data_path=config.data_path)
+    from ..models.transformer import Transformer, select_attention
+    if isinstance(model, Transformer):
+        if mesh.shape["pipe"] > 1:
+            # pipeline mode: wrap in the GPipe-scheduled model (pipe +
+            # data axes; blocks live on their pipe rank).  Attention inside
+            # a pipeline stage is the per-shard dense kernel.
+            if config.attention != "dense":
+                raise ValueError(
+                    "--attention must be dense with a pipe axis (stage-"
+                    "internal attention runs inside shard_map)")
+            from .pipeline import PipelinedTransformerLM
+            model = PipelinedTransformerLM(
+                model, mesh, num_microbatches=config.microbatches)
+        else:
+            # give the model the mesh (activation sharding constraints) and
+            # the selected attention implementation — flash composes with
+            # the mesh via shard_map over batch/head shards, ring/ulysses
+            # ride the seq axis (models/transformer.select_attention).
+            # Dense resets to causal_attention (the constructor's with-mesh
+            # default): the model may have been built mesh-less with the
+            # PSDT_FLASH_ATTENTION env default, whose single-shard pallas
+            # kernel must not run unsharded under GSPMD.
+            from ..models.transformer import causal_attention
+            model.mesh = mesh
+            attn = select_attention(config.attention, mesh)
+            model.attention_fn = attn or causal_attention
+    else:
+        if config.attention != "dense":
+            raise ValueError(
+                f"--attention={config.attention} applies to transformer "
+                f"models; {config.model!r} is not one")
+        if mesh.shape["pipe"] > 1:
+            raise ValueError(
+                f"--mesh pipe axis applies to transformer models; "
+                f"{config.model!r} is not one")
     trainer = ShardedTrainer(
         model.loss, mesh, _pick_rule(config.model, mesh),
         make_optimizer(config.optimizer, config.learning_rate,

@@ -86,3 +86,133 @@ def test_pipeline_rejects_indivisible_microbatches(rng):
     x = np.zeros((8, 8), np.float32)
     with pytest.raises(ValueError, match="microbatches"):
         pipeline_apply(stage_fn, stacked, x, mesh, 3)
+
+
+# ---------------------------------------------------------------------------
+# PipelinedTransformerLM: the full-model training mode (embed -> pipelined
+# blocks -> head), gradients exact vs the non-pipelined Transformer
+# ---------------------------------------------------------------------------
+
+def _lm_fixtures(rng, n_layers=4, pipe=2, seq=16, batch=8):
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=pipe, data=8 // pipe))
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                               n_layers=n_layers, d_ff=64, max_seq=seq,
+                               dtype=jnp.float32)
+    plain = Transformer(config)
+    piped = PipelinedTransformerLM(plain, mesh, num_microbatches=2)
+    tokens = rng.integers(0, 64, (batch, seq)).astype(np.int32)
+    return plain, piped, mesh, tokens
+
+
+def _restack_grads(piped, flat_grads):
+    """Flat per-layer grads -> the pipelined blocks/ layout, for comparison."""
+    by_suffix = {}
+    config = piped.config
+    for i in range(config.n_layers):
+        for name, g in flat_grads.items():
+            if name.startswith(f"layer{i}/"):
+                by_suffix.setdefault(name.split("/", 1)[1], []).append(g)
+    out = {}
+    for suffix, values in by_suffix.items():
+        stacked = np.stack(values)
+        out["blocks/" + suffix] = stacked.reshape(
+            piped.n_pipe, piped.layers_per_stage, *stacked.shape[1:])
+    for name, g in flat_grads.items():
+        if not name.startswith("layer"):
+            out[name] = np.asarray(g)
+    return out
+
+
+def test_pipelined_lm_loss_matches_plain(rng):
+    plain, piped, mesh, tokens = _lm_fixtures(rng)
+    piped_params = piped.init_params(0)
+    plain_params = plain.init_params(0)
+    loss_plain = float(jax.jit(plain.loss)(plain_params, tokens))
+    loss_piped = float(jax.jit(piped.loss)(piped_params, tokens))
+    np.testing.assert_allclose(loss_piped, loss_plain, rtol=1e-5)
+
+
+def test_pipelined_lm_gradients_match_plain(rng):
+    """jax.grad through the GPipe schedule == grad of the sequential model,
+    for every parameter (the VERDICT item 6 'verify gradients equal the
+    non-pipelined run' contract)."""
+    plain, piped, mesh, tokens = _lm_fixtures(rng)
+    plain_params = plain.init_params(0)
+    piped_params = piped.init_params(0)
+    g_plain = jax.jit(jax.grad(plain.loss))(plain_params, tokens)
+    g_piped = jax.jit(jax.grad(piped.loss))(piped_params, tokens)
+    expected = _restack_grads(piped, {k: np.asarray(v)
+                                      for k, v in g_plain.items()})
+    assert set(expected) == set(g_piped)
+    for name in sorted(expected):
+        np.testing.assert_allclose(
+            np.asarray(g_piped[name]), expected[name], rtol=2e-4, atol=1e-5,
+            err_msg=f"gradient mismatch for {name}")
+
+
+def test_pipelined_lm_trains_in_sharded_trainer(rng):
+    """ShardedTrainer + pipeline_rule: one step updates the pipe-sharded
+    state and matches the equivalent non-pipelined step."""
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        pipeline_rule)
+    from parameter_server_distributed_tpu.parallel.train_step import (
+        ShardedTrainer, make_optimizer)
+    from parameter_server_distributed_tpu.models.transformer import (
+        transformer_rule)
+
+    plain, piped, mesh, tokens = _lm_fixtures(rng)
+    trainer = ShardedTrainer(piped.loss, mesh, pipeline_rule(mesh),
+                             make_optimizer("sgd", 0.1))
+    state = trainer.init_state(piped.init_params(0))
+    # block params actually live sharded over pipe
+    spec = state.params["blocks/attn/wq"].sharding.spec
+    assert spec[0] == "pipe"
+    state, metrics = trainer.step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+
+    # reference: the plain model on a data-only mesh, same sgd step
+    dmesh = build_mesh(MeshConfig(data=8))
+    ref = ShardedTrainer(plain.loss, dmesh, transformer_rule(dmesh),
+                         make_optimizer("sgd", 0.1))
+    ref_state = ref.init_state(plain.init_params(0))
+    ref_state, ref_metrics = ref.step(ref_state, tokens)
+    np.testing.assert_allclose(float(metrics["loss"]),
+                               float(ref_metrics["loss"]), rtol=1e-5)
+    got = np.asarray(state.params["blocks/mlp/w1"])[0, 0]
+    want = np.asarray(ref_state.params["layer0/mlp/w1"])
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+def test_run_training_pipeline_mode(rng):
+    """train_main --mesh=pipe:2,data:4 trains the LM end to end."""
+    from parameter_server_distributed_tpu.parallel.train_loop import (
+        TrainLoopConfig, run_training)
+
+    config = TrainLoopConfig(
+        model="small_lm", batch_size=8, steps=6, optimizer="sgd",
+        learning_rate=0.5, mesh=MeshConfig(pipeline=2, data=4),
+        microbatches=2, log_every=2)
+    summary = run_training(config)
+    assert summary["steps"] == 6
+    assert np.isfinite(summary["final_loss"])
+
+
+def test_pipeline_rejects_bad_configs(rng):
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    with pytest.raises(ValueError, match="divide"):
+        PipelinedTransformerLM(
+            Transformer(TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                          n_layers=3, d_ff=64,
+                                          dtype=jnp.float32)), mesh)
+    with pytest.raises(ValueError, match="Transformer"):
+        PipelinedTransformerLM(object(), mesh)

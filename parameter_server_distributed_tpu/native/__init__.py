@@ -40,13 +40,16 @@ _F32P = ctypes.POINTER(ctypes.c_float)
 
 
 def _build() -> str | None:
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    if (os.path.exists(_SO_PATH)
-            and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC)):
-        return _SO_PATH
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", _SO_PATH, _SRC]
     try:
+        # makedirs inside the guard: a root-installed package run by an
+        # unprivileged user has a read-only site-packages — that must mean
+        # numpy fallback, not a crash on the PS hot loop
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        if (os.path.exists(_SO_PATH)
+                and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC)):
+            return _SO_PATH
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               "-o", _SO_PATH, _SRC]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return _SO_PATH
     except (OSError, subprocess.SubprocessError) as exc:

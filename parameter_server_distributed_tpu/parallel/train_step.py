@@ -23,11 +23,24 @@ from typing import Any, Callable, Mapping
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .mesh import batch_sharding, replicated
 from .sharding import ShardingRule, store_shardings
+
+
+def put_global(x, sharding) -> jax.Array:
+    """Place a host (or device) value with a global sharding.  Under a
+    multi-controller run device_put cannot target non-addressable devices;
+    every process must hold the same value and contributes its addressable
+    shards.  The single shared placement helper for batches and state."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    host = np.asarray(x)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
 
 
 @jax.tree_util.register_dataclass
@@ -196,11 +209,25 @@ class ShardedTrainer:
         self._shardings: TrainState | None = None
 
     def init_state(self, params: Mapping[str, jax.Array]) -> TrainState:
-        """Create and shard the train state (host arrays OK)."""
-        state = TrainState.create(params, self.optimizer)
-        self._shardings = state_shardings(state, self.mesh, self.rule)
-        put = lambda leaf, sh: jax.device_put(leaf, sh)
-        return jax.tree.map(put, state, self._shardings)
+        """Create and shard the train state (host arrays OK).  Every
+        process must pass identical param values (same init seed).
+
+        Only the params cross the host<->device boundary: their shardings
+        come from the rule, and the optimizer state is initialized directly
+        INTO its shardings by a jitted ``optimizer.init`` — no process ever
+        materializes a full unsharded optimizer-state replica (the point of
+        fsdp sharding)."""
+        params = dict(params)
+        abstract = jax.eval_shape(
+            lambda p: TrainState.create(p, self.optimizer), params)
+        self._shardings = state_shardings(abstract, self.mesh, self.rule)
+        placed = {name: put_global(value, self._shardings.params[name])
+                  for name, value in params.items()}
+        opt_state = jax.jit(
+            self.optimizer.init,
+            out_shardings=self._shardings.opt_state)(placed)
+        step = put_global(np.zeros((), np.int32), self._shardings.step)
+        return TrainState(params=placed, opt_state=opt_state, step=step)
 
     def step_fn(self) -> Callable:
         if self._compiled is None:
@@ -217,5 +244,10 @@ class ShardedTrainer:
         return self._compiled
 
     def step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
-        batch = jax.device_put(batch, batch_sharding(self.mesh))
-        return self.step_fn()(state, batch)
+        return self.step_fn()(state, self.put_batch(batch))
+
+    def put_batch(self, batch):
+        """Place a host batch with the global batch sharding (every process
+        holds the same global batch — deterministic loaders)."""
+        sharding = batch_sharding(self.mesh)
+        return jax.tree.map(lambda x: put_global(x, sharding), batch)

@@ -12,6 +12,11 @@ implementation for transformer models: flash = pallas kernels (shard_mapped
 over batch/head shards when the mesh is >1 device), ring/ulysses = sequence
 parallelism over the mesh's seq axis (pair with --mesh=seq:N).
 
+``--dtype=bf16`` trains in bfloat16 (f32 MXU accumulation) for models
+whose factory takes a dtype; ``--remat`` recomputes layer activations in
+the backward pass (jax.checkpoint, transformer LMs) — the long-context
+memory/FLOPs trade.
+
 ``--mesh=pipe:P`` trains transformer models with GPipe pipeline
 parallelism (parallel/pipeline.py): layer blocks live on their pipe rank,
 microbatches stream through; ``--microbatches=M`` sets the schedule depth
@@ -76,6 +81,8 @@ def main(argv: list[str] | None = None) -> int:
         data_path=flags.get("data", ""),
         attention=flags.get("attention", "dense"),
         microbatches=int(flags.get("microbatches", 0)),
+        model_dtype=flags.get("dtype", ""),
+        remat="remat" in flags,
         steps=int(flags.get("steps", 100)),
         optimizer=flags.get("optimizer", "adam"),
         learning_rate=float(flags.get("lr", 1e-3)),

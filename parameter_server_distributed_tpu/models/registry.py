@@ -7,6 +7,7 @@ configs: MNIST MLP (config 1), CIFAR ResNet-18 (config 2), 1B MLP
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, Iterator
 
 from ..data.synthetic import (synthetic_image_batches, synthetic_mnist,
@@ -46,26 +47,62 @@ def _mlp_1b_batches(batch_size: int, seed: int) -> Iterator:
 # name -> (model factory, synthetic data factory, file-data kind)
 # file-data kind: "tokens" (memmap .bin shard, data/files.token_stream) or
 # "xy" (npz with x/y arrays, data/files.npz_stream)
+# Factories may accept dtype=/remat= keywords; get_model_and_batches passes
+# only what each signature supports.
 REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator], str]] = {
     "mnist_mlp": (mnist_mlp, _mnist_batches, "xy"),
-    "resnet18_cifar": (lambda: resnet18(num_classes=10), _cifar_batches, "xy"),
-    "resnet50_imagenet": (lambda: resnet50(num_classes=1000),
+    "resnet18_cifar": (partial(resnet18, num_classes=10),
+                       _cifar_batches, "xy"),
+    "resnet50_imagenet": (partial(resnet50, num_classes=1000),
                           _imagenet_batches, "xy"),
-    "small_lm": (lambda: small_lm(vocab=1024, seq=256), _lm_batches, "tokens"),
-    "moe_lm": (lambda: moe_lm(vocab=1024, seq=256), _lm_batches, "tokens"),
+    "small_lm": (partial(small_lm, vocab=1024, seq=256),
+                 _lm_batches, "tokens"),
+    "moe_lm": (partial(moe_lm, vocab=1024, seq=256),
+               _lm_batches, "tokens"),
     "mlp_1b": (billion_param_mlp, _mlp_1b_batches, "xy"),
 }
 
+DTYPE_NAMES = {"f32": "float32", "float32": "float32",
+               "bf16": "bfloat16", "bfloat16": "bfloat16"}
+
+
+def _model_kwargs(model_fn: Callable, name: str, dtype: str,
+                  remat: bool) -> dict:
+    """The subset of {dtype, remat} this factory supports; error (rather
+    than silently ignore) when the user asked for one it doesn't."""
+    import inspect
+
+    import jax.numpy as jnp
+
+    sig = inspect.signature(model_fn)
+    has_var_kw = any(p.kind is p.VAR_KEYWORD for p in sig.parameters.values())
+    kwargs: dict = {}
+    if dtype:
+        if dtype not in DTYPE_NAMES:
+            raise ValueError(f"unknown dtype {dtype!r}; "
+                             f"options {sorted(set(DTYPE_NAMES))}")
+        if not (has_var_kw or "dtype" in sig.parameters):
+            raise ValueError(f"model {name!r} does not take a dtype")
+        kwargs["dtype"] = getattr(jnp, DTYPE_NAMES[dtype])
+    if remat:
+        if not (has_var_kw or "remat" in sig.parameters):
+            raise ValueError(f"model {name!r} does not support remat "
+                             f"(transformer LMs only)")
+        kwargs["remat"] = True
+    return kwargs
+
 
 def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
-                          data_path: str = ""):
+                          data_path: str = "", dtype: str = "",
+                          remat: bool = False):
     """Build (model, batch iterator).  ``data_path`` switches from the
     synthetic loaders to file-backed data (data/files.py), dispatched by
-    the registry entry's declared file-data kind."""
+    the registry entry's declared file-data kind.  ``dtype`` ("f32"/"bf16")
+    and ``remat`` forward to factories that support them."""
     if name not in REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {sorted(REGISTRY)}")
     model_fn, data_fn, file_kind = REGISTRY[name]
-    model = model_fn()
+    model = model_fn(**_model_kwargs(model_fn, name, dtype, remat))
     if not data_path:
         return model, data_fn(batch_size, seed)
     from ..data.files import npz_stream, token_stream

@@ -44,6 +44,11 @@ class TransformerConfig:
     max_seq: int = 2048
     dtype: object = jnp.bfloat16
     rope_theta: float = 10000.0
+    # Rematerialization: recompute each layer's activations in the backward
+    # pass instead of saving them (jax.checkpoint) — O(1) layers of
+    # residuals instead of O(L), the standard long-context memory/FLOPs
+    # trade on TPU (HBM is the bottleneck, MXU FLOPs are cheap).
+    remat: bool = False
     # Mixture-of-experts: every ``moe_every``-th layer (1-based; 0 = dense
     # everywhere) swaps its FFN for a Switch-routed MoE (models/moe.py) with
     # ``moe_experts`` experts; the load-balancing aux loss is added to the
@@ -349,17 +354,34 @@ class Transformer:
         positions = jnp.arange(seq, dtype=jnp.int32)[None, :].repeat(batch, 0)
         kvs: list = []
         aux_total = jnp.zeros((), jnp.float32)
-        for i in range(c.n_layers):
+
+        def layer_body(layer_params, i, h):
             p = f"layer{i}"
-            q, k, v = self.qkv(params, p, h, positions)
-            if collect_kv:
-                kvs.append((k, v))
+            q, k, v = self.qkv(layer_params, p, h, positions)
             attn = self.attention_fn(q, k, v)
-            h = self.attn_residual(params, p, h, attn)
+            h = self.attn_residual(layer_params, p, h, attn)
             h = self._constrain(h, ("data", "fsdp"), "seq", None)
-            h, aux = self.ffn_residual(params, i, h)
+            h, aux = self.ffn_residual(layer_params, i, h)
+            h = self._constrain(h, ("data", "fsdp"), "seq", None)
+            return h, aux, (k, v)
+
+        # remat recomputes layer activations in the backward pass (O(1)
+        # layers of residuals); never combined with collect_kv, which
+        # exists to SAVE per-layer tensors (generation prefill)
+        if c.remat and not collect_kv:
+            body = jax.checkpoint(
+                lambda lp, i, h: layer_body(lp, i, h)[:2],
+                static_argnums=(1,))
+        else:
+            body = None
+        for i in range(c.n_layers):
+            if body is not None:
+                h, aux = body(params, i, h)
+            else:
+                h, aux, kv = layer_body(params, i, h)
+                if collect_kv:
+                    kvs.append(kv)
             aux_total = aux_total + aux
-            h = self._constrain(h, ("data", "fsdp"), "seq", None)
         return self.final_logits(params, h), kvs, aux_total
 
     def loss(self, params: Mapping[str, Array], batch) -> Array:
@@ -432,15 +454,17 @@ def transformer_rule(mesh: Mesh):
     return rule
 
 
-def small_lm(vocab: int = 1024, seq: int = 256) -> Transformer:
+def small_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
+             remat: bool = False) -> Transformer:
     """Test-scale LM."""
     return Transformer(TransformerConfig(
         vocab=vocab, d_model=128, n_heads=4, n_layers=2, d_ff=512,
-        max_seq=seq, dtype=jnp.float32))
+        max_seq=seq, dtype=dtype, remat=remat))
 
 
-def moe_lm(vocab: int = 1024, seq: int = 256) -> Transformer:
+def moe_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
+           remat: bool = False) -> Transformer:
     """Test-scale MoE LM: every 2nd layer is a Switch-routed FFN."""
     return Transformer(TransformerConfig(
         vocab=vocab, d_model=128, n_heads=4, n_layers=4, d_ff=512,
-        max_seq=seq, dtype=jnp.float32, moe_every=2, moe_experts=4))
+        max_seq=seq, dtype=dtype, moe_every=2, moe_experts=4, remat=remat))

@@ -124,18 +124,26 @@ class PipelinedTransformerLM:
     # --------------------------------------------------------------- forward
     def _stage_fn(self, stage_params: dict, h: jax.Array) -> jax.Array:
         """Apply this stage's L/P transformer blocks.  stage_params values
-        have a leading [L/P] axis; the loop is static (unrolled by trace)."""
+        have a leading [L/P] axis; the loop is static (unrolled by trace).
+        Honors config.remat: each block recomputes its activations in the
+        backward pass (jax.checkpoint), same trade as the plain model."""
         model = self.inner
         key = self._STAGE_KEY
         seq = h.shape[1]
         positions = jnp.arange(seq, dtype=jnp.int32)
-        for j in range(self.layers_per_stage):
-            blk = {f"{key}/{suffix[len(self.BLOCK_PREFIX):]}": value[j]
-                   for suffix, value in stage_params.items()}
+
+        def one_block(blk, h):
             q, k, v = model.qkv(blk, key, h, positions)
             attn = model.attention_fn(q, k, v)
             h = model.attn_residual(blk, key, h, attn)
-            h = model.mlp_residual(blk, key, h)
+            return model.mlp_residual(blk, key, h)
+
+        apply_block = (jax.checkpoint(one_block) if self.config.remat
+                       else one_block)
+        for j in range(self.layers_per_stage):
+            blk = {f"{key}/{suffix[len(self.BLOCK_PREFIX):]}": value[j]
+                   for suffix, value in stage_params.items()}
+            h = apply_block(blk, h)
         return h
 
     def loss(self, params: Mapping, batch) -> jax.Array:

@@ -39,6 +39,8 @@ class TrainLoopConfig:
     data_path: str = ""           # file-backed data; empty = synthetic
     attention: str = "dense"      # dense | flash | ring | ulysses (LM models)
     microbatches: int = 0         # pipeline microbatches (0 = pipe size)
+    model_dtype: str = ""         # "" = model default | f32 | bf16
+    remat: bool = False           # jax.checkpoint per layer (LM models)
     steps: int = 100
     optimizer: str = "adam"
     learning_rate: float = 1e-3
@@ -73,7 +75,9 @@ def run_training(config: TrainLoopConfig) -> dict:
     mesh = build_mesh(config.mesh, devices=devices)
     model, batches = get_model_and_batches(config.model, config.batch_size,
                                            seed=config.seed,
-                                           data_path=config.data_path)
+                                           data_path=config.data_path,
+                                           dtype=config.model_dtype,
+                                           remat=config.remat)
     from ..models.transformer import Transformer, select_attention
     if isinstance(model, Transformer):
         if mesh.shape["pipe"] > 1:

@@ -216,3 +216,26 @@ def test_pipeline_rejects_bad_configs(rng):
                                           dtype=jnp.float32)), mesh)
     with pytest.raises(ValueError, match="Transformer"):
         PipelinedTransformerLM(object(), mesh)
+
+
+def test_pipelined_lm_remat_gradients_match(rng):
+    """config.remat flows into the pipeline stages (jax.checkpoint per
+    block) without changing loss or gradients."""
+    import dataclasses
+
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    plain, piped, mesh, tokens = _lm_fixtures(rng)
+    remat_model = Transformer(dataclasses.replace(plain.config, remat=True))
+    piped_remat = PipelinedTransformerLM(remat_model, mesh,
+                                         num_microbatches=2)
+    params = piped.init_params(0)
+    g_a = jax.jit(jax.grad(piped.loss))(params, tokens)
+    g_b = jax.jit(jax.grad(piped_remat.loss))(params, tokens)
+    for name in g_a:
+        np.testing.assert_allclose(np.asarray(g_b[name]),
+                                   np.asarray(g_a[name]), rtol=1e-5,
+                                   atol=1e-7, err_msg=name)

@@ -97,18 +97,39 @@ def bench_mfu() -> dict:
     on_tpu = _is_tpu(device)
     model_name = os.environ.get("PSDT_BENCH_MODEL", "")
     flops_known = not model_name  # 6*P*B holds for the dense MLP only
+    flops_per_sample = None  # set for models with known FLOP accounting
 
     if model_name:
         from parameter_server_distributed_tpu.models.registry import (
             get_model_and_batches)
+        from parameter_server_distributed_tpu.models.transformer import (
+            Transformer, select_attention)
         batch = int(os.environ.get("PSDT_BENCH_BATCH",
                                    "256" if on_tpu else "32"))
         model, batches = get_model_and_batches(model_name, batch)
         batch_data = next(batches)
         n_params = model.num_params()
-        # MFU only where 6*P*B is the true cost and the model is big enough
-        # to be compute-bound; small models report samples/s instead.
+        # MFU only where the FLOP count is known and the model is big
+        # enough to be compute-bound; small models report samples/s.
         flops_known = model_name == "mlp_1b"
+        if isinstance(model, Transformer):
+            attn = os.environ.get("PSDT_BENCH_ATTENTION", "")
+            if attn:
+                from parameter_server_distributed_tpu.models.transformer import (
+                    causal_attention)
+                # 'dense' must force the einsum kernel — select_attention
+                # returns None for it (meaning "model default"), and the
+                # default may be flash via PSDT_FLASH_ATTENTION
+                model.attention_fn = (select_attention(attn, None)
+                                      or causal_attention)
+                log(f"bench_mfu: attention={attn}")
+            # MFU for any dense transformer big enough to be compute-bound
+            # (model.flops_per_sample covers params + attention matmuls);
+            # small LMs keep reporting samples/s
+            fps = model.flops_per_sample()
+            if fps is not None and n_params > 100e6:
+                flops_per_sample = fps
+                flops_known = True
     elif on_tpu:
         hidden, layers, batch = 8192, 4, 2048
         model = MLP((hidden,) * (layers + 2), dtype=jnp.bfloat16)
@@ -177,12 +198,16 @@ def bench_mfu() -> dict:
 
     peak = peak_for(device) if on_tpu else None
     if peak and flops_known:
-        # fwd+bwd+update: ~6 matmul flops per param per sample (dense MLP)
-        achieved = 6.0 * n_params * batch / dt
+        if flops_per_sample is None:
+            # fwd+bwd+update: ~6 matmul flops per param per sample (MLP)
+            flops_per_sample = 6.0 * n_params
+        achieved = flops_per_sample * batch / dt
         mfu = achieved / peak
         log(f"bench_mfu: achieved={achieved/1e12:.2f} TFLOP/s "
             f"MFU={mfu*100:.1f}% (peak {peak/1e12:.0f} TFLOP/s)")
-        return {"metric": "mlp_train_mfu", "value": round(mfu, 4),
+        metric = ("lm_train_mfu" if flops_per_sample is not None
+                  and model_name.startswith("lm") else "mlp_train_mfu")
+        return {"metric": metric, "value": round(mfu, 4),
                 "unit": "fraction_of_peak",
                 "vs_baseline": round(mfu / 0.45, 3)}
     name = model_name or "mlp"

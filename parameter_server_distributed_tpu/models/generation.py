@@ -55,7 +55,9 @@ class KVCache:
 
 def init_cache(model: Transformer, batch: int, max_len: int) -> KVCache:
     c = model.config
-    shape = (c.n_layers, batch, max_len, c.n_heads, c.head_dim)
+    # GQA: the cache stores kv_heads (< n_heads) — n_heads/kv_heads x less
+    # cache HBM; heads expand to the query count at attention time
+    shape = (c.n_layers, batch, max_len, c.kv_heads, c.head_dim)
     return KVCache(k=jnp.zeros(shape, c.dtype), v=jnp.zeros(shape, c.dtype),
                    length=jnp.zeros((), jnp.int32))
 
@@ -92,21 +94,28 @@ def decode_step(model: Transformer, params: Mapping[str, Array],
     # valid cache positions for this step: 0..pos inclusive
     mask = (jnp.arange(cache.max_len) <= pos)[None, None, None, :]
     new_k, new_v = cache.k, cache.v
+    groups = c.kv_groups
     for i in range(c.n_layers):
         p = f"layer{i}"
-        q, k, v = model.qkv(params, p, h, positions)     # [B, 1, H, D]
+        q, k, v = model.qkv(params, p, h, positions)  # k/v: [B, 1, KV, D]
         new_k = jax.lax.dynamic_update_slice(
             new_k, k[None].astype(new_k.dtype), (i, 0, pos, 0, 0))
         new_v = jax.lax.dynamic_update_slice(
             new_v, v[None].astype(new_v.dtype), (i, 0, pos, 0, 0))
-        # dense attention against the cache, f32 softmax
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, new_k[i],
+        # dense attention against the cache, f32 softmax.  GQA: contract
+        # query-head groups directly against the UNexpanded cache — the
+        # cache bytes streamed per step stay kv_heads-sized (the point of
+        # the smaller cache), no materialized repeat
+        b, s_q = q.shape[:2]
+        qg = q.reshape(b, s_q, c.kv_heads, groups, c.head_dim)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, new_k[i],
                             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
-        scores = jnp.where(mask, scores, -jnp.inf)
+        scores = jnp.where(mask[:, :, None], scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, new_v[i],
+        attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, new_v[i],
                           preferred_element_type=jnp.float32).astype(c.dtype)
+        attn = attn.reshape(b, s_q, c.n_heads, c.head_dim)
         h = model.attn_residual(params, p, h, attn)
         # MoE-aware, drop-free at decode time; aux loss unused here
         h, _ = model.ffn_residual(params, i, h, decode=True)

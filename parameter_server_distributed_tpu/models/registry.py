@@ -14,7 +14,7 @@ from ..data.synthetic import (synthetic_image_batches, synthetic_mnist,
                               synthetic_tokens)
 from .mlp import MLP, billion_param_mlp, mnist_mlp
 from .resnet import resnet18, resnet50
-from .transformer import moe_lm, small_lm
+from .transformer import lm_350m, moe_lm, small_lm
 
 
 def _mnist_batches(batch_size: int, seed: int) -> Iterator:
@@ -32,6 +32,10 @@ def _imagenet_batches(batch_size: int, seed: int) -> Iterator:
 
 def _lm_batches(batch_size: int, seed: int) -> Iterator:
     return synthetic_tokens(batch_size, seq_len=256, vocab=1024, seed=seed)
+
+
+def _lm_350m_batches(batch_size: int, seed: int) -> Iterator:
+    return synthetic_tokens(batch_size, seq_len=1024, vocab=32000, seed=seed)
 
 
 def _mlp_1b_batches(batch_size: int, seed: int) -> Iterator:
@@ -60,6 +64,7 @@ REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator], str]] = {
     "moe_lm": (partial(moe_lm, vocab=1024, seq=256),
                _lm_batches, "tokens"),
     "mlp_1b": (billion_param_mlp, _mlp_1b_batches, "xy"),
+    "lm_350m": (lm_350m, _lm_350m_batches, "tokens"),
 }
 
 DTYPE_NAMES = {"f32": "float32", "float32": "float32",

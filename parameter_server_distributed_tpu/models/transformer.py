@@ -39,6 +39,10 @@ class TransformerConfig:
     vocab: int = 32000
     d_model: int = 512
     n_heads: int = 8
+    # Grouped-query attention: number of K/V heads (0 = n_heads, i.e. MHA;
+    # 1 = multi-query).  Shrinks wk/wv and the decode KV cache by
+    # n_heads/n_kv_heads; each K/V head serves a group of query heads.
+    n_kv_heads: int = 0
     n_layers: int = 6
     d_ff: int = 2048
     max_seq: int = 2048
@@ -61,6 +65,15 @@ class TransformerConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def kv_groups(self) -> int:
+        """Query heads per K/V head."""
+        return self.n_heads // self.kv_heads
 
     def is_moe_layer(self, i: int) -> bool:
         return self.moe_every > 0 and (i + 1) % self.moe_every == 0
@@ -183,6 +196,14 @@ def _default_attention() -> Callable:
     return causal_attention
 
 
+def repeat_kv(x: Array, groups: int) -> Array:
+    """Expand GQA K/V heads to the query head count: [B, S, KV, D] ->
+    [B, S, KV*groups, D], each K/V head repeated for its query group."""
+    if groups == 1:
+        return x
+    return jnp.repeat(x, groups, axis=2)
+
+
 def causal_attention(q: Array, k: Array, v: Array) -> Array:
     """Reference einsum attention.  q,k,v: [B, S, H, D] -> [B, S, H, D].
     float32 logits/softmax for stability."""
@@ -204,6 +225,10 @@ class Transformer:
                  mesh: Mesh | None = None):
         if config.d_model % config.n_heads:
             raise ValueError("d_model must divide by n_heads")
+        if config.n_heads % config.kv_heads:
+            raise ValueError(
+                f"n_heads={config.n_heads} must divide by "
+                f"n_kv_heads={config.kv_heads}")
         self.config = config
         if config.moe_every > 0:
             from .moe import MoEConfig, MoELayer
@@ -227,10 +252,11 @@ class Transformer:
         shapes: dict[str, tuple[int, ...]] = {"embed/tok": (c.vocab, c.d_model)}
         for i in range(c.n_layers):
             p = f"layer{i}"
+            kv_dim = c.kv_heads * c.head_dim
             shapes[f"{p}/ln1/scale"] = (c.d_model,)
             shapes[f"{p}/attn/wq"] = (c.d_model, c.d_model)
-            shapes[f"{p}/attn/wk"] = (c.d_model, c.d_model)
-            shapes[f"{p}/attn/wv"] = (c.d_model, c.d_model)
+            shapes[f"{p}/attn/wk"] = (c.d_model, kv_dim)
+            shapes[f"{p}/attn/wv"] = (c.d_model, kv_dim)
             shapes[f"{p}/attn/wo"] = (c.d_model, c.d_model)
             shapes[f"{p}/ln2/scale"] = (c.d_model,)
             if c.is_moe_layer(i):
@@ -246,6 +272,19 @@ class Transformer:
 
     def num_params(self) -> int:
         return sum(math.prod(s) for s in self.param_shapes().values())
+
+    def flops_per_sample(self) -> float | None:
+        """Training (fwd+bwd) FLOPs for one max_seq-length sample:
+        6*P per token for the parameter matmuls plus 12*L*d_model*S per
+        token for the attention score/value matmuls (PaLM-appendix
+        convention, full-S accounting).  None for MoE configs, where 6*P
+        overcounts inactive experts."""
+        c = self.config
+        if c.moe_every > 0:
+            return None
+        seq = c.max_seq
+        return (6.0 * self.num_params() * seq
+                + 12.0 * c.n_layers * c.d_model * seq * seq)
 
     def init_params(self, rng: jax.Array | int = 0) -> dict[str, Array]:
         c = self.config
@@ -291,7 +330,10 @@ class Transformer:
     # so the layer math exists exactly once) -----------------------------
     def qkv(self, params: Mapping[str, Array], prefix: str, h: Array,
             positions: Array) -> tuple[Array, Array, Array]:
-        """ln1 -> q/k/v projections -> head split -> rope.  h: [B, S, d]."""
+        """ln1 -> q/k/v projections -> head split -> rope.  h: [B, S, d].
+        K/V come back with ``kv_heads`` heads (UNexpanded under GQA — the
+        cache-friendly form); expand to the query head count with
+        :func:`repeat_kv` before a plain attention kernel."""
         c = self.config
         batch, seq = h.shape[:2]
         x = rms_norm(h, params[f"{prefix}/ln1/scale"])
@@ -300,8 +342,8 @@ class Transformer:
         k = dot(x, params[f"{prefix}/attn/wk"]).astype(c.dtype)
         v = dot(x, params[f"{prefix}/attn/wv"]).astype(c.dtype)
         q = q.reshape(batch, seq, c.n_heads, c.head_dim)
-        k = k.reshape(batch, seq, c.n_heads, c.head_dim)
-        v = v.reshape(batch, seq, c.n_heads, c.head_dim)
+        k = k.reshape(batch, seq, c.kv_heads, c.head_dim)
+        v = v.reshape(batch, seq, c.kv_heads, c.head_dim)
         return (rope(q, positions, c.rope_theta),
                 rope(k, positions, c.rope_theta), v)
 
@@ -358,7 +400,8 @@ class Transformer:
         def layer_body(layer_params, i, h):
             p = f"layer{i}"
             q, k, v = self.qkv(layer_params, p, h, positions)
-            attn = self.attention_fn(q, k, v)
+            attn = self.attention_fn(q, repeat_kv(k, c.kv_groups),
+                                     repeat_kv(v, c.kv_groups))
             h = self.attn_residual(layer_params, p, h, attn)
             h = self._constrain(h, ("data", "fsdp"), "seq", None)
             h, aux = self.ffn_residual(layer_params, i, h)
@@ -459,6 +502,17 @@ def small_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
     """Test-scale LM."""
     return Transformer(TransformerConfig(
         vocab=vocab, d_model=128, n_heads=4, n_layers=2, d_ff=512,
+        max_seq=seq, dtype=dtype, remat=remat))
+
+
+def lm_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
+            remat: bool = True) -> Transformer:
+    """~370M-param GPT-style flagship for the LM MFU benchmark: 24 layers,
+    d_model 1024, seq 1024, bf16 weights/activations with f32 MXU
+    accumulation, per-layer remat by default (activation memory, not HBM
+    capacity, should bound the batch)."""
+    return Transformer(TransformerConfig(
+        vocab=vocab, d_model=1024, n_heads=16, n_layers=24, d_ff=4096,
         max_seq=seq, dtype=dtype, remat=remat))
 
 

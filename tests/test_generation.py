@@ -107,3 +107,28 @@ def test_top_k_larger_than_vocab_is_no_truncation():
     logits = jnp.asarray([[1.0, 2.0, 3.0]])
     tok = sample_token(logits, jax.random.key(0), temperature=1.0, top_k=99)
     assert 0 <= int(tok[0]) < 3
+
+
+def gqa_model(n_kv_heads):
+    return Transformer(TransformerConfig(
+        vocab=96, d_model=48, n_heads=4, n_kv_heads=n_kv_heads, n_layers=2,
+        d_ff=96, max_seq=64, dtype=jnp.float32))
+
+
+@pytest.mark.parametrize("n_kv", [1, 2])
+def test_gqa_cached_greedy_matches_full_forward(rng, n_kv):
+    """GQA decode (kv_heads-shaped cache, heads expanded at use) must
+    reproduce the full-sequence forward token for token."""
+    model = gqa_model(n_kv)
+    params = model.init_params(0)
+    prompt = jnp.asarray(rng.integers(0, 96, (2, 8)), jnp.int32)
+    expected = greedy_by_full_forward(model, params, prompt, 6)
+    got = generate(model, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_gqa_cache_is_smaller(rng):
+    mha = init_cache(tiny_model(), batch=2, max_len=16)
+    gqa = init_cache(gqa_model(1), batch=2, max_len=16)
+    assert gqa.k.shape[3] == 1 and mha.k.shape[3] == 4
+    assert gqa.k.size == mha.k.size // 4

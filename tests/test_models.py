@@ -256,3 +256,29 @@ def test_registry_dtype_and_remat_plumbing():
         get_model_and_batches("mlp_1b", 4, remat=True)
     with pytest.raises(ValueError, match="unknown dtype"):
         get_model_and_batches("small_lm", 4, dtype="fp8")
+
+
+def test_gqa_transformer_trains_and_matches_mha_when_equal(rng):
+    """n_kv_heads=n_heads is exactly MHA (same shapes, same loss); a real
+    GQA config has smaller wk/wv, finite loss, and gradients through them."""
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                               d_ff=64, max_seq=16, dtype=jnp.float32)
+    import dataclasses
+    tokens = rng.integers(0, 64, (4, 16)).astype(np.int32)
+
+    mha = Transformer(config)
+    same = Transformer(dataclasses.replace(config, n_kv_heads=4))
+    params = mha.init_params(0)
+    np.testing.assert_allclose(
+        float(jax.jit(same.loss)(params, tokens)),
+        float(jax.jit(mha.loss)(params, tokens)), rtol=1e-6)
+
+    gqa = Transformer(dataclasses.replace(config, n_kv_heads=2))
+    assert gqa.param_shapes()["layer0/attn/wk"] == (32, 16)
+    gparams = gqa.init_params(0)
+    loss, grads = jax.jit(jax.value_and_grad(gqa.loss))(gparams, tokens)
+    assert np.isfinite(float(loss))
+    assert float(jnp.abs(grads["layer0/attn/wk"]).max()) > 0
+
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        Transformer(dataclasses.replace(config, n_kv_heads=3))

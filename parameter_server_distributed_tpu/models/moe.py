@@ -120,16 +120,36 @@ class MoELayer:
         return out.reshape(b, s, d), aux
 
 
+def moe_expert_weight_spec(name: str, shape: tuple[int, ...], n_exp: int,
+                           n_tp: int, n_fsdp: int) -> PartitionSpec:
+    """Sharding for a [E, in, out] expert weight: ``expert`` on the expert
+    dim, Megatron within-expert TP on the d_ff dim (w1 output / w2 input —
+    one all-reduce per MoE branch, inserted by GSPMD), fsdp storage
+    sharding on the free d_model dim.  Shared by moe_sharding_rule and
+    models.transformer.transformer_rule."""
+    spec: list = [None] * len(shape)
+    if n_exp > 1 and shape[0] % n_exp == 0:
+        spec[0] = "expert"
+    is_w1 = name.endswith("w1")
+    ff_axis = len(shape) - 1 if is_w1 else 1
+    d_axis = 1 if is_w1 else len(shape) - 1
+    if n_tp > 1 and shape[ff_axis] % n_tp == 0:
+        spec[ff_axis] = "tensor"
+    if n_fsdp > 1 and shape[d_axis] % n_fsdp == 0:
+        spec[d_axis] = "fsdp"
+    return PartitionSpec(*spec)
+
+
 def moe_sharding_rule(mesh: Mesh):
-    """Shard the expert dimension over ``expert``; router replicated."""
+    """Shard expert weights over ``expert`` (+ within-expert ``tensor`` on
+    d_ff, ``fsdp`` on d_model); router replicated."""
     n_exp = mesh.shape["expert"]
+    n_tp = mesh.shape["tensor"]
+    n_fsdp = mesh.shape["fsdp"]
 
     def rule(name: str, shape: tuple[int, ...]) -> PartitionSpec:
         if "/moe/w" in name or name.startswith("moe/w"):
-            spec: list = [None] * len(shape)
-            if n_exp > 1 and shape[0] % n_exp == 0:
-                spec[0] = "expert"
-            return PartitionSpec(*spec)
-        return PartitionSpec()
+            return moe_expert_weight_spec(name, shape, n_exp, n_tp, n_fsdp)
+        return PartitionSpec()  # router + anything else: replicated
 
     return rule

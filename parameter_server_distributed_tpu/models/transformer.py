@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .moe import moe_expert_weight_spec
+
 Array = jax.Array
 
 
@@ -453,12 +455,7 @@ def transformer_rule(mesh: Mesh):
         if "/moe/router/" in name:
             return PartitionSpec()
         if "/moe/w" in name:
-            spec: list = [None] * len(shape)
-            if n_exp > 1 and shape[0] % n_exp == 0:
-                spec[0] = "expert"
-            if n_fsdp > 1 and shape[-1] % n_fsdp == 0:
-                spec[-1] = "fsdp"
-            return PartitionSpec(*spec)
+            return moe_expert_weight_spec(name, shape, n_exp, n_tp, n_fsdp)
         def fsdp_on(axis: int, taken: int | None) -> list:
             spec: list = [None] * len(shape)
             if taken is not None:

@@ -29,11 +29,39 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save_sharded(directory: str, step: int, state: Any) -> str:
-    """Save a (possibly sharded) pytree; returns the checkpoint path."""
+_async_ckptr = None
+
+
+def _async_checkpointer():
+    global _async_ckptr
+    if _async_ckptr is None:
+        import orbax.checkpoint as ocp
+        _async_ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return _async_ckptr
+
+
+def save_sharded(directory: str, step: int, state: Any,
+                 asynchronous: bool = False) -> str:
+    """Save a (possibly sharded) pytree; returns the checkpoint path.
+
+    ``asynchronous=True`` returns as soon as device buffers are snapshotted
+    and writes in a background thread (orbax AsyncCheckpointer) — the train
+    loop keeps stepping while the filesystem write happens.  Call
+    :func:`wait_for_saves` before reading the checkpoint or exiting.
+    Incomplete async writes live under a tmp-suffixed dirname, so
+    :func:`latest_step` never discovers a partial checkpoint."""
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
-    _checkpointer().save(path, state, force=True)
+    if asynchronous:
+        _async_checkpointer().save(path, state, force=True)
+    else:
+        _checkpointer().save(path, state, force=True)
     return path
+
+
+def wait_for_saves() -> None:
+    """Block until all pending asynchronous saves have committed."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
 
 
 def restore_sharded(path: str, template: Any | None = None) -> Any:

@@ -140,32 +140,41 @@ def run_training(config: TrainLoopConfig) -> dict:
     last_saved_step = -1
     window_t0 = time.perf_counter()
     window_steps = 0
-    with profile_trace("train_loop"):
-        for step_idx in range(start_step, config.steps):
-            batch = next(batches)
-            state, metrics = trainer.step(state, batch)
-            window_steps += 1
-            if (step_idx + 1) % config.log_every == 0 or step_idx == config.steps - 1:
-                last_loss = float(metrics["loss"])  # device sync point
-                # Steps dispatch asynchronously; the sync above drains the
-                # whole window, so per-step time is window wall time / steps.
-                dt = (time.perf_counter() - window_t0) / window_steps
-                timer.record(dt)
-                metrics_log.log(step=step_idx + 1, loss=last_loss,
-                                step_time_s=dt,
-                                samples_per_sec_chip=samples_per_sec(
-                                    config.batch_size, dt, n_chips),
-                                grad_norm=float(metrics["grad_norm"]))
-                log.info("step %d loss %.4f (%.1f ms)", step_idx + 1,
-                         last_loss, dt * 1e3)
-                window_t0 = time.perf_counter()
-                window_steps = 0
-            if (config.checkpoint_every
-                    and (step_idx + 1) % config.checkpoint_every == 0):
-                path = sharded_ckpt.save_sharded(config.checkpoint_dir,
-                                                 step_idx + 1, state)
-                last_saved_step = step_idx + 1
-                log.info("checkpoint %s", path)
+    try:
+        with profile_trace("train_loop"):
+            for step_idx in range(start_step, config.steps):
+                batch = next(batches)
+                state, metrics = trainer.step(state, batch)
+                window_steps += 1
+                if ((step_idx + 1) % config.log_every == 0
+                        or step_idx == config.steps - 1):
+                    last_loss = float(metrics["loss"])  # device sync point
+                    # Steps dispatch asynchronously; the sync above drains
+                    # the whole window, so per-step time is window wall
+                    # time / steps.
+                    dt = (time.perf_counter() - window_t0) / window_steps
+                    timer.record(dt)
+                    metrics_log.log(step=step_idx + 1, loss=last_loss,
+                                    step_time_s=dt,
+                                    samples_per_sec_chip=samples_per_sec(
+                                        config.batch_size, dt, n_chips),
+                                    grad_norm=float(metrics["grad_norm"]))
+                    log.info("step %d loss %.4f (%.1f ms)", step_idx + 1,
+                             last_loss, dt * 1e3)
+                    window_t0 = time.perf_counter()
+                    window_steps = 0
+                if (config.checkpoint_every and config.checkpoint_dir
+                        and (step_idx + 1) % config.checkpoint_every == 0):
+                    # async: the loop keeps stepping while orbax writes in
+                    # the background; the finally fence below surfaces any
+                    # write failure even if training dies first
+                    path = sharded_ckpt.save_sharded(config.checkpoint_dir,
+                                                     step_idx + 1, state,
+                                                     asynchronous=True)
+                    last_saved_step = step_idx + 1
+                    log.info("checkpoint %s (async)", path)
+    finally:
+        sharded_ckpt.wait_for_saves()
 
     jax.block_until_ready(state.params)
     end_step = max(start_step, config.steps)

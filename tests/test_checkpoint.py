@@ -124,3 +124,22 @@ def test_manager_load_restores_core_and_optimizer(tmp_path):
     core2.receive_gradients(0, 10, {"w": np.array([1.0], np.float32)})
     np.testing.assert_allclose(core2.get_parameters()["w"],
                                core.get_parameters()["w"])
+
+
+def test_async_sharded_save_roundtrip(tmp_path, rng):
+    """Async orbax save commits after wait_for_saves and restores exactly;
+    latest_step never sees an in-flight tmp dir as a checkpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    from parameter_server_distributed_tpu.checkpoint import sharded as sc
+
+    state = {"w": jnp.asarray(rng.standard_normal((16, 8)), jnp.float32),
+             "step": jnp.asarray(7, jnp.int32)}
+    path = sc.save_sharded(str(tmp_path), 7, state, asynchronous=True)
+    sc.wait_for_saves()
+    assert sc.latest_step(str(tmp_path)) == 7
+    restored = sc.restore_sharded(path, template=state)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(state["w"]))
+    assert int(restored["step"]) == 7

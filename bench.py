@@ -220,7 +220,10 @@ def bench_pushpull() -> dict:
     """p50 latency of PS push+pull round-trips over localhost gRPC
     (BASELINE.md 'push/pull p50' metric).  PSDT_BENCH_WIRE selects the
     tensor payload encoding: f32 (reference repeated-float, default),
-    raw (f32 bytes), bf16 (half the bytes)."""
+    raw (f32 bytes), bf16 (half the bytes).  PSDT_BENCH_PS_SHARDS > 1
+    runs the same 1M-param store name-partitioned across that many PS
+    processes through the sharded fan-out client (config 3's sharded
+    push/pull at the protocol level)."""
     import numpy as np
 
     from parameter_server_distributed_tpu.config import ParameterServerConfig
@@ -228,25 +231,48 @@ def bench_pushpull() -> dict:
     from parameter_server_distributed_tpu.rpc import messages as m
     from parameter_server_distributed_tpu.rpc.service import RpcClient
     from parameter_server_distributed_tpu.server.ps_service import ParameterServer
+    from parameter_server_distributed_tpu.worker.ps_shards import ShardedPSClient
 
     wire_name = os.environ.get("PSDT_BENCH_WIRE", "f32")
     if wire_name not in m.WIRE_DTYPE_NAMES:
         raise ValueError(f"PSDT_BENCH_WIRE={wire_name!r}; "
                          f"options: {sorted(m.WIRE_DTYPE_NAMES)}")
     wire_dtype = m.WIRE_DTYPE_NAMES[wire_name]
+    n_shards = int(os.environ.get("PSDT_BENCH_PS_SHARDS", "1"))
 
-    ps = ParameterServer(ParameterServerConfig(
+    shards = [ParameterServer(ParameterServerConfig(
         bind_address="127.0.0.1", port=0, total_workers=1,
         autosave_period_s=3600.0, checkpoint_dir="/tmp"))
-    port = ps.start()
+        for _ in range(n_shards)]
+    ports = [ps.start() for ps in shards]
+    ps = shards[0]
+    port = ports[0]
     rng = np.random.default_rng(0)
-    params = {"w": rng.standard_normal((1024, 256)).astype(np.float32)}
-    ps.core.initialize_parameters(params)
-    grads = to_wire({"w": rng.standard_normal((1024, 256)).astype(np.float32)},
-                    wire_dtype)
-
-    client = RpcClient(f"127.0.0.1:{port}", m.PARAMETER_SERVER_SERVICE,
-                       m.PARAMETER_SERVER_METHODS)
+    if n_shards > 1:
+        # same total bytes as the unsharded workload, split into 16 tensors
+        # so the name-partitioned store actually spreads across shards
+        # (a single blob would land on one shard whole)
+        params = {f"w{i}": rng.standard_normal((128, 128)).astype(np.float32)
+                  for i in range(16)}
+        grads = to_wire(
+            {name: rng.standard_normal((128, 128)).astype(np.float32)
+             for name in params}, wire_dtype)
+        client = ShardedPSClient([f"127.0.0.1:{p}" for p in ports])
+        from parameter_server_distributed_tpu.worker.ps_shards import shard_owner
+        for i, shard in enumerate(shards):
+            shard.core.initialize_parameters(
+                {name: value for name, value in params.items()
+                 if shard_owner(name, n_shards) == i})
+    else:
+        # the historical ps_pushpull_p50 workload — keep it byte-identical
+        # so BASELINE comparisons stay valid
+        params = {"w": rng.standard_normal((1024, 256)).astype(np.float32)}
+        grads = to_wire(
+            {"w": rng.standard_normal((1024, 256)).astype(np.float32)},
+            wire_dtype)
+        client = RpcClient(f"127.0.0.1:{port}", m.PARAMETER_SERVER_SERVICE,
+                           m.PARAMETER_SERVER_METHODS)
+        ps.core.initialize_parameters(params)
     push_times, pull_times = [], []
     for it in range(60):
         t0 = time.perf_counter()
@@ -260,14 +286,17 @@ def bench_pushpull() -> dict:
                                   wire_dtype=wire_dtype))
         pull_times.append(time.perf_counter() - t0)
     client.close()
-    ps.stop()
+    for shard in shards:
+        shard.stop()
     push_p50 = sorted(push_times)[len(push_times) // 2] * 1e3
     pull_p50 = sorted(pull_times)[len(pull_times) // 2] * 1e3
-    log(f"bench_pushpull: 1M-param store wire={wire_name} "
+    log(f"bench_pushpull: 1M-param store wire={wire_name} shards={n_shards} "
         f"push_p50={push_p50:.2f}ms pull_p50={pull_p50:.2f}ms")
     _ab_host_optimizer()
     metric = ("ps_pushpull_p50" if wire_name == "f32"
               else f"ps_pushpull_p50_{wire_name}")
+    if n_shards > 1:
+        metric += f"_{n_shards}shards"
     return {"metric": metric, "value": round(push_p50 + pull_p50, 2),
             "unit": "ms_roundtrip", "vs_baseline": 1.0}
 

@@ -3,10 +3,15 @@
 Argv contract mirrors the reference (reference: src/coordinator_main.cpp:6-20):
 
     python -m parameter_server_distributed_tpu.cli.coordinator_main \
-        [bind_addr] [ps_addr]
+        [bind_addr] [ps_addr] [--ps-shards=host:port,host:port,...]
 
     bind_addr  default 0.0.0.0:50052
     ps_addr    default 127.0.0.1:50051 (host:port split like the reference)
+
+Extension: ``--ps-shards`` lists ADDITIONAL parameter-server shard
+addresses beyond ps_addr — the store is then name-partitioned across all
+of them and framework workers fan pushes/pulls out per tensor owner
+(reference peers only see ps_addr).
 """
 
 from __future__ import annotations
@@ -15,7 +20,7 @@ import logging
 import sys
 
 from ..config import (DEFAULT_COORDINATOR_PORT, DEFAULT_PS_PORT,
-                      CoordinatorConfig, parse_host_port)
+                      CoordinatorConfig, parse_argv, parse_host_port)
 from ..server.coordinator_service import Coordinator
 
 
@@ -23,13 +28,17 @@ def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
-    bind = argv[0] if len(argv) > 0 else f"0.0.0.0:{DEFAULT_COORDINATOR_PORT}"
-    ps = argv[1] if len(argv) > 1 else f"127.0.0.1:{DEFAULT_PS_PORT}"
+    positional, flags = parse_argv(argv)
+    bind = positional[0] if len(positional) > 0 \
+        else f"0.0.0.0:{DEFAULT_COORDINATOR_PORT}"
+    ps = positional[1] if len(positional) > 1 \
+        else f"127.0.0.1:{DEFAULT_PS_PORT}"
     bind_host, bind_port = parse_host_port(bind, DEFAULT_COORDINATOR_PORT)
     ps_host, ps_port = parse_host_port(ps, DEFAULT_PS_PORT)
+    shards = tuple(s for s in flags.get("ps-shards", "").split(",") if s)
     coordinator = Coordinator(CoordinatorConfig(
         bind_address=bind_host, port=bind_port,
-        ps_address=ps_host, ps_port=ps_port))
+        ps_address=ps_host, ps_port=ps_port, ps_shards=shards))
     coordinator.start()
     print(f"Coordinator server listening on {bind}", flush=True)
     try:

@@ -39,6 +39,11 @@ class CoordinatorConfig:
     ps_port: int = DEFAULT_PS_PORT
     stale_timeout_s: float = STALE_TIMEOUT_S
     reap_period_s: float = REAP_PERIOD_S
+    # Extension: additional PS shard addresses ("host:port") beyond the
+    # primary above — the store is then name-partitioned across all of
+    # them (classic sharded parameter server; workers fan pushes/pulls
+    # out per tensor owner).  Reference topology is the empty default.
+    ps_shards: tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

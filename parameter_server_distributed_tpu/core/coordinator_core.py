@@ -32,9 +32,12 @@ class WorkerRegistryEntry:
 
 class CoordinatorCore:
     def __init__(self, ps_address: str, ps_port: int,
+                 ps_shards: tuple[str, ...] = (),
                  time_fn: Callable[[], float] = time.monotonic):
         self._ps_address = ps_address
         self._ps_port = int(ps_port)
+        # additional shards beyond the primary (see CoordinatorConfig)
+        self._ps_shards = tuple(ps_shards)
         self._workers: dict[int, WorkerRegistryEntry] = {}
         self._lock = threading.Lock()
         self._time = time_fn
@@ -78,6 +81,14 @@ class CoordinatorCore:
         construction; needed for ephemeral ports and PS failover)."""
         self._ps_address = address
         self._ps_port = int(port)
+
+    def get_parameter_server_shards(self) -> list[str]:
+        """All PS shard addresses, primary first.  A single-element list
+        means the unsharded (reference) topology."""
+        return [f"{self._ps_address}:{self._ps_port}", *self._ps_shards]
+
+    def set_parameter_server_shards(self, shards: tuple[str, ...]) -> None:
+        self._ps_shards = tuple(shards)
 
     def remove_stale_workers(self, timeout_s: float = 30.0) -> list[int]:
         """Evict workers silent for > timeout_s

@@ -267,9 +267,14 @@ class GetPSAddressRequest(Message):
 
 
 class GetPSAddressResponse(Message):
+    """Field 3 is a framework extension: the FULL list of parameter-server
+    shard addresses ("host:port", shard index = list index) when the store
+    is partitioned across several PS processes.  Reference peers skip it
+    per proto3 unknown-field rules and use fields 1/2 (shard 0)."""
     FIELDS = (
         Field(1, "address", "string"),
         Field(2, "port", "int32"),
+        Field(3, "shards", "string", repeated=True),
     )
 
 

@@ -55,7 +55,11 @@ class CoordinatorService:
     def GetParameterServerAddress(self, request: m.GetPSAddressRequest,
                                   context) -> m.GetPSAddressResponse:
         addr, port = self.core.get_parameter_server_address()
-        return m.GetPSAddressResponse(address=addr, port=port)
+        shards = self.core.get_parameter_server_shards()
+        # extension field 3 only when actually sharded: reference peers
+        # skip it; framework workers fan out per tensor owner
+        return m.GetPSAddressResponse(address=addr, port=port,
+                                      shards=shards if len(shards) > 1 else [])
 
 
 class Coordinator:
@@ -64,7 +68,8 @@ class Coordinator:
 
     def __init__(self, config: CoordinatorConfig):
         self.config = config
-        self.core = CoordinatorCore(config.ps_address, config.ps_port)
+        self.core = CoordinatorCore(config.ps_address, config.ps_port,
+                                    ps_shards=config.ps_shards)
         self.service = CoordinatorService(self.core)
         self._server: grpc.Server | None = None
         self._stop = threading.Event()

@@ -1,0 +1,177 @@
+"""Sharded parameter-server client: the classic multi-PS topology.
+
+BASELINE config 3 ("4 PS shards / 8 workers, sharded push/pull") has two
+realizations in this framework: inside one SPMD program the fsdp mesh axis
+IS the shard table (parallel/train_step.py), and across processes the
+store is name-partitioned over several ordinary PS servers — this module.
+Each tensor has one owner shard (stable CRC32 hash of its name, identical
+on every worker with no coordination); pushes and pulls fan out per owner
+and responses merge back into one logical store.
+
+`ShardedPSClient` mirrors `rpc.service.RpcClient`'s ``call(method,
+request)`` surface, so `worker.Worker` uses either interchangeably — the
+coordinator's discovery response (GetPSAddressResponse extension field 3)
+decides which gets built.  With one address it degrades to exactly the
+single-PS behavior.
+
+Per-shard semantics stay those of `ParameterServerCore`: every worker
+pushes to EVERY shard each iteration (a shard owning no tensors of the
+current push still receives an empty gradient list), so each shard's
+barrier sees the same contributor set and iteration numbering as the
+unsharded topology.
+"""
+
+from __future__ import annotations
+
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Sequence
+
+from ..rpc import messages as m
+from ..rpc.service import RpcClient
+
+
+def shard_owner(name: str, n_shards: int) -> int:
+    """Stable tensor-name -> shard index (CRC32; identical across
+    processes and runs, unlike Python's randomized hash())."""
+    return zlib.crc32(name.encode("utf-8")) % n_shards
+
+
+class ShardedPSClient:
+    """Fan-out/merge client over N parameter-server shards."""
+
+    def __init__(self, addresses: Sequence[str],
+                 service: str = m.PARAMETER_SERVER_SERVICE,
+                 methods=None):
+        if not addresses:
+            raise ValueError("need at least one PS shard address")
+        methods = methods or m.PARAMETER_SERVER_METHODS
+        self.addresses = list(addresses)
+        self._clients = [RpcClient(addr, service, methods)
+                         for addr in addresses]
+        # shard RPCs are independent — issue them concurrently so the
+        # fan-out latency is max(shard latencies), not their sum
+        self._pool = (ThreadPoolExecutor(
+            max_workers=len(self._clients),
+            thread_name_prefix="ps-shard") if len(self._clients) > 1
+            else None)
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._clients)
+
+    def close(self) -> None:
+        for client in self._clients:
+            client.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------------ call
+    def call(self, method: str, request, timeout: float | None = None):
+        if self.num_shards == 1:
+            return self._clients[0].call(method, request, timeout=timeout)
+        handler = getattr(self, f"_call_{method}", None)
+        if handler is None:
+            raise ValueError(f"unsupported sharded method {method!r}")
+        return handler(request, timeout)
+
+    def _fan_out(self, method: str, requests, timeout):
+        futures = [self._pool.submit(client.call, method, request,
+                                     timeout=timeout)
+                   for client, request in zip(self._clients, requests)]
+        return [f.result() for f in futures]
+
+    # ------------------------------------------------------------- push path
+    def _call_ReceiveGradients(self, request: m.GradientUpdate, timeout):
+        per_shard: list[list] = [[] for _ in range(self.num_shards)]
+        for tensor in request.gradients:
+            per_shard[shard_owner(tensor.name, self.num_shards)].append(tensor)
+        responses = self._fan_out(
+            "ReceiveGradients",
+            [m.GradientUpdate(worker_id=request.worker_id,
+                              iteration=request.iteration, gradients=tensors)
+             for tensors in per_shard], timeout)
+        # Async (bounded-staleness) partial failure: shards that accepted
+        # applied the update ON ARRIVAL, so a blanket worker-level retry
+        # would double-apply their partitions.  Re-push ONLY the rejected
+        # shards, with the SAME payload at the shard's current iteration —
+        # bounded-staleness semantics allow applying the gradient at a
+        # later logical time, and this keeps every shard at exactly one
+        # update per batch.  (Sync mode never produces 'stale' rejections
+        # and its re-pushes overwrite idempotently.)
+        for _ in range(3):
+            stale = [i for i, r in enumerate(responses)
+                     if not r.success and "stale" in r.message]
+            if not stale:
+                break
+            for i in stale:
+                responses[i] = self._clients[i].call(
+                    "ReceiveGradients",
+                    m.GradientUpdate(worker_id=request.worker_id,
+                                     iteration=responses[i].iteration,
+                                     gradients=per_shard[i]),
+                    timeout=timeout)
+        return m.PushResponse(
+            success=all(r.success for r in responses),
+            message="; ".join(sorted({r.message for r in responses})),
+            iteration=max(r.iteration for r in responses),
+            aggregation_complete=all(r.aggregation_complete
+                                     for r in responses),
+            workers_received=min(r.workers_received for r in responses),
+            total_workers=max(r.total_workers for r in responses))
+
+    # ------------------------------------------------------------- pull path
+    def _call_ServeParameters(self, request: m.PullRequest, timeout):
+        responses = self._fan_out("ServeParameters",
+                                  [request] * self.num_shards, timeout)
+        merged: list = []
+        for response in responses:
+            merged.extend(response.parameters)
+        return m.ParameterUpdate(
+            iteration=max(r.iteration for r in responses),
+            parameters=merged,
+            ready=all(r.ready for r in responses))
+
+    # ------------------------------------------------------------------ sync
+    def _call_CheckSyncStatus(self, request: m.SyncStatusRequest, timeout):
+        responses = self._fan_out("CheckSyncStatus",
+                                  [request] * self.num_shards, timeout)
+        return m.SyncStatusResponse(
+            iteration=request.iteration,
+            ready=all(r.ready for r in responses),
+            workers_received=min(r.workers_received for r in responses),
+            total_workers=max(r.total_workers for r in responses))
+
+    # ------------------------------------------------------------ checkpoint
+    def _shard_path(self, path: str, index: int) -> str:
+        """Distinct per-shard checkpoint path: shards may share a
+        filesystem, so an explicit path gets a .shard<N> suffix (shard 0
+        keeps the bare path for reference-tool compatibility)."""
+        if not path or index == 0:
+            return path
+        return f"{path}.shard{index}"
+
+    def _call_SaveCheckpoint(self, request: m.SaveCheckpointRequest, timeout):
+        responses = self._fan_out(
+            "SaveCheckpoint",
+            [m.SaveCheckpointRequest(epoch=request.epoch,
+                                     path=self._shard_path(request.path, i))
+             for i in range(self.num_shards)], timeout)
+        return m.SaveCheckpointResponse(
+            success=all(r.success for r in responses),
+            message="; ".join(sorted({r.message for r in responses})),
+            checkpoint_path=responses[0].checkpoint_path)
+
+    def _call_LoadCheckpoint(self, request: m.LoadCheckpointRequest, timeout):
+        responses = self._fan_out(
+            "LoadCheckpoint",
+            [m.LoadCheckpointRequest(path=self._shard_path(request.path, i))
+             for i in range(self.num_shards)], timeout)
+        merged: list = []
+        for response in responses:
+            merged.extend(response.parameters)
+        return m.LoadCheckpointResponse(
+            success=all(r.success for r in responses),
+            message="; ".join(sorted({r.message for r in responses})),
+            epoch=max(r.epoch for r in responses),
+            parameters=merged)

@@ -107,10 +107,19 @@ class Worker:
         self._ps_address = f"{resp.address}:{resp.port}"
         if self._ps is not None:
             self._ps.close()
-        self._ps = RpcClient(self._ps_address, m.PARAMETER_SERVER_SERVICE,
-                             m.PARAMETER_SERVER_METHODS)
+        if len(resp.shards) > 1:
+            # sharded store (extension field 3): fan pushes/pulls out per
+            # tensor owner across all PS shards (worker/ps_shards.py)
+            from .ps_shards import ShardedPSClient
+            self._ps = ShardedPSClient(list(resp.shards))
+            log.info("worker %d: %d PS shards at %s", self.config.worker_id,
+                     len(resp.shards), list(resp.shards))
+        else:
+            self._ps = RpcClient(self._ps_address, m.PARAMETER_SERVER_SERVICE,
+                                 m.PARAMETER_SERVER_METHODS)
+            log.info("worker %d: PS at %s", self.config.worker_id,
+                     self._ps_address)
         self._reset_wire_negotiation()  # a new PS must re-prove packed support
-        log.info("worker %d: PS at %s", self.config.worker_id, self._ps_address)
 
     def _reset_wire_negotiation(self) -> None:
         """Packed pushes start only after the connected PS proves it honors
@@ -273,6 +282,16 @@ class Worker:
                                   m.SyncStatusRequest(iteration=iteration),
                                   timeout=5.0))
 
+    _expected_names: frozenset[str] | None = None
+
+    def _expected_param_names(self) -> frozenset[str]:
+        """The model's full parameter-name set (cached) — used to detect a
+        PARTIAL pull under the sharded-PS topology, where one restarted
+        shard loses its partition while the others still serve theirs."""
+        if self._expected_names is None:
+            self._expected_names = frozenset(self.trainer.init_params(seed=0))
+        return self._expected_names
+
     # ------------------------------------------------------------ train loop
     def run_iteration(self, iteration: int) -> float:
         """One pull -> compute -> push -> barrier cycle
@@ -282,15 +301,30 @@ class Worker:
         self.last_bootstrap = False
         try:
             _, params = self.pull_parameters(iteration)
-            if not params:
-                # PS empty: every worker pushes the same deterministic init;
-                # the PS bootstrap rule (first aggregated payload *becomes*
-                # the parameters — reference src/parameter_server.cpp:78-81)
-                # then lands exactly the init.  Replaces the reference's
+            missing = (self._expected_param_names() - set(params)
+                       if params else set())
+            if not params or missing:
+                # PS store empty (or, under the sharded topology, one shard
+                # restarted empty — the merged pull is then PARTIAL): every
+                # worker pushes the same deterministic init for the missing
+                # names; the PS bootstrap rule (first aggregated payload
+                # *becomes* the parameters — reference
+                # src/parameter_server.cpp:78-81) then lands exactly the
+                # init on the empty shard(s).  Replaces the reference's
                 # dummy 10x10 fallback (src/worker.cpp:346-353).
                 init = self.trainer.init_params(seed=0)
-                log.info("worker %d: PS empty, pushing deterministic init",
-                         self.config.worker_id)
+                if missing:
+                    # a replacement shard must also re-prove packed support
+                    # before quantized pushes resume
+                    self._reset_wire_negotiation()
+                    init = {name: init[name] for name in missing}
+                    log.warning(
+                        "worker %d: pull missing %d tensors (shard "
+                        "restart?), re-seeding deterministic init",
+                        self.config.worker_id, len(missing))
+                else:
+                    log.info("worker %d: PS empty, pushing deterministic init",
+                             self.config.worker_id)
                 push = self.push_gradients(iteration, init)
                 if not push.success:
                     raise WorkerError(f"bootstrap push rejected: {push.message}")

@@ -1,0 +1,149 @@
+"""Raw text -> token shard pipeline (offline, dependency-free).
+
+Completes the LM data story end to end: a plain text corpus becomes the
+flat binary token shard that `files.token_stream` memmaps, with no
+network-downloaded tokenizer required.
+
+Two tokenizers:
+
+- **ByteTokenizer** (default): UTF-8 bytes as token ids (vocab 256 + BOS/
+  EOS sentinels = 258).  Zero vocabulary to ship, reversible for any
+  text, and the scheme used by byte-level LM baselines.
+- Any Hugging Face tokenizer object can be passed to
+  :func:`encode_file` instead (``transformers`` is an optional install);
+  only ``encode(text) -> list[int]`` and ``vocab_size`` are used.
+
+The shard writer streams the corpus in chunks — constant memory for
+multi-GB inputs — and picks uint16/uint32 by vocabulary size to match
+`files.load_tokens` auto-detection.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Protocol
+
+import numpy as np
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: ids 0-255 are bytes, 256=BOS, 257=EOS."""
+
+    BOS = 256
+    EOS = 257
+    vocab_size = 258
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids) -> str:
+        data = bytes(int(i) for i in np.asarray(ids).reshape(-1)
+                     if int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+def _shard_dtype(vocab_size: int) -> np.dtype:
+    return np.dtype("<u2") if vocab_size <= 1 << 16 else np.dtype("<u4")
+
+
+def _whitespace_chunks(src, chunk_bytes: int):
+    """Yield the corpus in pieces cut only at whitespace: a word never
+    spans two pieces, so subword (BPE) tokenizers produce the same ids as
+    whole-text encoding.  (Tokenizers that add per-call special tokens
+    must be configured not to — e.g. add_special_tokens=False.)"""
+    tail = ""
+    while True:
+        chunk = src.read(chunk_bytes)
+        if not chunk:
+            if tail:
+                yield tail
+            return
+        text = tail + chunk
+        cut = max(text.rfind(" "), text.rfind("\n"))
+        if 0 <= cut < len(text) - 1:
+            tail = text[cut + 1:]
+            text = text[:cut + 1]
+        else:
+            tail = ""
+        if text:
+            yield text
+
+
+def encode_file(text_path: str, shard_path: str,
+                tokenizer: Tokenizer | None = None,
+                chunk_bytes: int = 1 << 20,
+                add_document_tokens: bool = True) -> int:
+    """Tokenize ``text_path`` into the flat binary shard ``shard_path``
+    (the `files.token_stream` format); returns the token count.
+
+    Streams in ~``chunk_bytes`` pieces cut at whitespace (constant memory,
+    subword-tokenizer-safe).  The shard is written to a temp path and
+    os.replace()d into place, so a crash mid-encode never leaves a partial
+    file that later reads as a valid cache.  With ``add_document_tokens``
+    a BOS is written first and an EOS last, when the tokenizer defines
+    those ids."""
+    tokenizer = tokenizer or ByteTokenizer()
+    dtype = _shard_dtype(tokenizer.vocab_size)
+    bos = getattr(tokenizer, "BOS", None)
+    eos = getattr(tokenizer, "EOS", None)
+    total = 0
+    os.makedirs(os.path.dirname(os.path.abspath(shard_path)), exist_ok=True)
+    tmp = f"{shard_path}.tmp.{os.getpid()}"
+    try:
+        with open(text_path, "r", encoding="utf-8") as src, \
+                open(tmp, "wb") as out:
+            if add_document_tokens and bos is not None:
+                out.write(np.asarray([bos], dtype).tobytes())
+                total += 1
+            for text in _whitespace_chunks(src, chunk_bytes):
+                # validate BEFORE narrowing to the shard dtype — a uint16
+                # conversion of an out-of-range id would wrap or overflow
+                # before the check could see it
+                ids = np.asarray(tokenizer.encode(text), np.int64)
+                if ids.size and (int(ids.max()) >= tokenizer.vocab_size
+                                 or int(ids.min()) < 0):
+                    raise ValueError(
+                        f"tokenizer produced id outside [0, "
+                        f"{tokenizer.vocab_size}) = vocab_size range")
+                out.write(ids.astype(dtype).tobytes())
+                total += ids.size
+            if add_document_tokens and eos is not None:
+                out.write(np.asarray([eos], dtype).tobytes())
+                total += 1
+        os.replace(tmp, shard_path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return total
+
+
+def text_stream(text_path: str, batch_size: int, seq_len: int,
+                seed: int = 0, tokenizer: Tokenizer | None = None,
+                cache_dir: str | None = None) -> Iterator[np.ndarray]:
+    """Endless [batch, seq_len] int32 batches straight from a text file:
+    tokenizes to a cached shard next to the source (or in ``cache_dir``)
+    on first use, then streams random crops via `files.token_stream`."""
+    from .files import token_stream
+
+    tokenizer = tokenizer or ByteTokenizer()
+    # cache name carries a tokenizer fingerprint: switching tokenizers
+    # must re-encode, never silently reuse another tokenizer's ids
+    fingerprint = f"{type(tokenizer).__name__}{tokenizer.vocab_size}"
+    suffix = ".bin" if _shard_dtype(tokenizer.vocab_size).itemsize == 2 \
+        else ".u32"
+    base = f"{os.path.basename(text_path)}.{fingerprint}{suffix}"
+    shard = os.path.join(cache_dir or os.path.dirname(
+        os.path.abspath(text_path)), base)
+    if (not os.path.exists(shard)
+            or os.path.getmtime(shard) < os.path.getmtime(text_path)):
+        encode_file(text_path, shard, tokenizer)
+    return token_stream(shard, batch_size, seq_len, seed=seed,
+                        vocab=tokenizer.vocab_size)

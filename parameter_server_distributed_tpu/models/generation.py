@@ -124,15 +124,27 @@ def decode_step(model: Transformer, params: Mapping[str, Array],
 
 
 def sample_token(logits: Array, rng: Array, temperature: float = 0.0,
-                 top_k: int = 0) -> Array:
-    """Greedy when temperature == 0; otherwise temperature softmax sampling,
-    optionally truncated to the top_k logits."""
+                 top_k: int = 0, top_p: float = 0.0) -> Array:
+    """Greedy when temperature == 0; otherwise temperature softmax
+    sampling, optionally truncated to the top_k logits and/or the nucleus
+    (smallest set of tokens with cumulative probability >= top_p)."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     top_k = min(top_k, logits.shape[-1])  # top_k > vocab = no truncation
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # keep a token while the cumulative mass BEFORE it is < top_p
+        # (the argmax token is always kept); cut logits below the
+        # smallest kept one
+        keep = (cumulative - probs) < top_p
+        kth = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                      keepdims=True)
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
@@ -149,8 +161,8 @@ _RUNNERS_LOCK = threading.Lock()
 
 
 def _runner(model: Transformer, max_new_tokens: int, temperature: float,
-            top_k: int):
-    key = (id(model), max_new_tokens, temperature, top_k)
+            top_k: int, top_p: float):
+    key = (id(model), max_new_tokens, temperature, top_k, top_p)
     with _RUNNERS_LOCK:
         run = _RUNNERS.get(key)
         if run is not None:
@@ -161,13 +173,13 @@ def _runner(model: Transformer, max_new_tokens: int, temperature: float,
             max_len = prompt.shape[1] + max_new_tokens
             logits, cache = prefill(model, params, prompt, max_len)
             rng0, rng = jax.random.split(rng)
-            first = sample_token(logits, rng0, temperature, top_k)
+            first = sample_token(logits, rng0, temperature, top_k, top_p)
 
             def body(carry, _):
                 token, cache, rng = carry
                 rng, sub = jax.random.split(rng)
                 logits, cache = decode_step(model, params, token, cache)
-                nxt = sample_token(logits, sub, temperature, top_k)
+                nxt = sample_token(logits, sub, temperature, top_k, top_p)
                 return (nxt, cache, rng), token
 
             (_, _, _), tokens = jax.lax.scan(
@@ -183,14 +195,14 @@ def _runner(model: Transformer, max_new_tokens: int, temperature: float,
 
 def generate(model: Transformer, params: Mapping[str, Array],
              prompt: Array, max_new_tokens: int, *,
-             temperature: float = 0.0, top_k: int = 0,
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
              rng: Array | int = 0) -> Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` [B, S] int32.
     Returns [B, max_new_tokens].  Prefill and the whole decode scan are
     jitted with static shapes; the compiled runner is cached per
-    (model, max_new_tokens, temperature, top_k), so repeated calls with the
-    same shapes do not retrace."""
+    (model, max_new_tokens, temperature, top_k, top_p), so repeated calls
+    with the same shapes do not retrace."""
     if isinstance(rng, int):
         rng = jax.random.key(rng)
-    return _runner(model, max_new_tokens, temperature, top_k)(
+    return _runner(model, max_new_tokens, temperature, top_k, top_p)(
         params, prompt, rng)

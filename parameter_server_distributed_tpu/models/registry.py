@@ -112,9 +112,23 @@ def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
         return model, data_fn(batch_size, seed)
     from ..data.files import npz_stream, token_stream
     if file_kind == "tokens":
-        batches = token_stream(data_path, batch_size,
-                               seq_len=model.config.max_seq, seed=seed,
-                               vocab=model.config.vocab)
+        if data_path.endswith(".txt"):
+            # raw text corpus: byte-tokenize to a cached shard on first
+            # use (data/text.py), then stream crops like any shard.  The
+            # model's vocab must cover the byte tokenizer's 258 ids.
+            from ..data.text import ByteTokenizer, text_stream
+            tok = ByteTokenizer()
+            if model.config.vocab < tok.vocab_size:
+                raise ValueError(
+                    f"model vocab {model.config.vocab} < byte tokenizer "
+                    f"vocab {tok.vocab_size}; use a vocab>=258 LM for .txt")
+            batches = text_stream(data_path, batch_size,
+                                  seq_len=model.config.max_seq, seed=seed,
+                                  tokenizer=tok)
+        else:
+            batches = token_stream(data_path, batch_size,
+                                   seq_len=model.config.max_seq, seed=seed,
+                                   vocab=model.config.vocab)
     else:
         batches = npz_stream(data_path, batch_size, seed=seed)
     return model, batches

@@ -1,0 +1,110 @@
+"""Raw-text -> token-shard pipeline (data/text.py)."""
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.data.text import (ByteTokenizer,
+                                                        encode_file,
+                                                        text_stream)
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = "hello, TPU world! éè€"
+    ids = tok.encode(text)
+    assert all(0 <= i < 256 for i in ids)
+    assert tok.decode(ids) == text
+    assert tok.vocab_size == 258
+
+
+def test_encode_file_and_stream(tmp_path):
+    text = "the quick brown fox jumps over the lazy dog\n" * 50
+    src = tmp_path / "corpus.txt"
+    src.write_text(text)
+    shard = tmp_path / "corpus.bin"
+    n = encode_file(str(src), str(shard), chunk_bytes=64)
+    assert n == len(text.encode()) + 2  # BOS + EOS
+    raw = np.fromfile(shard, dtype="<u2")
+    assert raw[0] == ByteTokenizer.BOS and raw[-1] == ByteTokenizer.EOS
+    assert bytes(raw[1:-1].astype(np.uint8)).decode() == text
+
+    batches = text_stream(str(src), batch_size=4, seq_len=16, seed=0)
+    batch = next(batches)
+    assert batch.shape == (4, 16) and batch.dtype == np.int32
+    assert batch.max() < 258
+
+
+def test_text_stream_caches_shard(tmp_path):
+    src = tmp_path / "c.txt"
+    src.write_text("abcdefgh" * 100)
+    it1 = text_stream(str(src), 2, 8)
+    next(it1)
+    shards = [p for p in tmp_path.iterdir() if p.suffix == ".bin"]
+    assert len(shards) == 1
+    mtime = shards[0].stat().st_mtime_ns
+    it2 = text_stream(str(src), 2, 8)  # reuses the cached shard
+    next(it2)
+    assert shards[0].stat().st_mtime_ns == mtime
+
+
+def test_registry_trains_lm_from_txt(tmp_path):
+    from parameter_server_distributed_tpu.models.registry import (
+        get_model_and_batches)
+
+    src = tmp_path / "c.txt"
+    src.write_text("to be or not to be, that is the question. " * 40)
+    model, batches = get_model_and_batches("small_lm", 4,
+                                           data_path=str(src))
+    batch = next(batches)
+    assert batch.shape == (4, model.config.max_seq)
+    # byte ids fit the small_lm vocab (1024 >= 258)
+    assert 0 <= batch.min() and batch.max() < 258
+
+
+def test_registry_rejects_txt_for_tiny_vocab(tmp_path, monkeypatch):
+    """The registry's .txt path errors for models whose vocab cannot
+    cover the byte tokenizer's 258 ids."""
+    import jax.numpy as jnp
+
+    import parameter_server_distributed_tpu.models.registry as reg
+    from parameter_server_distributed_tpu.models.transformer import small_lm
+
+    monkeypatch.setitem(
+        reg.REGISTRY, "tiny_vocab_lm",
+        (lambda: small_lm(vocab=96, seq=16, dtype=jnp.float32),
+         reg._lm_batches, "tokens"))
+    src = tmp_path / "c.txt"
+    src.write_text("hello")
+    with pytest.raises(ValueError, match="byte tokenizer"):
+        reg.get_model_and_batches("tiny_vocab_lm", 2, data_path=str(src))
+
+
+def test_encode_chunks_match_whole_text(tmp_path):
+    """Whitespace-cut chunking must produce identical shards regardless of
+    chunk size (the subword-tokenizer safety contract)."""
+    text = ("supercalifragilistic words of many different lengths "
+            "spread across lines\nand paragraphs " * 30)
+    src = tmp_path / "c.txt"
+    src.write_text(text)
+    encode_file(str(src), str(tmp_path / "whole.bin"),
+                chunk_bytes=1 << 24)
+    encode_file(str(src), str(tmp_path / "tiny.bin"), chunk_bytes=17)
+    whole = np.fromfile(tmp_path / "whole.bin", dtype="<u2")
+    tiny = np.fromfile(tmp_path / "tiny.bin", dtype="<u2")
+    np.testing.assert_array_equal(whole, tiny)
+
+
+def test_failed_encode_leaves_no_shard(tmp_path):
+    """A tokenizer error mid-encode must not leave a partial shard that a
+    later call would treat as a valid cache."""
+    class BrokenTokenizer(ByteTokenizer):
+        def encode(self, text):
+            return [999999]  # out of vocab -> ValueError mid-stream
+
+    src = tmp_path / "c.txt"
+    src.write_text("some text")
+    shard = tmp_path / "c.bin"
+    with pytest.raises(ValueError, match="vocab_size"):
+        encode_file(str(src), str(shard), BrokenTokenizer())
+    assert not shard.exists()
+    assert not list(tmp_path.glob("*.tmp.*"))

@@ -95,7 +95,7 @@ def test_repeated_generate_does_not_retrace(rng):
     params = model.init_params(3)
     prompt = jnp.asarray(rng.integers(0, 96, (1, 4)), jnp.int32)
     generate(model, params, prompt, 3)
-    run = generation._RUNNERS[(id(model), 3, 0.0, 0)]
+    run = generation._RUNNERS[(id(model), 3, 0.0, 0, 0.0)]
     traces_before = run._cache_size()
     out1 = generate(model, params, prompt, 3)
     out2 = generate(model, params, prompt, 3)
@@ -132,3 +132,29 @@ def test_gqa_cache_is_smaller(rng):
     gqa = init_cache(gqa_model(1), batch=2, max_len=16)
     assert gqa.k.shape[3] == 1 and mha.k.shape[3] == 4
     assert gqa.k.size == mha.k.size // 4
+
+
+def test_top_p_restricts_support():
+    """probs ~ [.5, .3, .15, .05]: top_p=0.6 keeps exactly {0, 1} (tokens
+    whose preceding cumulative mass < p); top_p>=1 truncates nothing."""
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    picks = {int(sample_token(logits, jax.random.key(i), temperature=1.0,
+                              top_p=0.6)[0]) for i in range(60)}
+    assert picks == {0, 1}
+    picks_all = {int(sample_token(logits, jax.random.key(i),
+                                  temperature=1.0, top_p=0.0)[0])
+                 for i in range(120)}
+    assert picks_all == {0, 1, 2, 3}
+    # argmax token always survives even a tiny p
+    assert int(sample_token(logits, jax.random.key(0), temperature=1.0,
+                            top_p=1e-6)[0]) == 0
+
+
+def test_top_p_generation_seeded(rng):
+    model = tiny_model()
+    params = model.init_params(4)
+    prompt = jnp.asarray(rng.integers(0, 96, (2, 4)), jnp.int32)
+    a = generate(model, params, prompt, 5, temperature=0.9, top_p=0.8, rng=3)
+    b = generate(model, params, prompt, 5, temperature=0.9, top_p=0.8, rng=3)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.asarray(a).min() >= 0 and np.asarray(a).max() < 96

@@ -67,12 +67,19 @@ def wait_for_saves() -> None:
 def restore_sharded(path: str, template: Any | None = None) -> Any:
     """Restore a pytree.  With ``template`` (a pytree of sharded arrays or
     jax.ShapeDtypeStruct with shardings), shards land directly on their
-    owning devices — pass the target TrainState to reshard on restore."""
+    owning devices — pass the target TrainState to reshard on restore.
+    Without a template, leaves come back as HOST numpy arrays: the
+    checkpoint may have been written by a mesh this process doesn't have
+    (e.g. pst-generate reading a pst-train checkpoint on one chip), so no
+    device placement is assumed."""
     import orbax.checkpoint as ocp
 
     checkpointer = _checkpointer()
     if template is None:
-        return checkpointer.restore(path)
+        meta = checkpointer.metadata(path).item_metadata
+        restore_args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta.tree)
+        return checkpointer.restore(path, restore_args=restore_args)
 
     def as_restore_type(leaf):
         if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
@@ -83,6 +90,18 @@ def restore_sharded(path: str, template: Any | None = None) -> Any:
     restore_args = jax.tree.map(as_restore_type, template)
     return checkpointer.restore(path, item=template,
                                 restore_args=restore_args)
+
+
+def restore_latest(directory: str, template: Any | None = None):
+    """Restore the newest ``step_N`` checkpoint under ``directory``;
+    returns (step, state) or (None, None) when none exists.  The single
+    discovery+restore path shared by the train loop's --resume and the
+    generation CLI."""
+    step = latest_step(directory)
+    if step is None:
+        return None, None
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    return step, restore_sharded(path, template=template)
 
 
 def latest_step(directory: str) -> int | None:

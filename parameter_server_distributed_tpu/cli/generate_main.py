@@ -1,0 +1,102 @@
+"""Text generation CLI for the LM flagship (KV-cached decode).
+
+    python -m parameter_server_distributed_tpu.cli.generate_main \
+        --model=small_lm --prompt="the quick brown" --max-new=64 \
+        [--ckpt=path.ckpt | --ckpt-dir=orbax_dir] \
+        [--temperature=0.8] [--top-k=40] [--top-p=0.9] [--seed=0] \
+        [--dtype=bf16] [--tokens=1,2,3]
+
+Parameters come from (in priority order) ``--ckpt`` (the host binary
+checkpoint format — same files the PS writes), ``--ckpt-dir`` (latest
+orbax sharded TrainState from pst-train), or fresh ``--seed`` init (demo
+mode).  Prompts are byte-tokenized (data/text.ByteTokenizer, vocab 258 —
+works for any registry LM whose vocab covers it); ``--tokens`` supplies
+raw comma-separated token ids instead.  Output is the decoded
+continuation (or raw ids with ``--tokens``).
+
+The reference has no inference path at all (its gradient computation is a
+0.01-constant stub — reference src/worker.cpp:316-329); this CLI completes
+the train -> checkpoint -> generate loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from ..config import parse_argv
+
+
+def load_params(flags: dict, model, seed: int):
+    """Resolve the parameter source; returns (params, description)."""
+    if flags.get("ckpt"):
+        from ..checkpoint import codec
+        epoch, iteration, params = codec.load(flags["ckpt"])
+        return params, f"host checkpoint {flags['ckpt']} (iter {iteration})"
+    if flags.get("ckpt-dir"):
+        from ..checkpoint import sharded as sc
+        step, state = sc.restore_latest(flags["ckpt-dir"])
+        if step is None:
+            raise FileNotFoundError(
+                f"no step_N checkpoints under {flags['ckpt-dir']!r}")
+        params = state["params"] if isinstance(state, dict) else state.params
+        return params, f"sharded checkpoint step {step}"
+    return model.init_params(seed), f"fresh init (seed {seed})"
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    _, flags = parse_argv(argv)
+
+    import numpy as np
+
+    from ..data.text import ByteTokenizer
+    from ..models.generation import generate
+    from ..models.registry import get_model_and_batches
+    from ..models.transformer import Transformer
+
+    model, _ = get_model_and_batches(flags.get("model", "small_lm"), 1,
+                                     dtype=flags.get("dtype", ""))
+    if not isinstance(model, Transformer):
+        raise ValueError(f"--model={flags.get('model')!r} is not an LM")
+    seed = int(flags.get("seed", 0))
+    params, source = load_params(flags, model, seed)
+    print(f"params: {source}", file=sys.stderr)
+
+    tokenizer = ByteTokenizer()
+    if flags.get("tokens"):
+        ids = [int(t) for t in flags["tokens"].split(",")]
+        decode_text = False
+    else:
+        from ..data.text import require_vocab
+        prompt_text = flags.get("prompt", "hello")
+        require_vocab(model.config.vocab, tokenizer)
+        ids = tokenizer.encode(prompt_text) or [tokenizer.BOS]
+        decode_text = True
+    if any(not 0 <= t < model.config.vocab for t in ids):
+        raise ValueError(f"token id out of range for vocab "
+                         f"{model.config.vocab}")
+
+    top_k = int(flags.get("top-k", 0))
+    top_p = float(flags.get("top-p", 0.0))
+    # sampling flags imply sampling: temperature 0 (greedy) would silently
+    # ignore top-k/top-p, so they default the temperature to 1.0
+    default_temp = "1.0" if (top_k or top_p) else "0.0"
+    temperature = float(flags.get("temperature", default_temp))
+    prompt = np.asarray([ids], np.int32)
+    out = generate(model, params, prompt,
+                   int(flags.get("max-new", 64)),
+                   temperature=temperature, top_k=top_k, top_p=top_p,
+                   rng=seed)
+    tokens = np.asarray(out)[0]
+    if decode_text:
+        print(tokenizer.decode(tokens), flush=True)
+    else:
+        print(",".join(str(int(t)) for t in tokens), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

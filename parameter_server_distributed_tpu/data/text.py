@@ -50,6 +50,17 @@ class ByteTokenizer:
         return data.decode("utf-8", errors="replace")
 
 
+def require_vocab(model_vocab: int, tokenizer: "Tokenizer") -> None:
+    """Raise when a model's vocabulary cannot cover the tokenizer's ids —
+    the single guard shared by the registry's .txt path and the
+    generation CLI."""
+    if model_vocab < tokenizer.vocab_size:
+        raise ValueError(
+            f"model vocab {model_vocab} < byte tokenizer vocab "
+            f"{tokenizer.vocab_size}; use a vocab>={tokenizer.vocab_size} "
+            f"LM for text prompts/corpora")
+
+
 def _shard_dtype(vocab_size: int) -> np.dtype:
     return np.dtype("<u2") if vocab_size <= 1 << 16 else np.dtype("<u4")
 

@@ -116,12 +116,9 @@ def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
             # raw text corpus: byte-tokenize to a cached shard on first
             # use (data/text.py), then stream crops like any shard.  The
             # model's vocab must cover the byte tokenizer's 258 ids.
-            from ..data.text import ByteTokenizer, text_stream
+            from ..data.text import ByteTokenizer, require_vocab, text_stream
             tok = ByteTokenizer()
-            if model.config.vocab < tok.vocab_size:
-                raise ValueError(
-                    f"model vocab {model.config.vocab} < byte tokenizer "
-                    f"vocab {tok.vocab_size}; use a vocab>=258 LM for .txt")
+            require_vocab(model.config.vocab, tok)
             batches = text_stream(data_path, batch_size,
                                   seq_len=model.config.max_seq, seed=seed,
                                   tokenizer=tok)

@@ -125,10 +125,10 @@ def run_training(config: TrainLoopConfig) -> dict:
 
     start_step = 0
     if config.resume and config.checkpoint_dir:
-        last = sharded_ckpt.latest_step(config.checkpoint_dir)
+        last, restored = sharded_ckpt.restore_latest(config.checkpoint_dir,
+                                                     template=state)
         if last is not None:
-            state = sharded_ckpt.restore_sharded(
-                f"{config.checkpoint_dir}/step_{last}", template=state)
+            state = restored
             start_step = int(np.asarray(state.step))
             log.info("resumed from step %d", start_step)
 

@@ -158,3 +158,50 @@ def test_top_p_generation_seeded(rng):
     b = generate(model, params, prompt, 5, temperature=0.9, top_p=0.8, rng=3)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert np.asarray(a).min() >= 0 and np.asarray(a).max() < 96
+
+
+def test_generate_cli_end_to_end(tmp_path, rng, capsys):
+    """pst-generate: train -> host checkpoint -> decode text, all through
+    the CLI entry point."""
+    from parameter_server_distributed_tpu.checkpoint import codec
+    from parameter_server_distributed_tpu.cli.generate_main import main
+    from parameter_server_distributed_tpu.models.registry import (
+        get_model_and_batches)
+
+    model, _ = get_model_and_batches("small_lm", 1)
+    params = {k: np.asarray(v) for k, v in model.init_params(0).items()}
+    ckpt = tmp_path / "m.ckpt"
+    codec.save(str(ckpt), 1, 10, params)
+
+    rc = main(["--model=small_lm", f"--ckpt={ckpt}", "--prompt=ab",
+               "--max-new=4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.endswith("\n") and len(out) >= 1  # decoded text printed
+
+    # raw token-id mode
+    rc = main(["--model=small_lm", f"--ckpt={ckpt}", "--tokens=1,2,3",
+               "--max-new=3", "--temperature=0.5", "--top-p=0.9"])
+    assert rc == 0
+    ids = [int(t) for t in capsys.readouterr().out.strip().split(",")]
+    assert len(ids) == 3 and all(0 <= i < 1024 for i in ids)
+
+    with pytest.raises(ValueError, match="out of range"):
+        main(["--model=small_lm", f"--ckpt={ckpt}", "--tokens=99999"])
+
+
+def test_generate_cli_from_sharded_checkpoint(tmp_path, capsys):
+    """pst-train orbax checkpoint -> pst-generate --ckpt-dir round-trip."""
+    from parameter_server_distributed_tpu.cli.generate_main import main
+    from parameter_server_distributed_tpu.config import MeshConfig
+    from parameter_server_distributed_tpu.parallel.train_loop import (
+        TrainLoopConfig, run_training)
+
+    run_training(TrainLoopConfig(
+        model="small_lm", batch_size=8, steps=2, optimizer="sgd",
+        learning_rate=0.1, mesh=MeshConfig(data=8),
+        checkpoint_dir=str(tmp_path), checkpoint_every=2, log_every=1))
+    rc = main(["--model=small_lm", f"--ckpt-dir={tmp_path}",
+               "--prompt=hello", "--max-new=4"])
+    assert rc == 0
+    assert "sharded checkpoint step 2" in capsys.readouterr().err

@@ -15,7 +15,7 @@ def _pyproject():
 
 def test_console_scripts_resolve():
     scripts = _pyproject()["project"]["scripts"]
-    assert len(scripts) == 5
+    assert len(scripts) == 6
     for name, target in scripts.items():
         module, _, attr = target.partition(":")
         fn = getattr(importlib.import_module(module), attr)

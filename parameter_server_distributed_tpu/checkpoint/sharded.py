@@ -114,3 +114,24 @@ def latest_step(directory: str) -> int | None:
             step = int(match.group(1))
             best = step if best is None else max(best, step)
     return best
+
+
+def prune_checkpoints(directory: str, keep: int) -> list[int]:
+    """Delete all but the newest ``keep`` committed step_N checkpoints
+    (the sharded analogue of the host manager's retention —
+    checkpoint/manager.py).  In-flight async writes live under
+    tmp-suffixed names the step regex doesn't match, so they are never
+    touched.  Returns the deleted step numbers."""
+    import shutil
+
+    if keep <= 0 or not os.path.isdir(directory):
+        return []
+    steps = sorted(
+        (int(match.group(1)) for name in os.listdir(directory)
+         if (match := _STEP_RE.search(name))), reverse=True)
+    deleted = []
+    for step in steps[keep:]:
+        shutil.rmtree(os.path.join(directory, f"step_{step}"),
+                      ignore_errors=True)
+        deleted.append(step)
+    return deleted

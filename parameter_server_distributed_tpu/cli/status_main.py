@@ -31,23 +31,31 @@ def main(argv: list[str] | None = None) -> int:
 
     print(f"coordinator: {coordinator_addr}")
     print(f"parameter server: {ps_addr.address}:{ps_addr.port}")
+    shards = list(ps_addr.shards)
+    if len(shards) > 1:
+        print(f"ps shards: {len(shards)}")
+        for i, shard in enumerate(shards):
+            print(f"  shard {i}: {shard}")
     print(f"registered workers: {workers.total_workers}")
     for w in workers.workers:
         print(f"  worker {w.worker_id}: {w.address}:{w.port} ({w.hostname})")
 
     iteration = int(flags.get("iteration", 0))
-    try:
-        with RpcClient(f"{ps_addr.address}:{ps_addr.port}",
-                       m.PARAMETER_SERVER_SERVICE,
-                       m.PARAMETER_SERVER_METHODS) as ps:
-            sync = ps.call("CheckSyncStatus",
-                           m.SyncStatusRequest(iteration=iteration),
-                           timeout=5.0)
-        print(f"sync status @ iteration {sync.iteration}: "
-              f"ready={sync.ready} received={sync.workers_received}/"
-              f"{sync.total_workers}")
-    except Exception as exc:  # noqa: BLE001
-        print(f"parameter server unreachable: {exc}")
+    targets = shards if len(shards) > 1 \
+        else [f"{ps_addr.address}:{ps_addr.port}"]
+    for i, target in enumerate(targets):
+        label = f"shard {i} " if len(targets) > 1 else ""
+        try:
+            with RpcClient(target, m.PARAMETER_SERVER_SERVICE,
+                           m.PARAMETER_SERVER_METHODS) as ps:
+                sync = ps.call("CheckSyncStatus",
+                               m.SyncStatusRequest(iteration=iteration),
+                               timeout=5.0)
+            print(f"{label}sync status @ iteration {sync.iteration}: "
+                  f"ready={sync.ready} received={sync.workers_received}/"
+                  f"{sync.total_workers}")
+        except Exception as exc:  # noqa: BLE001
+            print(f"{label}parameter server unreachable: {exc}")
     return 0
 
 

@@ -5,7 +5,7 @@
         --schedule=cosine --warmup=10 --clip-norm=1.0 --accum=2 \
         --data=/data/train.npz \
         --mesh=data:2,fsdp:2,tensor:2 --ckpt-dir=/tmp/ckpt --ckpt-every=50 \
-        --resume --metrics=/tmp/metrics.jsonl
+        --ckpt-keep=3 --resume --metrics=/tmp/metrics.jsonl
 
 ``--attention=dense|flash|ring|ulysses`` selects the attention
 implementation for transformer models: flash = pallas kernels (shard_mapped
@@ -93,6 +93,7 @@ def main(argv: list[str] | None = None) -> int:
         mesh=parse_mesh(flags.get("mesh", "")),
         checkpoint_dir=flags.get("ckpt-dir", ""),
         checkpoint_every=int(flags.get("ckpt-every", 0)),
+        checkpoint_keep=int(flags.get("ckpt-keep", 0)),
         log_every=int(flags.get("log-every", 10)),
         seed=int(flags.get("seed", 0)),
         resume="resume" in flags,

@@ -51,6 +51,7 @@ class TrainLoopConfig:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     checkpoint_dir: str = ""
     checkpoint_every: int = 0     # steps; 0 = disabled
+    checkpoint_keep: int = 0      # retention: newest N kept (0 = all)
     log_every: int = 10
     seed: int = 0
     resume: bool = False
@@ -173,8 +174,16 @@ def run_training(config: TrainLoopConfig) -> dict:
                                                      asynchronous=True)
                     last_saved_step = step_idx + 1
                     log.info("checkpoint %s (async)", path)
+                    if config.checkpoint_keep:
+                        # prunes COMMITTED checkpoints only; the save above
+                        # is still writing under a tmp-suffixed name
+                        sharded_ckpt.prune_checkpoints(
+                            config.checkpoint_dir, config.checkpoint_keep)
     finally:
         sharded_ckpt.wait_for_saves()
+        if config.checkpoint_keep and config.checkpoint_dir:
+            sharded_ckpt.prune_checkpoints(config.checkpoint_dir,
+                                           config.checkpoint_keep)
 
     jax.block_until_ready(state.params)
     end_step = max(start_step, config.steps)
@@ -187,4 +196,9 @@ def run_training(config: TrainLoopConfig) -> dict:
             and last_saved_step != config.steps):
         summary["checkpoint"] = sharded_ckpt.save_sharded(
             config.checkpoint_dir, config.steps, state)
+        if config.checkpoint_keep:
+            # the fallback save lands after the finally-block prune; prune
+            # again so keep=N never ends the run with N+1 checkpoints
+            sharded_ckpt.prune_checkpoints(config.checkpoint_dir,
+                                           config.checkpoint_keep)
     return summary

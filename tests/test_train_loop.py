@@ -104,3 +104,32 @@ def test_attention_flag_rejected_for_non_transformer():
                              mesh=MeshConfig(data=8))
     with pytest.raises(ValueError, match="transformer"):
         run_training(config)
+
+
+def test_checkpoint_retention(tmp_path):
+    """--ckpt-keep prunes all but the newest N committed checkpoints."""
+    config = TrainLoopConfig(
+        model="mnist_mlp", batch_size=16, steps=12, optimizer="sgd",
+        learning_rate=0.05, mesh=MeshConfig(data=8),
+        checkpoint_dir=str(tmp_path), checkpoint_every=2,
+        checkpoint_keep=2, log_every=6)
+    run_training(config)
+    steps = sorted(int(p.name.split("_")[1]) for p in tmp_path.iterdir()
+                   if p.name.startswith("step_"))
+    assert steps == [10, 12]
+    # the survivors restore fine
+    assert sc.latest_step(str(tmp_path)) == 12
+
+
+def test_checkpoint_retention_with_final_fallback_save(tmp_path):
+    """steps not a multiple of ckpt-every: the end-of-run fallback save
+    must not leave keep+1 checkpoints behind."""
+    config = TrainLoopConfig(
+        model="mnist_mlp", batch_size=16, steps=13, optimizer="sgd",
+        learning_rate=0.05, mesh=MeshConfig(data=8),
+        checkpoint_dir=str(tmp_path), checkpoint_every=2,
+        checkpoint_keep=2, log_every=6)
+    run_training(config)
+    steps = sorted(int(p.name.split("_")[1]) for p in tmp_path.iterdir()
+                   if p.name.startswith("step_"))
+    assert steps == [12, 13]

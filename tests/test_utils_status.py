@@ -81,3 +81,40 @@ def test_hybrid_mesh_config_single_host():
     assert config.num_devices == 8 and config.tensor == 2
     with pytest.raises(ValueError):
         hybrid_mesh_config(tensor=3)
+
+
+def test_status_main_shows_shards(tmp_path, capsys):
+    """pst-status lists shard addresses and per-shard sync state when the
+    coordinator reports a sharded store."""
+    from parameter_server_distributed_tpu.cli.status_main import main
+    from parameter_server_distributed_tpu.config import (CoordinatorConfig,
+                                                         ParameterServerConfig)
+    from parameter_server_distributed_tpu.server.coordinator_service import (
+        Coordinator)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+
+    shards = []
+    ports = []
+    for i in range(2):
+        ps = ParameterServer(ParameterServerConfig(
+            bind_address="127.0.0.1", port=0, total_workers=1,
+            checkpoint_dir=str(tmp_path / f"s{i}"), autosave_period_s=600.0))
+        shards.append(ps)
+        ports.append(ps.start())
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+        ps_port=ports[0], ps_shards=(f"127.0.0.1:{ports[1]}",),
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    try:
+        rc = main([f"127.0.0.1:{coord_port}"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "ps shards: 2" in out
+        assert f"shard 1: 127.0.0.1:{ports[1]}" in out
+        assert out.count("sync status") == 2  # one per shard
+    finally:
+        coordinator.stop()
+        for ps in shards:
+            ps.stop()

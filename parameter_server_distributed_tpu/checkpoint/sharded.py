@@ -104,34 +104,41 @@ def restore_latest(directory: str, template: Any | None = None):
     return step, restore_sharded(path, template=template)
 
 
-def latest_step(directory: str) -> int | None:
-    best = None
+def _committed_steps(directory: str) -> list[int]:
+    """Step numbers of COMMITTED step_N checkpoints (in-flight async writes
+    live under tmp-suffixed names the regex rejects), newest first.  The
+    single discovery scan shared by latest_step and prune_checkpoints."""
     if not os.path.isdir(directory):
-        return None
-    for name in os.listdir(directory):
-        match = _STEP_RE.search(name)
-        if match:
-            step = int(match.group(1))
-            best = step if best is None else max(best, step)
-    return best
+        return []
+    return sorted(
+        (int(match.group(1)) for name in os.listdir(directory)
+         if (match := _STEP_RE.search(name))), reverse=True)
+
+
+def latest_step(directory: str) -> int | None:
+    steps = _committed_steps(directory)
+    return steps[0] if steps else None
 
 
 def prune_checkpoints(directory: str, keep: int) -> list[int]:
     """Delete all but the newest ``keep`` committed step_N checkpoints
     (the sharded analogue of the host manager's retention —
-    checkpoint/manager.py).  In-flight async writes live under
-    tmp-suffixed names the step regex doesn't match, so they are never
-    touched.  Returns the deleted step numbers."""
+    checkpoint/manager.py).  Multi-controller runs must call this from
+    ONE process (the train loop gates on process_index() == 0 — orbax
+    saves are coordinated, deletion must be too).  Returns the deleted
+    step numbers; failures are logged, not swallowed."""
+    import logging
     import shutil
 
-    if keep <= 0 or not os.path.isdir(directory):
+    if keep <= 0:
         return []
-    steps = sorted(
-        (int(match.group(1)) for name in os.listdir(directory)
-         if (match := _STEP_RE.search(name))), reverse=True)
     deleted = []
-    for step in steps[keep:]:
-        shutil.rmtree(os.path.join(directory, f"step_{step}"),
-                      ignore_errors=True)
-        deleted.append(step)
+    for step in _committed_steps(directory)[keep:]:
+        path = os.path.join(directory, f"step_{step}")
+        try:
+            shutil.rmtree(path)
+            deleted.append(step)
+        except OSError as exc:
+            logging.getLogger("pst.checkpoint").warning(
+                "retention could not delete %s: %s", path, exc)
     return deleted

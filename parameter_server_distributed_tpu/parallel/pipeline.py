@@ -157,9 +157,10 @@ class PipelinedTransformerLM:
                         if name.startswith(self.BLOCK_PREFIX)}
         h = pipeline_apply(self._stage_fn, stage_params, h, self.mesh,
                            self.num_microbatches)
-        logits = self.inner.final_logits(params, h)
+        if self.config.loss_chunk:
+            return self.inner._chunked_next_token_nll(params, h, tokens)
         from ..models.transformer import next_token_nll
-        return next_token_nll(logits, tokens)
+        return next_token_nll(self.inner.final_logits(params, h), tokens)
 
 
 def pipeline_rule(mesh: Mesh):

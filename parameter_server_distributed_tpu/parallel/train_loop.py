@@ -174,14 +174,17 @@ def run_training(config: TrainLoopConfig) -> dict:
                                                      asynchronous=True)
                     last_saved_step = step_idx + 1
                     log.info("checkpoint %s (async)", path)
-                    if config.checkpoint_keep:
+                    if config.checkpoint_keep and jax.process_index() == 0:
                         # prunes COMMITTED checkpoints only; the save above
-                        # is still writing under a tmp-suffixed name
+                        # is still writing under a tmp-suffixed name.
+                        # process 0 only: deletion of the shared directory
+                        # must not race across controllers
                         sharded_ckpt.prune_checkpoints(
                             config.checkpoint_dir, config.checkpoint_keep)
     finally:
         sharded_ckpt.wait_for_saves()
-        if config.checkpoint_keep and config.checkpoint_dir:
+        if (config.checkpoint_keep and config.checkpoint_dir
+                and jax.process_index() == 0):
             sharded_ckpt.prune_checkpoints(config.checkpoint_dir,
                                            config.checkpoint_keep)
 
@@ -196,7 +199,7 @@ def run_training(config: TrainLoopConfig) -> dict:
             and last_saved_step != config.steps):
         summary["checkpoint"] = sharded_ckpt.save_sharded(
             config.checkpoint_dir, config.steps, state)
-        if config.checkpoint_keep:
+        if config.checkpoint_keep and jax.process_index() == 0:
             # the fallback save lands after the finally-block prune; prune
             # again so keep=N never ends the run with N+1 checkpoints
             sharded_ckpt.prune_checkpoints(config.checkpoint_dir,

@@ -282,3 +282,31 @@ def test_gqa_transformer_trains_and_matches_mha_when_equal(rng):
 
     with pytest.raises(ValueError, match="n_kv_heads"):
         Transformer(dataclasses.replace(config, n_kv_heads=3))
+
+
+def test_chunked_cross_entropy_matches_unchunked(rng):
+    """loss_chunk must be numerically invisible: same loss, same gradients
+    — only peak logits memory changes."""
+    import dataclasses
+
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                               d_ff=64, max_seq=16, dtype=jnp.float32)
+    tokens = rng.integers(0, 64, (4, 16)).astype(np.int32)
+    plain = Transformer(config)
+    chunked = Transformer(dataclasses.replace(config, loss_chunk=4))
+    params = plain.init_params(0)
+
+    la = float(jax.jit(plain.loss)(params, tokens))
+    lb = float(jax.jit(chunked.loss)(params, tokens))
+    np.testing.assert_allclose(lb, la, rtol=1e-6)
+
+    g_a = jax.jit(jax.grad(plain.loss))(params, tokens)
+    g_b = jax.jit(jax.grad(chunked.loss))(params, tokens)
+    for name in g_a:
+        np.testing.assert_allclose(np.asarray(g_b[name]),
+                                   np.asarray(g_a[name]), rtol=2e-5,
+                                   atol=1e-7, err_msg=name)
+
+    bad = Transformer(dataclasses.replace(config, loss_chunk=5))
+    with pytest.raises(ValueError, match="divide"):
+        jax.jit(bad.loss)(params, tokens)

@@ -239,3 +239,30 @@ def test_pipelined_lm_remat_gradients_match(rng):
         np.testing.assert_allclose(np.asarray(g_b[name]),
                                    np.asarray(g_a[name]), rtol=1e-5,
                                    atol=1e-7, err_msg=name)
+
+
+def test_pipelined_lm_chunked_loss_matches(rng):
+    """config.loss_chunk flows through the pipelined loss: same loss and
+    gradients as the unchunked pipelined run."""
+    import dataclasses
+
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    plain, piped, mesh, tokens = _lm_fixtures(rng)
+    chunked_model = Transformer(dataclasses.replace(plain.config,
+                                                    loss_chunk=4))
+    piped_chunked = PipelinedTransformerLM(chunked_model, mesh,
+                                           num_microbatches=2)
+    params = piped.init_params(0)
+    la = float(jax.jit(piped.loss)(params, tokens))
+    lb = float(jax.jit(piped_chunked.loss)(params, tokens))
+    np.testing.assert_allclose(lb, la, rtol=1e-6)
+    g_a = jax.jit(jax.grad(piped.loss))(params, tokens)
+    g_b = jax.jit(jax.grad(piped_chunked.loss))(params, tokens)
+    for name in g_a:
+        np.testing.assert_allclose(np.asarray(g_b[name]),
+                                   np.asarray(g_a[name]), rtol=2e-5,
+                                   atol=1e-7, err_msg=name)

@@ -15,7 +15,8 @@ parallelism over the mesh's seq axis (pair with --mesh=seq:N).
 ``--dtype=bf16`` trains in bfloat16 (f32 MXU accumulation) for models
 whose factory takes a dtype; ``--remat`` recomputes layer activations in
 the backward pass (jax.checkpoint, transformer LMs) — the long-context
-memory/FLOPs trade.
+memory/FLOPs trade.  ``--no-remat`` forces it off for models that default
+it on (lm_350m); neither flag keeps the model's default.
 
 ``--mesh=pipe:P`` trains transformer models with GPipe pipeline
 parallelism (parallel/pipeline.py): layer blocks live on their pipe rank,
@@ -82,7 +83,8 @@ def main(argv: list[str] | None = None) -> int:
         attention=flags.get("attention", "dense"),
         microbatches=int(flags.get("microbatches", 0)),
         model_dtype=flags.get("dtype", ""),
-        remat="remat" in flags,
+        remat=(False if "no-remat" in flags
+               else True if "remat" in flags else None),
         steps=int(flags.get("steps", 100)),
         optimizer=flags.get("optimizer", "adam"),
         learning_rate=float(flags.get("lr", 1e-3)),

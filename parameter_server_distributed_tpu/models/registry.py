@@ -72,7 +72,7 @@ DTYPE_NAMES = {"f32": "float32", "float32": "float32",
 
 
 def _model_kwargs(model_fn: Callable, name: str, dtype: str,
-                  remat: bool) -> dict:
+                  remat: bool | None) -> dict:
     """The subset of {dtype, remat} this factory supports; error (rather
     than silently ignore) when the user asked for one it doesn't."""
     import inspect
@@ -89,21 +89,28 @@ def _model_kwargs(model_fn: Callable, name: str, dtype: str,
         if not (has_var_kw or "dtype" in sig.parameters):
             raise ValueError(f"model {name!r} does not take a dtype")
         kwargs["dtype"] = getattr(jnp, DTYPE_NAMES[dtype])
-    if remat:
-        if not (has_var_kw or "remat" in sig.parameters):
+    if remat is not None:
+        if has_var_kw or "remat" in sig.parameters:
+            kwargs["remat"] = remat
+        elif remat:
+            # asking for remat on a model that can't honor the memory
+            # saving is an error; forcing it OFF on a model that never
+            # remats is a no-op (lets --no-remat / PSDT_BENCH_REMAT=0
+            # sweep across the whole registry)
             raise ValueError(f"model {name!r} does not support remat "
                              f"(transformer LMs only)")
-        kwargs["remat"] = True
     return kwargs
 
 
 def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
                           data_path: str = "", dtype: str = "",
-                          remat: bool = False):
+                          remat: bool | None = None):
     """Build (model, batch iterator).  ``data_path`` switches from the
     synthetic loaders to file-backed data (data/files.py), dispatched by
     the registry entry's declared file-data kind.  ``dtype`` ("f32"/"bf16")
-    and ``remat`` forward to factories that support them."""
+    and ``remat`` forward to factories that support them; remat is
+    tri-state — None keeps the factory's default (e.g. lm_350m defaults
+    remat on), True/False force it for factories that take the keyword."""
     if name not in REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {sorted(REGISTRY)}")
     model_fn, data_fn, file_kind = REGISTRY[name]

@@ -7,10 +7,9 @@ accumulation math, but blocks stream from HBM instead of rotating over ICI.
 All kernels stream K/V (or Q/dO) through the innermost grid dimension, so
 VMEM residency per step is O(block^2) regardless of sequence length — no
 full-sequence tensor is ever resident.  Running state (online-softmax
-m/l/acc) lives in VMEM scratch that persists across the sequential TPU
-grid; grad accumulators live in revisited output blocks whose index map is
-constant over the streaming dimension (the standard pallas accumulation
-pattern).  Blocks entirely outside the causal triangle are skipped twice
+m/l/acc, grad accumulators) lives in f32 VMEM scratch that persists across
+the sequential TPU grid; outputs are written once in the stream's final
+step, in the input dtype.  Blocks entirely outside the causal triangle are skipped twice
 over: `pl.when` skips the compute, and the streaming index_map CLAMPS the
 block index to the causal frontier so consecutive out-of-range steps
 revisit the same resident block and trigger no HBM DMA — block fetch count
@@ -18,7 +17,9 @@ matches the old per-kernel fori_loop frontier exactly.
 
 Backward is the standard two-kernel flash decomposition: the forward saves
 only O and the per-row logsumexp (O(S) residuals, not the O(S^2) attention
-matrix), probabilities are recomputed blockwise from them:
+matrix), probabilities are recomputed blockwise from them (the
+softmax-jacobian delta row term is recomputed in-kernel from O/dO rather
+than materialized in HBM):
 
 - dQ kernel: grid (BH, q-blocks, k-blocks), K/V streaming innermost;
 - dK/dV kernel: grid (BH, k-blocks, q-blocks), Q/dO streaming innermost.
@@ -44,7 +45,7 @@ def _iota_pos(start, rows: int, cols: int, axis: int):
     return start + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), axis)
 
 
-def _kv_frontier_spec(block: int, block_q: int, block_k: int, d: int | None):
+def _kv_frontier_spec(block: int, block_q: int, block_k: int, d: int):
     """BlockSpec for a K/V operand streamed over inner grid dim j, with the
     block index clamped to the causal frontier of q block i: steps past the
     frontier revisit the resident block (no DMA) and `pl.when` skips their
@@ -52,20 +53,20 @@ def _kv_frontier_spec(block: int, block_q: int, block_k: int, d: int | None):
     def clamp(i, j):
         return jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
 
-    if d is None:
-        return pl.BlockSpec((1, block), lambda b, i, j: (b, clamp(i, j)))
     return pl.BlockSpec((1, block, d), lambda b, i, j: (b, clamp(i, j), 0))
 
 
-def _q_frontier_spec(block: int, block_q: int, block_k: int, d: int | None):
+def _q_frontier_spec(block: int, block_q: int, block_k: int,
+                     d: int | None = None):
     """BlockSpec for a Q/dO operand streamed over inner grid dim j in the
     dK/dV kernel: indices before this k block's first attending q block are
-    clamped up to it."""
+    clamped up to it.  d=None selects the lane-major per-row layout
+    (lse: (BH, 1, S) blocked (1, 1, block), see _flash_fwd)."""
     def clamp(i, j):
         return jnp.maximum(j, (i * block_k) // block_q)
 
     if d is None:
-        return pl.BlockSpec((1, block), lambda b, i, j: (b, clamp(i, j)))
+        return pl.BlockSpec((1, 1, block), lambda b, i, j: (b, 0, clamp(i, j)))
     return pl.BlockSpec((1, block, d), lambda b, i, j: (b, clamp(i, j), 0))
 
 
@@ -102,23 +103,32 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
     def _finalize():
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+        # lse rows live along lanes in HBM (see _flash_fwd layout note)
+        lse_ref[0] = (m_ref[...] + jnp.log(l)).T      # [1, block_q]
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, block_q: int,
                block_k: int, interpret: bool) -> tuple[jax.Array, jax.Array]:
-    """q,k,v: [BH, S, D] -> (o [BH, S, D], lse [BH, S])."""
+    """q,k,v: [BH, S, D] -> (o [BH, S, D], lse [BH, 1, S]).
+
+    lse layout: one logsumexp per q row, stored LANE-major as (BH, 1, S)
+    and blocked (1, 1, block_q).  The naive (BH, S) array blocked
+    (1, block_q) violates Mosaic's last-two-dims tiling rule, and the
+    sublane-major (BH, S, 1) alternative satisfies it but lane-pads 1->128
+    (a 128x HBM expansion — 2 GB at batch 256).  Lane-major costs one
+    (block_q, 1)->(1, block_q) transpose per q-block finalize and pads
+    only sublanes (1->8)."""
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     kernel = functools.partial(_flash_fwd_kernel, block_q=block_q,
                                block_k=block_k, scale=scale)
     qblk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    qrow = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    qrow = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
     kblk = _kv_frontier_spec(block_k, block_q, block_k, d)
     o, lse = pl.pallas_call(
         kernel,
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype),      # o
-                   jax.ShapeDtypeStruct((bh, s), jnp.float32)],    # lse
+                   jax.ShapeDtypeStruct((bh, 1, s), jnp.float32)],  # lse
         grid=(bh, s // block_q, s // block_k),
         in_specs=[qblk, kblk, kblk],
         out_specs=[qblk, qrow],
@@ -130,16 +140,24 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, block_q: int,
     return o, lse
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_q: int, block_k: int, scale: float):
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
+                         dq_ref, acc_ref, delta_ref, *, block_q: int,
+                         block_k: int, scale: float):
     """dQ for one q block, K/V streaming over the inner grid dimension.
-    ds = p * (dp - delta); dq = scale * ds @ K."""
+    ds = p * (dp - delta); dq = scale * ds @ K.  Accumulates in f32 VMEM
+    scratch and writes the (possibly bf16) output once at stream end —
+    an f32 output array would double the HBM footprint (and pad 2x when
+    D=64).  delta (softmax-jacobian row correction sum_d g*o) is computed
+    here from the resident o/g blocks rather than materialized in HBM."""
     qi, kj = pl.program_id(1), pl.program_id(2)
     q_start, k_start = qi * block_q, kj * block_k
 
     @pl.when(kj == 0)
     def _init():
-        dq_ref[...] = jnp.zeros_like(dq_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        delta_ref[...] = jnp.sum(
+            g_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1, keepdims=True)
 
     @pl.when(k_start < q_start + block_q)
     def _compute():
@@ -147,30 +165,36 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         g = g_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0].T                             # [block_q, 1]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         mask = (_iota_pos(q_start, block_q, 1, 0)
                 >= _iota_pos(k_start, 1, block_k, 1))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dq_ref[0] += jnp.dot(ds, k,
-                             preferred_element_type=jnp.float32) * scale
+        ds = p * (dp - delta_ref[...])
+        acc_ref[...] += jnp.dot(ds, k,
+                                preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kj == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, block_k: int,
-                          scale: float):
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                          block_k: int, scale: float):
     """dK/dV for one k block, Q/dO streaming over the inner grid dimension.
-    dv = p^T @ dO; dk = scale * ds^T @ Q."""
+    dv = p^T @ dO; dk = scale * ds^T @ Q.  Same scratch-accumulate /
+    write-once layout as the dQ kernel; delta is recomputed per streamed
+    q block (one [block_q, D] elementwise reduce — cheap next to the four
+    matmuls)."""
     ki, qj = pl.program_id(1), pl.program_id(2)
     k_start, q_start = ki * block_k, qj * block_q
 
     @pl.when(qj == 0)
     def _init():
-        dk_ref[...] = jnp.zeros_like(dk_ref)
-        dv_ref[...] = jnp.zeros_like(dv_ref)
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
     @pl.when(q_start + block_q > k_start)  # q block reaches this k block
     def _compute():
@@ -178,55 +202,63 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         g = g_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0].T                             # [block_q, 1]
+        delta = jnp.sum(
+            g * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         mask = (_iota_pos(q_start, block_q, 1, 0)
                 >= _iota_pos(k_start, 1, block_k, 1))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)   # [block_q, block_k]
-        dv_ref[0] += jnp.dot(p.T, g, preferred_element_type=jnp.float32)
+        dv_acc[...] += jnp.dot(p.T, g, preferred_element_type=jnp.float32)
         dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk_ref[0] += jnp.dot(ds.T, q,
-                             preferred_element_type=jnp.float32) * scale
+        dk_acc[...] += jnp.dot(ds.T, q,
+                               preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qj == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, o, lse, g, block_q: int, block_k: int,
                interpret: bool):
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    # delta_i = sum_d g_id * o_id — the softmax-jacobian row correction
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
     qblk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    qrow = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    qrow = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
     kblk = _kv_frontier_spec(block_k, block_q, block_k, d)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         grid=(bh, s // block_q, s // block_k),
-        in_specs=[qblk, kblk, kblk, qblk, qrow, qrow],
+        in_specs=[qblk, kblk, kblk, qblk, qblk, qrow],
         out_specs=qblk,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, o, g, lse)
 
     # streaming roles swap: k blocks are the outer (revisited) dimension
     kout = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
     qstream = _q_frontier_spec(block_q, block_q, block_k, d)
-    qstream_row = _q_frontier_spec(block_q, block_q, block_k, None)
+    qstream_row = _q_frontier_spec(block_q, block_q, block_k)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, scale=scale),
-        out_shape=[jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
-                   jax.ShapeDtypeStruct((bh, s, d), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
         grid=(bh, s // block_k, s // block_q),
-        in_specs=[qstream, kout, kout, qstream, qstream_row, qstream_row],
+        in_specs=[qstream, kout, kout, qstream, qstream, qstream_row],
         out_specs=[kout, kout],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
-    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+    )(q, k, v, o, g, lse)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -40,7 +40,8 @@ class TrainLoopConfig:
     attention: str = "dense"      # dense | flash | ring | ulysses (LM models)
     microbatches: int = 0         # pipeline microbatches (0 = pipe size)
     model_dtype: str = ""         # "" = model default | f32 | bf16
-    remat: bool = False           # jax.checkpoint per layer (LM models)
+    remat: bool | None = None     # per-layer jax.checkpoint (LM models);
+                                  # None = model default, True/False force
     steps: int = 100
     optimizer: str = "adam"
     learning_rate: float = 1e-3

@@ -106,6 +106,14 @@ def run_training(config: TrainLoopConfig) -> dict:
             model.mesh = mesh
             attn = select_attention(config.attention, mesh)
             model.attention_fn = attn or causal_attention
+            if mesh.shape["seq"] > 1 and model.config.loss_chunk:
+                # chunked cross-entropy scans over seq chunks, which
+                # under sequence parallelism would slice single devices'
+                # shards out of the seq-sharded activations and serialize
+                # the LM head; per-device logits are already O(S/N *
+                # vocab) there, so drop the chunking instead
+                import dataclasses as _dc
+                model.config = _dc.replace(model.config, loss_chunk=0)
     else:
         if config.attention != "dense":
             raise ValueError(

@@ -99,6 +99,40 @@ def test_run_training_attention_selection(attention, mesh):
     assert np.isfinite(summary["final_loss"])
 
 
+def test_seq_mesh_drops_loss_chunk(monkeypatch):
+    """Under sequence parallelism the chunked-cross-entropy scan would
+    slice per-device shards out of the seq-sharded activations and
+    serialize the LM head, so run_training disables it (per-device logits
+    are already O(S/N * vocab) there); a seq-less mesh keeps it."""
+    from parameter_server_distributed_tpu.models import registry as reg
+    from parameter_server_distributed_tpu.parallel import train_loop as tl
+
+    seen = {}
+    real = reg.get_model_and_batches
+
+    def spy(*args, **kwargs):
+        model, batches = real(*args, **kwargs)
+        import dataclasses
+        model.config = dataclasses.replace(model.config, loss_chunk=8)
+        seen["model"] = model
+        return model, batches
+
+    monkeypatch.setattr(tl, "get_model_and_batches", spy)
+    config = TrainLoopConfig(
+        model="small_lm", batch_size=4, steps=1, optimizer="sgd",
+        attention="ring", mesh=MeshConfig(sequence=2, data=4))
+    summary = run_training(config)
+    assert np.isfinite(summary["final_loss"])
+    assert seen["model"].config.loss_chunk == 0
+
+    monkeypatch.setattr(tl, "get_model_and_batches", spy)
+    summary = run_training(TrainLoopConfig(
+        model="small_lm", batch_size=8, steps=1, optimizer="sgd",
+        mesh=MeshConfig(data=8)))
+    assert np.isfinite(summary["final_loss"])
+    assert seen["model"].config.loss_chunk == 8
+
+
 def test_attention_flag_rejected_for_non_transformer():
     config = TrainLoopConfig(model="mnist_mlp", attention="flash", steps=1,
                              mesh=MeshConfig(data=8))

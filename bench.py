@@ -19,8 +19,9 @@ carry the exception text in a "note" field.
 Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
 (mfu | samples | pushpull | async | generate; default mfu),
 PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
-(default 2), PSDT_BENCH_CPU_TIMEOUT (s, default 420), PSDT_BENCH_REMAT
-(unset = model default, 0/1 force off/on for transformer LMs).
+(default 2), PSDT_BENCH_CPU_TIMEOUT (s, default 420), PSDT_BENCH_REMAT /
+PSDT_BENCH_SCAN (unset = model default, 0/1 force off/on — remat and
+lax.scan-over-layers for transformer LMs).
 """
 
 from __future__ import annotations
@@ -107,11 +108,13 @@ def bench_mfu() -> dict:
             Transformer, select_attention)
         batch = int(os.environ.get("PSDT_BENCH_BATCH",
                                    "256" if on_tpu else "32"))
-        # tri-state remat override: unset = model default, 0/1 force
-        remat_env = os.environ.get("PSDT_BENCH_REMAT", "")
-        remat = None if remat_env == "" else remat_env not in ("0", "off")
-        model, batches = get_model_and_batches(model_name, batch,
-                                               remat=remat)
+        # tri-state overrides: unset = model default, 0/1 force
+        def tri(env):
+            value = os.environ.get(env, "")
+            return None if value == "" else value not in ("0", "off")
+        model, batches = get_model_and_batches(
+            model_name, batch, remat=tri("PSDT_BENCH_REMAT"),
+            scan=tri("PSDT_BENCH_SCAN"))
         batch_data = next(batches)
         n_params = model.num_params()
         # MFU only where the FLOP count is known and the model is big

@@ -9,7 +9,10 @@
 Parameters come from (in priority order) ``--ckpt`` (the host binary
 checkpoint format — same files the PS writes), ``--ckpt-dir`` (latest
 orbax sharded TrainState from pst-train), or fresh ``--seed`` init (demo
-mode).  Prompts are byte-tokenized (data/text.ByteTokenizer, vocab 258 —
+mode).  Either layer layout decodes: stores from ``--scan-layers``
+training (stacked ``blocks/*``) and unrolled stores are converted to
+whatever layout this process's model uses (``--scan-layers`` /
+``--no-scan-layers`` / model default).  Prompts are byte-tokenized (data/text.ByteTokenizer, vocab 258 —
 works for any registry LM whose vocab covers it); ``--tokens`` supplies
 raw comma-separated token ids instead.  Output is the decoded
 continuation (or raw ids with ``--tokens``).
@@ -57,13 +60,25 @@ def main(argv: list[str] | None = None) -> int:
     from ..models.registry import get_model_and_batches
     from ..models.transformer import Transformer
 
-    model, _ = get_model_and_batches(flags.get("model", "small_lm"), 1,
-                                     dtype=flags.get("dtype", ""))
+    model, _ = get_model_and_batches(
+        flags.get("model", "small_lm"), 1, dtype=flags.get("dtype", ""),
+        scan=(False if "no-scan-layers" in flags
+              else True if "scan-layers" in flags else None))
     if not isinstance(model, Transformer):
         raise ValueError(f"--model={flags.get('model')!r} is not an LM")
     seed = int(flags.get("seed", 0))
     params, source = load_params(flags, model, seed)
     print(f"params: {source}", file=sys.stderr)
+
+    # checkpoints port across layer layouts: a store trained with
+    # --scan-layers (stacked blocks/*) decodes on an unrolled model and
+    # vice versa — convert to whatever layout this model instance uses
+    from ..models.transformer import stack_layers, unstack_layers
+    stacked_store = any(n.startswith("blocks/") for n in params)
+    if model.config.scan_layers and not stacked_store:
+        params = stack_layers(params, model.config.n_layers)
+    elif not model.config.scan_layers and stacked_store:
+        params = unstack_layers(params)
 
     tokenizer = ByteTokenizer()
     if flags.get("tokens"):

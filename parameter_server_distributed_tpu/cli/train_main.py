@@ -17,6 +17,9 @@ whose factory takes a dtype; ``--remat`` recomputes layer activations in
 the backward pass (jax.checkpoint, transformer LMs) — the long-context
 memory/FLOPs trade.  ``--no-remat`` forces it off for models that default
 it on (lm_350m); neither flag keeps the model's default.
+``--scan-layers`` / ``--no-scan-layers`` likewise force lax.scan over
+stacked layer weights (depth-independent compile time) or the unrolled
+loop (cross-layer XLA fusion) for transformer LMs.
 
 ``--mesh=pipe:P`` trains transformer models with GPipe pipeline
 parallelism (parallel/pipeline.py): layer blocks live on their pipe rank,
@@ -85,6 +88,8 @@ def main(argv: list[str] | None = None) -> int:
         model_dtype=flags.get("dtype", ""),
         remat=(False if "no-remat" in flags
                else True if "remat" in flags else None),
+        scan_layers=(False if "no-scan-layers" in flags
+                     else True if "scan-layers" in flags else None),
         steps=int(flags.get("steps", 100)),
         optimizer=flags.get("optimizer", "adam"),
         learning_rate=float(flags.get("lr", 1e-3)),

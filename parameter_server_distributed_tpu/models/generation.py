@@ -96,8 +96,10 @@ def decode_step(model: Transformer, params: Mapping[str, Array],
     new_k, new_v = cache.k, cache.v
     groups = c.kv_groups
     for i in range(c.n_layers):
-        p = f"layer{i}"
-        q, k, v = model.qkv(params, p, h, positions)  # k/v: [B, 1, KV, D]
+        # layer_view resolves either param layout (unrolled layer<i>/* or
+        # scan_layers' stacked blocks/*)
+        lp, p = model.layer_view(params, i)
+        q, k, v = model.qkv(lp, p, h, positions)  # k/v: [B, 1, KV, D]
         new_k = jax.lax.dynamic_update_slice(
             new_k, k[None].astype(new_k.dtype), (i, 0, pos, 0, 0))
         new_v = jax.lax.dynamic_update_slice(
@@ -116,7 +118,7 @@ def decode_step(model: Transformer, params: Mapping[str, Array],
         attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, new_v[i],
                           preferred_element_type=jnp.float32).astype(c.dtype)
         attn = attn.reshape(b, s_q, c.n_heads, c.head_dim)
-        h = model.attn_residual(params, p, h, attn)
+        h = model.attn_residual(lp, p, h, attn)
         # MoE-aware, drop-free at decode time; aux loss unused here
         h, _ = model.ffn_residual(params, i, h, decode=True)
     logits = model.final_logits(params, h)

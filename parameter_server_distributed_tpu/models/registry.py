@@ -72,7 +72,7 @@ DTYPE_NAMES = {"f32": "float32", "float32": "float32",
 
 
 def _model_kwargs(model_fn: Callable, name: str, dtype: str,
-                  remat: bool | None) -> dict:
+                  remat: bool | None, scan: bool | None = None) -> dict:
     """The subset of {dtype, remat} this factory supports; error (rather
     than silently ignore) when the user asked for one it doesn't."""
     import inspect
@@ -99,22 +99,30 @@ def _model_kwargs(model_fn: Callable, name: str, dtype: str,
             # sweep across the whole registry)
             raise ValueError(f"model {name!r} does not support remat "
                              f"(transformer LMs only)")
+    if scan is not None:
+        if has_var_kw or "scan_layers" in sig.parameters:
+            kwargs["scan_layers"] = scan
+        elif scan:
+            raise ValueError(f"model {name!r} does not support scan_layers "
+                             f"(dense transformer LMs only)")
     return kwargs
 
 
 def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
                           data_path: str = "", dtype: str = "",
-                          remat: bool | None = None):
+                          remat: bool | None = None,
+                          scan: bool | None = None):
     """Build (model, batch iterator).  ``data_path`` switches from the
     synthetic loaders to file-backed data (data/files.py), dispatched by
-    the registry entry's declared file-data kind.  ``dtype`` ("f32"/"bf16")
-    and ``remat`` forward to factories that support them; remat is
-    tri-state — None keeps the factory's default (e.g. lm_350m defaults
-    remat on), True/False force it for factories that take the keyword."""
+    the registry entry's declared file-data kind.  ``dtype`` ("f32"/"bf16"),
+    ``remat``, and ``scan`` (lax.scan over stacked layers) forward to
+    factories that support them; remat/scan are tri-state — None keeps the
+    factory's default (e.g. lm_350m defaults remat on), True/False force
+    it for factories that take the keyword."""
     if name not in REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {sorted(REGISTRY)}")
     model_fn, data_fn, file_kind = REGISTRY[name]
-    model = model_fn(**_model_kwargs(model_fn, name, dtype, remat))
+    model = model_fn(**_model_kwargs(model_fn, name, dtype, remat, scan))
     if not data_path:
         return model, data_fn(batch_size, seed)
     from ..data.files import npz_stream, token_stream

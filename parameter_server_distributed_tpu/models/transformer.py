@@ -68,6 +68,14 @@ class TransformerConfig:
     # LM loss scaled by ``moe_aux_coef``.
     moe_every: int = 0
     moe_experts: int = 8
+    # Scan over layers: store block weights stacked with a leading [L]
+    # axis (``blocks/<suffix>``) and run the layer loop as one
+    # ``lax.scan`` body traced ONCE, instead of n_layers Python-unrolled
+    # copies.  Compile time and HLO size stop growing with depth (the
+    # 24-layer flagship's jit drops from minutes to one layer's worth);
+    # the trade is that XLA cannot specialize or fuse across layer
+    # boundaries.  Requires homogeneous layers (no MoE interleaving).
+    scan_layers: bool = False
     moe_capacity: float = 1.25
     moe_aux_coef: float = 0.01
 
@@ -238,6 +246,10 @@ class Transformer:
             raise ValueError(
                 f"n_heads={config.n_heads} must divide by "
                 f"n_kv_heads={config.kv_heads}")
+        if config.scan_layers and config.moe_every > 0:
+            raise ValueError(
+                "scan_layers needs homogeneous layers; MoE interleaving "
+                "(moe_every > 0) makes the scan body layer-dependent")
         self.config = config
         if config.moe_every > 0:
             from .moe import MoEConfig, MoELayer
@@ -259,22 +271,31 @@ class Transformer:
     def param_shapes(self) -> dict[str, tuple[int, ...]]:
         c = self.config
         shapes: dict[str, tuple[int, ...]] = {"embed/tok": (c.vocab, c.d_model)}
-        for i in range(c.n_layers):
-            p = f"layer{i}"
-            kv_dim = c.kv_heads * c.head_dim
-            shapes[f"{p}/ln1/scale"] = (c.d_model,)
-            shapes[f"{p}/attn/wq"] = (c.d_model, c.d_model)
-            shapes[f"{p}/attn/wk"] = (c.d_model, kv_dim)
-            shapes[f"{p}/attn/wv"] = (c.d_model, kv_dim)
-            shapes[f"{p}/attn/wo"] = (c.d_model, c.d_model)
-            shapes[f"{p}/ln2/scale"] = (c.d_model,)
-            if c.is_moe_layer(i):
-                shapes[f"{p}/moe/router/w"] = (c.d_model, c.moe_experts)
-                shapes[f"{p}/moe/w1"] = (c.moe_experts, c.d_model, c.d_ff)
-                shapes[f"{p}/moe/w2"] = (c.moe_experts, c.d_ff, c.d_model)
-            else:
-                shapes[f"{p}/mlp/w1"] = (c.d_model, c.d_ff)
-                shapes[f"{p}/mlp/w2"] = (c.d_ff, c.d_model)
+        kv_dim = c.kv_heads * c.head_dim
+        block = {"ln1/scale": (c.d_model,),
+                 "attn/wq": (c.d_model, c.d_model),
+                 "attn/wk": (c.d_model, kv_dim),
+                 "attn/wv": (c.d_model, kv_dim),
+                 "attn/wo": (c.d_model, c.d_model),
+                 "ln2/scale": (c.d_model,)}
+        if c.scan_layers:
+            # stacked layout: one [L, ...] array per block weight, scanned
+            for suffix, shape in block.items():
+                shapes[f"blocks/{suffix}"] = (c.n_layers, *shape)
+            shapes["blocks/mlp/w1"] = (c.n_layers, c.d_model, c.d_ff)
+            shapes["blocks/mlp/w2"] = (c.n_layers, c.d_ff, c.d_model)
+        else:
+            for i in range(c.n_layers):
+                p = f"layer{i}"
+                for suffix, shape in block.items():
+                    shapes[f"{p}/{suffix}"] = shape
+                if c.is_moe_layer(i):
+                    shapes[f"{p}/moe/router/w"] = (c.d_model, c.moe_experts)
+                    shapes[f"{p}/moe/w1"] = (c.moe_experts, c.d_model, c.d_ff)
+                    shapes[f"{p}/moe/w2"] = (c.moe_experts, c.d_ff, c.d_model)
+                else:
+                    shapes[f"{p}/mlp/w1"] = (c.d_model, c.d_ff)
+                    shapes[f"{p}/mlp/w2"] = (c.d_ff, c.d_model)
         shapes["final_ln/scale"] = (c.d_model,)
         shapes["lm_head/w"] = (c.d_model, c.vocab)
         return shapes
@@ -376,6 +397,19 @@ class Transformer:
         ff = jax.nn.gelu(dot(x, params[f"{prefix}/mlp/w1"]).astype(c.dtype))
         return h + dot(ff, params[f"{prefix}/mlp/w2"]).astype(c.dtype)
 
+    def layer_view(self, params: Mapping[str, Array],
+                   layer: int) -> tuple[Mapping[str, Array], str]:
+        """(param view, key prefix) for one layer in either layout: the
+        store itself with prefix ``layer<i>`` when unrolled, or a sliced
+        ``blk/*`` view of the stacked ``blocks/*`` arrays under
+        ``scan_layers`` — so per-layer consumers (generation's decode
+        loop) work on both layouts."""
+        if self.config.scan_layers:
+            return ({f"blk/{name[len('blocks/'):]}": value[layer]
+                     for name, value in params.items()
+                     if name.startswith("blocks/")}, "blk")
+        return params, f"layer{layer}"
+
     def ffn_residual(self, params: Mapping[str, Array], layer: int,
                      h: Array, decode: bool = False) -> tuple[Array, Array]:
         """The layer's FFN branch: dense MLP or Switch MoE per the config.
@@ -383,9 +417,10 @@ class Transformer:
         runs MoE drop-free (capacity = token count): capacity dropping is a
         batch-global training mechanism and cannot be reproduced causally
         during KV-cached decoding."""
-        p = f"layer{layer}"
         if not self.config.is_moe_layer(layer):
-            return self.mlp_residual(params, p, h), jnp.zeros((), jnp.float32)
+            lp, p = self.layer_view(params, layer)
+            return self.mlp_residual(lp, p, h), jnp.zeros((), jnp.float32)
+        p = f"layer{layer}"
         x = rms_norm(h, params[f"{p}/ln2/scale"])
         cap = h.shape[0] * h.shape[1] if decode else None
         moe_out, aux = self._moe.apply(params, x, prefix=f"{p}/",
@@ -407,16 +442,46 @@ class Transformer:
         kvs: list = []
         aux_total = jnp.zeros((), jnp.float32)
 
-        def layer_body(layer_params, i, h):
-            p = f"layer{i}"
+        def layer_body(layer_params, i, h, p=None):
+            p = f"layer{i}" if p is None else p
             q, k, v = self.qkv(layer_params, p, h, positions)
             attn = self.attention_fn(q, repeat_kv(k, c.kv_groups),
                                      repeat_kv(v, c.kv_groups))
             h = self.attn_residual(layer_params, p, h, attn)
             h = self._constrain(h, ("data", "fsdp"), "seq", None)
-            h, aux = self.ffn_residual(layer_params, i, h)
+            if i is None:  # scan body: homogeneous dense layers
+                h = self.mlp_residual(layer_params, p, h)
+                aux = jnp.zeros((), jnp.float32)
+            else:
+                h, aux = self.ffn_residual(layer_params, i, h)
             h = self._constrain(h, ("data", "fsdp"), "seq", None)
             return h, aux, (k, v)
+
+        if c.scan_layers:
+            # one scan body traced once, block weights streamed from their
+            # stacked [L, ...] arrays — compile cost is depth-independent
+            blocks = {name[len("blocks/"):]: value
+                      for name, value in params.items()
+                      if name.startswith("blocks/")}
+
+            def scan_body(h, blk):
+                view = {f"blk/{suffix}": value
+                        for suffix, value in blk.items()}
+                h, aux, kv = layer_body(view, None, h, p="blk")
+                return h, (kv if collect_kv else aux)
+
+            if c.remat and not collect_kv:
+                # scan's internals already rule out the CSE hazard that
+                # jax.checkpoint's default prevent_cse=True guards against;
+                # the default would insert optimization barriers per step
+                scan_body = jax.checkpoint(scan_body, prevent_cse=False)
+            h, ys = jax.lax.scan(scan_body, h, blocks)
+            if collect_kv:
+                k_stack, v_stack = ys  # [L, B, S, H, D] each
+                kvs = [(k_stack[i], v_stack[i]) for i in range(c.n_layers)]
+            else:
+                aux_total = jnp.sum(ys)
+            return h, kvs, aux_total
 
         # remat recomputes layer activations in the backward pass (O(1)
         # layers of residuals); never combined with collect_kv, which
@@ -488,6 +553,44 @@ class Transformer:
         return total / (batch * (seq - 1))
 
 
+def stack_layers(params: Mapping[str, Array], n_layers: int) -> dict:
+    """Convert an unrolled store (``layer<i>/<suffix>``) to the stacked
+    ``scan_layers`` layout (``blocks/<suffix>`` with leading [L]) — e.g.
+    to load a checkpoint trained unrolled into a scanned model.  Dense
+    layers only (stacking requires homogeneous blocks)."""
+    out: dict = {}
+    by_suffix: dict[str, list] = {}
+    for i in range(n_layers):
+        prefix = f"layer{i}/"
+        for name, value in params.items():
+            if name.startswith(prefix):
+                by_suffix.setdefault(name[len(prefix):], []).append(value)
+    for suffix, values in by_suffix.items():
+        if len(values) != n_layers:
+            raise ValueError(
+                f"suffix {suffix!r} present in {len(values)}/{n_layers} "
+                f"layers — stacking requires homogeneous blocks")
+        out[f"blocks/{suffix}"] = jnp.stack(values)
+    for name, value in params.items():
+        if not name.startswith("layer"):
+            out[name] = value
+    return out
+
+
+def unstack_layers(params: Mapping[str, Array]) -> dict:
+    """Inverse of :func:`stack_layers`: stacked ``blocks/*`` arrays back
+    to per-layer ``layer<i>/*`` entries."""
+    out: dict = {}
+    for name, value in params.items():
+        if name.startswith("blocks/"):
+            suffix = name[len("blocks/"):]
+            for i in range(value.shape[0]):
+                out[f"layer{i}/{suffix}"] = value[i]
+        else:
+            out[name] = value
+    return out
+
+
 def transformer_rule(mesh: Mesh):
     """Sharding rule for transformer stores: Megatron TP + fsdp (+ EP).
 
@@ -513,11 +616,16 @@ def transformer_rule(mesh: Mesh):
                 spec[axis] = "fsdp"
             return spec
 
+        # in/out weight dims are the trailing two; stacked scan-layer
+        # weights (blocks/*, [L, in, out]) keep their leading layer dim
+        # unsharded — it is the scan axis, and sharding it would gather
+        # one shard's slice every scan step
         if name.endswith(("attn/wq", "attn/wk", "attn/wv", "mlp/w1", "lm_head/w")):
             taken = len(shape) - 1 if n_tp > 1 and shape[-1] % n_tp == 0 else None
-            return PartitionSpec(*fsdp_on(0, taken))
+            return PartitionSpec(*fsdp_on(len(shape) - 2, taken))
         if name.endswith(("attn/wo", "mlp/w2")):
-            taken = 0 if n_tp > 1 and shape[0] % n_tp == 0 else None
+            taken = (len(shape) - 2
+                     if n_tp > 1 and shape[-2] % n_tp == 0 else None)
             return PartitionSpec(*fsdp_on(len(shape) - 1, taken))
         if name == "embed/tok":
             # TP goes d_model-wise, never vocab(row)-wise: a TENSOR-sharded
@@ -544,23 +652,24 @@ def transformer_rule(mesh: Mesh):
 
 
 def small_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
-             remat: bool = False) -> Transformer:
+             remat: bool = False, scan_layers: bool = False) -> Transformer:
     """Test-scale LM."""
     return Transformer(TransformerConfig(
         vocab=vocab, d_model=128, n_heads=4, n_layers=2, d_ff=512,
-        max_seq=seq, dtype=dtype, remat=remat))
+        max_seq=seq, dtype=dtype, remat=remat, scan_layers=scan_layers))
 
 
 def lm_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
-            remat: bool = True) -> Transformer:
+            remat: bool = True, scan_layers: bool = False) -> Transformer:
     """~370M-param GPT-style flagship for the LM MFU benchmark: 24 layers,
     d_model 1024, seq 1024, bf16 weights/activations with f32 MXU
     accumulation, per-layer remat by default (activation memory, not HBM
     capacity, should bound the batch), chunked cross-entropy (peak f32
-    logits ~1 GB -> ~32 MB at batch 8)."""
+    logits ~1 GB -> ~32 MB at batch 8).  ``scan_layers`` stores blocks
+    stacked and scans the layer loop — depth-independent compile time."""
     return Transformer(TransformerConfig(
         vocab=vocab, d_model=1024, n_heads=16, n_layers=24, d_ff=4096,
-        max_seq=seq, dtype=dtype, remat=remat,
+        max_seq=seq, dtype=dtype, remat=remat, scan_layers=scan_layers,
         # largest chunk <= 128 dividing seq, so every seq stays valid
         loss_chunk=math.gcd(128, seq)))
 

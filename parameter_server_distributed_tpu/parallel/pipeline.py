@@ -68,6 +68,10 @@ class PipelinedTransformerLM:
             raise ValueError("pipeline parallelism wraps a Transformer LM")
         if inner.config.moe_every > 0:
             raise ValueError("pipeline + MoE is not supported yet")
+        if inner.config.scan_layers:
+            raise ValueError(
+                "pipeline wraps an unrolled Transformer (it restacks "
+                "layer<i>/* itself); build the model without scan_layers")
         n_pipe = mesh.shape["pipe"]
         if inner.config.n_layers % n_pipe:
             raise ValueError(

@@ -42,6 +42,8 @@ class TrainLoopConfig:
     model_dtype: str = ""         # "" = model default | f32 | bf16
     remat: bool | None = None     # per-layer jax.checkpoint (LM models);
                                   # None = model default, True/False force
+    scan_layers: bool | None = None  # lax.scan over stacked layers (LMs);
+                                     # tri-state like remat
     steps: int = 100
     optimizer: str = "adam"
     learning_rate: float = 1e-3
@@ -79,7 +81,8 @@ def run_training(config: TrainLoopConfig) -> dict:
                                            seed=config.seed,
                                            data_path=config.data_path,
                                            dtype=config.model_dtype,
-                                           remat=config.remat)
+                                           remat=config.remat,
+                                           scan=config.scan_layers)
     from ..models.transformer import Transformer, select_attention
     if isinstance(model, Transformer):
         if mesh.shape["pipe"] > 1:

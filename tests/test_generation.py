@@ -205,3 +205,38 @@ def test_generate_cli_from_sharded_checkpoint(tmp_path, capsys):
                "--prompt=hello", "--max-new=4"])
     assert rc == 0
     assert "sharded checkpoint step 2" in capsys.readouterr().err
+
+
+def test_generate_cli_cross_layout(tmp_path, capsys):
+    """A store trained with --scan-layers (stacked blocks/*) decodes on an
+    unrolled model and vice versa — generate_main converts layouts, and
+    greedy output is identical either way."""
+    from parameter_server_distributed_tpu.checkpoint import codec
+    from parameter_server_distributed_tpu.cli.generate_main import main
+    from parameter_server_distributed_tpu.models.registry import (
+        get_model_and_batches)
+    from parameter_server_distributed_tpu.models.transformer import (
+        stack_layers)
+
+    model, _ = get_model_and_batches("small_lm", 1)
+    params = {k: np.asarray(v) for k, v in model.init_params(0).items()}
+    stacked = stack_layers(params, model.config.n_layers)
+
+    flat_ckpt = tmp_path / "flat.ckpt"
+    codec.save(str(flat_ckpt), 1, 10, params)
+    stacked_ckpt = tmp_path / "stacked.ckpt"
+    codec.save(str(stacked_ckpt), 1, 10,
+               {k: np.asarray(v) for k, v in stacked.items()})
+
+    outs = []
+    for ckpt, flag in [(flat_ckpt, "--scan-layers"),
+                       (stacked_ckpt, ""),          # unrolled model default
+                       (stacked_ckpt, "--scan-layers"),
+                       (flat_ckpt, "")]:
+        argv = ["--model=small_lm", f"--ckpt={ckpt}", "--tokens=1,2,3",
+                "--max-new=4"]
+        if flag:
+            argv.append(flag)
+        assert main(argv) == 0
+        outs.append(capsys.readouterr().out.strip())
+    assert len(set(outs)) == 1, outs

@@ -310,3 +310,110 @@ def test_chunked_cross_entropy_matches_unchunked(rng):
     bad = Transformer(dataclasses.replace(config, loss_chunk=5))
     with pytest.raises(ValueError, match="divide"):
         jax.jit(bad.loss)(params, tokens)
+
+
+def test_scan_layers_matches_unrolled(rng):
+    """scan_layers is a layout/compile-time change only: with the same
+    weights (converted via stack_layers) the loss and gradients match the
+    unrolled model; unstack_layers round-trips the store."""
+    import dataclasses
+
+    from parameter_server_distributed_tpu.models.transformer import (
+        stack_layers, unstack_layers)
+
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=3,
+                               d_ff=64, max_seq=16, dtype=jnp.float32)
+    tokens = rng.integers(0, 64, (4, 16)).astype(np.int32)
+    plain = Transformer(config)
+    scanned = Transformer(dataclasses.replace(config, scan_layers=True))
+    params = plain.init_params(0)
+    stacked = stack_layers(params, config.n_layers)
+
+    assert set(stacked) == set(scanned.param_shapes())
+    assert scanned.num_params() == plain.num_params()
+    back = unstack_layers(stacked)
+    assert set(back) == set(params)
+    for name in params:
+        np.testing.assert_array_equal(np.asarray(back[name]),
+                                      np.asarray(params[name]))
+
+    loss_a = float(jax.jit(plain.loss)(params, tokens))
+    loss_b = float(jax.jit(scanned.loss)(stacked, tokens))
+    np.testing.assert_allclose(loss_b, loss_a, rtol=1e-6)
+
+    # atol covers f32 reassociation noise: scan accumulates the embed
+    # grad layer-by-layer in a different order than the unrolled sum
+    g_a = stack_layers(jax.jit(jax.grad(plain.loss))(params, tokens),
+                       config.n_layers)
+    g_b = jax.jit(jax.grad(scanned.loss))(stacked, tokens)
+    for name in g_a:
+        np.testing.assert_allclose(np.asarray(g_b[name]),
+                                   np.asarray(g_a[name]), rtol=2e-5,
+                                   atol=2e-6, err_msg=name)
+
+    # remat composes with scan (checkpointed scan body), still exact
+    remat_scan = Transformer(dataclasses.replace(
+        config, scan_layers=True, remat=True))
+    loss_c = float(jax.jit(remat_scan.loss)(stacked, tokens))
+    np.testing.assert_allclose(loss_c, loss_a, rtol=1e-6)
+    g_c = jax.jit(jax.grad(remat_scan.loss))(stacked, tokens)
+    for name in g_a:
+        np.testing.assert_allclose(np.asarray(g_c[name]),
+                                   np.asarray(g_a[name]), rtol=2e-5,
+                                   atol=2e-6, err_msg=name)
+
+
+def test_scan_layers_generation_matches_unrolled(rng):
+    """KV-cached decode (prefill collect_kv + per-layer layer_view) works
+    on the stacked layout and matches the unrolled model token-exactly."""
+    import dataclasses
+
+    from parameter_server_distributed_tpu.models.generation import generate
+    from parameter_server_distributed_tpu.models.transformer import (
+        stack_layers)
+
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                               d_ff=64, max_seq=32, dtype=jnp.float32)
+    plain = Transformer(config)
+    scanned = Transformer(dataclasses.replace(config, scan_layers=True))
+    params = plain.init_params(0)
+    stacked = stack_layers(params, config.n_layers)
+    prompt = rng.integers(0, 64, (2, 5)).astype(np.int32)
+
+    out_a = np.asarray(generate(plain, params, prompt, max_new_tokens=8))
+    out_b = np.asarray(generate(scanned, stacked, prompt, max_new_tokens=8))
+    np.testing.assert_array_equal(out_a, out_b)
+
+
+def test_scan_layers_sharded_training():
+    """The stacked store trains under a dp x tp mesh: transformer_rule
+    shards the trailing weight dims and leaves the scanned layer dim
+    whole."""
+    from jax.sharding import PartitionSpec
+
+    model = small_lm(scan_layers=True)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    rule = transformer_rule(mesh)
+    spec = rule("blocks/attn/wq", (2, 128, 128))
+    assert spec == PartitionSpec(None, "fsdp", "tensor")
+    spec = rule("blocks/mlp/w2", (2, 512, 128))
+    assert spec == PartitionSpec(None, "tensor", "fsdp")
+
+    trainer = ShardedTrainer(model.loss, mesh, rule,
+                             make_optimizer("adam", 1e-3))
+    state = trainer.init_state(model.init_params(0))
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 1024, (8, 256)).astype(np.int32)
+    losses = []
+    for _ in range(3):
+        state, metrics = trainer.step(state, tokens)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_scan_layers_rejects_moe():
+    with pytest.raises(ValueError, match="homogeneous"):
+        Transformer(TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                      n_layers=2, d_ff=64, moe_every=2,
+                                      scan_layers=True))

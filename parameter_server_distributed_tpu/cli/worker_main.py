@@ -19,6 +19,10 @@ Extension flags:
                      default), raw, bf16 (half the push/pull bytes), or
                      int8 (quarter-size error-feedback gradient pushes,
                      bf16 pulls; requires a framework PS)
+    --dtype=bf16     model compute dtype (factories that take one)
+    --remat / --no-remat / --scan-layers / --no-scan-layers
+                     transformer LM layer-loop knobs (same semantics as
+                     pst-train; absent = model default)
 """
 
 from __future__ import annotations
@@ -36,7 +40,10 @@ def build_worker(config: WorkerConfig, seed: int | None = None) -> Worker:
     data_seed = config.worker_id if seed is None else seed
     model, batches = get_model_and_batches(config.model, config.batch_size,
                                            seed=data_seed,
-                                           data_path=config.data_path)
+                                           data_path=config.data_path,
+                                           dtype=config.model_dtype,
+                                           remat=config.remat,
+                                           scan=config.scan_layers)
     return Worker(config, Trainer(model), batches)
 
 
@@ -54,6 +61,11 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_path=positional[5] if len(positional) > 5 else "",
         model=flags.get("model", "mnist_mlp"),
         batch_size=int(flags.get("batch", 32)),
+        model_dtype=flags.get("dtype", ""),
+        remat=(False if "no-remat" in flags
+               else True if "remat" in flags else None),
+        scan_layers=(False if "no-scan-layers" in flags
+                     else True if "scan-layers" in flags else None),
         data_path=flags.get("data", ""),
         wire_dtype=flags.get("wire", "f32"),
     )

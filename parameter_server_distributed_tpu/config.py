@@ -85,6 +85,11 @@ class WorkerConfig:
     sync_outer_retries: int = SYNC_OUTER_RETRIES
     batch_size: int = 32
     model: str = "mnist_mlp"
+    # Model-construction knobs forwarded to the registry (same tri-state
+    # semantics as TrainLoopConfig: None/"" = model default)
+    model_dtype: str = ""
+    remat: bool | None = None
+    scan_layers: bool | None = None
     # File-backed dataset (data/files.py): token shard for LMs, npz
     # elsewhere.  Empty = synthetic loaders.
     data_path: str = ""

@@ -169,7 +169,7 @@ def make_sharded_flash_attention(mesh: Mesh,
     return sharded_flash
 
 
-ATTENTION_CHOICES = ("dense", "flash", "ring", "ulysses")
+ATTENTION_CHOICES = ("dense", "flash", "ring", "ulysses", "ulysses_flash")
 
 
 def select_attention(name: str, mesh: Mesh | None) -> Callable | None:
@@ -180,6 +180,8 @@ def select_attention(name: str, mesh: Mesh | None) -> Callable | None:
               batch/head shards (seq must be unsharded)
     ring    — ring attention over the mesh's ``seq`` axis (K/V ppermute)
     ulysses — all-to-all seq<->heads swap, dense attention per head shard
+    ulysses_flash — same swap, pallas flash kernel on the gathered
+              full sequence (seq parallelism + O(block^2) VMEM)
 
     Returns None for dense (the Transformer default), letting the model
     pick its own fallback logic."""
@@ -189,14 +191,17 @@ def select_attention(name: str, mesh: Mesh | None) -> Callable | None:
         if mesh is None:
             return flash_attention_auto
         return make_sharded_flash_attention(mesh)
-    if name in ("ring", "ulysses"):
+    if name in ("ring", "ulysses", "ulysses_flash"):
         if mesh is None:
             raise ValueError(f"--attention={name} needs a mesh with a seq axis")
         from ..ops.ring_attention import (make_ring_attention,
                                           make_ulysses_attention)
-        maker = (make_ring_attention if name == "ring"
-                 else make_ulysses_attention)
-        return maker(mesh)
+        if name == "ring":
+            return make_ring_attention(mesh)
+        if name == "ulysses_flash":
+            # pallas flash on each device's gathered full sequence
+            return make_ulysses_attention(mesh, inner=flash_attention_auto)
+        return make_ulysses_attention(mesh)
     raise ValueError(f"unknown attention {name!r}; options {ATTENTION_CHOICES}")
 
 

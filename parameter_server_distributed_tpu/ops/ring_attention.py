@@ -69,6 +69,12 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
     spec = P(batch_axes, seq_axis, heads_spec, None)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    # remat each block update: the [B,H,Sq,Sk] score tile is recomputed in
+    # the backward pass instead of saved — per-step backward residuals
+    # shrink to the O(Sq*D) carries, the whole point of ring attention's
+    # O(S/N) activation-memory claim at long context
+    block_update = jax.checkpoint(_block_attention_update)
+
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
     def ring(q, k, v):
@@ -89,8 +95,8 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
             # attention FLOPs across the ring for causal LM training.
             m, l, acc = jax.lax.cond(
                 src <= my,
-                lambda ops: _block_attention_update(q32, *ops, q_pos, k_pos,
-                                                    m, l, acc),
+                lambda ops: block_update(q32, *ops, q_pos, k_pos,
+                                         m, l, acc),
                 lambda ops: (m, l, acc),
                 (k_cur, v_cur))
             if step < n - 1:
@@ -103,12 +109,21 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
 
 def make_ulysses_attention(mesh: Mesh, seq_axis: str = "seq",
                            batch_axes: tuple[str, ...] = ("data", "fsdp"),
-                           head_axis: str = "tensor"):
+                           head_axis: str = "tensor",
+                           inner=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism: swap the
-    sharded axis seq -> heads, run dense causal attention over the full
+    sharded axis seq -> heads, run causal attention over the full
     sequence, swap back.  Heads (after any tensor sharding) must divide by
-    the seq-axis size."""
-    from ..models.transformer import causal_attention
+    the seq-axis size.
+
+    ``inner`` is the per-device full-sequence attention kernel (default
+    dense einsum).  After the gather each device holds [B, S, H/n, D] at
+    aligned positions — exactly the pallas flash kernel's contract — so
+    passing ``flash_attention_auto`` (the ``ulysses_flash`` CLI choice)
+    runs the O(block^2)-VMEM kernel on the full sequence per head shard."""
+    if inner is None:
+        from ..models.transformer import causal_attention
+        inner = causal_attention
 
     n = mesh.shape[seq_axis]
     heads_spec = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
@@ -125,7 +140,7 @@ def make_ulysses_attention(mesh: Mesh, seq_axis: str = "seq",
             return jax.lax.all_to_all(x, seq_axis, split_axis=1, concat_axis=2,
                                       tiled=True)
 
-        out = causal_attention(gather_seq(q), gather_seq(k), gather_seq(v))
+        out = inner(gather_seq(q), gather_seq(k), gather_seq(v))
         return scatter_seq(out)
 
     return ulysses

@@ -21,7 +21,8 @@ Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
 PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
 (default 2), PSDT_BENCH_CPU_TIMEOUT (s, default 420), PSDT_BENCH_REMAT /
 PSDT_BENCH_SCAN (unset = model default, 0/1 force off/on — remat and
-lax.scan-over-layers for transformer LMs).
+lax.scan-over-layers for transformer LMs), PSDT_BENCH_SEQ (sequence-
+length override for LMs: long-context runs).
 """
 
 from __future__ import annotations
@@ -114,7 +115,8 @@ def bench_mfu() -> dict:
             return None if value == "" else value not in ("0", "off")
         model, batches = get_model_and_batches(
             model_name, batch, remat=tri("PSDT_BENCH_REMAT"),
-            scan=tri("PSDT_BENCH_SCAN"))
+            scan=tri("PSDT_BENCH_SCAN"),
+            seq_len=int(os.environ.get("PSDT_BENCH_SEQ", "0")))
         batch_data = next(batches)
         n_params = model.num_params()
         # MFU only where the FLOP count is known and the model is big
@@ -215,10 +217,16 @@ def bench_mfu() -> dict:
             f"MFU={mfu*100:.1f}% (peak {peak/1e12:.0f} TFLOP/s)")
         metric = ("lm_train_mfu" if flops_per_sample is not None
                   and model_name.startswith("lm") else "mlp_train_mfu")
+        seq_env = os.environ.get("PSDT_BENCH_SEQ", "")
+        if seq_env:
+            metric += f"_seq{seq_env}"
         return {"metric": metric, "value": round(mfu, 4),
                 "unit": "fraction_of_peak",
                 "vs_baseline": round(mfu / 0.45, 3)}
     name = model_name or "mlp"
+    seq_env = os.environ.get("PSDT_BENCH_SEQ", "")
+    if seq_env:
+        name += f"_seq{seq_env}"
     return {"metric": f"{name}_train_samples_per_sec_chip",
             "value": round(samples_per_sec, 1), "unit": "samples/sec",
             "vs_baseline": 1.0}

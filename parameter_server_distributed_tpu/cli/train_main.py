@@ -21,7 +21,9 @@ memory/FLOPs trade.  ``--no-remat`` forces it off for models that default
 it on (lm_350m); neither flag keeps the model's default.
 ``--scan-layers`` / ``--no-scan-layers`` likewise force lax.scan over
 stacked layer weights (depth-independent compile time) or the unrolled
-loop (cross-layer XLA fusion) for transformer LMs.
+loop (cross-layer XLA fusion) for transformer LMs.  ``--seq=N``
+overrides the LM sequence length (long-context runs; synthetic token
+streams follow the model).
 
 ``--mesh=pipe:P`` trains transformer models with GPipe pipeline
 parallelism (parallel/pipeline.py): layer blocks live on their pipe rank,
@@ -85,6 +87,7 @@ def main(argv: list[str] | None = None) -> int:
         model=flags.get("model", "mnist_mlp"),
         batch_size=int(flags.get("batch", 64)),
         data_path=flags.get("data", ""),
+        seq_len=int(flags.get("seq", 0)),
         attention=flags.get("attention", "dense"),
         microbatches=int(flags.get("microbatches", 0)),
         model_dtype=flags.get("dtype", ""),

@@ -72,7 +72,8 @@ DTYPE_NAMES = {"f32": "float32", "float32": "float32",
 
 
 def _model_kwargs(model_fn: Callable, name: str, dtype: str,
-                  remat: bool | None, scan: bool | None = None) -> dict:
+                  remat: bool | None, scan: bool | None = None,
+                  seq_len: int = 0) -> dict:
     """The subset of {dtype, remat} this factory supports; error (rather
     than silently ignore) when the user asked for one it doesn't."""
     import inspect
@@ -105,25 +106,41 @@ def _model_kwargs(model_fn: Callable, name: str, dtype: str,
         elif scan:
             raise ValueError(f"model {name!r} does not support scan_layers "
                              f"(dense transformer LMs only)")
+    if seq_len:
+        if not (has_var_kw or "seq" in sig.parameters):
+            raise ValueError(f"model {name!r} has no sequence length "
+                             f"(transformer LMs only)")
+        kwargs["seq"] = seq_len
     return kwargs
 
 
 def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
                           data_path: str = "", dtype: str = "",
                           remat: bool | None = None,
-                          scan: bool | None = None):
+                          scan: bool | None = None,
+                          seq_len: int = 0):
     """Build (model, batch iterator).  ``data_path`` switches from the
     synthetic loaders to file-backed data (data/files.py), dispatched by
     the registry entry's declared file-data kind.  ``dtype`` ("f32"/"bf16"),
     ``remat``, and ``scan`` (lax.scan over stacked layers) forward to
     factories that support them; remat/scan are tri-state — None keeps the
     factory's default (e.g. lm_350m defaults remat on), True/False force
-    it for factories that take the keyword."""
+    it for factories that take the keyword.  ``seq_len`` overrides the
+    sequence length for transformer LMs (long-context runs, e.g.
+    lm_350m at 4096); the synthetic token stream follows the model."""
     if name not in REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {sorted(REGISTRY)}")
     model_fn, data_fn, file_kind = REGISTRY[name]
-    model = model_fn(**_model_kwargs(model_fn, name, dtype, remat, scan))
+    model = model_fn(**_model_kwargs(model_fn, name, dtype, remat, scan,
+                                     seq_len))
     if not data_path:
+        if seq_len and file_kind == "tokens":
+            # the factory's synthetic stream bakes in the default seq; at
+            # an overridden length, stream crops matching the model
+            from ..data.synthetic import synthetic_tokens
+            return model, synthetic_tokens(
+                batch_size, seq_len=model.config.max_seq,
+                vocab=model.config.vocab, seed=seed)
         return model, data_fn(batch_size, seed)
     from ..data.files import npz_stream, token_stream
     if file_kind == "tokens":

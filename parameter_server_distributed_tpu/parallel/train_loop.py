@@ -37,6 +37,7 @@ class TrainLoopConfig:
     model: str = "mnist_mlp"
     batch_size: int = 64          # global batch
     data_path: str = ""           # file-backed data; empty = synthetic
+    seq_len: int = 0              # LM sequence-length override (0 = default)
     attention: str = "dense"      # dense | flash | ring | ulysses (LM models)
     microbatches: int = 0         # pipeline microbatches (0 = pipe size)
     model_dtype: str = ""         # "" = model default | f32 | bf16
@@ -82,7 +83,8 @@ def run_training(config: TrainLoopConfig) -> dict:
                                            data_path=config.data_path,
                                            dtype=config.model_dtype,
                                            remat=config.remat,
-                                           scan=config.scan_layers)
+                                           scan=config.scan_layers,
+                                           seq_len=config.seq_len)
     from ..models.transformer import Transformer, select_attention
     if isinstance(model, Transformer):
         if mesh.shape["pipe"] > 1:

@@ -417,3 +417,17 @@ def test_scan_layers_rejects_moe():
         Transformer(TransformerConfig(vocab=64, d_model=32, n_heads=4,
                                       n_layers=2, d_ff=64, moe_every=2,
                                       scan_layers=True))
+
+
+def test_registry_seq_override():
+    """seq_len builds the LM at the requested context length, the
+    synthetic token stream follows, and non-LM models reject it."""
+    from parameter_server_distributed_tpu.models.registry import (
+        get_model_and_batches)
+
+    model, batches = get_model_and_batches("small_lm", 2, seq_len=512)
+    assert model.config.max_seq == 512
+    batch = next(batches)
+    assert batch.shape == (2, 512)
+    with pytest.raises(ValueError, match="sequence length"):
+        get_model_and_batches("mnist_mlp", 2, seq_len=512)

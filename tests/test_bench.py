@@ -1,0 +1,59 @@
+"""bench.py driver-artifact contract: exactly one parseable JSON line on
+stdout with a non-zero value, whatever the backend situation.
+
+A bench.py regression silently costs the round's BENCH_r{N}.json, so the
+orchestrator is exercised end to end (parent process -> subprocess child ->
+JSON line) in CPU mode with tiny shapes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def run_bench(mode: str, extra_env: dict | None = None,
+              timeout: float = 420.0) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "PSDT_BENCH_MODE": mode,
+        # skip TPU attempts entirely: this test is about the orchestration
+        # and JSON contract, not the accelerator
+        "PSDT_BENCH_TPU_ATTEMPTS": "0",
+        "PSDT_BENCH_CPU_TIMEOUT": str(int(timeout - 30)),
+        "PSDT_BENCH_STEPS": "2",
+        "PSDT_PLATFORM": "cpu",
+    })
+    env.pop("PSDT_BENCH_CHILD", None)
+    env.update(extra_env or {})
+    proc = subprocess.run([sys.executable, BENCH], env=env, cwd=REPO,
+                          stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                          timeout=timeout)
+    lines = [ln for ln in proc.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    result = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in result, f"missing {key}: {result}"
+    return result
+
+
+@pytest.mark.slow
+def test_bench_mfu_cpu_contract():
+    result = run_bench("mfu")
+    # CPU fallback with zero TPU attempts is not labeled a fallback (no
+    # failed attempt preceded it) but must still be a real number
+    assert result["metric"].startswith("mlp")
+    assert result["value"] > 0
+    assert result["metric"] != "bench_error"
+
+
+@pytest.mark.slow
+def test_bench_pushpull_contract():
+    result = run_bench("pushpull")
+    assert result["metric"].startswith("ps_pushpull_p50")
+    assert result["value"] > 0

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# LM benchmark sweep: attention kernel x remat x layer layout x context
+# length, one bench.py run per config, serial (single chip).  Each run
+# appends its JSON line to the results file with the config as a prefix
+# key; stderr goes to the log.  Skips nothing on failure — a bench_error
+# line records what failed.
+#
+#   RESULTS=/tmp/lm_sweep.jsonl LOG=/tmp/lm_sweep.log scripts/bench_sweep.sh
+#
+# Env passthrough: PSDT_BENCH_TPU_TIMEOUT (default 560 here: first
+# compiles of the unrolled 24-layer flagship run ~2 min on the tunneled
+# backend), PSDT_BENCH_STEPS.
+set -u
+cd "$(dirname "$0")/.."
+
+RESULTS="${RESULTS:-/tmp/lm_sweep.jsonl}"
+LOG="${LOG:-/tmp/lm_sweep.log}"
+export PSDT_BENCH_MODEL="${PSDT_BENCH_MODEL:-lm_350m}"
+export PSDT_BENCH_TPU_TIMEOUT="${PSDT_BENCH_TPU_TIMEOUT:-560}"
+export PSDT_BENCH_TPU_ATTEMPTS=1
+export PSDT_BENCH_CPU_TIMEOUT=1   # TPU sweep: a CPU fallback number is noise
+
+run() {  # run <tag> [VAR=VALUE...]
+  local tag="$1"; shift
+  echo "=== $tag ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
+  local line
+  line=$(env "$@" python bench.py 2>>"$LOG")
+  echo "{\"config\": \"$tag\", \"result\": $line}" | tee -a "$RESULTS"
+}
+
+# seq 1024 (flagship default): layout/remat matrix on dense attention
+run dense_remat_b32        PSDT_BENCH_BATCH=32
+run dense_noremat_b32      PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT=0
+run dense_scan_remat_b32   PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
+run dense_scan_noremat_b32 PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_REMAT=0
+# flash at seq 1024 (expected slower than dense here; recorded for the
+# crossover curve)
+run flash_remat_b32        PSDT_BENCH_BATCH=32 PSDT_BENCH_ATTENTION=flash
+# long context: flash + remat is the memory-viable config
+run flash_seq4096_b8       PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=flash
+run dense_seq4096_b8       PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096
+
+echo "sweep done -> $RESULTS" | tee -a "$LOG"

@@ -11,10 +11,13 @@ control-plane push/pull p50 latency over real gRPC on localhost.
 Robustness: the tunneled TPU backend ('axon' PJRT plugin) is intermittently
 unavailable and its init can HANG rather than fail.  The top-level process
 therefore orchestrates the actual measurement in child subprocesses with
-hard wall-clock timeouts: up to PSDT_BENCH_TPU_ATTEMPTS tries on the TPU
-backend, then an explicitly-labeled CPU fallback, so a round never records
-a bare 0.0.  The final stdout is always exactly one JSON line; failures
-carry the exception text in a "note" field.
+hard wall-clock timeouts: a cheap preflight (init + one tiny op,
+PSDT_BENCH_PREFLIGHT_TIMEOUT, default 90 s) gates up to
+PSDT_BENCH_TPU_ATTEMPTS tries on the TPU backend, then an
+explicitly-labeled CPU fallback, so a round never records a bare 0.0 and
+a dead TPU costs ~90 s instead of every attempt's full timeout.  The
+final stdout is always exactly one JSON line; failures carry the
+exception text in a "note" field.
 
 Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
 (mfu | samples | pushpull | async | generate; default mfu),
@@ -531,6 +534,35 @@ def _run_child(mode: str, platform: str, timeout_s: float) -> tuple[dict | None,
     return None, f"{platform} child rc={proc.returncode}, no JSON emitted"
 
 
+def _tpu_preflight(timeout_s: float) -> str:
+    """Cheap health probe in a subprocess: init the backend and run one
+    tiny device op.  Returns "" when healthy, else the failure reason.
+
+    Rationale: a wedged tunnel HANGS at init rather than failing, so
+    without this a dead TPU costs the full per-attempt timeout N times
+    before the CPU fallback — possibly longer than the driver waits for
+    bench.py at all.  ~20-40 s of extra init when the TPU is healthy buys
+    a bounded worst case when it is not."""
+    code = ("import jax\n"
+            "d = jax.devices()[0]\n"
+            "assert d.platform in ('tpu', 'axon') or "
+            "d.device_kind.upper().startswith('TPU'), d.platform\n"
+            "import jax.numpy as jnp\n"
+            "print(float(jnp.ones((8, 8)).sum()))\n")
+    env = dict(os.environ)
+    env.pop("PSDT_PLATFORM", None)
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              timeout=timeout_s, stdout=subprocess.DEVNULL,
+                              stderr=subprocess.PIPE)
+    except subprocess.TimeoutExpired:
+        return f"TPU preflight hung (> {timeout_s:.0f}s)"
+    if proc.returncode:
+        tail = proc.stderr.decode(errors="replace").strip().splitlines()
+        return f"TPU preflight rc={proc.returncode}: {tail[-1][:200] if tail else ''}"
+    return ""
+
+
 def main() -> int:
     """Orchestrate: TPU attempts with hard timeouts, then CPU fallback."""
     mode = os.environ.get("PSDT_BENCH_MODE", "mfu")
@@ -540,6 +572,8 @@ def main() -> int:
     tpu_timeout = float(os.environ.get("PSDT_BENCH_TPU_TIMEOUT", "240"))
     cpu_timeout = float(os.environ.get("PSDT_BENCH_CPU_TIMEOUT", "420"))
     tpu_attempts = int(os.environ.get("PSDT_BENCH_TPU_ATTEMPTS", "2"))
+    preflight_timeout = float(
+        os.environ.get("PSDT_BENCH_PREFLIGHT_TIMEOUT", "90"))
 
     # Host-only benches never need the accelerator — run them on CPU
     # directly rather than risking a flaky TPU init.
@@ -550,6 +584,14 @@ def main() -> int:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]
 
     errors: list[str] = []
+    if any(platform == "tpu" for platform, _ in plans):
+        log(f"bench: TPU preflight (timeout {preflight_timeout:.0f}s)")
+        err = _tpu_preflight(preflight_timeout)
+        if err:
+            log(f"bench: {err}; skipping TPU attempts")
+            errors.append(err)
+            plans = [(platform, t) for platform, t in plans
+                     if platform != "tpu"]
     for i, (platform, timeout_s) in enumerate(plans):
         if i > 0:
             time.sleep(min(10.0 * i, 30.0))  # backoff between attempts

@@ -1,14 +1,16 @@
 """Mixture-of-Experts layer with expert parallelism.
 
-Switch-style top-1 routing with capacity: router picks one expert per
-token, tokens beyond an expert's capacity are dropped (pass through the
-residual), and dispatch/combine are expressed as einsums so that with the
+Top-k routing with capacity (k=1 gives the Switch transformer, k=2 the
+Mixtral/GShard shape): the router picks each token's top-k experts, gates
+are the top-k probabilities renormalized to sum one, and (token, choice)
+assignments beyond an expert's capacity are dropped (pass through the
+residual).  Dispatch/combine are expressed as einsums so that with the
 expert dimension of w1/w2 sharded over the mesh's ``expert`` axis, GSPMD
 lowers dispatch to an all-to-all over ICI — no manual collective code.
 
 Load-balancing auxiliary loss per Switch Transformer: E * sum_e f_e * p_e
-(fraction routed * mean router prob).  No reference analogue (SURVEY.md
-§2: expert parallelism absent from the reference).
+(fraction of assignments routed * mean router prob).  No reference
+analogue (SURVEY.md §2: expert parallelism absent from the reference).
 """
 
 from __future__ import annotations
@@ -30,11 +32,17 @@ class MoEConfig:
     d_ff: int = 512
     num_experts: int = 8
     capacity_factor: float = 1.25
+    # experts per token: 1 = Switch, 2 = Mixtral/GShard top-2
+    top_k: int = 1
     dtype: object = jnp.float32
 
 
 class MoELayer:
     def __init__(self, config: MoEConfig):
+        if not 1 <= config.top_k <= config.num_experts:
+            raise ValueError(
+                f"top_k={config.top_k} must be in [1, num_experts="
+                f"{config.num_experts}]")
         self.config = config
 
     def param_shapes(self) -> dict[str, tuple[int, ...]]:
@@ -62,10 +70,12 @@ class MoELayer:
                 / math.sqrt(c.d_ff),
         }
 
-    def capacity(self, num_tokens: int) -> int:
+    def capacity(self, num_assignments: int) -> int:
+        """Per-expert queue length for ``num_assignments`` (token, choice)
+        routing assignments — N tokens produce N * top_k assignments."""
         c = self.config
         return max(1, int(math.ceil(
-            num_tokens / c.num_experts * c.capacity_factor)))
+            num_assignments / c.num_experts * c.capacity_factor)))
 
     def apply(self, params: Mapping[str, Array], x: Array,
               prefix: str = "",
@@ -79,41 +89,58 @@ class MoELayer:
         mechanism: which token drops depends on every other token in the
         batch, so it cannot be reproduced causally at decode time)."""
         c = self.config
+        k = c.top_k
         b, s, d = x.shape
         tokens = x.reshape(b * s, d)
         n = b * s
         cap = capacity_override if capacity_override is not None \
-            else self.capacity(n)
+            else self.capacity(n * k)
 
         logits = jnp.dot(tokens.astype(jnp.float32),
                          params[f"{prefix}moe/router/w"].astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)            # [N, E]
-        expert_idx = jnp.argmax(probs, axis=-1)            # [N]
-        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+        top_probs, top_idx = jax.lax.top_k(probs, k)       # [N, k]
+        if k == 1:
+            # Switch gates by the raw router prob — renormalizing would
+            # make the gate a constant 1 and cut the router's gradient
+            gates = top_probs
+        else:
+            # Mixtral/GShard: top-k probs renormalized to sum one (the
+            # router still gets gradients through the ratios)
+            gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
 
-        # position of each token within its expert's queue
-        onehot = jax.nn.one_hot(expert_idx, c.num_experts, dtype=jnp.int32)
-        position = jnp.cumsum(onehot, axis=0) * onehot     # [N, E], 1-based
-        pos_in_expert = jnp.sum(position, axis=-1) - 1     # [N]
+        # flatten (token, choice) assignments, token-major so earlier
+        # tokens win expert queue slots regardless of choice rank
+        a_idx = top_idx.reshape(n * k)                     # [A]
+        a_gate = gates.reshape(n * k)
+        # position of each assignment within its expert's queue
+        onehot = jax.nn.one_hot(a_idx, c.num_experts, dtype=jnp.int32)
+        position = jnp.cumsum(onehot, axis=0) * onehot     # [A, E], 1-based
+        pos_in_expert = jnp.sum(position, axis=-1) - 1     # [A]
         keep = pos_in_expert < cap
 
-        # dispatch tensor [N, E, C]: token n -> slot (e, c)
-        dispatch = (jax.nn.one_hot(expert_idx, c.num_experts, dtype=x.dtype)
-                    [:, :, None]
-                    * jax.nn.one_hot(jnp.where(keep, pos_in_expert, cap),
-                                     cap + 1, dtype=x.dtype)[:, None, :cap])
+        # dispatch tensor [N, K, E, C]: token n's choice j -> slot (e, c);
+        # contracting the (n) or (k, e, c) sides directly avoids ever
+        # materializing a [N*k, D] repeated-token copy
+        dispatch = ((jax.nn.one_hot(a_idx, c.num_experts, dtype=x.dtype)
+                     [:, :, None]
+                     * jax.nn.one_hot(jnp.where(keep, pos_in_expert, cap),
+                                      cap + 1, dtype=x.dtype)[:, None, :cap])
+                    .reshape(n, k, c.num_experts, cap))
         # expert inputs [E, C, D] — with w1/w2 sharded over 'expert', GSPMD
         # turns this einsum contraction into the dispatch all-to-all
-        expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)
+        expert_in = jnp.einsum("nkec,nd->ecd", dispatch, tokens)
         h = jnp.einsum("ecd,edf->ecf", expert_in, params[f"{prefix}moe/w1"])
         h = jax.nn.gelu(h)
         expert_out = jnp.einsum("ecf,efd->ecd", h, params[f"{prefix}moe/w2"])
-        combined = jnp.einsum("nec,ecd->nd", dispatch, expert_out)
-        out = combined * (gate * keep).astype(x.dtype)[:, None]
+        combined = jnp.einsum("nkec,ecd->nkd", dispatch, expert_out)
+        weighted = combined * (a_gate * keep).astype(x.dtype).reshape(
+            n, k)[..., None]
+        out = weighted.sum(axis=1)
 
-        # Switch load-balancing aux: E * sum_e (fraction of tokens to e) *
-        # (mean router prob of e)
-        frac = jnp.mean(jax.nn.one_hot(expert_idx, c.num_experts,
+        # Switch load-balancing aux: E * sum_e (fraction of assignments
+        # to e) * (mean router prob of e)
+        frac = jnp.mean(jax.nn.one_hot(a_idx, c.num_experts,
                                        dtype=jnp.float32), axis=0)
         mean_prob = jnp.mean(probs, axis=0)
         aux = c.num_experts * jnp.sum(frac * mean_prob)

@@ -63,6 +63,8 @@ REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator], str]] = {
                  _lm_batches, "tokens"),
     "moe_lm": (partial(moe_lm, vocab=1024, seq=256),
                _lm_batches, "tokens"),
+    "moe_lm_top2": (partial(moe_lm, vocab=1024, seq=256, top_k=2),
+                    _lm_batches, "tokens"),
     "mlp_1b": (billion_param_mlp, _mlp_1b_batches, "xy"),
     "lm_350m": (lm_350m, _lm_350m_batches, "tokens"),
 }

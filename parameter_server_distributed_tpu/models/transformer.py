@@ -68,6 +68,8 @@ class TransformerConfig:
     # LM loss scaled by ``moe_aux_coef``.
     moe_every: int = 0
     moe_experts: int = 8
+    # experts per token: 1 = Switch (default), 2 = Mixtral-style top-2
+    moe_top_k: int = 1
     # Scan over layers: store block weights stacked with a leading [L]
     # axis (``blocks/<suffix>``) and run the layer loop as one
     # ``lax.scan`` body traced ONCE, instead of n_layers Python-unrolled
@@ -260,7 +262,7 @@ class Transformer:
             from .moe import MoEConfig, MoELayer
             self._moe = MoELayer(MoEConfig(
                 d_model=config.d_model, d_ff=config.d_ff,
-                num_experts=config.moe_experts,
+                num_experts=config.moe_experts, top_k=config.moe_top_k,
                 capacity_factor=config.moe_capacity, dtype=config.dtype))
         else:
             self._moe = None
@@ -680,8 +682,10 @@ def lm_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
 
 
 def moe_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
-           remat: bool = False) -> Transformer:
-    """Test-scale MoE LM: every 2nd layer is a Switch-routed FFN."""
+           remat: bool = False, top_k: int = 1) -> Transformer:
+    """Test-scale MoE LM: every 2nd layer is an expert-routed FFN
+    (``top_k=1`` Switch, ``top_k=2`` Mixtral-style)."""
     return Transformer(TransformerConfig(
         vocab=vocab, d_model=128, n_heads=4, n_layers=4, d_ff=512,
-        max_seq=seq, dtype=dtype, moe_every=2, moe_experts=4, remat=remat))
+        max_seq=seq, dtype=dtype, moe_every=2, moe_experts=4, remat=remat,
+        moe_top_k=top_k))

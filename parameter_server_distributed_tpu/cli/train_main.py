@@ -32,7 +32,10 @@ microbatches stream through; ``--microbatches=M`` sets the schedule depth
 
 ``--data`` switches from synthetic loaders to file-backed data
 (data/files.py): a token shard (.bin/.u32 memmap) for LM models, an npz
-with x/y arrays otherwise.
+with x/y arrays otherwise.  ``--eval-every=N`` runs a held-out
+evaluation (mean loss over ``--eval-steps`` batches, no updates) every N
+steps and at the end; ``--eval-data`` points it at a held-out file,
+otherwise a shifted-seed synthetic stream is used.
 
 The mesh spec names axes explicitly; unnamed axes default to 1.  For
 multi-host runs set --coordinator=HOST:PORT --num-processes=N
@@ -88,6 +91,9 @@ def main(argv: list[str] | None = None) -> int:
         batch_size=int(flags.get("batch", 64)),
         data_path=flags.get("data", ""),
         seq_len=int(flags.get("seq", 0)),
+        eval_every=int(flags.get("eval-every", 0)),
+        eval_steps=int(flags.get("eval-steps", 4)),
+        eval_data_path=flags.get("eval-data", ""),
         attention=flags.get("attention", "dense"),
         microbatches=int(flags.get("microbatches", 0)),
         model_dtype=flags.get("dtype", ""),

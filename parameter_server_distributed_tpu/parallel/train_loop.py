@@ -38,6 +38,10 @@ class TrainLoopConfig:
     batch_size: int = 64          # global batch
     data_path: str = ""           # file-backed data; empty = synthetic
     seq_len: int = 0              # LM sequence-length override (0 = default)
+    eval_every: int = 0           # held-out eval cadence in steps (0 = off)
+    eval_steps: int = 4           # batches averaged per evaluation
+    eval_data_path: str = ""      # held-out data; empty = shifted-seed
+                                  # synthetic stream
     attention: str = "dense"      # dense | flash | ring | ulysses (LM models)
     microbatches: int = 0         # pipeline microbatches (0 = pipe size)
     model_dtype: str = ""         # "" = model default | f32 | bf16
@@ -147,12 +151,38 @@ def run_training(config: TrainLoopConfig) -> dict:
             start_step = int(np.asarray(state.step))
             log.info("resumed from step %d", start_step)
 
+    eval_batches = None
+    if config.eval_every:
+        # a disjoint stream: the held-out file when given; otherwise the
+        # TRAINING source at a shifted seed (different random crops of the
+        # same file, or a shifted-seed synthetic stream) — never a
+        # different distribution than training, which would make the
+        # number meaningless
+        eval_source = config.eval_data_path or config.data_path
+        if config.data_path and not config.eval_data_path:
+            log.warning(
+                "--eval-every without --eval-data: evaluating on "
+                "shifted-seed crops of the TRAINING file %s (overlapping "
+                "data, not a held-out split)", config.data_path)
+        _, eval_batches = get_model_and_batches(
+            config.model, config.batch_size, seed=config.seed + 100_003,
+            data_path=eval_source,
+            dtype=config.model_dtype, remat=config.remat,
+            scan=config.scan_layers, seq_len=config.seq_len)
+
+    def run_eval(state) -> float:
+        total = 0.0
+        for _ in range(max(1, config.eval_steps)):
+            total += float(trainer.evaluate(state, next(eval_batches)))
+        return total / max(1, config.eval_steps)
+
     metrics_log = MetricsLogger(config.metrics_path or None)
     timer = StepTimer()
     n_chips = mesh.devices.size
     last_loss = float("nan")
 
     last_saved_step = -1
+    last_eval = (-1, float("nan"))
     window_t0 = time.perf_counter()
     window_steps = 0
     try:
@@ -176,6 +206,17 @@ def run_training(config: TrainLoopConfig) -> dict:
                                     grad_norm=float(metrics["grad_norm"]))
                     log.info("step %d loss %.4f (%.1f ms)", step_idx + 1,
                              last_loss, dt * 1e3)
+                    window_t0 = time.perf_counter()
+                    window_steps = 0
+                if (config.eval_every
+                        and (step_idx + 1) % config.eval_every == 0):
+                    last_eval = (step_idx + 1, run_eval(state))
+                    metrics_log.log(step=step_idx + 1,
+                                    eval_loss=last_eval[1])
+                    log.info("step %d eval_loss %.4f (%d batches)",
+                             step_idx + 1, last_eval[1], config.eval_steps)
+                    # eval synced the device; restart the timing window so
+                    # its wall time is not booked to training steps
                     window_t0 = time.perf_counter()
                     window_steps = 0
                 if (config.checkpoint_every and config.checkpoint_dir
@@ -206,6 +247,14 @@ def run_training(config: TrainLoopConfig) -> dict:
     end_step = max(start_step, config.steps)
     summary = {"final_loss": last_loss, "steps": end_step,
                "dp_size": data_parallel_size(mesh), **timer.summary()}
+    if config.eval_every:
+        # reuse the loop's step-N result when training ended exactly on an
+        # eval boundary (same params — a re-run would just burn eval_steps
+        # forwards and report a different-batch number than the JSONL)
+        summary["eval_loss"] = (last_eval[1] if last_eval[0] == end_step
+                                else run_eval(state))
+        if math.isnan(summary["eval_loss"]):
+            summary["eval_loss"] = None  # strict-JSON safe, like final_loss
     if math.isnan(summary["final_loss"]):
         summary["final_loss"] = None  # keep the summary strict-JSON safe
     if (config.checkpoint_every and config.checkpoint_dir

@@ -203,9 +203,12 @@ class ShardedTrainer:
         self.mesh = mesh
         self.rule = rule
         self.optimizer = optimizer or make_optimizer("sgd", 1.0)
+        self._loss_fn = loss_fn
+        self._accum_steps = accum_steps
         self._raw_step = make_train_step(loss_fn, self.optimizer,
                                          accum_steps=accum_steps)
         self._compiled: Callable | None = None
+        self._compiled_eval: Callable | None = None
         self._shardings: TrainState | None = None
 
     def init_state(self, params: Mapping[str, jax.Array]) -> TrainState:
@@ -245,6 +248,44 @@ class ShardedTrainer:
 
     def step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
         return self.step_fn()(state, self.put_batch(batch))
+
+    def eval_fn(self) -> Callable:
+        """Compiled loss-only forward for held-out evaluation: same state
+        and batch shardings as training, no gradient, no buffer donation
+        (the state lives on).  Honors accum_steps — a run that needs
+        microbatched training would OOM on a full-batch eval forward, so
+        eval scans the same microbatch split (mean of equal-size
+        microbatch means == the global mean)."""
+        if self._compiled_eval is None:
+            if self._shardings is None:
+                raise RuntimeError("call init_state first")
+            loss_fn = self._loss_fn
+            accum = self._accum_steps
+
+            def evaluate(state: TrainState, batch):
+                if accum == 1:
+                    return loss_fn(state.params, batch)
+                micro = jax.tree.map(
+                    lambda x: x.reshape(accum, x.shape[0] // accum,
+                                        *x.shape[1:]), batch)
+
+                def body(total, mb):
+                    return (total
+                            + loss_fn(state.params, mb).astype(jnp.float32),
+                            None)
+
+                total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                        micro)
+                return total / accum
+
+            self._compiled_eval = jax.jit(
+                evaluate,
+                in_shardings=(self._shardings, batch_sharding(self.mesh)),
+                out_shardings=replicated(self.mesh))
+        return self._compiled_eval
+
+    def evaluate(self, state: TrainState, batch) -> jax.Array:
+        return self.eval_fn()(state, self.put_batch(batch))
 
     def put_batch(self, batch):
         """Place a host batch with the global batch sharding (every process

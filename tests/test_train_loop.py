@@ -167,3 +167,29 @@ def test_checkpoint_retention_with_final_fallback_save(tmp_path):
     steps = sorted(int(p.name.split("_")[1]) for p in tmp_path.iterdir()
                    if p.name.startswith("step_"))
     assert steps == [12, 13]
+
+
+def test_eval_loop(tmp_path):
+    """--eval-every runs held-out evaluation: eval_loss lands in the
+    summary and the JSONL metrics, and evaluation never perturbs training
+    (same final_loss with eval on and off)."""
+    metrics = tmp_path / "m.jsonl"
+    base = dict(model="small_lm", batch_size=8, steps=4, optimizer="sgd",
+                learning_rate=0.1, mesh=MeshConfig(data=2), log_every=2)
+    with_eval = run_training(TrainLoopConfig(
+        **base, eval_every=2, eval_steps=2, metrics_path=str(metrics)))
+    assert np.isfinite(with_eval["eval_loss"])
+    lines = [json.loads(line) for line in metrics.read_text().splitlines()]
+    assert any("eval_loss" in entry for entry in lines)
+
+    without = run_training(TrainLoopConfig(**base))
+    assert without["final_loss"] == pytest.approx(with_eval["final_loss"])
+    assert "eval_loss" not in without
+
+    # gradient accumulation: eval scans the same microbatch split, and
+    # the mean of equal-size microbatch means equals the full-batch mean
+    # (same eval cadence -> same eval-stream batches as the accum=1 run)
+    accum = run_training(TrainLoopConfig(
+        **base, accum_steps=2, eval_every=2, eval_steps=2))
+    assert accum["eval_loss"] == pytest.approx(with_eval["eval_loss"],
+                                               rel=1e-4)

@@ -40,6 +40,11 @@ otherwise a shifted-seed synthetic stream is used.
 The mesh spec names axes explicitly; unnamed axes default to 1.  For
 multi-host runs set --coordinator=HOST:PORT --num-processes=N
 --process-id=I (or run on a TPU pod where jax.distributed auto-configures).
+``--per-process-data`` switches multi-host runs to per-process loading:
+each host draws only batch/N rows at an independent seed and JAX stitches
+the global batch from the local shards — no host materializes the full
+batch (the scalable data path; default keeps every host loading the same
+deterministic global batch).
 """
 
 from __future__ import annotations
@@ -94,6 +99,7 @@ def main(argv: list[str] | None = None) -> int:
         eval_every=int(flags.get("eval-every", 0)),
         eval_steps=int(flags.get("eval-steps", 4)),
         eval_data_path=flags.get("eval-data", ""),
+        per_process_data="per-process-data" in flags,
         attention=flags.get("attention", "dense"),
         microbatches=int(flags.get("microbatches", 0)),
         model_dtype=flags.get("dtype", ""),

@@ -77,11 +77,17 @@ def synthetic_cifar10(num_examples: int = 4096, seed: int = 0) -> ClassClusterDa
 
 def synthetic_image_batches(batch_size: int, image_size: int = 32,
                             channels: int = 3, num_classes: int = 10,
-                            seed: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-    """Endless NHWC image batches for conv models."""
+                            seed: int = 0, dataset_seed: int = 0
+                            ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Endless NHWC image batches for conv models.
+
+    ``seed`` varies the SAMPLING order only; the dataset itself (cluster
+    centers = the classification task) comes from ``dataset_seed``, so
+    differently-seeded streams (per worker, per host, eval) draw from the
+    same task — like differently-shuffled loaders over one fixed MNIST."""
     ds = ClassClusterDataset(image_size * image_size * channels, num_classes,
                              num_examples=64 * batch_size if batch_size < 64 else 4096,
-                             seed=seed)
+                             seed=dataset_seed)
     for x, y in ds.batch_stream(batch_size, seed=seed):
         yield x.reshape(-1, image_size, image_size, channels), y
 

@@ -17,8 +17,12 @@ from .resnet import resnet18, resnet50
 from .transformer import lm_350m, moe_lm, small_lm
 
 
+# xy loaders: the registry seed varies the SAMPLING stream only — the
+# generated dataset (the task) is fixed, like real MNIST.  Seeding the
+# dataset itself would hand differently-seeded consumers (PS workers,
+# --per-process-data hosts, the eval stream) unrelated tasks.
 def _mnist_batches(batch_size: int, seed: int) -> Iterator:
-    return synthetic_mnist(seed=seed).batch_stream(batch_size, seed=seed)
+    return synthetic_mnist(seed=0).batch_stream(batch_size, seed=seed)
 
 
 def _cifar_batches(batch_size: int, seed: int) -> Iterator:

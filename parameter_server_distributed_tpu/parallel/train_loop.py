@@ -38,6 +38,8 @@ class TrainLoopConfig:
     batch_size: int = 64          # global batch
     data_path: str = ""           # file-backed data; empty = synthetic
     seq_len: int = 0              # LM sequence-length override (0 = default)
+    per_process_data: bool = False  # multi-host: each process loads only
+                                    # its batch/process_count rows
     eval_every: int = 0           # held-out eval cadence in steps (0 = off)
     eval_steps: int = 4           # batches averaged per evaluation
     eval_data_path: str = ""      # held-out data; empty = shifted-seed
@@ -82,8 +84,22 @@ def run_training(config: TrainLoopConfig) -> dict:
     # use the first N devices when the mesh is smaller than the machine
     devices = jax.devices()[:config.mesh.num_devices]
     mesh = build_mesh(config.mesh, devices=devices)
-    model, batches = get_model_and_batches(config.model, config.batch_size,
-                                           seed=config.seed,
+    # per-process data: each host draws an independent seed and only its
+    # share of rows; the trainer stitches the global batch from the local
+    # shards (put_batch_local).  Data remains iid across hosts.
+    n_proc = jax.process_count()
+    local_mode = config.per_process_data and n_proc > 1
+    load_batch = config.batch_size
+    load_seed = config.seed
+    if local_mode:
+        if config.batch_size % n_proc:
+            raise ValueError(
+                f"--per-process-data: global batch {config.batch_size} "
+                f"must divide by process count {n_proc}")
+        load_batch = config.batch_size // n_proc
+        load_seed = config.seed + 7919 * (jax.process_index() + 1)
+    model, batches = get_model_and_batches(config.model, load_batch,
+                                           seed=load_seed,
                                            data_path=config.data_path,
                                            dtype=config.model_dtype,
                                            remat=config.remat,
@@ -165,17 +181,21 @@ def run_training(config: TrainLoopConfig) -> dict:
                 "shifted-seed crops of the TRAINING file %s (overlapping "
                 "data, not a held-out split)", config.data_path)
         _, eval_batches = get_model_and_batches(
-            config.model, config.batch_size, seed=config.seed + 100_003,
+            config.model, load_batch, seed=load_seed + 100_003,
             data_path=eval_source,
             dtype=config.model_dtype, remat=config.remat,
             scan=config.scan_layers, seq_len=config.seq_len)
 
     def run_eval(state) -> float:
         total = 0.0
+        evaluate = trainer.eval_fn()
         for _ in range(max(1, config.eval_steps)):
-            total += float(trainer.evaluate(state, next(eval_batches)))
+            total += float(evaluate(state, place_batch(next(eval_batches))))
         return total / max(1, config.eval_steps)
 
+    step_fn = trainer.step_fn()
+    place_batch = (trainer.put_batch_local if local_mode
+                   else trainer.put_batch)
     metrics_log = MetricsLogger(config.metrics_path or None)
     timer = StepTimer()
     n_chips = mesh.devices.size
@@ -189,7 +209,7 @@ def run_training(config: TrainLoopConfig) -> dict:
         with profile_trace("train_loop"):
             for step_idx in range(start_step, config.steps):
                 batch = next(batches)
-                state, metrics = trainer.step(state, batch)
+                state, metrics = step_fn(state, place_batch(batch))
                 window_steps += 1
                 if ((step_idx + 1) % config.log_every == 0
                         or step_idx == config.steps - 1):

@@ -112,6 +112,20 @@ def make_optimizer(name: str = "sgd", learning_rate: float = 1.0,
     return opt
 
 
+def split_microbatches(batch, accum_steps: int):
+    """Reshape every leaf's leading dim B -> [accum_steps, B/accum_steps]
+    for gradient-accumulation scans (training and eval share this split
+    and its divisibility check)."""
+    def _one(x):
+        if x.shape[0] % accum_steps:
+            raise ValueError(
+                f"batch leading dim {x.shape[0]} does not divide by "
+                f"accum_steps={accum_steps}")
+        return x.reshape(accum_steps, x.shape[0] // accum_steps, *x.shape[1:])
+
+    return jax.tree.map(_one, batch)
+
+
 def make_train_step(loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
                     accum_steps: int = 1) -> Callable:
@@ -128,18 +142,11 @@ def make_train_step(loss_fn: Callable,
     def grads_of(params, batch):
         return jax.value_and_grad(loss_fn)(params, batch)
 
-    def _microbatch(x):
-        if x.shape[0] % accum_steps:
-            raise ValueError(
-                f"batch leading dim {x.shape[0]} does not divide by "
-                f"accum_steps={accum_steps}")
-        return x.reshape(accum_steps, x.shape[0] // accum_steps, *x.shape[1:])
-
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
         if accum_steps == 1:
             loss, grads = grads_of(state.params, batch)
         else:
-            micro = jax.tree.map(_microbatch, batch)
+            micro = split_microbatches(batch, accum_steps)
 
             def body(carry, mb):
                 loss_sum, acc = carry
@@ -265,9 +272,7 @@ class ShardedTrainer:
             def evaluate(state: TrainState, batch):
                 if accum == 1:
                     return loss_fn(state.params, batch)
-                micro = jax.tree.map(
-                    lambda x: x.reshape(accum, x.shape[0] // accum,
-                                        *x.shape[1:]), batch)
+                micro = split_microbatches(batch, accum)
 
                 def body(total, mb):
                     return (total
@@ -292,3 +297,16 @@ class ShardedTrainer:
         holds the same global batch — deterministic loaders)."""
         sharding = batch_sharding(self.mesh)
         return jax.tree.map(lambda x: put_global(x, sharding), batch)
+
+    def put_batch_local(self, local_batch):
+        """Assemble a global batch from PER-PROCESS rows: each host loads
+        only global_batch/process_count rows (its devices' shards) and JAX
+        stitches the global array — no host ever materializes the full
+        batch.  The scalable multi-host data path; single-process it is
+        just put_batch."""
+        if jax.process_count() == 1:
+            return self.put_batch(local_batch)
+        sharding = batch_sharding(self.mesh)
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)), local_batch)

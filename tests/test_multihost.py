@@ -79,6 +79,50 @@ def test_two_process_training_e2e(model, mesh, tmp_path):
     assert summaries[0]["steps"] == 4
 
 
+def test_two_process_per_process_data(tmp_path):
+    """--per-process-data: each host loads only batch/2 rows at its own
+    seed and the global batch is stitched from local shards
+    (make_array_from_process_local_data).  Both processes must agree on
+    the loss (one SPMD program) and show a learning signal."""
+    port = _free_port()
+    args = [sys.executable, "-m",
+            "parameter_server_distributed_tpu.cli.train_main",
+            f"--coordinator=127.0.0.1:{port}", "--num-processes=2",
+            "--model=mnist_mlp", "--mesh=data:8", "--steps=6",
+            "--batch=32", "--optimizer=sgd", "--lr=0.1", "--log-every=2",
+            "--per-process-data",
+            "--metrics=metrics_{}.jsonl"]
+    procs = [
+        subprocess.Popen(
+            [a.replace("{}", str(i)) for a in args] + [f"--process-id={i}"],
+            env=_child_env(), cwd=str(tmp_path), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE)
+        for i in range(2)
+    ]
+    outs = []
+    for i, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"process {i} timed out")
+        assert proc.returncode == 0, (
+            f"process {i} rc={proc.returncode}\n"
+            f"stderr tail:\n{err.decode(errors='replace')[-2000:]}")
+        outs.append(out.decode(errors="replace"))
+
+    summaries = [json.loads([l for l in out.splitlines()
+                             if l.startswith("{")][-1]) for out in outs]
+    losses = [s["final_loss"] for s in summaries]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+    # learning signal across the stitched global batches
+    lines = [json.loads(l)
+             for l in open(tmp_path / "metrics_0.jsonl")]
+    assert lines[-1]["loss"] < lines[0]["loss"]
+
+
 def test_hybrid_mesh_config_single_process():
     """hybrid_mesh_config factorizes the (virtual) global device count with
     model axes innermost."""

@@ -138,6 +138,7 @@ def flash_attention_auto(q: Array, k: Array, v: Array) -> Array:
     ``PSDT_FLASH_ATTENTION=1`` to make it the model default."""
     from ..ops.pallas.flash_attention import flash_attention
 
+    k, v = expand_gqa(q, k, v)
     seq = q.shape[1]
     if seq % 128 == 0:
         return flash_attention(q, k, v, block_q=128, block_k=128)
@@ -168,7 +169,16 @@ def make_sharded_flash_attention(mesh: Mesh,
     def sharded_flash(q, k, v):
         return flash_attention_auto(q, k, v)
 
-    return sharded_flash
+    n_tp = mesh.shape.get(head_axis, 1)
+
+    def sharded_flash_gqa(q, k, v):
+        # unexpanded GQA K/V whose kv_heads axis cannot be sharded by the
+        # tensor axis: pre-expand so the specs stay satisfiable
+        if n_tp > 1 and k.shape[2] % n_tp:
+            k, v = expand_gqa(q, k, v)
+        return sharded_flash(q, k, v)
+
+    return sharded_flash_gqa
 
 
 ATTENTION_CHOICES = ("dense", "flash", "ring", "ulysses", "ulysses_flash")
@@ -228,9 +238,24 @@ def repeat_kv(x: Array, groups: int) -> Array:
     return jnp.repeat(x, groups, axis=2)
 
 
+def expand_gqa(q: Array, k: Array, v: Array) -> tuple[Array, Array]:
+    """Repeat grouped-query K/V heads up to the query head count, inferring
+    the group size from the shapes.  Attention implementations call this
+    THEMSELVES (rather than receiving pre-expanded K/V) so that comm-bound
+    paths — ring's ppermute rotation, Ulysses' all-to-all — move the small
+    kv_heads-sized tensors and expand only at the math."""
+    groups = q.shape[2] // k.shape[2]
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"query heads {q.shape[2]} must divide by "
+                         f"kv heads {k.shape[2]}")
+    return repeat_kv(k, groups), repeat_kv(v, groups)
+
+
 def causal_attention(q: Array, k: Array, v: Array) -> Array:
-    """Reference einsum attention.  q,k,v: [B, S, H, D] -> [B, S, H, D].
-    float32 logits/softmax for stability."""
+    """Reference einsum attention.  q: [B, S, H, D], k/v: [B, S, H, D] or
+    the GQA [B, S, KV, D] (expanded here) -> [B, S, H, D].  float32
+    logits/softmax for stability."""
+    k, v = expand_gqa(q, k, v)
     head_dim = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)
@@ -452,8 +477,10 @@ class Transformer:
         def layer_body(layer_params, i, h, p=None):
             p = f"layer{i}" if p is None else p
             q, k, v = self.qkv(layer_params, p, h, positions)
-            attn = self.attention_fn(q, repeat_kv(k, c.kv_groups),
-                                     repeat_kv(v, c.kv_groups))
+            # K/V go to the attention fn UNexpanded (kv_heads-sized);
+            # each implementation expands at the math (expand_gqa), so
+            # ring/Ulysses communicate the small tensors
+            attn = self.attention_fn(q, k, v)
             h = self.attn_residual(layer_params, p, h, attn)
             h = self._constrain(h, ("data", "fsdp"), "seq", None)
             if i is None:  # scan body: homogeneous dense layers

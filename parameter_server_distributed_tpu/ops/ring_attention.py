@@ -15,9 +15,12 @@ axis.  Causal attention then needs cross-device K/V:
 
 Both return an ``attention_fn(q, k, v) -> out`` with the same signature as
 `models.transformer.causal_attention` ([B, S, H, D] -> [B, S, H, D]), so the
-Transformer takes them as drop-in `attention_fn`.  There is no reference
-analogue — the reference has no model, no sequence axis (SURVEY.md §5);
-this is required TPU-native scale capability.
+Transformer takes them as drop-in `attention_fn`.  K/V may arrive with the
+GQA kv_heads-sized head axis: the ring rotates and Ulysses all-to-alls the
+SMALL unexpanded tensors (n_heads/kv_heads fewer bytes on ICI) and expands
+only at the math.  There is no reference analogue — the reference has no
+model, no sequence axis (SURVEY.md §5); this is required TPU-native scale
+capability.
 """
 
 from __future__ import annotations
@@ -33,12 +36,34 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30  # avoid true -inf: exp/where arithmetic stays NaN-free
 
 
+def _prepare_gqa_kv(q, k, v, n_tp: int):
+    """Validate GQA head grouping and, when the unexpanded kv_heads axis
+    cannot be sharded by the ``tensor`` axis (kv_heads % n_tp != 0),
+    pre-expand K/V to the query head count so the shard_map specs stay
+    satisfiable — the pre-refactor behavior for that corner (MQA with
+    tensor parallelism); all other configs keep the small K/V transfers."""
+    from ..models.transformer import expand_gqa
+
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"query heads {q.shape[2]} must divide by "
+                         f"kv heads {k.shape[2]}")
+    if n_tp > 1 and k.shape[2] % n_tp:
+        k, v = expand_gqa(q, k, v)
+    return k, v
+
+
 def _block_attention_update(q32, k_blk, v_blk, q_pos, k_pos, m, l, acc):
     """One online-softmax accumulation step over a K/V block.
 
-    q32 [B,H,Sq,D] f32; k_blk/v_blk [B,Sk,H,D]; m,l [B,H,Sq]; acc [B,H,Sq,D].
+    q32 [B,H,Sq,D] f32; k_blk/v_blk [B,Sk,H,D] or the GQA [B,Sk,KV,D]
+    (expanded here — the ring rotates the small unexpanded tensors);
+    m,l [B,H,Sq]; acc [B,H,Sq,D].
     """
     d = q32.shape[-1]
+    groups = q32.shape[1] // k_blk.shape[2]
+    if groups > 1:
+        k_blk = jnp.repeat(k_blk, groups, axis=2)
+        v_blk = jnp.repeat(v_blk, groups, axis=2)
     k32 = k_blk.astype(jnp.float32)
     v32 = v_blk.astype(jnp.float32)
     scores = jnp.einsum("bhqd,bkhd->bhqk", q32, k32) / math.sqrt(d)
@@ -74,6 +99,7 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
     # shrink to the O(Sq*D) carries, the whole point of ring attention's
     # O(S/N) activation-memory claim at long context
     block_update = jax.checkpoint(_block_attention_update)
+    n_tp = mesh.shape.get(head_axis, 1)
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
@@ -104,7 +130,11 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
                 v_cur = jax.lax.ppermute(v_cur, seq_axis, perm)
         return _finalize(acc, l).astype(q.dtype)
 
-    return ring
+    def ring_gqa(q, k, v):
+        k, v = _prepare_gqa_kv(q, k, v, n_tp)
+        return ring(q, k, v)
+
+    return ring_gqa
 
 
 def make_ulysses_attention(mesh: Mesh, seq_axis: str = "seq",
@@ -126,7 +156,8 @@ def make_ulysses_attention(mesh: Mesh, seq_axis: str = "seq",
         inner = causal_attention
 
     n = mesh.shape[seq_axis]
-    heads_spec = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    n_tp = mesh.shape.get(head_axis, 1)
+    heads_spec = head_axis if n_tp > 1 else None
     spec = P(batch_axes, seq_axis, heads_spec, None)
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
@@ -140,7 +171,20 @@ def make_ulysses_attention(mesh: Mesh, seq_axis: str = "seq",
             return jax.lax.all_to_all(x, seq_axis, split_axis=1, concat_axis=2,
                                       tiled=True)
 
-        out = inner(gather_seq(q), gather_seq(k), gather_seq(v))
+        # GQA: all-to-all the small kv_heads-sized K/V when kv_heads
+        # divides the seq axis (groups/n fewer bytes on the wire) and let
+        # the inner kernel expand; otherwise expand first (correct for
+        # any head count, at the old expanded-transfer cost)
+        if k.shape[2] % n == 0:
+            out = inner(gather_seq(q), gather_seq(k), gather_seq(v))
+        else:
+            from ..models.transformer import expand_gqa
+            ke, ve = expand_gqa(q, k, v)
+            out = inner(gather_seq(q), gather_seq(ke), gather_seq(ve))
         return scatter_seq(out)
 
-    return ulysses
+    def ulysses_gqa(q, k, v):
+        k, v = _prepare_gqa_kv(q, k, v, n_tp)
+        return ulysses(q, k, v)
+
+    return ulysses_gqa

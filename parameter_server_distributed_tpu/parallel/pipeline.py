@@ -137,12 +137,8 @@ class PipelinedTransformerLM:
         positions = jnp.arange(seq, dtype=jnp.int32)
 
         def one_block(blk, h):
-            from ..models.transformer import repeat_kv
-
             q, k, v = model.qkv(blk, key, h, positions)
-            groups = model.config.kv_groups
-            attn = model.attention_fn(q, repeat_kv(k, groups),
-                                      repeat_kv(v, groups))
+            attn = model.attention_fn(q, k, v)  # impls expand GQA K/V
             h = model.attn_residual(blk, key, h, attn)
             return model.mlp_residual(blk, key, h)
 

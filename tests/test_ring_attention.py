@@ -200,3 +200,58 @@ def test_ring_block_remat_gradients_match(rng):
     for gr, gd, name in zip(grads_r, grads_d, "qkv"):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
                                    rtol=2e-4, atol=2e-4, err_msg=f"d{name}")
+
+
+def test_ring_and_ulysses_gqa_unexpanded_kv(rng):
+    """GQA contract: attention fns take kv_heads-sized K/V (the ring
+    rotates / Ulysses all-to-alls the small tensors) and match the
+    expanded dense reference."""
+    from parameter_server_distributed_tpu.models.transformer import repeat_kv
+
+    b, s, h, kv, d = 4, 32, 8, 2, 16
+    q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    k = rng.standard_normal((b, s, kv, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, kv, d)).astype(np.float32)
+    dense = np.asarray(causal_attention(
+        jnp.asarray(q), repeat_kv(jnp.asarray(k), h // kv),
+        repeat_kv(jnp.asarray(v), h // kv)))
+
+    mesh = build_mesh(MeshConfig(sequence=4, data=2))
+    out_ring = np.asarray(jax.jit(make_ring_attention(mesh))(q, k, v))
+    np.testing.assert_allclose(out_ring, dense, rtol=2e-5, atol=2e-5)
+
+    # kv=2 divides seq axis 2: the small-transfer path
+    mesh2 = build_mesh(MeshConfig(sequence=2, data=4))
+    out_uly = np.asarray(jax.jit(make_ulysses_attention(mesh2))(q, k, v))
+    np.testing.assert_allclose(out_uly, dense, rtol=2e-5, atol=2e-5)
+
+    # kv=2 does NOT divide seq axis 4: the expand-first fallback
+    mesh4 = build_mesh(MeshConfig(sequence=4, data=2))
+    out_uly4 = np.asarray(jax.jit(make_ulysses_attention(mesh4))(q, k, v))
+    np.testing.assert_allclose(out_uly4, dense, rtol=2e-5, atol=2e-5)
+
+
+def test_mqa_with_tensor_parallel_heads(rng):
+    """MQA (kv_heads=1) + tensor-sharded heads: kv_heads cannot be sharded
+    by the tensor axis, so the wrappers pre-expand K/V — the pre-GQA-
+    refactor behavior for this corner (regression test)."""
+    from parameter_server_distributed_tpu.models.transformer import (
+        make_sharded_flash_attention, repeat_kv)
+
+    b, s, h, d = 4, 32, 4, 16
+    q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    k = rng.standard_normal((b, s, 1, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, 1, d)).astype(np.float32)
+    dense = np.asarray(causal_attention(
+        jnp.asarray(q), repeat_kv(jnp.asarray(k), h),
+        repeat_kv(jnp.asarray(v), h)))
+
+    mesh = build_mesh(MeshConfig(sequence=2, tensor=2, data=2))
+    for maker in (make_ring_attention, make_ulysses_attention):
+        out = np.asarray(jax.jit(maker(mesh))(q, k, v))
+        np.testing.assert_allclose(out, dense, rtol=2e-5, atol=2e-5,
+                                   err_msg=maker.__name__)
+
+    fmesh = build_mesh(MeshConfig(tensor=2, data=4))
+    out = np.asarray(jax.jit(make_sharded_flash_attention(fmesh))(q, k, v))
+    np.testing.assert_allclose(out, dense, rtol=2e-5, atol=2e-5)

@@ -100,6 +100,7 @@ def main(argv: list[str] | None = None) -> int:
         eval_steps=int(flags.get("eval-steps", 4)),
         eval_data_path=flags.get("eval-data", ""),
         per_process_data="per-process-data" in flags,
+        prefetch=int(flags.get("prefetch", 2)),
         attention=flags.get("attention", "dense"),
         microbatches=int(flags.get("microbatches", 0)),
         model_dtype=flags.get("dtype", ""),

@@ -172,10 +172,7 @@ def make_sharded_flash_attention(mesh: Mesh,
     n_tp = mesh.shape.get(head_axis, 1)
 
     def sharded_flash_gqa(q, k, v):
-        # unexpanded GQA K/V whose kv_heads axis cannot be sharded by the
-        # tensor axis: pre-expand so the specs stay satisfiable
-        if n_tp > 1 and k.shape[2] % n_tp:
-            k, v = expand_gqa(q, k, v)
+        k, v = prepare_gqa_kv(q, k, v, n_tp)
         return sharded_flash(q, k, v)
 
     return sharded_flash_gqa
@@ -249,6 +246,22 @@ def expand_gqa(q: Array, k: Array, v: Array) -> tuple[Array, Array]:
         raise ValueError(f"query heads {q.shape[2]} must divide by "
                          f"kv heads {k.shape[2]}")
     return repeat_kv(k, groups), repeat_kv(v, groups)
+
+
+def prepare_gqa_kv(q: Array, k: Array, v: Array,
+                   n_tp: int) -> tuple[Array, Array]:
+    """Validate GQA head grouping and, when the unexpanded kv_heads axis
+    cannot be sharded by the ``tensor`` axis (kv_heads % n_tp != 0),
+    pre-expand K/V to the query head count so shard_map head specs stay
+    satisfiable (MQA + tensor parallelism); all other configs keep the
+    small kv_heads-sized transfers.  The single home for this rule,
+    shared by the ring/Ulysses/sharded-flash wrappers."""
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"query heads {q.shape[2]} must divide by "
+                         f"kv heads {k.shape[2]}")
+    if n_tp > 1 and k.shape[2] % n_tp:
+        k, v = expand_gqa(q, k, v)
+    return k, v
 
 
 def causal_attention(q: Array, k: Array, v: Array) -> Array:

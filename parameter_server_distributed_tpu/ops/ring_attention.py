@@ -37,19 +37,11 @@ NEG_INF = -1e30  # avoid true -inf: exp/where arithmetic stays NaN-free
 
 
 def _prepare_gqa_kv(q, k, v, n_tp: int):
-    """Validate GQA head grouping and, when the unexpanded kv_heads axis
-    cannot be sharded by the ``tensor`` axis (kv_heads % n_tp != 0),
-    pre-expand K/V to the query head count so the shard_map specs stay
-    satisfiable — the pre-refactor behavior for that corner (MQA with
-    tensor parallelism); all other configs keep the small K/V transfers."""
-    from ..models.transformer import expand_gqa
+    """models.transformer.prepare_gqa_kv, imported lazily (the transformer
+    module is the single home for the GQA-vs-tensor-axis rule)."""
+    from ..models.transformer import prepare_gqa_kv
 
-    if q.shape[2] % k.shape[2]:
-        raise ValueError(f"query heads {q.shape[2]} must divide by "
-                         f"kv heads {k.shape[2]}")
-    if n_tp > 1 and k.shape[2] % n_tp:
-        k, v = expand_gqa(q, k, v)
-    return k, v
+    return prepare_gqa_kv(q, k, v, n_tp)
 
 
 def _block_attention_update(q32, k_blk, v_blk, q_pos, k_pos, m, l, acc):

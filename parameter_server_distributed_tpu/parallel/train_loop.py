@@ -40,6 +40,8 @@ class TrainLoopConfig:
     seq_len: int = 0              # LM sequence-length override (0 = default)
     per_process_data: bool = False  # multi-host: each process loads only
                                     # its batch/process_count rows
+    prefetch: int = 2             # batches placed on device ahead of the
+                                  # loop (0 = synchronous loading)
     eval_every: int = 0           # held-out eval cadence in steps (0 = off)
     eval_steps: int = 4           # batches averaged per evaluation
     eval_data_path: str = ""      # held-out data; empty = shifted-seed
@@ -196,6 +198,14 @@ def run_training(config: TrainLoopConfig) -> dict:
     step_fn = trainer.step_fn()
     place_batch = (trainer.put_batch_local if local_mode
                    else trainer.put_batch)
+    if config.prefetch > 0:
+        # loader + H2D placement run on a background thread, staying
+        # config.prefetch batches ahead of the compute loop
+        from ..data.prefetch import prefetch_to_device
+        placed_batches = prefetch_to_device(batches, place_batch,
+                                            depth=config.prefetch)
+    else:
+        placed_batches = (place_batch(b) for b in batches)
     metrics_log = MetricsLogger(config.metrics_path or None)
     timer = StepTimer()
     n_chips = mesh.devices.size
@@ -208,8 +218,7 @@ def run_training(config: TrainLoopConfig) -> dict:
     try:
         with profile_trace("train_loop"):
             for step_idx in range(start_step, config.steps):
-                batch = next(batches)
-                state, metrics = step_fn(state, place_batch(batch))
+                state, metrics = step_fn(state, next(placed_batches))
                 window_steps += 1
                 if ((step_idx + 1) % config.log_every == 0
                         or step_idx == config.steps - 1):
@@ -257,6 +266,10 @@ def run_training(config: TrainLoopConfig) -> dict:
                         sharded_ckpt.prune_checkpoints(
                             config.checkpoint_dir, config.checkpoint_keep)
     finally:
+        if hasattr(placed_batches, "close"):
+            # stop the prefetch worker: otherwise it keeps placing device
+            # batches while the final eval/checkpoint need the memory
+            placed_batches.close()
         sharded_ckpt.wait_for_saves()
         if (config.checkpoint_keep and config.checkpoint_dir
                 and jax.process_index() == 0):

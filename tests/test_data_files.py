@@ -131,3 +131,38 @@ def test_token_stream_vocab_validation(tmp_path):
     np.full(100, 5000, dtype="<u2").tofile(path)
     with pytest.raises(ValueError, match="wrong tokenizer"):
         next(token_stream(path, batch_size=2, seq_len=16, vocab=1024))
+
+
+def test_prefetch_to_device():
+    """prefetch_to_device: same batches in order, loader/placement errors
+    surface at next(), close() stops the worker."""
+    import numpy as np
+    from parameter_server_distributed_tpu.data.prefetch import (
+        prefetch_to_device)
+
+    def loader(n):
+        for i in range(n):
+            yield np.full((2, 2), i)
+
+    got = list(prefetch_to_device(loader(5), place=lambda b: b * 10))
+    assert [int(b[0, 0]) for b in got] == [0, 10, 20, 30, 40]
+
+    def bad_loader():
+        yield np.ones((1,))
+        raise RuntimeError("loader died")
+
+    it = prefetch_to_device(bad_loader(), place=lambda b: b)
+    next(it)
+    import pytest
+    with pytest.raises(RuntimeError, match="loader died"):
+        next(it)
+
+    def endless():
+        i = 0
+        while True:
+            yield np.full((1,), i)
+            i += 1
+
+    it = prefetch_to_device(endless(), place=lambda b: b, depth=1)
+    assert int(next(it)[0]) == 0
+    it.close()  # worker must stop even though the stream is endless

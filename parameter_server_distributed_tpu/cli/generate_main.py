@@ -2,7 +2,7 @@
 
     python -m parameter_server_distributed_tpu.cli.generate_main \
         --model=small_lm --prompt="the quick brown" --max-new=64 \
-        [--ckpt=path.ckpt | --ckpt-dir=orbax_dir] \
+        [--ckpt=path.ckpt | --ckpt-dir=orbax_dir [--avg-last=K]] \
         [--temperature=0.8] [--top-k=40] [--top-p=0.9] [--seed=0] \
         [--dtype=bf16] [--tokens=1,2,3]
 
@@ -38,12 +38,19 @@ def load_params(flags: dict, model, seed: int):
         return params, f"host checkpoint {flags['ckpt']} (iter {iteration})"
     if flags.get("ckpt-dir"):
         from ..checkpoint import sharded as sc
-        step, state = sc.restore_latest(flags["ckpt-dir"])
+        avg_k = int(flags.get("avg-last", 0))
+        if avg_k > 1:
+            have = min(avg_k, len(sc._committed_steps(flags["ckpt-dir"])))
+            step, state = sc.average_checkpoints(flags["ckpt-dir"], avg_k)
+            what = f"average of last {have} checkpoints (newest step {step})"
+        else:
+            step, state = sc.restore_latest(flags["ckpt-dir"])
+            what = f"sharded checkpoint step {step}"
         if step is None:
             raise FileNotFoundError(
                 f"no step_N checkpoints under {flags['ckpt-dir']!r}")
         params = state["params"] if isinstance(state, dict) else state.params
-        return params, f"sharded checkpoint step {step}"
+        return params, what
     return model.init_params(seed), f"fresh init (seed {seed})"
 
 

@@ -193,3 +193,30 @@ def test_eval_loop(tmp_path):
         **base, accum_steps=2, eval_every=2, eval_steps=2))
     assert accum["eval_loss"] == pytest.approx(with_eval["eval_loss"],
                                                rel=1e-4)
+
+
+def test_checkpoint_averaging(tmp_path):
+    """average_checkpoints: uniform f32 mean of the last K params, newest
+    step's metadata, stored dtype preserved."""
+    run_training(TrainLoopConfig(
+        model="mnist_mlp", batch_size=16, steps=6, optimizer="sgd",
+        learning_rate=0.1, mesh=MeshConfig(data=2),
+        checkpoint_dir=str(tmp_path), checkpoint_every=2, log_every=6))
+    import jax.numpy as jnp
+
+    s4 = sc.restore_sharded(str(tmp_path / "step_4"))
+    s6 = sc.restore_sharded(str(tmp_path / "step_6"))
+    step, avg = sc.average_checkpoints(str(tmp_path), 2)
+    assert step == 6
+    p4 = s4["params"] if isinstance(s4, dict) else s4.params
+    p6 = s6["params"] if isinstance(s6, dict) else s6.params
+    pa = avg["params"] if isinstance(avg, dict) else avg.params
+    for name in pa:
+        expect = (np.asarray(p4[name], np.float32)
+                  + np.asarray(p6[name], np.float32)) / 2
+        np.testing.assert_allclose(np.asarray(pa[name], np.float32), expect,
+                                   rtol=1e-6, err_msg=name)
+        assert jnp.asarray(pa[name]).dtype == jnp.asarray(p6[name]).dtype
+
+    none_step, none_state = sc.average_checkpoints(str(tmp_path / "nope"), 3)
+    assert none_step is None and none_state is None

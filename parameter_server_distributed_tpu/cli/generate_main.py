@@ -3,7 +3,8 @@
     python -m parameter_server_distributed_tpu.cli.generate_main \
         --model=small_lm --prompt="the quick brown" --max-new=64 \
         [--ckpt=path.ckpt | --ckpt-dir=orbax_dir [--avg-last=K]] \
-        [--temperature=0.8] [--top-k=40] [--top-p=0.9] [--seed=0] \
+        [--temperature=0.8] [--top-k=40] [--top-p=0.9] [--beam=4] \
+        [--seed=0] \
         [--dtype=bf16] [--tokens=1,2,3]
 
 Parameters come from (in priority order) ``--ckpt`` (the host binary
@@ -103,15 +104,26 @@ def main(argv: list[str] | None = None) -> int:
 
     top_k = int(flags.get("top-k", 0))
     top_p = float(flags.get("top-p", 0.0))
+    beam = int(flags.get("beam", 0))
     # sampling flags imply sampling: temperature 0 (greedy) would silently
     # ignore top-k/top-p, so they default the temperature to 1.0
     default_temp = "1.0" if (top_k or top_p) else "0.0"
     temperature = float(flags.get("temperature", default_temp))
     prompt = np.asarray([ids], np.int32)
-    out = generate(model, params, prompt,
-                   int(flags.get("max-new", 64)),
-                   temperature=temperature, top_k=top_k, top_p=top_p,
-                   rng=seed)
+    max_new = int(flags.get("max-new", 64))
+    if beam > 1:
+        if top_k or top_p or "temperature" in flags:
+            raise ValueError("--beam is deterministic; it does not combine "
+                             "with --temperature/--top-k/--top-p")
+        from ..models.generation import beam_search
+        out, score = beam_search(model, params, prompt, max_new,
+                                 beam_width=beam)
+        print(f"beam: width {beam}, joint logprob "
+              f"{float(np.asarray(score)[0]):.3f}", file=sys.stderr)
+    else:
+        out = generate(model, params, prompt, max_new,
+                       temperature=temperature, top_k=top_k, top_p=top_p,
+                       rng=seed)
     tokens = np.asarray(out)[0]
     if decode_text:
         print(tokenizer.decode(tokens), flush=True)

@@ -162,14 +162,28 @@ _RUNNERS_MAX = 32
 _RUNNERS_LOCK = threading.Lock()
 
 
-def _runner(model: Transformer, max_new_tokens: int, temperature: float,
-            top_k: int, top_p: float):
-    key = (id(model), max_new_tokens, temperature, top_k, top_p)
+def _cached_runner(key: tuple, build):
+    """LRU-cached compiled runner: one lock/evict protocol for every
+    runner flavor.  A concurrent miss may build twice (benign — last
+    insert wins and the loser is garbage)."""
     with _RUNNERS_LOCK:
         run = _RUNNERS.get(key)
         if run is not None:
             _RUNNERS.move_to_end(key)
-    if run is None:
+            return run
+    run = build()
+    with _RUNNERS_LOCK:
+        _RUNNERS[key] = run
+        while len(_RUNNERS) > _RUNNERS_MAX:
+            _RUNNERS.popitem(last=False)
+    return run
+
+
+def _runner(model: Transformer, max_new_tokens: int, temperature: float,
+            top_k: int, top_p: float):
+    key = (id(model), max_new_tokens, temperature, top_k, top_p)
+
+    def build():
         @jax.jit
         def run(params, prompt, rng):
             max_len = prompt.shape[1] + max_new_tokens
@@ -188,11 +202,85 @@ def _runner(model: Transformer, max_new_tokens: int, temperature: float,
                 body, (first, cache, rng), None, length=max_new_tokens)
             return jnp.swapaxes(tokens, 0, 1)      # [B, max_new]
 
-        with _RUNNERS_LOCK:
-            _RUNNERS[key] = run
-            while len(_RUNNERS) > _RUNNERS_MAX:
-                _RUNNERS.popitem(last=False)
-    return run
+        return run
+
+    return _cached_runner(key, build)
+
+
+def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int):
+    key = (id(model), max_new_tokens, "beam", beam_width)
+
+    def build():
+        @jax.jit
+        def run(params, prompt):
+            b, s = prompt.shape
+            w = beam_width
+            max_len = s + max_new_tokens
+            logits, cache = prefill(model, params, prompt, max_len)
+            logp = jax.nn.log_softmax(logits, axis=-1)        # [B, V]
+            vocab = logp.shape[-1]
+            scores, first = jax.lax.top_k(logp, w)            # [B, W]
+
+            # beams live interleaved in the cache batch dim: row b*W + j
+            def tile(x):
+                return jnp.repeat(x, w, axis=1)
+            cache = KVCache(k=tile(cache.k), v=tile(cache.v),
+                            length=cache.length)
+            seqs = jnp.zeros((b, w, max_new_tokens), jnp.int32)
+            seqs = seqs.at[:, :, 0].set(first)
+
+            def body(carry, i):
+                seqs, scores, cache = carry
+                tok = jax.lax.dynamic_index_in_dim(
+                    seqs, i - 1, axis=2, keepdims=False)       # [B, W]
+                logits, cache = decode_step(model, params,
+                                            tok.reshape(b * w), cache)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                total = scores[:, :, None] + logp.reshape(b, w, vocab)
+                scores, flat = jax.lax.top_k(
+                    total.reshape(b, w * vocab), w)            # [B, W]
+                parent = flat // vocab                         # [B, W]
+                token = (flat % vocab).astype(jnp.int32)
+                # reorder histories and cache rows onto the winning beams
+                seqs = jnp.take_along_axis(seqs, parent[:, :, None], axis=1)
+                seqs = jax.lax.dynamic_update_slice_in_dim(
+                    seqs, token[:, :, None], i, axis=2)
+                rows = (jnp.arange(b)[:, None] * w + parent).reshape(-1)
+                cache = KVCache(k=jnp.take(cache.k, rows, axis=1),
+                                v=jnp.take(cache.v, rows, axis=1),
+                                length=cache.length)
+                return (seqs, scores, cache), None
+
+            (seqs, scores, _), _ = jax.lax.scan(
+                body, (seqs, scores, cache),
+                jnp.arange(1, max_new_tokens))
+            best = jnp.argmax(scores, axis=1)
+            out = jnp.take_along_axis(seqs, best[:, None, None],
+                                      axis=1)[:, 0]            # [B, max_new]
+            return out, jnp.take_along_axis(scores, best[:, None],
+                                            axis=1)[:, 0]
+
+        return run
+
+    return _cached_runner(key, build)
+
+
+def beam_search(model: Transformer, params: Mapping[str, Array],
+                prompt: Array, max_new_tokens: int,
+                beam_width: int = 4) -> tuple[Array, Array]:
+    """Fixed-length beam search over ``max_new_tokens`` continuations:
+    keeps the ``beam_width`` highest joint-log-prob prefixes each step,
+    reordering the KV cache rows onto the surviving beams (beams live
+    interleaved in the cache batch dim).  Returns (tokens [B, max_new],
+    joint log-prob [B]) for each item's best beam.  beam_width=1 is
+    greedy decoding; there is no EOS handling (the framework's LMs have
+    no reserved stop token), so all beams run the full length."""
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if not 1 <= beam_width <= model.config.vocab:
+        raise ValueError(f"beam_width={beam_width} must be in "
+                         f"[1, vocab={model.config.vocab}]")
+    return _beam_runner(model, max_new_tokens, beam_width)(params, prompt)
 
 
 def generate(model: Transformer, params: Mapping[str, Array],

@@ -240,3 +240,64 @@ def test_generate_cli_cross_layout(tmp_path, capsys):
         assert main(argv) == 0
         outs.append(capsys.readouterr().out.strip())
     assert len(set(outs)) == 1, outs
+
+
+def test_beam_width_one_is_greedy(rng):
+    from parameter_server_distributed_tpu.models.generation import (
+        beam_search, generate)
+    from parameter_server_distributed_tpu.models.transformer import small_lm
+
+    model = small_lm(vocab=64, seq=32)
+    params = model.init_params(0)
+    prompt = rng.integers(0, 64, (2, 5)).astype(np.int32)
+    greedy = np.asarray(generate(model, params, prompt, max_new_tokens=6))
+    beam, scores = beam_search(model, params, prompt, max_new_tokens=6,
+                               beam_width=1)
+    np.testing.assert_array_equal(np.asarray(beam), greedy)
+    assert np.all(np.isfinite(np.asarray(scores)))
+
+
+def test_beam_search_full_width_finds_joint_argmax(rng):
+    """With beam_width = vocab, a 2-step beam search is exhaustive: its
+    result must be the argmax of the joint log-prob over ALL two-token
+    continuations, computed by brute force through the full forward."""
+    from parameter_server_distributed_tpu.models.generation import beam_search
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    vocab = 16
+    model = Transformer(TransformerConfig(
+        vocab=vocab, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=16, dtype=jnp.float32))
+    params = model.init_params(0)
+    prompt = rng.integers(0, vocab, (1, 3)).astype(np.int32)
+
+    out, score = beam_search(model, params, prompt, max_new_tokens=2,
+                             beam_width=vocab)
+    out = np.asarray(out)[0]
+
+    # brute force: joint logprob of every (t1, t2)
+    best = (None, -np.inf)
+    logits = np.asarray(model.apply(params, prompt))  # [1, 3, V]
+    lp1 = jax.nn.log_softmax(logits[0, -1])
+    for t1 in range(vocab):
+        seq = np.concatenate([prompt[0], [t1]])[None].astype(np.int32)
+        lp2 = jax.nn.log_softmax(np.asarray(model.apply(params, seq))[0, -1])
+        for t2 in range(vocab):
+            joint = float(lp1[t1]) + float(lp2[t2])
+            if joint > best[1]:
+                best = ((t1, t2), joint)
+    assert tuple(out) == best[0]
+    assert float(np.asarray(score)[0]) == pytest.approx(best[1], rel=1e-4)
+
+
+def test_beam_width_validation(rng):
+    from parameter_server_distributed_tpu.models.generation import beam_search
+    from parameter_server_distributed_tpu.models.transformer import small_lm
+
+    model = small_lm(vocab=64, seq=32)
+    params = model.init_params(0)
+    prompt = rng.integers(0, 64, (1, 4)).astype(np.int32)
+    for bad in (0, 65):
+        with pytest.raises(ValueError, match="beam_width"):
+            beam_search(model, params, prompt, 4, beam_width=bad)

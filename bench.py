@@ -57,6 +57,20 @@ def _configure_platform() -> None:
     """
     import jax
 
+    # persistent compilation cache: a retried TPU attempt (new subprocess)
+    # reuses the previous attempt's XLA compiles instead of re-paying the
+    # multi-minute remote compile — often the difference between a timed-
+    # out and a successful attempt.  Opt out with PSDT_COMPILE_CACHE=off.
+    cache_dir = os.environ.get("PSDT_COMPILE_CACHE",
+                               "/tmp/psdt_jax_cache")
+    if cache_dir and cache_dir != "off":
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+        except Exception:  # noqa: BLE001 — cache is best-effort
+            pass
+
     if os.environ.get("PSDT_BENCH_PLATFORM") == "cpu":
         jax.config.update("jax_platforms", "cpu")
         return

@@ -35,3 +35,14 @@ if _os.environ.get("PSDT_PLATFORM"):
     import jax as _jax
 
     _jax.config.update("jax_platforms", _os.environ["PSDT_PLATFORM"])
+
+if _os.environ.get("PSDT_COMPILE_CACHE"):
+    # Opt-in persistent XLA compilation cache (PSDT_COMPILE_CACHE=<dir>):
+    # repeated CLI runs reuse compiled executables across processes — on
+    # remote-compile TPU backends that turns multi-minute recompiles into
+    # disk reads.  bench.py defaults this ON for its own children.
+    import jax as _jax_cc
+
+    _jax_cc.config.update("jax_compilation_cache_dir",
+                          _os.environ["PSDT_COMPILE_CACHE"])
+    _jax_cc.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

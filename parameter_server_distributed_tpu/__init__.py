@@ -36,7 +36,7 @@ if _os.environ.get("PSDT_PLATFORM"):
 
     _jax.config.update("jax_platforms", _os.environ["PSDT_PLATFORM"])
 
-if _os.environ.get("PSDT_COMPILE_CACHE"):
+if _os.environ.get("PSDT_COMPILE_CACHE") not in (None, "", "off"):
     # Opt-in persistent XLA compilation cache (PSDT_COMPILE_CACHE=<dir>):
     # repeated CLI runs reuse compiled executables across processes — on
     # remote-compile TPU backends that turns multi-minute recompiles into

@@ -116,8 +116,12 @@ def main(argv: list[str] | None = None) -> int:
             raise ValueError("--beam is deterministic; it does not combine "
                              "with --temperature/--top-k/--top-p")
         from ..models.generation import beam_search
+        # text mode: the byte tokenizer's EOS finishes beams early
+        # (require_vocab above guaranteed the model covers it);
+        # raw-token mode has no reserved stop id
+        eos = tokenizer.EOS if decode_text else None
         out, score = beam_search(model, params, prompt, max_new,
-                                 beam_width=beam)
+                                 beam_width=beam, eos_id=eos)
         print(f"beam: width {beam}, joint logprob "
               f"{float(np.asarray(score)[0]):.3f}", file=sys.stderr)
     else:
@@ -126,6 +130,9 @@ def main(argv: list[str] | None = None) -> int:
                        rng=seed)
     tokens = np.asarray(out)[0]
     if decode_text:
+        stop = np.nonzero(tokens == tokenizer.EOS)[0]
+        if stop.size:  # trim at the first EOS (beam padding or natural)
+            tokens = tokens[:int(stop[0])]
         print(tokenizer.decode(tokens), flush=True)
     else:
         print(",".join(str(int(t)) for t in tokens), flush=True)

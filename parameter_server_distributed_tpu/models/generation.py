@@ -207,8 +207,9 @@ def _runner(model: Transformer, max_new_tokens: int, temperature: float,
     return _cached_runner(key, build)
 
 
-def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int):
-    key = (id(model), max_new_tokens, "beam", beam_width)
+def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int,
+                 eos_id: int | None):
+    key = (id(model), max_new_tokens, "beam", beam_width, eos_id)
 
     def build():
         @jax.jit
@@ -220,6 +221,8 @@ def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int):
             logp = jax.nn.log_softmax(logits, axis=-1)        # [B, V]
             vocab = logp.shape[-1]
             scores, first = jax.lax.top_k(logp, w)            # [B, W]
+            finished = (jnp.zeros((b, w), bool) if eos_id is None
+                        else first == eos_id)
 
             # beams live interleaved in the cache batch dim: row b*W + j
             def tile(x):
@@ -230,13 +233,21 @@ def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int):
             seqs = seqs.at[:, :, 0].set(first)
 
             def body(carry, i):
-                seqs, scores, cache = carry
+                seqs, scores, finished, cache = carry
                 tok = jax.lax.dynamic_index_in_dim(
                     seqs, i - 1, axis=2, keepdims=False)       # [B, W]
                 logits, cache = decode_step(model, params,
                                             tok.reshape(b * w), cache)
-                logp = jax.nn.log_softmax(logits, axis=-1)
-                total = scores[:, :, None] + logp.reshape(b, w, vocab)
+                logp = jax.nn.log_softmax(logits, axis=-1).reshape(
+                    b, w, vocab)
+                if eos_id is not None:
+                    # a finished beam may only continue with EOS at logp 0:
+                    # its joint score freezes and it stays comparable in
+                    # the flat top-k against live beams
+                    pad = jnp.full((vocab,), -jnp.inf).at[eos_id].set(0.0)
+                    logp = jnp.where(finished[:, :, None],
+                                     pad[None, None, :], logp)
+                total = scores[:, :, None] + logp
                 scores, flat = jax.lax.top_k(
                     total.reshape(b, w * vocab), w)            # [B, W]
                 parent = flat // vocab                         # [B, W]
@@ -245,14 +256,17 @@ def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int):
                 seqs = jnp.take_along_axis(seqs, parent[:, :, None], axis=1)
                 seqs = jax.lax.dynamic_update_slice_in_dim(
                     seqs, token[:, :, None], i, axis=2)
+                finished = jnp.take_along_axis(finished, parent, axis=1)
+                if eos_id is not None:
+                    finished = finished | (token == eos_id)
                 rows = (jnp.arange(b)[:, None] * w + parent).reshape(-1)
                 cache = KVCache(k=jnp.take(cache.k, rows, axis=1),
                                 v=jnp.take(cache.v, rows, axis=1),
                                 length=cache.length)
-                return (seqs, scores, cache), None
+                return (seqs, scores, finished, cache), None
 
-            (seqs, scores, _), _ = jax.lax.scan(
-                body, (seqs, scores, cache),
+            (seqs, scores, _, _), _ = jax.lax.scan(
+                body, (seqs, scores, finished, cache),
                 jnp.arange(1, max_new_tokens))
             best = jnp.argmax(scores, axis=1)
             out = jnp.take_along_axis(seqs, best[:, None, None],
@@ -267,20 +281,27 @@ def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int):
 
 def beam_search(model: Transformer, params: Mapping[str, Array],
                 prompt: Array, max_new_tokens: int,
-                beam_width: int = 4) -> tuple[Array, Array]:
+                beam_width: int = 4,
+                eos_id: int | None = None) -> tuple[Array, Array]:
     """Fixed-length beam search over ``max_new_tokens`` continuations:
     keeps the ``beam_width`` highest joint-log-prob prefixes each step,
     reordering the KV cache rows onto the surviving beams (beams live
     interleaved in the cache batch dim).  Returns (tokens [B, max_new],
     joint log-prob [B]) for each item's best beam.  beam_width=1 is
-    greedy decoding; there is no EOS handling (the framework's LMs have
-    no reserved stop token), so all beams run the full length."""
+    greedy decoding.  With ``eos_id`` set, a beam that emits it finishes:
+    its score freezes and it pads with EOS while live beams keep
+    expanding (the scan still runs the static full length — shapes never
+    change; trim at the first EOS on the host)."""
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if not 1 <= beam_width <= model.config.vocab:
         raise ValueError(f"beam_width={beam_width} must be in "
                          f"[1, vocab={model.config.vocab}]")
-    return _beam_runner(model, max_new_tokens, beam_width)(params, prompt)
+    if eos_id is not None and not 0 <= eos_id < model.config.vocab:
+        raise ValueError(f"eos_id={eos_id} outside vocab "
+                         f"{model.config.vocab}")
+    return _beam_runner(model, max_new_tokens, beam_width,
+                        eos_id)(params, prompt)
 
 
 def generate(model: Transformer, params: Mapping[str, Array],

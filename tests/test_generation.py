@@ -301,3 +301,33 @@ def test_beam_width_validation(rng):
     for bad in (0, 65):
         with pytest.raises(ValueError, match="beam_width"):
             beam_search(model, params, prompt, 4, beam_width=bad)
+
+
+def test_beam_search_eos_freezes_score(rng):
+    """A beam that emits eos_id finishes: score frozen, EOS-padded, and it
+    stays comparable against live beams.  Rigged so EOS is the argmax
+    from the first step: the best beam must be all-EOS with joint score
+    exactly logp(EOS at step 1)."""
+    from parameter_server_distributed_tpu.models.generation import beam_search
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    vocab = 16
+    model = Transformer(TransformerConfig(
+        vocab=vocab, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=16, dtype=jnp.float32))
+    params = model.init_params(0)
+    prompt = rng.integers(0, vocab, (1, 3)).astype(np.int32)
+    # the model's own first greedy token as EOS: the top beam finishes at
+    # step 1 with score logp(eos), and no live beam can ever overtake it
+    # (a live beam's joint is logp(weaker first token) + non-positive
+    # continuations < logp(eos)), so the frozen beam must win
+    logits = np.asarray(model.apply(params, prompt))[0, -1]
+    eos = int(logits.argmax())
+
+    out, score = beam_search(model, params, prompt, max_new_tokens=5,
+                             beam_width=3, eos_id=eos)
+    out = np.asarray(out)[0]
+    assert np.all(out == eos)  # finished at step 1, EOS-padded after
+    expect = float(jax.nn.log_softmax(logits)[eos])
+    assert float(np.asarray(score)[0]) == pytest.approx(expect, rel=1e-5)

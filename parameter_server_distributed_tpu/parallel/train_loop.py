@@ -13,6 +13,7 @@ chip), periodic sharded checkpoints with resume, profiler hook.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import math
 import time
@@ -195,6 +196,8 @@ def run_training(config: TrainLoopConfig) -> dict:
             total += float(evaluate(state, place_batch(next(eval_batches))))
         return total / max(1, config.eval_steps)
 
+    log.info("config: %s", json.dumps(dataclasses.asdict(config),
+                                      default=str, sort_keys=True))
     step_fn = trainer.step_fn()
     place_batch = (trainer.put_batch_local if local_mode
                    else trainer.put_batch)
@@ -288,6 +291,11 @@ def run_training(config: TrainLoopConfig) -> dict:
                                 else run_eval(state))
         if math.isnan(summary["eval_loss"]):
             summary["eval_loss"] = None  # strict-JSON safe, like final_loss
+        else:
+            # mean NLL in nats -> perplexity (LM-meaningful; harmless
+            # but ignorable for classification losses)
+            summary["eval_ppl"] = round(math.exp(
+                min(summary["eval_loss"], 700.0)), 4)
     if math.isnan(summary["final_loss"]):
         summary["final_loss"] = None  # keep the summary strict-JSON safe
     if (config.checkpoint_every and config.checkpoint_dir

@@ -3,7 +3,8 @@
     python -m parameter_server_distributed_tpu.cli.generate_main \
         --model=small_lm --prompt="the quick brown" --max-new=64 \
         [--ckpt=path.ckpt | --ckpt-dir=orbax_dir [--avg-last=K]] \
-        [--temperature=0.8] [--top-k=40] [--top-p=0.9] [--beam=4] \
+        [--temperature=0.8] [--top-k=40] [--top-p=0.9] \
+        [--beam=4 [--length-penalty=0.6]] \
         [--seed=0] \
         [--dtype=bf16] [--tokens=1,2,3]
 
@@ -111,6 +112,9 @@ def main(argv: list[str] | None = None) -> int:
     temperature = float(flags.get("temperature", default_temp))
     prompt = np.asarray([ids], np.int32)
     max_new = int(flags.get("max-new", 64))
+    if beam <= 1 and "length-penalty" in flags:
+        raise ValueError("--length-penalty applies to beam search; "
+                         "pass --beam=W > 1")
     if beam > 1:
         if top_k or top_p or "temperature" in flags:
             raise ValueError("--beam is deterministic; it does not combine "
@@ -120,8 +124,9 @@ def main(argv: list[str] | None = None) -> int:
         # (require_vocab above guaranteed the model covers it);
         # raw-token mode has no reserved stop id
         eos = tokenizer.EOS if decode_text else None
-        out, score = beam_search(model, params, prompt, max_new,
-                                 beam_width=beam, eos_id=eos)
+        out, score = beam_search(
+            model, params, prompt, max_new, beam_width=beam, eos_id=eos,
+            length_penalty=float(flags.get("length-penalty", 0.0)))
         print(f"beam: width {beam}, joint logprob "
               f"{float(np.asarray(score)[0]):.3f}", file=sys.stderr)
     else:

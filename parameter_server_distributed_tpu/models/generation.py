@@ -208,8 +208,9 @@ def _runner(model: Transformer, max_new_tokens: int, temperature: float,
 
 
 def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int,
-                 eos_id: int | None):
-    key = (id(model), max_new_tokens, "beam", beam_width, eos_id)
+                 eos_id: int | None, length_penalty: float):
+    key = (id(model), max_new_tokens, "beam", beam_width, eos_id,
+           length_penalty)
 
     def build():
         @jax.jit
@@ -223,6 +224,7 @@ def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int,
             scores, first = jax.lax.top_k(logp, w)            # [B, W]
             finished = (jnp.zeros((b, w), bool) if eos_id is None
                         else first == eos_id)
+            lengths = jnp.ones((b, w), jnp.int32)
 
             # beams live interleaved in the cache batch dim: row b*W + j
             def tile(x):
@@ -233,7 +235,7 @@ def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int,
             seqs = seqs.at[:, :, 0].set(first)
 
             def body(carry, i):
-                seqs, scores, finished, cache = carry
+                seqs, scores, finished, lengths, cache = carry
                 tok = jax.lax.dynamic_index_in_dim(
                     seqs, i - 1, axis=2, keepdims=False)       # [B, W]
                 logits, cache = decode_step(model, params,
@@ -257,18 +259,31 @@ def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int,
                 seqs = jax.lax.dynamic_update_slice_in_dim(
                     seqs, token[:, :, None], i, axis=2)
                 finished = jnp.take_along_axis(finished, parent, axis=1)
+                lengths = jnp.take_along_axis(lengths, parent, axis=1)
+                # a beam already finished keeps its length; live beams
+                # (including one finishing right now, whose EOS counts)
+                # are i+1 tokens long
+                lengths = jnp.where(finished, lengths, i + 1)
                 if eos_id is not None:
                     finished = finished | (token == eos_id)
                 rows = (jnp.arange(b)[:, None] * w + parent).reshape(-1)
                 cache = KVCache(k=jnp.take(cache.k, rows, axis=1),
                                 v=jnp.take(cache.v, rows, axis=1),
                                 length=cache.length)
-                return (seqs, scores, finished, cache), None
+                return (seqs, scores, finished, lengths, cache), None
 
-            (seqs, scores, _, _), _ = jax.lax.scan(
-                body, (seqs, scores, finished, cache),
+            (seqs, scores, _, lengths, _), _ = jax.lax.scan(
+                body, (seqs, scores, finished, lengths, cache),
                 jnp.arange(1, max_new_tokens))
-            best = jnp.argmax(scores, axis=1)
+            if length_penalty:
+                # GNMT normalization at final selection only (within-step
+                # pruning stays raw-joint-log-prob): score / lp(len) with
+                # lp = ((5 + len) / 6) ** alpha
+                lp = ((5.0 + lengths.astype(jnp.float32)) / 6.0
+                      ) ** length_penalty
+                best = jnp.argmax(scores / lp, axis=1)
+            else:
+                best = jnp.argmax(scores, axis=1)
             out = jnp.take_along_axis(seqs, best[:, None, None],
                                       axis=1)[:, 0]            # [B, max_new]
             return out, jnp.take_along_axis(scores, best[:, None],
@@ -282,7 +297,8 @@ def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int,
 def beam_search(model: Transformer, params: Mapping[str, Array],
                 prompt: Array, max_new_tokens: int,
                 beam_width: int = 4,
-                eos_id: int | None = None) -> tuple[Array, Array]:
+                eos_id: int | None = None,
+                length_penalty: float = 0.0) -> tuple[Array, Array]:
     """Fixed-length beam search over ``max_new_tokens`` continuations:
     keeps the ``beam_width`` highest joint-log-prob prefixes each step,
     reordering the KV cache rows onto the surviving beams (beams live
@@ -291,7 +307,10 @@ def beam_search(model: Transformer, params: Mapping[str, Array],
     greedy decoding.  With ``eos_id`` set, a beam that emits it finishes:
     its score freezes and it pads with EOS while live beams keep
     expanding (the scan still runs the static full length — shapes never
-    change; trim at the first EOS on the host)."""
+    change; trim at the first EOS on the host).  ``length_penalty``
+    alpha > 0 applies GNMT length normalization (score / ((5+len)/6)^a)
+    at the FINAL beam selection, countering the short-hypothesis bias
+    EOS finishing introduces; 0 selects by raw joint log-prob."""
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if not 1 <= beam_width <= model.config.vocab:
@@ -300,8 +319,8 @@ def beam_search(model: Transformer, params: Mapping[str, Array],
     if eos_id is not None and not 0 <= eos_id < model.config.vocab:
         raise ValueError(f"eos_id={eos_id} outside vocab "
                          f"{model.config.vocab}")
-    return _beam_runner(model, max_new_tokens, beam_width,
-                        eos_id)(params, prompt)
+    return _beam_runner(model, max_new_tokens, beam_width, eos_id,
+                        float(length_penalty))(params, prompt)
 
 
 def generate(model: Transformer, params: Mapping[str, Array],

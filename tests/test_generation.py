@@ -331,3 +331,32 @@ def test_beam_search_eos_freezes_score(rng):
     assert np.all(out == eos)  # finished at step 1, EOS-padded after
     expect = float(jax.nn.log_softmax(logits)[eos])
     assert float(np.asarray(score)[0]) == pytest.approx(expect, rel=1e-5)
+
+
+def test_beam_length_penalty_prefers_longer(rng):
+    """alpha=0 picks the short frozen beam (highest raw joint log-prob);
+    a large alpha divides long beams' negative scores by a big factor,
+    flipping the selection to a full-length live beam."""
+    from parameter_server_distributed_tpu.models.generation import beam_search
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    vocab = 16
+    model = Transformer(TransformerConfig(
+        vocab=vocab, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=16, dtype=jnp.float32))
+    params = model.init_params(0)
+    prompt = rng.integers(0, vocab, (1, 3)).astype(np.int32)
+    logits = np.asarray(model.apply(params, prompt))[0, -1]
+    eos = int(logits.argmax())
+
+    raw, _ = beam_search(model, params, prompt, max_new_tokens=5,
+                         beam_width=3, eos_id=eos)
+    assert np.all(np.asarray(raw)[0] == eos)  # short frozen beam wins
+
+    # alpha=50: a full-length beam's negative score is divided by
+    # (10/6)^50 ~ 1e11, so any live beam beats the frozen one unless
+    # p(EOS) > 1 - 1e-10 — impossible for an untrained model
+    norm, _ = beam_search(model, params, prompt, max_new_tokens=5,
+                          beam_width=3, eos_id=eos, length_penalty=50.0)
+    assert np.asarray(norm)[0][0] != eos

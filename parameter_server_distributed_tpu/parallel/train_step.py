@@ -18,14 +18,13 @@ SGD config.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any, Callable, Mapping
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh
 
 from .mesh import batch_sharding, replicated
 from .sharding import ShardingRule, store_shardings

@@ -9,8 +9,6 @@ and runs the periodic checkpoint daemon
 from __future__ import annotations
 
 import logging
-import os
-import threading
 from typing import Callable
 
 import grpc

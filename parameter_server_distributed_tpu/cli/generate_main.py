@@ -56,6 +56,20 @@ def load_params(flags: dict, model, seed: int):
     return model.init_params(seed), f"fresh init (seed {seed})"
 
 
+def match_layout(model, params):
+    """Checkpoints port across layer layouts: convert a store to whatever
+    layout this model instance uses (stacked blocks/* for scan_layers,
+    unrolled layer<i>/* otherwise)."""
+    from ..models.transformer import stack_layers, unstack_layers
+
+    stacked_store = any(n.startswith("blocks/") for n in params)
+    if model.config.scan_layers and not stacked_store:
+        return stack_layers(params, model.config.n_layers)
+    if not model.config.scan_layers and stacked_store:
+        return unstack_layers(params)
+    return params
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     logging.basicConfig(level=logging.INFO,
@@ -79,15 +93,7 @@ def main(argv: list[str] | None = None) -> int:
     params, source = load_params(flags, model, seed)
     print(f"params: {source}", file=sys.stderr)
 
-    # checkpoints port across layer layouts: a store trained with
-    # --scan-layers (stacked blocks/*) decodes on an unrolled model and
-    # vice versa — convert to whatever layout this model instance uses
-    from ..models.transformer import stack_layers, unstack_layers
-    stacked_store = any(n.startswith("blocks/") for n in params)
-    if model.config.scan_layers and not stacked_store:
-        params = stack_layers(params, model.config.n_layers)
-    elif not model.config.scan_layers and stacked_store:
-        params = unstack_layers(params)
+    params = match_layout(model, params)
 
     tokenizer = ByteTokenizer()
     if flags.get("tokens"):
@@ -112,10 +118,32 @@ def main(argv: list[str] | None = None) -> int:
     temperature = float(flags.get("temperature", default_temp))
     prompt = np.asarray([ids], np.int32)
     max_new = int(flags.get("max-new", 64))
+    draft_name = flags.get("draft-model", "")
     if beam <= 1 and "length-penalty" in flags:
         raise ValueError("--length-penalty applies to beam search; "
                          "pass --beam=W > 1")
-    if beam > 1:
+    if draft_name:
+        if beam > 1 or top_k or top_p or "temperature" in flags:
+            raise ValueError("--draft-model (speculative decoding) is "
+                             "greedy-only; it does not combine with "
+                             "--beam or sampling flags")
+        from ..models.generation import speculative_generate
+        draft, _ = get_model_and_batches(draft_name, 1,
+                                         dtype=flags.get("dtype", ""))
+        if not isinstance(draft, Transformer):
+            raise ValueError(f"--draft-model={draft_name!r} is not an LM")
+        dparams, dsource = load_params(
+            {"ckpt": flags.get("draft-ckpt", "")}, draft,
+            int(flags.get("draft-seed", seed + 1)))
+        dparams = match_layout(draft, dparams)
+        print(f"draft params: {dsource}", file=sys.stderr)
+        out, stats = speculative_generate(
+            model, params, draft, dparams, prompt, max_new,
+            draft_len=int(flags.get("draft-len", 4)))
+        print(f"speculative: {stats['tokens_per_target_forward']:.2f} "
+              f"tokens/target-forward (incl. prefill), accept rate "
+              f"{stats['draft_accept_rate']:.2f}", file=sys.stderr)
+    elif beam > 1:
         if top_k or top_p or "temperature" in flags:
             raise ValueError("--beam is deterministic; it does not combine "
                              "with --temperature/--top-k/--top-p")

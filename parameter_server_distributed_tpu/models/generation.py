@@ -33,6 +33,7 @@ from typing import Mapping
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .transformer import Transformer
 
@@ -82,24 +83,31 @@ def prefill(model: Transformer, params: Mapping[str, Array], tokens: Array,
     return logits[:, -1], cache
 
 
-def decode_step(model: Transformer, params: Mapping[str, Array],
-                token: Array, cache: KVCache) -> tuple[Array, KVCache]:
-    """One single-token forward against the cache.  token: [B] int32 ->
-    (logits [B, vocab] float32, updated cache)."""
+def decode_block(model: Transformer, params: Mapping[str, Array],
+                 tokens: Array, cache: KVCache) -> tuple[Array, KVCache]:
+    """Forward a block of ``tokens`` [B, T] against the cache at positions
+    length..length+T-1, causally masked within the block — the verify
+    step of speculative decoding (T=1 is ordinary single-token decode).
+    Returns (logits [B, T, vocab] f32, cache with length advanced by T;
+    rolling ``length`` back later simply re-exposes old positions — stale
+    K/V beyond length are masked out and overwritten on the next write).
+    """
     c = model.config
-    batch = token.shape[0]
+    batch, t = tokens.shape
     pos = cache.length                                   # scalar int32
-    h = jnp.take(params["embed/tok"], token[:, None], axis=0)  # [B, 1, d]
-    positions = jnp.full((batch, 1), pos, jnp.int32)
-    # valid cache positions for this step: 0..pos inclusive
-    mask = (jnp.arange(cache.max_len) <= pos)[None, None, None, :]
+    h = jnp.take(params["embed/tok"], tokens, axis=0)    # [B, T, d]
+    offsets = jnp.arange(t, dtype=jnp.int32)
+    positions = pos + offsets[None, :].repeat(batch, 0)  # [B, T]
+    # query j may attend cache positions 0..pos+j
+    mask = (jnp.arange(cache.max_len)[None, :]
+            <= (pos + offsets)[:, None])[None, None, None]  # [1,1,1,T,M]
     new_k, new_v = cache.k, cache.v
     groups = c.kv_groups
     for i in range(c.n_layers):
         # layer_view resolves either param layout (unrolled layer<i>/* or
         # scan_layers' stacked blocks/*)
         lp, p = model.layer_view(params, i)
-        q, k, v = model.qkv(lp, p, h, positions)  # k/v: [B, 1, KV, D]
+        q, k, v = model.qkv(lp, p, h, positions)  # k/v: [B, T, KV, D]
         new_k = jax.lax.dynamic_update_slice(
             new_k, k[None].astype(new_k.dtype), (i, 0, pos, 0, 0))
         new_v = jax.lax.dynamic_update_slice(
@@ -113,7 +121,7 @@ def decode_step(model: Transformer, params: Mapping[str, Array],
         scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, new_k[i],
                             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
-        scores = jnp.where(mask[:, :, None], scores, -jnp.inf)
+        scores = jnp.where(mask, scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
         attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, new_v[i],
                           preferred_element_type=jnp.float32).astype(c.dtype)
@@ -122,7 +130,15 @@ def decode_step(model: Transformer, params: Mapping[str, Array],
         # MoE-aware, drop-free at decode time; aux loss unused here
         h, _ = model.ffn_residual(params, i, h, decode=True)
     logits = model.final_logits(params, h)
-    return logits[:, 0], KVCache(k=new_k, v=new_v, length=pos + 1)
+    return logits, KVCache(k=new_k, v=new_v, length=pos + t)
+
+
+def decode_step(model: Transformer, params: Mapping[str, Array],
+                token: Array, cache: KVCache) -> tuple[Array, KVCache]:
+    """One single-token forward against the cache.  token: [B] int32 ->
+    (logits [B, vocab] float32, updated cache)."""
+    logits, cache = decode_block(model, params, token[:, None], cache)
+    return logits[:, 0], cache
 
 
 def sample_token(logits: Array, rng: Array, temperature: float = 0.0,
@@ -321,6 +337,114 @@ def beam_search(model: Transformer, params: Mapping[str, Array],
                          f"{model.config.vocab}")
     return _beam_runner(model, max_new_tokens, beam_width, eos_id,
                         float(length_penalty))(params, prompt)
+
+
+def _decode_step_runner(model: Transformer):
+    key = (id(model), "spec_step")
+    return _cached_runner(key, lambda: jax.jit(
+        lambda params, tok, cache: decode_step(model, params, tok, cache)))
+
+
+def _decode_block_runner(model: Transformer, t: int):
+    key = (id(model), "spec_block", t)
+    return _cached_runner(key, lambda: jax.jit(
+        lambda params, toks, cache: decode_block(model, params, toks, cache)))
+
+
+def speculative_generate(target: Transformer, target_params,
+                         draft: Transformer, draft_params,
+                         prompt: Array, max_new_tokens: int, *,
+                         draft_len: int = 4) -> tuple[Array, dict]:
+    """Greedy speculative decoding: the cheap ``draft`` model proposes
+    ``draft_len`` tokens autoregressively, the ``target`` verifies them in
+    ONE ``decode_block`` forward, and the longest agreeing prefix plus the
+    target's own next token commit — per verify call the output advances
+    1..draft_len+1 tokens at one target forward, while remaining
+    TOKEN-EXACT vs target-alone greedy decoding (tested).  Rejection
+    rollback is free: KVCache.length just moves back, stale entries are
+    masked and overwritten.
+
+    Batch 1 (rows would accept different counts and the cache keeps one
+    scalar length); greedy only (sampling-based acceptance needs the
+    softmax-ratio rule).  Returns (tokens [1, max_new], stats) where
+    stats reports verify calls and acceptance counts — the speedup story
+    on real hardware is target-forwards / tokens."""
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative decoding is batch-1 (per-row "
+                         "acceptance lengths diverge)")
+    if target.config.vocab != draft.config.vocab:
+        raise ValueError(
+            f"vocab mismatch: target {target.config.vocab} vs draft "
+            f"{draft.config.vocab}")
+    if draft_len < 1:
+        raise ValueError("draft_len must be >= 1")
+
+    s = prompt.shape[1]
+    # headroom: a verify block may write draft_len+1 entries past the
+    # committed length before rolling back
+    max_len = s + max_new_tokens + draft_len + 1
+    t_logits, t_cache = prefill(target, target_params, prompt, max_len)
+    _, d_cache = prefill(draft, draft_params, prompt, max_len)
+    d_step = _decode_step_runner(draft)
+    t_block = _decode_block_runner(target, draft_len + 1)
+
+    out: list[int] = []
+    cur = int(np.asarray(jnp.argmax(t_logits, axis=-1))[0])
+    out.append(cur)
+    pending: list[int] = []   # committed tokens not yet in the draft cache
+    verify_calls = 0
+    accepted_total = 0
+
+    while len(out) < max_new_tokens:
+        for tok in pending:   # catch the draft cache up to the context
+            _, d_cache = d_step(draft_params,
+                                jnp.asarray([tok], jnp.int32), d_cache)
+        pending = []
+        proposals: list[int] = []
+        dtok = cur
+        for _ in range(draft_len):
+            dl, d_cache = d_step(draft_params,
+                                 jnp.asarray([dtok], jnp.int32), d_cache)
+            dtok = int(np.asarray(jnp.argmax(dl, axis=-1))[0])
+            proposals.append(dtok)
+        # target verifies [cur, p1..pk] in one forward: greedy[i] is the
+        # target's token after ...cur,p1..p_i
+        block = jnp.asarray([[cur] + proposals], jnp.int32)
+        base = int(np.asarray(t_cache.length))
+        logits, t_cache = t_block(target_params, block, t_cache)
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))[0]   # [k+1]
+        verify_calls += 1
+
+        m = 0
+        while m < draft_len and proposals[m] == int(greedy[m]):
+            m += 1
+        accepted_total += m
+        committed = proposals[:m] + [int(greedy[m])]
+        out.extend(committed)
+        cur = committed[-1]
+        if m == draft_len:
+            # full accept + bonus token: every block entry (cur, p1..pk)
+            # is committed context; the draft cache is missing p_k
+            t_cache = dataclasses.replace(
+                t_cache, length=jnp.asarray(base + draft_len + 1,
+                                            jnp.int32))
+            pending = [proposals[-1]]
+        else:
+            # cache keeps cur..p_{m-1} (m+1 entries); the draft cache
+            # holds the same prefix plus rejected entries — roll both back
+            t_cache = dataclasses.replace(
+                t_cache, length=jnp.asarray(base + m + 1, jnp.int32))
+            d_cache = dataclasses.replace(
+                d_cache, length=jnp.asarray(base + m + 1, jnp.int32))
+
+    tokens = np.asarray(out[:max_new_tokens], np.int32)[None]
+    stats = {"verify_calls": verify_calls,
+             "draft_accept_rate": (accepted_total
+                                   / max(1, verify_calls * draft_len)),
+             # +1: the prefill forward produced out[0] and also counts
+             "tokens_per_target_forward": (tokens.shape[1]
+                                           / (verify_calls + 1))}
+    return tokens, stats
 
 
 def generate(model: Transformer, params: Mapping[str, Array],

@@ -360,3 +360,47 @@ def test_beam_length_penalty_prefers_longer(rng):
     norm, _ = beam_search(model, params, prompt, max_new_tokens=5,
                           beam_width=3, eos_id=eos, length_penalty=50.0)
     assert np.asarray(norm)[0][0] != eos
+
+
+def test_speculative_matches_target_greedy(rng):
+    """Speculative decoding is an exactness-preserving accelerator: for
+    any draft (here a 1-layer LM with the target's vocab) the output must
+    be token-identical to target-alone greedy decoding, while committing
+    multiple tokens per target forward."""
+    from parameter_server_distributed_tpu.models.generation import (
+        generate, speculative_generate)
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig, small_lm)
+
+    target = small_lm(vocab=256, seq=64)
+    draft = Transformer(TransformerConfig(
+        vocab=256, d_model=64, n_heads=4, n_layers=1, d_ff=128,
+        max_seq=64, dtype=jnp.float32))
+    tparams = target.init_params(0)
+    dparams = draft.init_params(1)
+    prompt = rng.integers(0, 256, (1, 7)).astype(np.int32)
+
+    reference = np.asarray(generate(target, tparams, prompt,
+                                    max_new_tokens=16))
+    out, stats = speculative_generate(target, tparams, draft, dparams,
+                                      prompt, 16, draft_len=3)
+    np.testing.assert_array_equal(out, reference)
+    assert stats["verify_calls"] >= 1
+    assert stats["tokens_per_target_forward"] >= 1.0
+
+    # a PERFECT draft (the target itself) must accept everything:
+    # draft_len+1 tokens per verify call
+    out2, stats2 = speculative_generate(target, tparams, target, tparams,
+                                        prompt, 16, draft_len=3)
+    np.testing.assert_array_equal(out2, reference)
+    assert stats2["draft_accept_rate"] == pytest.approx(1.0)
+    # 16 tokens from prefill + 4 fully-accepted verify calls = 5 forwards
+    assert stats2["tokens_per_target_forward"] == pytest.approx(16 / 5)
+
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(target, tparams, small_lm(vocab=64, seq=32),
+                             small_lm(vocab=64, seq=32).init_params(0),
+                             prompt, 4)
+    with pytest.raises(ValueError, match="batch-1"):
+        speculative_generate(target, tparams, draft, dparams,
+                             np.zeros((2, 4), np.int32), 4)

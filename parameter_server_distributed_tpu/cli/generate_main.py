@@ -123,10 +123,11 @@ def main(argv: list[str] | None = None) -> int:
         raise ValueError("--length-penalty applies to beam search; "
                          "pass --beam=W > 1")
     if draft_name:
-        if beam > 1 or top_k or top_p or "temperature" in flags:
-            raise ValueError("--draft-model (speculative decoding) is "
-                             "greedy-only; it does not combine with "
-                             "--beam or sampling flags")
+        if beam > 1 or top_k or top_p:
+            raise ValueError("--draft-model (speculative decoding) "
+                             "supports greedy (default) or plain "
+                             "--temperature sampling; it does not combine "
+                             "with --beam/--top-k/--top-p")
         from ..models.generation import speculative_generate
         draft, _ = get_model_and_batches(draft_name, 1,
                                          dtype=flags.get("dtype", ""))
@@ -139,7 +140,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"draft params: {dsource}", file=sys.stderr)
         out, stats = speculative_generate(
             model, params, draft, dparams, prompt, max_new,
-            draft_len=int(flags.get("draft-len", 4)))
+            draft_len=int(flags.get("draft-len", 4)),
+            temperature=temperature, seed=seed)
         print(f"speculative: {stats['tokens_per_target_forward']:.2f} "
               f"tokens/target-forward (incl. prefill), accept rate "
               f"{stats['draft_accept_rate']:.2f}", file=sys.stderr)

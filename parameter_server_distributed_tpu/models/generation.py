@@ -351,10 +351,28 @@ def _decode_block_runner(model: Transformer, t: int):
         lambda params, toks, cache: decode_block(model, params, toks, cache)))
 
 
+def accept_or_resample(p: "np.ndarray", q: "np.ndarray", x: int,
+                       rng: "np.random.Generator") -> tuple[int, bool]:
+    """The speculative-sampling rejection rule (Leviathan/Chen): accept
+    draft token ``x`` (drawn from q) with probability min(1, p[x]/q[x]);
+    on reject, sample from the residual normalize(max(p - q, 0)).  Over
+    the randomness of (x ~ q, this rule), the returned token is EXACTLY
+    distributed as p — tested empirically in tests/test_generation.py.
+    Returns (token, accepted)."""
+    if rng.uniform() < min(1.0, float(p[x]) / max(float(q[x]), 1e-20)):
+        return x, True
+    residual = np.maximum(p - q, 0.0)
+    total = residual.sum()
+    if total <= 0.0:   # p == q: acceptance was certain, but guard anyway
+        return int(rng.choice(len(p), p=p / p.sum())), False
+    return int(rng.choice(len(p), p=residual / total)), False
+
+
 def speculative_generate(target: Transformer, target_params,
                          draft: Transformer, draft_params,
                          prompt: Array, max_new_tokens: int, *,
-                         draft_len: int = 4) -> tuple[Array, dict]:
+                         draft_len: int = 4, temperature: float = 0.0,
+                         seed: int = 0) -> tuple[Array, dict]:
     """Greedy speculative decoding: the cheap ``draft`` model proposes
     ``draft_len`` tokens autoregressively, the ``target`` verifies them in
     ONE ``decode_block`` forward, and the longest agreeing prefix plus the
@@ -364,11 +382,15 @@ def speculative_generate(target: Transformer, target_params,
     rollback is free: KVCache.length just moves back, stale entries are
     masked and overwritten.
 
+    ``temperature=0`` is greedy (output token-exact vs target-alone
+    greedy decoding); ``temperature>0`` is speculative SAMPLING with the
+    rejection rule (:func:`accept_or_resample`), which preserves the
+    target's temperature-adjusted sampling distribution exactly.
+
     Batch 1 (rows would accept different counts and the cache keeps one
-    scalar length); greedy only (sampling-based acceptance needs the
-    softmax-ratio rule).  Returns (tokens [1, max_new], stats) where
-    stats reports verify calls and acceptance counts — the speedup story
-    on real hardware is target-forwards / tokens."""
+    scalar length).  Returns (tokens [1, max_new], stats) where stats
+    reports verify calls and acceptance counts — the speedup story on
+    real hardware is target-forwards / tokens."""
     if prompt.shape[0] != 1:
         raise ValueError("speculative decoding is batch-1 (per-row "
                          "acceptance lengths diverge)")
@@ -380,6 +402,14 @@ def speculative_generate(target: Transformer, target_params,
         raise ValueError("draft_len must be >= 1")
 
     s = prompt.shape[1]
+    sampling = temperature > 0.0
+    host_rng = np.random.default_rng(seed)
+
+    def host_probs(logits_row) -> "np.ndarray":
+        p = np.asarray(jax.nn.softmax(logits_row / temperature, axis=-1),
+                       np.float64)
+        return p / p.sum()
+
     # headroom: a verify block may write draft_len+1 entries past the
     # committed length before rolling back
     max_len = s + max_new_tokens + draft_len + 1
@@ -389,7 +419,11 @@ def speculative_generate(target: Transformer, target_params,
     t_block = _decode_block_runner(target, draft_len + 1)
 
     out: list[int] = []
-    cur = int(np.asarray(jnp.argmax(t_logits, axis=-1))[0])
+    if sampling:
+        p0 = host_probs(t_logits[0])
+        cur = int(host_rng.choice(len(p0), p=p0))
+    else:
+        cur = int(np.asarray(jnp.argmax(t_logits, axis=-1))[0])
     out.append(cur)
     pending: list[int] = []   # committed tokens not yet in the draft cache
     verify_calls = 0
@@ -401,25 +435,50 @@ def speculative_generate(target: Transformer, target_params,
                                 jnp.asarray([tok], jnp.int32), d_cache)
         pending = []
         proposals: list[int] = []
+        d_probs: list = []
         dtok = cur
         for _ in range(draft_len):
             dl, d_cache = d_step(draft_params,
                                  jnp.asarray([dtok], jnp.int32), d_cache)
-            dtok = int(np.asarray(jnp.argmax(dl, axis=-1))[0])
+            if sampling:
+                q = host_probs(dl[0])
+                dtok = int(host_rng.choice(len(q), p=q))
+                d_probs.append(q)
+            else:
+                dtok = int(np.asarray(jnp.argmax(dl, axis=-1))[0])
             proposals.append(dtok)
-        # target verifies [cur, p1..pk] in one forward: greedy[i] is the
-        # target's token after ...cur,p1..p_i
+        # target verifies [cur, p1..pk] in one forward: logits[i] scores
+        # the target's token after ...cur,p1..p_i
         block = jnp.asarray([[cur] + proposals], jnp.int32)
         base = int(np.asarray(t_cache.length))
         logits, t_cache = t_block(target_params, block, t_cache)
-        greedy = np.asarray(jnp.argmax(logits, axis=-1))[0]   # [k+1]
         verify_calls += 1
 
-        m = 0
-        while m < draft_len and proposals[m] == int(greedy[m]):
-            m += 1
+        if sampling:
+            rows = np.asarray(jax.nn.softmax(logits[0] / temperature,
+                                             axis=-1), np.float64)
+            p_all = [row / row.sum() for row in rows]  # one dispatch
+            m = 0
+            committed: list[int] = []
+            while m < draft_len:
+                token, ok = accept_or_resample(
+                    p_all[m], d_probs[m], proposals[m], host_rng)
+                if not ok:
+                    committed.append(token)
+                    break
+                committed.append(token)
+                m += 1
+            else:
+                # full accept: bonus token from the target's own dist
+                committed.append(int(host_rng.choice(
+                    len(p_all[draft_len]), p=p_all[draft_len])))
+        else:
+            greedy = np.asarray(jnp.argmax(logits, axis=-1))[0]  # [k+1]
+            m = 0
+            while m < draft_len and proposals[m] == int(greedy[m]):
+                m += 1
+            committed = proposals[:m] + [int(greedy[m])]
         accepted_total += m
-        committed = proposals[:m] + [int(greedy[m])]
         out.extend(committed)
         cur = committed[-1]
         if m == draft_len:

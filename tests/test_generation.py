@@ -404,3 +404,46 @@ def test_speculative_matches_target_greedy(rng):
     with pytest.raises(ValueError, match="batch-1"):
         speculative_generate(target, tparams, draft, dparams,
                              np.zeros((2, 4), np.int32), 4)
+
+
+def test_accept_or_resample_preserves_target_distribution():
+    """The rejection rule's defining property: over x ~ q followed by
+    accept/resample, the output token is distributed exactly as p —
+    checked empirically on a skewed (p, q) pair."""
+    from parameter_server_distributed_tpu.models.generation import (
+        accept_or_resample)
+
+    rng = np.random.default_rng(0)
+    p = np.asarray([0.5, 0.3, 0.15, 0.05])
+    q = np.asarray([0.05, 0.15, 0.3, 0.5])  # draft skewed the wrong way
+    n = 20000
+    counts = np.zeros(4)
+    for _ in range(n):
+        x = int(rng.choice(4, p=q))
+        token, _ = accept_or_resample(p, q, x, rng)
+        counts[token] += 1
+    freq = counts / n
+    # 3-sigma bound per bin: sigma = sqrt(p(1-p)/n) < 0.0036
+    np.testing.assert_allclose(freq, p, atol=0.012)
+
+
+def test_speculative_sampling_perfect_draft_accepts_all(rng):
+    """temperature > 0 with draft == target: p == q so acceptance is
+    certain; output length and stats must reflect full acceptance."""
+    from parameter_server_distributed_tpu.models.generation import (
+        speculative_generate)
+    from parameter_server_distributed_tpu.models.transformer import small_lm
+
+    model = small_lm(vocab=128, seq=64)
+    params = model.init_params(0)
+    prompt = rng.integers(0, 128, (1, 5)).astype(np.int32)
+    out, stats = speculative_generate(model, params, model, params,
+                                      prompt, 12, draft_len=3,
+                                      temperature=1.0, seed=7)
+    assert out.shape == (1, 12)
+    assert stats["draft_accept_rate"] == pytest.approx(1.0)
+    # deterministic given the seed
+    out2, _ = speculative_generate(model, params, model, params,
+                                   prompt, 12, draft_len=3,
+                                   temperature=1.0, seed=7)
+    np.testing.assert_array_equal(out, out2)

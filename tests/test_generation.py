@@ -447,3 +447,38 @@ def test_speculative_sampling_perfect_draft_accepts_all(rng):
                                    prompt, 12, draft_len=3,
                                    temperature=1.0, seed=7)
     np.testing.assert_array_equal(out, out2)
+
+
+def test_decode_block_matches_sequential_steps(rng):
+    """A T-token decode_block equals T sequential decode_steps: same
+    final logits and same cache contents (the verify-step contract)."""
+    import dataclasses
+
+    from parameter_server_distributed_tpu.models.generation import (
+        decode_block, decode_step, init_cache, prefill)
+    from parameter_server_distributed_tpu.models.transformer import small_lm
+
+    model = small_lm(vocab=128, seq=64)
+    params = model.init_params(0)
+    prompt = rng.integers(0, 128, (2, 6)).astype(np.int32)
+    toks = rng.integers(0, 128, (2, 4)).astype(np.int32)
+
+    _, cache_a = prefill(model, params, prompt, 32)
+    block_logits, cache_a = decode_block(model, params, toks, cache_a)
+
+    _, cache_b = prefill(model, params, prompt, 32)
+    step_logits = []
+    for j in range(4):
+        lg, cache_b = decode_step(model, params, toks[:, j], cache_b)
+        step_logits.append(lg)
+
+    np.testing.assert_allclose(np.asarray(block_logits[:, -1]),
+                               np.asarray(step_logits[-1]),
+                               rtol=2e-5, atol=2e-5)
+    for j in range(4):
+        np.testing.assert_allclose(np.asarray(block_logits[:, j]),
+                                   np.asarray(step_logits[j]),
+                                   rtol=2e-5, atol=2e-5)
+    assert int(np.asarray(cache_a.length)) == int(np.asarray(cache_b.length))
+    np.testing.assert_allclose(np.asarray(cache_a.k), np.asarray(cache_b.k),
+                               rtol=2e-5, atol=2e-5)

@@ -103,7 +103,11 @@ def make_optimizer(name: str = "sgd", learning_rate: float = 1.0,
     elif name == "adam":
         opt = optax.adam(lr)
     elif name == "adamw":
-        opt = optax.adamw(lr, weight_decay=weight_decay)
+        # decay matrices only: decaying RMSNorm scales/biases toward zero
+        # is a known quality bug, the standard mask excludes sub-2D params
+        opt = optax.adamw(lr, weight_decay=weight_decay,
+                          mask=lambda params: jax.tree.map(
+                              lambda p: p.ndim >= 2, params))
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     if clip_norm and clip_norm > 0:

@@ -232,3 +232,22 @@ def test_accum_steps_validation():
     state = TrainState.create({"w": jnp.zeros((16, 8))}, opt)
     with pytest.raises(ValueError, match="does not divide"):
         step(state, (jnp.zeros((32, 16)), jnp.zeros((32, 8))))
+
+
+def test_adamw_decay_skips_norm_scales():
+    """AdamW's weight decay must not pull 1D params (norm scales, biases)
+    toward zero: with zero gradients, matrices shrink and vectors hold."""
+    import jax
+    import jax.numpy as jnp
+
+    from parameter_server_distributed_tpu.parallel.train_step import (
+        make_optimizer)
+
+    opt = make_optimizer("adamw", 0.1, weight_decay=0.1)
+    params = {"w": jnp.ones((4, 4)), "ln/scale": jnp.ones((4,))}
+    state = opt.init(params)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    updates, _ = opt.update(grads, state, params)
+    new = jax.tree.map(lambda p, u: p + u, params, updates)
+    assert float(jnp.max(jnp.abs(new["ln/scale"] - 1.0))) == 0.0
+    assert float(jnp.max(new["w"])) < 1.0  # decayed

@@ -154,11 +154,33 @@ class Adam(HostOptimizer):
         self.step = int(state.get("step", 0))
 
 
+class AdamW(Adam):
+    """Adam with decoupled weight decay on matrices only (sub-2D params —
+    norm scales, biases — are excluded, matching the device-side optax
+    mask in parallel/train_step.make_optimizer)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 weight_decay: float = 1e-4, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.weight_decay = weight_decay
+
+    def apply(self, params: TensorStore,
+              grads: Mapping[str, np.ndarray]) -> TensorStore:
+        out = super().apply(params, grads)
+        decay = np.float32(self.learning_rate * self.weight_decay)
+        for name, p in out.items():
+            if name in grads and p.ndim >= 2:
+                # decay from the PRE-update param (optax.adamw convention:
+                # update = adam_term + wd * p, applied together)
+                out[name] = p - decay * np.asarray(params[name], np.float32)
+        return out
+
+
 def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9) -> HostOptimizer:
-    """PS optimizer by name.  Plain names (`sgd|momentum|adam`) are the
-    host-side numpy/native-C++ optimizers above; `device_*` selects the
-    accelerator-resident optax path and `pallas_*` the fused pallas-kernel
-    path (async_sgd/device_optimizer.py)."""
+    """PS optimizer by name.  Plain names (`sgd|momentum|adam|adamw`) are
+    the host-side numpy/native-C++ optimizers above; `device_*` selects
+    the accelerator-resident optax path and `pallas_*` the fused
+    pallas-kernel path (async_sgd/device_optimizer.py)."""
     name = name.lower()
     if name == "sgd":
         return SGD(learning_rate)
@@ -166,6 +188,8 @@ def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9) -> Ho
         return Momentum(learning_rate, momentum)
     if name == "adam":
         return Adam(learning_rate)
+    if name == "adamw":
+        return AdamW(learning_rate)
     if name.startswith("device_") or name.startswith("pallas_"):
         kind, _, rule = name.partition("_")
         from ..async_sgd.device_optimizer import DeviceOptimizer, PallasOptimizer

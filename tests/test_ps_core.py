@@ -268,3 +268,19 @@ def test_make_optimizer_factory():
     assert isinstance(make_optimizer("adam", 1e-3), Adam)
     with pytest.raises(ValueError):
         make_optimizer("lion", 1.0)
+
+
+def test_host_adamw_decays_matrices_only():
+    """Host AdamW: decoupled decay shrinks matrices, never 1D params —
+    matching the device-side optax mask."""
+    import numpy as np
+
+    from parameter_server_distributed_tpu.core.optimizer import make_optimizer
+
+    opt = make_optimizer("adamw", 0.1)
+    params = {"w": np.ones((4, 4), np.float32),
+              "ln/scale": np.ones((4,), np.float32)}
+    zero = {k: np.zeros_like(v) for k, v in params.items()}
+    out = opt.apply(params, zero)
+    np.testing.assert_array_equal(out["ln/scale"], params["ln/scale"])
+    assert out["w"].max() < 1.0

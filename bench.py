@@ -25,7 +25,9 @@ PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
 (default 2), PSDT_BENCH_CPU_TIMEOUT (s, default 420), PSDT_BENCH_REMAT /
 PSDT_BENCH_SCAN (unset = model default, 0/1 force off/on — remat and
 lax.scan-over-layers for transformer LMs), PSDT_BENCH_SEQ (sequence-
-length override for LMs: long-context runs).
+length override for LMs: long-context runs), PSDT_BENCH_DRAFT /
+PSDT_BENCH_DRAFT_LEN (generate mode: speculative decoding with a
+registry draft model).
 """
 
 from __future__ import annotations
@@ -391,7 +393,10 @@ def _ab_host_optimizer() -> None:
 def bench_generate() -> dict:
     """KV-cached decode throughput (tokens/sec/chip) for the LM flagship.
     PSDT_BENCH_MODEL picks the registry LM (small_lm | moe_lm); batch and
-    new-token count via PSDT_BENCH_BATCH / PSDT_BENCH_STEPS."""
+    new-token count via PSDT_BENCH_BATCH / PSDT_BENCH_STEPS.
+    PSDT_BENCH_DRAFT=<registry LM> switches to speculative decoding
+    (batch 1, greedy; PSDT_BENCH_DRAFT_LEN proposals per verify) and
+    reports tokens/sec plus the acceptance stats."""
     import numpy as np
 
     from parameter_server_distributed_tpu.models.generation import generate
@@ -405,6 +410,33 @@ def bench_generate() -> dict:
     params = model.init_params(0)
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, model.config.vocab, (batch, 32)).astype(np.int32)
+
+    draft_name = os.environ.get("PSDT_BENCH_DRAFT", "")
+    if draft_name:
+        from parameter_server_distributed_tpu.models.generation import (
+            speculative_generate)
+        draft, _ = get_model_and_batches(draft_name, 1)
+        dparams = draft.init_params(1)
+        draft_len = int(os.environ.get("PSDT_BENCH_DRAFT_LEN", "4"))
+        sp = prompt[:1]
+        # warmup compiles prefill + draft step + verify block
+        speculative_generate(model, params, draft, dparams, sp, max_new,
+                             draft_len=draft_len)
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            out, stats = speculative_generate(model, params, draft, dparams,
+                                              sp, max_new,
+                                              draft_len=draft_len)
+        dt = (time.perf_counter() - t0) / reps
+        tps = max_new / dt
+        log(f"bench_generate: speculative target={name} draft={draft_name} "
+            f"k={draft_len}: {tps:,.0f} tokens/s, "
+            f"{stats['tokens_per_target_forward']:.2f} tokens/target-fwd, "
+            f"accept {stats['draft_accept_rate']:.2f}")
+        return {"metric": f"{name}_speculative_tokens_per_sec",
+                "value": round(tps, 1), "unit": "tokens/sec",
+                "vs_baseline": 1.0}
 
     # warm up the EXACT runner the timed loop uses — the compiled-runner
     # cache keys on (model, max_new, temperature, top_k)

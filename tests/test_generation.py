@@ -482,3 +482,25 @@ def test_decode_block_matches_sequential_steps(rng):
     assert int(np.asarray(cache_a.length)) == int(np.asarray(cache_b.length))
     np.testing.assert_allclose(np.asarray(cache_a.k), np.asarray(cache_b.k),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_generate_cli_speculative_matches_greedy(tmp_path, capsys):
+    """pst-generate --draft-model: greedy speculative output through the
+    CLI is byte-identical to plain greedy decoding of the same model."""
+    from parameter_server_distributed_tpu.checkpoint import codec
+    from parameter_server_distributed_tpu.cli.generate_main import main
+    from parameter_server_distributed_tpu.models.registry import (
+        get_model_and_batches)
+
+    model, _ = get_model_and_batches("small_lm", 1)
+    params = {k: np.asarray(v) for k, v in model.init_params(0).items()}
+    ckpt = tmp_path / "m.ckpt"
+    codec.save(str(ckpt), 1, 10, params)
+
+    base = ["--model=small_lm", f"--ckpt={ckpt}", "--tokens=5,6,7",
+            "--max-new=8"]
+    assert main(base) == 0
+    greedy = capsys.readouterr().out.strip()
+    assert main(base + ["--draft-model=moe_lm", "--draft-len=2"]) == 0
+    spec = capsys.readouterr().out.strip()
+    assert spec == greedy

@@ -56,7 +56,8 @@ class ParameterServerConfig:
     autosave_period_s: float = AUTOSAVE_CHECK_PERIOD_S
     learning_rate: float = 1.0   # reference applies param -= mean_grad (lr=1.0)
     # extensions beyond the reference:
-    optimizer: str = "sgd"       # sgd | momentum | adam | device_* | pallas_*
+    optimizer: str = "sgd"       # sgd | momentum | adam | adamw |
+                                 # device_* | pallas_*
     momentum: float = 0.9
     staleness_bound: int = 0     # 0 = strictly synchronous (reference behavior)
     elastic: bool = False        # True: barrier width tracks live registrations

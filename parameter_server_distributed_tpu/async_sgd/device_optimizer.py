@@ -64,6 +64,16 @@ class DeviceOptimizer(HostOptimizer):
     def adam(cls, learning_rate: float = 1e-3) -> "DeviceOptimizer":
         return cls(optax.adam(learning_rate), learning_rate)
 
+    @classmethod
+    def adamw(cls, learning_rate: float = 1e-3,
+              weight_decay: float = 1e-4) -> "DeviceOptimizer":
+        # matrices-only decay mask, matching parallel/train_step and the
+        # host AdamW (decaying norm scales/biases is a quality bug)
+        return cls(optax.adamw(
+            learning_rate, weight_decay=weight_decay,
+            mask=lambda params: jax.tree.map(
+                lambda p: p.ndim >= 2, params)), learning_rate)
+
     def apply(self, params: Mapping[str, np.ndarray],
               grads: Mapping[str, np.ndarray]) -> dict:
         device_params = {k: jnp.asarray(v) for k, v in params.items()}

@@ -199,6 +199,8 @@ def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9) -> Ho
             return DeviceOptimizer.sgd(learning_rate)
         if rule == "momentum":
             return DeviceOptimizer.momentum(learning_rate, momentum)
+        if rule == "adamw":
+            return DeviceOptimizer.adamw(learning_rate)
         if rule == "adam":
             return DeviceOptimizer.adam(learning_rate)
     raise ValueError(f"unknown optimizer {name!r}")

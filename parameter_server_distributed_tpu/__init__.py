@@ -46,3 +46,36 @@ if _os.environ.get("PSDT_COMPILE_CACHE") not in (None, "", "off"):
     _jax_cc.config.update("jax_compilation_cache_dir",
                           _os.environ["PSDT_COMPILE_CACHE"])
     _jax_cc.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+# Lazy top-level API: the common entry points resolve on first access so
+# the bare import stays device- and jax-free (control-plane tools depend
+# on that).
+_API = {
+    "run_training": ("parallel.train_loop", "run_training"),
+    "TrainLoopConfig": ("parallel.train_loop", "TrainLoopConfig"),
+    "generate": ("models.generation", "generate"),
+    "beam_search": ("models.generation", "beam_search"),
+    "speculative_generate": ("models.generation", "speculative_generate"),
+    "get_model_and_batches": ("models.registry", "get_model_and_batches"),
+    "Transformer": ("models.transformer", "Transformer"),
+    "TransformerConfig": ("models.transformer", "TransformerConfig"),
+    "MeshConfig": ("config", "MeshConfig"),
+    "build_mesh": ("parallel.mesh", "build_mesh"),
+    "ShardedTrainer": ("parallel.train_step", "ShardedTrainer"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _API[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(f".{module}", __name__), attr)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_API))

@@ -12,10 +12,15 @@ Robustness: the tunneled TPU backend ('axon' PJRT plugin) is intermittently
 unavailable and its init can HANG rather than fail.  The top-level process
 therefore orchestrates the actual measurement in child subprocesses with
 hard wall-clock timeouts: a cheap preflight (init + one tiny op,
-PSDT_BENCH_PREFLIGHT_TIMEOUT, default 90 s) gates up to
+PSDT_BENCH_PREFLIGHT_TIMEOUT, default 90 s; retried
+PSDT_BENCH_PREFLIGHT_RETRIES times spaced PSDT_BENCH_PREFLIGHT_SPACING_S
+apart — defaults 3 probes x 90 s + 2 sleeps x 240 s = 12.5 min worst
+case before the CPU fallback starts, so a transient tunnel blip does not
+forfeit the round's TPU verification) gates up to
 PSDT_BENCH_TPU_ATTEMPTS tries on the TPU backend, then an
 explicitly-labeled CPU fallback, so a round never records a bare 0.0 and
-a dead TPU costs ~90 s instead of every attempt's full timeout.  The
+a dead TPU costs a bounded window instead of every attempt's full
+timeout.  The
 final stdout is always exactly one JSON line; failures carry the
 exception text in a "note" field.
 
@@ -631,11 +636,30 @@ def main() -> int:
 
     errors: list[str] = []
     if any(platform == "tpu" for platform, _ in plans):
-        log(f"bench: TPU preflight (timeout {preflight_timeout:.0f}s)")
-        err = _tpu_preflight(preflight_timeout)
+        # Spaced retry window: a transient tunnel blip at measurement time
+        # should not cost the whole round's TPU verification.  Up to
+        # PSDT_BENCH_PREFLIGHT_RETRIES probes (default 3) spaced
+        # PSDT_BENCH_PREFLIGHT_SPACING_S apart (default 240 s) — ~10 min
+        # of patience for a dead tunnel, one probe's cost for a live one.
+        probes = max(1, int(
+            os.environ.get("PSDT_BENCH_PREFLIGHT_RETRIES", "3")))
+        spacing = float(
+            os.environ.get("PSDT_BENCH_PREFLIGHT_SPACING_S", "240"))
+        err = ""
+        for probe in range(probes):
+            if probe:
+                log(f"bench: preflight retry {probe + 1}/{probes} "
+                    f"in {spacing:.0f}s")
+                time.sleep(spacing)
+            log(f"bench: TPU preflight (timeout {preflight_timeout:.0f}s)")
+            err = _tpu_preflight(preflight_timeout)
+            if not err:
+                break
+            log(f"bench: {err}")
         if err:
-            log(f"bench: {err}; skipping TPU attempts")
-            errors.append(err)
+            log(f"bench: preflight window exhausted ({probes} probes); "
+                "skipping TPU attempts")
+            errors.append(f"{err} after {probes} spaced probes")
             plans = [(platform, t) for platform, t in plans
                      if platform != "tpu"]
     for i, (platform, timeout_s) in enumerate(plans):

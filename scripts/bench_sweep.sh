@@ -19,12 +19,17 @@ export PSDT_BENCH_MODEL="${PSDT_BENCH_MODEL:-lm_350m}"
 export PSDT_BENCH_TPU_TIMEOUT="${PSDT_BENCH_TPU_TIMEOUT:-560}"
 export PSDT_BENCH_TPU_ATTEMPTS=1
 export PSDT_BENCH_CPU_TIMEOUT=1   # TPU sweep: a CPU fallback number is noise
+# fail fast per run: one probe, no retry window (bench.py defaults to a
+# 12.5-min spaced window meant for the single driver run, which would turn
+# a dead-tunnel 7-config sweep into ~1.5 h of waiting)
+export PSDT_BENCH_PREFLIGHT_RETRIES=1
 
 run() {  # run <tag> [VAR=VALUE...]
   local tag="$1"; shift
   echo "=== $tag ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
   local line
   line=$(env "$@" python bench.py 2>>"$LOG")
+  [ -n "$line" ] || line='{"metric": "bench_error", "value": 0.0, "unit": "error", "vs_baseline": 0.0, "note": "bench.py emitted no output"}'
   echo "{\"config\": \"$tag\", \"result\": $line}" | tee -a "$RESULTS"
 }
 

@@ -57,3 +57,22 @@ def test_bench_pushpull_contract():
     result = run_bench("pushpull")
     assert result["metric"].startswith("ps_pushpull_p50")
     assert result["value"] > 0
+
+
+@pytest.mark.slow
+def test_bench_preflight_spaced_retry_then_fallback():
+    # With a TPU attempt requested but every preflight doomed (tiny probe
+    # timeout: the probe subprocess cannot even finish importing jax), the
+    # orchestrator must burn the whole retry window, then fall back to an
+    # honestly-labeled CPU number that records the probe count.
+    result = run_bench("mfu", extra_env={
+        "PSDT_BENCH_TPU_ATTEMPTS": "1",
+        # no python subprocess can import jax and run an op in 0.5 s, so
+        # the probe fails deterministically even on a healthy backend
+        "PSDT_BENCH_PREFLIGHT_TIMEOUT": "0.5",
+        "PSDT_BENCH_PREFLIGHT_RETRIES": "2",
+        "PSDT_BENCH_PREFLIGHT_SPACING_S": "0",
+    })
+    assert result["metric"].endswith("_cpu_fallback")
+    assert "2 spaced probes" in result.get("note", "")
+    assert result["value"] > 0

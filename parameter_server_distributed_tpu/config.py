@@ -59,6 +59,7 @@ class ParameterServerConfig:
     optimizer: str = "sgd"       # sgd | momentum | adam | adamw |
                                  # device_* | pallas_*
     momentum: float = 0.9
+    weight_decay: float = 1e-4   # adamw variants only (matrices-only decay)
     staleness_bound: int = 0     # 0 = strictly synchronous (reference behavior)
     elastic: bool = False        # True: barrier width tracks live registrations
     live_workers_ttl_s: float = 1.0  # cache TTL for the live-worker lookup

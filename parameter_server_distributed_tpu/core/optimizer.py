@@ -176,7 +176,8 @@ class AdamW(Adam):
         return out
 
 
-def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9) -> HostOptimizer:
+def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9,
+                   weight_decay: float = 1e-4) -> HostOptimizer:
     """PS optimizer by name.  Plain names (`sgd|momentum|adam|adamw`) are
     the host-side numpy/native-C++ optimizers above; `device_*` selects
     the accelerator-resident optax path and `pallas_*` the fused
@@ -189,7 +190,7 @@ def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9) -> Ho
     if name == "adam":
         return Adam(learning_rate)
     if name == "adamw":
-        return AdamW(learning_rate)
+        return AdamW(learning_rate, weight_decay)
     if name.startswith("device_") or name.startswith("pallas_"):
         kind, _, rule = name.partition("_")
         from ..async_sgd.device_optimizer import DeviceOptimizer, PallasOptimizer
@@ -200,7 +201,7 @@ def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9) -> Ho
         if rule == "momentum":
             return DeviceOptimizer.momentum(learning_rate, momentum)
         if rule == "adamw":
-            return DeviceOptimizer.adamw(learning_rate)
+            return DeviceOptimizer.adamw(learning_rate, weight_decay)
         if rule == "adam":
             return DeviceOptimizer.adam(learning_rate)
     raise ValueError(f"unknown optimizer {name!r}")

@@ -85,6 +85,17 @@ def prefetch_to_device(batches: Iterator, place: Callable,
 
         def close(self):
             stop.set()
+            # Drain already-placed batches so their device buffers are
+            # actually released (the queue would otherwise pin up to
+            # ``depth`` batches of HBM through the final eval/checkpoint),
+            # then give the worker a moment to observe stop and exit.
+            for _ in range(2):  # 2nd pass: a worker mid-put can slip one
+                while True:     # more batch in after the first drain
+                    try:
+                        out.get_nowait()
+                    except queue.Empty:
+                        break
+                thread.join(timeout=1.0)
 
         def __del__(self):
             stop.set()

@@ -167,15 +167,23 @@ def sample_token(logits: Array, rng: Array, temperature: float = 0.0,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-# Compiled runner cache: one jitted wrapper per (model, generation config);
-# the closure keeps the model alive, so its id cannot be reused while the
-# entry exists.  jax.jit's own cache then handles distinct prompt shapes.
+# Compiled runner cache: one jitted wrapper per (model, generation config),
+# keyed on the model's never-reused cache_token (id() can be recycled after
+# GC).  jax.jit's own cache then handles distinct prompt shapes.
 # Bounded LRU: a long-lived service sweeping generation settings would
 # otherwise pin compiled executables (and their models) for process
 # lifetime.  Lock-guarded — concurrent generate() calls share the cache.
 _RUNNERS: "OrderedDict[tuple, object]" = OrderedDict()
 _RUNNERS_MAX = 32
 _RUNNERS_LOCK = threading.Lock()
+
+
+def _model_key(model) -> int:
+    # cache_token is assigned in Transformer.__init__; getattr keeps
+    # duck-typed model stand-ins (tests) working, accepting id()'s
+    # recycling caveat only for those.
+    token = getattr(model, "cache_token", None)
+    return id(model) if token is None else token
 
 
 def _cached_runner(key: tuple, build):
@@ -197,7 +205,7 @@ def _cached_runner(key: tuple, build):
 
 def _runner(model: Transformer, max_new_tokens: int, temperature: float,
             top_k: int, top_p: float):
-    key = (id(model), max_new_tokens, temperature, top_k, top_p)
+    key = (_model_key(model), max_new_tokens, temperature, top_k, top_p)
 
     def build():
         @jax.jit
@@ -225,7 +233,7 @@ def _runner(model: Transformer, max_new_tokens: int, temperature: float,
 
 def _beam_runner(model: Transformer, max_new_tokens: int, beam_width: int,
                  eos_id: int | None, length_penalty: float):
-    key = (id(model), max_new_tokens, "beam", beam_width, eos_id,
+    key = (_model_key(model), max_new_tokens, "beam", beam_width, eos_id,
            length_penalty)
 
     def build():
@@ -340,13 +348,13 @@ def beam_search(model: Transformer, params: Mapping[str, Array],
 
 
 def _decode_step_runner(model: Transformer):
-    key = (id(model), "spec_step")
+    key = (_model_key(model), "spec_step")
     return _cached_runner(key, lambda: jax.jit(
         lambda params, tok, cache: decode_step(model, params, tok, cache)))
 
 
 def _decode_block_runner(model: Transformer, t: int):
-    key = (id(model), "spec_block", t)
+    key = (_model_key(model), "spec_block", t)
     return _cached_runner(key, lambda: jax.jit(
         lambda params, toks, cache: decode_block(model, params, toks, cache)))
 

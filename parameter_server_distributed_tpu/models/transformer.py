@@ -22,6 +22,7 @@ transformer flows through the PS protocol, checkpointing, and ShardedTrainer.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import math
 from functools import partial
 from typing import Callable, Mapping
@@ -281,6 +282,9 @@ def causal_attention(q: Array, k: Array, v: Array) -> Array:
                       preferred_element_type=jnp.float32).astype(v.dtype)
 
 
+_INSTANCE_COUNTER = itertools.count()
+
+
 class Transformer:
     def __init__(self, config: TransformerConfig,
                  attention_fn: Callable | None = None,
@@ -311,6 +315,9 @@ class Transformer:
         self.attention_fn = attention_fn or (
             _default_attention() if mesh is None else causal_attention)
         self.mesh = mesh  # when set, activations get sharding constraints
+        # Never-reused identity for compiled-runner caches (generation.py):
+        # id(self) can be recycled after GC, a counter token cannot.
+        self.cache_token = next(_INSTANCE_COUNTER)
 
     # ------------------------------------------------------------- shapes
     def param_shapes(self) -> dict[str, tuple[int, ...]]:

@@ -1,0 +1,100 @@
+"""Emit proto3 IDL text from the declarative message schemas.
+
+`rpc/messages.py` is the single source of truth for the wire contract
+(field numbers/types mirroring the reference IDL — reference
+proto/parameter_server.proto, proto/coordinator.proto).  This module
+renders that contract back out as `.proto` files so that
+
+- a C++/Go peer can `protoc`-compile against this framework without the
+  reference checkout (``python -m parameter_server_distributed_tpu.rpc.idl
+  <outdir>``), and
+- the wire-interop test suite can cross-check our hand-rolled codec
+  against protoc gencode even where the reference protos are absent
+  (e.g. public CI).
+
+The emitted text includes the framework's extension fields (Tensor 5/6,
+PullRequest 3, GetPSAddressResponse 3); reference peers skip those per
+proto3 unknown-field rules.
+"""
+
+from __future__ import annotations
+
+from . import messages as m
+
+_SCALAR = {"int32": "int32", "int64": "int64", "bool": "bool",
+           "float": "float", "string": "string", "bytes": "bytes"}
+
+# The only enum in either package; field kind "enum" maps to its type name.
+_ENUM_NAME = "WorkerStatus"
+
+PACKAGES = {
+    "parameter_server": {
+        "messages": (m.GradientUpdate, m.Tensor, m.PushResponse,
+                     m.PullRequest, m.ParameterUpdate, m.SyncStatusRequest,
+                     m.SyncStatusResponse, m.SaveCheckpointRequest,
+                     m.SaveCheckpointResponse, m.LoadCheckpointRequest,
+                     m.LoadCheckpointResponse),
+        "enums": (),
+        "service": ("ParameterServer", m.PARAMETER_SERVER_METHODS),
+    },
+    "coordinator": {
+        "messages": (m.WorkerInfo, m.RegisterResponse, m.HeartbeatRequest,
+                     m.HeartbeatResponse, m.ListWorkersRequest,
+                     m.ListWorkersResponse, m.GetPSAddressRequest,
+                     m.GetPSAddressResponse),
+        "enums": (m.WorkerStatus,),
+        "service": ("Coordinator", m.COORDINATOR_METHODS),
+    },
+}
+
+
+def _field_line(f) -> str:
+    if f.kind == "message":
+        type_name = f.message_type.__name__
+    elif f.kind == "enum":
+        type_name = _ENUM_NAME
+    else:
+        type_name = _SCALAR[f.kind]
+    repeated = "repeated " if f.repeated else ""
+    return f"  {repeated}{type_name} {f.name} = {f.number};"
+
+
+def render_package(package: str) -> str:
+    spec = PACKAGES[package]
+    service_name, methods = spec["service"]
+    out = ["syntax = \"proto3\";", "", f"package {package};", ""]
+    out.append(f"service {service_name} {{")
+    for method, (req, resp) in methods.items():
+        out.append(f"  rpc {method}({req.__name__}) "
+                   f"returns ({resp.__name__});")
+    out.append("}")
+    for enum in spec["enums"]:
+        out += ["", f"enum {enum.__name__} {{"]
+        for value, name in sorted(enum._NAMES.items()):
+            out.append(f"  {name} = {value};")
+        out.append("}")
+    for msg in spec["messages"]:
+        out += ["", f"message {msg.__name__} {{"]
+        out += [_field_line(f) for f in msg.FIELDS]
+        out.append("}")
+    return "\n".join(out) + "\n"
+
+
+def write_protos(outdir: str) -> list[str]:
+    import os
+
+    os.makedirs(outdir, exist_ok=True)
+    paths = []
+    for package in PACKAGES:
+        path = os.path.join(outdir, f"{package}.proto")
+        with open(path, "w") as fh:
+            fh.write(render_package(package))
+        paths.append(path)
+    return paths
+
+
+if __name__ == "__main__":
+    import sys
+
+    for p in write_protos(sys.argv[1] if len(sys.argv) > 1 else "."):
+        print(p)

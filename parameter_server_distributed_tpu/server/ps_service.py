@@ -95,7 +95,7 @@ class ParameterServer:
                  live_workers_fn: Callable[[], int] | None = None):
         self.config = config
         optimizer = make_optimizer(config.optimizer, config.learning_rate,
-                                   config.momentum)
+                                   config.momentum, config.weight_decay)
         self.core = ParameterServerCore(
             total_workers=config.total_workers,
             optimizer=optimizer,

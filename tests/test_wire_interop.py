@@ -40,16 +40,23 @@ REFERENCE_PROTO_DIR = "/root/reference/proto"
 @pytest.fixture(scope="module")
 def gencode(tmp_path_factory):
     """Compile the reference .proto files with protoc; returns the two
-    generated modules (parameter_server_pb2, coordinator_pb2)."""
+    generated modules (parameter_server_pb2, coordinator_pb2).
+
+    Where the reference checkout is absent (public CI), the IDL emitted
+    from our own declarative schemas (rpc/idl.py) is compiled instead —
+    still a real cross-check of the hand-rolled codec against protoc's
+    encoder/decoder for the same schema."""
     protoc = shutil.which("protoc")
     if protoc is None:
         pytest.skip("protoc not available")
     import os
-    if not os.path.isdir(REFERENCE_PROTO_DIR):
-        pytest.skip("reference proto files not available")
     out = tmp_path_factory.mktemp("gencode")
-    for name in ("parameter_server.proto", "coordinator.proto"):
-        shutil.copy(f"{REFERENCE_PROTO_DIR}/{name}", out / name)
+    if os.path.isdir(REFERENCE_PROTO_DIR):
+        for name in ("parameter_server.proto", "coordinator.proto"):
+            shutil.copy(f"{REFERENCE_PROTO_DIR}/{name}", out / name)
+    else:
+        from parameter_server_distributed_tpu.rpc import idl
+        idl.write_protos(str(out))
     subprocess.run(
         [protoc, f"--python_out={out}", "parameter_server.proto",
          "coordinator.proto"],
@@ -272,3 +279,60 @@ def test_service_and_method_names_match_reference(gencode):
         req_cls, resp_cls = m.COORDINATOR_METHODS[meth.name]
         assert req_cls.__name__ == meth.input_type.name
         assert resp_cls.__name__ == meth.output_type.name
+
+
+def test_emitted_idl_matches_reference_descriptors(tmp_path):
+    """rpc/idl.py's emitted .proto files, protoc-compiled, must describe
+    the same wire contract as the reference IDL: every reference message's
+    fields (number, proto type, label) are present and identical in the
+    emitted schema.  This is what licenses the CI fallback that interop-
+    tests against the emitted IDL when the reference checkout is absent."""
+    import os
+
+    protoc = shutil.which("protoc")
+    if protoc is None:
+        pytest.skip("protoc not available")
+    if not os.path.isdir(REFERENCE_PROTO_DIR):
+        pytest.skip("reference proto files not available")
+    from parameter_server_distributed_tpu.rpc import idl
+
+    emitted_src = tmp_path / "emitted"
+    idl.write_protos(str(emitted_src))
+    # descriptor_pb2-level comparison avoids the duplicate-registration
+    # problem entirely: parse the FileDescriptorProto text protoc makes
+    out = subprocess.run(
+        [protoc, "-o", "/dev/stdout", "--include_imports",
+         "parameter_server.proto", "coordinator.proto"],
+        cwd=REFERENCE_PROTO_DIR, check=True, capture_output=True)
+    ref_fds = out.stdout
+    out = subprocess.run(
+        [protoc, "-o", "/dev/stdout", "--include_imports",
+         "parameter_server.proto", "coordinator.proto"],
+        cwd=emitted_src, check=True, capture_output=True)
+    our_fds = out.stdout
+
+    from google.protobuf import descriptor_pb2
+
+    def field_map(fds_bytes):
+        fds = descriptor_pb2.FileDescriptorSet()
+        fds.MergeFromString(fds_bytes)
+        fields = {}
+        for f in fds.file:
+            for msg in f.message_type:
+                for fld in msg.field:
+                    fields[(f.package, msg.name, fld.number)] = (
+                        fld.name, fld.type, fld.label)
+        return fields
+
+    ref_fields = field_map(ref_fds)
+    our_fields = field_map(our_fds)
+    for key, val in ref_fields.items():
+        assert key in our_fields, f"reference field missing: {key} {val}"
+        assert our_fields[key] == val, (
+            f"field mismatch at {key}: ref={val} ours={our_fields[key]}")
+    extras = set(our_fields) - set(ref_fields)
+    # only the documented framework extensions may exceed the reference
+    assert extras == {("parameter_server", "Tensor", 5),
+                      ("parameter_server", "Tensor", 6),
+                      ("parameter_server", "PullRequest", 3),
+                      ("coordinator", "GetPSAddressResponse", 3)}, extras

@@ -25,10 +25,14 @@ loop (cross-layer XLA fusion) for transformer LMs.  ``--seq=N``
 overrides the LM sequence length (long-context runs; synthetic token
 streams follow the model).
 
-``--mesh=pipe:P`` trains transformer models with GPipe pipeline
-parallelism (parallel/pipeline.py): layer blocks live on their pipe rank,
+``--mesh=pipe:P`` trains transformer models with pipeline parallelism
+(parallel/pipeline.py): layer blocks live on their pipe rank,
 microbatches stream through; ``--microbatches=M`` sets the schedule depth
-(default P).  Requires n_layers divisible by P; combine with data:N.
+(default P).  ``--pipeline-schedule=gpipe|1f1b`` picks the schedule:
+gpipe (all forwards then all backwards via autodiff) or 1f1b (interleaved
+one-forward-one-backward — O(P) instead of O(M) in-flight activations).
+Requires n_layers divisible by P; combine with data:N.  ``--attention``
+may be dense or flash inside pipeline stages.
 
 ``--data`` switches from synthetic loaders to file-backed data
 (data/files.py): a token shard (.bin/.u32 memmap) for LM models, an npz
@@ -76,11 +80,31 @@ def parse_mesh(spec: str) -> MeshConfig:
     return MeshConfig(**kwargs)
 
 
+KNOWN_FLAGS = frozenset({
+    "model", "batch", "data", "seq", "eval-every", "eval-steps", "eval-data",
+    "per-process-data", "prefetch", "attention", "microbatches",
+    "pipeline-schedule", "dtype", "remat", "no-remat", "scan-layers",
+    "no-scan-layers", "steps", "optimizer", "lr", "schedule", "warmup",
+    "clip-norm", "accum", "mesh", "ckpt-dir", "ckpt-every", "ckpt-keep",
+    "log-every", "seed", "resume", "metrics", "coordinator",
+    "num-processes", "process-id",
+})
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     _, flags = parse_argv(argv)
+    if "help" in flags:
+        print(__doc__)
+        return 0
+    unknown = set(flags) - KNOWN_FLAGS
+    if unknown:
+        # a typo'd flag silently falling back to its default is how a 64x
+        # batch lands in a benchmark unnoticed — fail loudly instead
+        raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
+                         f"--help lists the accepted flags")
 
     if "coordinator" in flags or int(flags.get("num-processes", 1)) > 1:
         from ..parallel.distributed import initialize_multihost
@@ -103,6 +127,7 @@ def main(argv: list[str] | None = None) -> int:
         prefetch=int(flags.get("prefetch", 2)),
         attention=flags.get("attention", "dense"),
         microbatches=int(flags.get("microbatches", 0)),
+        pipeline_schedule=flags.get("pipeline-schedule", "gpipe"),
         model_dtype=flags.get("dtype", ""),
         remat=(False if "no-remat" in flags
                else True if "remat" in flags else None),

@@ -27,6 +27,21 @@ def num_pipeline_stages(mesh: Mesh) -> int:
     return mesh.shape["pipe"]
 
 
+def _microbatch_size(mesh: Mesh, batch_axes: tuple[str, ...],
+                     global_batch: int, num_microbatches: int) -> int:
+    """Per-device microbatch rows; the one divisibility check both the
+    GPipe and 1F1B schedules share."""
+    dp = 1
+    for axis in batch_axes:
+        dp *= mesh.shape.get(axis, 1)
+    local_batch, rem = divmod(global_batch, dp)
+    if rem or local_batch % num_microbatches:
+        raise ValueError(
+            f"per-device batch {global_batch}/{dp} must divide by "
+            f"num_microbatches={num_microbatches}")
+    return local_batch // num_microbatches
+
+
 def stack_stage_params(per_stage_params: list[dict], mesh: Mesh) -> dict:
     """Stack per-stage param stores along a leading [P] axis and shard it
     over ``pipe``: stage i's weights live on pipe rank i."""
@@ -61,8 +76,12 @@ class PipelinedTransformerLM:
     BLOCK_PREFIX = "blocks/"
     _STAGE_KEY = "blk"  # reuse Transformer block methods with this prefix
 
-    def __init__(self, inner, mesh: Mesh, num_microbatches: int = 0):
-        from ..models.transformer import Transformer
+    SCHEDULES = ("gpipe", "1f1b")
+
+    def __init__(self, inner, mesh: Mesh, num_microbatches: int = 0,
+                 schedule: str = "gpipe", attention: str | None = None):
+        from ..models.transformer import (Transformer, causal_attention,
+                                          flash_attention_auto)
 
         if not isinstance(inner, Transformer):
             raise ValueError("pipeline parallelism wraps a Transformer LM")
@@ -72,15 +91,32 @@ class PipelinedTransformerLM:
             raise ValueError(
                 "pipeline wraps an unrolled Transformer (it restacks "
                 "layer<i>/* itself); build the model without scan_layers")
+        if schedule not in self.SCHEDULES:
+            raise ValueError(f"schedule {schedule!r}; options {self.SCHEDULES}")
         n_pipe = mesh.shape["pipe"]
         if inner.config.n_layers % n_pipe:
             raise ValueError(
                 f"n_layers={inner.config.n_layers} must divide by the "
                 f"pipe axis ({n_pipe})")
+        # Stage-internal attention runs per device inside shard_map, so the
+        # single-shard kernels are the contract: dense einsum or the pallas
+        # flash kernel (seq/ring/ulysses need a seq axis, which pipeline
+        # does not compose with).  None = inherit the wrapped model's.
+        if attention == "dense":
+            self._stage_attention = causal_attention
+        elif attention == "flash":
+            self._stage_attention = flash_attention_auto
+        elif attention is None:
+            self._stage_attention = inner.attention_fn
+        else:
+            raise ValueError(
+                f"pipeline stages support attention dense|flash, "
+                f"got {attention!r}")
         self.inner = inner
         self.config = inner.config
         self.mesh = mesh
         self.n_pipe = n_pipe
+        self.schedule = schedule
         self.layers_per_stage = inner.config.n_layers // n_pipe
         self.num_microbatches = num_microbatches or n_pipe
 
@@ -138,7 +174,7 @@ class PipelinedTransformerLM:
 
         def one_block(blk, h):
             q, k, v = model.qkv(blk, key, h, positions)
-            attn = model.attention_fn(q, k, v)  # impls expand GQA K/V
+            attn = self._stage_attention(q, k, v)  # impls expand GQA K/V
             h = model.attn_residual(blk, key, h, attn)
             return model.mlp_residual(blk, key, h)
 
@@ -157,10 +193,173 @@ class PipelinedTransformerLM:
                         if name.startswith(self.BLOCK_PREFIX)}
         h = pipeline_apply(self._stage_fn, stage_params, h, self.mesh,
                            self.num_microbatches)
+        return self._head_loss(params, h, tokens)
+
+    def _head_loss(self, rest_params: Mapping, h: jax.Array,
+                   tokens: jax.Array) -> jax.Array:
+        """Per-microbatch LM-head loss (final norm -> logits -> NLL), the
+        last pipeline stage's tail in the 1F1B schedule."""
         if self.config.loss_chunk:
-            return self.inner._chunked_next_token_nll(params, h, tokens)
+            return self.inner._chunked_next_token_nll(rest_params, h, tokens)
         from ..models.transformer import next_token_nll
-        return next_token_nll(self.inner.final_logits(params, h), tokens)
+        return next_token_nll(self.inner.final_logits(rest_params, h),
+                              tokens)
+
+    def value_and_grad(self, params: Mapping, batch):
+        """(loss, grads) under the configured schedule.  For "1f1b" this is
+        the hand-written interleaved schedule below; "gpipe" (or a 1-wide
+        pipe axis) differentiates the GPipe forward with jax.grad."""
+        if self.schedule == "1f1b" and self.n_pipe > 1:
+            return self._value_and_grad_1f1b(params, batch)
+        return jax.value_and_grad(self.loss)(params, batch)
+
+    def _value_and_grad_1f1b(self, params: Mapping, batch):
+        """One-forward-one-backward pipeline schedule (PipeDream-flush /
+        Megatron's non-interleaved 1F1B), hand-written as an SPMD program.
+
+        Why: GPipe-by-autodiff (jax.grad over :func:`pipeline_apply`) runs
+        all M forwards, then all M backwards — every stage holds residuals
+        for all M microbatches at the backward's start.  1F1B starts
+        microbatch m's backward as soon as its forward leaves the last
+        stage, bounding in-flight microbatches per stage at
+        K = 2*(P-1)+1 regardless of M — activation memory O(P) instead of
+        O(M), same bubble fraction.
+
+        Rematerialized: each stage saves only its INPUT per in-flight
+        microbatch (a [mb, S, D] block in a K-slot ring buffer) and
+        recomputes the stage forward inside `jax.vjp` at backward time —
+        the standard memory/compute trade for pipelined large models, and
+        the same trade `config.remat` makes for the plain model.
+
+        Schedule (P stages, M microbatches, rank r, tick t):
+          forward  of microbatch  f = t - r          (0 <= f < M)
+          backward of microbatch  b = t - 2(P-1) + r (0 <= b < M)
+        so the last rank runs fwd(m) and bwd(m) in the same tick (its head
+        cotangent is produced in-tick), and cotangents reach rank r-1 one
+        ppermute later.  T = M + 2(P-1) ticks total.  Every rank executes
+        every tick's fwd+vjp on (possibly garbage) data, with validity
+        masks zeroing the contributions — the SPMD-uniform formulation
+        shard_map requires, like pipeline_apply's jnp.where injection.
+
+        Exactness: gradients equal jax.grad of the non-pipelined model
+        (tests/test_pipeline.py::test_pipelined_lm_1f1b_*).
+        """
+        from jax import lax
+
+        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        mesh, n_pipe, M = self.mesh, self.n_pipe, self.num_microbatches
+        batch_axes = ("data", "fsdp")
+        mb = _microbatch_size(mesh, batch_axes, tokens.shape[0], M)
+        seq = tokens.shape[1]
+        d_model = self.config.d_model
+        K = 2 * (n_pipe - 1) + 1  # in-flight ring-buffer slots per rank
+        T = M + 2 * (n_pipe - 1)  # total schedule ticks
+
+        blocks = {k: v for k, v in params.items()
+                  if k.startswith(self.BLOCK_PREFIX)}
+        rest = {k: v for k, v in params.items()
+                if not k.startswith(self.BLOCK_PREFIX)}
+        block_specs = {k: P("pipe", *([None] * (v.ndim - 1)))
+                       for k, v in blocks.items()}
+        rest_specs = {k: P() for k in rest}
+        tok_spec = P(batch_axes, None)
+        stage_fn = self._stage_fn
+        head_loss = self._head_loss
+        acts_dtype = self.config.dtype
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(block_specs, rest_specs, tok_spec),
+                 out_specs=(P(), block_specs, rest_specs),
+                 check_vma=False)
+        def run(blocks_in, rest_in, tok_local):
+            my = lax.axis_index("pipe")
+            my_blocks = jax.tree.map(lambda p: p[0], blocks_in)
+            tok_mb = tok_local.reshape(M, mb, seq)
+            fwd_perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
+            bwd_perm = [(i, (i - 1) % n_pipe) for i in range(n_pipe)]
+
+            state = jnp.zeros((mb, seq, d_model), acts_dtype)
+            cot_recv = jnp.zeros((mb, seq, d_model), jnp.float32)
+            buf = jnp.zeros((K, mb, seq, d_model), acts_dtype)
+            g_blocks = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), my_blocks)
+            g_rest = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), rest_in)
+            loss_acc = jnp.zeros((), jnp.float32)
+            is_last = my == n_pipe - 1
+
+            def masked_add(acc, contrib, mask):
+                return jax.tree.map(
+                    lambda a, g: a + jnp.where(mask, g, 0.0).astype(
+                        jnp.float32), acc, contrib)
+
+            for t in range(T):
+                # ---- forward: rank r computes microbatch f = t - r
+                if t < M:  # rank 0 injects microbatch t (static index)
+                    inj = jnp.take(rest_in["embed/tok"], tok_mb[t],
+                                   axis=0).astype(acts_dtype)
+                    state_in = jnp.where(my == 0, inj, state)
+                else:
+                    state_in = state
+                f_slot = jnp.mod(t - my, K)
+                buf = lax.dynamic_update_index_in_dim(buf, state_in,
+                                                      f_slot, axis=0)
+                state_out = stage_fn(my_blocks, state_in)
+
+                # ---- last-rank head: loss + cotangent for f = t - (P-1)
+                tl = t - (n_pipe - 1)
+                if 0 <= tl < M:
+                    def head(rp, h, _tok=tok_mb[tl]):
+                        return head_loss(rp, h, _tok)
+                    lval, head_vjp = jax.vjp(head, rest_in,
+                                             state_out.astype(jnp.float32))
+                    g_rest_m, cot_head = head_vjp(
+                        jnp.ones((), lval.dtype))
+                    loss_acc = loss_acc + jnp.where(is_last, lval, 0.0)
+                    g_rest = masked_add(g_rest, g_rest_m, is_last)
+                    cot = jnp.where(is_last, cot_head, cot_recv)
+                else:
+                    cot = cot_recv
+
+                # ---- backward: rank r computes microbatch b = t-2(P-1)+r
+                b_off = t - 2 * (n_pipe - 1)
+                dx_send = jnp.zeros((mb, seq, d_model), jnp.float32)
+                if t >= n_pipe - 1 and b_off <= M - 1:
+                    bvalid = (b_off + my >= 0) & (b_off + my < M)
+                    b_slot = jnp.mod(b_off + my, K)
+                    saved_in = lax.dynamic_index_in_dim(buf, b_slot, axis=0,
+                                                        keepdims=False)
+                    _, stage_vjp = jax.vjp(stage_fn, my_blocks, saved_in)
+                    g_blk_m, dx = stage_vjp(cot.astype(acts_dtype))
+                    g_blocks = masked_add(g_blocks, g_blk_m, bvalid)
+                    dx_send = jnp.where(bvalid, dx.astype(jnp.float32), 0.0)
+                    if 0 <= b_off < M:  # rank 0: embedding-lookup backward
+                        emb_mask = jnp.where((my == 0) & bvalid, 1.0, 0.0)
+                        g_rest["embed/tok"] = (
+                            g_rest["embed/tok"].at[tok_mb[b_off]].add(
+                                dx_send * emb_mask))
+
+                # ---- rotate activations forward, cotangents backward
+                if t < T - 1:
+                    state = lax.ppermute(state_out, "pipe", fwd_perm)
+                    cot_recv = lax.ppermute(dx_send, "pipe", bwd_perm)
+
+            # reductions: microbatch mean, then mean over the data shards;
+            # loss/head/embed live on single ranks -> share over pipe
+            loss = lax.pmean(lax.psum(loss_acc, "pipe") / M, batch_axes)
+            g_blocks = jax.tree.map(
+                lambda g, p: lax.pmean(g / M, batch_axes).astype(
+                    p.dtype)[None], g_blocks, my_blocks)
+            g_rest = jax.tree.map(
+                lambda g, p: lax.pmean(lax.psum(g, "pipe") / M,
+                                       batch_axes).astype(p.dtype),
+                g_rest, rest_in)
+            return loss, g_blocks, g_rest
+
+        loss, g_blocks, g_rest = run(blocks, rest, tokens)
+        grads = dict(g_blocks)
+        grads.update(g_rest)
+        return loss, {name: grads[name] for name in params}
 
 
 def pipeline_rule(mesh: Mesh):
@@ -198,15 +397,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
         params0 = jax.tree.map(lambda p: p[0], stage_params)
         return stage_fn(params0, x)
 
-    dp = 1
-    for axis in batch_axes:
-        dp *= mesh.shape.get(axis, 1)
-    local_batch, rem = divmod(x.shape[0], dp)
-    if rem or local_batch % num_microbatches:
-        raise ValueError(
-            f"per-device batch {x.shape[0]}/{dp} must divide by "
-            f"num_microbatches={num_microbatches}")
-    mb = local_batch // num_microbatches
+    mb = _microbatch_size(mesh, batch_axes, x.shape[0], num_microbatches)
 
     param_specs = jax.tree.map(
         lambda p: P("pipe", *([None] * (p.ndim - 1))), stage_params)

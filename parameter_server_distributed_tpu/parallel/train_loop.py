@@ -49,6 +49,7 @@ class TrainLoopConfig:
                                   # synthetic stream
     attention: str = "dense"      # dense | flash | ring | ulysses (LM models)
     microbatches: int = 0         # pipeline microbatches (0 = pipe size)
+    pipeline_schedule: str = "gpipe"  # gpipe | 1f1b (pipe axis > 1)
     model_dtype: str = ""         # "" = model default | f32 | bf16
     remat: bool | None = None     # per-layer jax.checkpoint (LM models);
                                   # None = model default, True/False force
@@ -111,16 +112,20 @@ def run_training(config: TrainLoopConfig) -> dict:
     from ..models.transformer import Transformer, select_attention
     if isinstance(model, Transformer):
         if mesh.shape["pipe"] > 1:
-            # pipeline mode: wrap in the GPipe-scheduled model (pipe +
-            # data axes; blocks live on their pipe rank).  Attention inside
-            # a pipeline stage is the per-shard dense kernel.
-            if config.attention != "dense":
+            # pipeline mode: wrap in the scheduled model (pipe + data axes;
+            # blocks live on their pipe rank).  Attention inside a stage is
+            # the per-device kernel: dense einsum or the pallas flash
+            # kernel (ring/ulysses need a seq axis, which pipe does not
+            # compose with).
+            if config.attention not in ("dense", "flash"):
                 raise ValueError(
-                    "--attention must be dense with a pipe axis (stage-"
-                    "internal attention runs inside shard_map)")
+                    "--attention must be dense or flash with a pipe axis "
+                    "(stage-internal attention runs inside shard_map)")
             from .pipeline import PipelinedTransformerLM
             model = PipelinedTransformerLM(
-                model, mesh, num_microbatches=config.microbatches)
+                model, mesh, num_microbatches=config.microbatches,
+                schedule=config.pipeline_schedule,
+                attention=config.attention)
         else:
             # give the model the mesh (activation sharding constraints) and
             # the selected attention implementation — flash composes with
@@ -158,7 +163,8 @@ def run_training(config: TrainLoopConfig) -> dict:
                        warmup_steps=config.warmup_steps,
                        total_steps=config.steps,
                        clip_norm=config.clip_norm),
-        accum_steps=config.accum_steps)
+        accum_steps=config.accum_steps,
+        grad_fn=getattr(model, "value_and_grad", None))
     state = trainer.init_state(model.init_params(config.seed))
 
     start_step = 0

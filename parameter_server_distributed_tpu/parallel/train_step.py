@@ -131,19 +131,24 @@ def split_microbatches(batch, accum_steps: int):
 
 def make_train_step(loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
-                    accum_steps: int = 1) -> Callable:
+                    accum_steps: int = 1,
+                    grad_fn: Callable | None = None) -> Callable:
     """Build a pure (state, batch) -> (state, metrics) step function.
 
     ``accum_steps > 1`` splits the batch's leading axis into that many
     microbatches and accumulates gradients in float32 under `lax.scan` —
     one optimizer update per step, activation memory of one microbatch.
+
+    ``grad_fn`` overrides autodiff of ``loss_fn``: a (params, batch) ->
+    (loss, grads) callable for models whose backward IS a schedule (the
+    1F1B pipeline, parallel/pipeline.py) rather than jax.grad of their
+    forward.
     """
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
-    def grads_of(params, batch):
-        return jax.value_and_grad(loss_fn)(params, batch)
+    grads_of = grad_fn or jax.value_and_grad(loss_fn)
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
         if accum_steps == 1:
@@ -209,14 +214,15 @@ class ShardedTrainer:
 
     def __init__(self, loss_fn: Callable, mesh: Mesh, rule: ShardingRule,
                  optimizer: optax.GradientTransformation | None = None,
-                 accum_steps: int = 1):
+                 accum_steps: int = 1, grad_fn: Callable | None = None):
         self.mesh = mesh
         self.rule = rule
         self.optimizer = optimizer or make_optimizer("sgd", 1.0)
         self._loss_fn = loss_fn
         self._accum_steps = accum_steps
         self._raw_step = make_train_step(loss_fn, self.optimizer,
-                                         accum_steps=accum_steps)
+                                         accum_steps=accum_steps,
+                                         grad_fn=grad_fn)
         self._compiled: Callable | None = None
         self._compiled_eval: Callable | None = None
         self._shardings: TrainState | None = None

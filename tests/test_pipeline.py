@@ -266,3 +266,145 @@ def test_pipelined_lm_chunked_loss_matches(rng):
         np.testing.assert_allclose(np.asarray(g_b[name]),
                                    np.asarray(g_a[name]), rtol=2e-5,
                                    atol=1e-7, err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule: hand-written interleaved fwd/bwd must be grad-exact vs the
+# non-pipelined model (same contract the GPipe tests prove for autodiff)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pipe,microbatches", [(2, 4), (4, 4), (4, 8)])
+def test_pipelined_lm_1f1b_matches_plain(rng, pipe, microbatches):
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    plain, _, mesh, tokens = _lm_fixtures(rng, pipe=pipe,
+                                          batch=microbatches * (8 // pipe))
+    piped = PipelinedTransformerLM(plain, mesh,
+                                   num_microbatches=microbatches,
+                                   schedule="1f1b")
+    l_plain, g_plain = jax.jit(jax.value_and_grad(plain.loss))(
+        plain.init_params(0), tokens)
+    l_piped, g_piped = jax.jit(piped.value_and_grad)(piped.init_params(0),
+                                                     tokens)
+    np.testing.assert_allclose(float(l_piped), float(l_plain), rtol=1e-5)
+    expected = _restack_grads(piped, {k: np.asarray(v)
+                                      for k, v in g_plain.items()})
+    assert set(expected) == set(g_piped)
+    for name in sorted(expected):
+        np.testing.assert_allclose(
+            np.asarray(g_piped[name]), expected[name], rtol=2e-4, atol=1e-5,
+            err_msg=f"1f1b gradient mismatch for {name}")
+
+
+def test_pipelined_lm_1f1b_remat_and_chunked(rng):
+    """config.remat (per-block checkpoint inside the stage vjp) and
+    loss_chunk both compose with the 1F1B schedule unchanged."""
+    import dataclasses
+
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    plain, _, mesh, tokens = _lm_fixtures(rng)
+    base = PipelinedTransformerLM(plain, mesh, num_microbatches=2,
+                                  schedule="1f1b")
+    params = base.init_params(0)
+    l_a, g_a = jax.jit(base.value_and_grad)(params, tokens)
+    for override in (dict(remat=True), dict(loss_chunk=4)):
+        variant_model = Transformer(dataclasses.replace(plain.config,
+                                                        **override))
+        variant = PipelinedTransformerLM(variant_model, mesh,
+                                         num_microbatches=2,
+                                         schedule="1f1b")
+        l_b, g_b = jax.jit(variant.value_and_grad)(params, tokens)
+        np.testing.assert_allclose(float(l_b), float(l_a), rtol=1e-5)
+        for name in g_a:
+            np.testing.assert_allclose(
+                np.asarray(g_b[name]), np.asarray(g_a[name]), rtol=2e-5,
+                atol=1e-6, err_msg=f"{override}: {name}")
+
+
+def test_pipelined_lm_1f1b_trains_in_sharded_trainer(rng):
+    """ShardedTrainer with the 1F1B grad_fn: one sgd step equals the
+    GPipe-scheduled step (same grads -> same update)."""
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM, pipeline_rule)
+    from parameter_server_distributed_tpu.parallel.train_step import (
+        ShardedTrainer, make_optimizer)
+
+    plain, piped_gpipe, mesh, tokens = _lm_fixtures(rng)
+    piped_1f1b = PipelinedTransformerLM(plain, mesh, num_microbatches=2,
+                                        schedule="1f1b")
+    kw = dict(mesh=mesh, rule=pipeline_rule(mesh),
+              optimizer=make_optimizer("sgd", 0.1))
+    t_a = ShardedTrainer(piped_gpipe.loss, kw["mesh"], kw["rule"],
+                         kw["optimizer"])
+    t_b = ShardedTrainer(piped_1f1b.loss, kw["mesh"], kw["rule"],
+                         kw["optimizer"], grad_fn=piped_1f1b.value_and_grad)
+    s_a = t_a.init_state(piped_gpipe.init_params(0))
+    s_b = t_b.init_state(piped_1f1b.init_params(0))
+    s_a, m_a = t_a.step(s_a, tokens)
+    s_b, m_b = t_b.step(s_b, tokens)
+    np.testing.assert_allclose(float(m_b["loss"]), float(m_a["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(m_b["grad_norm"]),
+                               float(m_a["grad_norm"]), rtol=2e-4)
+    for name in s_a.params:
+        np.testing.assert_allclose(np.asarray(s_b.params[name]),
+                                   np.asarray(s_a.params[name]), rtol=2e-4,
+                                   atol=1e-6, err_msg=name)
+
+
+def test_pipeline_flash_attention_stage(rng):
+    """--attention=flash inside pipeline stages: the per-device pallas
+    kernel (interpret mode on CPU) gives the same loss as dense stages
+    when seq is block-divisible."""
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                               d_ff=64, max_seq=128, dtype=jnp.float32)
+    tokens = rng.integers(0, 64, (8, 128)).astype(np.int32)
+    dense = PipelinedTransformerLM(Transformer(config), mesh,
+                                   num_microbatches=2, attention="dense")
+    flash = PipelinedTransformerLM(Transformer(config), mesh,
+                                   num_microbatches=2, attention="flash")
+    params = dense.init_params(0)
+    l_dense = float(jax.jit(dense.loss)(params, tokens))
+    l_flash = float(jax.jit(flash.loss)(params, tokens))
+    np.testing.assert_allclose(l_flash, l_dense, rtol=1e-4)
+
+
+def test_pipeline_rejects_bad_schedule_and_attention(rng):
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    model = Transformer(TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                          n_layers=2, d_ff=64,
+                                          dtype=jnp.float32))
+    with pytest.raises(ValueError, match="schedule"):
+        PipelinedTransformerLM(model, mesh, schedule="pipedream")
+    with pytest.raises(ValueError, match="attention"):
+        PipelinedTransformerLM(model, mesh, attention="ring")
+
+
+def test_run_training_pipeline_1f1b_mode(rng):
+    """train_main --mesh=pipe:2,data:4 --pipeline-schedule=1f1b trains."""
+    from parameter_server_distributed_tpu.parallel.train_loop import (
+        TrainLoopConfig, run_training)
+
+    config = TrainLoopConfig(
+        model="small_lm", batch_size=8, steps=4, optimizer="sgd",
+        learning_rate=0.5, mesh=MeshConfig(pipeline=2, data=4),
+        microbatches=2, pipeline_schedule="1f1b", log_every=2)
+    summary = run_training(config)
+    assert summary["steps"] == 4
+    assert np.isfinite(summary["final_loss"])

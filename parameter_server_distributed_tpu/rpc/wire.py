@@ -136,17 +136,32 @@ class Message:
 
     # -- encoding ---------------------------------------------------------
     def encode(self) -> bytes:
-        out = bytearray()
+        """Two-pass encode: size everything, preallocate once, write in
+        place.  Naive bytearray appending copies each nested tensor body
+        ~3x (child buffer -> parent growth -> final bytes); at config-3
+        scale (hundreds of MB per push) those copies dominate push/pull
+        latency, so the encoder is exactly-sized instead."""
+        writer = _Writer(self.encoded_size())
+        self.encode_into(writer)
+        return writer.getvalue()
+
+    def encoded_size(self) -> int:
+        return sum(_field_size(f, getattr(self, f.name))
+                   for f in self.FIELDS)
+
+    def encode_into(self, writer: "_Writer") -> None:
         for f in self.FIELDS:
-            value = getattr(self, f.name)
-            _encode_field(out, f, value)
-        return bytes(out)
+            _encode_field(writer, f, getattr(self, f.name))
 
     # -- decoding ---------------------------------------------------------
     @classmethod
     def decode(cls, buf: bytes | memoryview):
         msg = cls()
-        buf = bytes(buf) if isinstance(buf, memoryview) else buf
+        # memoryview input decodes zero-copy; nested messages and bytes
+        # fields become views into the caller's buffer (which they keep
+        # alive), so a 100MB+ gradient push is never re-sliced wholesale
+        if not isinstance(buf, (bytes, memoryview)):
+            buf = bytes(buf)
         by_number = cls._fields_by_number()
         pos = 0
         n = len(buf)
@@ -203,69 +218,151 @@ def _default_for(f: Field) -> Any:
     }.get(f.kind) if f.kind != "message" else None
 
 
-def _encode_field(out: bytearray, f: Field, value: Any) -> None:
+class _Writer:
+    """Preallocated in-place buffer writer (see Message.encode).  Backed
+    by np.empty rather than bytearray(n): bytearray zero-fills its buffer,
+    a full extra memory sweep at 100MB+ message sizes."""
+
+    __slots__ = ("buf", "_view", "pos")
+
+    def __init__(self, size: int):
+        self.buf = np.empty(size, np.uint8)
+        self._view = memoryview(self.buf)
+        self.pos = 0
+
+    def write(self, data) -> None:
+        n = len(data)
+        self._view[self.pos:self.pos + n] = data
+        self.pos += n
+
+    def getvalue(self) -> bytes:
+        return self.buf.tobytes()
+
+
+def _varint_size(value: int) -> int:
+    value &= _U64_MASK
+    n = 1
+    while value >= 0x80:
+        value >>= 7
+        n += 1
+    return n
+
+
+def _len_delimited_size(field_number: int, body_len: int) -> int:
+    return (_varint_size(field_number << 3) + _varint_size(body_len)
+            + body_len)
+
+
+def _field_size(f: Field, value: Any) -> int:
+    """Exact encoded byte count of one field, mirroring _encode_field's
+    branching (incl. proto3 default elision) case for case — the two are
+    kept adjacent and any divergence corrupts the stream (covered by the
+    byte-identity tests vs protoc gencode in tests/test_wire_interop.py)."""
+    kind = f.kind
+    if f.repeated:
+        if kind == "message":
+            return sum(_len_delimited_size(f.number, item.encoded_size())
+                       for item in value)
+        if kind == "float":
+            arr = np.asarray(value, dtype="<f4")
+            if not arr.size:
+                return 0
+            return _len_delimited_size(f.number, 4 * arr.size)
+        if kind in ("int32", "int64", "enum", "bool"):
+            if not value:
+                return 0
+            body = sum(_varint_size(int(item)) for item in value)
+            return _len_delimited_size(f.number, body)
+        if kind == "string":
+            return sum(_len_delimited_size(f.number,
+                                           len(item.encode("utf-8")))
+                       for item in value)
+        raise TypeError(f"unsupported repeated kind {kind}")
+    if kind in ("int32", "int64", "enum"):
+        if not value:
+            return 0
+        return _varint_size(f.number << 3) + _varint_size(int(value))
+    if kind == "bool":
+        return _varint_size(f.number << 3) + 1 if value else 0
+    if kind == "string":
+        if not value:
+            return 0
+        return _len_delimited_size(f.number, len(value.encode("utf-8")))
+    if kind == "bytes":
+        if not value:
+            return 0
+        return _len_delimited_size(f.number, len(value))
+    if kind == "float":
+        if not value:
+            return 0
+        return _varint_size((f.number << 3) | WT_FIXED32) + 4
+    if kind == "message":
+        if value is None:
+            return 0
+        return _len_delimited_size(f.number, value.encoded_size())
+    raise TypeError(f"unsupported kind {kind}")
+
+
+def _encode_field(out: "_Writer", f: Field, value: Any) -> None:
     kind = f.kind
     if f.repeated:
         if kind == "message":
             for item in value:
-                body = item.encode()
-                out += _tag(f.number, WT_LEN)
-                out += encode_varint(len(body))
-                out += body
+                out.write(_tag(f.number, WT_LEN))
+                out.write(encode_varint(item.encoded_size()))
+                item.encode_into(out)
         elif kind == "float":
             arr = np.asarray(value, dtype="<f4")
             if arr.size:
-                body = arr.tobytes()
-                out += _tag(f.number, WT_LEN)
-                out += encode_varint(len(body))
-                out += body
+                out.write(_tag(f.number, WT_LEN))
+                out.write(encode_varint(4 * arr.size))
+                out.write(memoryview(np.ascontiguousarray(arr)).cast("B"))
         elif kind in ("int32", "int64", "enum", "bool"):
             if value:
                 body = bytearray()
                 for item in value:
                     body += encode_varint(int(item))
-                out += _tag(f.number, WT_LEN)
-                out += encode_varint(len(body))
-                out += body
+                out.write(_tag(f.number, WT_LEN))
+                out.write(encode_varint(len(body)))
+                out.write(body)
         elif kind == "string":
             for item in value:
                 data = item.encode("utf-8")
-                out += _tag(f.number, WT_LEN)
-                out += encode_varint(len(data))
-                out += data
+                out.write(_tag(f.number, WT_LEN))
+                out.write(encode_varint(len(data)))
+                out.write(data)
         else:
             raise TypeError(f"unsupported repeated kind {kind}")
         return
 
     if kind in ("int32", "int64", "enum"):
         if value:
-            out += _tag(f.number, WT_VARINT)
-            out += encode_varint(int(value))
+            out.write(_tag(f.number, WT_VARINT))
+            out.write(encode_varint(int(value)))
     elif kind == "bool":
         if value:
-            out += _tag(f.number, WT_VARINT)
-            out += b"\x01"
+            out.write(_tag(f.number, WT_VARINT))
+            out.write(b"\x01")
     elif kind == "string":
         if value:
             data = value.encode("utf-8")
-            out += _tag(f.number, WT_LEN)
-            out += encode_varint(len(data))
-            out += data
+            out.write(_tag(f.number, WT_LEN))
+            out.write(encode_varint(len(data)))
+            out.write(data)
     elif kind == "bytes":
         if value:
-            out += _tag(f.number, WT_LEN)
-            out += encode_varint(len(value))
-            out += value
+            out.write(_tag(f.number, WT_LEN))
+            out.write(encode_varint(len(value)))
+            out.write(value)
     elif kind == "float":
         if value:
-            out += _tag(f.number, WT_FIXED32)
-            out += struct.pack("<f", value)
+            out.write(_tag(f.number, WT_FIXED32))
+            out.write(struct.pack("<f", value))
     elif kind == "message":
         if value is not None:
-            body = value.encode()
-            out += _tag(f.number, WT_LEN)
-            out += encode_varint(len(body))
-            out += body
+            out.write(_tag(f.number, WT_LEN))
+            out.write(encode_varint(value.encoded_size()))
+            value.encode_into(out)
     else:
         raise TypeError(f"unsupported kind {kind}")
 
@@ -278,7 +375,8 @@ def _decode_field(msg: Message, buf: bytes, pos: int, f: Field, wire_type: int) 
                 raise ValueError(f"field {f.name}: bad wire type {wire_type}")
             length, pos = decode_varint(buf, pos)
             end = pos + length
-            getattr(msg, f.name).append(f.message_type.decode(buf[pos:end]))
+            getattr(msg, f.name).append(
+                f.message_type.decode(memoryview(buf)[pos:end]))
             return end
         if kind == "float":
             if wire_type == WT_LEN:  # packed
@@ -313,7 +411,7 @@ def _decode_field(msg: Message, buf: bytes, pos: int, f: Field, wire_type: int) 
         if kind == "string":
             length, pos = decode_varint(buf, pos)
             end = pos + length
-            getattr(msg, f.name).append(buf[pos:end].decode("utf-8"))
+            getattr(msg, f.name).append(str(buf[pos:end], "utf-8"))
             return end
         raise TypeError(f"unsupported repeated kind {kind}")
 
@@ -328,7 +426,7 @@ def _decode_field(msg: Message, buf: bytes, pos: int, f: Field, wire_type: int) 
     if kind == "string":
         length, pos = decode_varint(buf, pos)
         end = pos + length
-        setattr(msg, f.name, buf[pos:end].decode("utf-8"))
+        setattr(msg, f.name, str(buf[pos:end], "utf-8"))
         return end
     if kind == "bytes":
         length, pos = decode_varint(buf, pos)
@@ -341,7 +439,8 @@ def _decode_field(msg: Message, buf: bytes, pos: int, f: Field, wire_type: int) 
     if kind == "message":
         length, pos = decode_varint(buf, pos)
         end = pos + length
-        setattr(msg, f.name, f.message_type.decode(buf[pos:end]))
+        setattr(msg, f.name,
+                f.message_type.decode(memoryview(buf)[pos:end]))
         return end
     raise TypeError(f"unsupported kind {kind}")
 

@@ -128,7 +128,7 @@ def main(argv: list[str] | None = None) -> int:
                              "supports greedy (default) or plain "
                              "--temperature sampling; it does not combine "
                              "with --beam/--top-k/--top-p")
-        from ..models.generation import speculative_generate
+        from ..models.generation import speculative_generate_batched
         draft, _ = get_model_and_batches(draft_name, 1,
                                          dtype=flags.get("dtype", ""))
         if not isinstance(draft, Transformer):
@@ -138,7 +138,10 @@ def main(argv: list[str] | None = None) -> int:
             int(flags.get("draft-seed", seed + 1)))
         dparams = match_layout(draft, dparams)
         print(f"draft params: {dsource}", file=sys.stderr)
-        out, stats = speculative_generate(
+        # whole-loop-on-device batched decoder (accept/resample jitted,
+        # per-row ragged caches) — the serving path; the host-loop
+        # speculative_generate stays as the tested reference
+        out, stats = speculative_generate_batched(
             model, params, draft, dparams, prompt, max_new,
             draft_len=int(flags.get("draft-len", 4)),
             temperature=temperature, seed=seed)

@@ -84,23 +84,40 @@ def prefill(model: Transformer, params: Mapping[str, Array], tokens: Array,
 
 
 def decode_block(model: Transformer, params: Mapping[str, Array],
-                 tokens: Array, cache: KVCache) -> tuple[Array, KVCache]:
+                 tokens: Array, cache: KVCache,
+                 lengths: Array | None = None) -> tuple[Array, KVCache]:
     """Forward a block of ``tokens`` [B, T] against the cache at positions
     length..length+T-1, causally masked within the block — the verify
     step of speculative decoding (T=1 is ordinary single-token decode).
     Returns (logits [B, T, vocab] f32, cache with length advanced by T;
     rolling ``length`` back later simply re-exposes old positions — stale
     K/V beyond length are masked out and overwritten on the next write).
+
+    ``lengths`` [B] switches to RAGGED mode: row b's block writes at its
+    own positions lengths[b]..lengths[b]+T-1 (per-row scatter instead of
+    one dynamic_update_slice) and attends within its own valid prefix.
+    cache.length is then ignored and returned unchanged — callers track
+    the per-row lengths.  This is what batched speculative decoding needs:
+    rows accept different numbers of draft tokens, so their caches advance
+    at different rates (models/generation.speculative_generate_batched).
     """
     c = model.config
     batch, t = tokens.shape
-    pos = cache.length                                   # scalar int32
-    h = jnp.take(params["embed/tok"], tokens, axis=0)    # [B, T, d]
+    ragged = lengths is not None
     offsets = jnp.arange(t, dtype=jnp.int32)
-    positions = pos + offsets[None, :].repeat(batch, 0)  # [B, T]
-    # query j may attend cache positions 0..pos+j
-    mask = (jnp.arange(cache.max_len)[None, :]
-            <= (pos + offsets)[:, None])[None, None, None]  # [1,1,1,T,M]
+    if ragged:
+        positions = lengths[:, None] + offsets[None, :]      # [B, T]
+        # row b's query j may attend its cache positions 0..lengths[b]+j
+        mask = (jnp.arange(cache.max_len)[None, None, :]
+                <= positions[:, :, None])[:, None, None]     # [B,1,1,T,M]
+        bidx = jnp.arange(batch, dtype=jnp.int32)[:, None]
+    else:
+        pos = cache.length                                   # scalar int32
+        positions = pos + offsets[None, :].repeat(batch, 0)  # [B, T]
+        # query j may attend cache positions 0..pos+j
+        mask = (jnp.arange(cache.max_len)[None, :]
+                <= (pos + offsets)[:, None])[None, None, None]  # [1,1,1,T,M]
+    h = jnp.take(params["embed/tok"], tokens, axis=0)        # [B, T, d]
     new_k, new_v = cache.k, cache.v
     groups = c.kv_groups
     for i in range(c.n_layers):
@@ -108,10 +125,14 @@ def decode_block(model: Transformer, params: Mapping[str, Array],
         # scan_layers' stacked blocks/*)
         lp, p = model.layer_view(params, i)
         q, k, v = model.qkv(lp, p, h, positions)  # k/v: [B, T, KV, D]
-        new_k = jax.lax.dynamic_update_slice(
-            new_k, k[None].astype(new_k.dtype), (i, 0, pos, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(
-            new_v, v[None].astype(new_v.dtype), (i, 0, pos, 0, 0))
+        if ragged:
+            new_k = new_k.at[i, bidx, positions].set(k.astype(new_k.dtype))
+            new_v = new_v.at[i, bidx, positions].set(v.astype(new_v.dtype))
+        else:
+            new_k = jax.lax.dynamic_update_slice(
+                new_k, k[None].astype(new_k.dtype), (i, 0, pos, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(
+                new_v, v[None].astype(new_v.dtype), (i, 0, pos, 0, 0))
         # dense attention against the cache, f32 softmax.  GQA: contract
         # query-head groups directly against the UNexpanded cache — the
         # cache bytes streamed per step stay kv_heads-sized (the point of
@@ -130,7 +151,8 @@ def decode_block(model: Transformer, params: Mapping[str, Array],
         # MoE-aware, drop-free at decode time; aux loss unused here
         h, _ = model.ffn_residual(params, i, h, decode=True)
     logits = model.final_logits(params, h)
-    return logits, KVCache(k=new_k, v=new_v, length=pos + t)
+    new_length = cache.length if ragged else pos + t
+    return logits, KVCache(k=new_k, v=new_v, length=new_length)
 
 
 def decode_step(model: Transformer, params: Mapping[str, Array],
@@ -512,6 +534,199 @@ def speculative_generate(target: Transformer, target_params,
              "tokens_per_target_forward": (tokens.shape[1]
                                            / (verify_calls + 1))}
     return tokens, stats
+
+
+def _spec_batched_runner(target: Transformer, draft: Transformer,
+                         max_new_tokens: int, draft_len: int,
+                         temperature: float):
+    """Compiled whole-loop batched speculative decoder (see
+    :func:`speculative_generate_batched`).  One jit: prefill both models,
+    then a lax.while_loop whose body is draft-propose -> verify ->
+    vectorized accept/resample — no host round-trips inside the loop."""
+    key_tuple = (_model_key(target), _model_key(draft), "spec_batched",
+                 max_new_tokens, draft_len, temperature)
+    k_draft = draft_len
+    sampling = temperature > 0.0
+
+    def build():
+        @jax.jit
+        def run(tparams, dparams, prompt, rng_key):
+            batch, s = prompt.shape
+            cap = max_new_tokens + k_draft + 1
+            max_len = s + cap + k_draft + 2
+            bidx = jnp.arange(batch, dtype=jnp.int32)[:, None]
+            iota_k1 = jnp.arange(k_draft + 1, dtype=jnp.int32)
+
+            t_logits, t_cache = prefill(target, tparams, prompt, max_len)
+            _, d_cache = prefill(draft, dparams, prompt, max_len)
+
+            def sample(logits, key):
+                if not sampling:
+                    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return jax.random.categorical(
+                    key, logits / temperature, axis=-1).astype(jnp.int32)
+
+            rng_key, k0 = jax.random.split(rng_key)
+            cur = sample(t_logits, k0)                       # [B]
+            out = jnp.zeros((batch, cap), jnp.int32)
+            out = out.at[:, 0].set(cur)
+            n_out = jnp.ones((batch,), jnp.int32)
+            lt = jnp.full((batch,), s, jnp.int32)   # next target write pos
+            pc = jnp.full((batch,), s, jnp.int32)   # draft position of cur
+            y = prompt[:, -1]                       # token cached at pc-1
+            stats0 = jnp.zeros((3,), jnp.int32)  # verifies, accepts, rows
+
+            def cond(carry):
+                return jnp.any(carry[0] < max_new_tokens)
+
+            def body(carry):
+                (n_out, out, cur, y, lt, pc, t_cache, d_cache, rng_key,
+                 stats) = carry
+                active = n_out < max_new_tokens
+
+                # --- draft: catch-up block [y, cur] (re-writing y's slot
+                # with identical K/V is a no-op; writing it fresh is the
+                # full-accept catch-up), then k-1 single steps.  Produces
+                # proposals p_1..p_k and their distributions.
+                dl, d_cache = decode_block(
+                    draft, dparams, jnp.stack([y, cur], axis=1), d_cache,
+                    lengths=pc - 1)
+                q_logits = dl[:, 1]
+                proposals = []
+                q_rows = []
+                rng_key, *keys = jax.random.split(rng_key, k_draft + 3)
+                for i in range(k_draft):
+                    tok = sample(q_logits, keys[i])
+                    proposals.append(tok)
+                    if sampling:
+                        q_rows.append(jax.nn.softmax(
+                            q_logits / temperature, axis=-1))
+                    if i < k_draft - 1:
+                        dl, d_cache = decode_block(
+                            draft, dparams, tok[:, None], d_cache,
+                            lengths=pc + 1 + i)
+                        q_logits = dl[:, 0]
+                props = jnp.stack(proposals, axis=1)         # [B, k]
+
+                # --- target verifies [cur, p_1..p_k] in one forward
+                block = jnp.concatenate([cur[:, None], props], axis=1)
+                vlogits, t_cache = decode_block(target, tparams, block,
+                                                t_cache, lengths=lt)
+
+                # --- vectorized acceptance
+                if sampling:
+                    probs_t = jax.nn.softmax(vlogits / temperature, axis=-1)
+                    probs_q = jnp.stack(q_rows, axis=1)      # [B, k, V]
+                    px = jnp.take_along_axis(
+                        probs_t[:, :k_draft], props[..., None], 2)[..., 0]
+                    qx = jnp.take_along_axis(
+                        probs_q, props[..., None], 2)[..., 0]
+                    u = jax.random.uniform(keys[k_draft], px.shape)
+                    acc = u < px / jnp.maximum(qx, 1e-20)
+                    m = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), 1), 1)
+                    # resample from the residual at the reject position
+                    # (clamped gather; overridden by the bonus when m == k)
+                    gather_m = jnp.clip(m, 0, k_draft - 1)[:, None, None]
+                    p_m = jnp.take_along_axis(probs_t[:, :k_draft],
+                                              gather_m, 1)[:, 0]
+                    q_m = jnp.take_along_axis(probs_q, gather_m, 1)[:, 0]
+                    residual = jnp.maximum(p_m - q_m, 0.0)
+                    total = jnp.sum(residual, -1, keepdims=True)
+                    residual = jnp.where(total > 0, residual, p_m)
+                    rng_key, kr, kb = jax.random.split(rng_key, 3)
+                    resampled = jax.random.categorical(
+                        kr, jnp.log(residual + 1e-30), axis=-1)
+                    bonus = jax.random.categorical(
+                        kb, jnp.log(probs_t[:, k_draft] + 1e-30), axis=-1)
+                    corr = jnp.where(m == k_draft, bonus,
+                                     resampled).astype(jnp.int32)
+                else:
+                    g = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+                    match = (props == g[:, :k_draft]).astype(jnp.int32)
+                    m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
+                    corr = jnp.take_along_axis(g, m[:, None], 1)[:, 0]
+
+                # --- commit p_1..p_m then the correction/bonus token
+                ext = jnp.concatenate([props, jnp.zeros((batch, 1),
+                                                        jnp.int32)], 1)
+                commit = jnp.where(iota_k1[None, :] < m[:, None], ext,
+                                   corr[:, None])            # [B, k+1]
+                n_commit = m + 1
+                idx = jnp.clip(n_out[:, None] + iota_k1[None, :], 0,
+                               cap - 1)
+                # garbage lanes (i >= n_commit) land ahead of the valid
+                # frontier and are overwritten by later rounds' valid
+                # writes; done rows clip into the slack region >= max_new
+                out = out.at[bidx, idx].set(commit)
+                prev = jnp.take_along_axis(
+                    props, jnp.clip(m - 1, 0, k_draft - 1)[:, None],
+                    1)[:, 0]
+                y_new = jnp.where(m == 0, cur, prev)
+                stats = stats + jnp.stack(
+                    [jnp.ones((), jnp.int32),
+                     jnp.sum(jnp.where(active, m, 0)),
+                     jnp.sum(active.astype(jnp.int32))])
+                return (n_out + n_commit, out, corr, y_new, lt + n_commit,
+                        pc + n_commit, t_cache, d_cache, rng_key, stats)
+
+            carry = (n_out, out, cur, y, lt, pc, t_cache, d_cache,
+                     rng_key, stats0)
+            (n_out, out, *_rest, stats) = jax.lax.while_loop(
+                cond, body, carry)
+            return out[:, :max_new_tokens], stats
+
+        return run
+
+    return _cached_runner(key_tuple, build)
+
+
+def speculative_generate_batched(
+        target: Transformer, target_params, draft: Transformer,
+        draft_params, prompt: Array, max_new_tokens: int, *,
+        draft_len: int = 4, temperature: float = 0.0,
+        seed: int = 0) -> tuple[Array, dict]:
+    """Batched speculative decoding with the WHOLE loop on device.
+
+    Unlike :func:`speculative_generate` (batch-1, host accept loop — kept
+    as the readable reference implementation its tests cross-check), this
+    runs prefill + a ``lax.while_loop`` of draft-propose / verify /
+    vectorized accept-or-resample inside one jit: no per-token host
+    round-trips, so decode throughput is device-bound — the serving path.
+
+    Batch > 1 works because rows accept DIFFERENT numbers of draft tokens
+    per round: each row's KV caches advance at their own rate via ragged
+    ``decode_block`` (per-row lengths), committed tokens scatter into a
+    per-row output frontier, and rows that reach ``max_new_tokens`` keep
+    verifying into slack slots until the slowest row finishes (their
+    stats are masked out).
+
+    ``temperature=0`` is greedy and token-exact vs target-alone greedy
+    decoding (tested per row); ``temperature>0`` applies the
+    Leviathan/Chen rejection rule vectorized on device, preserving the
+    target's sampling distribution exactly (tested empirically).
+
+    Returns (tokens [B, max_new_tokens], stats).
+    """
+    if target.config.vocab != draft.config.vocab:
+        raise ValueError(
+            f"vocab mismatch: target {target.config.vocab} vs draft "
+            f"{draft.config.vocab}")
+    if draft_len < 1:
+        raise ValueError("draft_len must be >= 1")
+    run = _spec_batched_runner(target, draft, max_new_tokens, draft_len,
+                               float(temperature))
+    tokens, stats = run(target_params, draft_params,
+                        jnp.asarray(prompt, jnp.int32),
+                        jax.random.key(seed))
+    verifies, accepted, active_rows = (int(x) for x in np.asarray(stats))
+    total = prompt.shape[0] * max_new_tokens
+    return np.asarray(tokens), {
+        "verify_calls": verifies,
+        "draft_accept_rate": accepted / max(1, active_rows * draft_len),
+        # +1: the prefill forward produced each row's first token
+        "tokens_per_target_forward": total / max(
+            1, prompt.shape[0] * (verifies + 1)),
+    }
 
 
 def generate(model: Transformer, params: Mapping[str, Array],

@@ -355,18 +355,25 @@ class Transformer:
     def num_params(self) -> int:
         return sum(math.prod(s) for s in self.param_shapes().values())
 
-    def flops_per_sample(self) -> float | None:
+    def flops_per_sample(self, remat_credited: bool = False) -> float | None:
         """Training (fwd+bwd) FLOPs for one max_seq-length sample:
         6*P per token for the parameter matmuls plus 12*L*d_model*S per
         token for the attention score/value matmuls (PaLM-appendix
         convention, full-S accounting).  None for MoE configs, where 6*P
-        overcounts inactive experts."""
+        overcounts inactive experts.
+
+        ``remat_credited=True`` counts the extra forward the hardware
+        actually executes under ``config.remat`` (+2*P and +4*L*d*S per
+        token): hardware-utilization accounting for rematerialized runs.
+        Callers reporting MFU from it must label the number as
+        remat-credited (bench.py does)."""
         c = self.config
         if c.moe_every > 0:
             return None
         seq = c.max_seq
-        return (6.0 * self.num_params() * seq
-                + 12.0 * c.n_layers * c.d_model * seq * seq)
+        params_mult, attn_mult = (8.0, 16.0) if remat_credited else (6.0, 12.0)
+        return (params_mult * self.num_params() * seq
+                + attn_mult * c.n_layers * c.d_model * seq * seq)
 
     def init_params(self, rng: jax.Array | int = 0) -> dict[str, Array]:
         c = self.config

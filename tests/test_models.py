@@ -431,3 +431,27 @@ def test_registry_seq_override():
     assert batch.shape == (2, 512)
     with pytest.raises(ValueError, match="sequence length"):
         get_model_and_batches("mnist_mlp", 2, seq_len=512)
+
+
+def test_flops_per_sample_accounting():
+    """PaLM-convention FLOPs: 6P + 12*L*d*S per token; remat-credited adds
+    the recompute forward (8P + 16*L*d*S).  MoE returns None (6P would
+    overcount inactive experts)."""
+    import dataclasses
+
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    config = TransformerConfig(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                               d_ff=128, max_seq=32, dtype=jnp.float32)
+    model = Transformer(config)
+    base = model.flops_per_sample()
+    seq = config.max_seq
+    assert base == (6.0 * model.num_params() * seq
+                    + 12.0 * config.n_layers * config.d_model * seq * seq)
+    credited = model.flops_per_sample(remat_credited=True)
+    assert credited == (8.0 * model.num_params() * seq
+                        + 16.0 * config.n_layers * config.d_model * seq * seq)
+    moe = Transformer(dataclasses.replace(config, moe_every=2,
+                                          moe_experts=4))
+    assert moe.flops_per_sample() is None

@@ -133,16 +133,18 @@ def flash_attention_auto(q: Array, k: Array, v: Array) -> Array:
     """Causal attention that uses the pallas flash kernels
     (ops/pallas/flash_attention.py — blockwise fwd+bwd, O(S) residual
     memory) when the sequence is block-divisible, falling back to the dense
-    einsum otherwise.  On non-TPU backends the kernels run in interpret
-    mode, so this is only worth selecting on TPU; pass it explicitly as
+    einsum otherwise.  GQA K/V stay UNexpanded: the grouped-query kernel
+    folds query groups into the block batch, so K/V HBM stays
+    kv_heads-sized end to end (fwd blocks and dK/dV alike).  On non-TPU
+    backends the kernels run in interpret mode, so this is only worth
+    selecting on TPU; pass it explicitly as
     ``Transformer(config, attention_fn=flash_attention_auto)`` or set
     ``PSDT_FLASH_ATTENTION=1`` to make it the model default."""
-    from ..ops.pallas.flash_attention import flash_attention
+    from ..ops.pallas.flash_attention import flash_attention_gqa
 
-    k, v = expand_gqa(q, k, v)
     seq = q.shape[1]
     if seq % 128 == 0:
-        return flash_attention(q, k, v, block_q=128, block_k=128)
+        return flash_attention_gqa(q, k, v, block_q=128, block_k=128)
     return causal_attention(q, k, v)
 
 
@@ -720,16 +722,31 @@ def small_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
         max_seq=seq, dtype=dtype, remat=remat, scan_layers=scan_layers))
 
 
+def tiny_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
+            remat: bool = False, scan_layers: bool = False) -> Transformer:
+    """1-layer draft-scale LM (same default vocab as small_lm, so the pair
+    works as a speculative-decoding target/draft out of the box)."""
+    return Transformer(TransformerConfig(
+        vocab=vocab, d_model=64, n_heads=2, n_layers=1, d_ff=256,
+        max_seq=seq, dtype=dtype, remat=remat, scan_layers=scan_layers))
+
+
 def lm_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
-            remat: bool = True, scan_layers: bool = False) -> Transformer:
+            remat: bool = True, scan_layers: bool = False,
+            kv_heads: int = 0) -> Transformer:
     """~370M-param GPT-style flagship for the LM MFU benchmark: 24 layers,
     d_model 1024, seq 1024, bf16 weights/activations with f32 MXU
     accumulation, per-layer remat by default (activation memory, not HBM
     capacity, should bound the batch), chunked cross-entropy (peak f32
     logits ~1 GB -> ~32 MB at batch 8).  ``scan_layers`` stores blocks
-    stacked and scans the layer loop — depth-independent compile time."""
+    stacked and scans the layer loop — depth-independent compile time.
+    ``kv_heads`` in {1, 2, 4, 8} switches to GQA (0, the default, keeps
+    all 16; the `lm_350m_gqa` registry entry uses 4): kv_heads/16 the
+    KV-cache HBM and ring/Ulysses ICI bytes, and the GQA-folded flash
+    kernel keeps K/V unexpanded end to end."""
     return Transformer(TransformerConfig(
         vocab=vocab, d_model=1024, n_heads=16, n_layers=24, d_ff=4096,
+        n_kv_heads=kv_heads,
         max_seq=seq, dtype=dtype, remat=remat, scan_layers=scan_layers,
         # largest chunk <= 128 dividing seq, so every seq stays valid
         loss_chunk=math.gcd(128, seq)))

@@ -45,25 +45,38 @@ def _iota_pos(start, rows: int, cols: int, axis: int):
     return start + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), axis)
 
 
-def _kv_frontier_spec(block: int, block_q: int, block_k: int, d: int):
+def _kv_frontier_spec(block: int, block_q: int, block_k: int, d: int,
+                      bps: int):
     """BlockSpec for a K/V operand streamed over inner grid dim j, with the
     block index clamped to the causal frontier of q block i: steps past the
     frontier revisit the resident block (no DMA) and `pl.when` skips their
-    compute."""
+    compute.
+
+    ``bps`` = q blocks per sequence SEGMENT: under the GQA fold
+    (:func:`flash_attention_gqa`) the q-rows axis is G segments of S rows
+    sharing one K/V sequence, so the frontier depends on i's position
+    WITHIN its segment (i % bps), not on i itself.  bps == total q blocks
+    reduces to the plain single-segment layout."""
     def clamp(i, j):
-        return jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
+        i_pos = jax.lax.rem(i, bps)
+        return jnp.minimum(j, ((i_pos + 1) * block_q - 1) // block_k)
 
     return pl.BlockSpec((1, block, d), lambda b, i, j: (b, clamp(i, j), 0))
 
 
-def _q_frontier_spec(block: int, block_q: int, block_k: int,
-                     d: int | None = None):
+def _q_frontier_spec(block: int, block_q: int, block_k: int, *,
+                     bps: int, d: int | None = None):
     """BlockSpec for a Q/dO operand streamed over inner grid dim j in the
     dK/dV kernel: indices before this k block's first attending q block are
-    clamped up to it.  d=None selects the lane-major per-row layout
+    clamped up to it — per SEGMENT under the GQA fold (the clamp floor
+    repeats every ``bps`` q blocks, so within-segment pre-frontier steps
+    revisit the resident block while segment boundaries restart the
+    stream).  d=None selects the lane-major per-row layout
     (lse: (BH, 1, S) blocked (1, 1, block), see _flash_fwd)."""
     def clamp(i, j):
-        return jnp.maximum(j, (i * block_k) // block_q)
+        j_seg = jax.lax.rem(j, bps)
+        seg = j // bps
+        return seg * bps + jnp.maximum(j_seg, (i * block_k) // block_q)
 
     if d is None:
         return pl.BlockSpec((1, 1, block), lambda b, i, j: (b, 0, clamp(i, j)))
@@ -71,9 +84,12 @@ def _q_frontier_spec(block: int, block_q: int, block_k: int,
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                      l_ref, *, block_q: int, block_k: int, scale: float):
+                      l_ref, *, block_q: int, block_k: int, scale: float,
+                      bps: int):
     qi, kj = pl.program_id(1), pl.program_id(2)
-    q_start, k_start = qi * block_q, kj * block_k
+    # q position is segment-relative: under the GQA fold the q-rows axis
+    # is G segments of S rows sharing one K/V sequence (bps blocks each)
+    q_start, k_start = jax.lax.rem(qi, bps) * block_q, kj * block_k
 
     @pl.when(kj == 0)
     def _init():
@@ -108,7 +124,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, block_q: int,
-               block_k: int, interpret: bool) -> tuple[jax.Array, jax.Array]:
+               block_k: int, interpret: bool,
+               bps: int = 0) -> tuple[jax.Array, jax.Array]:
     """q,k,v: [BH, S, D] -> (o [BH, S, D], lse [BH, 1, S]).
 
     lse layout: one logsumexp per q row, stored LANE-major as (BH, 1, S)
@@ -119,17 +136,19 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, block_q: int,
     (block_q, 1)->(1, block_q) transpose per q-block finalize and pads
     only sublanes (1->8)."""
     bh, s, d = q.shape
+    sk = k.shape[1]          # K/V sequence (= s unless GQA-folded)
+    bps = bps or s // block_q
     scale = 1.0 / math.sqrt(d)
     kernel = functools.partial(_flash_fwd_kernel, block_q=block_q,
-                               block_k=block_k, scale=scale)
+                               block_k=block_k, scale=scale, bps=bps)
     qblk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     qrow = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
-    kblk = _kv_frontier_spec(block_k, block_q, block_k, d)
+    kblk = _kv_frontier_spec(block_k, block_q, block_k, d, bps)
     o, lse = pl.pallas_call(
         kernel,
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype),      # o
                    jax.ShapeDtypeStruct((bh, 1, s), jnp.float32)],  # lse
-        grid=(bh, s // block_q, s // block_k),
+        grid=(bh, s // block_q, sk // block_k),
         in_specs=[qblk, kblk, kblk],
         out_specs=[qblk, qrow],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),   # acc
@@ -142,7 +161,7 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, block_q: int,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
                          dq_ref, acc_ref, delta_ref, *, block_q: int,
-                         block_k: int, scale: float):
+                         block_k: int, scale: float, bps: int):
     """dQ for one q block, K/V streaming over the inner grid dimension.
     ds = p * (dp - delta); dq = scale * ds @ K.  Accumulates in f32 VMEM
     scratch and writes the (possibly bf16) output once at stream end —
@@ -150,7 +169,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
     D=64).  delta (softmax-jacobian row correction sum_d g*o) is computed
     here from the resident o/g blocks rather than materialized in HBM."""
     qi, kj = pl.program_id(1), pl.program_id(2)
-    q_start, k_start = qi * block_q, kj * block_k
+    q_start, k_start = jax.lax.rem(qi, bps) * block_q, kj * block_k
 
     @pl.when(kj == 0)
     def _init():
@@ -182,14 +201,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
-                          block_k: int, scale: float):
+                          block_k: int, scale: float, bps: int):
     """dK/dV for one k block, Q/dO streaming over the inner grid dimension.
     dv = p^T @ dO; dk = scale * ds^T @ Q.  Same scratch-accumulate /
     write-once layout as the dQ kernel; delta is recomputed per streamed
     q block (one [block_q, D] elementwise reduce — cheap next to the four
     matmuls)."""
     ki, qj = pl.program_id(1), pl.program_id(2)
-    k_start, q_start = ki * block_k, qj * block_q
+    k_start = ki * block_k
+    q_start = jax.lax.rem(qj, bps) * block_q
 
     @pl.when(qj == 0)
     def _init():
@@ -222,19 +242,21 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, g, block_q: int, block_k: int,
-               interpret: bool):
+               interpret: bool, bps: int = 0):
     bh, s, d = q.shape
+    sk = k.shape[1]          # K/V sequence (= s unless GQA-folded)
+    bps = bps or s // block_q
     scale = 1.0 / math.sqrt(d)
 
     qblk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     qrow = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
-    kblk = _kv_frontier_spec(block_k, block_q, block_k, d)
+    kblk = _kv_frontier_spec(block_k, block_q, block_k, d, bps)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
-                          block_k=block_k, scale=scale),
+                          block_k=block_k, scale=scale, bps=bps),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        grid=(bh, s // block_q, s // block_k),
+        grid=(bh, s // block_q, sk // block_k),
         in_specs=[qblk, kblk, kblk, qblk, qblk, qrow],
         out_specs=qblk,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
@@ -242,16 +264,18 @@ def _flash_bwd(q, k, v, o, lse, g, block_q: int, block_k: int,
         interpret=interpret,
     )(q, k, v, o, g, lse)
 
-    # streaming roles swap: k blocks are the outer (revisited) dimension
+    # streaming roles swap: k blocks are the outer (revisited) dimension;
+    # under the GQA fold every k block streams ALL G segments' q blocks,
+    # so dK/dV come back kv_heads-sized with the group sum built in
     kout = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
-    qstream = _q_frontier_spec(block_q, block_q, block_k, d)
-    qstream_row = _q_frontier_spec(block_q, block_q, block_k)
+    qstream = _q_frontier_spec(block_q, block_q, block_k, bps=bps, d=d)
+    qstream_row = _q_frontier_spec(block_q, block_q, block_k, bps=bps)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          block_k=block_k, scale=scale),
-        out_shape=[jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
-        grid=(bh, s // block_k, s // block_q),
+                          block_k=block_k, scale=scale, bps=bps),
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        grid=(bh, sk // block_k, s // block_q),
         in_specs=[qstream, kout, kout, qstream, qstream, qstream_row],
         out_specs=[kout, kout],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
@@ -261,20 +285,20 @@ def _flash_bwd(q, k, v, o, lse, g, block_q: int, block_k: int,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, block_q, block_k, interpret, bps=0):
+    o, _ = _flash_fwd(q, k, v, block_q, block_k, interpret, bps)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, block_q, block_k, interpret)
+def _flash_vjp_fwd(q, k, v, block_q, block_k, interpret, bps=0):
+    o, lse = _flash_fwd(q, k, v, block_q, block_k, interpret, bps)
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(block_q, block_k, interpret, residuals, g):
+def _flash_vjp_bwd(block_q, block_k, interpret, bps, residuals, g):
     q, k, v, o, lse = residuals
-    return _flash_bwd(q, k, v, o, lse, g, block_q, block_k, interpret)
+    return _flash_bwd(q, k, v, o, lse, g, block_q, block_k, interpret, bps)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -299,3 +323,46 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     out = _flash(fold(q), fold(k), fold(v), block_q, block_k, interpret)
     return jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
+
+
+def flash_attention_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
+                        block_q: int = 128, block_k: int = 128,
+                        interpret: bool | None = None) -> jax.Array:
+    """Causal flash attention with UNexpanded GQA K/V: q [B, S, H, D],
+    k/v [B, S, KV, D] -> [B, S, H, D].
+
+    Instead of repeating K/V up to H heads (G x the HBM capacity and
+    expand-materialization traffic of :func:`flash_attention` after
+    expand_gqa), the G query heads of each kv head fold into the q-rows
+    axis: q becomes [B*KV, G*S, D] against k/v [B*KV, S, D].  The kernels
+    treat the folded axis as G causal SEGMENTS sharing one K/V sequence
+    (segment-relative positions + frontier clamps, ``bps`` = blocks per
+    segment), and the dK/dV kernel streams all G segments' q blocks per k
+    block — so dK/dV come back kv_heads-sized with the group reduction
+    built in, never materializing H-sized K/V gradients."""
+    b, s, h, d = q.shape
+    kv = k.shape[2]
+    if h % kv:
+        raise ValueError(f"query heads {h} must divide by kv heads {kv}")
+    groups = h // kv
+    if groups == 1:
+        return flash_attention(q, k, v, block_q, block_k, interpret)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} must divide by blocks "
+                         f"({block_q}, {block_k})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # head h = kv_head * G + group (repeat_kv convention)
+    qf = jnp.transpose(q.reshape(b, s, kv, groups, d),
+                       (0, 2, 3, 1, 4)).reshape(b * kv, groups * s, d)
+
+    def fold_kv(x):  # [B,S,KV,D] -> [B*KV, S, D]
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * kv, s, d)
+
+    out = _flash(qf, fold_kv(k), fold_kv(v), block_q, block_k, interpret,
+                 s // block_q)
+    out = out.reshape(b, kv, groups, s, d)
+    return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, s, h, d)

@@ -38,11 +38,25 @@ run dense_remat_b32        PSDT_BENCH_BATCH=32
 run dense_noremat_b32      PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT=0
 run dense_scan_remat_b32   PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
 run dense_scan_noremat_b32 PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_REMAT=0
+# batch scaling at remat (compute-vs-HBM bound diagnosis) + the
+# remat-credited hardware-utilization view of the same config
+run dense_remat_b64        PSDT_BENCH_BATCH=64
+run dense_remat_b32_credit PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT_CREDIT=1
 # flash at seq 1024 (expected slower than dense here; recorded for the
 # crossover curve)
 run flash_remat_b32        PSDT_BENCH_BATCH=32 PSDT_BENCH_ATTENTION=flash
-# long context: flash + remat is the memory-viable config
+# long context: flash + remat is the memory-viable config; the crossover
+# curve needs both kernels at 4096 and 8192
 run flash_seq4096_b8       PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=flash
 run dense_seq4096_b8       PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096
+run flash_seq8192_b4       PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192 PSDT_BENCH_ATTENTION=flash
+run dense_seq8192_b4       PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192
+# GQA flagship (kv_heads=4): unexpanded-K/V flash fold vs dense at long
+# context — the KV-cache/ICI-frugal long-context config
+run gqa_flash_seq4096_b8   PSDT_BENCH_MODEL=lm_350m_gqa PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=flash
+run gqa_dense_seq4096_b8   PSDT_BENCH_MODEL=lm_350m_gqa PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096
+# speculative decode serving row: perfect-draft upper bound + realistic
+run spec_perfect_draft     PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=self PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
+run spec_tiny_draft        PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 
 echo "sweep done -> $RESULTS" | tee -a "$LOG"

@@ -218,3 +218,61 @@ def test_flash_backward_bf16(rng):
         assert a.dtype == jnp.bfloat16
         np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b_),
                                    rtol=0.1, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# GQA-folded flash: unexpanded K/V, group segments in the q-rows axis
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv,groups,block", [(2, 4, 64), (1, 4, 32),
+                                             (4, 2, 64)])
+def test_flash_gqa_matches_dense(rng, kv, groups, block):
+    from parameter_server_distributed_tpu.ops.pallas.flash_attention import (
+        flash_attention_gqa)
+
+    b, s, d = 2, 128, 16
+    q = jnp.asarray(rng.standard_normal((b, s, kv * groups, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, kv, d)), jnp.float32)
+    dense = np.asarray(causal_attention(q, k, v))  # expands GQA itself
+    got = np.asarray(flash_attention_gqa(q, k, v, block_q=block,
+                                         block_k=block))
+    np.testing.assert_allclose(got, dense, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_gqa_gradients_match_dense_and_stay_kv_sized(rng):
+    """dK/dV must come back [B, S, KV, D] (the group reduction happens in
+    the kernel's k-block stream, never materializing H-sized grads) and
+    equal the dense GQA gradients."""
+    from parameter_server_distributed_tpu.ops.pallas.flash_attention import (
+        flash_attention_gqa)
+
+    b, s, kv, groups, d = 1, 128, 2, 3, 8
+    q = jnp.asarray(rng.standard_normal((b, s, kv * groups, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, kv, d)), jnp.float32)
+
+    def loss_gqa(q, k, v):
+        return jnp.sum(
+            flash_attention_gqa(q, k, v, block_q=32, block_k=32) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    gf = jax.grad(loss_gqa, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    assert gf[1].shape == (b, s, kv, d)
+    assert gf[2].shape == (b, s, kv, d)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_flash_gqa_rejects_bad_heads(rng):
+    from parameter_server_distributed_tpu.ops.pallas.flash_attention import (
+        flash_attention_gqa)
+
+    q = jnp.zeros((1, 128, 6, 8), jnp.float32)
+    k = jnp.zeros((1, 128, 4, 8), jnp.float32)
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention_gqa(q, k, k)

@@ -31,8 +31,11 @@ microbatches stream through; ``--microbatches=M`` sets the schedule depth
 (default P).  ``--pipeline-schedule=gpipe|1f1b`` picks the schedule:
 gpipe (all forwards then all backwards via autodiff) or 1f1b (interleaved
 one-forward-one-backward — O(P) instead of O(M) in-flight activations).
-Requires n_layers divisible by P; combine with data:N.  ``--attention``
-may be dense or flash inside pipeline stages.
+``--virtual-stages=V`` (with 1f1b) runs the Megatron INTERLEAVED
+schedule: each rank holds V round-robin layer chunks, shrinking the
+pipeline bubble ~V-fold at V x the ppermute count.  Requires n_layers
+divisible by P*V; combine with data:N.  ``--attention`` may be dense or
+flash inside pipeline stages.
 
 ``--data`` switches from synthetic loaders to file-backed data
 (data/files.py): a token shard (.bin/.u32 memmap) for LM models, an npz
@@ -83,7 +86,8 @@ def parse_mesh(spec: str) -> MeshConfig:
 KNOWN_FLAGS = frozenset({
     "model", "batch", "data", "seq", "eval-every", "eval-steps", "eval-data",
     "per-process-data", "prefetch", "attention", "microbatches",
-    "pipeline-schedule", "dtype", "remat", "no-remat", "scan-layers",
+    "pipeline-schedule", "virtual-stages", "dtype", "remat", "no-remat",
+    "scan-layers",
     "no-scan-layers", "steps", "optimizer", "lr", "schedule", "warmup",
     "clip-norm", "accum", "mesh", "ckpt-dir", "ckpt-every", "ckpt-keep",
     "log-every", "seed", "resume", "metrics", "coordinator",
@@ -128,6 +132,7 @@ def main(argv: list[str] | None = None) -> int:
         attention=flags.get("attention", "dense"),
         microbatches=int(flags.get("microbatches", 0)),
         pipeline_schedule=flags.get("pipeline-schedule", "gpipe"),
+        virtual_stages=int(flags.get("virtual-stages", 1)),
         model_dtype=flags.get("dtype", ""),
         remat=(False if "no-remat" in flags
                else True if "remat" in flags else None),

@@ -67,6 +67,8 @@ REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator], str]] = {
                  _lm_batches, "tokens"),
     "tiny_lm": (partial(tiny_lm, vocab=1024, seq=256),
                 _lm_batches, "tokens"),
+    "small_lm4": (partial(small_lm, vocab=1024, seq=256, n_layers=4),
+                  _lm_batches, "tokens"),
     "moe_lm": (partial(moe_lm, vocab=1024, seq=256),
                _lm_batches, "tokens"),
     "moe_lm_top2": (partial(moe_lm, vocab=1024, seq=256, top_k=2),

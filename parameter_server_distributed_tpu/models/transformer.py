@@ -715,10 +715,12 @@ def transformer_rule(mesh: Mesh):
 
 
 def small_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
-             remat: bool = False, scan_layers: bool = False) -> Transformer:
-    """Test-scale LM."""
+             remat: bool = False, scan_layers: bool = False,
+             n_layers: int = 2) -> Transformer:
+    """Test-scale LM (``small_lm4`` in the registry is the 4-layer variant
+    — deep enough for pipe x virtual-stage factorizations)."""
     return Transformer(TransformerConfig(
-        vocab=vocab, d_model=128, n_heads=4, n_layers=2, d_ff=512,
+        vocab=vocab, d_model=128, n_heads=4, n_layers=n_layers, d_ff=512,
         max_seq=seq, dtype=dtype, remat=remat, scan_layers=scan_layers))
 
 

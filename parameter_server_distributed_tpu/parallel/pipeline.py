@@ -79,7 +79,8 @@ class PipelinedTransformerLM:
     SCHEDULES = ("gpipe", "1f1b")
 
     def __init__(self, inner, mesh: Mesh, num_microbatches: int = 0,
-                 schedule: str = "gpipe", attention: str | None = None):
+                 schedule: str = "gpipe", attention: str | None = None,
+                 virtual_stages: int = 1):
         from ..models.transformer import (Transformer, causal_attention,
                                           flash_attention_auto)
 
@@ -94,10 +95,17 @@ class PipelinedTransformerLM:
         if schedule not in self.SCHEDULES:
             raise ValueError(f"schedule {schedule!r}; options {self.SCHEDULES}")
         n_pipe = mesh.shape["pipe"]
-        if inner.config.n_layers % n_pipe:
+        if virtual_stages < 1:
+            raise ValueError(f"virtual_stages must be >= 1, got "
+                             f"{virtual_stages}")
+        if virtual_stages > 1 and schedule != "1f1b":
             raise ValueError(
-                f"n_layers={inner.config.n_layers} must divide by the "
-                f"pipe axis ({n_pipe})")
+                "virtual_stages > 1 (interleaved pipelining) requires "
+                "schedule='1f1b' — GPipe has no interleaved form here")
+        if inner.config.n_layers % (n_pipe * virtual_stages):
+            raise ValueError(
+                f"n_layers={inner.config.n_layers} must divide by "
+                f"pipe x virtual_stages ({n_pipe} x {virtual_stages})")
         # Stage-internal attention runs per device inside shard_map, so the
         # single-shard kernels are the contract: dense einsum or the pallas
         # flash kernel (seq/ring/ulysses need a seq axis, which pipeline
@@ -117,7 +125,11 @@ class PipelinedTransformerLM:
         self.mesh = mesh
         self.n_pipe = n_pipe
         self.schedule = schedule
-        self.layers_per_stage = inner.config.n_layers // n_pipe
+        self.virtual_stages = virtual_stages
+        # per-SCHEDULED-stage layer count: rank r holds virtual_stages
+        # chunks, chunk c being global stage c*P + r (Megatron round-robin)
+        self.layers_per_stage = inner.config.n_layers // (
+            n_pipe * virtual_stages)
         self.num_microbatches = num_microbatches or n_pipe
 
     # ---------------------------------------------------------------- params
@@ -127,9 +139,18 @@ class PipelinedTransformerLM:
     def _block_suffix(self, name: str) -> str:
         return name.split("/", 1)[1]  # "layer3/attn/wq" -> "attn/wq"
 
+    def _block_leading_shape(self) -> tuple[int, ...]:
+        """Leading axes of a stacked ``blocks/*`` param: [P, Lc] plain,
+        [P, V, Lc] interleaved (rank r, chunk c = global stage c*P + r)."""
+        if self.virtual_stages == 1:
+            return (self.n_pipe, self.layers_per_stage)
+        return (self.n_pipe, self.virtual_stages, self.layers_per_stage)
+
     def init_params(self, rng=0) -> dict:
         """Flat transformer store restacked: per-layer params become
-        ``blocks/<suffix>`` with leading [P, L/P] axes."""
+        ``blocks/<suffix>`` with leading [P, L/P] axes ([P, V, L/(P*V)]
+        interleaved: layer l lives at [stage % P, stage // P, l % Lc]
+        where stage = l // Lc — the Megatron round-robin chunk layout)."""
         flat = self.inner.init_params(rng)
         out: dict = {}
         by_suffix: dict[str, list] = {}
@@ -138,13 +159,44 @@ class PipelinedTransformerLM:
                 if name.startswith(f"layer{i}/"):
                     by_suffix.setdefault(self._block_suffix(name),
                                          []).append(value)
+        lead = self._block_leading_shape()
         for suffix, values in by_suffix.items():
-            stacked = jnp.stack(values)  # [L, ...]
-            out[self.BLOCK_PREFIX + suffix] = stacked.reshape(
-                self.n_pipe, self.layers_per_stage, *stacked.shape[1:])
+            stacked = jnp.stack(values)  # [L, ...] in layer order
+            if self.virtual_stages > 1:
+                # layer order is stage-major [(c,P),(r),(j)] -> [V,P,Lc];
+                # swap to the rank-major [P,V,Lc] the pipe axis shards
+                stacked = jnp.swapaxes(stacked.reshape(
+                    self.virtual_stages, self.n_pipe,
+                    self.layers_per_stage, *stacked.shape[1:]), 0, 1)
+            else:
+                stacked = stacked.reshape(*lead, *stacked.shape[1:])
+            out[self.BLOCK_PREFIX + suffix] = stacked
         for name, value in flat.items():
             if not self._is_block_param(name):
                 out[name] = value
+        return out
+
+    def flat_params(self, params: Mapping) -> dict:
+        """Inverse of :meth:`init_params`' restack: a pipelined store
+        (``blocks/*`` with [P(,V),Lc] leading axes) back to the plain
+        ``layer<i>/*`` layout, so a pipeline-trained checkpoint loads into
+        the unwrapped Transformer (generation/serving, or re-training at a
+        different pipe/virtual_stages factorization)."""
+        out: dict = {}
+        lc = self.layers_per_stage
+        for name, value in params.items():
+            if not name.startswith(self.BLOCK_PREFIX):
+                out[name] = value
+                continue
+            suffix = name[len(self.BLOCK_PREFIX):]
+            value = jnp.asarray(value)
+            if self.virtual_stages > 1:   # [P,V,Lc,...] -> stage-major
+                value = jnp.swapaxes(value, 0, 1)
+            stages = value.reshape(-1, lc, *value.shape[
+                (3 if self.virtual_stages > 1 else 2):])
+            for s in range(stages.shape[0]):
+                for j in range(lc):
+                    out[f"layer{s * lc + j}/{suffix}"] = stages[s, j]
         return out
 
     def num_params(self) -> int:
@@ -156,17 +208,19 @@ class PipelinedTransformerLM:
             if self._is_block_param(name):
                 if name.startswith("layer0/"):
                     shapes[self.BLOCK_PREFIX + self._block_suffix(name)] = (
-                        self.n_pipe, self.layers_per_stage, *shape)
+                        *self._block_leading_shape(), *shape)
             else:
                 shapes[name] = shape
         return shapes
 
     # --------------------------------------------------------------- forward
     def _stage_fn(self, stage_params: dict, h: jax.Array) -> jax.Array:
-        """Apply this stage's L/P transformer blocks.  stage_params values
-        have a leading [L/P] axis; the loop is static (unrolled by trace).
-        Honors config.remat: each block recomputes its activations in the
-        backward pass (jax.checkpoint), same trade as the plain model."""
+        """Apply one scheduled stage's transformer blocks.  stage_params
+        values have a leading layer axis (its static length is the block
+        count — L/P plain, L/(P*V) interleaved); the loop is unrolled by
+        trace.  Honors config.remat: each block recomputes its activations
+        in the backward pass (jax.checkpoint), same trade as the plain
+        model."""
         model = self.inner
         key = self._STAGE_KEY
         seq = h.shape[1]
@@ -180,7 +234,8 @@ class PipelinedTransformerLM:
 
         apply_block = (jax.checkpoint(one_block) if self.config.remat
                        else one_block)
-        for j in range(self.layers_per_stage):
+        n_layers = next(iter(stage_params.values())).shape[0]
+        for j in range(n_layers):
             blk = {f"{key}/{suffix[len(self.BLOCK_PREFIX):]}": value[j]
                    for suffix, value in stage_params.items()}
             h = apply_block(blk, h)
@@ -191,8 +246,18 @@ class PipelinedTransformerLM:
         h = jnp.take(params["embed/tok"], tokens, axis=0)
         stage_params = {name: value for name, value in params.items()
                         if name.startswith(self.BLOCK_PREFIX)}
-        h = pipeline_apply(self._stage_fn, stage_params, h, self.mesh,
-                           self.num_microbatches)
+        if self.virtual_stages == 1:
+            h = pipeline_apply(self._stage_fn, stage_params, h, self.mesh,
+                               self.num_microbatches)
+        else:
+            # interleaved layout, forward-only (eval): one GPipe pass per
+            # chunk — pass c applies global stages c*P .. c*P+P-1, so V
+            # sequential passes traverse the layers in order
+            for c in range(self.virtual_stages):
+                chunk = {name: value[:, c]
+                         for name, value in stage_params.items()}
+                h = pipeline_apply(self._stage_fn, chunk, h, self.mesh,
+                                   self.num_microbatches)
         return self._head_loss(params, h, tokens)
 
     def _head_loss(self, rest_params: Mapping, h: jax.Array,
@@ -215,45 +280,72 @@ class PipelinedTransformerLM:
 
     def _value_and_grad_1f1b(self, params: Mapping, batch):
         """One-forward-one-backward pipeline schedule (PipeDream-flush /
-        Megatron's non-interleaved 1F1B), hand-written as an SPMD program.
+        Megatron 1F1B, optionally INTERLEAVED over virtual stages),
+        hand-written as an SPMD program.
 
         Why: GPipe-by-autodiff (jax.grad over :func:`pipeline_apply`) runs
         all M forwards, then all M backwards — every stage holds residuals
         for all M microbatches at the backward's start.  1F1B starts
         microbatch m's backward as soon as its forward leaves the last
-        stage, bounding in-flight microbatches per stage at
-        K = 2*(P-1)+1 regardless of M — activation memory O(P) instead of
-        O(M), same bubble fraction.
+        stage, bounding in-flight units per rank at K = 2*(P*V-1)+1
+        regardless of M — activation memory O(P*V) instead of O(M).
 
-        Rematerialized: each stage saves only its INPUT per in-flight
-        microbatch (a [mb, S, D] block in a K-slot ring buffer) and
+        Rematerialized: each scheduled stage saves only its INPUT per
+        in-flight unit (a [mb, S, D] block in a K-slot ring buffer) and
         recomputes the stage forward inside `jax.vjp` at backward time —
         the standard memory/compute trade for pipelined large models, and
         the same trade `config.remat` makes for the plain model.
 
-        Schedule (P stages, M microbatches, rank r, tick t):
-          forward  of microbatch  f = t - r          (0 <= f < M)
-          backward of microbatch  b = t - 2(P-1) + r (0 <= b < M)
-        so the last rank runs fwd(m) and bwd(m) in the same tick (its head
-        cotangent is produced in-tick), and cotangents reach rank r-1 one
-        ppermute later.  T = M + 2(P-1) ticks total.  Every rank executes
-        every tick's fwd+vjp on (possibly garbage) data, with validity
-        masks zeroing the contributions — the SPMD-uniform formulation
-        shard_map requires, like pipeline_apply's jnp.where injection.
+        Schedule (P ranks, V chunks/rank, S = P*V global stages; stage
+        s = c*P + r is rank r's chunk c — Megatron round-robin; microbatch
+        m = G*P + i in groups of P):
+
+          forward  of (m, s) at tick  t_f = G*P*V + c*P + i + r
+          backward of (m, s) at tick  t_b = G*P*V + i + 2*(P*V-1) - c*P - r
+
+        Both chains advance one ppermute per tick (+1 rotation forward,
+        -1 backward; chunk boundaries ride the same wrap-around edge), the
+        last global stage runs fwd(m) and bwd(m) in the same tick (its
+        head cotangent is produced in-tick), and V=1 reduces exactly to
+        the plain 1F1B formulas (t_f = m + r, t_b = m + 2(P-1) - r).
+        T = t_b(M-1, stage 0) + 1 ticks total; interleaving (V>1) shrinks
+        the pipeline-fill/drain bubble from ~2P stage-sized ticks to
+        ~2PV chunk-sized ticks at 1/V the work each — the Megatron
+        interleaved-schedule trade (more, smaller bubbles + V x the
+        ppermute count).  Every rank executes every tick's fwd+vjp on
+        (possibly garbage) data with validity masks zeroing the
+        contributions — the SPMD-uniform formulation shard_map requires.
 
         Exactness: gradients equal jax.grad of the non-pipelined model
-        (tests/test_pipeline.py::test_pipelined_lm_1f1b_*).
+        (tests/test_pipeline.py::test_pipelined_lm_1f1b_* and
+        *_interleaved_*).
         """
         from jax import lax
 
         tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
         mesh, n_pipe, M = self.mesh, self.n_pipe, self.num_microbatches
+        V = self.virtual_stages
+        PV = n_pipe * V
         batch_axes = ("data", "fsdp")
         mb = _microbatch_size(mesh, batch_axes, tokens.shape[0], M)
         seq = tokens.shape[1]
         d_model = self.config.d_model
-        K = 2 * (n_pipe - 1) + 1  # in-flight ring-buffer slots per rank
-        T = M + 2 * (n_pipe - 1)  # total schedule ticks
+        K = 2 * (PV - 1) + 1      # in-flight ring-buffer slots per rank
+
+        def t_fwd(m: int, c: int, r: int) -> int:
+            grp, i = divmod(m, n_pipe)
+            return grp * PV + c * n_pipe + i + r
+
+        def t_bwd(m: int, c: int, r: int) -> int:
+            grp, i = divmod(m, n_pipe)
+            return grp * PV + i + 2 * (PV - 1) - c * n_pipe - r
+
+        T = t_bwd(M - 1, 0, 0) + 1
+        # static tick -> microbatch maps for the single-rank events: the
+        # LAST stage (rank P-1, chunk V-1: head loss + cotangent seed) and
+        # stage 0's backward (rank 0, chunk 0: embedding-lookup grad)
+        head_m = {t_fwd(m, V - 1, n_pipe - 1): m for m in range(M)}
+        embed_m = {t_bwd(m, 0, 0): m for m in range(M)}
 
         blocks = {k: v for k, v in params.items()
                   if k.startswith(self.BLOCK_PREFIX)}
@@ -266,6 +358,7 @@ class PipelinedTransformerLM:
         stage_fn = self._stage_fn
         head_loss = self._head_loss
         acts_dtype = self.config.dtype
+        Lc = self.layers_per_stage
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(block_specs, rest_specs, tok_spec),
@@ -273,20 +366,35 @@ class PipelinedTransformerLM:
                  check_vma=False)
         def run(blocks_in, rest_in, tok_local):
             my = lax.axis_index("pipe")
-            my_blocks = jax.tree.map(lambda p: p[0], blocks_in)
+
+            def to_chunks(p):  # local [1,(V,)Lc,...] -> uniform [V,Lc,...]
+                rest_shape = p.shape[2:] if V == 1 else p.shape[3:]
+                return p[0].reshape(V, Lc, *rest_shape)
+
+            my_chunks = jax.tree.map(to_chunks, blocks_in)
             tok_mb = tok_local.reshape(M, mb, seq)
             fwd_perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
             bwd_perm = [(i, (i - 1) % n_pipe) for i in range(n_pipe)]
 
+            def chunk_view(c):
+                """Chunk c's stage params ([Lc, ...] leaves); c may be a
+                traced index (dynamic chunk selection per rank)."""
+                if V == 1:
+                    return jax.tree.map(lambda p: p[0], my_chunks)
+                return jax.tree.map(
+                    lambda p: lax.dynamic_index_in_dim(p, c, axis=0,
+                                                       keepdims=False),
+                    my_chunks)
+
             state = jnp.zeros((mb, seq, d_model), acts_dtype)
             cot_recv = jnp.zeros((mb, seq, d_model), jnp.float32)
             buf = jnp.zeros((K, mb, seq, d_model), acts_dtype)
-            g_blocks = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), my_blocks)
+            g_chunks = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), my_chunks)
             g_rest = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), rest_in)
             loss_acc = jnp.zeros((), jnp.float32)
-            is_last = my == n_pipe - 1
+            is_last_rank = my == n_pipe - 1
 
             def masked_add(acc, contrib, mask):
                 return jax.tree.map(
@@ -294,49 +402,77 @@ class PipelinedTransformerLM:
                         jnp.float32), acc, contrib)
 
             for t in range(T):
-                # ---- forward: rank r computes microbatch f = t - r
-                if t < M:  # rank 0 injects microbatch t (static index)
-                    inj = jnp.take(rest_in["embed/tok"], tok_mb[t],
+                # ---- forward unit: u = t - my decomposes to (G, c, i);
+                # invalid units compute garbage that masks out downstream
+                # (their buffer slots never alias a live unit's: lifetime
+                # 2(PV-1-s) < K and u advances one per tick)
+                u = t - my
+                c_f = jnp.mod(u, PV) // n_pipe
+                # stage-0 injection is rank 0 only, where u = t is STATIC:
+                # embed microbatch m statically when rank 0's unit this
+                # tick is a chunk-0 unit
+                rem0, i0 = divmod(t % PV, n_pipe)
+                m0 = (t // PV) * n_pipe + i0
+                if rem0 == 0 and m0 < M:
+                    inj = jnp.take(rest_in["embed/tok"], tok_mb[m0],
                                    axis=0).astype(acts_dtype)
                     state_in = jnp.where(my == 0, inj, state)
                 else:
                     state_in = state
-                f_slot = jnp.mod(t - my, K)
+                f_slot = jnp.mod(u, K)
                 buf = lax.dynamic_update_index_in_dim(buf, state_in,
                                                       f_slot, axis=0)
-                state_out = stage_fn(my_blocks, state_in)
+                state_out = stage_fn(chunk_view(jnp.clip(c_f, 0, V - 1)),
+                                     state_in)
 
-                # ---- last-rank head: loss + cotangent for f = t - (P-1)
-                tl = t - (n_pipe - 1)
-                if 0 <= tl < M:
-                    def head(rp, h, _tok=tok_mb[tl]):
+                # ---- head: loss + cotangent seed on the LAST stage's
+                # (static) ticks; by the t_b identity the same rank's bwd
+                # unit this tick IS (m, last stage), so cot feeds straight
+                # through
+                if t in head_m:
+                    def head(rp, h, _tok=tok_mb[head_m[t]]):
                         return head_loss(rp, h, _tok)
                     lval, head_vjp = jax.vjp(head, rest_in,
                                              state_out.astype(jnp.float32))
-                    g_rest_m, cot_head = head_vjp(
-                        jnp.ones((), lval.dtype))
-                    loss_acc = loss_acc + jnp.where(is_last, lval, 0.0)
-                    g_rest = masked_add(g_rest, g_rest_m, is_last)
-                    cot = jnp.where(is_last, cot_head, cot_recv)
+                    g_rest_m, cot_head = head_vjp(jnp.ones((), lval.dtype))
+                    loss_acc = loss_acc + jnp.where(is_last_rank, lval, 0.0)
+                    g_rest = masked_add(g_rest, g_rest_m, is_last_rank)
+                    cot = jnp.where(is_last_rank, cot_head, cot_recv)
                 else:
                     cot = cot_recv
 
-                # ---- backward: rank r computes microbatch b = t-2(P-1)+r
-                b_off = t - 2 * (n_pipe - 1)
+                # ---- backward unit: y = t + my - 2(PV-1) decomposes via
+                # i = y mod P, q = (y - i)/P = G*V - c, G = ceil(q/V)
                 dx_send = jnp.zeros((mb, seq, d_model), jnp.float32)
-                if t >= n_pipe - 1 and b_off <= M - 1:
-                    bvalid = (b_off + my >= 0) & (b_off + my < M)
-                    b_slot = jnp.mod(b_off + my, K)
-                    saved_in = lax.dynamic_index_in_dim(buf, b_slot, axis=0,
-                                                        keepdims=False)
-                    _, stage_vjp = jax.vjp(stage_fn, my_blocks, saved_in)
+                if t >= PV - 1:
+                    y = t + my - 2 * (PV - 1)
+                    i_b = jnp.mod(y, n_pipe)
+                    q = (y - i_b) // n_pipe
+                    G_b = -((-q) // V)          # ceil(q / V)
+                    c_b = G_b * V - q           # in [0, V) by construction
+                    m_b = G_b * n_pipe + i_b
+                    bvalid = (G_b >= 0) & (m_b < M)
+                    u_b = G_b * PV + c_b * n_pipe + i_b
+                    saved_in = lax.dynamic_index_in_dim(
+                        buf, jnp.mod(u_b, K), axis=0, keepdims=False)
+                    chunk_b = chunk_view(c_b)
+                    _, stage_vjp = jax.vjp(stage_fn, chunk_b, saved_in)
                     g_blk_m, dx = stage_vjp(cot.astype(acts_dtype))
-                    g_blocks = masked_add(g_blocks, g_blk_m, bvalid)
+                    if V == 1:
+                        g_chunks = masked_add(
+                            g_chunks,
+                            jax.tree.map(lambda g: g[None], g_blk_m),
+                            bvalid)
+                    else:
+                        g_chunks = jax.tree.map(
+                            lambda a, g: a.at[c_b].add(
+                                jnp.where(bvalid, g, 0.0).astype(
+                                    jnp.float32)), g_chunks, g_blk_m)
                     dx_send = jnp.where(bvalid, dx.astype(jnp.float32), 0.0)
-                    if 0 <= b_off < M:  # rank 0: embedding-lookup backward
+                    if t in embed_m:  # rank 0 / chunk 0: embedding bwd
                         emb_mask = jnp.where((my == 0) & bvalid, 1.0, 0.0)
                         g_rest["embed/tok"] = (
-                            g_rest["embed/tok"].at[tok_mb[b_off]].add(
+                            g_rest["embed/tok"].at[tok_mb[embed_m[t]]].add(
                                 dx_send * emb_mask))
 
                 # ---- rotate activations forward, cotangents backward
@@ -348,8 +484,9 @@ class PipelinedTransformerLM:
             # loss/head/embed live on single ranks -> share over pipe
             loss = lax.pmean(lax.psum(loss_acc, "pipe") / M, batch_axes)
             g_blocks = jax.tree.map(
-                lambda g, p: lax.pmean(g / M, batch_axes).astype(
-                    p.dtype)[None], g_blocks, my_blocks)
+                lambda g, p: lax.pmean(
+                    g.reshape(p[0].shape) / M, batch_axes).astype(
+                        p.dtype)[None], g_chunks, blocks_in)
             g_rest = jax.tree.map(
                 lambda g, p: lax.pmean(lax.psum(g, "pipe") / M,
                                        batch_axes).astype(p.dtype),

@@ -50,6 +50,7 @@ class TrainLoopConfig:
     attention: str = "dense"      # dense | flash | ring | ulysses (LM models)
     microbatches: int = 0         # pipeline microbatches (0 = pipe size)
     pipeline_schedule: str = "gpipe"  # gpipe | 1f1b (pipe axis > 1)
+    virtual_stages: int = 1       # interleaved 1F1B chunks per pipe rank
     model_dtype: str = ""         # "" = model default | f32 | bf16
     remat: bool | None = None     # per-layer jax.checkpoint (LM models);
                                   # None = model default, True/False force
@@ -125,7 +126,8 @@ def run_training(config: TrainLoopConfig) -> dict:
             model = PipelinedTransformerLM(
                 model, mesh, num_microbatches=config.microbatches,
                 schedule=config.pipeline_schedule,
-                attention=config.attention)
+                attention=config.attention,
+                virtual_stages=config.virtual_stages)
         else:
             # give the model the mesh (activation sharding constraints) and
             # the selected attention implementation — flash composes with

@@ -23,6 +23,11 @@ Extension flags:
     --remat / --no-remat / --scan-layers / --no-scan-layers
                      transformer LM layer-loop knobs (same semantics as
                      pst-train; absent = model default)
+    --mesh=SPEC      intra-worker MODEL parallelism over the worker's
+                     local chips (e.g. fsdp:2,data:2 or tensor:4): params
+                     are sharding-constrained inside the jitted step, so
+                     a model too big for one chip still speaks plain PS.
+                     Default: pure local data parallelism over all chips
 """
 
 from __future__ import annotations
@@ -44,7 +49,22 @@ def build_worker(config: WorkerConfig, seed: int | None = None) -> Worker:
                                            dtype=config.model_dtype,
                                            remat=config.remat,
                                            scan=config.scan_layers)
-    return Worker(config, Trainer(model), batches)
+    mesh_config = rule_fn = None
+    if config.mesh:
+        from .train_main import parse_mesh
+        from ..parallel.train_loop import _pick_rule
+
+        mesh_config = parse_mesh(config.mesh)
+        if mesh_config.pipeline > 1 or mesh_config.sequence > 1:
+            # pipe needs the schedule machinery (pst-train); seq has no
+            # param rule here — accepting it would leave chips silently
+            # doing replicated work
+            raise ValueError(
+                "worker --mesh supports data/fsdp/tensor/expert axes; "
+                "use pst-train for pipeline or sequence parallelism")
+        rule_fn = lambda mesh: _pick_rule(config.model, mesh)  # noqa: E731
+    return Worker(config, Trainer(model, mesh_config=mesh_config,
+                                  rule_fn=rule_fn), batches)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -68,6 +88,7 @@ def main(argv: list[str] | None = None) -> int:
                      else True if "scan-layers" in flags else None),
         data_path=flags.get("data", ""),
         wire_dtype=flags.get("wire", "f32"),
+        mesh=flags.get("mesh", ""),
     )
     worker = build_worker(config, seed=int(flags["seed"]) if "seed" in flags else None)
     worker.initialize()

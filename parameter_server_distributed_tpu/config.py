@@ -101,6 +101,11 @@ class WorkerConfig:
     # with error feedback; pulls stay bf16).  Packed encodings require a
     # framework PS (negotiated; falls back to f32 against the reference).
     wire_dtype: str = "f32"
+    # Intra-worker model parallelism: a mesh spec over the worker's local
+    # chips (e.g. "fsdp:2,data:2", "tensor:4").  Empty = pure local data
+    # parallelism.  Params are sharding-constrained inside the jitted
+    # step; the PS protocol still sees one packed host store.
+    mesh: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

@@ -39,16 +39,41 @@ class Trainer:
     ``local_devices``: devices for intra-worker data parallelism (defaults
     to all visible devices).  The batch's leading axis is sharded across
     them; parameters are replicated.
+
+    ``mesh_config`` + ``rule``: intra-worker MODEL parallelism — the
+    worker's local chips form a full mesh (data/fsdp/tensor/...) and the
+    unpacked params are sharding-constrained by ``rule`` inside the jitted
+    step, so XLA partitions the forward/backward across the worker's chips
+    (Megatron TP, ZeRO fsdp) while the PS protocol still sees one packed
+    host store per push/pull.  The reference's workers are strictly
+    single-GPU-per-rank (src/worker.cpp); this is the TPU-native upgrade:
+    a worker whose model does not fit one chip still speaks plain PS.
     """
 
-    def __init__(self, model, local_devices: list | None = None):
+    def __init__(self, model, local_devices: list | None = None,
+                 mesh_config=None, rule_fn=None):
         self.model = model
         devices = local_devices or jax.local_devices()
-        self._mesh = jax.sharding.Mesh(np.array(devices), ("local",))
-        self._replicated = jax.sharding.NamedSharding(
-            self._mesh, jax.sharding.PartitionSpec())
-        self._batch_sharded = jax.sharding.NamedSharding(
-            self._mesh, jax.sharding.PartitionSpec("local"))
+        self._rule = None
+        if mesh_config is not None:
+            from ..parallel.mesh import batch_sharding, build_mesh, replicated
+
+            need = mesh_config.num_devices
+            if len(devices) < need:
+                raise ValueError(
+                    f"worker mesh {mesh_config.axis_sizes} needs {need} "
+                    f"local devices, have {len(devices)}")
+            self._mesh = build_mesh(mesh_config, devices=devices[:need])
+            if rule_fn is not None:
+                self._rule = rule_fn(self._mesh)
+            self._replicated = replicated(self._mesh)
+            self._batch_sharded = batch_sharding(self._mesh)
+        else:
+            self._mesh = jax.sharding.Mesh(np.array(devices), ("local",))
+            self._replicated = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec())
+            self._batch_sharded = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec("local"))
 
         # fixed packing layout: (name, offset, size, shape, dtype), by name
         init = model.init_params(0)
@@ -64,11 +89,21 @@ class Trainer:
         del init
 
         layout = self._layout
+        mesh = self._mesh
+        param_rule = self._rule
 
         def packed_step(flat_params, batch):
             params = {name: flat_params[off:off + size]
                       .reshape(shape).astype(dtype)
                       for name, off, size, shape, dtype in layout}
+            if param_rule is not None:
+                # model parallelism: constrain each unpacked param to its
+                # rule sharding — XLA partitions the whole step around it
+                params = {
+                    name: jax.lax.with_sharding_constraint(
+                        value, jax.sharding.NamedSharding(
+                            mesh, param_rule(name, tuple(value.shape))))
+                    for name, value in params.items()}
             loss, grads = jax.value_and_grad(model.loss)(params, batch)
             flat = jnp.concatenate(
                 [jnp.reshape(loss, (1,)).astype(jnp.float32)]

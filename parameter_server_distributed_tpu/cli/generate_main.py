@@ -70,11 +70,28 @@ def match_layout(model, params):
     return params
 
 
+KNOWN_FLAGS = frozenset({
+    "model", "dtype", "scan-layers", "no-scan-layers", "seed", "ckpt",
+    "ckpt-dir", "avg-last", "tokens", "prompt", "top-k", "top-p", "beam",
+    "temperature", "max-new", "draft-model", "draft-ckpt", "draft-seed",
+    "draft-len", "length-penalty",
+})
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     _, flags = parse_argv(argv)
+    if "help" in flags:
+        print(__doc__)
+        return 0
+    unknown = set(flags) - KNOWN_FLAGS
+    if unknown:
+        # same contract as pst-train: a typo'd flag silently falling back
+        # to its default corrupts results invisibly — fail loudly
+        raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
+                         f"--help lists the accepted flags")
 
     import numpy as np
 

@@ -40,14 +40,18 @@ class Trainer:
     to all visible devices).  The batch's leading axis is sharded across
     them; parameters are replicated.
 
-    ``mesh_config`` + ``rule``: intra-worker MODEL parallelism — the
+    ``mesh_config`` + ``rule_fn``: intra-worker MODEL parallelism — the
     worker's local chips form a full mesh (data/fsdp/tensor/...) and the
-    unpacked params are sharding-constrained by ``rule`` inside the jitted
-    step, so XLA partitions the forward/backward across the worker's chips
-    (Megatron TP, ZeRO fsdp) while the PS protocol still sees one packed
-    host store per push/pull.  The reference's workers are strictly
-    single-GPU-per-rank (src/worker.cpp); this is the TPU-native upgrade:
-    a worker whose model does not fit one chip still speaks plain PS.
+    unpacked params are sharding-constrained by ``rule_fn(mesh)`` inside
+    the jitted step, so XLA partitions the forward/backward across the
+    worker's chips (Megatron TP, ZeRO fsdp) while the PS protocol still
+    sees one packed host store per push/pull.  The packed flat buffers at
+    the host<->device boundary are themselves element-sharded over ALL
+    mesh axes (padded to divisibility), so no chip ever materializes a
+    full replica of the params or grads — the point of a model-parallel
+    worker.  The reference's workers are strictly single-GPU-per-rank
+    (src/worker.cpp); this is the TPU-native upgrade: a worker whose
+    model does not fit one chip still speaks plain PS.
     """
 
     def __init__(self, model, local_devices: list | None = None,
@@ -56,7 +60,8 @@ class Trainer:
         devices = local_devices or jax.local_devices()
         self._rule = None
         if mesh_config is not None:
-            from ..parallel.mesh import batch_sharding, build_mesh, replicated
+            from ..parallel.mesh import (AXIS_NAMES, batch_sharding,
+                                         build_mesh)
 
             need = mesh_config.num_devices
             if len(devices) < need:
@@ -66,12 +71,17 @@ class Trainer:
             self._mesh = build_mesh(mesh_config, devices=devices[:need])
             if rule_fn is not None:
                 self._rule = rule_fn(self._mesh)
-            self._replicated = replicated(self._mesh)
+            # flat param/grad buffers are element-sharded across every
+            # chip: 1/N of the store per chip at the boundary
+            self._flat_sharding = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec(AXIS_NAMES))
+            self._n_shard = need
             self._batch_sharded = batch_sharding(self._mesh)
         else:
             self._mesh = jax.sharding.Mesh(np.array(devices), ("local",))
-            self._replicated = jax.sharding.NamedSharding(
+            self._flat_sharding = jax.sharding.NamedSharding(
                 self._mesh, jax.sharding.PartitionSpec())
+            self._n_shard = 1
             self._batch_sharded = jax.sharding.NamedSharding(
                 self._mesh, jax.sharding.PartitionSpec("local"))
 
@@ -88,9 +98,15 @@ class Trainer:
         self._packed_size = offset
         del init
 
+        # padded so the element-sharded flat buffers divide over the mesh
+        self._padded_in = -(-self._packed_size // self._n_shard) * self._n_shard
+        out_size = 1 + self._packed_size  # loss at offset 0
+        self._padded_out = -(-out_size // self._n_shard) * self._n_shard
+
         layout = self._layout
         mesh = self._mesh
         param_rule = self._rule
+        pad_out = self._padded_out - out_size
 
         def packed_step(flat_params, batch):
             params = {name: flat_params[off:off + size]
@@ -108,10 +124,12 @@ class Trainer:
             flat = jnp.concatenate(
                 [jnp.reshape(loss, (1,)).astype(jnp.float32)]
                 + [grads[name].astype(jnp.float32).ravel()
-                   for name, *_ in layout])
+                   for name, *_ in layout]
+                + ([jnp.zeros((pad_out,), jnp.float32)] if pad_out else []))
             return flat
 
-        self._step = jax.jit(packed_step, out_shardings=self._replicated)
+        self._step = jax.jit(packed_step,
+                             out_shardings=self._flat_sharding)
 
     @property
     def num_local_devices(self) -> int:
@@ -131,7 +149,7 @@ class Trainer:
         return jax.tree.map(put, batch)
 
     def _pack(self, params: Mapping[str, np.ndarray]) -> np.ndarray:
-        flat = np.empty(self._packed_size, np.float32)
+        flat = np.zeros(self._padded_in, np.float32)
         for name, off, size, _shape, _dtype in self._layout:
             flat[off:off + size] = np.asarray(
                 params[name], np.float32).ravel()
@@ -143,7 +161,7 @@ class Trainer:
 
         One H2D upload (packed params), one D2H fetch (loss + packed
         grads), regardless of tensor count."""
-        flat = jax.device_put(self._pack(params), self._replicated)
+        flat = jax.device_put(self._pack(params), self._flat_sharding)
         packed = np.asarray(self._step(flat, self._shard_batch(batch)))
         loss = float(packed[0])
         grads = {name: packed[1 + off:1 + off + size].reshape(shape)

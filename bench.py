@@ -307,6 +307,10 @@ def bench_pushpull() -> dict:
     # apply regardless of iteration interleaving across client threads —
     # the config-5 semantics, so apply cost is always in the number.
     staleness = 0 if (n_workers == 1 and ps_opt == "sgd") else 1_000_000_000
+    if staleness:
+        log(f"bench_pushpull: async mode (workers={n_workers} opt={ps_opt} "
+            f"staleness_bound={staleness}) — metric gains the "
+            f"_{ps_opt}apply suffix and is NOT comparable to the sync p50")
     shards = [ParameterServer(ParameterServerConfig(
         bind_address="127.0.0.1", port=0, total_workers=1,
         optimizer=ps_opt, learning_rate=1e-3 if ps_opt != "sgd" else 1.0,

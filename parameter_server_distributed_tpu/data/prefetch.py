@@ -17,6 +17,7 @@ has.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Callable, Iterator
@@ -96,6 +97,14 @@ def prefetch_to_device(batches: Iterator, place: Callable,
                     except queue.Empty:
                         break
                 thread.join(timeout=1.0)
+            if thread.is_alive():
+                # Producer stuck inside a slow upstream iterator: its one
+                # in-flight batch keeps device buffers pinned.  Surface it
+                # rather than returning silently.
+                logging.getLogger("pst.prefetch").warning(
+                    "prefetch: producer thread still alive after close() "
+                    "(blocked in upstream iterator?); one in-flight batch "
+                    "may keep device buffers pinned")
 
         def __del__(self):
             stop.set()

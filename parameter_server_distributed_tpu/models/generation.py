@@ -126,8 +126,14 @@ def decode_block(model: Transformer, params: Mapping[str, Array],
         lp, p = model.layer_view(params, i)
         q, k, v = model.qkv(lp, p, h, positions)  # k/v: [B, T, KV, D]
         if ragged:
-            new_k = new_k.at[i, bidx, positions].set(k.astype(new_k.dtype))
-            new_v = new_v.at[i, bidx, positions].set(v.astype(new_v.dtype))
+            # mode="drop": rows that finished generating keep advancing
+            # their lengths each speculative round, so their scatter
+            # positions intentionally overshoot cache.max_len — those
+            # writes must be dropped, not clamped onto the last slot.
+            new_k = new_k.at[i, bidx, positions].set(
+                k.astype(new_k.dtype), mode="drop")
+            new_v = new_v.at[i, bidx, positions].set(
+                v.astype(new_v.dtype), mode="drop")
         else:
             new_k = jax.lax.dynamic_update_slice(
                 new_k, k[None].astype(new_k.dtype), (i, 0, pos, 0, 0))

@@ -38,6 +38,11 @@ WT_FIXED32 = 5
 
 _U64_MASK = (1 << 64) - 1
 
+# bytes fields at or below this size are copied out of the RPC buffer at
+# decode time (see the "bytes" branch in _decode_field); larger payloads
+# (tensor data) stay zero-copy memoryviews into the caller's buffer.
+_BYTES_COPY_THRESHOLD = 4096
+
 
 def encode_varint(value: int) -> bytes:
     """Encode a non-negative (or two's-complement 64-bit wrapped) varint."""
@@ -431,7 +436,15 @@ def _decode_field(msg: Message, buf: bytes, pos: int, f: Field, wire_type: int) 
     if kind == "bytes":
         length, pos = decode_varint(buf, pos)
         end = pos + length
-        setattr(msg, f.name, buf[pos:end])
+        # Small bytes fields (ids, names, digests) are copied eagerly:
+        # a zero-copy memoryview slice would pin the ENTIRE RPC buffer
+        # (possibly 100MB+) alive for as long as the field is retained,
+        # and downstream consumers expect hashable `bytes`.  Tensor-sized
+        # payloads stay zero-copy — their lifetime IS the buffer's
+        # lifetime, and the copy is the cost we built this codec to avoid.
+        raw = buf[pos:end]
+        setattr(msg, f.name,
+                bytes(raw) if length <= _BYTES_COPY_THRESHOLD else raw)
         return end
     if kind == "float":
         setattr(msg, f.name, struct.unpack_from("<f", buf, pos)[0])

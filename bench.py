@@ -278,13 +278,16 @@ def bench_pushpull() -> dict:
     PSDT_BENCH_WORKERS > 1 adds an aggregate-throughput phase: N client
     threads pushing/pulling concurrently (config 3's 8-worker shape;
     on a 1-core host this measures protocol contention, not parallelism).
-    PSDT_BENCH_PS_OPT sets the shards' apply path (e.g. device_adamw)."""
+    PSDT_BENCH_PS_OPT sets the shards' apply path (e.g. device_adamw).
+    PSDT_BENCH_STREAM=0 forces the reference-shaped monolithic unary RPCs
+    instead of the chunk-stream data plane (rpc/data_plane.py);
+    PSDT_STREAM_CHUNK_BYTES tunes the chunk budget."""
     import numpy as np
 
     from parameter_server_distributed_tpu.config import ParameterServerConfig
     from parameter_server_distributed_tpu.core.tensor import to_wire
     from parameter_server_distributed_tpu.rpc import messages as m
-    from parameter_server_distributed_tpu.rpc.service import RpcClient
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
     from parameter_server_distributed_tpu.server.ps_service import ParameterServer
     from parameter_server_distributed_tpu.worker.ps_shards import ShardedPSClient
 
@@ -344,11 +347,15 @@ def bench_pushpull() -> dict:
     grads = to_wire(
         {name: rng.standard_normal(value.shape).astype(np.float32)
          for name, value in params.items()}, wire_dtype)
+    # Streamed chunk data plane (rpc/data_plane.py) is the framework's
+    # real client path and the default here; PSDT_BENCH_STREAM=0 forces the
+    # reference-shaped monolithic unary RPCs for A/B comparison.
+    streaming = os.environ.get("PSDT_BENCH_STREAM", "1") != "0"
+
     def make_client():
         if n_shards > 1:
             return ShardedPSClient([f"127.0.0.1:{p}" for p in ports])
-        return RpcClient(f"127.0.0.1:{port}", m.PARAMETER_SERVER_SERVICE,
-                         m.PARAMETER_SERVER_METHODS)
+        return PSClient(f"127.0.0.1:{port}")
 
     client = make_client()
     if n_shards > 1:
@@ -366,14 +373,20 @@ def bench_pushpull() -> dict:
         for i in range(n):
             it = offset + i
             try:
+                push_req = m.GradientUpdate(worker_id=0, iteration=it,
+                                            gradients=grads)
+                pull_req = m.PullRequest(worker_id=0, iteration=it,
+                                         wire_dtype=wire_dtype)
                 t0 = time.perf_counter()
-                cl.call("ReceiveGradients",
-                        m.GradientUpdate(worker_id=0, iteration=it,
-                                         gradients=grads))
+                if streaming:
+                    cl.push_gradients(push_req)
+                else:
+                    cl.call("ReceiveGradients", push_req)
                 t1 = time.perf_counter()
-                cl.call("ServeParameters",
-                        m.PullRequest(worker_id=0, iteration=it,
-                                      wire_dtype=wire_dtype))
+                if streaming:
+                    cl.pull_parameters(pull_req)
+                else:
+                    cl.call("ServeParameters", pull_req)
                 t2 = time.perf_counter()
             except Exception as exc:  # noqa: BLE001 — a failed concurrent
                 # roundtrip must not kill its thread silently; record and

@@ -7,7 +7,19 @@
 #
 #   deploy/deploy.sh apply    # terraform apply + ship package + start roles
 #   deploy/deploy.sh ship     # re-ship package + restart roles (no apply)
+#   deploy/deploy.sh scale N  # resize the worker fleet to N TPU slices
 #   deploy/deploy.sh destroy
+#
+# `scale` is the cloud analogue of the reference's scripts/scale_workers.sh
+# (terraform re-apply with the new worker count, then provision + start
+# only the NEW instances — reference scripts/scale_workers.sh:51-148) with
+# one deliberate protocol difference: no parameter-server restart in either
+# direction.  Scale-up workers register with the coordinator and join the
+# elastic barrier; scale-down slices are destroyed by terraform and the
+# coordinator's reaper evicts them after the 30 s staleness window, which
+# shrinks the barrier width for everyone still running (the reference
+# instead restarts the PS with the new WORLD size, losing live state —
+# reference scripts/scale_workers.sh:150-186).
 #
 # Requires: terraform, gcloud (authenticated), TF_VAR_project set.
 set -euo pipefail
@@ -18,6 +30,21 @@ ACTION="${1:-apply}"
 if [ "$ACTION" = "destroy" ]; then
   terraform -chdir=terraform destroy -auto-approve
   exit 0
+fi
+
+PREV_WORKERS=0
+if [ "$ACTION" = "scale" ]; then
+  NEW_COUNT="${2:?usage: deploy.sh scale <worker_slice_count>}"
+  PREV_WORKERS="$(terraform -chdir=terraform output -json worker_names \
+    2>/dev/null | jq 'length' || echo 0)"
+  echo "== scaling worker fleet: $PREV_WORKERS -> $NEW_COUNT slices"
+  terraform -chdir=terraform apply -auto-approve \
+    -var "worker_slice_count=$NEW_COUNT"
+  if [ "$NEW_COUNT" -le "$PREV_WORKERS" ]; then
+    echo "== scale-down complete: terraform destroyed the removed slices;"
+    echo "   the coordinator reaper evicts them from the barrier within 30s"
+    exit 0
+  fi
 fi
 
 if [ "$ACTION" = "apply" ]; then
@@ -46,6 +73,19 @@ ship_tpu() { # ship package to every host of a TPU slice
     "sudo rsync -a --delete /tmp/psdt-pkg/ /opt/psdt/parameter_server_distributed_tpu/ \
      && sudo systemctl enable --now psdt-worker && sudo systemctl restart psdt-worker"
 }
+
+if [ "$ACTION" = "scale" ]; then
+  # provision + start ONLY the slices terraform just created; running
+  # workers, PS, and coordinator are untouched (elastic barrier handles
+  # the width change)
+  for w in "${WORKERS[@]:$PREV_WORKERS}"; do
+    echo "== shipping package to NEW worker slice $w"
+    ship_tpu "$w"
+  done
+  echo "== scale-up complete: new workers register with the coordinator"
+  echo "   and join the elastic barrier on their next iteration"
+  exit 0
+fi
 
 echo "== shipping package to control plane ($COORD_VM)"
 ship_gce "$COORD_VM"

@@ -22,7 +22,8 @@ from typing import Mapping
 
 import numpy as np
 
-from ..native import adam_native, lib as native_lib, momentum_native, sgd_native
+from ..native import (adam_native, adamw_native, lib as native_lib,
+                      momentum_native, sgd_native)
 from .tensor import TensorStore
 
 
@@ -82,10 +83,11 @@ class Momentum(HostOptimizer):
             g = np.asarray(grads[name], np.float32)
             v_prev = self.velocity.get(name)
             if use_native:
-                # Fresh copies so state_dict snapshots taken earlier stay
-                # valid (the native kernel updates in place).
+                # fresh params buffer (served dicts hold references to the
+                # old one); velocity updates in place — state_dict
+                # deep-copies on snapshot
                 p_new = np.array(p, np.float32)
-                v_new = (np.array(v_prev, np.float32) if v_prev is not None
+                v_new = (_owned_f32(v_prev) if v_prev is not None
                          else np.zeros_like(g))
                 if momentum_native(p_new, g, v_new, float(lr), float(mu)):
                     self.velocity[name] = v_new
@@ -97,10 +99,23 @@ class Momentum(HostOptimizer):
         return out
 
     def state_dict(self) -> dict:
-        return {"velocity": dict(self.velocity)}
+        # deep copy — the native apply path updates velocity in place
+        return {"velocity": {k: np.array(v)
+                             for k, v in self.velocity.items()}}
 
     def load_state_dict(self, state: dict) -> None:
-        self.velocity = dict(state.get("velocity", {}))
+        self.velocity = {k: np.array(v, np.float32)
+                         for k, v in state.get("velocity", {}).items()}
+
+
+def _owned_f32(a: np.ndarray) -> np.ndarray:
+    """Contiguous writable float32 view of an optimizer slot, copying only
+    when the stored array is not already kernel-ready (e.g. right after a
+    checkpoint load of a float64 or read-only array)."""
+    out = np.asarray(a, np.float32)
+    if not (out.flags.c_contiguous and out.flags.writeable):
+        out = np.array(out, np.float32)
+    return out
 
 
 class Adam(HostOptimizer):
@@ -129,14 +144,15 @@ class Adam(HostOptimizer):
             m = self.m.get(name, np.zeros_like(g))
             v = self.v.get(name, np.zeros_like(g))
             if use_native:
-                # Fresh copies so state_dict snapshots taken earlier stay
-                # valid (the native kernel updates in place).
+                # params must NOT mutate in place (served param dicts hold
+                # references — RCU-style immutability), so the new params
+                # get a fresh buffer; m/v are private to the optimizer and
+                # update in place (state_dict deep-copies on snapshot).
                 p_new = np.array(p, np.float32)
-                m_new = np.array(m, np.float32)
-                v_new = np.array(v, np.float32)
-                if adam_native(p_new, g, m_new, v_new, float(lr), self.b1,
+                m, v = _owned_f32(m), _owned_f32(v)
+                if adam_native(p_new, g, m, v, float(lr), self.b1,
                                self.b2, self.eps, self.step):
-                    self.m[name], self.v[name] = m_new, v_new
+                    self.m[name], self.v[name] = m, v
                     out[name] = p_new
                     continue
             m = b1 * m + (1 - b1) * g
@@ -146,11 +162,20 @@ class Adam(HostOptimizer):
         return out
 
     def state_dict(self) -> dict:
-        return {"m": dict(self.m), "v": dict(self.v), "step": self.step}
+        # deep copy: the hot apply path updates m/v IN PLACE, so a
+        # checkpoint snapshot must own its buffers (copy-on-snapshot is
+        # per checkpoint; the old copy-on-apply cost 2 state-sized sweeps
+        # on every push at 1B scale)
+        return {"m": {k: np.array(v) for k, v in self.m.items()},
+                "v": {k: np.array(v) for k, v in self.v.items()},
+                "step": self.step}
 
     def load_state_dict(self, state: dict) -> None:
-        self.m = dict(state.get("m", {}))
-        self.v = dict(state.get("v", {}))
+        # deep copy so in-place applies never mutate the caller's dict
+        self.m = {k: np.array(v, np.float32)
+                  for k, v in state.get("m", {}).items()}
+        self.v = {k: np.array(v, np.float32)
+                  for k, v in state.get("v", {}).items()}
         self.step = int(state.get("step", 0))
 
 
@@ -166,13 +191,41 @@ class AdamW(Adam):
 
     def apply(self, params: TensorStore,
               grads: Mapping[str, np.ndarray]) -> TensorStore:
-        out = super().apply(params, grads)
-        decay = np.float32(self.learning_rate * self.weight_decay)
-        for name, p in out.items():
-            if name in grads and p.ndim >= 2:
-                # decay from the PRE-update param (optax.adamw convention:
-                # update = adam_term + wd * p, applied together)
-                out[name] = p - decay * np.asarray(params[name], np.float32)
+        self.step += 1
+        b1, b2 = np.float32(self.b1), np.float32(self.b2)
+        lr = np.float32(self.learning_rate)
+        bc1 = 1.0 - self.b1 ** self.step
+        bc2 = 1.0 - self.b2 ** self.step
+        use_native = native_lib() is not None
+        out: TensorStore = {}
+        for name, p in params.items():
+            p = np.asarray(p, np.float32)
+            if name not in grads:
+                out[name] = p
+                continue
+            # decay from the PRE-update param, matrices only
+            # (optax.adamw convention: update = adam_term + wd * p,
+            # applied together; decaying norm scales/biases is a quality
+            # bug — mask matches parallel/train_step.make_optimizer)
+            wd = self.weight_decay if p.ndim >= 2 else 0.0
+            g = np.asarray(grads[name], np.float32)
+            m = self.m.get(name, np.zeros_like(g))
+            v = self.v.get(name, np.zeros_like(g))
+            if use_native:
+                # fresh params buffer (served dicts hold references to the
+                # old one); m/v update in place — see Adam.apply
+                p_new = np.array(p, np.float32)
+                m, v = _owned_f32(m), _owned_f32(v)
+                if adamw_native(p_new, g, m, v, float(lr), self.b1,
+                                self.b2, self.eps, self.step, wd):
+                    self.m[name], self.v[name] = m, v
+                    out[name] = p_new
+                    continue
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * (g * g)
+            self.m[name], self.v[name] = m, v
+            adam_term = (m / bc1) / (np.sqrt(v / bc2) + self.eps)
+            out[name] = p - lr * (adam_term + np.float32(wd) * p)
         return out
 
 

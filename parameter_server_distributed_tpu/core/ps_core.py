@@ -71,6 +71,24 @@ class PushResult:
         self.total_workers = total_workers
 
 
+def _store_ready(store: "TensorStore") -> bool:
+    """True iff every array is materialized.  numpy arrays always are;
+    jax Arrays expose non-blocking ``is_ready()`` (False while the async
+    dispatch that produces them is still running)."""
+    for v in store.values():
+        ready = getattr(v, "is_ready", None)
+        if ready is not None and not ready():
+            return False
+    return True
+
+
+def _block_on_store(store: "TensorStore") -> None:
+    for v in store.values():
+        wait = getattr(v, "block_until_ready", None)
+        if wait is not None:
+            wait()
+
+
 class ParameterServerCore:
     def __init__(self,
                  total_workers: int = 2,
@@ -100,6 +118,14 @@ class ParameterServerCore:
         # Async mode: iteration of the bootstrap push, so racing duplicate
         # init pushes from other workers are recognized and dropped.
         self._bootstrap_iteration: int | None = None
+        # Async non-blocking serve: device optimizers dispatch their apply
+        # asynchronously (jax), so right after a push the new store is a
+        # promise.  Reads must not stall on that compute — bounded
+        # staleness already tolerates serving the previous version — so
+        # this holds the latest fully-materialized store until the
+        # in-flight apply lands (serve_parameters promotes it).  None in
+        # sync mode and whenever _params is known materialized.
+        self._serving: TensorStore | None = None
         # Lock order: _state_lock before _params_lock, everywhere.
 
     # ------------------------------------------------------------------ props
@@ -151,8 +177,21 @@ class ParameterServerCore:
     def serve_parameters(self, iteration: int = 0) -> tuple[int, TensorStore, bool]:
         """Return (current_iteration, params copy, ready).  The iteration
         argument is accepted and ignored, matching the reference
-        (src/parameter_server.cpp:93-97)."""
+        (src/parameter_server.cpp:93-97).
+
+        Async mode never blocks a read on an in-flight device apply: while
+        the newest store is still a dispatched-but-unmaterialized promise,
+        the previous (materialized) version is served — one extra step of
+        staleness, which bounded-staleness mode tolerates by definition.
+        Sync mode always serves ``_params`` itself: barrier clients must
+        observe exactly the post-aggregation values they were promised."""
         with self._params_lock:
+            if self._serving is not None:
+                if _store_ready(self._params):
+                    self._serving = None  # in-flight apply landed: promote
+                else:
+                    return (self._current_iteration, dict(self._serving),
+                            True)
             params = dict(self._params)
         return self._current_iteration, params, True
 
@@ -294,12 +333,33 @@ class ParameterServerCore:
         return True
 
     def _apply_update(self, mean_grads: TensorStore) -> None:
+        """Caller holds _state_lock, so applies are serialized; only
+        _params_lock is taken here, and only briefly — in async mode the
+        depth-bound fence on the previous in-flight apply happens OUTSIDE
+        it, so concurrent serves keep reading the materialized snapshot
+        instead of queueing behind device compute."""
         with self._params_lock:
             if not self._params:
                 # bootstrap quirk preserved from the reference (cpp:78-81)
                 self._params = dict(mean_grads)
                 return
-            self._params = self._optimizer.apply(self._params, mean_grads)
+            prev = self._params
+        if not self.synchronous:
+            # Depth bound: at most ONE apply in flight — if the previous
+            # apply hasn't materialized yet, fence on it now so push
+            # latency absorbs the pipeline backpressure instead of the XLA
+            # queue growing without bound under a push rate faster than
+            # the apply rate.
+            if not _store_ready(prev):
+                _block_on_store(prev)
+            new_params = self._optimizer.apply(prev, mean_grads)
+            with self._params_lock:
+                self._serving = prev  # materialized: serve this while the
+                self._params = new_params  # new apply is in flight
+        else:
+            with self._params_lock:
+                self._params = self._optimizer.apply(self._params,
+                                                     mean_grads)
 
     # ------------------------------------------------------------------- sync
     def check_sync_status(self, iteration: int) -> tuple[int, bool, int, int]:

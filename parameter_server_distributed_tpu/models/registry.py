@@ -14,7 +14,7 @@ from ..data.synthetic import (synthetic_image_batches, synthetic_mnist,
                               synthetic_tokens)
 from .mlp import MLP, billion_param_mlp, mnist_mlp
 from .resnet import resnet18, resnet50
-from .transformer import lm_350m, moe_lm, small_lm, tiny_lm
+from .transformer import lm_350m, moe_lm, small_lm, switch_lm, tiny_lm
 
 
 # xy loaders: the registry seed varies the SAMPLING stream only — the
@@ -73,6 +73,8 @@ REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator], str]] = {
                _lm_batches, "tokens"),
     "moe_lm_top2": (partial(moe_lm, vocab=1024, seq=256, top_k=2),
                     _lm_batches, "tokens"),
+    "switch_lm": (partial(switch_lm, vocab=1024, seq=256),
+                  _lm_batches, "tokens"),
     "mlp_1b": (billion_param_mlp, _mlp_1b_batches, "xy"),
     "lm_350m": (lm_350m, _lm_350m_batches, "tokens"),
     "lm_350m_gqa": (partial(lm_350m, kv_heads=4), _lm_350m_batches,

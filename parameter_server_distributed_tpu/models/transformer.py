@@ -762,3 +762,14 @@ def moe_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
         vocab=vocab, d_model=128, n_heads=4, n_layers=4, d_ff=512,
         max_seq=seq, dtype=dtype, moe_every=2, moe_experts=4, remat=remat,
         moe_top_k=top_k))
+
+
+def switch_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
+              remat: bool = False, top_k: int = 1) -> Transformer:
+    """Test-scale ALL-MoE LM (moe_every=1, the Switch/Mixtral layout):
+    homogeneous expert blocks, so it composes with pipeline parallelism
+    (parallel/pipeline.py requires uniform per-layer param sets)."""
+    return Transformer(TransformerConfig(
+        vocab=vocab, d_model=128, n_heads=4, n_layers=4, d_ff=512,
+        max_seq=seq, dtype=dtype, moe_every=1, moe_experts=4, remat=remat,
+        moe_top_k=top_k))

@@ -66,6 +66,8 @@ def _bind(path: str) -> ctypes.CDLL:
     lib.psdt_momentum.argtypes = [_F32P, _F32P, _F32P, i64, f32, f32]
     lib.psdt_adam.argtypes = [_F32P, _F32P, _F32P, _F32P, i64, f32, f32, f32,
                               f32, f32, f32]
+    lib.psdt_adamw.argtypes = [_F32P, _F32P, _F32P, _F32P, i64, f32, f32,
+                               f32, f32, f32, f32, f32]
     lib.psdt_mean_sgd.argtypes = [_F32P, pp, i32, i64, f32]
     return lib
 
@@ -186,4 +188,27 @@ def adam_native(param: np.ndarray, grad: np.ndarray, m: np.ndarray,
                      ctypes.c_float(b2), ctypes.c_float(eps),
                      ctypes.c_float(1.0 - b1 ** step),
                      ctypes.c_float(1.0 - b2 ** step))
+    return True
+
+
+def adamw_native(param: np.ndarray, grad: np.ndarray, m: np.ndarray,
+                 v: np.ndarray, lr: float, b1: float, b2: float, eps: float,
+                 step: int, wd: float) -> bool:
+    """In-place fused AdamW pass (Adam + decoupled decay in one sweep);
+    pass wd=0 for tensors excluded from decay."""
+    native = lib()
+    arrays = (param, m, v)
+    if (native is None or step < 1
+            or any(a.dtype != np.float32 or not a.flags.c_contiguous
+                   for a in arrays)
+            or param.shape != np.shape(grad)
+            or any(a.shape != param.shape for a in (m, v))):
+        return False
+    grad_c = np.ascontiguousarray(grad, np.float32)
+    native.psdt_adamw(_fptr(param), _fptr(grad_c), _fptr(m), _fptr(v),
+                      param.size, ctypes.c_float(lr), ctypes.c_float(b1),
+                      ctypes.c_float(b2), ctypes.c_float(eps),
+                      ctypes.c_float(1.0 - b1 ** step),
+                      ctypes.c_float(1.0 - b2 ** step),
+                      ctypes.c_float(wd))
     return True

@@ -65,6 +65,28 @@ void psdt_adam(float* param, const float* grad, float* m, float* v,
     }
 }
 
+// AdamW fused pass: Adam plus decoupled weight decay folded into the SAME
+// sweep (optax.adamw convention: update = adam_term + wd * p_pre, applied
+// together from the pre-update param).  wd = 0 for non-decayed tensors
+// (the matrices-only mask lives in the Python caller).
+void psdt_adamw(float* param, const float* grad, float* m, float* v,
+                const int64_t n, const float lr, const float b1,
+                const float b2, const float eps, const float bc1,
+                const float bc2, const float wd) {
+    for (int64_t i = 0; i < n; ++i) {
+        const float g = grad[i];
+        const float p_old = param[i];
+        const float m_new = b1 * m[i] + (1.0f - b1) * g;
+        const float v_new = b2 * v[i] + (1.0f - b2) * g * g;
+        m[i] = m_new;
+        v[i] = v_new;
+        const float m_hat = m_new / bc1;
+        const float v_hat = v_new / bc2;
+        param[i] = p_old
+            - lr * (m_hat / (__builtin_sqrtf(v_hat) + eps) + wd * p_old);
+    }
+}
+
 // Fused mean + SGD: param -= lr * mean(srcs) with no intermediate buffer.
 void psdt_mean_sgd(float* param, const float** srcs, int32_t count,
                    const int64_t n, const float lr) {

@@ -86,8 +86,21 @@ class PipelinedTransformerLM:
 
         if not isinstance(inner, Transformer):
             raise ValueError("pipeline parallelism wraps a Transformer LM")
-        if inner.config.moe_every > 0:
-            raise ValueError("pipeline + MoE is not supported yet")
+        if inner.config.moe_every > 1:
+            # Stage stacking requires HOMOGENEOUS blocks: every layer's
+            # params stack along one leading [L/P] axis (init_params), so
+            # dense/MoE interleaves (different per-layer param sets) cannot
+            # be pipelined.  The supported MoE pipeline shape is
+            # moe_every=1 — every block MoE, the Switch/Mixtral layout.
+            raise ValueError(
+                "pipeline + interleaved MoE (moe_every > 1) is not "
+                "supported: stage stacking needs homogeneous blocks; "
+                "use moe_every=1 (all-MoE blocks)")
+        if inner.config.moe_every == 1 and schedule == "1f1b":
+            raise ValueError(
+                "pipeline + MoE currently requires schedule='gpipe' (the "
+                "hand-written 1F1B schedule does not thread the MoE "
+                "aux-loss accumulator yet)")
         if inner.config.scan_layers:
             raise ValueError(
                 "pipeline wraps an unrolled Transformer (it restacks "
@@ -241,11 +254,51 @@ class PipelinedTransformerLM:
             h = apply_block(blk, h)
         return h
 
+    def _stage_fn_aux(self, stage_params: dict,
+                      h: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """MoE variant of :meth:`_stage_fn`: every block's FFN is the
+        Switch/Mixtral MoE (config.moe_every == 1) and the stage returns
+        (h, summed aux loss).  Expert capacity is computed per MICROBATCH
+        (the tokens a stage sees per tick) — the standard microbatched-MoE
+        semantics: which tokens drop depends on routing statistics within
+        the microbatch, not the global batch."""
+        from ..models.transformer import rms_norm
+
+        model = self.inner
+        key = self._STAGE_KEY
+        seq = h.shape[1]
+        positions = jnp.arange(seq, dtype=jnp.int32)
+
+        def one_block(blk, h):
+            q, k, v = model.qkv(blk, key, h, positions)
+            attn = self._stage_attention(q, k, v)
+            h = model.attn_residual(blk, key, h, attn)
+            x = rms_norm(h, blk[f"{key}/ln2/scale"])
+            moe_out, aux = model._moe.apply(blk, x, prefix=f"{key}/")
+            return h + moe_out.astype(model.config.dtype), aux
+
+        apply_block = (jax.checkpoint(one_block) if self.config.remat
+                       else one_block)
+        n_layers = next(iter(stage_params.values())).shape[0]
+        aux_total = jnp.zeros((), jnp.float32)
+        for j in range(n_layers):
+            blk = {f"{key}/{suffix[len(self.BLOCK_PREFIX):]}": value[j]
+                   for suffix, value in stage_params.items()}
+            h, aux = apply_block(blk, h)
+            aux_total = aux_total + aux
+        return h, aux_total
+
     def loss(self, params: Mapping, batch) -> jax.Array:
         tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
         h = jnp.take(params["embed/tok"], tokens, axis=0)
         stage_params = {name: value for name, value in params.items()
                         if name.startswith(self.BLOCK_PREFIX)}
+        if self.config.moe_every == 1:
+            h, aux = pipeline_apply(self._stage_fn_aux, stage_params, h,
+                                    self.mesh, self.num_microbatches,
+                                    with_aux=True)
+            return (self._head_loss(params, h, tokens)
+                    + self.config.moe_aux_coef * aux)
         if self.virtual_stages == 1:
             h = pipeline_apply(self._stage_fn, stage_params, h, self.mesh,
                                self.num_microbatches)
@@ -520,7 +573,8 @@ def pipeline_rule(mesh: Mesh):
 
 def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
                    mesh: Mesh, num_microbatches: int,
-                   batch_axes: tuple[str, ...] = ("data", "fsdp")) -> jax.Array:
+                   batch_axes: tuple[str, ...] = ("data", "fsdp"),
+                   with_aux: bool = False) -> jax.Array:
     """Run ``x`` through P pipelined stages.
 
     stage_fn(params_i, h) -> h applies ONE stage.  stage_params is the
@@ -528,20 +582,44 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
     x: [B, ...] with B divisible by num_microbatches (and by the data axes).
     Shape-preserving stages (d_in == d_out), the usual transformer-block
     case.
+
+    ``with_aux``: stage_fn returns (h, aux scalar) — MoE load-balance
+    loss.  Ticks where a rank processes fill/drain garbage are masked out;
+    the returned aux is the mean over microbatches of the per-microbatch
+    stage sums (the standard microbatched-MoE aux semantics).  Returns
+    (out, aux).
     """
     n_pipe = mesh.shape["pipe"]
     if n_pipe == 1:
         params0 = jax.tree.map(lambda p: p[0], stage_params)
-        return stage_fn(params0, x)
+        if not with_aux:
+            return stage_fn(params0, x)
+        # Preserve the per-MICROBATCH contract on a 1-wide pipe axis too:
+        # expert capacity / routing aux are microbatch statistics, so the
+        # batch still goes through in num_microbatches slices (otherwise
+        # collapsing pipe to 1 would silently switch MoE dropping to
+        # whole-batch capacity and change the training trajectory).
+        if x.shape[0] % num_microbatches:
+            raise ValueError(f"batch {x.shape[0]} must divide by "
+                             f"num_microbatches={num_microbatches}")
+        mb = x.shape[0] // num_microbatches
+        outs = []
+        aux_acc = jnp.zeros((), jnp.float32)
+        for i in range(num_microbatches):
+            h, aux = stage_fn(params0, x[i * mb:(i + 1) * mb])
+            outs.append(h)
+            aux_acc = aux_acc + aux
+        return jnp.concatenate(outs), aux_acc / num_microbatches
 
     mb = _microbatch_size(mesh, batch_axes, x.shape[0], num_microbatches)
 
     param_specs = jax.tree.map(
         lambda p: P("pipe", *([None] * (p.ndim - 1))), stage_params)
     x_spec = P(batch_axes, *([None] * (x.ndim - 1)))
+    out_specs = (x_spec, P()) if with_aux else x_spec
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(param_specs, x_spec), out_specs=x_spec,
+             in_specs=(param_specs, x_spec), out_specs=out_specs,
              check_vma=False)
     def run(params, x_local):
         my = jax.lax.axis_index("pipe")
@@ -549,12 +627,22 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
         x_mb = x_local.reshape(num_microbatches, mb, *x_local.shape[1:])
         state = jnp.zeros_like(x_mb[0])
         out = jnp.zeros_like(x_mb)
+        aux_acc = jnp.zeros((), jnp.float32)
         fwd = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
         for t in range(num_microbatches + n_pipe - 1):
             # stage 0 injects microbatch t during the fill phase
             if t < num_microbatches:
                 state = jnp.where(my == 0, x_mb[t], state)
-            state = stage_fn(my_params, state)
+            if with_aux:
+                state, aux = stage_fn(my_params, state)
+                # rank r processes microbatch t-r this tick; anything else
+                # is fill/drain garbage whose routing stats must not leak
+                # into the aux loss
+                valid = jnp.logical_and(t - my >= 0,
+                                        t - my < num_microbatches)
+                aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            else:
+                state = stage_fn(my_params, state)
             # last stage emits microbatch t-(P-1) during the drain phase
             out_idx = t - (n_pipe - 1)
             if 0 <= out_idx < num_microbatches:
@@ -565,6 +653,15 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
         # outputs live on the last rank; share them with every rank so the
         # loss (and its gradient) is computed replicated over pipe
         out = jax.lax.psum(out, "pipe")
-        return out.reshape(x_local.shape)
+        out = out.reshape(x_local.shape)
+        if with_aux:
+            aux = jax.lax.psum(aux_acc, "pipe") / num_microbatches
+            # replicate over the batch axes too (P() out_spec): each data
+            # shard routed different tokens, so average their aux
+            for ax in batch_axes:
+                if mesh.shape.get(ax, 1) > 1:
+                    aux = jax.lax.pmean(aux, ax)
+            return out, aux
+        return out
 
     return run(stage_params, x)

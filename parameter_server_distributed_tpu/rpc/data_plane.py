@@ -1,0 +1,168 @@
+"""Streaming data plane for the parameter-server service.
+
+The reference moves every push/pull as ONE unary protobuf message
+(reference proto/parameter_server.proto:5-11).  At config-3 scale (GBs of
+tensors per push) a monolithic message serializes encode -> transport ->
+decode, peaks at several whole-store-sized buffers, and hits gRPC's
+message-size ceiling.  This framework extension moves the same payloads as
+a STREAM of chunk messages, each carrying a subset of the tensors:
+
+- ``PushGradientsStream`` (client-streaming): gRPC pulls the request
+  iterator from a sender thread, so chunk N+1's fused encode
+  (wire.ArrayPayload) overlaps chunk N's transport, and the server's
+  per-chunk decode + f32 conversion overlaps receiving later chunks.
+- ``ServeParametersStream`` (server-streaming): the server encodes and
+  ships tensors chunk by chunk; the client converts each chunk while the
+  next is in flight.
+
+Chunks reuse the wire-compatible ``GradientUpdate`` / ``ParameterUpdate``
+schemas (a chunk is just a smaller message), so nothing new exists at the
+encoding layer.  Reference peers are unaffected: these are extra method
+names on the same gRPC service, and :class:`PSClient` permanently falls
+back to the reference's unary RPCs for a connection the first time the
+server answers UNIMPLEMENTED — so it interoperates with a reference PS
+unchanged.
+
+A single tensor larger than the chunk budget rides alone in one oversized
+chunk (tensors are never split mid-payload); the budget is a grouping
+target, not a hard message cap.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator
+
+import grpc
+
+from . import messages as m
+from .service import RpcClient
+
+# Default chunk budget for streamed pushes/pulls.  Tens of MB amortizes
+# per-message overhead while keeping encode/transport/decode pipelined;
+# PSDT_STREAM_CHUNK_BYTES overrides, 0 disables streaming entirely.
+DEFAULT_CHUNK_BYTES = 32 << 20
+
+
+def _status_code(exc: grpc.RpcError):
+    """Status code of an RpcError, or None for errors that carry none
+    (e.g. fault-injection stubs raising bare grpc.RpcError)."""
+    code = getattr(exc, "code", None)
+    return code() if callable(code) else None
+
+
+def stream_chunk_bytes() -> int:
+    return int(os.environ.get("PSDT_STREAM_CHUNK_BYTES",
+                              str(DEFAULT_CHUNK_BYTES)))
+
+
+def _tensor_nbytes(t: m.Tensor) -> int:
+    if t.packed:
+        return len(t.packed)
+    data = t.data
+    return getattr(data, "nbytes", 4 * len(data))
+
+
+def split_tensors(tensors: Iterable[m.Tensor],
+                  chunk_bytes: int) -> Iterator[list[m.Tensor]]:
+    """Greedy-pack tensors into order-preserving chunks of roughly
+    ``chunk_bytes`` payload each.  Cheap: only metadata is touched (the
+    payloads are lazy ArrayPayloads or buffer views)."""
+    group: list[m.Tensor] = []
+    size = 0
+    for t in tensors:
+        n = _tensor_nbytes(t)
+        if group and size + n > chunk_bytes:
+            yield group
+            group, size = [], 0
+        group.append(t)
+        size += n
+    if group:
+        yield group
+
+
+class PSClient(RpcClient):
+    """Parameter-server client with the streaming data plane.
+
+    ``push_gradients`` / ``pull_parameters`` use the chunk-stream RPCs and
+    transparently fall back (once, remembered per connection) to the
+    reference unary RPCs when the server does not implement them.  All
+    other methods are plain :meth:`RpcClient.call`.
+    """
+
+    def __init__(self, target: str,
+                 service: str = m.PARAMETER_SERVER_SERVICE,
+                 methods=None, chunk_bytes: int | None = None):
+        methods = dict(methods or m.PARAMETER_SERVER_METHODS)
+        methods.update(m.PARAMETER_SERVER_STREAM_METHODS)
+        super().__init__(target, service, methods)
+        self.chunk_bytes = (stream_chunk_bytes() if chunk_bytes is None
+                            else chunk_bytes)
+        # None = untried; False = server answered UNIMPLEMENTED (reference
+        # PS) — unary forever on this connection
+        self._stream_ok: bool | None = None
+
+    def _streaming(self) -> bool:
+        return self.chunk_bytes > 0 and self._stream_ok is not False
+
+    # ------------------------------------------------------------------ push
+    def push_gradients(self, update: m.GradientUpdate,
+                       timeout: float | None = None) -> m.PushResponse:
+        if not self._streaming():
+            return self.call("ReceiveGradients", update, timeout=timeout)
+
+        def chunks() -> Iterator[m.GradientUpdate]:
+            # worker_id/iteration ride on every chunk (a handful of bytes);
+            # the server reads them off the first.  An empty push still
+            # sends ONE empty chunk: under the sharded topology a shard
+            # owning none of the pushed tensors must still see the push as
+            # a barrier contribution (worker/ps_shards.py).
+            sent = False
+            for group in split_tensors(update.gradients, self.chunk_bytes):
+                sent = True
+                yield m.GradientUpdate(worker_id=update.worker_id,
+                                       iteration=update.iteration,
+                                       gradients=group)
+            if not sent:
+                yield m.GradientUpdate(worker_id=update.worker_id,
+                                       iteration=update.iteration,
+                                       gradients=[])
+
+        try:
+            resp = self.call("PushGradientsStream", chunks(), timeout=timeout)
+            self._stream_ok = True
+            return resp
+        except grpc.RpcError as exc:
+            if _status_code(exc) != grpc.StatusCode.UNIMPLEMENTED:
+                raise
+            self._stream_ok = False
+            return self.call("ReceiveGradients", update, timeout=timeout)
+
+    # ------------------------------------------------------------------ pull
+    def pull_parameters(self, request: m.PullRequest,
+                        timeout: float | None = None) -> m.ParameterUpdate:
+        """Returns one merged ParameterUpdate (chunks are concatenated in
+        server order, so the result is indistinguishable from the unary
+        response)."""
+        if not self._streaming():
+            return self.call("ServeParameters", request, timeout=timeout)
+        try:
+            chunks = self.call("ServeParametersStream", request,
+                               timeout=timeout)
+            merged: list[m.Tensor] = []
+            iteration, ready = 0, False
+            got_any = False
+            for chunk in chunks:
+                got_any = True
+                iteration, ready = chunk.iteration, chunk.ready
+                merged.extend(chunk.parameters)
+            self._stream_ok = True
+            if not got_any:  # zero-chunk stream: treat as an empty store
+                return self.call("ServeParameters", request, timeout=timeout)
+            return m.ParameterUpdate(iteration=iteration, parameters=merged,
+                                     ready=ready)
+        except grpc.RpcError as exc:
+            if _status_code(exc) != grpc.StatusCode.UNIMPLEMENTED:
+                raise
+            self._stream_ok = False
+            return self.call("ServeParameters", request, timeout=timeout)

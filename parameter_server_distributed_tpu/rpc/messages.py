@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .wire import Field, Message
+from .wire import ArrayPayload, Field, Message
 
 # --------------------------------------------------------------------------
 # parameter_server package
@@ -80,9 +80,14 @@ class Tensor(Message):
                      else DTYPE_FLOAT32)
         arr = src.astype(np.float32, copy=False)  # zero-copy for f32 input
         if wire_dtype == WIRE_RAW_F32:
-            payload = np.ascontiguousarray(arr.reshape(-1), "<f4").tobytes()
+            # lazy payload: the (no-op) cast-and-store happens straight into
+            # the outgoing message buffer at encode time (wire.ArrayPayload)
+            payload = ArrayPayload(np.ascontiguousarray(arr.reshape(-1)),
+                                   "<f4")
         elif wire_dtype == WIRE_BF16:
-            payload = arr.reshape(-1).astype(_bf16_dtype()).tobytes()
+            # lazy payload: f32->bf16 conversion fused into the encode write
+            payload = ArrayPayload(np.ascontiguousarray(arr.reshape(-1)),
+                                   _bf16_dtype())
         elif wire_dtype == WIRE_INT8:
             flat = arr.reshape(-1)
             max_abs = float(np.max(np.abs(flat))) if flat.size else 0.0
@@ -96,15 +101,22 @@ class Tensor(Message):
                    packed=payload, packed_dtype=wire_dtype)
 
     def to_array(self) -> np.ndarray:
-        if self.packed_dtype == WIRE_BF16 and self.packed:
-            arr = np.frombuffer(self.packed, dtype=_bf16_dtype()).astype(
+        packed = self.packed
+        if isinstance(packed, ArrayPayload):
+            # locally-built tensor read back without a wire round-trip:
+            # materialize the exact bytes the wire would carry so the value
+            # matches what a remote peer would decode (bf16 quantization
+            # included)
+            packed = packed.tobytes()
+        if self.packed_dtype == WIRE_BF16 and packed:
+            arr = np.frombuffer(packed, dtype=_bf16_dtype()).astype(
                 np.float32)
-        elif self.packed_dtype == WIRE_RAW_F32 and self.packed:
-            arr = np.frombuffer(self.packed, dtype="<f4").astype(
+        elif self.packed_dtype == WIRE_RAW_F32 and packed:
+            arr = np.frombuffer(packed, dtype="<f4").astype(
                 np.float32, copy=False)
-        elif self.packed_dtype == WIRE_INT8 and self.packed:
-            scale = np.frombuffer(self.packed, dtype="<f4", count=1)[0]
-            arr = np.frombuffer(self.packed, dtype=np.int8,
+        elif self.packed_dtype == WIRE_INT8 and packed:
+            scale = np.frombuffer(packed, dtype="<f4", count=1)[0]
+            arr = np.frombuffer(packed, dtype=np.int8,
                                 offset=4).astype(np.float32) * scale
         else:
             arr = np.asarray(self.data, dtype=np.float32)
@@ -293,6 +305,18 @@ PARAMETER_SERVER_METHODS = {
     "CheckSyncStatus": (SyncStatusRequest, SyncStatusResponse),
     "SaveCheckpoint": (SaveCheckpointRequest, SaveCheckpointResponse),
     "LoadCheckpoint": (LoadCheckpointRequest, LoadCheckpointResponse),
+}
+
+# Streaming data-plane extension (rpc/data_plane.py): the same push/pull
+# payloads as a stream of chunk messages instead of one monolithic unary
+# message.  Kept OUT of PARAMETER_SERVER_METHODS, whose method set is the
+# reference IDL's (reference proto/parameter_server.proto:5-11) — these are
+# extra method names on the same service that a reference peer simply never
+# calls, and PSClient falls back to the unary RPCs when a reference server
+# answers UNIMPLEMENTED.
+PARAMETER_SERVER_STREAM_METHODS = {
+    "PushGradientsStream": (GradientUpdate, PushResponse, "stream_unary"),
+    "ServeParametersStream": (PullRequest, ParameterUpdate, "unary_stream"),
 }
 
 COORDINATOR_METHODS = {

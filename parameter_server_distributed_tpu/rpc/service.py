@@ -22,14 +22,32 @@ import grpc
 from .wire import Message
 
 
+def _spec(entry) -> tuple[type[Message], type[Message], str]:
+    """Normalize a method-table entry: (req, resp) -> unary-unary, or
+    (req, resp, style) with style in unary | stream_unary | unary_stream."""
+    if len(entry) == 2:
+        req_cls, resp_cls = entry
+        return req_cls, resp_cls, "unary"
+    req_cls, resp_cls, style = entry
+    return req_cls, resp_cls, style
+
+
 def bind_service(server: grpc.Server, service_name: str,
-                 methods: Mapping[str, tuple[type[Message], type[Message]]],
+                 methods: Mapping[str, tuple],
                  impl: Any) -> None:
     """Register ``impl`` on ``server``: for each method M, ``impl.M(request,
-    context)`` must exist and return the response message."""
+    context)`` must exist and return the response message (for
+    ``stream_unary`` the first argument is a request iterator; for
+    ``unary_stream`` the method returns an iterator of responses)."""
     handlers = {}
-    for method, (req_cls, resp_cls) in methods.items():
-        handlers[method] = grpc.unary_unary_rpc_method_handler(
+    for method, entry in methods.items():
+        req_cls, resp_cls, style = _spec(entry)
+        make_handler = {
+            "unary": grpc.unary_unary_rpc_method_handler,
+            "stream_unary": grpc.stream_unary_rpc_method_handler,
+            "unary_stream": grpc.unary_stream_rpc_method_handler,
+        }[style]
+        handlers[method] = make_handler(
             getattr(impl, method),
             request_deserializer=req_cls.decode,
             response_serializer=lambda msg: msg.encode(),
@@ -38,13 +56,23 @@ def bind_service(server: grpc.Server, service_name: str,
         (grpc.method_handlers_generic_handler(service_name, handlers),))
 
 
+# Shared channel/server options.  The HTTP/2 tuning matters for the bulk
+# data plane: the default 16KB frame size caps loopback/LAN throughput at a
+# fraction of line rate for tensor-sized messages (measured ~2x on streamed
+# chunks with 16MB frames); the larger write buffer keeps the transport fed
+# while the next chunk encodes.
+CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", 1 << 30),
+    ("grpc.max_receive_message_length", 1 << 30),
+    ("grpc.http2.max_frame_size", 16 << 20),
+    ("grpc.http2.write_buffer_size", 64 << 20),
+]
+
+
 def make_server(max_workers: int = 8) -> grpc.Server:
     return grpc.server(
         concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
-        options=[
-            ("grpc.max_send_message_length", 1 << 30),
-            ("grpc.max_receive_message_length", 1 << 30),
-        ])
+        options=CHANNEL_OPTIONS)
 
 
 class RpcClient:
@@ -53,20 +81,28 @@ class RpcClient:
     src/worker.cpp:143, parameter_server_service.cpp:181)."""
 
     def __init__(self, target: str, service_name: str,
-                 methods: Mapping[str, tuple[type[Message], type[Message]]]):
-        self._channel = grpc.insecure_channel(target, options=[
-            ("grpc.max_send_message_length", 1 << 30),
-            ("grpc.max_receive_message_length", 1 << 30),
-        ])
+                 methods: Mapping[str, tuple]):
+        self._channel = grpc.insecure_channel(target,
+                                              options=CHANNEL_OPTIONS)
         self._calls: dict[str, Callable] = {}
-        for method, (req_cls, resp_cls) in methods.items():
-            self._calls[method] = self._channel.unary_unary(
+        for method, entry in methods.items():
+            req_cls, resp_cls, style = _spec(entry)
+            make_call = {
+                "unary": self._channel.unary_unary,
+                "stream_unary": self._channel.stream_unary,
+                "unary_stream": self._channel.unary_stream,
+            }[style]
+            self._calls[method] = make_call(
                 f"/{service_name}/{method}",
                 request_serializer=lambda msg: msg.encode(),
                 response_deserializer=resp_cls.decode,
             )
 
     def call(self, method: str, request: Message, timeout: float | None = None):
+        """Unary call.  For a ``stream_unary`` method pass an ITERATOR of
+        request messages (gRPC pulls it from a sender thread, so per-chunk
+        encode overlaps transport); a ``unary_stream`` method returns an
+        iterator of response messages that decode as chunks arrive."""
         return self._calls[method](request, timeout=timeout)
 
     def close(self) -> None:

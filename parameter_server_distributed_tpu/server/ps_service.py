@@ -19,6 +19,7 @@ from ..core.optimizer import make_optimizer
 from ..core.ps_core import ParameterServerCore
 from ..core.tensor import from_wire, to_wire
 from ..rpc import messages as m
+from ..rpc.data_plane import split_tensors, stream_chunk_bytes
 from ..rpc.service import bind_service, make_server
 
 log = logging.getLogger("pst.ps")
@@ -55,6 +56,46 @@ class ParameterServerService:
             iteration=iteration,
             parameters=to_wire(params, wire_dtype=request.wire_dtype),
             ready=ready)
+
+    # RPC (framework extension, rpc/data_plane.py): client-streamed push.
+    # Chunks decode + convert to f32 as they arrive, overlapping transport;
+    # the core sees ONE receive_gradients call, so barrier/staleness
+    # semantics are exactly the unary RPC's.
+    def PushGradientsStream(self, request_iterator, context) -> m.PushResponse:
+        worker_id = iteration = None
+        grads: dict = {}
+        for chunk in request_iterator:
+            if worker_id is None:
+                worker_id, iteration = chunk.worker_id, chunk.iteration
+            for t in chunk.gradients:
+                grads[t.name] = t.to_array()
+        if worker_id is None:
+            return m.PushResponse(success=False, message="empty push stream")
+        result = self.core.receive_gradients(worker_id, iteration, grads)
+        return m.PushResponse(
+            success=result.success,
+            message=result.message,
+            iteration=result.iteration,
+            aggregation_complete=result.aggregation_complete,
+            workers_received=result.workers_received,
+            total_workers=result.total_workers,
+        )
+
+    # RPC (framework extension): server-streamed pull.  Tensors ship in
+    # chunk_bytes-sized groups; each chunk's fused bf16/raw encode happens
+    # as it is yielded, overlapping the previous chunk's transport.
+    def ServeParametersStream(self, request: m.PullRequest, context):
+        iteration, params, ready = self.core.serve_parameters(request.iteration)
+        tensors = to_wire(params, wire_dtype=request.wire_dtype)
+        sent = False
+        for group in split_tensors(tensors, stream_chunk_bytes() or
+                                   (32 << 20)):
+            sent = True
+            yield m.ParameterUpdate(iteration=iteration, parameters=group,
+                                    ready=ready)
+        if not sent:  # empty store still answers one (empty) chunk
+            yield m.ParameterUpdate(iteration=iteration, parameters=[],
+                                    ready=ready)
 
     # RPC: barrier poll (reference: src/parameter_server_service.cpp:85-95)
     def CheckSyncStatus(self, request: m.SyncStatusRequest, context) -> m.SyncStatusResponse:
@@ -122,7 +163,8 @@ class ParameterServer:
         """Start serving; returns the bound port (0 in config = ephemeral)."""
         self._server = make_server()
         bind_service(self._server, m.PARAMETER_SERVER_SERVICE,
-                     m.PARAMETER_SERVER_METHODS, self.service)
+                     {**m.PARAMETER_SERVER_METHODS,
+                      **m.PARAMETER_SERVER_STREAM_METHODS}, self.service)
         addr = f"{self.config.bind_address}:{self.config.port}"
         self._port = self._server.add_insecure_port(addr)
         if self._port == 0:

@@ -28,7 +28,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
 from ..rpc import messages as m
-from ..rpc.service import RpcClient
+from ..rpc.data_plane import PSClient
 
 
 def shard_owner(name: str, n_shards: int) -> int:
@@ -38,16 +38,18 @@ def shard_owner(name: str, n_shards: int) -> int:
 
 
 class ShardedPSClient:
-    """Fan-out/merge client over N parameter-server shards."""
+    """Fan-out/merge client over N parameter-server shards.  Each shard
+    connection is a :class:`rpc.data_plane.PSClient`, so pushes and pulls
+    ride the chunk-stream data plane per shard (with per-connection unary
+    fallback against reference servers)."""
 
     def __init__(self, addresses: Sequence[str],
                  service: str = m.PARAMETER_SERVER_SERVICE,
                  methods=None):
         if not addresses:
             raise ValueError("need at least one PS shard address")
-        methods = methods or m.PARAMETER_SERVER_METHODS
         self.addresses = list(addresses)
-        self._clients = [RpcClient(addr, service, methods)
+        self._clients = [PSClient(addr, service, methods)
                          for addr in addresses]
         # shard RPCs are independent — issue them concurrently so the
         # fan-out latency is max(shard latencies), not their sum
@@ -82,15 +84,34 @@ class ShardedPSClient:
         return [f.result() for f in futures]
 
     # ------------------------------------------------------------- push path
+    def push_gradients(self, update: m.GradientUpdate,
+                       timeout: float | None = None) -> m.PushResponse:
+        """Streaming-data-plane push (chunk streams per shard, concurrent
+        fan-out).  Same merge/stale-retry semantics as the unary path."""
+        if self.num_shards == 1:
+            return self._clients[0].push_gradients(update, timeout=timeout)
+        return self._push_sharded(update, timeout, stream=True)
+
     def _call_ReceiveGradients(self, request: m.GradientUpdate, timeout):
+        return self._push_sharded(request, timeout, stream=False)
+
+    def _push_sharded(self, request: m.GradientUpdate, timeout,
+                      stream: bool) -> m.PushResponse:
+        def push(client, update):
+            if stream:
+                return client.push_gradients(update, timeout=timeout)
+            return client.call("ReceiveGradients", update, timeout=timeout)
+
         per_shard: list[list] = [[] for _ in range(self.num_shards)]
         for tensor in request.gradients:
             per_shard[shard_owner(tensor.name, self.num_shards)].append(tensor)
-        responses = self._fan_out(
-            "ReceiveGradients",
-            [m.GradientUpdate(worker_id=request.worker_id,
-                              iteration=request.iteration, gradients=tensors)
-             for tensors in per_shard], timeout)
+        updates = [m.GradientUpdate(worker_id=request.worker_id,
+                                    iteration=request.iteration,
+                                    gradients=tensors)
+                   for tensors in per_shard]
+        futures = [self._pool.submit(push, client, update)
+                   for client, update in zip(self._clients, updates)]
+        responses = [f.result() for f in futures]
         # Async (bounded-staleness) partial failure: shards that accepted
         # applied the update ON ARRIVAL, so a blanket worker-level retry
         # would double-apply their partitions.  Re-push ONLY the rejected
@@ -105,12 +126,11 @@ class ShardedPSClient:
             if not stale:
                 break
             for i in stale:
-                responses[i] = self._clients[i].call(
-                    "ReceiveGradients",
+                responses[i] = push(
+                    self._clients[i],
                     m.GradientUpdate(worker_id=request.worker_id,
                                      iteration=responses[i].iteration,
-                                     gradients=per_shard[i]),
-                    timeout=timeout)
+                                     gradients=per_shard[i]))
         return m.PushResponse(
             success=all(r.success for r in responses),
             message="; ".join(sorted({r.message for r in responses})),
@@ -121,9 +141,24 @@ class ShardedPSClient:
             total_workers=max(r.total_workers for r in responses))
 
     # ------------------------------------------------------------- pull path
+    def pull_parameters(self, request: m.PullRequest,
+                        timeout: float | None = None) -> m.ParameterUpdate:
+        """Streaming-data-plane pull (chunk streams per shard, concurrent
+        fan-out), merged exactly like the unary path."""
+        if self.num_shards == 1:
+            return self._clients[0].pull_parameters(request, timeout=timeout)
+        futures = [self._pool.submit(client.pull_parameters, request,
+                                     timeout=timeout)
+                   for client in self._clients]
+        return self._merge_pulls([f.result() for f in futures])
+
     def _call_ServeParameters(self, request: m.PullRequest, timeout):
-        responses = self._fan_out("ServeParameters",
-                                  [request] * self.num_shards, timeout)
+        return self._merge_pulls(
+            self._fan_out("ServeParameters",
+                          [request] * self.num_shards, timeout))
+
+    @staticmethod
+    def _merge_pulls(responses) -> m.ParameterUpdate:
         merged: list = []
         for response in responses:
             merged.extend(response.parameters)

@@ -38,6 +38,7 @@ import numpy as np
 from ..config import WorkerConfig
 from ..core.tensor import TensorStore, from_wire, to_wire
 from ..rpc import messages as m
+from ..rpc.data_plane import PSClient
 from ..rpc.service import RpcClient
 from ..utils.metrics import MetricsLogger, StepTimer
 
@@ -115,8 +116,9 @@ class Worker:
             log.info("worker %d: %d PS shards at %s", self.config.worker_id,
                      len(resp.shards), list(resp.shards))
         else:
-            self._ps = RpcClient(self._ps_address, m.PARAMETER_SERVER_SERVICE,
-                                 m.PARAMETER_SERVER_METHODS)
+            # PSClient: chunk-stream data plane with automatic unary
+            # fallback against a reference PS (rpc/data_plane.py)
+            self._ps = PSClient(self._ps_address)
             log.info("worker %d: PS at %s", self.config.worker_id,
                      self._ps_address)
         self._reset_wire_negotiation()  # a new PS must re-prove packed support
@@ -205,11 +207,11 @@ class Worker:
     def pull_parameters(self, iteration: int) -> tuple[int, TensorStore]:
         """reference: src/worker.cpp:240-252."""
         resp = self.query_with_retry(
-            lambda: self._ps.call("ServeParameters",
-                                  m.PullRequest(worker_id=self.config.worker_id,
-                                                iteration=iteration,
-                                                wire_dtype=self._pull_wire_dtype()),
-                                  timeout=30.0))
+            lambda: self._ps.pull_parameters(
+                m.PullRequest(worker_id=self.config.worker_id,
+                              iteration=iteration,
+                              wire_dtype=self._pull_wire_dtype()),
+                timeout=30.0))
         if not self._peer_packed_ok and resp.parameters:
             if any(t.packed_dtype != m.WIRE_F32 for t in resp.parameters):
                 self._peer_packed_ok = True
@@ -252,7 +254,7 @@ class Worker:
         update = m.GradientUpdate(worker_id=self.config.worker_id,
                                   iteration=iteration, gradients=tensors)
         resp = self.query_with_retry(
-            lambda: self._ps.call("ReceiveGradients", update, timeout=30.0))
+            lambda: self._ps.push_gradients(update, timeout=30.0))
         if new_residual is not None and resp.success:
             # commit the carried error only for pushes the PS accepted — a
             # rejected (stale) push's gradient was discarded whole, so its

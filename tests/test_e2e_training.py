@@ -178,9 +178,19 @@ def test_bf16_worker_falls_back_against_f32_only_ps(tmp_path):
         seen_encodings.extend(t.packed_dtype for t in request.gradients)
         return orig_recv(ps.service, request, context)
 
+    def unimplemented_stream(request, context):
+        # a reference PS has no chunk-stream extension methods at all; an
+        # unknown method surfaces to the client as UNIMPLEMENTED, which is
+        # exactly what aborting here produces
+        import grpc
+        context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                      "reference PS: no streaming data plane")
+
     # patch BEFORE start(): bind_service captures bound methods at bind time
     ps.service.ServeParameters = serve_f32_only
     ps.service.ReceiveGradients = recording_recv
+    ps.service.PushGradientsStream = unimplemented_stream
+    ps.service.ServeParametersStream = unimplemented_stream
     ps_port = ps.start()
     coordinator = Coordinator(CoordinatorConfig(
         bind_address="127.0.0.1", port=0,

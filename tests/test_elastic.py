@@ -109,3 +109,110 @@ def test_scale_up_widens_barrier(elastic_cluster):
             w2.shutdown()
     finally:
         w0.shutdown()
+
+
+# --------------------------------------------------- core-level churn tests
+# (no gRPC: ParameterServerCore + a fake registry, exercising the elastic
+# barrier machinery of core/ps_core.py:122-137 directly)
+
+class _Registry:
+    """Fake live-worker provider counting how often the PS queries it."""
+
+    def __init__(self, live=2):
+        self.live = live
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        return self.live
+
+
+def _core(registry, ttl=0.0, total=99):
+    from parameter_server_distributed_tpu.core.ps_core import (
+        ParameterServerCore)
+    from parameter_server_distributed_tpu.core.optimizer import SGD
+
+    core = ParameterServerCore(total_workers=total, optimizer=SGD(1.0),
+                               live_workers_fn=registry,
+                               live_workers_ttl_s=ttl)
+    core.initialize_parameters({"w": np.array([4.0], np.float32)})
+    return core
+
+
+def test_live_workers_ttl_caches_provider_calls():
+    """barrier_width() is read on every push and 20 Hz sync poll; with a
+    TTL the provider (a remote registry RPC in production) is hit once per
+    window, and a width change only becomes visible after expiry."""
+    reg = _Registry(live=2)
+    core = _core(reg, ttl=60.0)
+    assert core.barrier_width() == 2
+    for _ in range(50):
+        core.barrier_width()
+    assert reg.calls == 1  # cached for the whole window
+    reg.live = 5
+    assert core.barrier_width() == 2  # stale until expiry
+    core._live_cache = (core._live_cache[0], 0.0)  # force expiry
+    assert core.barrier_width() == 5
+    assert reg.calls == 2
+
+
+def test_registry_flap_to_zero_falls_back_to_static_width():
+    """A coordinator outage (live count 0) must not collapse the barrier
+    to zero width — the static total_workers is the fallback."""
+    reg = _Registry(live=2)
+    core = _core(reg, total=7)
+    assert core.barrier_width() == 2
+    reg.live = 0
+    assert core.barrier_width() == 7  # static fallback, not 0
+    reg.live = 2
+    assert core.barrier_width() == 2  # recovers with the registry
+
+
+def test_shrink_mid_barrier_releases_parked_iteration():
+    """Worker 0 pushes at width 2, then worker 1 is evicted: the next sync
+    poll re-reads the width and fires the barrier with the one real
+    contributor (elastic release — nothing strands)."""
+    reg = _Registry(live=2)
+    core = _core(reg)
+    r = core.receive_gradients(0, 1, {"w": np.array([1.0], np.float32)})
+    assert not r.aggregation_complete
+    reg.live = 1  # eviction
+    _, ready, received, total = core.check_sync_status(1)
+    assert ready and received == 1 and total == 1
+    np.testing.assert_allclose(core.get_parameters()["w"], [3.0])
+
+
+def test_grow_mid_barrier_parks_until_all_new_workers_push():
+    """Width grows 1 -> 3 while an iteration is buffered: the barrier now
+    waits for the larger contributor set, then aggregates the mean over
+    ALL three pushes."""
+    reg = _Registry(live=1)
+    core = _core(reg)
+    reg.live = 3  # scale-up lands before the push is aggregated... but
+    # worker 0 already computed against width-1 expectations
+    r0 = core.receive_gradients(0, 1, {"w": np.array([3.0], np.float32)})
+    assert not r0.aggregation_complete and r0.total_workers == 3
+    _, ready, _, _ = core.check_sync_status(1)
+    assert not ready
+    core.receive_gradients(1, 1, {"w": np.array([3.0], np.float32)})
+    r2 = core.receive_gradients(2, 1, {"w": np.array([3.0], np.float32)})
+    assert r2.aggregation_complete and r2.workers_received == 3
+    np.testing.assert_allclose(core.get_parameters()["w"], [1.0])
+
+
+def test_churn_register_evict_reregister_with_ttl():
+    """Registry churn under a TTL: evict + rejoin inside one window is
+    invisible (cached width), and the width settles once the window
+    rolls — barrier semantics stay consistent throughout."""
+    reg = _Registry(live=2)
+    core = _core(reg, ttl=60.0)
+    assert core.barrier_width() == 2
+    reg.live = 1   # flap down...
+    reg.live = 2   # ...and straight back up within the TTL window
+    assert core.barrier_width() == 2 and reg.calls == 1
+    # worker 1 leaves for real; window rolls; a parked push releases
+    reg.live = 1
+    core.receive_gradients(0, 1, {"w": np.array([1.0], np.float32)})
+    core._live_cache = (core._live_cache[0], 0.0)  # window expiry
+    _, ready, received, total = core.check_sync_status(1)
+    assert ready and received == 1 and total == 1

@@ -141,9 +141,10 @@ def test_transient_rpc_failures_recovered_by_retry(tmp_path):
         loss = w.run_iteration(1)
         assert np.isfinite(loss)
         assert ps.core.current_iteration == 1
-        # the injection actually hit the pull and push paths
-        assert fail_counts["ServeParameters"] == 2
-        assert fail_counts["ReceiveGradients"] == 2
+        # the injection actually hit the pull and push paths (the worker's
+        # data plane rides the chunk-stream RPCs — rpc/data_plane.py)
+        assert fail_counts["ServeParametersStream"] == 2
+        assert fail_counts["PushGradientsStream"] == 2
     finally:
         if w is not None:
             w.shutdown()
@@ -301,8 +302,16 @@ def test_packed_wire_renegotiated_after_ps_replacement(tmp_path):
             seen_encodings.extend(t.packed_dtype for t in request.gradients)
             return orig_recv(ps2.service, request, context)
 
+        def unimplemented_stream(request, context):
+            # reference-like PS: no chunk-stream extension either — the
+            # worker's PSClient must fall back to the recorded unary RPCs
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "reference PS: no streaming data plane")
+
         ps2.service.ServeParameters = serve_f32_only
         ps2.service.ReceiveGradients = recording_recv
+        ps2.service.PushGradientsStream = unimplemented_stream
+        ps2.service.ServeParametersStream = unimplemented_stream
         ps2_port = ps2.start()
         ps2.ckpt.load(saved_path)
         coordinator.core.set_parameter_server_address("127.0.0.1", ps2_port)
@@ -346,12 +355,22 @@ def test_packed_wire_renegotiated_after_same_address_restart(tmp_path):
         ps2 = make_ps(tmp_path, coordinator, port=ps_port)
         seen_encodings = []
         orig_recv = type(ps2.service).ReceiveGradients
+        orig_stream = type(ps2.service).PushGradientsStream
 
         def recording_recv(request, context):
             seen_encodings.extend(t.packed_dtype for t in request.gradients)
             return orig_recv(ps2.service, request, context)
 
+        def recording_stream(request_iterator, context):
+            def record(chunks):
+                for chunk in chunks:
+                    seen_encodings.extend(t.packed_dtype
+                                          for t in chunk.gradients)
+                    yield chunk
+            return orig_stream(ps2.service, record(request_iterator), context)
+
         ps2.service.ReceiveGradients = recording_recv
+        ps2.service.PushGradientsStream = recording_stream
         ps2.start()
 
         # NO w.reconnect(): the stale negotiation must self-heal on pull

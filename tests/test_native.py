@@ -136,3 +136,63 @@ def test_ps_core_native_mean_agrees_with_numpy_path(rng):
     expect = -np.mean(grads, axis=0)  # lr=1.0, params started at 0
     np.testing.assert_allclose(ps.get_parameters()["w"], expect, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_native_adamw_matches_numpy(rng):
+    p = rng.standard_normal(257).astype(np.float32)
+    g = rng.standard_normal(257).astype(np.float32)
+    m = rng.standard_normal(257).astype(np.float32) * 0.1
+    v = np.abs(rng.standard_normal(257)).astype(np.float32) * 0.1
+    step, lr, b1, b2, eps, wd = 3, 1e-3, 0.9, 0.999, 1e-8, 0.1
+    em = b1 * m + (1 - b1) * g
+    ev = b2 * v + (1 - b2) * g * g
+    adam_term = (em / (1 - b1**step)) / (np.sqrt(ev / (1 - b2**step)) + eps)
+    ep = p - lr * (adam_term + wd * p)
+    assert native.adamw_native(p, g, m, v, lr, b1, b2, eps, step, wd)
+    np.testing.assert_allclose(m, em, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(v, ev, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(p, ep, rtol=1e-4, atol=1e-6)
+
+
+def test_host_adamw_native_and_numpy_paths_agree(rng):
+    from parameter_server_distributed_tpu.core.optimizer import make_optimizer
+
+    params = {"w": rng.standard_normal((17, 9)).astype(np.float32),
+              "b": rng.standard_normal(23).astype(np.float32)}
+    grad_seq = [{"w": rng.standard_normal((17, 9)).astype(np.float32),
+                 "b": rng.standard_normal(23).astype(np.float32)}
+                for _ in range(4)]
+    results = {}
+    for enabled in (True, False):
+        native.set_enabled(enabled)
+        try:
+            opt = make_optimizer("adamw", 0.01, weight_decay=0.1)
+            cur = dict(params)
+            for grads in grad_seq:
+                cur = opt.apply(cur, grads)
+            results[enabled] = cur
+        finally:
+            native.set_enabled(True)
+    for key in params:
+        np.testing.assert_allclose(results[True][key], results[False][key],
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_optimizer_state_snapshot_isolated_from_in_place_applies(rng):
+    """The hot path updates m/v in place; state_dict must deep-copy so a
+    checkpoint snapshot taken between applies stays frozen."""
+    from parameter_server_distributed_tpu.core.optimizer import make_optimizer
+
+    opt = make_optimizer("adamw", 0.01)
+    params = {"w": rng.standard_normal((8, 4)).astype(np.float32)}
+    grads = {"w": rng.standard_normal((8, 4)).astype(np.float32)}
+    params = opt.apply(params, grads)
+    snap = opt.state_dict()
+    frozen_m = snap["m"]["w"].copy()
+    opt.apply(params, grads)  # mutates internal m/v in place
+    np.testing.assert_array_equal(snap["m"]["w"], frozen_m)
+    # load_state_dict must also own its buffers
+    opt2 = make_optimizer("adamw", 0.01)
+    opt2.load_state_dict(snap)
+    opt2.apply(params, grads)
+    np.testing.assert_array_equal(snap["m"]["w"], frozen_m)

@@ -560,3 +560,75 @@ def test_pipeline_trained_checkpoint_serves_plain_generation(rng, tmp_path,
     assert rc == 0
     out = capsys.readouterr().out
     assert out.strip()  # decoded token ids printed
+
+
+# ----------------------------------------------------------- pipeline x MoE
+
+def test_pipelined_moe_matches_per_microbatch_reference(rng):
+    """pipe x MoE (moe_every=1, gpipe): the pipelined loss must equal the
+    mean over microbatches of the plain MoE model's loss on each
+    microbatch — expert capacity (and therefore token dropping) is a
+    per-microbatch statistic under pipelining, exactly as it is under any
+    microbatched MoE schedule."""
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=4,
+                               d_ff=64, max_seq=16, dtype=jnp.float32,
+                               moe_every=1, moe_experts=4)
+    plain = Transformer(config)
+    piped = PipelinedTransformerLM(plain, mesh, num_microbatches=2,
+                                   schedule="gpipe")
+    tokens = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    piped_params = piped.init_params(0)
+    plain_params = plain.init_params(0)
+
+    loss_piped = float(jax.jit(piped.loss)(piped_params, tokens))
+    # reference: the plain model on each (data shard, microbatch) piece —
+    # data rank d holds rows [2d, 2d+2), microbatch m is its m-th row
+    pieces = [tokens[row:row + 1] for row in range(tokens.shape[0])]
+    loss_ref = float(np.mean([jax.jit(plain.loss)(plain_params, piece)
+                              for piece in pieces]))
+    np.testing.assert_allclose(loss_piped, loss_ref, rtol=1e-5)
+
+
+def test_pipelined_moe_gradients_flow_to_experts(rng):
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=2, expert=4))
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                               d_ff=64, max_seq=16, dtype=jnp.float32,
+                               moe_every=1, moe_experts=4)
+    piped = PipelinedTransformerLM(Transformer(config), mesh,
+                                   num_microbatches=2, schedule="gpipe")
+    tokens = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    params = piped.init_params(0)
+    grads = jax.grad(piped.loss)(params, tokens)
+    assert "blocks/moe/w1" in grads
+    for name in ("blocks/moe/w1", "blocks/moe/w2", "blocks/moe/router/w"):
+        assert float(np.abs(np.asarray(grads[name])).max()) > 0, name
+
+
+def test_pipeline_moe_rejections(rng):
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    interleaved = Transformer(TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=4, d_ff=64, max_seq=16,
+        moe_every=2, moe_experts=4))
+    with pytest.raises(ValueError, match="homogeneous"):
+        PipelinedTransformerLM(interleaved, mesh)
+    all_moe = Transformer(TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=4, d_ff=64, max_seq=16,
+        moe_every=1, moe_experts=4))
+    with pytest.raises(ValueError, match="gpipe"):
+        PipelinedTransformerLM(all_moe, mesh, schedule="1f1b")

@@ -284,3 +284,79 @@ def test_host_adamw_decays_matrices_only():
     out = opt.apply(params, zero)
     np.testing.assert_array_equal(out["ln/scale"], params["ln/scale"])
     assert out["w"].max() < 1.0
+
+
+# ------------------------------------------------- async non-blocking serve
+
+class _LazyArray(np.ndarray):
+    """numpy array with a jax-like async-materialization surface: is_ready
+    flips when block_until_ready() is called (or the test flips it)."""
+
+    def __new__(cls, values):
+        obj = np.asarray(values, np.float32).view(cls)
+        obj._ready = False
+        return obj
+
+    def is_ready(self):
+        return self._ready
+
+    def block_until_ready(self):
+        self._ready = True
+        return self
+
+
+class _LazyOptimizer(SGD):
+    """SGD whose outputs pretend to be in-flight device computations."""
+
+    def apply(self, params, grads):
+        out = super().apply(params, grads)
+        return {k: _LazyArray(v) for k, v in out.items()}
+
+
+def test_async_serve_does_not_block_on_in_flight_apply():
+    """Bounded-staleness reads never wait on device compute: while the
+    newest store is an unmaterialized promise, the previous materialized
+    version is served; once the apply lands, the new store is promoted."""
+    ps = ParameterServerCore(total_workers=1, staleness_bound=10,
+                             optimizer=_LazyOptimizer(0.5))
+    ps.initialize_parameters(store(w=[10.0]))
+    r = ps.receive_gradients(0, 0, store(w=[2.0]))
+    assert r.success
+    # apply in flight: serve returns the PREVIOUS (materialized) params
+    _, served, ready = ps.serve_parameters()
+    assert ready
+    np.testing.assert_allclose(served["w"], [10.0])
+    # apply lands -> next serve promotes the new store
+    with ps._params_lock:
+        for v in ps._params.values():
+            v.block_until_ready()
+    _, served2, _ = ps.serve_parameters()
+    np.testing.assert_allclose(served2["w"], [9.0])
+
+
+def test_async_depth_bound_fences_previous_apply():
+    """A second push while the previous apply is still in flight fences on
+    it first (depth-1 pipeline), so the XLA queue cannot grow without
+    bound; values stay exact."""
+    ps = ParameterServerCore(total_workers=1, staleness_bound=10,
+                             optimizer=_LazyOptimizer(0.5))
+    ps.initialize_parameters(store(w=[10.0]))
+    ps.receive_gradients(0, 0, store(w=[2.0]))   # -> 9.0, in flight
+    ps.receive_gradients(0, 1, store(w=[2.0]))   # fences 9.0, -> 8.0
+    _, served, _ = ps.serve_parameters()
+    np.testing.assert_allclose(served["w"], [9.0])  # 8.0 still in flight
+    with ps._params_lock:
+        for v in ps._params.values():
+            v.block_until_ready()
+    _, served2, _ = ps.serve_parameters()
+    np.testing.assert_allclose(served2["w"], [8.0])
+
+
+def test_sync_serve_unaffected_by_nonblocking_path():
+    """Sync (barrier) mode always serves _params itself — clients polled
+    the barrier and must observe post-aggregation values."""
+    ps = ParameterServerCore(total_workers=1, optimizer=SGD(1.0))
+    ps.initialize_parameters(store(w=[4.0]))
+    ps.receive_gradients(0, 1, store(w=[1.0]))
+    _, served, _ = ps.serve_parameters()
+    np.testing.assert_allclose(served["w"], [3.0])

@@ -148,3 +148,92 @@ def test_serve_parameters_in_requested_wire_dtype(ps):
                             m.PullRequest(worker_id=0, iteration=1))
         np.testing.assert_allclose(after.parameters[0].to_array(), w - 0.25,
                                    rtol=1e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------- streaming
+# Chunk-stream data plane (rpc/data_plane.py): same payloads as the unary
+# RPCs, shipped as streams of smaller GradientUpdate/ParameterUpdate chunks.
+
+def test_streaming_push_pull_matches_unary(ps):
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+
+    server, port = ps
+    rng = np.random.default_rng(0)
+    params = {f"w{i}": rng.standard_normal((64, 8)).astype(np.float32)
+              for i in range(7)}
+    server.core.initialize_parameters(params)
+    # chunk_bytes far below one tensor: every tensor rides its own chunk
+    with PSClient(f"127.0.0.1:{port}", chunk_bytes=128) as client:
+        pulled = client.pull_parameters(
+            m.PullRequest(worker_id=0, iteration=0, wire_dtype=m.WIRE_BF16))
+        assert client._stream_ok is True
+        assert pulled.ready
+        assert {t.name for t in pulled.parameters} == set(params)
+        for t in pulled.parameters:
+            np.testing.assert_allclose(t.to_array(), params[t.name],
+                                       rtol=8e-3, atol=1e-2)
+        grads = [m.Tensor.from_array(k, np.full_like(v, 0.5))
+                 for k, v in params.items()]
+        for wid in (0, 1):
+            push = client.push_gradients(
+                m.GradientUpdate(worker_id=wid, iteration=1, gradients=grads))
+            assert push.success
+        assert push.aggregation_complete
+        after = client.pull_parameters(
+            m.PullRequest(worker_id=0, iteration=1))
+        for t in after.parameters:
+            np.testing.assert_allclose(t.to_array(), params[t.name] - 0.5,
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_streaming_falls_back_against_unary_only_server(tmp_path):
+    """A server binding only the reference's 5 unary RPCs (a reference PS)
+    answers UNIMPLEMENTED for the stream methods; PSClient must fall back
+    to unary and remember (per connection)."""
+    from parameter_server_distributed_tpu.checkpoint.manager import CheckpointManager
+    from parameter_server_distributed_tpu.core.ps_core import ParameterServerCore
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+    from parameter_server_distributed_tpu.rpc.service import (bind_service,
+                                                              make_server)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServerService)
+
+    core = ParameterServerCore(total_workers=1)
+    core.initialize_parameters({"w": np.array([1.0, 2.0], np.float32)})
+    service = ParameterServerService(
+        core, CheckpointManager(core, directory=str(tmp_path),
+                                checkpoint_interval=100, check_period_s=600.0))
+    server = make_server()
+    bind_service(server, m.PARAMETER_SERVER_SERVICE,
+                 m.PARAMETER_SERVER_METHODS, service)  # unary only
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        with PSClient(f"127.0.0.1:{port}") as client:
+            pulled = client.pull_parameters(m.PullRequest(worker_id=0,
+                                                          iteration=0))
+            assert client._stream_ok is False
+            np.testing.assert_allclose(pulled.parameters[0].to_array(),
+                                       [1.0, 2.0])
+            push = client.push_gradients(m.GradientUpdate(
+                worker_id=0, iteration=1,
+                gradients=[m.Tensor.from_array(
+                    "w", np.array([0.5, 0.5], np.float32))]))
+            assert push.success and push.aggregation_complete
+    finally:
+        server.stop(0)
+
+
+def test_streaming_empty_push_still_contributes_to_barrier(ps):
+    """Sharded topology invariant: a shard owning none of the pushed
+    tensors still receives the (empty) push as a barrier contribution —
+    the stream variant must send one empty chunk, not zero chunks."""
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+
+    server, port = ps
+    server.core.initialize_parameters({"w": np.array([1.0], np.float32)})
+    with PSClient(f"127.0.0.1:{port}") as client:
+        push = client.push_gradients(
+            m.GradientUpdate(worker_id=0, iteration=1, gradients=[]))
+        assert push.success
+        assert push.workers_received == 1 and push.total_workers == 2

@@ -225,3 +225,34 @@ def test_raw_f32_decode_is_writable(rng):
         out = m.Tensor.decode(
             m.Tensor.from_array("w", arr, wire_dtype=wd).encode()).to_array()
         out += 1.0  # raises on read-only arrays
+
+
+def test_lazy_array_payload_encodes_identically_to_eager_bytes(rng):
+    """ArrayPayload (fused convert-into-buffer encode) must produce byte-
+    identical messages to an eager astype+tobytes payload, and to_array on
+    a locally built tensor must return the same quantized values a wire
+    round-trip would."""
+    from parameter_server_distributed_tpu.rpc.wire import ArrayPayload
+
+    arr = rng.standard_normal((33, 17)).astype(np.float32)
+    for wd, np_dtype in ((m.WIRE_BF16, None), (m.WIRE_RAW_F32, "<f4")):
+        t = m.Tensor.from_array("w", arr, wire_dtype=wd)
+        assert isinstance(t.packed, ArrayPayload)
+        eager = m.Tensor(name="w", shape=list(arr.shape),
+                         packed=t.packed.tobytes(), packed_dtype=wd)
+        assert t.encode() == eager.encode()
+        # local read-back equals the decoded wire value
+        decoded = m.Tensor.decode(t.encode())
+        np.testing.assert_array_equal(t.to_array(), decoded.to_array())
+
+
+def test_writer_output_is_plain_bytes(rng):
+    """encode() must hand gRPC a real `bytes` object (its cython layer
+    rejects bytearray/memoryview), produced without a final whole-message
+    copy (wire._Writer's uninitialized-bytes backing)."""
+    t = m.Tensor.from_array("w", rng.standard_normal(257).astype(np.float32),
+                            wire_dtype=m.WIRE_BF16)
+    buf = m.GradientUpdate(worker_id=1, iteration=2, gradients=[t]).encode()
+    assert type(buf) is bytes
+    back = m.GradientUpdate.decode(buf)
+    assert back.worker_id == 1 and back.gradients[0].name == "w"

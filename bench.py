@@ -513,6 +513,75 @@ def _ab_host_optimizer() -> None:
         f"({times[False]/times[True]:.2f}x)")
 
 
+def _train_target_and_draft(model, params, draft, dparams, batch: int,
+                            steps: int):
+    """Fit target and draft LMs on the same corpus for the trained-draft
+    speculative row.  Corpus = this package's .py sources byte-tokenized
+    (data/text.py) — learnable structure, vocab 258 <= any registry LM's.
+    Returns (params, dparams, in-distribution prompts, losses)."""
+    import glob
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from parameter_server_distributed_tpu.data.text import (ByteTokenizer,
+                                                            require_vocab,
+                                                            text_stream)
+
+    # both models embed byte-tokenizer ids (0..257): reject a vocab that
+    # cannot, instead of letting the gather clamp indices and silently
+    # train on garbage
+    require_vocab(model.config.vocab, ByteTokenizer())
+    require_vocab(draft.config.vocab, ByteTokenizer())
+
+    pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "parameter_server_distributed_tpu")
+    corpus_path = "/tmp/psdt_bench_corpus.txt"
+    sources = sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True))
+    newest_src = max(os.path.getmtime(p) for p in sources)
+    if (not os.path.exists(corpus_path)
+            or os.path.getmtime(corpus_path) < newest_src):
+        # regenerate whenever any source is newer (the repo grows every
+        # round — a stale snapshot would make the losses irreproducible);
+        # write-then-rename so a crash mid-write can't leave a truncated
+        # corpus that os.path.exists() would accept forever
+        chunks = []
+        for path in sources:
+            with open(path, errors="replace") as fh:
+                chunks.append(fh.read())
+        tmp = corpus_path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write("\n\n".join(chunks))
+        os.replace(tmp, corpus_path)
+
+    def fit(m, p, seed):
+        tx = optax.adam(1e-3)
+        opt_state = tx.init(p)
+
+        @jax.jit
+        def step(p, opt_state, tokens):
+            loss, grads = jax.value_and_grad(m.loss)(p, tokens)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        batches = text_stream(corpus_path, batch, m.config.max_seq,
+                              seed=seed, cache_dir="/tmp")
+        loss = float("nan")
+        for _ in range(steps):
+            p, opt_state, loss = step(p, opt_state,
+                                      jnp.asarray(next(batches)))
+        return p, float(loss)
+
+    params, tloss = fit(model, params, seed=1)
+    dparams, dloss = fit(draft, dparams, seed=1)
+    prompts = next(text_stream(corpus_path, batch, 32, seed=7,
+                               cache_dir="/tmp"))
+    return params, dparams, np.asarray(prompts, np.int32), tloss, dloss
+
+
 def bench_generate() -> dict:
     """KV-cached decode throughput (tokens/sec/chip) for the LM flagship.
     PSDT_BENCH_MODEL picks the registry LM (small_lm | moe_lm); batch and
@@ -529,6 +598,7 @@ def bench_generate() -> dict:
     name = os.environ.get("PSDT_BENCH_MODEL", "small_lm")
     batch = int(os.environ.get("PSDT_BENCH_BATCH", "8"))
     max_new = int(os.environ.get("PSDT_BENCH_STEPS", "64"))
+    train_steps = int(os.environ.get("PSDT_BENCH_TRAIN_STEPS", "0"))
     model, _ = get_model_and_batches(name, batch)
     params = model.init_params(0)
     rng = np.random.default_rng(0)
@@ -546,6 +616,17 @@ def bench_generate() -> dict:
         else:
             draft, _ = get_model_and_batches(draft_name, 1)
             dparams = draft.init_params(1)
+        if train_steps and draft_name != "self":
+            # TRAINED draft: fit target and draft on the same byte-level
+            # corpus (this package's own source code — real structure a
+            # 1-layer draft can learn), then bench on in-distribution
+            # prompts.  This sits between the accept->0 (random draft)
+            # and accept->1 ("self") brackets with a REAL accept rate.
+            params, dparams, prompt, tloss, dloss = _train_target_and_draft(
+                model, params, draft, dparams, batch, train_steps)
+            log(f"bench_generate: trained {train_steps} steps on the "
+                f"source-code byte corpus: target loss {tloss:.3f}, "
+                f"draft loss {dloss:.3f}")
         draft_len = int(os.environ.get("PSDT_BENCH_DRAFT_LEN", "4"))
         reps = 3
         # greedy baseline with the SAME batch: the speedup denominator
@@ -572,7 +653,9 @@ def bench_generate() -> dict:
             f"{base_tps:,.0f} ({tps / base_tps:.2f}x), "
             f"{stats['tokens_per_target_forward']:.2f} tokens/target-fwd, "
             f"accept {stats['draft_accept_rate']:.2f}")
-        return {"metric": f"{name}_speculative_tokens_per_sec",
+        suffix = (f"_trained{train_steps}" if train_steps
+                  and draft_name != "self" else "")
+        return {"metric": f"{name}_speculative_tokens_per_sec{suffix}",
                 "value": round(tps, 1), "unit": "tokens/sec",
                 "vs_baseline": round(tps / base_tps, 3)}
 

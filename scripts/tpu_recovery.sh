@@ -46,6 +46,7 @@ run lm350_dense_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSD
 run decode_small_lm              PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run spec_perfect_draft           PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=self PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run spec_tiny_draft              PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
+run spec_trained_draft_k2        PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_TRAIN_STEPS=200 PSDT_BENCH_DRAFT_LEN=2 PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 # -- 5. remaining sweep matrix (scan layout variants)
 run lm350_scan_remat_b32         PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
 run lm350_flash_remat_b32        PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_ATTENTION=flash

@@ -76,3 +76,21 @@ def test_bench_preflight_spaced_retry_then_fallback():
     assert result["metric"].endswith("_cpu_fallback")
     assert "2 spaced probes" in result.get("note", "")
     assert result["value"] > 0
+
+
+@pytest.mark.slow
+def test_bench_generate_trained_draft_contract():
+    """PSDT_BENCH_TRAIN_STEPS fits target+draft on the source-code byte
+    corpus before the speculative A/B; the JSON contract must hold and the
+    metric must carry the trained suffix."""
+    result = run_bench("generate", extra_env={
+        "PSDT_BENCH_MODEL": "small_lm",
+        "PSDT_BENCH_DRAFT": "tiny_lm",
+        "PSDT_BENCH_TRAIN_STEPS": "3",
+        "PSDT_BENCH_BATCH": "2",
+        "PSDT_BENCH_STEPS": "8",
+        "PSDT_BENCH_DRAFT_LEN": "2",
+    })
+    assert "speculative" in result["metric"]
+    assert "_trained3" in result["metric"]
+    assert result["value"] > 0

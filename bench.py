@@ -746,6 +746,81 @@ def bench_async() -> dict:
             "vs_baseline": 1.0}
 
 
+def bench_attention() -> dict:
+    """Attention-op A/B at long sequence: fwd+bwd wall time for the
+    implementations in PSDT_BENCH_ATTN_IMPLS (default dense,xla_flash,
+    flash; flash = pallas, only meaningful on TPU).  Shape knobs:
+    PSDT_BENCH_SEQ (default 8192), PSDT_BENCH_BATCH (1), PSDT_BENCH_HEADS
+    (16), PSDT_BENCH_HEAD_DIM (64), PSDT_BENCH_KV_HEADS (= heads).
+    Reports the best non-dense speedup vs dense as vs_baseline."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from parameter_server_distributed_tpu.models.transformer import (
+        causal_attention, flash_attention_auto)
+    from parameter_server_distributed_tpu.ops.xla_flash import (
+        make_xla_flash_attention)
+
+    seq = int(os.environ.get("PSDT_BENCH_SEQ", "8192"))
+    batch = int(os.environ.get("PSDT_BENCH_BATCH", "1"))
+    heads = int(os.environ.get("PSDT_BENCH_HEADS", "16"))
+    head_dim = int(os.environ.get("PSDT_BENCH_HEAD_DIM", "64"))
+    kv_heads = int(os.environ.get("PSDT_BENCH_KV_HEADS", "0")) or heads
+    impls = os.environ.get("PSDT_BENCH_ATTN_IMPLS",
+                           "dense,xla_flash,flash").split(",")
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((batch, seq, heads, head_dim)),
+                    dtype)
+    k = jnp.asarray(rng.standard_normal((batch, seq, kv_heads, head_dim)),
+                    dtype)
+    v = jnp.asarray(rng.standard_normal((batch, seq, kv_heads, head_dim)),
+                    dtype)
+    fns = {"dense": causal_attention,
+           "xla_flash": make_xla_flash_attention(),
+           "flash": flash_attention_auto}
+    reps = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 3
+    times: dict[str, float] = {}
+    for impl in impls:
+        impl = impl.strip()
+        if impl == "flash" and not on_tpu:
+            log("bench_attention: skipping pallas flash off-TPU "
+                "(interpret mode is not a perf datapoint)")
+            continue
+        fn = fns[impl]
+        step = jax.jit(jax.value_and_grad(
+            lambda q, fn=fn: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)))
+        l, g = step(q)
+        jax.block_until_ready((l, g))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            l, g = step(q)
+        jax.block_until_ready((l, g))
+        times[impl] = (time.perf_counter() - t0) / reps
+        log(f"bench_attention: {impl} seq={seq} b={batch} h={heads} "
+            f"d={head_dim}: {times[impl]*1e3:.0f} ms fwd+bwd")
+    if not times:
+        return {"metric": "attention_ab_skipped", "value": 0.0,
+                "unit": "none", "vs_baseline": 0.0,
+                "note": "every requested impl was skipped on this backend"}
+    if "dense" not in times or len(times) < 2:
+        best = min(times, key=times.get)
+        return {"metric": f"attention_{best}_s{seq}_ms",
+                "value": round(times[best] * 1e3, 1), "unit": "ms",
+                "vs_baseline": 1.0}
+    contenders = {k: v for k, v in times.items() if k != "dense"}
+    best = min(contenders, key=contenders.get)
+    speedup = times["dense"] / contenders[best]
+    log(f"bench_attention: best {best} = {speedup:.2f}x vs dense")
+    return {"metric": f"attention_{best}_vs_dense_s{seq}",
+            "value": round(speedup, 3), "unit": "speedup_x",
+            "vs_baseline": round(speedup, 3)}
+
+
 def child_main(mode: str) -> int:
     """Run ONE measurement in-process (called in a subprocess by main)."""
     _configure_platform()
@@ -756,6 +831,8 @@ def child_main(mode: str) -> int:
             result = bench_async()
         elif mode == "generate":
             result = bench_generate()
+        elif mode == "attention":
+            result = bench_attention()
         else:
             result = bench_mfu()
     except Exception as exc:  # noqa: BLE001 — always emit the JSON line

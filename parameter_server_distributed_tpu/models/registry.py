@@ -79,6 +79,10 @@ REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator], str]] = {
     "lm_350m": (lm_350m, _lm_350m_batches, "tokens"),
     "lm_350m_gqa": (partial(lm_350m, kv_heads=4), _lm_350m_batches,
                     "tokens"),
+    # head_dim-128 flagship: 8 heads x 128 — a full MXU tile per
+    # attention matmul (the flash kernel's preferred shape)
+    "lm_350m_hd128": (partial(lm_350m, n_heads=8), _lm_350m_batches,
+                      "tokens"),
 }
 
 DTYPE_NAMES = {"f32": "float32", "float32": "float32",

@@ -181,7 +181,8 @@ def make_sharded_flash_attention(mesh: Mesh,
     return sharded_flash_gqa
 
 
-ATTENTION_CHOICES = ("dense", "flash", "ring", "ulysses", "ulysses_flash")
+ATTENTION_CHOICES = ("dense", "flash", "xla_flash", "ring", "ulysses",
+                     "ulysses_flash")
 
 
 def select_attention(name: str, mesh: Mesh | None) -> Callable | None:
@@ -190,6 +191,11 @@ def select_attention(name: str, mesh: Mesh | None) -> Callable | None:
     dense   — einsum causal attention (GSPMD partitions it over the mesh)
     flash   — pallas flash kernels; with a mesh, shard_mapped over
               batch/head shards (seq must be unsharded)
+    xla_flash — the same blockwise online-softmax recurrence in plain
+              lax.scan (ops/xla_flash.py): compiled natively on every
+              backend, O(S) residuals via per-block remat; the long-
+              context path where pallas is unavailable, and the pallas
+              kernels' A/B contender on TPU
     ring    — ring attention over the mesh's ``seq`` axis (K/V ppermute)
     ulysses — all-to-all seq<->heads swap, dense attention per head shard
     ulysses_flash — same swap, pallas flash kernel on the gathered
@@ -203,6 +209,11 @@ def select_attention(name: str, mesh: Mesh | None) -> Callable | None:
         if mesh is None:
             return flash_attention_auto
         return make_sharded_flash_attention(mesh)
+    if name == "xla_flash":
+        from ..ops.xla_flash import make_xla_flash_attention
+        # plain einsums + scan: with a mesh, GSPMD partitions it over the
+        # batch/head axes exactly like dense — no shard_map needed
+        return make_xla_flash_attention()
     if name in ("ring", "ulysses", "ulysses_flash"):
         if mesh is None:
             raise ValueError(f"--attention={name} needs a mesh with a seq axis")
@@ -735,7 +746,7 @@ def tiny_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
 
 def lm_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
             remat: bool = True, scan_layers: bool = False,
-            kv_heads: int = 0) -> Transformer:
+            kv_heads: int = 0, n_heads: int = 16) -> Transformer:
     """~370M-param GPT-style flagship for the LM MFU benchmark: 24 layers,
     d_model 1024, seq 1024, bf16 weights/activations with f32 MXU
     accumulation, per-layer remat by default (activation memory, not HBM
@@ -746,8 +757,11 @@ def lm_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
     all 16; the `lm_350m_gqa` registry entry uses 4): kv_heads/16 the
     KV-cache HBM and ring/Ulysses ICI bytes, and the GQA-folded flash
     kernel keeps K/V unexpanded end to end."""
+    # n_heads=8 gives head_dim 128 — a full MXU tile per attention
+    # matmul (head_dim 64 halves MXU utilization; the r02 on-chip flash
+    # measurement showed it) — same parameter count either way
     return Transformer(TransformerConfig(
-        vocab=vocab, d_model=1024, n_heads=16, n_layers=24, d_ff=4096,
+        vocab=vocab, d_model=1024, n_heads=n_heads, n_layers=24, d_ff=4096,
         n_kv_heads=kv_heads,
         max_seq=seq, dtype=dtype, remat=remat, scan_layers=scan_layers,
         # largest chunk <= 128 dividing seq, so every seq stays valid

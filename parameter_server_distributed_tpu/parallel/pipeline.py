@@ -127,11 +127,14 @@ class PipelinedTransformerLM:
             self._stage_attention = causal_attention
         elif attention == "flash":
             self._stage_attention = flash_attention_auto
+        elif attention == "xla_flash":
+            from ..ops.xla_flash import make_xla_flash_attention
+            self._stage_attention = make_xla_flash_attention()
         elif attention is None:
             self._stage_attention = inner.attention_fn
         else:
             raise ValueError(
-                f"pipeline stages support attention dense|flash, "
+                f"pipeline stages support attention dense|flash|xla_flash, "
                 f"got {attention!r}")
         self.inner = inner
         self.config = inner.config

@@ -47,7 +47,8 @@ class TrainLoopConfig:
     eval_steps: int = 4           # batches averaged per evaluation
     eval_data_path: str = ""      # held-out data; empty = shifted-seed
                                   # synthetic stream
-    attention: str = "dense"      # dense | flash | ring | ulysses (LM models)
+    attention: str = "dense"      # dense | flash | xla_flash | ring |
+                                  # ulysses | ulysses_flash (LM models)
     microbatches: int = 0         # pipeline microbatches (0 = pipe size)
     pipeline_schedule: str = "gpipe"  # gpipe | 1f1b (pipe axis > 1)
     virtual_stages: int = 1       # interleaved 1F1B chunks per pipe rank
@@ -118,10 +119,11 @@ def run_training(config: TrainLoopConfig) -> dict:
             # the per-device kernel: dense einsum or the pallas flash
             # kernel (ring/ulysses need a seq axis, which pipe does not
             # compose with).
-            if config.attention not in ("dense", "flash"):
+            if config.attention not in ("dense", "flash", "xla_flash"):
                 raise ValueError(
-                    "--attention must be dense or flash with a pipe axis "
-                    "(stage-internal attention runs inside shard_map)")
+                    "--attention must be dense, flash, or xla_flash with a "
+                    "pipe axis (stage-internal attention runs inside "
+                    "shard_map; ring/ulysses need a seq axis)")
             from .pipeline import PipelinedTransformerLM
             model = PipelinedTransformerLM(
                 model, mesh, num_microbatches=config.microbatches,

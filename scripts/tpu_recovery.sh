@@ -36,9 +36,15 @@ run lm350_dense_remat_b32        PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32
 run lm350_dense_remat_b32_credit PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT_CREDIT=1
 run lm350_dense_noremat_b32      PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT=0
 run lm350_dense_remat_b64        PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=64
+run lm350_hd128_dense_b32        PSDT_BENCH_MODEL=lm_350m_hd128 PSDT_BENCH_BATCH=32
+run lm350_xlaflash_b32           PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_ATTENTION=xla_flash
 # -- 3. long-context crossover
+run attn_ab_seq4096              PSDT_BENCH_MODE=attention PSDT_BENCH_SEQ=4096
+run attn_ab_seq8192              PSDT_BENCH_MODE=attention PSDT_BENCH_SEQ=8192
+run attn_ab_seq8192_hd128        PSDT_BENCH_MODE=attention PSDT_BENCH_SEQ=8192 PSDT_BENCH_HEADS=8 PSDT_BENCH_HEAD_DIM=128
 run lm350_flash_seq4096_b8       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=flash
 run lm350_dense_seq4096_b8       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096
+run lm350_hd128_seq4096_b8       PSDT_BENCH_MODEL=lm_350m_hd128 PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=flash
 run gqa_flash_seq4096_b8         PSDT_BENCH_MODEL=lm_350m_gqa PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=flash
 run lm350_flash_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192 PSDT_BENCH_ATTENTION=flash
 run lm350_dense_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192

@@ -962,9 +962,16 @@ def main() -> int:
         if result is not None:
             if platform == "cpu" and errors:
                 # Honest labeling: the TPU was unavailable; this number is
-                # a host-CPU measurement, not the headline TPU metric.
+                # a host-CPU measurement, not the headline TPU metric, and
+                # on the shared 1-core host it carries load noise (r02 vs
+                # r03 swung -26% on identical code) — flag it as
+                # non-comparable instead of implying parity
                 result["metric"] = f"{result['metric']}_cpu_fallback"
-                result["note"] = "; ".join(errors)[:800]
+                result["vs_baseline"] = 0.0
+                result["note"] = (
+                    "CPU fallback: host-load noise up to +/-40% "
+                    "run-to-run; not comparable across rounds or to TPU "
+                    "rows. TPU errors: " + "; ".join(errors))[:800]
             print(json.dumps(result), flush=True)
             return 0
         errors.append(err)

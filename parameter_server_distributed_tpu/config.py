@@ -56,8 +56,12 @@ class ParameterServerConfig:
     autosave_period_s: float = AUTOSAVE_CHECK_PERIOD_S
     learning_rate: float = 1.0   # reference applies param -= mean_grad (lr=1.0)
     # extensions beyond the reference:
-    optimizer: str = "sgd"       # sgd | momentum | adam | adamw |
-                                 # device_* | pallas_*
+    optimizer: str = "sgd"       # sgd | momentum | adam | adamw (host,
+                                 # native C++ fused kernels) | device_sgd |
+                                 # device_momentum | device_adam |
+                                 # device_adamw | device_adamw_bf16 (bf16
+                                 # moment slots: half the state HBM) |
+                                 # pallas_sgd | pallas_momentum | pallas_adam
     momentum: float = 0.9
     weight_decay: float = 1e-4   # adamw variants only (matrices-only decay)
     staleness_bound: int = 0     # 0 = strictly synchronous (reference behavior)

@@ -255,6 +255,9 @@ def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9,
             return DeviceOptimizer.momentum(learning_rate, momentum)
         if rule == "adamw":
             return DeviceOptimizer.adamw(learning_rate, weight_decay)
+        if rule == "adamw_bf16":
+            # bf16 moment slots: half the optimizer-state HBM
+            return DeviceOptimizer.adamw_bf16(learning_rate, weight_decay)
         if rule == "adam":
             return DeviceOptimizer.adam(learning_rate)
     raise ValueError(f"unknown optimizer {name!r}")

@@ -79,7 +79,9 @@ class MoELayer:
 
     def apply(self, params: Mapping[str, Array], x: Array,
               prefix: str = "",
-              capacity_override: int | None = None) -> tuple[Array, Array]:
+              capacity_override: int | None = None,
+              expert_slice: "tuple[Array, int] | None" = None
+              ) -> tuple[Array, Array]:
         """x: [B, S, D] -> (out [B, S, D], aux_loss scalar).
 
         Dropped tokens (over capacity) contribute zero output — callers add
@@ -87,7 +89,17 @@ class MoELayer:
         factor-derived capacity; pass the token count for drop-free
         inference (capacity dropping is a batch-global training-time
         mechanism: which token drops depends on every other token in the
-        batch, so it cannot be reproduced causally at decode time)."""
+        batch, so it cannot be reproduced causally at decode time).
+
+        ``expert_slice=(start, count)``: manual expert parallelism for
+        callers INSIDE shard_map (parallel/pipeline.py), where GSPMD can't
+        partition the dispatch einsums.  Routing/capacity/aux are computed
+        over ALL num_experts from the (expert-axis-replicated) tokens —
+        identical on every rank — but ``params[...moe/w1|w2]`` hold only
+        this rank's ``count`` experts starting at ``start``, and the
+        returned out is that PARTIAL contribution: the caller psums it
+        over the expert axis.  ``start`` may be traced (lax.axis_index);
+        ``count`` must be static."""
         c = self.config
         k = c.top_k
         b, s, d = x.shape
@@ -127,12 +139,21 @@ class MoELayer:
                      * jax.nn.one_hot(jnp.where(keep, pos_in_expert, cap),
                                       cap + 1, dtype=x.dtype)[:, None, :cap])
                     .reshape(n, k, c.num_experts, cap))
+        w1, w2 = params[f"{prefix}moe/w1"], params[f"{prefix}moe/w2"]
+        if expert_slice is not None:
+            start, count = expert_slice
+            if w1.shape[0] != count:
+                raise ValueError(
+                    f"expert_slice count {count} != local expert weights "
+                    f"{w1.shape[0]}")
+            dispatch = jax.lax.dynamic_slice_in_dim(dispatch, start, count,
+                                                    axis=2)
         # expert inputs [E, C, D] — with w1/w2 sharded over 'expert', GSPMD
         # turns this einsum contraction into the dispatch all-to-all
         expert_in = jnp.einsum("nkec,nd->ecd", dispatch, tokens)
-        h = jnp.einsum("ecd,edf->ecf", expert_in, params[f"{prefix}moe/w1"])
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w1)
         h = jax.nn.gelu(h)
-        expert_out = jnp.einsum("ecf,efd->ecd", h, params[f"{prefix}moe/w2"])
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w2)
         combined = jnp.einsum("nkec,ecd->nkd", dispatch, expert_out)
         weighted = combined * (a_gate * keep).astype(x.dtype).reshape(
             n, k)[..., None]

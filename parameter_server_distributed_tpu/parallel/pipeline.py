@@ -257,14 +257,23 @@ class PipelinedTransformerLM:
             h = apply_block(blk, h)
         return h
 
-    def _stage_fn_aux(self, stage_params: dict,
-                      h: jax.Array) -> tuple[jax.Array, jax.Array]:
+    def _stage_fn_aux(self, stage_params: dict, h: jax.Array,
+                      sharded_experts: bool = False
+                      ) -> tuple[jax.Array, jax.Array]:
         """MoE variant of :meth:`_stage_fn`: every block's FFN is the
         Switch/Mixtral MoE (config.moe_every == 1) and the stage returns
         (h, summed aux loss).  Expert capacity is computed per MICROBATCH
         (the tokens a stage sees per tick) — the standard microbatched-MoE
         semantics: which tokens drop depends on routing statistics within
-        the microbatch, not the global batch."""
+        the microbatch, not the global batch.
+
+        ``sharded_experts`` (set when running inside pipeline_apply's
+        shard_map on a mesh with an ``expert`` axis > 1): each rank holds
+        only its slice of every block's expert weights (pipe x expert
+        2-D-sharded stacks — see loss()'s param_spec_fn); routing runs on
+        the expert-replicated tokens, each rank computes its local
+        experts' partial output, and a psum over ``expert`` combines —
+        real expert parallelism composed orthogonally with the pipe axis."""
         from ..models.transformer import rms_norm
 
         model = self.inner
@@ -277,7 +286,14 @@ class PipelinedTransformerLM:
             attn = self._stage_attention(q, k, v)
             h = model.attn_residual(blk, key, h, attn)
             x = rms_norm(h, blk[f"{key}/ln2/scale"])
-            moe_out, aux = model._moe.apply(blk, x, prefix=f"{key}/")
+            if sharded_experts:
+                count = blk[f"{key}/moe/w1"].shape[0]
+                start = jax.lax.axis_index("expert") * count
+                moe_out, aux = model._moe.apply(
+                    blk, x, prefix=f"{key}/", expert_slice=(start, count))
+                moe_out = jax.lax.psum(moe_out, "expert")
+            else:
+                moe_out, aux = model._moe.apply(blk, x, prefix=f"{key}/")
             return h + moe_out.astype(model.config.dtype), aux
 
         apply_block = (jax.checkpoint(one_block) if self.config.remat
@@ -297,9 +313,23 @@ class PipelinedTransformerLM:
         stage_params = {name: value for name, value in params.items()
                         if name.startswith(self.BLOCK_PREFIX)}
         if self.config.moe_every == 1:
-            h, aux = pipeline_apply(self._stage_fn_aux, stage_params, h,
+            ep = self.mesh.shape.get("expert", 1)
+            sharded = (ep > 1 and self.n_pipe > 1
+                       and self.config.moe_experts % ep == 0)
+            spec_fn = None
+            if sharded:
+                def spec_fn(name, p):
+                    # same definition the state-placement rule uses
+                    # (_block_param_spec): no reshard at shard_map entry
+                    return _block_param_spec(name, p.ndim, p.shape[2:3], ep)
+
+            def stage(blk_params, h):
+                return self._stage_fn_aux(blk_params, h,
+                                          sharded_experts=sharded)
+
+            h, aux = pipeline_apply(stage, stage_params, h,
                                     self.mesh, self.num_microbatches,
-                                    with_aux=True)
+                                    with_aux=True, param_spec_fn=spec_fn)
             return (self._head_loss(params, h, tokens)
                     + self.config.moe_aux_coef * aux)
         if self.virtual_stages == 1:
@@ -566,18 +596,35 @@ def pipeline_rule(mesh: Mesh):
 
     base = transformer_rule(mesh)
 
+    n_exp = mesh.shape.get("expert", 1)
+
     def rule(name: str, shape: tuple) -> P:
         if name.startswith(PipelinedTransformerLM.BLOCK_PREFIX):
-            return P("pipe", *([None] * (len(shape) - 1)))
+            return _block_param_spec(name, len(shape), shape[2:3], n_exp)
         return base(name, shape)
 
     return rule
 
 
+def _block_param_spec(name: str, ndim: int, expert_dim: tuple,
+                      n_exp: int) -> P:
+    """THE spec for a stacked ``blocks/*`` param — the single definition
+    shared by :func:`pipeline_rule` (state placement) and the MoE loss's
+    shard_map in_specs, so stored state and shard_map entry can never
+    drift apart (drifting costs a silent reshard every step).  MoE expert
+    stacks [P, Lc, E, ...] go pipe x expert 2-D when the expert axis can
+    divide E; everything else is pipe on the stage axis only."""
+    if (n_exp > 1 and (name.endswith("moe/w1") or name.endswith("moe/w2"))
+            and expert_dim and expert_dim[0] % n_exp == 0):
+        return P("pipe", None, "expert", *([None] * (ndim - 3)))
+    return P("pipe", *([None] * (ndim - 1)))
+
+
 def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
                    mesh: Mesh, num_microbatches: int,
                    batch_axes: tuple[str, ...] = ("data", "fsdp"),
-                   with_aux: bool = False) -> jax.Array:
+                   with_aux: bool = False,
+                   param_spec_fn: Callable | None = None) -> jax.Array:
     """Run ``x`` through P pipelined stages.
 
     stage_fn(params_i, h) -> h applies ONE stage.  stage_params is the
@@ -616,8 +663,14 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
 
     mb = _microbatch_size(mesh, batch_axes, x.shape[0], num_microbatches)
 
-    param_specs = jax.tree.map(
-        lambda p: P("pipe", *([None] * (p.ndim - 1))), stage_params)
+    if param_spec_fn is None:
+        param_specs = jax.tree.map(
+            lambda p: P("pipe", *([None] * (p.ndim - 1))), stage_params)
+    else:
+        # per-name specs (stage_params is a flat name->array store):
+        # lets MoE stacks shard pipe x expert 2-D (see the pipelined LM)
+        param_specs = {name: param_spec_fn(name, p)
+                       for name, p in stage_params.items()}
     x_spec = P(batch_axes, *([None] * (x.ndim - 1)))
     out_specs = (x_spec, P()) if with_aux else x_spec
 

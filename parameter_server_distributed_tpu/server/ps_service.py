@@ -9,6 +9,7 @@ and runs the periodic checkpoint daemon
 from __future__ import annotations
 
 import logging
+import os
 from typing import Callable
 
 import grpc
@@ -116,11 +117,37 @@ class ParameterServerService:
 
     # RPC: load into the PS; response ships the params back as the reference
     # does (src/parameter_server_service.cpp:126-137) even though its worker
-    # discards them (src/worker.cpp:311-313)
+    # discards them (src/worker.cpp:311-313).  Above the echo cap the
+    # echo is omitted: a 1B store's packed repeated-float encoding (~4 GB)
+    # would blow the 1 GB gRPC message cap AFTER the load already
+    # succeeded server-side, turning a successful restore into a
+    # client-visible error.  Workers (ours and the reference's) discard
+    # the echo anyway.
+    @staticmethod
+    def _echo_max_bytes() -> int:
+        # read per call (matching rpc/data_plane.stream_chunk_bytes) so
+        # env overrides set after import still take effect
+        return int(os.environ.get("PSDT_CKPT_ECHO_MAX_BYTES",
+                                  str(256 << 20)))
+
     def LoadCheckpoint(self, request: m.LoadCheckpointRequest, context) -> m.LoadCheckpointResponse:
         try:
             epoch, _iteration = self.ckpt.load(request.path)
             _, params, _ = self.core.serve_parameters()
+            cap = self._echo_max_bytes()
+            # .size without np.asarray: device-resident stores (jax
+            # Arrays) must not be copied to host just to be counted
+            nbytes = sum(4 * int(v.size) for v in params.values())
+            if nbytes > cap:
+                log.info("LoadCheckpoint: store is %.2f GB f32 — omitting "
+                         "the parameter echo (cap %d MB)", nbytes / 1e9,
+                         cap >> 20)
+                return m.LoadCheckpointResponse(
+                    success=True,
+                    message="checkpoint loaded (parameter echo omitted: "
+                            "store exceeds the unary response cap; pull "
+                            "via ServeParameters)",
+                    epoch=epoch)
             return m.LoadCheckpointResponse(success=True, message="checkpoint loaded",
                                             epoch=epoch, parameters=to_wire(params))
         except Exception as exc:  # noqa: BLE001

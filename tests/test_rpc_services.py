@@ -237,3 +237,30 @@ def test_streaming_empty_push_still_contributes_to_barrier(ps):
             m.GradientUpdate(worker_id=0, iteration=1, gradients=[]))
         assert push.success
         assert push.workers_received == 1 and push.total_workers == 2
+
+
+def test_load_checkpoint_omits_echo_for_large_store(ps, monkeypatch):
+    """A restore of a store too large for the unary response cap must
+    still SUCCEED — the reference-shaped parameter echo is omitted (a 1B
+    store's repeated-float encoding would blow the gRPC cap after the
+    load already happened server-side); small stores keep the echo."""
+    server, port = ps
+    server.core.initialize_parameters(
+        {"w": np.arange(64, dtype=np.float32)})
+    with ps_client(port) as client:
+        saved = client.call("SaveCheckpoint", m.SaveCheckpointRequest())
+        assert saved.success
+        # normal store: echo present
+        loaded = client.call("LoadCheckpoint",
+                             m.LoadCheckpointRequest(path=saved.checkpoint_path))
+        assert loaded.success and loaded.parameters
+        # force the cap below the store size: echo omitted, still success
+        monkeypatch.setenv("PSDT_CKPT_ECHO_MAX_BYTES", "16")
+        loaded2 = client.call("LoadCheckpoint",
+                              m.LoadCheckpointRequest(path=saved.checkpoint_path))
+        assert loaded2.success and not loaded2.parameters
+        assert "echo omitted" in loaded2.message
+        # the restore really happened: params servable
+        pull = client.call("ServeParameters", m.PullRequest(worker_id=0))
+        np.testing.assert_allclose(pull.parameters[0].to_array(),
+                                   np.arange(64, dtype=np.float32))

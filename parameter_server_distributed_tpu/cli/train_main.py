@@ -7,12 +7,14 @@
         --mesh=data:2,fsdp:2,tensor:2 --ckpt-dir=/tmp/ckpt --ckpt-every=50 \
         --ckpt-keep=3 --resume --metrics=/tmp/metrics.jsonl
 
-``--attention=dense|flash|ring|ulysses|ulysses_flash`` selects the
-attention implementation for transformer models: flash = pallas kernels
-(shard_mapped over batch/head shards when the mesh is >1 device),
-ring/ulysses = sequence parallelism over the mesh's seq axis (pair with
---mesh=seq:N); ulysses_flash runs the pallas kernel on each device's
-gathered full sequence.
+``--attention=dense|flash|xla_flash|ring|ulysses|ulysses_flash|
+ulysses_xla_flash`` selects the attention implementation for transformer
+models: flash = pallas kernels (shard_mapped over batch/head shards when
+the mesh is >1 device), xla_flash = the same blockwise recurrence as a
+compiled lax.scan (any backend), ring/ulysses = sequence parallelism
+over the mesh's seq axis (pair with --mesh=seq:N); ulysses_flash /
+ulysses_xla_flash run the pallas kernel / the lax.scan recurrence on
+each device's gathered full sequence.
 
 ``--dtype=bf16`` trains in bfloat16 (f32 MXU accumulation) for models
 whose factory takes a dtype; ``--remat`` recomputes layer activations in

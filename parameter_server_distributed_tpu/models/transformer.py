@@ -182,7 +182,7 @@ def make_sharded_flash_attention(mesh: Mesh,
 
 
 ATTENTION_CHOICES = ("dense", "flash", "xla_flash", "ring", "ulysses",
-                     "ulysses_flash")
+                     "ulysses_flash", "ulysses_xla_flash")
 
 
 def select_attention(name: str, mesh: Mesh | None) -> Callable | None:
@@ -200,6 +200,8 @@ def select_attention(name: str, mesh: Mesh | None) -> Callable | None:
     ulysses — all-to-all seq<->heads swap, dense attention per head shard
     ulysses_flash — same swap, pallas flash kernel on the gathered
               full sequence (seq parallelism + O(block^2) VMEM)
+    ulysses_xla_flash — same swap, the lax.scan flash recurrence on the
+              gathered sequence (compiled on every backend)
 
     Returns None for dense (the Transformer default), letting the model
     pick its own fallback logic."""
@@ -214,7 +216,7 @@ def select_attention(name: str, mesh: Mesh | None) -> Callable | None:
         # plain einsums + scan: with a mesh, GSPMD partitions it over the
         # batch/head axes exactly like dense — no shard_map needed
         return make_xla_flash_attention()
-    if name in ("ring", "ulysses", "ulysses_flash"):
+    if name in ("ring", "ulysses", "ulysses_flash", "ulysses_xla_flash"):
         if mesh is None:
             raise ValueError(f"--attention={name} needs a mesh with a seq axis")
         from ..ops.ring_attention import (make_ring_attention,
@@ -224,6 +226,12 @@ def select_attention(name: str, mesh: Mesh | None) -> Callable | None:
         if name == "ulysses_flash":
             # pallas flash on each device's gathered full sequence
             return make_ulysses_attention(mesh, inner=flash_attention_auto)
+        if name == "ulysses_xla_flash":
+            # the lax.scan flash recurrence on the gathered sequence —
+            # compiled on every backend (ops/xla_flash.py)
+            from ..ops.xla_flash import make_xla_flash_attention
+            return make_ulysses_attention(mesh,
+                                          inner=make_xla_flash_attention())
         return make_ulysses_attention(mesh)
     raise ValueError(f"unknown attention {name!r}; options {ATTENTION_CHOICES}")
 

@@ -50,6 +50,9 @@ run flash_remat_b32        PSDT_BENCH_BATCH=32 PSDT_BENCH_ATTENTION=flash
 run flash_seq4096_b8       PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=flash
 run dense_seq4096_b8       PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096
 run flash_seq8192_b4       PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192 PSDT_BENCH_ATTENTION=flash
+run xlaflash_seq4096_b8    PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=xla_flash
+run xlaflash_seq8192_b4    PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192 PSDT_BENCH_ATTENTION=xla_flash
+run attn_ab_seq8192        PSDT_BENCH_MODE=attention PSDT_BENCH_SEQ=8192
 run dense_seq8192_b4       PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192
 # GQA flagship (kv_heads=4): unexpanded-K/V flash fold vs dense at long
 # context — the KV-cache/ICI-frugal long-context config

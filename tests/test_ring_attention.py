@@ -146,7 +146,7 @@ def test_select_attention_switch(rng):
     mesh = build_mesh(MeshConfig(sequence=2, data=4))
     q, k, v = qkv(rng)
     dense = np.asarray(causal_attention(*map(jnp.asarray, (q, k, v))))
-    for name in ("ring", "ulysses", "ulysses_flash"):
+    for name in ("ring", "ulysses", "ulysses_flash", "ulysses_xla_flash"):
         fn = select_attention(name, mesh)
         np.testing.assert_allclose(np.asarray(jax.jit(fn)(q, k, v)), dense,
                                    rtol=2e-5, atol=2e-5)

@@ -360,3 +360,66 @@ def test_sync_serve_unaffected_by_nonblocking_path():
     ps.receive_gradients(0, 1, store(w=[1.0]))
     _, served, _ = ps.serve_parameters()
     np.testing.assert_allclose(served["w"], [3.0])
+
+
+def test_async_concurrent_push_pull_serves_consistent_snapshots():
+    """Race discipline for the non-blocking serve path: concurrent async
+    pushes (device-style lazy applies) and serves must never hand out a
+    TORN store — every served snapshot's tensors must all come from the
+    same applied generation.  Generation g's store is {w: g, b: g}, so
+    consistency is checkable per pull."""
+    import threading
+    import time as _time
+
+    ps = ParameterServerCore(total_workers=1, staleness_bound=10**9,
+                             optimizer=_LazyOptimizer(1.0))
+    ps.initialize_parameters(store(w=[0.0, 0.0], b=[0.0]))
+    stop = threading.Event()
+    errors: list = []
+
+    def guarded(fn):
+        # a crashed thread must FAIL the test, not die silently and let
+        # the invariant check pass vacuously
+        def run():
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"thread crashed: {exc!r}")
+                stop.set()
+        return run
+
+    def pusher():
+        it = 0
+        while not stop.is_set():
+            it += 1
+            # grad -1 at lr 1.0: params increase by exactly 1 per apply
+            ps.receive_gradients(0, it, store(w=[-1.0, -1.0], b=[-1.0]))
+            # materialize promptly so serves can promote
+            with ps._params_lock:
+                for v in ps._params.values():
+                    if hasattr(v, "block_until_ready"):
+                        v.block_until_ready()
+            if ps.applied_updates >= 200:   # progress-bound, not
+                stop.set()                  # wall-clock-bound
+
+    def puller():
+        while not stop.is_set():
+            _, served, ready = ps.serve_parameters()
+            if not ready:
+                errors.append("not ready")
+                continue
+            gens = {float(np.asarray(v).reshape(-1)[0])
+                    for v in served.values()}
+            if len(gens) != 1:
+                errors.append(f"torn snapshot: generations {gens}")
+
+    threads = [threading.Thread(target=guarded(pusher))] + [
+        threading.Thread(target=guarded(puller)) for _ in range(3)]
+    deadline = _time.monotonic() + 30.0
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=max(0.1, deadline - _time.monotonic()))
+    stop.set()
+    assert not errors, errors[:5]
+    assert ps.applied_updates >= 200  # the pusher made real progress

@@ -413,8 +413,11 @@ def test_async_concurrent_push_pull_serves_consistent_snapshots():
             if len(gens) != 1:
                 errors.append(f"torn snapshot: generations {gens}")
 
-    threads = [threading.Thread(target=guarded(pusher))] + [
-        threading.Thread(target=guarded(puller)) for _ in range(3)]
+    # daemon: if a lock-order regression ever deadlocks the pusher, the
+    # join timeout must FAIL the test — not hang interpreter exit
+    threads = [threading.Thread(target=guarded(pusher), daemon=True)] + [
+        threading.Thread(target=guarded(puller), daemon=True)
+        for _ in range(3)]
     deadline = _time.monotonic() + 30.0
     for t in threads:
         t.start()

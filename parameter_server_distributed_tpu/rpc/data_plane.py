@@ -140,12 +140,27 @@ class PSClient(RpcClient):
 
     # ------------------------------------------------------------------ pull
     def pull_parameters(self, request: m.PullRequest,
-                        timeout: float | None = None) -> m.ParameterUpdate:
+                        timeout: float | None = None,
+                        on_chunk=None) -> m.ParameterUpdate:
         """Returns one merged ParameterUpdate (chunks are concatenated in
         server order, so the result is indistinguishable from the unary
-        response)."""
+        response).
+
+        ``on_chunk(tensors)``: optional per-chunk consumer called as each
+        chunk ARRIVES — the worker converts tensors to f32 arrays there,
+        overlapping conversion with the transport of later chunks.  The
+        consumed tensors still appear in the returned message (the
+        consumer must not mutate them); on the unary fallback it is
+        called once with the whole list, so callers behave identically
+        either way."""
+        def unary_pull() -> m.ParameterUpdate:
+            resp = self.call("ServeParameters", request, timeout=timeout)
+            if on_chunk is not None:
+                on_chunk(resp.parameters)
+            return resp
+
         if not self._streaming():
-            return self.call("ServeParameters", request, timeout=timeout)
+            return unary_pull()
         try:
             chunks = self.call("ServeParametersStream", request,
                                timeout=timeout)
@@ -155,14 +170,25 @@ class PSClient(RpcClient):
             for chunk in chunks:
                 got_any = True
                 iteration, ready = chunk.iteration, chunk.ready
-                merged.extend(chunk.parameters)
+                if on_chunk is not None:
+                    on_chunk(chunk.parameters)
+                    # the consumer took the payloads; retain only the
+                    # metadata callers read off the response (name +
+                    # packed_dtype for wire negotiation) — holding the
+                    # full wire copy alongside the converted store would
+                    # double peak pull memory at GB scale
+                    merged.extend(
+                        m.Tensor(name=t.name, packed_dtype=t.packed_dtype)
+                        for t in chunk.parameters)
+                else:
+                    merged.extend(chunk.parameters)
             self._stream_ok = True
             if not got_any:  # zero-chunk stream: treat as an empty store
-                return self.call("ServeParameters", request, timeout=timeout)
+                return unary_pull()
             return m.ParameterUpdate(iteration=iteration, parameters=merged,
                                      ready=ready)
         except grpc.RpcError as exc:
             if _status_code(exc) != grpc.StatusCode.UNIMPLEMENTED:
                 raise
             self._stream_ok = False
-            return self.call("ServeParameters", request, timeout=timeout)
+            return unary_pull()

@@ -142,13 +142,19 @@ class ShardedPSClient:
 
     # ------------------------------------------------------------- pull path
     def pull_parameters(self, request: m.PullRequest,
-                        timeout: float | None = None) -> m.ParameterUpdate:
+                        timeout: float | None = None,
+                        on_chunk=None) -> m.ParameterUpdate:
         """Streaming-data-plane pull (chunk streams per shard, concurrent
-        fan-out), merged exactly like the unary path."""
+        fan-out), merged exactly like the unary path.  ``on_chunk`` is
+        invoked from the fan-out threads CONCURRENTLY (shards stream
+        independently) — consumers must be thread-safe per call; the
+        worker's per-tensor dict insert is (tensor names are disjoint
+        across shards)."""
         if self.num_shards == 1:
-            return self._clients[0].pull_parameters(request, timeout=timeout)
+            return self._clients[0].pull_parameters(request, timeout=timeout,
+                                                    on_chunk=on_chunk)
         futures = [self._pool.submit(client.pull_parameters, request,
-                                     timeout=timeout)
+                                     timeout=timeout, on_chunk=on_chunk)
                    for client in self._clients]
         return self._merge_pulls([f.result() for f in futures])
 

@@ -206,12 +206,27 @@ class Worker:
     # ------------------------------------------------------------ data plane
     def pull_parameters(self, iteration: int) -> tuple[int, TensorStore]:
         """reference: src/worker.cpp:240-252."""
-        resp = self.query_with_retry(
-            lambda: self._ps.pull_parameters(
+
+        def attempt():
+            # a FRESH store per attempt: after a sharded-pull failure,
+            # the other shards' fan-out threads may still be streaming
+            # chunks of the FAILED attempt — they write into the old
+            # dict, never into this retry's
+            local: TensorStore = {}
+
+            def convert_chunk(tensors) -> None:
+                # f32 conversion per chunk AS IT ARRIVES, overlapping the
+                # transport of later chunks (rpc/data_plane.py on_chunk)
+                local.update(from_wire(tensors))
+
+            resp = self._ps.pull_parameters(
                 m.PullRequest(worker_id=self.config.worker_id,
                               iteration=iteration,
                               wire_dtype=self._pull_wire_dtype()),
-                timeout=30.0))
+                timeout=30.0, on_chunk=convert_chunk)
+            return resp, local
+
+        resp, store = self.query_with_retry(attempt)
         if not self._peer_packed_ok and resp.parameters:
             if any(t.packed_dtype != m.WIRE_F32 for t in resp.parameters):
                 self._peer_packed_ok = True
@@ -241,7 +256,7 @@ class Worker:
                     "worker %d: pull no longer packed (PS restart?), "
                     "re-negotiating wire encoding", self.config.worker_id)
                 self._reset_wire_negotiation()
-        return resp.iteration, from_wire(resp.parameters)
+        return resp.iteration, store
 
     def push_gradients(self, iteration: int, grads: TensorStore) -> m.PushResponse:
         """reference: src/worker.cpp:254-272."""

@@ -613,3 +613,54 @@ def test_speculative_batched_rejects_vocab_mismatch(rng):
         speculative_generate_batched(target, tparams, other,
                                      other.init_params(0),
                                      np.zeros((2, 4), np.int32), 4)
+
+
+def test_speculative_batched_gqa_target_matches_greedy(rng):
+    """Batched device speculative decoding with a GQA target (unexpanded
+    K/V caches through the ragged decode path) stays token-exact vs
+    target-alone greedy decoding."""
+    from parameter_server_distributed_tpu.models.generation import (
+        generate, speculative_generate_batched)
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    target = Transformer(TransformerConfig(
+        vocab=256, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=64, max_seq=64, dtype=jnp.float32))
+    tparams = target.init_params(0)
+    draft = Transformer(TransformerConfig(
+        vocab=256, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq=64, dtype=jnp.float32))
+    dparams = draft.init_params(1)
+    prompt = rng.integers(0, 256, (3, 6)).astype(np.int32)
+    reference = np.asarray(generate(target, tparams, prompt,
+                                    max_new_tokens=12))
+    out, _ = speculative_generate_batched(target, tparams, draft, dparams,
+                                          prompt, 12, draft_len=3)
+    np.testing.assert_array_equal(out, reference)
+
+
+def test_generation_with_xla_flash_prefill_matches_dense(rng):
+    """A model built with the xla_flash attention kernel serves the same
+    prefill as the dense model (decode then uses the cache einsums either
+    way).  Logits compared with a tolerance, not token equality — the two
+    kernels reorder float accumulation, and a near-tie argmax flip would
+    make discrete comparison flaky across backends."""
+    from parameter_server_distributed_tpu.models.generation import prefill
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig, select_attention)
+
+    config = TransformerConfig(vocab=256, d_model=32, n_heads=4,
+                               n_layers=2, d_ff=64, max_seq=64,
+                               dtype=jnp.float32)
+    dense = Transformer(config)
+    flash = Transformer(config,
+                        attention_fn=select_attention("xla_flash", None))
+    params = dense.init_params(0)
+    prompt = jnp.asarray(rng.integers(0, 256, (2, 8)), jnp.int32)
+    logits_d, cache_d = prefill(dense, params, prompt, 32)
+    logits_f, cache_f = prefill(flash, params, prompt, 32)
+    np.testing.assert_allclose(np.asarray(logits_f), np.asarray(logits_d),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(cache_f.k), np.asarray(cache_d.k),
+                               rtol=2e-4, atol=2e-4)

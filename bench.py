@@ -886,17 +886,27 @@ def _tpu_preflight(timeout_s: float) -> str:
     without this a dead TPU costs the full per-attempt timeout N times
     before the CPU fallback — possibly longer than the driver waits for
     bench.py at all.  ~20-40 s of extra init when the TPU is healthy buys
-    a bounded worst case when it is not."""
-    code = ("import jax\n"
-            "d = jax.devices()[0]\n"
-            "assert d.platform in ('tpu', 'axon') or "
-            "d.device_kind.upper().startswith('TPU'), d.platform\n"
-            "import jax.numpy as jnp\n"
-            "print(float(jnp.ones((8, 8)).sum()))\n")
+    a bounded worst case when it is not.
+
+    The probe predicate lives in scripts/tpu_probe.py (shared with the
+    watchdog scripts so both agree on what "up" means); the inline snippet
+    is only the fallback for a standalone copy of bench.py."""
+    probe = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "tpu_probe.py")
+    if os.path.exists(probe):
+        cmd = [sys.executable, probe]
+    else:
+        cmd = [sys.executable, "-c",
+               "import jax\n"
+               "d = jax.devices()[0]\n"
+               "assert d.platform in ('tpu', 'axon') or "
+               "d.device_kind.upper().startswith('TPU'), d.platform\n"
+               "import jax.numpy as jnp\n"
+               "print(float(jnp.ones((8, 8)).sum()))\n"]
     env = dict(os.environ)
     env.pop("PSDT_PLATFORM", None)
     try:
-        proc = subprocess.run([sys.executable, "-c", code], env=env,
+        proc = subprocess.run(cmd, env=env,
                               timeout=timeout_s, stdout=subprocess.DEVNULL,
                               stderr=subprocess.PIPE)
     except subprocess.TimeoutExpired:

@@ -1,0 +1,18 @@
+"""Single source of truth for the TPU health predicate.
+
+bench.py's preflight and scripts/tpu_watchdog.sh / tpu_recovery.sh all run
+this file in a subprocess (the wedged-tunnel failure mode is a hard HANG at
+backend init, so the caller must wrap it in a timeout).  Exit 0 = a real
+TPU-like device answered a tiny op; nonzero/hang = treat the device as down.
+
+Keep the predicate here only — duplicating it risks bench.py and the
+watchdog disagreeing about device health.
+"""
+import jax
+
+d = jax.devices()[0]
+assert (d.platform in ("tpu", "axon")
+        or d.device_kind.upper().startswith("TPU")), d.platform
+import jax.numpy as jnp
+
+print(float(jnp.ones((8, 8)).sum()))

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Shared health-probe wrapper: single place for the timeout + env handling
+# around scripts/tpu_probe.py (the predicate itself).  Exit 0 = device up.
+# PROBE_TIMEOUT_S defaults to 90 s to match bench.py's
+# PSDT_BENCH_PREFLIGHT_TIMEOUT default — the two must agree or the watchdog
+# and bench.py can disagree about whether a slow-init tunnel is healthy.
+timeout "${PROBE_TIMEOUT_S:-90}" env -u PSDT_PLATFORM \
+  python "$(dirname "$0")/tpu_probe.py" >/dev/null 2>&1

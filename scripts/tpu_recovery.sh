@@ -8,8 +8,11 @@
 #   3. long-context flash-vs-dense crossover incl. the GQA flagship
 #   4. speculative-decode serving rows
 #
-# Each line appends to $RESULTS as it lands, so a mid-run outage keeps
-# everything captured so far.  RESULTS=/tmp/tpu_recovery.jsonl LOG=...
+# RESUMABLE: each line appends to $RESULTS as it lands, a tag that already
+# has a non-error result is skipped on re-run, and a tunnel-down signature
+# (preflight hang / attempt timeout) aborts with rc=2 so a caller
+# (scripts/tpu_watchdog.sh) can wait for recovery and re-invoke — a mid-run
+# outage keeps everything captured so far and loses nothing else.
 set -u
 cd "$(dirname "$0")/.."
 
@@ -20,41 +23,84 @@ export PSDT_BENCH_CPU_TIMEOUT=1        # a CPU fallback number is noise here
 export PSDT_BENCH_PREFLIGHT_RETRIES=1  # fail fast per config
 export PSDT_BENCH_TPU_TIMEOUT="${PSDT_BENCH_TPU_TIMEOUT:-560}"
 
+device_up() {  # same predicate + timeout bench.py's preflight uses
+  bash scripts/tpu_probe.sh
+}
+
 run() {  # run <tag> [VAR=VALUE...]
   local tag="$1"; shift
+  # A tag counts as captured only with a real TPU number — bench_error and
+  # *_cpu_fallback rows are both retried on resume.
+  if grep -q "\"config\": \"$tag\"" "$RESULTS" 2>/dev/null \
+     && ! grep "\"config\": \"$tag\"" "$RESULTS" \
+          | grep -qE "bench_error|_cpu_fallback"; then
+    echo "=== $tag: already captured, skipping ===" | tee -a "$LOG"
+    return 0
+  fi
   echo "=== $tag ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
   local line
   line=$(env "$@" python bench.py 2>>"$LOG")
   [ -n "$line" ] || line='{"metric": "bench_error", "value": 0.0, "unit": "error", "vs_baseline": 0.0, "note": "bench.py emitted no output"}'
+  # Drop a stale row for this tag before appending the retry (grep -v exits
+  # 1 on empty output, so don't chain the mv on it).
+  if grep -q "\"config\": \"$tag\"" "$RESULTS" 2>/dev/null; then
+    grep -v "\"config\": \"$tag\"" "$RESULTS" > "$RESULTS.tmp"
+    mv "$RESULTS.tmp" "$RESULTS"
+  fi
   echo "{\"config\": \"$tag\", \"result\": $line}" | tee -a "$RESULTS"
+  case "$line" in
+    *"preflight hung"*)
+      # The preflight is itself a probe — a hang means the tunnel is gone.
+      echo "tunnel-down signature on $tag; aborting sweep (rc=2)" \
+        | tee -a "$LOG"
+      exit 2 ;;
+    *"tpu attempt timed out"*)
+      # Ambiguous: a mid-run tunnel death and a config that genuinely needs
+      # more compile/run budget produce the same timeout.  Re-probe to
+      # disambiguate, else a deterministically-slow config would livelock
+      # the watchdog<->recovery pair and starve every config after it.
+      if device_up; then
+        echo "$tag timed out on a live device (config too slow for its" \
+             "budget); continuing" | tee -a "$LOG"
+      else
+        echo "tunnel died during $tag; aborting sweep (rc=2)" | tee -a "$LOG"
+        exit 2
+      fi ;;
+  esac
 }
 
 # -- 1. headline (driver default config)
 run headline_mlp_mfu
-# -- 2. flagship LM rows
-run lm350_dense_remat_b32        PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32
-run lm350_dense_remat_b32_credit PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT_CREDIT=1
-run lm350_dense_noremat_b32      PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT=0
-run lm350_dense_remat_b64        PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=64
-run lm350_hd128_dense_b32        PSDT_BENCH_MODEL=lm_350m_hd128 PSDT_BENCH_BATCH=32
-run lm350_xlaflash_b32           PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_ATTENTION=xla_flash
+# -- 2. flagship LM rows (scan layout first: compiles ~7x smaller HLO, so a
+#    short tunnel window banks a flagship number before the slow unrolled
+#    variants; unrolled rows get a longer per-config compile budget)
+run lm350_scan_remat_b32         PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
+run lm350_scan_noremat_b32       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_REMAT=0
+run lm350_scan_remat_b64         PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=64 PSDT_BENCH_SCAN=1
+run lm350_scan_remat_b32_credit  PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_REMAT_CREDIT=1
+run lm350_hd128_scan_b32         PSDT_BENCH_MODEL=lm_350m_hd128 PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
+run lm350_xlaflash_scan_b32      PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=xla_flash
+run lm350_dense_remat_b32        PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32
+run lm350_dense_noremat_b32      PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT=0
 # -- 3. long-context crossover
 run attn_ab_seq4096              PSDT_BENCH_MODE=attention PSDT_BENCH_SEQ=4096
 run attn_ab_seq8192              PSDT_BENCH_MODE=attention PSDT_BENCH_SEQ=8192
 run attn_ab_seq8192_hd128        PSDT_BENCH_MODE=attention PSDT_BENCH_SEQ=8192 PSDT_BENCH_HEADS=8 PSDT_BENCH_HEAD_DIM=128
-run lm350_flash_seq4096_b8       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=flash
-run lm350_dense_seq4096_b8       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096
-run lm350_hd128_seq4096_b8       PSDT_BENCH_MODEL=lm_350m_hd128 PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=flash
-run gqa_flash_seq4096_b8         PSDT_BENCH_MODEL=lm_350m_gqa PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_ATTENTION=flash
-run lm350_flash_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192 PSDT_BENCH_ATTENTION=flash
-run lm350_dense_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192
+run lm350_flash_seq4096_b8       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=flash
+run lm350_dense_seq4096_b8       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_SCAN=1
+run lm350_hd128_seq4096_b8       PSDT_BENCH_MODEL=lm_350m_hd128 PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=flash
+run gqa_flash_seq4096_b8         PSDT_BENCH_MODEL=lm_350m_gqa PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=flash
+run lm350_flash_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=flash
+run lm350_dense_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192 PSDT_BENCH_SCAN=1
 # -- 4. decode/serving
 run decode_small_lm              PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run spec_perfect_draft           PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=self PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run spec_tiny_draft              PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run spec_trained_draft_k2        PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_TRAIN_STEPS=200 PSDT_BENCH_DRAFT_LEN=2 PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
-# -- 5. remaining sweep matrix (scan layout variants)
-run lm350_scan_remat_b32         PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
-run lm350_flash_remat_b32        PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_ATTENTION=flash
+# -- 5. other BASELINE config rows (1B MFU is the config-3/5 anchor)
+run mlp1b_sgd_b1024              PSDT_BENCH_MODEL=mlp_1b PSDT_BENCH_BATCH=1024
+run mnist_mlp_b256               PSDT_BENCH_MODEL=mnist_mlp PSDT_BENCH_BATCH=256
+run resnet18_b256                PSDT_BENCH_MODEL=resnet18_cifar PSDT_BENCH_BATCH=256
+run resnet50_b128                PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=resnet50_imagenet PSDT_BENCH_BATCH=128
 
 echo "recovery sweep done -> $RESULTS" | tee -a "$LOG"

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Wait out a TPU-tunnel outage and bank the measurement sweep the moment
+# the device returns.  The tunnel's failure mode is a hard multi-hour hang
+# (jax.devices() never returns), so the loop is: cheap 60 s probe ->
+# down? sleep and re-probe -> up? run scripts/tpu_recovery.sh (resumable;
+# rc=2 means the tunnel died mid-sweep -> back to probing).
+#
+#   RESULTS=/tmp/tpu_recovery.jsonl LOG=... DEADLINE_S=36000 \
+#     bash scripts/tpu_watchdog.sh
+set -u
+cd "$(dirname "$0")/.."
+
+RESULTS="${RESULTS:-/tmp/tpu_recovery.jsonl}"
+LOG="${LOG:-/tmp/tpu_recovery.log}"
+PROBE_SPACING_S="${PROBE_SPACING_S:-240}"
+DEADLINE_S="${DEADLINE_S:-36000}"
+START=$(date +%s)
+
+# Shared predicate + wrapper (scripts/tpu_probe.sh) so watchdog, recovery,
+# and bench.py cannot disagree about what a healthy device is.
+probe() {
+  bash scripts/tpu_probe.sh
+}
+
+while :; do
+  now=$(date +%s)
+  if [ $((now - START)) -ge "$DEADLINE_S" ]; then
+    echo "watchdog: deadline reached ($DEADLINE_S s); giving up" | tee -a "$LOG"
+    exit 1
+  fi
+  if probe; then
+    echo "watchdog: TPU up ($(date -u +%H:%M:%S)); running sweep" | tee -a "$LOG"
+    RESULTS="$RESULTS" LOG="$LOG" bash scripts/tpu_recovery.sh
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+      echo "watchdog: sweep complete" | tee -a "$LOG"
+      exit 0
+    fi
+    echo "watchdog: sweep aborted (rc=$rc); back to probing" | tee -a "$LOG"
+  else
+    echo "watchdog: TPU down ($(date -u +%H:%M:%S)); retry in ${PROBE_SPACING_S}s" >> "$LOG"
+  fi
+  sleep "$PROBE_SPACING_S"
+done

@@ -30,7 +30,9 @@ PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
 (default 2), PSDT_BENCH_CPU_TIMEOUT (s, default 420), PSDT_BENCH_REMAT /
 PSDT_BENCH_SCAN (unset = model default, 0/1 force off/on — remat and
 lax.scan-over-layers for transformer LMs), PSDT_BENCH_SEQ (sequence-
-length override for LMs: long-context runs), PSDT_BENCH_DRAFT /
+length override for LMs: long-context runs), PSDT_BENCH_QUANT=int8 /
+PSDT_BENCH_KV_CACHE=int8 (generate mode: int8 serving A/B — weight-only
+and/or quantized KV cache), PSDT_BENCH_DRAFT /
 PSDT_BENCH_DRAFT_LEN (generate mode: speculative decoding with a
 registry draft model).
 """
@@ -674,6 +676,47 @@ def bench_generate() -> dict:
     tps = batch * max_new / dt
     log(f"bench_generate: model={name} batch={batch} new={max_new} "
         f"{tps:,.0f} tokens/s ({dt*1e3/max_new:.2f} ms/token-step)")
+
+    quant_w = os.environ.get("PSDT_BENCH_QUANT", "") == "int8"
+    quant_kv = os.environ.get("PSDT_BENCH_KV_CACHE", "") == "int8"
+    if quant_w or quant_kv:
+        # int8 serving A/B against the bf16 decode just timed: decode
+        # streams the full weight set (+ KV cache) per token, so halved
+        # bytes bound the expected speedup (models/quant.py weights,
+        # generation.QuantKVCache cache)
+        from parameter_server_distributed_tpu.models.quant import (
+            quantize_params, store_bytes)
+        qparams = quantize_params(params) if quant_w else params
+        cache_dtype = "int8" if quant_kv else "native"
+        # the baseline just timed ran the model's own dtype — label the
+        # A/B with it honestly (small LMs default f32 on CPU hosts)
+        base_dtype = np.dtype(model.config.dtype)
+        out = generate(model, qparams, prompt, max_new, rng=0,
+                       temperature=0.7, top_k=40, cache_dtype=cache_dtype)
+        np.asarray(out)
+        t0 = time.perf_counter()
+        for i in range(reps):
+            out = generate(model, qparams, prompt, max_new, rng=i + 1,
+                           temperature=0.7, top_k=40,
+                           cache_dtype=cache_dtype)
+        np.asarray(out)
+        qdt = (time.perf_counter() - t0) / reps
+        qtps = batch * max_new / qdt
+        which = "+".join(s for s, on in
+                         (("weights", quant_w), ("kv", quant_kv)) if on)
+        extra = ""
+        if quant_w:
+            as_is, dense = store_bytes(
+                qparams, unquantized_itemsize=base_dtype.itemsize)
+            extra = (f"; weight bytes {dense / 1e6:.1f} MB -> "
+                     f"{as_is / 1e6:.1f} MB")
+        log(f"bench_generate: int8 {which} {qtps:,.0f} tokens/s "
+            f"({dt / qdt:.2f}x vs {base_dtype.name}{extra})")
+        suffix = ("int8" if quant_w else "") + ("kv8" if quant_kv else "")
+        return {"metric": f"{name}_decode_tokens_per_sec_{suffix}",
+                "value": round(qtps, 1), "unit": "tokens/sec",
+                "vs_baseline": round(qtps / tps, 3)}
+
     return {"metric": f"{name}_decode_tokens_per_sec", "value": round(tps, 1),
             "unit": "tokens/sec", "vs_baseline": 1.0}
 

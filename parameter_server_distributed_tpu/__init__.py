@@ -57,6 +57,7 @@ _API = {
     "generate": ("models.generation", "generate"),
     "beam_search": ("models.generation", "beam_search"),
     "speculative_generate": ("models.generation", "speculative_generate"),
+    "quantize_params": ("models.quant", "quantize_params"),
     "get_model_and_batches": ("models.registry", "get_model_and_batches"),
     "Transformer": ("models.transformer", "Transformer"),
     "TransformerConfig": ("models.transformer", "TransformerConfig"),

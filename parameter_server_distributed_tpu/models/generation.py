@@ -54,38 +54,90 @@ class KVCache:
         return self.k.shape[2]
 
 
-def init_cache(model: Transformer, batch: int, max_len: int) -> KVCache:
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QuantKVCache:
+    """int8 KV cache: k/v int8 [L, B, max_len, H, D] with a per-(position,
+    head) f32 absmax scale [L, B, max_len, H].  Long-context decode is
+    cache-bandwidth-bound (the cache bytes streamed per token dwarf the
+    weights once B*S is large), so int8 storage nearly halves the HBM
+    traffic of every decode step; the int8->compute-dtype convert fuses
+    into the attention einsums.  Scale overhead is 4/D bytes/elem (~6% at
+    D=64).  Companion to the weight-only path in models/quant.py."""
+    k: Array
+    v: Array
+    k_scale: Array
+    v_scale: Array
+    length: Array
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def _kv_quantize(x: Array) -> tuple[Array, Array]:
+    """Symmetric int8 over the head_dim (last) axis: x [..., D] ->
+    (int8 [..., D], f32 scale [...])."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def init_cache(model: Transformer, batch: int, max_len: int,
+               cache_dtype: str = "native") -> KVCache | QuantKVCache:
     c = model.config
     # GQA: the cache stores kv_heads (< n_heads) — n_heads/kv_heads x less
     # cache HBM; heads expand to the query count at attention time
     shape = (c.n_layers, batch, max_len, c.kv_heads, c.head_dim)
+    if cache_dtype == "int8":
+        return QuantKVCache(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.ones(shape[:-1], jnp.float32),
+            v_scale=jnp.ones(shape[:-1], jnp.float32),
+            length=jnp.zeros((), jnp.int32))
     return KVCache(k=jnp.zeros(shape, c.dtype), v=jnp.zeros(shape, c.dtype),
                    length=jnp.zeros((), jnp.int32))
 
 
 def prefill(model: Transformer, params: Mapping[str, Array], tokens: Array,
-            max_len: int) -> tuple[Array, KVCache]:
+            max_len: int, cache_dtype: str = "native",
+            ) -> tuple[Array, KVCache | QuantKVCache]:
     """Run the prompt through the full-sequence forward; returns the last
-    position's logits [B, vocab] and a cache holding the prompt's K/V."""
+    position's logits [B, vocab] and a cache holding the prompt's K/V
+    (int8-quantized on write when ``cache_dtype="int8"``)."""
     batch, prompt_len = tokens.shape
     if prompt_len > max_len:
         raise ValueError(f"prompt {prompt_len} exceeds cache {max_len}")
     logits, kvs = model.apply_collect_kv(params, tokens)
-    cache = init_cache(model, batch, max_len)
+    cache = init_cache(model, batch, max_len, cache_dtype)
     k = jnp.stack([k for k, _ in kvs])        # [L, B, S, H, D]
     v = jnp.stack([v for _, v in kvs])
+    at0 = (0, 0, 0, 0, 0)
+    if isinstance(cache, QuantKVCache):
+        k8, ks = _kv_quantize(k)
+        v8, vs = _kv_quantize(v)
+        cache = QuantKVCache(
+            k=jax.lax.dynamic_update_slice(cache.k, k8, at0),
+            v=jax.lax.dynamic_update_slice(cache.v, v8, at0),
+            k_scale=jax.lax.dynamic_update_slice(cache.k_scale, ks, at0[:-1]),
+            v_scale=jax.lax.dynamic_update_slice(cache.v_scale, vs, at0[:-1]),
+            length=jnp.asarray(prompt_len, jnp.int32))
+        return logits[:, -1], cache
     cache = KVCache(
         k=jax.lax.dynamic_update_slice(cache.k, k.astype(cache.k.dtype),
-                                       (0, 0, 0, 0, 0)),
+                                       at0),
         v=jax.lax.dynamic_update_slice(cache.v, v.astype(cache.v.dtype),
-                                       (0, 0, 0, 0, 0)),
+                                       at0),
         length=jnp.asarray(prompt_len, jnp.int32))
     return logits[:, -1], cache
 
 
 def decode_block(model: Transformer, params: Mapping[str, Array],
-                 tokens: Array, cache: KVCache,
-                 lengths: Array | None = None) -> tuple[Array, KVCache]:
+                 tokens: Array, cache: KVCache | QuantKVCache,
+                 lengths: Array | None = None,
+                 ) -> tuple[Array, KVCache | QuantKVCache]:
     """Forward a block of ``tokens`` [B, T] against the cache at positions
     length..length+T-1, causally masked within the block — the verify
     step of speculative decoding (T=1 is ordinary single-token decode).
@@ -118,13 +170,19 @@ def decode_block(model: Transformer, params: Mapping[str, Array],
         mask = (jnp.arange(cache.max_len)[None, :]
                 <= (pos + offsets)[:, None])[None, None, None]  # [1,1,1,T,M]
     h = jnp.take(params["embed/tok"], tokens, axis=0)        # [B, T, d]
+    quant = isinstance(cache, QuantKVCache)
     new_k, new_v = cache.k, cache.v
+    new_ks = cache.k_scale if quant else None
+    new_vs = cache.v_scale if quant else None
     groups = c.kv_groups
     for i in range(c.n_layers):
         # layer_view resolves either param layout (unrolled layer<i>/* or
         # scan_layers' stacked blocks/*)
         lp, p = model.layer_view(params, i)
         q, k, v = model.qkv(lp, p, h, positions)  # k/v: [B, T, KV, D]
+        if quant:
+            k, ks = _kv_quantize(k)
+            v, vs = _kv_quantize(v)
         if ragged:
             # mode="drop": rows that finished generating keep advancing
             # their lengths each speculative round, so their scatter
@@ -134,23 +192,45 @@ def decode_block(model: Transformer, params: Mapping[str, Array],
                 k.astype(new_k.dtype), mode="drop")
             new_v = new_v.at[i, bidx, positions].set(
                 v.astype(new_v.dtype), mode="drop")
+            if quant:
+                new_ks = new_ks.at[i, bidx, positions].set(ks, mode="drop")
+                new_vs = new_vs.at[i, bidx, positions].set(vs, mode="drop")
         else:
             new_k = jax.lax.dynamic_update_slice(
                 new_k, k[None].astype(new_k.dtype), (i, 0, pos, 0, 0))
             new_v = jax.lax.dynamic_update_slice(
                 new_v, v[None].astype(new_v.dtype), (i, 0, pos, 0, 0))
+            if quant:
+                new_ks = jax.lax.dynamic_update_slice(
+                    new_ks, ks[None], (i, 0, pos, 0))
+                new_vs = jax.lax.dynamic_update_slice(
+                    new_vs, vs[None], (i, 0, pos, 0))
         # dense attention against the cache, f32 softmax.  GQA: contract
         # query-head groups directly against the UNexpanded cache — the
         # cache bytes streamed per step stay kv_heads-sized (the point of
         # the smaller cache), no materialized repeat
         b, s_q = q.shape[:2]
         qg = q.reshape(b, s_q, c.kv_heads, groups, c.head_dim)
-        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, new_k[i],
+        # int8 cache: contract against the int8 array (only int8 bytes
+        # stream from HBM; the convert fuses into the einsum) and fold the
+        # per-(position, head) scale into the product afterwards
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
+                            new_k[i].astype(c.dtype) if quant else new_k[i],
                             preferred_element_type=jnp.float32)
+        if quant:
+            # k_scale[i]: [B, M, H] -> [B, H, 1, 1, M] over score axes
+            scores = scores * jnp.transpose(
+                new_ks[i], (0, 2, 1))[:, :, None, None, :]
         scores = scores / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
         scores = jnp.where(mask, scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-        attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, new_v[i],
+        if quant:
+            # fold v_scale into probs (tiny [.., M] multiply) so the value
+            # contraction streams raw int8
+            probs = probs * jnp.transpose(
+                new_vs[i], (0, 2, 1))[:, :, None, None, :].astype(c.dtype)
+        attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs,
+                          new_v[i].astype(c.dtype) if quant else new_v[i],
                           preferred_element_type=jnp.float32).astype(c.dtype)
         attn = attn.reshape(b, s_q, c.n_heads, c.head_dim)
         h = model.attn_residual(lp, p, h, attn)
@@ -158,11 +238,15 @@ def decode_block(model: Transformer, params: Mapping[str, Array],
         h, _ = model.ffn_residual(params, i, h, decode=True)
     logits = model.final_logits(params, h)
     new_length = cache.length if ragged else pos + t
+    if quant:
+        return logits, QuantKVCache(k=new_k, v=new_v, k_scale=new_ks,
+                                    v_scale=new_vs, length=new_length)
     return logits, KVCache(k=new_k, v=new_v, length=new_length)
 
 
 def decode_step(model: Transformer, params: Mapping[str, Array],
-                token: Array, cache: KVCache) -> tuple[Array, KVCache]:
+                token: Array, cache: KVCache | QuantKVCache,
+                ) -> tuple[Array, KVCache | QuantKVCache]:
     """One single-token forward against the cache.  token: [B] int32 ->
     (logits [B, vocab] float32, updated cache)."""
     logits, cache = decode_block(model, params, token[:, None], cache)
@@ -232,14 +316,16 @@ def _cached_runner(key: tuple, build):
 
 
 def _runner(model: Transformer, max_new_tokens: int, temperature: float,
-            top_k: int, top_p: float):
-    key = (_model_key(model), max_new_tokens, temperature, top_k, top_p)
+            top_k: int, top_p: float, cache_dtype: str = "native"):
+    key = (_model_key(model), max_new_tokens, temperature, top_k, top_p,
+           cache_dtype)
 
     def build():
         @jax.jit
         def run(params, prompt, rng):
             max_len = prompt.shape[1] + max_new_tokens
-            logits, cache = prefill(model, params, prompt, max_len)
+            logits, cache = prefill(model, params, prompt, max_len,
+                                    cache_dtype)
             rng0, rng = jax.random.split(rng)
             first = sample_token(logits, rng0, temperature, top_k, top_p)
 
@@ -738,13 +824,16 @@ def speculative_generate_batched(
 def generate(model: Transformer, params: Mapping[str, Array],
              prompt: Array, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
-             rng: Array | int = 0) -> Array:
+             rng: Array | int = 0, cache_dtype: str = "native") -> Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` [B, S] int32.
     Returns [B, max_new_tokens].  Prefill and the whole decode scan are
     jitted with static shapes; the compiled runner is cached per
-    (model, max_new_tokens, temperature, top_k, top_p), so repeated calls
-    with the same shapes do not retrace."""
+    (model, max_new_tokens, temperature, top_k, top_p, cache_dtype), so
+    repeated calls with the same shapes do not retrace.
+    ``cache_dtype="int8"`` stores the KV cache quantized (QuantKVCache) —
+    composes with a models/quant.py weight-quantized ``params`` for the
+    fully int8-bandwidth serving path."""
     if isinstance(rng, int):
         rng = jax.random.key(rng)
-    return _runner(model, max_new_tokens, temperature, top_k, top_p)(
-        params, prompt, rng)
+    return _runner(model, max_new_tokens, temperature, top_k, top_p,
+                   cache_dtype)(params, prompt, rng)

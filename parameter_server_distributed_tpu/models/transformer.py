@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .moe import moe_expert_weight_spec
+from .quant import wdot
 
 Array = jax.Array
 
@@ -448,7 +449,8 @@ class Transformer:
         c = self.config
         batch, seq = h.shape[:2]
         x = rms_norm(h, params[f"{prefix}/ln1/scale"])
-        dot = partial(jnp.dot, preferred_element_type=jnp.float32)
+        # wdot: contracts against int8 QTensor weights too (serving quant)
+        dot = partial(wdot, preferred_element_type=jnp.float32)
         q = dot(x, params[f"{prefix}/attn/wq"]).astype(c.dtype)
         k = dot(x, params[f"{prefix}/attn/wk"]).astype(c.dtype)
         v = dot(x, params[f"{prefix}/attn/wv"]).astype(c.dtype)
@@ -463,16 +465,16 @@ class Transformer:
         """h + wo(attn).  attn: [B, S, H, D]."""
         c = self.config
         batch, seq = h.shape[:2]
-        out = jnp.dot(attn.reshape(batch, seq, c.d_model),
-                      params[f"{prefix}/attn/wo"],
-                      preferred_element_type=jnp.float32)
+        out = wdot(attn.reshape(batch, seq, c.d_model),
+                   params[f"{prefix}/attn/wo"],
+                   preferred_element_type=jnp.float32)
         return h + out.astype(c.dtype)
 
     def mlp_residual(self, params: Mapping[str, Array], prefix: str,
                      h: Array) -> Array:
         """h + w2(gelu(w1(ln2(h))))."""
         c = self.config
-        dot = partial(jnp.dot, preferred_element_type=jnp.float32)
+        dot = partial(wdot, preferred_element_type=jnp.float32)
         x = rms_norm(h, params[f"{prefix}/ln2/scale"])
         ff = jax.nn.gelu(dot(x, params[f"{prefix}/mlp/w1"]).astype(c.dtype))
         return h + dot(ff, params[f"{prefix}/mlp/w2"]).astype(c.dtype)
@@ -509,8 +511,8 @@ class Transformer:
 
     def final_logits(self, params: Mapping[str, Array], h: Array) -> Array:
         h = rms_norm(h, params["final_ln/scale"])
-        return jnp.dot(h, params["lm_head/w"],
-                       preferred_element_type=jnp.float32)
+        return wdot(h, params["lm_head/w"],
+                    preferred_element_type=jnp.float32)
 
     def _forward(self, params: Mapping[str, Array], tokens: Array,
                  collect_kv: bool) -> tuple[Array, list, Array]:

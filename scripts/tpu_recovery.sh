@@ -94,6 +94,8 @@ run lm350_flash_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSD
 run lm350_dense_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192 PSDT_BENCH_SCAN=1
 # -- 4. decode/serving
 run decode_small_lm              PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
+run decode_small_lm_int8         PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_QUANT=int8
+run decode_small_lm_int8_full    PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_QUANT=int8 PSDT_BENCH_KV_CACHE=int8
 run spec_perfect_draft           PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=self PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run spec_tiny_draft              PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run spec_trained_draft_k2        PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_TRAIN_STEPS=200 PSDT_BENCH_DRAFT_LEN=2 PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64

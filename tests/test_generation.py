@@ -95,7 +95,8 @@ def test_repeated_generate_does_not_retrace(rng):
     params = model.init_params(3)
     prompt = jnp.asarray(rng.integers(0, 96, (1, 4)), jnp.int32)
     generate(model, params, prompt, 3)
-    run = generation._RUNNERS[(generation._model_key(model), 3, 0.0, 0, 0.0)]
+    run = generation._RUNNERS[
+        (generation._model_key(model), 3, 0.0, 0, 0.0, "native")]
     traces_before = run._cache_size()
     out1 = generate(model, params, prompt, 3)
     out2 = generate(model, params, prompt, 3)

@@ -601,6 +601,8 @@ def bench_generate() -> dict:
     batch = int(os.environ.get("PSDT_BENCH_BATCH", "8"))
     max_new = int(os.environ.get("PSDT_BENCH_STEPS", "64"))
     train_steps = int(os.environ.get("PSDT_BENCH_TRAIN_STEPS", "0"))
+    quant_kv = os.environ.get("PSDT_BENCH_KV_CACHE", "") == "int8"
+    cache_dtype = "int8" if quant_kv else "native"
     model, _ = get_model_and_batches(name, batch)
     params = model.init_params(0)
     rng = np.random.default_rng(0)
@@ -631,32 +633,37 @@ def bench_generate() -> dict:
                 f"draft loss {dloss:.3f}")
         draft_len = int(os.environ.get("PSDT_BENCH_DRAFT_LEN", "4"))
         reps = 3
-        # greedy baseline with the SAME batch: the speedup denominator
-        generate(model, params, prompt, max_new)
+        # greedy baseline with the SAME batch (and same cache dtype): the
+        # speedup denominator
+        generate(model, params, prompt, max_new, cache_dtype=cache_dtype)
         t0 = time.perf_counter()
         for _ in range(reps):
-            base_out = generate(model, params, prompt, max_new)
+            base_out = generate(model, params, prompt, max_new,
+                                cache_dtype=cache_dtype)
         np.asarray(base_out)
         base_dt = (time.perf_counter() - t0) / reps
         base_tps = batch * max_new / base_dt
         # batched device-loop speculative decoding (accept/resample under
         # one jit, per-row ragged caches — models/generation.py)
         speculative_generate_batched(model, params, draft, dparams, prompt,
-                                     max_new, draft_len=draft_len)
+                                     max_new, draft_len=draft_len,
+                                     cache_dtype=cache_dtype)
         t0 = time.perf_counter()
         for _ in range(reps):
             out, stats = speculative_generate_batched(
                 model, params, draft, dparams, prompt, max_new,
-                draft_len=draft_len)
+                draft_len=draft_len, cache_dtype=cache_dtype)
         dt = (time.perf_counter() - t0) / reps
         tps = batch * max_new / dt
         log(f"bench_generate: speculative target={name} draft={draft_name} "
-            f"k={draft_len} batch={batch}: {tps:,.0f} tokens/s vs greedy "
+            f"k={draft_len} batch={batch} cache={cache_dtype}: "
+            f"{tps:,.0f} tokens/s vs greedy "
             f"{base_tps:,.0f} ({tps / base_tps:.2f}x), "
             f"{stats['tokens_per_target_forward']:.2f} tokens/target-fwd, "
             f"accept {stats['draft_accept_rate']:.2f}")
         suffix = (f"_trained{train_steps}" if train_steps
                   and draft_name != "self" else "")
+        suffix += "_kv8" if cache_dtype == "int8" else ""
         return {"metric": f"{name}_speculative_tokens_per_sec{suffix}",
                 "value": round(tps, 1), "unit": "tokens/sec",
                 "vs_baseline": round(tps / base_tps, 3)}
@@ -678,7 +685,6 @@ def bench_generate() -> dict:
         f"{tps:,.0f} tokens/s ({dt*1e3/max_new:.2f} ms/token-step)")
 
     quant_w = os.environ.get("PSDT_BENCH_QUANT", "") == "int8"
-    quant_kv = os.environ.get("PSDT_BENCH_KV_CACHE", "") == "int8"
     if quant_w or quant_kv:
         # int8 serving A/B against the bf16 decode just timed: decode
         # streams the full weight set (+ KV cache) per token, so halved
@@ -687,7 +693,6 @@ def bench_generate() -> dict:
         from parameter_server_distributed_tpu.models.quant import (
             quantize_params, store_bytes)
         qparams = quantize_params(params) if quant_w else params
-        cache_dtype = "int8" if quant_kv else "native"
         # the baseline just timed ran the model's own dtype — label the
         # A/B with it honestly (small LMs default f32 on CPU hosts)
         base_dtype = np.dtype(model.config.dtype)

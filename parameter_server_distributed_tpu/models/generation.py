@@ -88,6 +88,9 @@ def _kv_quantize(x: Array) -> tuple[Array, Array]:
 def init_cache(model: Transformer, batch: int, max_len: int,
                cache_dtype: str = "native") -> KVCache | QuantKVCache:
     c = model.config
+    if cache_dtype not in ("native", "int8"):
+        raise ValueError(
+            f"cache_dtype must be 'native' or 'int8', got {cache_dtype!r}")
     # GQA: the cache stores kv_heads (< n_heads) — n_heads/kv_heads x less
     # cache HBM; heads expand to the query count at attention time
     shape = (c.n_layers, batch, max_len, c.kv_heads, c.head_dim)
@@ -630,13 +633,13 @@ def speculative_generate(target: Transformer, target_params,
 
 def _spec_batched_runner(target: Transformer, draft: Transformer,
                          max_new_tokens: int, draft_len: int,
-                         temperature: float):
+                         temperature: float, cache_dtype: str = "native"):
     """Compiled whole-loop batched speculative decoder (see
     :func:`speculative_generate_batched`).  One jit: prefill both models,
     then a lax.while_loop whose body is draft-propose -> verify ->
     vectorized accept/resample — no host round-trips inside the loop."""
     key_tuple = (_model_key(target), _model_key(draft), "spec_batched",
-                 max_new_tokens, draft_len, temperature)
+                 max_new_tokens, draft_len, temperature, cache_dtype)
     k_draft = draft_len
     sampling = temperature > 0.0
 
@@ -649,8 +652,10 @@ def _spec_batched_runner(target: Transformer, draft: Transformer,
             bidx = jnp.arange(batch, dtype=jnp.int32)[:, None]
             iota_k1 = jnp.arange(k_draft + 1, dtype=jnp.int32)
 
-            t_logits, t_cache = prefill(target, tparams, prompt, max_len)
-            _, d_cache = prefill(draft, dparams, prompt, max_len)
+            t_logits, t_cache = prefill(target, tparams, prompt, max_len,
+                                        cache_dtype)
+            _, d_cache = prefill(draft, dparams, prompt, max_len,
+                                 cache_dtype)
 
             def sample(logits, key):
                 if not sampling:
@@ -776,7 +781,7 @@ def speculative_generate_batched(
         target: Transformer, target_params, draft: Transformer,
         draft_params, prompt: Array, max_new_tokens: int, *,
         draft_len: int = 4, temperature: float = 0.0,
-        seed: int = 0) -> tuple[Array, dict]:
+        seed: int = 0, cache_dtype: str = "native") -> tuple[Array, dict]:
     """Batched speculative decoding with the WHOLE loop on device.
 
     Unlike :func:`speculative_generate` (batch-1, host accept loop — kept
@@ -797,6 +802,12 @@ def speculative_generate_batched(
     Leviathan/Chen rejection rule vectorized on device, preserving the
     target's sampling distribution exactly (tested empirically).
 
+    ``cache_dtype="int8"`` stores BOTH models' KV caches quantized
+    (QuantKVCache; the ragged per-row scatter paths quantize on write) —
+    K/V depend only on (token, position, params), so block-verify and
+    single-step writes quantize identically and the greedy token-exactness
+    vs an int8-cache target-alone decode is preserved (tested).
+
     Returns (tokens [B, max_new_tokens], stats).
     """
     if target.config.vocab != draft.config.vocab:
@@ -806,7 +817,7 @@ def speculative_generate_batched(
     if draft_len < 1:
         raise ValueError("draft_len must be >= 1")
     run = _spec_batched_runner(target, draft, max_new_tokens, draft_len,
-                               float(temperature))
+                               float(temperature), cache_dtype)
     tokens, stats = run(target_params, draft_params,
                         jnp.asarray(prompt, jnp.int32),
                         jax.random.key(seed))

@@ -175,6 +175,24 @@ def test_int8_kv_cache_gqa(rng):
     assert out_q8.shape == out_fp.shape
 
 
+def test_int8_kv_cache_speculative_matches_int8_greedy(rng):
+    """Perfect self-draft speculative decoding with int8 caches stays
+    token-exact vs int8-cache greedy decoding: K/V depend only on (token,
+    position, params), so ragged block writes and single-step writes
+    quantize identically."""
+    from parameter_server_distributed_tpu.models.generation import (
+        speculative_generate_batched)
+    model = tiny()
+    params = model.init_params(0)
+    prompt = jnp.asarray(rng.integers(0, 96, (2, 6)), jnp.int32)
+    greedy = generate(model, params, prompt, 6, cache_dtype="int8")
+    spec, stats = speculative_generate_batched(
+        model, params, model, params, prompt, 6, draft_len=2,
+        cache_dtype="int8")
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(greedy))
+    assert stats["draft_accept_rate"] == 1.0
+
+
 def test_store_bytes_reports_shrink():
     model = tiny()
     params = {k: (v.astype(jnp.bfloat16) if v.ndim >= 2 else v)

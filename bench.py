@@ -25,7 +25,9 @@ final stdout is always exactly one JSON line; failures carry the
 exception text in a "note" field.
 
 Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
-(mfu | samples | pushpull | async | generate; default mfu),
+(mfu | samples | pushpull | async | generate | serve | attention;
+default mfu; serve = continuous-batching sustained tokens/s, with
+PSDT_BENCH_REQUESTS total requests),
 PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
 (default 2), PSDT_BENCH_CPU_TIMEOUT (s, default 420), PSDT_BENCH_REMAT /
 PSDT_BENCH_SCAN (unset = model default, 0/1 force off/on — remat and
@@ -726,6 +728,59 @@ def bench_generate() -> dict:
             "unit": "tokens/sec", "vs_baseline": 1.0}
 
 
+def bench_serve() -> dict:
+    """Continuous-batching server throughput: keep all slots full with a
+    steady arrival stream (a new request is admitted the moment a slot
+    frees) and report sustained tokens/s across the whole run — the
+    serving-runtime number, vs bench_generate's one-shot batch decode.
+    PSDT_BENCH_BATCH = slots, PSDT_BENCH_STEPS = tokens per request,
+    PSDT_BENCH_REQUESTS = total requests (default 4x slots),
+    PSDT_BENCH_QUANT / PSDT_BENCH_KV_CACHE as in generate mode."""
+    import numpy as np
+
+    from parameter_server_distributed_tpu.models.registry import (
+        get_model_and_batches)
+    from parameter_server_distributed_tpu.models.serving import DecodeServer
+
+    name = os.environ.get("PSDT_BENCH_MODEL", "small_lm")
+    slots = int(os.environ.get("PSDT_BENCH_BATCH", "8"))
+    per_req = int(os.environ.get("PSDT_BENCH_STEPS", "64"))
+    n_req = int(os.environ.get("PSDT_BENCH_REQUESTS", str(4 * slots)))
+    cache_dtype = ("int8" if os.environ.get("PSDT_BENCH_KV_CACHE", "")
+                   == "int8" else "native")
+    model, _ = get_model_and_batches(name, slots)
+    params = model.init_params(0)
+    if os.environ.get("PSDT_BENCH_QUANT", "") == "int8":
+        from parameter_server_distributed_tpu.models.quant import (
+            quantize_params)
+        params = quantize_params(params)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.config.vocab, 24).astype(np.int32)
+               for _ in range(n_req)]
+
+    def drive(prompt_list):
+        srv = DecodeServer(model, params, slots=slots,
+                           max_len=32 + per_req, cache_dtype=cache_dtype)
+        pending = list(prompt_list)
+        while pending or not srv.idle:
+            while pending and srv.has_free_slot:
+                srv.submit(pending.pop(), max_new_tokens=per_req)
+            srv.step()
+        return srv
+
+    drive(prompts[:slots])                     # compile all three programs
+    t0 = time.perf_counter()
+    drive(prompts)
+    dt = time.perf_counter() - t0
+    tps = n_req * per_req / dt
+    suffix = "_kv8" if cache_dtype == "int8" else ""
+    log(f"bench_serve: model={name} slots={slots} requests={n_req} x "
+        f"{per_req} tokens: {tps:,.0f} sustained tokens/s")
+    return {"metric": f"{name}_serve_tokens_per_sec{suffix}",
+            "value": round(tps, 1), "unit": "tokens/sec",
+            "vs_baseline": 1.0}
+
+
 def bench_async() -> dict:
     """End-to-end async/bounded-staleness throughput: real PS + coordinator
     over localhost gRPC, N worker threads training a real model on the
@@ -879,6 +934,8 @@ def child_main(mode: str) -> int:
             result = bench_async()
         elif mode == "generate":
             result = bench_generate()
+        elif mode == "serve":
+            result = bench_serve()
         elif mode == "attention":
             result = bench_attention()
         else:

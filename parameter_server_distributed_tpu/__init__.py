@@ -58,6 +58,7 @@ _API = {
     "beam_search": ("models.generation", "beam_search"),
     "speculative_generate": ("models.generation", "speculative_generate"),
     "quantize_params": ("models.quant", "quantize_params"),
+    "DecodeServer": ("models.serving", "DecodeServer"),
     "get_model_and_batches": ("models.registry", "get_model_and_batches"),
     "Transformer": ("models.transformer", "Transformer"),
     "TransformerConfig": ("models.transformer", "TransformerConfig"),

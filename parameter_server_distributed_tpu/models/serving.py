@@ -1,0 +1,298 @@
+"""Continuous-batching decode server: the serving runtime over the ragged
+KV-cache machinery.
+
+Static-shape TPU serving has a classic tension: the device wants one fixed
+[B, ...] decode program compiled once, but requests arrive and finish at
+arbitrary times.  The resolution (the pattern behind production LLM
+servers) is **slot-based continuous batching**:
+
+- the KV cache is allocated once with B slots;
+- every device step decodes ALL B slots in one ragged ``decode_block``
+  (per-row lengths — rows sit at different positions), one compiled
+  program, no retraces;
+- a request occupies a slot from submit to EOS/limit; a finished slot is
+  immediately refillable by the next request via a prefill whose K/V are
+  spliced into that slot's cache rows while the other slots' state is
+  untouched — admission never pauses in-flight decodes.
+
+Prefill pads prompts up to a power-of-two bucket so only a handful of
+prefill programs ever compile.  Pad positions write garbage K/V beyond
+the row's real length — harmless by construction: the ragged attention
+mask hides positions >= length, and subsequent decode steps overwrite
+exactly those cache rows.
+
+The reference has no serving path at all (no model, no inference —
+reference src/worker.cpp:316-329 fabricates 0.01-gradients); this is
+TPU-native added capability alongside generation.py's one-shot decoders.
+Composes with the int8 serving stack: ``cache_dtype="int8"`` quantizes
+the slot cache (generation.QuantKVCache), and a models/quant.py
+weight-quantized ``params`` store works unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generation import (KVCache, QuantKVCache, _cached_runner, _kv_quantize,
+                         _model_key, decode_block, init_cache, sample_token)
+from .transformer import Transformer
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    tokens: list[int]          # generated tokens so far
+    max_new: int
+    done: bool = False
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def _prefill_runner(model: Transformer, bucket: int, cache_dtype: str):
+    """Jitted per (model, prompt bucket): forward the padded prompt, return
+    the last REAL position's logits and the prompt's K/V stack (quantized
+    already when the slot cache is int8, so splicing is dtype-pure)."""
+    key = (_model_key(model), "serve_prefill", bucket, cache_dtype)
+
+    def build():
+        @jax.jit
+        def run(params, padded, real_len):
+            logits, kvs = model.apply_collect_kv(params, padded)
+            last = logits[0, real_len - 1]                  # [vocab]
+            k = jnp.stack([k for k, _ in kvs])[:, 0]        # [L, S', H, D]
+            v = jnp.stack([v for _, v in kvs])[:, 0]
+            if cache_dtype == "int8":
+                k8, ks = _kv_quantize(k)
+                v8, vs = _kv_quantize(v)
+                return last, (k8, v8, ks, vs)
+            return last, (k, v)
+
+        return run
+
+    return _cached_runner(key, build)
+
+
+def _splice_runner(model: Transformer, bucket: int, cache_dtype: str):
+    """Jitted per (model, bucket): write one prefilled row's K/V into slot
+    ``slot`` of the batch cache (dynamic slot index — one program serves
+    every slot)."""
+    key = (_model_key(model), "serve_splice", bucket, cache_dtype)
+
+    def build():
+        # donate the cache: the host drops its old reference immediately,
+        # so XLA may update the (large) K/V buffers in place
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(cache, row, slot):
+            if cache_dtype == "int8":
+                k8, v8, ks, vs = row
+                return QuantKVCache(
+                    k=jax.lax.dynamic_update_slice(
+                        cache.k, k8[:, None], (0, slot, 0, 0, 0)),
+                    v=jax.lax.dynamic_update_slice(
+                        cache.v, v8[:, None], (0, slot, 0, 0, 0)),
+                    k_scale=jax.lax.dynamic_update_slice(
+                        cache.k_scale, ks[:, None], (0, slot, 0, 0)),
+                    v_scale=jax.lax.dynamic_update_slice(
+                        cache.v_scale, vs[:, None], (0, slot, 0, 0)),
+                    length=cache.length)
+            k, v = row
+            return KVCache(
+                k=jax.lax.dynamic_update_slice(
+                    cache.k, k[:, None].astype(cache.k.dtype),
+                    (0, slot, 0, 0, 0)),
+                v=jax.lax.dynamic_update_slice(
+                    cache.v, v[:, None].astype(cache.v.dtype),
+                    (0, slot, 0, 0, 0)),
+                length=cache.length)
+
+        return run
+
+    return _cached_runner(key, build)
+
+
+def _step_runner(model: Transformer, slots: int, temperature: float,
+                 top_k: int, top_p: float, cache_dtype: str):
+    """Jitted once per (model, B, sampling config): one ragged decode step
+    over ALL slots + sampling.  Free/done slots decode garbage lanes that
+    the host discards — the price of a single static program."""
+    key = (_model_key(model), "serve_step", slots, temperature, top_k,
+           top_p, cache_dtype)
+
+    def build():
+        # donate the cache: without it every per-token step would copy the
+        # whole [L, B, max_len, H, D] K/V — doubling HBM traffic in the
+        # exact loop this server exists to keep bandwidth-bound
+        @partial(jax.jit, donate_argnums=(2,))
+        def run(params, tokens, cache, lengths, rng):
+            logits, cache = decode_block(model, params, tokens[:, None],
+                                         cache, lengths=lengths)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_token(logits[:, 0], sub, temperature, top_k, top_p)
+            return nxt, cache, rng
+
+        return run
+
+    return _cached_runner(key, build)
+
+
+class DecodeServer:
+    """Slot-based continuous-batching decoder.
+
+    >>> srv = DecodeServer(model, params, slots=8, max_len=2048)
+    >>> rid = srv.submit([1, 2, 3], max_new_tokens=64)
+    >>> while not srv.idle:
+    ...     for request_id, token in srv.step():
+    ...         ...                      # stream tokens as they decode
+    >>> srv.result(rid)                  # full generation for a request
+
+    Host-side state is per-slot bookkeeping only; all model math runs in
+    three compiled programs (prefill-per-bucket, splice, step).  ``eos_id``
+    frees a slot early; a freed slot is reused by the next ``submit``.
+    """
+
+    def __init__(self, model: Transformer, params: Mapping[str, Any],
+                 slots: int = 8, max_len: int = 2048, *,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, eos_id: int | None = None,
+                 cache_dtype: str = "native", seed: int = 0):
+        self.model = model
+        self.params = params
+        self.slots = slots
+        self.max_len = max_len
+        self.eos_id = eos_id
+        self.cache_dtype = cache_dtype
+        self._cache = init_cache(model, slots, max_len, cache_dtype)
+        self._lengths = np.zeros((slots,), np.int32)
+        self._tokens = np.zeros((slots,), np.int32)
+        self._slot: list[_Slot | None] = [None] * slots
+        self._results: dict[int, list[int]] = {}
+        self._next_id = 0
+        self._rng = jax.random.key(seed)
+        self._step = _step_runner(model, slots, temperature, top_k, top_p,
+                                  cache_dtype)
+        self._temperature = temperature
+        self._top_k = top_k
+        self._top_p = top_p
+
+    # ------------------------------------------------------------- admin
+    @property
+    def idle(self) -> bool:
+        return all(s is None for s in self._slot)
+
+    @property
+    def has_free_slot(self) -> bool:
+        return self._free_slot() is not None
+
+    @property
+    def active(self) -> int:
+        """Number of in-flight requests."""
+        return sum(s is not None for s in self._slot)
+
+    def _free_slot(self) -> int | None:
+        for i, s in enumerate(self._slot):
+            if s is None:
+                return i
+        return None
+
+    # ------------------------------------------------------------ submit
+    def submit(self, prompt, max_new_tokens: int = 64) -> int:
+        """Admit a request into a free slot (prefill + cache splice).
+        Raises RuntimeError when every slot is busy — callers queue above
+        this layer.  Returns the request id."""
+        slot = self._free_slot()
+        if slot is None:
+            raise RuntimeError("no free slot; drain with step() first")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        real_len = int(prompt.shape[0])
+        if real_len == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        if real_len + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {real_len} + max_new {max_new_tokens} exceeds "
+                f"cache max_len {self.max_len}")
+        bucket = min(_bucket(real_len), self.max_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :real_len] = prompt
+        last, row = _prefill_runner(self.model, bucket, self.cache_dtype)(
+            self.params, jnp.asarray(padded),
+            jnp.asarray(real_len, jnp.int32))
+        self._rng, sub = jax.random.split(self._rng)
+        first = int(sample_token(last[None], sub, self._temperature,
+                                 self._top_k, self._top_p)[0])
+        self._cache = _splice_runner(self.model, bucket, self.cache_dtype)(
+            self._cache, row, jnp.asarray(slot, jnp.int32))
+        rid = self._next_id
+        self._next_id += 1
+        entry = _Slot(request_id=rid, tokens=[first],
+                      max_new=max_new_tokens)
+        self._slot[slot] = entry
+        self._lengths[slot] = real_len
+        self._tokens[slot] = first
+        if self._finishes(entry, first):
+            self._retire(slot)
+        return rid
+
+    # -------------------------------------------------------------- step
+    def step(self) -> list[tuple[int, int]]:
+        """One device decode step over all slots.  Returns
+        [(request_id, token), ...] for every ACTIVE slot's newly decoded
+        token (already appended to its result)."""
+        if self.idle:
+            return []
+        nxt, self._cache, self._rng = self._step(
+            self.params, jnp.asarray(self._tokens), self._cache,
+            jnp.asarray(self._lengths), self._rng)
+        nxt = np.asarray(nxt)
+        emitted: list[tuple[int, int]] = []
+        for i, entry in enumerate(self._slot):
+            if entry is None:
+                continue
+            token = int(nxt[i])
+            entry.tokens.append(token)
+            emitted.append((entry.request_id, token))
+            # the step consumed self._tokens[i] at position lengths[i]
+            self._lengths[i] += 1
+            self._tokens[i] = token
+            if self._finishes(entry, token):
+                self._retire(i)
+        return emitted
+
+    def _finishes(self, entry: _Slot, token: int) -> bool:
+        return (len(entry.tokens) >= entry.max_new
+                or (self.eos_id is not None and token == self.eos_id))
+
+    def _retire(self, slot: int) -> None:
+        entry = self._slot[slot]
+        entry.done = True
+        self._results[entry.request_id] = entry.tokens
+        self._slot[slot] = None
+        # lengths/tokens stay — the lane decodes garbage until reused;
+        # the splice on reuse rewrites the cache rows that matter
+
+    # ------------------------------------------------------------ result
+    def result(self, request_id: int) -> list[int]:
+        """Generated tokens for a finished request (pops it)."""
+        return self._results.pop(request_id)
+
+    def run_to_completion(self) -> dict[int, list[int]]:
+        """Drain all in-flight requests; returns {request_id: tokens}."""
+        while not self.idle:
+            self.step()
+        out, self._results = self._results, {}
+        return out

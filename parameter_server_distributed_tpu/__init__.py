@@ -59,6 +59,7 @@ _API = {
     "speculative_generate": ("models.generation", "speculative_generate"),
     "quantize_params": ("models.quant", "quantize_params"),
     "DecodeServer": ("models.serving", "DecodeServer"),
+    "from_hf_gpt2": ("models.hf", "from_hf_gpt2"),
     "get_model_and_batches": ("models.registry", "get_model_and_batches"),
     "Transformer": ("models.transformer", "Transformer"),
     "TransformerConfig": ("models.transformer", "TransformerConfig"),

@@ -2,7 +2,8 @@
 
     python -m parameter_server_distributed_tpu.cli.generate_main \
         --model=small_lm --prompt="the quick brown" --max-new=64 \
-        [--ckpt=path.ckpt | --ckpt-dir=orbax_dir [--avg-last=K]] \
+        [--ckpt=path.ckpt | --ckpt-dir=orbax_dir [--avg-last=K] \
+         | --hf-gpt2=<local transformers checkout or hub name>] \
         [--temperature=0.8] [--top-k=40] [--top-p=0.9] \
         [--beam=4 [--length-penalty=0.6]] \
         [--seed=0] \
@@ -74,8 +75,35 @@ KNOWN_FLAGS = frozenset({
     "model", "dtype", "scan-layers", "no-scan-layers", "seed", "ckpt",
     "ckpt-dir", "avg-last", "tokens", "prompt", "top-k", "top-p", "beam",
     "temperature", "max-new", "draft-model", "draft-ckpt", "draft-seed",
-    "draft-len", "length-penalty",
+    "draft-len", "length-penalty", "hf-gpt2",
 })
+
+
+def load_hf(flags: dict):
+    """--hf-gpt2=<local dir or hub name>: convert a transformers GPT-2
+    checkpoint (models/hf.py) and use its own tokenizer.  Returns
+    (model, params, tokenizer_or_None)."""
+    import jax.numpy as jnp
+    import transformers
+
+    from ..models.hf import from_hf_gpt2
+    from ..models.registry import DTYPE_NAMES
+
+    src = flags["hf-gpt2"]
+    hf_model = transformers.GPT2LMHeadModel.from_pretrained(src)
+    dtype_flag = flags.get("dtype", "")
+    if dtype_flag and dtype_flag not in DTYPE_NAMES:
+        raise ValueError(f"unknown dtype {dtype_flag!r}; "
+                         f"options {sorted(set(DTYPE_NAMES))}")
+    dtype = (getattr(jnp, DTYPE_NAMES[dtype_flag]) if dtype_flag
+             else jnp.float32)
+    model, params = from_hf_gpt2(
+        hf_model, dtype=dtype, scan_layers=("scan-layers" in flags))
+    try:
+        tok = transformers.AutoTokenizer.from_pretrained(src)
+    except Exception:  # noqa: BLE001 — tokenizer files may be absent
+        tok = None
+    return model, params, tok
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -100,22 +128,37 @@ def main(argv: list[str] | None = None) -> int:
     from ..models.registry import get_model_and_batches
     from ..models.transformer import Transformer
 
-    model, _ = get_model_and_batches(
-        flags.get("model", "small_lm"), 1, dtype=flags.get("dtype", ""),
-        scan=(False if "no-scan-layers" in flags
-              else True if "scan-layers" in flags else None))
-    if not isinstance(model, Transformer):
-        raise ValueError(f"--model={flags.get('model')!r} is not an LM")
     seed = int(flags.get("seed", 0))
-    params, source = load_params(flags, model, seed)
-    print(f"params: {source}", file=sys.stderr)
-
-    params = match_layout(model, params)
+    hf_tok = None
+    if flags.get("hf-gpt2"):
+        if flags.get("ckpt") or flags.get("ckpt-dir"):
+            raise ValueError("--hf-gpt2 provides its own weights; it does "
+                             "not combine with --ckpt/--ckpt-dir")
+        model, params, hf_tok = load_hf(flags)
+        print(f"params: HF GPT-2 checkpoint {flags['hf-gpt2']} "
+              f"({model.num_params() / 1e6:.1f}M params)", file=sys.stderr)
+    else:
+        model, _ = get_model_and_batches(
+            flags.get("model", "small_lm"), 1, dtype=flags.get("dtype", ""),
+            scan=(False if "no-scan-layers" in flags
+                  else True if "scan-layers" in flags else None))
+        if not isinstance(model, Transformer):
+            raise ValueError(f"--model={flags.get('model')!r} is not an LM")
+        params, source = load_params(flags, model, seed)
+        print(f"params: {source}", file=sys.stderr)
+        params = match_layout(model, params)
 
     tokenizer = ByteTokenizer()
     if flags.get("tokens"):
         ids = [int(t) for t in flags["tokens"].split(",")]
         decode_text = False
+    elif hf_tok is not None:
+        prompt_text = flags.get("prompt", "hello")
+        ids = hf_tok.encode(prompt_text)
+        decode_text = True
+    elif flags.get("hf-gpt2"):
+        raise ValueError("--hf-gpt2 checkpoint has no tokenizer files; "
+                         "pass raw ids via --tokens=1,2,3")
     else:
         from ..data.text import require_vocab
         prompt_text = flags.get("prompt", "hello")
@@ -170,10 +213,15 @@ def main(argv: list[str] | None = None) -> int:
             raise ValueError("--beam is deterministic; it does not combine "
                              "with --temperature/--top-k/--top-p")
         from ..models.generation import beam_search
-        # text mode: the byte tokenizer's EOS finishes beams early
-        # (require_vocab above guaranteed the model covers it);
-        # raw-token mode has no reserved stop id
-        eos = tokenizer.EOS if decode_text else None
+        # text mode: the tokenizer's EOS finishes beams early
+        # (require_vocab above guaranteed the byte vocab is covered);
+        # raw-token mode has no reserved stop id, HF or not
+        if not decode_text:
+            eos = None
+        elif hf_tok is not None:
+            eos = hf_tok.eos_token_id
+        else:
+            eos = tokenizer.EOS
         out, score = beam_search(
             model, params, prompt, max_new, beam_width=beam, eos_id=eos,
             length_penalty=float(flags.get("length-penalty", 0.0)))
@@ -185,10 +233,14 @@ def main(argv: list[str] | None = None) -> int:
                        rng=seed)
     tokens = np.asarray(out)[0]
     if decode_text:
-        stop = np.nonzero(tokens == tokenizer.EOS)[0]
+        eos_id = (hf_tok.eos_token_id if hf_tok is not None
+                  else tokenizer.EOS)
+        stop = np.nonzero(tokens == eos_id)[0]
         if stop.size:  # trim at the first EOS (beam padding or natural)
             tokens = tokens[:int(stop[0])]
-        print(tokenizer.decode(tokens), flush=True)
+        text = (hf_tok.decode(tokens) if hf_tok is not None
+                else tokenizer.decode(tokens))
+        print(text, flush=True)
     else:
         print(",".join(str(int(t)) for t in tokens), flush=True)
     return 0

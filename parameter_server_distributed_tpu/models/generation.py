@@ -104,6 +104,19 @@ def init_cache(model: Transformer, batch: int, max_len: int,
                    length=jnp.zeros((), jnp.int32))
 
 
+def check_position_budget(model: Transformer, prompt_len: int,
+                          max_new_tokens: int) -> None:
+    """Learned-position models have a hard position ceiling (the embed/pos
+    table); reject generations that would run past it instead of silently
+    reusing the last row's embedding (Transformer.embed clips only for
+    speculative slack lanes whose output is discarded)."""
+    c = model.config
+    if c.pos_emb == "learned" and prompt_len + max_new_tokens > c.max_seq:
+        raise ValueError(
+            f"prompt {prompt_len} + max_new {max_new_tokens} exceeds the "
+            f"learned-position table max_seq={c.max_seq}")
+
+
 def prefill(model: Transformer, params: Mapping[str, Array], tokens: Array,
             max_len: int, cache_dtype: str = "native",
             ) -> tuple[Array, KVCache | QuantKVCache]:
@@ -172,7 +185,11 @@ def decode_block(model: Transformer, params: Mapping[str, Array],
         # query j may attend cache positions 0..pos+j
         mask = (jnp.arange(cache.max_len)[None, :]
                 <= (pos + offsets)[:, None])[None, None, None]  # [1,1,1,T,M]
-    h = jnp.take(params["embed/tok"], tokens, axis=0)        # [B, T, d]
+    # shared embed: adds learned positional embeddings at the ragged
+    # positions when the config uses them (positions overshooting max_seq
+    # for finished speculative rows hit embed's explicit mode="clip" —
+    # those lanes' outputs are discarded)
+    h = model.embed(params, tokens, positions)               # [B, T, d]
     quant = isinstance(cache, QuantKVCache)
     new_k, new_v = cache.k, cache.v
     new_ks = cache.k_scale if quant else None
@@ -460,6 +477,8 @@ def beam_search(model: Transformer, params: Mapping[str, Array],
     if eos_id is not None and not 0 <= eos_id < model.config.vocab:
         raise ValueError(f"eos_id={eos_id} outside vocab "
                          f"{model.config.vocab}")
+    check_position_budget(model, int(np.asarray(prompt).shape[1]),
+                          max_new_tokens)
     return _beam_runner(model, max_new_tokens, beam_width, eos_id,
                         float(length_penalty))(params, prompt)
 
@@ -527,6 +546,10 @@ def speculative_generate(target: Transformer, target_params,
         raise ValueError("draft_len must be >= 1")
 
     s = prompt.shape[1]
+    # + draft_len + 1: a verify block may run past the committed length
+    # before rolling back
+    check_position_budget(target, s, max_new_tokens + draft_len + 1)
+    check_position_budget(draft, s, max_new_tokens + draft_len + 1)
     sampling = temperature > 0.0
     host_rng = np.random.default_rng(seed)
 
@@ -816,6 +839,12 @@ def speculative_generate_batched(
             f"{draft.config.vocab}")
     if draft_len < 1:
         raise ValueError("draft_len must be >= 1")
+    prompt_len = int(np.asarray(prompt).shape[1])
+    # + draft_len: the last verify round may write a full draft block
+    # before the loop notices every row is done (active lanes only —
+    # finished rows clip into discarded slack)
+    check_position_budget(target, prompt_len, max_new_tokens + draft_len)
+    check_position_budget(draft, prompt_len, max_new_tokens + draft_len)
     run = _spec_batched_runner(target, draft, max_new_tokens, draft_len,
                                float(temperature), cache_dtype)
     tokens, stats = run(target_params, draft_params,
@@ -844,6 +873,7 @@ def generate(model: Transformer, params: Mapping[str, Array],
     ``cache_dtype="int8"`` stores the KV cache quantized (QuantKVCache) —
     composes with a models/quant.py weight-quantized ``params`` for the
     fully int8-bandwidth serving path."""
+    check_position_budget(model, int(prompt.shape[1]), max_new_tokens)
     if isinstance(rng, int):
         rng = jax.random.key(rng)
     return _runner(model, max_new_tokens, temperature, top_k, top_p,

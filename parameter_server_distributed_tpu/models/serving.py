@@ -40,7 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .generation import (KVCache, QuantKVCache, _cached_runner, _kv_quantize,
-                         _model_key, decode_block, init_cache, sample_token)
+                         _model_key, check_position_budget, decode_block,
+                         init_cache, sample_token)
 from .transformer import Transformer
 
 Array = jax.Array
@@ -226,6 +227,7 @@ class DecodeServer:
             raise ValueError(
                 f"prompt {real_len} + max_new {max_new_tokens} exceeds "
                 f"cache max_len {self.max_len}")
+        check_position_budget(self.model, real_len, max_new_tokens)
         bucket = min(_bucket(real_len), self.max_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :real_len] = prompt

@@ -82,6 +82,22 @@ class TransformerConfig:
     scan_layers: bool = False
     moe_capacity: float = 1.25
     moe_aux_coef: float = 0.01
+    # --- GPT-2-family compatibility knobs (models/hf.py interop).  The
+    # defaults are the native architecture (RoPE + RMSNorm, no biases);
+    # the flags exist so pretrained-checkpoint families with learned
+    # positions / LayerNorm / biased projections convert losslessly.
+    pos_emb: str = "rope"         # rope | learned ("embed/pos" table)
+    norm: str = "rms"             # rms | layernorm (mean-centering + bias)
+    bias: bool = False            # biases on attn/mlp projections
+    norm_eps: float = 1e-6
+
+    def __post_init__(self):
+        if self.pos_emb not in ("rope", "learned"):
+            raise ValueError(
+                f"pos_emb must be 'rope' or 'learned', got {self.pos_emb!r}")
+        if self.norm not in ("rms", "layernorm"):
+            raise ValueError(
+                f"norm must be 'rms' or 'layernorm', got {self.norm!r}")
 
     @property
     def head_dim(self) -> int:
@@ -115,6 +131,17 @@ def rms_norm(x: Array, scale: Array, eps: float = 1e-6) -> Array:
     x32 = x.astype(jnp.float32)
     inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
     return (x32 * inv * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def layer_norm(x: Array, scale: Array, bias: Array,
+               eps: float = 1e-5) -> Array:
+    """Mean-centering LayerNorm with bias (the GPT-2-family norm)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
 
 
 def rope(x: Array, positions: Array, theta: float = 10000.0) -> Array:
@@ -345,6 +372,8 @@ class Transformer:
     def param_shapes(self) -> dict[str, tuple[int, ...]]:
         c = self.config
         shapes: dict[str, tuple[int, ...]] = {"embed/tok": (c.vocab, c.d_model)}
+        if c.pos_emb == "learned":
+            shapes["embed/pos"] = (c.max_seq, c.d_model)
         kv_dim = c.kv_heads * c.head_dim
         block = {"ln1/scale": (c.d_model,),
                  "attn/wq": (c.d_model, c.d_model),
@@ -352,12 +381,19 @@ class Transformer:
                  "attn/wv": (c.d_model, kv_dim),
                  "attn/wo": (c.d_model, c.d_model),
                  "ln2/scale": (c.d_model,)}
+        if c.norm == "layernorm":
+            block["ln1/bias"] = (c.d_model,)
+            block["ln2/bias"] = (c.d_model,)
+        if c.bias:
+            block.update({"attn/bq": (c.d_model,), "attn/bk": (kv_dim,),
+                          "attn/bv": (kv_dim,), "attn/bo": (c.d_model,)})
+        mlp = {"mlp/w1": (c.d_model, c.d_ff), "mlp/w2": (c.d_ff, c.d_model)}
+        if c.bias:
+            mlp.update({"mlp/b1": (c.d_ff,), "mlp/b2": (c.d_model,)})
         if c.scan_layers:
             # stacked layout: one [L, ...] array per block weight, scanned
-            for suffix, shape in block.items():
+            for suffix, shape in {**block, **mlp}.items():
                 shapes[f"blocks/{suffix}"] = (c.n_layers, *shape)
-            shapes["blocks/mlp/w1"] = (c.n_layers, c.d_model, c.d_ff)
-            shapes["blocks/mlp/w2"] = (c.n_layers, c.d_ff, c.d_model)
         else:
             for i in range(c.n_layers):
                 p = f"layer{i}"
@@ -368,9 +404,11 @@ class Transformer:
                     shapes[f"{p}/moe/w1"] = (c.moe_experts, c.d_model, c.d_ff)
                     shapes[f"{p}/moe/w2"] = (c.moe_experts, c.d_ff, c.d_model)
                 else:
-                    shapes[f"{p}/mlp/w1"] = (c.d_model, c.d_ff)
-                    shapes[f"{p}/mlp/w2"] = (c.d_ff, c.d_model)
+                    for suffix, shape in mlp.items():
+                        shapes[f"{p}/{suffix}"] = shape
         shapes["final_ln/scale"] = (c.d_model,)
+        if c.norm == "layernorm":
+            shapes["final_ln/bias"] = (c.d_model,)
         shapes["lm_head/w"] = (c.d_model, c.vocab)
         return shapes
 
@@ -406,7 +444,10 @@ class Transformer:
             rng, sub = jax.random.split(rng)
             if name.endswith("/scale"):
                 params[name] = jnp.ones(shape, c.dtype)
-            elif name == "embed/tok":
+            elif (name.endswith(("/bias", "/b1", "/b2", "/bq", "/bk",
+                                 "/bv", "/bo"))):
+                params[name] = jnp.zeros(shape, c.dtype)
+            elif name in ("embed/tok", "embed/pos"):
                 params[name] = jax.random.normal(sub, shape, c.dtype) * 0.02
             else:
                 # fan-in: leading dim for 2D weights, middle dim for the
@@ -440,44 +481,70 @@ class Transformer:
 
     # --- shared layer pieces (used by _forward AND generation.decode_step,
     # so the layer math exists exactly once) -----------------------------
+    def _norm(self, params: Mapping[str, Array], key: str, x: Array) -> Array:
+        """rms_norm or layer_norm per config — ``key`` is the ln prefix
+        (e.g. "layer0/ln1")."""
+        c = self.config
+        if c.norm == "layernorm":
+            return layer_norm(x, params[f"{key}/scale"],
+                              params[f"{key}/bias"], c.norm_eps)
+        return rms_norm(x, params[f"{key}/scale"], c.norm_eps)
+
     def qkv(self, params: Mapping[str, Array], prefix: str, h: Array,
             positions: Array) -> tuple[Array, Array, Array]:
-        """ln1 -> q/k/v projections -> head split -> rope.  h: [B, S, d].
+        """ln1 -> q/k/v projections (+ biases) -> head split -> rope (or
+        pass-through under learned positions).  h: [B, S, d].
         K/V come back with ``kv_heads`` heads (UNexpanded under GQA — the
         cache-friendly form); expand to the query head count with
         :func:`repeat_kv` before a plain attention kernel."""
         c = self.config
         batch, seq = h.shape[:2]
-        x = rms_norm(h, params[f"{prefix}/ln1/scale"])
+        x = self._norm(params, f"{prefix}/ln1", h)
         # wdot: contracts against int8 QTensor weights too (serving quant)
         dot = partial(wdot, preferred_element_type=jnp.float32)
-        q = dot(x, params[f"{prefix}/attn/wq"]).astype(c.dtype)
-        k = dot(x, params[f"{prefix}/attn/wk"]).astype(c.dtype)
-        v = dot(x, params[f"{prefix}/attn/wv"]).astype(c.dtype)
-        q = q.reshape(batch, seq, c.n_heads, c.head_dim)
-        k = k.reshape(batch, seq, c.kv_heads, c.head_dim)
-        v = v.reshape(batch, seq, c.kv_heads, c.head_dim)
+        q = dot(x, params[f"{prefix}/attn/wq"])
+        k = dot(x, params[f"{prefix}/attn/wk"])
+        v = dot(x, params[f"{prefix}/attn/wv"])
+        if c.bias:
+            q = q + params[f"{prefix}/attn/bq"].astype(jnp.float32)
+            k = k + params[f"{prefix}/attn/bk"].astype(jnp.float32)
+            v = v + params[f"{prefix}/attn/bv"].astype(jnp.float32)
+        q = q.astype(c.dtype).reshape(batch, seq, c.n_heads, c.head_dim)
+        k = k.astype(c.dtype).reshape(batch, seq, c.kv_heads, c.head_dim)
+        v = v.astype(c.dtype).reshape(batch, seq, c.kv_heads, c.head_dim)
+        if c.pos_emb == "learned":
+            # learned positions live in the residual stream (embed/pos,
+            # added at embedding time) — K/V need no positional transform
+            return q, k, v
         return (rope(q, positions, c.rope_theta),
                 rope(k, positions, c.rope_theta), v)
 
     def attn_residual(self, params: Mapping[str, Array], prefix: str,
                       h: Array, attn: Array) -> Array:
-        """h + wo(attn).  attn: [B, S, H, D]."""
+        """h + wo(attn) (+ bias).  attn: [B, S, H, D]."""
         c = self.config
         batch, seq = h.shape[:2]
         out = wdot(attn.reshape(batch, seq, c.d_model),
                    params[f"{prefix}/attn/wo"],
                    preferred_element_type=jnp.float32)
+        if c.bias:
+            out = out + params[f"{prefix}/attn/bo"].astype(jnp.float32)
         return h + out.astype(c.dtype)
 
     def mlp_residual(self, params: Mapping[str, Array], prefix: str,
                      h: Array) -> Array:
-        """h + w2(gelu(w1(ln2(h))))."""
+        """h + w2(gelu(w1(ln2(h)))) (+ biases)."""
         c = self.config
         dot = partial(wdot, preferred_element_type=jnp.float32)
-        x = rms_norm(h, params[f"{prefix}/ln2/scale"])
-        ff = jax.nn.gelu(dot(x, params[f"{prefix}/mlp/w1"]).astype(c.dtype))
-        return h + dot(ff, params[f"{prefix}/mlp/w2"]).astype(c.dtype)
+        x = self._norm(params, f"{prefix}/ln2", h)
+        ff = dot(x, params[f"{prefix}/mlp/w1"])
+        if c.bias:
+            ff = ff + params[f"{prefix}/mlp/b1"].astype(jnp.float32)
+        ff = jax.nn.gelu(ff.astype(c.dtype))
+        out = dot(ff, params[f"{prefix}/mlp/w2"])
+        if c.bias:
+            out = out + params[f"{prefix}/mlp/b2"].astype(jnp.float32)
+        return h + out.astype(c.dtype)
 
     def layer_view(self, params: Mapping[str, Array],
                    layer: int) -> tuple[Mapping[str, Array], str]:
@@ -503,24 +570,43 @@ class Transformer:
             lp, p = self.layer_view(params, layer)
             return self.mlp_residual(lp, p, h), jnp.zeros((), jnp.float32)
         p = f"layer{layer}"
-        x = rms_norm(h, params[f"{p}/ln2/scale"])
+        x = self._norm(params, f"{p}/ln2", h)
         cap = h.shape[0] * h.shape[1] if decode else None
         moe_out, aux = self._moe.apply(params, x, prefix=f"{p}/",
                                        capacity_override=cap)
         return h + moe_out.astype(self.config.dtype), aux
 
     def final_logits(self, params: Mapping[str, Array], h: Array) -> Array:
-        h = rms_norm(h, params["final_ln/scale"])
+        h = self._norm(params, "final_ln", h)
         return wdot(h, params["lm_head/w"],
                     preferred_element_type=jnp.float32)
+
+    def embed(self, params: Mapping[str, Array], tokens: Array,
+              positions: Array) -> Array:
+        """Token (+ learned positional) embedding — the single definition
+        shared by the training forward and cached decode, so the two can
+        never disagree about where position information enters.
+
+        mode="clip" on the positional gather: batched speculative
+        decoding's finished rows intentionally overshoot max_seq (their
+        outputs land in discarded slack lanes) and jnp.take's default
+        would fill NaN there, poisoning the row's whole forward.  The
+        REAL out-of-range case (a user decoding past max_seq) is rejected
+        loudly at the entry points (generate / DecodeServer.submit /
+        speculative_generate_batched), not silently clamped here."""
+        h = jnp.take(params["embed/tok"], tokens, axis=0)
+        if self.config.pos_emb == "learned":
+            h = h + jnp.take(params["embed/pos"], positions, axis=0,
+                             mode="clip").astype(h.dtype)
+        return h
 
     def _forward(self, params: Mapping[str, Array], tokens: Array,
                  collect_kv: bool) -> tuple[Array, list, Array]:
         c = self.config
         batch, seq = tokens.shape
-        h = jnp.take(params["embed/tok"], tokens, axis=0)
-        h = self._constrain(h, ("data", "fsdp"), "seq", None)
         positions = jnp.arange(seq, dtype=jnp.int32)[None, :].repeat(batch, 0)
+        h = self.embed(params, tokens, positions)
+        h = self._constrain(h, ("data", "fsdp"), "seq", None)
         kvs: list = []
         aux_total = jnp.zeros((), jnp.float32)
 
@@ -722,7 +808,15 @@ def transformer_rule(mesh: Mesh):
             taken = (len(shape) - 1
                      if n_tp > 1 and shape[-1] % n_tp == 0 else None)
             return PartitionSpec(*fsdp_on(0, taken))
-        if name.endswith("/scale"):
+        if name.endswith(("/scale", "/bias", "/bq", "/bk", "/bv", "/bo",
+                          "/b1", "/b2")):
+            # norm scales and all biases: tiny 1-D vectors, replicated like
+            # their paired scales (an fsdp-sharded bias would force a
+            # per-use all-gather against its tensor-sharded activation)
+            return PartitionSpec()
+        if name == "embed/pos":
+            # small [max_seq, d_model] table gathered per position —
+            # replicate rather than reshard every lookup
             return PartitionSpec()
         # fallback: fsdp on largest divisible dim
         spec: list = [None] * len(shape)

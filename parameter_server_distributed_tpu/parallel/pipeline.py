@@ -86,6 +86,16 @@ class PipelinedTransformerLM:
 
         if not isinstance(inner, Transformer):
             raise ValueError("pipeline parallelism wraps a Transformer LM")
+        if (inner.config.pos_emb != "rope" or inner.config.norm != "rms"
+                or inner.config.bias):
+            # the pipelined forward re-implements embed/ln2 inline for the
+            # native architecture only; silently training a GPT-2-family
+            # config here would drop its positional table and biases
+            raise ValueError(
+                "pipeline parallelism supports the native architecture "
+                "(pos_emb='rope', norm='rms', bias=False) only; "
+                f"got pos_emb={inner.config.pos_emb!r}, "
+                f"norm={inner.config.norm!r}, bias={inner.config.bias}")
         if inner.config.moe_every > 1:
             # Stage stacking requires HOMOGENEOUS blocks: every layer's
             # params stack along one leading [L/P] axis (init_params), so

@@ -1,0 +1,192 @@
+"""HF GPT-2 interop (models/hf.py) + the compatibility knobs it exercises
+(learned positions, LayerNorm, projection biases).
+
+Ground truth is the torch forward of a random-init GPT2LMHeadModel —
+no network or checkpoint files involved; the conversion must be a pure
+re-layout, so logits match to float32 tolerance and every downstream
+capability (KV-cached decode, continuous batching, quantization) works
+on the converted store unchanged.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+
+from parameter_server_distributed_tpu.models.generation import (  # noqa: E402
+    generate)
+from parameter_server_distributed_tpu.models.hf import (  # noqa: E402
+    from_hf_gpt2)
+from parameter_server_distributed_tpu.models.serving import (  # noqa: E402
+    DecodeServer)
+
+
+@pytest.fixture(scope="module")
+def hf_pair():
+    torch.manual_seed(0)
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=2)
+    hf_model = transformers.GPT2LMHeadModel(cfg).eval()
+    model, params = from_hf_gpt2(hf_model)
+    return hf_model, model, params
+
+
+def _torch_logits(hf_model, x):
+    with torch.no_grad():
+        return hf_model(torch.from_numpy(
+            np.asarray(x, np.int64))).logits.numpy()
+
+
+def test_logits_parity(hf_pair, rng):
+    hf_model, model, params = hf_pair
+    x = rng.integers(0, 128, (2, 12)).astype(np.int32)
+    want = _torch_logits(hf_model, x)
+    got = np.asarray(model.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_scan_layers_conversion_parity(hf_pair, rng):
+    hf_model, _, _ = hf_pair
+    model, params = from_hf_gpt2(hf_model, scan_layers=True)
+    x = rng.integers(0, 128, (1, 9)).astype(np.int32)
+    want = _torch_logits(hf_model, x)
+    got = np.asarray(model.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_greedy_generation_matches_hf(hf_pair, rng):
+    """End-to-end: our KV-cached greedy decode reproduces HF's greedy
+    continuation token for token."""
+    hf_model, model, params = hf_pair
+    prompt = rng.integers(0, 128, (1, 6)).astype(np.int32)
+    n = 8
+    with torch.no_grad():
+        hf_out = hf_model.generate(
+            torch.from_numpy(prompt.astype(np.int64)),
+            max_new_tokens=n, do_sample=False,
+            pad_token_id=0)[0, prompt.shape[1]:].numpy()
+    ours = np.asarray(generate(model, params, jnp.asarray(prompt), n))[0]
+    np.testing.assert_array_equal(ours, hf_out.astype(ours.dtype))
+
+
+def test_cached_decode_matches_full_forward_learned_pos(hf_pair, rng):
+    """The cache-correctness invariant under learned positions: cached
+    decode must equal re-running the whole sequence (position info enters
+    via embed, not rope — a decode path that dropped the positional add
+    would diverge here)."""
+    hf_model, model, params = hf_pair
+    prompt = jnp.asarray(rng.integers(0, 128, (2, 5)), jnp.int32)
+    toks = prompt
+    expected = []
+    for _ in range(5):
+        nxt = jnp.argmax(model.apply(params, toks)[:, -1], -1)
+        expected.append(nxt.astype(jnp.int32))
+        toks = jnp.concatenate([toks, nxt[:, None].astype(jnp.int32)], 1)
+    got = generate(model, params, prompt, 5)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(jnp.stack(expected, 1)))
+
+
+def test_converted_model_serves_and_quantizes(hf_pair, rng):
+    """The whole serving stack composes on a converted checkpoint:
+    continuous batching + int8 weights + int8 KV cache."""
+    from parameter_server_distributed_tpu.models.quant import (
+        quantize_params)
+    hf_model, model, params = hf_pair
+    prompt = list(rng.integers(0, 128, 6))
+    ref = list(np.asarray(generate(
+        model, params, jnp.asarray([prompt], jnp.int32), 5))[0])
+    srv = DecodeServer(model, quantize_params(params), slots=2, max_len=64,
+                       cache_dtype="int8")
+    rid = srv.submit(prompt, max_new_tokens=5)
+    results = srv.run_to_completion()
+    assert len(results[rid]) == 5
+    # int8 noise may flip late tokens on a random-init model; the first
+    # token comes from prefill logits and must agree
+    assert results[rid][0] == ref[0]
+
+
+def test_conversion_shape_contract(hf_pair):
+    hf_model, model, params = hf_pair
+    assert {k: tuple(v.shape) for k, v in params.items()} \
+        == model.param_shapes()
+
+
+def test_position_budget_guard(hf_pair, rng):
+    """Learned-position models reject decoding past max_seq (n_positions)
+    instead of silently reusing the last position embedding."""
+    hf_model, model, params = hf_pair
+    max_seq = model.config.max_seq
+    prompt = jnp.asarray(rng.integers(0, 128, (1, max_seq - 2)), jnp.int32)
+    with pytest.raises(ValueError, match="learned-position"):
+        generate(model, params, prompt, 5)
+    srv = DecodeServer(model, params, slots=1, max_len=2 * max_seq)
+    with pytest.raises(ValueError, match="learned-position"):
+        srv.submit(list(np.asarray(prompt)[0]), max_new_tokens=5)
+
+
+def test_unsupported_activation_rejected():
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=16, n_layer=1, n_head=2,
+        activation_function="gelu")  # exact erf GELU — not our math
+    hf_model = transformers.GPT2LMHeadModel(cfg)
+    with pytest.raises(ValueError, match="activation_function"):
+        from_hf_gpt2(hf_model)
+
+
+def test_n_inner_honored():
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=16, n_layer=1, n_head=2,
+        n_inner=40)
+    model, params = from_hf_gpt2(transformers.GPT2LMHeadModel(cfg))
+    assert model.config.d_ff == 40
+    assert params["layer0/mlp/w1"].shape == (16, 40)
+
+
+def test_config_knob_validation():
+    from parameter_server_distributed_tpu.models.transformer import (
+        TransformerConfig)
+    with pytest.raises(ValueError, match="pos_emb"):
+        TransformerConfig(pos_emb="learnt")
+    with pytest.raises(ValueError, match="norm"):
+        TransformerConfig(norm="layer_norm")
+
+
+def test_position_budget_guard_beam_and_host_spec(hf_pair, rng):
+    """Every decode entry point rejects past-max_seq generation on
+    learned-position models — beam search and the host-loop speculative
+    decoder included."""
+    from parameter_server_distributed_tpu.models.generation import (
+        beam_search, speculative_generate)
+    hf_model, model, params = hf_pair
+    max_seq = model.config.max_seq
+    prompt = jnp.asarray(rng.integers(0, 128, (1, max_seq - 2)), jnp.int32)
+    with pytest.raises(ValueError, match="learned-position"):
+        beam_search(model, params, prompt, 5, beam_width=2)
+    with pytest.raises(ValueError, match="learned-position"):
+        speculative_generate(model, params, model, params, prompt, 5)
+
+
+def test_attention_variant_configs_rejected():
+    for field in ("scale_attn_by_inverse_layer_idx",
+                  "reorder_and_upcast_attn"):
+        cfg = transformers.GPT2Config(
+            vocab_size=64, n_positions=32, n_embd=16, n_layer=1, n_head=2,
+            **{field: True})
+        with pytest.raises(ValueError, match=field):
+            from_hf_gpt2(transformers.GPT2LMHeadModel(cfg))
+
+
+def test_pipeline_rejects_nonnative_architecture(hf_pair):
+    from parameter_server_distributed_tpu.parallel.mesh import build_mesh
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+    from parameter_server_distributed_tpu.config import MeshConfig
+    _, model, _ = hf_pair
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    with pytest.raises(ValueError, match="native architecture"):
+        PipelinedTransformerLM(model, mesh)

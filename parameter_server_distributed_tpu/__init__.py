@@ -60,6 +60,7 @@ _API = {
     "quantize_params": ("models.quant", "quantize_params"),
     "DecodeServer": ("models.serving", "DecodeServer"),
     "from_hf_gpt2": ("models.hf", "from_hf_gpt2"),
+    "from_hf_llama": ("models.hf", "from_hf_llama"),
     "get_model_and_batches": ("models.registry", "get_model_and_batches"),
     "Transformer": ("models.transformer", "Transformer"),
     "TransformerConfig": ("models.transformer", "TransformerConfig"),

@@ -87,16 +87,12 @@ def load_hf(flags: dict):
     import transformers
 
     from ..models.hf import from_hf_gpt2
-    from ..models.registry import DTYPE_NAMES
+    from ..models.registry import resolve_dtype
 
     src = flags["hf-gpt2"]
     hf_model = transformers.GPT2LMHeadModel.from_pretrained(src)
     dtype_flag = flags.get("dtype", "")
-    if dtype_flag and dtype_flag not in DTYPE_NAMES:
-        raise ValueError(f"unknown dtype {dtype_flag!r}; "
-                         f"options {sorted(set(DTYPE_NAMES))}")
-    dtype = (getattr(jnp, DTYPE_NAMES[dtype_flag]) if dtype_flag
-             else jnp.float32)
+    dtype = resolve_dtype(dtype_flag) if dtype_flag else jnp.float32
     model, params = from_hf_gpt2(
         hf_model, dtype=dtype, scan_layers=("scan-layers" in flags))
     try:

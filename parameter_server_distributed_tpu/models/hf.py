@@ -36,6 +36,14 @@ import numpy as np
 from .transformer import Transformer, TransformerConfig
 
 
+def _state_dict_np(hf_model: Any) -> dict:
+    """torch state_dict -> numpy.  Upcasts through float32 first: torch
+    bf16 tensors (the dtype real checkpoints ship in, and the standard
+    torch_dtype=bfloat16 loading path) do not support .numpy()."""
+    return {name: t.detach().cpu().float().numpy()
+            for name, t in hf_model.state_dict().items()}
+
+
 def config_from_hf_gpt2(hf_config: Any, *,
                         dtype=jnp.float32,
                         scan_layers: bool = False) -> TransformerConfig:
@@ -83,8 +91,7 @@ def from_hf_gpt2(hf_model: Any, *, dtype=jnp.float32,
     cfg = config_from_hf_gpt2(hf_model.config, dtype=dtype,
                               scan_layers=scan_layers)
     model = Transformer(cfg)
-    sd = {name: np.asarray(t.detach().cpu().numpy())
-          for name, t in hf_model.state_dict().items()}
+    sd = _state_dict_np(hf_model)
     d = cfg.d_model
 
     def arr(x):
@@ -131,7 +138,12 @@ def from_hf_gpt2(hf_model: Any, *, dtype=jnp.float32,
             for suffix, value in layer.items():
                 params[f"layer{i}/{suffix}"] = arr(value)
 
-    # shape contract: exactly the parameters the config says exist
+    _check_shapes(model, params)
+    return model, params
+
+
+def _check_shapes(model: Transformer, params: dict) -> None:
+    """Shape contract: exactly the parameters the config says exist."""
     expected = model.param_shapes()
     got = {name: tuple(v.shape) for name, v in params.items()}
     if got != expected:
@@ -142,4 +154,87 @@ def from_hf_gpt2(hf_model: Any, *, dtype=jnp.float32,
         raise ValueError(
             f"converted store mismatch: missing={sorted(missing)} "
             f"extra={sorted(extra)} wrong_shape={sorted(wrong)}")
+
+
+def config_from_hf_llama(hf_config: Any, *, dtype=jnp.bfloat16,
+                         scan_layers: bool = False) -> TransformerConfig:
+    """Map a ``transformers.LlamaConfig`` onto TransformerConfig.  The
+    LLaMA family IS this framework's native architecture (RoPE in the
+    rotate-half convention, RMSNorm, GQA, no biases) plus the SwiGLU MLP
+    knob — so the mapping is direct.  Rejects rope_scaling and attention
+    bias, whose math this framework does not implement."""
+    if getattr(hf_config, "rope_scaling", None):
+        raise ValueError("unsupported rope_scaling: this framework "
+                         "implements plain RoPE only")
+    if getattr(hf_config, "attention_bias", False):
+        raise ValueError("unsupported attention_bias=True for the "
+                         "LLaMA-family conversion (bias-free attention)")
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act != "silu":
+        raise ValueError(f"unsupported hidden_act {act!r}: the SwiGLU "
+                         "path applies silu gating only")
+    return TransformerConfig(
+        vocab=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=(hf_config.num_key_value_heads
+                    if hf_config.num_key_value_heads
+                    != hf_config.num_attention_heads else 0),
+        n_layers=hf_config.num_hidden_layers,
+        d_ff=hf_config.intermediate_size,
+        max_seq=hf_config.max_position_embeddings,
+        dtype=dtype,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(hf_config.rms_norm_eps),
+        mlp_act="swiglu",
+        scan_layers=scan_layers,
+    )
+
+
+def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
+                  scan_layers: bool = False,
+                  ) -> tuple[Transformer, dict[str, jnp.ndarray]]:
+    """Convert a ``transformers.LlamaForCausalLM`` (torch) into
+    (Transformer, params).  torch ``nn.Linear`` stores [out, in], so every
+    projection transposes into this package's x @ W layout; gate_proj ->
+    mlp/w1, up_proj -> mlp/w3, down_proj -> mlp/w2.  RoPE conventions
+    already agree (both rotate-half), so no head permutation is needed."""
+    cfg = config_from_hf_llama(hf_model.config, dtype=dtype,
+                               scan_layers=scan_layers)
+    model = Transformer(cfg)
+    sd = _state_dict_np(hf_model)
+
+    def arr(x):
+        return jnp.asarray(x, dtype)
+
+    embed = sd["model.embed_tokens.weight"]
+    params: dict[str, jnp.ndarray] = {
+        "embed/tok": arr(embed),
+        "final_ln/scale": arr(sd["model.norm.weight"]),
+        "lm_head/w": arr(sd["lm_head.weight"].T
+                         if "lm_head.weight" in sd else embed.T),
+    }
+    per_layer: list[dict[str, np.ndarray]] = []
+    for i in range(cfg.n_layers):
+        hf = f"model.layers.{i}"
+        per_layer.append({
+            "ln1/scale": sd[f"{hf}.input_layernorm.weight"],
+            "attn/wq": sd[f"{hf}.self_attn.q_proj.weight"].T,
+            "attn/wk": sd[f"{hf}.self_attn.k_proj.weight"].T,
+            "attn/wv": sd[f"{hf}.self_attn.v_proj.weight"].T,
+            "attn/wo": sd[f"{hf}.self_attn.o_proj.weight"].T,
+            "ln2/scale": sd[f"{hf}.post_attention_layernorm.weight"],
+            "mlp/w1": sd[f"{hf}.mlp.gate_proj.weight"].T,
+            "mlp/w3": sd[f"{hf}.mlp.up_proj.weight"].T,
+            "mlp/w2": sd[f"{hf}.mlp.down_proj.weight"].T,
+        })
+    if scan_layers:
+        for suffix in per_layer[0]:
+            params[f"blocks/{suffix}"] = arr(
+                np.stack([layer[suffix] for layer in per_layer]))
+    else:
+        for i, layer in enumerate(per_layer):
+            for suffix, value in layer.items():
+                params[f"layer{i}/{suffix}"] = arr(value)
+    _check_shapes(model, params)
     return model, params

@@ -43,7 +43,7 @@ Array = Any
 # Matmul-weight key suffixes eligible for quantization, in both layouts
 # (unrolled "layer<i>/attn/wq" and scan_layers' stacked "blocks/attn/wq").
 _WEIGHT_SUFFIXES = ("/attn/wq", "/attn/wk", "/attn/wv", "/attn/wo",
-                    "/mlp/w1", "/mlp/w2")
+                    "/mlp/w1", "/mlp/w2", "/mlp/w3")
 
 
 @jax.tree_util.register_pytree_node_class

@@ -89,6 +89,15 @@ DTYPE_NAMES = {"f32": "float32", "float32": "float32",
                "bf16": "bfloat16", "bfloat16": "bfloat16"}
 
 
+def resolve_dtype(name: str):
+    """Flag string -> jnp dtype; single owner of the alias table and its
+    error (cli/generate_main's --hf-gpt2 path reuses it)."""
+    if name not in DTYPE_NAMES:
+        raise ValueError(f"unknown dtype {name!r}; "
+                         f"options {sorted(set(DTYPE_NAMES))}")
+    return getattr(jnp, DTYPE_NAMES[name])
+
+
 def _model_kwargs(model_fn: Callable, name: str, dtype: str,
                   remat: bool | None, scan: bool | None = None,
                   seq_len: int = 0) -> dict:
@@ -102,12 +111,10 @@ def _model_kwargs(model_fn: Callable, name: str, dtype: str,
     has_var_kw = any(p.kind is p.VAR_KEYWORD for p in sig.parameters.values())
     kwargs: dict = {}
     if dtype:
-        if dtype not in DTYPE_NAMES:
-            raise ValueError(f"unknown dtype {dtype!r}; "
-                             f"options {sorted(set(DTYPE_NAMES))}")
+        resolved = resolve_dtype(dtype)
         if not (has_var_kw or "dtype" in sig.parameters):
             raise ValueError(f"model {name!r} does not take a dtype")
-        kwargs["dtype"] = getattr(jnp, DTYPE_NAMES[dtype])
+        kwargs["dtype"] = resolved
     if remat is not None:
         if has_var_kw or "remat" in sig.parameters:
             kwargs["remat"] = remat

@@ -90,6 +90,9 @@ class TransformerConfig:
     norm: str = "rms"             # rms | layernorm (mean-centering + bias)
     bias: bool = False            # biases on attn/mlp projections
     norm_eps: float = 1e-6
+    # gelu: w2(gelu(w1 x)); swiglu: w2(silu(w1 x) * (w3 x)) — the
+    # LLaMA-family gated MLP (w1 = gate_proj, w3 = up_proj)
+    mlp_act: str = "gelu"
 
     def __post_init__(self):
         if self.pos_emb not in ("rope", "learned"):
@@ -98,6 +101,9 @@ class TransformerConfig:
         if self.norm not in ("rms", "layernorm"):
             raise ValueError(
                 f"norm must be 'rms' or 'layernorm', got {self.norm!r}")
+        if self.mlp_act not in ("gelu", "swiglu"):
+            raise ValueError(
+                f"mlp_act must be 'gelu' or 'swiglu', got {self.mlp_act!r}")
 
     @property
     def head_dim(self) -> int:
@@ -388,6 +394,8 @@ class Transformer:
             block.update({"attn/bq": (c.d_model,), "attn/bk": (kv_dim,),
                           "attn/bv": (kv_dim,), "attn/bo": (c.d_model,)})
         mlp = {"mlp/w1": (c.d_model, c.d_ff), "mlp/w2": (c.d_ff, c.d_model)}
+        if c.mlp_act == "swiglu":
+            mlp["mlp/w3"] = (c.d_model, c.d_ff)   # up_proj of the gate pair
         if c.bias:
             mlp.update({"mlp/b1": (c.d_ff,), "mlp/b2": (c.d_model,)})
         if c.scan_layers:
@@ -533,14 +541,19 @@ class Transformer:
 
     def mlp_residual(self, params: Mapping[str, Array], prefix: str,
                      h: Array) -> Array:
-        """h + w2(gelu(w1(ln2(h)))) (+ biases)."""
+        """h + w2(gelu(w1(ln2(h)))) (+ biases), or the SwiGLU gated form
+        h + w2(silu(w1 x) * (w3 x)) under ``mlp_act="swiglu"``."""
         c = self.config
         dot = partial(wdot, preferred_element_type=jnp.float32)
         x = self._norm(params, f"{prefix}/ln2", h)
         ff = dot(x, params[f"{prefix}/mlp/w1"])
         if c.bias:
             ff = ff + params[f"{prefix}/mlp/b1"].astype(jnp.float32)
-        ff = jax.nn.gelu(ff.astype(c.dtype))
+        if c.mlp_act == "swiglu":
+            up = dot(x, params[f"{prefix}/mlp/w3"]).astype(c.dtype)
+            ff = jax.nn.silu(ff.astype(c.dtype)) * up
+        else:
+            ff = jax.nn.gelu(ff.astype(c.dtype))
         out = dot(ff, params[f"{prefix}/mlp/w2"])
         if c.bias:
             out = out + params[f"{prefix}/mlp/b2"].astype(jnp.float32)
@@ -604,6 +617,14 @@ class Transformer:
                  collect_kv: bool) -> tuple[Array, list, Array]:
         c = self.config
         batch, seq = tokens.shape
+        if c.pos_emb == "learned" and seq > c.max_seq:
+            # static shapes: this fires at trace time, before any compute.
+            # Without it, embed's clip would silently reuse the last
+            # position row for every overflow position — wrong logits AND
+            # gradients (HF torch raises IndexError on the same input)
+            raise ValueError(
+                f"sequence length {seq} exceeds the learned-position "
+                f"table max_seq={c.max_seq}")
         positions = jnp.arange(seq, dtype=jnp.int32)[None, :].repeat(batch, 0)
         h = self.embed(params, tokens, positions)
         h = self._constrain(h, ("data", "fsdp"), "seq", None)
@@ -790,7 +811,8 @@ def transformer_rule(mesh: Mesh):
         # weights (blocks/*, [L, in, out]) keep their leading layer dim
         # unsharded — it is the scan axis, and sharding it would gather
         # one shard's slice every scan step
-        if name.endswith(("attn/wq", "attn/wk", "attn/wv", "mlp/w1", "lm_head/w")):
+        if name.endswith(("attn/wq", "attn/wk", "attn/wv", "mlp/w1",
+                          "mlp/w3", "lm_head/w")):
             taken = len(shape) - 1 if n_tp > 1 and shape[-1] % n_tp == 0 else None
             return PartitionSpec(*fsdp_on(len(shape) - 2, taken))
         if name.endswith(("attn/wo", "mlp/w2")):

@@ -295,7 +295,8 @@ class PipelinedTransformerLM:
             q, k, v = model.qkv(blk, key, h, positions)
             attn = self._stage_attention(q, k, v)
             h = model.attn_residual(blk, key, h, attn)
-            x = rms_norm(h, blk[f"{key}/ln2/scale"])
+            x = rms_norm(h, blk[f"{key}/ln2/scale"],
+                         model.config.norm_eps)
             if sharded_experts:
                 count = blk[f"{key}/moe/w1"].shape[0]
                 start = jax.lax.axis_index("expert") * count

@@ -181,6 +181,105 @@ def test_attention_variant_configs_rejected():
             from_hf_gpt2(transformers.GPT2LMHeadModel(cfg))
 
 
+@pytest.fixture(scope="module")
+def llama_pair():
+    torch.manual_seed(0)
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=56,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64)
+    hf_model = transformers.LlamaForCausalLM(cfg).eval()
+    from parameter_server_distributed_tpu.models.hf import from_hf_llama
+    model, params = from_hf_llama(hf_model, dtype=jnp.float32)
+    return hf_model, model, params
+
+
+def test_llama_logits_parity(llama_pair, rng):
+    """GQA + SwiGLU + RoPE (rotate-half) all line up with the torch
+    forward — the LLaMA family is the native architecture."""
+    hf_model, model, params = llama_pair
+    assert model.config.mlp_act == "swiglu"
+    assert model.config.kv_heads == 2
+    x = rng.integers(0, 128, (2, 12)).astype(np.int32)
+    want = _torch_logits(hf_model, x)
+    got = np.asarray(model.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_llama_greedy_generation_matches_hf(llama_pair, rng):
+    hf_model, model, params = llama_pair
+    prompt = rng.integers(0, 128, (1, 6)).astype(np.int32)
+    n = 8
+    with torch.no_grad():
+        hf_out = hf_model.generate(
+            torch.from_numpy(prompt.astype(np.int64)),
+            max_new_tokens=n, do_sample=False,
+            pad_token_id=0)[0, prompt.shape[1]:].numpy()
+    ours = np.asarray(generate(model, params, jnp.asarray(prompt), n))[0]
+    np.testing.assert_array_equal(ours, hf_out.astype(ours.dtype))
+
+
+def test_llama_scan_layers_and_quant_compose(llama_pair, rng):
+    from parameter_server_distributed_tpu.models.hf import from_hf_llama
+    from parameter_server_distributed_tpu.models.quant import (
+        QTensor, quantize_params)
+    hf_model, _, _ = llama_pair
+    model, params = from_hf_llama(hf_model, dtype=jnp.float32,
+                                  scan_layers=True)
+    qparams = quantize_params(params)
+    assert isinstance(qparams["blocks/mlp/w3"], QTensor)
+    prompt = jnp.asarray(rng.integers(0, 128, (1, 6)), jnp.int32)
+    out = generate(model, qparams, prompt, 4, cache_dtype="int8")
+    assert out.shape == (1, 4)
+
+
+def test_llama_unsupported_variants_rejected():
+    from parameter_server_distributed_tpu.models.hf import (
+        config_from_hf_llama)
+    base = dict(vocab_size=64, hidden_size=16, intermediate_size=32,
+                num_hidden_layers=1, num_attention_heads=2,
+                num_key_value_heads=2, max_position_embeddings=32)
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf_llama(transformers.LlamaConfig(
+            **base, rope_scaling={"rope_type": "linear", "factor": 2.0}))
+    with pytest.raises(ValueError, match="attention_bias"):
+        config_from_hf_llama(transformers.LlamaConfig(
+            **base, attention_bias=True))
+    with pytest.raises(ValueError, match="hidden_act"):
+        config_from_hf_llama(transformers.LlamaConfig(
+            **base, hidden_act="gelu"))
+
+
+def test_bf16_torch_checkpoint_converts():
+    """Real checkpoints ship bf16 and torch bf16 tensors lack .numpy();
+    the converter must upcast through float32."""
+    from parameter_server_distributed_tpu.models.hf import from_hf_llama
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32)
+    hf_model = transformers.LlamaForCausalLM(cfg).to(torch.bfloat16)
+    model, params = from_hf_llama(hf_model)
+    assert params["embed/tok"].dtype == jnp.bfloat16
+
+
+def test_training_forward_rejects_past_position_table(hf_pair, rng):
+    """apply()/loss() on a learned-position model must reject sequences
+    longer than the table instead of silently clipping (wrong gradients)."""
+    hf_model, model, params = hf_pair
+    seq = model.config.max_seq + 8
+    toks = jnp.asarray(rng.integers(0, 128, (1, seq)), jnp.int32)
+    with pytest.raises(ValueError, match="learned-position"):
+        model.apply(params, toks)
+
+
+def test_swiglu_knob_validation():
+    from parameter_server_distributed_tpu.models.transformer import (
+        TransformerConfig)
+    with pytest.raises(ValueError, match="mlp_act"):
+        TransformerConfig(mlp_act="geglu")
+
+
 def test_pipeline_rejects_nonnative_architecture(hf_pair):
     from parameter_server_distributed_tpu.parallel.mesh import build_mesh
     from parameter_server_distributed_tpu.parallel.pipeline import (

@@ -14,7 +14,8 @@ from ..data.synthetic import (synthetic_image_batches, synthetic_mnist,
                               synthetic_tokens)
 from .mlp import MLP, billion_param_mlp, mnist_mlp
 from .resnet import resnet18, resnet50
-from .transformer import lm_350m, moe_lm, small_lm, switch_lm, tiny_lm
+from .transformer import (llama_350m, lm_350m, moe_lm, small_lm, switch_lm,
+                          tiny_lm)
 
 
 # xy loaders: the registry seed varies the SAMPLING stream only — the
@@ -83,6 +84,9 @@ REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator], str]] = {
     # attention matmul (the flash kernel's preferred shape)
     "lm_350m_hd128": (partial(lm_350m, n_heads=8), _lm_350m_batches,
                       "tokens"),
+    # LLaMA-architecture flagship (SwiGLU + GQA): the shape from_hf_llama
+    # conversions have, so its bench rows transfer to real checkpoints
+    "llama_350m": (llama_350m, _lm_350m_batches, "tokens"),
 }
 
 DTYPE_NAMES = {"f32": "float32", "float32": "float32",

@@ -894,6 +894,22 @@ def lm_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
         loss_chunk=math.gcd(128, seq)))
 
 
+def llama_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
+               remat: bool = True, scan_layers: bool = False,
+               kv_heads: int = 4) -> Transformer:
+    """LLaMA-architecture sibling of :func:`lm_350m` (~350M params):
+    SwiGLU gated MLP (d_ff scaled to 8/3·d keeping the parameter count
+    near the GELU flagship), GQA kv_heads=4, RoPE/RMSNorm — exactly the
+    shape :func:`models.hf.from_hf_llama` produces, so benches on this
+    entry transfer to converted checkpoints."""
+    return Transformer(TransformerConfig(
+        vocab=vocab, d_model=1024, n_heads=16, n_layers=24,
+        d_ff=2816,  # ~8/3 * 1024, rounded to a 128-multiple for the MXU
+        n_kv_heads=kv_heads, mlp_act="swiglu",
+        max_seq=seq, dtype=dtype, remat=remat, scan_layers=scan_layers,
+        loss_chunk=math.gcd(128, seq)))
+
+
 def moe_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
            remat: bool = False, top_k: int = 1) -> Transformer:
     """Test-scale MoE LM: every 2nd layer is an expert-routed FFN

@@ -108,6 +108,24 @@ def make_optimizer(name: str = "sgd", learning_rate: float = 1.0,
         opt = optax.adamw(lr, weight_decay=weight_decay,
                           mask=lambda params: jax.tree.map(
                               lambda p: p.ndim >= 2, params))
+    elif name == "adafactor":
+        # the TPU-era memory-frugal optimizer (T5 lineage): factored
+        # second moments store O(rows + cols) per matrix instead of
+        # Adam's O(rows * cols) — at 1B params that is ~8 GB of slot
+        # HBM back.  multiply_by_parameter_scale off so the passed
+        # warmup/cosine schedule IS the effective step size; weight
+        # decay honored with the same matrices-only mask as adamw/lion
+        opt = optax.adafactor(
+            lr, multiply_by_parameter_scale=False,
+            weight_decay_rate=weight_decay if weight_decay else None,
+            weight_decay_mask=lambda params: jax.tree.map(
+                lambda p: p.ndim >= 2, params))
+    elif name == "lion":
+        # sign-momentum optimizer: one slot (momentum) instead of
+        # Adam's two — half the optimizer HBM at Adam-class quality
+        opt = optax.lion(lr, weight_decay=weight_decay,
+                         mask=lambda params: jax.tree.map(
+                             lambda p: p.ndim >= 2, params))
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     if clip_norm and clip_norm > 0:

@@ -1,0 +1,223 @@
+"""Continuous-batching serving process (`pst-serve`) — the operational
+face of models/serving.DecodeServer.
+
+    pst-serve --model=small_lm [--ckpt=... | --ckpt-dir=... |
+              --hf-gpt2=<checkout>] \\
+              [--slots=8] [--max-len=2048] [--temperature=0.8 --top-k=40] \\
+              [--quant=int8] [--kv-cache=int8] [--eos=ID]
+
+Line protocol (JSONL on stdin/stdout — composable behind any transport):
+
+    -> {"id": 1, "prompt": "hello"}             # or "tokens": [1,2,3]
+    -> {"id": 2, "tokens": [5,6], "max_new": 32}
+    <- {"id": 1, "token": 42}                   # streamed as decoded
+    <- {"id": 1, "done": true, "text": "..."}   # or "tokens": [...]
+    <- {"id": 3, "error": "..."}                # bad request
+
+Requests are admitted the moment a slot frees (continuous batching — one
+compiled ragged decode step serves every in-flight request); stdin close
+drains the in-flight work and exits.  Reference has no serving runtime at
+all (no model, no inference — reference src/worker.cpp:316-329); this
+completes the train -> checkpoint -> serve loop as a process main in the
+reference's CLI style (component #10, SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import sys
+import threading
+
+from ..config import parse_argv
+
+KNOWN_FLAGS = frozenset({
+    "model", "dtype", "scan-layers", "no-scan-layers", "seed", "ckpt",
+    "ckpt-dir", "avg-last", "hf-gpt2", "slots", "max-len", "temperature",
+    "top-k", "top-p", "eos", "quant", "kv-cache", "default-max-new",
+})
+
+
+def _reader(out_q: "queue.Queue[dict | None]") -> None:
+    """stdin -> request queue; None marks end of input.  Only dict
+    requests pass through — a valid-JSON scalar/array/null becomes a
+    per-line error instead of crashing the loop (and a `null` line can
+    never be confused with the EOF sentinel)."""
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as exc:
+            out_q.put({"_parse_error": str(exc)})
+            continue
+        if not isinstance(obj, dict):
+            out_q.put({"_parse_error":
+                       f"request must be a JSON object, got {line[:80]!r}"})
+            continue
+        out_q.put(obj)
+    out_q.put(None)
+
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s "
+                               "%(message)s")
+    _, flags = parse_argv(argv)
+    if "help" in flags:
+        print(__doc__)
+        return 0
+    unknown = set(flags) - KNOWN_FLAGS
+    if unknown:
+        raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
+                         f"--help lists the accepted flags")
+
+    from ..models.serving import DecodeServer
+    from .generate_main import load_hf, load_params, match_layout
+
+    hf_tok = None
+    if flags.get("hf-gpt2"):
+        model, params, hf_tok = load_hf(flags)
+        source = f"HF GPT-2 checkpoint {flags['hf-gpt2']}"
+    else:
+        from ..models.registry import get_model_and_batches
+        from ..models.transformer import Transformer
+        model, _ = get_model_and_batches(
+            flags.get("model", "small_lm"), 1, dtype=flags.get("dtype", ""),
+            scan=(False if "no-scan-layers" in flags
+                  else True if "scan-layers" in flags else None))
+        if not isinstance(model, Transformer):
+            raise ValueError(f"--model={flags.get('model')!r} is not an LM")
+        params, source = load_params(flags, model,
+                                     int(flags.get("seed", 0)))
+        params = match_layout(model, params)
+    if flags.get("quant", "") == "int8":
+        from ..models.quant import quantize_params
+        params = quantize_params(params)
+        source += " (int8 weights)"
+    print(f"serving: {source}", file=sys.stderr)
+
+    from ..data.text import ByteTokenizer
+    tokenizer = ByteTokenizer()
+    eos = int(flags["eos"]) if flags.get("eos") else (
+        hf_tok.eos_token_id if hf_tok is not None else None)
+    srv = DecodeServer(
+        model, params,
+        slots=int(flags.get("slots", "8")),
+        max_len=int(flags.get("max-len", "2048")),
+        temperature=float(flags.get("temperature", "0.0")),
+        top_k=int(flags.get("top-k", "0")),
+        top_p=float(flags.get("top-p", "0.0")),
+        eos_id=eos,
+        cache_dtype=("int8" if flags.get("kv-cache", "") == "int8"
+                     else "native"),
+        seed=int(flags.get("seed", 0)))
+    default_max_new = int(flags.get("default-max-new", "64"))
+
+    in_q: "queue.Queue[dict | None]" = queue.Queue()
+    threading.Thread(target=_reader, args=(in_q,), daemon=True).start()
+
+    pending: list[dict] = []          # parsed, awaiting a free slot
+    live: dict[int, dict] = {}        # request_id -> request (slot-held)
+    text_mode: dict[int, bool] = {}
+    eof = False
+
+    def finish(req: dict, tokens: list[int], is_text: bool) -> None:
+        done: dict = {"id": req.get("id"), "done": True}
+        if is_text:
+            trim = tokens
+            if eos is not None and eos in trim:
+                trim = trim[:trim.index(eos)]
+            done["text"] = (hf_tok.decode(trim) if hf_tok is not None
+                            else tokenizer.decode(trim))
+        else:
+            done["tokens"] = tokens
+        _emit(done)
+
+    def admit() -> None:
+        while pending and srv.has_free_slot:
+            req = pending.pop(0)
+            rid_key = req.get("id")
+            try:
+                if "tokens" in req:
+                    ids = [int(t) for t in req["tokens"]]
+                    is_text = False
+                elif "prompt" in req:
+                    if hf_tok is not None:
+                        ids = hf_tok.encode(req["prompt"])
+                    else:
+                        from ..data.text import require_vocab
+                        require_vocab(model.config.vocab, tokenizer)
+                        ids = (tokenizer.encode(req["prompt"])
+                               or [tokenizer.BOS])
+                    is_text = True
+                else:
+                    raise ValueError("request needs 'prompt' or 'tokens'")
+                rid = srv.submit(ids, int(req.get("max_new",
+                                                  default_max_new)))
+            except Exception as exc:  # noqa: BLE001 — server boundary: a
+                # malformed request (wrong types included) must become a
+                # per-request error, never kill the other in-flight work
+                _emit({"id": rid_key, "error": str(exc)})
+                continue
+            if rid in srv.finished():
+                # max_new=1 (or instant EOS): the prefill token already
+                # completed the request inside submit()
+                tokens = srv.result(rid)
+                for t in tokens:
+                    _emit({"id": rid_key, "token": int(t)})
+                finish(req, tokens, is_text)
+                continue
+            # the prefill forward already produced the first token —
+            # stream it now (step() only emits subsequent ones)
+            _emit({"id": rid_key, "token": int(srv.peek(rid)[0])})
+            live[rid] = req
+            text_mode[rid] = is_text
+
+    while True:
+        # drain whatever arrived on stdin without blocking the decode loop
+        try:
+            while True:
+                item = in_q.get_nowait()
+                if item is None:
+                    eof = True
+                    break
+                if "_parse_error" in item:
+                    _emit({"error": item["_parse_error"]})
+                else:
+                    pending.append(item)
+        except queue.Empty:
+            pass
+        admit()
+        if srv.idle:
+            if eof and not pending:
+                return 0
+            if not pending:
+                # nothing in flight: block for the next request (or EOF)
+                item = in_q.get()
+                if item is None:
+                    return 0
+                if "_parse_error" in item:
+                    _emit({"error": item["_parse_error"]})
+                else:
+                    pending.append(item)
+                continue
+        emitted = srv.step()
+        done_now = set(srv.finished())
+        for rid, token in emitted:
+            req = live[rid]
+            _emit({"id": req.get("id"), "token": int(token)})
+            if rid in done_now:
+                finish(req, srv.result(rid), text_mode[rid])
+                del live[rid], text_mode[rid]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

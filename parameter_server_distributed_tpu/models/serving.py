@@ -288,6 +288,19 @@ class DecodeServer:
         # the splice on reuse rewrites the cache rows that matter
 
     # ------------------------------------------------------------ result
+    def peek(self, request_id: int) -> list[int]:
+        """Tokens generated so far for an IN-FLIGHT request (the prefill
+        token appears here immediately after submit; finished requests
+        live in result())."""
+        for entry in self._slot:
+            if entry is not None and entry.request_id == request_id:
+                return list(entry.tokens)
+        raise KeyError(f"request {request_id} is not in flight")
+
+    def finished(self) -> list[int]:
+        """Request ids whose results are ready to collect."""
+        return list(self._results)
+
     def result(self, request_id: int) -> list[int]:
         """Generated tokens for a finished request (pops it)."""
         return self._results.pop(request_id)

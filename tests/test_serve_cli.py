@@ -1,0 +1,91 @@
+"""pst-serve (cli/serve_main.py): the JSONL line-protocol serving process.
+
+Driven as a real subprocess — the same way a user (or a transport shim)
+would.  Contract: every request's streamed tokens equal its final result,
+concurrent requests interleave, errors are per-request, and stdin EOF
+drains in-flight work then exits 0.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def run_serve(requests: list[dict], *extra_flags: str,
+              timeout: float = 400.0) -> tuple[list[dict], str]:
+    env = dict(os.environ)
+    env["PSDT_PLATFORM"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "parameter_server_distributed_tpu.cli.serve_main",
+         "--model=tiny_lm", "--slots=2", "--max-len=48", *extra_flags],
+        input="\n".join(json.dumps(r) for r in requests) + "\n",
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return ([json.loads(line) for line in proc.stdout.strip().splitlines()],
+            proc.stderr)
+
+
+def test_stream_equals_result_and_errors_are_per_request():
+    lines, _ = run_serve([
+        {"id": "a", "tokens": [1, 2, 3], "max_new": 4},
+        {"id": "b", "tokens": [7, 8], "max_new": 3},
+        {"id": "oneshot", "tokens": [4], "max_new": 1},
+        {"id": "bad"},
+    ])
+    streamed: dict = {}
+    for line in lines:
+        if "token" in line:
+            streamed.setdefault(line["id"], []).append(line["token"])
+    done = {line["id"]: line for line in lines if line.get("done")}
+    assert set(done) == {"a", "b", "oneshot"}
+    for rid, expect_n in (("a", 4), ("b", 3), ("oneshot", 1)):
+        assert streamed[rid] == done[rid]["tokens"]
+        assert len(done[rid]["tokens"]) == expect_n
+    errors = [line for line in lines if "error" in line]
+    assert len(errors) == 1 and errors[0]["id"] == "bad"
+
+
+def test_malformed_lines_never_kill_the_server():
+    """Type-confused requests, JSON scalars/arrays, and a bare `null`
+    (which must not alias the EOF sentinel) all become per-line errors
+    while the well-formed request completes."""
+    env = dict(os.environ)
+    env["PSDT_PLATFORM"] = "cpu"
+    raw = "\n".join([
+        json.dumps({"id": "t", "tokens": 5}),        # non-iterable tokens
+        "42", "[1,2]", "null", "{not json",
+        json.dumps({"id": "ok", "tokens": [1], "max_new": 2}),
+    ]) + "\n"
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "parameter_server_distributed_tpu.cli.serve_main",
+         "--model=tiny_lm", "--slots=2", "--max-len=48"],
+        input=raw, capture_output=True, text=True, timeout=400, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(line) for line in proc.stdout.strip().splitlines()]
+    errors = [line for line in lines if "error" in line]
+    assert len(errors) == 5, lines                   # one per bad line
+    done = {line["id"]: line for line in lines if line.get("done")}
+    assert len(done["ok"]["tokens"]) == 2            # null != EOF sentinel
+
+
+def test_text_mode_round_trip():
+    lines, _ = run_serve([{"id": 1, "prompt": "hi", "max_new": 3}])
+    done = [line for line in lines if line.get("done")]
+    assert len(done) == 1 and isinstance(done[0]["text"], str)
+
+
+def test_overflow_request_rejected_not_fatal():
+    """A request that cannot fit the cache errors; the server keeps
+    serving the others and still exits cleanly."""
+    lines, _ = run_serve([
+        {"id": "big", "tokens": list(range(40)), "max_new": 20},
+        {"id": "ok", "tokens": [1], "max_new": 2},
+    ])
+    assert any("error" in line and line["id"] == "big" for line in lines)
+    done = {line["id"]: line for line in lines if line.get("done")}
+    assert len(done["ok"]["tokens"]) == 2

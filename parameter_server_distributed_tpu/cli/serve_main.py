@@ -39,11 +39,13 @@ KNOWN_FLAGS = frozenset({
 })
 
 
-def _reader(out_q: "queue.Queue[dict | None]") -> None:
-    """stdin -> request queue; None marks end of input.  Only dict
-    requests pass through — a valid-JSON scalar/array/null becomes a
-    per-line error instead of crashing the loop (and a `null` line can
-    never be confused with the EOF sentinel)."""
+def _reader(out_q: "queue.Queue[tuple | None]") -> None:
+    """stdin -> request queue as TYPED items — ("req", dict) or
+    ("err", message) — with None marking end of input.  The out-of-band
+    tag means no request payload can alias the error channel (an in-band
+    magic key could), a valid-JSON scalar/array becomes a per-line error
+    instead of crashing the loop, and a `null` line can never be confused
+    with the EOF sentinel."""
     for line in sys.stdin:
         line = line.strip()
         if not line:
@@ -51,13 +53,13 @@ def _reader(out_q: "queue.Queue[dict | None]") -> None:
         try:
             obj = json.loads(line)
         except json.JSONDecodeError as exc:
-            out_q.put({"_parse_error": str(exc)})
+            out_q.put(("err", str(exc)))
             continue
         if not isinstance(obj, dict):
-            out_q.put({"_parse_error":
-                       f"request must be a JSON object, got {line[:80]!r}"})
+            out_q.put(("err",
+                       f"request must be a JSON object, got {line[:80]!r}"))
             continue
-        out_q.put(obj)
+        out_q.put(("req", obj))
     out_q.put(None)
 
 
@@ -189,10 +191,11 @@ def main(argv: list[str] | None = None) -> int:
                 if item is None:
                     eof = True
                     break
-                if "_parse_error" in item:
-                    _emit({"error": item["_parse_error"]})
+                tag, payload = item
+                if tag == "err":
+                    _emit({"error": payload})
                 else:
-                    pending.append(item)
+                    pending.append(payload)
         except queue.Empty:
             pass
         admit()
@@ -204,10 +207,11 @@ def main(argv: list[str] | None = None) -> int:
                 item = in_q.get()
                 if item is None:
                     return 0
-                if "_parse_error" in item:
-                    _emit({"error": item["_parse_error"]})
+                tag, payload = item
+                if tag == "err":
+                    _emit({"error": payload})
                 else:
-                    pending.append(item)
+                    pending.append(payload)
                 continue
         emitted = srv.step()
         done_now = set(srv.finished())

@@ -62,6 +62,30 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+def _shard_cache(cache, mesh):
+    """Place the slot cache on the mesh: batch over ``data``, kv heads
+    over ``tensor`` (where divisible), everything else replicated.  K/V
+    leaves are [L, B, M, H, D]; int8 scale leaves [L, B, M, H]; length
+    is scalar."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def place(leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim < 4:
+            spec = PartitionSpec()
+        else:
+            data = ("data" if mesh.shape.get("data", 1) > 1
+                    and leaf.shape[1] % mesh.shape["data"] == 0 else None)
+            tensor = ("tensor" if mesh.shape.get("tensor", 1) > 1
+                      and leaf.shape[3] % mesh.shape["tensor"] == 0
+                      else None)
+            spec = PartitionSpec(*([None, data, None, tensor]
+                                   + [None] * (ndim - 4)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, cache)
+
+
 def _prefill_runner(model: Transformer, bucket: int, cache_dtype: str):
     """Jitted per (model, prompt bucket): forward the padded prompt, return
     the last REAL position's logits and the prompt's K/V stack (quantized
@@ -168,14 +192,37 @@ class DecodeServer:
                  slots: int = 8, max_len: int = 2048, *,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, eos_id: int | None = None,
-                 cache_dtype: str = "native", seed: int = 0):
+                 cache_dtype: str = "native", seed: int = 0,
+                 mesh=None, param_rule=None):
+        """``mesh`` turns on multi-chip serving: params are placed under
+        ``param_rule`` (default: models.transformer.transformer_rule —
+        Megatron TP columns/rows + fsdp) and the slot cache is sharded
+        batch-over-``data`` / kv-heads-over-``tensor`` where divisible;
+        GSPMD then partitions the same three compiled programs, inserting
+        the attention/MLP collectives.  Token-exact vs the single-device
+        server (tested on the virtual mesh).  int8 weights with a mesh
+        are not supported yet (QTensor pytrees need per-leaf placement);
+        the int8 KV cache composes fine."""
         self.model = model
-        self.params = params
         self.slots = slots
         self.max_len = max_len
         self.eos_id = eos_id
         self.cache_dtype = cache_dtype
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.sharding import shard_store
+            from .quant import QTensor
+            from .transformer import transformer_rule
+            if any(isinstance(v, QTensor) for v in params.values()):
+                raise ValueError(
+                    "mesh serving with int8 weights is not supported yet; "
+                    "use dense params (the int8 KV cache still composes)")
+            params = shard_store(dict(params), mesh,
+                                 param_rule or transformer_rule(mesh))
+        self.params = params
         self._cache = init_cache(model, slots, max_len, cache_dtype)
+        if mesh is not None:
+            self._cache = _shard_cache(self._cache, mesh)
         self._lengths = np.zeros((slots,), np.int32)
         self._tokens = np.zeros((slots,), np.int32)
         self._slot: list[_Slot | None] = [None] * slots

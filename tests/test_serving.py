@@ -115,6 +115,48 @@ def test_int8_cache_server_matches_int8_generate(rng):
     assert results[rid] == ref
 
 
+@pytest.mark.parametrize("cache_dtype", ["native", "int8"])
+def test_mesh_tp_serving_token_exact(rng, cache_dtype):
+    """Multi-chip serving: the same requests through a data×tensor-sharded
+    DecodeServer (params under the Megatron rule, cache batch/heads
+    sharded — int8 scale leaves included, GSPMD-partitioned step) produce
+    exactly the single-device tokens — staggered admission included."""
+    from parameter_server_distributed_tpu.config import MeshConfig
+    from parameter_server_distributed_tpu.parallel.mesh import build_mesh
+
+    model = tiny(d_model=64, n_heads=4)   # head_dim 16; tp=2 splits heads
+    params = model.init_params(0)
+    pa = list(rng.integers(0, 96, 6))
+    pb = list(rng.integers(0, 96, 9))
+
+    def drive(srv):
+        ra = srv.submit(pa, max_new_tokens=6)
+        for _ in range(2):
+            srv.step()
+        rb = srv.submit(pb, max_new_tokens=4)
+        out = srv.run_to_completion()
+        return out[ra], out[rb]
+
+    base = drive(DecodeServer(model, params, slots=4, max_len=64,
+                              cache_dtype=cache_dtype))
+    mesh = build_mesh(MeshConfig(data=2, tensor=2, fsdp=2))
+    sharded = drive(DecodeServer(model, params, slots=4, max_len=64,
+                                 cache_dtype=cache_dtype, mesh=mesh))
+    assert sharded == base
+
+
+def test_mesh_serving_rejects_int8_weights(rng):
+    from parameter_server_distributed_tpu.config import MeshConfig
+    from parameter_server_distributed_tpu.models.quant import quantize_params
+    from parameter_server_distributed_tpu.parallel.mesh import build_mesh
+
+    model = tiny()
+    mesh = build_mesh(MeshConfig(data=8))
+    with pytest.raises(ValueError, match="int8 weights"):
+        DecodeServer(model, quantize_params(model.init_params(0)),
+                     slots=2, max_len=32, mesh=mesh)
+
+
 def test_prompt_validation(rng):
     model = tiny()
     srv = DecodeServer(model, model.init_params(0), slots=1, max_len=32)

@@ -62,6 +62,37 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+def _place_params(params, mesh, rule):
+    """Place a (possibly int8-quantized) store on the mesh.  Dense leaves
+    take the rule's spec directly; a QTensor's int8 matrix takes the spec
+    of its own shape and the per-output-channel scale inherits the same
+    mesh axes minus the contracted (-2) dim — so a tensor-column-sharded
+    weight keeps its scale tensor-sharded alongside it and the wdot
+    product needs no resharding."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .quant import QTensor
+
+    out = {}
+    for name, value in params.items():
+        if isinstance(value, QTensor):
+            spec = rule(name, tuple(value.q.shape))
+            # PartitionSpec may legally omit trailing replicated dims —
+            # pad to full rank so the -2/-1 slicing below always refers
+            # to the contracted/output axes
+            axes = list(spec) + [None] * (value.q.ndim - len(spec))
+            scale_axes = axes[:-2] + [axes[-1]]
+            out[name] = QTensor(
+                jax.device_put(value.q, NamedSharding(mesh, spec)),
+                jax.device_put(value.scale,
+                               NamedSharding(mesh,
+                                             PartitionSpec(*scale_axes))))
+        else:
+            spec = rule(name, tuple(value.shape))
+            out[name] = jax.device_put(value, NamedSharding(mesh, spec))
+    return out
+
+
 def _shard_cache(cache, mesh):
     """Place the slot cache on the mesh: batch over ``data``, kv heads
     over ``tensor`` (where divisible), everything else replicated.  K/V
@@ -200,9 +231,9 @@ class DecodeServer:
         batch-over-``data`` / kv-heads-over-``tensor`` where divisible;
         GSPMD then partitions the same three compiled programs, inserting
         the attention/MLP collectives.  Token-exact vs the single-device
-        server (tested on the virtual mesh).  int8 weights with a mesh
-        are not supported yet (QTensor pytrees need per-leaf placement);
-        the int8 KV cache composes fine."""
+        server for every weight/cache dtype combination (tested on the
+        virtual mesh; int8 QTensor weights place their per-channel scale
+        alongside the matrix's output sharding)."""
         self.model = model
         self.slots = slots
         self.max_len = max_len
@@ -210,15 +241,9 @@ class DecodeServer:
         self.cache_dtype = cache_dtype
         self.mesh = mesh
         if mesh is not None:
-            from ..parallel.sharding import shard_store
-            from .quant import QTensor
             from .transformer import transformer_rule
-            if any(isinstance(v, QTensor) for v in params.values()):
-                raise ValueError(
-                    "mesh serving with int8 weights is not supported yet; "
-                    "use dense params (the int8 KV cache still composes)")
-            params = shard_store(dict(params), mesh,
-                                 param_rule or transformer_rule(mesh))
+            params = _place_params(dict(params), mesh,
+                                   param_rule or transformer_rule(mesh))
         self.params = params
         self._cache = init_cache(model, slots, max_len, cache_dtype)
         if mesh is not None:

@@ -145,16 +145,33 @@ def test_mesh_tp_serving_token_exact(rng, cache_dtype):
     assert sharded == base
 
 
-def test_mesh_serving_rejects_int8_weights(rng):
+def test_mesh_serving_with_int8_weights_token_exact(rng):
+    """The full int8 serving stack over a mesh: QTensor weights placed
+    with their scales following the matrix's output sharding, int8 slot
+    cache — tokens equal the single-device int8 server's."""
     from parameter_server_distributed_tpu.config import MeshConfig
-    from parameter_server_distributed_tpu.models.quant import quantize_params
+    from parameter_server_distributed_tpu.models.quant import (
+        QTensor, quantize_params)
     from parameter_server_distributed_tpu.parallel.mesh import build_mesh
 
-    model = tiny()
-    mesh = build_mesh(MeshConfig(data=8))
-    with pytest.raises(ValueError, match="int8 weights"):
-        DecodeServer(model, quantize_params(model.init_params(0)),
-                     slots=2, max_len=32, mesh=mesh)
+    model = tiny(d_model=64, n_heads=4)
+    qparams = quantize_params(model.init_params(0))
+    prompt = list(rng.integers(0, 96, 7))
+
+    def drive(srv):
+        rid = srv.submit(prompt, max_new_tokens=5)
+        return srv.run_to_completion()[rid]
+
+    base = drive(DecodeServer(model, qparams, slots=2, max_len=64,
+                              cache_dtype="int8"))
+    mesh = build_mesh(MeshConfig(data=2, tensor=2, fsdp=2))
+    srv = DecodeServer(model, qparams, slots=2, max_len=64,
+                       cache_dtype="int8", mesh=mesh)
+    # scale rides the matrix's output sharding (tensor axis)
+    wq = srv.params["layer0/attn/wq"]
+    assert isinstance(wq, QTensor)
+    assert wq.scale.sharding.spec == wq.q.sharding.spec[-1:]
+    assert drive(srv) == base
 
 
 def test_prompt_validation(rng):

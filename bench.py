@@ -754,13 +754,38 @@ def bench_serve() -> dict:
         from parameter_server_distributed_tpu.models.quant import (
             quantize_params)
         params = quantize_params(params)
+    draft_name = os.environ.get("PSDT_BENCH_DRAFT", "")
+    spec_kwargs: dict = {}
+    spec_slack = 0
+    if draft_name:
+        # speculative continuous batching ("self" = perfect draft — the
+        # SAME store the target serves, quantization included, so
+        # acceptance is exactly 1.0: the mechanism's upper bound)
+        if draft_name == "self":
+            draft, dparams = model, params
+        else:
+            from parameter_server_distributed_tpu.models.transformer import (
+                Transformer)
+            draft, _ = get_model_and_batches(draft_name, 1)
+            if not isinstance(draft, Transformer):
+                raise SystemExit(
+                    f"PSDT_BENCH_DRAFT={draft_name!r} is not an LM")
+            dparams = draft.init_params(1)
+        draft_len = int(os.environ.get("PSDT_BENCH_DRAFT_LEN", "4"))
+        spec_kwargs = dict(draft=draft, draft_params=dparams,
+                           draft_len=draft_len)
+        spec_slack = draft_len + 1   # submit()'s verify-overshoot slack
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, model.config.vocab, 24).astype(np.int32)
                for _ in range(n_req)]
 
     def drive(prompt_list):
+        # plain serving keeps the historical 32+per_req cache (the ragged
+        # mask attends over max_len, so growing it would silently change
+        # tracked numbers); speculative mode adds exactly its slack
         srv = DecodeServer(model, params, slots=slots,
-                           max_len=32 + per_req, cache_dtype=cache_dtype)
+                           max_len=32 + per_req + spec_slack,
+                           cache_dtype=cache_dtype, **spec_kwargs)
         pending = list(prompt_list)
         while pending or not srv.idle:
             while pending and srv.has_free_slot:
@@ -774,8 +799,10 @@ def bench_serve() -> dict:
     dt = time.perf_counter() - t0
     tps = n_req * per_req / dt
     suffix = "_kv8" if cache_dtype == "int8" else ""
+    suffix += f"_spec_{draft_name}" if draft_name else ""
     log(f"bench_serve: model={name} slots={slots} requests={n_req} x "
-        f"{per_req} tokens: {tps:,.0f} sustained tokens/s")
+        f"{per_req} tokens{' draft=' + draft_name if draft_name else ''}: "
+        f"{tps:,.0f} sustained tokens/s")
     return {"metric": f"{name}_serve_tokens_per_sec{suffix}",
             "value": round(tps, 1), "unit": "tokens/sec",
             "vs_baseline": 1.0}

@@ -36,6 +36,7 @@ KNOWN_FLAGS = frozenset({
     "model", "dtype", "scan-layers", "no-scan-layers", "seed", "ckpt",
     "ckpt-dir", "avg-last", "hf-gpt2", "slots", "max-len", "temperature",
     "top-k", "top-p", "eos", "quant", "kv-cache", "default-max-new",
+    "draft-model", "draft-ckpt", "draft-seed", "draft-len",
 })
 
 
@@ -110,6 +111,25 @@ def main(argv: list[str] | None = None) -> int:
     tokenizer = ByteTokenizer()
     eos = int(flags["eos"]) if flags.get("eos") else (
         hf_tok.eos_token_id if hf_tok is not None else None)
+    spec_kwargs: dict = {}
+    if flags.get("draft-model"):
+        # speculative continuous batching (greedy-only; DecodeServer
+        # validates) — same flag family as pst-generate
+        from ..models.registry import get_model_and_batches as _get
+        from ..models.transformer import Transformer as _T
+        draft, _ = _get(flags["draft-model"], 1,
+                        dtype=flags.get("dtype", ""))
+        if not isinstance(draft, _T):
+            raise ValueError(f"--draft-model={flags['draft-model']!r} "
+                             "is not an LM")
+        dparams, dsource = load_params(
+            {"ckpt": flags.get("draft-ckpt", "")}, draft,
+            int(flags.get("draft-seed", int(flags.get("seed", 0)) + 1)))
+        dparams = match_layout(draft, dparams)
+        print(f"draft: {dsource}", file=sys.stderr)
+        spec_kwargs = dict(
+            draft=draft, draft_params=dparams,
+            draft_len=int(flags.get("draft-len", "4")))
     srv = DecodeServer(
         model, params,
         slots=int(flags.get("slots", "8")),
@@ -120,7 +140,7 @@ def main(argv: list[str] | None = None) -> int:
         eos_id=eos,
         cache_dtype=("int8" if flags.get("kv-cache", "") == "int8"
                      else "native"),
-        seed=int(flags.get("seed", 0)))
+        seed=int(flags.get("seed", 0)), **spec_kwargs)
     default_max_new = int(flags.get("default-max-new", "64"))
 
     in_q: "queue.Queue[dict | None]" = queue.Queue()

@@ -179,6 +179,64 @@ def _splice_runner(model: Transformer, bucket: int, cache_dtype: str):
     return _cached_runner(key, build)
 
 
+def _spec_round_runner(target: Transformer, draft: Transformer,
+                       draft_len: int, cache_dtype: str):
+    """Jitted per (target, draft, k): ONE greedy speculative round over
+    ALL slots — draft catch-up block + k-1 single proposals, one target
+    verify block, vectorized longest-prefix acceptance.  The same math as
+    generation._spec_batched_runner's loop body, but one round per call
+    so the host can admit/retire requests between rounds (continuous
+    batching).  Greedy is token-exact whatever each slot's accept rate.
+    Returns (commit [B, k+1], n_commit [B], cur_new [B], y_new [B],
+    t_cache, d_cache)."""
+    key = (_model_key(target), _model_key(draft), "serve_spec_round",
+           draft_len, cache_dtype)
+    k_draft = draft_len
+
+    def build():
+        @partial(jax.jit, donate_argnums=(4, 5))
+        def run(tparams, dparams, cur, y, t_cache, d_cache, lt, pc):
+            batch = cur.shape[0]
+            iota_k1 = jnp.arange(k_draft + 1, dtype=jnp.int32)
+            # draft: catch-up block [y, cur] (re-writing y's slot is a
+            # no-op; writing fresh is the full-accept catch-up), then
+            # k-1 single steps
+            dl, d_cache = decode_block(
+                draft, dparams, jnp.stack([y, cur], axis=1), d_cache,
+                lengths=pc - 1)
+            q_logits = dl[:, 1]
+            proposals = []
+            for i in range(k_draft):
+                tok = jnp.argmax(q_logits, axis=-1).astype(jnp.int32)
+                proposals.append(tok)
+                if i < k_draft - 1:
+                    dl, d_cache = decode_block(
+                        draft, dparams, tok[:, None], d_cache,
+                        lengths=pc + 1 + i)
+                    q_logits = dl[:, 0]
+            props = jnp.stack(proposals, axis=1)          # [B, k]
+            # target verifies [cur, p_1..p_k] in one ragged forward
+            block = jnp.concatenate([cur[:, None], props], axis=1)
+            vlogits, t_cache = decode_block(target, tparams, block,
+                                            t_cache, lengths=lt)
+            g = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, k+1]
+            match = (props == g[:, :k_draft]).astype(jnp.int32)
+            m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)     # [B]
+            corr = jnp.take_along_axis(g, m[:, None], 1)[:, 0]
+            ext = jnp.concatenate(
+                [props, jnp.zeros((batch, 1), jnp.int32)], axis=1)
+            commit = jnp.where(iota_k1[None, :] < m[:, None], ext,
+                               corr[:, None])             # [B, k+1]
+            prev = jnp.take_along_axis(
+                props, jnp.clip(m - 1, 0, k_draft - 1)[:, None], 1)[:, 0]
+            y_new = jnp.where(m == 0, cur, prev)
+            return commit, m + 1, corr, y_new, t_cache, d_cache
+
+        return run
+
+    return _cached_runner(key, build)
+
+
 def _step_runner(model: Transformer, slots: int, temperature: float,
                  top_k: int, top_p: float, cache_dtype: str):
     """Jitted once per (model, B, sampling config): one ragged decode step
@@ -224,7 +282,9 @@ class DecodeServer:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, eos_id: int | None = None,
                  cache_dtype: str = "native", seed: int = 0,
-                 mesh=None, param_rule=None):
+                 mesh=None, param_rule=None,
+                 draft: Transformer | None = None, draft_params=None,
+                 draft_len: int = 4):
         """``mesh`` turns on multi-chip serving: params are placed under
         ``param_rule`` (default: models.transformer.transformer_rule —
         Megatron TP columns/rows + fsdp) and the slot cache is sharded
@@ -233,15 +293,23 @@ class DecodeServer:
         the attention/MLP collectives.  Token-exact vs the single-device
         server for every weight/cache dtype combination (tested on the
         virtual mesh; int8 QTensor weights place their per-channel scale
-        alongside the matrix's output sharding)."""
+        alongside the matrix's output sharding).
+
+        ``draft`` turns on SPECULATIVE continuous batching: every step()
+        runs one greedy draft-propose/verify round over all slots, so
+        each request advances 1..draft_len+1 tokens per target forward at
+        its own acceptance rate while staying token-exact vs the plain
+        greedy server (tested — greedy speculative decoding is exact
+        whatever the draft).  Greedy only (temperature/top_k/top_p must
+        be off); the draft shares the cache dtype and mesh."""
         self.model = model
         self.slots = slots
         self.max_len = max_len
         self.eos_id = eos_id
         self.cache_dtype = cache_dtype
         self.mesh = mesh
+        from .transformer import transformer_rule
         if mesh is not None:
-            from .transformer import transformer_rule
             params = _place_params(dict(params), mesh,
                                    param_rule or transformer_rule(mesh))
         self.params = params
@@ -259,6 +327,33 @@ class DecodeServer:
         self._temperature = temperature
         self._top_k = top_k
         self._top_p = top_p
+        # --- speculative mode state
+        self.draft = draft
+        self.draft_len = draft_len
+        if draft is not None:
+            if temperature or top_k or top_p:
+                raise ValueError("speculative serving is greedy-only: "
+                                 "temperature/top_k/top_p must be off")
+            if draft.config.vocab != model.config.vocab:
+                raise ValueError(
+                    f"vocab mismatch: target {model.config.vocab} vs "
+                    f"draft {draft.config.vocab}")
+            if draft_len < 1:
+                raise ValueError("draft_len must be >= 1")
+            if draft_params is None:
+                raise ValueError("draft requires draft_params")
+            if mesh is not None:
+                draft_params = _place_params(
+                    dict(draft_params), mesh,
+                    param_rule or transformer_rule(mesh))
+            self.draft_params = draft_params
+            self._d_cache = init_cache(draft, slots, max_len, cache_dtype)
+            if mesh is not None:
+                self._d_cache = _shard_cache(self._d_cache, mesh)
+            self._d_lengths = np.zeros((slots,), np.int32)  # pc per slot
+            self._prev = np.zeros((slots,), np.int32)       # y per slot
+            self._spec_round = _spec_round_runner(model, draft, draft_len,
+                                                  cache_dtype)
 
     # ------------------------------------------------------------- admin
     @property
@@ -295,11 +390,16 @@ class DecodeServer:
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, "
                              f"got {max_new_tokens}")
-        if real_len + max_new_tokens > self.max_len:
+        # speculative mode: a verify round may write draft_len+1 entries
+        # past the committed frontier before the host truncates
+        slack = self.draft_len + 1 if self.draft is not None else 0
+        if real_len + max_new_tokens + slack > self.max_len:
             raise ValueError(
-                f"prompt {real_len} + max_new {max_new_tokens} exceeds "
-                f"cache max_len {self.max_len}")
-        check_position_budget(self.model, real_len, max_new_tokens)
+                f"prompt {real_len} + max_new {max_new_tokens} (+ "
+                f"speculative slack {slack}) exceeds cache max_len "
+                f"{self.max_len}")
+        check_position_budget(self.model, real_len,
+                              max_new_tokens + slack)
         bucket = min(_bucket(real_len), self.max_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :real_len] = prompt
@@ -311,6 +411,18 @@ class DecodeServer:
                                  self._top_k, self._top_p)[0])
         self._cache = _splice_runner(self.model, bucket, self.cache_dtype)(
             self._cache, row, jnp.asarray(slot, jnp.int32))
+        if self.draft is not None:
+            check_position_budget(self.draft, real_len,
+                                  max_new_tokens + slack)
+            _, d_row = _prefill_runner(self.draft, bucket,
+                                       self.cache_dtype)(
+                self.draft_params, jnp.asarray(padded),
+                jnp.asarray(real_len, jnp.int32))
+            self._d_cache = _splice_runner(self.draft, bucket,
+                                           self.cache_dtype)(
+                self._d_cache, d_row, jnp.asarray(slot, jnp.int32))
+            self._d_lengths[slot] = real_len
+            self._prev[slot] = int(prompt[-1])
         rid = self._next_id
         self._next_id += 1
         entry = _Slot(request_id=rid, tokens=[first],
@@ -324,11 +436,14 @@ class DecodeServer:
 
     # -------------------------------------------------------------- step
     def step(self) -> list[tuple[int, int]]:
-        """One device decode step over all slots.  Returns
-        [(request_id, token), ...] for every ACTIVE slot's newly decoded
-        token (already appended to its result)."""
+        """One device decode step over all slots (a speculative round when
+        a draft is configured — each slot may advance several tokens).
+        Returns [(request_id, token), ...] for every ACTIVE slot's newly
+        decoded token(s) (already appended to its result)."""
         if self.idle:
             return []
+        if self.draft is not None:
+            return self._spec_step()
         nxt, self._cache, self._rng = self._step(
             self.params, jnp.asarray(self._tokens), self._cache,
             jnp.asarray(self._lengths), self._rng)
@@ -345,6 +460,41 @@ class DecodeServer:
             self._tokens[i] = token
             if self._finishes(entry, token):
                 self._retire(i)
+        return emitted
+
+    def _spec_step(self) -> list[tuple[int, int]]:
+        """One speculative round: commit each slot's accepted prefix plus
+        the target's correction token.  Free/garbage lanes advance their
+        device-side frontiers like active ones (host state must mirror
+        what the device wrote; a reused slot's splice resets both)."""
+        commit, n_commit, cur_new, y_new, self._cache, self._d_cache = (
+            self._spec_round(
+                self.params, self.draft_params,
+                jnp.asarray(self._tokens), jnp.asarray(self._prev),
+                self._cache, self._d_cache,
+                jnp.asarray(self._lengths), jnp.asarray(self._d_lengths)))
+        commit = np.asarray(commit)
+        n_commit = np.asarray(n_commit)
+        cur_new = np.asarray(cur_new)
+        y_new = np.asarray(y_new)
+        emitted: list[tuple[int, int]] = []
+        for i, entry in enumerate(self._slot):
+            n = int(n_commit[i])
+            if entry is not None:
+                for t in commit[i, :n]:
+                    token = int(t)
+                    entry.tokens.append(token)
+                    emitted.append((entry.request_id, token))
+                    if self._finishes(entry, token):
+                        # tokens past EOS/limit in this round's commit are
+                        # discarded; the cache rows they wrote sit beyond
+                        # the retired frontier and splice-reset on reuse
+                        self._retire(i)
+                        break
+            self._lengths[i] += n
+            self._d_lengths[i] += n
+            self._tokens[i] = int(cur_new[i])
+            self._prev[i] = int(y_new[i])
         return emitted
 
     def _finishes(self, entry: _Slot, token: int) -> bool:

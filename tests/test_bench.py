@@ -79,6 +79,36 @@ def test_bench_preflight_spaced_retry_then_fallback():
 
 
 @pytest.mark.slow
+def test_bench_serve_contract():
+    """serve mode: continuous-batching sustained tokens/s with the int8
+    stack applied; the metric must carry the kv8 suffix."""
+    result = run_bench("serve", extra_env={
+        "PSDT_BENCH_MODEL": "tiny_lm",
+        "PSDT_BENCH_BATCH": "2",
+        "PSDT_BENCH_STEPS": "4",
+        "PSDT_BENCH_REQUESTS": "4",
+        "PSDT_BENCH_QUANT": "int8",
+        "PSDT_BENCH_KV_CACHE": "int8",
+    })
+    assert result["metric"] == "tiny_lm_serve_tokens_per_sec_kv8"
+    assert result["value"] > 0
+
+
+@pytest.mark.slow
+def test_bench_generate_int8_ab_contract():
+    """generate-mode int8 A/B: metric suffix reflects exactly which of
+    weights/cache are quantized, vs_baseline is the measured ratio."""
+    result = run_bench("generate", extra_env={
+        "PSDT_BENCH_MODEL": "tiny_lm",
+        "PSDT_BENCH_BATCH": "2",
+        "PSDT_BENCH_STEPS": "8",
+        "PSDT_BENCH_QUANT": "int8",
+    })
+    assert result["metric"] == "tiny_lm_decode_tokens_per_sec_int8"
+    assert result["value"] > 0 and result["vs_baseline"] > 0
+
+
+@pytest.mark.slow
 def test_bench_generate_trained_draft_contract():
     """PSDT_BENCH_TRAIN_STEPS fits target+draft on the source-code byte
     corpus before the speculative A/B; the JSON contract must hold and the

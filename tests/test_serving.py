@@ -174,6 +174,54 @@ def test_mesh_serving_with_int8_weights_token_exact(rng):
     assert drive(srv) == base
 
 
+@pytest.mark.parametrize("draft_kind", ["self", "random"])
+def test_speculative_serving_token_exact(rng, draft_kind):
+    """Speculative continuous batching is token-exact vs the plain greedy
+    server for ANY draft (greedy acceptance commits exactly the target's
+    greedy tokens): a perfect self-draft accepts everything, a random-init
+    draft accepts ~nothing — outputs must be identical either way,
+    staggered admission and slot reuse included."""
+    model = tiny()
+    params = model.init_params(0)
+    if draft_kind == "self":
+        draft, dparams = model, params
+    else:
+        draft = tiny(n_layers=1)
+        dparams = draft.init_params(7)
+    pa = list(rng.integers(0, 96, 6))
+    pb = list(rng.integers(0, 96, 11))
+    pc = list(rng.integers(0, 96, 4))
+
+    def drive(srv):
+        ra = srv.submit(pa, max_new_tokens=7)
+        srv.step()
+        rb = srv.submit(pb, max_new_tokens=5)
+        out = dict(srv.run_to_completion())
+        rc = srv.submit(pc, max_new_tokens=6)     # slot reuse
+        out.update(srv.run_to_completion())
+        return out[ra], out[rb], out[rc]
+
+    base = drive(DecodeServer(model, params, slots=2, max_len=64))
+    spec = drive(DecodeServer(model, params, slots=2, max_len=64,
+                              draft=draft, draft_params=dparams,
+                              draft_len=3))
+    assert spec == base
+
+
+def test_speculative_serving_validation(rng):
+    model = tiny()
+    params = model.init_params(0)
+    with pytest.raises(ValueError, match="greedy-only"):
+        DecodeServer(model, params, slots=2, max_len=64, temperature=0.5,
+                     draft=model, draft_params=params)
+    with pytest.raises(ValueError, match="draft_params"):
+        DecodeServer(model, params, slots=2, max_len=64, draft=model)
+    other = tiny(vocab=64)
+    with pytest.raises(ValueError, match="vocab"):
+        DecodeServer(model, params, slots=2, max_len=64, draft=other,
+                     draft_params=other.init_params(0))
+
+
 def test_prompt_validation(rng):
     model = tiny()
     srv = DecodeServer(model, model.init_params(0), slots=1, max_len=32)

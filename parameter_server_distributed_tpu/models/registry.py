@@ -99,6 +99,8 @@ def resolve_dtype(name: str):
     if name not in DTYPE_NAMES:
         raise ValueError(f"unknown dtype {name!r}; "
                          f"options {sorted(set(DTYPE_NAMES))}")
+    import jax.numpy as jnp
+
     return getattr(jnp, DTYPE_NAMES[name])
 
 
@@ -108,8 +110,6 @@ def _model_kwargs(model_fn: Callable, name: str, dtype: str,
     """The subset of {dtype, remat} this factory supports; error (rather
     than silently ignore) when the user asked for one it doesn't."""
     import inspect
-
-    import jax.numpy as jnp
 
     sig = inspect.signature(model_fn)
     has_var_kw = any(p.kind is p.VAR_KEYWORD for p in sig.parameters.values())

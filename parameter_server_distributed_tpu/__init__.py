@@ -61,6 +61,8 @@ _API = {
     "DecodeServer": ("models.serving", "DecodeServer"),
     "from_hf_gpt2": ("models.hf", "from_hf_gpt2"),
     "from_hf_llama": ("models.hf", "from_hf_llama"),
+    "to_hf_gpt2": ("models.hf", "to_hf_gpt2"),
+    "to_hf_llama": ("models.hf", "to_hf_llama"),
     "get_model_and_batches": ("models.registry", "get_model_and_batches"),
     "Transformer": ("models.transformer", "Transformer"),
     "TransformerConfig": ("models.transformer", "TransformerConfig"),

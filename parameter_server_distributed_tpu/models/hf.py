@@ -28,7 +28,7 @@ capability for the serving/fine-tuning story.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Mapping
 
 import jax.numpy as jnp
 import numpy as np
@@ -154,6 +154,129 @@ def _check_shapes(model: Transformer, params: dict) -> None:
         raise ValueError(
             f"converted store mismatch: missing={sorted(missing)} "
             f"extra={sorted(extra)} wrong_shape={sorted(wrong)}")
+
+
+def _require_dense(params: Mapping[str, Any]) -> None:
+    from .quant import QTensor
+    if any(isinstance(v, QTensor) for v in params.values()):
+        raise ValueError("cannot export an int8-quantized store; export "
+                         "the pre-quantization parameters")
+
+
+def _layer_view(params: Mapping[str, Any], i: int) -> dict:
+    """Per-layer suffix -> numpy array, for either layer layout."""
+    if any(name.startswith("blocks/") for name in params):
+        return {name[len("blocks/"):]: np.asarray(v[i], np.float32)
+                for name, v in params.items() if name.startswith("blocks/")}
+    prefix = f"layer{i}/"
+    return {name[len(prefix):]: np.asarray(v, np.float32)
+            for name, v in params.items() if name.startswith(prefix)}
+
+
+def to_hf_gpt2(model: Transformer, params: Mapping[str, Any]) -> dict:
+    """Export a (possibly fine-tuned here) GPT-2-architecture store back
+    to a ``transformers.GPT2LMHeadModel`` state_dict (torch tensors) —
+    the round-trip of :func:`from_hf_gpt2`, so checkpoints trained on
+    this framework load straight into the torch ecosystem.  Weight tying
+    is restored from ``embed/tok`` (GPT-2's lm_head IS wte)."""
+    import torch
+
+    _require_dense(params)
+    cfg = model.config
+    if (cfg.pos_emb, cfg.norm, cfg.bias) != ("learned", "layernorm", True):
+        raise ValueError("to_hf_gpt2 exports the GPT-2 architecture "
+                         "(pos_emb='learned', norm='layernorm', bias=True)")
+    t = lambda x: torch.from_numpy(  # noqa: E731 — copy: a zero-copy
+        # view of the live JAX buffer would be non-writable (torch UB on
+        # in-place writes / assign=True training)
+        np.array(x, np.float32, copy=True))
+    # HF GPT-2 ARCHITECTURALLY ties lm_head to wte.  This framework
+    # trains them as separate parameters, so a fine-tuned store whose
+    # head diverged from embed.T cannot be represented — reject instead
+    # of silently dropping the tuned head on export.
+    head = np.asarray(params["lm_head/w"], np.float32)
+    tok = np.asarray(params["embed/tok"], np.float32)
+    if not np.allclose(head, tok.T, rtol=1e-4, atol=1e-5):
+        raise ValueError(
+            "GPT-2 ties lm_head to wte but this store's lm_head/w has "
+            "diverged from embed/tok.T (fine-tuning here unties them); "
+            "re-tie (params['lm_head/w'] = params['embed/tok'].T) or "
+            "export a LLaMA-architecture model, whose head is untied")
+    sd = {
+        "transformer.wte.weight": t(params["embed/tok"]),
+        "transformer.wpe.weight": t(params["embed/pos"]),
+        "transformer.ln_f.weight": t(params["final_ln/scale"]),
+        "transformer.ln_f.bias": t(params["final_ln/bias"]),
+        "lm_head.weight": t(params["embed/tok"]),     # tied
+    }
+    for i in range(cfg.n_layers):
+        layer = _layer_view(params, i)
+        hf = f"transformer.h.{i}"
+        sd[f"{hf}.ln_1.weight"] = t(layer["ln1/scale"])
+        sd[f"{hf}.ln_1.bias"] = t(layer["ln1/bias"])
+        sd[f"{hf}.attn.c_attn.weight"] = t(np.concatenate(
+            [layer["attn/wq"], layer["attn/wk"], layer["attn/wv"]], axis=1))
+        sd[f"{hf}.attn.c_attn.bias"] = t(np.concatenate(
+            [layer["attn/bq"], layer["attn/bk"], layer["attn/bv"]]))
+        sd[f"{hf}.attn.c_proj.weight"] = t(layer["attn/wo"])
+        sd[f"{hf}.attn.c_proj.bias"] = t(layer["attn/bo"])
+        sd[f"{hf}.ln_2.weight"] = t(layer["ln2/scale"])
+        sd[f"{hf}.ln_2.bias"] = t(layer["ln2/bias"])
+        sd[f"{hf}.mlp.c_fc.weight"] = t(layer["mlp/w1"])
+        sd[f"{hf}.mlp.c_fc.bias"] = t(layer["mlp/b1"])
+        sd[f"{hf}.mlp.c_proj.weight"] = t(layer["mlp/w2"])
+        sd[f"{hf}.mlp.c_proj.bias"] = t(layer["mlp/b2"])
+    return sd
+
+
+def to_hf_llama(model: Transformer, params: Mapping[str, Any], *,
+                tie_word_embeddings: bool = False) -> dict:
+    """Export a LLaMA-architecture store to a
+    ``transformers.LlamaForCausalLM`` state_dict — the round-trip of
+    :func:`from_hf_llama` (torch Linear stores [out, in]: transpose
+    back).  Set ``tie_word_embeddings=True`` when the DESTINATION model
+    ties lm_head to embed_tokens (TinyLlama/Llama-3.2 style): the export
+    then verifies the tie still holds and omits the lm_head key —
+    emitting it would silently stomp the shared embedding on load (last
+    copy into the shared Parameter wins)."""
+    import torch
+
+    _require_dense(params)
+    cfg = model.config
+    if (cfg.pos_emb, cfg.norm, cfg.bias, cfg.mlp_act) != (
+            "rope", "rms", False, "swiglu"):
+        raise ValueError("to_hf_llama exports the LLaMA architecture "
+                         "(rope/rms/bias-free/swiglu)")
+    t = lambda x: torch.from_numpy(  # noqa: E731 — copy, as in to_hf_gpt2
+        np.array(x, np.float32, copy=True))
+    sd = {
+        "model.embed_tokens.weight": t(params["embed/tok"]),
+        "model.norm.weight": t(params["final_ln/scale"]),
+    }
+    if tie_word_embeddings:
+        head = np.asarray(params["lm_head/w"], np.float32)
+        tok = np.asarray(params["embed/tok"], np.float32)
+        if not np.allclose(head, tok.T, rtol=1e-4, atol=1e-5):
+            raise ValueError(
+                "tie_word_embeddings=True but this store's lm_head/w has "
+                "diverged from embed/tok.T (fine-tuning unties them); "
+                "re-tie or export for an untied destination model")
+    else:
+        sd["lm_head.weight"] = t(np.asarray(params["lm_head/w"],
+                                            np.float32).T)
+    for i in range(cfg.n_layers):
+        layer = _layer_view(params, i)
+        hf = f"model.layers.{i}"
+        sd[f"{hf}.input_layernorm.weight"] = t(layer["ln1/scale"])
+        sd[f"{hf}.self_attn.q_proj.weight"] = t(layer["attn/wq"].T)
+        sd[f"{hf}.self_attn.k_proj.weight"] = t(layer["attn/wk"].T)
+        sd[f"{hf}.self_attn.v_proj.weight"] = t(layer["attn/wv"].T)
+        sd[f"{hf}.self_attn.o_proj.weight"] = t(layer["attn/wo"].T)
+        sd[f"{hf}.post_attention_layernorm.weight"] = t(layer["ln2/scale"])
+        sd[f"{hf}.mlp.gate_proj.weight"] = t(layer["mlp/w1"].T)
+        sd[f"{hf}.mlp.up_proj.weight"] = t(layer["mlp/w3"].T)
+        sd[f"{hf}.mlp.down_proj.weight"] = t(layer["mlp/w2"].T)
+    return sd
 
 
 def config_from_hf_llama(hf_config: Any, *, dtype=jnp.bfloat16,

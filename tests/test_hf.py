@@ -313,6 +313,87 @@ def test_llama_350m_registry_entry():
     assert 300e6 < model.num_params() < 420e6
 
 
+def test_export_round_trip_llama_finetuned(llama_pair, rng):
+    """to_hf_llama: a store fine-tuned HERE loads back into the torch
+    model with exact logits parity — the interop round-trips both ways
+    (LLaMA's head is untied, so tuned weights export faithfully)."""
+    import copy
+
+    import jax
+
+    from parameter_server_distributed_tpu.models.hf import to_hf_llama
+    hf_model, model, params = llama_pair
+    # the fixture is module-scoped: load tuned weights into a COPY so
+    # the other parity tests keep their pristine torch model
+    hf_model = copy.deepcopy(hf_model)
+    toks = jnp.asarray(rng.integers(0, 128, (2, 12)), jnp.int32)
+    _, grads = jax.value_and_grad(model.loss)(params, toks)
+    tuned = jax.tree.map(lambda p, g: p - 0.01 * g, params, grads)
+    sd = to_hf_llama(model, tuned)
+    hf_model.load_state_dict(sd)
+    x = rng.integers(0, 128, (2, 9)).astype(np.int32)
+    want = np.asarray(model.apply(tuned, jnp.asarray(x)))
+    got = _torch_logits(hf_model, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_export_round_trip_gpt2(hf_pair, rng):
+    """to_hf_gpt2 round-trips an (untuned or head-retied) store exactly;
+    a fine-tuned store whose head diverged from wte.T is rejected loudly
+    — HF GPT-2's tying cannot represent it."""
+    import jax
+
+    from parameter_server_distributed_tpu.models.hf import to_hf_gpt2
+    hf_model, model, params = hf_pair
+    import copy
+    hf_model = copy.deepcopy(hf_model)   # module-scoped fixture
+    x = rng.integers(0, 128, (2, 9)).astype(np.int32)
+    want = np.asarray(model.apply(params, jnp.asarray(x)))
+    hf_model.load_state_dict(to_hf_gpt2(model, params))
+    got = _torch_logits(hf_model, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    # fine-tune -> head unties -> export must refuse
+    toks = jnp.asarray(rng.integers(0, 128, (2, 12)), jnp.int32)
+    _, grads = jax.value_and_grad(model.loss)(params, toks)
+    tuned = jax.tree.map(lambda p, g: p - 0.01 * g, params, grads)
+    with pytest.raises(ValueError, match="ties lm_head"):
+        to_hf_gpt2(model, tuned)
+    # re-tying restores exportability
+    tuned = dict(tuned)
+    tuned["lm_head/w"] = tuned["embed/tok"].T
+    hf_model.load_state_dict(to_hf_gpt2(model, tuned))
+
+
+def test_export_scan_layout_and_quant_guard(llama_pair):
+    import copy
+
+    from parameter_server_distributed_tpu.models.hf import (from_hf_llama,
+                                                            to_hf_llama)
+    from parameter_server_distributed_tpu.models.quant import quantize_params
+    hf_model, _, _ = llama_pair
+    hf_model = copy.deepcopy(hf_model)       # module-scoped fixture
+    model, params = from_hf_llama(hf_model, dtype=jnp.float32,
+                                  scan_layers=True)
+    sd = to_hf_llama(model, params)           # stacked layout exports too
+    hf_model.load_state_dict(sd)
+    with pytest.raises(ValueError, match="int8-quantized"):
+        to_hf_llama(model, quantize_params(params))
+
+
+def test_export_tied_destination_guard(llama_pair):
+    """tie_word_embeddings=True destinations: the export omits lm_head
+    (emitting it would stomp the shared embedding) and refuses a store
+    whose head diverged from the tie."""
+    from parameter_server_distributed_tpu.models.hf import to_hf_llama
+    _, model, params = llama_pair
+    tied = dict(params)
+    tied["lm_head/w"] = tied["embed/tok"].T
+    sd = to_hf_llama(model, tied, tie_word_embeddings=True)
+    assert "lm_head.weight" not in sd
+    with pytest.raises(ValueError, match="diverged"):
+        to_hf_llama(model, params, tie_word_embeddings=True)
+
+
 def test_pipeline_rejects_nonnative_architecture(hf_pair):
     from parameter_server_distributed_tpu.parallel.mesh import build_mesh
     from parameter_server_distributed_tpu.parallel.pipeline import (

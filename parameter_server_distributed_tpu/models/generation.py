@@ -654,6 +654,51 @@ def speculative_generate(target: Transformer, target_params,
     return tokens, stats
 
 
+def _greedy_accept(vlogits: Array, props: Array) -> tuple[Array, Array]:
+    """Longest-matching-prefix acceptance for a verify block
+    [cur, p_1..p_k]: (m accepted counts [B], corr next token [B]).
+    Shared by the one-shot batched decoder and the serving round runner
+    (models/serving.py) so the acceptance math exists once."""
+    k_draft = props.shape[1]
+    g = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)     # [B, k+1]
+    match = (props == g[:, :k_draft]).astype(jnp.int32)
+    m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)        # [B]
+    corr = jnp.take_along_axis(g, m[:, None], 1)[:, 0]
+    return m, corr
+
+
+def _sampling_accept(vlogits: Array, props: Array, q_rows: list,
+                     temperature: float, key_u: Array, key_resample: Array,
+                     key_bonus: Array) -> tuple[Array, Array]:
+    """Vectorized Leviathan/Chen rejection for a verify block
+    [cur, p_1..p_k]: accept each proposal with prob min(1, p/q), resample
+    the reject position from the residual (clamped gather; overridden by
+    the bonus draw when everything accepted).  Preserves the target's
+    temperature-adjusted distribution exactly.  Shared single definition
+    — see :func:`_greedy_accept`."""
+    k_draft = props.shape[1]
+    probs_t = jax.nn.softmax(vlogits / temperature, axis=-1)
+    probs_q = jnp.stack(q_rows, axis=1)                    # [B, k, V]
+    px = jnp.take_along_axis(
+        probs_t[:, :k_draft], props[..., None], 2)[..., 0]
+    qx = jnp.take_along_axis(probs_q, props[..., None], 2)[..., 0]
+    u = jax.random.uniform(key_u, px.shape)
+    acc = u < px / jnp.maximum(qx, 1e-20)
+    m = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), 1), 1)
+    gather_m = jnp.clip(m, 0, k_draft - 1)[:, None, None]
+    p_m = jnp.take_along_axis(probs_t[:, :k_draft], gather_m, 1)[:, 0]
+    q_m = jnp.take_along_axis(probs_q, gather_m, 1)[:, 0]
+    residual = jnp.maximum(p_m - q_m, 0.0)
+    total = jnp.sum(residual, -1, keepdims=True)
+    residual = jnp.where(total > 0, residual, p_m)
+    resampled = jax.random.categorical(
+        key_resample, jnp.log(residual + 1e-30), axis=-1)
+    bonus = jax.random.categorical(
+        key_bonus, jnp.log(probs_t[:, k_draft] + 1e-30), axis=-1)
+    corr = jnp.where(m == k_draft, bonus, resampled).astype(jnp.int32)
+    return m, corr
+
+
 def _spec_batched_runner(target: Transformer, draft: Transformer,
                          max_new_tokens: int, draft_len: int,
                          temperature: float, cache_dtype: str = "native"):
@@ -733,38 +778,14 @@ def _spec_batched_runner(target: Transformer, draft: Transformer,
                 vlogits, t_cache = decode_block(target, tparams, block,
                                                 t_cache, lengths=lt)
 
-                # --- vectorized acceptance
+                # --- vectorized acceptance (shared single definition)
                 if sampling:
-                    probs_t = jax.nn.softmax(vlogits / temperature, axis=-1)
-                    probs_q = jnp.stack(q_rows, axis=1)      # [B, k, V]
-                    px = jnp.take_along_axis(
-                        probs_t[:, :k_draft], props[..., None], 2)[..., 0]
-                    qx = jnp.take_along_axis(
-                        probs_q, props[..., None], 2)[..., 0]
-                    u = jax.random.uniform(keys[k_draft], px.shape)
-                    acc = u < px / jnp.maximum(qx, 1e-20)
-                    m = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), 1), 1)
-                    # resample from the residual at the reject position
-                    # (clamped gather; overridden by the bonus when m == k)
-                    gather_m = jnp.clip(m, 0, k_draft - 1)[:, None, None]
-                    p_m = jnp.take_along_axis(probs_t[:, :k_draft],
-                                              gather_m, 1)[:, 0]
-                    q_m = jnp.take_along_axis(probs_q, gather_m, 1)[:, 0]
-                    residual = jnp.maximum(p_m - q_m, 0.0)
-                    total = jnp.sum(residual, -1, keepdims=True)
-                    residual = jnp.where(total > 0, residual, p_m)
                     rng_key, kr, kb = jax.random.split(rng_key, 3)
-                    resampled = jax.random.categorical(
-                        kr, jnp.log(residual + 1e-30), axis=-1)
-                    bonus = jax.random.categorical(
-                        kb, jnp.log(probs_t[:, k_draft] + 1e-30), axis=-1)
-                    corr = jnp.where(m == k_draft, bonus,
-                                     resampled).astype(jnp.int32)
+                    m, corr = _sampling_accept(vlogits, props, q_rows,
+                                               temperature, keys[k_draft],
+                                               kr, kb)
                 else:
-                    g = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
-                    match = (props == g[:, :k_draft]).astype(jnp.int32)
-                    m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
-                    corr = jnp.take_along_axis(g, m[:, None], 1)[:, 0]
+                    m, corr = _greedy_accept(vlogits, props)
 
                 # --- commit p_1..p_m then the correction/bonus token
                 ext = jnp.concatenate([props, jnp.zeros((batch, 1),

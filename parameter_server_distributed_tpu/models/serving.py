@@ -39,9 +39,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generation import (KVCache, QuantKVCache, _cached_runner, _kv_quantize,
-                         _model_key, check_position_budget, decode_block,
-                         init_cache, sample_token)
+from .generation import (KVCache, QuantKVCache, _cached_runner,
+                         _greedy_accept, _kv_quantize, _model_key,
+                         _sampling_accept, check_position_budget,
+                         decode_block, init_cache, sample_token)
 from .transformer import Transformer
 
 Array = jax.Array
@@ -180,22 +181,26 @@ def _splice_runner(model: Transformer, bucket: int, cache_dtype: str):
 
 
 def _spec_round_runner(target: Transformer, draft: Transformer,
-                       draft_len: int, cache_dtype: str):
-    """Jitted per (target, draft, k): ONE greedy speculative round over
-    ALL slots — draft catch-up block + k-1 single proposals, one target
-    verify block, vectorized longest-prefix acceptance.  The same math as
+                       draft_len: int, cache_dtype: str,
+                       temperature: float = 0.0):
+    """Jitted per (target, draft, k, T): ONE speculative round over ALL
+    slots — draft catch-up block + k-1 single proposals, one target
+    verify block, vectorized acceptance.  The same math as
     generation._spec_batched_runner's loop body, but one round per call
     so the host can admit/retire requests between rounds (continuous
-    batching).  Greedy is token-exact whatever each slot's accept rate.
+    batching).  Greedy (T=0, longest matching prefix) is token-exact
+    whatever each slot's accept rate; T>0 applies the Leviathan/Chen
+    rejection rule, preserving the target's sampling distribution.
     Returns (commit [B, k+1], n_commit [B], cur_new [B], y_new [B],
-    t_cache, d_cache)."""
+    t_cache, d_cache, rng)."""
     key = (_model_key(target), _model_key(draft), "serve_spec_round",
-           draft_len, cache_dtype)
+           draft_len, cache_dtype, temperature)
     k_draft = draft_len
+    sampling = temperature > 0.0
 
     def build():
         @partial(jax.jit, donate_argnums=(4, 5))
-        def run(tparams, dparams, cur, y, t_cache, d_cache, lt, pc):
+        def run(tparams, dparams, cur, y, t_cache, d_cache, lt, pc, rng):
             batch = cur.shape[0]
             iota_k1 = jnp.arange(k_draft + 1, dtype=jnp.int32)
             # draft: catch-up block [y, cur] (re-writing y's slot is a
@@ -205,9 +210,18 @@ def _spec_round_runner(target: Transformer, draft: Transformer,
                 draft, dparams, jnp.stack([y, cur], axis=1), d_cache,
                 lengths=pc - 1)
             q_logits = dl[:, 1]
+            rng, *keys = jax.random.split(rng, k_draft + 4)
             proposals = []
+            q_rows = []
             for i in range(k_draft):
-                tok = jnp.argmax(q_logits, axis=-1).astype(jnp.int32)
+                if sampling:
+                    tok = jax.random.categorical(
+                        keys[i], q_logits / temperature,
+                        axis=-1).astype(jnp.int32)
+                    q_rows.append(jax.nn.softmax(q_logits / temperature,
+                                                 axis=-1))
+                else:
+                    tok = jnp.argmax(q_logits, axis=-1).astype(jnp.int32)
                 proposals.append(tok)
                 if i < k_draft - 1:
                     dl, d_cache = decode_block(
@@ -219,10 +233,12 @@ def _spec_round_runner(target: Transformer, draft: Transformer,
             block = jnp.concatenate([cur[:, None], props], axis=1)
             vlogits, t_cache = decode_block(target, tparams, block,
                                             t_cache, lengths=lt)
-            g = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, k+1]
-            match = (props == g[:, :k_draft]).astype(jnp.int32)
-            m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)     # [B]
-            corr = jnp.take_along_axis(g, m[:, None], 1)[:, 0]
+            if sampling:
+                m, corr = _sampling_accept(
+                    vlogits, props, q_rows, temperature, keys[k_draft],
+                    keys[k_draft + 1], keys[k_draft + 2])
+            else:
+                m, corr = _greedy_accept(vlogits, props)
             ext = jnp.concatenate(
                 [props, jnp.zeros((batch, 1), jnp.int32)], axis=1)
             commit = jnp.where(iota_k1[None, :] < m[:, None], ext,
@@ -230,7 +246,7 @@ def _spec_round_runner(target: Transformer, draft: Transformer,
             prev = jnp.take_along_axis(
                 props, jnp.clip(m - 1, 0, k_draft - 1)[:, None], 1)[:, 0]
             y_new = jnp.where(m == 0, cur, prev)
-            return commit, m + 1, corr, y_new, t_cache, d_cache
+            return commit, m + 1, corr, y_new, t_cache, d_cache, rng
 
         return run
 
@@ -296,12 +312,14 @@ class DecodeServer:
         alongside the matrix's output sharding).
 
         ``draft`` turns on SPECULATIVE continuous batching: every step()
-        runs one greedy draft-propose/verify round over all slots, so
-        each request advances 1..draft_len+1 tokens per target forward at
-        its own acceptance rate while staying token-exact vs the plain
-        greedy server (tested — greedy speculative decoding is exact
-        whatever the draft).  Greedy only (temperature/top_k/top_p must
-        be off); the draft shares the cache dtype and mesh."""
+        runs one draft-propose/verify round over all slots, so each
+        request advances 1..draft_len+1 tokens per target forward at its
+        own acceptance rate.  Greedy (default) stays token-exact vs the
+        plain greedy server whatever the draft (tested);
+        ``temperature>0`` applies the Leviathan/Chen rejection rule,
+        preserving the target's sampling distribution (tested
+        empirically); top_k/top_p do not combine.  The draft shares the
+        cache dtype and mesh."""
         self.model = model
         self.slots = slots
         self.max_len = max_len
@@ -331,9 +349,10 @@ class DecodeServer:
         self.draft = draft
         self.draft_len = draft_len
         if draft is not None:
-            if temperature or top_k or top_p:
-                raise ValueError("speculative serving is greedy-only: "
-                                 "temperature/top_k/top_p must be off")
+            if top_k or top_p:
+                raise ValueError("speculative serving supports greedy "
+                                 "(default) or plain --temperature "
+                                 "sampling; top_k/top_p must be off")
             if draft.config.vocab != model.config.vocab:
                 raise ValueError(
                     f"vocab mismatch: target {model.config.vocab} vs "
@@ -353,7 +372,8 @@ class DecodeServer:
             self._d_lengths = np.zeros((slots,), np.int32)  # pc per slot
             self._prev = np.zeros((slots,), np.int32)       # y per slot
             self._spec_round = _spec_round_runner(model, draft, draft_len,
-                                                  cache_dtype)
+                                                  cache_dtype,
+                                                  float(temperature))
 
     # ------------------------------------------------------------- admin
     @property
@@ -467,12 +487,13 @@ class DecodeServer:
         the target's correction token.  Free/garbage lanes advance their
         device-side frontiers like active ones (host state must mirror
         what the device wrote; a reused slot's splice resets both)."""
-        commit, n_commit, cur_new, y_new, self._cache, self._d_cache = (
-            self._spec_round(
-                self.params, self.draft_params,
-                jnp.asarray(self._tokens), jnp.asarray(self._prev),
-                self._cache, self._d_cache,
-                jnp.asarray(self._lengths), jnp.asarray(self._d_lengths)))
+        (commit, n_commit, cur_new, y_new, self._cache, self._d_cache,
+         self._rng) = self._spec_round(
+            self.params, self.draft_params,
+            jnp.asarray(self._tokens), jnp.asarray(self._prev),
+            self._cache, self._d_cache,
+            jnp.asarray(self._lengths), jnp.asarray(self._d_lengths),
+            self._rng)
         commit = np.asarray(commit)
         n_commit = np.asarray(n_commit)
         cur_new = np.asarray(cur_new)

@@ -79,6 +79,31 @@ def test_text_mode_round_trip():
     assert len(done) == 1 and isinstance(done[0]["text"], str)
 
 
+def test_hf_checkpoint_serves(tmp_path):
+    """pst-serve --hf-gpt2 drives a local transformers checkout end to
+    end (tokens-mode request — save_pretrained writes no tokenizer
+    files, so the text path is not exercised here)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    torch.manual_seed(0)
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=2)
+    transformers.GPT2LMHeadModel(cfg).save_pretrained(tmp_path)
+    env = dict(os.environ)
+    env["PSDT_PLATFORM"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "parameter_server_distributed_tpu.cli.serve_main",
+         f"--hf-gpt2={tmp_path}", "--slots=2", "--max-len=48"],
+        input=json.dumps({"id": 1, "tokens": [5, 6, 7],
+                          "max_new": 3}) + "\n",
+        capture_output=True, text=True, timeout=400, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(line) for line in proc.stdout.strip().splitlines()]
+    done = [line for line in lines if line.get("done")]
+    assert len(done) == 1 and len(done[0]["tokens"]) == 3
+
+
 def test_overflow_request_rejected_not_fatal():
     """A request that cannot fit the cache errors; the server keeps
     serving the others and still exits cleanly."""

@@ -208,11 +208,60 @@ def test_speculative_serving_token_exact(rng, draft_kind):
     assert spec == base
 
 
+def test_speculative_serving_sampling_preserves_distribution():
+    """T>0 speculative serving applies the rejection rule: empirical
+    first-token frequencies over many seeded servers match the target's
+    own softmax (tiny vocab, 4-sigma) — the serving analogue of the
+    one-shot decoder's distribution test."""
+    import jax
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    vocab = 8
+    target = Transformer(TransformerConfig(
+        vocab=vocab, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq=64, dtype=jnp.float32))
+    draft = Transformer(TransformerConfig(
+        vocab=vocab, d_model=8, n_heads=1, n_layers=1, d_ff=16,
+        max_seq=64, dtype=jnp.float32))
+    tparams, dparams = target.init_params(0), draft.init_params(3)
+    prompt = [2, 2, 2, 2]
+    counts0 = np.zeros(vocab)
+    counts1 = np.zeros(vocab)
+    reps, slots = 48, 8
+    for seed in range(reps):
+        srv = DecodeServer(target, tparams, slots=slots, max_len=32,
+                           temperature=1.0, seed=seed,
+                           draft=draft, draft_params=dparams, draft_len=2)
+        rids = [srv.submit(prompt, max_new_tokens=2)
+                for _ in range(slots)]
+        out = srv.run_to_completion()
+        for rid in rids:
+            counts0[out[rid][0]] += 1
+            counts1[out[rid][1]] += 1
+    n = reps * slots
+    from parameter_server_distributed_tpu.models.generation import prefill
+    logits, _ = prefill(target, tparams,
+                        jnp.asarray([prompt], jnp.int32), 8)
+    p0 = np.asarray(jax.nn.softmax(logits[0]))
+    # position 0 is submit()'s direct target sample; position 1 is the
+    # ROUND's accept/resample product — its ground truth marginalizes
+    # over the first token: p1[j] = sum_i p0[i] * P(j | prompt+[i])
+    p1 = np.zeros(vocab)
+    for i in range(vocab):
+        li, _ = prefill(target, tparams,
+                        jnp.asarray([prompt + [i]], jnp.int32), 8)
+        p1 += p0[i] * np.asarray(jax.nn.softmax(li[0]))
+    for freq, p in ((counts0 / n, p0), (counts1 / n, p1)):
+        sigma = np.sqrt(p * (1 - p) / n)
+        np.testing.assert_array_less(np.abs(freq - p), 4 * sigma + 0.01)
+
+
 def test_speculative_serving_validation(rng):
     model = tiny()
     params = model.init_params(0)
-    with pytest.raises(ValueError, match="greedy-only"):
-        DecodeServer(model, params, slots=2, max_len=64, temperature=0.5,
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        DecodeServer(model, params, slots=2, max_len=64, top_k=5,
                      draft=model, draft_params=params)
     with pytest.raises(ValueError, match="draft_params"):
         DecodeServer(model, params, slots=2, max_len=64, draft=model)

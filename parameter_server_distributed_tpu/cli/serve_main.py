@@ -113,8 +113,9 @@ def main(argv: list[str] | None = None) -> int:
         hf_tok.eos_token_id if hf_tok is not None else None)
     spec_kwargs: dict = {}
     if flags.get("draft-model"):
-        # speculative continuous batching (greedy-only; DecodeServer
-        # validates) — same flag family as pst-generate
+        # speculative continuous batching — greedy or plain --temperature
+        # sampling (DecodeServer rejects top-k/top-p); same flag family
+        # as pst-generate
         from ..models.registry import get_model_and_batches as _get
         from ..models.transformer import Transformer as _T
         draft, _ = _get(flags["draft-model"], 1,

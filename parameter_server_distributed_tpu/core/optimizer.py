@@ -229,11 +229,52 @@ class AdamW(Adam):
         return out
 
 
+class Lion(HostOptimizer):
+    """Sign-momentum optimizer (Chen et al. 2023): ONE slot instead of
+    Adam's two — half the PS optimizer-state memory, which on the
+    aggregation server is host RAM holding the full model.  Update:
+    p -= lr * (sign(b1*m + (1-b1)*g) + wd*p); m <- b2*m + (1-b2)*g.
+    Decoupled decay on matrices only, same mask as AdamW and the
+    device-side optax menu (parallel/train_step.make_optimizer)."""
+
+    def __init__(self, learning_rate: float = 1e-4, b1: float = 0.9,
+                 b2: float = 0.99, weight_decay: float = 1e-4):
+        super().__init__(learning_rate)
+        self.b1, self.b2 = b1, b2
+        self.weight_decay = weight_decay
+        self.m: TensorStore = {}
+
+    def apply(self, params: TensorStore,
+              grads: Mapping[str, np.ndarray]) -> TensorStore:
+        lr = np.float32(self.learning_rate)
+        b1, b2 = np.float32(self.b1), np.float32(self.b2)
+        out: TensorStore = {}
+        for name, p in params.items():
+            p = np.asarray(p, np.float32)
+            if name not in grads:
+                out[name] = p
+                continue
+            g = np.asarray(grads[name], np.float32)
+            m = self.m.get(name, np.zeros_like(g))
+            update = np.sign(b1 * m + (1 - b1) * g)
+            wd = self.weight_decay if p.ndim >= 2 else 0.0
+            self.m[name] = b2 * m + (1 - b2) * g
+            out[name] = p - lr * (update + np.float32(wd) * p)
+        return out
+
+    def state_dict(self) -> dict:
+        return {"m": {k: np.array(v) for k, v in self.m.items()}}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.m = {k: np.array(v, np.float32)
+                  for k, v in state.get("m", {}).items()}
+
+
 def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9,
                    weight_decay: float = 1e-4) -> HostOptimizer:
-    """PS optimizer by name.  Plain names (`sgd|momentum|adam|adamw`) are
-    the host-side numpy/native-C++ optimizers above; `device_*` selects
-    the accelerator-resident optax path and `pallas_*` the fused
+    """PS optimizer by name.  Plain names (`sgd|momentum|adam|adamw|lion`)
+    are the host-side numpy/native-C++ optimizers above; `device_*`
+    selects the accelerator-resident optax path and `pallas_*` the fused
     pallas-kernel path (async_sgd/device_optimizer.py)."""
     name = name.lower()
     if name == "sgd":
@@ -244,6 +285,8 @@ def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9,
         return Adam(learning_rate)
     if name == "adamw":
         return AdamW(learning_rate, weight_decay)
+    if name == "lion":
+        return Lion(learning_rate, weight_decay=weight_decay)
     if name.startswith("device_") or name.startswith("pallas_"):
         kind, _, rule = name.partition("_")
         from ..async_sgd.device_optimizer import DeviceOptimizer, PallasOptimizer

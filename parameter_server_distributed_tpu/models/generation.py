@@ -29,7 +29,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 from collections import OrderedDict
-from typing import Mapping
+from typing import Any, Mapping
 
 import jax
 import jax.numpy as jnp
@@ -654,6 +654,32 @@ def speculative_generate(target: Transformer, target_params,
     return tokens, stats
 
 
+def _draft_propose(draft: Transformer, dparams, q_logits: Array,
+                   d_cache, pc: Array, k_draft: int, temperature: float,
+                   keys: list) -> tuple[Array, list, Any]:
+    """The draft's k-proposal loop after its catch-up block: sample (or
+    argmax) each proposal, collecting the tempered proposal distributions
+    the rejection rule needs, stepping the draft cache k-1 times at the
+    per-row ragged positions.  Returns (props [B, k], q_rows, d_cache).
+    Shared single definition — see :func:`_greedy_accept`."""
+    sampling = temperature > 0.0
+    proposals = []
+    q_rows: list = []
+    for i in range(k_draft):
+        if sampling:
+            tok = jax.random.categorical(
+                keys[i], q_logits / temperature, axis=-1).astype(jnp.int32)
+            q_rows.append(jax.nn.softmax(q_logits / temperature, axis=-1))
+        else:
+            tok = jnp.argmax(q_logits, axis=-1).astype(jnp.int32)
+        proposals.append(tok)
+        if i < k_draft - 1:
+            dl, d_cache = decode_block(draft, dparams, tok[:, None],
+                                       d_cache, lengths=pc + 1 + i)
+            q_logits = dl[:, 0]
+    return jnp.stack(proposals, axis=1), q_rows, d_cache
+
+
 def _greedy_accept(vlogits: Array, props: Array) -> tuple[Array, Array]:
     """Longest-matching-prefix acceptance for a verify block
     [cur, p_1..p_k]: (m accepted counts [B], corr next token [B]).
@@ -756,22 +782,10 @@ def _spec_batched_runner(target: Transformer, draft: Transformer,
                 dl, d_cache = decode_block(
                     draft, dparams, jnp.stack([y, cur], axis=1), d_cache,
                     lengths=pc - 1)
-                q_logits = dl[:, 1]
-                proposals = []
-                q_rows = []
                 rng_key, *keys = jax.random.split(rng_key, k_draft + 3)
-                for i in range(k_draft):
-                    tok = sample(q_logits, keys[i])
-                    proposals.append(tok)
-                    if sampling:
-                        q_rows.append(jax.nn.softmax(
-                            q_logits / temperature, axis=-1))
-                    if i < k_draft - 1:
-                        dl, d_cache = decode_block(
-                            draft, dparams, tok[:, None], d_cache,
-                            lengths=pc + 1 + i)
-                        q_logits = dl[:, 0]
-                props = jnp.stack(proposals, axis=1)         # [B, k]
+                props, q_rows, d_cache = _draft_propose(
+                    draft, dparams, dl[:, 1], d_cache, pc, k_draft,
+                    temperature, keys)
 
                 # --- target verifies [cur, p_1..p_k] in one forward
                 block = jnp.concatenate([cur[:, None], props], axis=1)

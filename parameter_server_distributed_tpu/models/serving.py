@@ -40,9 +40,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .generation import (KVCache, QuantKVCache, _cached_runner,
-                         _greedy_accept, _kv_quantize, _model_key,
-                         _sampling_accept, check_position_budget,
-                         decode_block, init_cache, sample_token)
+                         _draft_propose, _greedy_accept, _kv_quantize,
+                         _model_key, _sampling_accept,
+                         check_position_budget, decode_block, init_cache,
+                         sample_token)
 from .transformer import Transformer
 
 Array = jax.Array
@@ -209,26 +210,10 @@ def _spec_round_runner(target: Transformer, draft: Transformer,
             dl, d_cache = decode_block(
                 draft, dparams, jnp.stack([y, cur], axis=1), d_cache,
                 lengths=pc - 1)
-            q_logits = dl[:, 1]
             rng, *keys = jax.random.split(rng, k_draft + 4)
-            proposals = []
-            q_rows = []
-            for i in range(k_draft):
-                if sampling:
-                    tok = jax.random.categorical(
-                        keys[i], q_logits / temperature,
-                        axis=-1).astype(jnp.int32)
-                    q_rows.append(jax.nn.softmax(q_logits / temperature,
-                                                 axis=-1))
-                else:
-                    tok = jnp.argmax(q_logits, axis=-1).astype(jnp.int32)
-                proposals.append(tok)
-                if i < k_draft - 1:
-                    dl, d_cache = decode_block(
-                        draft, dparams, tok[:, None], d_cache,
-                        lengths=pc + 1 + i)
-                    q_logits = dl[:, 0]
-            props = jnp.stack(proposals, axis=1)          # [B, k]
+            props, q_rows, d_cache = _draft_propose(
+                draft, dparams, dl[:, 1], d_cache, pc, k_draft,
+                temperature, keys)
             # target verifies [cur, p_1..p_k] in one ragged forward
             block = jnp.concatenate([cur[:, None], props], axis=1)
             vlogits, t_cache = decode_block(target, tparams, block,
@@ -339,6 +324,13 @@ class DecodeServer:
         self._slot: list[_Slot | None] = [None] * slots
         self._results: dict[int, list[int]] = {}
         self._next_id = 0
+        # observability counters (the stats property)
+        self._n_steps = 0
+        self._n_emitted = 0
+        self._n_requests = 0
+        self._n_retired = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._rng = jax.random.key(seed)
         self._step = _step_runner(model, slots, temperature, top_k, top_p,
                                   cache_dtype)
@@ -445,6 +437,7 @@ class DecodeServer:
             self._prev[slot] = int(prompt[-1])
         rid = self._next_id
         self._next_id += 1
+        self._n_requests += 1
         entry = _Slot(request_id=rid, tokens=[first],
                       max_new=max_new_tokens)
         self._slot[slot] = entry
@@ -480,6 +473,8 @@ class DecodeServer:
             self._tokens[i] = token
             if self._finishes(entry, token):
                 self._retire(i)
+        self._n_steps += 1
+        self._n_emitted += len(emitted)
         return emitted
 
     def _spec_step(self) -> list[tuple[int, int]]:
@@ -502,6 +497,9 @@ class DecodeServer:
         for i, entry in enumerate(self._slot):
             n = int(n_commit[i])
             if entry is not None:
+                # active-slot acceptance stats: n-1 of draft_len accepted
+                self._spec_proposed += self.draft_len
+                self._spec_accepted += n - 1
                 for t in commit[i, :n]:
                     token = int(t)
                     entry.tokens.append(token)
@@ -516,6 +514,8 @@ class DecodeServer:
             self._d_lengths[i] += n
             self._tokens[i] = int(cur_new[i])
             self._prev[i] = int(y_new[i])
+        self._n_steps += 1
+        self._n_emitted += len(emitted)
         return emitted
 
     def _finishes(self, entry: _Slot, token: int) -> bool:
@@ -527,8 +527,28 @@ class DecodeServer:
         entry.done = True
         self._results[entry.request_id] = entry.tokens
         self._slot[slot] = None
+        self._n_retired += 1
         # lengths/tokens stay — the lane decodes garbage until reused;
         # the splice on reuse rewrites the cache rows that matter
+
+    @property
+    def stats(self) -> dict:
+        """Serving counters since construction: device steps/rounds run,
+        tokens emitted to active requests, requests admitted/completed,
+        and (speculative mode) the measured draft acceptance rate."""
+        out = {
+            "steps": self._n_steps,
+            "tokens_emitted": self._n_emitted,
+            "requests_admitted": self._n_requests,
+            "requests_completed": self._n_retired,
+        }
+        if self.draft is not None:
+            out["draft_accept_rate"] = (
+                self._spec_accepted / self._spec_proposed
+                if self._spec_proposed else 0.0)
+            out["tokens_per_round"] = (
+                self._n_emitted / self._n_steps if self._n_steps else 0.0)
+        return out
 
     # ------------------------------------------------------------ result
     def peek(self, request_id: int) -> list[int]:

@@ -263,11 +263,43 @@ def test_sgd_momentum_adam_steps():
 
 
 def test_make_optimizer_factory():
+    from parameter_server_distributed_tpu.core.optimizer import Lion
+
     assert isinstance(make_optimizer("sgd", 1.0), SGD)
     assert isinstance(make_optimizer("momentum", 1.0), Momentum)
     assert isinstance(make_optimizer("adam", 1e-3), Adam)
+    assert isinstance(make_optimizer("lion", 1e-4), Lion)
     with pytest.raises(ValueError):
-        make_optimizer("lion", 1.0)
+        make_optimizer("adagrad", 1.0)
+
+
+def test_host_lion_sign_update_one_slot():
+    """Host Lion: sign-of-interpolated-momentum update (bounded step
+    magnitude lr*(1+wd*|p|)), ONE slot, matrices-only decay, state
+    round-trips through the checkpoint dict."""
+    import numpy as np
+
+    from parameter_server_distributed_tpu.core.optimizer import Lion
+
+    opt = Lion(0.1, weight_decay=0.0)
+    params = {"w": np.zeros((2, 2), np.float32),
+              "ln/scale": np.ones((2,), np.float32)}
+    grads = {"w": np.asarray([[3.0, -2.0], [0.5, -9.0]], np.float32),
+             "ln/scale": np.zeros((2,), np.float32)}
+    out = opt.apply(params, grads)
+    # first step: update = sign((1-b1) g) = sign(g); lr 0.1
+    np.testing.assert_allclose(
+        out["w"], [[-0.1, 0.1], [-0.1, 0.1]], atol=1e-7)
+    assert set(opt.state_dict()["m"]) == {"w", "ln/scale"}  # one slot
+    # decay masked off 1D params
+    opt_wd = Lion(0.1, weight_decay=0.5)
+    out2 = opt_wd.apply({"ln/scale": np.ones((2,), np.float32)},
+                        {"ln/scale": np.zeros((2,), np.float32)})
+    np.testing.assert_array_equal(out2["ln/scale"], 1.0)
+    # checkpoint round-trip
+    fresh = Lion(0.1)
+    fresh.load_state_dict(opt.state_dict())
+    np.testing.assert_array_equal(fresh.m["w"], opt.m["w"])
 
 
 def test_host_adamw_decays_matrices_only():

@@ -257,6 +257,34 @@ def test_speculative_serving_sampling_preserves_distribution():
         np.testing.assert_array_less(np.abs(freq - p), 4 * sigma + 0.01)
 
 
+def test_serving_stats(rng):
+    """Observability counters: request/step/token accounting on the plain
+    server; a perfect self-draft reports acceptance 1.0 and k+1
+    tokens/round while requests are saturating the slots."""
+    model = tiny()
+    params = model.init_params(0)
+    prompt = list(rng.integers(0, 96, 5))
+    srv = DecodeServer(model, params, slots=2, max_len=64)
+    rid = srv.submit(prompt, max_new_tokens=4)
+    srv.run_to_completion()
+    s = srv.stats
+    assert s["requests_admitted"] == s["requests_completed"] == 1
+    assert s["steps"] == 3          # first token came from prefill
+    assert s["tokens_emitted"] == 3
+    assert "draft_accept_rate" not in s
+
+    spec = DecodeServer(model, params, slots=1, max_len=64,
+                        draft=model, draft_params=params, draft_len=3)
+    spec.submit(prompt, max_new_tokens=8)
+    spec.run_to_completion()
+    s = spec.stats
+    assert s["draft_accept_rate"] == 1.0
+    assert s["requests_completed"] == 1
+    # 7 round-produced tokens (first came from prefill) over 2 rounds:
+    # full k+1=4 then truncated at max_new
+    assert s["tokens_per_round"] == 3.5
+
+
 def test_speculative_serving_validation(rng):
     model = tiny()
     params = model.init_params(0)

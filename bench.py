@@ -31,8 +31,10 @@ PSDT_BENCH_REQUESTS total requests),
 PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
 (default 2), PSDT_BENCH_CPU_TIMEOUT (s, default 420), PSDT_BENCH_REMAT /
 PSDT_BENCH_SCAN (unset = model default, 0/1 force off/on — remat and
-lax.scan-over-layers for transformer LMs), PSDT_BENCH_SEQ (sequence-
-length override for LMs: long-context runs), PSDT_BENCH_QUANT=int8 /
+lax.scan-over-layers for transformer LMs), PSDT_BENCH_REMAT_POLICY
+(full | dots — what remat may keep; dots saves projection/MLP matmul
+outputs and recomputes only the attention einsums), PSDT_BENCH_SEQ
+(sequence-length override for LMs: long-context runs), PSDT_BENCH_QUANT=int8 /
 PSDT_BENCH_KV_CACHE=int8 (generate mode: int8 serving A/B — weight-only
 and/or quantized KV cache), PSDT_BENCH_DRAFT /
 PSDT_BENCH_DRAFT_LEN (generate mode: speculative decoding with a
@@ -145,7 +147,8 @@ def bench_mfu() -> dict:
         model, batches = get_model_and_batches(
             model_name, batch, remat=tri("PSDT_BENCH_REMAT"),
             scan=tri("PSDT_BENCH_SCAN"),
-            seq_len=int(os.environ.get("PSDT_BENCH_SEQ", "0")))
+            seq_len=int(os.environ.get("PSDT_BENCH_SEQ", "0")),
+            remat_policy=os.environ.get("PSDT_BENCH_REMAT_POLICY", ""))
         batch_data = next(batches)
         n_params = model.num_params()
         # MFU only where the FLOP count is known and the model is big

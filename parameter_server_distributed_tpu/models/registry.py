@@ -106,7 +106,7 @@ def resolve_dtype(name: str):
 
 def _model_kwargs(model_fn: Callable, name: str, dtype: str,
                   remat: bool | None, scan: bool | None = None,
-                  seq_len: int = 0) -> dict:
+                  seq_len: int = 0, remat_policy: str = "") -> dict:
     """The subset of {dtype, remat} this factory supports; error (rather
     than silently ignore) when the user asked for one it doesn't."""
     import inspect
@@ -140,6 +140,11 @@ def _model_kwargs(model_fn: Callable, name: str, dtype: str,
             raise ValueError(f"model {name!r} has no sequence length "
                              f"(transformer LMs only)")
         kwargs["seq"] = seq_len
+    if remat_policy:
+        if not (has_var_kw or "remat_policy" in sig.parameters):
+            raise ValueError(f"model {name!r} does not support remat_policy "
+                             f"(flagship transformer LMs only)")
+        kwargs["remat_policy"] = remat_policy
     return kwargs
 
 
@@ -147,7 +152,7 @@ def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
                           data_path: str = "", dtype: str = "",
                           remat: bool | None = None,
                           scan: bool | None = None,
-                          seq_len: int = 0):
+                          seq_len: int = 0, remat_policy: str = ""):
     """Build (model, batch iterator).  ``data_path`` switches from the
     synthetic loaders to file-backed data (data/files.py), dispatched by
     the registry entry's declared file-data kind.  ``dtype`` ("f32"/"bf16"),
@@ -161,7 +166,7 @@ def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
         raise ValueError(f"unknown model {name!r}; have {sorted(REGISTRY)}")
     model_fn, data_fn, file_kind = REGISTRY[name]
     model = model_fn(**_model_kwargs(model_fn, name, dtype, remat, scan,
-                                     seq_len))
+                                     seq_len, remat_policy))
     if not data_path:
         if seq_len and file_kind == "tokens":
             # the factory's synthetic stream bakes in the default seq; at

@@ -57,6 +57,17 @@ class TransformerConfig:
     # residuals instead of O(L), the standard long-context memory/FLOPs
     # trade on TPU (HBM is the bottleneck, MXU FLOPs are cheap).
     remat: bool = False
+    # What remat may keep: "full" recomputes everything (O(1) residuals,
+    # ~33% extra FLOPs — the whole forward again); "dots" applies
+    # jax.checkpoint_policies.dots_with_no_batch_dims_saveable — the
+    # projection/MLP matmul outputs (dot_generals with no batch dims) are
+    # SAVED and only the attention score/value einsums (batch dims B, H —
+    # the O(S^2) memory hogs) plus elementwise ops are recomputed: the
+    # recompute overhead drops from a whole extra forward (~33% of the
+    # fwd+bwd budget) to the attention einsums alone (~5% at S=d=1024 —
+    # 4·S·d² vs the 72·S·d² + 12·S²·d fwd+bwd per-layer matmul total),
+    # for O(L·S·d) saved activations instead of O(1) residuals.
+    remat_policy: str = "full"
     # Chunked cross-entropy: compute the LM head + softmax in sequence
     # chunks of this many positions (0 = whole sequence at once).  Peak
     # logits memory drops from O(S * vocab) to O(chunk * vocab) — at
@@ -104,6 +115,9 @@ class TransformerConfig:
         if self.mlp_act not in ("gelu", "swiglu"):
             raise ValueError(
                 f"mlp_act must be 'gelu' or 'swiglu', got {self.mlp_act!r}")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(f"remat_policy must be 'full' or 'dots', "
+                             f"got {self.remat_policy!r}")
 
     @property
     def head_dim(self) -> int:
@@ -431,17 +445,30 @@ class Transformer:
         overcounts inactive experts.
 
         ``remat_credited=True`` counts the extra forward the hardware
-        actually executes under ``config.remat`` (+2*P and +4*L*d*S per
-        token): hardware-utilization accounting for rematerialized runs.
-        Callers reporting MFU from it must label the number as
-        remat-credited (bench.py does)."""
+        actually executes under ``config.remat``: hardware-utilization
+        accounting for rematerialized runs.  Under the "full" policy that
+        is the whole forward again (+2*P and +4*L*d*S per token); under
+        "dots" the projection/MLP matmuls are saved and only the attention
+        einsums re-run (+4*L*d*S only).  Callers reporting MFU from it
+        must label the number as remat-credited (bench.py does)."""
         c = self.config
         if c.moe_every > 0:
             return None
         seq = c.max_seq
-        params_mult, attn_mult = (8.0, 16.0) if remat_credited else (6.0, 12.0)
+        params_mult, attn_mult = 6.0, 12.0
+        if remat_credited:
+            attn_mult = 16.0
+            if c.remat_policy == "full":
+                params_mult = 8.0
         return (params_mult * self.num_params() * seq
                 + attn_mult * c.n_layers * c.d_model * seq * seq)
+
+    def _remat_policy(self):
+        """config.remat_policy -> jax.checkpoint policy (None = save
+        nothing, i.e. full recompute)."""
+        if self.config.remat_policy == "dots":
+            return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        return None
 
     def init_params(self, rng: jax.Array | int = 0) -> dict[str, Array]:
         c = self.config
@@ -665,7 +692,8 @@ class Transformer:
                 # scan's internals already rule out the CSE hazard that
                 # jax.checkpoint's default prevent_cse=True guards against;
                 # the default would insert optimization barriers per step
-                scan_body = jax.checkpoint(scan_body, prevent_cse=False)
+                scan_body = jax.checkpoint(scan_body, prevent_cse=False,
+                                           policy=self._remat_policy())
             h, ys = jax.lax.scan(scan_body, h, blocks)
             if collect_kv:
                 k_stack, v_stack = ys  # [L, B, S, H, D] each
@@ -680,7 +708,7 @@ class Transformer:
         if c.remat and not collect_kv:
             body = jax.checkpoint(
                 lambda lp, i, h: layer_body(lp, i, h)[:2],
-                static_argnums=(1,))
+                static_argnums=(1,), policy=self._remat_policy())
         else:
             body = None
         for i in range(c.n_layers):
@@ -872,7 +900,8 @@ def tiny_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
 
 def lm_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
             remat: bool = True, scan_layers: bool = False,
-            kv_heads: int = 0, n_heads: int = 16) -> Transformer:
+            kv_heads: int = 0, n_heads: int = 16,
+            remat_policy: str = "full") -> Transformer:
     """~370M-param GPT-style flagship for the LM MFU benchmark: 24 layers,
     d_model 1024, seq 1024, bf16 weights/activations with f32 MXU
     accumulation, per-layer remat by default (activation memory, not HBM
@@ -888,7 +917,7 @@ def lm_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
     # measurement showed it) — same parameter count either way
     return Transformer(TransformerConfig(
         vocab=vocab, d_model=1024, n_heads=n_heads, n_layers=24, d_ff=4096,
-        n_kv_heads=kv_heads,
+        n_kv_heads=kv_heads, remat_policy=remat_policy,
         max_seq=seq, dtype=dtype, remat=remat, scan_layers=scan_layers,
         # largest chunk <= 128 dividing seq, so every seq stays valid
         loss_chunk=math.gcd(128, seq)))
@@ -896,7 +925,8 @@ def lm_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
 
 def llama_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
                remat: bool = True, scan_layers: bool = False,
-               kv_heads: int = 4) -> Transformer:
+               kv_heads: int = 4,
+               remat_policy: str = "full") -> Transformer:
     """LLaMA-architecture sibling of :func:`lm_350m` (~350M params):
     SwiGLU gated MLP (d_ff scaled to 8/3·d keeping the parameter count
     near the GELU flagship), GQA kv_heads=4, RoPE/RMSNorm — exactly the
@@ -905,7 +935,7 @@ def llama_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
     return Transformer(TransformerConfig(
         vocab=vocab, d_model=1024, n_heads=16, n_layers=24,
         d_ff=2816,  # ~8/3 * 1024, rounded to a 128-multiple for the MXU
-        n_kv_heads=kv_heads, mlp_act="swiglu",
+        n_kv_heads=kv_heads, mlp_act="swiglu", remat_policy=remat_policy,
         max_seq=seq, dtype=dtype, remat=remat, scan_layers=scan_layers,
         loss_chunk=math.gcd(128, seq)))
 

@@ -221,6 +221,44 @@ def test_remat_loss_and_gradients_match_non_remat(rng):
                                    atol=1e-7, err_msg=name)
 
 
+def test_remat_dots_policy_matches_full(rng):
+    """remat_policy='dots' (save projection/MLP dot outputs, recompute
+    only the attention einsums) must be numerically identical to the
+    full-recompute policy — the policy changes WHAT the backward pass
+    recomputes, never the math.  Covers unrolled and scan layouts, and
+    checks the credited-FLOPs accounting only credits the attention
+    recompute under 'dots'."""
+    import dataclasses
+
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    tokens = rng.integers(0, 64, (4, 16)).astype(np.int32)
+    for scan in (False, True):
+        config = TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                   n_layers=2, d_ff=64, max_seq=16,
+                                   dtype=jnp.float32, remat=True,
+                                   scan_layers=scan)
+        full = Transformer(config)
+        dots = Transformer(dataclasses.replace(config, remat_policy="dots"))
+        params = full.init_params(0)
+        g_a = jax.jit(jax.grad(full.loss))(params, tokens)
+        g_b = jax.jit(jax.grad(dots.loss))(params, tokens)
+        for name in g_a:
+            np.testing.assert_allclose(np.asarray(g_b[name]),
+                                       np.asarray(g_a[name]), rtol=1e-5,
+                                       atol=1e-7, err_msg=f"scan={scan} {name}")
+        # credited accounting: full credits the whole recompute forward
+        # (8P + 16 attn), dots only the attention einsums (6P + 16 attn)
+        base = full.flops_per_sample()
+        assert dots.flops_per_sample() == base
+        assert (full.flops_per_sample(remat_credited=True)
+                > dots.flops_per_sample(remat_credited=True) > base)
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        TransformerConfig(remat_policy="bogus")
+
+
 def test_remat_generation_still_exact(rng):
     """collect_kv (generation prefill) bypasses remat; decoding from a
     remat-configured model matches the plain model token for token."""

@@ -10,9 +10,16 @@ Line protocol (JSONL on stdin/stdout — composable behind any transport):
 
     -> {"id": 1, "prompt": "hello"}             # or "tokens": [1,2,3]
     -> {"id": 2, "tokens": [5,6], "max_new": 32}
+    -> {"id": 4, "prompt": "hi", "temperature": 0.7, "stop": [13]}
     <- {"id": 1, "token": 42}                   # streamed as decoded
     <- {"id": 1, "done": true, "text": "..."}   # or "tokens": [...]
     <- {"id": 3, "error": "..."}                # bad request
+
+Per-request "temperature" overrides the server default for that request
+only (temperatures are a traced per-slot input — mixed batches share one
+compiled step; rejected in speculative mode, where the accept rule is
+compiled for the server temperature).  "stop": [ids...] finishes that
+request at any of the listed tokens, alongside the global --eos.
 
 Requests are admitted the moment a slot frees (continuous batching — one
 compiled ragged decode step serves every in-flight request); stdin close
@@ -183,8 +190,15 @@ def main(argv: list[str] | None = None) -> int:
                     is_text = True
                 else:
                     raise ValueError("request needs 'prompt' or 'tokens'")
-                rid = srv.submit(ids, int(req.get("max_new",
-                                                  default_max_new)))
+                temp = req.get("temperature")
+                stop_field = req.get("stop", [])
+                if not isinstance(stop_field, list):
+                    # a JSON string would silently iterate per character
+                    raise ValueError("'stop' must be an array of token ids")
+                rid = srv.submit(
+                    ids, int(req.get("max_new", default_max_new)),
+                    temperature=None if temp is None else float(temp),
+                    stop=[int(t) for t in stop_field])
             except Exception as exc:  # noqa: BLE001 — server boundary: a
                 # malformed request (wrong types included) must become a
                 # per-request error, never kill the other in-flight work

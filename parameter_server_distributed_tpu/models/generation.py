@@ -273,14 +273,9 @@ def decode_step(model: Transformer, params: Mapping[str, Array],
     return logits[:, 0], cache
 
 
-def sample_token(logits: Array, rng: Array, temperature: float = 0.0,
-                 top_k: int = 0, top_p: float = 0.0) -> Array:
-    """Greedy when temperature == 0; otherwise temperature softmax
-    sampling, optionally truncated to the top_k logits and/or the nucleus
-    (smallest set of tokens with cumulative probability >= top_p)."""
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
+def _truncate_logits(logits: Array, top_k: int, top_p: float) -> Array:
+    """Top-k and/or nucleus truncation on temperature-scaled logits
+    (shared by the scalar and per-row samplers)."""
     top_k = min(top_k, logits.shape[-1])  # top_k > vocab = no truncation
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
@@ -296,7 +291,32 @@ def sample_token(logits: Array, rng: Array, temperature: float = 0.0,
         kth = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
                       keepdims=True)
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return logits
+
+
+def sample_token(logits: Array, rng: Array, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0) -> Array:
+    """Greedy when temperature == 0; otherwise temperature softmax
+    sampling, optionally truncated to the top_k logits and/or the nucleus
+    (smallest set of tokens with cumulative probability >= top_p)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _truncate_logits(logits / temperature, top_k, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_token_rowwise(logits: Array, rng: Array, temps: Array,
+                         top_k: int = 0, top_p: float = 0.0) -> Array:
+    """Per-row temperature sampling in ONE traced program: row i is
+    greedy when ``temps[i] == 0``, temperature-sampled otherwise
+    (top_k/top_p truncation stays static — shared by all rows).  Lets a
+    continuous-batching server honor per-request temperatures without a
+    recompile per distinct value.  logits: [B, V]; temps: [B]."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    scaled = _truncate_logits(scaled, top_k, top_p)
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
 
 
 # Compiled runner cache: one jitted wrapper per (model, generation config),

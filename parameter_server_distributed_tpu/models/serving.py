@@ -43,7 +43,7 @@ from .generation import (KVCache, QuantKVCache, _cached_runner,
                          _draft_propose, _greedy_accept, _kv_quantize,
                          _model_key, _sampling_accept,
                          check_position_budget, decode_block, init_cache,
-                         sample_token)
+                         sample_token, sample_token_rowwise)
 from .transformer import Transformer
 
 Array = jax.Array
@@ -55,6 +55,8 @@ class _Slot:
     tokens: list[int]          # generated tokens so far
     max_new: int
     done: bool = False
+    # per-request finish tokens checked alongside the server eos_id
+    stop: frozenset = frozenset()
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -238,24 +240,27 @@ def _spec_round_runner(target: Transformer, draft: Transformer,
     return _cached_runner(key, build)
 
 
-def _step_runner(model: Transformer, slots: int, temperature: float,
+def _step_runner(model: Transformer, slots: int,
                  top_k: int, top_p: float, cache_dtype: str):
-    """Jitted once per (model, B, sampling config): one ragged decode step
-    over ALL slots + sampling.  Free/done slots decode garbage lanes that
-    the host discards — the price of a single static program."""
-    key = (_model_key(model), "serve_step", slots, temperature, top_k,
-           top_p, cache_dtype)
+    """Jitted once per (model, B, truncation config): one ragged decode
+    step over ALL slots + per-row-temperature sampling (temperatures are
+    a traced [B] input, so per-request values never recompile).  Free/
+    done slots decode garbage lanes that the host discards — the price
+    of a single static program."""
+    key = (_model_key(model), "serve_step", slots, top_k, top_p,
+           cache_dtype)
 
     def build():
         # donate the cache: without it every per-token step would copy the
         # whole [L, B, max_len, H, D] K/V — doubling HBM traffic in the
         # exact loop this server exists to keep bandwidth-bound
         @partial(jax.jit, donate_argnums=(2,))
-        def run(params, tokens, cache, lengths, rng):
+        def run(params, tokens, cache, lengths, temps, rng):
             logits, cache = decode_block(model, params, tokens[:, None],
                                          cache, lengths=lengths)
             rng, sub = jax.random.split(rng)
-            nxt = sample_token(logits[:, 0], sub, temperature, top_k, top_p)
+            nxt = sample_token_rowwise(logits[:, 0], sub, temps,
+                                       top_k, top_p)
             return nxt, cache, rng
 
         return run
@@ -332,11 +337,13 @@ class DecodeServer:
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._rng = jax.random.key(seed)
-        self._step = _step_runner(model, slots, temperature, top_k, top_p,
-                                  cache_dtype)
+        self._step = _step_runner(model, slots, top_k, top_p, cache_dtype)
         self._temperature = temperature
         self._top_k = top_k
         self._top_p = top_p
+        # per-slot sampling temperature (traced input to the step program;
+        # submit(..., temperature=) overrides the server default per slot)
+        self._temps = np.full((slots,), temperature, np.float32)
         # --- speculative mode state
         self.draft = draft
         self.draft_len = draft_len
@@ -388,10 +395,27 @@ class DecodeServer:
         return None
 
     # ------------------------------------------------------------ submit
-    def submit(self, prompt, max_new_tokens: int = 64) -> int:
+    def submit(self, prompt, max_new_tokens: int = 64, *,
+               temperature: float | None = None,
+               stop=()) -> int:
         """Admit a request into a free slot (prefill + cache splice).
         Raises RuntimeError when every slot is busy — callers queue above
-        this layer.  Returns the request id."""
+        this layer.  Returns the request id.
+
+        ``temperature`` overrides the server default for THIS request
+        (0.0 = greedy; temperatures are a traced per-slot input, so
+        mixed-temperature batches share one compiled step).  Speculative
+        mode bakes the temperature into the verify round's acceptance
+        rule, so per-request overrides are rejected there.  ``stop`` is
+        an iterable of token ids that finish this request, checked
+        alongside the server ``eos_id``."""
+        if temperature is not None and self.draft is not None \
+                and temperature != self._temperature:
+            raise ValueError(
+                "per-request temperature is not supported in speculative "
+                "mode (the accept rule is compiled for the server "
+                "temperature); construct the server with the temperature "
+                "you need")
         slot = self._free_slot()
         if slot is None:
             raise RuntimeError("no free slot; drain with step() first")
@@ -418,8 +442,9 @@ class DecodeServer:
         last, row = _prefill_runner(self.model, bucket, self.cache_dtype)(
             self.params, jnp.asarray(padded),
             jnp.asarray(real_len, jnp.int32))
+        req_temp = self._temperature if temperature is None else temperature
         self._rng, sub = jax.random.split(self._rng)
-        first = int(sample_token(last[None], sub, self._temperature,
+        first = int(sample_token(last[None], sub, req_temp,
                                  self._top_k, self._top_p)[0])
         self._cache = _splice_runner(self.model, bucket, self.cache_dtype)(
             self._cache, row, jnp.asarray(slot, jnp.int32))
@@ -439,10 +464,11 @@ class DecodeServer:
         self._next_id += 1
         self._n_requests += 1
         entry = _Slot(request_id=rid, tokens=[first],
-                      max_new=max_new_tokens)
+                      max_new=max_new_tokens, stop=frozenset(stop))
         self._slot[slot] = entry
         self._lengths[slot] = real_len
         self._tokens[slot] = first
+        self._temps[slot] = req_temp
         if self._finishes(entry, first):
             self._retire(slot)
         return rid
@@ -459,7 +485,8 @@ class DecodeServer:
             return self._spec_step()
         nxt, self._cache, self._rng = self._step(
             self.params, jnp.asarray(self._tokens), self._cache,
-            jnp.asarray(self._lengths), self._rng)
+            jnp.asarray(self._lengths), jnp.asarray(self._temps),
+            self._rng)
         nxt = np.asarray(nxt)
         emitted: list[tuple[int, int]] = []
         for i, entry in enumerate(self._slot):
@@ -520,7 +547,8 @@ class DecodeServer:
 
     def _finishes(self, entry: _Slot, token: int) -> bool:
         return (len(entry.tokens) >= entry.max_new
-                or (self.eos_id is not None and token == self.eos_id))
+                or (self.eos_id is not None and token == self.eos_id)
+                or token in entry.stop)
 
     def _retire(self, slot: int) -> None:
         entry = self._slot[slot]

@@ -79,7 +79,7 @@ run lm350_scan_noremat_b32       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PS
 run lm350_scan_remat_b64         PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=64 PSDT_BENCH_SCAN=1
 run lm350_scan_remat_b32_credit  PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_REMAT_CREDIT=1
 run lm350_hd128_scan_b32         PSDT_BENCH_MODEL=lm_350m_hd128 PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
-run llama350_scan_b32            PSDT_BENCH_MODEL=llama_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
+run llama350_scan_b32            PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=llama_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
 run lm350_xlaflash_scan_b32      PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=xla_flash
 run lm350_dense_remat_b32        PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32
 run lm350_dense_noremat_b32      PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT=0

@@ -14,6 +14,9 @@ RESULTS="${RESULTS:-/tmp/tpu_recovery.jsonl}"
 LOG="${LOG:-/tmp/tpu_recovery.log}"
 PROBE_SPACING_S="${PROBE_SPACING_S:-240}"
 DEADLINE_S="${DEADLINE_S:-36000}"
+# Which resumable sweep to bank (same run/skip/abort contract):
+# scripts/tpu_recovery.sh (default) or e.g. scripts/tpu_recovery_dots.sh
+SWEEP="${SWEEP:-scripts/tpu_recovery.sh}"
 START=$(date +%s)
 
 # Shared predicate + wrapper (scripts/tpu_probe.sh) so watchdog, recovery,
@@ -30,7 +33,7 @@ while :; do
   fi
   if probe; then
     echo "watchdog: TPU up ($(date -u +%H:%M:%S)); running sweep" | tee -a "$LOG"
-    RESULTS="$RESULTS" LOG="$LOG" bash scripts/tpu_recovery.sh
+    RESULTS="$RESULTS" LOG="$LOG" bash "$SWEEP"
     rc=$?
     if [ "$rc" -eq 0 ]; then
       echo "watchdog: sweep complete" | tee -a "$LOG"

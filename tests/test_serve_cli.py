@@ -73,6 +73,24 @@ def test_malformed_lines_never_kill_the_server():
     assert len(done["ok"]["tokens"]) == 2            # null != EOF sentinel
 
 
+def test_per_request_temperature_and_stop_fields():
+    """Protocol-level pass-through of the per-request sampling knobs: a
+    greedy request and a hot-temperature request on the SAME tokens give
+    different streams, and "stop" cuts a request short."""
+    greedy = {"id": "g", "tokens": [1, 2, 3], "max_new": 8}
+    hot = {"id": "h", "tokens": [1, 2, 3], "max_new": 8, "temperature": 9.0}
+    lines, _ = run_serve([greedy, hot])
+    done = {line["id"]: line for line in lines if line.get("done")}
+    assert len(done["g"]["tokens"]) == 8
+    assert done["g"]["tokens"] != done["h"]["tokens"]
+
+    # stop at the greedy stream's 3rd token truncates the result there
+    stop_tok = done["g"]["tokens"][2]
+    lines2, _ = run_serve([dict(greedy, id="s", stop=[stop_tok])])
+    done2 = {line["id"]: line for line in lines2 if line.get("done")}
+    assert done2["s"]["tokens"] == done["g"]["tokens"][:3]
+
+
 def test_text_mode_round_trip():
     lines, _ = run_serve([{"id": 1, "prompt": "hi", "max_new": 3}])
     done = [line for line in lines if line.get("done")]

@@ -101,6 +101,61 @@ def test_eos_frees_slot_early(rng):
     assert srv._free_slot() is not None
 
 
+def test_per_request_stop_tokens(rng):
+    """submit(stop=...) finishes THAT request at its stop token while a
+    concurrent request sails past the same token id."""
+    model = tiny()
+    params = model.init_params(0)
+    prompt = list(rng.integers(0, 96, 5))
+    ref = reference(model, params, prompt, 8)
+    stop = ref[2]                              # cut request A at token 3
+    srv = DecodeServer(model, params, slots=2, max_len=64)
+    ra = srv.submit(prompt, max_new_tokens=8, stop=[stop])
+    rb = srv.submit(prompt, max_new_tokens=8)  # same prompt, no stop
+    results = srv.run_to_completion()
+    assert results[ra] == ref[:3]
+    assert results[rb] == ref
+
+
+def test_per_request_temperature_mixed_batch(rng):
+    """A greedy request and a sampled request share one batch: the greedy
+    row must stay token-exact vs standalone generate (sampling other rows
+    may not perturb it), the sampled row must actually differ, and no
+    recompile happens per distinct temperature (one step runner)."""
+    model = tiny()
+    params = model.init_params(0)
+    prompt = list(rng.integers(0, 96, 6))
+    ref = reference(model, params, prompt, 10)
+    srv = DecodeServer(model, params, slots=2, max_len=64, seed=3)
+    ra = srv.submit(prompt, max_new_tokens=10)                    # greedy
+    rb = srv.submit(prompt, max_new_tokens=10, temperature=5.0)   # hot
+    results = srv.run_to_completion()
+    assert results[ra] == ref
+    assert results[rb] != ref  # temperature 5 on a random-init model
+
+    # default server temperature still applies when submit doesn't set one
+    srv2 = DecodeServer(model, params, slots=1, max_len=64,
+                        temperature=0.0)
+    rc = srv2.submit(prompt, max_new_tokens=10)
+    assert srv2.run_to_completion()[rc] == ref
+
+
+def test_speculative_rejects_per_request_temperature(rng):
+    """The speculative accept rule is compiled for the server temperature,
+    so submit() must reject a differing per-request value (and accept a
+    matching one)."""
+    model = tiny()
+    draft = tiny(n_layers=1)
+    params = model.init_params(0)
+    dparams = draft.init_params(1)
+    srv = DecodeServer(model, params, slots=2, max_len=64,
+                       draft=draft, draft_params=dparams, draft_len=2)
+    with pytest.raises(ValueError, match="per-request temperature"):
+        srv.submit([1, 2, 3], temperature=0.7)
+    rid = srv.submit([1, 2, 3], max_new_tokens=4, temperature=0.0)
+    assert rid in srv.run_to_completion()
+
+
 def test_int8_cache_server_matches_int8_generate(rng):
     model = tiny()
     params = model.init_params(0)

@@ -33,6 +33,16 @@ import sys
 from ..config import parse_argv
 
 
+def draft_ckpt_flags(path: str) -> dict:
+    """--draft-ckpt accepts either checkpoint form: a single-file host
+    checkpoint (reference binary codec) or a sharded checkpoint DIRECTORY
+    (what --ckpt-dir training runs write) — dispatch by what the path is,
+    into the flag load_params reads for that form."""
+    import os
+
+    return {"ckpt-dir": path} if os.path.isdir(path) else {"ckpt": path}
+
+
 def load_params(flags: dict, model, seed: int):
     """Resolve the parameter source; returns (params, description)."""
     if flags.get("ckpt"):
@@ -190,7 +200,7 @@ def main(argv: list[str] | None = None) -> int:
         if not isinstance(draft, Transformer):
             raise ValueError(f"--draft-model={draft_name!r} is not an LM")
         dparams, dsource = load_params(
-            {"ckpt": flags.get("draft-ckpt", "")}, draft,
+            draft_ckpt_flags(flags.get("draft-ckpt", "")), draft,
             int(flags.get("draft-seed", seed + 1)))
         dparams = match_layout(draft, dparams)
         print(f"draft params: {dsource}", file=sys.stderr)

@@ -130,8 +130,9 @@ def main(argv: list[str] | None = None) -> int:
         if not isinstance(draft, _T):
             raise ValueError(f"--draft-model={flags['draft-model']!r} "
                              "is not an LM")
+        from .generate_main import draft_ckpt_flags
         dparams, dsource = load_params(
-            {"ckpt": flags.get("draft-ckpt", "")}, draft,
+            draft_ckpt_flags(flags.get("draft-ckpt", "")), draft,
             int(flags.get("draft-seed", int(flags.get("seed", 0)) + 1)))
         dparams = match_layout(draft, dparams)
         print(f"draft: {dsource}", file=sys.stderr)
@@ -162,14 +163,23 @@ def main(argv: list[str] | None = None) -> int:
     def finish(req: dict, tokens: list[int], is_text: bool) -> None:
         done: dict = {"id": req.get("id"), "done": True}
         if is_text:
-            trim = tokens
-            if eos is not None and eos in trim:
-                trim = trim[:trim.index(eos)]
+            # the terminator — global eos or a per-request stop token —
+            # is metadata, not content: trim it from the decoded text
+            # (admit() already rejected non-list "stop" fields)
+            enders = {int(t) for t in req.get("stop") or ()}
+            if eos is not None:
+                enders.add(eos)
+            cut = [i for i, t in enumerate(tokens) if t in enders]
+            trim = tokens[:cut[0]] if cut else tokens
             done["text"] = (hf_tok.decode(trim) if hf_tok is not None
                             else tokenizer.decode(trim))
         else:
             done["tokens"] = tokens
         _emit(done)
+
+    def finish_run() -> int:
+        print(f"serving stats: {json.dumps(srv.stats)}", file=sys.stderr)
+        return 0
 
     def admit() -> None:
         while pending and srv.has_free_slot:
@@ -236,12 +246,12 @@ def main(argv: list[str] | None = None) -> int:
         admit()
         if srv.idle:
             if eof and not pending:
-                return 0
+                return finish_run()
             if not pending:
                 # nothing in flight: block for the next request (or EOF)
                 item = in_q.get()
                 if item is None:
-                    return 0
+                    return finish_run()
                 tag, payload = item
                 if tag == "err":
                     _emit({"error": payload})
@@ -250,12 +260,14 @@ def main(argv: list[str] | None = None) -> int:
                 continue
         emitted = srv.step()
         done_now = set(srv.finished())
+        # stream every token BEFORE retiring finished requests: a
+        # speculative round can emit several tokens for one rid, and the
+        # finishing token may not be its last emitted pair
         for rid, token in emitted:
-            req = live[rid]
-            _emit({"id": req.get("id"), "token": int(token)})
-            if rid in done_now:
-                finish(req, srv.result(rid), text_mode[rid])
-                del live[rid], text_mode[rid]
+            _emit({"id": live[rid].get("id"), "token": int(token)})
+        for rid in done_now & set(live):
+            finish(live[rid], srv.result(rid), text_mode[rid])
+            del live[rid], text_mode[rid]
 
 
 if __name__ == "__main__":

@@ -57,6 +57,8 @@ class TrainLoopConfig:
                                   # None = model default, True/False force
     scan_layers: bool | None = None  # lax.scan over stacked layers (LMs);
                                      # tri-state like remat
+    remat_policy: str = ""        # "" = model default | full | dots
+                                  # (what remat may keep; flagship LMs)
     steps: int = 100
     optimizer: str = "adam"
     learning_rate: float = 1e-3
@@ -110,7 +112,8 @@ def run_training(config: TrainLoopConfig) -> dict:
                                            dtype=config.model_dtype,
                                            remat=config.remat,
                                            scan=config.scan_layers,
-                                           seq_len=config.seq_len)
+                                           seq_len=config.seq_len,
+                                           remat_policy=config.remat_policy)
     from ..models.transformer import Transformer, select_attention
     if isinstance(model, Transformer):
         if mesh.shape["pipe"] > 1:
@@ -197,7 +200,8 @@ def run_training(config: TrainLoopConfig) -> dict:
             config.model, load_batch, seed=load_seed + 100_003,
             data_path=eval_source,
             dtype=config.model_dtype, remat=config.remat,
-            scan=config.scan_layers, seq_len=config.seq_len)
+            scan=config.scan_layers, seq_len=config.seq_len,
+            remat_policy=config.remat_policy)
 
     def run_eval(state) -> float:
         total = 0.0

@@ -15,59 +15,7 @@
 # outage keeps everything captured so far and loses nothing else.
 set -u
 cd "$(dirname "$0")/.."
-
-RESULTS="${RESULTS:-/tmp/tpu_recovery.jsonl}"
-LOG="${LOG:-/tmp/tpu_recovery.log}"
-export PSDT_BENCH_TPU_ATTEMPTS=1
-export PSDT_BENCH_CPU_TIMEOUT=1        # a CPU fallback number is noise here
-export PSDT_BENCH_PREFLIGHT_RETRIES=1  # fail fast per config
-export PSDT_BENCH_TPU_TIMEOUT="${PSDT_BENCH_TPU_TIMEOUT:-560}"
-
-device_up() {  # same predicate + timeout bench.py's preflight uses
-  bash scripts/tpu_probe.sh
-}
-
-run() {  # run <tag> [VAR=VALUE...]
-  local tag="$1"; shift
-  # A tag counts as captured only with a real TPU number — bench_error and
-  # *_cpu_fallback rows are both retried on resume.
-  if grep -q "\"config\": \"$tag\"" "$RESULTS" 2>/dev/null \
-     && ! grep "\"config\": \"$tag\"" "$RESULTS" \
-          | grep -qE "bench_error|_cpu_fallback"; then
-    echo "=== $tag: already captured, skipping ===" | tee -a "$LOG"
-    return 0
-  fi
-  echo "=== $tag ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
-  local line
-  line=$(env "$@" python bench.py 2>>"$LOG")
-  [ -n "$line" ] || line='{"metric": "bench_error", "value": 0.0, "unit": "error", "vs_baseline": 0.0, "note": "bench.py emitted no output"}'
-  # Drop a stale row for this tag before appending the retry (grep -v exits
-  # 1 on empty output, so don't chain the mv on it).
-  if grep -q "\"config\": \"$tag\"" "$RESULTS" 2>/dev/null; then
-    grep -v "\"config\": \"$tag\"" "$RESULTS" > "$RESULTS.tmp"
-    mv "$RESULTS.tmp" "$RESULTS"
-  fi
-  echo "{\"config\": \"$tag\", \"result\": $line}" | tee -a "$RESULTS"
-  case "$line" in
-    *"preflight hung"*)
-      # The preflight is itself a probe — a hang means the tunnel is gone.
-      echo "tunnel-down signature on $tag; aborting sweep (rc=2)" \
-        | tee -a "$LOG"
-      exit 2 ;;
-    *"tpu attempt timed out"*)
-      # Ambiguous: a mid-run tunnel death and a config that genuinely needs
-      # more compile/run budget produce the same timeout.  Re-probe to
-      # disambiguate, else a deterministically-slow config would livelock
-      # the watchdog<->recovery pair and starve every config after it.
-      if device_up; then
-        echo "$tag timed out on a live device (config too slow for its" \
-             "budget); continuing" | tee -a "$LOG"
-      else
-        echo "tunnel died during $tag; aborting sweep (rc=2)" | tee -a "$LOG"
-        exit 2
-      fi ;;
-  esac
-}
+. scripts/tpu_sweep_lib.sh
 
 # -- 1. headline (driver default config)
 run headline_mlp_mfu

@@ -13,50 +13,7 @@
 # signatures abort rc=2 for scripts/tpu_watchdog.sh to wait out.
 set -u
 cd "$(dirname "$0")/.."
-
-RESULTS="${RESULTS:-/tmp/tpu_recovery.jsonl}"
-LOG="${LOG:-/tmp/tpu_recovery.log}"
-export PSDT_BENCH_TPU_ATTEMPTS=1
-export PSDT_BENCH_CPU_TIMEOUT=1
-export PSDT_BENCH_PREFLIGHT_RETRIES=1
-export PSDT_BENCH_TPU_TIMEOUT="${PSDT_BENCH_TPU_TIMEOUT:-560}"
-
-device_up() {
-  bash scripts/tpu_probe.sh
-}
-
-run() {  # run <tag> [VAR=VALUE...]
-  local tag="$1"; shift
-  if grep -q "\"config\": \"$tag\"" "$RESULTS" 2>/dev/null \
-     && ! grep "\"config\": \"$tag\"" "$RESULTS" \
-          | grep -qE "bench_error|_cpu_fallback"; then
-    echo "=== $tag: already captured, skipping ===" | tee -a "$LOG"
-    return 0
-  fi
-  echo "=== $tag ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
-  local line
-  line=$(env "$@" python bench.py 2>>"$LOG")
-  [ -n "$line" ] || line='{"metric": "bench_error", "value": 0.0, "unit": "error", "vs_baseline": 0.0, "note": "bench.py emitted no output"}'
-  if grep -q "\"config\": \"$tag\"" "$RESULTS" 2>/dev/null; then
-    grep -v "\"config\": \"$tag\"" "$RESULTS" > "$RESULTS.tmp"
-    mv "$RESULTS.tmp" "$RESULTS"
-  fi
-  echo "{\"config\": \"$tag\", \"result\": $line}" | tee -a "$RESULTS"
-  case "$line" in
-    *"preflight hung"*)
-      echo "tunnel-down signature on $tag; aborting sweep (rc=2)" \
-        | tee -a "$LOG"
-      exit 2 ;;
-    *"tpu attempt timed out"*)
-      if device_up; then
-        echo "$tag timed out on a live device (config too slow for its" \
-             "budget); continuing" | tee -a "$LOG"
-      else
-        echo "tunnel died during $tag; aborting sweep (rc=2)" | tee -a "$LOG"
-        exit 2
-      fi ;;
-  esac
-}
+. scripts/tpu_sweep_lib.sh
 
 # hd128 first: full-remat already measured highest (38.7% vs 31.5% for
 # head_dim 64), so hd128 x dots is the best shot at the >=45% target

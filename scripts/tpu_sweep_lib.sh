@@ -1,0 +1,64 @@
+# Shared scaffolding for the resumable TPU capture sweeps — sourced by
+# scripts/tpu_recovery.sh and scripts/tpu_recovery_dots.sh so the
+# run/skip/abort contract cannot diverge between them:
+#   * a tag with a real TPU number in $RESULTS is skipped on re-run;
+#     bench_error and *_cpu_fallback rows are retried
+#   * a tunnel-down signature (preflight hang, or a timeout on a dead
+#     device) aborts with rc=2 so scripts/tpu_watchdog.sh can wait out
+#     the outage and re-invoke
+#   * each banked line replaces any stale row for its tag
+# Callers must set (or accept the defaults for) RESULTS and LOG, then
+# call `run <tag> [VAR=VALUE...]` per config.
+
+RESULTS="${RESULTS:-/tmp/tpu_recovery.jsonl}"
+LOG="${LOG:-/tmp/tpu_recovery.log}"
+export PSDT_BENCH_TPU_ATTEMPTS=1
+export PSDT_BENCH_CPU_TIMEOUT=1        # a CPU fallback number is noise here
+export PSDT_BENCH_PREFLIGHT_RETRIES=1  # fail fast per config
+export PSDT_BENCH_TPU_TIMEOUT="${PSDT_BENCH_TPU_TIMEOUT:-560}"
+
+device_up() {  # same predicate + timeout bench.py's preflight uses
+  bash scripts/tpu_probe.sh
+}
+
+run() {  # run <tag> [VAR=VALUE...]
+  local tag="$1"; shift
+  # A tag counts as captured only with a real TPU number — bench_error and
+  # *_cpu_fallback rows are both retried on resume.
+  if grep -q "\"config\": \"$tag\"" "$RESULTS" 2>/dev/null \
+     && ! grep "\"config\": \"$tag\"" "$RESULTS" \
+          | grep -qE "bench_error|_cpu_fallback"; then
+    echo "=== $tag: already captured, skipping ===" | tee -a "$LOG"
+    return 0
+  fi
+  echo "=== $tag ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
+  local line
+  line=$(env "$@" python bench.py 2>>"$LOG")
+  [ -n "$line" ] || line='{"metric": "bench_error", "value": 0.0, "unit": "error", "vs_baseline": 0.0, "note": "bench.py emitted no output"}'
+  # Drop a stale row for this tag before appending the retry (grep -v exits
+  # 1 on empty output, so don't chain the mv on it).
+  if grep -q "\"config\": \"$tag\"" "$RESULTS" 2>/dev/null; then
+    grep -v "\"config\": \"$tag\"" "$RESULTS" > "$RESULTS.tmp"
+    mv "$RESULTS.tmp" "$RESULTS"
+  fi
+  echo "{\"config\": \"$tag\", \"result\": $line}" | tee -a "$RESULTS"
+  case "$line" in
+    *"preflight hung"*)
+      # The preflight is itself a probe — a hang means the tunnel is gone.
+      echo "tunnel-down signature on $tag; aborting sweep (rc=2)" \
+        | tee -a "$LOG"
+      exit 2 ;;
+    *"tpu attempt timed out"*)
+      # Ambiguous: a mid-run tunnel death and a config that genuinely needs
+      # more compile/run budget produce the same timeout.  Re-probe to
+      # disambiguate, else a deterministically-slow config would livelock
+      # the watchdog<->recovery pair and starve every config after it.
+      if device_up; then
+        echo "$tag timed out on a live device (config too slow for its" \
+             "budget); continuing" | tee -a "$LOG"
+      else
+        echo "tunnel died during $tag; aborting sweep (rc=2)" | tee -a "$LOG"
+        exit 2
+      fi ;;
+  esac
+}

@@ -91,6 +91,33 @@ def test_per_request_temperature_and_stop_fields():
     assert done2["s"]["tokens"] == done["g"]["tokens"][:3]
 
 
+def test_speculative_serving_protocol_multi_token_rounds():
+    """Speculative mode at the protocol level: a SELF-draft at the same
+    seed accepts every proposal, so each round commits draft_len+1 tokens
+    and requests finish mid-round — the stream must still deliver every
+    token exactly once and a done line per request (regression: the drain
+    loop once popped a request at its finishing token and crashed on the
+    same round's remaining pairs)."""
+    lines, _ = run_serve(
+        [{"id": "a", "tokens": [1, 2, 3], "max_new": 9},
+         {"id": "b", "tokens": [4, 5], "max_new": 7}],
+        "--draft-model=tiny_lm", "--draft-seed=0", "--draft-len=4")
+    streamed: dict = {}
+    for line in lines:
+        if "token" in line:
+            streamed.setdefault(line["id"], []).append(line["token"])
+    done = {line["id"]: line for line in lines if line.get("done")}
+    assert set(done) == {"a", "b"}
+    for rid, expect_n in (("a", 9), ("b", 7)):
+        assert streamed[rid] == done[rid]["tokens"]
+        assert len(done[rid]["tokens"]) == expect_n
+
+    # greedy speculative output is token-exact vs the plain server
+    plain, _ = run_serve([{"id": "a", "tokens": [1, 2, 3], "max_new": 9}])
+    plain_done = next(l for l in plain if l.get("done"))
+    assert plain_done["tokens"] == done["a"]["tokens"]
+
+
 def test_text_mode_round_trip():
     lines, _ = run_serve([{"id": 1, "prompt": "hi", "max_new": 3}])
     done = [line for line in lines if line.get("done")]

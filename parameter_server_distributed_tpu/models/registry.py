@@ -16,6 +16,7 @@ from .mlp import MLP, billion_param_mlp, mnist_mlp
 from .resnet import resnet18, resnet50
 from .transformer import (llama_350m, lm_350m, moe_lm, small_lm, switch_lm,
                           tiny_lm)
+from .vit import vit_s16, vit_tiny
 
 
 # xy loaders: the registry seed varies the SAMPLING stream only — the
@@ -87,6 +88,12 @@ REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator], str]] = {
     # LLaMA-architecture flagship (SwiGLU + GQA): the shape from_hf_llama
     # conversions have, so its bench rows transfer to real checkpoints
     "llama_350m": (llama_350m, _lm_350m_batches, "tokens"),
+    # vision transformers (models/vit.py): CIFAR-scale and ImageNet-scale
+    "vit_tiny_cifar": (partial(vit_tiny, num_classes=10, image_size=32),
+                       _cifar_batches, "xy"),
+    "vit_s16_imagenet": (partial(vit_s16, num_classes=1000,
+                                 image_size=224),
+                         _imagenet_batches, "xy"),
 }
 
 DTYPE_NAMES = {"f32": "float32", "float32": "float32",

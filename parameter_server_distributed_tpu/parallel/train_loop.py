@@ -80,7 +80,10 @@ def _pick_rule(model_name: str, mesh):
     if mesh.shape["pipe"] > 1:
         from .pipeline import pipeline_rule
         return pipeline_rule(mesh)
-    if "lm" in model_name or "transformer" in model_name:
+    if ("lm" in model_name or "transformer" in model_name
+            or model_name.startswith("vit")):
+        # ViT stores use the transformer's param-name suffixes on purpose
+        # (models/vit.py docstring) — same Megatron TP/fsdp layout
         from ..models.transformer import transformer_rule
         return transformer_rule(mesh)
     if mesh.shape["tensor"] > 1:

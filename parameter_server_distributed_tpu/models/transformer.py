@@ -187,12 +187,24 @@ def flash_attention_auto(q: Array, k: Array, v: Array) -> Array:
     backends the kernels run in interpret mode, so this is only worth
     selecting on TPU; pass it explicitly as
     ``Transformer(config, attention_fn=flash_attention_auto)`` or set
-    ``PSDT_FLASH_ATTENTION=1`` to make it the model default."""
+    ``PSDT_FLASH_ATTENTION=1`` to make it the model default.
+
+    ``PSDT_FLASH_BLOCK_Q`` / ``PSDT_FLASH_BLOCK_K`` (default 128) tune
+    the kernel tile sizes without a code change — larger K blocks raise
+    arithmetic intensity per HBM fetch at O(block_q*block_k) VMEM cost;
+    the sequence must divide by both."""
+    import os
+
     from ..ops.pallas.flash_attention import flash_attention_gqa
 
+    # `or "128"`: an EMPTY env value means unset (shell idiom VAR= ),
+    # matching the package's other PSDT_ flags; non-numeric fails loudly
+    block_q = int(os.environ.get("PSDT_FLASH_BLOCK_Q") or "128")
+    block_k = int(os.environ.get("PSDT_FLASH_BLOCK_K") or "128")
     seq = q.shape[1]
-    if seq % 128 == 0:
-        return flash_attention_gqa(q, k, v, block_q=128, block_k=128)
+    if seq % block_q == 0 and seq % block_k == 0:
+        return flash_attention_gqa(q, k, v, block_q=block_q,
+                                   block_k=block_k)
     return causal_attention(q, k, v)
 
 

@@ -41,6 +41,10 @@ run lm350_hd128_seq4096_b8       PSDT_BENCH_MODEL=lm_350m_hd128 PSDT_BENCH_BATCH
 run gqa_flash_seq4096_b8         PSDT_BENCH_MODEL=lm_350m_gqa PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=flash
 run lm350_flash_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=flash
 run lm350_dense_seq8192_b4       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=4 PSDT_BENCH_SEQ=8192 PSDT_BENCH_SCAN=1
+# flash kernel tile tuning (PSDT_FLASH_BLOCK_Q/K): larger K blocks raise
+# arithmetic intensity per HBM fetch at O(bq*bk) VMEM cost
+run flash_seq4096_bk256          PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=flash PSDT_FLASH_BLOCK_K=256
+run flash_seq4096_bq256_bk256    PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=8 PSDT_BENCH_SEQ=4096 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=flash PSDT_FLASH_BLOCK_Q=256 PSDT_FLASH_BLOCK_K=256
 # -- 4. decode/serving
 run decode_small_lm              PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run decode_small_lm_int8         PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_QUANT=int8
@@ -56,5 +60,11 @@ run mlp1b_sgd_b1024              PSDT_BENCH_MODEL=mlp_1b PSDT_BENCH_BATCH=1024
 run mnist_mlp_b256               PSDT_BENCH_MODEL=mnist_mlp PSDT_BENCH_BATCH=256
 run resnet18_b256                PSDT_BENCH_MODEL=resnet18_cifar PSDT_BENCH_BATCH=256
 run resnet50_b128                PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=resnet50_imagenet PSDT_BENCH_BATCH=128
+# XLA cost-analysis MFU (hardware-executed FLOPs, any model): conv nets
+# get their first MFU rows, and the LM row cross-checks the analytic
+# remat-credited accounting against XLA's own count
+run resnet50_b128_xlaflops       PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=resnet50_imagenet PSDT_BENCH_BATCH=128 PSDT_BENCH_FLOPS=xla
+run vit_s16_b64_xlaflops         PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=vit_s16_imagenet PSDT_BENCH_BATCH=64 PSDT_BENCH_FLOPS=xla
+run lm350_scan_b32_xlaflops      PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_FLOPS=xla
 
 echo "recovery sweep done -> $RESULTS" | tee -a "$LOG"

@@ -16,9 +16,12 @@ Extension flags:
     --data=PATH      file-backed dataset (token .bin for LMs, npz x/y
                      otherwise); default synthetic
     --wire=ENC       tensor payload encoding: f32 (reference-compatible,
-                     default), raw, bf16 (half the push/pull bytes), or
+                     default), raw, bf16 (half the push/pull bytes),
                      int8 (quarter-size error-feedback gradient pushes,
-                     bf16 pulls; requires a framework PS)
+                     bf16 pulls; requires a framework PS), or topk
+                     (top-k sparsified pushes at --topk-density, unsent
+                     mass carried by error feedback; bf16 pulls)
+    --topk-density=F fraction of entries a topk push keeps (default 0.01)
     --dtype=bf16     model compute dtype (factories that take one)
     --remat / --no-remat / --scan-layers / --no-scan-layers
                      transformer LM layer-loop knobs (same semantics as
@@ -88,6 +91,9 @@ def main(argv: list[str] | None = None) -> int:
                      else True if "scan-layers" in flags else None),
         data_path=flags.get("data", ""),
         wire_dtype=flags.get("wire", "f32"),
+        # omit when unset so WorkerConfig's default governs (one owner)
+        **({"topk_density": float(flags["topk-density"])}
+           if "topk-density" in flags else {}),
         mesh=flags.get("mesh", ""),
     )
     worker = build_worker(config, seed=int(flags["seed"]) if "seed" in flags else None)

@@ -101,10 +101,16 @@ class WorkerConfig:
     data_path: str = ""
     # Tensor payload encoding on push/pull: "f32" (reference-compatible
     # repeated float), "raw" (f32 bytes blob), "bf16" (half the bytes;
-    # TPU-native number format), or "int8" (quarter-size gradient pushes
-    # with error feedback; pulls stay bf16).  Packed encodings require a
+    # TPU-native number format), "int8" (quarter-size gradient pushes
+    # with error feedback; pulls stay bf16), or "topk" (top-k sparsified
+    # pushes — ~topk_density*3/4 of the bf16 payload, unsent mass carried
+    # by error feedback; pulls stay bf16).  Packed encodings require a
     # framework PS (negotiated; falls back to f32 against the reference).
     wire_dtype: str = "f32"
+    # Fraction of gradient entries a "topk" push keeps (by |value|).
+    # Default lives in rpc/messages.py (TOPK_DEFAULT_DENSITY) — one owner
+    # for the wire layer, this config, and the CLI.
+    topk_density: float = 0.01  # == messages.TOPK_DEFAULT_DENSITY
     # Intra-worker model parallelism: a mesh spec over the worker's local
     # chips (e.g. "fsdp:2,data:2", "tensor:4").  Empty = pure local data
     # parallelism.  Params are sharding-constrained inside the jitted

@@ -15,17 +15,20 @@ from typing import Iterable, Mapping
 
 import numpy as np
 
-from ..rpc.messages import Tensor
+from ..rpc.messages import TOPK_DEFAULT_DENSITY, Tensor
 
 # A parameter/gradient store is just an ordered mapping name -> array.
 TensorStore = dict[str, np.ndarray]
 
 
-def to_wire(store: Mapping[str, np.ndarray], wire_dtype: int = 0) -> list[Tensor]:
+def to_wire(store: Mapping[str, np.ndarray], wire_dtype: int = 0,
+            topk_density: float = TOPK_DEFAULT_DENSITY) -> list[Tensor]:
     """Store -> wire messages (reference: src/worker.cpp:40-52 to_proto).
     `wire_dtype` selects the payload encoding (messages.WIRE_*); the default
-    is the reference-compatible packed repeated-float."""
-    return [Tensor.from_array(name, np.asarray(arr), wire_dtype=wire_dtype)
+    is the reference-compatible packed repeated-float.  ``topk_density``
+    applies to the WIRE_TOPK encoding only (fraction of entries kept)."""
+    return [Tensor.from_array(name, np.asarray(arr), wire_dtype=wire_dtype,
+                              topk_density=topk_density)
             for name, arr in store.items()]
 
 

@@ -36,9 +36,17 @@ WIRE_BF16 = 2      # raw bfloat16 bytes in field 5 — half the payload
 WIRE_INT8 = 3      # f32 max-abs scale + int8 bytes in field 5 — quarter
                    # the payload (EQuARX-style quantized transport; pair
                    # with error feedback for gradients — worker/worker.py)
+WIRE_TOPK = 4      # top-k sparsified: u32 k | k*u32 indices | k*bf16
+                   # values in field 5 (Deep-Gradient-Compression-style
+                   # transport: ~density*3/4 of the bf16 payload; pair
+                   # with error feedback so unsent mass is carried, not
+                   # dropped — worker/worker.py).  Decode rematerializes
+                   # dense, so the server aggregation path is unchanged.
 
 WIRE_DTYPE_NAMES = {"f32": WIRE_F32, "raw": WIRE_RAW_F32, "bf16": WIRE_BF16,
-                    "int8": WIRE_INT8}
+                    "int8": WIRE_INT8, "topk": WIRE_TOPK}
+
+TOPK_DEFAULT_DENSITY = 0.01  # fraction of entries a topk tensor keeps
 
 
 _BF16 = None
@@ -70,7 +78,8 @@ class Tensor(Message):
 
     @classmethod
     def from_array(cls, name: str, array: np.ndarray,
-                   wire_dtype: int = WIRE_F32) -> "Tensor":
+                   wire_dtype: int = WIRE_F32,
+                   topk_density: float = TOPK_DEFAULT_DENSITY) -> "Tensor":
         # float64 inputs are marked dtype=1 (the reference IDL's declared
         # float64 — proto/parameter_server.proto:23) but still ride the
         # wire as `repeated float`, exactly as a reference peer would emit
@@ -94,6 +103,19 @@ class Tensor(Message):
             scale = max_abs / 127.0 if max_abs > 0 else 1.0
             q = np.clip(np.rint(flat / scale), -127, 127).astype(np.int8)
             payload = np.float32(scale).tobytes() + q.tobytes()
+        elif wire_dtype == WIRE_TOPK:
+            flat = arr.reshape(-1)
+            k = min(flat.size, max(1, int(round(flat.size * topk_density)))) \
+                if flat.size else 0
+            if k:
+                # argpartition: O(n) selection of the k largest |values|
+                idx = np.argpartition(np.abs(flat), flat.size - k)[-k:]
+                idx = np.sort(idx).astype("<u4")  # sorted: cache-friendly
+                vals = flat[idx.astype(np.int64)].astype(_bf16_dtype())
+                payload = (np.uint32(k).tobytes() + idx.tobytes()
+                           + vals.tobytes())
+            else:
+                payload = np.uint32(0).tobytes()
         else:
             return cls(name=name, shape=list(arr.shape),
                        data=arr.reshape(-1), dtype=dtype_tag)
@@ -118,6 +140,17 @@ class Tensor(Message):
             scale = np.frombuffer(packed, dtype="<f4", count=1)[0]
             arr = np.frombuffer(packed, dtype=np.int8,
                                 offset=4).astype(np.float32) * scale
+        elif self.packed_dtype == WIRE_TOPK and packed:
+            k = int(np.frombuffer(packed, dtype="<u4", count=1)[0])
+            # np.prod([]) == 1: an empty shape list is a 0-d SCALAR (one
+            # element), not an empty tensor — empty tensors carry [0]
+            total = int(np.prod(self.shape))
+            arr = np.zeros(total, np.float32)
+            if k:
+                idx = np.frombuffer(packed, dtype="<u4", offset=4, count=k)
+                vals = np.frombuffer(packed, dtype=_bf16_dtype(),
+                                     offset=4 + 4 * k, count=k)
+                arr[idx.astype(np.int64)] = vals.astype(np.float32)
         else:
             arr = np.asarray(self.data, dtype=np.float32)
         if self.dtype == DTYPE_FLOAT64:

@@ -51,11 +51,25 @@ class ParameterServerService:
     # RPC: pull parameters (reference: src/parameter_server_service.cpp:62-84)
     # Serves in the encoding the client requested (request.wire_dtype, a
     # framework extension; reference clients leave it 0 = repeated float).
+    @staticmethod
+    def _serve_wire_dtype(requested: int) -> int:
+        """The lossy gradient-push encodings (int8, topk) must never be
+        applied to SERVED parameters — error feedback corrects push bias
+        over time, but re-compressing the parameters every pull compounds
+        irrecoverable error (99% of weights zeroed, under topk).  The
+        framework worker already asks for bf16 in that case
+        (worker/worker.py _pull_wire_dtype); enforcing it server-side
+        protects every other client too."""
+        if requested in (m.WIRE_INT8, m.WIRE_TOPK):
+            return m.WIRE_BF16
+        return requested
+
     def ServeParameters(self, request: m.PullRequest, context) -> m.ParameterUpdate:
         iteration, params, ready = self.core.serve_parameters(request.iteration)
         return m.ParameterUpdate(
             iteration=iteration,
-            parameters=to_wire(params, wire_dtype=request.wire_dtype),
+            parameters=to_wire(
+                params, wire_dtype=self._serve_wire_dtype(request.wire_dtype)),
             ready=ready)
 
     # RPC (framework extension, rpc/data_plane.py): client-streamed push.
@@ -87,7 +101,8 @@ class ParameterServerService:
     # as it is yielded, overlapping the previous chunk's transport.
     def ServeParametersStream(self, request: m.PullRequest, context):
         iteration, params, ready = self.core.serve_parameters(request.iteration)
-        tensors = to_wire(params, wire_dtype=request.wire_dtype)
+        tensors = to_wire(
+            params, wire_dtype=self._serve_wire_dtype(request.wire_dtype))
         sent = False
         for group in split_tensors(tensors, stream_chunk_bytes() or
                                    (32 << 20)):

@@ -56,6 +56,11 @@ class Worker:
             raise ValueError(
                 f"unknown wire_dtype {config.wire_dtype!r}; "
                 f"options: {sorted(m.WIRE_DTYPE_NAMES)}")
+        if not 0.0 < config.topk_density <= 1.0:
+            # a percent-style typo (--topk-density=2) would otherwise
+            # emit a k larger than the serialized pairs
+            raise ValueError(f"topk_density must be in (0, 1], "
+                             f"got {config.topk_density}")
         self.config = config
         self.trainer = trainer
         self.batches = batches
@@ -136,11 +141,12 @@ class Worker:
         self._ef_residual: dict[str, np.ndarray] = {}
 
     def _pull_wire_dtype(self) -> int:
-        """Encoding requested for served parameters.  int8 is for gradient
-        pushes only — error feedback corrects its bias push-over-push, but
-        repeatedly quantizing the *parameters* on every pull would compound
-        irrecoverable error, so int8 workers pull bf16."""
-        if self._wire_dtype == m.WIRE_INT8:
+        """Encoding requested for served parameters.  The lossy encodings
+        (int8, topk) are for gradient pushes only — error feedback corrects
+        their bias push-over-push, but repeatedly compressing the
+        *parameters* on every pull would compound irrecoverable error, so
+        those workers pull bf16."""
+        if self._wire_dtype in (m.WIRE_INT8, m.WIRE_TOPK):
             return m.WIRE_BF16
         return self._wire_dtype
 
@@ -262,8 +268,9 @@ class Worker:
         """reference: src/worker.cpp:254-272."""
         push_dtype = self._wire_dtype if self._peer_packed_ok else m.WIRE_F32
         new_residual = None
-        if push_dtype == m.WIRE_INT8:
-            tensors, new_residual = self._quantize_with_feedback(grads)
+        if push_dtype in (m.WIRE_INT8, m.WIRE_TOPK):
+            tensors, new_residual = self._compress_with_feedback(
+                grads, push_dtype)
         else:
             tensors = to_wire(grads, push_dtype)
         update = m.GradientUpdate(worker_id=self.config.worker_id,
@@ -277,18 +284,22 @@ class Worker:
             self._ef_residual = new_residual
         return resp
 
-    def _quantize_with_feedback(
-            self, grads: TensorStore) -> tuple[list, dict]:
-        """int8 quantization with error feedback (1-bit-SGD/EF-SGD style):
-        each push sends quantize(grad + residual) and carries the rounding
-        error into the next push, so quantization bias cancels over time
-        instead of accumulating."""
+    def _compress_with_feedback(
+            self, grads: TensorStore, wire_dtype: int) -> tuple[list, dict]:
+        """Lossy gradient compression with error feedback (1-bit-SGD /
+        EF-SGD / Deep-Gradient-Compression style): each push sends
+        compress(grad + residual) and carries the un-sent part — rounding
+        error under int8, the whole non-top-k mass under topk — into the
+        next push, so compression bias cancels over time instead of
+        accumulating.  The residual is what the PS did NOT see: decoding
+        the wire tensor gives exactly the server's view."""
         adjusted = {}
         for name, g in grads.items():
             g = np.asarray(g, np.float32)
             prev = self._ef_residual.get(name)
             adjusted[name] = g + prev if prev is not None else g
-        tensors = to_wire(adjusted, m.WIRE_INT8)
+        tensors = to_wire(adjusted, wire_dtype,
+                          topk_density=self.config.topk_density)
         residual = {t.name: adjusted[t.name] - t.to_array() for t in tensors}
         return tensors, residual
 

@@ -234,13 +234,47 @@ def test_int8_error_feedback_cancels_quantization_bias():
         g = {"w": rng.standard_normal(512).astype(np.float32)}
         decoded = []
         for _ in range(64):
-            tensors, residual = w._quantize_with_feedback(g)
+            tensors, residual = w._compress_with_feedback(g, 3)  # WIRE_INT8
             w._ef_residual = residual  # as a successful push would
             decoded.append(tensors[0].to_array())
         single_err = np.abs(decoded[0] - g["w"]).max()
         mean_err = np.abs(np.mean(decoded, axis=0) - g["w"]).max()
         assert mean_err < single_err / 5  # bias cancelled over pushes
         assert any(np.abs(r).sum() > 0 for r in w._ef_residual.values())
+    finally:
+        w.shutdown()
+
+
+def test_topk_error_feedback_delivers_full_mass():
+    """Top-k sparsified pushes at 25% density: each push delivers only
+    the largest entries, but the residual carries everything unsent —
+    including the bf16 rounding of what WAS sent — so the telescoping
+    identity sum(decoded pushes) + final_residual == N * true_gradient
+    holds exactly (nothing is ever dropped, only deferred)."""
+    from parameter_server_distributed_tpu.cli.worker_main import build_worker
+    from parameter_server_distributed_tpu.rpc import messages as m
+
+    w = build_worker(WorkerConfig(worker_id=0, wire_dtype="topk",
+                                  topk_density=0.25,
+                                  heartbeat_period_s=600.0))
+    try:
+        w._peer_packed_ok = True
+        rng = np.random.default_rng(0)
+        g = {"w": rng.standard_normal(256).astype(np.float32)}
+        total = np.zeros(256, np.float32)
+        n = 64
+        for _ in range(n):
+            tensors, residual = w._compress_with_feedback(g, m.WIRE_TOPK)
+            w._ef_residual = residual
+            arr = tensors[0].to_array()
+            assert np.count_nonzero(arr) <= 64  # 25% of 256
+            total += arr
+        np.testing.assert_allclose(total + w._ef_residual["w"],
+                                   n * g["w"], atol=1e-3)
+        # and the deferred mass is bounded: the mean of what the PS saw
+        # tracks the true gradient to O(residual / n)
+        bound = np.abs(w._ef_residual["w"]).max() / n + 1e-3
+        assert np.abs(total / n - g["w"]).max() <= bound
     finally:
         w.shutdown()
 
@@ -266,6 +300,33 @@ def test_int8_wire_training_loss_decreases(cluster):
         # error feedback engaged on both workers
         for w in workers:
             assert w._wire_dtype == 3 and w._ef_residual
+    finally:
+        for w in workers:
+            w.shutdown()
+
+
+def test_topk_wire_training_loss_decreases(cluster):
+    """End to end: top-k sparsified error-feedback pushes (10% density)
+    + bf16 pulls still learn over real gRPC."""
+    ps, coordinator, coord_port, _ = cluster
+    workers = []
+    for wid in range(2):
+        w = build_worker(WorkerConfig(
+            coordinator_address=f"127.0.0.1:{coord_port}",
+            worker_id=wid, iterations=5, address="127.0.0.1",
+            port=50070 + wid, batch_size=16, model="mnist_mlp",
+            heartbeat_period_s=600.0, wire_dtype="topk",
+            topk_density=0.1))
+        w.initialize()
+        workers.append(w)
+    try:
+        losses = run_workers(workers, 5)
+        for wid, series in losses.items():
+            real = [x for x in series if np.isfinite(x)]
+            assert len(real) >= 3
+            assert real[-1] < real[0], f"worker {wid} loss did not decrease"
+        for w in workers:
+            assert w._wire_dtype == 4 and w._ef_residual  # WIRE_TOPK
     finally:
         for w in workers:
             w.shutdown()

@@ -150,6 +150,24 @@ def test_serve_parameters_in_requested_wire_dtype(ps):
                                    rtol=1e-2, atol=1e-3)
 
 
+def test_lossy_pull_requests_served_bf16(ps):
+    """The lossy gradient-push encodings must never apply to SERVED
+    parameters: a client asking to pull int8/topk gets bf16 — enforced
+    server-side so a misconfigured client cannot receive sparsified
+    (99%-zeroed) weights."""
+    server, port = ps
+    w = np.linspace(-2, 2, 1024).astype(np.float32)
+    server.core.initialize_parameters({"w": w})
+    with ps_client(port) as client:
+        for lossy in (m.WIRE_INT8, m.WIRE_TOPK):
+            resp = client.call("ServeParameters",
+                               m.PullRequest(worker_id=0, iteration=0,
+                                             wire_dtype=lossy))
+            t = resp.parameters[0]
+            assert t.packed_dtype == m.WIRE_BF16
+            np.testing.assert_allclose(t.to_array(), w, rtol=8e-3)
+
+
 # ---------------------------------------------------------------- streaming
 # Chunk-stream data plane (rpc/data_plane.py): same payloads as the unary
 # RPCs, shipped as streams of smaller GradientUpdate/ParameterUpdate chunks.

@@ -199,6 +199,48 @@ def test_int8_packed_quarter_bytes_and_error_bound(rng):
                                   np.zeros(16, np.float32))
 
 
+def test_topk_packed_sparse_roundtrip(rng):
+    """WIRE_TOPK keeps exactly the k largest-|value| entries (bf16-
+    precision values at their original indices, zeros elsewhere) and the
+    payload shrinks with the density."""
+    arr = rng.standard_normal((64, 32)).astype(np.float32)
+    t = m.Tensor.from_array("g", arr, wire_dtype=m.WIRE_TOPK,
+                            topk_density=0.1)
+    rt = m.Tensor.decode(t.encode()).to_array()
+    k = max(1, round(arr.size * 0.1))
+    flat = arr.reshape(-1)
+    keep = np.argsort(np.abs(flat))[-k:]
+    assert np.count_nonzero(rt) == k
+    mask = np.zeros(arr.size, bool)
+    mask[keep] = True
+    # kept entries match to bf16 precision; everything else is zero
+    np.testing.assert_allclose(rt.reshape(-1)[mask], flat[mask],
+                               rtol=8e-3, atol=1e-6)
+    np.testing.assert_array_equal(rt.reshape(-1)[~mask], 0.0)
+    # ~density * bf16 payload: 6 bytes/entry vs 4 dense f32 bytes
+    f32 = m.Tensor.from_array("g", arr).encode()
+    assert len(t.encode()) < len(f32) * 0.2
+    # degenerate cases: empty tensor and k rounding to >= 1
+    empty = m.Tensor.from_array("e", np.zeros((0,), np.float32),
+                                wire_dtype=m.WIRE_TOPK)
+    assert m.Tensor.decode(empty.encode()).to_array().size == 0
+    tiny = m.Tensor.from_array("t", np.ones(3, np.float32),
+                               wire_dtype=m.WIRE_TOPK, topk_density=0.01)
+    assert np.count_nonzero(
+        m.Tensor.decode(tiny.encode()).to_array()) == 1
+    # 0-d scalar: np.prod([]) == 1, so it round-trips as one element
+    s = m.Tensor.from_array("s", np.float32(3.5), wire_dtype=m.WIRE_TOPK)
+    assert float(m.Tensor.decode(s.encode()).to_array()) == 3.5
+    # density > 1 clamps k to the tensor size instead of corrupting
+    over = m.Tensor.from_array("o", np.ones(10, np.float32),
+                               wire_dtype=m.WIRE_TOPK, topk_density=2.0)
+    np.testing.assert_array_equal(
+        m.Tensor.decode(over.encode()).to_array(), np.ones(10, np.float32))
+    # the density default has ONE owner shared by wire, config, and CLI
+    from parameter_server_distributed_tpu.config import WorkerConfig
+    assert WorkerConfig().topk_density == m.TOPK_DEFAULT_DENSITY
+
+
 def test_float64_dtype_tag_roundtrip(rng):
     """The reference IDL declares dtype=1 float64 (proto:23) while carrying
     data as `repeated float`; from_array marks float64 inputs and to_array
@@ -221,7 +263,8 @@ def test_raw_f32_decode_is_writable(rng):
     """Every decode path returns a writable array (frombuffer views are
     read-only; in-place aggregation must work on any encoding)."""
     arr = rng.standard_normal(32).astype(np.float32)
-    for wd in (m.WIRE_F32, m.WIRE_RAW_F32, m.WIRE_BF16, m.WIRE_INT8):
+    for wd in (m.WIRE_F32, m.WIRE_RAW_F32, m.WIRE_BF16, m.WIRE_INT8,
+               m.WIRE_TOPK):
         out = m.Tensor.decode(
             m.Tensor.from_array("w", arr, wire_dtype=wd).encode()).to_array()
         out += 1.0  # raises on read-only arrays

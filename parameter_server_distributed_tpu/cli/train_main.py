@@ -27,7 +27,11 @@ loop (cross-layer XLA fusion) for transformer LMs.
 ``--remat-policy=full|dots`` picks what remat may keep (flagship LMs):
 full recomputes the whole layer, dots saves the projection/MLP matmul
 outputs and recomputes only the attention einsums (~5% extra FLOPs
-instead of ~33%, for O(L·S·d) saved activations).  ``--seq=N``
+instead of ~33%, for O(L·S·d) saved activations).  ``--lora=R[:ALPHA]``
+switches to LoRA fine-tuning: rank-R adapters on the attention q/v
+projections are the ONLY trainable parameters (base weights frozen, no
+optimizer state allocated for them — models/lora.py; merge with
+``models.lora.merge_lora`` for serving).  ``--seq=N``
 overrides the LM sequence length (long-context runs; synthetic token
 streams follow the model).
 
@@ -93,7 +97,7 @@ KNOWN_FLAGS = frozenset({
     "model", "batch", "data", "seq", "eval-every", "eval-steps", "eval-data",
     "per-process-data", "prefetch", "attention", "microbatches",
     "pipeline-schedule", "virtual-stages", "dtype", "remat", "no-remat",
-    "scan-layers", "remat-policy",
+    "scan-layers", "remat-policy", "lora", "init-ckpt-dir",
     "no-scan-layers", "steps", "optimizer", "lr", "schedule", "warmup",
     "clip-norm", "accum", "mesh", "ckpt-dir", "ckpt-every", "ckpt-keep",
     "log-every", "seed", "resume", "metrics", "coordinator",
@@ -116,6 +120,12 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
                          f"--help lists the accepted flags")
 
+    if "--lora" in argv:
+        # parse_argv maps a bare --lora to "1", which would silently run
+        # a near-useless rank-1 adapter; demand the explicit spec
+        # (--lora=1 stays a deliberate rank-1 choice)
+        raise SystemExit("--lora requires an explicit spec, e.g. "
+                         "--lora=8 or --lora=8:16")
     if "coordinator" in flags or int(flags.get("num-processes", 1)) > 1:
         from ..parallel.distributed import initialize_multihost
         initialize_multihost(
@@ -145,6 +155,8 @@ def main(argv: list[str] | None = None) -> int:
         scan_layers=(False if "no-scan-layers" in flags
                      else True if "scan-layers" in flags else None),
         remat_policy=flags.get("remat-policy", ""),
+        lora=flags.get("lora", ""),
+        init_ckpt_dir=flags.get("init-ckpt-dir", ""),
         steps=int(flags.get("steps", 100)),
         optimizer=flags.get("optimizer", "adam"),
         learning_rate=float(flags.get("lr", 1e-3)),

@@ -1,0 +1,157 @@
+"""LoRA: low-rank adaptation for parameter-efficient fine-tuning.
+
+Fine-tunes a pretrained store (e.g. a :func:`models.hf.from_hf_gpt2` /
+``from_hf_llama`` conversion) by training only a rank-r product added to
+selected 2-D weights — W_eff = W + (alpha/r) * A @ B with A [in, r] and
+B [r, out] (the paper writes the same product as B@A under its
+transposed layout) — while the base weights stay frozen (Hu et al.,
+LoRA).  The reference
+framework has no fine-tuning story at all (no models — reference
+src/worker.cpp:316-329); this makes converted checkpoints cheaply
+adaptable on the PS/SPMD training stack.
+
+Design: model-agnostic and zero-intrusion.  Adapters are ordinary store
+entries (``<weight>/lora_a`` [in, r] and ``<weight>/lora_b`` [r, out])
+living alongside the base weights in ONE params dict, so sharding rules,
+checkpointing, and the PS protocol all see a plain store.  The loss
+wrapper materializes W + scale*A@B per step (one rank-r matmul per
+adapted weight — negligible FLOPs) and hands the model a store it cannot
+distinguish from a dense one; the optimizer is masked so ONLY ``/lora_``
+entries update.  ``merge_lora`` collapses adapters into the base weights
+for serving/export — numerically identical to the adapted forward.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+A_SUFFIX = "/lora_a"
+B_SUFFIX = "/lora_b"
+
+# default adaptation targets: the attention q/v projections (the
+# original-paper recipe); members of TransformerConfig naming, matched
+# as name suffixes so layer prefixes and scan-stacked blocks both hit
+DEFAULT_TARGETS = ("attn/wq", "attn/wv")
+
+
+DEFAULT_ALPHA = 16.0
+
+
+def init_lora(params: Mapping[str, Array], rank: int = 8,
+              targets: Sequence[str] = DEFAULT_TARGETS,
+              rng: jax.Array | int = 0) -> dict[str, Array]:
+    """Return ``params`` + freshly-initialized adapter entries for every
+    2-D weight whose name ends with one of ``targets`` (scan-stacked
+    [L, in, out] blocks get per-layer factors [L, in, r] / [L, r, out]).
+    A is Gaussian / sqrt(in), B is zero — the adapted model starts
+    EXACTLY at the base model."""
+    if isinstance(rng, int):
+        rng = jax.random.key(rng)
+    matched = [name for name, w in params.items()
+               if name.endswith(tuple(targets)) and jnp.ndim(w) in (2, 3)]
+    if not matched:
+        raise ValueError(f"no parameters match LoRA targets {targets}; "
+                         f"store has e.g. {sorted(params)[:5]}")
+    out = dict(params)
+    for name in matched:
+        w = params[name]
+        rng, sub = jax.random.split(rng)
+        if w.ndim == 3:  # scan-stacked [L, in, out]
+            layers, d_in, d_out = w.shape
+            a_shape, b_shape = (layers, d_in, rank), (layers, rank, d_out)
+        else:
+            d_in, d_out = w.shape
+            a_shape, b_shape = (d_in, rank), (rank, d_out)
+        out[name + A_SUFFIX] = (jax.random.normal(sub, a_shape, w.dtype)
+                                / math.sqrt(d_in))
+        out[name + B_SUFFIX] = jnp.zeros(b_shape, w.dtype)
+    return out
+
+
+def lora_names(params: Mapping[str, Array]) -> list[str]:
+    return [n for n in params if n.endswith((A_SUFFIX, B_SUFFIX))]
+
+
+def _effective(params: Mapping[str, Array],
+               alpha: float) -> dict[str, Array]:
+    """Collapse adapters: {base + (alpha/r) * A @ B}, adapter entries
+    removed.  The rank is READ FROM the stored A factor (its trailing
+    dim), never passed — a rank argument that disagreed with the trained
+    factors would silently mis-scale the merge.  Works on stacked
+    [L, ...] factors via a batched matmul."""
+    eff = {}
+    for name, value in params.items():
+        if name.endswith((A_SUFFIX, B_SUFFIX)):
+            continue
+        a = params.get(name + A_SUFFIX)
+        if a is not None:
+            b = params[name + B_SUFFIX]
+            scale = alpha / a.shape[-1]
+            delta = jnp.einsum("...ir,...ro->...io", a, b) * scale
+            value = (value + delta).astype(value.dtype)
+        eff[name] = value
+    return eff
+
+
+def lora_loss(base_loss: Callable,
+              alpha: float = DEFAULT_ALPHA) -> Callable:
+    """Wrap a model's ``loss(params, batch)``: the wrapped function takes
+    the base+adapter store, materializes effective weights (rank read
+    from the factors themselves), and calls the model unchanged.
+    Differentiable end to end — gradients flow to A/B through the add;
+    pair with :func:`trainable_mask` so the optimizer freezes everything
+    else."""
+
+    def loss(params: Mapping[str, Array], batch):
+        return base_loss(_effective(params, alpha), batch)
+
+    return loss
+
+
+def trainable_mask(params: Mapping[str, Array]) -> dict[str, bool]:
+    """True for adapter entries, False for frozen base weights — the
+    shape optax.masked expects (matching the params dict)."""
+    return {name: name.endswith((A_SUFFIX, B_SUFFIX)) for name in params}
+
+
+def freeze_base(optimizer):
+    """Wrap an optax optimizer so base weights are frozen: updates apply
+    to ``/lora_`` entries only, and no optimizer state is allocated for
+    the (much larger) base store."""
+    import optax
+
+    return optax.multi_transform(
+        {"train": optimizer, "freeze": optax.set_to_zero()},
+        lambda params: {name: ("train" if name.endswith((A_SUFFIX, B_SUFFIX))
+                               else "freeze")
+                        for name in params})
+
+
+def merge_lora(params: Mapping[str, Array],
+               alpha: float = DEFAULT_ALPHA) -> dict[str, Array]:
+    """Export: fold adapters into the base weights permanently (rank read
+    from the stored factors — only alpha must match training).  The
+    returned plain store serves/saves/converts (models/hf.to_hf_*)
+    exactly like any dense checkpoint, and its forward equals the
+    adapted model's."""
+    return _effective(params, alpha)
+
+
+def split_rank_alpha(spec: str) -> tuple[int, float]:
+    """Parse the CLI's ``--lora=R[:ALPHA]`` spec (alpha defaults 2*R,
+    the common heuristic)."""
+    m = re.fullmatch(r"(\d+)(?::([\d.]+))?", spec)
+    if not m:
+        raise ValueError(f"--lora expects R or R:ALPHA, got {spec!r}")
+    rank = int(m.group(1))
+    if rank < 1:
+        raise ValueError(f"LoRA rank must be >= 1, got {rank}")
+    alpha = float(m.group(2)) if m.group(2) else 2.0 * rank
+    return rank, alpha

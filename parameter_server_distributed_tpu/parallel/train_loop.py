@@ -59,6 +59,12 @@ class TrainLoopConfig:
                                      # tri-state like remat
     remat_policy: str = ""        # "" = model default | full | dots
                                   # (what remat may keep; flagship LMs)
+    lora: str = ""                # "R" or "R:ALPHA" = LoRA fine-tune:
+                                  # only rank-R adapters train, base
+                                  # weights frozen (models/lora.py)
+    init_ckpt_dir: str = ""       # load params (only) from this sharded
+                                  # checkpoint dir before training — the
+                                  # pretrained-base fine-tune flow
     steps: int = 100
     optimizer: str = "adam"
     learning_rate: float = 1e-3
@@ -166,16 +172,52 @@ def run_training(config: TrainLoopConfig) -> dict:
             raise ValueError(
                 f"--mesh pipe axis applies to transformer models; "
                 f"{config.model!r} is not one")
+    loss_fn = model.loss
+    init_params = model.init_params(config.seed)
+    optimizer = make_optimizer(config.optimizer, config.learning_rate,
+                               schedule=config.schedule,
+                               warmup_steps=config.warmup_steps,
+                               total_steps=config.steps,
+                               clip_norm=config.clip_norm)
+    if config.init_ckpt_dir:
+        # start from a PRETRAINED store (params only — fresh optimizer):
+        # the dense-checkpoint -> fine-tune flow, incl. converted HF
+        # checkpoints saved by checkpoint/sharded.  --resume, by
+        # contrast, restores the full TrainState of the SAME run shape.
+        last, restored = sharded_ckpt.restore_latest(config.init_ckpt_dir)
+        if last is None:
+            raise FileNotFoundError(
+                f"--init-ckpt-dir: no step_N checkpoints under "
+                f"{config.init_ckpt_dir!r}")
+        init_params = (restored["params"] if isinstance(restored, dict)
+                       else restored.params)
+        log.info("initialized params from %s step %d",
+                 config.init_ckpt_dir, last)
+    if config.lora:
+        # parameter-efficient fine-tuning: adapters join the store as
+        # plain entries (sharding/checkpointing unchanged), the loss
+        # materializes effective weights per step, and the optimizer is
+        # masked so ONLY /lora_ entries train (models/lora.py)
+        from ..models.lora import (freeze_base, init_lora, lora_loss,
+                                   lora_names, split_rank_alpha)
+        rank, alpha = split_rank_alpha(config.lora)
+        if getattr(model, "value_and_grad", None) is not None:
+            raise ValueError("--lora does not compose with pipeline "
+                             "parallelism yet (the pipe schedule owns its "
+                             "grad function)")
+        init_params = init_lora(init_params, rank=rank,
+                                rng=config.seed + 1)
+        loss_fn = lora_loss(model.loss, alpha=alpha)
+        optimizer = freeze_base(optimizer)
+        log.info("LoRA fine-tuning: rank %d alpha %.1f — %d adapter "
+                 "tensors train, base frozen", rank, alpha,
+                 len(lora_names(init_params)))
     trainer = ShardedTrainer(
-        model.loss, mesh, _pick_rule(config.model, mesh),
-        make_optimizer(config.optimizer, config.learning_rate,
-                       schedule=config.schedule,
-                       warmup_steps=config.warmup_steps,
-                       total_steps=config.steps,
-                       clip_norm=config.clip_norm),
+        loss_fn, mesh, _pick_rule(config.model, mesh),
+        optimizer,
         accum_steps=config.accum_steps,
         grad_fn=getattr(model, "value_and_grad", None))
-    state = trainer.init_state(model.init_params(config.seed))
+    state = trainer.init_state(init_params)
 
     start_step = 0
     if config.resume and config.checkpoint_dir:

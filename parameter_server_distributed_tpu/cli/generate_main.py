@@ -33,14 +33,38 @@ import sys
 from ..config import parse_argv
 
 
-def draft_ckpt_flags(path: str) -> dict:
+def draft_ckpt_flags(path: str, lora_alpha: str = "") -> dict:
     """--draft-ckpt accepts either checkpoint form: a single-file host
     checkpoint (reference binary codec) or a sharded checkpoint DIRECTORY
     (what --ckpt-dir training runs write) — dispatch by what the path is,
-    into the flag load_params reads for that form."""
+    into the flag load_params reads for that form.  ``lora_alpha``
+    (--draft-lora-alpha: the draft may be LoRA-trained with a DIFFERENT
+    alpha than the target) forwards to the merge-on-load."""
     import os
 
-    return {"ckpt-dir": path} if os.path.isdir(path) else {"ckpt": path}
+    out = {"ckpt-dir": path} if os.path.isdir(path) else {"ckpt": path}
+    if lora_alpha:
+        out["lora-alpha"] = lora_alpha
+    return out
+
+
+def _merge_if_lora(params, flags: dict, what: str):
+    """A checkpoint written by a --lora run carries adapter entries; fold
+    them into dense weights before serving.  alpha must MATCH training
+    (it scales the adapters), so it is demanded explicitly rather than
+    silently defaulted."""
+    from ..models.lora import lora_names, merge_lora
+
+    if not lora_names(params):
+        return params, what
+    if not flags.get("lora-alpha"):
+        raise SystemExit(
+            f"{what} contains LoRA adapters; pass --lora-alpha=A (the "
+            f"ALPHA the run trained with, e.g. --lora=8:16 -> 16) to "
+            f"merge them for serving")
+    alpha = float(flags["lora-alpha"])
+    return (merge_lora(params, alpha=alpha),
+            f"{what} (LoRA merged, alpha {alpha:g})")
 
 
 def load_params(flags: dict, model, seed: int):
@@ -48,7 +72,9 @@ def load_params(flags: dict, model, seed: int):
     if flags.get("ckpt"):
         from ..checkpoint import codec
         epoch, iteration, params = codec.load(flags["ckpt"])
-        return params, f"host checkpoint {flags['ckpt']} (iter {iteration})"
+        return _merge_if_lora(
+            params, flags,
+            f"host checkpoint {flags['ckpt']} (iter {iteration})")
     if flags.get("ckpt-dir"):
         from ..checkpoint import sharded as sc
         avg_k = int(flags.get("avg-last", 0))
@@ -56,6 +82,16 @@ def load_params(flags: dict, model, seed: int):
             have = min(avg_k, len(sc._committed_steps(flags["ckpt-dir"])))
             step, state = sc.average_checkpoints(flags["ckpt-dir"], avg_k)
             what = f"average of last {have} checkpoints (newest step {step})"
+            p = state["params"] if isinstance(state, dict) else state.params
+            from ..models.lora import lora_names
+            if lora_names(p):
+                # averaging A and B independently then merging computes
+                # W + s*mean(A)@mean(B), which equals NONE of the
+                # averaged models (the product is nonlinear in (A, B))
+                raise SystemExit(
+                    "--avg-last cannot average LoRA checkpoints (A@B is "
+                    "nonlinear in the factors); merge each checkpoint "
+                    "first (models.lora.merge_lora) or drop --avg-last")
         else:
             step, state = sc.restore_latest(flags["ckpt-dir"])
             what = f"sharded checkpoint step {step}"
@@ -63,7 +99,7 @@ def load_params(flags: dict, model, seed: int):
             raise FileNotFoundError(
                 f"no step_N checkpoints under {flags['ckpt-dir']!r}")
         params = state["params"] if isinstance(state, dict) else state.params
-        return params, what
+        return _merge_if_lora(params, flags, what)
     return model.init_params(seed), f"fresh init (seed {seed})"
 
 
@@ -84,7 +120,8 @@ def match_layout(model, params):
 KNOWN_FLAGS = frozenset({
     "model", "dtype", "scan-layers", "no-scan-layers", "seed", "ckpt",
     "ckpt-dir", "avg-last", "tokens", "prompt", "top-k", "top-p", "beam",
-    "temperature", "max-new", "draft-model", "draft-ckpt", "draft-seed",
+    "temperature", "max-new", "lora-alpha", "draft-lora-alpha",
+    "draft-model", "draft-ckpt", "draft-seed",
     "draft-len", "length-penalty", "hf-gpt2",
 })
 
@@ -120,6 +157,12 @@ def main(argv: list[str] | None = None) -> int:
     if "help" in flags:
         print(__doc__)
         return 0
+    for bare in ("--lora-alpha", "--draft-lora-alpha"):
+        if bare in argv:
+            # parse_argv maps a bare flag to "1": merging with alpha 1
+            # instead of the trained value silently mis-scales adapters
+            raise SystemExit(f"{bare} requires an explicit value "
+                             f"(the ALPHA the run trained with)")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
         # same contract as pst-train: a typo'd flag silently falling back
@@ -200,7 +243,8 @@ def main(argv: list[str] | None = None) -> int:
         if not isinstance(draft, Transformer):
             raise ValueError(f"--draft-model={draft_name!r} is not an LM")
         dparams, dsource = load_params(
-            draft_ckpt_flags(flags.get("draft-ckpt", "")), draft,
+            draft_ckpt_flags(flags.get("draft-ckpt", ""),
+                             flags.get("draft-lora-alpha", "")), draft,
             int(flags.get("draft-seed", seed + 1)))
         dparams = match_layout(draft, dparams)
         print(f"draft params: {dsource}", file=sys.stderr)

@@ -43,6 +43,7 @@ KNOWN_FLAGS = frozenset({
     "model", "dtype", "scan-layers", "no-scan-layers", "seed", "ckpt",
     "ckpt-dir", "avg-last", "hf-gpt2", "slots", "max-len", "temperature",
     "top-k", "top-p", "eos", "quant", "kv-cache", "default-max-new",
+    "lora-alpha", "draft-lora-alpha",
     "draft-model", "draft-ckpt", "draft-seed", "draft-len",
 })
 
@@ -84,6 +85,12 @@ def main(argv: list[str] | None = None) -> int:
     if "help" in flags:
         print(__doc__)
         return 0
+    for bare in ("--lora-alpha", "--draft-lora-alpha"):
+        if bare in argv:
+            # parse_argv maps a bare flag to "1": merging with alpha 1
+            # instead of the trained value silently mis-scales adapters
+            raise SystemExit(f"{bare} requires an explicit value "
+                             f"(the ALPHA the run trained with)")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
         raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
@@ -132,7 +139,8 @@ def main(argv: list[str] | None = None) -> int:
                              "is not an LM")
         from .generate_main import draft_ckpt_flags
         dparams, dsource = load_params(
-            draft_ckpt_flags(flags.get("draft-ckpt", "")), draft,
+            draft_ckpt_flags(flags.get("draft-ckpt", ""),
+                             flags.get("draft-lora-alpha", "")), draft,
             int(flags.get("draft-seed", int(flags.get("seed", 0)) + 1)))
         dparams = match_layout(draft, dparams)
         print(f"draft: {dsource}", file=sys.stderr)

@@ -193,6 +193,18 @@ def run_training(config: TrainLoopConfig) -> dict:
                        else restored.params)
         log.info("initialized params from %s step %d",
                  config.init_ckpt_dir, last)
+        from ..models.lora import lora_names
+        if lora_names(init_params):
+            # explicit over silent: with --lora, init_lora would OVERWRITE
+            # the trained factors with fresh init; without it, the plain
+            # loss never reads them and the run trains the base model
+            # while the inert adapters still get optimizer state
+            raise ValueError(
+                f"--init-ckpt-dir store already contains LoRA adapters; "
+                f"to continue that fine-tune use --resume "
+                f"--ckpt-dir={config.init_ckpt_dir}, or merge first "
+                f"(models.lora.merge_lora) to start a fresh run from the "
+                f"adapted weights")
     if config.lora:
         # parameter-efficient fine-tuning: adapters join the store as
         # plain entries (sharding/checkpointing unchanged), the loss

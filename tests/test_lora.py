@@ -152,6 +152,66 @@ def test_train_loop_lora_on_mesh(tmp_path):
     assert np.isfinite(summary["final_loss"])
 
 
+def test_generate_cli_merges_lora_checkpoint(tmp_path, rng):
+    """pst-generate on a --lora checkpoint: refuses without --lora-alpha
+    (the scale must match training), merges and decodes with it."""
+    import os
+    import subprocess
+    import sys
+
+    from parameter_server_distributed_tpu.parallel.train_loop import (
+        TrainLoopConfig, run_training)
+
+    ckpt = str(tmp_path / "ft")
+    run_training(TrainLoopConfig(
+        model="tiny_lm", batch_size=4, steps=2, optimizer="adam",
+        learning_rate=1e-2, lora="2:4", checkpoint_dir=ckpt,
+        checkpoint_every=2, log_every=2))
+    env = dict(os.environ, PSDT_PLATFORM="cpu")
+    base = [sys.executable, "-m",
+            "parameter_server_distributed_tpu.cli.generate_main",
+            "--model=tiny_lm", f"--ckpt-dir={ckpt}", "--tokens=1,2,3",
+            "--max-new=3"]
+    refused = subprocess.run(base, capture_output=True, text=True, env=env,
+                             timeout=300)
+    assert refused.returncode != 0
+    assert "lora-alpha" in refused.stderr + refused.stdout
+    merged = subprocess.run(base + ["--lora-alpha=4"], capture_output=True,
+                            text=True, env=env, timeout=300)
+    assert merged.returncode == 0, merged.stderr[-1500:]
+    assert "LoRA merged" in merged.stderr
+    # bare --lora-alpha would silently mean alpha=1 — rejected
+    bare = subprocess.run(base + ["--lora-alpha"], capture_output=True,
+                          text=True, env=env, timeout=300)
+    assert bare.returncode != 0
+    assert "explicit value" in bare.stderr + bare.stdout
+    # --avg-last over LoRA checkpoints is nonlinear in the factors
+    avg = subprocess.run(base + ["--lora-alpha=4", "--avg-last=2"],
+                         capture_output=True, text=True, env=env,
+                         timeout=300)
+    assert avg.returncode != 0
+    assert "nonlinear" in avg.stderr + avg.stdout
+
+
+def test_init_ckpt_dir_rejects_adapter_store(tmp_path):
+    """--init-ckpt-dir pointing at a LoRA run errors explicitly: with
+    --lora it would overwrite trained factors, without it the adapters
+    would ride along inert."""
+    from parameter_server_distributed_tpu.parallel.train_loop import (
+        TrainLoopConfig, run_training)
+
+    ckpt = str(tmp_path / "ft")
+    run_training(TrainLoopConfig(
+        model="tiny_lm", batch_size=4, steps=2, optimizer="adam",
+        learning_rate=1e-2, lora="2:4", checkpoint_dir=ckpt,
+        checkpoint_every=2, log_every=2))
+    for lora in ("2:4", ""):
+        with pytest.raises(ValueError, match="already contains LoRA"):
+            run_training(TrainLoopConfig(
+                model="tiny_lm", batch_size=4, steps=2, lora=lora,
+                init_ckpt_dir=ckpt, log_every=2))
+
+
 def test_spec_parsing_and_errors():
     assert split_rank_alpha("8") == (8, 16.0)
     assert split_rank_alpha("4:32") == (4, 32.0)

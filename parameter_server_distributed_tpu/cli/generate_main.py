@@ -30,7 +30,7 @@ from __future__ import annotations
 import logging
 import sys
 
-from ..config import parse_argv
+from ..config import parse_argv, require_flag_value
 
 
 def draft_ckpt_flags(path: str, lora_alpha: str = "") -> dict:
@@ -45,6 +45,9 @@ def draft_ckpt_flags(path: str, lora_alpha: str = "") -> dict:
     out = {"ckpt-dir": path} if os.path.isdir(path) else {"ckpt": path}
     if lora_alpha:
         out["lora-alpha"] = lora_alpha
+    # internal marker so a missing-alpha error names the flag that
+    # actually reaches this dict (load_params -> _merge_if_lora)
+    out["lora-flag-name"] = "--draft-lora-alpha"
     return out
 
 
@@ -52,14 +55,18 @@ def _merge_if_lora(params, flags: dict, what: str):
     """A checkpoint written by a --lora run carries adapter entries; fold
     them into dense weights before serving.  alpha must MATCH training
     (it scales the adapters), so it is demanded explicitly rather than
-    silently defaulted."""
+    silently defaulted.  The error names the flag that actually feeds
+    this dict: the DRAFT checkpoint's merge is fed by
+    --draft-lora-alpha (draft_ckpt_flags sets the marker), not
+    --lora-alpha."""
     from ..models.lora import lora_names, merge_lora
 
     if not lora_names(params):
         return params, what
     if not flags.get("lora-alpha"):
+        flag_name = flags.get("lora-flag-name", "--lora-alpha")
         raise SystemExit(
-            f"{what} contains LoRA adapters; pass --lora-alpha=A (the "
+            f"{what} contains LoRA adapters; pass {flag_name}=A (the "
             f"ALPHA the run trained with, e.g. --lora=8:16 -> 16) to "
             f"merge them for serving")
     alpha = float(flags["lora-alpha"])
@@ -82,16 +89,6 @@ def load_params(flags: dict, model, seed: int):
             have = min(avg_k, len(sc._committed_steps(flags["ckpt-dir"])))
             step, state = sc.average_checkpoints(flags["ckpt-dir"], avg_k)
             what = f"average of last {have} checkpoints (newest step {step})"
-            p = state["params"] if isinstance(state, dict) else state.params
-            from ..models.lora import lora_names
-            if lora_names(p):
-                # averaging A and B independently then merging computes
-                # W + s*mean(A)@mean(B), which equals NONE of the
-                # averaged models (the product is nonlinear in (A, B))
-                raise SystemExit(
-                    "--avg-last cannot average LoRA checkpoints (A@B is "
-                    "nonlinear in the factors); merge each checkpoint "
-                    "first (models.lora.merge_lora) or drop --avg-last")
         else:
             step, state = sc.restore_latest(flags["ckpt-dir"])
             what = f"sharded checkpoint step {step}"
@@ -99,6 +96,16 @@ def load_params(flags: dict, model, seed: int):
             raise FileNotFoundError(
                 f"no step_N checkpoints under {flags['ckpt-dir']!r}")
         params = state["params"] if isinstance(state, dict) else state.params
+        if avg_k > 1:
+            from ..models.lora import lora_names
+            if lora_names(params):
+                # averaging A and B independently then merging computes
+                # W + s*mean(A)@mean(B), which equals NONE of the
+                # averaged models (the product is nonlinear in (A, B))
+                raise SystemExit(
+                    "--avg-last cannot average LoRA checkpoints (A@B is "
+                    "nonlinear in the factors); merge each checkpoint "
+                    "first (models.lora.merge_lora) or drop --avg-last")
         return _merge_if_lora(params, flags, what)
     return model.init_params(seed), f"fresh init (seed {seed})"
 
@@ -157,12 +164,9 @@ def main(argv: list[str] | None = None) -> int:
     if "help" in flags:
         print(__doc__)
         return 0
-    for bare in ("--lora-alpha", "--draft-lora-alpha"):
-        if bare in argv:
-            # parse_argv maps a bare flag to "1": merging with alpha 1
-            # instead of the trained value silently mis-scales adapters
-            raise SystemExit(f"{bare} requires an explicit value "
-                             f"(the ALPHA the run trained with)")
+    # bare --lora-alpha would merge with alpha 1 instead of the trained
+    # value, silently mis-scaling every adapter
+    require_flag_value(argv, "--lora-alpha", "--draft-lora-alpha")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
         # same contract as pst-train: a typo'd flag silently falling back

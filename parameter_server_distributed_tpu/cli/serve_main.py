@@ -37,7 +37,7 @@ import queue
 import sys
 import threading
 
-from ..config import parse_argv
+from ..config import parse_argv, require_flag_value
 
 KNOWN_FLAGS = frozenset({
     "model", "dtype", "scan-layers", "no-scan-layers", "seed", "ckpt",
@@ -85,12 +85,9 @@ def main(argv: list[str] | None = None) -> int:
     if "help" in flags:
         print(__doc__)
         return 0
-    for bare in ("--lora-alpha", "--draft-lora-alpha"):
-        if bare in argv:
-            # parse_argv maps a bare flag to "1": merging with alpha 1
-            # instead of the trained value silently mis-scales adapters
-            raise SystemExit(f"{bare} requires an explicit value "
-                             f"(the ALPHA the run trained with)")
+    # bare --lora-alpha would merge with alpha 1 instead of the trained
+    # value, silently mis-scaling every adapter
+    require_flag_value(argv, "--lora-alpha", "--draft-lora-alpha")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
         raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "

@@ -45,26 +45,21 @@ def draft_ckpt_flags(path: str, lora_alpha: str = "") -> dict:
     out = {"ckpt-dir": path} if os.path.isdir(path) else {"ckpt": path}
     if lora_alpha:
         out["lora-alpha"] = lora_alpha
-    # internal marker so a missing-alpha error names the flag that
-    # actually reaches this dict (load_params -> _merge_if_lora)
-    out["lora-flag-name"] = "--draft-lora-alpha"
     return out
 
 
-def _merge_if_lora(params, flags: dict, what: str):
+def _merge_if_lora(params, flags: dict, what: str,
+                   flag_name: str = "--lora-alpha"):
     """A checkpoint written by a --lora run carries adapter entries; fold
     them into dense weights before serving.  alpha must MATCH training
     (it scales the adapters), so it is demanded explicitly rather than
-    silently defaulted.  The error names the flag that actually feeds
-    this dict: the DRAFT checkpoint's merge is fed by
-    --draft-lora-alpha (draft_ckpt_flags sets the marker), not
-    --lora-alpha."""
+    silently defaulted.  ``flag_name`` is the user-facing flag that
+    feeds this dict — --draft-lora-alpha for a DRAFT checkpoint."""
     from ..models.lora import lora_names, merge_lora
 
     if not lora_names(params):
         return params, what
     if not flags.get("lora-alpha"):
-        flag_name = flags.get("lora-flag-name", "--lora-alpha")
         raise SystemExit(
             f"{what} contains LoRA adapters; pass {flag_name}=A (the "
             f"ALPHA the run trained with, e.g. --lora=8:16 -> 16) to "
@@ -74,14 +69,18 @@ def _merge_if_lora(params, flags: dict, what: str):
             f"{what} (LoRA merged, alpha {alpha:g})")
 
 
-def load_params(flags: dict, model, seed: int):
-    """Resolve the parameter source; returns (params, description)."""
+def load_params(flags: dict, model, seed: int,
+                lora_flag: str = "--lora-alpha"):
+    """Resolve the parameter source; returns (params, description).
+    ``lora_flag`` names the user-facing alpha flag in merge errors
+    (draft call sites pass --draft-lora-alpha)."""
     if flags.get("ckpt"):
         from ..checkpoint import codec
         epoch, iteration, params = codec.load(flags["ckpt"])
         return _merge_if_lora(
             params, flags,
-            f"host checkpoint {flags['ckpt']} (iter {iteration})")
+            f"host checkpoint {flags['ckpt']} (iter {iteration})",
+            lora_flag)
     if flags.get("ckpt-dir"):
         from ..checkpoint import sharded as sc
         avg_k = int(flags.get("avg-last", 0))
@@ -106,7 +105,7 @@ def load_params(flags: dict, model, seed: int):
                     "--avg-last cannot average LoRA checkpoints (A@B is "
                     "nonlinear in the factors); merge each checkpoint "
                     "first (models.lora.merge_lora) or drop --avg-last")
-        return _merge_if_lora(params, flags, what)
+        return _merge_if_lora(params, flags, what, lora_flag)
     return model.init_params(seed), f"fresh init (seed {seed})"
 
 
@@ -166,7 +165,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     # bare --lora-alpha would merge with alpha 1 instead of the trained
     # value, silently mis-scaling every adapter
-    require_flag_value(argv, "--lora-alpha", "--draft-lora-alpha")
+    require_flag_value(argv, "--lora-alpha", "--draft-lora-alpha",
+                       hint="the ALPHA the run trained with")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
         # same contract as pst-train: a typo'd flag silently falling back
@@ -249,7 +249,8 @@ def main(argv: list[str] | None = None) -> int:
         dparams, dsource = load_params(
             draft_ckpt_flags(flags.get("draft-ckpt", ""),
                              flags.get("draft-lora-alpha", "")), draft,
-            int(flags.get("draft-seed", seed + 1)))
+            int(flags.get("draft-seed", seed + 1)),
+            lora_flag="--draft-lora-alpha")
         dparams = match_layout(draft, dparams)
         print(f"draft params: {dsource}", file=sys.stderr)
         # whole-loop-on-device batched decoder (accept/resample jitted,

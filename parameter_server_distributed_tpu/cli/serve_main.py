@@ -87,7 +87,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     # bare --lora-alpha would merge with alpha 1 instead of the trained
     # value, silently mis-scaling every adapter
-    require_flag_value(argv, "--lora-alpha", "--draft-lora-alpha")
+    require_flag_value(argv, "--lora-alpha", "--draft-lora-alpha",
+                       hint="the ALPHA the run trained with")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
         raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
@@ -138,7 +139,8 @@ def main(argv: list[str] | None = None) -> int:
         dparams, dsource = load_params(
             draft_ckpt_flags(flags.get("draft-ckpt", ""),
                              flags.get("draft-lora-alpha", "")), draft,
-            int(flags.get("draft-seed", int(flags.get("seed", 0)) + 1)))
+            int(flags.get("draft-seed", int(flags.get("seed", 0)) + 1)),
+            lora_flag="--draft-lora-alpha")
         dparams = match_layout(draft, dparams)
         print(f"draft: {dsource}", file=sys.stderr)
         spec_kwargs = dict(

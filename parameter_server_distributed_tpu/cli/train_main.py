@@ -70,7 +70,7 @@ import json
 import logging
 import sys
 
-from ..config import MeshConfig, parse_argv
+from ..config import MeshConfig, parse_argv, require_flag_value
 
 
 def parse_mesh(spec: str) -> MeshConfig:
@@ -120,12 +120,11 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
                          f"--help lists the accepted flags")
 
-    if "--lora" in argv:
-        # parse_argv maps a bare --lora to "1", which would silently run
-        # a near-useless rank-1 adapter; demand the explicit spec
-        # (--lora=1 stays a deliberate rank-1 choice)
-        raise SystemExit("--lora requires an explicit spec, e.g. "
-                         "--lora=8 or --lora=8:16")
+    # a bare --lora would silently run a near-useless rank-1 adapter
+    # (parse_argv's "1" sentinel); --lora=1 stays a deliberate choice
+    require_flag_value(argv, "--lora",
+                       hint="the R[:ALPHA] spec, e.g. --lora=8 or "
+                            "--lora=8:16")
     if "coordinator" in flags or int(flags.get("num-processes", 1)) > 1:
         from ..parallel.distributed import initialize_multihost
         initialize_multihost(

@@ -160,15 +160,17 @@ def parse_argv(argv: Sequence[str]) -> tuple[list[str], dict[str, str]]:
     return positional, flags
 
 
-def require_flag_value(argv: Sequence[str], *names: str) -> None:
+def require_flag_value(argv: Sequence[str], *names: str,
+                       hint: str = "") -> None:
     """Reject bare value-flags: :func:`parse_argv` maps ``--k`` (no "=")
     to the string "1", which for flags like ``--lora-alpha`` would
     silently substitute a wrong value instead of failing loudly.  Call
-    with the raw argv and the ``--name`` spellings to demand."""
+    with the raw argv and the ``--name`` spellings to demand; ``hint``
+    tells the user WHAT value belongs there."""
     for name in names:
         if name in argv:
             raise SystemExit(f"{name} requires an explicit value "
-                             f"({name}=...)")
+                             f"({name}=...{f' — {hint}' if hint else ''})")
 
 
 def parse_host_port(addr: str, default_port: int) -> tuple[str, int]:

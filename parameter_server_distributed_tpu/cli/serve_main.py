@@ -4,7 +4,8 @@ face of models/serving.DecodeServer.
     pst-serve --model=small_lm [--ckpt=... | --ckpt-dir=... |
               --hf-gpt2=<checkout>] \\
               [--slots=8] [--max-len=2048] [--temperature=0.8 --top-k=40] \\
-              [--quant=int8] [--kv-cache=int8] [--eos=ID]
+              [--quant=int8] [--kv-cache=int8] [--eos=ID] \\
+              [--prompt-cache=N]   # repeated prompts skip prefill (LRU)
 
 Line protocol (JSONL on stdin/stdout — composable behind any transport):
 
@@ -43,7 +44,7 @@ KNOWN_FLAGS = frozenset({
     "model", "dtype", "scan-layers", "no-scan-layers", "seed", "ckpt",
     "ckpt-dir", "avg-last", "hf-gpt2", "slots", "max-len", "temperature",
     "top-k", "top-p", "eos", "quant", "kv-cache", "default-max-new",
-    "lora-alpha", "draft-lora-alpha",
+    "lora-alpha", "draft-lora-alpha", "prompt-cache",
     "draft-model", "draft-ckpt", "draft-seed", "draft-len",
 })
 
@@ -156,6 +157,9 @@ def main(argv: list[str] | None = None) -> int:
         eos_id=eos,
         cache_dtype=("int8" if flags.get("kv-cache", "") == "int8"
                      else "native"),
+        # --prompt-cache=N: repeated prompts skip the prefill forward
+        # (LRU of N prompts' logits + K/V rows; 0 = off)
+        prompt_cache=int(flags.get("prompt-cache", "0")),
         seed=int(flags.get("seed", 0)), **spec_kwargs)
     default_max_new = int(flags.get("default-max-new", "64"))
 

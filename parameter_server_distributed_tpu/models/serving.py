@@ -32,6 +32,7 @@ weight-quantized ``params`` store works unchanged.
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
 from functools import partial
 from typing import Any, Mapping
 
@@ -290,7 +291,7 @@ class DecodeServer:
                  cache_dtype: str = "native", seed: int = 0,
                  mesh=None, param_rule=None,
                  draft: Transformer | None = None, draft_params=None,
-                 draft_len: int = 4):
+                 draft_len: int = 4, prompt_cache: int = 0):
         """``mesh`` turns on multi-chip serving: params are placed under
         ``param_rule`` (default: models.transformer.transformer_rule —
         Megatron TP columns/rows + fsdp) and the slot cache is sharded
@@ -309,7 +310,23 @@ class DecodeServer:
         ``temperature>0`` applies the Leviathan/Chen rejection rule,
         preserving the target's sampling distribution (tested
         empirically); top_k/top_p do not combine.  The draft shares the
-        cache dtype and mesh."""
+        cache dtype and mesh.
+
+        ``prompt_cache`` > 0 keeps the prefill results (final-position
+        logits + the prompt's K/V row, and the draft's row in
+        speculative mode) of the last N distinct prompts.  The key is
+        the EXACT full prompt — an identical resubmission (a retry, a
+        repeated canned query, a fixed prompt fanned out over sampling
+        settings) skips the prefill forward entirely and only splices;
+        a shared prefix with a different suffix is a MISS (this is
+        whole-prompt caching, not vLLM-style prefix reuse).
+        Token-exact: the cached row is exactly what the prefill would
+        recompute (params are fixed for the server's lifetime), and the
+        first token is re-sampled per request, so per-request
+        temperature still applies.  Entries pin device memory."""
+        if prompt_cache < 0:
+            raise ValueError(f"prompt_cache must be >= 0, "
+                             f"got {prompt_cache}")
         self.model = model
         self.slots = slots
         self.max_len = max_len
@@ -336,6 +353,11 @@ class DecodeServer:
         self._n_retired = 0
         self._spec_proposed = 0
         self._spec_accepted = 0
+        # prompt -> (last_logits, kv_row, draft_row|None), LRU-bounded;
+        # entries pin device memory, so the cap is the knob
+        self.prompt_cache_size = prompt_cache
+        self._prompt_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._prompt_hits = 0
         self._rng = jax.random.key(seed)
         self._step = _step_runner(model, slots, top_k, top_p, cache_dtype)
         self._temperature = temperature
@@ -437,11 +459,33 @@ class DecodeServer:
         check_position_budget(self.model, real_len,
                               max_new_tokens + slack)
         bucket = min(_bucket(real_len), self.max_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :real_len] = prompt
-        last, row = _prefill_runner(self.model, bucket, self.cache_dtype)(
-            self.params, jnp.asarray(padded),
-            jnp.asarray(real_len, jnp.int32))
+        if self.draft is not None:
+            check_position_budget(self.draft, real_len,
+                                  max_new_tokens + slack)
+        pkey = tuple(int(t) for t in prompt)
+        hit = (self._prompt_cache.get(pkey)
+               if self.prompt_cache_size else None)
+        if hit is not None:
+            self._prompt_cache.move_to_end(pkey)  # LRU touch
+            self._prompt_hits += 1
+            last, row, d_row = hit
+        else:
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :real_len] = prompt
+            last, row = _prefill_runner(self.model, bucket,
+                                        self.cache_dtype)(
+                self.params, jnp.asarray(padded),
+                jnp.asarray(real_len, jnp.int32))
+            d_row = None
+            if self.draft is not None:
+                _, d_row = _prefill_runner(self.draft, bucket,
+                                           self.cache_dtype)(
+                    self.draft_params, jnp.asarray(padded),
+                    jnp.asarray(real_len, jnp.int32))
+            if self.prompt_cache_size:
+                self._prompt_cache[pkey] = (last, row, d_row)
+                while len(self._prompt_cache) > self.prompt_cache_size:
+                    self._prompt_cache.popitem(last=False)
         req_temp = self._temperature if temperature is None else temperature
         self._rng, sub = jax.random.split(self._rng)
         first = int(sample_token(last[None], sub, req_temp,
@@ -449,12 +493,6 @@ class DecodeServer:
         self._cache = _splice_runner(self.model, bucket, self.cache_dtype)(
             self._cache, row, jnp.asarray(slot, jnp.int32))
         if self.draft is not None:
-            check_position_budget(self.draft, real_len,
-                                  max_new_tokens + slack)
-            _, d_row = _prefill_runner(self.draft, bucket,
-                                       self.cache_dtype)(
-                self.draft_params, jnp.asarray(padded),
-                jnp.asarray(real_len, jnp.int32))
             self._d_cache = _splice_runner(self.draft, bucket,
                                            self.cache_dtype)(
                 self._d_cache, d_row, jnp.asarray(slot, jnp.int32))
@@ -570,6 +608,8 @@ class DecodeServer:
             "requests_admitted": self._n_requests,
             "requests_completed": self._n_retired,
         }
+        if self.prompt_cache_size:
+            out["prompt_cache_hits"] = self._prompt_hits
         if self.draft is not None:
             out["draft_accept_rate"] = (
                 self._spec_accepted / self._spec_proposed

@@ -101,6 +101,63 @@ def test_eos_frees_slot_early(rng):
     assert srv._free_slot() is not None
 
 
+def test_prompt_cache_token_exact_and_lru(rng):
+    """A repeated prompt served from the prompt cache decodes EXACTLY the
+    tokens of an uncached server; distinct prompts evict LRU-style; the
+    hit counter surfaces in stats; a negative cap is rejected."""
+    model = tiny()
+    params = model.init_params(0)
+    prompts = [list(rng.integers(0, 96, n)) for n in (6, 9, 13)]
+    plain = DecodeServer(model, params, slots=2, max_len=64)
+    expect = {}
+    for i, p in enumerate(prompts):
+        rid = plain.submit(p, max_new_tokens=5)
+        expect[i] = plain.run_to_completion()[rid]
+
+    srv = DecodeServer(model, params, slots=2, max_len=64, prompt_cache=2)
+    # each prompt twice: second submit of each must hit the cache
+    for i, p in enumerate(prompts[:2]):
+        for _ in range(2):
+            rid = srv.submit(p, max_new_tokens=5)
+            assert srv.run_to_completion()[rid] == expect[i]
+    assert srv.stats["prompt_cache_hits"] == 2
+    # cap 2: admitting a 3rd distinct prompt evicts the LRU entry
+    rid = srv.submit(prompts[2], max_new_tokens=5)
+    assert srv.run_to_completion()[rid] == expect[2]
+    assert len(srv._prompt_cache) == 2
+    # the evicted prompt (prompts[0] — least recently used) misses again
+    hits_before = srv._prompt_hits
+    rid = srv.submit(prompts[0], max_new_tokens=5)
+    assert srv.run_to_completion()[rid] == expect[0]
+    assert srv._prompt_hits == hits_before
+    with pytest.raises(ValueError, match="prompt_cache"):
+        DecodeServer(model, params, slots=2, max_len=64, prompt_cache=-1)
+
+
+def test_prompt_cache_speculative_and_int8(rng):
+    """The cache composes with speculative mode (draft row cached too)
+    and the int8 KV cache — hits stay token-exact in both."""
+    model = tiny()
+    params = model.init_params(0)
+    prompt = list(rng.integers(0, 96, 7))
+    ref = reference(model, params, prompt, 6)
+    srv = DecodeServer(model, params, slots=2, max_len=64,
+                       draft=model, draft_params=params, draft_len=2,
+                       prompt_cache=4)
+    for expect_hits in (0, 1):
+        rid = srv.submit(prompt, max_new_tokens=6)
+        assert srv.run_to_completion()[rid] == ref
+        assert srv._prompt_hits == expect_hits
+
+    q = DecodeServer(model, params, slots=2, max_len=64,
+                     cache_dtype="int8", prompt_cache=4)
+    first = q.submit(prompt, max_new_tokens=6)
+    a = q.run_to_completion()[first]
+    second = q.submit(prompt, max_new_tokens=6)
+    assert q.run_to_completion()[second] == a
+    assert q._prompt_hits == 1
+
+
 def test_per_request_stop_tokens(rng):
     """submit(stop=...) finishes THAT request at its stop token while a
     concurrent request sails past the same token id."""

@@ -818,16 +818,26 @@ def bench_serve() -> dict:
                            draft_len=draft_len)
         spec_slack = draft_len + 1   # submit()'s verify-overshoot slack
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(0, model.config.vocab, 24).astype(np.int32)
-               for _ in range(n_req)]
+    # PSDT_BENCH_DISTINCT_PROMPTS caps the distinct-prompt pool (default:
+    # all distinct).  With PSDT_BENCH_PROMPT_CACHE=N set, repeats hit the
+    # server's prompt cache and skip their prefill — the canned-query
+    # serving shape.
+    n_distinct = int(os.environ.get("PSDT_BENCH_DISTINCT_PROMPTS",
+                                    str(n_req))) or n_req
+    prompt_len = int(os.environ.get("PSDT_BENCH_PROMPT_LEN", "24"))
+    pool = [rng.integers(0, model.config.vocab, prompt_len).astype(np.int32)
+            for _ in range(min(n_distinct, n_req))]
+    prompts = [pool[i % len(pool)] for i in range(n_req)]
+    prompt_cache = int(os.environ.get("PSDT_BENCH_PROMPT_CACHE", "0"))
 
     def drive(prompt_list):
         # plain serving keeps the historical 32+per_req cache (the ragged
         # mask attends over max_len, so growing it would silently change
         # tracked numbers); speculative mode adds exactly its slack
         srv = DecodeServer(model, params, slots=slots,
-                           max_len=32 + per_req + spec_slack,
-                           cache_dtype=cache_dtype, **spec_kwargs)
+                           max_len=prompt_len + 8 + per_req + spec_slack,
+                           cache_dtype=cache_dtype,
+                           prompt_cache=prompt_cache, **spec_kwargs)
         pending = list(prompt_list)
         while pending or not srv.idle:
             while pending and srv.has_free_slot:
@@ -837,13 +847,23 @@ def bench_serve() -> dict:
 
     drive(prompts[:slots])                     # compile all three programs
     t0 = time.perf_counter()
-    drive(prompts)
+    srv = drive(prompts)
     dt = time.perf_counter() - t0
     tps = n_req * per_req / dt
     suffix = "_kv8" if cache_dtype == "int8" else ""
     suffix += f"_spec_{draft_name}" if draft_name else ""
+    hits = srv.stats.get("prompt_cache_hits", 0)
+    # every workload-shape knob marks the metric id — a non-default shape
+    # must never collide with the tracked canonical serve row
+    if prompt_len != 24:
+        suffix += f"_plen{prompt_len}"
+    if n_distinct < n_req:
+        suffix += f"_distinct{n_distinct}"
+    if prompt_cache:
+        suffix += f"_pcache{prompt_cache}"
     log(f"bench_serve: model={name} slots={slots} requests={n_req} x "
-        f"{per_req} tokens{' draft=' + draft_name if draft_name else ''}: "
+        f"{per_req} tokens{' draft=' + draft_name if draft_name else ''}"
+        f"{f' prompt_cache_hits={hits}' if prompt_cache else ''}: "
         f"{tps:,.0f} sustained tokens/s")
     return {"metric": f"{name}_serve_tokens_per_sec{suffix}",
             "value": round(tps, 1), "unit": "tokens/sec",

@@ -462,7 +462,8 @@ class DecodeServer:
         if self.draft is not None:
             check_position_budget(self.draft, real_len,
                                   max_new_tokens + slack)
-        pkey = tuple(int(t) for t in prompt)
+        pkey = (tuple(int(t) for t in prompt)
+                if self.prompt_cache_size else None)
         hit = (self._prompt_cache.get(pkey)
                if self.prompt_cache_size else None)
         if hit is not None:

@@ -15,8 +15,8 @@ def _pyproject():
 
 def test_console_scripts_resolve():
     scripts = _pyproject()["project"]["scripts"]
-    assert len(scripts) == 7  # ps/coordinator/worker + train/status/
-    #                           generate/serve
+    assert len(scripts) == 8  # ps/coordinator/worker + train/status/
+    #                           generate/serve/eval
     for name, target in scripts.items():
         module, _, attr = target.partition(":")
         fn = getattr(importlib.import_module(module), attr)

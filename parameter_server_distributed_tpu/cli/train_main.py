@@ -47,6 +47,11 @@ pipeline bubble ~V-fold at V x the ppermute count.  Requires n_layers
 divisible by P*V; combine with data:N.  ``--attention`` may be dense or
 flash inside pipeline stages.
 
+``--ema=0.999`` tracks a Polyak/EMA shadow of the parameters at that
+decay inside the optimizer state (checkpointed and sharded like any
+slot); with ``--eval-every`` the final summary reports
+``ema_eval_loss`` next to the raw ``eval_loss``.
+
 ``--data`` switches from synthetic loaders to file-backed data
 (data/files.py): a token shard (.bin/.u32 memmap) for LM models, an npz
 with x/y arrays otherwise.  ``--eval-every=N`` runs a held-out
@@ -97,7 +102,7 @@ KNOWN_FLAGS = frozenset({
     "model", "batch", "data", "seq", "eval-every", "eval-steps", "eval-data",
     "per-process-data", "prefetch", "attention", "microbatches",
     "pipeline-schedule", "virtual-stages", "dtype", "remat", "no-remat",
-    "scan-layers", "remat-policy", "lora", "init-ckpt-dir",
+    "scan-layers", "remat-policy", "lora", "init-ckpt-dir", "ema",
     "no-scan-layers", "steps", "optimizer", "lr", "schedule", "warmup",
     "clip-norm", "accum", "mesh", "ckpt-dir", "ckpt-every", "ckpt-keep",
     "log-every", "seed", "resume", "metrics", "coordinator",
@@ -156,6 +161,7 @@ def main(argv: list[str] | None = None) -> int:
         remat_policy=flags.get("remat-policy", ""),
         lora=flags.get("lora", ""),
         init_ckpt_dir=flags.get("init-ckpt-dir", ""),
+        ema=float(flags.get("ema", 0.0)),
         steps=int(flags.get("steps", 100)),
         optimizer=flags.get("optimizer", "adam"),
         learning_rate=float(flags.get("lr", 1e-3)),

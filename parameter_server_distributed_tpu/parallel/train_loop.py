@@ -62,6 +62,10 @@ class TrainLoopConfig:
     lora: str = ""                # "R" or "R:ALPHA" = LoRA fine-tune:
                                   # only rank-R adapters train, base
                                   # weights frozen (models/lora.py)
+    ema: float = 0.0              # >0 = track a Polyak/EMA shadow of the
+                                  # params at this decay (in opt state —
+                                  # checkpointed/sharded for free); the
+                                  # summary reports ema_eval_loss
     init_ckpt_dir: str = ""       # load params (only) from this sharded
                                   # checkpoint dir before training — the
                                   # pretrained-base fine-tune flow
@@ -178,7 +182,8 @@ def run_training(config: TrainLoopConfig) -> dict:
                                schedule=config.schedule,
                                warmup_steps=config.warmup_steps,
                                total_steps=config.steps,
-                               clip_norm=config.clip_norm)
+                               clip_norm=config.clip_norm,
+                               ema_decay=config.ema)
     if config.init_ckpt_dir:
         # start from a PRETRAINED store (params only — fresh optimizer):
         # the dense-checkpoint -> fine-tune flow, incl. converted HF
@@ -217,6 +222,14 @@ def run_training(config: TrainLoopConfig) -> dict:
             raise ValueError("--lora does not compose with pipeline "
                              "parallelism yet (the pipe schedule owns its "
                              "grad function)")
+        if config.ema:
+            # freeze_base masks the whole inner chain (params_ema
+            # included) to /lora_ entries, so the shadow would hold
+            # MaskedNode placeholders for every base weight — reject
+            # rather than crash at the end-of-run EMA eval
+            raise ValueError("--ema does not compose with --lora yet "
+                             "(the masked optimizer would track an EMA "
+                             "of the adapters only)")
         init_params = init_lora(init_params, rank=rank,
                                 rng=config.seed + 1)
         loss_fn = lora_loss(model.loss, alpha=alpha)
@@ -260,12 +273,13 @@ def run_training(config: TrainLoopConfig) -> dict:
             scan=config.scan_layers, seq_len=config.seq_len,
             remat_policy=config.remat_policy)
 
-    def run_eval(state) -> float:
-        total = 0.0
+    def run_eval(state, batch_list=None) -> float:
         evaluate = trainer.eval_fn()
-        for _ in range(max(1, config.eval_steps)):
-            total += float(evaluate(state, place_batch(next(eval_batches))))
-        return total / max(1, config.eval_steps)
+        if batch_list is None:
+            batch_list = [place_batch(next(eval_batches))
+                          for _ in range(max(1, config.eval_steps))]
+        total = sum(float(evaluate(state, b)) for b in batch_list)
+        return total / len(batch_list)
 
     log.info("config: %s", json.dumps(dataclasses.asdict(config),
                                       default=str, sort_keys=True))
@@ -358,8 +372,31 @@ def run_training(config: TrainLoopConfig) -> dict:
         # reuse the loop's step-N result when training ended exactly on an
         # eval boundary (same params — a re-run would just burn eval_steps
         # forwards and report a different-batch number than the JSONL)
-        summary["eval_loss"] = (last_eval[1] if last_eval[0] == end_step
-                                else run_eval(state))
+        if config.ema:
+            # raw-vs-EMA on the SAME eval batches, else the gap the
+            # feature exists to show is confounded by batch noise
+            from .train_step import extract_ema, state_shardings
+            shared = [place_batch(next(eval_batches))
+                      for _ in range(max(1, config.eval_steps))]
+            summary["eval_loss"] = run_eval(state, shared)
+            ema_params = extract_ema(state.opt_state)
+            if ema_params is not None:
+                # opt-state slots are shape-matched to param shardings,
+                # which under NAME-based rules (Megatron TP) can pick a
+                # different-but-self-consistent layout; the eval jit
+                # expects the params' own specs, so re-place first
+                param_sh = state_shardings(
+                    state, mesh, _pick_rule(config.model, mesh)).params
+                ema_placed = jax.tree.map(jax.device_put, ema_params,
+                                          param_sh)
+                ema_loss = run_eval(
+                    dataclasses.replace(state, params=ema_placed), shared)
+                summary["ema_eval_loss"] = (None if math.isnan(ema_loss)
+                                            else ema_loss)
+        else:
+            summary["eval_loss"] = (last_eval[1]
+                                    if last_eval[0] == end_step
+                                    else run_eval(state))
         if math.isnan(summary["eval_loss"]):
             summary["eval_loss"] = None  # strict-JSON safe, like final_loss
         else:

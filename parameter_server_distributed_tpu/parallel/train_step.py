@@ -18,7 +18,7 @@ SGD config.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Mapping
+from typing import Any, Callable, Mapping, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -85,11 +85,54 @@ def make_lr_schedule(learning_rate: float, schedule: str = "constant",
     return optax.join_schedules([warmup, decay], [warmup_steps])
 
 
+class EmaState(NamedTuple):
+    """State for :func:`params_ema` — the shadow (EMA) parameter tree."""
+    ema: dict
+
+
+def params_ema(decay: float) -> optax.GradientTransformation:
+    """Track an exponential moving average of the PARAMETERS inside the
+    optimizer state (Polyak averaging): after each update,
+    ``ema = decay * ema + (1 - decay) * new_params``.  Living in
+    opt_state means TrainState/checkpoint structure is untouched — the
+    EMA rides existing save/restore/sharding for free; read it back with
+    :func:`extract_ema`.  Updates pass through unchanged (chain-neutral).
+    The shadow initializes to the INITIAL params (not zeros), so no
+    zero-init bias exists and no debiasing is needed anywhere."""
+    if not 0.0 < decay < 1.0:
+        raise ValueError(f"EMA decay must be in (0, 1), got {decay}")
+
+    def init(params):
+        return EmaState(ema=jax.tree.map(jnp.asarray, params))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("params_ema needs params: call "
+                             "opt.update(grads, state, params)")
+        new_ema = jax.tree.map(
+            lambda e, p, u: decay * e + (1.0 - decay) * (p + u),
+            state.ema, params, updates)
+        return updates, EmaState(ema=new_ema)
+
+    return optax.GradientTransformation(init, update)
+
+
+def extract_ema(opt_state):
+    """The EMA parameter tree from an optimizer state built with
+    ``make_optimizer(..., ema_decay>0)``, or None when no EmaState is
+    present.  Works on the nested chain states optax builds."""
+    found = [s.ema for s in jax.tree.leaves(
+        opt_state, is_leaf=lambda s: isinstance(s, EmaState))
+        if isinstance(s, EmaState)]
+    return found[0] if found else None
+
+
 def make_optimizer(name: str = "sgd", learning_rate: float = 1.0,
                    momentum: float = 0.9, *,
                    schedule: str = "constant", warmup_steps: int = 0,
                    total_steps: int = 0, clip_norm: float = 0.0,
-                   weight_decay: float = 1e-4) -> optax.GradientTransformation:
+                   weight_decay: float = 1e-4,
+                   ema_decay: float = 0.0) -> optax.GradientTransformation:
     """Device-side optimizer matching the host-side ones in core/optimizer.py
     (the reference applies bare SGD at lr=1.0 — src/parameter_server.cpp:87).
     Extensions beyond the reference: LR schedules (warmup + cosine/linear
@@ -129,7 +172,11 @@ def make_optimizer(name: str = "sgd", learning_rate: float = 1.0,
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     if clip_norm and clip_norm > 0:
-        return optax.chain(optax.clip_by_global_norm(clip_norm), opt)
+        opt = optax.chain(optax.clip_by_global_norm(clip_norm), opt)
+    if ema_decay:
+        # EMA LAST in the chain: it must see the final updates so the
+        # shadow tree averages the actual post-step parameters
+        opt = optax.chain(opt, params_ema(ema_decay))
     return opt
 
 

@@ -220,3 +220,63 @@ def test_checkpoint_averaging(tmp_path):
 
     none_step, none_state = sc.average_checkpoints(str(tmp_path / "nope"), 3)
     assert none_step is None and none_state is None
+
+
+def test_params_ema_tracks_and_extracts():
+    """params_ema keeps a Polyak shadow of the parameters inside the
+    optimizer state: the recursion matches a hand computation, the
+    shadow survives chaining (clip + sgd + ema), extract_ema finds it
+    through the nested chain state, and invalid decays are rejected."""
+    import jax.numpy as jnp
+    import optax
+
+    from parameter_server_distributed_tpu.parallel.train_step import (
+        extract_ema, make_optimizer, params_ema)
+
+    decay = 0.9
+    opt = make_optimizer("sgd", 0.5, clip_norm=10.0, ema_decay=decay)
+    params = {"w": jnp.asarray([2.0, -1.0], jnp.float32)}
+    state = opt.init(params)
+    expect_ema = np.asarray(params["w"])
+    for step in range(4):
+        grads = {"w": jnp.asarray([0.5, 0.5], jnp.float32)}
+        updates, state = opt.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        expect_ema = decay * expect_ema + (1 - decay) * np.asarray(
+            params["w"])
+    ema = extract_ema(state)
+    np.testing.assert_allclose(np.asarray(ema["w"]), expect_ema, rtol=1e-6)
+    # and the raw updates were NOT perturbed by the ema stage
+    np.testing.assert_allclose(np.asarray(params["w"]),
+                               np.asarray([2.0, -1.0]) - 4 * 0.25,
+                               rtol=1e-6)
+
+    assert extract_ema(make_optimizer("sgd", 0.1).init(params)) is None
+    with pytest.raises(ValueError, match="decay"):
+        params_ema(1.0)
+    with pytest.raises(ValueError, match="decay"):
+        params_ema(0.0)
+
+
+def test_train_loop_ema_eval(tmp_path):
+    """run_training with --ema reports ema_eval_loss next to eval_loss,
+    and the EMA tree rides the checkpoint: a --resume run (template
+    restore preserves the typed EmaState) still reports it."""
+    config = dict(
+        model="mnist_mlp", batch_size=16, steps=8, optimizer="adam",
+        learning_rate=1e-3, ema=0.9, eval_every=8, eval_steps=2,
+        checkpoint_dir=str(tmp_path), checkpoint_every=8, log_every=4)
+    summary = run_training(TrainLoopConfig(**config))
+    assert np.isfinite(summary["eval_loss"])
+    assert np.isfinite(summary["ema_eval_loss"])
+    # resume at the final step: 0 further updates, the EMA evaluated is
+    # exactly the checkpointed shadow
+    summary2 = run_training(TrainLoopConfig(**config, resume=True))
+    assert summary2["steps"] == 8
+    assert np.isfinite(summary2["ema_eval_loss"])
+
+    # --ema does not compose with --lora (masked optimizer would shadow
+    # adapters only) — rejected explicitly, not a crash at run end
+    with pytest.raises(ValueError, match="--ema does not compose"):
+        run_training(TrainLoopConfig(
+            model="tiny_lm", batch_size=4, steps=2, lora="2:4", ema=0.9))

@@ -14,8 +14,8 @@ from ..data.synthetic import (synthetic_image_batches, synthetic_mnist,
                               synthetic_tokens)
 from .mlp import MLP, billion_param_mlp, mnist_mlp
 from .resnet import resnet18, resnet50
-from .transformer import (llama_350m, lm_350m, moe_lm, small_lm, switch_lm,
-                          tiny_lm)
+from .transformer import (llama_350m, lm_350m, moe_350m, moe_lm, small_lm,
+                          switch_lm, tiny_lm)
 from .vit import vit_s16, vit_tiny
 
 
@@ -88,6 +88,9 @@ REGISTRY: dict[str, tuple[Callable, Callable[[int, int], Iterator], str]] = {
     # LLaMA-architecture flagship (SwiGLU + GQA): the shape from_hf_llama
     # conversions have, so its bench rows transfer to real checkpoints
     "llama_350m": (llama_350m, _lm_350m_batches, "tokens"),
+    # flagship-scale sparse MoE: lm_350m's trunk, every 2nd FFN routed
+    # over 8 experts (~350M active / ~1.07B total)
+    "moe_350m": (moe_350m, _lm_350m_batches, "tokens"),
     # vision transformers (models/vit.py): CIFAR-scale and ImageNet-scale
     "vit_tiny_cifar": (partial(vit_tiny, num_classes=10, image_size=32),
                        _cifar_batches, "xy"),

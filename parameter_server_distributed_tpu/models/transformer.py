@@ -962,6 +962,24 @@ def moe_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
         moe_top_k=top_k))
 
 
+def moe_350m(vocab: int = 32000, seq: int = 1024, dtype=jnp.bfloat16,
+             remat: bool = True, top_k: int = 1,
+             experts: int = 8) -> Transformer:
+    """Flagship-scale MoE: the :func:`lm_350m` trunk (24L / d1024 /
+    seq 1024) with every 2nd FFN expert-routed — ~350M ACTIVE params
+    per token (Switch top-1) over ~1.07B total.  The sparse-scaling
+    shape: serve-time compute of the dense flagship, ~3x its capacity.
+    Pair with a mesh ``expert`` axis to shard the expert stacks
+    (``--mesh=expert:4,data:2``); MFU is not reported for MoE configs
+    (6*P overcounts inactive experts — flops_per_sample returns None),
+    bench rows report samples/s."""
+    return Transformer(TransformerConfig(
+        vocab=vocab, d_model=1024, n_heads=16, n_layers=24, d_ff=4096,
+        max_seq=seq, dtype=dtype, remat=remat, moe_every=2,
+        moe_experts=experts, moe_top_k=top_k,
+        loss_chunk=math.gcd(128, seq)))
+
+
 def switch_lm(vocab: int = 1024, seq: int = 256, dtype=jnp.float32,
               remat: bool = False, top_k: int = 1) -> Transformer:
     """Test-scale ALL-MoE LM (moe_every=1, the Switch/Mixtral layout):

@@ -381,6 +381,11 @@ def run_training(config: TrainLoopConfig) -> dict:
             summary["eval_loss"] = run_eval(state, shared)
             ema_params = extract_ema(state.opt_state)
             if ema_params is not None:
+                # the shadow is float32 (params_ema); cast back to the
+                # model dtype so the eval jit sees the params' avals
+                ema_params = jax.tree.map(
+                    lambda e, p: e.astype(p.dtype), ema_params,
+                    state.params)
                 # opt-state slots are shape-matched to param shardings,
                 # which under NAME-based rules (Megatron TP) can pick a
                 # different-but-self-consistent layout; the eval jit

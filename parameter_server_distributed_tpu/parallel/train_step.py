@@ -103,14 +103,21 @@ def params_ema(decay: float) -> optax.GradientTransformation:
         raise ValueError(f"EMA decay must be in (0, 1), got {decay}")
 
     def init(params):
-        return EmaState(ema=jax.tree.map(jnp.asarray, params))
+        # shadow in FLOAT32 regardless of param dtype: at decay 0.999
+        # the per-step correction (1-decay)*(p-e) is below bf16's
+        # half-ulp, so a bf16 shadow would round back to itself every
+        # step and never move off the initial params
+        return EmaState(ema=jax.tree.map(
+            lambda p: jnp.asarray(p, jnp.float32), params))
 
     def update(updates, state, params=None):
         if params is None:
             raise ValueError("params_ema needs params: call "
                              "opt.update(grads, state, params)")
         new_ema = jax.tree.map(
-            lambda e, p, u: decay * e + (1.0 - decay) * (p + u),
+            lambda e, p, u: decay * e
+            + (1.0 - decay) * (p.astype(jnp.float32)
+                               + u.astype(jnp.float32)),
             state.ema, params, updates)
         return updates, EmaState(ema=new_ema)
 
@@ -118,9 +125,11 @@ def params_ema(decay: float) -> optax.GradientTransformation:
 
 
 def extract_ema(opt_state):
-    """The EMA parameter tree from an optimizer state built with
-    ``make_optimizer(..., ema_decay>0)``, or None when no EmaState is
-    present.  Works on the nested chain states optax builds."""
+    """The EMA parameter tree (float32 — see :func:`params_ema`) from an
+    optimizer state built with ``make_optimizer(..., ema_decay>0)``, or
+    None when no EmaState is present.  Works on the nested chain states
+    optax builds.  Cast back to the model dtype for eval/serving:
+    ``jax.tree.map(lambda e, p: e.astype(p.dtype), ema, params)``."""
     found = [s.ema for s in jax.tree.leaves(
         opt_state, is_leaf=lambda s: isinstance(s, EmaState))
         if isinstance(s, EmaState)]

@@ -55,6 +55,9 @@ run spec_trained_draft_k2        PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small
 run serve_small_lm               PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run serve_small_lm_int8_full     PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_QUANT=int8 PSDT_BENCH_KV_CACHE=int8
 run serve_small_lm_spec          PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_DRAFT=self PSDT_BENCH_DRAFT_LEN=4
+# flagship-scale sparse MoE (350M active / 1.07B total): samples/s row
+# (MFU not reported — 6P overcounts inactive experts)
+run moe350_b16                   PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=moe_350m PSDT_BENCH_BATCH=16
 # -- 5. other BASELINE config rows (1B MFU is the config-3/5 anchor)
 run mlp1b_sgd_b1024              PSDT_BENCH_MODEL=mlp_1b PSDT_BENCH_BATCH=1024
 run mnist_mlp_b256               PSDT_BENCH_MODEL=mnist_mlp PSDT_BENCH_BATCH=256

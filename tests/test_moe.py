@@ -316,3 +316,21 @@ def test_moe_lm_top2_trains_and_decodes(rng):
         logits = model.apply(params, np.asarray([ids], np.int32))
         ids.append(int(np.asarray(logits)[0, -1].argmax()))
     np.testing.assert_array_equal(out[0], np.asarray(ids[4:]))
+
+
+def test_moe_350m_preset_shape(rng):
+    """The flagship-scale sparse preset: lm_350m trunk, 12 routed layers
+    over 8 experts, ~1.07B total params, MFU honestly unreported (6P
+    would overcount inactive experts).  Full-size training is a TPU job
+    (the sweep's moe350_b16 row); expert-sharded TRAINING coverage for
+    this layout lives in test_moe/test_parallel's small twins."""
+    from parameter_server_distributed_tpu.models.registry import (
+        get_model_and_batches)
+
+    model, batches = get_model_and_batches("moe_350m", 2)
+    c = model.config
+    assert sum(c.is_moe_layer(i) for i in range(c.n_layers)) == 12
+    assert 1.0e9 < model.num_params() < 1.2e9
+    assert model.flops_per_sample() is None
+    tokens, = (next(batches),)
+    assert tokens.shape == (2, 1024)

@@ -257,6 +257,20 @@ def test_params_ema_tracks_and_extracts():
     with pytest.raises(ValueError, match="decay"):
         params_ema(0.0)
 
+    # bf16 regression: the shadow is kept in FLOAT32 — at decay 0.999
+    # a bf16 shadow's per-step correction is below its half-ulp and
+    # would round back to the init value forever
+    opt16 = make_optimizer("sgd", 0.5, ema_decay=0.999)
+    p16 = {"w": jnp.asarray([2.0], jnp.bfloat16)}
+    s16 = opt16.init(p16)
+    for _ in range(50):
+        upd, s16 = opt16.update({"w": jnp.asarray([0.5], jnp.bfloat16)},
+                                s16, p16)
+        p16 = optax.apply_updates(p16, upd)
+    ema16 = extract_ema(s16)
+    assert ema16["w"].dtype == jnp.float32
+    assert float(ema16["w"][0]) != 2.0  # the shadow actually moved
+
 
 def test_train_loop_ema_eval(tmp_path):
     """run_training with --ema reports ema_eval_loss next to eval_loss,

@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from parameter_server_distributed_tpu.models.generation import (
-    generate, init_cache, prefill, sample_token)
+    generate, init_cache, prefill, sample_token, sample_token_rowwise)
 from parameter_server_distributed_tpu.models.transformer import (
     Transformer, TransformerConfig)
 
@@ -665,3 +665,38 @@ def test_generation_with_xla_flash_prefill_matches_dense(rng):
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(cache_f.k), np.asarray(cache_d.k),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_sample_token_rowwise_exactness(rng):
+    """The per-row sampler's contract against the scalar one: with a
+    uniform temperature vector it draws EXACTLY sample_token's tokens
+    (same rng, same truncation math), zero-temperature rows are exact
+    argmax regardless of the other rows, and static top_k truncation
+    applies to sampled rows."""
+    logits = jnp.asarray(rng.standard_normal((6, 32)) * 3.0, jnp.float32)
+    key = jax.random.key(7)
+
+    # uniform hot vector == scalar sampler, token for token
+    uniform = jnp.full((6,), 0.8, jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(sample_token_rowwise(logits, key, uniform)),
+        np.asarray(sample_token(logits, key, 0.8)))
+    # ... including under top_k/top_p truncation
+    np.testing.assert_array_equal(
+        np.asarray(sample_token_rowwise(logits, key, uniform,
+                                        top_k=5, top_p=0.9)),
+        np.asarray(sample_token(logits, key, 0.8, top_k=5, top_p=0.9)))
+
+    # mixed batch: zero rows are exact argmax, whatever the others do
+    mixed = jnp.asarray([0.0, 9.0, 0.0, 0.5, 0.0, 2.0], jnp.float32)
+    out = np.asarray(sample_token_rowwise(logits, key, mixed))
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    for i in (0, 2, 4):
+        assert out[i] == greedy[i]
+
+    # top_k=1 forces argmax even at high temperature (truncation is
+    # shared/static across rows)
+    hot = jnp.full((6,), 9.0, jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(sample_token_rowwise(logits, key, hot, top_k=1)),
+        greedy)

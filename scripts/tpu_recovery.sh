@@ -4,33 +4,38 @@
 # short window must bank the most important numbers first):
 #
 #   1. headline MFU (the BASELINE north-star + driver default)
-#   2. lm_350m flagship rows: dense/remat matrix, remat-credited view
+#   2. lm_350m flagship rows, scan layout (compiles ~7x smaller HLO — a
+#      short window banks flagship numbers before anything slow)
 #   3. long-context flash-vs-dense crossover incl. the GQA flagship
-#   4. speculative-decode serving rows
+#   4. speculative-decode / serving rows (cheap, decode-sized compiles)
+#   5. model-family rows (MoE, ViT, 1B MLP, resnets)
+#   6. LONG-BUDGET tail: unrolled-layout LM rows and xla-cost-analysis
+#      rows (multi-minute compiles, 900 s budgets) — deliberately last so
+#      they can never starve a short window (round-4 lost 8 configs to
+#      exactly that)
 #
 # RESUMABLE: each line appends to $RESULTS as it lands, a tag that already
 # has a non-error result is skipped on re-run, and a tunnel-down signature
 # (preflight hang / attempt timeout) aborts with rc=2 so a caller
 # (scripts/tpu_watchdog.sh) can wait for recovery and re-invoke — a mid-run
 # outage keeps everything captured so far and loses nothing else.
+# Live-device timeouts get one adaptive doubled-budget retry (warm compile
+# cache), transport 5xxs one paused retry, and repeat offenders are
+# deferred to the chain's SWEEP_RETRY_DEFERRED pass — scripts/tpu_sweep_lib.sh.
 set -u
 cd "$(dirname "$0")/.."
 . scripts/tpu_sweep_lib.sh
 
 # -- 1. headline (driver default config)
 run headline_mlp_mfu
-# -- 2. flagship LM rows (scan layout first: compiles ~7x smaller HLO, so a
-#    short tunnel window banks a flagship number before the slow unrolled
-#    variants; unrolled rows get a longer per-config compile budget)
+# -- 2. flagship LM rows, scan layout
 run lm350_scan_remat_b32         PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
 run lm350_scan_noremat_b32       PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_REMAT=0
 run lm350_scan_remat_b64         PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=64 PSDT_BENCH_SCAN=1
 run lm350_scan_remat_b32_credit  PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_REMAT_CREDIT=1
 run lm350_hd128_scan_b32         PSDT_BENCH_MODEL=lm_350m_hd128 PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
-run llama350_scan_b32            PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=llama_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
+run llama350_scan_b32            PSDT_BENCH_MODEL=llama_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1
 run lm350_xlaflash_scan_b32      PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_ATTENTION=xla_flash
-run lm350_dense_remat_b32        PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32
-run lm350_dense_noremat_b32      PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT=0
 # -- 3. long-context crossover
 run attn_ab_seq4096              PSDT_BENCH_MODE=attention PSDT_BENCH_SEQ=4096
 run attn_ab_seq8192              PSDT_BENCH_MODE=attention PSDT_BENCH_SEQ=8192
@@ -55,13 +60,19 @@ run spec_trained_draft_k2        PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small
 run serve_small_lm               PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run serve_small_lm_int8_full     PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_QUANT=int8 PSDT_BENCH_KV_CACHE=int8
 run serve_small_lm_spec          PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_DRAFT=self PSDT_BENCH_DRAFT_LEN=4
-# flagship-scale sparse MoE (350M active / 1.07B total): samples/s row
-# (MFU not reported — 6P overcounts inactive experts)
-run moe350_b16                   PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=moe_350m PSDT_BENCH_BATCH=16
-# -- 5. other BASELINE config rows (1B MFU is the config-3/5 anchor)
+# -- 5. model-family rows (flagship-scale sparse MoE: samples/s row —
+#    analytic MFU not reported, 6P overcounts inactive experts; the
+#    xlaflops rows in section 6 are the hardware-executed-FLOPs view;
+#    ViT gets its first perf row)
+run moe350_b16                   PSDT_BENCH_MODEL=moe_350m PSDT_BENCH_BATCH=16
+run vit_s16_b64                  PSDT_BENCH_MODEL=vit_s16_imagenet PSDT_BENCH_BATCH=64
 run mlp1b_sgd_b1024              PSDT_BENCH_MODEL=mlp_1b PSDT_BENCH_BATCH=1024
 run mnist_mlp_b256               PSDT_BENCH_MODEL=mnist_mlp PSDT_BENCH_BATCH=256
 run resnet18_b256                PSDT_BENCH_MODEL=resnet18_cifar PSDT_BENCH_BATCH=256
+# -- 6. LONG-BUDGET tail (multi-minute unrolled/conv compiles; 900 s
+#    budgets; adaptive retry doubles to 1800 s on a live device)
+run lm350_dense_remat_b32        PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32
+run lm350_dense_noremat_b32      PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_REMAT=0
 run resnet50_b128                PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=resnet50_imagenet PSDT_BENCH_BATCH=128
 # XLA cost-analysis MFU (hardware-executed FLOPs, any model): conv nets
 # get their first MFU rows, and the LM row cross-checks the analytic

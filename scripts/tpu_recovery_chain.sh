@@ -1,11 +1,15 @@
 #!/usr/bin/env bash
 # Priority-ordered TPU capture: the remat_policy=dots ladder (the >=45%
 # MFU chase) first, then the remaining main-sweep configs (long-context
-# A/B, decode/serve, 1B/resnet rows).  Both are resumable and share the
-# tag contract (scripts/tpu_sweep_lib.sh), so a tunnel death anywhere
+# A/B, decode/serve, 1B/resnet rows), then a final SWEEP_RETRY_DEFERRED
+# pass that gives configs deferred for repeated live-device failures the
+# leftover window budget.  All passes are resumable and share the tag
+# contract (scripts/tpu_sweep_lib.sh), so a tunnel death anywhere
 # propagates rc=2 to scripts/tpu_watchdog.sh, which waits out the outage
 # and re-invokes this chain — already-banked tags are skipped.
 set -u
 cd "$(dirname "$0")/.."
 bash scripts/tpu_recovery_dots.sh || exit $?
-bash scripts/tpu_recovery.sh
+bash scripts/tpu_recovery.sh || exit $?
+SWEEP_RETRY_DEFERRED=1 bash scripts/tpu_recovery_dots.sh || exit $?
+SWEEP_RETRY_DEFERRED=1 bash scripts/tpu_recovery.sh

@@ -14,9 +14,13 @@ RESULTS="${RESULTS:-/tmp/tpu_recovery.jsonl}"
 LOG="${LOG:-/tmp/tpu_recovery.log}"
 PROBE_SPACING_S="${PROBE_SPACING_S:-240}"
 DEADLINE_S="${DEADLINE_S:-36000}"
-# Which resumable sweep to bank (same run/skip/abort contract):
-# scripts/tpu_recovery.sh (default) or e.g. scripts/tpu_recovery_dots.sh
-SWEEP="${SWEEP:-scripts/tpu_recovery.sh}"
+# Which resumable sweep to bank (same run/skip/abort contract).  The
+# default is the full chain — it is the only entry point that runs the
+# SWEEP_RETRY_DEFERRED pass, so tags deferred for repeated live-device
+# failures get the leftover budget instead of ending the round banked as
+# bench_error.  Point SWEEP at a single sweep script only for targeted
+# captures.
+SWEEP="${SWEEP:-scripts/tpu_recovery_chain.sh}"
 START=$(date +%s)
 
 # Shared predicate + wrapper (scripts/tpu_probe.sh) so watchdog, recovery,

@@ -1,0 +1,206 @@
+"""Kill-switch test for the resumable TPU sweep scaffolding.
+
+scripts/tpu_sweep_lib.sh is the only thing standing between a 4-minute
+tunnel window and an empty results file (round 4 banked 4 of 12 configs;
+the losses were an unretried HTTP 500, a single fixed per-config timeout,
+and expensive configs starving cheap ones).  These tests drive the lib
+with a fake bench + fake probe at ~1 s timescales and assert the contract
+the real sweeps rely on:
+
+  * a short window still banks every cheap config (>= 3 here) even when a
+    hog config sits in the middle of the list
+  * a transport-layer 5xx is retried once and banks on the retry
+  * a live-device timeout is retried once with a doubled budget
+  * a config that keeps failing is deferred after MAX_TAG_FAILS failures
+    (and runs again only under SWEEP_RETRY_DEFERRED=1)
+  * a tunnel-down signature aborts rc=2 so the watchdog can wait it out
+  * banked tags are skipped on re-run; bench_error rows are retried
+
+No TPU involved: BENCH and PROBE_CMD are the lib's test seams.
+"""
+from __future__ import annotations
+
+import json
+import os
+import stat
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+FAKE_BENCH = r"""
+import json, os, sys, time
+
+cost = float(os.environ.get("FAKE_COST_S", "0"))
+timeout = float(os.environ.get("PSDT_BENCH_TPU_TIMEOUT", "560"))
+
+marker = os.environ.get("FAKE_500_FILE", "")
+if marker and not os.path.exists(marker):
+    open(marker, "w").close()
+    print(json.dumps({
+        "metric": "bench_error", "value": 0.0, "unit": "error",
+        "vs_baseline": 0.0,
+        "note": "JaxRuntimeError remote_compile: HTTP 500: helper exit 1"}))
+    sys.exit(0)
+
+if os.environ.get("FAKE_PREFLIGHT_HANG"):
+    print(json.dumps({
+        "metric": "bench_error", "value": 0.0, "unit": "error",
+        "vs_baseline": 0.0,
+        "note": "TPU preflight hung (> 1s) after 1 spaced probes"}))
+    sys.exit(0)
+
+if cost > timeout:
+    time.sleep(timeout)
+    print(json.dumps({
+        "metric": "bench_error", "value": 0.0, "unit": "error",
+        "vs_baseline": 0.0,
+        "note": "tpu attempt timed out after %ds" % timeout}))
+    sys.exit(0)
+
+time.sleep(cost)
+print(json.dumps({
+    "metric": "fake_mfu", "value": 0.5, "unit": "fraction_of_peak",
+    "vs_baseline": 1.1}))
+"""
+
+
+def _env(tmp: Path, results: Path, probe_ok: bool = True) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "RESULTS": str(results),
+        "LOG": str(tmp / "sweep.log"),
+        "BENCH": f"python {tmp / 'fake_bench.py'}",
+        "PROBE_CMD": "true" if probe_ok else "false",
+        "PSDT_BENCH_TPU_TIMEOUT": "1",
+        "RETRY_5XX_PAUSE_S": "0",
+    })
+    return env
+
+
+def _write_sweep(tmp: Path, body: str) -> Path:
+    (tmp / "fake_bench.py").write_text(FAKE_BENCH)
+    sweep = tmp / "sweep.sh"
+    sweep.write_text("#!/usr/bin/env bash\nset -u\n"
+                     ". scripts/tpu_sweep_lib.sh\n" + body)
+    sweep.chmod(sweep.stat().st_mode | stat.S_IEXEC)
+    return sweep
+
+
+def _banked(results: Path) -> dict:
+    rows = {}
+    if results.exists():
+        for line in results.read_text().splitlines():
+            row = json.loads(line)
+            rows[row["config"]] = row["result"]
+    return rows
+
+
+def _run_sweep(sweep: Path, env: dict, timeout: float = 60.0):
+    return subprocess.run(["bash", str(sweep)], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_short_window_banks_cheap_configs_despite_hog(tmp_path):
+    """The round-4 failure shape: a hog mid-list must not starve the
+    cheap configs behind it, and the whole window stays bounded by the
+    hog's (budget + doubled retry), not by the window deadline."""
+    results = tmp_path / "r.jsonl"
+    sweep = _write_sweep(tmp_path, "\n".join([
+        "run cheap1 FAKE_COST_S=0",
+        "run hog FAKE_COST_S=99",
+        "run cheap2 FAKE_COST_S=0",
+        "run cheap3 FAKE_COST_S=0",
+        ""]))
+    start = time.monotonic()
+    proc = _run_sweep(sweep, _env(tmp_path, results))
+    elapsed = time.monotonic() - start
+    assert proc.returncode == 0, proc.stderr
+    rows = _banked(results)
+    real = [t for t, r in rows.items() if r["metric"] == "fake_mfu"]
+    assert sorted(real) == ["cheap1", "cheap2", "cheap3"]
+    # hog: 1 s attempt + 2 s doubled retry, banked as error, didn't block
+    assert rows["hog"]["metric"] == "bench_error"
+    assert elapsed < 20, f"hog starved the window: {elapsed:.1f}s"
+    assert "adaptive retry with 2s" in (tmp_path / "sweep.log").read_text()
+
+
+def test_adaptive_retry_banks_config_that_fits_doubled_budget(tmp_path):
+    """The headline round-4 fix: a config whose cost sits between the
+    base budget and 2x budget must bank a REAL number on the doubled
+    retry (warm compile cache in production), not a bench_error row."""
+    results = tmp_path / "r.jsonl"
+    sweep = _write_sweep(tmp_path, "run midcost FAKE_COST_S=1.5\n")
+    proc = _run_sweep(sweep, _env(tmp_path, results))
+    assert proc.returncode == 0, proc.stderr
+    assert _banked(results)["midcost"]["metric"] == "fake_mfu"
+    assert "adaptive retry with 2s" in (tmp_path / "sweep.log").read_text()
+
+
+def test_transport_5xx_retried_once_and_banks(tmp_path):
+    results = tmp_path / "r.jsonl"
+    marker = tmp_path / "flaky_marker"
+    sweep = _write_sweep(
+        tmp_path, f"run flaky FAKE_500_FILE={marker} FAKE_COST_S=0\n")
+    proc = _run_sweep(sweep, _env(tmp_path, results))
+    assert proc.returncode == 0, proc.stderr
+    assert marker.exists()  # first attempt consumed the 500
+    assert _banked(results)["flaky"]["metric"] == "fake_mfu"
+
+
+def test_repeat_offender_deferred_then_retried_under_flag(tmp_path):
+    results = tmp_path / "r.jsonl"
+    sweep = _write_sweep(tmp_path, "run hog FAKE_COST_S=99\n")
+    env = _env(tmp_path, results)
+    log = tmp_path / "sweep.log"
+    # two watchdog re-invocations -> MAX_TAG_FAILS=2 reached
+    for _ in range(2):
+        assert _run_sweep(sweep, env).returncode == 0
+    # third invocation: deferred without running (fast)
+    start = time.monotonic()
+    assert _run_sweep(sweep, env).returncode == 0
+    assert time.monotonic() - start < 2
+    assert "deferred" in log.read_text()
+    # the chain's final pass still gives it the leftover budget
+    env_retry = dict(env, SWEEP_RETRY_DEFERRED="1")
+    assert _run_sweep(sweep, env_retry).returncode == 0
+    assert "deferred (" not in log.read_text().splitlines()[-1]
+
+
+def test_tunnel_down_timeout_aborts_rc2(tmp_path):
+    """A timeout with a dead probe is a tunnel death -> rc=2, no retry."""
+    results = tmp_path / "r.jsonl"
+    sweep = _write_sweep(tmp_path, "run hog FAKE_COST_S=99\n")
+    proc = _run_sweep(sweep, _env(tmp_path, results, probe_ok=False))
+    assert proc.returncode == 2
+
+
+def test_preflight_hang_aborts_rc2(tmp_path):
+    results = tmp_path / "r.jsonl"
+    sweep = _write_sweep(tmp_path, "run dead FAKE_PREFLIGHT_HANG=1\n")
+    proc = _run_sweep(sweep, _env(tmp_path, results))
+    assert proc.returncode == 2
+    # the error row is still banked so the round artifact shows the state
+    assert _banked(results)["dead"]["metric"] == "bench_error"
+
+
+def test_banked_tag_skipped_error_tag_retried(tmp_path):
+    results = tmp_path / "r.jsonl"
+    results.write_text("\n".join([
+        json.dumps({"config": "done", "result": {
+            "metric": "fake_mfu", "value": 0.4}}),
+        json.dumps({"config": "errored", "result": {
+            "metric": "bench_error", "value": 0.0}}),
+        ""]))
+    sweep = _write_sweep(tmp_path, "\n".join([
+        "run done FAKE_COST_S=99",    # would time out if not skipped
+        "run errored FAKE_COST_S=0",
+        ""]))
+    proc = _run_sweep(sweep, _env(tmp_path, results))
+    assert proc.returncode == 0, proc.stderr
+    rows = _banked(results)
+    assert rows["done"]["value"] == 0.4          # untouched
+    assert rows["errored"]["metric"] == "fake_mfu"  # retried, replaced

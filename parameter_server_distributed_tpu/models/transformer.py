@@ -453,26 +453,35 @@ class Transformer:
         """Training (fwd+bwd) FLOPs for one max_seq-length sample:
         6*P per token for the parameter matmuls plus 12*L*d_model*S per
         token for the attention score/value matmuls (PaLM-appendix
-        convention, full-S accounting).  None for MoE configs, where 6*P
-        overcounts inactive experts.
+        convention, full-S accounting).
+
+        MoE configs count ACTIVE-expert FLOPs: each token's FFN runs
+        ``moe_top_k`` of the ``moe_experts`` experts, so the parameter
+        term uses P_active = P - n_moe_layers * (E - top_k) * 2*d*d_ff
+        (the standard sparse-MoE MFU numerator; an upper bound when
+        expert-capacity dropping skips some tokens' experts — callers
+        reporting MoE MFU must say "active-expert accounting", bench.py
+        does).
 
         ``remat_credited=True`` counts the extra forward the hardware
         actually executes under ``config.remat``: hardware-utilization
         accounting for rematerialized runs.  Under the "full" policy that
         is the whole forward again (+2*P and +4*L*d*S per token); under
         "dots" the projection/MLP matmuls are saved and only the attention
-        einsums re-run (+4*L*d*S only).  Callers reporting MFU from it
-        must label the number as remat-credited (bench.py does)."""
+        einsums re-run (+4*L*d*S only)."""
         c = self.config
-        if c.moe_every > 0:
-            return None
         seq = c.max_seq
+        n_params = self.num_params()
+        if c.moe_every > 0:
+            n_moe = sum(1 for i in range(c.n_layers) if c.is_moe_layer(i))
+            inactive = max(0, c.moe_experts - c.moe_top_k)
+            n_params -= n_moe * inactive * 2 * c.d_model * c.d_ff
         params_mult, attn_mult = 6.0, 12.0
         if remat_credited:
             attn_mult = 16.0
             if c.remat_policy == "full":
                 params_mult = 8.0
-        return (params_mult * self.num_params() * seq
+        return (params_mult * n_params * seq
                 + attn_mult * c.n_layers * c.d_model * seq * seq)
 
     def _remat_policy(self):

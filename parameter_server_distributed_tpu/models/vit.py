@@ -122,10 +122,16 @@ class ViT:
         c = self.config
         s = c.seq_len
         head = c.d_model * c.num_classes
-        matmul_params = sum(math.prod(shape)
-                            for name, shape in self.param_shapes().items()
-                            if len(shape) == 2 and name != "lm_head/w")
-        return (6.0 * (matmul_params * s + head)
+        # Only weights that participate in matmuls count: embed/pos is a
+        # 2-D table consumed by an add, and patch/w sees the n_patches
+        # patch tokens but never the CLS token.
+        block_params = sum(math.prod(shape)
+                           for name, shape in self.param_shapes().items()
+                           if len(shape) == 2
+                           and name not in ("lm_head/w", "embed/pos",
+                                            "patch/w"))
+        patch_params = math.prod(self.param_shapes()["patch/w"])
+        return (6.0 * (block_params * s + patch_params * c.n_patches + head)
                 + 12.0 * c.n_layers * c.d_model * s * s)
 
     def init_params(self, rng: jax.Array | int = 0) -> dict[str, Array]:

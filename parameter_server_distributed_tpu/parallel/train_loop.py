@@ -210,29 +210,27 @@ def run_training(config: TrainLoopConfig) -> dict:
                 f"--ckpt-dir={config.init_ckpt_dir}, or merge first "
                 f"(models.lora.merge_lora) to start a fresh run from the "
                 f"adapted weights")
+    grad_fn = getattr(model, "value_and_grad", None)
     if config.lora:
         # parameter-efficient fine-tuning: adapters join the store as
         # plain entries (sharding/checkpointing unchanged), the loss
         # materializes effective weights per step, and the optimizer is
-        # masked so ONLY /lora_ entries train (models/lora.py)
+        # masked so ONLY /lora_ entries train (models/lora.py).
+        # Composes with pipeline (adapters follow the blocks/* restack;
+        # the schedule's grad_fn is wrapped to differentiate through the
+        # adapter collapse) and with --ema (freeze_base masks params_ema
+        # to the adapters, so the shadow tracks exactly what trains; the
+        # EMA eval below grafts the shadowed adapters onto the frozen
+        # base)
         from ..models.lora import (freeze_base, init_lora, lora_loss,
-                                   lora_names, split_rank_alpha)
+                                   lora_names, lora_value_and_grad,
+                                   split_rank_alpha)
         rank, alpha = split_rank_alpha(config.lora)
-        if getattr(model, "value_and_grad", None) is not None:
-            raise ValueError("--lora does not compose with pipeline "
-                             "parallelism yet (the pipe schedule owns its "
-                             "grad function)")
-        if config.ema:
-            # freeze_base masks the whole inner chain (params_ema
-            # included) to /lora_ entries, so the shadow would hold
-            # MaskedNode placeholders for every base weight — reject
-            # rather than crash at the end-of-run EMA eval
-            raise ValueError("--ema does not compose with --lora yet "
-                             "(the masked optimizer would track an EMA "
-                             "of the adapters only)")
         init_params = init_lora(init_params, rank=rank,
                                 rng=config.seed + 1)
         loss_fn = lora_loss(model.loss, alpha=alpha)
+        if grad_fn is not None:
+            grad_fn = lora_value_and_grad(grad_fn, alpha=alpha)
         optimizer = freeze_base(optimizer)
         log.info("LoRA fine-tuning: rank %d alpha %.1f — %d adapter "
                  "tensors train, base frozen", rank, alpha,
@@ -241,7 +239,7 @@ def run_training(config: TrainLoopConfig) -> dict:
         loss_fn, mesh, _pick_rule(config.model, mesh),
         optimizer,
         accum_steps=config.accum_steps,
-        grad_fn=getattr(model, "value_and_grad", None))
+        grad_fn=grad_fn)
     state = trainer.init_state(init_params)
 
     start_step = 0
@@ -382,10 +380,18 @@ def run_training(config: TrainLoopConfig) -> dict:
             ema_params = extract_ema(state.opt_state)
             if ema_params is not None:
                 # the shadow is float32 (params_ema); cast back to the
-                # model dtype so the eval jit sees the params' avals
-                ema_params = jax.tree.map(
-                    lambda e, p: e.astype(p.dtype), ema_params,
-                    state.params)
+                # model dtype so the eval jit sees the params' avals.
+                # Under --lora the shadow is masked to the trainable
+                # adapters (freeze_base wraps the whole chain), so frozen
+                # entries hold MaskedNode placeholders — graft the
+                # shadowed adapters onto the frozen base, which IS the
+                # EMA of a store whose base never moves
+                import optax
+                ema_params = {
+                    name: (p if isinstance(ema_params[name],
+                                           optax.MaskedNode)
+                           else ema_params[name].astype(p.dtype))
+                    for name, p in state.params.items()}
                 # opt-state slots are shape-matched to param shardings,
                 # which under NAME-based rules (Megatron TP) can pick a
                 # different-but-self-consistent layout; the eval jit
@@ -398,6 +404,14 @@ def run_training(config: TrainLoopConfig) -> dict:
                     dataclasses.replace(state, params=ema_placed), shared)
                 summary["ema_eval_loss"] = (None if math.isnan(ema_loss)
                                             else ema_loss)
+            else:
+                # config.ema is on but no EmaState survived in opt_state —
+                # a template-free checkpoint restore can degrade the
+                # NamedTuple to a plain tuple.  Losing the metric silently
+                # would read as "EMA converged to raw"; say what happened.
+                log.warning(
+                    "--ema is set but no EmaState found in opt_state "
+                    "(template-free restore?); ema_eval_loss omitted")
         else:
             summary["eval_loss"] = (last_eval[1]
                                     if last_eval[0] == end_step

@@ -105,6 +105,13 @@ class Tensor(Message):
             payload = np.float32(scale).tobytes() + q.tobytes()
         elif wire_dtype == WIRE_TOPK:
             flat = arr.reshape(-1)
+            if flat.size >= 2**32:
+                # u4 wire indices would silently wrap on decode; no real
+                # tensor is 4B+ elements (16 GB+ f32), so refuse loudly
+                # rather than degrade to a quiet corruption.
+                raise ValueError(
+                    f"WIRE_TOPK indices are u32: tensor {name!r} has "
+                    f"{flat.size} elements (>= 2**32); use bf16 wire")
             k = min(flat.size, max(1, int(round(flat.size * topk_density)))) \
                 if flat.size else 0
             if k:

@@ -536,8 +536,9 @@ def test_registry_seq_override():
 
 def test_flops_per_sample_accounting():
     """PaLM-convention FLOPs: 6P + 12*L*d*S per token; remat-credited adds
-    the recompute forward (8P + 16*L*d*S).  MoE returns None (6P would
-    overcount inactive experts)."""
+    the recompute forward (8P + 16*L*d*S).  MoE counts ACTIVE-expert
+    FLOPs: P_active excludes the (E - top_k) experts a token never
+    runs."""
     import dataclasses
 
     from parameter_server_distributed_tpu.models.transformer import (
@@ -554,5 +555,39 @@ def test_flops_per_sample_accounting():
     assert credited == (8.0 * model.num_params() * seq
                         + 16.0 * config.n_layers * config.d_model * seq * seq)
     moe = Transformer(dataclasses.replace(config, moe_every=2,
-                                          moe_experts=4))
-    assert moe.flops_per_sample() is None
+                                          moe_experts=4, moe_top_k=1))
+    # layer 1 (1-based layer 2) is MoE: 3 of 4 experts inactive per token
+    active = moe.num_params() - 1 * 3 * 2 * config.d_model * config.d_ff
+    assert moe.flops_per_sample() == (
+        6.0 * active * seq
+        + 12.0 * config.n_layers * config.d_model * seq * seq)
+    # top_k=2 activates one more expert's worth of FLOPs
+    moe2 = Transformer(dataclasses.replace(config, moe_every=2,
+                                           moe_experts=4, moe_top_k=2))
+    assert moe2.flops_per_sample() > moe.flops_per_sample()
+
+
+def test_vit_flops_accounting_excludes_non_matmul_params():
+    """ViT MFU numerator: embed/pos is an add (no FLOPs credit), patch/w
+    sees only the n_patches patch tokens (never CLS), and the classifier
+    head sees exactly one pooled token."""
+    import math
+
+    from parameter_server_distributed_tpu.models.vit import ViT, ViTConfig
+
+    c = ViTConfig(image_size=32, patch_size=8, d_model=64, n_heads=4,
+                  n_layers=2, d_ff=128, num_classes=10)
+    model = ViT(c)
+    shapes = model.param_shapes()
+    s, n = c.seq_len, c.n_patches
+    block = sum(math.prod(shape) for name, shape in shapes.items()
+                if len(shape) == 2
+                and name not in ("lm_head/w", "embed/pos", "patch/w"))
+    expected = (6.0 * (block * s + math.prod(shapes["patch/w"]) * n
+                       + c.d_model * c.num_classes)
+                + 12.0 * c.n_layers * c.d_model * s * s)
+    assert model.flops_per_sample() == expected
+    # the two excluded tables would have inflated the numerator
+    assert math.prod(shapes["embed/pos"]) > 0
+    assert model.flops_per_sample() < expected + 6.0 * s * math.prod(
+        shapes["embed/pos"])

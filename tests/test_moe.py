@@ -320,10 +320,12 @@ def test_moe_lm_top2_trains_and_decodes(rng):
 
 def test_moe_350m_preset_shape(rng):
     """The flagship-scale sparse preset: lm_350m trunk, 12 routed layers
-    over 8 experts, ~1.07B total params, MFU honestly unreported (6P
-    would overcount inactive experts).  Full-size training is a TPU job
-    (the sweep's moe350_b16 row); expert-sharded TRAINING coverage for
-    this layout lives in test_moe/test_parallel's small twins."""
+    over 8 experts, ~1.07B total params; MFU uses ACTIVE-expert FLOPs
+    (top_k of 8 experts per token — the per-token compute is ~the dense
+    350M trunk's, which is the point of sparse MoE).  Full-size training
+    is a TPU job (the sweep's moe350_b16 row); expert-sharded TRAINING
+    coverage for this layout lives in test_moe/test_parallel's small
+    twins."""
     from parameter_server_distributed_tpu.models.registry import (
         get_model_and_batches)
 
@@ -331,6 +333,9 @@ def test_moe_350m_preset_shape(rng):
     c = model.config
     assert sum(c.is_moe_layer(i) for i in range(c.n_layers)) == 12
     assert 1.0e9 < model.num_params() < 1.2e9
-    assert model.flops_per_sample() is None
+    fps = model.flops_per_sample()
+    inactive = 12 * (c.moe_experts - c.moe_top_k) * 2 * c.d_model * c.d_ff
+    assert fps == (6.0 * (model.num_params() - inactive) * c.max_seq
+                   + 12.0 * c.n_layers * c.d_model * c.max_seq ** 2)
     tokens, = (next(batches),)
     assert tokens.shape == (2, 1024)

@@ -229,8 +229,16 @@ def test_topk_packed_sparse_roundtrip(rng):
     assert np.count_nonzero(
         m.Tensor.decode(tiny.encode()).to_array()) == 1
     # 0-d scalar: np.prod([]) == 1, so it round-trips as one element
+    # (shape (1,) through packed encodings; .item() — float() on a
+    # 1-element array is deprecated in NumPy 1.25+)
     s = m.Tensor.from_array("s", np.float32(3.5), wire_dtype=m.WIRE_TOPK)
-    assert float(m.Tensor.decode(s.encode()).to_array()) == 3.5
+    assert m.Tensor.decode(s.encode()).to_array().item() == 3.5
+    # u32 index space: a >= 2**32-element tensor would wrap indices on
+    # decode, so encode refuses loudly (zero-stride broadcast view: 4B
+    # elements without the 16 GB allocation)
+    big = np.broadcast_to(np.float32(1.0), (2**32,))
+    with pytest.raises(ValueError, match="u32"):
+        m.Tensor.from_array("g", big, wire_dtype=m.WIRE_TOPK)
     # density > 1 clamps k to the tensor size instead of corrupting
     over = m.Tensor.from_array("o", np.ones(10, np.float32),
                                wire_dtype=m.WIRE_TOPK, topk_density=2.0)

@@ -48,14 +48,18 @@ def init_lora(params: Mapping[str, Array], rank: int = 8,
               targets: Sequence[str] = DEFAULT_TARGETS,
               rng: jax.Array | int = 0) -> dict[str, Array]:
     """Return ``params`` + freshly-initialized adapter entries for every
-    2-D weight whose name ends with one of ``targets`` (scan-stacked
-    [L, in, out] blocks get per-layer factors [L, in, r] / [L, r, out]).
+    >=2-D weight whose name ends with one of ``targets``.  Leading axes
+    are batch axes for per-slice factors: scan-stacked [L, in, out]
+    blocks get [L, in, r] / [L, r, out], pipeline-restacked
+    [P(,V), Lc, in, out] blocks get matching [P(,V), Lc, ...] factors —
+    the adapters inherit the weight's layout, so sharding rules
+    (transformer_rule, pipeline_rule) place them with their base weight.
     A is Gaussian / sqrt(in), B is zero — the adapted model starts
     EXACTLY at the base model."""
     if isinstance(rng, int):
         rng = jax.random.key(rng)
     matched = [name for name, w in params.items()
-               if name.endswith(tuple(targets)) and jnp.ndim(w) in (2, 3)]
+               if name.endswith(tuple(targets)) and jnp.ndim(w) >= 2]
     if not matched:
         raise ValueError(f"no parameters match LoRA targets {targets}; "
                          f"store has e.g. {sorted(params)[:5]}")
@@ -63,12 +67,8 @@ def init_lora(params: Mapping[str, Array], rank: int = 8,
     for name in matched:
         w = params[name]
         rng, sub = jax.random.split(rng)
-        if w.ndim == 3:  # scan-stacked [L, in, out]
-            layers, d_in, d_out = w.shape
-            a_shape, b_shape = (layers, d_in, rank), (layers, rank, d_out)
-        else:
-            d_in, d_out = w.shape
-            a_shape, b_shape = (d_in, rank), (rank, d_out)
+        *lead, d_in, d_out = w.shape
+        a_shape, b_shape = (*lead, d_in, rank), (*lead, rank, d_out)
         out[name + A_SUFFIX] = (jax.random.normal(sub, a_shape, w.dtype)
                                 / math.sqrt(d_in))
         out[name + B_SUFFIX] = jnp.zeros(b_shape, w.dtype)
@@ -113,6 +113,29 @@ def lora_loss(base_loss: Callable,
         return base_loss(_effective(params, alpha), batch)
 
     return loss
+
+
+def lora_value_and_grad(grad_fn: Callable,
+                        alpha: float = DEFAULT_ALPHA) -> Callable:
+    """Compose LoRA with a model whose backward IS a schedule (the 1F1B
+    pipeline's ``value_and_grad``) rather than jax.grad of a loss.
+
+    The schedule computes (loss, grads) w.r.t. an EFFECTIVE dense store;
+    differentiating through :func:`_effective` around it maps those
+    cotangents back to (base, A, B) — d loss/dA = dW_eff @ B^T * scale and
+    d loss/dB = A^T @ dW_eff * scale flow through the ``jax.vjp`` of the
+    collapse, while the base-weight cotangents pass through unchanged
+    (and are then frozen by :func:`freeze_base`).  The wrapped function
+    has the same (params, batch) -> (loss, grads) contract, so
+    ShardedTrainer uses it as a drop-in ``grad_fn``."""
+
+    def value_and_grad(params: Mapping[str, Array], batch):
+        eff, vjp = jax.vjp(lambda p: _effective(p, alpha), dict(params))
+        loss, g_eff = grad_fn(eff, batch)
+        (grads,) = vjp(g_eff)
+        return loss, grads
+
+    return value_and_grad
 
 
 def trainable_mask(params: Mapping[str, Array]) -> dict[str, bool]:

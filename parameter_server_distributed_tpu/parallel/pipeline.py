@@ -106,11 +106,6 @@ class PipelinedTransformerLM:
                 "pipeline + interleaved MoE (moe_every > 1) is not "
                 "supported: stage stacking needs homogeneous blocks; "
                 "use moe_every=1 (all-MoE blocks)")
-        if inner.config.moe_every == 1 and schedule == "1f1b":
-            raise ValueError(
-                "pipeline + MoE currently requires schedule='gpipe' (the "
-                "hand-written 1F1B schedule does not thread the MoE "
-                "aux-loss accumulator yet)")
         if inner.config.scan_layers:
             raise ValueError(
                 "pipeline wraps an unrolled Transformer (it restacks "
@@ -448,11 +443,35 @@ class PipelinedTransformerLM:
                   if k.startswith(self.BLOCK_PREFIX)}
         rest = {k: v for k, v in params.items()
                 if not k.startswith(self.BLOCK_PREFIX)}
+        # MoE (all-MoE blocks): the stage returns (h, aux) and the
+        # schedule threads the aux-loss accumulator through the backward
+        # wave — each valid unit's aux is read off the vjp's PRIMAL (the
+        # recompute forward), and the aux cotangent seeds moe_aux_coef so
+        # router/expert gradients ride the same stage_vjp as the
+        # activation chain.  Expert-axis sharding stays GPipe-only: the
+        # hand-written schedule seeds jax.vjp cotangents mid-shard_map,
+        # which breaks the unreduced-cotangent convention the expert
+        # psum's transpose relies on (measured: expert-weight grads come
+        # out exactly ep x too large) — grad-of-the-whole-shard_map
+        # (GPipe) pairs the transposes correctly, verified by
+        # tests/test_pipeline.py::test_pipelined_moe_expert_sharded_matches.
+        moe = self.config.moe_every == 1
+        aux_coef = self.config.moe_aux_coef
+        ep = mesh.shape.get("expert", 1)
+        if moe and ep > 1:
+            raise ValueError(
+                "pipeline + MoE + expert-axis sharding requires "
+                "schedule='gpipe' (the 1F1B schedule's manual vjp cannot "
+                "thread the expert psum transpose); drop the expert axis "
+                "or use gpipe")
+        if moe:
+            stage_fn = partial(self._stage_fn_aux, sharded_experts=False)
+        else:
+            stage_fn = self._stage_fn
         block_specs = {k: P("pipe", *([None] * (v.ndim - 1)))
                        for k, v in blocks.items()}
         rest_specs = {k: P() for k in rest}
         tok_spec = P(batch_axes, None)
-        stage_fn = self._stage_fn
         head_loss = self._head_loss
         acts_dtype = self.config.dtype
         Lc = self.layers_per_stage
@@ -491,6 +510,7 @@ class PipelinedTransformerLM:
             g_rest = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), rest_in)
             loss_acc = jnp.zeros((), jnp.float32)
+            aux_acc = jnp.zeros((), jnp.float32)
             is_last_rank = my == n_pipe - 1
 
             def masked_add(acc, contrib, mask):
@@ -521,6 +541,8 @@ class PipelinedTransformerLM:
                                                       f_slot, axis=0)
                 state_out = stage_fn(chunk_view(jnp.clip(c_f, 0, V - 1)),
                                      state_in)
+                if moe:  # aux is collected on the backward wave instead
+                    state_out, _ = state_out
 
                 # ---- head: loss + cotangent seed on the LAST stage's
                 # (static) ticks; by the t_b identity the same rank's bwd
@@ -553,8 +575,20 @@ class PipelinedTransformerLM:
                     saved_in = lax.dynamic_index_in_dim(
                         buf, jnp.mod(u_b, K), axis=0, keepdims=False)
                     chunk_b = chunk_view(c_b)
-                    _, stage_vjp = jax.vjp(stage_fn, chunk_b, saved_in)
-                    g_blk_m, dx = stage_vjp(cot.astype(acts_dtype))
+                    primal, stage_vjp = jax.vjp(stage_fn, chunk_b,
+                                                saved_in)
+                    if moe:
+                        # the vjp's primal IS the recompute forward, so
+                        # the unit's aux comes for free; seeding the aux
+                        # cotangent with its loss weight sends router/
+                        # expert gradients down the same backward
+                        aux_acc = aux_acc + jnp.where(bvalid, primal[1],
+                                                      0.0)
+                        g_blk_m, dx = stage_vjp(
+                            (cot.astype(acts_dtype),
+                             jnp.asarray(aux_coef, jnp.float32)))
+                    else:
+                        g_blk_m, dx = stage_vjp(cot.astype(acts_dtype))
                     if V == 1:
                         g_chunks = masked_add(
                             g_chunks,
@@ -578,8 +612,12 @@ class PipelinedTransformerLM:
                     cot_recv = lax.ppermute(dx_send, "pipe", bwd_perm)
 
             # reductions: microbatch mean, then mean over the data shards;
-            # loss/head/embed live on single ranks -> share over pipe
-            loss = lax.pmean(lax.psum(loss_acc, "pipe") / M, batch_axes)
+            # loss/head/embed live on single ranks -> share over pipe.
+            # MoE: the aux term joins with its coefficient — the reported
+            # loss matches the GPipe path's head + coef * aux
+            total_acc = (loss_acc + aux_coef * aux_acc if moe
+                         else loss_acc)
+            loss = lax.pmean(lax.psum(total_acc, "pipe") / M, batch_axes)
             g_blocks = jax.tree.map(
                 lambda g, p: lax.pmean(
                     g.reshape(p[0].shape) / M, batch_axes).astype(

@@ -225,3 +225,107 @@ def test_spec_parsing_and_errors():
     p = init_lora({"x/attn/wq": jnp.zeros((4, 4))}, rank=2)
     mask = trainable_mask(p)
     assert mask["x/attn/wq/lora_a"] and not mask["x/attn/wq"]
+
+
+def test_lora_composes_with_pipeline(rng):
+    """LoRA x pipeline: adapters follow the blocks/* restack ([P, Lc, d, r]
+    factors), and lora_value_and_grad differentiates through the adapter
+    collapse around the 1F1B schedule.  At init (B = 0) the loss equals
+    the base pipelined model's; dL/dA = dW @ B^T = 0 while dL/dB != 0 —
+    exactly the vjp chain through W_eff = W + scale * A @ B."""
+    from parameter_server_distributed_tpu.config import MeshConfig
+    from parameter_server_distributed_tpu.models.lora import (
+        init_lora, lora_value_and_grad)
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.mesh import build_mesh
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=4,
+                               d_ff=64, max_seq=16, dtype=jnp.float32)
+    piped = PipelinedTransformerLM(Transformer(config), mesh,
+                                   num_microbatches=2, schedule="1f1b")
+    tokens = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    base_params = piped.init_params(0)
+    params = init_lora(base_params, rank=2, rng=1)
+    assert params["blocks/attn/wq/lora_a"].shape == (2, 2, 32, 2)
+    assert params["blocks/attn/wq/lora_b"].shape == (2, 2, 2, 32)
+
+    vg = jax.jit(lora_value_and_grad(piped.value_and_grad, alpha=4.0))
+    loss0, grads = vg(params, tokens)
+    loss_base, _ = jax.jit(piped.value_and_grad)(base_params, tokens)
+    np.testing.assert_allclose(float(loss0), float(loss_base), rtol=1e-5)
+    assert float(np.abs(np.asarray(
+        grads["blocks/attn/wq/lora_b"])).max()) > 0
+    np.testing.assert_allclose(
+        np.asarray(grads["blocks/attn/wq/lora_a"]), 0.0, atol=1e-7)
+    # base cotangents pass through the collapse unchanged
+    assert float(np.abs(np.asarray(grads["blocks/attn/wq"])).max()) > 0
+
+
+def test_train_loop_lora_pipeline_and_ema(tmp_path):
+    """The full round-5 composition: --lora x pipeline (1F1B) x --ema in
+    one run_training — adapters train under the pipe schedule, the EMA
+    shadow tracks only the adapters (freeze_base masks params_ema), and
+    the end-of-run eval grafts the shadowed adapters onto the frozen base
+    to report ema_eval_loss."""
+    from parameter_server_distributed_tpu.config import MeshConfig
+    from parameter_server_distributed_tpu.parallel.train_loop import (
+        TrainLoopConfig, run_training)
+
+    summary = run_training(TrainLoopConfig(
+        model="small_lm4", batch_size=8, steps=4, optimizer="adam",
+        learning_rate=1e-2, lora="2:4", ema=0.5, eval_every=2,
+        log_every=2, pipeline_schedule="1f1b",
+        mesh=MeshConfig(pipeline=2, data=4)))
+    assert summary["steps"] == 4
+    assert np.isfinite(summary["final_loss"])
+    assert np.isfinite(summary["eval_loss"])
+    assert summary["ema_eval_loss"] is not None
+    assert np.isfinite(summary["ema_eval_loss"])
+
+
+def test_lora_ema_shadow_tracks_adapters_only(rng):
+    """--ema x --lora at the optimizer level: freeze_base(make_optimizer
+    (ema_decay>0)) masks params_ema to the adapters, extract_ema returns
+    MaskedNode for frozen entries, and the grafted store (shadowed
+    adapters on the frozen base) is the EMA of the full store."""
+    import optax
+
+    from parameter_server_distributed_tpu.models.lora import (
+        freeze_base, init_lora, lora_loss, trainable_mask)
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.train_step import (
+        extract_ema, make_optimizer)
+
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                               d_ff=64, max_seq=16, dtype=jnp.float32)
+    model = Transformer(config)
+    params = init_lora(model.init_params(0), rank=2, rng=1)
+    loss_fn = lora_loss(model.loss, alpha=4.0)
+    opt = freeze_base(make_optimizer("adam", 1e-2, ema_decay=0.5))
+    state = opt.init(params)
+    tokens = rng.integers(0, 64, (4, 16)).astype(np.int32)
+
+    shadows = []
+    for _ in range(3):
+        grads = jax.grad(loss_fn)(params, tokens)
+        updates, state = opt.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        ema = extract_ema(state)
+        assert ema is not None
+        shadows.append(ema)
+    mask = trainable_mask(params)
+    for name, trains in mask.items():
+        if trains:
+            assert isinstance(ema[name], jax.Array), name
+        else:
+            assert isinstance(ema[name], optax.MaskedNode), name
+    # decay 0.5: shadow lags the live adapter, converging toward it
+    live = np.asarray(params["layer0/attn/wq/lora_b"])
+    shadow = np.asarray(shadows[-1]["layer0/attn/wq/lora_b"])
+    assert np.abs(shadow).max() > 0
+    assert not np.allclose(shadow, live)

@@ -627,11 +627,13 @@ def test_pipeline_moe_rejections(rng):
         moe_every=2, moe_experts=4))
     with pytest.raises(ValueError, match="homogeneous"):
         PipelinedTransformerLM(interleaved, mesh)
+    # 1F1B x MoE composes since round 5 (aux threads through the
+    # backward wave) — construction must NOT raise
     all_moe = Transformer(TransformerConfig(
         vocab=64, d_model=32, n_heads=4, n_layers=4, d_ff=64, max_seq=16,
         moe_every=1, moe_experts=4))
-    with pytest.raises(ValueError, match="gpipe"):
-        PipelinedTransformerLM(all_moe, mesh, schedule="1f1b")
+    piped = PipelinedTransformerLM(all_moe, mesh, schedule="1f1b")
+    assert piped.schedule == "1f1b"
 
 
 def test_pipelined_moe_expert_sharded_matches_replicated(rng):
@@ -671,3 +673,124 @@ def test_pipelined_moe_expert_sharded_matches_replicated(rng):
     grads = jax.grad(piped_ep.loss)(piped_ep.init_params(0), tokens)
     for name in ("blocks/moe/w1", "blocks/moe/w2", "blocks/moe/router/w"):
         assert float(np.abs(np.asarray(grads[name])).max()) > 0, name
+
+
+def test_pipelined_moe_1f1b_matches_gpipe(rng):
+    """1F1B x MoE: the hand-written schedule threads the aux-loss
+    accumulator (each valid unit's aux read off the backward vjp's primal,
+    cotangent seeded with moe_aux_coef), so loss AND gradients must match
+    GPipe-by-autodiff on the same microbatch split — the two schedules
+    are different orderings of identical math."""
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=4,
+                               d_ff=64, max_seq=16, dtype=jnp.float32,
+                               moe_every=1, moe_experts=4)
+    plain = Transformer(config)
+    gp = PipelinedTransformerLM(plain, mesh, num_microbatches=2,
+                                schedule="gpipe")
+    fb = PipelinedTransformerLM(plain, mesh, num_microbatches=2,
+                                schedule="1f1b")
+    tokens = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    params = gp.init_params(0)
+    loss_g, grads_g = jax.jit(gp.value_and_grad)(params, tokens)
+    loss_f, grads_f = jax.jit(fb.value_and_grad)(params, tokens)
+    np.testing.assert_allclose(float(loss_f), float(loss_g), rtol=1e-5)
+    assert set(grads_f) == set(grads_g)
+    for name in grads_g:
+        np.testing.assert_allclose(np.asarray(grads_f[name]),
+                                   np.asarray(grads_g[name]),
+                                   rtol=5e-4, atol=1e-6, err_msg=name)
+    # router/expert gradients actually flow under 1F1B
+    for name in ("blocks/moe/w1", "blocks/moe/w2", "blocks/moe/router/w"):
+        assert float(np.abs(np.asarray(grads_f[name])).max()) > 0, name
+
+
+def test_pipelined_moe_1f1b_expert_axis_rejected(rng):
+    """1F1B x MoE x expert sharding is explicitly out of scope: the manual
+    schedule seeds jax.vjp cotangents mid-shard_map, which breaks the
+    unreduced-cotangent convention the expert psum transpose relies on
+    (measured: expert grads come out exactly ep x too large).  GPipe owns
+    expert parallelism — and its grads are verified correct below."""
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=4,
+                               d_ff=64, max_seq=16, dtype=jnp.float32,
+                               moe_every=1, moe_experts=4)
+    plain = Transformer(config)
+    tokens = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    mesh_ep = build_mesh(MeshConfig(pipeline=2, expert=2, data=2))
+    fb_ep = PipelinedTransformerLM(plain, mesh_ep, num_microbatches=2,
+                                   schedule="1f1b")
+    with pytest.raises(ValueError, match="gpipe"):
+        fb_ep.value_and_grad(fb_ep.init_params(0), tokens)
+
+
+def test_pipelined_moe_expert_sharded_grads_match_replicated(rng):
+    """GPipe x MoE x expert sharding, GRADIENT equality (the existing
+    sharded-vs-replicated test checks the loss and grad flow only):
+    differentiating the whole shard_map pairs the expert-psum transposes
+    correctly, so every gradient must match the expert-replicated run."""
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=4,
+                               d_ff=64, max_seq=16, dtype=jnp.float32,
+                               moe_every=1, moe_experts=4)
+    plain = Transformer(config)
+    tokens = rng.integers(0, 64, (8, 16)).astype(np.int32)
+
+    mesh_ep = build_mesh(MeshConfig(pipeline=2, expert=2, data=2))
+    gp_ep = PipelinedTransformerLM(plain, mesh_ep, num_microbatches=2,
+                                   schedule="gpipe")
+    g_ep = jax.jit(jax.grad(gp_ep.loss))(gp_ep.init_params(0), tokens)
+
+    mesh_rep = build_mesh(MeshConfig(pipeline=2, tensor=2, data=2))
+    gp_rep = PipelinedTransformerLM(plain, mesh_rep, num_microbatches=2,
+                                    schedule="gpipe")
+    g_rep = jax.jit(jax.grad(gp_rep.loss))(gp_rep.init_params(0), tokens)
+    for name in ("blocks/moe/w1", "blocks/moe/w2", "blocks/moe/router/w",
+                 "blocks/attn/wq", "embed/tok"):
+        np.testing.assert_allclose(np.asarray(g_ep[name]),
+                                   np.asarray(g_rep[name]),
+                                   rtol=5e-4, atol=1e-6, err_msg=name)
+
+
+def test_pipelined_moe_1f1b_interleaved_matches_plain_1f1b(rng):
+    """1F1B x MoE x virtual stages: interleaving re-chunks the SAME layer
+    sequence over the same microbatch split, so V=2 must reproduce V=1
+    exactly."""
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=4,
+                               d_ff=64, max_seq=16, dtype=jnp.float32,
+                               moe_every=1, moe_experts=4)
+    plain = Transformer(config)
+    v1 = PipelinedTransformerLM(plain, mesh, num_microbatches=2,
+                                schedule="1f1b")
+    v2 = PipelinedTransformerLM(plain, mesh, num_microbatches=2,
+                                schedule="1f1b", virtual_stages=2)
+    tokens = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    loss1, grads1 = jax.jit(v1.value_and_grad)(v1.init_params(0), tokens)
+    loss2, grads2 = jax.jit(v2.value_and_grad)(v2.init_params(0), tokens)
+    np.testing.assert_allclose(float(loss2), float(loss1), rtol=1e-5)
+    # layouts differ ([P,Lc] vs [P,V,Lc']) — compare through flat_params
+    flat1 = v1.flat_params(grads1)
+    flat2 = v2.flat_params(grads2)
+    for name in flat1:
+        np.testing.assert_allclose(np.asarray(flat2[name]),
+                                   np.asarray(flat1[name]),
+                                   rtol=5e-4, atol=1e-6, err_msg=name)

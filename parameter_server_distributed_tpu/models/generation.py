@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 from collections import OrderedDict
+from functools import partial
 from typing import Any, Mapping
 
 import jax
@@ -745,60 +746,177 @@ def _sampling_accept(vlogits: Array, props: Array, q_rows: list,
     return m, corr
 
 
-def _spec_batched_runner(target: Transformer, draft: Transformer,
-                         max_new_tokens: int, draft_len: int,
-                         temperature: float, cache_dtype: str = "native"):
-    """Compiled whole-loop batched speculative decoder (see
-    :func:`speculative_generate_batched`).  One jit: prefill both models,
-    then a lax.while_loop whose body is draft-propose -> verify ->
-    vectorized accept/resample — no host round-trips inside the loop."""
-    key_tuple = (_model_key(target), _model_key(draft), "spec_batched",
-                 max_new_tokens, draft_len, temperature, cache_dtype)
+def _init_spec_carry(target, tparams, draft, dparams, prompt, cap: int,
+                     max_len: int, temperature: float, seed: int,
+                     cache_dtype: str):
+    """Prefill both models and build the carry the speculative segment
+    runners thread: (n_out, out, cur, y, lt, pc, t_cache, d_cache, rng,
+    stats[verifies, accepts, active_rows]) — the single definition of
+    the speculative decode state, shared by the fixed-depth and
+    adaptive paths."""
+    batch, s = prompt.shape
+    t_logits, t_cache = prefill(target, tparams, prompt, max_len,
+                                cache_dtype)
+    _, d_cache = prefill(draft, dparams, prompt, max_len, cache_dtype)
+    rng = jax.random.key(seed)
+    if temperature > 0.0:
+        rng, k0 = jax.random.split(rng)
+        cur = jax.random.categorical(k0, t_logits / temperature,
+                                     axis=-1).astype(jnp.int32)
+    else:
+        cur = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+    out = jnp.zeros((batch, cap), jnp.int32).at[:, 0].set(cur)
+    return (jnp.ones((batch,), jnp.int32), out, cur,
+            jnp.asarray(prompt[:, -1], jnp.int32),
+            jnp.full((batch,), s, jnp.int32),
+            jnp.full((batch,), s, jnp.int32),
+            t_cache, d_cache, rng, jnp.zeros((3,), jnp.int32))
+
+
+def _spec_round_runner(target: Transformer, draft: Transformer,
+                       draft_len: int, cache_dtype: str,
+                       temperature: float = 0.0):
+    """Jitted per (target, draft, k, T): ONE speculative round over ALL
+    slots — draft catch-up block + k-1 single proposals, one target
+    verify block, vectorized acceptance.  The same math as
+    generation._spec_segment_runner's loop body, but one round per call
+    so the host can admit/retire requests between rounds (continuous
+    batching).  Greedy (T=0, longest matching prefix) is token-exact
+    whatever each slot's accept rate; T>0 applies the Leviathan/Chen
+    rejection rule, preserving the target's sampling distribution.
+    Returns (commit [B, k+1], n_commit [B], cur_new [B], y_new [B],
+    t_cache, d_cache, rng)."""
+    key = (_model_key(target), _model_key(draft), "serve_spec_round",
+           draft_len, cache_dtype, temperature)
+    k_draft = draft_len
+    sampling = temperature > 0.0
+
+    def build():
+        @partial(jax.jit, donate_argnums=(4, 5))
+        def run(tparams, dparams, cur, y, t_cache, d_cache, lt, pc, rng):
+            batch = cur.shape[0]
+            iota_k1 = jnp.arange(k_draft + 1, dtype=jnp.int32)
+            # draft: catch-up block [y, cur] (re-writing y's slot is a
+            # no-op; writing fresh is the full-accept catch-up), then
+            # k-1 single steps
+            dl, d_cache = decode_block(
+                draft, dparams, jnp.stack([y, cur], axis=1), d_cache,
+                lengths=pc - 1)
+            rng, *keys = jax.random.split(rng, k_draft + 4)
+            props, q_rows, d_cache = _draft_propose(
+                draft, dparams, dl[:, 1], d_cache, pc, k_draft,
+                temperature, keys)
+            # target verifies [cur, p_1..p_k] in one ragged forward
+            block = jnp.concatenate([cur[:, None], props], axis=1)
+            vlogits, t_cache = decode_block(target, tparams, block,
+                                            t_cache, lengths=lt)
+            if sampling:
+                m, corr = _sampling_accept(
+                    vlogits, props, q_rows, temperature, keys[k_draft],
+                    keys[k_draft + 1], keys[k_draft + 2])
+            else:
+                m, corr = _greedy_accept(vlogits, props)
+            ext = jnp.concatenate(
+                [props, jnp.zeros((batch, 1), jnp.int32)], axis=1)
+            commit = jnp.where(iota_k1[None, :] < m[:, None], ext,
+                               corr[:, None])             # [B, k+1]
+            prev = jnp.take_along_axis(
+                props, jnp.clip(m - 1, 0, k_draft - 1)[:, None], 1)[:, 0]
+            y_new = jnp.where(m == 0, cur, prev)
+            return commit, m + 1, corr, y_new, t_cache, d_cache, rng
+
+        return run
+
+    return _cached_runner(key, build)
+
+
+def _invert_accept_fraction(f: float, k: int) -> float:
+    """Per-proposal agreement p from a measured accept FRACTION
+    f = E[m]/k at depth k, under the geometric-acceptance model
+    E[m] = sum_{i=1..k} p^i (each proposal agrees independently with
+    probability p; the round commits the longest agreeing prefix).
+    Monotone in p -> bisection."""
+    if f <= 0.0:
+        return 0.0
+    if f >= 1.0:
+        return 1.0
+    lo, hi = 0.0, 1.0
+    for _ in range(40):
+        mid = (lo + hi) / 2
+        if sum(mid ** i for i in range(1, k + 1)) / k < f:
+            lo = mid
+        else:
+            hi = mid
+    return (lo + hi) / 2
+
+
+def optimal_draft_depth(accept_frac: float, k: int, k_max: int,
+                        cost_ratio: float,
+                        round_overhead: float = 0.25,
+                        allow_disable: bool = False) -> int:
+    """The depth maximizing expected tokens per round COST: a round at
+    depth j commits E(p, j) = (1 - p^(j+1)) / (1 - p) tokens (accepted
+    prefix + correction/bonus) and costs ``round_overhead`` + 1 target
+    forward + j draft forwards at ``cost_ratio`` target-units each.
+    ``round_overhead`` is the spec round's fixed overhead IN EXCESS OF
+    a plain greedy step (extra dispatches: draft catch-up block, wider
+    verify, commit bookkeeping) — defined that way, plain greedy scores
+    exactly 1.0 token/unit, which is what the ``allow_disable``
+    threshold compares against; it also breaks the cost_ratio=1.0 tie
+    toward deeper drafts (fewer rounds, less excess overhead).
+    ``accept_frac`` is the measured fraction at the CURRENT depth k
+    (inverted to per-proposal agreement p first — fractions are not
+    comparable across depths).  This model reproduces the round-4
+    measurements: p=0.57, rho~1/3 -> k* in {1, 2} at ~1.2x, k=4 scoring
+    ~0.9x (the observed 0.76x over-speculation loss)."""
+    p = _invert_accept_fraction(accept_frac, k)
+    best_k, best = 1, -1.0
+    for j in range(1, max(1, k_max) + 1):
+        expect = (j + 1.0 if p >= 1.0
+                  else (1.0 - p ** (j + 1)) / (1.0 - p))
+        score = expect / (round_overhead + 1.0 + cost_ratio * j)
+        if score > best:
+            best, best_k = score, j
+    if allow_disable and best < 1.0:
+        # even the best depth expects fewer tokens per cost than plain
+        # greedy decoding (score 1.0): speculation cannot pay with this
+        # draft — k=0 means "decode greedy", the arm that makes adaptive
+        # speculation never lose beyond its calibration segment
+        return 0
+    return best_k
+
+
+def _spec_segment_runner(target: Transformer, draft: Transformer,
+                         cap: int, max_new_tokens: int, draft_len: int,
+                         temperature: float, cache_dtype: str):
+    """Resumable segment of the whole-loop batched speculative decoder:
+    the speculative while_loop body over an explicit carry: the
+    carry is an argument/result and the loop runs until every row
+    reaches a TRACED ``seg_target`` — so an adaptive driver can run a
+    few segments with different depths k (one compiled program per k,
+    shared carry shapes sized by ``cap``/k_max) and re-pick k between
+    them from the measured accept rate, keeping the decode device-bound
+    (host syncs per SEGMENT, not per round)."""
+    key_tuple = (_model_key(target), _model_key(draft), "spec_segment",
+                 cap, max_new_tokens, draft_len, temperature, cache_dtype)
     k_draft = draft_len
     sampling = temperature > 0.0
 
     def build():
         @jax.jit
-        def run(tparams, dparams, prompt, rng_key):
-            batch, s = prompt.shape
-            cap = max_new_tokens + k_draft + 1
-            max_len = s + cap + k_draft + 2
+        def run(tparams, dparams, carry, seg_target):
+            batch = carry[0].shape[0]
             bidx = jnp.arange(batch, dtype=jnp.int32)[:, None]
             iota_k1 = jnp.arange(k_draft + 1, dtype=jnp.int32)
 
-            t_logits, t_cache = prefill(target, tparams, prompt, max_len,
-                                        cache_dtype)
-            _, d_cache = prefill(draft, dparams, prompt, max_len,
-                                 cache_dtype)
-
-            def sample(logits, key):
-                if not sampling:
-                    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return jax.random.categorical(
-                    key, logits / temperature, axis=-1).astype(jnp.int32)
-
-            rng_key, k0 = jax.random.split(rng_key)
-            cur = sample(t_logits, k0)                       # [B]
-            out = jnp.zeros((batch, cap), jnp.int32)
-            out = out.at[:, 0].set(cur)
-            n_out = jnp.ones((batch,), jnp.int32)
-            lt = jnp.full((batch,), s, jnp.int32)   # next target write pos
-            pc = jnp.full((batch,), s, jnp.int32)   # draft position of cur
-            y = prompt[:, -1]                       # token cached at pc-1
-            stats0 = jnp.zeros((3,), jnp.int32)  # verifies, accepts, rows
-
             def cond(carry):
-                return jnp.any(carry[0] < max_new_tokens)
+                return jnp.any(carry[0] < seg_target)
 
             def body(carry):
                 (n_out, out, cur, y, lt, pc, t_cache, d_cache, rng_key,
                  stats) = carry
                 active = n_out < max_new_tokens
 
-                # --- draft: catch-up block [y, cur] (re-writing y's slot
-                # with identical K/V is a no-op; writing it fresh is the
-                # full-accept catch-up), then k-1 single steps.  Produces
-                # proposals p_1..p_k and their distributions.
                 dl, d_cache = decode_block(
                     draft, dparams, jnp.stack([y, cur], axis=1), d_cache,
                     lengths=pc - 1)
@@ -807,12 +925,10 @@ def _spec_batched_runner(target: Transformer, draft: Transformer,
                     draft, dparams, dl[:, 1], d_cache, pc, k_draft,
                     temperature, keys)
 
-                # --- target verifies [cur, p_1..p_k] in one forward
                 block = jnp.concatenate([cur[:, None], props], axis=1)
                 vlogits, t_cache = decode_block(target, tparams, block,
                                                 t_cache, lengths=lt)
 
-                # --- vectorized acceptance (shared single definition)
                 if sampling:
                     rng_key, kr, kb = jax.random.split(rng_key, 3)
                     m, corr = _sampling_accept(vlogits, props, q_rows,
@@ -821,7 +937,6 @@ def _spec_batched_runner(target: Transformer, draft: Transformer,
                 else:
                     m, corr = _greedy_accept(vlogits, props)
 
-                # --- commit p_1..p_m then the correction/bonus token
                 ext = jnp.concatenate([props, jnp.zeros((batch, 1),
                                                         jnp.int32)], 1)
                 commit = jnp.where(iota_k1[None, :] < m[:, None], ext,
@@ -829,9 +944,6 @@ def _spec_batched_runner(target: Transformer, draft: Transformer,
                 n_commit = m + 1
                 idx = jnp.clip(n_out[:, None] + iota_k1[None, :], 0,
                                cap - 1)
-                # garbage lanes (i >= n_commit) land ahead of the valid
-                # frontier and are overwritten by later rounds' valid
-                # writes; done rows clip into the slack region >= max_new
                 out = out.at[bidx, idx].set(commit)
                 prev = jnp.take_along_axis(
                     props, jnp.clip(m - 1, 0, k_draft - 1)[:, None],
@@ -844,22 +956,261 @@ def _spec_batched_runner(target: Transformer, draft: Transformer,
                 return (n_out + n_commit, out, corr, y_new, lt + n_commit,
                         pc + n_commit, t_cache, d_cache, rng_key, stats)
 
-            carry = (n_out, out, cur, y, lt, pc, t_cache, d_cache,
-                     rng_key, stats0)
-            (n_out, out, *_rest, stats) = jax.lax.while_loop(
-                cond, body, carry)
-            return out[:, :max_new_tokens], stats
+            return jax.lax.while_loop(cond, body, carry)
 
         return run
 
     return _cached_runner(key_tuple, build)
 
 
+def _greedy_segment_runner(target: Transformer, cap: int,
+                           max_new_tokens: int, temperature: float,
+                           cache_dtype: str):
+    """Plain-greedy segment over the SAME carry as
+    :func:`_spec_segment_runner` — the k=0 arm of adaptive speculation:
+    when the controller concludes speculation cannot pay (see
+    :func:`optimal_draft_depth` ``allow_disable``), remaining tokens
+    decode one-per-round with the target alone.  Draft-side carry fields
+    (y, pc, d_cache) pass through untouched (stale but unused)."""
+    key_tuple = (_model_key(target), "greedy_segment", cap,
+                 max_new_tokens, temperature, cache_dtype)
+    sampling = temperature > 0.0
+
+    def build():
+        @jax.jit
+        def run(tparams, carry, seg_target):
+            batch = carry[0].shape[0]
+            bidx = jnp.arange(batch, dtype=jnp.int32)
+
+            def cond(carry):
+                return jnp.any(carry[0] < seg_target)
+
+            def body(carry):
+                (n_out, out, cur, y, lt, pc, t_cache, d_cache, rng_key,
+                 stats) = carry
+                logits, t_cache = decode_block(target, tparams,
+                                               cur[:, None], t_cache,
+                                               lengths=lt)
+                if sampling:
+                    rng_key, kk = jax.random.split(rng_key)
+                    nxt = jax.random.categorical(
+                        kk, logits[:, 0] / temperature,
+                        axis=-1).astype(jnp.int32)
+                else:
+                    nxt = jnp.argmax(logits[:, 0],
+                                     axis=-1).astype(jnp.int32)
+                out = out.at[bidx, jnp.clip(n_out, 0, cap - 1)].set(nxt)
+                stats = stats + jnp.stack(
+                    [jnp.ones((), jnp.int32), jnp.zeros((), jnp.int32),
+                     jnp.zeros((), jnp.int32)])
+                return (n_out + 1, out, nxt, y, lt + 1, pc, t_cache,
+                        d_cache, rng_key, stats)
+
+            return jax.lax.while_loop(cond, body, carry)
+
+        return run
+
+    return _cached_runner(key_tuple, build)
+
+
+# Calibrated depths memoized per (target, draft, sampling, cache) pair:
+# the first adaptive call pays a segmented calibration run; every later
+# call jumps straight to the winning FUSED program (whole-loop spec at
+# k*, or plain generate when speculation cannot pay) — steady-state
+# adaptive throughput equals the best fixed configuration by
+# construction.  Params are assumed fixed per model object (true for
+# serving and benching; retraining under the same object should clear
+# this).
+_DEPTH_MEMO: dict = {}
+
+
+def _speculative_adaptive(target, tparams, draft, dparams, prompt,
+                          max_new_tokens: int, k_max: int,
+                          temperature: float, seed: int, cache_dtype: str,
+                          cost_ratio: float,
+                          calibration: str = "measured"
+                          ) -> tuple[Array, dict]:
+    """Adaptive-depth speculative decoding (see
+    :func:`speculative_generate_batched` ``adaptive=True``).
+
+    The generation runs as a handful of on-device SEGMENTS of the
+    whole-loop decoder (:func:`_spec_segment_runner` — carry threaded
+    through, one compiled program per depth), and between segments the
+    controller re-picks the depth k via :func:`optimal_draft_depth`:
+    invert the segment's accept fraction to per-proposal agreement p,
+    then argmax expected-tokens/round-cost over 1..k_max with the
+    caller-measured draft/target ``cost_ratio``.  Fixed k=4 at accept
+    0.36 measured 0.76x vs greedy (round 4): this controller lands on
+    the profitable depth instead, at ~4 host syncs per generation.
+    Token-exact for greedy at ANY depth sequence."""
+    sampling = temperature > 0.0
+    if calibration not in ("measured", "model"):
+        raise ValueError(f"calibration must be 'measured' or 'model', "
+                         f"got {calibration!r}")
+    memo_key = (_model_key(target), _model_key(draft), k_max,
+                temperature, cache_dtype, cost_ratio, calibration)
+    k_known = _DEPTH_MEMO.get(memo_key)
+    if k_known == 0:
+        # calibration concluded speculation cannot pay: steady state IS
+        # plain fused decoding (token-exact for greedy; for temperature
+        # sampling the speculative path preserves the same distribution)
+        out = generate(target, tparams, prompt, max_new_tokens,
+                       temperature=temperature, rng=seed,
+                       cache_dtype=cache_dtype)
+        return np.asarray(out), {
+            "verify_calls": max_new_tokens,
+            "draft_accept_rate": 0.0,
+            "tokens_per_target_forward": 1.0,
+            "draft_depth": 0, "draft_depths": ["memo"],
+        }
+    if k_known is not None:
+        # steady state at the calibrated depth: one full-length compiled
+        # segment (no calibration boundaries, no extra host syncs)
+        out, stats = _run_fixed_spec(
+            target, tparams, draft, dparams, prompt, max_new_tokens,
+            k_known, temperature, seed, cache_dtype)
+        stats["draft_depth"] = k_known
+        stats["draft_depths"] = ["memo"]
+        return out, stats
+
+    # ---- first call for this pair: MEASURED calibration.  Two timed
+    # probes on this host — a spec segment at k0 and a greedy segment —
+    # decide empirically (wall-clock tokens/sec), with the analytic model
+    # only extrapolating the spec rate across depths.  Each probe runs
+    # twice from the same carry (pure function): the first run absorbs
+    # compilation, the second is the measurement.
+    import time as _time
+
+    prompt = jnp.asarray(prompt, jnp.int32)
+    batch, s = prompt.shape
+    cap = max_new_tokens + k_max + 1
+    max_len = s + cap + k_max + 2
+    carry = _init_spec_carry(target, tparams, draft, dparams, prompt,
+                             cap, max_len, float(temperature), seed,
+                             cache_dtype)
+    k0 = min(2, k_max)
+    seg = max(8, min(24, max_new_tokens // 4))
+    t1 = min(max_new_tokens, seg)
+    t2 = min(max_new_tokens, 3 * seg)
+    spec_runner = _spec_segment_runner(target, draft, cap,
+                                       max_new_tokens, k0,
+                                       float(temperature), cache_dtype)
+    greedy_runner = _greedy_segment_runner(target, cap, max_new_tokens,
+                                           float(temperature),
+                                           cache_dtype)
+
+    def timed(runner, args, carry, target_n):
+        tgt = jnp.asarray(target_n, jnp.int32)
+        warm = runner(*args, carry, tgt)
+        np.asarray(warm[0])                     # compile + drain
+        t0 = _time.perf_counter()
+        res = runner(*args, carry, tgt)
+        np.asarray(res[0])
+        return res, _time.perf_counter() - t0
+
+    tokens_before = int(np.asarray(carry[0], np.int64).sum())
+    carry, dt_spec = timed(spec_runner, (tparams, dparams), carry, t1)
+    stats1 = np.asarray(carry[9], np.int64)
+    spec_tokens = int(np.asarray(carry[0], np.int64).sum()) - tokens_before
+    rate_spec = spec_tokens / max(dt_spec, 1e-9)
+    frac = float(stats1[1]) / max(1, int(stats1[2]) * k0)
+    proposed_total = int(stats1[2]) * k0
+    depths: list[int] = [k0]
+
+    p = _invert_accept_fraction(frac, k0)
+    rate_greedy = float("nan")
+    if calibration == "measured":
+        # greedy probe, then extrapolate the measured spec rate across
+        # depths with the model's RELATIVE scores and compare measured
+        # against measured
+        tokens_before = int(np.asarray(carry[0], np.int64).sum())
+        carry, dt_greedy = timed(greedy_runner, (tparams,), carry, t2)
+        greedy_tokens = (int(np.asarray(carry[0], np.int64).sum())
+                         - tokens_before)
+        rate_greedy = greedy_tokens / max(dt_greedy, 1e-9)
+        depths.append(0)
+
+        def score(j):
+            expect = (j + 1.0 if p >= 1.0
+                      else (1.0 - p ** (j + 1)) / (1.0 - p))
+            return expect / (0.25 + 1.0 + cost_ratio * j)
+
+        best_j = max(range(1, max(1, k_max) + 1), key=score)
+        est_best = rate_spec * score(best_j) / score(k0)
+        k = best_j if est_best > rate_greedy * 1.02 else 0
+    else:
+        # "model": deterministic, timing-free decision (tests; hosts
+        # where two short probes cannot be timed meaningfully)
+        k = optimal_draft_depth(frac, k0, k_max, cost_ratio,
+                                allow_disable=True)
+    _DEPTH_MEMO[memo_key] = k
+
+    # ---- finish the remaining tokens at the decided configuration
+    if k == 0:
+        carry = greedy_runner(tparams, carry,
+                              jnp.asarray(max_new_tokens, jnp.int32))
+        depths.append(0)
+    else:
+        runner = (_spec_segment_runner(target, draft, cap,
+                                       max_new_tokens, k,
+                                       float(temperature), cache_dtype)
+                  if k != k0 else spec_runner)
+        pre = np.asarray(carry[9], np.int64)
+        carry = runner(tparams, dparams, carry,
+                       jnp.asarray(max_new_tokens, jnp.int32))
+        post = np.asarray(carry[9], np.int64)
+        proposed_total += int(post[2] - pre[2]) * k
+        depths.append(k)
+    final = np.asarray(carry[9], np.int64)
+    verifies, accepted = int(final[0]), int(final[1])
+    tokens = np.asarray(carry[1])[:, :max_new_tokens]
+    return tokens, {
+        "verify_calls": verifies,
+        "draft_accept_rate": accepted / max(1, proposed_total),
+        "tokens_per_target_forward": tokens.size / max(
+            1, batch * (verifies + 1)),
+        "draft_depth": k,            # depth the controller settled on
+        "draft_depths": depths,      # [probe_k, 0(greedy probe), chosen]
+        "calibration": {"rate_spec": rate_spec,
+                        "rate_greedy": rate_greedy, "p": p},
+    }
+
+
+def _run_fixed_spec(target, tparams, draft, dparams, prompt,
+                    max_new_tokens: int, k: int, temperature: float,
+                    seed: int, cache_dtype: str) -> tuple[Array, dict]:
+    """One fixed-depth run (shared by the non-adaptive path and the
+    adaptive steady state): init the carry, run ONE full-length segment
+    of the compiled while_loop, convert stats."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    batch, s = prompt.shape
+    cap = max_new_tokens + k + 1
+    max_len = s + cap + k + 2
+    carry = _init_spec_carry(target, tparams, draft, dparams, prompt,
+                             cap, max_len, float(temperature), seed,
+                             cache_dtype)
+    runner = _spec_segment_runner(target, draft, cap, max_new_tokens, k,
+                                  float(temperature), cache_dtype)
+    carry = runner(tparams, dparams, carry,
+                   jnp.asarray(max_new_tokens, jnp.int32))
+    verifies, accepted, active_rows = (
+        int(x) for x in np.asarray(carry[9]))
+    return np.asarray(carry[1])[:, :max_new_tokens], {
+        "verify_calls": verifies,
+        "draft_accept_rate": accepted / max(1, active_rows * k),
+        # +1: the prefill forward produced each row's first token
+        "tokens_per_target_forward": batch * max_new_tokens / max(
+            1, batch * (verifies + 1)),
+    }
+
+
 def speculative_generate_batched(
         target: Transformer, target_params, draft: Transformer,
         draft_params, prompt: Array, max_new_tokens: int, *,
         draft_len: int = 4, temperature: float = 0.0,
-        seed: int = 0, cache_dtype: str = "native") -> tuple[Array, dict]:
+        seed: int = 0, cache_dtype: str = "native",
+        adaptive: bool = False, draft_cost_ratio: float = 0.5,
+        calibration: str = "measured") -> tuple[Array, dict]:
     """Batched speculative decoding with the WHOLE loop on device.
 
     Unlike :func:`speculative_generate` (batch-1, host accept loop — kept
@@ -900,20 +1251,17 @@ def speculative_generate_batched(
     # finished rows clip into discarded slack)
     check_position_budget(target, prompt_len, max_new_tokens + draft_len)
     check_position_budget(draft, prompt_len, max_new_tokens + draft_len)
-    run = _spec_batched_runner(target, draft, max_new_tokens, draft_len,
-                               float(temperature), cache_dtype)
-    tokens, stats = run(target_params, draft_params,
-                        jnp.asarray(prompt, jnp.int32),
-                        jax.random.key(seed))
-    verifies, accepted, active_rows = (int(x) for x in np.asarray(stats))
-    total = prompt.shape[0] * max_new_tokens
-    return np.asarray(tokens), {
-        "verify_calls": verifies,
-        "draft_accept_rate": accepted / max(1, active_rows * draft_len),
-        # +1: the prefill forward produced each row's first token
-        "tokens_per_target_forward": total / max(
-            1, prompt.shape[0] * (verifies + 1)),
-    }
+    if adaptive:
+        # draft_len becomes the depth CAP; the controller re-picks k
+        # between on-device segments from the measured accept rate and
+        # the caller's draft/target cost ratio (_speculative_adaptive)
+        return _speculative_adaptive(
+            target, target_params, draft, draft_params, prompt,
+            max_new_tokens, draft_len, float(temperature), seed,
+            cache_dtype, float(draft_cost_ratio), calibration)
+    return _run_fixed_spec(target, target_params, draft, draft_params,
+                           prompt, max_new_tokens, draft_len,
+                           float(temperature), seed, cache_dtype)
 
 
 def generate(model: Transformer, params: Mapping[str, Array],

@@ -41,8 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .generation import (KVCache, QuantKVCache, _cached_runner,
-                         _draft_propose, _greedy_accept, _kv_quantize,
-                         _model_key, _sampling_accept,
+                         _kv_quantize, _model_key, _spec_round_runner,
                          check_position_budget, decode_block, init_cache,
                          sample_token, sample_token_rowwise)
 from .transformer import Transformer
@@ -184,63 +183,6 @@ def _splice_runner(model: Transformer, bucket: int, cache_dtype: str):
     return _cached_runner(key, build)
 
 
-def _spec_round_runner(target: Transformer, draft: Transformer,
-                       draft_len: int, cache_dtype: str,
-                       temperature: float = 0.0):
-    """Jitted per (target, draft, k, T): ONE speculative round over ALL
-    slots — draft catch-up block + k-1 single proposals, one target
-    verify block, vectorized acceptance.  The same math as
-    generation._spec_batched_runner's loop body, but one round per call
-    so the host can admit/retire requests between rounds (continuous
-    batching).  Greedy (T=0, longest matching prefix) is token-exact
-    whatever each slot's accept rate; T>0 applies the Leviathan/Chen
-    rejection rule, preserving the target's sampling distribution.
-    Returns (commit [B, k+1], n_commit [B], cur_new [B], y_new [B],
-    t_cache, d_cache, rng)."""
-    key = (_model_key(target), _model_key(draft), "serve_spec_round",
-           draft_len, cache_dtype, temperature)
-    k_draft = draft_len
-    sampling = temperature > 0.0
-
-    def build():
-        @partial(jax.jit, donate_argnums=(4, 5))
-        def run(tparams, dparams, cur, y, t_cache, d_cache, lt, pc, rng):
-            batch = cur.shape[0]
-            iota_k1 = jnp.arange(k_draft + 1, dtype=jnp.int32)
-            # draft: catch-up block [y, cur] (re-writing y's slot is a
-            # no-op; writing fresh is the full-accept catch-up), then
-            # k-1 single steps
-            dl, d_cache = decode_block(
-                draft, dparams, jnp.stack([y, cur], axis=1), d_cache,
-                lengths=pc - 1)
-            rng, *keys = jax.random.split(rng, k_draft + 4)
-            props, q_rows, d_cache = _draft_propose(
-                draft, dparams, dl[:, 1], d_cache, pc, k_draft,
-                temperature, keys)
-            # target verifies [cur, p_1..p_k] in one ragged forward
-            block = jnp.concatenate([cur[:, None], props], axis=1)
-            vlogits, t_cache = decode_block(target, tparams, block,
-                                            t_cache, lengths=lt)
-            if sampling:
-                m, corr = _sampling_accept(
-                    vlogits, props, q_rows, temperature, keys[k_draft],
-                    keys[k_draft + 1], keys[k_draft + 2])
-            else:
-                m, corr = _greedy_accept(vlogits, props)
-            ext = jnp.concatenate(
-                [props, jnp.zeros((batch, 1), jnp.int32)], axis=1)
-            commit = jnp.where(iota_k1[None, :] < m[:, None], ext,
-                               corr[:, None])             # [B, k+1]
-            prev = jnp.take_along_axis(
-                props, jnp.clip(m - 1, 0, k_draft - 1)[:, None], 1)[:, 0]
-            y_new = jnp.where(m == 0, cur, prev)
-            return commit, m + 1, corr, y_new, t_cache, d_cache, rng
-
-        return run
-
-    return _cached_runner(key, build)
-
-
 def _step_runner(model: Transformer, slots: int,
                  top_k: int, top_p: float, cache_dtype: str):
     """Jitted once per (model, B, truncation config): one ragged decode
@@ -291,7 +233,8 @@ class DecodeServer:
                  cache_dtype: str = "native", seed: int = 0,
                  mesh=None, param_rule=None,
                  draft: Transformer | None = None, draft_params=None,
-                 draft_len: int = 4, prompt_cache: int = 0):
+                 draft_len: int = 4, adaptive_draft: bool = True,
+                 draft_cost_ratio: float = 0.5, prompt_cache: int = 0):
         """``mesh`` turns on multi-chip serving: params are placed under
         ``param_rule`` (default: models.transformer.transformer_rule —
         Megatron TP columns/rows + fsdp) and the slot cache is sharded
@@ -304,13 +247,25 @@ class DecodeServer:
 
         ``draft`` turns on SPECULATIVE continuous batching: every step()
         runs one draft-propose/verify round over all slots, so each
-        request advances 1..draft_len+1 tokens per target forward at its
-        own acceptance rate.  Greedy (default) stays token-exact vs the
+        request advances 1..k+1 tokens per target forward at its own
+        acceptance rate.  Greedy (default) stays token-exact vs the
         plain greedy server whatever the draft (tested);
         ``temperature>0`` applies the Leviathan/Chen rejection rule,
         preserving the target's sampling distribution (tested
         empirically); top_k/top_p do not combine.  The draft shares the
         cache dtype and mesh.
+
+        ``adaptive_draft`` (default on) treats ``draft_len`` as the CAP
+        and re-picks the per-round depth k every few rounds via
+        generation.optimal_draft_depth: the EMA accept fraction inverts
+        to per-proposal agreement p, and k* maximizes expected tokens
+        per round cost (1 target forward + k drafts at
+        ``draft_cost_ratio`` target-units each) — the controller that
+        avoids the over-speculation regime where a fixed k=4 measured
+        0.76x vs greedy (BASELINE.md).  Each k's round program is
+        compiled once and cached; token-exactness is unaffected
+        (speculative commits are exact at ANY depth).
+        ``adaptive_draft=False`` pins k = draft_len.
 
         ``prompt_cache`` > 0 keeps the prefill results (final-position
         logits + the prompt's K/V row, and the draft's row in
@@ -368,7 +323,8 @@ class DecodeServer:
         self._temps = np.full((slots,), temperature, np.float32)
         # --- speculative mode state
         self.draft = draft
-        self.draft_len = draft_len
+        self.draft_len = draft_len          # cap (verify-slack sizing)
+        self.adaptive_draft = adaptive_draft
         if draft is not None:
             if top_k or top_p:
                 raise ValueError("speculative serving supports greedy "
@@ -392,9 +348,48 @@ class DecodeServer:
                 self._d_cache = _shard_cache(self._d_cache, mesh)
             self._d_lengths = np.zeros((slots,), np.int32)  # pc per slot
             self._prev = np.zeros((slots,), np.int32)       # y per slot
-            self._spec_round = _spec_round_runner(model, draft, draft_len,
-                                                  cache_dtype,
-                                                  float(temperature))
+            # current depth + adaptation state; one compiled round program
+            # per depth, built lazily (cached in _cached_runner)
+            self._k = min(2, draft_len) if adaptive_draft else draft_len
+            self.draft_cost_ratio = draft_cost_ratio
+            self._accept_ema: float | None = None
+            self._rounds_since_adapt = 0
+
+    _ADAPT_EVERY = 4        # rounds between depth decisions
+    _ADAPT_DECAY = 0.8      # EMA decay on the per-round accept fraction
+
+    def _spec_round(self, *args):
+        runner = _spec_round_runner(self.model, self.draft, self._k,
+                                    self.cache_dtype,
+                                    float(self._temperature))
+        return runner(*args)
+
+    def _adapt_depth(self, accepted: int, proposed: int) -> None:
+        """Update the agreement estimate with this round's active-slot
+        stats and re-pick k every _ADAPT_EVERY rounds via the shared
+        expected-throughput controller (generation.optimal_draft_depth).
+        The EMA runs in per-proposal-agreement space (each round's accept
+        FRACTION is inverted at the depth it was measured at) so samples
+        taken at different depths stay comparable.  Shortening when
+        agreement is weak avoids over-speculation (k tokens drafted, few
+        kept: wasted draft forwards AND a wider verify); deepening when
+        it is strong converts cheap drafts into >1 token/verify."""
+        if not self.adaptive_draft or not proposed:
+            return
+        from .generation import _invert_accept_fraction, optimal_draft_depth
+        p_round = _invert_accept_fraction(accepted / proposed, self._k)
+        self._accept_ema = (p_round if self._accept_ema is None else
+                            self._ADAPT_DECAY * self._accept_ema
+                            + (1.0 - self._ADAPT_DECAY) * p_round)
+        self._rounds_since_adapt += 1
+        if self._rounds_since_adapt < self._ADAPT_EVERY:
+            return
+        self._rounds_since_adapt = 0
+        # the EMA is already p, so invert at k=1 (identity)
+        self._k = optimal_draft_depth(self._accept_ema, 1,
+                                      self.draft_len,
+                                      self.draft_cost_ratio,
+                                      allow_disable=True)
 
     # ------------------------------------------------------------- admin
     @property
@@ -478,7 +473,10 @@ class DecodeServer:
                 self.params, jnp.asarray(padded),
                 jnp.asarray(real_len, jnp.int32))
             d_row = None
-            if self.draft is not None:
+            if self.draft is not None and self._k > 0:
+                # k=0 (controller disabled speculation, permanently):
+                # the draft cache is never read again, so skip its
+                # prefill + splice for newly admitted requests
                 _, d_row = _prefill_runner(self.draft, bucket,
                                            self.cache_dtype)(
                     self.draft_params, jnp.asarray(padded),
@@ -493,7 +491,7 @@ class DecodeServer:
                                  self._top_k, self._top_p)[0])
         self._cache = _splice_runner(self.model, bucket, self.cache_dtype)(
             self._cache, row, jnp.asarray(slot, jnp.int32))
-        if self.draft is not None:
+        if self.draft is not None and d_row is not None:
             self._d_cache = _splice_runner(self.draft, bucket,
                                            self.cache_dtype)(
                 self._d_cache, d_row, jnp.asarray(slot, jnp.int32))
@@ -520,7 +518,11 @@ class DecodeServer:
         decoded token(s) (already appended to its result)."""
         if self.idle:
             return []
-        if self.draft is not None:
+        if self.draft is not None and self._k > 0:
+            # k can reach 0 when the adaptive controller concludes this
+            # draft cannot pay (optimal_draft_depth allow_disable) —
+            # the server then serves plain greedy rounds below, which
+            # read the same _tokens/_lengths state the spec rounds kept
             return self._spec_step()
         nxt, self._cache, self._rng = self._step(
             self.params, jnp.asarray(self._tokens), self._cache,
@@ -560,12 +562,14 @@ class DecodeServer:
         cur_new = np.asarray(cur_new)
         y_new = np.asarray(y_new)
         emitted: list[tuple[int, int]] = []
+        round_proposed = round_accepted = 0
         for i, entry in enumerate(self._slot):
             n = int(n_commit[i])
             if entry is not None:
-                # active-slot acceptance stats: n-1 of draft_len accepted
-                self._spec_proposed += self.draft_len
-                self._spec_accepted += n - 1
+                # active-slot acceptance stats: n-1 of this round's k
+                # accepted (k is the adaptive depth, not the cap)
+                round_proposed += self._k
+                round_accepted += n - 1
                 for t in commit[i, :n]:
                     token = int(t)
                     entry.tokens.append(token)
@@ -580,6 +584,9 @@ class DecodeServer:
             self._d_lengths[i] += n
             self._tokens[i] = int(cur_new[i])
             self._prev[i] = int(y_new[i])
+        self._spec_proposed += round_proposed
+        self._spec_accepted += round_accepted
+        self._adapt_depth(round_accepted, round_proposed)
         self._n_steps += 1
         self._n_emitted += len(emitted)
         return emitted
@@ -617,6 +624,7 @@ class DecodeServer:
                 if self._spec_proposed else 0.0)
             out["tokens_per_round"] = (
                 self._n_emitted / self._n_steps if self._n_steps else 0.0)
+            out["draft_depth"] = self._k   # current adaptive depth
         return out
 
     # ------------------------------------------------------------ result

@@ -700,3 +700,103 @@ def test_sample_token_rowwise_exactness(rng):
     np.testing.assert_array_equal(
         np.asarray(sample_token_rowwise(logits, key, hot, top_k=1)),
         greedy)
+
+
+def test_optimal_draft_depth_controller():
+    """The expected-throughput controller: depth follows per-proposal
+    agreement p and the draft/target cost ratio.  Anchors: the round-4
+    measurements (accept 0.57 at k=2 -> 1.20x, accept 0.36 at k=4 ->
+    0.76x over-speculation) must map to k* <= 2 at rho~1/3, and a
+    perfect draft must max out the cap."""
+    from parameter_server_distributed_tpu.models.generation import (
+        _invert_accept_fraction, optimal_draft_depth)
+
+    # inversion: fraction at depth k back to per-proposal p
+    for p in (0.1, 0.5, 0.9):
+        for k in (1, 2, 4):
+            frac = sum(p ** i for i in range(1, k + 1)) / k
+            assert _invert_accept_fraction(frac, k) == pytest.approx(
+                p, abs=1e-6)
+    assert _invert_accept_fraction(0.0, 4) == 0.0
+    assert _invert_accept_fraction(1.0, 4) == 1.0
+
+    # perfect draft -> cap; hopeless draft -> minimum depth
+    assert optimal_draft_depth(1.0, 2, 8, cost_ratio=0.1) == 8
+    assert optimal_draft_depth(0.0, 4, 8, cost_ratio=0.3) == 1
+    # the round-4 regression shape: mid accept, moderate cost ratio
+    assert optimal_draft_depth(0.36, 4, 4, cost_ratio=1 / 3) <= 2
+    assert optimal_draft_depth(0.57, 2, 4, cost_ratio=1 / 3) <= 2
+    # near-free draft deepens even at mid accept
+    assert optimal_draft_depth(0.6, 2, 8, cost_ratio=0.02) >= 4
+
+
+def test_speculative_batched_adaptive_token_exact_and_settles(rng):
+    """adaptive=True: token-exact vs target-alone greedy for any depth
+    trajectory, and the controller settles where acceptance points —
+    depth 0 (speculation disabled, plain greedy segments) for a
+    random-init draft whose economics can never pay, the cap for a
+    perfect self-draft (accept 1.0)."""
+    from parameter_server_distributed_tpu.models.generation import (
+        generate, speculative_generate_batched)
+
+    target, tparams, draft, dparams = _spec_pair()
+    prompt = rng.integers(0, 256, (4, 7)).astype(np.int32)
+    reference = np.asarray(generate(target, tparams, prompt,
+                                    max_new_tokens=32))
+    out, stats = speculative_generate_batched(
+        target, tparams, draft, dparams, prompt, 32, draft_len=4,
+        adaptive=True, draft_cost_ratio=0.3, calibration="model")
+    np.testing.assert_array_equal(out, reference)
+    assert stats["draft_depths"][0] == 2          # starts at min(2, cap)
+    assert stats["draft_depth"] == 0              # junk draft -> disabled
+    assert 0 in stats["draft_depths"]             # greedy segments ran
+
+    out2, stats2 = speculative_generate_batched(
+        target, tparams, target, tparams, prompt, 32, draft_len=4,
+        adaptive=True, draft_cost_ratio=0.3, calibration="model")
+    np.testing.assert_array_equal(out2, reference)
+    assert stats2["draft_depth"] == 4             # perfect draft -> cap
+    assert stats2["draft_accept_rate"] == pytest.approx(1.0)
+
+    # measured mode: depth choices are host-timing-dependent, but the
+    # outputs must stay token-exact whatever the probes decide
+    out3, stats3 = speculative_generate_batched(
+        target, tparams, draft, dparams, prompt, 32, draft_len=4,
+        adaptive=True, draft_cost_ratio=0.3)
+    np.testing.assert_array_equal(out3, reference)
+    assert stats3["draft_depth"] in (0, 1, 2, 3, 4)
+
+
+def test_adaptive_memoizes_steady_state_depth(rng):
+    """The first adaptive call calibrates (segmented run); subsequent
+    calls for the same (target, draft, sampling) jump straight to the
+    winning FUSED program — depths report "memo" and outputs stay
+    token-exact.  A junk draft memoizes k=0 (plain generate); a perfect
+    draft memoizes the cap (whole-loop spec)."""
+    from parameter_server_distributed_tpu.models.generation import (
+        generate, speculative_generate_batched)
+
+    target, tparams, draft, dparams = _spec_pair()
+    prompt = rng.integers(0, 256, (4, 7)).astype(np.int32)
+    reference = np.asarray(generate(target, tparams, prompt,
+                                    max_new_tokens=32))
+    kw = dict(draft_len=4, adaptive=True, draft_cost_ratio=0.3,
+              calibration="model")
+    _, first = speculative_generate_batched(
+        target, tparams, draft, dparams, prompt, 32, **kw)
+    assert first["draft_depth"] == 0
+    out, steady = speculative_generate_batched(
+        target, tparams, draft, dparams, prompt, 32, **kw)
+    np.testing.assert_array_equal(out, reference)
+    assert steady["draft_depths"] == ["memo"]
+    assert steady["draft_depth"] == 0
+    assert steady["verify_calls"] == 32       # one target fwd per token
+
+    _, first2 = speculative_generate_batched(
+        target, tparams, target, tparams, prompt, 32, **kw)
+    assert first2["draft_depth"] == 4
+    out2, steady2 = speculative_generate_batched(
+        target, tparams, target, tparams, prompt, 32, **kw)
+    np.testing.assert_array_equal(out2, reference)
+    assert steady2["draft_depths"] == ["memo"]
+    assert steady2["draft_accept_rate"] == pytest.approx(1.0)

@@ -386,7 +386,8 @@ def test_serving_stats(rng):
     assert "draft_accept_rate" not in s
 
     spec = DecodeServer(model, params, slots=1, max_len=64,
-                        draft=model, draft_params=params, draft_len=3)
+                        draft=model, draft_params=params, draft_len=3,
+                        adaptive_draft=False)  # pin k: exact round counts
     spec.submit(prompt, max_new_tokens=8)
     spec.run_to_completion()
     s = spec.stats
@@ -418,3 +419,41 @@ def test_prompt_validation(rng):
         srv.submit([])
     with pytest.raises(ValueError):
         srv.submit(list(rng.integers(0, 96, 30)), max_new_tokens=10)
+
+
+def test_speculative_serving_adaptive_depth(rng):
+    """adaptive_draft: the server's depth controller follows acceptance —
+    a perfect self-draft deepens to the cap, a random draft drops to 1 —
+    while outputs stay token-exact vs the plain greedy server."""
+    model = tiny()
+    params = model.init_params(0)
+    prompts = [list(rng.integers(0, model.config.vocab, 5))
+               for _ in range(6)]
+
+    def run(**kwargs):
+        srv = DecodeServer(model, params, slots=2, max_len=64, **kwargs)
+        pending = list(prompts)
+        while pending or not srv.idle:
+            while pending and srv.has_free_slot:
+                srv.submit(pending.pop(0), max_new_tokens=24)
+            srv.step()
+        return srv
+
+    plain = run()
+    perfect = run(draft=model, draft_params=params, draft_len=4,
+                  adaptive_draft=True, draft_cost_ratio=0.3)
+    assert perfect.stats["draft_depth"] == 4
+    junk = tiny(n_layers=1)
+    junky = run(draft=junk, draft_params=junk.init_params(99),
+                draft_len=4, adaptive_draft=True, draft_cost_ratio=0.3)
+    # accept ~0: the controller disables speculation (k=0) and the
+    # server switches to plain greedy rounds mid-flight
+    assert junky.stats["draft_depth"] == 0
+    for rid in range(6):
+        want = plain.result(rid)      # result() pops — read once
+        assert perfect.result(rid) == want
+        assert junky.result(rid) == want
+    # pinned mode keeps the configured depth
+    pinned = run(draft=junk, draft_params=junk.init_params(99),
+                 draft_len=3, adaptive_draft=False)
+    assert pinned.stats["draft_depth"] == 3

@@ -10,6 +10,13 @@
 #   deploy/deploy.sh scale N  # resize the worker fleet to N TPU slices
 #   deploy/deploy.sh destroy
 #
+# `--dry-run` (first argument, before the action) prints the FULL action
+# plan — every terraform/gcloud command in order, with placeholder
+# instance names where terraform outputs would be read — without touching
+# the cloud or requiring terraform/gcloud to be installed.  This is how
+# the deploy path is exercised in CI and on dev boxes with no GCP access
+# (tests/test_deploy_dryrun.py).
+#
 # `scale` is the cloud analogue of the reference's scripts/scale_workers.sh
 # (terraform re-apply with the new worker count, then provision + start
 # only the NEW instances — reference scripts/scale_workers.sh:51-148) with
@@ -21,24 +28,44 @@
 # instead restarts the PS with the new WORLD size, losing live state —
 # reference scripts/scale_workers.sh:150-186).
 #
-# Requires: terraform, gcloud (authenticated), TF_VAR_project set.
+# Requires (non-dry-run): terraform, gcloud (authenticated), TF_VAR_project.
 set -euo pipefail
 cd "$(dirname "$0")"
 REPO_ROOT="$(cd .. && pwd)"
+
+DRY_RUN=0
+if [ "${1:-}" = "--dry-run" ] || [ "${1:-}" = "-n" ]; then
+  DRY_RUN=1
+  shift
+fi
 ACTION="${1:-apply}"
 
+run() {  # execute, or print the exact command in dry-run
+  if [ "$DRY_RUN" = 1 ]; then
+    echo "DRY-RUN: $*"
+  else
+    "$@"
+  fi
+}
+
 if [ "$ACTION" = "destroy" ]; then
-  terraform -chdir=terraform destroy -auto-approve
+  run terraform -chdir=terraform destroy -auto-approve
   exit 0
 fi
 
 PREV_WORKERS=0
 if [ "$ACTION" = "scale" ]; then
-  NEW_COUNT="${2:?usage: deploy.sh scale <worker_slice_count>}"
-  PREV_WORKERS="$(terraform -chdir=terraform output -json worker_names \
-    2>/dev/null | jq 'length' || echo 0)"
+  NEW_COUNT="${2:?usage: deploy.sh [--dry-run] scale <worker_slice_count>}"
+  if [ "$DRY_RUN" = 1 ]; then
+    PREV_WORKERS="${PSDT_DRY_RUN_PREV_WORKERS:-2}"
+    echo "DRY-RUN: read current worker count from terraform output" \
+         "(assuming $PREV_WORKERS)"
+  else
+    PREV_WORKERS="$(terraform -chdir=terraform output -json worker_names \
+      2>/dev/null | jq 'length' || echo 0)"
+  fi
   echo "== scaling worker fleet: $PREV_WORKERS -> $NEW_COUNT slices"
-  terraform -chdir=terraform apply -auto-approve \
+  run terraform -chdir=terraform apply -auto-approve \
     -var "worker_slice_count=$NEW_COUNT"
   if [ "$NEW_COUNT" -le "$PREV_WORKERS" ]; then
     echo "== scale-down complete: terraform destroyed the removed slices;"
@@ -48,28 +75,40 @@ if [ "$ACTION" = "scale" ]; then
 fi
 
 if [ "$ACTION" = "apply" ]; then
-  terraform -chdir=terraform init -input=false
-  terraform -chdir=terraform apply -auto-approve
+  run terraform -chdir=terraform init -input=false
+  run terraform -chdir=terraform apply -auto-approve
 fi
 
-OUT="$(terraform -chdir=terraform output -json)"
-ZONE="$(jq -r .zone.value <<<"$OUT")"
-COORD_VM="$(jq -r '.worker_names.value[0]' <<<"$OUT" | sed 's/-worker-0$/-coordinator/')"
-mapfile -t WORKERS < <(jq -r '.worker_names.value[]' <<<"$OUT")
+if [ "$DRY_RUN" = 1 ]; then
+  # placeholder topology mirroring terraform/outputs.tf: a control-plane
+  # VM (coordinator + PS) and N worker TPU slices
+  N="${NEW_COUNT:-${PSDT_DRY_RUN_WORKERS:-3}}"
+  ZONE="<zone>"
+  COORD_VM="psdt-coordinator"
+  WORKERS=()
+  for i in $(seq 0 $((N - 1))); do WORKERS+=("psdt-worker-$i"); done
+  echo "DRY-RUN: read zone/instance names from terraform output" \
+       "(assuming $COORD_VM + ${#WORKERS[@]} worker slices)"
+else
+  OUT="$(terraform -chdir=terraform output -json)"
+  ZONE="$(jq -r .zone.value <<<"$OUT")"
+  COORD_VM="$(jq -r '.worker_names.value[0]' <<<"$OUT" | sed 's/-worker-0$/-coordinator/')"
+  mapfile -t WORKERS < <(jq -r '.worker_names.value[]' <<<"$OUT")
+fi
 
 ship_gce() { # ship package to the control-plane VM over plain ssh
-  gcloud compute scp --recurse --zone="$ZONE" \
+  run gcloud compute scp --recurse --zone="$ZONE" \
     "$REPO_ROOT/parameter_server_distributed_tpu" "$1:/tmp/psdt-pkg"
-  gcloud compute ssh --zone="$ZONE" "$1" --command \
+  run gcloud compute ssh --zone="$ZONE" "$1" --command \
     "sudo rsync -a --delete /tmp/psdt-pkg/ /opt/psdt/parameter_server_distributed_tpu/ \
      && sudo systemctl enable --now psdt-coordinator psdt-ps \
      && sudo systemctl restart psdt-coordinator psdt-ps"
 }
 
 ship_tpu() { # ship package to every host of a TPU slice
-  gcloud compute tpus tpu-vm scp --recurse --worker=all --zone="$ZONE" \
+  run gcloud compute tpus tpu-vm scp --recurse --worker=all --zone="$ZONE" \
     "$REPO_ROOT/parameter_server_distributed_tpu" "$1:/tmp/psdt-pkg"
-  gcloud compute tpus tpu-vm ssh --worker=all --zone="$ZONE" "$1" --command \
+  run gcloud compute tpus tpu-vm ssh --worker=all --zone="$ZONE" "$1" --command \
     "sudo rsync -a --delete /tmp/psdt-pkg/ /opt/psdt/parameter_server_distributed_tpu/ \
      && sudo systemctl enable --now psdt-worker && sudo systemctl restart psdt-worker"
 }

@@ -13,10 +13,13 @@
 //   - security group                  -> VPC firewall on 50051/50052/22
 
 terraform {
+  required_version = ">= 1.5"
   required_providers {
     google = {
-      source  = "hashicorp/google"
-      version = ">= 5.0"
+      source = "hashicorp/google"
+      // pinned minor so `terraform init -backend=false && validate` in CI
+      // is reproducible (no credentials needed at validate time)
+      version = "~> 5.45"
     }
   }
 }

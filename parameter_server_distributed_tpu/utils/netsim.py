@@ -1,0 +1,135 @@
+"""Userspace network-condition injection for loopback benchmarks.
+
+The top-k/bf16 wire encodings exist to win on a REAL network boundary
+(DCN between PS hosts and workers), where bytes cost wall-clock; on
+localhost the kernel moves 10+ GB/s and the byte advantage vanishes
+(BASELINE.md: top-k at 1B was a null result on loopback).  The honest
+way to measure the wire win without two hosts is to inject latency and
+a bandwidth cap into the path.  Kernel tools (tc netem / tbf) need
+modules this environment's kernel doesn't ship, so this is a portable
+userspace equivalent: a TCP relay that forwards byte-for-byte while
+
+- delaying each chunk by ``delay_ms`` (one-way; applied in both
+  directions, so round-trips see ~2x), WITHOUT serializing the stream —
+  chunks are timestamped at read and released at read-time + delay,
+  preserving pipelining exactly like a long link does, and
+- pacing writes to ``mbps`` megabits/second per direction (token-bucket
+  style: the writer owes ``bytes/rate`` seconds after each chunk).
+
+gRPC/HTTP-2 traffic relays transparently (it is plain TCP).  One relay
+fronts one backend port; `bench.py pushpull` starts one per PS shard
+when PSDT_BENCH_NET="rtt_ms:mbps" is set and points the client at the
+relay ports (reference wire comparison: the reference's repeated-float
+proto has no compression at all — reference proto/parameter_server.proto:19-24).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from queue import Queue
+
+_CHUNK = 65536
+
+
+class ThrottledRelay:
+    """TCP relay 127.0.0.1:<listen_port> -> 127.0.0.1:<target_port> with
+    one-way delay and a per-direction bandwidth cap.
+
+    >>> relay = ThrottledRelay(target_port, delay_ms=10, mbps=500)
+    >>> port = relay.start()     # connect clients here
+    >>> relay.stop()
+    """
+
+    def __init__(self, target_port: int, delay_ms: float = 0.0,
+                 mbps: float = 0.0, host: str = "127.0.0.1"):
+        self.target = (host, int(target_port))
+        self.delay_s = float(delay_ms) / 1e3
+        # bytes/second; 0 = uncapped
+        self.rate = float(mbps) * 1e6 / 8.0
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> int:
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((self.target[0], 0))
+        listener.listen(64)
+        self._listener = listener
+        accept = threading.Thread(target=self._accept_loop, daemon=True)
+        accept.start()
+        self._threads.append(accept)
+        return listener.getsockname()[1]
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- internals
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(self.target)
+            except OSError:
+                conn.close()
+                continue
+            for src, dst in ((conn, upstream), (upstream, conn)):
+                self._pump(src, dst)
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        """One direction: a reader timestamps chunks into a queue, a
+        writer releases each at read-time + delay and paces to the rate —
+        the pipelined long-link model (latency does not serialize
+        throughput, bandwidth is capped independently)."""
+        q: Queue = Queue(maxsize=256)
+
+        def reader():
+            try:
+                while not self._stop.is_set():
+                    data = src.recv(_CHUNK)
+                    if not data:
+                        break
+                    q.put((time.monotonic(), data))
+            except OSError:
+                pass
+            q.put((0.0, b""))          # EOF sentinel
+
+        def writer():
+            pace = time.monotonic()
+            try:
+                while True:
+                    ts, data = q.get()
+                    if not data:
+                        break
+                    release = ts + self.delay_s
+                    if self.rate > 0:
+                        pace = max(pace, time.monotonic())
+                        release = max(release, pace)
+                        pace = release + len(data) / self.rate
+                    wait = release - time.monotonic()
+                    if wait > 0:
+                        time.sleep(wait)
+                    dst.sendall(data)
+            except OSError:
+                pass
+            # half-close so gRPC sees clean stream shutdown
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+        for fn in (reader, writer):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)

@@ -57,13 +57,18 @@ run decode_small_lm_int8_full    PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small
 run spec_perfect_draft           PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=self PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run spec_tiny_draft              PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run spec_trained_draft_k2        PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_TRAIN_STEPS=200 PSDT_BENCH_DRAFT_LEN=2 PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
+# adaptive depth (cap 4): the config that LOST at fixed k=4 (0.76x, r04)
+# must never lose now — the controller shortens k when accept is low
+run spec_trained_draft_k4        PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_TRAIN_STEPS=200 PSDT_BENCH_DRAFT_LEN=4 PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run serve_small_lm               PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run serve_small_lm_int8_full     PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_QUANT=int8 PSDT_BENCH_KV_CACHE=int8
-run serve_small_lm_spec          PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_DRAFT=self PSDT_BENCH_DRAFT_LEN=4
-# -- 5. model-family rows (flagship-scale sparse MoE: samples/s row —
-#    analytic MFU not reported, 6P overcounts inactive experts; the
-#    xlaflops rows in section 6 are the hardware-executed-FLOPs view;
-#    ViT gets its first perf row)
+# trained tiny_lm draft (self-draft costs as much as the target and can
+# only lose; a cheap trained draft is the regime speculation serves)
+run serve_small_lm_spec          PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_TRAIN_STEPS=200 PSDT_BENCH_DRAFT_LEN=4
+# -- 5. model-family rows (flagship-scale sparse MoE reports MFU with
+#    ACTIVE-expert FLOPs — top_k of E experts per token, noted in the
+#    metric; the xlaflops rows in section 6 are the hardware-executed
+#    view; ViT gets its first perf row)
 run moe350_b16                   PSDT_BENCH_MODEL=moe_350m PSDT_BENCH_BATCH=16
 run vit_s16_b64                  PSDT_BENCH_MODEL=vit_s16_imagenet PSDT_BENCH_BATCH=64
 run mlp1b_sgd_b1024              PSDT_BENCH_MODEL=mlp_1b PSDT_BENCH_BATCH=1024

@@ -1,0 +1,78 @@
+"""deploy.sh --dry-run: the full cloud action plan without cloud access.
+
+The deploy script has never been executable in this environment (no
+terraform/gcloud, no GCP credentials), so --dry-run is the testable
+surface: it must print every command the real run would execute, in
+order, for every verb — including the reference-parity properties the
+scale verb documents (provision only NEW slices on scale-up, no PS
+restart in either direction — reference scripts/scale_workers.sh:51-186).
+CI pairs this with `terraform init -backend=false && validate` against
+the pinned provider (.github/workflows/ci.yml deploy-validate job).
+
+These tests run deploy.sh with plain bash — no terraform, gcloud, or jq
+on PATH required (that is the point of --dry-run).
+"""
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DEPLOY = REPO / "deploy" / "deploy.sh"
+
+
+def _dry_run(*args, env_extra=None):
+    import os
+
+    env = dict(os.environ, **(env_extra or {}))
+    proc = subprocess.run(["bash", str(DEPLOY), "--dry-run", *args],
+                          capture_output=True, text=True, timeout=60,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+def test_apply_plan_orders_terraform_then_control_plane_then_workers():
+    out = _dry_run("apply")
+    plan = [ln for ln in out.splitlines() if ln.startswith("DRY-RUN:")]
+    assert "terraform -chdir=terraform init" in plan[0]
+    assert "terraform -chdir=terraform apply -auto-approve" in plan[1]
+    # start order mirrors the reference: coordinator/PS before workers
+    coord = out.index("psdt-coordinator:/tmp/psdt-pkg")
+    worker0 = out.index("psdt-worker-0:/tmp/psdt-pkg")
+    assert coord < worker0
+    assert "systemctl enable --now psdt-coordinator psdt-ps" in out
+    assert "systemctl enable --now psdt-worker" in out
+
+
+def test_scale_up_ships_only_new_slices_and_never_restarts_ps():
+    out = _dry_run("scale", "4",
+                   env_extra={"PSDT_DRY_RUN_PREV_WORKERS": "2"})
+    assert "2 -> 4 slices" in out
+    assert "worker_slice_count=4" in out
+    # only the NEW slices (2, 3) are provisioned; 0/1 keep running
+    assert "psdt-worker-2:/tmp/psdt-pkg" in out
+    assert "psdt-worker-3:/tmp/psdt-pkg" in out
+    assert "psdt-worker-0:/tmp/psdt-pkg" not in out
+    assert "psdt-worker-1:/tmp/psdt-pkg" not in out
+    # the reference-divergence contract: no PS/coordinator restart
+    assert "psdt-ps" not in out
+    assert "psdt-coordinator:" not in out
+
+
+def test_scale_down_is_terraform_only_reaper_evicts():
+    out = _dry_run("scale", "1",
+                   env_extra={"PSDT_DRY_RUN_PREV_WORKERS": "3"})
+    assert "worker_slice_count=1" in out
+    assert "reaper evicts" in out
+    assert "psdt-pkg" not in out          # nothing shipped on scale-down
+    assert "psdt-ps" not in out           # and no PS restart
+
+
+def test_destroy_and_ship_plans():
+    assert "terraform -chdir=terraform destroy -auto-approve" in _dry_run(
+        "destroy")
+    ship = _dry_run("ship")
+    assert "terraform -chdir=terraform apply" not in ship  # no re-apply
+    assert "psdt-coordinator:/tmp/psdt-pkg" in ship
+    assert "psdt-worker-0:/tmp/psdt-pkg" in ship

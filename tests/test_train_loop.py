@@ -289,8 +289,11 @@ def test_train_loop_ema_eval(tmp_path):
     assert summary2["steps"] == 8
     assert np.isfinite(summary2["ema_eval_loss"])
 
-    # --ema does not compose with --lora (masked optimizer would shadow
-    # adapters only) — rejected explicitly, not a crash at run end
-    with pytest.raises(ValueError, match="--ema does not compose"):
-        run_training(TrainLoopConfig(
-            model="tiny_lm", batch_size=4, steps=2, lora="2:4", ema=0.9))
+    # --ema composes with --lora since round 5: freeze_base masks the
+    # shadow to exactly the adapters and the EMA eval grafts them onto
+    # the frozen base (tests/test_lora.py covers the mechanics; here
+    # assert the combination runs end to end and reports the metric)
+    summary3 = run_training(TrainLoopConfig(
+        model="tiny_lm", batch_size=4, steps=2, lora="2:4", ema=0.9,
+        eval_every=2, log_every=2))
+    assert np.isfinite(summary3["ema_eval_loss"])

@@ -85,5 +85,8 @@ run resnet50_b128                PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=res
 run resnet50_b128_xlaflops       PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=resnet50_imagenet PSDT_BENCH_BATCH=128 PSDT_BENCH_FLOPS=xla
 run vit_s16_b64_xlaflops         PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=vit_s16_imagenet PSDT_BENCH_BATCH=64 PSDT_BENCH_FLOPS=xla
 run lm350_scan_b32_xlaflops      PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=lm_350m PSDT_BENCH_BATCH=32 PSDT_BENCH_SCAN=1 PSDT_BENCH_FLOPS=xla
+# hardware-executed FLOPs for the sparse MoE flagship: cross-checks the
+# analytic ACTIVE-expert MFU accounting against XLA's own count
+run moe350_b16_xlaflops          PSDT_BENCH_TPU_TIMEOUT=900 PSDT_BENCH_MODEL=moe_350m PSDT_BENCH_BATCH=16 PSDT_BENCH_FLOPS=xla
 
 echo "recovery sweep done -> $RESULTS" | tee -a "$LOG"

@@ -86,16 +86,27 @@ class PipelinedTransformerLM:
 
         if not isinstance(inner, Transformer):
             raise ValueError("pipeline parallelism wraps a Transformer LM")
-        if (inner.config.pos_emb != "rope" or inner.config.norm != "rms"
-                or inner.config.bias):
-            # the pipelined forward re-implements embed/ln2 inline for the
-            # native architecture only; silently training a GPT-2-family
-            # config here would drop its positional table and biases
+        native_arch = (inner.config.pos_emb == "rope"
+                       and inner.config.norm == "rms"
+                       and not inner.config.bias)
+        # MoE first: it rejects non-native under EVERY schedule, so the
+        # 1F1B guard below can honestly recommend gpipe for the rest
+        if not native_arch and inner.config.moe_every == 1:
             raise ValueError(
-                "pipeline parallelism supports the native architecture "
-                "(pos_emb='rope', norm='rms', bias=False) only; "
-                f"got pos_emb={inner.config.pos_emb!r}, "
-                f"norm={inner.config.norm!r}, bias={inner.config.bias}")
+                "pipeline + MoE requires the native architecture (the "
+                "MoE stage normalizes with rms inline)")
+        if not native_arch and schedule == "1f1b":
+            # the 1F1B schedule hand-writes the embedding backward
+            # (token-table scatter only) and injects raw token embeds;
+            # GPT-2-family configs (learned positions / layernorm /
+            # biases) pipeline under GPipe, whose autodiff covers the
+            # positional table and bias gradients
+            raise ValueError(
+                "schedule='1f1b' supports the native architecture "
+                "(pos_emb='rope', norm='rms', bias=False); converted "
+                "GPT-2-family configs pipeline with schedule='gpipe' "
+                f"(got pos_emb={inner.config.pos_emb!r}, "
+                f"norm={inner.config.norm!r}, bias={inner.config.bias})")
         if inner.config.moe_every > 1:
             # Stage stacking requires HOMOGENEOUS blocks: every layer's
             # params stack along one leading [L/P] axis (init_params), so
@@ -168,11 +179,16 @@ class PipelinedTransformerLM:
         return (self.n_pipe, self.virtual_stages, self.layers_per_stage)
 
     def init_params(self, rng=0) -> dict:
-        """Flat transformer store restacked: per-layer params become
-        ``blocks/<suffix>`` with leading [P, L/P] axes ([P, V, L/(P*V)]
-        interleaved: layer l lives at [stage % P, stage // P, l % Lc]
-        where stage = l // Lc — the Megatron round-robin chunk layout)."""
-        flat = self.inner.init_params(rng)
+        return self.restack_params(self.inner.init_params(rng))
+
+    def restack_params(self, flat: Mapping) -> dict:
+        """Flat transformer store (``layer<i>/*``) restacked for the
+        pipeline: per-layer params become ``blocks/<suffix>`` with
+        leading [P, L/P] axes ([P, V, L/(P*V)] interleaved: layer l
+        lives at [stage % P, stage // P, l % Lc] where stage = l // Lc —
+        the Megatron round-robin chunk layout).  The inverse of
+        :meth:`flat_params` — converts an EXISTING checkpoint (a dense
+        pretrain, an HF conversion) for pipelined training."""
         out: dict = {}
         by_suffix: dict[str, list] = {}
         for i in range(self.config.n_layers):
@@ -315,7 +331,20 @@ class PipelinedTransformerLM:
 
     def loss(self, params: Mapping, batch) -> jax.Array:
         tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
-        h = jnp.take(params["embed/tok"], tokens, axis=0)
+        if (self.config.pos_emb == "learned"
+                and tokens.shape[1] > self.config.max_seq):
+            # same trace-time guard as Transformer._forward: embed's
+            # mode="clip" would otherwise silently reuse the last
+            # positional row for every overlong position
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds the "
+                f"learned-position table max_seq={self.config.max_seq}")
+        # the model's own embed: adds the learned positional table for
+        # GPT-2-family configs (a raw token-table take would silently
+        # drop it); rope configs take positions inside each stage's qkv
+        h = self.inner.embed(
+            params, tokens,
+            jnp.arange(tokens.shape[1], dtype=jnp.int32))
         stage_params = {name: value for name, value in params.items()
                         if name.startswith(self.BLOCK_PREFIX)}
         if self.config.moe_every == 1:

@@ -394,12 +394,27 @@ def test_export_tied_destination_guard(llama_pair):
         to_hf_llama(model, params, tie_word_embeddings=True)
 
 
-def test_pipeline_rejects_nonnative_architecture(hf_pair):
+def test_pipeline_composes_with_converted_gpt2(hf_pair, rng):
+    """A CONVERTED GPT-2 checkpoint trains under pipeline parallelism
+    (GPipe) since round 5: the pipelined loss equals the plain converted
+    model's (positional table and biases included).  The hand-written
+    1F1B schedule keeps its native-arch guard and points at gpipe."""
+    import jax
+
     from parameter_server_distributed_tpu.parallel.mesh import build_mesh
     from parameter_server_distributed_tpu.parallel.pipeline import (
         PipelinedTransformerLM)
     from parameter_server_distributed_tpu.config import MeshConfig
-    _, model, _ = hf_pair
+    _, model, params = hf_pair
     mesh = build_mesh(MeshConfig(pipeline=2, data=4))
-    with pytest.raises(ValueError, match="native architecture"):
-        PipelinedTransformerLM(model, mesh)
+    piped = PipelinedTransformerLM(model, mesh, num_microbatches=2)
+    tokens = rng.integers(0, 128, (8, 16)).astype(np.int32)
+    # the converted store is the unrolled layer<i>/* layout; restack it
+    # into the pipeline's blocks/* layout so both run IDENTICAL weights
+    loss_plain = float(jax.jit(model.loss)(params, jnp.asarray(tokens)))
+    stacked = piped.restack_params(
+        {k: jnp.asarray(v) for k, v in params.items()})
+    loss_piped = float(jax.jit(piped.loss)(stacked, jnp.asarray(tokens)))
+    np.testing.assert_allclose(loss_piped, loss_plain, rtol=1e-5)
+    with pytest.raises(ValueError, match="gpipe"):
+        PipelinedTransformerLM(model, mesh, schedule="1f1b")

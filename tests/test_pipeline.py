@@ -110,22 +110,10 @@ def _lm_fixtures(rng, n_layers=4, pipe=2, seq=16, batch=8):
 
 
 def _restack_grads(piped, flat_grads):
-    """Flat per-layer grads -> the pipelined blocks/ layout, for comparison."""
-    by_suffix = {}
-    config = piped.config
-    for i in range(config.n_layers):
-        for name, g in flat_grads.items():
-            if name.startswith(f"layer{i}/"):
-                by_suffix.setdefault(name.split("/", 1)[1], []).append(g)
-    out = {}
-    for suffix, values in by_suffix.items():
-        stacked = np.stack(values)
-        out["blocks/" + suffix] = stacked.reshape(
-            piped.n_pipe, piped.layers_per_stage, *stacked.shape[1:])
-    for name, g in flat_grads.items():
-        if not name.startswith("layer"):
-            out[name] = np.asarray(g)
-    return out
+    """Flat per-layer grads -> the pipelined blocks/ layout, for
+    comparison — the model's own checkpoint-restack transform."""
+    return {name: np.asarray(value) for name, value in
+            piped.restack_params(flat_grads).items()}
 
 
 def test_pipelined_lm_loss_matches_plain(rng):
@@ -794,3 +782,51 @@ def test_pipelined_moe_1f1b_interleaved_matches_plain_1f1b(rng):
         np.testing.assert_allclose(np.asarray(flat2[name]),
                                    np.asarray(flat1[name]),
                                    rtol=5e-4, atol=1e-6, err_msg=name)
+
+
+def test_pipelined_gpt2_arch_matches_plain(rng):
+    """Converted GPT-2-family configs (learned positions + layernorm +
+    biases) pipeline under GPipe: the model's own embed adds the
+    positional table, the stage helpers carry biases/LN, and loss AND
+    gradients (positional table and biases included) match the plain
+    model.  The hand-written 1F1B schedule keeps its native-arch guard."""
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=4,
+                               d_ff=64, max_seq=16, dtype=jnp.float32,
+                               pos_emb="learned", norm="layernorm",
+                               bias=True, mlp_act="gelu")
+    plain = Transformer(config)
+    piped = PipelinedTransformerLM(plain, mesh, num_microbatches=2,
+                                   schedule="gpipe")
+    tokens = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    plain_params = plain.init_params(0)
+    piped_params = piped.init_params(0)
+    loss_plain = float(jax.jit(plain.loss)(plain_params, tokens))
+    loss_piped = float(jax.jit(piped.loss)(piped_params, tokens))
+    np.testing.assert_allclose(loss_piped, loss_plain, rtol=1e-5)
+
+    g_plain = jax.jit(jax.grad(plain.loss))(plain_params, tokens)
+    g_piped = jax.jit(jax.grad(piped.loss))(piped_params, tokens)
+    expected = _restack_grads(piped, {k: np.asarray(v)
+                                      for k, v in g_plain.items()})
+    assert set(expected) == set(g_piped)
+    # the params a raw token-embed pipeline would silently drop
+    for name in ("embed/pos", "layer0/attn/bq", "final_ln/bias"):
+        assert name in g_plain
+    for name in sorted(expected):
+        np.testing.assert_allclose(
+            np.asarray(g_piped[name]), expected[name], rtol=3e-4,
+            atol=1e-5, err_msg=name)
+
+    with pytest.raises(ValueError, match="gpipe"):
+        PipelinedTransformerLM(plain, mesh, schedule="1f1b")
+    # the learned-position overflow guard survives the pipelining (the
+    # plain model raises; embed's mode='clip' must not silently engage)
+    with pytest.raises(ValueError, match="exceeds the"):
+        piped.loss(piped_params,
+                   rng.integers(0, 64, (8, 32)).astype(np.int32))

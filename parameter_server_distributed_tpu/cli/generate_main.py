@@ -33,6 +33,16 @@ import sys
 from ..config import parse_argv, require_flag_value
 
 
+def draft_cost_ratio(flags: dict, draft, model) -> float:
+    """--draft-cost-ratio if given, else the parameter-count proxy the
+    adaptive depth controller's cost model defaults to (per-token decode
+    cost tracks params, FLOPs- or bytes-bound alike).  Shared by
+    pst-generate and pst-serve so the default cannot drift."""
+    if "draft-cost-ratio" in flags:
+        return float(flags["draft-cost-ratio"])
+    return max(0.05, draft.num_params() / model.num_params())
+
+
 def draft_ckpt_flags(path: str, lora_alpha: str = "") -> dict:
     """--draft-ckpt accepts either checkpoint form: a single-file host
     checkpoint (reference binary codec) or a sharded checkpoint DIRECTORY
@@ -128,7 +138,8 @@ KNOWN_FLAGS = frozenset({
     "ckpt-dir", "avg-last", "tokens", "prompt", "top-k", "top-p", "beam",
     "temperature", "max-new", "lora-alpha", "draft-lora-alpha",
     "draft-model", "draft-ckpt", "draft-seed",
-    "draft-len", "length-penalty", "hf-gpt2",
+    "draft-len", "adaptive-draft", "draft-cost-ratio",
+    "length-penalty", "hf-gpt2",
 })
 
 
@@ -166,6 +177,7 @@ def main(argv: list[str] | None = None) -> int:
     # bare --lora-alpha would merge with alpha 1 instead of the trained
     # value, silently mis-scaling every adapter
     require_flag_value(argv, "--lora-alpha", "--draft-lora-alpha",
+                       "--draft-cost-ratio",
                        hint="the ALPHA the run trained with")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
@@ -256,13 +268,23 @@ def main(argv: list[str] | None = None) -> int:
         # whole-loop-on-device batched decoder (accept/resample jitted,
         # per-row ragged caches) — the serving path; the host-loop
         # speculative_generate stays as the tested reference
+        # --adaptive-draft: --draft-len becomes the CAP; the first call
+        # runs measured spec-vs-greedy probes and memoizes the winning
+        # depth (one-shot CLI calls pay the calibration, so fixed depth
+        # stays the default here — servers and repeated callers benefit)
+        adaptive = "adaptive-draft" in flags
+        rho = draft_cost_ratio(flags, draft, model)
         out, stats = speculative_generate_batched(
             model, params, draft, dparams, prompt, max_new,
             draft_len=int(flags.get("draft-len", 4)),
-            temperature=temperature, seed=seed)
+            temperature=temperature, seed=seed, adaptive=adaptive,
+            draft_cost_ratio=rho)
+        depth_note = (f", settled depth {stats['draft_depth']}"
+                      if adaptive else "")
         print(f"speculative: {stats['tokens_per_target_forward']:.2f} "
               f"tokens/target-forward (incl. prefill), accept rate "
-              f"{stats['draft_accept_rate']:.2f}", file=sys.stderr)
+              f"{stats['draft_accept_rate']:.2f}{depth_note}",
+              file=sys.stderr)
     elif beam > 1:
         if top_k or top_p or "temperature" in flags:
             raise ValueError("--beam is deterministic; it does not combine "

@@ -6,6 +6,12 @@ face of models/serving.DecodeServer.
               [--slots=8] [--max-len=2048] [--temperature=0.8 --top-k=40] \\
               [--quant=int8] [--kv-cache=int8] [--eos=ID] \\
               [--prompt-cache=N]   # repeated prompts skip prefill (LRU)
+              [--draft-model=tiny_lm --draft-ckpt=... --draft-len=4]
+              [--no-adaptive-draft] [--draft-cost-ratio=R]
+              # speculative serving: --draft-len is the depth CAP; the
+              # server adapts per-round depth from the measured accept
+              # rate (disabling speculation when it cannot pay) unless
+              # --no-adaptive-draft pins it
 
 Line protocol (JSONL on stdin/stdout — composable behind any transport):
 
@@ -46,6 +52,7 @@ KNOWN_FLAGS = frozenset({
     "top-k", "top-p", "eos", "quant", "kv-cache", "default-max-new",
     "lora-alpha", "draft-lora-alpha", "prompt-cache",
     "draft-model", "draft-ckpt", "draft-seed", "draft-len",
+    "no-adaptive-draft", "draft-cost-ratio",
 })
 
 
@@ -90,6 +97,9 @@ def main(argv: list[str] | None = None) -> int:
     # value, silently mis-scaling every adapter
     require_flag_value(argv, "--lora-alpha", "--draft-lora-alpha",
                        hint="the ALPHA the run trained with")
+    require_flag_value(argv, "--draft-cost-ratio",
+                       hint="draft/target per-token cost for the "
+                            "adaptive depth controller")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
         raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
@@ -136,7 +146,7 @@ def main(argv: list[str] | None = None) -> int:
         if not isinstance(draft, _T):
             raise ValueError(f"--draft-model={flags['draft-model']!r} "
                              "is not an LM")
-        from .generate_main import draft_ckpt_flags
+        from .generate_main import draft_ckpt_flags, draft_cost_ratio
         dparams, dsource = load_params(
             draft_ckpt_flags(flags.get("draft-ckpt", ""),
                              flags.get("draft-lora-alpha", "")), draft,
@@ -146,7 +156,12 @@ def main(argv: list[str] | None = None) -> int:
         print(f"draft: {dsource}", file=sys.stderr)
         spec_kwargs = dict(
             draft=draft, draft_params=dparams,
-            draft_len=int(flags.get("draft-len", "4")))
+            draft_len=int(flags.get("draft-len", "4")),
+            # adaptive depth on by default (--draft-len is the cap);
+            # --no-adaptive-draft pins it.  --draft-cost-ratio overrides
+            # the param-count proxy for the controller's cost model
+            adaptive_draft="no-adaptive-draft" not in flags,
+            draft_cost_ratio=draft_cost_ratio(flags, draft, model))
     srv = DecodeServer(
         model, params,
         slots=int(flags.get("slots", "8")),

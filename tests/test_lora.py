@@ -329,3 +329,24 @@ def test_lora_ema_shadow_tracks_adapters_only(rng):
     shadow = np.asarray(shadows[-1]["layer0/attn/wq/lora_b"])
     assert np.abs(shadow).max() > 0
     assert not np.allclose(shadow, live)
+
+
+def test_lora_ema_survives_resume(tmp_path):
+    """--lora x --ema x --resume: the masked EmaState (MaskedNode
+    placeholders for frozen base entries) must round-trip the sharded
+    checkpoint template restore, and the resumed run still reports
+    ema_eval_loss (the advisor flagged template-free restores degrading
+    NamedTuples — the template path must not)."""
+    from parameter_server_distributed_tpu.parallel.train_loop import (
+        TrainLoopConfig, run_training)
+
+    config = dict(
+        model="tiny_lm", batch_size=4, steps=4, optimizer="adam",
+        learning_rate=1e-2, lora="2:4", ema=0.7, eval_every=4,
+        eval_steps=1, checkpoint_dir=str(tmp_path / "ft"),
+        checkpoint_every=4, log_every=2)
+    first = run_training(TrainLoopConfig(**config))
+    assert np.isfinite(first["ema_eval_loss"])
+    resumed = run_training(TrainLoopConfig(**config, resume=True))
+    assert resumed["steps"] == 4            # nothing further to train
+    assert np.isfinite(resumed["ema_eval_loss"])

@@ -89,24 +89,15 @@ class PipelinedTransformerLM:
         native_arch = (inner.config.pos_emb == "rope"
                        and inner.config.norm == "rms"
                        and not inner.config.bias)
-        # MoE first: it rejects non-native under EVERY schedule, so the
-        # 1F1B guard below can honestly recommend gpipe for the rest
+        # the one arch restriction left: the MoE stage normalizes with
+        # rms inline, so non-native configs cannot pipeline all-MoE
+        # blocks (dense GPT-2-family configs run under BOTH schedules —
+        # the 1F1B injection/backward goes through the model's embed)
         if not native_arch and inner.config.moe_every == 1:
             raise ValueError(
                 "pipeline + MoE requires the native architecture (the "
                 "MoE stage normalizes with rms inline)")
-        if not native_arch and schedule == "1f1b":
-            # the 1F1B schedule hand-writes the embedding backward
-            # (token-table scatter only) and injects raw token embeds;
-            # GPT-2-family configs (learned positions / layernorm /
-            # biases) pipeline under GPipe, whose autodiff covers the
-            # positional table and bias gradients
-            raise ValueError(
-                "schedule='1f1b' supports the native architecture "
-                "(pos_emb='rope', norm='rms', bias=False); converted "
-                "GPT-2-family configs pipeline with schedule='gpipe' "
-                f"(got pos_emb={inner.config.pos_emb!r}, "
-                f"norm={inner.config.norm!r}, bias={inner.config.bias})")
+
         if inner.config.moe_every > 1:
             # Stage stacking requires HOMOGENEOUS blocks: every layer's
             # params stack along one leading [L/P] axis (init_params), so
@@ -468,6 +459,13 @@ class PipelinedTransformerLM:
         head_m = {t_fwd(m, V - 1, n_pipe - 1): m for m in range(M)}
         embed_m = {t_bwd(m, 0, 0): m for m in range(M)}
 
+        inner_embed = self.inner.embed
+        learned_pos = self.config.pos_emb == "learned"
+        positions_iota = jnp.arange(seq, dtype=jnp.int32)
+        if learned_pos and seq > self.config.max_seq:
+            raise ValueError(
+                f"sequence length {seq} exceeds the learned-position "
+                f"table max_seq={self.config.max_seq}")
         blocks = {k: v for k, v in params.items()
                   if k.startswith(self.BLOCK_PREFIX)}
         rest = {k: v for k, v in params.items()
@@ -560,8 +558,11 @@ class PipelinedTransformerLM:
                 rem0, i0 = divmod(t % PV, n_pipe)
                 m0 = (t // PV) * n_pipe + i0
                 if rem0 == 0 and m0 < M:
-                    inj = jnp.take(rest_in["embed/tok"], tok_mb[m0],
-                                   axis=0).astype(acts_dtype)
+                    # the model's embed adds the learned positional table
+                    # for GPT-2-family configs; its backward is the
+                    # hand-written scatter at the embed_m tick below
+                    inj = inner_embed(rest_in, tok_mb[m0],
+                                      positions_iota).astype(acts_dtype)
                     state_in = jnp.where(my == 0, inj, state)
                 else:
                     state_in = state
@@ -634,6 +635,14 @@ class PipelinedTransformerLM:
                         g_rest["embed/tok"] = (
                             g_rest["embed/tok"].at[tok_mb[embed_m[t]]].add(
                                 dx_send * emb_mask))
+                        if learned_pos:
+                            # h = tok_table[tokens] + pos_table[0..S-1]:
+                            # the positional rows see every microbatch at
+                            # the same positions, so their cotangent is
+                            # the batch-sum of dx
+                            g_rest["embed/pos"] = (
+                                g_rest["embed/pos"].at[:seq].add(
+                                    jnp.sum(dx_send * emb_mask, axis=0)))
 
                 # ---- rotate activations forward, cotangents backward
                 if t < T - 1:

@@ -416,5 +416,10 @@ def test_pipeline_composes_with_converted_gpt2(hf_pair, rng):
         {k: jnp.asarray(v) for k, v in params.items()})
     loss_piped = float(jax.jit(piped.loss)(stacked, jnp.asarray(tokens)))
     np.testing.assert_allclose(loss_piped, loss_plain, rtol=1e-5)
-    with pytest.raises(ValueError, match="gpipe"):
-        PipelinedTransformerLM(model, mesh, schedule="1f1b")
+    # the hand-written 1F1B schedule handles the converted arch too
+    fb = PipelinedTransformerLM(model, mesh, num_microbatches=2,
+                                schedule="1f1b")
+    loss_fb, grads_fb = jax.jit(fb.value_and_grad)(
+        stacked, jnp.asarray(tokens))
+    np.testing.assert_allclose(float(loss_fb), loss_plain, rtol=1e-5)
+    assert float(np.abs(np.asarray(grads_fb["embed/pos"])).max()) > 0

@@ -823,10 +823,23 @@ def test_pipelined_gpt2_arch_matches_plain(rng):
             np.asarray(g_piped[name]), expected[name], rtol=3e-4,
             atol=1e-5, err_msg=name)
 
-    with pytest.raises(ValueError, match="gpipe"):
-        PipelinedTransformerLM(plain, mesh, schedule="1f1b")
+    # 1F1B covers GPT-2-family configs too since round 5: the schedule
+    # injects via the model's embed (positional table included) and
+    # scatters the positional-table gradient at the embed tick —
+    # loss AND grads must match GPipe-by-autodiff exactly
+    fb = PipelinedTransformerLM(plain, mesh, num_microbatches=2,
+                                schedule="1f1b")
+    loss_fb, g_fb = jax.jit(fb.value_and_grad)(piped_params, tokens)
+    np.testing.assert_allclose(float(loss_fb), loss_piped, rtol=1e-5)
+    for name in sorted(expected):
+        np.testing.assert_allclose(
+            np.asarray(g_fb[name]), expected[name], rtol=3e-4,
+            atol=1e-5, err_msg=f"1f1b {name}")
     # the learned-position overflow guard survives the pipelining (the
     # plain model raises; embed's mode='clip' must not silently engage)
     with pytest.raises(ValueError, match="exceeds the"):
         piped.loss(piped_params,
                    rng.integers(0, 64, (8, 32)).astype(np.int32))
+    with pytest.raises(ValueError, match="exceeds the"):
+        fb.value_and_grad(piped_params,
+                          rng.integers(0, 64, (8, 32)).astype(np.int32))

@@ -350,3 +350,60 @@ def test_lora_ema_survives_resume(tmp_path):
     resumed = run_training(TrainLoopConfig(**config, resume=True))
     assert resumed["steps"] == 4            # nothing further to train
     assert np.isfinite(resumed["ema_eval_loss"])
+
+
+def test_lora_composes_with_moe_and_converted_arch_1f1b(rng):
+    """Two more cells of the composition matrix: (a) LoRA on an all-MoE
+    LM — adapters target the attention projections, router/experts stay
+    frozen base weights; (b) LoRA through the 1F1B schedule on a
+    GPT-2-ARCH config (learned positions + layernorm + biases), the
+    round-5 converted-checkpoint path."""
+    import optax
+
+    from parameter_server_distributed_tpu.config import MeshConfig
+    from parameter_server_distributed_tpu.models.lora import (
+        freeze_base, init_lora, lora_loss, lora_value_and_grad)
+    from parameter_server_distributed_tpu.models.transformer import (
+        Transformer, TransformerConfig, switch_lm)
+    from parameter_server_distributed_tpu.parallel.mesh import build_mesh
+    from parameter_server_distributed_tpu.parallel.pipeline import (
+        PipelinedTransformerLM)
+
+    # (a) MoE: one masked adam step moves adapters only
+    moe = switch_lm(vocab=128, seq=16)
+    params = init_lora(moe.init_params(0), rank=2, rng=1)
+    opt = freeze_base(optax.adam(1e-2))
+    state = opt.init(params)
+    tokens = rng.integers(0, 128, (4, 16)).astype(np.int32)
+    loss_fn = lora_loss(moe.loss, alpha=4.0)
+    grads = jax.grad(loss_fn)(params, tokens)
+    updates, state = opt.update(grads, state, params)
+    new = optax.apply_updates(params, updates)
+    assert float(np.abs(np.asarray(
+        new["layer0/attn/wq/lora_b"]
+        - params["layer0/attn/wq/lora_b"])).max()) > 0
+    np.testing.assert_array_equal(np.asarray(new["layer0/moe/w1"]),
+                                  np.asarray(params["layer0/moe/w1"]))
+    np.testing.assert_array_equal(np.asarray(new["layer0/moe/router/w"]),
+                                  np.asarray(params["layer0/moe/router/w"]))
+
+    # (b) GPT-2 arch x LoRA x 1F1B: collapse-wrapped schedule grads —
+    # at init (B=0) loss equals base, dL/dB flows, base cotangents exist
+    mesh = build_mesh(MeshConfig(pipeline=2, data=4))
+    config = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=4,
+                               d_ff=64, max_seq=16, dtype=jnp.float32,
+                               pos_emb="learned", norm="layernorm",
+                               bias=True, mlp_act="gelu")
+    piped = PipelinedTransformerLM(Transformer(config), mesh,
+                                   num_microbatches=2, schedule="1f1b")
+    base_params = piped.init_params(0)
+    lparams = init_lora(base_params, rank=2, rng=1)
+    tokens = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    vg = jax.jit(lora_value_and_grad(piped.value_and_grad, alpha=4.0))
+    loss0, grads = vg(lparams, tokens)
+    base_loss, _ = jax.jit(piped.value_and_grad)(base_params, tokens)
+    # B=0 at init: the adapted model IS the base model
+    np.testing.assert_allclose(float(loss0), float(base_loss), rtol=1e-5)
+    assert float(np.abs(np.asarray(
+        grads["blocks/attn/wq/lora_b"])).max()) > 0
+    assert float(np.abs(np.asarray(grads["embed/pos"])).max()) > 0

@@ -186,22 +186,36 @@ def get_model_and_batches(name: str, batch_size: int, seed: int = 0,
                 batch_size, seq_len=model.config.max_seq,
                 vocab=model.config.vocab, seed=seed)
         return model, data_fn(batch_size, seed)
-    from ..data.files import npz_stream, token_stream
     if file_kind == "tokens":
-        if data_path.endswith(".txt"):
-            # raw text corpus: byte-tokenize to a cached shard on first
-            # use (data/text.py), then stream crops like any shard.  The
-            # model's vocab must cover the byte tokenizer's 258 ids.
-            from ..data.text import ByteTokenizer, require_vocab, text_stream
-            tok = ByteTokenizer()
-            require_vocab(model.config.vocab, tok)
-            batches = text_stream(data_path, batch_size,
-                                  seq_len=model.config.max_seq, seed=seed,
-                                  tokenizer=tok)
-        else:
-            batches = token_stream(data_path, batch_size,
-                                   seq_len=model.config.max_seq, seed=seed,
-                                   vocab=model.config.vocab)
+        batches = lm_batches(model, batch_size, seed=seed,
+                             data_path=data_path)
     else:
+        from ..data.files import npz_stream
         batches = npz_stream(data_path, batch_size, seed=seed)
     return model, batches
+
+
+def lm_batches(model, batch_size: int, seed: int = 0, data_path: str = ""):
+    """Token batches for an arbitrary transformer LM — the registry's
+    "tokens" data branch exposed for models built OUTSIDE the registry
+    (HF conversions, hand-constructed configs): file-backed data when
+    ``data_path`` is set (.txt byte-tokenized via data/text.py, else a
+    token memmap via data/files.py), synthetic (vocab, max_seq) crops
+    otherwise."""
+    if not data_path:
+        from ..data.synthetic import synthetic_tokens
+        return synthetic_tokens(batch_size, seq_len=model.config.max_seq,
+                                vocab=model.config.vocab, seed=seed)
+    if data_path.endswith(".txt"):
+        # raw text corpus: byte-tokenize to a cached shard on first use;
+        # the model's vocab must cover the byte tokenizer's 258 ids
+        from ..data.text import ByteTokenizer, require_vocab, text_stream
+        tok = ByteTokenizer()
+        require_vocab(model.config.vocab, tok)
+        return text_stream(data_path, batch_size,
+                           seq_len=model.config.max_seq, seed=seed,
+                           tokenizer=tok)
+    from ..data.files import token_stream
+    return token_stream(data_path, batch_size,
+                        seq_len=model.config.max_seq, seed=seed,
+                        vocab=model.config.vocab)

@@ -36,6 +36,10 @@ log = logging.getLogger("pst.train")
 @dataclasses.dataclass(frozen=True)
 class TrainLoopConfig:
     model: str = "mnist_mlp"
+    hf_gpt2: str = ""             # path to a transformers GPT-2 checkout:
+                                  # train/fine-tune the CONVERTED model
+                                  # (models/hf.from_hf_gpt2) instead of a
+                                  # registry preset
     batch_size: int = 64          # global batch
     data_path: str = ""           # file-backed data; empty = synthetic
     seq_len: int = 0              # LM sequence-length override (0 = default)
@@ -119,14 +123,39 @@ def run_training(config: TrainLoopConfig) -> dict:
                 f"must divide by process count {n_proc}")
         load_batch = config.batch_size // n_proc
         load_seed = config.seed + 7919 * (jax.process_index() + 1)
-    model, batches = get_model_and_batches(config.model, load_batch,
-                                           seed=load_seed,
-                                           data_path=config.data_path,
-                                           dtype=config.model_dtype,
-                                           remat=config.remat,
-                                           scan=config.scan_layers,
-                                           seq_len=config.seq_len,
-                                           remat_policy=config.remat_policy)
+    hf_params = None
+    # the sharding rule keys on the model name; a converted checkpoint is
+    # a transformer whatever config.model defaults to
+    rule_model = "transformer" if config.hf_gpt2 else config.model
+    if config.hf_gpt2:
+        # converted-checkpoint training: model + weights come from the
+        # transformers checkout, data from --data or the synthetic stream
+        if config.init_ckpt_dir:
+            raise ValueError("--hf-gpt2 and --init-ckpt-dir are both "
+                             "parameter initializers; pass one")
+        if config.seq_len or config.remat or config.remat_policy:
+            raise ValueError("--hf-gpt2 fixes seq (n_positions) and has "
+                             "no remat wiring; drop --seq/--remat/"
+                             "--remat-policy")
+        import transformers
+
+        from ..models.hf import from_hf_gpt2
+        from ..models.registry import lm_batches, resolve_dtype
+        hf_model = transformers.GPT2LMHeadModel.from_pretrained(
+            config.hf_gpt2)
+        model, hf_params = from_hf_gpt2(
+            hf_model, dtype=resolve_dtype(config.model_dtype or "f32"),
+            scan_layers=bool(config.scan_layers))
+        batches = lm_batches(model, load_batch, seed=load_seed,
+                             data_path=config.data_path)
+        log.info("converted HF GPT-2 checkpoint %s: %d params",
+                 config.hf_gpt2, model.num_params())
+    else:
+        model, batches = get_model_and_batches(
+            config.model, load_batch, seed=load_seed,
+            data_path=config.data_path, dtype=config.model_dtype,
+            remat=config.remat, scan=config.scan_layers,
+            seq_len=config.seq_len, remat_policy=config.remat_policy)
     from ..models.transformer import Transformer, select_attention
     if isinstance(model, Transformer):
         if mesh.shape["pipe"] > 1:
@@ -177,7 +206,14 @@ def run_training(config: TrainLoopConfig) -> dict:
                 f"--mesh pipe axis applies to transformer models; "
                 f"{config.model!r} is not one")
     loss_fn = model.loss
-    init_params = model.init_params(config.seed)
+    if hf_params is not None:
+        # the converted weights ARE the initializer; a pipelined model
+        # restacks them into its blocks/* layout
+        init_params = (model.restack_params(hf_params)
+                       if hasattr(model, "restack_params")
+                       else dict(hf_params))
+    else:
+        init_params = model.init_params(config.seed)
     optimizer = make_optimizer(config.optimizer, config.learning_rate,
                                schedule=config.schedule,
                                warmup_steps=config.warmup_steps,
@@ -236,7 +272,7 @@ def run_training(config: TrainLoopConfig) -> dict:
                  "tensors train, base frozen", rank, alpha,
                  len(lora_names(init_params)))
     trainer = ShardedTrainer(
-        loss_fn, mesh, _pick_rule(config.model, mesh),
+        loss_fn, mesh, _pick_rule(rule_model, mesh),
         optimizer,
         accum_steps=config.accum_steps,
         grad_fn=grad_fn)
@@ -264,12 +300,18 @@ def run_training(config: TrainLoopConfig) -> dict:
                 "--eval-every without --eval-data: evaluating on "
                 "shifted-seed crops of the TRAINING file %s (overlapping "
                 "data, not a held-out split)", config.data_path)
-        _, eval_batches = get_model_and_batches(
-            config.model, load_batch, seed=load_seed + 100_003,
-            data_path=eval_source,
-            dtype=config.model_dtype, remat=config.remat,
-            scan=config.scan_layers, seq_len=config.seq_len,
-            remat_policy=config.remat_policy)
+        if config.hf_gpt2:
+            from ..models.registry import lm_batches
+            eval_batches = lm_batches(model, load_batch,
+                                      seed=load_seed + 100_003,
+                                      data_path=eval_source)
+        else:
+            _, eval_batches = get_model_and_batches(
+                config.model, load_batch, seed=load_seed + 100_003,
+                data_path=eval_source,
+                dtype=config.model_dtype, remat=config.remat,
+                scan=config.scan_layers, seq_len=config.seq_len,
+                remat_policy=config.remat_policy)
 
     def run_eval(state, batch_list=None) -> float:
         evaluate = trainer.eval_fn()
@@ -397,7 +439,7 @@ def run_training(config: TrainLoopConfig) -> dict:
                 # different-but-self-consistent layout; the eval jit
                 # expects the params' own specs, so re-place first
                 param_sh = state_shardings(
-                    state, mesh, _pick_rule(config.model, mesh)).params
+                    state, mesh, _pick_rule(rule_model, mesh)).params
                 ema_placed = jax.tree.map(jax.device_put, ema_params,
                                           param_sh)
                 ema_loss = run_eval(

@@ -423,3 +423,35 @@ def test_pipeline_composes_with_converted_gpt2(hf_pair, rng):
         stacked, jnp.asarray(tokens))
     np.testing.assert_allclose(float(loss_fb), loss_plain, rtol=1e-5)
     assert float(np.abs(np.asarray(grads_fb["embed/pos"])).max()) > 0
+
+
+def test_run_training_finetunes_hf_checkpoint(tmp_path, hf_pair, rng):
+    """pst-train --hf-gpt2=<checkout>: the FULL converted-checkpoint
+    fine-tune flow through the training loop — plain, then --lora on a
+    pipe mesh under 1F1B (the round-5 composition for converted
+    models)."""
+    from parameter_server_distributed_tpu.config import MeshConfig
+    from parameter_server_distributed_tpu.parallel.train_loop import (
+        TrainLoopConfig, run_training)
+
+    hf_model, _, _ = hf_pair
+    checkout = tmp_path / "hf_ckpt"
+    hf_model.save_pretrained(checkout)
+
+    summary = run_training(TrainLoopConfig(
+        hf_gpt2=str(checkout), batch_size=8, steps=3, optimizer="adam",
+        learning_rate=1e-3, log_every=1))
+    assert summary["steps"] == 3
+    assert np.isfinite(summary["final_loss"])
+
+    summary2 = run_training(TrainLoopConfig(
+        hf_gpt2=str(checkout), batch_size=8, steps=2, lora="2:4",
+        pipeline_schedule="1f1b", log_every=1,
+        mesh=MeshConfig(pipeline=2, data=4)))
+    assert summary2["steps"] == 2
+    assert np.isfinite(summary2["final_loss"])
+
+    # initializer exclusivity is rejected loudly
+    with pytest.raises(ValueError, match="initializers"):
+        run_training(TrainLoopConfig(
+            hf_gpt2=str(checkout), init_ckpt_dir=str(tmp_path), steps=1))

@@ -35,12 +35,13 @@ optimizer state allocated for them — models/lora.py; merge with
 overrides the LM sequence length (long-context runs; synthetic token
 streams follow the model).
 
-``--hf-gpt2=<checkout>`` trains the CONVERTED transformers GPT-2
-checkpoint instead of a registry preset (models/hf.from_hf_gpt2): the
-converted weights are the initializer, ``--data`` feeds it (synthetic
-crops otherwise), and it composes with ``--lora``, ``--ema``, and a
-``pipe`` mesh axis under either schedule — the fine-tune flow for
-models the reference ecosystem ships.
+``--hf-gpt2=<checkout>`` / ``--hf-llama=<checkout>`` train the
+CONVERTED transformers checkpoint instead of a registry preset
+(models/hf.from_hf_gpt2 / from_hf_llama): the converted weights are the
+initializer, ``--data`` feeds it (synthetic crops otherwise), and both
+compose with ``--lora``, ``--ema``, and a ``pipe`` mesh axis — the
+fine-tune flow for models the reference ecosystem ships (llama
+conversions are the native arch, so every schedule applies).
 
 ``--mesh=pipe:P`` trains transformer models with pipeline parallelism
 (parallel/pipeline.py): layer blocks live on their pipe rank,
@@ -106,8 +107,8 @@ def parse_mesh(spec: str) -> MeshConfig:
 
 
 KNOWN_FLAGS = frozenset({
-    "model", "hf-gpt2", "batch", "data", "seq", "eval-every", "eval-steps",
-    "eval-data",
+    "model", "hf-gpt2", "hf-llama", "batch", "data", "seq", "eval-every",
+    "eval-steps", "eval-data",
     "per-process-data", "prefetch", "attention", "microbatches",
     "pipeline-schedule", "virtual-stages", "dtype", "remat", "no-remat",
     "scan-layers", "remat-policy", "lora", "init-ckpt-dir", "ema",
@@ -133,10 +134,10 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
                          f"--help lists the accepted flags")
 
-    if "model" in flags and "hf-gpt2" in flags:
-        raise SystemExit("--model and --hf-gpt2 both pick the model; "
-                         "pass one (the converted checkpoint defines its "
-                         "own architecture)")
+    if "model" in flags and ("hf-gpt2" in flags or "hf-llama" in flags):
+        raise SystemExit("--model and --hf-gpt2/--hf-llama both pick the "
+                         "model; pass one (the converted checkpoint "
+                         "defines its own architecture)")
     # a bare --lora would silently run a near-useless rank-1 adapter
     # (parse_argv's "1" sentinel); --lora=1 stays a deliberate choice
     require_flag_value(argv, "--lora",
@@ -154,6 +155,7 @@ def main(argv: list[str] | None = None) -> int:
     config = TrainLoopConfig(
         model=flags.get("model", "mnist_mlp"),
         hf_gpt2=flags.get("hf-gpt2", ""),
+        hf_llama=flags.get("hf-llama", ""),
         batch_size=int(flags.get("batch", 64)),
         data_path=flags.get("data", ""),
         seq_len=int(flags.get("seq", 0)),

@@ -40,6 +40,10 @@ class TrainLoopConfig:
                                   # train/fine-tune the CONVERTED model
                                   # (models/hf.from_hf_gpt2) instead of a
                                   # registry preset
+    hf_llama: str = ""            # same for a LlamaForCausalLM checkout
+                                  # (models/hf.from_hf_llama; native
+                                  # rope/rms arch — every schedule and
+                                  # composition applies)
     batch_size: int = 64          # global batch
     data_path: str = ""           # file-backed data; empty = synthetic
     seq_len: int = 0              # LM sequence-length override (0 = default)
@@ -124,32 +128,43 @@ def run_training(config: TrainLoopConfig) -> dict:
         load_batch = config.batch_size // n_proc
         load_seed = config.seed + 7919 * (jax.process_index() + 1)
     hf_params = None
+    hf_path = config.hf_gpt2 or config.hf_llama
     # the sharding rule keys on the model name; a converted checkpoint is
-    # a transformer whatever config.model defaults to
-    rule_model = "transformer" if config.hf_gpt2 else config.model
-    if config.hf_gpt2:
+    # a transformer whatever config.model says
+    rule_model = "transformer" if hf_path else config.model
+    if hf_path:
         # converted-checkpoint training: model + weights come from the
         # transformers checkout, data from --data or the synthetic stream
+        if config.hf_gpt2 and config.hf_llama:
+            raise ValueError("--hf-gpt2 and --hf-llama both pick the "
+                             "checkpoint; pass one")
         if config.init_ckpt_dir:
-            raise ValueError("--hf-gpt2 and --init-ckpt-dir are both "
-                             "parameter initializers; pass one")
+            raise ValueError("--hf-gpt2/--hf-llama and --init-ckpt-dir "
+                             "are both parameter initializers; pass one")
         if config.seq_len or config.remat or config.remat_policy:
-            raise ValueError("--hf-gpt2 fixes seq (n_positions) and has "
-                             "no remat wiring; drop --seq/--remat/"
-                             "--remat-policy")
+            raise ValueError("converted checkpoints fix seq (the HF "
+                             "config's positions) and have no remat "
+                             "wiring; drop --seq/--remat/--remat-policy")
         import transformers
 
-        from ..models.hf import from_hf_gpt2
+        from ..models.hf import from_hf_gpt2, from_hf_llama
         from ..models.registry import lm_batches, resolve_dtype
-        hf_model = transformers.GPT2LMHeadModel.from_pretrained(
-            config.hf_gpt2)
-        model, hf_params = from_hf_gpt2(
-            hf_model, dtype=resolve_dtype(config.model_dtype or "f32"),
+        if config.hf_gpt2:
+            hf_model = transformers.GPT2LMHeadModel.from_pretrained(
+                config.hf_gpt2)
+            convert, default_dtype = from_hf_gpt2, "f32"
+        else:
+            hf_model = transformers.LlamaForCausalLM.from_pretrained(
+                config.hf_llama)
+            convert, default_dtype = from_hf_llama, "bf16"
+        model, hf_params = convert(
+            hf_model,
+            dtype=resolve_dtype(config.model_dtype or default_dtype),
             scan_layers=bool(config.scan_layers))
         batches = lm_batches(model, load_batch, seed=load_seed,
                              data_path=config.data_path)
-        log.info("converted HF GPT-2 checkpoint %s: %d params",
-                 config.hf_gpt2, model.num_params())
+        log.info("converted HF checkpoint %s: %d params", hf_path,
+                 model.num_params())
     else:
         model, batches = get_model_and_batches(
             config.model, load_batch, seed=load_seed,

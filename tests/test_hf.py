@@ -455,3 +455,31 @@ def test_run_training_finetunes_hf_checkpoint(tmp_path, hf_pair, rng):
     with pytest.raises(ValueError, match="initializers"):
         run_training(TrainLoopConfig(
             hf_gpt2=str(checkout), init_ckpt_dir=str(tmp_path), steps=1))
+
+
+def test_run_training_finetunes_hf_llama(tmp_path, rng):
+    """--hf-llama: the converted LlamaForCausalLM trains through
+    run_training — native arch, so the 1F1B pipe mesh applies directly;
+    --hf-gpt2 x --hf-llama conflict rejected."""
+    from parameter_server_distributed_tpu.config import MeshConfig
+    from parameter_server_distributed_tpu.parallel.train_loop import (
+        TrainLoopConfig, run_training)
+
+    torch.manual_seed(0)
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32)
+    checkout = tmp_path / "llama"
+    transformers.LlamaForCausalLM(cfg).save_pretrained(checkout)
+
+    summary = run_training(TrainLoopConfig(
+        hf_llama=str(checkout), batch_size=8, steps=2, lora="2:4",
+        pipeline_schedule="1f1b", log_every=1, model_dtype="f32",
+        mesh=MeshConfig(pipeline=2, data=4)))
+    assert summary["steps"] == 2
+    assert np.isfinite(summary["final_loss"])
+
+    with pytest.raises(ValueError, match="both pick"):
+        run_training(TrainLoopConfig(
+            hf_gpt2=str(checkout), hf_llama=str(checkout), steps=1))

@@ -24,9 +24,15 @@ SWEEP="${SWEEP:-scripts/tpu_recovery_chain.sh}"
 START=$(date +%s)
 
 # Shared predicate + wrapper (scripts/tpu_probe.sh) so watchdog, recovery,
-# and bench.py cannot disagree about what a healthy device is.
+# and bench.py cannot disagree about what a healthy device is.  PROBE_CMD
+# is the same test seam scripts/tpu_sweep_lib.sh exposes
+# (tests/test_tpu_sweep.py drives the full watchdog loop with it);
+# EXPORTED so the child sweep inherits exactly this watchdog's resolved
+# predicate — one health definition per watchdog<->recovery pair at
+# runtime, whatever either file's fallback default says.
+export PROBE_CMD="${PROBE_CMD:-bash scripts/tpu_probe.sh}"
 probe() {
-  bash scripts/tpu_probe.sh
+  $PROBE_CMD
 }
 
 while :; do

@@ -204,3 +204,73 @@ def test_banked_tag_skipped_error_tag_retried(tmp_path):
     rows = _banked(results)
     assert rows["done"]["value"] == 0.4          # untouched
     assert rows["errored"]["metric"] == "fake_mfu"  # retried, replaced
+
+
+def test_watchdog_waits_out_outage_then_banks_and_exits(tmp_path):
+    """The full watchdog loop at 1s timescales: a down probe sleeps and
+    re-probes; once the device 'recovers' the sweep runs to completion
+    and the watchdog exits 0 with everything banked."""
+    results = tmp_path / "r.jsonl"
+    sweep = _write_sweep(tmp_path, "\n".join([
+        "run a FAKE_COST_S=0",
+        "run b FAKE_COST_S=0",
+        ""]))
+    # probe script: fails the first 2 calls (outage), then healthy
+    probe = tmp_path / "probe.sh"
+    probe.write_text("#!/usr/bin/env bash\n"
+                     f"n=$(cat {tmp_path}/probes 2>/dev/null || echo 0)\n"
+                     f"echo $((n + 1)) > {tmp_path}/probes\n"
+                     "[ \"$n\" -ge 2 ]\n")
+    env = _env(tmp_path, results)
+    env.update({"PROBE_CMD": f"bash {probe}", "PROBE_SPACING_S": "1",
+                "DEADLINE_S": "60", "SWEEP": str(sweep)})
+    proc = subprocess.run(["bash", "scripts/tpu_watchdog.sh"], cwd=REPO,
+                          env=env, capture_output=True, text=True,
+                          timeout=90)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    rows = _banked(results)
+    assert rows["a"]["metric"] == rows["b"]["metric"] == "fake_mfu"
+    log = (tmp_path / "sweep.log").read_text()
+    assert "TPU down" in log and "sweep complete" in log
+    assert int((tmp_path / "probes").read_text()) >= 3
+
+
+def test_watchdog_reprobes_after_mid_sweep_tunnel_death(tmp_path):
+    """A sweep abort (rc=2, tunnel died mid-config) sends the watchdog
+    back to probing; the next window resumes the sweep with the already-
+    banked tag skipped."""
+    results = tmp_path / "r.jsonl"
+    # config 'a' banks; 'b' times out — with the probe then DOWN, the lib
+    # aborts rc=2.  The flag file flips the probe back up for the retry,
+    # where 'b' is cheap and banks.
+    flag = tmp_path / "second_window"
+    sweep = _write_sweep(tmp_path, "\n".join([
+        "run a FAKE_COST_S=0",
+        f"if [ ! -f {flag} ]; then",
+        f"  touch {flag}",
+        "  run b FAKE_COST_S=99",    # times out; probe says down -> rc=2
+        "else",
+        "  run b FAKE_COST_S=0",
+        "fi",
+        ""]))
+    # probe: healthy unless mid-first-sweep (flag exists but retry file
+    # doesn't yet) — models the tunnel dying during config b
+    probe = tmp_path / "probe.sh"
+    probe.write_text(
+        "#!/usr/bin/env bash\n"
+        f"if [ -f {flag} ] && [ ! -f {tmp_path}/retry ]; then\n"
+        f"  touch {tmp_path}/retry\n"
+        "  exit 1\n"                 # one down verdict -> rc=2 + one wait
+        "fi\nexit 0\n")
+    env = _env(tmp_path, results)
+    env.update({"PROBE_CMD": f"bash {probe}", "PROBE_SPACING_S": "1",
+                "DEADLINE_S": "60", "SWEEP": str(sweep)})
+    proc = subprocess.run(["bash", "scripts/tpu_watchdog.sh"], cwd=REPO,
+                          env=env, capture_output=True, text=True,
+                          timeout=90)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    rows = _banked(results)
+    assert rows["a"]["metric"] == "fake_mfu"
+    assert rows["b"]["metric"] == "fake_mfu"   # banked on the 2nd window
+    log = (tmp_path / "sweep.log").read_text()
+    assert "sweep aborted (rc=2)" in log

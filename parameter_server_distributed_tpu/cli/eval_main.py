@@ -7,6 +7,7 @@ step, no server.
              [--data=corpus.txt|shard.bin|data.npz] [--batch=32]
              [--steps=16] [--seq=N] [--seed=0] [--dtype=bf16]
              [--scan-layers | --no-scan-layers]
+    pst-eval --hf-gpt2=<checkout> [--data=...]   # converted checkpoint
 
 Output is ONE strict-JSON line: ``{"model": ..., "loss": mean,
 "perplexity": exp(loss)}`` for token models (perplexity is per-token —
@@ -33,8 +34,9 @@ import sys
 from ..config import parse_argv, require_flag_value
 
 KNOWN_FLAGS = frozenset({
-    "model", "dtype", "scan-layers", "no-scan-layers", "seed", "ckpt",
-    "ckpt-dir", "avg-last", "lora-alpha", "data", "batch", "steps", "seq",
+    "model", "hf-gpt2", "dtype", "scan-layers", "no-scan-layers", "seed",
+    "ckpt", "ckpt-dir", "avg-last", "lora-alpha", "data", "batch", "steps",
+    "seq",
 })
 
 
@@ -65,13 +67,36 @@ def main(argv: list[str] | None = None) -> int:
     batch = int(flags.get("batch", 32))
     steps = int(flags.get("steps", 16))
     seed = int(flags.get("seed", 0))
-    model, batches = get_model_and_batches(
-        name, batch, seed=seed + 100_003,  # held-out-style stream shift
-        data_path=flags.get("data", ""), dtype=flags.get("dtype", ""),
-        scan=(False if "no-scan-layers" in flags
-              else True if "scan-layers" in flags else None),
-        seq_len=int(flags.get("seq", 0)))
-    params, source = load_params(flags, model, seed)
+    if flags.get("hf-gpt2"):
+        # evaluate a converted transformers checkpoint directly (same
+        # loader pst-generate/pst-serve use; --seq fixed by n_positions)
+        conflicts = {"model", "ckpt", "ckpt-dir", "avg-last",
+                     "lora-alpha"} & set(flags)
+        if conflicts:
+            # avg-last/lora-alpha act during checkpoint LOADING, which
+            # the hf branch never does — ignoring them would silently
+            # score the raw converted weights
+            raise SystemExit(
+                "--hf-gpt2 defines model AND weights; drop "
+                + "/".join(sorted("--" + c for c in conflicts)))
+        if flags.get("seq"):
+            raise SystemExit("--hf-gpt2 fixes seq (n_positions); "
+                             "drop --seq")
+        from ..models.registry import lm_batches
+        from .generate_main import load_hf
+        model, params, _ = load_hf(flags)
+        name = f"hf-gpt2:{flags['hf-gpt2']}"
+        source = name
+        batches = lm_batches(model, batch, seed=seed + 100_003,
+                             data_path=flags.get("data", ""))
+    else:
+        model, batches = get_model_and_batches(
+            name, batch, seed=seed + 100_003,  # held-out stream shift
+            data_path=flags.get("data", ""), dtype=flags.get("dtype", ""),
+            scan=(False if "no-scan-layers" in flags
+                  else True if "scan-layers" in flags else None),
+            seq_len=int(flags.get("seq", 0)))
+        params, source = load_params(flags, model, seed)
     is_lm = isinstance(model, Transformer)
     if is_lm:
         params = match_layout(model, params)

@@ -54,3 +54,30 @@ def test_trained_checkpoint_beats_fresh_init(tmp_path):
                      "--steps=4")
     assert trained["loss"] < fresh["loss"]
     assert trained["perplexity"] < fresh["perplexity"]
+
+
+def test_eval_hf_checkpoint(tmp_path, capsys):
+    """pst-eval --hf-gpt2: loss/perplexity of a converted transformers
+    checkpoint — the eval leg of the converted-model CLI suite."""
+    import torch
+    import transformers
+
+    from parameter_server_distributed_tpu.cli.eval_main import main
+
+    torch.manual_seed(0)
+    checkout = tmp_path / "hf"
+    transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=32, n_layer=2,
+        n_head=2)).save_pretrained(checkout)
+    rc = main([f"--hf-gpt2={checkout}", "--batch=4", "--steps=2"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"].startswith("hf-gpt2:")
+    assert np.isfinite(out["loss"]) and out["perplexity"] > 1.0
+
+    with pytest.raises(SystemExit, match="defines model"):
+        main([f"--hf-gpt2={checkout}", "--model=small_lm"])
+    # checkpoint-loading flags are meaningless here — rejected, not
+    # silently ignored
+    with pytest.raises(SystemExit, match="lora-alpha"):
+        main([f"--hf-gpt2={checkout}", "--lora-alpha=16"])

@@ -28,7 +28,10 @@ python -m parameter_server_distributed_tpu.cli.train_main \
   --model=tiny_lm --batch=8 --steps="$STEPS" --data="$CORPUS" \
   --optimizer=adamw --lr=3e-3 --ckpt-dir="$WORK/draft" --ckpt-every="$STEPS"
 
-echo "== 2. serve the target with the draft proposing 4 tokens/round =="
+echo "== 2. serve the target with the draft (depth CAP 4 — the server"
+echo "      ADAPTS the per-round depth from the measured accept rate,"
+echo "      disabling speculation if this draft cannot pay on this host;"
+echo "      add --no-adaptive-draft to pin the depth) =="
 python -m parameter_server_distributed_tpu.cli.serve_main \
   --model=small_lm --ckpt-dir="$WORK/target" \
   --draft-model=tiny_lm --draft-ckpt="$WORK/draft" --draft-len=4 \

@@ -953,6 +953,12 @@ def bench_serve() -> dict:
     prompts = [pool[i % len(pool)] for i in range(n_req)]
     prompt_cache = int(os.environ.get("PSDT_BENCH_PROMPT_CACHE", "0"))
 
+    # PSDT_BENCH_SERVE_FUSED=N: between admissions, run up to N decode
+    # rounds per device dispatch (DecodeServer.step_many) — the host
+    # round-trip amortization for dispatch-bound serving (tunneled
+    # devices, tiny models)
+    fused = int(os.environ.get("PSDT_BENCH_SERVE_FUSED", "0"))
+
     def drive(prompt_list, use_spec=True):
         # plain serving keeps the historical 32+per_req cache (the ragged
         # mask attends over max_len, so growing it would silently change
@@ -966,7 +972,12 @@ def bench_serve() -> dict:
         while pending or not srv.idle:
             while pending and srv.has_free_slot:
                 srv.submit(pending.pop(), max_new_tokens=per_req)
-            srv.step()
+            # the admission loop above drained everything admissible,
+            # so fusing here never delays a ready submission
+            if fused > 1:
+                srv.step_many(fused)
+            else:
+                srv.step()
         return srv
 
     vs_baseline = 1.0
@@ -1010,6 +1021,8 @@ def bench_serve() -> dict:
         suffix += f"_distinct{n_distinct}"
     if prompt_cache:
         suffix += f"_pcache{prompt_cache}"
+    if fused > 1:
+        suffix += f"_fused{fused}"
     spec_note = ""
     if draft_name:
         spec_note = (f" draft={draft_name}"

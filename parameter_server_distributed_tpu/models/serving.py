@@ -199,12 +199,54 @@ def _step_runner(model: Transformer, slots: int,
         # exact loop this server exists to keep bandwidth-bound
         @partial(jax.jit, donate_argnums=(2,))
         def run(params, tokens, cache, lengths, temps, rng):
-            logits, cache = decode_block(model, params, tokens[:, None],
-                                         cache, lengths=lengths)
-            rng, sub = jax.random.split(rng)
-            nxt = sample_token_rowwise(logits[:, 0], sub, temps,
-                                       top_k, top_p)
-            return nxt, cache, rng
+            return _decode_round(model, top_k, top_p, params, tokens,
+                                 cache, lengths, temps, rng)
+
+        return run
+
+    return _cached_runner(key, build)
+
+
+def _decode_round(model, top_k, top_p, params, tokens, cache, lengths,
+                  temps, rng):
+    """ONE plain decode round — the single definition both the per-round
+    program (_step_runner) and the fused scan (_multi_step_runner) jit,
+    so step_many's token-exactness vs a step() loop holds by
+    construction (same decode_block -> rng split -> rowwise sample
+    sequence)."""
+    logits, cache = decode_block(model, params, tokens[:, None], cache,
+                                 lengths=lengths)
+    rng, sub = jax.random.split(rng)
+    nxt = sample_token_rowwise(logits[:, 0], sub, temps, top_k, top_p)
+    return nxt, cache, rng
+
+
+def _multi_step_runner(model: Transformer, slots: int, top_k: int,
+                       top_p: float, cache_dtype: str, n_rounds: int):
+    """Jitted per (model, B, truncation, N): N plain decode rounds as ONE
+    compiled lax.scan — rng split and per-round math identical to N
+    calls of the single-step program, so outputs are token-exact vs a
+    step() loop (tested).  The host lever for dispatch-bound serving:
+    each step() round-trip costs a full host<->device dispatch (tens of
+    ms through a tunneled device), and between admissions those rounds
+    need no host decisions."""
+    key = (_model_key(model), "serve_multistep", slots, top_k, top_p,
+           cache_dtype, n_rounds)
+
+    def build():
+        @partial(jax.jit, donate_argnums=(2,))
+        def run(params, tokens, cache, lengths, temps, rng):
+            def body(carry, _):
+                tokens, cache, lengths, rng = carry
+                nxt, cache, rng = _decode_round(
+                    model, top_k, top_p, params, tokens, cache, lengths,
+                    temps, rng)
+                return (nxt, cache, lengths + 1, rng), nxt
+
+            (tokens, cache, lengths, rng), outs = jax.lax.scan(
+                body, (tokens, cache, lengths, rng), None,
+                length=n_rounds)
+            return outs, tokens, cache, rng     # outs: [N, B]
 
         return run
 
@@ -542,6 +584,66 @@ class DecodeServer:
             if self._finishes(entry, token):
                 self._retire(i)
         self._n_steps += 1
+        self._n_emitted += len(emitted)
+        return emitted
+
+    def step_many(self, max_rounds: int = 8) -> list[tuple[int, int]]:
+        """Up to ``max_rounds`` decode rounds in ONE device dispatch
+        (plain mode; speculative mode falls back to per-round step()s —
+        its depth controller needs host decisions between rounds).
+
+        Trades admission latency for dispatch overhead: new submissions
+        wait until the fused rounds return, so call this when the
+        admission queue is empty (bench_serve does between arrivals —
+        the win is the per-round host<->device round-trip, tens of ms on
+        tunneled devices).  The round count is clamped to the minimum
+        remaining budget across active slots (then rounded down to a
+        power of two — one compiled scan per size class), so no slot
+        overshoots max_new; a row finishing EARLY (eos/stop) keeps decoding garbage
+        into its own lane for the rest of the fused block, exactly like
+        a retired lane does between rounds — host truncation discards
+        those tokens and the splice on reuse resets the cache rows.
+        Token-exact vs the equivalent step() loop (identical rng
+        sequence and math; tested)."""
+        if self.idle:
+            return []
+        if self.draft is not None and self._k > 0:
+            return self._spec_step()
+        remaining = [entry.max_new - len(entry.tokens)
+                     for entry in self._slot if entry is not None]
+        n = max(1, min([max_rounds] + remaining))
+        # round DOWN to a power of two: a mixed-budget drain would
+        # otherwise compile a separate scan per distinct n (each compile
+        # costs far more than the dispatches it saves); log2(max_rounds)
+        # programs cover every clamp
+        n = 1 << (n.bit_length() - 1)
+        if n == 1:
+            return self.step()
+        runner = _multi_step_runner(self.model, self.slots, self._top_k,
+                                    self._top_p, self.cache_dtype, n)
+        outs, last, self._cache, self._rng = runner(
+            self.params, jnp.asarray(self._tokens), self._cache,
+            jnp.asarray(self._lengths), jnp.asarray(self._temps),
+            self._rng)
+        outs = np.asarray(outs)                   # [n, B]
+        last = np.asarray(last)
+        emitted: list[tuple[int, int]] = []
+        for r in range(n):
+            for i, entry in enumerate(self._slot):
+                if entry is None:
+                    continue
+                token = int(outs[r, i])
+                entry.tokens.append(token)
+                emitted.append((entry.request_id, token))
+                if self._finishes(entry, token):
+                    # later fused rounds decoded garbage continuations
+                    # for this lane; they are simply not appended
+                    self._retire(i)
+        # mirror what the device wrote: every lane (retired included)
+        # advanced n positions and holds its last fused token
+        self._lengths += n
+        self._tokens[:] = last
+        self._n_steps += n
         self._n_emitted += len(emitted)
         return emitted
 

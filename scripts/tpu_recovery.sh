@@ -61,6 +61,9 @@ run spec_trained_draft_k2        PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small
 # must never lose now — the controller shortens k when accept is low
 run spec_trained_draft_k4        PSDT_BENCH_MODE=generate PSDT_BENCH_MODEL=small_lm PSDT_BENCH_DRAFT=tiny_lm PSDT_BENCH_TRAIN_STEPS=200 PSDT_BENCH_DRAFT_LEN=4 PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
 run serve_small_lm               PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64
+# fused multi-round serving (step_many): amortizes the per-round
+# host<->device dispatch — the tunneled-device regime's biggest lever
+run serve_small_lm_fused8        PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_SERVE_FUSED=8
 run serve_small_lm_int8_full     PSDT_BENCH_MODE=serve PSDT_BENCH_MODEL=small_lm PSDT_BENCH_BATCH=8 PSDT_BENCH_STEPS=64 PSDT_BENCH_QUANT=int8 PSDT_BENCH_KV_CACHE=int8
 # trained tiny_lm draft (self-draft costs as much as the target and can
 # only lose; a cheap trained draft is the regime speculation serves)

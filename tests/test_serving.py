@@ -457,3 +457,66 @@ def test_speculative_serving_adaptive_depth(rng):
     pinned = run(draft=junk, draft_params=junk.init_params(99),
                  draft_len=3, adaptive_draft=False)
     assert pinned.stats["draft_depth"] == 3
+
+
+def test_step_many_token_exact_vs_step_loop(rng):
+    """Fused multi-round serving == the step() loop token for token:
+    greedy and per-request-temperature sampling (identical rng split
+    sequence), a stop token retiring a request MID-fused-block, and a
+    mixed-length batch (the round count clamps to the minimum remaining
+    budget)."""
+    model = tiny()
+    params = model.init_params(0)
+    prompts = [list(rng.integers(0, 96, 5)) for _ in range(3)]
+
+    def run(fused, stops=(), temps=()):
+        srv = DecodeServer(model, params, slots=2, max_len=96, seed=3)
+        results = {}
+        pending = list(enumerate(prompts))
+        while pending or not srv.idle:
+            while pending and srv.has_free_slot:
+                i, p = pending.pop(0)
+                rid = srv.submit(
+                    p, max_new_tokens=10 + 3 * i,       # mixed budgets
+                    stop=list(stops),
+                    temperature=(temps[i % len(temps)] if temps
+                                 else None))
+            (srv.step_many(4) if fused else srv.step())
+        for rid in srv.finished():
+            results[rid] = srv.result(rid)
+        return srv, results
+
+    base_srv, base = run(fused=False)
+    fused_srv, got = run(fused=True)
+    assert got == base
+    assert fused_srv.stats["steps"] == base_srv.stats["steps"]
+
+    # sampling path: same rng stream through the fused scan
+    _, base_s = run(fused=False, temps=(0.8, 0.0))
+    _, got_s = run(fused=True, temps=(0.8, 0.0))
+    assert got_s == base_s
+
+    # a stop token that fires mid-block: truncation must match exactly
+    stop_tok = base[0][1]
+    _, base_stop = run(fused=False, stops=(stop_tok,))
+    _, got_stop = run(fused=True, stops=(stop_tok,))
+    assert got_stop == base_stop
+
+
+def test_step_many_speculative_falls_back(rng):
+    """With an active draft the fused path defers to the adaptive spec
+    round (host decisions between rounds); output stays exact."""
+    model = tiny()
+    params = model.init_params(0)
+    prompt = list(rng.integers(0, 96, 5))
+
+    def run(fused):
+        srv = DecodeServer(model, params, slots=1, max_len=96,
+                           draft=model, draft_params=params, draft_len=3,
+                           adaptive_draft=False)
+        rid = srv.submit(prompt, max_new_tokens=8)
+        while not srv.idle:
+            (srv.step_many(4) if fused else srv.step())
+        return srv.result(rid)
+
+    assert run(True) == run(False)

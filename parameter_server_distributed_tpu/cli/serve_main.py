@@ -8,6 +8,10 @@ face of models/serving.DecodeServer.
               [--prompt-cache=N]   # repeated prompts skip prefill (LRU)
               [--draft-model=tiny_lm --draft-ckpt=... --draft-len=4]
               [--no-adaptive-draft] [--draft-cost-ratio=R]
+              [--fused-rounds=N]  # amortize N decode rounds per device
+                                  # dispatch when no requests are waiting
+                                  # (token-exact; higher throughput,
+                                  # blockier streaming)
               # speculative serving: --draft-len is the depth CAP; the
               # server adapts per-round depth from the measured accept
               # rate (disabling speculation when it cannot pay) unless
@@ -52,7 +56,7 @@ KNOWN_FLAGS = frozenset({
     "top-k", "top-p", "eos", "quant", "kv-cache", "default-max-new",
     "lora-alpha", "draft-lora-alpha", "prompt-cache",
     "draft-model", "draft-ckpt", "draft-seed", "draft-len",
-    "no-adaptive-draft", "draft-cost-ratio",
+    "no-adaptive-draft", "draft-cost-ratio", "fused-rounds",
 })
 
 
@@ -100,6 +104,11 @@ def main(argv: list[str] | None = None) -> int:
     require_flag_value(argv, "--draft-cost-ratio",
                        hint="draft/target per-token cost for the "
                             "adaptive depth controller")
+    # bare --fused-rounds would parse as 1 and silently disable the
+    # feature the user asked for
+    require_flag_value(argv, "--fused-rounds",
+                       hint="decode rounds per device dispatch, e.g. "
+                            "--fused-rounds=8")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
         raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
@@ -182,6 +191,7 @@ def main(argv: list[str] | None = None) -> int:
     threading.Thread(target=_reader, args=(in_q,), daemon=True).start()
 
     pending: list[dict] = []          # parsed, awaiting a free slot
+    fused_rounds = int(flags.get("fused-rounds", "1"))
     live: dict[int, dict] = {}        # request_id -> request (slot-held)
     text_mode: dict[int, bool] = {}
     eof = False
@@ -284,7 +294,10 @@ def main(argv: list[str] | None = None) -> int:
                 else:
                     pending.append(payload)
                 continue
-        emitted = srv.step()
+        # fuse rounds only when nothing is waiting for a slot — a
+        # pending request must get the next admission opportunity
+        emitted = (srv.step_many(fused_rounds)
+                   if fused_rounds > 1 and not pending else srv.step())
         done_now = set(srv.finished())
         # stream every token BEFORE retiring finished requests: a
         # speculative round can emit several tokens for one rid, and the

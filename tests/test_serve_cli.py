@@ -159,3 +159,18 @@ def test_overflow_request_rejected_not_fatal():
     assert any("error" in line and line["id"] == "big" for line in lines)
     done = {line["id"]: line for line in lines if line.get("done")}
     assert len(done["ok"]["tokens"]) == 2
+
+
+def test_serve_cli_fused_rounds_token_exact():
+    """--fused-rounds=N: same token streams as the per-round server
+    (step_many is token-exact), just fewer device dispatches."""
+    reqs = [{"id": i, "tokens": [3 + i, 7, 11], "max_new": 9}
+            for i in range(3)]
+
+    def done_map(lines):
+        return {obj["id"]: obj["tokens"] for obj in lines
+                if obj.get("done")}
+
+    plain, _ = run_serve(reqs)
+    fused, _ = run_serve(reqs, "--fused-rounds=4")
+    assert done_map(fused) == done_map(plain)

@@ -3,17 +3,26 @@ as raw RPCs (Coordinator.ListWorkers — proto/coordinator.proto:8; PS
 CheckSyncStatus — proto/parameter_server.proto:7).
 
     python -m parameter_server_distributed_tpu.cli.status_main \
-        [coordinator_addr] [--iteration=N]
+        [coordinator_addr] [--iteration=N] [--metrics] [--metrics-json]
 
 Prints the worker registry (id/address/hostname) and the PS sync state for
-the given iteration (default: 0).
+the given iteration (default: 0).  ``--metrics`` adds the cluster metric
+rollup the coordinator aggregates from heartbeat-piggybacked worker
+snapshots (obs/export.py): per-worker RPC p50/p95 latency, wire-byte
+totals (with the f32-payload compression ratio), step-phase breakdown,
+and the straggler spread.  ``--metrics-json`` emits the raw rollup JSON
+instead (for dashboards/scripts).  Degrades gracefully against a
+reference coordinator, which does not implement the extension RPC.
 """
 
 from __future__ import annotations
 
 import sys
 
+import grpc
+
 from ..config import parse_argv
+from ..obs.export import render_rollup
 from ..rpc import messages as m
 from ..rpc.service import RpcClient
 
@@ -23,11 +32,26 @@ def main(argv: list[str] | None = None) -> int:
     positional, flags = parse_argv(argv)
     coordinator_addr = positional[0] if positional else "127.0.0.1:50052"
 
+    want_metrics = "metrics" in flags or "metrics-json" in flags
+    metrics_json = None
     with RpcClient(coordinator_addr, m.COORDINATOR_SERVICE,
-                   m.COORDINATOR_METHODS) as coord:
+                   {**m.COORDINATOR_METHODS,
+                    **m.COORDINATOR_EXT_METHODS}) as coord:
         workers = coord.call("ListWorkers", m.ListWorkersRequest(), timeout=5.0)
         ps_addr = coord.call("GetParameterServerAddress",
                              m.GetPSAddressRequest(), timeout=5.0)
+        if want_metrics:
+            try:
+                metrics_json = coord.call(
+                    "GetClusterMetrics", m.ClusterMetricsRequest(),
+                    timeout=5.0).rollup_json
+            except grpc.RpcError as exc:
+                code = getattr(exc, "code", lambda: None)()
+                if code != grpc.StatusCode.UNIMPLEMENTED:
+                    raise
+                # reference coordinator: the metrics extension RPC does
+                # not exist there; report instead of erroring out
+                metrics_json = ""
 
     print(f"coordinator: {coordinator_addr}")
     print(f"parameter server: {ps_addr.address}:{ps_addr.port}")
@@ -56,6 +80,17 @@ def main(argv: list[str] | None = None) -> int:
                   f"{sync.total_workers}")
         except Exception as exc:  # noqa: BLE001
             print(f"{label}parameter server unreachable: {exc}")
+
+    if want_metrics:
+        if not metrics_json:
+            print("cluster metrics unavailable (coordinator does not "
+                  "implement GetClusterMetrics — reference build?)")
+        elif "metrics-json" in flags:
+            print(metrics_json)
+        else:
+            import json
+
+            print(render_rollup(json.loads(metrics_json)))
     return 0
 
 

@@ -1013,15 +1013,88 @@ def _greedy_segment_runner(target: Transformer, cap: int,
     return _cached_runner(key_tuple, build)
 
 
+def _spec_catchup_runner(draft: Transformer, gap: int, cache_dtype: str):
+    """Advance the DRAFT cache over ``gap`` committed tokens the target
+    decoded alone (the greedy calibration probe leaves d_cache/pc/y
+    untouched).  The spec round's own catch-up block only rewrites the
+    last two positions, so without this a k>0 finish segment after the
+    greedy probe would condition the draft on a prefix with a
+    ``gap``-token hole.  Feeds the committed tokens at sequence positions
+    pc-1 .. lt-2 (out columns n_out-gap-2 ..) through one ragged
+    decode_block, then restores the segment invariant: pc = lt, y = the
+    token at position lt-1."""
+    key = (_model_key(draft), "spec_catchup", gap, cache_dtype)
+
+    def build():
+        @jax.jit
+        def run(dparams, carry):
+            (n_out, out, cur, y, lt, pc, t_cache, d_cache, rng_key,
+             stats) = carry
+            batch = out.shape[0]
+            bidx = jnp.arange(batch, dtype=jnp.int32)[:, None]
+            cols = ((n_out - gap - 2)[:, None]
+                    + jnp.arange(gap, dtype=jnp.int32)[None, :])
+            block = out[bidx, jnp.clip(cols, 0, out.shape[1] - 1)]
+            _, d_cache = decode_block(draft, dparams, block, d_cache,
+                                      lengths=pc - 1)
+            y_new = out[jnp.arange(batch, dtype=jnp.int32),
+                        jnp.clip(n_out - 2, 0, out.shape[1] - 1)]
+            return (n_out, out, cur, y_new, lt, lt, t_cache, d_cache,
+                    rng_key, stats)
+
+        return run
+
+    return _cached_runner(key, build)
+
+
 # Calibrated depths memoized per (target, draft, sampling, cache) pair:
 # the first adaptive call pays a segmented calibration run; every later
 # call jumps straight to the winning FUSED program (whole-loop spec at
 # k*, or plain generate when speculation cannot pay) — steady-state
 # adaptive throughput equals the best fixed configuration by
-# construction.  Params are assumed fixed per model object (true for
-# serving and benching; retraining under the same object should clear
-# this).
-_DEPTH_MEMO: dict = {}
+# construction.  Keys use _model_key (the never-reused cache_token, not a
+# recyclable id()).  Params are assumed fixed per model object (true for
+# serving and benching); retraining under the same object must call
+# :func:`clear_depth_memo`, since the calibrated depth is a property of
+# the PARAMS (target/draft agreement), not the architecture.  Bounded
+# LRU + lock, same protocol as _RUNNERS.
+_DEPTH_MEMO: "OrderedDict[tuple, int]" = OrderedDict()
+_DEPTH_MEMO_MAX = 64
+_DEPTH_MEMO_LOCK = threading.Lock()
+
+
+def clear_depth_memo(model=None) -> int:
+    """Invalidate memoized calibrated draft depths — all of them, or only
+    the entries involving ``model`` (as target OR draft).  Returns the
+    number of entries dropped.  Call after swapping params under a model
+    object you keep reusing (e.g. reloading a checkpoint mid-process):
+    the next adaptive call re-calibrates against the new params."""
+    with _DEPTH_MEMO_LOCK:
+        if model is None:
+            n = len(_DEPTH_MEMO)
+            _DEPTH_MEMO.clear()
+            return n
+        mkey = _model_key(model)
+        stale = [k for k in _DEPTH_MEMO if mkey in k[:2]]
+        for k in stale:
+            del _DEPTH_MEMO[k]
+        return len(stale)
+
+
+def _depth_memo_get(key: tuple) -> int | None:
+    with _DEPTH_MEMO_LOCK:
+        k = _DEPTH_MEMO.get(key)
+        if k is not None:
+            _DEPTH_MEMO.move_to_end(key)
+        return k
+
+
+def _depth_memo_put(key: tuple, k: int) -> None:
+    with _DEPTH_MEMO_LOCK:
+        _DEPTH_MEMO[key] = k
+        _DEPTH_MEMO.move_to_end(key)
+        while len(_DEPTH_MEMO) > _DEPTH_MEMO_MAX:
+            _DEPTH_MEMO.popitem(last=False)
 
 
 def _speculative_adaptive(target, tparams, draft, dparams, prompt,
@@ -1049,7 +1122,7 @@ def _speculative_adaptive(target, tparams, draft, dparams, prompt,
                          f"got {calibration!r}")
     memo_key = (_model_key(target), _model_key(draft), k_max,
                 temperature, cache_dtype, cost_ratio, calibration)
-    k_known = _DEPTH_MEMO.get(memo_key)
+    k_known = _depth_memo_get(memo_key)
     if k_known == 0:
         # calibration concluded speculation cannot pay: steady state IS
         # plain fused decoding (token-exact for greedy; for temperature
@@ -1143,7 +1216,7 @@ def _speculative_adaptive(target, tparams, draft, dparams, prompt,
         # where two short probes cannot be timed meaningfully)
         k = optimal_draft_depth(frac, k0, k_max, cost_ratio,
                                 allow_disable=True)
-    _DEPTH_MEMO[memo_key] = k
+    _depth_memo_put(memo_key, k)
 
     # ---- finish the remaining tokens at the decided configuration
     if k == 0:
@@ -1151,6 +1224,12 @@ def _speculative_adaptive(target, tparams, draft, dparams, prompt,
                               jnp.asarray(max_new_tokens, jnp.int32))
         depths.append(0)
     else:
+        gap = int(np.asarray(carry[4])[0] - np.asarray(carry[5])[0])
+        if gap > 0:
+            # measured calibration ran a greedy probe: catch the draft up
+            # over the probe's committed tokens before speculating again
+            carry = _spec_catchup_runner(draft, gap, cache_dtype)(
+                dparams, carry)
         runner = (_spec_segment_runner(target, draft, cap,
                                        max_new_tokens, k,
                                        float(temperature), cache_dtype)

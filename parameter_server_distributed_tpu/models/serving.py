@@ -32,6 +32,7 @@ weight-quantized ``params`` store works unchanged.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import OrderedDict
 from functools import partial
 from typing import Any, Mapping
@@ -40,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import stats as obs_stats
 from .generation import (KVCache, QuantKVCache, _cached_runner,
                          _kv_quantize, _model_key, _spec_round_runner,
                          check_position_budget, decode_block, init_cache,
@@ -350,6 +352,14 @@ class DecodeServer:
         self._n_retired = 0
         self._spec_proposed = 0
         self._spec_accepted = 0
+        self._plain_rounds = 0   # non-speculative rounds since last probe
+        # obs-registry mirrors: serving health in the same process-wide
+        # registry the RPC layer and train loops report to (obs/stats.py)
+        self._obs_round = obs_stats.histogram("serve.round_s")
+        self._obs_tokens = obs_stats.counter("serve.tokens")
+        self._obs_active = obs_stats.gauge("serve.active_slots")
+        self._obs_rate = obs_stats.gauge("serve.tokens_per_s")
+        self._obs_accept = obs_stats.gauge("serve.accept_rate")
         # prompt -> (last_logits, kv_row, draft_row|None), LRU-bounded;
         # entries pin device memory, so the cap is the knob
         self.prompt_cache_size = prompt_cache
@@ -396,9 +406,12 @@ class DecodeServer:
             self.draft_cost_ratio = draft_cost_ratio
             self._accept_ema: float | None = None
             self._rounds_since_adapt = 0
+            self._ema_proposals = 0  # proposals folded into the EMA so far
 
     _ADAPT_EVERY = 4        # rounds between depth decisions
     _ADAPT_DECAY = 0.8      # EMA decay on the per-round accept fraction
+    _MIN_DISABLE_PROPOSALS = 16  # EMA evidence required before k=0 allowed
+    _REPROBE_AFTER_PLAIN = 64    # plain rounds between k=0 re-probes
 
     def _spec_round(self, *args):
         runner = _spec_round_runner(self.model, self.draft, self._k,
@@ -423,15 +436,39 @@ class DecodeServer:
         self._accept_ema = (p_round if self._accept_ema is None else
                             self._ADAPT_DECAY * self._accept_ema
                             + (1.0 - self._ADAPT_DECAY) * p_round)
+        self._ema_proposals += proposed
         self._rounds_since_adapt += 1
         if self._rounds_since_adapt < self._ADAPT_EVERY:
             return
         self._rounds_since_adapt = 0
-        # the EMA is already p, so invert at k=1 (identity)
-        self._k = optimal_draft_depth(self._accept_ema, 1,
-                                      self.draft_len,
-                                      self.draft_cost_ratio,
-                                      allow_disable=True)
+        # the EMA is already p, so invert at k=1 (identity).  Disabling
+        # (k=0) needs _MIN_DISABLE_PROPOSALS of evidence in the EMA: one
+        # unlucky early round must not shut speculation off (ADVICE.md
+        # round 5 — k=0 used to be permanent AND cheap to reach).
+        self._k = optimal_draft_depth(
+            self._accept_ema, 1, self.draft_len, self.draft_cost_ratio,
+            allow_disable=self._ema_proposals >= self._MIN_DISABLE_PROPOSALS)
+        if self._k == 0:
+            self._plain_rounds = 0   # count plain rounds toward a re-probe
+
+    def _maybe_rearm_speculation(self) -> None:
+        """k=0 is no longer forever (ADVICE.md round 5): after
+        _REPROBE_AFTER_PLAIN plain rounds, the next IDLE admission re-arms
+        speculation at a probe depth of 1 with fresh adaptation state (the
+        workload may have shifted toward the draft since the disable).
+        Idle matters for correctness: requests admitted while k=0 skipped
+        their draft prefill, so their draft-cache rows are holes — once
+        idle, every active request after the rearm is admitted with a
+        draft prefill again."""
+        if (self.draft is None or not self.adaptive_draft or self._k > 0
+                or not self.idle
+                or self._plain_rounds < self._REPROBE_AFTER_PLAIN):
+            return
+        self._k = 1
+        self._plain_rounds = 0
+        self._accept_ema = None
+        self._ema_proposals = 0
+        self._rounds_since_adapt = 0
 
     # ------------------------------------------------------------- admin
     @property
@@ -475,6 +512,7 @@ class DecodeServer:
                 "mode (the accept rule is compiled for the server "
                 "temperature); construct the server with the temperature "
                 "you need")
+        self._maybe_rearm_speculation()
         slot = self._free_slot()
         if slot is None:
             raise RuntimeError("no free slot; drain with step() first")
@@ -507,6 +545,19 @@ class DecodeServer:
             self._prompt_cache.move_to_end(pkey)  # LRU touch
             self._prompt_hits += 1
             last, row, d_row = hit
+            if self.draft is not None and self._k > 0 and d_row is None:
+                # entry was cached while the controller had speculation
+                # off (k=0 skips the draft prefill below); replaying it
+                # as-is after a re-probe re-armed k would skip the draft
+                # splice and leave this slot's _d_lengths/_prev stale —
+                # backfill the draft half and repair the cached entry
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :real_len] = prompt
+                _, d_row = _prefill_runner(self.draft, bucket,
+                                           self.cache_dtype)(
+                    self.draft_params, jnp.asarray(padded),
+                    jnp.asarray(real_len, jnp.int32))
+                self._prompt_cache[pkey] = (last, row, d_row)
         else:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :real_len] = prompt
@@ -516,9 +567,10 @@ class DecodeServer:
                 jnp.asarray(real_len, jnp.int32))
             d_row = None
             if self.draft is not None and self._k > 0:
-                # k=0 (controller disabled speculation, permanently):
-                # the draft cache is never read again, so skip its
-                # prefill + splice for newly admitted requests
+                # k=0 (controller disabled speculation): the draft cache
+                # is not read while disabled, so skip its prefill +
+                # splice; a later re-probe backfills via the cache-hit
+                # repair above
                 _, d_row = _prefill_runner(self.draft, bucket,
                                            self.cache_dtype)(
                     self.draft_params, jnp.asarray(padded),
@@ -560,12 +612,18 @@ class DecodeServer:
         decoded token(s) (already appended to its result)."""
         if self.idle:
             return []
+        t0 = time.perf_counter()
         if self.draft is not None and self._k > 0:
             # k can reach 0 when the adaptive controller concludes this
             # draft cannot pay (optimal_draft_depth allow_disable) —
             # the server then serves plain greedy rounds below, which
-            # read the same _tokens/_lengths state the spec rounds kept
-            return self._spec_step()
+            # read the same _tokens/_lengths state the spec rounds kept.
+            # Disable is NOT forever: submit() re-probes at the next idle
+            # admission boundary (see _maybe_rearm_speculation).
+            emitted = self._spec_step()
+            self._obs_record_round(t0, len(emitted))
+            return emitted
+        self._plain_rounds += 1
         nxt, self._cache, self._rng = self._step(
             self.params, jnp.asarray(self._tokens), self._cache,
             jnp.asarray(self._lengths), jnp.asarray(self._temps),
@@ -585,6 +643,7 @@ class DecodeServer:
                 self._retire(i)
         self._n_steps += 1
         self._n_emitted += len(emitted)
+        self._obs_record_round(t0, len(emitted))
         return emitted
 
     def step_many(self, max_rounds: int = 8) -> list[tuple[int, int]]:
@@ -607,8 +666,11 @@ class DecodeServer:
         sequence and math; tested)."""
         if self.idle:
             return []
+        t0 = time.perf_counter()
         if self.draft is not None and self._k > 0:
-            return self._spec_step()
+            emitted = self._spec_step()
+            self._obs_record_round(t0, len(emitted))
+            return emitted
         remaining = [entry.max_new - len(entry.tokens)
                      for entry in self._slot if entry is not None]
         n = max(1, min([max_rounds] + remaining))
@@ -645,6 +707,8 @@ class DecodeServer:
         self._tokens[:] = last
         self._n_steps += n
         self._n_emitted += len(emitted)
+        self._plain_rounds += n
+        self._obs_record_round(t0, len(emitted))
         return emitted
 
     def _spec_step(self) -> list[tuple[int, int]]:
@@ -692,6 +756,20 @@ class DecodeServer:
         self._n_steps += 1
         self._n_emitted += len(emitted)
         return emitted
+
+    def _obs_record_round(self, t0: float, n_tokens: int) -> None:
+        """Mirror one decode round into the process-wide obs registry:
+        round latency, emitted tokens, queue depth (active slots), the
+        instantaneous token rate, and (speculative mode) the lifetime
+        accept rate — what obs/export rolls up for pst-status."""
+        dt = time.perf_counter() - t0
+        self._obs_round.observe(dt)
+        self._obs_tokens.add(n_tokens)
+        self._obs_active.set(self.active)
+        if dt > 0:
+            self._obs_rate.set(n_tokens / dt)
+        if self._spec_proposed:
+            self._obs_accept.set(self._spec_accepted / self._spec_proposed)
 
     def _finishes(self, entry: _Slot, token: int) -> bool:
         return (len(entry.tokens) >= entry.max_new

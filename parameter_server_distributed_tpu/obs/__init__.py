@@ -1,0 +1,28 @@
+"""Cluster-wide observability subsystem.
+
+Three layers, one package (the reference's observability was bare stdout
+prints — SURVEY.md §5):
+
+- :mod:`~parameter_server_distributed_tpu.obs.trace` — trace/span IDs with
+  a thread-local current-span stack, propagated across processes via a
+  high-numbered extension field on the RPC request messages (reference
+  protoc gencode skips unknown fields, so C++ peers are unaffected —
+  tests/test_wire_interop.py), exported as Chrome-trace (catapult) JSON so
+  one distributed training step renders in ``chrome://tracing``/Perfetto;
+- :mod:`~parameter_server_distributed_tpu.obs.stats` — cheap log-bucket
+  histograms, counters, and gauges behind a process-wide registry; every
+  RPC endpoint, step phase, and serving loop reports here;
+- :mod:`~parameter_server_distributed_tpu.obs.export` — workers piggyback
+  registry snapshots on heartbeats, the coordinator aggregates them
+  per-worker, and ``pst-status --metrics`` prints the cluster rollup.
+
+``utils/metrics.py`` (StepTimer, MetricsLogger, profile_trace) folded in
+here; the old module re-exports for backward compatibility.
+"""
+
+from . import export, stats, trace
+from .stats import (MetricsLogger, StepTimer, profile_trace,
+                    samples_per_sec)
+
+__all__ = ["trace", "stats", "export", "StepTimer", "MetricsLogger",
+           "profile_trace", "samples_per_sec"]

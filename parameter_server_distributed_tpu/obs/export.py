@@ -1,0 +1,210 @@
+"""Cluster metric export: heartbeat piggyback + coordinator aggregation.
+
+Workers serialize their registry snapshot (:func:`snapshot_blob`) into the
+``obs_snapshot`` extension field of every heartbeat (rpc/messages.py —
+reference coordinators skip the unknown field).  The coordinator keeps the
+latest snapshot per worker (:class:`ClusterAggregator`) and serves the
+rollup over the ``GetClusterMetrics`` extension RPC, which
+``pst-status --metrics`` renders: per-worker RPC p50/p95 latency, wire-byte
+totals, step-phase breakdown, and the cluster straggler spread — the
+telemetry elastic-membership and quantized-transport tuning need
+(PAPERS.md: arXiv:2204.03211, arXiv:2506.17615).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any
+
+from .stats import REGISTRY, percentile_from
+
+# step-phase histograms recorded by worker/worker.py, in display order
+_PHASES = ("data", "pull", "compute", "push", "barrier_wait")
+
+
+def snapshot_blob(**extra: Any) -> bytes:
+    """The process registry as JSON bytes, ready for the heartbeat
+    extension field.  ``extra`` rides alongside (worker_id etc.)."""
+    snap = REGISTRY.snapshot()
+    snap["t"] = time.time()
+    snap.update(extra)
+    return json.dumps(snap, default=float).encode("utf-8")
+
+
+def _hist_stats(snap: dict, name: str) -> dict | None:
+    h = snap.get("histograms", {}).get(name)
+    if not h or not h.get("count"):
+        return None
+    return {"count": h["count"],
+            "mean": h["sum"] / h["count"],
+            "p50": percentile_from(h, 50),
+            "p95": percentile_from(h, 95)}
+
+
+def _sum_counters(snap: dict, suffix: str, prefix: str = "") -> int:
+    return sum(v for k, v in snap.get("counters", {}).items()
+               if k.endswith(suffix) and k.startswith(prefix))
+
+
+def worker_rollup(snap: dict) -> dict:
+    """Derived per-worker view of one snapshot: per-method RPC latency
+    percentiles, wire-byte totals, and the step-phase breakdown."""
+    rpc: dict[str, dict] = {}
+    for name in snap.get("histograms", {}):
+        if name.startswith("rpc.client.") and name.endswith(".latency_s"):
+            method = name[len("rpc.client."):-len(".latency_s")]
+            stats = _hist_stats(snap, name)
+            if stats:
+                rpc[method] = stats
+    phases = {}
+    for phase in _PHASES:
+        stats = _hist_stats(snap, f"worker.{phase}_s")
+        if stats:
+            phases[phase] = stats
+    out = {
+        "rpc": rpc,
+        "phases": phases,
+        "step": _hist_stats(snap, "worker.step_s"),
+        "bytes_sent": _sum_counters(snap, ".request_bytes", "rpc.client."),
+        "bytes_received": _sum_counters(snap, ".response_bytes",
+                                        "rpc.client."),
+        "retries": snap.get("counters", {}).get("rpc.client.retries", 0),
+        "t": snap.get("t"),
+    }
+    payload = _sum_counters(snap, ".payload_bytes", "rpc.client.")
+    if payload:
+        # uncompressed (f32) size of the tensors that rode those wire
+        # bytes — the with/without-compression comparison in one view
+        out["payload_bytes_f32"] = payload
+        # the matching denominator: wire bytes of the PUSH methods only
+        # (bytes_sent also counts heartbeat snapshots, sync polls, and
+        # registration, which would understate the ratio)
+        push = (_sum_counters(snap, ".request_bytes",
+                              "rpc.client.ReceiveGradients")
+                + _sum_counters(snap, ".request_bytes",
+                                "rpc.client.PushGradientsStream"))
+        if push:
+            out["push_bytes"] = push
+    return out
+
+
+class ClusterAggregator:
+    """Latest snapshot per worker + the cluster rollup.
+
+    Entries expire after ``ttl_s`` without a heartbeat so an evicted
+    worker's stale numbers do not skew the straggler spread forever."""
+
+    def __init__(self, ttl_s: float = 120.0):
+        self._lock = threading.Lock()
+        self._snaps: dict[int, dict] = {}
+        self._ttl_s = ttl_s
+
+    def ingest(self, worker_id: int, blob: bytes | str) -> bool:
+        if not blob:
+            return False
+        try:
+            snap = json.loads(bytes(blob).decode("utf-8")
+                              if not isinstance(blob, str) else blob)
+        except (ValueError, UnicodeDecodeError):
+            return False
+        snap["received_t"] = time.time()
+        with self._lock:
+            self._snaps[int(worker_id)] = snap
+        return True
+
+    def snapshots(self) -> dict[int, dict]:
+        now = time.time()
+        with self._lock:
+            for wid in [w for w, s in self._snaps.items()
+                        if now - s.get("received_t", now) > self._ttl_s]:
+                del self._snaps[wid]
+            return {wid: dict(snap) for wid, snap in self._snaps.items()}
+
+    def rollup(self) -> dict:
+        """Cluster view: per-worker derived metrics plus cross-worker
+        aggregates (straggler spread, slowest RPC p95, byte totals)."""
+        per_worker = {wid: worker_rollup(snap)
+                      for wid, snap in self.snapshots().items()}
+        step_p50s = {wid: w["step"]["p50"] for wid, w in per_worker.items()
+                     if w.get("step")}
+        rpc_worst: dict[str, dict] = {}
+        for wid, w in per_worker.items():
+            for method, stats in w["rpc"].items():
+                worst = rpc_worst.get(method)
+                if worst is None or stats["p95"] > worst["p95"]:
+                    rpc_worst[method] = {**stats, "worker": wid}
+        cluster = {
+            "workers": len(per_worker),
+            "bytes_sent": sum(w["bytes_sent"]
+                              for w in per_worker.values()),
+            "bytes_received": sum(w["bytes_received"]
+                                  for w in per_worker.values()),
+            "slowest_rpc": rpc_worst,
+        }
+        if step_p50s:
+            fastest, slowest = min(step_p50s.values()), max(step_p50s.values())
+            cluster["straggler"] = {
+                "fastest_p50_s": fastest, "slowest_p50_s": slowest,
+                "spread": slowest / fastest if fastest > 0 else float("inf"),
+                "slowest_worker": max(step_p50s, key=step_p50s.get),
+            }
+        return {"per_worker": per_worker, "cluster": cluster}
+
+
+def _fmt_s(v: float | None) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 1e3:.2f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def render_rollup(rollup: dict) -> str:
+    """Human view of :meth:`ClusterAggregator.rollup` for pst-status."""
+    lines: list[str] = []
+    cluster = rollup.get("cluster", {})
+    lines.append(f"cluster metrics ({cluster.get('workers', 0)} workers "
+                 f"reporting)")
+    straggler = cluster.get("straggler")
+    if straggler:
+        lines.append(
+            f"  step p50 spread: {_fmt_s(straggler['fastest_p50_s'])} .. "
+            f"{_fmt_s(straggler['slowest_p50_s'])} "
+            f"({straggler['spread']:.2f}x, slowest worker "
+            f"{straggler['slowest_worker']})")
+    lines.append(f"  wire bytes: {_fmt_bytes(cluster.get('bytes_sent', 0))} "
+                 f"sent / {_fmt_bytes(cluster.get('bytes_received', 0))} "
+                 f"received (client-side totals)")
+    for method, stats in sorted(cluster.get("slowest_rpc", {}).items()):
+        lines.append(f"  slowest {method}: p95 {_fmt_s(stats['p95'])} "
+                     f"(worker {stats['worker']})")
+    for wid, w in sorted(rollup.get("per_worker", {}).items()):
+        lines.append(f"  worker {wid}:")
+        for method, stats in sorted(w["rpc"].items()):
+            lines.append(
+                f"    rpc {method}: n={stats['count']} "
+                f"p50={_fmt_s(stats['p50'])} p95={_fmt_s(stats['p95'])}")
+        if w.get("phases"):
+            parts = " ".join(
+                f"{phase}={_fmt_s(stats['p50'])}"
+                for phase, stats in w["phases"].items())
+            lines.append(f"    step phases (p50): {parts}")
+        extra = (f"    bytes: {_fmt_bytes(w['bytes_sent'])} sent / "
+                 f"{_fmt_bytes(w['bytes_received'])} received")
+        if w.get("payload_bytes_f32"):
+            ratio = (w["payload_bytes_f32"]
+                     / max(1, w.get("push_bytes") or w["bytes_sent"]))
+            extra += (f" (f32 payload {_fmt_bytes(w['payload_bytes_f32'])}"
+                      f", {ratio:.1f}x compression)")
+        if w.get("retries"):
+            extra += f", {w['retries']} retries"
+        lines.append(extra)
+    return "\n".join(lines)

@@ -1,0 +1,295 @@
+"""Distributed trace spans with wire-level propagation.
+
+A *trace* is one logical operation across the cluster (e.g. one training
+step: worker pull -> compute -> push -> PS apply -> barrier); a *span* is
+one timed piece of it in one thread of one process.  Spans carry
+(trace_id, span_id, parent_id); the current span rides a thread-local
+stack, and crosses process boundaries as a ``b"trace_id/span_id"`` blob in
+a high-numbered extension field of the RPC request messages
+(rpc/messages.py — reference protoc gencode skips unknown fields, so
+reference C++ peers are unaffected; proven by tests/test_wire_interop.py).
+
+Recording is OFF by default: ``span()`` costs one truthiness check when
+disabled, so instrumentation can stay unconditionally in hot paths.
+Enable with :func:`enable`, ``PSDT_TRACE=1``, or ``PSDT_TRACE_FILE=path``
+(the latter also registers an atexit Chrome-trace dump, ``%d`` in the path
+expands to the pid — how multi-process cluster runs each drop their slice;
+:func:`merge_chrome_traces` stitches the slices into one file that renders
+in ``chrome://tracing`` / Perfetto with a shared trace id per step).
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import signal
+import threading
+import time
+from collections import deque
+from typing import Any, Iterator
+
+_BUFFER_MAX = 200_000  # spans kept per process (oldest dropped)
+
+_enabled = False
+_buffer: deque = deque(maxlen=_BUFFER_MAX)
+_lock = threading.Lock()
+_tls = threading.local()
+
+
+def enable(on: bool = True) -> None:
+    """Turn span recording on/off process-wide."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def current() -> tuple[str, str] | None:
+    """(trace_id, span_id) of the innermost open span on this thread."""
+    stack = _stack()
+    return (stack[-1][0], stack[-1][1]) if stack else None
+
+
+def wire_context() -> bytes:
+    """Current span serialized for the RPC extension field (empty bytes
+    when tracing is off or no span is open — proto3 elides the field, so
+    the wire bytes are identical to an uninstrumented build)."""
+    if not _enabled:
+        return b""
+    ctx = current()
+    return f"{ctx[0]}/{ctx[1]}".encode("ascii") if ctx else b""
+
+
+def parse_context(raw: bytes | str) -> tuple[str, str] | None:
+    """Inverse of :func:`wire_context`; None on empty/garbage (a peer that
+    does not trace simply leaves the field at its default)."""
+    if not raw:
+        return None
+    try:
+        text = raw.decode("ascii") if isinstance(raw, (bytes, bytearray,
+                                                       memoryview)) else raw
+        trace_id, _, span_id = text.partition("/")
+        if len(trace_id) == 16 and len(span_id) == 16:
+            return trace_id, span_id
+    except (UnicodeDecodeError, ValueError):
+        pass
+    return None
+
+
+def _record(name: str, trace_id: str, span_id: str, parent_id: str,
+            t0: float, dur: float, args: dict | None) -> None:
+    span = {"name": name, "trace_id": trace_id, "span_id": span_id,
+            "parent_id": parent_id, "pid": os.getpid(),
+            "tid": threading.get_ident(), "ts": t0, "dur": dur}
+    if args:
+        span["args"] = args
+    with _lock:
+        _buffer.append(span)
+
+
+@contextlib.contextmanager
+def span(name: str, **args: Any) -> Iterator[None]:
+    """Record one span; nests under the thread's current span (same trace)
+    or roots a fresh trace.  No-op when tracing is disabled."""
+    if not _enabled:
+        yield
+        return
+    stack = _stack()
+    trace_id = stack[-1][0] if stack else _new_id()
+    parent_id = stack[-1][1] if stack else ""
+    span_id = _new_id()
+    stack.append((trace_id, span_id))
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        dur = time.time() - t0
+        stack.pop()
+        _record(name, trace_id, span_id, parent_id, t0, dur, args)
+
+
+@contextlib.contextmanager
+def attach(ctx: tuple[str, str] | None) -> Iterator[None]:
+    """Make ``ctx`` (a :func:`current` result captured on ANOTHER thread)
+    this thread's innermost span, without recording a span of its own.
+    The span stack is thread-local, so work handed to a pool (e.g. the
+    sharded-PS fan-out) would otherwise root fresh traces instead of
+    nesting under the caller's push/pull span.  No-op for None/disabled."""
+    if not _enabled or ctx is None:
+        yield
+        return
+    stack = _stack()
+    stack.append((ctx[0], ctx[1]))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+@contextlib.contextmanager
+def server_span(name: str, ctx: bytes | str, **args: Any) -> Iterator[None]:
+    """Server-side span adopting a REMOTE parent from the request's wire
+    context: the handler's work joins the caller's trace.  Falls back to
+    :func:`span` semantics when the context is absent/unparseable."""
+    if not _enabled:
+        yield
+        return
+    parsed = parse_context(ctx)
+    if parsed is None:
+        with span(name, **args):
+            yield
+        return
+    trace_id, parent_id = parsed
+    span_id = _new_id()
+    stack = _stack()
+    stack.append((trace_id, span_id))
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        dur = time.time() - t0
+        stack.pop()
+        _record(name, trace_id, span_id, parent_id, t0, dur, args)
+
+
+class SpanHolder:
+    """Deferred-context server span for CLIENT-STREAMING handlers: the
+    remote parent arrives on the first request chunk, after the handler
+    already started.  Construct at handler entry (stamps t0), call
+    :meth:`adopt` as chunks arrive (first parseable context wins — it is
+    pushed onto the thread's span stack so spans the handler opens later,
+    e.g. ``ps/apply`` after draining a streamed push, join the caller's
+    trace), and :meth:`finish` on the way out.  adopt/finish must run on
+    the handler's thread (they do: gRPC drains the request iterator inside
+    the handler call)."""
+
+    __slots__ = ("name", "args", "_t0", "_span_id", "_trace_id",
+                 "_parent_id", "_pushed")
+
+    def __init__(self, name: str, **args: Any):
+        self.name = name
+        self.args = args
+        self._t0 = time.time() if _enabled else 0.0
+        self._span_id = _new_id() if _enabled else ""
+        self._trace_id: str | None = None
+        self._parent_id = ""
+        self._pushed = False
+
+    def adopt(self, ctx: bytes | str) -> None:
+        if not _enabled or self._pushed:
+            return
+        parsed = parse_context(ctx)
+        if parsed is None:
+            return
+        self._trace_id, self._parent_id = parsed
+        _stack().append((self._trace_id, self._span_id))
+        self._pushed = True
+
+    def finish(self) -> None:
+        if not _enabled:
+            return
+        if self._pushed:
+            stack = _stack()
+            if stack and stack[-1][1] == self._span_id:
+                stack.pop()
+            self._pushed = False
+        _record(self.name, self._trace_id or _new_id(), self._span_id,
+                self._parent_id, self._t0, time.time() - self._t0,
+                self.args)
+
+
+# ----------------------------------------------------------------- export
+def spans() -> list[dict]:
+    """Snapshot of the recorded spans (oldest first)."""
+    with _lock:
+        return list(_buffer)
+
+
+def clear() -> None:
+    with _lock:
+        _buffer.clear()
+
+
+def chrome_trace_events(recorded: list[dict] | None = None) -> list[dict]:
+    """Spans -> Chrome-trace (catapult) complete events: ``ph="X"``,
+    microsecond ``ts``/``dur``, pid/tid lanes.  The trace/span ids ride in
+    ``args`` so Perfetto's query/filter view can group one distributed
+    step across processes by ``trace_id``."""
+    events = []
+    for s in (spans() if recorded is None else recorded):
+        events.append({
+            "name": s["name"], "ph": "X", "cat": "psdt",
+            "ts": s["ts"] * 1e6, "dur": max(s["dur"], 1e-7) * 1e6,
+            "pid": s["pid"], "tid": s["tid"],
+            "args": {"trace_id": s["trace_id"], "span_id": s["span_id"],
+                     "parent_id": s["parent_id"], **s.get("args", {})},
+        })
+    return events
+
+
+def export_chrome_trace(path: str,
+                        recorded: list[dict] | None = None) -> str:
+    """Write this process's spans as a Chrome-trace JSON file; returns the
+    path.  Open in chrome://tracing or https://ui.perfetto.dev."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": chrome_trace_events(recorded),
+                   "displayTimeUnit": "ms"}, fh)
+    return path
+
+
+def merge_chrome_traces(paths: list[str], out_path: str) -> str:
+    """Concatenate several per-process Chrome-trace files (written by
+    :func:`export_chrome_trace` / PSDT_TRACE_FILE) into one.  Events keep
+    their pid lanes; spans of one step stay correlated by args.trace_id."""
+    events: list[dict] = []
+    for path in paths:
+        with open(path) as fh:
+            doc = json.load(fh)
+        events.extend(doc["traceEvents"] if isinstance(doc, dict) else doc)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return out_path
+
+
+# Env wiring: PSDT_TRACE=1 records; PSDT_TRACE_FILE=path also dumps at
+# process exit (the zero-code path for real multi-process cluster runs).
+if os.environ.get("PSDT_TRACE", "").lower() in ("1", "true", "yes"):
+    enable()
+_TRACE_FILE = os.environ.get("PSDT_TRACE_FILE", "")
+if _TRACE_FILE:
+    enable()
+    atexit.register(
+        lambda: export_chrome_trace(
+            _TRACE_FILE.replace("%d", str(os.getpid()))))
+
+    def _dump_on_sigterm(signum, frame):
+        # servers (PS/coordinator) normally die by SIGTERM, which skips
+        # atexit — without this their halves of every cross-process trace
+        # vanish.  Only claims the signal when nobody else has a handler.
+        export_chrome_trace(_TRACE_FILE.replace("%d", str(os.getpid())))
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    try:
+        if signal.getsignal(signal.SIGTERM) is signal.SIG_DFL:
+            signal.signal(signal.SIGTERM, _dump_on_sigterm)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass

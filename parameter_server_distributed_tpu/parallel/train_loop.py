@@ -24,6 +24,7 @@ import numpy as np
 from ..config import MeshConfig
 from ..checkpoint import sharded as sharded_ckpt
 from ..models.registry import get_model_and_batches
+from ..obs import stats as obs_stats
 from ..utils.metrics import (MetricsLogger, StepTimer, profile_trace,
                              samples_per_sec)
 from .mesh import build_mesh, data_parallel_size
@@ -353,6 +354,14 @@ def run_training(config: TrainLoopConfig) -> dict:
     timer = StepTimer()
     n_chips = mesh.devices.size
     last_loss = float("nan")
+    # obs registry mirrors of the JSONL stream: data-wait vs dispatch
+    # split per step (cheap: two perf_counter reads), synced step time per
+    # window — what `pst-status --metrics` style rollups and the bench
+    # harness read without parsing logs
+    obs_data = obs_stats.histogram("train.data_s")
+    obs_dispatch = obs_stats.histogram("train.dispatch_s")
+    obs_step = obs_stats.histogram("train.step_s")
+    obs_rate = obs_stats.gauge("train.samples_per_sec_chip")
 
     last_saved_step = -1
     last_eval = (-1, float("nan"))
@@ -361,7 +370,12 @@ def run_training(config: TrainLoopConfig) -> dict:
     try:
         with profile_trace("train_loop"):
             for step_idx in range(start_step, config.steps):
-                state, metrics = step_fn(state, next(placed_batches))
+                t0 = time.perf_counter()
+                batch = next(placed_batches)
+                t1 = time.perf_counter()
+                obs_data.observe(t1 - t0)
+                state, metrics = step_fn(state, batch)
+                obs_dispatch.observe(time.perf_counter() - t1)
                 window_steps += 1
                 if ((step_idx + 1) % config.log_every == 0
                         or step_idx == config.steps - 1):
@@ -371,6 +385,9 @@ def run_training(config: TrainLoopConfig) -> dict:
                     # time / steps.
                     dt = (time.perf_counter() - window_t0) / window_steps
                     timer.record(dt)
+                    obs_step.observe(dt)
+                    obs_rate.set(samples_per_sec(config.batch_size, dt,
+                                                 n_chips))
                     metrics_log.log(step=step_idx + 1, loss=last_loss,
                                     step_time_s=dt,
                                     samples_per_sec_chip=samples_per_sec(

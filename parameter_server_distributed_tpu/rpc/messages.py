@@ -174,11 +174,20 @@ class Tensor(Message):
         return arr
 
 
+# Observability extension (obs/trace.py): request messages of the traced
+# data/control path carry the caller's span context in high-numbered field
+# 999 — b"trace_id/span_id".  Reference peers skip the unknown field per
+# proto3 rules (tests/test_wire_interop.py), and the field elides entirely
+# when tracing is off, keeping the bytes reference-identical.
+TRACE_FIELD_NUMBER = 999
+
+
 class GradientUpdate(Message):
     FIELDS = (
         Field(1, "worker_id", "int32"),
         Field(2, "iteration", "int32"),
         Field(3, "gradients", "message", message_type=Tensor, repeated=True),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
     )
 
 
@@ -201,6 +210,7 @@ class PullRequest(Message):
         Field(1, "worker_id", "int32"),
         Field(2, "iteration", "int32"),
         Field(3, "wire_dtype", "int32"),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
     )
 
 
@@ -213,7 +223,10 @@ class ParameterUpdate(Message):
 
 
 class SyncStatusRequest(Message):
-    FIELDS = (Field(1, "iteration", "int32"),)
+    FIELDS = (
+        Field(1, "iteration", "int32"),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
+    )
 
 
 class SyncStatusResponse(Message):
@@ -290,9 +303,15 @@ class RegisterResponse(Message):
 
 
 class HeartbeatRequest(Message):
+    """Field 999 is a framework extension: a JSON metric snapshot of the
+    worker's obs registry (obs/export.snapshot_blob), piggybacked on the
+    existing heartbeat cadence so cluster metrics need no extra RPC from
+    the workers.  Reference coordinators skip it per proto3 unknown-field
+    rules."""
     FIELDS = (
         Field(1, "worker_id", "int32"),
         Field(2, "status", "enum"),
+        Field(999, "obs_snapshot", "bytes"),
     )
 
 
@@ -364,4 +383,25 @@ COORDINATOR_METHODS = {
     "Heartbeat": (HeartbeatRequest, HeartbeatResponse),
     "ListWorkers": (ListWorkersRequest, ListWorkersResponse),
     "GetParameterServerAddress": (GetPSAddressRequest, GetPSAddressResponse),
+}
+
+
+class ClusterMetricsRequest(Message):
+    FIELDS = ()
+
+
+class ClusterMetricsResponse(Message):
+    """JSON rollup of the coordinator's per-worker metric snapshots
+    (obs/export.ClusterAggregator.rollup)."""
+    FIELDS = (Field(1, "rollup_json", "string"),)
+
+
+# Observability extension (obs/export.py): the cluster metrics rollup as
+# an extra method name on the coordinator service.  Kept OUT of
+# COORDINATOR_METHODS (the reference IDL's method set, which interop tests
+# pin); a reference client simply never calls it, and `pst-status
+# --metrics` degrades gracefully against a reference coordinator
+# (UNIMPLEMENTED).
+COORDINATOR_EXT_METHODS = {
+    "GetClusterMetrics": (ClusterMetricsRequest, ClusterMetricsResponse),
 }

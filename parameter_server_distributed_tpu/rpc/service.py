@@ -10,15 +10,28 @@ and `/coordinator.Coordinator/<M>`).
 One deliberate departure: the reference opens a **fresh channel per call**
 on the worker hot path (reference: src/worker.cpp:241, 255, 275, 219) —
 connection setup per RPC.  Clients here hold one persistent channel.
+
+Both ends of every RPC are instrumented through the observability
+subsystem (obs/): per-method call counts, latency histograms, and
+request/response byte counters are always on (a few dict ops per call —
+bounded overhead), and when tracing is enabled the client opens a span
+whose context rides the request's extension field so the server handler's
+span joins the caller's trace (obs/trace.py).  Latency for a
+``unary_stream`` client call covers dispatch only (the response iterator
+outlives the call); byte counters still see every chunk because they live
+in the (de)serializers.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
-from typing import Any, Callable, Mapping
+import time
+from typing import Any, Callable, Iterator, Mapping
 
 import grpc
 
+from ..obs import stats as obs_stats
+from ..obs import trace as obs_trace
 from .wire import Message
 
 
@@ -30,6 +43,76 @@ def _spec(entry) -> tuple[type[Message], type[Message], str]:
         return req_cls, resp_cls, "unary"
     req_cls, resp_cls, style = entry
     return req_cls, resp_cls, style
+
+
+def _counting_deserializer(decode: Callable, counter) -> Callable:
+    def deserialize(buf):
+        counter.add(len(buf))
+        return decode(buf)
+    return deserialize
+
+
+def _counting_serializer(counter) -> Callable:
+    def serialize(msg: Message) -> bytes:
+        data = msg.encode()
+        counter.add(len(data))
+        return data
+    return serialize
+
+
+def _instrument_handler(behavior: Callable, method: str, style: str):
+    """Wrap a service method with call/latency accounting and a server
+    span that adopts the caller's trace context (when the request message
+    carries the extension field and tracing is on)."""
+    calls = obs_stats.counter(f"rpc.server.{method}.calls")
+    latency = obs_stats.histogram(f"rpc.server.{method}.latency_s")
+    span_name = f"rpc/server/{method}"
+
+    if style == "stream_unary":
+        def stream_unary(request_iterator, context):
+            calls.add()
+            t0 = time.perf_counter()
+            # the remote context arrives on the FIRST chunk, after the
+            # handler has started — SpanHolder defers adoption
+            holder = obs_trace.SpanHolder(span_name)
+
+            def chunks():
+                for req in request_iterator:
+                    holder.adopt(getattr(req, "trace_context", b""))
+                    yield req
+
+            try:
+                return behavior(chunks(), context)
+            finally:
+                holder.finish()
+                latency.observe(time.perf_counter() - t0)
+        return stream_unary
+
+    if style == "unary_stream":
+        def unary_stream(request, context):
+            calls.add()
+            t0 = time.perf_counter()
+            ctx = getattr(request, "trace_context", b"")
+
+            def stream():
+                try:
+                    with obs_trace.server_span(span_name, ctx):
+                        yield from behavior(request, context)
+                finally:
+                    latency.observe(time.perf_counter() - t0)
+            return stream()
+        return unary_stream
+
+    def unary(request, context):
+        calls.add()
+        t0 = time.perf_counter()
+        try:
+            with obs_trace.server_span(
+                    span_name, getattr(request, "trace_context", b"")):
+                return behavior(request, context)
+        finally:
+            latency.observe(time.perf_counter() - t0)
+    return unary
 
 
 def bind_service(server: grpc.Server, service_name: str,
@@ -48,9 +131,12 @@ def bind_service(server: grpc.Server, service_name: str,
             "unary_stream": grpc.unary_stream_rpc_method_handler,
         }[style]
         handlers[method] = make_handler(
-            getattr(impl, method),
-            request_deserializer=req_cls.decode,
-            response_serializer=lambda msg: msg.encode(),
+            _instrument_handler(getattr(impl, method), method, style),
+            request_deserializer=_counting_deserializer(
+                req_cls.decode,
+                obs_stats.counter(f"rpc.server.{method}.request_bytes")),
+            response_serializer=_counting_serializer(
+                obs_stats.counter(f"rpc.server.{method}.response_bytes")),
         )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(service_name, handlers),))
@@ -75,6 +161,16 @@ def make_server(max_workers: int = 8) -> grpc.Server:
         options=CHANNEL_OPTIONS)
 
 
+def _inject_stream(request_iterator, ctx: bytes) -> Iterator[Message]:
+    """Stamp the trace context on every chunk of a client-streamed request
+    (gRPC pulls the iterator from its own sender thread, so the context is
+    captured eagerly on the calling thread)."""
+    for req in request_iterator:
+        if hasattr(req, "trace_context"):
+            req.trace_context = ctx
+        yield req
+
+
 class RpcClient:
     """Typed unary-unary client over one persistent insecure channel
     (the reference uses insecure channels throughout —
@@ -82,9 +178,13 @@ class RpcClient:
 
     def __init__(self, target: str, service_name: str,
                  methods: Mapping[str, tuple]):
+        self._target = target
         self._channel = grpc.insecure_channel(target,
                                               options=CHANNEL_OPTIONS)
         self._calls: dict[str, Callable] = {}
+        # per-method instruments, resolved once (registry lookups are
+        # locked dict ops; the hot path should only touch the instruments)
+        self._instruments: dict[str, tuple] = {}
         for method, entry in methods.items():
             req_cls, resp_cls, style = _spec(entry)
             make_call = {
@@ -94,16 +194,38 @@ class RpcClient:
             }[style]
             self._calls[method] = make_call(
                 f"/{service_name}/{method}",
-                request_serializer=lambda msg: msg.encode(),
-                response_deserializer=resp_cls.decode,
+                request_serializer=_counting_serializer(
+                    obs_stats.counter(f"rpc.client.{method}.request_bytes")),
+                response_deserializer=_counting_deserializer(
+                    resp_cls.decode,
+                    obs_stats.counter(
+                        f"rpc.client.{method}.response_bytes")),
             )
+            self._instruments[method] = (
+                obs_stats.counter(f"rpc.client.{method}.calls"),
+                obs_stats.histogram(f"rpc.client.{method}.latency_s"),
+                style)
 
     def call(self, method: str, request: Message, timeout: float | None = None):
         """Unary call.  For a ``stream_unary`` method pass an ITERATOR of
         request messages (gRPC pulls it from a sender thread, so per-chunk
         encode overlaps transport); a ``unary_stream`` method returns an
         iterator of response messages that decode as chunks arrive."""
-        return self._calls[method](request, timeout=timeout)
+        calls, latency, style = self._instruments[method]
+        calls.add()
+        t0 = time.perf_counter()
+        try:
+            if not obs_trace.enabled():
+                return self._calls[method](request, timeout=timeout)
+            with obs_trace.span(f"rpc/client/{method}", target=self._target):
+                ctx = obs_trace.wire_context()
+                if style == "stream_unary":
+                    request = _inject_stream(request, ctx)
+                elif ctx and hasattr(request, "trace_context"):
+                    request.trace_context = ctx
+                return self._calls[method](request, timeout=timeout)
+        finally:
+            latency.observe(time.perf_counter() - t0)
 
     def close(self) -> None:
         self._channel.close()

@@ -14,8 +14,11 @@ import time
 
 import grpc
 
+import json
+
 from ..config import CoordinatorConfig
 from ..core.coordinator_core import CoordinatorCore
+from ..obs.export import ClusterAggregator
 from ..rpc import messages as m
 from ..rpc.service import bind_service, make_server
 
@@ -23,8 +26,12 @@ log = logging.getLogger("pst.coordinator")
 
 
 class CoordinatorService:
-    def __init__(self, core: CoordinatorCore):
+    def __init__(self, core: CoordinatorCore,
+                 aggregator: ClusterAggregator | None = None):
         self.core = core
+        # per-worker metric snapshots, fed by the heartbeat piggyback
+        # (obs/export.py); served back by the GetClusterMetrics extension
+        self.aggregator = aggregator or ClusterAggregator()
 
     # reference: src/coordinator_service.cpp:39-61
     def RegisterWorker(self, request: m.WorkerInfo, context) -> m.RegisterResponse:
@@ -40,6 +47,10 @@ class CoordinatorService:
     # reference: src/coordinator_service.cpp:63-72
     def Heartbeat(self, request: m.HeartbeatRequest, context) -> m.HeartbeatResponse:
         ok = self.core.update_heartbeat(request.worker_id, request.status)
+        if request.obs_snapshot:
+            # extension-field piggyback: framework workers attach their
+            # metric registry; reference workers leave the field empty
+            self.aggregator.ingest(request.worker_id, request.obs_snapshot)
         return m.HeartbeatResponse(success=ok, timestamp=int(time.time() * 1000))
 
     # reference: src/coordinator_service.cpp:74-88
@@ -61,6 +72,14 @@ class CoordinatorService:
         return m.GetPSAddressResponse(address=addr, port=port,
                                       shards=shards if len(shards) > 1 else [])
 
+    # RPC (framework extension, obs/export.py): the aggregated cluster
+    # metric rollup for `pst-status --metrics`.  Reference clients never
+    # call it (extra method name on the same service).
+    def GetClusterMetrics(self, request: m.ClusterMetricsRequest,
+                          context) -> m.ClusterMetricsResponse:
+        return m.ClusterMetricsResponse(
+            rollup_json=json.dumps(self.aggregator.rollup(), default=float))
+
 
 class Coordinator:
     """Process-level assembly (reference: run_coordinator_server at
@@ -78,7 +97,8 @@ class Coordinator:
     def start(self) -> int:
         self._server = make_server()
         bind_service(self._server, m.COORDINATOR_SERVICE,
-                     m.COORDINATOR_METHODS, self.service)
+                     {**m.COORDINATOR_METHODS, **m.COORDINATOR_EXT_METHODS},
+                     self.service)
         addr = f"{self.config.bind_address}:{self.config.port}"
         self._port = self._server.add_insecure_port(addr)
         if self._port == 0:

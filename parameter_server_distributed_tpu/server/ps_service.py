@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Callable
 
 import grpc
@@ -19,6 +20,8 @@ from ..config import ParameterServerConfig
 from ..core.optimizer import make_optimizer
 from ..core.ps_core import ParameterServerCore
 from ..core.tensor import from_wire, to_wire
+from ..obs import stats as obs_stats
+from ..obs import trace as obs_trace
 from ..rpc import messages as m
 from ..rpc.data_plane import split_tensors, stream_chunk_bytes
 from ..rpc.service import bind_service, make_server
@@ -33,12 +36,26 @@ class ParameterServerService:
     def __init__(self, core: ParameterServerCore, ckpt: CheckpointManager):
         self.core = core
         self.ckpt = ckpt
+        # aggregation/serve timing net of RPC plumbing (the handler-level
+        # latency histograms live in rpc/service.bind_service)
+        self._obs_apply = obs_stats.histogram("ps.apply_s")
+        self._obs_serve = obs_stats.histogram("ps.serve_s")
+
+    def _apply(self, worker_id: int, iteration: int, grads):
+        """Decoded-gradients -> core aggregation, timed and traced (the
+        "PS apply" leg of the distributed step trace — the enclosing
+        handler span carries the worker's trace id)."""
+        t0 = time.perf_counter()
+        with obs_trace.span("ps/apply", worker=worker_id,
+                            iteration=iteration):
+            result = self.core.receive_gradients(worker_id, iteration, grads)
+        self._obs_apply.observe(time.perf_counter() - t0)
+        return result
 
     # RPC: push gradients (reference: src/parameter_server_service.cpp:32-59)
     def ReceiveGradients(self, request: m.GradientUpdate, context) -> m.PushResponse:
         grads = from_wire(request.gradients)
-        result = self.core.receive_gradients(request.worker_id,
-                                             request.iteration, grads)
+        result = self._apply(request.worker_id, request.iteration, grads)
         return m.PushResponse(
             success=result.success,
             message=result.message,
@@ -65,12 +82,19 @@ class ParameterServerService:
         return requested
 
     def ServeParameters(self, request: m.PullRequest, context) -> m.ParameterUpdate:
-        iteration, params, ready = self.core.serve_parameters(request.iteration)
-        return m.ParameterUpdate(
-            iteration=iteration,
-            parameters=to_wire(
-                params, wire_dtype=self._serve_wire_dtype(request.wire_dtype)),
-            ready=ready)
+        t0 = time.perf_counter()
+        with obs_trace.span("ps/serve", worker=request.worker_id,
+                            iteration=request.iteration):
+            iteration, params, ready = self.core.serve_parameters(
+                request.iteration)
+            resp = m.ParameterUpdate(
+                iteration=iteration,
+                parameters=to_wire(
+                    params,
+                    wire_dtype=self._serve_wire_dtype(request.wire_dtype)),
+                ready=ready)
+        self._obs_serve.observe(time.perf_counter() - t0)
+        return resp
 
     # RPC (framework extension, rpc/data_plane.py): client-streamed push.
     # Chunks decode + convert to f32 as they arrive, overlapping transport;
@@ -86,7 +110,7 @@ class ParameterServerService:
                 grads[t.name] = t.to_array()
         if worker_id is None:
             return m.PushResponse(success=False, message="empty push stream")
-        result = self.core.receive_gradients(worker_id, iteration, grads)
+        result = self._apply(worker_id, iteration, grads)
         return m.PushResponse(
             success=result.success,
             message=result.message,

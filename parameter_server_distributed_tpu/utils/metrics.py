@@ -1,112 +1,11 @@
-"""Metrics, step timing, and profiling.
-
-The reference's observability is bare stdout prints (SURVEY.md §5: server
-start lines, checkpoint saves, per-iteration worker status).  Here:
-
-- `StepTimer`: wall-clock per-step timing with p50/p95 summaries;
-- `MetricsLogger`: structured JSONL metrics (step, loss, samples/sec,
-  collective/step time) — machine-readable where the reference had log
-  greps;
-- `profile_trace`: context manager around `jax.profiler.trace` for TPU
-  timeline captures (set PSDT_TRACE_DIR to enable).
-"""
+"""Backward-compat shim: the metrics/timing utilities moved into the
+observability subsystem (obs/stats.py) when cluster-wide tracing and the
+coordinator-aggregated rollup landed.  Import from
+``parameter_server_distributed_tpu.obs`` in new code."""
 
 from __future__ import annotations
 
-import contextlib
-import json
-import os
-import time
-from typing import Any, Iterator
+from ..obs.stats import (MetricsLogger, StepTimer, profile_trace,  # noqa: F401
+                         samples_per_sec)
 
-
-class StepTimer:
-    def __init__(self, capacity: int = 1024):
-        self._durations: list[float] = []
-        self._capacity = capacity
-        self._t0: float | None = None
-
-    def __enter__(self) -> "StepTimer":
-        self._t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc) -> None:
-        assert self._t0 is not None
-        self.record(time.perf_counter() - self._t0)
-
-    def record(self, duration_s: float) -> None:
-        self._durations.append(duration_s)
-        if len(self._durations) > self._capacity:
-            del self._durations[:-self._capacity]
-
-    @property
-    def count(self) -> int:
-        return len(self._durations)
-
-    def percentile(self, q: float) -> float:
-        if not self._durations:
-            return float("nan")
-        ordered = sorted(self._durations)
-        idx = min(len(ordered) - 1, int(q / 100.0 * len(ordered)))
-        return ordered[idx]
-
-    def summary(self) -> dict[str, float]:
-        if not self._durations:
-            return {"count": 0}
-        return {
-            "count": len(self._durations),
-            "mean_s": sum(self._durations) / len(self._durations),
-            "p50_s": self.percentile(50),
-            "p95_s": self.percentile(95),
-            "last_s": self._durations[-1],
-        }
-
-
-class MetricsLogger:
-    """Append-only JSONL metrics stream (path=None: in-memory only)."""
-
-    def __init__(self, path: str | None = None):
-        self._path = path
-        self._records: list[dict] = []
-        if path:
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-
-    def log(self, **fields: Any) -> dict:
-        record = {"t": time.time(), **fields}
-        self._records.append(record)
-        if self._path:
-            with open(self._path, "a") as f:
-                f.write(json.dumps(record, default=float) + "\n")
-        return record
-
-    @property
-    def records(self) -> list[dict]:
-        return list(self._records)
-
-    def latest(self, metric: str) -> Any:
-        for record in reversed(self._records):
-            if metric in record:
-                return record[metric]
-        return None
-
-
-@contextlib.contextmanager
-def profile_trace(name: str = "train",
-                  trace_dir: str | None = None) -> Iterator[None]:
-    """TPU timeline capture via jax.profiler; no-op unless a directory is
-    given or PSDT_TRACE_DIR is set."""
-    trace_dir = trace_dir or os.environ.get("PSDT_TRACE_DIR")
-    if not trace_dir:
-        yield
-        return
-    import jax
-
-    with jax.profiler.trace(os.path.join(trace_dir, name)):
-        yield
-
-
-def samples_per_sec(batch_size: int, step_time_s: float,
-                    num_chips: int = 1) -> float:
-    if step_time_s <= 0:
-        return float("nan")
-    return batch_size / step_time_s / max(1, num_chips)
+__all__ = ["StepTimer", "MetricsLogger", "profile_trace", "samples_per_sec"]

@@ -51,6 +51,24 @@ class ThrottledRelay:
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        # on-the-wire byte totals per direction, across all connections —
+        # lets a test/bench assert what a transport change (e.g. packed
+        # wire dtypes) actually put on the link, independent of what the
+        # application THINKS it sent (counted at relay read, before
+        # delay/pacing)
+        self._byte_lock = threading.Lock()
+        self.bytes_to_target = 0     # client -> backend (requests)
+        self.bytes_from_target = 0   # backend -> client (responses)
+
+    def byte_counts(self) -> tuple[int, int]:
+        """(bytes_to_target, bytes_from_target) so far."""
+        with self._byte_lock:
+            return self.bytes_to_target, self.bytes_from_target
+
+    def reset_byte_counts(self) -> None:
+        with self._byte_lock:
+            self.bytes_to_target = 0
+            self.bytes_from_target = 0
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> int:
@@ -84,10 +102,12 @@ class ThrottledRelay:
             except OSError:
                 conn.close()
                 continue
-            for src, dst in ((conn, upstream), (upstream, conn)):
-                self._pump(src, dst)
+            for src, dst, attr in ((conn, upstream, "bytes_to_target"),
+                                   (upstream, conn, "bytes_from_target")):
+                self._pump(src, dst, attr)
 
-    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              count_attr: str) -> None:
         """One direction: a reader timestamps chunks into a queue, a
         writer releases each at read-time + delay and paces to the rate —
         the pipelined long-link model (latency does not serialize
@@ -100,6 +120,9 @@ class ThrottledRelay:
                     data = src.recv(_CHUNK)
                     if not data:
                         break
+                    with self._byte_lock:
+                        setattr(self, count_attr,
+                                getattr(self, count_attr) + len(data))
                     q.put((time.monotonic(), data))
             except OSError:
                 pass

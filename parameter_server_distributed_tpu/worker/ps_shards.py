@@ -27,6 +27,7 @@ import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
+from ..obs import trace as obs_trace
 from ..rpc import messages as m
 from ..rpc.data_plane import PSClient
 
@@ -77,9 +78,21 @@ class ShardedPSClient:
             raise ValueError(f"unsupported sharded method {method!r}")
         return handler(request, timeout)
 
+    def _submit(self, fn, *fn_args, **fn_kwargs):
+        """Pool submit that carries the calling thread's span context into
+        the fan-out thread: shard RPC spans nest under the worker's
+        push/pull span instead of rooting disconnected traces."""
+        ctx = obs_trace.current()
+
+        def run():
+            with obs_trace.attach(ctx):
+                return fn(*fn_args, **fn_kwargs)
+
+        return self._pool.submit(run)
+
     def _fan_out(self, method: str, requests, timeout):
-        futures = [self._pool.submit(client.call, method, request,
-                                     timeout=timeout)
+        futures = [self._submit(client.call, method, request,
+                                timeout=timeout)
                    for client, request in zip(self._clients, requests)]
         return [f.result() for f in futures]
 
@@ -109,7 +122,7 @@ class ShardedPSClient:
                                     iteration=request.iteration,
                                     gradients=tensors)
                    for tensors in per_shard]
-        futures = [self._pool.submit(push, client, update)
+        futures = [self._submit(push, client, update)
                    for client, update in zip(self._clients, updates)]
         responses = [f.result() for f in futures]
         # Async (bounded-staleness) partial failure: shards that accepted
@@ -153,8 +166,8 @@ class ShardedPSClient:
         if self.num_shards == 1:
             return self._clients[0].pull_parameters(request, timeout=timeout,
                                                     on_chunk=on_chunk)
-        futures = [self._pool.submit(client.pull_parameters, request,
-                                     timeout=timeout, on_chunk=on_chunk)
+        futures = [self._submit(client.pull_parameters, request,
+                                timeout=timeout, on_chunk=on_chunk)
                    for client in self._clients]
         return self._merge_pulls([f.result() for f in futures])
 

@@ -37,6 +37,9 @@ import numpy as np
 
 from ..config import WorkerConfig
 from ..core.tensor import TensorStore, from_wire, to_wire
+from ..obs import stats as obs_stats
+from ..obs import trace as obs_trace
+from ..obs.export import snapshot_blob
 from ..rpc import messages as m
 from ..rpc.data_plane import PSClient
 from ..rpc.service import RpcClient
@@ -71,6 +74,16 @@ class Worker:
         self.metrics = MetricsLogger(
             metrics_path and metrics_path.replace("%d", str(config.worker_id)))
         self.step_timer = StepTimer()
+        # step-phase breakdown + retry accounting (obs registry; snapshots
+        # ride heartbeats to the coordinator — obs/export.py)
+        self._obs_phase = {name: obs_stats.histogram(f"worker.{name}_s")
+                           for name in ("step", "data", "pull", "compute",
+                                        "push", "barrier_wait")}
+        self._obs_retries = obs_stats.counter("rpc.client.retries")
+        # uncompressed f32 size of pushed gradients: the denominator of
+        # the wire-compression ratio in the status rollup
+        self._obs_push_payload = obs_stats.counter(
+            "rpc.client.push.payload_bytes")
         self._coordinator = RpcClient(config.coordinator_address,
                                       m.COORDINATOR_SERVICE, m.COORDINATOR_METHODS)
         self._ps: RpcClient | None = None
@@ -101,6 +114,11 @@ class Worker:
         self._stop.set()
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=2.0)
+        # one parting heartbeat: runs shorter than heartbeat_period_s
+        # would otherwise never deliver a metric snapshot, and even long
+        # runs would leave the coordinator's rollup missing the tail
+        # since the last periodic beat (obs/export.py piggyback)
+        self.send_heartbeat()
         self._coordinator.close()
         if self._ps is not None:
             self._ps.close()
@@ -174,6 +192,7 @@ class Worker:
                 return fn()
             except grpc.RpcError as exc:
                 last_exc = exc
+                self._obs_retries.add()
                 if attempt < attempts - 1:
                     time.sleep(delay)
                     delay *= 2
@@ -203,7 +222,11 @@ class Worker:
             resp = self._coordinator.call(
                 "Heartbeat",
                 m.HeartbeatRequest(worker_id=self.config.worker_id,
-                                   status=self.status),
+                                   status=self.status,
+                                   # metric snapshot piggyback (extension
+                                   # field; reference coordinators skip it)
+                                   obs_snapshot=snapshot_blob(
+                                       worker_id=self.config.worker_id)),
                 timeout=5.0)
             return resp.success
         except grpc.RpcError:
@@ -212,7 +235,13 @@ class Worker:
     # ------------------------------------------------------------ data plane
     def pull_parameters(self, iteration: int) -> tuple[int, TensorStore]:
         """reference: src/worker.cpp:240-252."""
+        t0 = time.perf_counter()
+        with obs_trace.span("worker/pull", iteration=iteration):
+            result = self._pull_parameters(iteration)
+        self._obs_phase["pull"].observe(time.perf_counter() - t0)
+        return result
 
+    def _pull_parameters(self, iteration: int) -> tuple[int, TensorStore]:
         def attempt():
             # a FRESH store per attempt: after a sharded-pull failure,
             # the other shards' fan-out threads may still be streaming
@@ -266,6 +295,15 @@ class Worker:
 
     def push_gradients(self, iteration: int, grads: TensorStore) -> m.PushResponse:
         """reference: src/worker.cpp:254-272."""
+        t0 = time.perf_counter()
+        with obs_trace.span("worker/push", iteration=iteration):
+            resp = self._push_gradients(iteration, grads)
+        self._obs_phase["push"].observe(time.perf_counter() - t0)
+        return resp
+
+    def _push_gradients(self, iteration: int, grads: TensorStore) -> m.PushResponse:
+        self._obs_push_payload.add(
+            sum(4 * int(np.asarray(g).size) for g in grads.values()))
         push_dtype = self._wire_dtype if self._peer_packed_ok else m.WIRE_F32
         new_residual = None
         if push_dtype in (m.WIRE_INT8, m.WIRE_TOPK):
@@ -327,6 +365,13 @@ class Worker:
         self.status = m.WorkerStatus.TRAINING
         self.step_timer.__enter__()
         self.last_bootstrap = False
+        t_step = time.perf_counter()
+        # the step span roots the distributed trace: the pull/push/barrier
+        # client spans nest under it, and their contexts ride the RPC
+        # extension field so the PS-side handler spans share its trace id
+        step_span = obs_trace.span("worker/step", iteration=iteration,
+                                   worker=self.config.worker_id)
+        step_span.__enter__()
         try:
             _, params = self.pull_parameters(iteration)
             missing = (self._expected_param_names() - set(params)
@@ -364,8 +409,13 @@ class Worker:
 
             effective_it = iteration
             for attempt in range(3):
+                t0 = time.perf_counter()
                 batch = next(self.batches)
-                grads, loss = self.trainer.compute_gradients(params, batch)
+                t1 = time.perf_counter()
+                self._obs_phase["data"].observe(t1 - t0)
+                with obs_trace.span("worker/compute", iteration=effective_it):
+                    grads, loss = self.trainer.compute_gradients(params, batch)
+                self._obs_phase["compute"].observe(time.perf_counter() - t1)
                 self.last_loss = loss
 
                 push = self.push_gradients(effective_it, grads)
@@ -388,6 +438,8 @@ class Worker:
             self.iteration = effective_it
             return loss
         finally:
+            step_span.__exit__(None, None, None)
+            self._obs_phase["step"].observe(time.perf_counter() - t_step)
             self.status = m.WorkerStatus.IDLE
             self.step_timer.__exit__()
             self.metrics.log(step=self.iteration, loss=self.last_loss,
@@ -396,6 +448,15 @@ class Worker:
     def _await_barrier(self, iteration: int) -> None:
         """Poll CheckSyncStatus: 50 ms period, <=200 polls, 3 outer retries
         (reference: src/worker.cpp:372-389)."""
+        t0 = time.perf_counter()
+        with obs_trace.span("worker/barrier_wait", iteration=iteration):
+            try:
+                self._await_barrier_inner(iteration)
+            finally:
+                self._obs_phase["barrier_wait"].observe(
+                    time.perf_counter() - t0)
+
+    def _await_barrier_inner(self, iteration: int) -> None:
         for outer in range(self.config.sync_outer_retries):
             for _ in range(self.config.sync_poll_max):
                 resp = self.check_sync_ready(iteration)

@@ -100,9 +100,13 @@ run() {  # run <tag> [VAR=VALUE...]
   line=$(_invoke "$@")
   # -- transport-layer 5xx from the remote-compile helper: transient on a
   #    live device; one retry after a pause (r04: a single HTTP 500 cost
-  #    lm350_scan_noremat_b32 its only window of the round)
+  #    lm350_scan_noremat_b32 its only window of the round).  The gate is
+  #    the actual error signature — a bench_error row whose note carries
+  #    an HTTP 5xx — NOT "remote_compile" anywhere in the output, which
+  #    also matched SUCCESSFUL rows that merely mention remote compilation
+  #    (e.g. a compile-cache note) and double-ran them.
   case "$line" in
-    *"HTTP 5"*|*remote_compile*)
+    *'"metric": "bench_error"'*"HTTP 5"*)
       if device_up; then
         echo "$tag: transport 5xx on a live device; retrying once in" \
              "${RETRY_5XX_PAUSE_S:-20}s" | tee -a "$LOG"

@@ -335,7 +335,14 @@ def test_emitted_idl_matches_reference_descriptors(tmp_path):
     assert extras == {("parameter_server", "Tensor", 5),
                       ("parameter_server", "Tensor", 6),
                       ("parameter_server", "PullRequest", 3),
-                      ("coordinator", "GetPSAddressResponse", 3)}, extras
+                      ("coordinator", "GetPSAddressResponse", 3),
+                      # observability extensions (obs/): trace context on
+                      # the traced request path, metric snapshots on
+                      # heartbeats — field 999, skipped by reference peers
+                      ("parameter_server", "GradientUpdate", 999),
+                      ("parameter_server", "PullRequest", 999),
+                      ("parameter_server", "SyncStatusRequest", 999),
+                      ("coordinator", "HeartbeatRequest", 999)}, extras
 
 
 def test_psclient_interoperates_with_gencode_server(gencode):

@@ -25,7 +25,8 @@ final stdout is always exactly one JSON line; failures carry the
 exception text in a "note" field.
 
 Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
-(mfu | samples | pushpull | async | generate | serve | attention;
+(mfu | samples | pushpull | dataplane | async | generate | serve |
+attention;
 default mfu; serve = continuous-batching sustained tokens/s, with
 PSDT_BENCH_REQUESTS total requests),
 PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
@@ -546,6 +547,120 @@ def bench_pushpull() -> dict:
         metric += f"_{ps_opt}apply"
     return {"metric": metric, "value": round(push_p50 + pull_p50, 2),
             "unit": "ms_roundtrip", "vs_baseline": 1.0}
+
+
+def bench_dataplane() -> dict:
+    """Worker data-plane microbench: per-step RPC-round count and the
+    step-phase breakdown (data/compute/pull/push/fused/barrier_wait) for
+    the fused PushPullStream plane vs the serial reference-shaped
+    push/poll/pull protocol, against an in-process PS.  The JSON line
+    carries both profiles so the BENCH trajectory shows the overlap win
+    explicitly.  PSDT_BENCH_NET="rtt_ms:mbps" inserts a netsim relay (the
+    regime where collapsing 3+ rounds into 1 shows up as wall clock);
+    PSDT_BENCH_STEPS sets the measured step count (default 12);
+    PSDT_BENCH_MODEL picks the worker model (default mnist_mlp)."""
+    import tempfile
+
+    from parameter_server_distributed_tpu.cli.worker_main import build_worker
+    from parameter_server_distributed_tpu.config import (
+        CoordinatorConfig, ParameterServerConfig, WorkerConfig)
+    from parameter_server_distributed_tpu.obs import stats as obs_stats
+    from parameter_server_distributed_tpu.server.coordinator_service import (
+        Coordinator)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+
+    iters = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 12
+    model = os.environ.get("PSDT_BENCH_MODEL", "mnist_mlp")
+    net = os.environ.get("PSDT_BENCH_NET", "")
+
+    data_plane_methods = ("PushPullStream", "PushGradientsStream",
+                          "ReceiveGradients", "ServeParameters",
+                          "ServeParametersStream", "CheckSyncStatus")
+    phase_names = ("data", "compute", "pull", "push", "fused",
+                   "barrier_wait")
+
+    def run_profile(fused: bool) -> dict:
+        # fresh registry per profile so counters/histograms attribute
+        # cleanly (worker/PS/coordinator instruments re-resolve on build)
+        obs_stats.REGISTRY.clear()
+        tmp = tempfile.mkdtemp(prefix="psdt-dataplane-")
+        ps = ParameterServer(ParameterServerConfig(
+            bind_address="127.0.0.1", port=0, total_workers=1,
+            checkpoint_dir=tmp, learning_rate=0.05,
+            autosave_period_s=3600.0))
+        ps_port = ps.start()
+        relay = None
+        if net:
+            from parameter_server_distributed_tpu.utils.netsim import (
+                ThrottledRelay)
+            rtt_ms, mbps = (float(x) for x in net.split(":"))
+            relay = ThrottledRelay(ps_port, delay_ms=rtt_ms / 2.0,
+                                   mbps=mbps)
+            ps_port = relay.start()
+        coordinator = Coordinator(CoordinatorConfig(
+            bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+            ps_port=ps_port, reap_period_s=600.0))
+        coord_port = coordinator.start()
+        worker = build_worker(WorkerConfig(
+            coordinator_address=f"127.0.0.1:{coord_port}", worker_id=0,
+            iterations=iters, batch_size=32, model=model,
+            heartbeat_period_s=3600.0, fused_step=fused))
+        worker.initialize()
+        try:
+            worker.run_iteration(0)   # bootstrap seed
+            worker.run_iteration(1)   # warm-up: jit compile + first pull
+            before = obs_stats.REGISTRY.snapshot()
+            t0 = time.perf_counter()
+            for it in range(2, 2 + iters):
+                worker.run_iteration(it)
+            wall = time.perf_counter() - t0
+            after = obs_stats.REGISTRY.snapshot()
+        finally:
+            worker.shutdown()
+            coordinator.stop()
+            if relay is not None:
+                relay.stop()
+            ps.stop()
+
+        def counter_delta(name: str) -> int:
+            return (after["counters"].get(name, 0)
+                    - before["counters"].get(name, 0))
+
+        rounds = sum(counter_delta(f"rpc.client.{m}.calls")
+                     for m in data_plane_methods)
+        phases = {}
+        for phase in phase_names:
+            h = after["histograms"].get(f"worker.{phase}_s")
+            hb = before["histograms"].get(f"worker.{phase}_s",
+                                          {"count": 0, "sum": 0.0})
+            if not h:
+                continue
+            count = h["count"] - hb["count"]
+            total = h["sum"] - hb["sum"]
+            if count:
+                phases[phase] = round(1e3 * total / count, 3)
+        return {"rpc_rounds_per_step": round(rounds / iters, 2),
+                "step_ms": round(1e3 * wall / iters, 2),
+                "phase_mean_ms": phases}
+
+    log(f"bench_dataplane: {iters} steps model={model}"
+        + (f" net={net}" if net else ""))
+    fused = run_profile(fused=True)
+    serial = run_profile(fused=False)
+    log(f"bench_dataplane: fused  {fused}")
+    log(f"bench_dataplane: serial {serial}")
+    metric = "dataplane_fused_step"
+    if net:
+        rtt_ms, mbps = (float(x) for x in net.split(":"))
+        metric += f"_net{rtt_ms:g}ms{mbps:g}mbps"
+    return {"metric": metric, "value": fused["step_ms"],
+            "unit": "ms_step", "vs_baseline": 1.0,
+            "fused": fused, "serial": serial,
+            "note": (f"fused {fused['rpc_rounds_per_step']:g} RPC "
+                     f"rounds/step vs serial "
+                     f"{serial['rpc_rounds_per_step']:g}; serial step "
+                     f"p-mean {serial['step_ms']:g} ms")}
 
 
 def _ab_host_optimizer() -> None:
@@ -1186,6 +1301,8 @@ def child_main(mode: str) -> int:
     try:
         if mode == "pushpull":
             result = bench_pushpull()
+        elif mode == "dataplane":
+            result = bench_dataplane()
         elif mode == "async":
             result = bench_async()
         elif mode == "generate":
@@ -1293,7 +1410,7 @@ def main() -> int:
     # Host-only benches never need the accelerator — run them on CPU
     # directly rather than risking a flaky TPU init.
     plans: list[tuple[str, float]]
-    if mode == "pushpull":
+    if mode in ("pushpull", "dataplane"):
         plans = [("cpu", cpu_timeout)]
     else:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]

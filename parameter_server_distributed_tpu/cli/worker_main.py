@@ -31,6 +31,9 @@ Extension flags:
                      are sharding-constrained inside the jitted step, so
                      a model too big for one chip still speaks plain PS.
                      Default: pure local data parallelism over all chips
+    --no-fused       disable the fused PushPullStream data plane (one RPC
+                     round per step, docs/training.md) and run the
+                     reference-shaped serial push/poll/pull protocol
 """
 
 from __future__ import annotations
@@ -95,6 +98,7 @@ def main(argv: list[str] | None = None) -> int:
         **({"topk_density": float(flags["topk-density"])}
            if "topk-density" in flags else {}),
         mesh=flags.get("mesh", ""),
+        fused_step="no-fused" not in flags,
     )
     worker = build_worker(config, seed=int(flags["seed"]) if "seed" in flags else None)
     worker.initialize()

@@ -116,6 +116,18 @@ class WorkerConfig:
     # parallelism.  Params are sharding-constrained inside the jitted
     # step; the PS protocol still sees one packed host store.
     mesh: str = ""
+    # Pipelined data plane (rpc/data_plane.py PushPullStream): collapse
+    # each synchronous step's push + barrier polls + pull into one fused
+    # RPC round with bucketed D2H/encode/transport overlap.  Degrades
+    # automatically (per connection) to the serial reference protocol
+    # against a reference PS; False forces the serial path everywhere.
+    fused_step: bool = True
+    # Client timeout of the fused call.  It spans push + barrier wait +
+    # pull, so it must exceed the SERVER-side barrier cap
+    # (PSDT_FUSED_BARRIER_TIMEOUT_S, default 60 s) — the server answers a
+    # clean not-ready inside this window and the worker falls back to its
+    # poll loop rather than aborting the stream.
+    fused_timeout_s: float = 120.0
 
 
 @dataclasses.dataclass(frozen=True)

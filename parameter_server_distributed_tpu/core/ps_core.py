@@ -100,6 +100,11 @@ class ParameterServerCore:
         self._params: TensorStore = {}
         self._params_lock = threading.Lock()   # reference: params_mutex_ (h:44)
         self._state_lock = threading.Lock()    # reference: state_mutex_ (h:52)
+        # Barrier-completion broadcast over _state_lock: the fused data
+        # plane (PushPullStream) parks here and is woken the instant an
+        # aggregation fires, instead of being polled at 20 Hz like the
+        # reference's CheckSyncStatus loop (src/worker.cpp:372-389).
+        self._barrier_cv = threading.Condition(self._state_lock)
         self._iteration_states: "OrderedDict[int, IterationState]" = OrderedDict()
         self._static_total_workers = int(total_workers)
         self._live_workers_fn = live_workers_fn
@@ -174,6 +179,11 @@ class ParameterServerCore:
         with self._params_lock:
             return dict(self._params)
 
+    @property
+    def has_parameters(self) -> bool:
+        with self._params_lock:
+            return bool(self._params)
+
     def serve_parameters(self, iteration: int = 0) -> tuple[int, TensorStore, bool]:
         """Return (current_iteration, params copy, ready).  The iteration
         argument is accepted and ignored, matching the reference
@@ -247,6 +257,7 @@ class ParameterServerCore:
             state.workers_at_aggregation = received
             state.worker_gradients.clear()  # free gradient memory promptly
             self._aggregated_watermark = max(self._aggregated_watermark, iteration)
+            self._barrier_cv.notify_all()  # wake fused-RPC barrier waiters
         return state.workers_at_aggregation if state.aggregated else received
 
     def _receive_async(self, worker_id: int, iteration: int,
@@ -382,6 +393,43 @@ class ParameterServerCore:
             if state.aggregated:
                 return iteration, True, state.workers_at_aggregation, total
             return iteration, False, received, total
+
+    def wait_for_aggregation(self, iteration: int,
+                             timeout: float) -> tuple[bool, int, int]:
+        """Block until ``iteration``'s aggregation completes (or timeout).
+        Returns (ready, workers_received, total_workers).
+
+        This is the serve-when-complete primitive of the fused data plane:
+        instead of N workers polling CheckSyncStatus at 20 Hz, their
+        PushPullStream handlers park on a condition variable and are
+        notified the instant the barrier closes.  The wait wakes at a
+        bounded cadence regardless, re-reading the (possibly elastic)
+        barrier width so a mid-iteration shrink releases a fully-buffered
+        iteration exactly as the polled path does."""
+        if not self.synchronous:
+            return True, 1, self.barrier_width()
+        deadline = time.monotonic() + timeout
+        while True:
+            # barrier_width() may hit a remote live-worker provider; keep
+            # it outside the lock like every other caller
+            total = self.barrier_width()
+            with self._barrier_cv:
+                state = self._iteration_states.get(iteration)
+                if state is None:
+                    if iteration <= self._aggregated_watermark:
+                        return True, total, total
+                    received = 0
+                else:
+                    received = self._maybe_aggregate_locked(iteration, state,
+                                                            total)
+                    if state.aggregated:
+                        return True, state.workers_at_aggregation, total
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False, received, total
+                # 250 ms cap: elastic width changes have no notification
+                # of their own, so re-evaluate on a short heartbeat
+                self._barrier_cv.wait(min(remaining, 0.25))
 
     # --------------------------------------------------------------------- gc
     def _gc_locked(self) -> None:

@@ -21,7 +21,10 @@ from typing import Any
 from .stats import REGISTRY, percentile_from
 
 # step-phase histograms recorded by worker/worker.py, in display order
-_PHASES = ("data", "pull", "compute", "push", "barrier_wait")
+# ("fused" is the single push→barrier→pull round of the pipelined data
+# plane; the serial pull/push/barrier_wait phases appear when it is off
+# or degraded)
+_PHASES = ("data", "pull", "compute", "push", "fused", "barrier_wait")
 
 
 def snapshot_blob(**extra: Any) -> bytes:
@@ -78,13 +81,19 @@ def worker_rollup(snap: dict) -> dict:
         # uncompressed (f32) size of the tensors that rode those wire
         # bytes — the with/without-compression comparison in one view
         out["payload_bytes_f32"] = payload
-        # the matching denominator: wire bytes of the PUSH methods only
-        # (bytes_sent also counts heartbeat snapshots, sync polls, and
-        # registration, which would understate the ratio)
-        push = (_sum_counters(snap, ".request_bytes",
-                              "rpc.client.ReceiveGradients")
-                + _sum_counters(snap, ".request_bytes",
-                                "rpc.client.PushGradientsStream"))
+        # The matching denominator, preferring the worker's exact
+        # wire-encoded tensor byte counter (rpc.client.push.wire_bytes —
+        # uniform across the unary/stream/fused push paths); older
+        # snapshots fall back to the push methods' request_bytes
+        # (bytes_sent alone also counts heartbeat snapshots, sync polls,
+        # and registration, which would understate the ratio).
+        push = _sum_counters(snap, "push.wire_bytes", "rpc.client.")
+        if not push:
+            push = sum(_sum_counters(snap, ".request_bytes",
+                                     f"rpc.client.{method}")
+                       for method in ("ReceiveGradients",
+                                      "PushGradientsStream",
+                                      "PushPullStream"))
         if push:
             out["push_bytes"] = push
     return out

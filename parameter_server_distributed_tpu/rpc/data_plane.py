@@ -14,6 +14,14 @@ a STREAM of chunk messages, each carrying a subset of the tensors:
 - ``ServeParametersStream`` (server-streaming): the server encodes and
   ships tensors chunk by chunk; the client converts each chunk while the
   next is in flight.
+- ``PushPullStream`` (bidirectional): the fused synchronous step.  The
+  client streams its gradient chunks; the server applies them, parks on
+  the aggregation barrier (condition variable — core/ps_core.py
+  ``wait_for_aggregation``), and streams the fresh parameter chunks back
+  on the same call.  One RPC round replaces push + M× CheckSyncStatus
+  polls + pull, and because the request side accepts a LAZY tensor
+  iterator, the worker's bucketed D2H fetch ⊕ compress ⊕ encode ⊕
+  transport all pipeline per bucket (worker/trainer.py GradientBuckets).
 
 Chunks reuse the wire-compatible ``GradientUpdate`` / ``ParameterUpdate``
 schemas (a chunk is just a smaller message), so nothing new exists at the
@@ -54,6 +62,17 @@ def _status_code(exc: grpc.RpcError):
 def stream_chunk_bytes() -> int:
     return int(os.environ.get("PSDT_STREAM_CHUNK_BYTES",
                               str(DEFAULT_CHUNK_BYTES)))
+
+
+def bucket_bytes() -> int:
+    """Bucket budget for the worker's incremental gradient D2H fetch
+    (worker/trainer.py GradientBuckets).  Defaults to the stream chunk
+    budget so D2H buckets and wire chunks stay aligned; PSDT_BUCKET_BYTES
+    overrides independently (0 falls back to whole-store fetch)."""
+    raw = os.environ.get("PSDT_BUCKET_BYTES")
+    if raw is not None:
+        return int(raw)
+    return stream_chunk_bytes()
 
 
 def _tensor_nbytes(t: m.Tensor) -> int:
@@ -101,9 +120,14 @@ class PSClient(RpcClient):
         # None = untried; False = server answered UNIMPLEMENTED (reference
         # PS) — unary forever on this connection
         self._stream_ok: bool | None = None
+        # same tri-state for the fused push→barrier→pull method
+        self._fused_ok: bool | None = None
 
     def _streaming(self) -> bool:
         return self.chunk_bytes > 0 and self._stream_ok is not False
+
+    def _fused(self) -> bool:
+        return self.chunk_bytes > 0 and self._fused_ok is not False
 
     # ------------------------------------------------------------------ push
     def push_gradients(self, update: m.GradientUpdate,
@@ -137,6 +161,93 @@ class PSClient(RpcClient):
                 raise
             self._stream_ok = False
             return self.call("ReceiveGradients", update, timeout=timeout)
+
+    # ------------------------------------------------------------------ fused
+    def push_pull(self, worker_id: int, iteration: int, tensors,
+                  pull_wire_dtype: int = 0, timeout: float | None = None,
+                  on_chunk=None) -> tuple[m.PushResponse,
+                                          m.ParameterUpdate | None]:
+        """Fused synchronous step over ``PushPullStream``: stream the
+        gradient chunks, let the server barrier-wait, receive the fresh
+        parameter chunks — one data-plane round.
+
+        ``tensors``: an iterable of wire Tensors, or a ZERO-ARG CALLABLE
+        returning a fresh iterator (required when the tensors materialize
+        lazily, e.g. bucketed D2H fetch — the unary fallback re-reads
+        them, and a half-consumed generator cannot be replayed).
+        ``on_chunk``: same contract as :meth:`pull_parameters`.
+
+        Returns ``(push_response, parameter_update | None)``.  The second
+        element is ``None`` whenever fresh parameters were NOT delivered
+        on this round — fused method unimplemented (reference server),
+        push rejected, or server-side barrier timeout — and the caller
+        must fall back to its own barrier-wait + pull.  The fallback is
+        remembered per connection, exactly like the chunk-stream RPCs."""
+        tensors_fn = tensors if callable(tensors) else lambda: iter(tensors)
+        if not self._fused():
+            return self._push_only(worker_id, iteration, tensors_fn,
+                                   timeout), None
+
+        def chunks() -> Iterator[m.GradientUpdate]:
+            # pull_wire_dtype rides the first chunk only (the server reads
+            # header fields off it); an empty push still sends one empty
+            # chunk — the sharded-topology barrier invariant (see
+            # push_gradients)
+            first = True
+            for group in split_tensors(tensors_fn(), self.chunk_bytes):
+                yield m.GradientUpdate(
+                    worker_id=worker_id, iteration=iteration,
+                    gradients=group,
+                    pull_wire_dtype=pull_wire_dtype if first else 0)
+                first = False
+            if first:
+                yield m.GradientUpdate(worker_id=worker_id,
+                                       iteration=iteration, gradients=[],
+                                       pull_wire_dtype=pull_wire_dtype)
+
+        try:
+            push: m.PushResponse | None = None
+            merged: list[m.Tensor] = []
+            params_iteration, ready, got_params = 0, False, False
+            for frame in self.call("PushPullStream", chunks(),
+                                   timeout=timeout):
+                if frame.push is not None and push is None:
+                    push = frame.push
+                if frame.params is not None:
+                    got_params = True
+                    chunk = frame.params
+                    params_iteration, ready = chunk.iteration, chunk.ready
+                    if on_chunk is not None:
+                        on_chunk(chunk.parameters)
+                        merged.extend(
+                            m.Tensor(name=t.name,
+                                     packed_dtype=t.packed_dtype)
+                            for t in chunk.parameters)
+                    else:
+                        merged.extend(chunk.parameters)
+            self._fused_ok = True
+            if push is None:
+                return m.PushResponse(success=False,
+                                      message="empty fused response"), None
+            if not (got_params and ready):
+                return push, None
+            return push, m.ParameterUpdate(iteration=params_iteration,
+                                           parameters=merged, ready=True)
+        except grpc.RpcError as exc:
+            if _status_code(exc) != grpc.StatusCode.UNIMPLEMENTED:
+                raise
+            self._fused_ok = False
+            return self._push_only(worker_id, iteration, tensors_fn,
+                                   timeout), None
+
+    def _push_only(self, worker_id: int, iteration: int, tensors_fn,
+                   timeout) -> m.PushResponse:
+        """Degraded fused call: push leg only (chunk-streamed when the
+        server supports it, unary otherwise); the caller supplies the
+        barrier-wait and pull."""
+        update = m.GradientUpdate(worker_id=worker_id, iteration=iteration,
+                                  gradients=list(tensors_fn()))
+        return self.push_gradients(update, timeout=timeout)
 
     # ------------------------------------------------------------------ pull
     def pull_parameters(self, request: m.PullRequest,

@@ -183,10 +183,17 @@ TRACE_FIELD_NUMBER = 999
 
 
 class GradientUpdate(Message):
+    """Fields 1-3 mirror the reference IDL.  Field 4 is a framework
+    extension read only by the fused ``PushPullStream`` data plane
+    (rpc/data_plane.py): the wire encoding (WIRE_*) the pushing worker
+    wants the post-barrier parameters streamed back in — the fused round
+    has no separate PullRequest to carry it.  Reference peers skip it per
+    proto3 unknown-field rules; the unary/stream push paths never set it."""
     FIELDS = (
         Field(1, "worker_id", "int32"),
         Field(2, "iteration", "int32"),
         Field(3, "gradients", "message", message_type=Tensor, repeated=True),
+        Field(4, "pull_wire_dtype", "int32"),
         Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
     )
 
@@ -219,6 +226,19 @@ class ParameterUpdate(Message):
         Field(1, "iteration", "int32"),
         Field(2, "parameters", "message", message_type=Tensor, repeated=True),
         Field(3, "ready", "bool"),
+    )
+
+
+class PushPullResponse(Message):
+    """One frame of the fused ``PushPullStream`` response (framework
+    extension, rpc/data_plane.py).  Exactly one of the two sub-messages is
+    set per frame: the FIRST frame carries ``push`` (the push verdict, sent
+    the instant the gradients are applied so a stale rejection never waits
+    on the barrier); every later frame carries ``params`` (a chunk of the
+    post-barrier parameter stream, same schema as the unary pull)."""
+    FIELDS = (
+        Field(1, "push", "message", message_type=PushResponse),
+        Field(2, "params", "message", message_type=ParameterUpdate),
     )
 
 
@@ -376,6 +396,11 @@ PARAMETER_SERVER_METHODS = {
 PARAMETER_SERVER_STREAM_METHODS = {
     "PushGradientsStream": (GradientUpdate, PushResponse, "stream_unary"),
     "ServeParametersStream": (PullRequest, ParameterUpdate, "unary_stream"),
+    # Fused data plane: client streams gradient chunks; the server applies
+    # them, waits on the aggregation barrier (condition variable, no
+    # polling), then streams the fresh parameter chunks back on the SAME
+    # call — push + M sync polls + pull collapse into one RPC round.
+    "PushPullStream": (GradientUpdate, PushPullResponse, "stream_stream"),
 }
 
 COORDINATOR_METHODS = {

@@ -37,7 +37,8 @@ from .wire import Message
 
 def _spec(entry) -> tuple[type[Message], type[Message], str]:
     """Normalize a method-table entry: (req, resp) -> unary-unary, or
-    (req, resp, style) with style in unary | stream_unary | unary_stream."""
+    (req, resp, style) with style in unary | stream_unary | unary_stream
+    | stream_stream."""
     if len(entry) == 2:
         req_cls, resp_cls = entry
         return req_cls, resp_cls, "unary"
@@ -88,6 +89,28 @@ def _instrument_handler(behavior: Callable, method: str, style: str):
                 latency.observe(time.perf_counter() - t0)
         return stream_unary
 
+    if style == "stream_stream":
+        def stream_stream(request_iterator, context):
+            calls.add()
+            t0 = time.perf_counter()
+            # like stream_unary, the remote context arrives on the first
+            # request chunk, after the handler has started
+            holder = obs_trace.SpanHolder(span_name)
+
+            def chunks():
+                for req in request_iterator:
+                    holder.adopt(getattr(req, "trace_context", b""))
+                    yield req
+
+            def stream():
+                try:
+                    yield from behavior(chunks(), context)
+                finally:
+                    holder.finish()
+                    latency.observe(time.perf_counter() - t0)
+            return stream()
+        return stream_stream
+
     if style == "unary_stream":
         def unary_stream(request, context):
             calls.add()
@@ -129,6 +152,7 @@ def bind_service(server: grpc.Server, service_name: str,
             "unary": grpc.unary_unary_rpc_method_handler,
             "stream_unary": grpc.stream_unary_rpc_method_handler,
             "unary_stream": grpc.unary_stream_rpc_method_handler,
+            "stream_stream": grpc.stream_stream_rpc_method_handler,
         }[style]
         handlers[method] = make_handler(
             _instrument_handler(getattr(impl, method), method, style),
@@ -191,6 +215,7 @@ class RpcClient:
                 "unary": self._channel.unary_unary,
                 "stream_unary": self._channel.stream_unary,
                 "unary_stream": self._channel.unary_stream,
+                "stream_stream": self._channel.stream_stream,
             }[style]
             self._calls[method] = make_call(
                 f"/{service_name}/{method}",
@@ -207,10 +232,11 @@ class RpcClient:
                 style)
 
     def call(self, method: str, request: Message, timeout: float | None = None):
-        """Unary call.  For a ``stream_unary`` method pass an ITERATOR of
-        request messages (gRPC pulls it from a sender thread, so per-chunk
-        encode overlaps transport); a ``unary_stream`` method returns an
-        iterator of response messages that decode as chunks arrive."""
+        """Unary call.  For a ``stream_unary`` or ``stream_stream`` method
+        pass an ITERATOR of request messages (gRPC pulls it from a sender
+        thread, so per-chunk encode overlaps transport); ``unary_stream``
+        and ``stream_stream`` return an iterator of response messages that
+        decode as chunks arrive."""
         calls, latency, style = self._instruments[method]
         calls.add()
         t0 = time.perf_counter()
@@ -219,7 +245,7 @@ class RpcClient:
                 return self._calls[method](request, timeout=timeout)
             with obs_trace.span(f"rpc/client/{method}", target=self._target):
                 ctx = obs_trace.wire_context()
-                if style == "stream_unary":
+                if style in ("stream_unary", "stream_stream"):
                     request = _inject_stream(request, ctx)
                 elif ctx and hasattr(request, "trace_context"):
                     request.trace_context = ctx

@@ -40,6 +40,9 @@ class ParameterServerService:
         # latency histograms live in rpc/service.bind_service)
         self._obs_apply = obs_stats.histogram("ps.apply_s")
         self._obs_serve = obs_stats.histogram("ps.serve_s")
+        # fused data plane: how long PushPullStream handlers park on the
+        # barrier condition variable before serving
+        self._obs_barrier = obs_stats.histogram("ps.barrier_wait_s")
 
     def _apply(self, worker_id: int, iteration: int, grads):
         """Decoded-gradients -> core aggregation, timed and traced (the
@@ -120,13 +123,15 @@ class ParameterServerService:
             total_workers=result.total_workers,
         )
 
-    # RPC (framework extension): server-streamed pull.  Tensors ship in
-    # chunk_bytes-sized groups; each chunk's fused bf16/raw encode happens
-    # as it is yielded, overlapping the previous chunk's transport.
-    def ServeParametersStream(self, request: m.PullRequest, context):
-        iteration, params, ready = self.core.serve_parameters(request.iteration)
-        tensors = to_wire(
-            params, wire_dtype=self._serve_wire_dtype(request.wire_dtype))
+    def _parameter_chunks(self, request_iteration: int, wire_dtype: int):
+        """Serve the current store as a stream of ParameterUpdate chunks
+        (shared by ServeParametersStream and the fused PushPullStream).
+        Each chunk's fused bf16/raw encode happens as it is yielded,
+        overlapping the previous chunk's transport."""
+        iteration, params, ready = self.core.serve_parameters(
+            request_iteration)
+        tensors = to_wire(params,
+                          wire_dtype=self._serve_wire_dtype(wire_dtype))
         sent = False
         for group in split_tensors(tensors, stream_chunk_bytes() or
                                    (32 << 20)):
@@ -136,6 +141,91 @@ class ParameterServerService:
         if not sent:  # empty store still answers one (empty) chunk
             yield m.ParameterUpdate(iteration=iteration, parameters=[],
                                     ready=ready)
+
+    # RPC (framework extension): server-streamed pull.
+    def ServeParametersStream(self, request: m.PullRequest, context):
+        yield from self._parameter_chunks(request.iteration,
+                                          request.wire_dtype)
+
+    # Server-side cap on the fused barrier park.  Kept BELOW the worker's
+    # fused call timeout so a stuck barrier surfaces as a clean
+    # ready=False frame (client falls back to its poll loop) instead of a
+    # DEADLINE_EXCEEDED stream abort.
+    @staticmethod
+    def _fused_barrier_timeout_s() -> float:
+        return float(os.environ.get("PSDT_FUSED_BARRIER_TIMEOUT_S", "60"))
+
+    # RPC (framework extension, rpc/data_plane.py): the fused synchronous
+    # step.  Client-streamed gradient chunks are applied as ONE
+    # receive_gradients call (barrier/staleness semantics identical to the
+    # unary push); the handler then parks on the aggregation condition
+    # variable and streams the fresh parameters back the instant the
+    # barrier closes — no CheckSyncStatus polling, no second round.
+    def PushPullStream(self, request_iterator, context):
+        if not self.core.has_parameters:
+            # A fused push must never be the store's FIRST payload: the
+            # bootstrap rule (first aggregated payload BECOMES the params
+            # — reference src/parameter_server.cpp:78-81) is reserved for
+            # the worker's deliberate init seed, which always rides the
+            # plain push path.  A fused push of real gradients can only
+            # reach an empty store when the PS restarted under a worker
+            # holding cached params — refusing makes the worker re-pull,
+            # notice the emptiness, and re-seed instead of silently
+            # turning its gradients into parameters.
+            yield m.PushPullResponse(push=m.PushResponse(
+                success=False,
+                message="parameter store empty: fused push refused "
+                        "(re-pull and seed init via the push path)",
+                iteration=self.core.current_iteration))
+            return
+        worker_id = iteration = None
+        pull_wire_dtype = 0
+        grads: dict = {}
+        for chunk in request_iterator:
+            if worker_id is None:
+                worker_id, iteration = chunk.worker_id, chunk.iteration
+                pull_wire_dtype = chunk.pull_wire_dtype
+            for t in chunk.gradients:
+                grads[t.name] = t.to_array()
+        if worker_id is None:
+            yield m.PushPullResponse(push=m.PushResponse(
+                success=False, message="empty push stream"))
+            return
+        result = self._apply(worker_id, iteration, grads)
+        push = m.PushResponse(
+            success=result.success,
+            message=result.message,
+            iteration=result.iteration,
+            aggregation_complete=result.aggregation_complete,
+            workers_received=result.workers_received,
+            total_workers=result.total_workers,
+        )
+        # the push verdict goes out immediately: a stale rejection (async
+        # mode) must reach the worker without waiting on any barrier
+        yield m.PushPullResponse(push=push)
+        if not result.success:
+            return
+        if not result.aggregation_complete:
+            t0 = time.perf_counter()
+            with obs_trace.span("ps/barrier_wait", worker=worker_id,
+                                iteration=iteration):
+                ready, received, total = self.core.wait_for_aggregation(
+                    iteration, timeout=self._fused_barrier_timeout_s())
+            self._obs_barrier.observe(time.perf_counter() - t0)
+            if not ready:
+                log.warning(
+                    "PushPullStream: barrier timeout at iteration %d "
+                    "(%d/%d received) — worker %d falls back to polling",
+                    iteration, received, total, worker_id)
+                yield m.PushPullResponse(params=m.ParameterUpdate(
+                    iteration=self.core.current_iteration, ready=False))
+                return
+        t0 = time.perf_counter()
+        with obs_trace.span("ps/serve", worker=worker_id,
+                            iteration=iteration):
+            for chunk in self._parameter_chunks(iteration, pull_wire_dtype):
+                yield m.PushPullResponse(params=chunk)
+        self._obs_serve.observe(time.perf_counter() - t0)
 
     # RPC: barrier poll (reference: src/parameter_server_service.cpp:85-95)
     def CheckSyncStatus(self, request: m.SyncStatusRequest, context) -> m.SyncStatusResponse:
@@ -227,7 +317,15 @@ class ParameterServer:
 
     def start(self) -> int:
         """Start serving; returns the bound port (0 in config = ephemeral)."""
-        self._server = make_server()
+        # The fused data plane parks one handler thread per barrier-waiting
+        # worker (PushPullStream blocks in wait_for_aggregation), so the
+        # pool must exceed the barrier width or the LAST worker's push —
+        # the one that would close the barrier — queues behind the parked
+        # handlers and every step stalls to the barrier timeout.  2x +
+        # headroom leaves room for concurrent pulls/checkpoint RPCs and
+        # moderate elastic growth past the configured width.
+        self._server = make_server(
+            max_workers=max(8, 2 * self.config.total_workers + 4))
         bind_service(self._server, m.PARAMETER_SERVER_SERVICE,
                      {**m.PARAMETER_SERVER_METHODS,
                       **m.PARAMETER_SERVER_STREAM_METHODS}, self.service)

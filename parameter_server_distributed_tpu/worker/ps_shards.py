@@ -108,6 +108,27 @@ class ShardedPSClient:
     def _call_ReceiveGradients(self, request: m.GradientUpdate, timeout):
         return self._push_sharded(request, timeout, stream=False)
 
+    def _partition(self, tensors) -> list[list]:
+        """Name-partition a tensor iterable over the shards (one list per
+        shard; non-owners get an empty list, which still counts as a
+        barrier contribution when pushed)."""
+        per_shard: list[list] = [[] for _ in range(self.num_shards)]
+        for tensor in (tensors() if callable(tensors) else tensors):
+            per_shard[shard_owner(tensor.name, self.num_shards)].append(
+                tensor)
+        return per_shard
+
+    @staticmethod
+    def _merge_pushes(responses) -> m.PushResponse:
+        return m.PushResponse(
+            success=all(r.success for r in responses),
+            message="; ".join(sorted({r.message for r in responses})),
+            iteration=max(r.iteration for r in responses),
+            aggregation_complete=all(r.aggregation_complete
+                                     for r in responses),
+            workers_received=min(r.workers_received for r in responses),
+            total_workers=max(r.total_workers for r in responses))
+
     def _push_sharded(self, request: m.GradientUpdate, timeout,
                       stream: bool) -> m.PushResponse:
         def push(client, update):
@@ -115,9 +136,7 @@ class ShardedPSClient:
                 return client.push_gradients(update, timeout=timeout)
             return client.call("ReceiveGradients", update, timeout=timeout)
 
-        per_shard: list[list] = [[] for _ in range(self.num_shards)]
-        for tensor in request.gradients:
-            per_shard[shard_owner(tensor.name, self.num_shards)].append(tensor)
+        per_shard = self._partition(request.gradients)
         updates = [m.GradientUpdate(worker_id=request.worker_id,
                                     iteration=request.iteration,
                                     gradients=tensors)
@@ -144,14 +163,52 @@ class ShardedPSClient:
                     m.GradientUpdate(worker_id=request.worker_id,
                                      iteration=responses[i].iteration,
                                      gradients=per_shard[i]))
-        return m.PushResponse(
-            success=all(r.success for r in responses),
-            message="; ".join(sorted({r.message for r in responses})),
-            iteration=max(r.iteration for r in responses),
-            aggregation_complete=all(r.aggregation_complete
-                                     for r in responses),
-            workers_received=min(r.workers_received for r in responses),
-            total_workers=max(r.total_workers for r in responses))
+        return self._merge_pushes(responses)
+
+    # ------------------------------------------------------------ fused path
+    def push_pull(self, worker_id: int, iteration: int, tensors,
+                  pull_wire_dtype: int = 0, timeout: float | None = None,
+                  on_chunk=None) -> tuple[m.PushResponse,
+                                          m.ParameterUpdate | None]:
+        """Fused push→barrier→pull fanned out per shard (one
+        PushPullStream round per shard, concurrent).  Every shard sees a
+        push — owners get their partition, the rest an empty chunk — so
+        each shard's barrier counts the same contributor set as the unary
+        topology; stale rejections re-push only the rejected shards with
+        the same payload (the `_push_sharded` semantics).  The merged
+        parameter update is ``None`` — caller falls back to barrier-poll +
+        pull — unless EVERY shard delivered fresh parameters."""
+        if self.num_shards == 1:
+            return self._clients[0].push_pull(
+                worker_id, iteration, tensors,
+                pull_wire_dtype=pull_wire_dtype, timeout=timeout,
+                on_chunk=on_chunk)
+        # name-partitioning needs the full tensor list up front, so the
+        # sharded topology materializes the (possibly lazy) producer; the
+        # per-bucket D2H overlap is a single-PS refinement
+        per_shard = self._partition(tensors)
+
+        def fused(client, shard_tensors, it):
+            return client.push_pull(worker_id, it, shard_tensors,
+                                    pull_wire_dtype=pull_wire_dtype,
+                                    timeout=timeout, on_chunk=on_chunk)
+
+        futures = [self._submit(fused, client, shard_tensors, iteration)
+                   for client, shard_tensors in zip(self._clients, per_shard)]
+        results = [f.result() for f in futures]
+        for _ in range(3):
+            stale = [i for i, (push, _) in enumerate(results)
+                     if not push.success and "stale" in push.message]
+            if not stale:
+                break
+            for i in stale:
+                results[i] = fused(self._clients[i], per_shard[i],
+                                   results[i][0].iteration)
+        merged_push = self._merge_pushes([push for push, _ in results])
+        stores = [params for _, params in results]
+        if not merged_push.success or any(s is None for s in stores):
+            return merged_push, None
+        return merged_push, self._merge_pulls(stores)
 
     # ------------------------------------------------------------- pull path
     def pull_parameters(self, request: m.PullRequest,

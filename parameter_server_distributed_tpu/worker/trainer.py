@@ -24,13 +24,117 @@ costs the same two transfers as a 1-tensor MLP.
 from __future__ import annotations
 
 import math
-from typing import Mapping
+import threading
+from typing import Callable, Iterator, Mapping
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import TensorStore
+
+# One dispatch at a time per process: trainer-originated XLA work (step
+# launch, bucket slice fetches) may run from several threads at once —
+# the worker's train thread plus the RPC sender draining GradientBuckets,
+# times N in-process workers under tests.  The XLA CPU client has
+# deadlocked under that concurrency (both dispatches parked forever);
+# serializing OUR dispatch entry points costs nothing in production (one
+# worker per process, dispatch is microseconds) and removes the overlap
+# the client cannot handle.  D2H/compute overlap is unaffected: the lock
+# covers launching work, and async copies still complete in parallel.
+_DISPATCH_LOCK = threading.Lock()
+
+
+class GradientBuckets:
+    """Lazily-fetched packed gradients: the D2H leg of the pipelined data
+    plane.
+
+    ``compute_gradient_buckets`` returns one of these instead of a
+    materialized gradient dict: the jitted step's flat output stays on
+    device, and iterating yields ``(name, f32 array)`` per tensor while
+    fetching the flat buffer host-side in bucket-sized slices on demand.
+    Fed to a lazy wire-tensor iterator (worker/worker.py) under the
+    chunk-stream/fused RPCs, bucket N+1's D2H copy (kicked off
+    asynchronously) overlaps bucket N's compress/encode/transport — the
+    whole-store fetch stall of the serial path disappears.
+
+    Bucket 0 additionally carries the loss scalar (flat offset 0);
+    reading :attr:`loss` fetches it, blocking until the step's compute is
+    done.  Fetched buckets are cached, so re-iteration (the unary
+    fallback replays the tensors) costs no second device round-trip.
+    ``on_fetch(bucket_index, n_buckets)`` fires on each REAL device
+    fetch — tests and the data-plane microbench use it to observe
+    pipelining."""
+
+    def __init__(self, layout, device_flat, bucket_bytes: int,
+                 on_fetch: Callable[[int, int], None] | None = None):
+        self._device = device_flat
+        self.on_fetch = on_fetch
+        # greedy plan over the fixed layout: consecutive tensors grouped
+        # into ~bucket_bytes f32 slices of the flat output (loss scalar
+        # rides bucket 0); a tensor larger than the budget rides alone —
+        # same grouping rule as rpc/data_plane.split_tensors
+        plan: list[tuple[int, int, list]] = []
+        group: list = []
+        start = 0
+        for entry in layout:
+            _name, off, size, _shape, _dtype = entry
+            end = 1 + off + size
+            if group and bucket_bytes > 0 and \
+                    4 * (end - start) > bucket_bytes:
+                plan.append((start, 1 + off, group))
+                group, start = [], 1 + off
+            group.append(entry)
+        if group or not plan:
+            end = (1 + group[-1][1] + group[-1][2]) if group else 1
+            plan.append((start, end, group))
+        self._plan = plan
+        self._slices: list = [None] * len(plan)
+        self._host: dict[int, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self._plan)
+
+    @property
+    def loss(self) -> float:
+        return float(self._fetch(0)[0])
+
+    def _dev_slice(self, i: int):
+        s = self._slices[i]
+        if s is None:
+            a, b, _ = self._plan[i]
+            with _DISPATCH_LOCK:
+                s = self._slices[i] = self._device[a:b]
+        return s
+
+    def _fetch(self, i: int) -> np.ndarray:
+        with self._lock:
+            buf = self._host.get(i)
+            if buf is None:
+                if self.on_fetch is not None:
+                    self.on_fetch(i, len(self._plan))
+                buf = self._host[i] = np.asarray(self._dev_slice(i))
+        return buf
+
+    def _prefetch(self, i: int) -> None:
+        """Kick bucket i's device→host copy without blocking, so it runs
+        under the previous bucket's encode/transport."""
+        if i >= len(self._plan) or i in self._host:
+            return
+        start_copy = getattr(self._dev_slice(i), "copy_to_host_async", None)
+        if start_copy is not None:
+            with _DISPATCH_LOCK:
+                start_copy()
+
+    def __iter__(self) -> Iterator[tuple[str, np.ndarray]]:
+        for i, (start, _end, entries) in enumerate(self._plan):
+            self._prefetch(i + 1)
+            buf = self._fetch(i)
+            for name, off, size, shape, _dtype in entries:
+                a = 1 + off - start
+                yield name, buf[a:a + size].reshape(shape)
 
 
 class Trainer:
@@ -148,12 +252,34 @@ class Trainer:
             return jax.device_put(x, self._batch_sharded)
         return jax.tree.map(put, batch)
 
+    _pack_bufs: list[np.ndarray] | None = None
+    _pack_turn = 0
+
     def _pack(self, params: Mapping[str, np.ndarray]) -> np.ndarray:
-        flat = np.zeros(self._padded_in, np.float32)
+        # Persistent DOUBLE buffer instead of a fresh np.zeros every
+        # iteration: the padded tail stays zero from allocation and every
+        # layout slot is overwritten per call, so reuse is exact.  Two
+        # buffers alternate because the CPU PJRT client may ZERO-COPY a
+        # device_put numpy array (the device buffer aliases it): the
+        # buffer written this iteration must not be the one the previous
+        # iteration's upload may still alias.
+        if self._pack_bufs is None:
+            self._pack_bufs = [np.zeros(self._padded_in, np.float32)
+                               for _ in range(2)]
+        flat = self._pack_bufs[self._pack_turn]
+        self._pack_turn ^= 1
         for name, off, size, _shape, _dtype in self._layout:
             flat[off:off + size] = np.asarray(
                 params[name], np.float32).ravel()
         return flat
+
+    def _dispatch_step(self, params: Mapping[str, np.ndarray], batch):
+        """Pack + upload + launch the jitted step; returns the (async)
+        flat device output without fetching it."""
+        packed = self._pack(params)
+        with _DISPATCH_LOCK:
+            flat = jax.device_put(packed, self._flat_sharding)
+            return self._step(flat, self._shard_batch(batch))
 
     def compute_gradients(self, params: Mapping[str, np.ndarray],
                           batch) -> tuple[TensorStore, float]:
@@ -161,9 +287,24 @@ class Trainer:
 
         One H2D upload (packed params), one D2H fetch (loss + packed
         grads), regardless of tensor count."""
-        flat = jax.device_put(self._pack(params), self._flat_sharding)
-        packed = np.asarray(self._step(flat, self._shard_batch(batch)))
+        packed = np.asarray(self._dispatch_step(params, batch))
         loss = float(packed[0])
         grads = {name: packed[1 + off:1 + off + size].reshape(shape)
                  for name, off, size, shape, _dtype in self._layout}
         return grads, loss
+
+    def compute_gradient_buckets(self, params: Mapping[str, np.ndarray],
+                                 batch, bucket_bytes: int | None = None,
+                                 on_fetch=None) -> GradientBuckets:
+        """Incremental-D2H variant of :meth:`compute_gradients`: same jitted
+        step, but the packed gradient buffer stays on device and comes back
+        host-side in ~``bucket_bytes`` slices fetched lazily as the
+        returned :class:`GradientBuckets` is iterated — the producer side
+        of the pipelined push (worker/worker.py).  Default bucket budget:
+        rpc/data_plane.bucket_bytes()."""
+        if bucket_bytes is None:
+            from ..rpc.data_plane import bucket_bytes as _bb
+            bucket_bytes = _bb()
+        return GradientBuckets(self._layout,
+                               self._dispatch_step(params, batch),
+                               bucket_bytes, on_fetch=on_fetch)

@@ -10,7 +10,16 @@ include/worker.h:25-33).  Protocol behavior preserved:
 - heartbeat thread every 5 s reporting WorkerStatus
   (reference: src/worker.cpp:231-238)
 - run_iteration: pull -> compute -> push -> poll sync status every 50 ms up
-  to 200 polls, 3 outer retries (reference: src/worker.cpp:331-406)
+  to 200 polls, 3 outer retries (reference: src/worker.cpp:331-406).
+  Against a framework PS the whole communication tail collapses into ONE
+  fused ``PushPullStream`` round (push + barrier + pull — the server
+  answers the instant aggregation completes instead of being polled), the
+  gradients stream out in lazily-D2H-fetched buckets
+  (trainer.GradientBuckets), the returned parameters are cached for the
+  next iteration's "pull", and the next batch prefetches during
+  communication.  All of it degrades to the reference-shaped serial
+  protocol against a reference PS (per-connection UNIMPLEMENTED fallback,
+  rpc/data_plane.py).
 - `reconnect()` re-runs discovery+registration (reference: src/worker.cpp:124-127)
 - checkpoint restore request at startup (reference: src/worker.cpp:289-314)
 
@@ -25,6 +34,7 @@ Departures:
 
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import os
 import socket
@@ -75,15 +85,21 @@ class Worker:
             metrics_path and metrics_path.replace("%d", str(config.worker_id)))
         self.step_timer = StepTimer()
         # step-phase breakdown + retry accounting (obs registry; snapshots
-        # ride heartbeats to the coordinator — obs/export.py)
+        # ride heartbeats to the coordinator — obs/export.py).  "fused" is
+        # the single push→barrier→pull round of the pipelined data plane.
         self._obs_phase = {name: obs_stats.histogram(f"worker.{name}_s")
                            for name in ("step", "data", "pull", "compute",
-                                        "push", "barrier_wait")}
+                                        "push", "fused", "barrier_wait")}
         self._obs_retries = obs_stats.counter("rpc.client.retries")
-        # uncompressed f32 size of pushed gradients: the denominator of
-        # the wire-compression ratio in the status rollup
+        # uncompressed f32 size of pushed gradients — the NUMERATOR of the
+        # wire-compression ratio in the status rollup ...
         self._obs_push_payload = obs_stats.counter(
             "rpc.client.push.payload_bytes")
+        # ... and the matching denominator: the bytes those tensors
+        # actually encode to on the wire (int8/topk shrink it), counted
+        # uniformly across the unary/stream/fused push paths
+        self._obs_push_wire = obs_stats.counter(
+            "rpc.client.push.wire_bytes")
         self._coordinator = RpcClient(config.coordinator_address,
                                       m.COORDINATOR_SERVICE, m.COORDINATOR_METHODS)
         self._ps: RpcClient | None = None
@@ -92,6 +108,16 @@ class Worker:
         self._requested_wire_dtype = m.WIRE_DTYPE_NAMES[config.wire_dtype]
         self._reset_wire_negotiation()
         self.last_bootstrap = False  # True iff the last iteration seeded the PS
+        # Parameters delivered by the previous iteration's fused round —
+        # they ARE what a pull at the next iteration would return, so the
+        # next step skips its pull entirely.
+        self._next_params: TensorStore | None = None
+        # single-slot batch prefetch: next(self.batches) runs on this
+        # thread while the worker is blocked in communication
+        self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1,
+            thread_name_prefix=f"worker-{config.worker_id}-prefetch")
+        self._prefetched: concurrent.futures.Future | None = None
         self._stop = threading.Event()
         self._heartbeat_thread: threading.Thread | None = None
         if start_heartbeat:
@@ -119,6 +145,7 @@ class Worker:
         # runs would leave the coordinator's rollup missing the tail
         # since the last periodic beat (obs/export.py piggyback)
         self.send_heartbeat()
+        self._prefetch_pool.shutdown(wait=False)
         self._coordinator.close()
         if self._ps is not None:
             self._ps.close()
@@ -145,6 +172,7 @@ class Worker:
             log.info("worker %d: PS at %s", self.config.worker_id,
                      self._ps_address)
         self._reset_wire_negotiation()  # a new PS must re-prove packed support
+        self._next_params = None  # cached params were the OLD PS's
 
     def _reset_wire_negotiation(self) -> None:
         """Packed pushes start only after the connected PS proves it honors
@@ -262,8 +290,15 @@ class Worker:
             return resp, local
 
         resp, store = self.query_with_retry(attempt)
-        if not self._peer_packed_ok and resp.parameters:
-            if any(t.packed_dtype != m.WIRE_F32 for t in resp.parameters):
+        self._note_pull_tensors(resp.parameters)
+        return resp.iteration, store
+
+    def _note_pull_tensors(self, parameters) -> None:
+        """Feed one pull response's tensor metadata into the packed-wire
+        negotiation.  Called on every path that receives served parameters
+        (unary/streamed pull AND the fused push-pull round)."""
+        if not self._peer_packed_ok and parameters:
+            if any(t.packed_dtype != m.WIRE_F32 for t in parameters):
                 self._peer_packed_ok = True
             else:
                 # Server ignored the extension (reference PS): stay on the
@@ -285,13 +320,12 @@ class Worker:
             # quantized) or a non-empty pull served entirely unpacked (a
             # replacement PS that ignores the extension would silently see
             # empty gradients in our packed pushes).
-            if not resp.parameters or all(
-                    t.packed_dtype == m.WIRE_F32 for t in resp.parameters):
+            if not parameters or all(
+                    t.packed_dtype == m.WIRE_F32 for t in parameters):
                 log.warning(
                     "worker %d: pull no longer packed (PS restart?), "
                     "re-negotiating wire encoding", self.config.worker_id)
                 self._reset_wire_negotiation()
-        return resp.iteration, store
 
     def push_gradients(self, iteration: int, grads: TensorStore) -> m.PushResponse:
         """reference: src/worker.cpp:254-272."""
@@ -311,6 +345,9 @@ class Worker:
                 grads, push_dtype)
         else:
             tensors = to_wire(grads, push_dtype)
+        # actual wire footprint of the payloads (packed encodings shrink
+        # it) so the --metrics compression ratio is truthful
+        self._obs_push_wire.add(sum(t.encoded_size() for t in tensors))
         update = m.GradientUpdate(worker_id=self.config.worker_id,
                                   iteration=iteration, gradients=tensors)
         resp = self.query_with_retry(
@@ -341,6 +378,106 @@ class Worker:
         residual = {t.name: adjusted[t.name] - t.to_array() for t in tensors}
         return tensors, residual
 
+    # -------------------------------------------------------- fused data plane
+    def _use_fused(self) -> bool:
+        return (self.config.fused_step and self._ps is not None
+                and hasattr(self._ps, "push_pull"))
+
+    def _wire_tensors(self, grads):
+        """Lazy wire-tensor producer for the fused push.
+
+        ``grads``: a mapping OR a lazy ``(name, array)`` iterable
+        (trainer.GradientBuckets — each re-iteration replays from its
+        host-side cache).  Returns ``(tensors_fn, residual_box)``:
+        ``tensors_fn()`` yields wire tensors one by one — compression +
+        error-feedback adjustment happen per tensor AS the RPC sender
+        consumes it, so D2H fetch ⊕ compress ⊕ encode ⊕ transport
+        pipeline per bucket.  ``residual_box`` (non-None under int8/topk)
+        fills with the new error-feedback residual; the caller commits it
+        only after the PS accepts the push."""
+        push_dtype = self._wire_dtype if self._peer_packed_ok else m.WIRE_F32
+        compress = push_dtype in (m.WIRE_INT8, m.WIRE_TOPK)
+        residual_box: dict[str, np.ndarray] | None = {} if compress else None
+
+        def tensors():
+            if residual_box is not None:
+                residual_box.clear()  # a retry replays from scratch
+            payload = wire = 0
+            pairs = grads.items() if hasattr(grads, "items") else grads
+            for name, g in pairs:
+                g = np.asarray(g, np.float32)
+                payload += 4 * g.size
+                if compress:
+                    prev = self._ef_residual.get(name)
+                    adjusted = g + prev if prev is not None else g
+                    t = m.Tensor.from_array(
+                        name, adjusted, wire_dtype=push_dtype,
+                        topk_density=self.config.topk_density)
+                    # what the PS did NOT see carries into the next push
+                    residual_box[name] = adjusted - t.to_array()
+                else:
+                    t = m.Tensor.from_array(name, g, wire_dtype=push_dtype)
+                wire += t.encoded_size()
+                yield t
+            self._obs_push_payload.add(payload)
+            self._obs_push_wire.add(wire)
+
+        return tensors, residual_box
+
+    def _fused_push_pull(self, iteration: int,
+                         grads) -> tuple[m.PushResponse, TensorStore | None]:
+        """One fused push→barrier→pull round.  Returns the push verdict
+        plus the fresh post-aggregation parameter store, or ``None`` for
+        the store when the fused round did not deliver one (reference
+        server, server-side barrier timeout) — the caller then falls back
+        to the serial barrier-poll + pull."""
+        tensors_fn, residual_box = self._wire_tensors(grads)
+
+        def attempt():
+            # fresh store per attempt, same rationale as _pull_parameters
+            local: TensorStore = {}
+
+            def convert_chunk(chunk_tensors) -> None:
+                local.update(from_wire(chunk_tensors))
+
+            push, params = self._ps.push_pull(
+                self.config.worker_id, iteration, tensors_fn,
+                pull_wire_dtype=self._pull_wire_dtype(),
+                timeout=self.config.fused_timeout_s,
+                on_chunk=convert_chunk)
+            return push, params, local
+
+        t0 = time.perf_counter()
+        with obs_trace.span("worker/fused", iteration=iteration):
+            push, params, store = self.query_with_retry(attempt)
+        self._obs_phase["fused"].observe(time.perf_counter() - t0)
+        if residual_box is not None and push.success:
+            self._ef_residual = dict(residual_box)
+        if params is None:
+            return push, None
+        self._note_pull_tensors(params.parameters)
+        return push, store
+
+    # ---------------------------------------------------------- batch stream
+    def _next_batch(self):
+        """The prefetched batch when one is ready, else a synchronous
+        ``next()`` on the loader."""
+        if self._prefetched is not None:
+            fut, self._prefetched = self._prefetched, None
+            return fut.result()
+        return next(self.batches)
+
+    def _start_batch_prefetch(self) -> None:
+        """Kick ``next(self.batches)`` on the prefetch thread so data
+        loading runs under the step's communication phase.  Single-slot:
+        the iterator is only ever advanced by one party at a time."""
+        if self._prefetched is None and not self._stop.is_set():
+            try:
+                self._prefetched = self._prefetch_pool.submit(
+                    next, self.batches)
+            except RuntimeError:  # pool shut down mid-run
+                self._prefetched = None
+
     def check_sync_ready(self, iteration: int) -> m.SyncStatusResponse:
         """reference: src/worker.cpp:274-287."""
         return self.query_with_retry(
@@ -358,10 +495,47 @@ class Worker:
             self._expected_names = frozenset(self.trainer.init_params(seed=0))
         return self._expected_names
 
+    def _seed_bootstrap(self, iteration: int, missing) -> float:
+        """PS store empty (or, under the sharded topology, one shard
+        restarted empty — the merged pull is then PARTIAL): every worker
+        pushes the same deterministic init for the missing names; the PS
+        bootstrap rule (first aggregated payload *becomes* the parameters
+        — reference src/parameter_server.cpp:78-81) then lands exactly
+        the init on the empty shard(s).  Replaces the reference's dummy
+        10x10 fallback (src/worker.cpp:346-353).  Rides the plain push
+        path deliberately: the fused data plane refuses to seed an empty
+        store (server/ps_service.py PushPullStream)."""
+        init = self.trainer.init_params(seed=0)
+        if missing:
+            # a replacement shard must also re-prove packed support
+            # before quantized pushes resume
+            self._reset_wire_negotiation()
+            init = {name: init[name] for name in missing}
+            log.warning(
+                "worker %d: pull missing %d tensors (shard "
+                "restart?), re-seeding deterministic init",
+                self.config.worker_id, len(missing))
+        else:
+            log.info("worker %d: PS empty, pushing deterministic init",
+                     self.config.worker_id)
+        push = self.push_gradients(iteration, init)
+        if not push.success:
+            raise WorkerError(f"bootstrap push rejected: {push.message}")
+        if not push.aggregation_complete:
+            self._await_barrier(iteration)
+        self.iteration = iteration
+        self.last_bootstrap = True
+        return float("nan")
+
     # ------------------------------------------------------------ train loop
     def run_iteration(self, iteration: int) -> float:
-        """One pull -> compute -> push -> barrier cycle
-        (reference: src/worker.cpp:331-406).  Returns the loss."""
+        """One synchronous training step (reference: src/worker.cpp:331-406
+        is pull -> compute -> push -> 50 ms barrier polls).  Returns the
+        loss.  Against a framework PS the communication tail is ONE fused
+        PushPullStream round whose response both closes the barrier and
+        delivers the next iteration's parameters (cached, so the next
+        step's pull is free); against a reference PS every leg degrades to
+        the serial unary protocol."""
         self.status = m.WorkerStatus.TRAINING
         self.step_timer.__enter__()
         self.last_bootstrap = False
@@ -373,52 +547,45 @@ class Worker:
                                    worker=self.config.worker_id)
         step_span.__enter__()
         try:
-            _, params = self.pull_parameters(iteration)
+            params, self._next_params = self._next_params, None
+            if params is None:
+                _, params = self.pull_parameters(iteration)
             missing = (self._expected_param_names() - set(params)
                        if params else set())
             if not params or missing:
-                # PS store empty (or, under the sharded topology, one shard
-                # restarted empty — the merged pull is then PARTIAL): every
-                # worker pushes the same deterministic init for the missing
-                # names; the PS bootstrap rule (first aggregated payload
-                # *becomes* the parameters — reference
-                # src/parameter_server.cpp:78-81) then lands exactly the
-                # init on the empty shard(s).  Replaces the reference's
-                # dummy 10x10 fallback (src/worker.cpp:346-353).
-                init = self.trainer.init_params(seed=0)
-                if missing:
-                    # a replacement shard must also re-prove packed support
-                    # before quantized pushes resume
-                    self._reset_wire_negotiation()
-                    init = {name: init[name] for name in missing}
-                    log.warning(
-                        "worker %d: pull missing %d tensors (shard "
-                        "restart?), re-seeding deterministic init",
-                        self.config.worker_id, len(missing))
-                else:
-                    log.info("worker %d: PS empty, pushing deterministic init",
-                             self.config.worker_id)
-                push = self.push_gradients(iteration, init)
-                if not push.success:
-                    raise WorkerError(f"bootstrap push rejected: {push.message}")
-                if not push.aggregation_complete:
-                    self._await_barrier(iteration)
-                self.iteration = iteration
-                self.last_bootstrap = True
-                return float("nan")
+                return self._seed_bootstrap(iteration, missing)
 
             effective_it = iteration
+            fused = self._use_fused()
+            incremental = fused and hasattr(self.trainer,
+                                            "compute_gradient_buckets")
+            fresh: TensorStore | None = None
             for attempt in range(3):
                 t0 = time.perf_counter()
-                batch = next(self.batches)
+                batch = self._next_batch()
                 t1 = time.perf_counter()
                 self._obs_phase["data"].observe(t1 - t0)
                 with obs_trace.span("worker/compute", iteration=effective_it):
-                    grads, loss = self.trainer.compute_gradients(params, batch)
+                    if incremental:
+                        # gradients stay on device; reading .loss blocks on
+                        # the jitted step (+ bucket 0's D2H) while the
+                        # remaining buckets fetch lazily INSIDE the fused
+                        # RPC, overlapping encode/transport per bucket
+                        grads = self.trainer.compute_gradient_buckets(
+                            params, batch)
+                        loss = grads.loss
+                    else:
+                        grads, loss = self.trainer.compute_gradients(params,
+                                                                     batch)
                 self._obs_phase["compute"].observe(time.perf_counter() - t1)
                 self.last_loss = loss
+                # the next batch loads while this thread blocks on the PS
+                self._start_batch_prefetch()
 
-                push = self.push_gradients(effective_it, grads)
+                if fused:
+                    push, fresh = self._fused_push_pull(effective_it, grads)
+                else:
+                    push = self.push_gradients(effective_it, grads)
                 if push.success:
                     break
                 if "stale" in push.message and attempt < 2:
@@ -432,8 +599,30 @@ class Worker:
                     effective_it = max(push.iteration, effective_it + 1)
                     _, params = self.pull_parameters(effective_it)
                     continue
+                if fused and "store empty" in push.message:
+                    # the PS (or one shard) restarted empty under our cached
+                    # params and refused to bootstrap from a fused gradient
+                    # push.  Re-pull to see what is actually missing: empty
+                    # or partial -> seed the deterministic init exactly like
+                    # a start-of-step detection; complete -> another worker
+                    # already re-seeded, retry with fresh params.
+                    log.warning(
+                        "worker %d: fused push refused (PS store empty — "
+                        "restart?), re-pulling to re-seed",
+                        self.config.worker_id)
+                    self._reset_wire_negotiation()
+                    _, params = self.pull_parameters(effective_it)
+                    missing = (self._expected_param_names() - set(params)
+                               if params else set())
+                    if not params or missing:
+                        return self._seed_bootstrap(effective_it, missing)
+                    if attempt < 2:
+                        continue
                 raise WorkerError(f"push rejected: {push.message}")
-            if not push.aggregation_complete:
+            if fresh is not None:
+                # the fused response IS the next iteration's pull
+                self._next_params = fresh
+            elif not push.aggregation_complete:
                 self._await_barrier(effective_it)
             self.iteration = effective_it
             return loss
@@ -457,6 +646,9 @@ class Worker:
                     time.perf_counter() - t0)
 
     def _await_barrier_inner(self, iteration: int) -> None:
+        # resp survives the poll loop: with sync_poll_max == 0 no poll ever
+        # runs and the progress report below must not blow up unbound
+        resp: m.SyncStatusResponse | None = None
         for outer in range(self.config.sync_outer_retries):
             for _ in range(self.config.sync_poll_max):
                 resp = self.check_sync_ready(iteration)
@@ -464,11 +656,18 @@ class Worker:
                     return
                 time.sleep(self.config.sync_poll_period_s)
             log.warning("worker %d: barrier timeout at iteration %d "
-                        "(%d/%d received), retry %d",
+                        "(%s), retry %d",
                         self.config.worker_id, iteration,
-                        resp.workers_received, resp.total_workers, outer + 1)
+                        self._barrier_progress(resp), outer + 1)
             time.sleep(0.5)
-        raise WorkerError(f"barrier never completed for iteration {iteration}")
+        raise WorkerError(f"barrier never completed for iteration "
+                          f"{iteration} ({self._barrier_progress(resp)})")
+
+    @staticmethod
+    def _barrier_progress(resp: m.SyncStatusResponse | None) -> str:
+        if resp is None:
+            return "no status polled"
+        return f"{resp.workers_received}/{resp.total_workers} received"
 
     def run(self, iterations: int | None = None) -> None:
         """Full training run (reference: src/worker_main.cpp:40-43)."""
@@ -493,6 +692,8 @@ class Worker:
                                       m.LoadCheckpointRequest(path=path),
                                       timeout=60.0))
             if resp.success:
+                # cached params predate the restore; force a real pull
+                self._next_params = None
                 log.info("worker %d: PS restored checkpoint %s (epoch %d)",
                          self.config.worker_id, path, resp.epoch)
             else:

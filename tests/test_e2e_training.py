@@ -191,6 +191,7 @@ def test_bf16_worker_falls_back_against_f32_only_ps(tmp_path):
     ps.service.ReceiveGradients = recording_recv
     ps.service.PushGradientsStream = unimplemented_stream
     ps.service.ServeParametersStream = unimplemented_stream
+    ps.service.PushPullStream = unimplemented_stream  # no fused plane either
     ps_port = ps.start()
     coordinator = Coordinator(CoordinatorConfig(
         bind_address="127.0.0.1", port=0,

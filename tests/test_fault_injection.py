@@ -141,10 +141,12 @@ def test_transient_rpc_failures_recovered_by_retry(tmp_path):
         loss = w.run_iteration(1)
         assert np.isfinite(loss)
         assert ps.core.current_iteration == 1
-        # the injection actually hit the pull and push paths (the worker's
-        # data plane rides the chunk-stream RPCs — rpc/data_plane.py)
+        # the injection actually hit the pull and fused push→barrier→pull
+        # paths (the worker's data plane rides the streaming RPCs —
+        # rpc/data_plane.py; the post-bootstrap pull is a plain stream
+        # pull, the step's communication is one fused round)
         assert fail_counts["ServeParametersStream"] == 2
-        assert fail_counts["PushGradientsStream"] == 2
+        assert fail_counts["PushPullStream"] == 2
     finally:
         if w is not None:
             w.shutdown()
@@ -312,6 +314,7 @@ def test_packed_wire_renegotiated_after_ps_replacement(tmp_path):
         ps2.service.ReceiveGradients = recording_recv
         ps2.service.PushGradientsStream = unimplemented_stream
         ps2.service.ServeParametersStream = unimplemented_stream
+        ps2.service.PushPullStream = unimplemented_stream
         ps2_port = ps2.start()
         ps2.ckpt.load(saved_path)
         coordinator.core.set_parameter_server_address("127.0.0.1", ps2_port)

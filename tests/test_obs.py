@@ -196,14 +196,17 @@ def test_step_trace_spans_one_trace_after_packed_renegotiation(
         tid = steps[0]["trace_id"]
         names_in_trace = {s["name"] for s in spans
                           if s["trace_id"] == tid}
-        assert {"worker/step", "worker/pull", "worker/push",
+        # steady state rides the fused data plane: the step's whole
+        # communication is one worker/fused span, and the PS-side apply
+        # still joins the worker's trace (context rides every chunk)
+        assert {"worker/step", "worker/fused",
                 "worker/compute", "ps/apply"} <= names_in_trace, \
             names_in_trace
         # and the Chrome-trace export keeps the correlation in args
         path = obs_trace.export_chrome_trace(str(tmp_path / "step.json"))
         with open(path) as fh:
             events = json.load(fh)["traceEvents"]
-        assert {"worker/push", "ps/apply"} <= {
+        assert {"worker/fused", "ps/apply"} <= {
             e["name"] for e in events if e["args"]["trace_id"] == tid}
         # heartbeat piggyback: the coordinator aggregates this worker
         assert w.send_heartbeat()

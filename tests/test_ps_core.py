@@ -458,3 +458,89 @@ def test_async_concurrent_push_pull_serves_consistent_snapshots():
     stop.set()
     assert not errors, errors[:5]
     assert ps.applied_updates >= 200  # the pusher made real progress
+
+
+# ------------------------------------------------- fused barrier wait (CV)
+def test_wait_for_aggregation_wakes_on_barrier_close():
+    """A waiter parked on an incomplete iteration is released by the push
+    that closes the barrier — the serve-when-complete primitive of the
+    fused data plane (no polling)."""
+    import threading
+    import time
+
+    ps = ParameterServerCore(total_workers=2)
+    ps.initialize_parameters(store(w=[10.0]))
+    ps.receive_gradients(0, 1, store(w=[2.0]))
+    out = {}
+
+    def wait():
+        out["result"] = ps.wait_for_aggregation(1, timeout=30.0)
+
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.05)          # waiter parks before the closing push
+    t0 = time.perf_counter()
+    ps.receive_gradients(1, 1, store(w=[4.0]))
+    t.join(timeout=5.0)
+    woke_in = time.perf_counter() - t0
+    assert not t.is_alive()
+    ready, received, total = out["result"]
+    assert ready and received == 2 and total == 2
+    # woken by notify, not by a poll cadence: well under the 250 ms
+    # heartbeat re-check, let alone the reference's 50 ms poll loop
+    assert woke_in < 0.2
+    np.testing.assert_allclose(ps.get_parameters()["w"], [7.0])
+
+
+def test_wait_for_aggregation_already_complete_and_gcd():
+    ps = ParameterServerCore(total_workers=1, gc_iterations=2)
+    ps.initialize_parameters(store(w=[1.0]))
+    for it in range(1, 6):
+        ps.receive_gradients(0, it, store(w=[0.0]))
+    # a long-GC'd iteration still reads as complete via the watermark
+    ready, received, total = ps.wait_for_aggregation(1, timeout=0.0)
+    assert ready and received == total == 1
+    ready, _, _ = ps.wait_for_aggregation(5, timeout=0.0)
+    assert ready
+
+
+def test_wait_for_aggregation_times_out_with_progress():
+    ps = ParameterServerCore(total_workers=3)
+    ps.initialize_parameters(store(w=[1.0]))
+    ps.receive_gradients(0, 1, store(w=[0.5]))
+    ready, received, total = ps.wait_for_aggregation(1, timeout=0.05)
+    assert not ready and received == 1 and total == 3
+
+
+def test_wait_for_aggregation_async_mode_immediate():
+    ps = ParameterServerCore(total_workers=4, staleness_bound=3)
+    ready, _, _ = ps.wait_for_aggregation(7, timeout=0.0)
+    assert ready
+
+
+def test_wait_for_aggregation_releases_on_elastic_shrink():
+    """A fully-buffered iteration must fire from INSIDE the wait when the
+    elastic barrier width shrinks (worker evicted mid-iteration) — the CV
+    wait re-evaluates the width on its heartbeat, like the polled path."""
+    import threading
+    import time
+
+    width = {"n": 2}
+    ps = ParameterServerCore(total_workers=2,
+                             live_workers_fn=lambda: width["n"])
+    ps.initialize_parameters(store(w=[10.0]))
+    ps.receive_gradients(0, 1, store(w=[2.0]))
+    out = {}
+
+    def wait():
+        out["result"] = ps.wait_for_aggregation(1, timeout=10.0)
+
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.05)
+    width["n"] = 1            # eviction: the lone contributor satisfies it
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    ready, received, total = out["result"]
+    assert ready and received == 1 and total == 1
+    np.testing.assert_allclose(ps.get_parameters()["w"], [8.0])

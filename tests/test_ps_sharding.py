@@ -243,3 +243,100 @@ def test_async_partial_stale_retries_only_failed_shard(tmp_path):
         direct1.close()
         ps0.stop()
         ps1.stop()
+
+
+def _unary_only_ps(tmp_path, name, total_workers=2):
+    """A reference-shaped PS process: the 5 unary RPCs ONLY (no chunk
+    streams, no fused PushPullStream) — every extension method answers
+    UNIMPLEMENTED, exactly like a reference server."""
+    from parameter_server_distributed_tpu.checkpoint.manager import (
+        CheckpointManager)
+    from parameter_server_distributed_tpu.core.ps_core import (
+        ParameterServerCore)
+    from parameter_server_distributed_tpu.rpc.service import (bind_service,
+                                                              make_server)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServerService)
+
+    from parameter_server_distributed_tpu.core.optimizer import SGD
+
+    core = ParameterServerCore(total_workers=total_workers,
+                               optimizer=SGD(learning_rate=0.05))
+    service = ParameterServerService(
+        core, CheckpointManager(core, directory=str(tmp_path / name),
+                                checkpoint_interval=100,
+                                check_period_s=600.0))
+    server = make_server()
+    bind_service(server, m.PARAMETER_SERVER_SERVICE,
+                 m.PARAMETER_SERVER_METHODS, service)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    return core, (lambda: server.stop(0)), port
+
+
+def _framework_ps(tmp_path, name, total_workers=2):
+    ps = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=total_workers,
+        checkpoint_dir=str(tmp_path / name), learning_rate=0.05,
+        autosave_period_s=600.0))
+    port = ps.start()
+    return ps.core, ps.stop, port
+
+
+def test_fused_degrades_to_unary_per_shard_with_identical_results(tmp_path):
+    """Fallback matrix, sharded topology: every shard is reference-shaped
+    (unary only), so the fused fan-out degrades per shard to unary
+    push/poll/pull — and training lands the SAME parameters as against
+    full framework shards given identical seeds (the degradation changes
+    transport, never math)."""
+    import threading
+
+    def run_cluster(make_shard, tag):
+        shards = [make_shard(tmp_path, f"{tag}{n}") for n in range(2)]
+        coordinator = Coordinator(CoordinatorConfig(
+            bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+            ps_port=shards[0][2],
+            ps_shards=(f"127.0.0.1:{shards[1][2]}",),
+            reap_period_s=600.0))
+        coord_port = coordinator.start()
+        workers = [build_worker(WorkerConfig(
+            coordinator_address=f"127.0.0.1:{coord_port}", worker_id=i,
+            address="127.0.0.1", port=15260 + i, model="mnist_mlp",
+            batch_size=32, heartbeat_period_s=600.0)) for i in range(2)]
+        try:
+            for w in workers:
+                w.initialize()
+            errors = []
+
+            def run(w):
+                try:
+                    for it in range(3):
+                        w.run_iteration(it)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=run, args=(w,))
+                       for w in workers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors
+            merged = {}
+            for core, _stop, _port in shards:
+                merged.update(core.get_parameters())
+            return merged
+        finally:
+            for w in workers:
+                w.shutdown()
+            coordinator.stop()
+            for _core, stop, _port in shards:
+                stop()
+
+    degraded = run_cluster(_unary_only_ps, "u")
+    full = run_cluster(_framework_ps, "f")
+    assert degraded and set(degraded) == set(full)
+    for name in sorted(full):
+        np.testing.assert_allclose(degraded[name], full[name],
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=name)

@@ -282,3 +282,361 @@ def test_load_checkpoint_omits_echo_for_large_store(ps, monkeypatch):
         pull = client.call("ServeParameters", m.PullRequest(worker_id=0))
         np.testing.assert_allclose(pull.parameters[0].to_array(),
                                    np.arange(64, dtype=np.float32))
+
+
+# ------------------------------------------------------------------- fused
+# Pipelined data plane (rpc/data_plane.py PushPullStream): one RPC round
+# per synchronous step instead of push + barrier polls + pull.
+
+def test_fused_push_pull_matches_unary_protocol(ps):
+    """The fused round must land exactly the state the serial protocol
+    lands: same aggregation, same served parameters."""
+    import threading
+
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+
+    server, port = ps
+    w0 = np.linspace(-1, 1, 512).astype(np.float32)
+    server.core.initialize_parameters({"w": w0})
+    grads = [m.Tensor.from_array("w", np.full_like(w0, 0.25))]
+    results = {}
+
+    def worker(wid):
+        with PSClient(f"127.0.0.1:{port}") as client:
+            results[wid] = client.push_pull(wid, 1, grads)
+
+    threads = [threading.Thread(target=worker, args=(wid,))
+               for wid in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for wid in (0, 1):
+        push, params = results[wid]
+        assert push.success
+        assert params is not None and params.ready
+        np.testing.assert_allclose(params.parameters[0].to_array(),
+                                   w0 - 0.25, rtol=1e-6)
+    # exactly what a serial pull now sees as well
+    with ps_client(port) as plain:
+        after = plain.call("ServeParameters", m.PullRequest(worker_id=0))
+        np.testing.assert_allclose(after.parameters[0].to_array(),
+                                   w0 - 0.25, rtol=1e-6)
+
+
+def test_fused_falls_back_against_unary_only_server(tmp_path):
+    """A reference-shaped server (5 unary RPCs only) answers UNIMPLEMENTED
+    for PushPullStream: push_pull must degrade to the unary push (params
+    None — the caller barrier-polls and pulls) and remember per
+    connection."""
+    from parameter_server_distributed_tpu.checkpoint.manager import CheckpointManager
+    from parameter_server_distributed_tpu.core.ps_core import ParameterServerCore
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+    from parameter_server_distributed_tpu.rpc.service import (bind_service,
+                                                              make_server)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServerService)
+
+    core = ParameterServerCore(total_workers=1)
+    core.initialize_parameters({"w": np.array([1.0, 2.0], np.float32)})
+    service = ParameterServerService(
+        core, CheckpointManager(core, directory=str(tmp_path),
+                                checkpoint_interval=100, check_period_s=600.0))
+    server = make_server()
+    bind_service(server, m.PARAMETER_SERVER_SERVICE,
+                 m.PARAMETER_SERVER_METHODS, service)  # unary only
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        with PSClient(f"127.0.0.1:{port}") as client:
+            grads = [m.Tensor.from_array(
+                "w", np.array([0.5, 0.5], np.float32))]
+            push, params = client.push_pull(0, 1, grads)
+            assert push.success and push.aggregation_complete
+            assert params is None            # caller must poll + pull
+            assert client._fused_ok is False  # remembered
+            assert client._stream_ok is False
+            np.testing.assert_allclose(core.get_parameters()["w"],
+                                       [0.5, 1.5])
+            # second call goes straight to the fallback (no re-probe)
+            push, params = client.push_pull(0, 2, grads)
+            assert push.success and params is None
+            pulled = client.pull_parameters(m.PullRequest(worker_id=0))
+            np.testing.assert_allclose(pulled.parameters[0].to_array(),
+                                       [0.0, 1.0])
+    finally:
+        server.stop(0)
+
+
+def test_fused_push_refused_on_empty_store(ps):
+    """A fused push must never bootstrap an empty store (the gradient
+    payload would silently BECOME the parameters); the server refuses and
+    the worker's recovery re-seeds via the plain push path."""
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+
+    _, port = ps
+    with PSClient(f"127.0.0.1:{port}") as client:
+        grads = [m.Tensor.from_array("w", np.array([0.5], np.float32))]
+        push, params = client.push_pull(0, 1, grads)
+        assert not push.success and params is None
+        assert "store empty" in push.message
+        assert client._fused_ok is True  # implemented, just refused
+
+
+def test_fused_lazy_tensor_factory_replayed_on_fallback(tmp_path):
+    """With a CALLABLE tensor producer, the unary fallback re-invokes it
+    (a half-consumed generator cannot be replayed): the pushed payload is
+    identical either way."""
+    from parameter_server_distributed_tpu.checkpoint.manager import CheckpointManager
+    from parameter_server_distributed_tpu.core.ps_core import ParameterServerCore
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+    from parameter_server_distributed_tpu.rpc.service import (bind_service,
+                                                              make_server)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServerService)
+
+    core = ParameterServerCore(total_workers=1)
+    core.initialize_parameters({"w": np.array([4.0], np.float32)})
+    service = ParameterServerService(
+        core, CheckpointManager(core, directory=str(tmp_path),
+                                checkpoint_interval=100, check_period_s=600.0))
+    server = make_server()
+    bind_service(server, m.PARAMETER_SERVER_SERVICE,
+                 m.PARAMETER_SERVER_METHODS, service)  # unary only
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    calls = []
+
+    def tensors():
+        calls.append(1)
+        yield m.Tensor.from_array("w", np.array([1.0], np.float32))
+
+    try:
+        with PSClient(f"127.0.0.1:{port}") as client:
+            push, params = client.push_pull(0, 1, tensors)
+            assert push.success and params is None
+            # the factory ran at least twice: fused attempt + fallback
+            assert len(calls) >= 2
+            np.testing.assert_allclose(core.get_parameters()["w"], [3.0])
+    finally:
+        server.stop(0)
+
+
+def _steady_worker_cluster(tmp_path, n_workers, relay_cfg=None, **worker_kw):
+    """Coordinator + PS (+ optional ThrottledRelay in front) + N workers,
+    driven past bootstrap so the next run_iteration is a steady-state
+    step.  Returns (ps, coordinator, workers, relay, stop)."""
+    import threading
+
+    from parameter_server_distributed_tpu.cli.worker_main import build_worker
+    from parameter_server_distributed_tpu.config import (CoordinatorConfig,
+                                                         WorkerConfig)
+    from parameter_server_distributed_tpu.server.coordinator_service import (
+        Coordinator)
+    from parameter_server_distributed_tpu.utils.netsim import ThrottledRelay
+
+    ps = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=n_workers,
+        checkpoint_interval=100, checkpoint_dir=str(tmp_path),
+        learning_rate=0.05, autosave_period_s=600.0))
+    ps_port = ps.start()
+    relay = None
+    if relay_cfg is not None:
+        relay = ThrottledRelay(ps_port, **relay_cfg)
+        ps_port = relay.start()
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+        ps_port=ps_port, reap_period_s=600.0))
+    coord_port = coordinator.start()
+    workers = []
+    for wid in range(n_workers):
+        w = build_worker(WorkerConfig(
+            coordinator_address=f"127.0.0.1:{coord_port}", worker_id=wid,
+            address="127.0.0.1", port=51500 + wid, batch_size=16,
+            model="mnist_mlp", heartbeat_period_s=600.0, **worker_kw))
+        w.initialize()
+        workers.append(w)
+
+    def run_step(it):
+        errors = []
+
+        def loop(w):
+            try:
+                w.run_iteration(it)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=loop, args=(w,))
+                   for w in workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        assert not errors, errors
+        return errors
+
+    def stop():
+        for w in workers:
+            w.shutdown()
+        coordinator.stop()
+        if relay is not None:
+            relay.stop()
+        ps.stop()
+
+    return ps, coordinator, workers, relay, run_step, stop
+
+
+def _data_plane_counters():
+    from parameter_server_distributed_tpu.obs import stats as obs_stats
+
+    snap = obs_stats.REGISTRY.snapshot()["counters"]
+    return {method: snap.get(f"rpc.client.{method}.calls", 0)
+            for method in ("PushPullStream", "PushGradientsStream",
+                           "ReceiveGradients", "ServeParameters",
+                           "ServeParametersStream", "CheckSyncStatus")}
+
+
+def test_fused_step_is_single_rpc_round(tmp_path):
+    """Acceptance: a steady-state synchronous step issues EXACTLY one
+    data-plane round (PushPullStream) per worker, where the serial path
+    issues >= 3 (push + >= 1 sync poll + pull)."""
+    _, _, _, _, run_step, stop = _steady_worker_cluster(
+        tmp_path / "fused", n_workers=2)
+    try:
+        run_step(0)   # bootstrap seed
+        run_step(1)   # warm-up: first real step (does the initial pull)
+        before = _data_plane_counters()
+        run_step(2)   # steady state
+        after = _data_plane_counters()
+        delta = {k: after[k] - before[k] for k in after}
+        assert delta["PushPullStream"] == 2, delta  # one round per worker
+        for method in ("PushGradientsStream", "ReceiveGradients",
+                       "ServeParameters", "ServeParametersStream",
+                       "CheckSyncStatus"):
+            assert delta[method] == 0, delta
+    finally:
+        stop()
+
+    # the serial protocol, same shape: push + pull per worker plus the
+    # first pusher's >=1 barrier poll
+    _, _, _, _, run_step, stop = _steady_worker_cluster(
+        tmp_path / "serial", n_workers=2, fused_step=False)
+    try:
+        run_step(0)
+        run_step(1)
+        before = _data_plane_counters()
+        run_step(2)
+        after = _data_plane_counters()
+        delta = {k: after[k] - before[k] for k in after}
+        assert delta["PushPullStream"] == 0, delta
+        pushes = delta["PushGradientsStream"] + delta["ReceiveGradients"]
+        pulls = delta["ServeParameters"] + delta["ServeParametersStream"]
+        assert pushes == 2 and pulls == 2, delta
+        assert delta["CheckSyncStatus"] >= 1, delta  # >=3 rounds somewhere
+    finally:
+        stop()
+
+
+def test_fused_step_pipelines_d2h_with_transport(tmp_path):
+    """Acceptance: at least one gradient chunk is ON THE WIRE (relay byte
+    counter) before the LAST D2H bucket is fetched — i.e. D2H, encode and
+    transport overlap instead of serializing whole-store."""
+    import os
+
+    os.environ["PSDT_STREAM_CHUNK_BYTES"] = "16384"
+    os.environ["PSDT_BUCKET_BYTES"] = "16384"
+    try:
+        _, _, workers, relay, run_step, stop = _steady_worker_cluster(
+            tmp_path, n_workers=1, relay_cfg={"delay_ms": 0.0, "mbps": 0.0})
+        worker = workers[0]
+        observed = {}
+        trainer = worker.trainer
+        orig = trainer.compute_gradient_buckets
+
+        def instrumented(params, batch, bucket_bytes=None, on_fetch=None):
+            def record(i, n):
+                if i == 0:
+                    relay.reset_byte_counts()
+                    observed["buckets"] = n
+                elif i == n - 1:
+                    # wait (bounded) for wire evidence: under pipelining,
+                    # earlier buckets' chunks are already in flight; a
+                    # serial fetch-everything-first implementation reaches
+                    # this fetch before the RPC even starts and times out
+                    import time
+                    deadline = time.monotonic() + 15.0
+                    while time.monotonic() < deadline:
+                        sent = relay.byte_counts()[0]
+                        if sent > 0:
+                            observed["wire_bytes_at_last_fetch"] = sent
+                            return
+                        time.sleep(0.005)
+                    observed["wire_bytes_at_last_fetch"] = 0
+
+            return orig(params, batch, bucket_bytes=bucket_bytes,
+                        on_fetch=record)
+
+        trainer.compute_gradient_buckets = instrumented
+        try:
+            run_step(0)   # bootstrap
+            run_step(1)   # steady fused step, instrumented
+            # mnist_mlp packs into a handful of tensors and a tensor never
+            # splits across buckets, so "several" is the right bar here
+            assert observed.get("buckets", 0) >= 2, observed
+            assert observed.get("wire_bytes_at_last_fetch", 0) > 0, (
+                "no gradient bytes on the wire before the last D2H bucket "
+                f"fetch: {observed}")
+        finally:
+            stop()
+    finally:
+        os.environ.pop("PSDT_STREAM_CHUNK_BYTES", None)
+        os.environ.pop("PSDT_BUCKET_BYTES", None)
+
+
+def test_fused_barrier_wider_than_default_thread_pool(tmp_path):
+    """Liveness: parked fused handlers hold server threads, so a barrier
+    WIDER than the old fixed 8-thread pool must still close promptly (the
+    server pool is sized from total_workers) — the closing worker's push
+    must never queue behind the parked handlers."""
+    import threading
+    import time
+
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+
+    n = 10
+    server = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=n,
+        checkpoint_interval=100, checkpoint_dir=str(tmp_path),
+        learning_rate=1.0, autosave_period_s=600.0))
+    port = server.start()
+    server.core.initialize_parameters(
+        {"w": np.array([1.0, 2.0], np.float32)})
+    results = {}
+
+    def worker(wid):
+        with PSClient(f"127.0.0.1:{port}") as client:
+            grads = [m.Tensor.from_array(
+                "w", np.array([float(wid), 1.0], np.float32))]
+            results[wid] = client.push_pull(wid, 1, grads)
+
+    try:
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(wid,))
+                   for wid in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        elapsed = time.perf_counter() - t0
+        assert all(not t.is_alive() for t in threads)
+        # well under the 60 s barrier timeout the starved pool would hit
+        assert elapsed < 20, f"barrier took {elapsed:.1f}s (pool starved?)"
+        expected = np.array([1.0, 2.0], np.float32) - [np.mean(range(n)), 1.0]
+        for wid in range(n):
+            push, params = results[wid]
+            assert push.success, push.message
+            assert params is not None and params.ready
+            np.testing.assert_allclose(params.parameters[0].to_array(),
+                                       expected, rtol=1e-6)
+    finally:
+        server.stop()

@@ -335,6 +335,10 @@ def test_emitted_idl_matches_reference_descriptors(tmp_path):
     assert extras == {("parameter_server", "Tensor", 5),
                       ("parameter_server", "Tensor", 6),
                       ("parameter_server", "PullRequest", 3),
+                      # fused data-plane extension: the wire encoding the
+                      # pushing worker wants parameters streamed back in
+                      # (read only by PushPullStream — rpc/data_plane.py)
+                      ("parameter_server", "GradientUpdate", 4),
                       ("coordinator", "GetPSAddressResponse", 3),
                       # observability extensions (obs/): trace context on
                       # the traced request path, metric snapshots on
@@ -429,5 +433,21 @@ def test_psclient_interoperates_with_gencode_server(gencode):
             np.testing.assert_allclose(after.parameters[0].to_array(),
                                        [0.5, 1.5, 2.5])
             assert after.iteration == 1
+            # (d) the FUSED round also degrades: push_pull falls back to
+            # the unary push (params None — caller polls + pulls), the
+            # payload crosses the reference wire format intact, and the
+            # fallback is remembered per connection
+            push, params = client.push_pull(
+                0, 2, [m.Tensor.from_array(
+                    "w", np.array([0.25, 0.25, 0.25], np.float32))])
+            assert push.success and params is None
+            assert client._fused_ok is False
+            sync = client.call("CheckSyncStatus",
+                               m.SyncStatusRequest(iteration=2))
+            assert sync.ready  # the poll leg of the degraded round
+            after2 = client.pull_parameters(m.PullRequest(worker_id=0,
+                                                          iteration=2))
+            np.testing.assert_allclose(after2.parameters[0].to_array(),
+                                       [0.25, 1.25, 2.25])
     finally:
         server.stop(0)

@@ -25,8 +25,8 @@ final stdout is always exactly one JSON line; failures carry the
 exception text in a "note" field.
 
 Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
-(mfu | samples | pushpull | dataplane | async | generate | serve |
-attention;
+(mfu | samples | pushpull | dataplane | aggregate | async | generate |
+serve | attention;
 default mfu; serve = continuous-batching sustained tokens/s, with
 PSDT_BENCH_REQUESTS total requests),
 PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
@@ -661,6 +661,108 @@ def bench_dataplane() -> dict:
                      f"rounds/step vs serial "
                      f"{serial['rpc_rounds_per_step']:g}; serial step "
                      f"p-mean {serial['step_ms']:g} ms")}
+
+
+def bench_aggregate() -> dict:
+    """PS-side aggregation + broadcast microbench (in-process, no gRPC):
+    barrier-close latency vs worker count, serve encodes per (params
+    version, wire dtype) through the encode-once cache, and peak resident
+    gradient bytes — streaming vs buffered (PSDT_AGGREGATION) side by
+    side.  Shape knobs: PSDT_BENCH_PARAMS (total store size, default 2M),
+    PSDT_BENCH_WORKER_COUNTS (default "2,4,8"), PSDT_BENCH_STEPS
+    (iterations per worker count, default 5)."""
+    import tempfile
+
+    import numpy as np
+
+    from parameter_server_distributed_tpu.checkpoint.manager import (
+        CheckpointManager)
+    from parameter_server_distributed_tpu.core.ps_core import (
+        ParameterServerCore)
+    from parameter_server_distributed_tpu.core.tensor import store_nbytes
+    from parameter_server_distributed_tpu.obs import stats as obs_stats
+    from parameter_server_distributed_tpu.rpc import messages as m
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServerService)
+
+    n_params = int(float(os.environ.get("PSDT_BENCH_PARAMS", "2e6")))
+    worker_counts = [int(x) for x in os.environ.get(
+        "PSDT_BENCH_WORKER_COUNTS", "2,4,8").split(",")]
+    iters = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 5
+
+    rng = np.random.default_rng(0)
+    n_tensors = 4
+    shape = (max(1, n_params // n_tensors),)
+    params = {f"w{i}": rng.standard_normal(shape).astype(np.float32)
+              for i in range(n_tensors)}
+    model_bytes = store_nbytes(params)
+    # one gradient set, reused for every worker (the PS folds/buffers its
+    # own copies, so sharing the source arrays does not skew memory)
+    grads = {name: rng.standard_normal(v.shape).astype(np.float32)
+             for name, v in params.items()}
+
+    def profile(mode: str) -> dict:
+        by_workers = {}
+        for n in worker_counts:
+            core = ParameterServerCore(total_workers=n, aggregation=mode)
+            core.initialize_parameters(params)
+            service = ParameterServerService(core, CheckpointManager(
+                core, directory=tempfile.mkdtemp(prefix="psdt-agg-"),
+                checkpoint_interval=10**9, check_period_s=3600.0))
+            before = obs_stats.REGISTRY.snapshot()["counters"]
+            close_times = []
+            for it in range(1, iters + 1):
+                for wid in range(n - 1):
+                    core.receive_gradients(wid, it, grads)
+                t0 = time.perf_counter()
+                r = core.receive_gradients(n - 1, it, grads)
+                close_times.append(time.perf_counter() - t0)
+                assert r.aggregation_complete, r.message
+                # post-barrier fan-out: every worker pulls the fresh store
+                for _ in range(n):
+                    for _chunk in service._parameter_chunks(it, m.WIRE_BF16):
+                        pass
+            after = obs_stats.REGISTRY.snapshot()["counters"]
+            encodes = (after.get("ps.serve.cache_miss", 0)
+                       - before.get("ps.serve.cache_miss", 0))
+            hits = (after.get("ps.serve.cache_hit", 0)
+                    - before.get("ps.serve.cache_hit", 0))
+            by_workers[n] = {
+                "barrier_close_ms": round(
+                    1e3 * sum(close_times) / len(close_times), 3),
+                "serve_encodes": encodes,
+                "serve_cache_hits": hits,
+                "serves": n * iters,
+                "peak_grad_buffer_bytes": core.peak_grad_buffer_bytes,
+                "peak_grad_buffer_x_model": round(
+                    core.peak_grad_buffer_bytes / model_bytes, 2),
+            }
+            log(f"bench_aggregate: {mode} workers={n} "
+                f"close={by_workers[n]['barrier_close_ms']}ms "
+                f"encodes={encodes}/{n * iters} serves "
+                f"peak_buffer={by_workers[n]['peak_grad_buffer_x_model']}x "
+                f"model")
+        return by_workers
+
+    log(f"bench_aggregate: store {n_params / 1e6:.1f}M params "
+        f"({model_bytes / 1e6:.0f} MB f32), worker counts {worker_counts}, "
+        f"{iters} iterations each")
+    streaming = profile("streaming")
+    buffered = profile("buffered")
+    n_max = worker_counts[-1]
+    s_close = streaming[n_max]["barrier_close_ms"]
+    b_close = buffered[n_max]["barrier_close_ms"]
+    return {"metric": f"ps_aggregate_barrier_close_ms_{n_max}w",
+            "value": s_close, "unit": "ms",
+            "vs_baseline": round(b_close / s_close, 3) if s_close else 0.0,
+            "streaming": streaming, "buffered": buffered,
+            "model_bytes": model_bytes,
+            "note": (f"streaming close {s_close}ms vs buffered {b_close}ms "
+                     f"at {n_max} workers; peak grad buffer "
+                     f"{streaming[n_max]['peak_grad_buffer_x_model']}x vs "
+                     f"{buffered[n_max]['peak_grad_buffer_x_model']}x model; "
+                     f"{streaming[n_max]['serve_encodes']} encodes for "
+                     f"{streaming[n_max]['serves']} serves")}
 
 
 def _ab_host_optimizer() -> None:
@@ -1303,6 +1405,8 @@ def child_main(mode: str) -> int:
             result = bench_pushpull()
         elif mode == "dataplane":
             result = bench_dataplane()
+        elif mode == "aggregate":
+            result = bench_aggregate()
         elif mode == "async":
             result = bench_async()
         elif mode == "generate":
@@ -1410,7 +1514,7 @@ def main() -> int:
     # Host-only benches never need the accelerator — run them on CPU
     # directly rather than risking a flaky TPU init.
     plans: list[tuple[str, float]]
-    if mode in ("pushpull", "dataplane"):
+    if mode in ("pushpull", "dataplane", "aggregate"):
         plans = [("cpu", cpu_timeout)]
     else:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]

@@ -16,6 +16,9 @@ Extension flags beyond the reference:
                     pallas_{sgd,momentum,adam} (fused pallas kernels) for a
                     device-resident store
     --staleness=N   bounded-staleness async mode (0 = synchronous)
+    --aggregation=S streaming (default: fold-on-arrival accumulator,
+                    O(model) barrier close) | buffered (classic
+                    buffer-all-then-mean; also PSDT_AGGREGATION env)
     --elastic       barrier width follows live registrations (needs
                     --coordinator=ADDR to poll the registry)
     --ckpt-dir=D    checkpoint directory (default .)
@@ -43,6 +46,7 @@ def build_config(argv: list[str]) -> tuple[ParameterServerConfig, str | None]:
         learning_rate=float(flags.get("lr", 1.0)),
         optimizer=flags.get("optimizer", "sgd"),
         staleness_bound=int(flags.get("staleness", 0)),
+        aggregation=flags.get("aggregation", ""),
         elastic="elastic" in flags,
         checkpoint_dir=flags.get("ckpt-dir", "."),
         checkpoint_keep=int(flags.get("keep", 0)),

@@ -65,6 +65,13 @@ class ParameterServerConfig:
     momentum: float = 0.9
     weight_decay: float = 1e-4   # adamw variants only (matrices-only decay)
     staleness_bound: int = 0     # 0 = strictly synchronous (reference behavior)
+    # Sync-barrier aggregation data path (core/ps_core.py): "streaming"
+    # folds every push into a running accumulator on arrival (O(model)
+    # barrier close, ~1x model peak gradient memory, duplicate pushes
+    # first-push-wins); "buffered" is the classic buffer-all-then-mean
+    # escape hatch (last-push-wins).  Empty = PSDT_AGGREGATION env or the
+    # streaming default.
+    aggregation: str = ""
     elastic: bool = False        # True: barrier width tracks live registrations
     live_workers_ttl_s: float = 1.0  # cache TTL for the live-worker lookup
     gc_iterations: int = 64      # retain at most this many iteration states

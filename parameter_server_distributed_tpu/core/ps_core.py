@@ -30,10 +30,31 @@ Deliberate departures (bug fixes / extensions, flagged in SURVEY.md §7):
   updates apply on arrival, gated on `current_iteration - iteration <= bound`;
   the synchronous protocol is the special case bound == 0.
 - pluggable optimizer (the reference hardcodes lr=1.0 SGD).
+
+Aggregation data path (PSDT_AGGREGATION, default ``streaming``):
+
+- **streaming** — every push folds its gradients into a per-iteration
+  running float32 accumulator on arrival (per *chunk* when the push is
+  stream-chunked — see :meth:`ParameterServerCore.begin_push`), so barrier
+  close shrinks from an O(workers × model) sweep to an O(model)
+  scale-and-apply, and peak buffered gradient memory drops from N× model
+  to ~1× model.  The optimizer apply runs OUTSIDE ``_state_lock`` (an
+  "aggregating" phase flag guards the iteration), so pushes for other
+  iterations and sync polls are never blocked behind the apply.  Duplicate
+  pre-barrier pushes from the same worker are **first-push-wins**: later
+  payloads are ignored per tensor name, which makes an RPC retry of a push
+  that actually landed (the worker replays an identical payload —
+  worker/worker.py) converge to exactly one contribution.
+- **buffered** — the classic escape hatch: per-worker gradients are
+  buffered whole and the contributor mean is taken at barrier close under
+  ``_state_lock`` (duplicate pushes are last-push-wins, the original
+  semantics).  Same contributor-mean math; use it when the per-worker
+  buffers themselves are wanted (debugging, exact reference timing).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -41,17 +62,56 @@ from typing import Callable, Mapping
 
 import numpy as np
 
+from ..obs import stats as obs_stats
 from .optimizer import HostOptimizer, SGD
-from .tensor import TensorStore, tree_like
+from .tensor import TensorStore, store_nbytes, tree_like
+
+AGGREGATION_MODES = ("streaming", "buffered")
 
 
 class IterationState:
-    __slots__ = ("worker_gradients", "aggregated", "workers_at_aggregation")
+    __slots__ = ("worker_gradients", "aggregated", "aggregating", "sealed",
+                 "workers_at_aggregation", "accum", "counts", "folded",
+                 "contributors", "buffer_bytes")
 
     def __init__(self):
+        # buffered mode: whole per-worker gradient stores
         self.worker_gradients: dict[int, TensorStore] = {}
+        # streaming mode: running per-name f32 sums + per-name contributor
+        # counts (per-name so workers pushing disjoint tensor subsets —
+        # the sharded topology — average correctly, exactly like the
+        # buffered _mean_over_workers did)
+        self.accum: TensorStore = {}
+        self.counts: dict[str, int] = {}
+        # streaming dedup: worker -> tensor names already folded, so a
+        # retried (replayed) push or a duplicate never double-counts
+        self.folded: dict[int, set[str]] = {}
+        # Workers whose push COMPLETED (stream fully received) — only
+        # these count toward the barrier width.  Folded VALUES from a
+        # stream still in flight are already in `accum` (fold-on-arrival
+        # is the point); if the barrier closes without that worker — a
+        # worker dying mid-stream whose eviction shrinks the elastic
+        # width — its already-folded tensors stay in their per-name
+        # means.  Each tensor remains a true mean of real worker
+        # gradients for that tensor (per-name counts divide correctly);
+        # the contributor SET can differ across tensors in that rare
+        # case, exactly as it legitimately does under sharded
+        # disjoint-subset pushes.  A worker that instead retries
+        # completes the same contribution via the dedup set.
+        self.contributors: set[int] = set()
         self.aggregated = False
+        # streaming close in flight: the accumulator has been taken and
+        # the O(model) scale+apply is running outside _state_lock
+        self.aggregating = False
+        # Set (and never cleared) the first time a close is ATTEMPTED: the
+        # contributor set is frozen from that point — later folds are
+        # discarded and later commits read "in progress".  A failed apply
+        # (aggregating comes back down, close retried by the next poll)
+        # must not let a straggler mix into the restored accumulator,
+        # whose sums are already scaled to means.
+        self.sealed = False
         self.workers_at_aggregation = 0
+        self.buffer_bytes = 0
 
 
 class PushResult:
@@ -69,6 +129,40 @@ class PushResult:
         self.aggregation_complete = aggregation_complete
         self.workers_received = workers_received
         self.total_workers = total_workers
+
+
+class PushSink:
+    """One worker's push in progress (possibly chunk-streamed).
+
+    Returned by :meth:`ParameterServerCore.begin_push`.  RPC handlers feed
+    each decoded chunk through :meth:`fold` as it arrives and call
+    :meth:`commit` when the request stream ends, so decode ⊕ accumulate
+    overlap the transport of later chunks.  In streaming sync mode each
+    fold adds straight into the iteration's shared running accumulator (no
+    per-worker copy is ever buffered); in buffered or async mode folds
+    stage into a private dict and commit routes through the classic
+    whole-push paths (an async apply must be atomic)."""
+
+    __slots__ = ("_core", "worker_id", "iteration", "_buffer")
+
+    def __init__(self, core: "ParameterServerCore", worker_id: int,
+                 iteration: int, streaming: bool):
+        self._core = core
+        self.worker_id = int(worker_id)
+        self.iteration = int(iteration)
+        self._buffer: dict | None = None if streaming else {}
+
+    def fold(self, gradients: Mapping[str, np.ndarray]) -> None:
+        if self._buffer is not None:
+            self._buffer.update(gradients)
+        else:
+            self._core._fold_chunk(self.worker_id, self.iteration, gradients)
+
+    def commit(self) -> PushResult:
+        if self._buffer is not None:
+            return self._core.receive_gradients(self.worker_id,
+                                                self.iteration, self._buffer)
+        return self._core._commit_push(self.worker_id, self.iteration)
 
 
 def _store_ready(store: "TensorStore") -> bool:
@@ -96,10 +190,21 @@ class ParameterServerCore:
                  staleness_bound: int = 0,
                  live_workers_fn: Callable[[], int] | None = None,
                  live_workers_ttl_s: float = 0.0,
-                 gc_iterations: int = 64):
+                 gc_iterations: int = 64,
+                 aggregation: str | None = None):
+        mode = (aggregation or os.environ.get("PSDT_AGGREGATION")
+                or "streaming").lower()
+        if mode not in AGGREGATION_MODES:
+            raise ValueError(f"unknown aggregation mode {mode!r}; "
+                             f"options: {AGGREGATION_MODES}")
+        self._aggregation = mode
         self._params: TensorStore = {}
         self._params_lock = threading.Lock()   # reference: params_mutex_ (h:44)
         self._state_lock = threading.Lock()    # reference: state_mutex_ (h:52)
+        # Serializes streaming-mode barrier applies, which run OUTSIDE
+        # _state_lock so pushes/polls for other iterations proceed during
+        # the optimizer apply.  Never held while acquiring _state_lock.
+        self._apply_lock = threading.Lock()
         # Barrier-completion broadcast over _state_lock: the fused data
         # plane (PushPullStream) parks here and is woken the instant an
         # aggregation fires, instead of being polled at 20 Hz like the
@@ -110,12 +215,35 @@ class ParameterServerCore:
         self._live_workers_fn = live_workers_fn
         self._live_ttl = float(live_workers_ttl_s)
         self._live_cache: tuple[int, float] = (0, 0.0)  # (value, expiry)
+        # Guards _live_cache: barrier_width() is called from many handler
+        # threads at once, and an unguarded expiry race both issues
+        # redundant remote registry calls and can publish a torn
+        # (value, expiry) pair.  Held across the provider call so exactly
+        # one thread refreshes per expiry; the others briefly queue and
+        # read the fresh value (they would have paid their own remote
+        # round-trip otherwise).
+        self._live_lock = threading.Lock()
         self._optimizer = optimizer or SGD(learning_rate=1.0)
         self._staleness_bound = int(staleness_bound)
         self._gc_iterations = int(gc_iterations)
         self._current_iteration = 0
         self._epoch = 0
         self._applied_updates = 0  # async mode: count of applied pushes
+        # Monotone store version: bumped on every parameter mutation
+        # (apply/initialize/restore).  The serve-side encode-once cache
+        # (server/ps_service.py) keys on it, and a version probe lets a
+        # cache-hit serve skip the per-request store copy entirely.
+        self._params_version = 0
+        self._serving_version = 0
+        # Resident buffered-gradient accounting (accumulators + buffered
+        # worker stores across live iteration states), for the
+        # ps.peak_grad_buffer_bytes gauge and the aggregate bench mode.
+        self._grad_buffer_bytes = 0
+        self._peak_grad_buffer_bytes = 0
+        self._obs_peak_buffer = obs_stats.gauge("ps.peak_grad_buffer_bytes")
+        # Wall time of the barrier close (mean/scale + optimizer apply) —
+        # O(model) in streaming mode, O(workers × model) in buffered.
+        self._obs_barrier_close = obs_stats.histogram("ps.barrier_close_s")
         # Highest iteration whose aggregation has completed.  Needed so a
         # straggler push for a GC'd iteration is recognized as late (no-op)
         # instead of re-buffering a stale gradient into a fresh state.
@@ -123,6 +251,12 @@ class ParameterServerCore:
         # Async mode: iteration of the bootstrap push, so racing duplicate
         # init pushes from other workers are recognized and dropped.
         self._bootstrap_iteration: int | None = None
+        # Bumped by restore().  The streaming barrier close applies outside
+        # _state_lock; a checkpoint restore that lands inside that window
+        # obsoletes the in-flight aggregate, and the closer checks this
+        # generation to drop it instead of applying a stale mean on top of
+        # the restored store (or resurrecting the watermark restore reset).
+        self._restore_epoch = 0
         # Async non-blocking serve: device optimizers dispatch their apply
         # asynchronously (jax), so right after a push the new store is a
         # promise.  Reads must not stall on that compute — bounded
@@ -131,7 +265,9 @@ class ParameterServerCore:
         # in-flight apply lands (serve_parameters promotes it).  None in
         # sync mode and whenever _params is known materialized.
         self._serving: TensorStore | None = None
-        # Lock order: _state_lock before _params_lock, everywhere.
+        # Lock order: _state_lock before _apply_lock before _params_lock,
+        # everywhere; _apply_lock is never held while acquiring
+        # _state_lock (the streaming closer drops _apply_lock first).
 
     # ------------------------------------------------------------------ props
     @property
@@ -139,8 +275,30 @@ class ParameterServerCore:
         return self._staleness_bound == 0
 
     @property
+    def aggregation_mode(self) -> str:
+        return self._aggregation
+
+    @property
+    def _streaming(self) -> bool:
+        return self._aggregation == "streaming"
+
+    @property
     def current_iteration(self) -> int:
         return self._current_iteration
+
+    @property
+    def params_version(self) -> int:
+        return self._params_version
+
+    @property
+    def grad_buffer_bytes(self) -> int:
+        """Currently-resident buffered gradient bytes (accumulators plus
+        buffered per-worker stores)."""
+        return self._grad_buffer_bytes
+
+    @property
+    def peak_grad_buffer_bytes(self) -> int:
+        return self._peak_grad_buffer_bytes
 
     @property
     def epoch(self) -> int:
@@ -156,13 +314,16 @@ class ParameterServerCore:
         process-lifetime constant (reference fixes it at startup —
         src/parameter_main.cpp:14-15)."""
         if self._live_workers_fn is not None:
-            live, expiry = self._live_cache
-            if self._live_ttl <= 0 or time.monotonic() >= expiry:
-                # TTL cache: the provider may be a remote registry RPC; the
-                # barrier width is read on every push and 20 Hz sync poll, so
-                # don't issue hot-path I/O for a value that changes in seconds
-                live = int(self._live_workers_fn())
-                self._live_cache = (live, time.monotonic() + self._live_ttl)
+            with self._live_lock:
+                live, expiry = self._live_cache
+                if self._live_ttl <= 0 or time.monotonic() >= expiry:
+                    # TTL cache: the provider may be a remote registry RPC;
+                    # the barrier width is read on every push and 20 Hz
+                    # sync poll, so don't issue hot-path I/O for a value
+                    # that changes in seconds.  One refresher per expiry
+                    # (see _live_lock above).
+                    live = int(self._live_workers_fn())
+                    self._live_cache = (live, time.monotonic() + self._live_ttl)
             if live > 0:
                 return live
         return self._static_total_workers
@@ -174,6 +335,7 @@ class ParameterServerCore:
     def initialize_parameters(self, params: Mapping[str, np.ndarray]) -> None:
         with self._params_lock:
             self._params = tree_like(params)
+            self._params_version += 1
 
     def get_parameters(self) -> TensorStore:
         with self._params_lock:
@@ -187,7 +349,13 @@ class ParameterServerCore:
     def serve_parameters(self, iteration: int = 0) -> tuple[int, TensorStore, bool]:
         """Return (current_iteration, params copy, ready).  The iteration
         argument is accepted and ignored, matching the reference
-        (src/parameter_server.cpp:93-97).
+        (src/parameter_server.cpp:93-97)."""
+        it, params, ready, _ = self.serve_view(iteration)
+        return it, params, ready
+
+    def serve_view(self, iteration: int = 0) -> tuple[int, TensorStore, bool, int]:
+        """(current_iteration, params copy, ready, store version) — the
+        versioned serve the encode-once broadcast cache keys on.
 
         Async mode never blocks a read on an in-flight device apply: while
         the newest store is still a dispatched-but-unmaterialized promise,
@@ -201,39 +369,138 @@ class ParameterServerCore:
                     self._serving = None  # in-flight apply landed: promote
                 else:
                     return (self._current_iteration, dict(self._serving),
-                            True)
-            params = dict(self._params)
-        return self._current_iteration, params, True
+                            True, self._serving_version)
+            return (self._current_iteration, dict(self._params), True,
+                    self._params_version)
+
+    def serve_version(self) -> int:
+        """The version :meth:`serve_view` would serve right now, WITHOUT
+        copying the store — the cache-hit fast path: a serve whose encoded
+        bytes are already cached never touches the parameters at all."""
+        with self._params_lock:
+            if self._serving is not None and not _store_ready(self._params):
+                return self._serving_version
+            return self._params_version
 
     # ------------------------------------------------------------------- push
+    def begin_push(self, worker_id: int, iteration: int) -> PushSink:
+        """Open a (possibly chunk-streamed) push.  The streaming handlers
+        fold each decoded chunk as it arrives and commit at end-of-stream;
+        the whole-store :meth:`receive_gradients` is the one-chunk case."""
+        return PushSink(self, worker_id, iteration,
+                        streaming=self._streaming and self.synchronous)
+
     def receive_gradients(self, worker_id: int, iteration: int,
                           gradients: Mapping[str, np.ndarray]) -> PushResult:
-        if self.synchronous:
-            return self._receive_sync(worker_id, iteration, gradients)
-        return self._receive_async(worker_id, iteration, gradients)
+        if not self.synchronous:
+            return self._receive_async(worker_id, iteration, gradients)
+        if self._streaming:
+            self._fold_chunk(worker_id, iteration, gradients)
+            return self._commit_push(worker_id, iteration)
+        return self._receive_sync(worker_id, iteration, gradients)
 
-    def _receive_sync(self, worker_id: int, iteration: int,
-                      gradients: Mapping[str, np.ndarray]) -> PushResult:
+    # ------------------------------------------------- streaming aggregation
+    def _grad_buffer_note(self, delta: int) -> None:
+        """Track resident buffered gradient bytes (caller holds
+        _state_lock)."""
+        self._grad_buffer_bytes += delta
+        if self._grad_buffer_bytes > self._peak_grad_buffer_bytes:
+            self._peak_grad_buffer_bytes = self._grad_buffer_bytes
+            self._obs_peak_buffer.set(self._peak_grad_buffer_bytes)
+
+    def _sync_state_locked(self, iteration: int) -> IterationState | None:
+        """The iteration's state, created on first touch; None when the
+        iteration is late (already aggregated and GC'd).  Caller holds
+        _state_lock."""
+        state = self._iteration_states.get(iteration)
+        if state is None:
+            if iteration <= self._aggregated_watermark:
+                return None
+            state = IterationState()
+            self._iteration_states[iteration] = state
+            self._gc_locked()
+        return state
+
+    def _fold_chunk(self, worker_id: int, iteration: int,
+                    gradients: Mapping[str, np.ndarray]) -> None:
+        """Fold one chunk of a worker's push into the iteration's running
+        accumulator (streaming sync mode).  Idempotent per (worker, tensor
+        name): a replayed chunk — an RPC retry of a push that actually
+        landed — is skipped, so retries converge to exactly one
+        contribution (first-push-wins).  Chunks for an aggregated (or
+        currently-aggregating) iteration are discarded; the commit reports
+        the push late."""
+        with self._state_lock:
+            self._current_iteration = max(self._current_iteration, iteration)
+            state = self._sync_state_locked(iteration)
+            if (state is None or state.aggregated or state.sealed
+                    or worker_id in state.contributors):
+                # late / close-attempted / already-committed worker: chunk
+                # is discarded (commit reports the push late or duplicate)
+                return
+            folded = state.folded.setdefault(worker_id, set())
+            added = 0
+            try:
+                for name, g in gradients.items():
+                    if name in folded:
+                        continue
+                    acc = state.accum.get(name)
+                    if acc is None:
+                        # owned f32 copy in ONE pass (convert-and-copy
+                        # fused; asarray-then-astype would sweep twice
+                        # for non-f32 wire decodes)
+                        acc = np.array(g, dtype=np.float32)
+                        state.accum[name] = acc
+                        state.counts[name] = 1
+                        added += acc.nbytes
+                    else:
+                        # raises (mutating nothing) on a shape mismatch —
+                        # only THEN is the name marked folded, so a retry
+                        # of a failed fold is not silently dropped
+                        np.add(acc, np.asarray(g, np.float32), out=acc)
+                        state.counts[name] += 1
+                    folded.add(name)
+            finally:
+                if added:
+                    state.buffer_bytes += added
+                    self._grad_buffer_note(added)
+
+    def _commit_push(self, worker_id: int, iteration: int) -> PushResult:
+        """End-of-stream for a streaming push: mark the worker a barrier
+        contributor and fire the barrier if the width is reached."""
         total = self.barrier_width()
         with self._state_lock:
             self._current_iteration = max(self._current_iteration, iteration)
-            state = self._iteration_states.get(iteration)
+            state = self._sync_state_locked(iteration)
             if state is None:
-                if iteration <= self._aggregated_watermark:
-                    # straggler push for a GC'd, already-aggregated iteration:
-                    # succeed without contributing (late-push invariant holds
-                    # across GC)
-                    return PushResult(True, "iteration already aggregated",
-                                      iteration, True, total, total)
-                state = IterationState()
-                self._iteration_states[iteration] = state
-                self._gc_locked()
+                # straggler push for a GC'd, already-aggregated iteration:
+                # succeed without contributing (late-push invariant holds
+                # across GC)
+                return PushResult(True, "iteration already aggregated",
+                                  iteration, True, total, total)
             if state.aggregated:
                 # late push: succeed without contributing
                 # (reference: src/parameter_server.cpp:28-30)
-                return PushResult(True, "iteration already aggregated", iteration,
-                                  True, state.workers_at_aggregation, total)
-            state.worker_gradients[worker_id] = tree_like(gradients)
+                return PushResult(True, "iteration already aggregated",
+                                  iteration, True,
+                                  state.workers_at_aggregation, total)
+            if state.sealed:
+                # a close was attempted (and is in flight or being
+                # retried) without this worker; the apply has NOT landed
+                # yet, so do not report complete — the worker observes
+                # readiness via the sync poll / condition variable exactly
+                # when it is real
+                return PushResult(True, "aggregation in progress", iteration,
+                                  False, len(state.contributors), total)
+            if worker_id in state.contributors:
+                # documented streaming policy: duplicate pre-barrier pushes
+                # from the same worker are first-push-wins (the buffered
+                # escape hatch keeps the original last-push-wins)
+                return PushResult(True, "duplicate push ignored (streaming "
+                                        "aggregation is first-push-wins)",
+                                  iteration, False,
+                                  len(state.contributors), total)
+            state.contributors.add(worker_id)
             received = self._maybe_aggregate_locked(iteration, state, total)
             if state.aggregated:
                 return PushResult(True, "aggregation complete", iteration,
@@ -241,24 +508,137 @@ class ParameterServerCore:
             return PushResult(True, "gradient received", iteration,
                               False, received, total)
 
+    # -------------------------------------------------- buffered aggregation
+    def _receive_sync(self, worker_id: int, iteration: int,
+                      gradients: Mapping[str, np.ndarray]) -> PushResult:
+        total = self.barrier_width()
+        with self._state_lock:
+            self._current_iteration = max(self._current_iteration, iteration)
+            state = self._sync_state_locked(iteration)
+            if state is None:
+                return PushResult(True, "iteration already aggregated",
+                                  iteration, True, total, total)
+            if state.aggregated:
+                # late push: succeed without contributing
+                # (reference: src/parameter_server.cpp:28-30)
+                return PushResult(True, "iteration already aggregated", iteration,
+                                  True, state.workers_at_aggregation, total)
+            store = tree_like(gradients)
+            prev = state.worker_gradients.get(worker_id)
+            delta = store_nbytes(store) - (store_nbytes(prev) if prev else 0)
+            state.worker_gradients[worker_id] = store
+            state.buffer_bytes += delta
+            self._grad_buffer_note(delta)
+            received = self._maybe_aggregate_locked(iteration, state, total)
+            if state.aggregated:
+                return PushResult(True, "aggregation complete", iteration,
+                                  True, received, total)
+            return PushResult(True, "gradient received", iteration,
+                              False, received, total)
+
+    # ---------------------------------------------------------- barrier close
     def _maybe_aggregate_locked(self, iteration: int, state: IterationState,
                                 total: int) -> int:
         """Fire the barrier if the contributor count has reached the current
-        width.  Called from push AND from sync-status polls so that an
-        elastic barrier shrink (worker evicted mid-iteration) releases
-        already-buffered iterations instead of stranding them.  Caller holds
-        _state_lock.  Returns the contributor count."""
-        received = len(state.worker_gradients)
-        if not state.aggregated and received >= total and received > 0:
-            if not self._apply_fused_mean_sgd(state.worker_gradients):
-                mean = _mean_over_workers(state.worker_gradients)
-                self._apply_update(mean)
-            state.aggregated = True
-            state.workers_at_aggregation = received
-            state.worker_gradients.clear()  # free gradient memory promptly
-            self._aggregated_watermark = max(self._aggregated_watermark, iteration)
-            self._barrier_cv.notify_all()  # wake fused-RPC barrier waiters
-        return state.workers_at_aggregation if state.aggregated else received
+        width.  Called from push AND from sync-status polls / CV waits so
+        that an elastic barrier shrink (worker evicted mid-iteration)
+        releases already-buffered iterations instead of stranding them.
+        Caller holds _state_lock.  Returns the contributor count."""
+        if state.aggregated:
+            return state.workers_at_aggregation
+        received = (len(state.contributors) if self._streaming
+                    else len(state.worker_gradients))
+        if state.aggregating or received < total or received == 0:
+            return received
+        self._close_barrier_locked(iteration, state, received)
+        return (state.workers_at_aggregation if state.aggregated
+                else received)
+
+    def _close_barrier_locked(self, iteration: int, state: IterationState,
+                              received: int) -> None:
+        """Close the barrier.  Streaming mode: take the accumulator, flag
+        the iteration "aggregating", RELEASE _state_lock for the O(model)
+        scale-and-apply (serialized by _apply_lock), then reacquire to
+        publish completion — pushes for other iterations and sync polls
+        run concurrently with the apply.  Buffered mode applies inline
+        under _state_lock (the escape hatch preserves the original
+        semantics and timing exactly).  Caller holds _state_lock; it is
+        held again on return."""
+        t0 = time.perf_counter()
+        state.sealed = True  # contributor set frozen, even across retries
+        state.aggregating = True
+        try:
+            if self._streaming:
+                if not self._close_streaming_locked(state):
+                    # a checkpoint restore landed inside the close window:
+                    # the aggregate belongs to the pre-restore world —
+                    # drop it and leave the (already-cleared) state
+                    # unpublished
+                    state.aggregating = False
+                    return
+            else:
+                if not self._apply_fused_mean_sgd(state.worker_gradients):
+                    mean = _mean_over_workers(state.worker_gradients)
+                    self._apply_update(mean)
+                state.worker_gradients.clear()  # free memory promptly
+                self._grad_buffer_note(-state.buffer_bytes)
+                state.buffer_bytes = 0
+        except BaseException:
+            # a failed apply must leave the barrier RETRYABLE, as the old
+            # inline close did: the phase flag comes back down (buffered
+            # gradients / the restored accumulator are still in place) and
+            # the next push or sync poll re-fires the aggregation
+            state.aggregating = False
+            raise
+        state.aggregating = False
+        state.aggregated = True
+        state.workers_at_aggregation = received
+        self._aggregated_watermark = max(self._aggregated_watermark, iteration)
+        self._obs_barrier_close.observe(time.perf_counter() - t0)
+        self._barrier_cv.notify_all()  # wake fused-RPC barrier waiters
+
+    def _close_streaming_locked(self, state: IterationState) -> bool:
+        """The streaming half of the barrier close: take the accumulator,
+        run the O(model) scale-and-apply outside _state_lock (serialized
+        by _apply_lock), reacquire.  Returns False when a concurrent
+        checkpoint restore obsoleted the aggregate.  On an apply failure
+        the accumulator is PUT BACK (already-scaled sums are means, so
+        their counts reset to 1) and the exception propagates — the next
+        push/poll retries the close instead of wedging the iteration."""
+        gen = self._restore_epoch
+        sums, counts = state.accum, state.counts
+        state.accum, state.counts = {}, {}
+        state.folded.clear()
+        freed = state.buffer_bytes
+        self._grad_buffer_note(-freed)
+        state.buffer_bytes = 0
+        scaled = False
+        try:
+            self._state_lock.release()
+            try:
+                with self._apply_lock:
+                    if self._restore_epoch == gen:
+                        # contributor mean without a per-worker sweep: one
+                        # in-place O(model) scale of the running sums
+                        # (per-name counts — see IterationState.counts)
+                        for name, acc in sums.items():
+                            acc *= np.float32(1.0 / counts[name])
+                        scaled = True
+                        self._apply_update(sums)
+            finally:
+                # _apply_lock is released BEFORE reacquiring _state_lock
+                # (lock-order: never hold _apply_lock while taking
+                # _state_lock)
+                self._state_lock.acquire()
+        except BaseException:
+            if self._restore_epoch == gen:
+                state.accum = sums
+                state.counts = (dict.fromkeys(sums, 1) if scaled
+                                else counts)
+                state.buffer_bytes = freed
+                self._grad_buffer_note(freed)
+            raise
+        return self._restore_epoch == gen
 
     def _receive_async(self, worker_id: int, iteration: int,
                        gradients: Mapping[str, np.ndarray]) -> PushResult:
@@ -312,8 +692,9 @@ class ParameterServerCore:
         aggregation loop (src/parameter_server.cpp:40-91).  Returns False —
         requesting the generic mean-then-optimizer path — for non-SGD
         optimizers, an uninitialized store (bootstrap needs the mean itself),
-        or when the native library is unavailable.  Caller holds _state_lock.
-        """
+        or when the native library is unavailable.  Buffered mode only; the
+        streaming path's accumulator makes the close O(model) without it.
+        Caller holds _state_lock."""
         from ..native import lib, mean_sgd_native
 
         if type(self._optimizer) is not SGD or lib() is None:
@@ -341,18 +722,21 @@ class ParameterServerCore:
                     p_new = p_new - np.float32(lr / len(arrays)) * acc
                 new_params[name] = p_new
             self._params = new_params
+            self._params_version += 1
         return True
 
     def _apply_update(self, mean_grads: TensorStore) -> None:
-        """Caller holds _state_lock, so applies are serialized; only
-        _params_lock is taken here, and only briefly — in async mode the
-        depth-bound fence on the previous in-flight apply happens OUTSIDE
-        it, so concurrent serves keep reading the materialized snapshot
-        instead of queueing behind device compute."""
+        """Applies are serialized by the caller: _state_lock on the
+        async/buffered paths, _apply_lock on the streaming barrier close.
+        Only _params_lock is taken here, and only briefly — in async mode
+        the depth-bound fence on the previous in-flight apply happens
+        OUTSIDE it, so concurrent serves keep reading the materialized
+        snapshot instead of queueing behind device compute."""
         with self._params_lock:
             if not self._params:
                 # bootstrap quirk preserved from the reference (cpp:78-81)
                 self._params = dict(mean_grads)
+                self._params_version += 1
                 return
             prev = self._params
         if not self.synchronous:
@@ -366,11 +750,14 @@ class ParameterServerCore:
             new_params = self._optimizer.apply(prev, mean_grads)
             with self._params_lock:
                 self._serving = prev  # materialized: serve this while the
+                self._serving_version = self._params_version
                 self._params = new_params  # new apply is in flight
+                self._params_version += 1
         else:
             with self._params_lock:
                 self._params = self._optimizer.apply(self._params,
                                                      mean_grads)
+                self._params_version += 1
 
     # ------------------------------------------------------------------- sync
     def check_sync_status(self, iteration: int) -> tuple[int, bool, int, int]:
@@ -433,8 +820,25 @@ class ParameterServerCore:
 
     # --------------------------------------------------------------------- gc
     def _gc_locked(self) -> None:
-        while len(self._iteration_states) > self._gc_iterations:
-            self._iteration_states.popitem(last=False)
+        excess = len(self._iteration_states) - self._gc_iterations
+        if excess <= 0:
+            return
+        for iteration in list(self._iteration_states):
+            if excess <= 0:
+                break
+            old = self._iteration_states[iteration]
+            if old.sealed and not old.aggregated:
+                # mid-close (apply in flight outside _state_lock, or a
+                # failed apply awaiting its retry): evicting now would let
+                # a replayed push recreate the state and fire a SECOND
+                # aggregation for the same iteration before the watermark
+                # publishes.  Skip; it becomes collectable once published.
+                continue
+            del self._iteration_states[iteration]
+            excess -= 1
+            if old.buffer_bytes:
+                self._grad_buffer_note(-old.buffer_bytes)
+                old.buffer_bytes = 0
 
     @property
     def tracked_iterations(self) -> int:
@@ -444,30 +848,43 @@ class ParameterServerCore:
     # ------------------------------------------------------------- checkpoint
     def snapshot(self) -> tuple[int, int, TensorStore]:
         """Consistent (epoch, current_iteration, params) snapshot.  Takes
-        _state_lock before _params_lock so a concurrent push cannot produce a
-        torn view (iteration bumped but its update not yet applied)."""
+        _state_lock, then _apply_lock (so a streaming barrier apply in
+        flight completes first), then _params_lock, so a concurrent push
+        cannot produce a torn view (iteration bumped but its update not
+        yet applied)."""
         with self._state_lock:
-            with self._params_lock:
-                return self._epoch, self._current_iteration, dict(self._params)
+            with self._apply_lock:
+                with self._params_lock:
+                    return (self._epoch, self._current_iteration,
+                            dict(self._params))
 
     def optimizer_state(self) -> dict:
         """Optimizer slot state (Momentum velocity / Adam moments), for
         checkpointing alongside :meth:`snapshot`."""
         with self._state_lock:
-            with self._params_lock:
-                return self._optimizer.state_dict()
+            with self._apply_lock:
+                with self._params_lock:
+                    return self._optimizer.state_dict()
 
     def restore(self, epoch: int, iteration: int,
                 params: Mapping[str, np.ndarray],
                 optimizer_state: dict | None = None) -> None:
         with self._state_lock:
-            with self._params_lock:
-                self._params = tree_like(params)
-                if optimizer_state is not None:
-                    self._optimizer.load_state_dict(optimizer_state)
+            with self._apply_lock:
+                with self._params_lock:
+                    self._params = tree_like(params)
+                    self._params_version += 1
+                    if optimizer_state is not None:
+                        self._optimizer.load_state_dict(optimizer_state)
+                # bumped while _apply_lock is held: an in-flight streaming
+                # barrier close observes it either before its apply (and
+                # skips) or after (and drops its publication) — see
+                # _close_barrier_locked
+                self._restore_epoch += 1
             self._epoch = int(epoch)
             self._current_iteration = int(iteration)
             self._iteration_states.clear()
+            self._grad_buffer_bytes = 0
             self._aggregated_watermark = -1
             self._bootstrap_iteration = None
 

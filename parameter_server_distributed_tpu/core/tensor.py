@@ -45,6 +45,20 @@ def num_params(store: Mapping[str, np.ndarray]) -> int:
     return sum(int(np.asarray(v).size) for v in store.values())
 
 
+def store_nbytes(store: Mapping[str, np.ndarray]) -> int:
+    """Total payload bytes of a store WITHOUT copying device-resident
+    arrays to host (``.size``/``.itemsize`` are metadata on numpy and jax
+    arrays alike).  Used for the PS gradient-buffer accounting
+    (core/ps_core.py) and the aggregate bench mode."""
+    total = 0
+    for v in store.values():
+        itemsize = getattr(v, "itemsize", None)
+        if itemsize is None:
+            itemsize = np.dtype(getattr(v, "dtype", np.float32)).itemsize
+        total += int(v.size) * int(itemsize)
+    return total
+
+
 def flat_concat(store: Mapping[str, np.ndarray]) -> np.ndarray:
     """Concatenate all tensors into one flat float32 vector (stable order)."""
     if not store:

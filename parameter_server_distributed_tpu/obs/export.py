@@ -51,6 +51,28 @@ def _sum_counters(snap: dict, suffix: str, prefix: str = "") -> int:
                if k.endswith(suffix) and k.startswith(prefix))
 
 
+def _ps_rollup(snap: dict) -> dict:
+    """PS-side hot-path metrics present in a snapshot (a colocated PS —
+    tests, bench, single-process demos — shares the process registry, so
+    its instruments ride the worker's heartbeat snapshot): the serve
+    encode-once cache hit/miss counters, the barrier-close latency, and
+    the peak resident gradient-buffer gauge (server/ps_service.py,
+    core/ps_core.py)."""
+    out: dict = {}
+    counters = snap.get("counters", {})
+    hits = counters.get("ps.serve.cache_hit", 0)
+    misses = counters.get("ps.serve.cache_miss", 0)
+    if hits or misses:
+        out["serve_cache"] = {"hits": hits, "misses": misses}
+    close = _hist_stats(snap, "ps.barrier_close_s")
+    if close:
+        out["barrier_close"] = close
+    peak = snap.get("gauges", {}).get("ps.peak_grad_buffer_bytes", 0)
+    if peak:
+        out["peak_grad_buffer_bytes"] = peak
+    return out
+
+
 def worker_rollup(snap: dict) -> dict:
     """Derived per-worker view of one snapshot: per-method RPC latency
     percentiles, wire-byte totals, and the step-phase breakdown."""
@@ -76,6 +98,9 @@ def worker_rollup(snap: dict) -> dict:
         "retries": snap.get("counters", {}).get("rpc.client.retries", 0),
         "t": snap.get("t"),
     }
+    ps = _ps_rollup(snap)
+    if ps:
+        out["ps"] = ps
     payload = _sum_counters(snap, ".payload_bytes", "rpc.client.")
     if payload:
         # uncompressed (f32) size of the tensors that rode those wire
@@ -206,6 +231,21 @@ def render_rollup(rollup: dict) -> str:
                 f"{phase}={_fmt_s(stats['p50'])}"
                 for phase, stats in w["phases"].items())
             lines.append(f"    step phases (p50): {parts}")
+        ps = w.get("ps")
+        if ps:
+            parts = []
+            cache = ps.get("serve_cache")
+            if cache:
+                total = cache["hits"] + cache["misses"]
+                parts.append(f"serve cache {cache['hits']}/{total} hits "
+                             f"({cache['misses']} encodes)")
+            close = ps.get("barrier_close")
+            if close:
+                parts.append(f"barrier close p50={_fmt_s(close['p50'])}")
+            peak = ps.get("peak_grad_buffer_bytes")
+            if peak:
+                parts.append(f"peak grad buffer {_fmt_bytes(peak)}")
+            lines.append(f"    ps: {', '.join(parts)}")
         extra = (f"    bytes: {_fmt_bytes(w['bytes_sent'])} sent / "
                  f"{_fmt_bytes(w['bytes_received'])} received")
         if w.get("payload_bytes_f32"):

@@ -39,12 +39,14 @@ target, not a hard message cap.
 from __future__ import annotations
 
 import os
-from typing import Iterable, Iterator
+from typing import Iterable, Iterator, Sequence
 
 import grpc
 
 from . import messages as m
 from .service import RpcClient
+from .wire import WT_LEN, WT_VARINT, _len_delimited_size, _tag, _varint_size, \
+    _Writer, encode_varint
 
 # Default chunk budget for streamed pushes/pulls.  Tens of MB amortizes
 # per-message overhead while keeping encode/transport/decode pipelined;
@@ -98,6 +100,72 @@ def split_tensors(tensors: Iterable[m.Tensor],
         size += n
     if group:
         yield group
+
+
+_PARAMETERS_FIELD = 2  # m.ParameterUpdate.parameters
+_ITERATION_FIELD = 1   # m.ParameterUpdate.iteration
+_READY_FIELD = 3       # m.ParameterUpdate.ready
+
+
+def encode_parameter_records(tensors: Iterable[m.Tensor]) -> bytes:
+    """Encode a group of wire Tensors ONCE into the exact bytes of
+    ``ParameterUpdate.parameters`` (field 2) records — tag, length, and
+    tensor body per element.  The server's encode-once broadcast cache
+    (server/ps_service.py) stores these and replays them to every puller
+    of the same (params version, wire dtype) via
+    :class:`PreEncodedParameterUpdate`, so the per-tensor payload encode
+    (f32→bf16 cast, repeated-float pack) runs once per version instead of
+    once per pulling worker."""
+    items = [(t, t.encoded_size()) for t in tensors]
+    writer = _Writer(sum(_len_delimited_size(_PARAMETERS_FIELD, size)
+                         for _, size in items))
+    for tensor, size in items:
+        writer.write(_tag(_PARAMETERS_FIELD, WT_LEN))
+        writer.write(encode_varint(size))
+        tensor.encode_into(writer)
+    return writer.getvalue()
+
+
+class PreEncodedParameterUpdate:
+    """A ``ParameterUpdate`` whose ``parameters`` field is pre-encoded wire
+    bytes (one or more :func:`encode_parameter_records` blobs).  Encodes
+    byte-identically to ``m.ParameterUpdate(...)`` with the same content —
+    field order 1, 2, 3 with proto3 default elision — so reference-shaped
+    clients decode it indistinguishably.  Quacks like a codec Message
+    (``encode`` / ``encoded_size`` / ``encode_into``), which is all the
+    gRPC serializer and the ``PushPullResponse.params`` embedding need."""
+
+    __slots__ = ("iteration", "ready", "bodies")
+
+    def __init__(self, iteration: int, ready: bool,
+                 bodies: Sequence[bytes]):
+        self.iteration = int(iteration)
+        self.ready = bool(ready)
+        self.bodies = bodies
+
+    def encoded_size(self) -> int:
+        size = sum(len(b) for b in self.bodies)
+        if self.iteration:
+            size += (_varint_size(_ITERATION_FIELD << 3)
+                     + _varint_size(self.iteration))
+        if self.ready:
+            size += _varint_size(_READY_FIELD << 3) + 1
+        return size
+
+    def encode_into(self, writer: "_Writer") -> None:
+        if self.iteration:
+            writer.write(_tag(_ITERATION_FIELD, WT_VARINT))
+            writer.write(encode_varint(self.iteration))
+        for body in self.bodies:
+            writer.write(memoryview(body))
+        if self.ready:
+            writer.write(_tag(_READY_FIELD, WT_VARINT))
+            writer.write(b"\x01")
+
+    def encode(self) -> bytes:
+        writer = _Writer(self.encoded_size())
+        self.encode_into(writer)
+        return writer.getvalue()
 
 
 class PSClient(RpcClient):

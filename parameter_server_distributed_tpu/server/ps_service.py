@@ -4,12 +4,28 @@ Wraps `ParameterServerCore` in the 5-RPC service of the reference
 (reference: src/parameter_server_service.cpp, proto/parameter_server.proto:5-11)
 and runs the periodic checkpoint daemon
 (reference: src/parameter_server_service.cpp:150-169) via CheckpointManager.
+
+Two server-side hot-path optimizations live here (ISSUE 3):
+
+- **Per-chunk gradient folding**: the streaming push handlers feed each
+  decoded chunk through a :class:`~..core.ps_core.PushSink` as it arrives,
+  so decode ⊕ accumulate overlap the transport of later chunks and the
+  core never buffers a whole per-worker gradient store (streaming
+  aggregation mode — core/ps_core.py).
+- **Encode-once broadcast cache**: served parameter chunks are encoded to
+  wire bytes once per (params version, wire dtype, chunk budget) and
+  replayed to every subsequent puller of the same version
+  (:class:`EncodedServeCache`), so the post-barrier fan-out to N workers
+  runs ONE `to_wire` encode instead of N.  The version key makes
+  invalidation automatic: apply/restore/initialize bump the core's store
+  version and the next serve re-encodes.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import Callable
 
@@ -18,15 +34,85 @@ import grpc
 from ..checkpoint.manager import CheckpointManager
 from ..config import ParameterServerConfig
 from ..core.optimizer import make_optimizer
-from ..core.ps_core import ParameterServerCore
+from ..core.ps_core import ParameterServerCore, PushSink
 from ..core.tensor import from_wire, to_wire
 from ..obs import stats as obs_stats
 from ..obs import trace as obs_trace
 from ..rpc import messages as m
-from ..rpc.data_plane import split_tensors, stream_chunk_bytes
+from ..rpc.data_plane import (PreEncodedParameterUpdate,
+                              encode_parameter_records, split_tensors,
+                              stream_chunk_bytes)
 from ..rpc.service import bind_service, make_server
 
 log = logging.getLogger("pst.ps")
+
+
+class _ServeCacheEntry:
+    __slots__ = ("event", "bodies", "failed")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.bodies: list[bytes] | None = None
+        self.failed = False
+
+
+class EncodedServeCache:
+    """Encode-once broadcast cache: encoded parameter-chunk bytes keyed by
+    (params version, wire dtype, chunk budget).
+
+    Single-flight per key: the first serve of a version encodes (the
+    cache miss); concurrent serves of the same key wait for that encode
+    and replay its bytes instead of racing N duplicate `to_wire` passes —
+    the post-barrier fan-out is exactly the situation where N pullers
+    arrive at once.  Entries for superseded versions are dropped on
+    insert, so the cache holds at most the current version's encodings
+    (one per requested wire dtype)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, _ServeCacheEntry] = {}
+
+    def lookup(self, key: tuple) -> tuple[_ServeCacheEntry, bool]:
+        """Returns (entry, is_builder).  A builder MUST call :meth:`fill`
+        or :meth:`fail`; everyone else waits on ``entry.event``.  Store
+        versions are monotone, so only entries for OLDER versions are
+        pruned — a probe that raced a newer serve must not evict the
+        newer bytes."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                return entry, False
+            entry = _ServeCacheEntry()
+            version = key[0]
+            for stale in [k for k in self._entries if k[0] < version]:
+                del self._entries[stale]
+            self._entries[key] = entry
+            return entry, True
+
+    def fill(self, key: tuple, entry: _ServeCacheEntry,
+             bodies: list[bytes], version: int) -> None:
+        entry.bodies = bodies
+        if version != key[0]:
+            # the store moved between the version probe and the atomic
+            # (params, version) read: re-register under the version that
+            # was actually encoded so later serves of it still hit — but
+            # never resurrect a version the cache has already moved past
+            with self._lock:
+                if self._entries.get(key) is entry:
+                    del self._entries[key]
+                if not any(k[0] > version for k in self._entries):
+                    for stale in [k for k in self._entries
+                                  if k[0] < version]:
+                        del self._entries[stale]
+                    self._entries[(version,) + key[1:]] = entry
+        entry.event.set()
+
+    def fail(self, key: tuple, entry: _ServeCacheEntry) -> None:
+        entry.failed = True
+        with self._lock:
+            if self._entries.get(key) is entry:
+                del self._entries[key]
+        entry.event.set()
 
 
 class ParameterServerService:
@@ -43,6 +129,11 @@ class ParameterServerService:
         # fused data plane: how long PushPullStream handlers park on the
         # barrier condition variable before serving
         self._obs_barrier = obs_stats.histogram("ps.barrier_wait_s")
+        # encode-once broadcast cache (see EncodedServeCache): hit = this
+        # serve replayed cached wire bytes; miss = it ran the encode
+        self._serve_cache = EncodedServeCache()
+        self._obs_cache_hit = obs_stats.counter("ps.serve.cache_hit")
+        self._obs_cache_miss = obs_stats.counter("ps.serve.cache_miss")
 
     def _apply(self, worker_id: int, iteration: int, grads):
         """Decoded-gradients -> core aggregation, timed and traced (the
@@ -55,10 +146,19 @@ class ParameterServerService:
         self._obs_apply.observe(time.perf_counter() - t0)
         return result
 
-    # RPC: push gradients (reference: src/parameter_server_service.cpp:32-59)
-    def ReceiveGradients(self, request: m.GradientUpdate, context) -> m.PushResponse:
-        grads = from_wire(request.gradients)
-        result = self._apply(request.worker_id, request.iteration, grads)
+    def _commit(self, sink: PushSink):
+        """End-of-stream commit of a chunk-folded push, timed/traced like
+        :meth:`_apply` (the fold legs were already accounted inside the
+        stream loop — they overlap transport)."""
+        t0 = time.perf_counter()
+        with obs_trace.span("ps/apply", worker=sink.worker_id,
+                            iteration=sink.iteration):
+            result = sink.commit()
+        self._obs_apply.observe(time.perf_counter() - t0)
+        return result
+
+    @staticmethod
+    def _push_result_response(result) -> m.PushResponse:
         return m.PushResponse(
             success=result.success,
             message=result.message,
@@ -67,6 +167,12 @@ class ParameterServerService:
             workers_received=result.workers_received,
             total_workers=result.total_workers,
         )
+
+    # RPC: push gradients (reference: src/parameter_server_service.cpp:32-59)
+    def ReceiveGradients(self, request: m.GradientUpdate, context) -> m.PushResponse:
+        grads = from_wire(request.gradients)
+        result = self._apply(request.worker_id, request.iteration, grads)
+        return self._push_result_response(result)
 
     # RPC: pull parameters (reference: src/parameter_server_service.cpp:62-84)
     # Serves in the encoding the client requested (request.wire_dtype, a
@@ -84,63 +190,132 @@ class ParameterServerService:
             return m.WIRE_BF16
         return requested
 
-    def ServeParameters(self, request: m.PullRequest, context) -> m.ParameterUpdate:
+    @staticmethod
+    def _cache_build_wait_s() -> float:
+        """How long a concurrent serve waits for an in-flight cache build
+        before falling back to its own (uncached) encode.  Kept BELOW the
+        worker's 30 s pull deadline (worker/worker.py _pull_parameters) —
+        same principle as _fused_barrier_timeout_s: a wedged builder must
+        degrade to a served (uncached) response, not to the client's
+        DEADLINE_EXCEEDED."""
+        return float(os.environ.get("PSDT_SERVE_CACHE_WAIT_S", "20"))
+
+    def _encode_chunk_bodies(self, request_iteration: int, eff_dtype: int,
+                             budget: int):
+        """One real encode pass: (lazy body iterator, store version) — the
+        single shared recipe under the cache.  Every consumer currently
+        drains it whole before touching the network (see
+        _parameter_chunks for why the fill must not be client-paced); the
+        laziness keeps peak memory at one chunk above the collected
+        bodies."""
+        _, params, _, version = self.core.serve_view(request_iteration)
+        tensors = to_wire(params, wire_dtype=eff_dtype)
+        bodies = (encode_parameter_records(group)
+                  for group in split_tensors(tensors, budget))
+        return bodies, version
+
+    def _serve_key(self, wire_dtype: int) -> tuple:
+        eff = self._serve_wire_dtype(wire_dtype)
+        budget = stream_chunk_bytes() or (32 << 20)
+        return (self.core.serve_version(), eff, budget)
+
+    def _wait_for_builder(self, entry: _ServeCacheEntry,
+                          key: tuple) -> tuple[list[bytes], bool]:
+        """Non-builder path: (bodies, cached).  Replays the in-flight
+        builder's bytes (cached=True — the caller re-probes the version),
+        or falls back to an uncached encode of the LIVE store if the
+        builder failed/wedged (cached=False — already current, no
+        re-probe) — serve correctness over cache purity."""
+        if entry.event.wait(self._cache_build_wait_s()) and not entry.failed:
+            self._obs_cache_hit.add()
+            return entry.bodies, True
+        self._obs_cache_miss.add()
+        return list(self._encode_chunk_bodies(0, key[1], key[2])[0]), False
+
+    def _encoded_parameter_chunks(self, request_iteration: int,
+                                  wire_dtype: int) -> list[bytes]:
+        """Whole-list encoded chunk bodies, through the encode-once cache.
+        The version probe (`core.serve_version`) is a lock-and-read — a
+        cache hit never copies the parameter store at all, let alone
+        re-encodes it.  A waiter that parked on a builder RE-PROBES the
+        version on wake: the store may have advanced during the wait, and
+        serving the old bytes then would stretch staleness from the probe
+        window to the whole wait window (bounded retries; the final
+        fallback serves what it has — indistinguishable from the serve
+        having happened when it was first admitted)."""
+        for _ in range(3):
+            key = self._serve_key(wire_dtype)
+            entry, builder = self._serve_cache.lookup(key)
+            if builder:
+                self._obs_cache_miss.add()
+                try:
+                    body_iter, version = self._encode_chunk_bodies(
+                        request_iteration, key[1], key[2])
+                    bodies = list(body_iter)
+                except BaseException:
+                    self._serve_cache.fail(key, entry)
+                    raise
+                self._serve_cache.fill(key, entry, bodies, version)
+                return bodies
+            bodies, cached = self._wait_for_builder(entry, key)
+            if not cached or self.core.serve_version() == key[0]:
+                return bodies
+        return bodies
+
+    def ServeParameters(self, request: m.PullRequest, context):
         t0 = time.perf_counter()
         with obs_trace.span("ps/serve", worker=request.worker_id,
                             iteration=request.iteration):
-            iteration, params, ready = self.core.serve_parameters(
-                request.iteration)
-            resp = m.ParameterUpdate(
-                iteration=iteration,
-                parameters=to_wire(
-                    params,
-                    wire_dtype=self._serve_wire_dtype(request.wire_dtype)),
-                ready=ready)
+            # label read BEFORE the bodies resolve: a serve must never
+            # stamp bytes with an iteration newer than they are (the old
+            # code read both under one lock; bytes newer than the label
+            # are the benign direction — a serve racing a push)
+            iteration = self.core.current_iteration
+            bodies = self._encoded_parameter_chunks(request.iteration,
+                                                    request.wire_dtype)
+            resp = PreEncodedParameterUpdate(iteration, True, bodies)
         self._obs_serve.observe(time.perf_counter() - t0)
         return resp
 
     # RPC (framework extension, rpc/data_plane.py): client-streamed push.
-    # Chunks decode + convert to f32 as they arrive, overlapping transport;
-    # the core sees ONE receive_gradients call, so barrier/staleness
-    # semantics are exactly the unary RPC's.
+    # Chunks decode + fold into the aggregation accumulator as they arrive,
+    # overlapping transport; barrier/staleness semantics are exactly the
+    # unary RPC's (the worker becomes a barrier contributor only at
+    # end-of-stream commit).
     def PushGradientsStream(self, request_iterator, context) -> m.PushResponse:
-        worker_id = iteration = None
-        grads: dict = {}
+        sink: PushSink | None = None
         for chunk in request_iterator:
-            if worker_id is None:
-                worker_id, iteration = chunk.worker_id, chunk.iteration
-            for t in chunk.gradients:
-                grads[t.name] = t.to_array()
-        if worker_id is None:
+            if sink is None:
+                sink = self.core.begin_push(chunk.worker_id, chunk.iteration)
+            if chunk.gradients:
+                sink.fold({t.name: t.to_array() for t in chunk.gradients})
+        if sink is None:
             return m.PushResponse(success=False, message="empty push stream")
-        result = self._apply(worker_id, iteration, grads)
-        return m.PushResponse(
-            success=result.success,
-            message=result.message,
-            iteration=result.iteration,
-            aggregation_complete=result.aggregation_complete,
-            workers_received=result.workers_received,
-            total_workers=result.total_workers,
-        )
+        return self._push_result_response(self._commit(sink))
 
     def _parameter_chunks(self, request_iteration: int, wire_dtype: int):
         """Serve the current store as a stream of ParameterUpdate chunks
-        (shared by ServeParametersStream and the fused PushPullStream).
-        Each chunk's fused bf16/raw encode happens as it is yielded,
-        overlapping the previous chunk's transport."""
-        iteration, params, ready = self.core.serve_parameters(
-            request_iteration)
-        tensors = to_wire(params,
-                          wire_dtype=self._serve_wire_dtype(wire_dtype))
-        sent = False
-        for group in split_tensors(tensors, stream_chunk_bytes() or
-                                   (32 << 20)):
-            sent = True
-            yield m.ParameterUpdate(iteration=iteration, parameters=group,
-                                    ready=ready)
-        if not sent:  # empty store still answers one (empty) chunk
-            yield m.ParameterUpdate(iteration=iteration, parameters=[],
-                                    ready=ready)
+        (shared by ServeParametersStream and the fused PushPullStream),
+        replaying the encode-once cache's wire bytes.
+
+        The builder (first serve of a version) encodes ALL chunk bodies
+        on its first pull and fills the cache BEFORE streaming them: the
+        fill must never be paced by the builder's client — each yield is
+        subject to gRPC flow control, and a slow or stalled first puller
+        must not hold the rest of the post-barrier fan-out hostage for
+        the single-flight wait.  The miss serve trades its intra-serve
+        encode ⊕ transport overlap (one serve per store version, CPU-
+        bounded) for that decoupling; every other serve streams cached
+        bytes chunk by chunk as before."""
+        # label before bodies — see ServeParameters
+        iteration = self.core.current_iteration
+        bodies = self._encoded_parameter_chunks(request_iteration,
+                                                wire_dtype)
+        if not bodies:  # empty store still answers one (empty) chunk
+            yield PreEncodedParameterUpdate(iteration, True, ())
+            return
+        for body in bodies:
+            yield PreEncodedParameterUpdate(iteration, True, (body,))
 
     # RPC (framework extension): server-streamed pull.
     def ServeParametersStream(self, request: m.PullRequest, context):
@@ -156,11 +331,12 @@ class ParameterServerService:
         return float(os.environ.get("PSDT_FUSED_BARRIER_TIMEOUT_S", "60"))
 
     # RPC (framework extension, rpc/data_plane.py): the fused synchronous
-    # step.  Client-streamed gradient chunks are applied as ONE
-    # receive_gradients call (barrier/staleness semantics identical to the
-    # unary push); the handler then parks on the aggregation condition
-    # variable and streams the fresh parameters back the instant the
-    # barrier closes — no CheckSyncStatus polling, no second round.
+    # step.  Client-streamed gradient chunks fold into the aggregation
+    # accumulator as they arrive and commit as ONE push at end-of-stream
+    # (barrier/staleness semantics identical to the unary push); the
+    # handler then parks on the aggregation condition variable and streams
+    # the fresh parameters back the instant the barrier closes — no
+    # CheckSyncStatus polling, no second round.
     def PushPullStream(self, request_iterator, context):
         if not self.core.has_parameters:
             # A fused push must never be the store's FIRST payload: the
@@ -178,28 +354,21 @@ class ParameterServerService:
                         "(re-pull and seed init via the push path)",
                 iteration=self.core.current_iteration))
             return
-        worker_id = iteration = None
+        sink: PushSink | None = None
         pull_wire_dtype = 0
-        grads: dict = {}
         for chunk in request_iterator:
-            if worker_id is None:
-                worker_id, iteration = chunk.worker_id, chunk.iteration
+            if sink is None:
+                sink = self.core.begin_push(chunk.worker_id, chunk.iteration)
                 pull_wire_dtype = chunk.pull_wire_dtype
-            for t in chunk.gradients:
-                grads[t.name] = t.to_array()
-        if worker_id is None:
+            if chunk.gradients:
+                sink.fold({t.name: t.to_array() for t in chunk.gradients})
+        if sink is None:
             yield m.PushPullResponse(push=m.PushResponse(
                 success=False, message="empty push stream"))
             return
-        result = self._apply(worker_id, iteration, grads)
-        push = m.PushResponse(
-            success=result.success,
-            message=result.message,
-            iteration=result.iteration,
-            aggregation_complete=result.aggregation_complete,
-            workers_received=result.workers_received,
-            total_workers=result.total_workers,
-        )
+        worker_id, iteration = sink.worker_id, sink.iteration
+        result = self._commit(sink)
+        push = self._push_result_response(result)
         # the push verdict goes out immediately: a stale rejection (async
         # mode) must reach the worker without waiting on any barrier
         yield m.PushPullResponse(push=push)
@@ -300,6 +469,7 @@ class ParameterServer:
             live_workers_fn=live_workers_fn if config.elastic else None,
             live_workers_ttl_s=config.live_workers_ttl_s,
             gc_iterations=config.gc_iterations,
+            aggregation=config.aggregation or None,
         )
         self.ckpt = CheckpointManager(
             self.core,

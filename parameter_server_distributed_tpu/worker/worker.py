@@ -336,6 +336,12 @@ class Worker:
         return resp
 
     def _push_gradients(self, iteration: int, grads: TensorStore) -> m.PushResponse:
+        # Retry invariant the PS-side streaming aggregation depends on:
+        # query_with_retry replays the SAME payload (same grads, same
+        # error-feedback residual — committed only after acceptance), so
+        # the server's per-(worker, tensor) dedup makes a retry of a push
+        # that actually landed converge to exactly one contribution
+        # (core/ps_core.py first-push-wins).
         self._obs_push_payload.add(
             sum(4 * int(np.asarray(g).size) for g in grads.values()))
         push_dtype = self._wire_dtype if self._peer_packed_ok else m.WIRE_F32
@@ -394,7 +400,13 @@ class Worker:
         consumes it, so D2H fetch ⊕ compress ⊕ encode ⊕ transport
         pipeline per bucket.  ``residual_box`` (non-None under int8/topk)
         fills with the new error-feedback residual; the caller commits it
-        only after the PS accepts the push."""
+        only after the PS accepts the push.
+
+        Replays are payload-identical: a retry re-reads the same gradients
+        (GradientBuckets' host-side cache) against the same committed
+        ``_ef_residual``, which is what lets the PS's streaming
+        aggregation dedup a retried push per (worker, tensor) instead of
+        double-counting it (core/ps_core.py first-push-wins)."""
         push_dtype = self._wire_dtype if self._peer_packed_ok else m.WIRE_F32
         compress = push_dtype in (m.WIRE_INT8, m.WIRE_TOPK)
         residual_box: dict[str, np.ndarray] | None = {} if compress else None

@@ -1,0 +1,650 @@
+"""Streaming incremental aggregation + encode-once broadcast serve.
+
+Covers ISSUE 3: equivalence of the streaming fold-on-arrival data path
+with the classic buffered mean (bit-for-bit in f32 on the numpy path),
+the documented duplicate-push policies, the O(model)/1x-model close
+properties, the apply-outside-the-lock aggregating phase, the
+encoded-chunk broadcast cache (single-flight, invalidation on
+apply/restore/initialize, mixed wire dtypes), and the barrier_width TTL
+cache lock."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu import native
+from parameter_server_distributed_tpu.core.optimizer import SGD, Adam, Momentum
+from parameter_server_distributed_tpu.core.ps_core import ParameterServerCore
+from parameter_server_distributed_tpu.core.tensor import store_nbytes, to_wire
+from parameter_server_distributed_tpu.obs import stats as obs_stats
+from parameter_server_distributed_tpu.rpc import messages as m
+
+
+def store(**kw):
+    return {k: np.asarray(v, np.float32) for k, v in kw.items()}
+
+
+@pytest.fixture
+def numpy_only():
+    """Pin the numpy aggregation path: the native kernels sum in a
+    different association order, and the bit-for-bit equivalence contract
+    is defined on the numpy semantics."""
+    native.set_enabled(False)
+    yield
+    native.set_enabled(True)
+
+
+def _random_grads(rng, shapes):
+    return {name: rng.standard_normal(shape).astype(np.float32)
+            for name, shape in shapes.items()}
+
+
+# ------------------------------------------------------------- equivalence
+
+@pytest.mark.parametrize("n_workers", [1, 2, 3, 5])
+@pytest.mark.parametrize("make_opt", [lambda: SGD(1.0),
+                                      lambda: Momentum(0.1, momentum=0.9),
+                                      lambda: Adam(0.01)])
+def test_streaming_matches_buffered_bit_for_bit(numpy_only, n_workers,
+                                                make_opt):
+    """The streaming accumulator must land EXACTLY the buffered
+    contributor mean — same f32 sum order, same scale, same optimizer
+    apply — across worker counts, optimizers, and several iterations."""
+    rng = np.random.default_rng(42)
+    shapes = {"w": (33, 7), "b": (11,), "scalar": ()}
+    init = _random_grads(rng, shapes)
+    cores = {mode: ParameterServerCore(total_workers=n_workers,
+                                       optimizer=make_opt(),
+                                       aggregation=mode)
+             for mode in ("streaming", "buffered")}
+    for core in cores.values():
+        core.initialize_parameters(init)
+    for it in range(1, 4):
+        pushes = [_random_grads(rng, shapes) for _ in range(n_workers)]
+        for mode, core in cores.items():
+            for wid, grads in enumerate(pushes):
+                r = core.receive_gradients(wid, it, grads)
+            assert r.aggregation_complete
+        a = cores["streaming"].get_parameters()
+        b = cores["buffered"].get_parameters()
+        for name in shapes:
+            np.testing.assert_array_equal(a[name], b[name])
+
+
+def test_streaming_matches_buffered_empty_store_bootstrap(numpy_only):
+    """Bootstrap (first aggregated mean BECOMES the params) is identical
+    in both modes."""
+    for mode in ("streaming", "buffered"):
+        ps = ParameterServerCore(total_workers=2, aggregation=mode)
+        ps.receive_gradients(0, 0, store(w=[2.0, 4.0]))
+        r = ps.receive_gradients(1, 0, store(w=[4.0, 8.0]))
+        assert r.aggregation_complete
+        np.testing.assert_array_equal(ps.get_parameters()["w"],
+                                      np.asarray([3.0, 6.0], np.float32))
+
+
+def test_streaming_matches_buffered_elastic_shrink(numpy_only):
+    """A mid-iteration barrier shrink (worker evicted) releases a
+    buffered iteration via the sync poll identically in both modes."""
+    results = {}
+    for mode in ("streaming", "buffered"):
+        live = {"n": 3}
+        ps = ParameterServerCore(total_workers=3, aggregation=mode,
+                                 live_workers_fn=lambda: live["n"])
+        ps.initialize_parameters(store(w=[0.0]))
+        ps.receive_gradients(0, 1, store(w=[2.0]))
+        ps.receive_gradients(1, 1, store(w=[4.0]))
+        _, ready, _, _ = ps.check_sync_status(1)
+        assert not ready
+        live["n"] = 2  # worker 2 evicted
+        _, ready, recv, total = ps.check_sync_status(1)
+        assert ready and recv == 2 and total == 2
+        results[mode] = ps.get_parameters()["w"]
+    np.testing.assert_array_equal(results["streaming"], results["buffered"])
+    np.testing.assert_allclose(results["streaming"], [-3.0])
+
+
+def test_streaming_late_and_gcd_pushes_are_noops():
+    ps = ParameterServerCore(total_workers=1, gc_iterations=4,
+                             aggregation="streaming")
+    ps.initialize_parameters(store(w=[0.0]))
+    for it in range(10):
+        ps.receive_gradients(0, it, store(w=[0.0]))
+    before = ps.get_parameters()["w"].copy()
+    late = ps.receive_gradients(1, 9, store(w=[500.0]))  # state still live
+    assert late.success and late.aggregation_complete
+    gcd = ps.receive_gradients(1, 1, store(w=[999.0]))   # state GC'd
+    assert gcd.success and gcd.aggregation_complete
+    np.testing.assert_array_equal(ps.get_parameters()["w"], before)
+    _, ready, _, _ = ps.check_sync_status(1)
+    assert ready
+
+
+# -------------------------------------------------- chunked fold / dedup
+
+def test_chunked_fold_equals_whole_push(numpy_only):
+    """A push delivered as several chunks through begin_push lands exactly
+    the state one whole-store receive_gradients lands."""
+    whole = ParameterServerCore(total_workers=2, aggregation="streaming")
+    chunked = ParameterServerCore(total_workers=2, aggregation="streaming")
+    init = store(a=[1.0, 1.0], b=[2.0], c=[3.0])
+    whole.initialize_parameters(init)
+    chunked.initialize_parameters(init)
+    g0 = store(a=[0.5, 0.5], b=[1.0], c=[2.0])
+    g1 = store(a=[1.5, 1.5], b=[3.0], c=[4.0])
+
+    whole.receive_gradients(0, 1, g0)
+    r_whole = whole.receive_gradients(1, 1, g1)
+
+    sink0 = chunked.begin_push(0, 1)
+    sink0.fold({"a": g0["a"]})
+    sink0.fold({"b": g0["b"], "c": g0["c"]})
+    r0 = sink0.commit()
+    assert r0.success and not r0.aggregation_complete
+    sink1 = chunked.begin_push(1, 1)
+    sink1.fold({"a": g1["a"], "b": g1["b"]})
+    sink1.fold({"c": g1["c"]})
+    r1 = sink1.commit()
+    assert r1.aggregation_complete == r_whole.aggregation_complete is True
+    for name in init:
+        np.testing.assert_array_equal(whole.get_parameters()[name],
+                                      chunked.get_parameters()[name])
+
+
+def test_retry_replay_folds_each_tensor_once(numpy_only):
+    """An RPC retry replays the SAME payload (worker/worker.py invariant);
+    the per-(worker, tensor) dedup must fold each tensor exactly once, so
+    a partially-landed push + full replay converges to one contribution."""
+    ps = ParameterServerCore(total_workers=2, aggregation="streaming")
+    ps.initialize_parameters(store(a=[0.0], b=[0.0]))
+    # first attempt dies after chunk 1 (no commit)
+    sink = ps.begin_push(0, 1)
+    sink.fold({"a": np.asarray([2.0], np.float32)})
+    # retry replays the full payload
+    retry = ps.begin_push(0, 1)
+    retry.fold({"a": np.asarray([2.0], np.float32)})
+    retry.fold({"b": np.asarray([4.0], np.float32)})
+    r = retry.commit()
+    assert r.success and r.workers_received == 1
+    ps.receive_gradients(1, 1, store(a=[4.0], b=[6.0]))
+    p = ps.get_parameters()
+    np.testing.assert_allclose(p["a"], [-3.0])  # mean(2,4), not mean(2,2,4)
+    np.testing.assert_allclose(p["b"], [-5.0])
+
+
+def test_streaming_duplicate_push_policy_and_message():
+    ps = ParameterServerCore(total_workers=3, aggregation="streaming")
+    ps.initialize_parameters(store(w=[0.0]))
+    ps.receive_gradients(0, 1, store(w=[3.0]))
+    dup = ps.receive_gradients(0, 1, store(w=[99.0]))
+    assert dup.success and not dup.aggregation_complete
+    assert dup.workers_received == 1
+    assert "first-push-wins" in dup.message
+
+
+# ------------------------------------------------- memory / close behavior
+
+def test_streaming_peak_gradient_buffer_is_one_model():
+    """N buffered pushes must cost ~1x model in streaming mode and N x
+    model in buffered mode — the headline memory claim."""
+    n = 6
+    shapes = {"w": (256, 16), "b": (64,)}
+    rng = np.random.default_rng(0)
+    init = _random_grads(rng, shapes)
+    model_bytes = store_nbytes(init)
+    peaks = {}
+    for mode in ("streaming", "buffered"):
+        ps = ParameterServerCore(total_workers=n, aggregation=mode)
+        ps.initialize_parameters(init)
+        for wid in range(n):
+            ps.receive_gradients(wid, 1, _random_grads(rng, shapes))
+        assert ps.grad_buffer_bytes == 0  # released at close
+        peaks[mode] = ps.peak_grad_buffer_bytes
+    assert peaks["streaming"] == model_bytes
+    assert peaks["buffered"] == n * model_bytes
+
+
+class _SlowSGD(SGD):
+    apply_delay_s = 0.25
+
+    def apply(self, params, grads):
+        time.sleep(self.apply_delay_s)
+        return super().apply(params, grads)
+
+
+def test_streaming_apply_runs_outside_state_lock():
+    """While iteration N's barrier apply is in flight (the "aggregating"
+    phase), a push for iteration N+1 and a sync poll must NOT block
+    behind it."""
+    ps = ParameterServerCore(total_workers=2, optimizer=_SlowSGD(1.0),
+                             aggregation="streaming")
+    ps.initialize_parameters(store(w=[10.0]))
+    ps.receive_gradients(0, 1, store(w=[1.0]))
+
+    def close_barrier():
+        ps.receive_gradients(1, 1, store(w=[1.0]))
+
+    closer = threading.Thread(target=close_barrier)
+    closer.start()
+    time.sleep(0.05)  # let the closer enter the slow apply
+    t0 = time.perf_counter()
+    r = ps.receive_gradients(0, 2, store(w=[1.0]))
+    push_latency = time.perf_counter() - t0
+    _, ready, _, _ = ps.check_sync_status(1)
+    poll_latency = time.perf_counter() - t0
+    closer.join(timeout=5.0)
+    assert not closer.is_alive()
+    assert r.success and not r.aggregation_complete
+    # both returned well inside the 0.25 s apply window
+    assert push_latency < 0.15, f"push blocked {push_latency:.3f}s"
+    assert poll_latency < 0.2, f"poll blocked {poll_latency:.3f}s"
+    # iteration 1 only reads ready once its apply has landed
+    _, ready1, _, _ = ps.check_sync_status(1)
+    assert ready1
+    np.testing.assert_allclose(ps.get_parameters()["w"], [9.0])
+
+
+def test_push_during_aggregating_window_reports_incomplete():
+    """A commit that lands while the barrier close is mid-apply must not
+    claim completion: the params are not applied yet, and the worker must
+    learn readiness from the poll/CV path when it is real."""
+    ps = ParameterServerCore(total_workers=1, optimizer=_SlowSGD(1.0),
+                             aggregation="streaming")
+    ps.initialize_parameters(store(w=[5.0]))
+
+    def close_barrier():
+        ps.receive_gradients(0, 1, store(w=[1.0]))
+
+    closer = threading.Thread(target=close_barrier)
+    closer.start()
+    time.sleep(0.05)
+    late = ps.receive_gradients(1, 1, store(w=[100.0]))
+    closer.join(timeout=5.0)
+    assert late.success and not late.aggregation_complete
+    assert "in progress" in late.message
+    # the late worker's payload did not contaminate the closed mean
+    _, ready, _, _ = ps.check_sync_status(1)
+    assert ready
+    np.testing.assert_allclose(ps.get_parameters()["w"], [4.0])
+
+
+class _FlakySGD(SGD):
+    """Raises on the first apply, works afterwards."""
+
+    def __init__(self, lr):
+        super().__init__(lr)
+        self.failures_left = 1
+
+    def apply(self, params, grads):
+        if self.failures_left:
+            self.failures_left -= 1
+            raise RuntimeError("injected apply failure")
+        return super().apply(params, grads)
+
+
+@pytest.mark.parametrize("mode", ["streaming", "buffered"])
+def test_failed_barrier_apply_is_retryable(numpy_only, mode):
+    """An optimizer apply that raises at barrier close must not wedge the
+    iteration: the aggregating flag comes back down, the gradients (or
+    the restored accumulator) stay in place, and the next sync poll
+    re-fires the close and lands the exact mean."""
+    ps = ParameterServerCore(total_workers=2, optimizer=_FlakySGD(1.0),
+                             aggregation=mode)
+    ps.initialize_parameters(store(w=[10.0]))
+    ps.receive_gradients(0, 1, store(w=[1.0]))
+    with pytest.raises(RuntimeError, match="injected"):
+        ps.receive_gradients(1, 1, store(w=[3.0]))
+    # A straggler arriving between failure and retry: streaming SEALED
+    # the contributor set at the close attempt (the restored accumulator
+    # holds already-scaled means, so mixing in raw gradients would be
+    # wrong); buffered keeps whole per-worker buffers, so including the
+    # straggler in the retried mean is the original valid semantics.
+    straggler = ps.receive_gradients(2, 1, store(w=[5.0]))
+    assert straggler.success
+    if mode == "streaming":
+        # the straggler is deferred to the poll path, which re-fires
+        assert not straggler.aggregation_complete
+        _, ready, recv, _ = ps.check_sync_status(1)
+        assert ready and recv == 2
+        np.testing.assert_allclose(ps.get_parameters()["w"], [8.0])  # 10-mean(1,3)
+    else:
+        # the straggler's own push re-fires the close and joins the mean
+        assert straggler.aggregation_complete
+        _, ready, recv, _ = ps.check_sync_status(1)
+        assert ready and recv == 3
+        np.testing.assert_allclose(ps.get_parameters()["w"], [7.0])  # 10-mean(1,3,5)
+
+
+def test_failed_fold_is_not_marked_folded():
+    """A chunk whose accumulate raises (shape mismatch vs the running
+    accumulator) must NOT be recorded as folded: the worker's retry with
+    a good payload still contributes instead of being dedup-dropped."""
+    ps = ParameterServerCore(total_workers=2, aggregation="streaming")
+    ps.initialize_parameters(store(w=[0.0, 0.0]))
+    ps.receive_gradients(0, 1, store(w=[2.0, 2.0]))
+    with pytest.raises(ValueError):
+        ps.receive_gradients(1, 1, store(w=[1.0, 1.0, 1.0]))  # bad shape
+    r = ps.receive_gradients(1, 1, store(w=[4.0, 4.0]))
+    assert r.aggregation_complete and r.workers_received == 2
+    np.testing.assert_allclose(ps.get_parameters()["w"], [-3.0, -3.0])
+
+
+def test_gc_never_evicts_mid_close_iteration():
+    """GC pressure during the off-lock close window must not evict the
+    closing iteration's state: a replayed (response-lost) push would
+    recreate it and fire a SECOND aggregation for the same iteration."""
+    ps = ParameterServerCore(total_workers=2, gc_iterations=1,
+                             optimizer=_SlowSGD(1.0),
+                             aggregation="streaming")
+    ps.initialize_parameters(store(w=[10.0]))
+    ps.receive_gradients(0, 1, store(w=[1.0]))
+    closer = threading.Thread(
+        target=lambda: ps.receive_gradients(1, 1, store(w=[1.0])))
+    closer.start()
+    time.sleep(0.05)  # closer is inside the slow apply
+    for it in (2, 3, 4):  # GC pressure while iteration 1 is mid-close
+        ps.receive_gradients(0, it, store(w=[1.0]))
+    # replayed pushes for the closing iteration (lost responses)
+    ps.receive_gradients(0, 1, store(w=[1.0]))
+    ps.receive_gradients(1, 1, store(w=[1.0]))
+    closer.join(timeout=5.0)
+    assert not closer.is_alive()
+    _, ready, _, _ = ps.check_sync_status(1)
+    assert ready
+    # exactly ONE apply of iteration 1's mean — 10 - mean(1,1), not 8.0
+    np.testing.assert_allclose(ps.get_parameters()["w"], [9.0])
+
+
+def test_restore_during_streaming_close_wins():
+    """A checkpoint restore that lands while a barrier apply is in flight
+    must end with EXACTLY the restored state: no stale mean applied on
+    top, no resurrected watermark, and the next barrier works."""
+    ps = ParameterServerCore(total_workers=1, optimizer=_SlowSGD(1.0),
+                             aggregation="streaming")
+    ps.initialize_parameters(store(w=[10.0]))
+
+    def close_barrier():
+        ps.receive_gradients(0, 1, store(w=[1.0]))
+
+    closer = threading.Thread(target=close_barrier)
+    closer.start()
+    time.sleep(0.05)  # closer is inside the slow apply
+    ps.restore(epoch=0, iteration=0, params=store(w=[42.0]))
+    closer.join(timeout=5.0)
+    assert not closer.is_alive()
+    np.testing.assert_allclose(ps.get_parameters()["w"], [42.0])
+    # the restored world starts fresh: a new iteration-1 barrier closes
+    r = ps.receive_gradients(0, 1, store(w=[2.0]))
+    assert r.aggregation_complete
+    np.testing.assert_allclose(ps.get_parameters()["w"], [40.0])
+
+
+# --------------------------------------------------- barrier_width TTL lock
+
+def test_barrier_width_ttl_refresh_is_single_flight():
+    """Concurrent expiry must issue ONE provider call (the old unlocked
+    cache issued one per racing thread and could publish torn pairs)."""
+    calls = []
+    barrier = threading.Barrier(6)
+
+    def provider():
+        calls.append(threading.get_ident())
+        time.sleep(0.05)  # widen the race window
+        return 3
+
+    ps = ParameterServerCore(total_workers=5, live_workers_fn=provider,
+                             live_workers_ttl_s=60.0)
+    widths = []
+
+    def read():
+        barrier.wait()
+        widths.append(ps.barrier_width())
+
+    threads = [threading.Thread(target=read) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert widths == [3] * 6
+    assert len(calls) == 1, f"{len(calls)} provider calls for one expiry"
+
+
+# --------------------------------------------------- encode-once serve cache
+
+def _make_service(core):
+    import tempfile
+
+    from parameter_server_distributed_tpu.checkpoint.manager import (
+        CheckpointManager)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServerService)
+
+    return ParameterServerService(core, CheckpointManager(
+        core, directory=tempfile.mkdtemp(prefix="psdt-aggtest-"),
+        checkpoint_interval=10**9, check_period_s=3600.0))
+
+
+def _cache_counters():
+    snap = obs_stats.REGISTRY.snapshot()["counters"]
+    return (snap.get("ps.serve.cache_hit", 0),
+            snap.get("ps.serve.cache_miss", 0))
+
+
+def _decode_serve(service, iteration=0, wire_dtype=0):
+    chunks = list(service._parameter_chunks(iteration, wire_dtype))
+    tensors = []
+    for chunk in chunks:
+        decoded = m.ParameterUpdate.decode(chunk.encode())
+        assert decoded.ready
+        tensors.extend(decoded.parameters)
+    return {t.name: t.to_array() for t in tensors}
+
+
+def test_serve_cache_hits_and_invalidation_on_apply():
+    rng = np.random.default_rng(1)
+    params = {"w": rng.standard_normal((64, 8)).astype(np.float32)}
+    core = ParameterServerCore(total_workers=1, aggregation="streaming")
+    core.initialize_parameters(params)
+    service = _make_service(core)
+
+    h0, m0 = _cache_counters()
+    first = _decode_serve(service)
+    np.testing.assert_array_equal(first["w"], params["w"])
+    for _ in range(3):
+        _decode_serve(service)
+    h1, m1 = _cache_counters()
+    assert m1 - m0 == 1 and h1 - h0 == 3  # one encode, three replays
+
+    # an aggregation apply bumps the store version -> cache invalidated
+    core.receive_gradients(0, 1, {"w": np.ones_like(params["w"])})
+    after = _decode_serve(service)
+    h2, m2 = _cache_counters()
+    assert m2 - m1 == 1
+    np.testing.assert_allclose(after["w"], params["w"] - 1.0, rtol=1e-6)
+
+
+def test_serve_cache_invalidation_on_initialize_and_restore():
+    core = ParameterServerCore(total_workers=1)
+    core.initialize_parameters(store(w=[1.0, 2.0]))
+    service = _make_service(core)
+    np.testing.assert_allclose(_decode_serve(service)["w"], [1.0, 2.0])
+
+    core.initialize_parameters(store(w=[7.0, 8.0]))
+    np.testing.assert_allclose(_decode_serve(service)["w"], [7.0, 8.0])
+
+    core.restore(epoch=3, iteration=5, params=store(w=[-1.0, -2.0]))
+    h0, m0 = _cache_counters()
+    np.testing.assert_allclose(_decode_serve(service)["w"], [-1.0, -2.0])
+    np.testing.assert_allclose(_decode_serve(service)["w"], [-1.0, -2.0])
+    h1, m1 = _cache_counters()
+    assert m1 - m0 == 1 and h1 - h0 == 1
+
+
+def test_serve_cache_keys_on_wire_dtype():
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal(512).astype(np.float32)
+    core = ParameterServerCore(total_workers=1)
+    core.initialize_parameters({"w": w})
+    service = _make_service(core)
+    h0, m0 = _cache_counters()
+    f32 = _decode_serve(service, wire_dtype=m.WIRE_F32)
+    bf16 = _decode_serve(service, wire_dtype=m.WIRE_BF16)
+    _decode_serve(service, wire_dtype=m.WIRE_F32)
+    _decode_serve(service, wire_dtype=m.WIRE_BF16)
+    # lossy pull requests serve bf16 (the serve guard) and share its entry
+    topk = _decode_serve(service, wire_dtype=m.WIRE_TOPK)
+    h1, m1 = _cache_counters()
+    assert m1 - m0 == 2 and h1 - h0 == 3
+    np.testing.assert_array_equal(f32["w"], w)
+    np.testing.assert_allclose(bf16["w"], w, rtol=8e-3)
+    np.testing.assert_array_equal(topk["w"], bf16["w"])
+
+
+def test_serve_cache_fill_never_resurrects_superseded_version():
+    """A builder whose encode landed on a version the cache has already
+    moved past must not re-register its (dead) bytes; and a stale probe
+    must not evict a newer version's entry (versions are monotone)."""
+    from parameter_server_distributed_tpu.server.ps_service import (
+        EncodedServeCache)
+
+    cache = EncodedServeCache()
+    e1, b1 = cache.lookup((1, 0, 32))
+    assert b1
+    e3, b3 = cache.lookup((3, 0, 32))  # newer version: v1 entry evicted
+    assert b3
+    cache.fill((3, 0, 32), e3, [b"v3"], 3)
+    # a probe that read version 2 BEFORE the v3 serve registered arrives
+    # late: it must not evict the newer entry
+    cache.lookup((2, 0, 32))
+    assert (3, 0, 32) in cache._entries
+    # the v1 builder's encode actually captured v2 — superseded by v3, so
+    # fill must NOT re-register its dead bytes
+    cache.fill((1, 0, 32), e1, [b"v2"], 2)
+    assert (2, 0, 32) not in [k for k in cache._entries
+                              if cache._entries[k] is e1]
+    assert e1.event.is_set()  # its own waiters still get served
+    entry, builder = cache.lookup((3, 0, 32))
+    assert not builder and entry.bodies == [b"v3"]
+
+
+def test_serve_cache_empty_store_single_empty_chunk():
+    core = ParameterServerCore(total_workers=1)
+    service = _make_service(core)
+    chunks = list(service._parameter_chunks(0, 0))
+    assert len(chunks) == 1
+    decoded = m.ParameterUpdate.decode(chunks[0].encode())
+    assert decoded.ready and not decoded.parameters
+
+
+def test_preencoded_parameter_update_is_byte_identical():
+    """The cache's replayed message must encode byte-identically to the
+    plain ParameterUpdate a reference-shaped peer expects."""
+    from parameter_server_distributed_tpu.rpc.data_plane import (
+        PreEncodedParameterUpdate, encode_parameter_records)
+
+    rng = np.random.default_rng(3)
+    tensors = to_wire({"a": rng.standard_normal((5, 3)).astype(np.float32),
+                       "b": rng.standard_normal(7).astype(np.float32)})
+    plain = m.ParameterUpdate(iteration=9, parameters=tensors,
+                              ready=True).encode()
+    pre = PreEncodedParameterUpdate(
+        9, True, [encode_parameter_records(tensors)]).encode()
+    assert plain == pre
+    # default elision: iteration 0 / ready False elide exactly alike
+    assert (m.ParameterUpdate(iteration=0, parameters=tensors,
+                              ready=False).encode()
+            == PreEncodedParameterUpdate(
+                0, False, [encode_parameter_records(tensors)]).encode())
+
+
+def test_fanout_runs_one_encode_per_version_and_dtype(tmp_path):
+    """Acceptance: N in-process workers' post-barrier fan-out performs
+    exactly ONE to_wire encode per (params version, wire dtype), verified
+    by the cache counters — the other N-1 serves replay cached bytes."""
+    from parameter_server_distributed_tpu.config import ParameterServerConfig
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+
+    n = 4
+    server = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=n,
+        checkpoint_interval=100, checkpoint_dir=str(tmp_path),
+        learning_rate=1.0, autosave_period_s=600.0))
+    port = server.start()
+    w0 = np.linspace(-1, 1, 2048).astype(np.float32)
+    server.core.initialize_parameters({"w": w0})
+    results = {}
+
+    def worker(wid):
+        with PSClient(f"127.0.0.1:{port}") as client:
+            grads = [m.Tensor.from_array("w", np.full_like(w0, 0.5))]
+            results[wid] = client.push_pull(wid, 1, grads)
+
+    try:
+        h0, m0 = _cache_counters()
+        threads = [threading.Thread(target=worker, args=(wid,))
+                   for wid in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(not t.is_alive() for t in threads)
+        h1, m1 = _cache_counters()
+        assert m1 - m0 == 1, f"{m1 - m0} encodes for the fan-out"
+        assert h1 - h0 == n - 1
+        for wid in range(n):
+            push, params = results[wid]
+            assert push.success and params is not None and params.ready
+            np.testing.assert_allclose(params.parameters[0].to_array(),
+                                       w0 - 0.5, rtol=1e-6)
+    finally:
+        server.stop()
+
+
+# ------------------------------------- reference-shaped client equivalence
+
+@pytest.mark.parametrize("mode", ["streaming", "buffered"])
+def test_reference_shaped_unary_client_trains_identically(tmp_path, mode,
+                                                          numpy_only):
+    """A reference-shaped client (the 5 unary RPCs, repeated-float
+    payloads, poll loop) must train to the same parameters in both
+    aggregation modes."""
+    from parameter_server_distributed_tpu.config import ParameterServerConfig
+    from parameter_server_distributed_tpu.rpc.service import RpcClient
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+
+    server = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=2,
+        checkpoint_interval=100, checkpoint_dir=str(tmp_path),
+        learning_rate=1.0, autosave_period_s=600.0, aggregation=mode))
+    port = server.start()
+    rng = np.random.default_rng(7)
+    w0 = rng.standard_normal(128).astype(np.float32)
+    server.core.initialize_parameters({"w": w0})
+    expected = w0.copy()
+    try:
+        with RpcClient(f"127.0.0.1:{port}", m.PARAMETER_SERVER_SERVICE,
+                       m.PARAMETER_SERVER_METHODS) as client:
+            for it in (1, 2, 3):
+                grads = [rng.standard_normal(128).astype(np.float32)
+                         for _ in range(2)]
+                for wid in (0, 1):
+                    push = client.call("ReceiveGradients", m.GradientUpdate(
+                        worker_id=wid, iteration=it,
+                        gradients=[m.Tensor.from_array("w", grads[wid])]))
+                    assert push.success
+                assert push.aggregation_complete
+                sync = client.call("CheckSyncStatus",
+                                   m.SyncStatusRequest(iteration=it))
+                assert sync.ready
+                expected = expected - (grads[0] + grads[1]) * np.float32(0.5)
+                pulled = client.call("ServeParameters",
+                                     m.PullRequest(worker_id=0, iteration=it))
+                np.testing.assert_array_equal(
+                    pulled.parameters[0].to_array(), expected)
+    finally:
+        server.stop()

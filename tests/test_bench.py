@@ -79,6 +79,26 @@ def test_bench_preflight_spaced_retry_then_fallback():
 
 
 @pytest.mark.slow
+def test_bench_aggregate_contract():
+    """aggregate mode: streaming-vs-buffered PS aggregation profile with
+    the acceptance properties visible in the JSON — ~1x model peak
+    gradient memory and one serve encode per version under streaming."""
+    result = run_bench("aggregate", extra_env={
+        "PSDT_BENCH_PARAMS": "2e5",
+        "PSDT_BENCH_WORKER_COUNTS": "2,4",
+        "PSDT_BENCH_STEPS": "2",
+    })
+    assert result["metric"].startswith("ps_aggregate_barrier_close_ms")
+    assert result["value"] > 0
+    streaming, buffered = result["streaming"], result["buffered"]
+    assert streaming["4"]["peak_grad_buffer_x_model"] <= 1.5
+    assert buffered["4"]["peak_grad_buffer_x_model"] >= 3.5
+    # one encode per (version, dtype): 2 iterations -> 2 misses for 8 serves
+    assert streaming["4"]["serve_encodes"] == 2
+    assert streaming["4"]["serves"] == 8
+
+
+@pytest.mark.slow
 def test_bench_serve_contract():
     """serve mode: continuous-batching sustained tokens/s with the int8
     stack applied; the metric must carry the kv8 suffix."""

@@ -41,8 +41,23 @@ def test_mean_over_actual_contributors_not_configured_total():
     np.testing.assert_allclose(ps.get_parameters()["w"], [-3.0])
 
 
-def test_same_worker_push_overwrites_not_double_counts():
-    ps = ParameterServerCore(total_workers=2)
+def test_same_worker_push_never_double_counts():
+    """Duplicate pre-barrier pushes from one worker count ONCE, whatever
+    the aggregation mode.  The documented per-mode policy (docs/training.md)
+    differs in which payload wins: streaming folds on arrival and is
+    first-push-wins (an RPC retry replays an identical payload, so the
+    distinction only shows for a worker that *recomputes* mid-iteration);
+    the buffered escape hatch keeps the original last-push-wins."""
+    ps = ParameterServerCore(total_workers=2, aggregation="streaming")
+    ps.initialize_parameters(store(w=[0.0]))
+    ps.receive_gradients(0, 1, store(w=[2.0]))
+    r = ps.receive_gradients(0, 1, store(w=[100.0]))  # ignored, still 1 worker
+    assert not r.aggregation_complete and r.workers_received == 1
+    assert "duplicate" in r.message
+    ps.receive_gradients(1, 1, store(w=[4.0]))
+    np.testing.assert_allclose(ps.get_parameters()["w"], [-3.0])
+
+    ps = ParameterServerCore(total_workers=2, aggregation="buffered")
     ps.initialize_parameters(store(w=[0.0]))
     ps.receive_gradients(0, 1, store(w=[100.0]))
     r = ps.receive_gradients(0, 1, store(w=[2.0]))  # overwrite, still 1 worker

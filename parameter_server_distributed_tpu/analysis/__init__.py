@@ -1,0 +1,51 @@
+"""Project-specific static analysis + runtime lock-discipline checking.
+
+The concurrency invariants PRs 2-3 introduced — the `_state_lock` →
+`_apply_lock` → `_params_lock` order, apply-outside-lock on the streaming
+barrier close, byte-identical `PreEncodedParameterUpdate` wire encoding —
+used to live only in comments.  This subsystem *checks* them:
+
+- :mod:`lockcheck` — AST lock-discipline pass: discovers
+  ``threading.Lock/RLock/Condition`` attributes per class (and module-level
+  locks), builds the intra-procedural lock-acquisition graph from
+  ``with``-statements and ``acquire()`` calls, and reports lock-order
+  inversions, non-``with`` acquisitions, and blocking calls (RPC, sleep,
+  socket/file I/O, ``Condition.wait``, XLA dispatch) made while holding a
+  lock.
+- :mod:`wirecheck` — wire-compat pass: extracts message field names / tags
+  / kinds and service method tables from ``rpc/messages.py`` +
+  ``rpc/idl.py`` and diffs them against the committed golden manifest
+  (``analysis/wire_manifest.json``) so protocol-breaking edits fail loudly.
+- :mod:`hygiene` — exception-hygiene pass (bare / overbroad ``except``
+  that swallows errors) and thread-hygiene pass (every
+  ``threading.Thread`` must be named and ``daemon=True``; every
+  ``ThreadPoolExecutor`` must set ``thread_name_prefix``).
+- :mod:`lock_order` — the single declared lock-order table, shared by the
+  static pass and the runtime mode: under ``PSDT_LOCK_CHECK=1`` the known
+  locks are wrapped in an order-asserting proxy that records per-thread
+  held-lock sets and raises :class:`~.lock_order.LockOrderError` on an
+  out-of-order acquire.
+- :mod:`runner` — orchestrates all passes over the package, filters
+  findings through the reviewed ``analysis/baseline.json``, and renders
+  text / JSON reports for the ``pst-analyze`` CLI.
+
+Run it: ``pst-analyze`` (or ``python -m
+parameter_server_distributed_tpu.cli.analyze_main``); see docs/analysis.md.
+
+This ``__init__`` stays import-light: ``core/ps_core.py`` imports
+:mod:`lock_order` on every process start, so nothing here may pull in the
+AST passes (or anything beyond stdlib) at import time.
+"""
+
+from __future__ import annotations
+
+__all__ = ["findings", "hygiene", "lock_order", "lockcheck", "runner",
+           "wirecheck"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,101 @@
+"""Finding model + reviewed-baseline handling for the analysis passes.
+
+A :class:`Finding` is one violation, keyed by a *stable* identity
+(``pass_id:path:symbol:slug``) that deliberately excludes line numbers, so
+a baseline entry keeps matching while unrelated edits move code around.
+``analysis/baseline.json`` is the reviewed allowlist: each entry carries
+the key and a one-line justification; ``pst-analyze`` fails on any finding
+NOT in it, and reports baseline entries that no longer match anything so
+the file cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+
+# Pass identifiers (stable — baseline keys embed them)
+LOCK_ORDER = "lock-order"          # inversion against declared order / cycle
+LOCK_RAW_ACQUIRE = "lock-raw-acquire"  # acquire() outside a with-statement
+LOCK_BLOCKING = "lock-blocking"    # blocking call while holding a lock
+EXCEPT_HYGIENE = "except-hygiene"  # bare/overbroad except that swallows
+THREAD_HYGIENE = "thread-hygiene"  # unnamed / non-daemon helper thread
+WIRE_COMPAT = "wire-compat"        # drift against the golden wire manifest
+
+ALL_PASSES = (LOCK_ORDER, LOCK_RAW_ACQUIRE, LOCK_BLOCKING, EXCEPT_HYGIENE,
+              THREAD_HYGIENE, WIRE_COMPAT)
+
+
+@dataclass
+class Finding:
+    pass_id: str
+    path: str        # repo-relative (or "rpc/messages.py" for wire findings)
+    line: int        # 1-based; 0 when not anchored to a source line
+    symbol: str      # "Class.method", "function", or message/field name
+    message: str     # human sentence
+    slug: str = ""   # short stable discriminator within (pass, path, symbol)
+    baselined_by: str | None = field(default=None, compare=False)
+
+    @property
+    def key(self) -> str:
+        parts = [self.pass_id, self.path, self.symbol]
+        if self.slug:
+            parts.append(self.slug)
+        return ":".join(parts)
+
+    def to_json(self) -> dict:
+        d = asdict(self)
+        d["key"] = self.key
+        return d
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"[{self.pass_id}] {loc} ({self.symbol}): {self.message}"
+
+
+@dataclass
+class BaselineEntry:
+    key: str
+    reason: str
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> list[BaselineEntry]:
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    entries = []
+    for raw in doc.get("entries", []):
+        if not raw.get("reason", "").strip():
+            raise ValueError(
+                f"baseline entry {raw.get('key')!r} has no justification — "
+                f"every baselined finding needs a one-line reason")
+        entries.append(BaselineEntry(key=raw["key"], reason=raw["reason"]))
+    return entries
+
+
+def apply_baseline(findings: list[Finding],
+                   entries: list[BaselineEntry]) -> tuple[
+                       list[Finding], list[Finding], list[BaselineEntry]]:
+    """Split into (violations, baselined, stale_entries).  An entry is
+    stale when its key matches no current finding — it should be deleted
+    (the code was fixed, or the key drifted and must be re-reviewed)."""
+    by_key = {e.key: e for e in entries}
+    violations, baselined = [], []
+    matched: set[str] = set()
+    for f in findings:
+        entry = by_key.get(f.key)
+        if entry is not None:
+            f.baselined_by = entry.reason
+            baselined.append(f)
+            matched.add(entry.key)
+        else:
+            violations.append(f)
+    stale = [e for e in entries if e.key not in matched]
+    return violations, baselined, stale

@@ -1,0 +1,176 @@
+"""Exception- and thread-hygiene passes.
+
+**Exception hygiene** — a bare ``except:`` or an overbroad ``except
+Exception/BaseException`` whose body neither re-raises nor surfaces the
+error (logging call, ``print``, ``traceback``, or stashing the exception
+object somewhere) *swallows* failures: in an RPC handler or barrier path
+that converts a crash into a silent hang, which is the worst possible
+failure mode for a synchronous barrier protocol.  A reviewed broad
+handler is annotated in source with ``# noqa: BLE001 — why`` (the
+codebase's existing convention) or ``# pst-analyze: allow``; the pass
+honors both, so the justification lives next to the code it excuses.
+
+**Thread hygiene** — every long-lived helper thread must be *named* (a
+deadlock dump full of ``Thread-7`` is undebuggable; the runtime
+lock-check errors and obs traces print thread names) and ``daemon=True``
+(a forgotten helper must never wedge interpreter shutdown — the reference
+restarts processes on scale events, so clean exit is a real path, not a
+nicety).  Enforced for ``threading.Thread(...)`` constructor kwargs and
+``ThreadPoolExecutor(thread_name_prefix=...)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .findings import EXCEPT_HYGIENE, Finding, THREAD_HYGIENE
+
+_BROAD = ("Exception", "BaseException")
+_SURFACING_CALLS = frozenset({
+    "exception", "error", "warning", "critical", "warn", "print",
+    "print_exc", "format_exc", "fail", "put",  # queue.put(exc): re-surfaced
+})
+_ALLOW_MARKERS = ("noqa", "pst-analyze: allow")
+
+
+def _exc_names(node: ast.expr | None) -> list[str]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Tuple):
+        return [n for elt in node.elts for n in _exc_names(elt)]
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    return []
+
+
+def _surfaces(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises or visibly reports the error."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else "")
+            if name in _SURFACING_CALLS:
+                return True
+    return False
+
+
+def _line_allows(source_lines: list[str], lineno: int) -> bool:
+    if 0 < lineno <= len(source_lines):
+        line = source_lines[lineno - 1]
+        return any(marker in line for marker in _ALLOW_MARKERS)
+    return False
+
+
+def _enclosing_symbols(tree: ast.Module) -> dict[int, str]:
+    """lineno -> enclosing Class.func symbol, for finding labels."""
+    spans: list[tuple[int, int, str]] = []
+
+    def visit(node, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                end = getattr(child, "end_lineno", child.lineno)
+                if not isinstance(child, ast.ClassDef):
+                    spans.append((child.lineno, end, name))
+                visit(child, name)
+
+    visit(tree, "")
+    out: dict[int, str] = {}
+    for start, end, name in sorted(spans):
+        for ln in range(start, end + 1):
+            out[ln] = name  # innermost wins (sorted: later = narrower)
+    return out
+
+
+def check_excepts(source: str, path: str,
+                  tree: ast.Module | None = None,
+                  symbols: dict[int, str] | None = None) -> list[Finding]:
+    if tree is None:
+        tree = ast.parse(source, filename=path)
+    lines = source.splitlines()
+    if symbols is None:
+        symbols = _enclosing_symbols(tree)
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        names = _exc_names(node.type)
+        bare = node.type is None
+        broad = bare or any(n in _BROAD for n in names)
+        if not broad:
+            continue
+        if _surfaces(node):
+            continue
+        if _line_allows(lines, node.lineno):
+            continue
+        symbol = symbols.get(node.lineno, "<module>")
+        what = "bare except:" if bare else f"except {'/'.join(names)}"
+        findings.append(Finding(
+            pass_id=EXCEPT_HYGIENE, path=path, line=node.lineno,
+            symbol=symbol,
+            message=f"{what} swallows the error (no raise/log/report) — "
+                    f"narrow it, surface it, or annotate "
+                    f"'# noqa: BLE001 — <why>' after review",
+            slug=f"{what.replace(' ', '-')}"))
+    return findings
+
+
+def _kwarg(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def check_threads(source: str, path: str,
+                  tree: ast.Module | None = None,
+                  symbols: dict[int, str] | None = None) -> list[Finding]:
+    if tree is None:
+        tree = ast.parse(source, filename=path)
+    if symbols is None:
+        symbols = _enclosing_symbols(tree)
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else "")
+        symbol = symbols.get(node.lineno, "<module>")
+        if name == "Thread":
+            dotted = (f"{func.value.id}.{name}"
+                      if isinstance(func, ast.Attribute)
+                      and isinstance(func.value, ast.Name) else name)
+            if dotted not in ("Thread", "threading.Thread"):
+                continue
+            problems = []
+            daemon = _kwarg(node, "daemon")
+            if not (isinstance(daemon, ast.Constant)
+                    and daemon.value is True):
+                problems.append("daemon=True")
+            if _kwarg(node, "name") is None:
+                problems.append("name=")
+            if problems:
+                findings.append(Finding(
+                    pass_id=THREAD_HYGIENE, path=path, line=node.lineno,
+                    symbol=symbol,
+                    message=f"threading.Thread(...) missing "
+                            f"{' and '.join(problems)} — helper threads "
+                            f"must be named and daemonic",
+                    slug="thread-ctor"))
+        elif name == "ThreadPoolExecutor":
+            if _kwarg(node, "thread_name_prefix") is None:
+                findings.append(Finding(
+                    pass_id=THREAD_HYGIENE, path=path, line=node.lineno,
+                    symbol=symbol,
+                    message="ThreadPoolExecutor(...) missing "
+                            "thread_name_prefix= — pool threads must be "
+                            "identifiable in stack dumps",
+                    slug="executor-ctor"))
+    return findings

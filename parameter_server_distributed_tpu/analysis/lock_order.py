@@ -1,0 +1,184 @@
+"""The declared lock order — one table, checked two ways.
+
+``LOCK_RANKS`` is the project's total order over the known long-lived
+locks: a thread may only acquire a lock whose rank is STRICTLY GREATER
+than every lock it already holds (re-acquiring an RLock it owns is
+exempt).  The static pass (:mod:`lockcheck`) checks every intra-procedural
+acquisition edge against this table; the runtime mode wraps the same locks
+in :class:`CheckedLock` proxies that enforce it live, per thread, under
+the real concurrency tests.
+
+Runtime mode is off by default and costs nothing when off:
+:func:`checked_lock` returns a plain ``threading.Lock`` unless
+``PSDT_LOCK_CHECK=1`` (read at lock creation, i.e. core construction).
+
+``BLOCKING_ALLOWED`` marks locks whose entire PURPOSE is to serialize a
+blocking section (the streaming close's ``_apply_lock``, the checkpoint
+writer's lock, the trainer's XLA dispatch serializer, the native build
+single-flight): the static blocking-while-holding rule skips them, and
+anything else blocking under a lock must be fixed or baselined with a
+justification (docs/analysis.md).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+# Qualified lock name -> rank.  Acquire in ascending rank only.
+# Class-attribute locks are "ClassName._attr"; module-level locks are
+# "module_basename.NAME".
+LOCK_RANKS: dict[str, int] = {
+    # checkpoint writer: holds its lock across core.snapshot()/restore(),
+    # so it must come before every core lock
+    "CheckpointManager._lock": 10,
+    # ps_core (core/ps_core.py): the documented order — _state_lock before
+    # _apply_lock before _params_lock; _apply_lock is never held while
+    # ACQUIRING _state_lock (the streaming closer drops it first)
+    "ParameterServerCore._state_lock": 20,
+    "ParameterServerCore._apply_lock": 30,
+    "ParameterServerCore._params_lock": 40,
+    # leaves: never held while acquiring anything else
+    "ParameterServerCore._live_lock": 50,
+    "EncodedServeCache._lock": 60,
+    "ClusterAggregator._lock": 62,
+    "trainer._DISPATCH_LOCK": 64,
+    "native._lock": 66,
+}
+
+# Locks that exist to serialize a blocking section: the static
+# blocking-while-holding rule does not fire under them.
+BLOCKING_ALLOWED: frozenset[str] = frozenset({
+    # serializes the O(model) scale + optimizer apply OUTSIDE _state_lock
+    # (the documented apply-outside-lock pattern, core/ps_core.py)
+    "ParameterServerCore._apply_lock",
+    # serializes checkpoint file writes (atomic .tmp + os.replace)
+    "CheckpointManager._lock",
+    # serializes trainer XLA dispatch (concurrent dispatch deadlocked the
+    # CPU client — worker/trainer.py)
+    "trainer._DISPATCH_LOCK",
+    # single-flight g++ build of the native kernels
+    "native._lock",
+})
+
+ENV_FLAG = "PSDT_LOCK_CHECK"
+
+
+def runtime_check_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0")
+
+
+class LockOrderError(RuntimeError):
+    """An acquire that violates the declared lock order (runtime mode)."""
+
+
+_tls = threading.local()
+
+
+def _held() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def held_locks() -> tuple[str, ...]:
+    """Qualified names of the locks the calling thread holds, in
+    acquisition order (runtime mode introspection, used by tests)."""
+    return tuple(lock.name for lock in _held())
+
+
+class CheckedLock:
+    """Order-asserting proxy over a ``threading.Lock``/``RLock``.
+
+    Drop-in for the ``with`` protocol, raw ``acquire``/``release``, and
+    ``threading.Condition(lock)`` (which needs only acquire/release plus
+    an optional ``_is_owned``).  Each acquire asserts that every lock the
+    thread already holds ranks strictly below this one; violations raise
+    :class:`LockOrderError` naming the held chain, which is exactly the
+    deadlock witness a hang would never print."""
+
+    __slots__ = ("_lock", "name", "rank", "_reentrant")
+
+    def __init__(self, name: str, rank: int, *, reentrant: bool = False):
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self.name = name
+        self.rank = rank
+        self._reentrant = reentrant
+
+    # ------------------------------------------------------------- checks
+    def _assert_order(self) -> None:
+        stack = _held()
+        worst = None
+        for held in stack:
+            if held is self:
+                if self._reentrant:
+                    return  # RLock re-acquire by the owner: always legal
+                raise LockOrderError(
+                    f"self-deadlock: thread re-acquiring non-reentrant "
+                    f"{self.name} (held: {[h.name for h in stack]})")
+            if held.rank >= self.rank and (worst is None
+                                           or held.rank > worst.rank):
+                worst = held
+        if worst is not None:
+            raise LockOrderError(
+                f"lock-order violation: acquiring {self.name} "
+                f"(rank {self.rank}) while holding {worst.name} "
+                f"(rank {worst.rank}); held: {[h.name for h in stack]} — "
+                f"declared order: analysis/lock_order.py LOCK_RANKS")
+
+    # ------------------------------------------------------ lock protocol
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._assert_order()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _held().append(self)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        stack = _held()
+        # remove the most recent entry for this lock (RLock acquires can
+        # nest, and ps_core's streaming close releases out of LIFO order)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        probe = getattr(self._lock, "locked", None)
+        if probe is not None:
+            return probe()
+        # RLock grows .locked() only in 3.13; emulate: owned by me, or a
+        # non-blocking probe acquire fails (owned by someone else)
+        if self._is_owned():
+            return True
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+    def _is_owned(self) -> bool:
+        # threading.Condition probes this to assert wait()/notify() are
+        # called with the lock held
+        return any(held is self for held in _held())
+
+
+def checked_lock(name: str, *, reentrant: bool = False):
+    """A lock for the known slot ``name`` (a ``LOCK_RANKS`` key): a plain
+    ``threading.Lock``/``RLock`` normally, a :class:`CheckedLock` proxy
+    under ``PSDT_LOCK_CHECK=1``.  Unknown names raise — a new long-lived
+    lock must be placed in the declared order before it ships."""
+    if name not in LOCK_RANKS:
+        raise KeyError(f"lock {name!r} has no declared rank; add it to "
+                       f"analysis/lock_order.py LOCK_RANKS")
+    if not runtime_check_enabled():
+        return threading.RLock() if reentrant else threading.Lock()
+    return CheckedLock(name, LOCK_RANKS[name], reentrant=reentrant)

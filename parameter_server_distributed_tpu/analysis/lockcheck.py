@@ -1,0 +1,443 @@
+"""AST lock-discipline pass.
+
+Per module this pass:
+
+1. **Discovers locks** — ``self._x = threading.Lock()/RLock()`` class
+   attributes, module-level ``NAME = threading.Lock()`` globals, and
+   ``threading.Condition(lock)`` objects (a condition variable is an
+   *alias* of its underlying lock: entering the CV acquires the lock, and
+   ``cv.wait()`` while holding only that lock is the one blocking call
+   that is always legal under it).
+2. **Simulates each function intra-procedurally** — ``with`` statements
+   and raw ``acquire()``/``release()`` calls maintain a per-function
+   held-lock stack (helpers whose docstring says ``caller holds _x`` start
+   with that lock held, matching the codebase's ``*_locked`` convention).
+3. **Reports**:
+   - ``lock-order`` — an acquisition edge that contradicts the declared
+     ranks in :mod:`lock_order`, or participates in a cycle among
+     undeclared locks (built across the whole run);
+   - ``lock-raw-acquire`` — an ``acquire()`` not done via ``with`` (leak
+     on exception unless the surrounding code is carefully hand-rolled);
+   - ``lock-blocking`` — a blocking call (RPC ``.call``, ``time.sleep``,
+     socket/file I/O, ``Condition``/``Event`` ``wait``, subprocess, XLA
+     dispatch / ``jax.*``) while holding a lock, unless the lock is in
+     :data:`lock_order.BLOCKING_ALLOWED` (locks whose purpose is to
+     serialize a blocking section) or the call is a CV waiting on the one
+     lock it owns.
+
+The pass is deliberately intra-procedural: cross-procedure discipline (the
+checkpoint manager holding its lock across ``core.snapshot()``) is what
+the ``PSDT_LOCK_CHECK=1`` runtime mode covers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from . import lock_order
+from .findings import (Finding, LOCK_BLOCKING, LOCK_ORDER, LOCK_RAW_ACQUIRE)
+
+# Fully-dotted call names that block (exact match).
+BLOCKING_EXACT = frozenset({
+    "time.sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output", "subprocess.Popen",
+    "os.replace", "os.remove", "os.rename", "shutil.rmtree", "open",
+    "socket.create_connection",
+})
+
+# Dotted suffixes for project-specific entry points known to block: the
+# live-worker provider is a remote registry RPC (core/ps_core.py
+# barrier_width), and the host optimizer apply is the O(model) compute /
+# XLA dispatch the streaming close exists to move off _state_lock.
+BLOCKING_SUFFIX = ("._live_workers_fn", "._optimizer.apply",
+                   "._block_on_store", ".block_until_ready")
+
+# Terminal method names that block regardless of receiver.
+BLOCKING_METHODS = frozenset({
+    "wait", "wait_for", "sendall", "recv", "recvfrom", "accept", "connect",
+    "call", "device_put", "result",
+})
+
+# Dotted prefixes: any jax dispatch is a device round-trip risk under a
+# lock (the CPU-client deadlock behind trainer._DISPATCH_LOCK).
+BLOCKING_PREFIX = ("jax.", "jnp.")
+
+_CALLER_HOLDS = re.compile(r"caller\s+holds\s+`{0,2}(_\w+)", re.IGNORECASE)
+
+
+@dataclass(frozen=True)
+class LockDecl:
+    qual: str          # "ClassName._attr" or "module._NAME"
+    attr: str          # attribute / global name as written in source
+    reentrant: bool = False
+    cv_of: str | None = None   # set on Condition objects: qual of the lock
+
+
+@dataclass
+class Edge:
+    held: str
+    acquired: str
+    path: str
+    line: int
+    symbol: str
+
+
+@dataclass
+class ModuleLocks:
+    """Locks visible to one module: per-class attr maps + module globals."""
+    by_class: dict[str, dict[str, LockDecl]] = field(default_factory=dict)
+    module: dict[str, LockDecl] = field(default_factory=dict)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _lock_ctor(node: ast.AST) -> tuple[str, ast.Call, str | None] | None:
+    """("Lock"|"RLock"|"Condition", call, qual_override) when ``node``
+    constructs a lock.  ``checked_lock("Qual", ...)`` (the runtime-mode
+    factory from :mod:`lock_order`) counts too, and its declared-name
+    string argument is authoritative for the lock's qualified name."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = _dotted(node.func)
+    if name in ("threading.Lock", "threading.RLock", "threading.Condition"):
+        return name.rsplit(".", 1)[1], node, None
+    if name and name.rsplit(".", 1)[-1] == "checked_lock":
+        reentrant = any(kw.arg == "reentrant"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in node.keywords)
+        qual = (node.args[0].value if node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str) else None)
+        return ("RLock" if reentrant else "Lock"), node, qual
+    return None
+
+
+def _discover(tree: ast.Module, modbase: str) -> ModuleLocks:
+    locks = ModuleLocks()
+
+    def note(scope: dict[str, LockDecl], owner: str, attr: str,
+             kind: str, call: ast.Call, qual: str | None) -> None:
+        cv_of = None
+        if kind == "Condition" and call.args:
+            target = _dotted(call.args[0])
+            if target and target.startswith("self."):
+                held = scope.get(target[len("self."):])
+                cv_of = held.qual if held else f"{owner}.{target[5:]}"
+        scope[attr] = LockDecl(qual=qual or f"{owner}.{attr}", attr=attr,
+                               reentrant=(kind == "RLock"), cv_of=cv_of)
+
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            ctor = _lock_ctor(stmt.value)
+            if ctor:
+                note(locks.module, modbase, stmt.targets[0].id, *ctor)
+        if isinstance(stmt, ast.ClassDef):
+            attrs: dict[str, LockDecl] = {}
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target = _dotted(node.targets[0])
+                    if not (target and target.startswith("self.")):
+                        continue
+                    ctor = _lock_ctor(node.value)
+                    if ctor:
+                        note(attrs, stmt.name, target[len("self."):], *ctor)
+            if attrs:
+                locks.by_class[stmt.name] = attrs
+    return locks
+
+
+@dataclass
+class _Held:
+    decl: LockDecl
+    via_with: bool
+    via_cv: bool = False
+
+
+class _FunctionSim:
+    """Statement-ordered simulation of one function body."""
+
+    def __init__(self, pass_state: "_PassState", cls: str | None,
+                 func: ast.FunctionDef | ast.AsyncFunctionDef):
+        self.st = pass_state
+        self.cls = cls
+        self.symbol = f"{cls}.{func.name}" if cls else func.name
+        self.held: list[_Held] = []
+        doc = ast.get_docstring(func) or ""
+        for attr in _CALLER_HOLDS.findall(doc):
+            decl = self._resolve_attr(attr)
+            if decl is not None:
+                self.held.append(_Held(decl, via_with=True))
+
+    # ------------------------------------------------------------ resolve
+    def _resolve_attr(self, attr: str) -> LockDecl | None:
+        if self.cls:
+            decl = self.st.locks.by_class.get(self.cls, {}).get(attr)
+            if decl:
+                return decl
+        return self.st.locks.module.get(attr)
+
+    def _resolve_expr(self, node: ast.AST) -> LockDecl | None:
+        name = _dotted(node)
+        if name is None:
+            return None
+        if name.startswith("self."):
+            return self._resolve_attr(name[len("self."):])
+        if "." not in name:
+            return self.st.locks.module.get(name)
+        return None
+
+    # ------------------------------------------------------------- events
+    def _effective(self, decl: LockDecl) -> LockDecl:
+        """A CV stands for its underlying lock when it has one."""
+        if decl.cv_of is not None:
+            for scope in (self.st.locks.by_class.get(self.cls or "", {}),
+                          self.st.locks.module):
+                for other in scope.values():
+                    if other.qual == decl.cv_of:
+                        return other
+        return decl
+
+    def _acquire(self, decl: LockDecl, node: ast.AST, via_with: bool) -> None:
+        eff = self._effective(decl)
+        for h in self.held:
+            if h.decl.qual == eff.qual and not eff.reentrant:
+                self.st.finding(LOCK_ORDER, node, self.symbol,
+                                f"self-deadlock: {eff.qual} acquired while "
+                                f"already held in this function",
+                                slug=f"self:{eff.qual}")
+            elif h.decl.qual != eff.qual:
+                self.st.edge(h.decl.qual, eff.qual, node, self.symbol)
+        if not via_with:
+            self.st.finding(
+                LOCK_RAW_ACQUIRE, node, self.symbol,
+                f"{eff.qual} acquired via .acquire() instead of a with-"
+                f"statement (leaks on exception unless hand-rolled "
+                f"try/finally is airtight)",
+                slug=eff.qual)
+        self.held.append(_Held(eff, via_with=via_with,
+                               via_cv=decl.cv_of is not None))
+
+    def _release(self, decl: LockDecl) -> None:
+        eff = self._effective(decl)
+        for i in range(len(self.held) - 1, -1, -1):
+            if self.held[i].decl.qual == eff.qual:
+                del self.held[i]
+                return
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        if not self.held:
+            return
+        name = _dotted(node.func)
+        if name is None:
+            return
+        terminal = name.rsplit(".", 1)[-1]
+        blocking = (name in BLOCKING_EXACT
+                    or name.startswith(BLOCKING_PREFIX)
+                    or any(name.endswith(s) for s in BLOCKING_SUFFIX)
+                    or terminal in BLOCKING_METHODS)
+        if not blocking:
+            return
+        if terminal in ("wait", "wait_for") and isinstance(node.func,
+                                                           ast.Attribute):
+            # cv.wait() releases its own lock while parked: legal iff that
+            # lock is the ONLY one held
+            decl = self._resolve_expr(node.func.value)
+            if decl is not None and decl.cv_of is not None:
+                eff = self._effective(decl)
+                if (len(self.held) == 1
+                        and self.held[0].decl.qual == eff.qual):
+                    return
+        if terminal == "join" and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Constant):
+            return  # "sep".join(...) — string, not a thread
+        offenders = [h.decl.qual for h in self.held
+                     if h.decl.qual not in lock_order.BLOCKING_ALLOWED]
+        if not offenders:
+            return
+        self.st.finding(
+            LOCK_BLOCKING, node, self.symbol,
+            f"blocking call {name}() while holding "
+            f"{', '.join(offenders)} — move it outside the lock or "
+            f"justify in the baseline",
+            slug=f"{name}:{offenders[-1]}")
+
+    # --------------------------------------------------------------- walk
+    def run(self, func: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        for stmt in func.body:
+            self._stmt(stmt)
+
+    def _stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later on some other stack — simulate fresh
+            self.st.function(self.cls, node)
+            return
+        if isinstance(node, ast.ClassDef):
+            return
+        if isinstance(node, ast.With):
+            entered: list[LockDecl] = []
+            for item in node.items:
+                self._expr(item.context_expr)
+                decl = self._resolve_expr(item.context_expr)
+                if decl is not None:
+                    self._acquire(decl, item.context_expr, via_with=True)
+                    entered.append(decl)
+            for inner in node.body:
+                self._stmt(inner)
+            for decl in reversed(entered):
+                self._release(decl)
+            return
+        if isinstance(node, ast.Try):
+            for inner in node.body:
+                self._stmt(inner)
+            for handler in node.handlers:
+                for inner in handler.body:
+                    self._stmt(inner)
+            for inner in node.orelse:
+                self._stmt(inner)
+            for inner in node.finalbody:
+                self._stmt(inner)
+            return
+        # compound statements: evaluate test/iter expressions, then bodies
+        for fname, value in ast.iter_fields(node):
+            if fname in ("body", "orelse", "finalbody"):
+                for inner in value:
+                    self._stmt(inner)
+            elif isinstance(value, ast.AST):
+                self._expr(value)
+            elif isinstance(value, list):
+                for item in value:
+                    if isinstance(item, ast.AST):
+                        self._expr(item)
+
+    def _expr(self, node: ast.AST) -> None:
+        for call in [n for n in ast.walk(node) if isinstance(n, ast.Call)]:
+            name = _dotted(call.func)
+            if name and name.endswith(".acquire"):
+                decl = self._resolve_expr(call.func.value)
+                if decl is not None:
+                    self._acquire(decl, call, via_with=False)
+                    continue
+            if name and name.endswith(".release"):
+                decl = self._resolve_expr(call.func.value)
+                if decl is not None:
+                    self._release(decl)
+                    continue
+            self._check_blocking(call)
+
+
+class _PassState:
+    def __init__(self, path: str, locks: ModuleLocks):
+        self.path = path
+        self.locks = locks
+        self.findings: list[Finding] = []
+        self.edges: list[Edge] = []
+
+    def finding(self, pass_id: str, node: ast.AST, symbol: str,
+                message: str, slug: str) -> None:
+        self.findings.append(Finding(
+            pass_id=pass_id, path=self.path,
+            line=getattr(node, "lineno", 0), symbol=symbol,
+            message=message, slug=slug))
+
+    def edge(self, held: str, acquired: str, node: ast.AST,
+             symbol: str) -> None:
+        self.edges.append(Edge(held=held, acquired=acquired, path=self.path,
+                               line=getattr(node, "lineno", 0),
+                               symbol=symbol))
+
+    def function(self, cls: str | None,
+                 func: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        _FunctionSim(self, cls, func).run(func)
+
+
+def analyze_module(source: str, path: str,
+                   modbase: str | None = None,
+                   tree: ast.Module | None = None) -> tuple[list[Finding],
+                                                            list[Edge]]:
+    """Run the lock pass over one module.  Returns (findings, edges);
+    edge ordering is checked by :func:`check_edges` once all modules have
+    contributed (cycles can span functions)."""
+    if modbase is None:
+        parts = path.replace("\\", "/").split("/")
+        modbase = parts[-1].removesuffix(".py")
+        if modbase == "__init__" and len(parts) > 1:
+            modbase = parts[-2]  # package/__init__.py locks are "package.X"
+    if tree is None:
+        tree = ast.parse(source, filename=path)
+    st = _PassState(path, _discover(tree, modbase))
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            st.function(None, stmt)
+        elif isinstance(stmt, ast.ClassDef):
+            for inner in stmt.body:
+                if isinstance(inner, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    st.function(stmt.name, inner)
+    return st.findings, st.edges
+
+
+def check_edges(edges: list[Edge]) -> list[Finding]:
+    """Order findings from the accumulated acquisition graph: declared-rank
+    contradictions, plus cycles among locks outside the declared table."""
+    findings: list[Finding] = []
+    graph: dict[str, set[str]] = {}
+    samples: dict[tuple[str, str], Edge] = {}
+    for e in edges:
+        r_held = lock_order.LOCK_RANKS.get(e.held)
+        r_acq = lock_order.LOCK_RANKS.get(e.acquired)
+        if r_held is not None and r_acq is not None:
+            if r_held >= r_acq:
+                findings.append(Finding(
+                    pass_id=LOCK_ORDER, path=e.path, line=e.line,
+                    symbol=e.symbol,
+                    message=f"lock-order inversion: {e.acquired} "
+                            f"(rank {r_acq}) acquired while holding "
+                            f"{e.held} (rank {r_held}); declared order: "
+                            f"analysis/lock_order.py",
+                    slug=f"{e.held}->{e.acquired}"))
+            continue
+        graph.setdefault(e.held, set()).add(e.acquired)
+        samples.setdefault((e.held, e.acquired), e)
+
+    # cycle detection over the undeclared part of the graph
+    def reachable(src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            node = stack.pop()
+            if node == dst:
+                return True
+            if node in seen:
+                continue
+            seen.add(node)
+            stack.extend(graph.get(node, ()))
+        return False
+
+    flagged: set[tuple[str, str]] = set()
+    for (held, acquired), e in samples.items():
+        if (acquired, held) in flagged:
+            continue
+        if reachable(acquired, held):
+            flagged.add((held, acquired))
+            findings.append(Finding(
+                pass_id=LOCK_ORDER, path=e.path, line=e.line,
+                symbol=e.symbol,
+                message=f"lock-order cycle: {e.acquired} acquired under "
+                        f"{e.held}, but {e.held} is also reachable under "
+                        f"{e.acquired} — pick one order and declare it in "
+                        f"analysis/lock_order.py",
+                slug=f"cycle:{e.held}<->{e.acquired}"))
+    return findings

@@ -1,0 +1,145 @@
+"""Pass orchestration + report rendering for ``pst-analyze``.
+
+Walks every ``.py`` file of the package (or any root you point it at),
+runs the AST passes per file, the wire-compat pass once, folds the
+acquisition-graph edges into order findings, then filters through the
+reviewed baseline.  Exit contract (consumed by scripts/analyze.sh and the
+gate test in tests/test_analysis.py): 0 = clean (all findings baselined),
+1 = non-baselined violations, and stale baseline entries are reported but
+do not fail the run (they are a cleanup prompt, not a regression).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+
+from . import hygiene, lockcheck, wirecheck
+from .findings import (BaselineEntry, Finding, apply_baseline,
+                       load_baseline)
+
+# Directories never analyzed: generated build output only.
+_SKIP_DIRS = {"build", "__pycache__"}
+
+
+def package_root() -> str:
+    """The installed package directory — the default analysis root."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@dataclass
+class Report:
+    root: str
+    files: int = 0
+    violations: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    stale_baseline: list[BaselineEntry] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.errors
+
+    def to_json(self) -> dict:
+        return {
+            "root": self.root,
+            "files": self.files,
+            "ok": self.ok,
+            "violations": [f.to_json() for f in self.violations],
+            "baselined": [f.to_json() for f in self.baselined],
+            "stale_baseline": [{"key": e.key, "reason": e.reason}
+                               for e in self.stale_baseline],
+            "errors": self.errors,
+        }
+
+    def render(self) -> str:
+        lines = [f"pst-analyze: {self.files} files under {self.root}"]
+        for f in self.violations:
+            lines.append("  " + f.render())
+        for err in self.errors:
+            lines.append(f"  [error] {err}")
+        if self.baselined:
+            lines.append(f"  {len(self.baselined)} finding(s) baselined "
+                         f"(analysis/baseline.json)")
+        for e in self.stale_baseline:
+            lines.append(f"  [stale-baseline] {e.key} matches nothing — "
+                         f"delete the entry (reason was: {e.reason})")
+        lines.append("OK: no non-baselined violations" if self.ok else
+                     f"FAIL: {len(self.violations)} violation(s)")
+        return "\n".join(lines)
+
+
+def _iter_sources(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
+def analyze_file(path: str, rel: str) -> tuple[list[Finding],
+                                               list[lockcheck.Edge]]:
+    """All AST passes over one file (shared by the runner and the fixture
+    tests, which feed synthetic sources through the same entry points)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    return analyze_source(source, rel)
+
+
+def analyze_source(source: str, rel: str) -> tuple[list[Finding],
+                                                   list[lockcheck.Edge]]:
+    # one parse + one symbol map, shared by all three AST passes
+    tree = ast.parse(source, filename=rel)
+    symbols = hygiene._enclosing_symbols(tree)
+    findings, edges = lockcheck.analyze_module(source, rel, tree=tree)
+    findings += hygiene.check_excepts(source, rel, tree=tree,
+                                      symbols=symbols)
+    findings += hygiene.check_threads(source, rel, tree=tree,
+                                      symbols=symbols)
+    return findings, edges
+
+
+def run(root: str | None = None,
+        baseline_path: str | None = None,
+        manifest_path: str | None = None,
+        wire: bool = True) -> Report:
+    root = os.path.abspath(root or package_root())
+    report = Report(root=root)
+    if not os.path.isdir(root):
+        report.errors.append(f"analysis root {root} is not a directory")
+        return report
+    findings: list[Finding] = []
+    edges: list[lockcheck.Edge] = []
+    repo_prefix = os.path.dirname(root)
+    for path in _iter_sources(root):
+        rel = os.path.relpath(path, repo_prefix).replace(os.sep, "/")
+        report.files += 1
+        try:
+            file_findings, file_edges = analyze_file(path, rel)
+        except (SyntaxError, ValueError) as exc:
+            report.errors.append(f"{rel}: {exc}")
+            continue
+        findings += file_findings
+        edges += file_edges
+    findings += lockcheck.check_edges(edges)
+    if wire:
+        try:
+            findings += wirecheck.run(manifest_path)
+        except Exception as exc:  # noqa: BLE001 — analyzer must report,
+            # not crash: a broken rpc import IS the finding
+            report.errors.append(f"wire-compat pass failed: {exc}")
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id, f.slug))
+    try:
+        entries = load_baseline(baseline_path)
+    except ValueError as exc:
+        report.errors.append(str(exc))
+        entries = []
+    (report.violations, report.baselined,
+     report.stale_baseline) = apply_baseline(findings, entries)
+    return report
+
+
+def to_json_str(report: Report) -> str:
+    return json.dumps(report.to_json(), indent=1, sort_keys=True)

@@ -1,0 +1,187 @@
+"""Wire-compat pass: the protocol contract as data, diffed every run.
+
+``rpc/messages.py`` is the single source of truth for the wire format the
+reference's C++ peers speak; an innocent-looking edit there (renumbering a
+field, changing a kind, dropping a method) silently corrupts interop
+instead of failing a test.  This pass extracts the full contract —
+message field names/tags/kinds, service method tables, wire-dtype and
+trace-field constants, and the ``rpc/idl.py`` package layout — into a
+manifest dict, and diffs it against the committed golden
+``analysis/wire_manifest.json``.
+
+Any drift is a ``wire-compat`` finding.  Deliberate protocol changes are
+made loudly: edit the schema, re-run ``pst-analyze --write-wire-manifest``,
+and commit the regenerated manifest alongside the change (docs/analysis.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .findings import Finding, WIRE_COMPAT
+
+MANIFEST_VERSION = 1
+
+_MESSAGES_PATH = "parameter_server_distributed_tpu/rpc/messages.py"
+_IDL_PATH = "parameter_server_distributed_tpu/rpc/idl.py"
+
+
+def default_manifest_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "wire_manifest.json")
+
+
+def _field_spec(f) -> dict:
+    spec = {"name": f.name, "kind": f.kind, "repeated": bool(f.repeated)}
+    if f.message_type is not None:
+        spec["message_type"] = f.message_type.__name__
+    return spec
+
+
+def _method_table(table: dict) -> dict:
+    out = {}
+    for method, entry in table.items():
+        req, resp = entry[0], entry[1]
+        style = entry[2] if len(entry) > 2 else "unary"
+        out[method] = {"request": req.__name__, "response": resp.__name__,
+                       "style": style}
+    return out
+
+
+def build_manifest() -> dict:
+    """The current wire contract, extracted live from rpc.messages +
+    rpc.idl (importing them IS the extraction: the declarative schemas are
+    the data)."""
+    from ..rpc import idl
+    from ..rpc import messages as m
+    from ..rpc.wire import Message
+
+    messages = {}
+    for name, obj in sorted(vars(m).items()):
+        if (isinstance(obj, type) and issubclass(obj, Message)
+                and obj is not Message and obj.__module__ == m.__name__):
+            messages[name] = {
+                "fields": {str(f.number): _field_spec(f)
+                           for f in obj.FIELDS}}
+
+    services = {
+        "parameter_server.ParameterServer": {
+            "reference_methods": _method_table(m.PARAMETER_SERVER_METHODS),
+            "extension_methods": _method_table(
+                m.PARAMETER_SERVER_STREAM_METHODS),
+        },
+        "coordinator.Coordinator": {
+            "reference_methods": _method_table(m.COORDINATOR_METHODS),
+            "extension_methods": _method_table(m.COORDINATOR_EXT_METHODS),
+        },
+    }
+
+    constants = {
+        "PARAMETER_SERVER_SERVICE": m.PARAMETER_SERVER_SERVICE,
+        "COORDINATOR_SERVICE": m.COORDINATOR_SERVICE,
+        "TRACE_FIELD_NUMBER": m.TRACE_FIELD_NUMBER,
+        "DTYPE_FLOAT32": m.DTYPE_FLOAT32,
+        "DTYPE_FLOAT64": m.DTYPE_FLOAT64,
+        "WIRE_DTYPES": {name: value
+                        for name, value in sorted(m.WIRE_DTYPE_NAMES.items())},
+    }
+
+    idl_packages = {}
+    for package, spec in idl.PACKAGES.items():
+        service_name, methods = spec["service"]
+        idl_packages[package] = {
+            "service": service_name,
+            "methods": sorted(methods),
+            "messages": sorted(cls.__name__ for cls in spec["messages"]),
+            "enums": {enum.__name__: {str(v): n
+                                      for v, n in sorted(enum._NAMES.items())}
+                      for enum in spec["enums"]},
+        }
+
+    return {"version": MANIFEST_VERSION, "messages": messages,
+            "services": services, "constants": constants,
+            "idl": idl_packages}
+
+
+def write_manifest(path: str | None = None) -> str:
+    path = path or default_manifest_path()
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(build_manifest(), fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_manifest(path: str | None = None) -> dict | None:
+    path = path or default_manifest_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _finding(path: str, symbol: str, message: str, slug: str) -> Finding:
+    return Finding(pass_id=WIRE_COMPAT, path=path, line=0, symbol=symbol,
+                   message=message, slug=slug)
+
+
+def _diff_tree(golden, current, path: str, symbol: str,
+               out: list[Finding]) -> None:
+    """Structural diff of nested dict/scalar manifest sections.  Each leaf
+    difference is its own finding so one renumbered field reads as exactly
+    that, not as a wall of JSON."""
+    if isinstance(golden, dict) and isinstance(current, dict):
+        for key in golden:
+            if key not in current:
+                out.append(_finding(
+                    path, symbol,
+                    f"{symbol}.{key} removed (golden manifest has it) — a "
+                    f"reference peer still sends/expects it",
+                    slug=f"{symbol}.{key}:removed"))
+            else:
+                _diff_tree(golden[key], current[key], path,
+                           f"{symbol}.{key}", out)
+        for key in current:
+            if key not in golden:
+                out.append(_finding(
+                    path, symbol,
+                    f"{symbol}.{key} added but not in the golden manifest "
+                    f"— regenerate it (pst-analyze --write-wire-manifest) "
+                    f"if the addition is deliberate",
+                    slug=f"{symbol}.{key}:added"))
+        return
+    if golden != current:
+        out.append(_finding(
+            path, symbol,
+            f"{symbol} changed: golden {golden!r} -> current {current!r}",
+            slug=f"{symbol}:changed"))
+
+
+def diff_manifests(golden: dict, current: dict) -> list[Finding]:
+    findings: list[Finding] = []
+    if golden.get("version") != current.get("version"):
+        findings.append(_finding(
+            _MESSAGES_PATH, "manifest",
+            f"manifest version drift: golden "
+            f"{golden.get('version')} vs current {current.get('version')}",
+            slug="version"))
+    for section, path in (("messages", _MESSAGES_PATH),
+                          ("services", _MESSAGES_PATH),
+                          ("constants", _MESSAGES_PATH),
+                          ("idl", _IDL_PATH)):
+        _diff_tree(golden.get(section, {}), current.get(section, {}),
+                   path, section, findings)
+    return findings
+
+
+def run(manifest_path: str | None = None) -> list[Finding]:
+    """The pass: diff the live contract against the committed golden
+    manifest.  A missing golden file is itself a finding — the contract
+    must be pinned, not merely unchanged."""
+    golden = load_manifest(manifest_path)
+    if golden is None:
+        return [_finding(
+            _MESSAGES_PATH, "manifest",
+            "golden wire manifest missing — run "
+            "pst-analyze --write-wire-manifest and commit the result",
+            slug="missing")]
+    return diff_manifests(golden, build_manifest())

@@ -19,6 +19,7 @@ from typing import Callable
 
 import numpy as np
 
+from ..analysis.lock_order import checked_lock
 from ..core.ps_core import ParameterServerCore
 from . import codec
 
@@ -49,8 +50,11 @@ class CheckpointManager:
         self._thread: threading.Thread | None = None
         # RLock: save() locks itself AND is called by maybe_autosave() under
         # the same lock — an on-demand SaveCheckpoint RPC racing the autosave
-        # daemon must not interleave writes on the same .tmp file.
-        self._lock = threading.RLock()
+        # daemon must not interleave writes on the same .tmp file.  Held
+        # across core.snapshot()/restore(), so it ranks BEFORE every core
+        # lock (analysis/lock_order.py; order-asserted under
+        # PSDT_LOCK_CHECK=1).
+        self._lock = checked_lock("CheckpointManager._lock", reentrant=True)
 
     # ----------------------------------------------------------- daemon
     def start(self) -> None:

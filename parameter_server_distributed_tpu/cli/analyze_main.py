@@ -1,0 +1,53 @@
+"""`pst-analyze`: the project's concurrency & wire-protocol analyzer.
+
+    python -m parameter_server_distributed_tpu.cli.analyze_main \
+        [root_dir] [--json] [--baseline=PATH] [--manifest=PATH] \
+        [--no-wire] [--write-wire-manifest]
+
+Runs the static passes (lock discipline, exception hygiene, thread
+hygiene) over the package source and diffs the live wire contract against
+the golden manifest (analysis/wire_manifest.json).  Exit 0 when every
+finding is covered by the reviewed baseline (analysis/baseline.json),
+1 otherwise — wire this into CI next to the tier-1 tests
+(scripts/analyze.sh).  See docs/analysis.md for the pass catalogue, the
+declared lock-order table, and the baseline / manifest workflows.
+
+``--write-wire-manifest`` regenerates the golden manifest from the
+current schemas and exits — run it (and commit the result) as part of any
+deliberate protocol change.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..config import parse_argv
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    positional, flags = parse_argv(argv)
+
+    from ..analysis import runner, wirecheck
+
+    manifest_path = flags.get("manifest") or None
+    if "write-wire-manifest" in flags:
+        path = wirecheck.write_manifest(manifest_path)
+        print(f"wire manifest written: {path}")
+        return 0
+
+    report = runner.run(
+        root=positional[0] if positional else None,
+        baseline_path=flags.get("baseline") or None,
+        manifest_path=manifest_path,
+        wire="no-wire" not in flags,
+    )
+    if "json" in flags:
+        print(runner.to_json_str(report))
+    else:
+        print(report.render())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

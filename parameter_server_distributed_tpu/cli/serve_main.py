@@ -188,7 +188,8 @@ def main(argv: list[str] | None = None) -> int:
     default_max_new = int(flags.get("default-max-new", "64"))
 
     in_q: "queue.Queue[dict | None]" = queue.Queue()
-    threading.Thread(target=_reader, args=(in_q,), daemon=True).start()
+    threading.Thread(target=_reader, args=(in_q,), daemon=True,
+                     name="pst-serve-stdin").start()
 
     pending: list[dict] = []          # parsed, awaiting a free slot
     fused_rounds = int(flags.get("fused-rounds", "1"))

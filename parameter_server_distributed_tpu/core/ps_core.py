@@ -62,6 +62,7 @@ from typing import Callable, Mapping
 
 import numpy as np
 
+from ..analysis.lock_order import checked_lock
 from ..obs import stats as obs_stats
 from .optimizer import HostOptimizer, SGD
 from .tensor import TensorStore, store_nbytes, tree_like
@@ -199,12 +200,18 @@ class ParameterServerCore:
                              f"options: {AGGREGATION_MODES}")
         self._aggregation = mode
         self._params: TensorStore = {}
-        self._params_lock = threading.Lock()   # reference: params_mutex_ (h:44)
-        self._state_lock = threading.Lock()    # reference: state_mutex_ (h:52)
+        # Locks come from the analysis subsystem's factory: plain
+        # threading.Lock normally, an order-asserting CheckedLock proxy
+        # under PSDT_LOCK_CHECK=1 (analysis/lock_order.py — the declared
+        # rank table the static analyzer checks is enforced live).
+        self._params_lock = checked_lock(
+            "ParameterServerCore._params_lock")  # reference: params_mutex_ (h:44)
+        self._state_lock = checked_lock(
+            "ParameterServerCore._state_lock")   # reference: state_mutex_ (h:52)
         # Serializes streaming-mode barrier applies, which run OUTSIDE
         # _state_lock so pushes/polls for other iterations proceed during
         # the optimizer apply.  Never held while acquiring _state_lock.
-        self._apply_lock = threading.Lock()
+        self._apply_lock = checked_lock("ParameterServerCore._apply_lock")
         # Barrier-completion broadcast over _state_lock: the fused data
         # plane (PushPullStream) parks here and is woken the instant an
         # aggregation fires, instead of being polled at 20 Hz like the
@@ -222,7 +229,7 @@ class ParameterServerCore:
         # one thread refreshes per expiry; the others briefly queue and
         # read the fresh value (they would have paid their own remote
         # round-trip otherwise).
-        self._live_lock = threading.Lock()
+        self._live_lock = checked_lock("ParameterServerCore._live_lock")
         self._optimizer = optimizer or SGD(learning_rate=1.0)
         self._staleness_bound = int(staleness_bound)
         self._gc_iterations = int(gc_iterations)

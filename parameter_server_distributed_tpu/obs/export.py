@@ -14,10 +14,10 @@ telemetry elastic-membership and quantized-transport tuning need
 from __future__ import annotations
 
 import json
-import threading
 import time
 from typing import Any
 
+from ..analysis.lock_order import checked_lock
 from .stats import REGISTRY, percentile_from
 
 # step-phase histograms recorded by worker/worker.py, in display order
@@ -131,7 +131,9 @@ class ClusterAggregator:
     worker's stale numbers do not skew the straggler spread forever."""
 
     def __init__(self, ttl_s: float = 120.0):
-        self._lock = threading.Lock()
+        # leaf rank: held only around snapshot-dict ops
+        # (analysis/lock_order.py; order-asserted under PSDT_LOCK_CHECK=1)
+        self._lock = checked_lock("ClusterAggregator._lock")
         self._snaps: dict[int, dict] = {}
         self._ttl_s = ttl_s
 

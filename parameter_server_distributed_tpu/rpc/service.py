@@ -181,7 +181,8 @@ CHANNEL_OPTIONS = [
 
 def make_server(max_workers: int = 8) -> grpc.Server:
     return grpc.server(
-        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
+        concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="rpc-handler"),
         options=CHANNEL_OPTIONS)
 
 

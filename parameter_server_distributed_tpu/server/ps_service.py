@@ -31,6 +31,7 @@ from typing import Callable
 
 import grpc
 
+from ..analysis.lock_order import checked_lock
 from ..checkpoint.manager import CheckpointManager
 from ..config import ParameterServerConfig
 from ..core.optimizer import make_optimizer
@@ -69,7 +70,9 @@ class EncodedServeCache:
     (one per requested wire dtype)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # leaf rank: held only around dict ops, never while acquiring a
+        # core lock (analysis/lock_order.py)
+        self._lock = checked_lock("EncodedServeCache._lock")
         self._entries: dict[tuple, _ServeCacheEntry] = {}
 
     def lookup(self, key: tuple) -> tuple[_ServeCacheEntry, bool]:

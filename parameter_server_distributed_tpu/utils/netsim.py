@@ -77,7 +77,8 @@ class ThrottledRelay:
         listener.bind((self.target[0], 0))
         listener.listen(64)
         self._listener = listener
-        accept = threading.Thread(target=self._accept_loop, daemon=True)
+        accept = threading.Thread(target=self._accept_loop, daemon=True,
+                                  name="netsim-accept")
         accept.start()
         self._threads.append(accept)
         return listener.getsockname()[1]
@@ -153,6 +154,7 @@ class ThrottledRelay:
                 pass
 
         for fn in (reader, writer):
-            t = threading.Thread(target=fn, daemon=True)
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"netsim-{fn.__name__}")
             t.start()
             self._threads.append(t)

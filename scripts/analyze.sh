@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Static-analysis gate: run pst-analyze over the package and fail on any
+# non-baselined violation.  Wire this next to the tier-1 test run in CI.
+#
+#   scripts/analyze.sh            # human-readable report
+#   scripts/analyze.sh --json     # machine-readable (dashboards, CI annot.)
+#
+# Extra args pass straight through to pst-analyze (e.g. --no-wire,
+# --baseline=..., --write-wire-manifest).  See docs/analysis.md.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m parameter_server_distributed_tpu.cli.analyze_main "$@"

@@ -27,3 +27,17 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_env(request, monkeypatch):
+    """Opt-in runtime lock-discipline checking: tests marked
+    ``@pytest.mark.lockcheck`` run with PSDT_LOCK_CHECK=1, so the known
+    locks (core/ps_core.py, checkpoint/manager.py, server/ps_service.py,
+    obs/export.py) are constructed as order-asserting proxies and any
+    lock-order violation raises LockOrderError instead of deadlocking
+    (analysis/lock_order.py, docs/analysis.md).  The env var is read at
+    lock construction, which happens inside the test body — after this
+    fixture has set it."""
+    if request.node.get_closest_marker("lockcheck"):
+        monkeypatch.setenv("PSDT_LOCK_CHECK", "1")

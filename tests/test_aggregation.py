@@ -214,6 +214,7 @@ class _SlowSGD(SGD):
         return super().apply(params, grads)
 
 
+@pytest.mark.lockcheck
 def test_streaming_apply_runs_outside_state_lock():
     """While iteration N's barrier apply is in flight (the "aggregating"
     phase), a push for iteration N+1 and a sync poll must NOT block
@@ -246,6 +247,7 @@ def test_streaming_apply_runs_outside_state_lock():
     np.testing.assert_allclose(ps.get_parameters()["w"], [9.0])
 
 
+@pytest.mark.lockcheck
 def test_push_during_aggregating_window_reports_incomplete():
     """A commit that lands while the barrier close is mid-apply must not
     claim completion: the params are not applied yet, and the worker must
@@ -284,6 +286,7 @@ class _FlakySGD(SGD):
         return super().apply(params, grads)
 
 
+@pytest.mark.lockcheck
 @pytest.mark.parametrize("mode", ["streaming", "buffered"])
 def test_failed_barrier_apply_is_retryable(numpy_only, mode):
     """An optimizer apply that raises at barrier close must not wedge the
@@ -331,6 +334,7 @@ def test_failed_fold_is_not_marked_folded():
     np.testing.assert_allclose(ps.get_parameters()["w"], [-3.0, -3.0])
 
 
+@pytest.mark.lockcheck
 def test_gc_never_evicts_mid_close_iteration():
     """GC pressure during the off-lock close window must not evict the
     closing iteration's state: a replayed (response-lost) push would
@@ -357,6 +361,7 @@ def test_gc_never_evicts_mid_close_iteration():
     np.testing.assert_allclose(ps.get_parameters()["w"], [9.0])
 
 
+@pytest.mark.lockcheck
 def test_restore_during_streaming_close_wins():
     """A checkpoint restore that lands while a barrier apply is in flight
     must end with EXACTLY the restored state: no stale mean applied on
@@ -383,6 +388,7 @@ def test_restore_during_streaming_close_wins():
 
 # --------------------------------------------------- barrier_width TTL lock
 
+@pytest.mark.lockcheck
 def test_barrier_width_ttl_refresh_is_single_flight():
     """Concurrent expiry must issue ONE provider call (the old unlocked
     cache issued one per racing thread and could publish torn pairs)."""

@@ -1,0 +1,490 @@
+"""Tests for the pst-analyze subsystem (analysis/).
+
+Three layers:
+
+1. **Fixture sources** — synthetic modules with seeded violations (a lock
+   order inversion, a blocking call under a lock, raw acquires, swallowed
+   exceptions, unnamed threads) fed through the same entry points the CLI
+   uses, asserting each pass detects exactly its seeded finding.
+2. **Gate** — the real package must analyze clean: zero non-baselined
+   violations, and the committed wire manifest must match the live
+   schemas bit for bit.
+3. **Runtime mode** — PSDT_LOCK_CHECK=1 wraps the known locks in
+   order-asserting proxies: a deliberate out-of-order acquire raises
+   LockOrderError, normal operation (push → barrier → apply → serve,
+   checkpoint save/load) does not.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.analysis import (findings as F,
+                                                       lock_order, lockcheck,
+                                                       runner, wirecheck)
+from parameter_server_distributed_tpu.cli import analyze_main
+
+
+def analyze(src: str, rel: str = "fixture/mod.py"):
+    file_findings, edges = runner.analyze_source(textwrap.dedent(src), rel)
+    return file_findings + lockcheck.check_edges(edges)
+
+
+def by_pass(found, pass_id):
+    return [f for f in found if f.pass_id == pass_id]
+
+
+# ----------------------------------------------------------- lock discipline
+
+def test_detects_declared_rank_inversion():
+    found = analyze("""
+        import threading
+
+        class ParameterServerCore:
+            def __init__(self):
+                self._state_lock = threading.Lock()
+                self._params_lock = threading.Lock()
+
+            def bad(self):
+                with self._params_lock:
+                    with self._state_lock:
+                        pass
+        """)
+    inversions = by_pass(found, F.LOCK_ORDER)
+    assert len(inversions) == 1
+    assert "ParameterServerCore._state_lock" in inversions[0].message
+    assert "rank" in inversions[0].message
+
+
+def test_detects_lock_order_cycle_between_undeclared_locks():
+    found = analyze("""
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    cycles = by_pass(found, F.LOCK_ORDER)
+    assert len(cycles) == 1
+    assert "cycle" in cycles[0].message
+
+
+def test_consistent_undeclared_order_is_clean():
+    found = analyze("""
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """)
+    assert by_pass(found, F.LOCK_ORDER) == []
+
+
+def test_detects_blocking_call_under_lock():
+    found = analyze("""
+        import threading
+        import time
+
+        class ParameterServerCore:
+            def __init__(self):
+                self._state_lock = threading.Lock()
+
+            def bad(self):
+                with self._state_lock:
+                    time.sleep(1.0)
+        """)
+    blocking = by_pass(found, F.LOCK_BLOCKING)
+    assert len(blocking) == 1
+    assert "time.sleep" in blocking[0].message
+
+
+def test_blocking_under_apply_lock_is_allowed():
+    # _apply_lock exists to serialize the blocking apply — the rule skips
+    # locks in lock_order.BLOCKING_ALLOWED
+    found = analyze("""
+        import threading
+
+        class ParameterServerCore:
+            def __init__(self):
+                self._apply_lock = threading.Lock()
+
+            def close(self):
+                with self._apply_lock:
+                    self._optimizer.apply(1, 2)
+        """)
+    assert by_pass(found, F.LOCK_BLOCKING) == []
+
+
+def test_raw_acquire_flagged_and_release_tracked():
+    found = analyze("""
+        import threading
+
+        class Core:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def handoff(self):
+                self._lock.acquire()
+                self._lock.release()
+        """)
+    raw = by_pass(found, F.LOCK_RAW_ACQUIRE)
+    assert len(raw) == 1
+    assert "Core._lock" in raw[0].message
+
+
+def test_cv_wait_on_own_lock_is_legal():
+    found = analyze("""
+        import threading
+
+        class Core:
+            def __init__(self):
+                self._state_lock = threading.Lock()
+                self._cv = threading.Condition(self._state_lock)
+
+            def wait(self):
+                with self._cv:
+                    self._cv.wait(0.25)
+        """)
+    assert by_pass(found, F.LOCK_BLOCKING) == []
+
+
+def test_cv_wait_while_holding_second_lock_flagged():
+    found = analyze("""
+        import threading
+
+        class Core:
+            def __init__(self):
+                self._other = threading.Lock()
+                self._state_lock = threading.Lock()
+                self._cv = threading.Condition(self._state_lock)
+
+            def wait(self):
+                with self._other:
+                    with self._cv:
+                        self._cv.wait(0.25)
+        """)
+    assert len(by_pass(found, F.LOCK_BLOCKING)) == 1
+
+
+def test_caller_holds_docstring_creates_edge():
+    found = analyze("""
+        import threading
+
+        class ParameterServerCore:
+            def __init__(self):
+                self._state_lock = threading.Lock()
+                self._params_lock = threading.Lock()
+
+            def _helper_locked(self):
+                \"\"\"Caller holds _params_lock.\"\"\"
+                with self._state_lock:
+                    pass
+        """)
+    # entry-held _params_lock (rank 40) then _state_lock (20): inversion
+    assert len(by_pass(found, F.LOCK_ORDER)) == 1
+
+
+def test_self_deadlock_on_nonreentrant_reacquire():
+    found = analyze("""
+        import threading
+
+        class Core:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def oops(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+        """)
+    assert any("self-deadlock" in f.message
+               for f in by_pass(found, F.LOCK_ORDER))
+
+
+def test_checked_lock_factory_recognized_in_discovery():
+    found = analyze("""
+        from parameter_server_distributed_tpu.analysis.lock_order import checked_lock
+
+        class ParameterServerCore:
+            def __init__(self):
+                self._state_lock = checked_lock("ParameterServerCore._state_lock")
+                self._params_lock = checked_lock("ParameterServerCore._params_lock")
+
+            def bad(self):
+                with self._params_lock:
+                    with self._state_lock:
+                        pass
+        """)
+    assert len(by_pass(found, F.LOCK_ORDER)) == 1
+
+
+# --------------------------------------------------------- exception hygiene
+
+def test_bare_and_broad_swallowing_excepts_flagged():
+    found = analyze("""
+        def handler():
+            try:
+                work()
+            except:
+                pass
+
+        def handler2():
+            try:
+                work()
+            except Exception:
+                return None
+        """)
+    exc = by_pass(found, F.EXCEPT_HYGIENE)
+    assert len(exc) == 2
+    assert any("bare except" in f.message for f in exc)
+
+
+def test_surfacing_and_annotated_excepts_are_clean():
+    found = analyze("""
+        import logging
+        log = logging.getLogger(__name__)
+
+        def reraises():
+            try:
+                work()
+            except Exception:
+                raise
+
+        def logs():
+            try:
+                work()
+            except Exception:
+                log.exception("failed")
+
+        def reviewed():
+            try:
+                work()
+            except Exception:  # noqa: BLE001 — boundary: reported via RPC
+                return None
+
+        def narrow():
+            try:
+                work()
+            except OSError:
+                pass
+        """)
+    assert by_pass(found, F.EXCEPT_HYGIENE) == []
+
+
+# ------------------------------------------------------------ thread hygiene
+
+def test_unnamed_or_nondaemon_threads_flagged():
+    found = analyze("""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def spawn():
+            threading.Thread(target=run).start()
+            threading.Thread(target=run, daemon=True).start()
+            threading.Thread(target=run, daemon=True, name="ok").start()
+            ThreadPoolExecutor(max_workers=2)
+            ThreadPoolExecutor(max_workers=2, thread_name_prefix="ok")
+        """)
+    threads = by_pass(found, F.THREAD_HYGIENE)
+    assert len(threads) == 3  # two bad Thread ctors, one bad executor
+    assert any("daemon=True and name=" in f.message for f in threads)
+
+
+# ---------------------------------------------------------------- wire compat
+
+def test_wire_manifest_matches_live_schemas():
+    """The committed golden manifest must match rpc/messages.py +
+    rpc/idl.py exactly — a failure here means a protocol edit shipped
+    without `pst-analyze --write-wire-manifest`."""
+    assert wirecheck.run() == []
+
+
+def test_wire_drift_detected():
+    golden = wirecheck.build_manifest()
+    current = json.loads(json.dumps(golden))  # deep copy
+
+    # renumber a Tensor field, drop a method, add a message
+    tensor = current["messages"]["Tensor"]["fields"]
+    tensor["7"] = tensor.pop("3")
+    del current["services"]["parameter_server.ParameterServer"][
+        "reference_methods"]["ServeParameters"]
+    current["messages"]["Rogue"] = {"fields": {}}
+
+    drifts = wirecheck.diff_manifests(golden, current)
+    slugs = {f.slug for f in drifts}
+    assert any("fields.3:removed" in s for s in slugs)
+    assert any("fields.7:added" in s for s in slugs)
+    assert any("ServeParameters:removed" in s for s in slugs)
+    assert any("Rogue:added" in s for s in slugs)
+
+
+def test_wire_constant_change_detected():
+    golden = wirecheck.build_manifest()
+    current = json.loads(json.dumps(golden))
+    current["constants"]["TRACE_FIELD_NUMBER"] = 998
+    drifts = wirecheck.diff_manifests(golden, current)
+    assert any("TRACE_FIELD_NUMBER" in f.slug and "changed" in f.slug
+               for f in drifts)
+
+
+# ------------------------------------------------------------------ the gate
+
+def test_package_analyzes_clean():
+    """THE gate: zero non-baselined violations over the real package.  If
+    this fails, either fix the new finding or — after review — add it to
+    analysis/baseline.json with a one-line justification."""
+    report = runner.run()
+    assert report.errors == []
+    rendered = "\n".join(f.render() for f in report.violations)
+    assert report.violations == [], f"non-baselined findings:\n{rendered}"
+    assert report.files > 50  # walked the real package, not a stub dir
+    # baseline must stay tight: every entry still matches a real finding
+    assert report.stale_baseline == [], [e.key for e in report.stale_baseline]
+    assert all(f.baselined_by for f in report.baselined)
+
+
+def test_cli_json_output_and_exit_codes(tmp_path, capsys):
+    assert analyze_main.main(["--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True and doc["violations"] == []
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        def spawn():
+            threading.Thread(target=spawn).start()
+        """))
+    assert analyze_main.main([str(tmp_path), "--json", "--no-wire"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is False
+    assert doc["violations"][0]["pass_id"] == "thread-hygiene"
+
+
+def test_baseline_requires_justification(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps(
+        {"entries": [{"key": "lock-order:x:y", "reason": " "}]}))
+    with pytest.raises(ValueError, match="justification"):
+        F.load_baseline(str(path))
+
+
+# ------------------------------------------------------------- runtime mode
+
+def _store(**kw):
+    return {k: np.asarray(v, np.float32) for k, v in kw.items()}
+
+
+@pytest.mark.lockcheck
+def test_runtime_out_of_order_acquire_raises():
+    from parameter_server_distributed_tpu.core.ps_core import \
+        ParameterServerCore
+
+    ps = ParameterServerCore(total_workers=1)
+    assert isinstance(ps._state_lock, lock_order.CheckedLock)
+    with pytest.raises(lock_order.LockOrderError, match="lock-order"):
+        with ps._params_lock:
+            with ps._state_lock:
+                pass
+    # the failed acquire must not corrupt the per-thread held stack
+    assert lock_order.held_locks() == ()
+
+
+@pytest.mark.lockcheck
+def test_runtime_self_deadlock_raises_instead_of_hanging():
+    from parameter_server_distributed_tpu.core.ps_core import \
+        ParameterServerCore
+
+    ps = ParameterServerCore(total_workers=1)
+    with pytest.raises(lock_order.LockOrderError, match="self-deadlock"):
+        with ps._state_lock:
+            with ps._state_lock:
+                pass
+
+
+@pytest.mark.lockcheck
+def test_runtime_clean_on_full_server_cycle(tmp_path):
+    """Push → barrier close (apply outside _state_lock) → serve → snapshot
+    → checkpoint save/load → restore: the whole documented order, live,
+    with assertions armed."""
+    from parameter_server_distributed_tpu.checkpoint.manager import \
+        CheckpointManager
+    from parameter_server_distributed_tpu.core.ps_core import \
+        ParameterServerCore
+
+    ps = ParameterServerCore(total_workers=2, aggregation="streaming")
+    ps.initialize_parameters(_store(w=[10.0, 10.0]))
+    for worker in range(2):
+        result = ps.receive_gradients(worker, 1, _store(w=[2.0, 4.0]))
+    assert result.aggregation_complete
+    np.testing.assert_allclose(ps.get_parameters()["w"], [8.0, 6.0])
+    assert ps.wait_for_aggregation(1, timeout=0.5)[0]
+
+    mgr = CheckpointManager(ps, directory=str(tmp_path))
+    path = mgr.save(epoch=1)   # ckpt lock -> state -> apply -> params
+    mgr.load(path)             # ckpt lock -> restore chain
+    assert mgr.maybe_autosave() is None  # reentrant ckpt RLock re-acquire
+    assert lock_order.held_locks() == ()
+
+
+def test_checked_lock_disabled_returns_plain_lock(monkeypatch):
+    monkeypatch.delenv(lock_order.ENV_FLAG, raising=False)
+    lock = lock_order.checked_lock("ParameterServerCore._state_lock")
+    assert not isinstance(lock, lock_order.CheckedLock)
+    with lock:
+        pass
+
+
+def test_checked_lock_unknown_name_rejected():
+    with pytest.raises(KeyError, match="declared rank"):
+        lock_order.checked_lock("Mystery._lock")
+
+
+@pytest.mark.lockcheck
+def test_runtime_condition_variable_wait_through_proxy():
+    """The barrier CV wraps the proxied _state_lock: park + notify must
+    work (wait releases/reacquires through the proxy's held tracking)."""
+    import threading
+
+    from parameter_server_distributed_tpu.core.ps_core import \
+        ParameterServerCore
+
+    ps = ParameterServerCore(total_workers=1, aggregation="streaming")
+    ps.initialize_parameters(_store(w=[1.0]))
+    woke = []
+
+    def waiter():
+        woke.append(ps.wait_for_aggregation(1, timeout=5.0))
+
+    t = threading.Thread(target=waiter, daemon=True, name="test-waiter")
+    t.start()
+    ps.receive_gradients(0, 1, _store(w=[1.0]))
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert woke and woke[0][0] is True

@@ -42,6 +42,7 @@ def coord_client(port):
                      m.COORDINATOR_METHODS)
 
 
+@pytest.mark.lockcheck
 def test_push_pull_sync_over_wire(ps):
     server, port = ps
     server.core.initialize_parameters({"w": np.array([1.0, 2.0], np.float32)})
@@ -172,6 +173,7 @@ def test_lossy_pull_requests_served_bf16(ps):
 # Chunk-stream data plane (rpc/data_plane.py): same payloads as the unary
 # RPCs, shipped as streams of smaller GradientUpdate/ParameterUpdate chunks.
 
+@pytest.mark.lockcheck
 def test_streaming_push_pull_matches_unary(ps):
     from parameter_server_distributed_tpu.rpc.data_plane import PSClient
 
@@ -288,6 +290,7 @@ def test_load_checkpoint_omits_echo_for_large_store(ps, monkeypatch):
 # Pipelined data plane (rpc/data_plane.py PushPullStream): one RPC round
 # per synchronous step instead of push + barrier polls + pull.
 
+@pytest.mark.lockcheck
 def test_fused_push_pull_matches_unary_protocol(ps):
     """The fused round must land exactly the state the serial protocol
     lands: same aggregation, same served parameters."""
@@ -593,6 +596,7 @@ def test_fused_step_pipelines_d2h_with_transport(tmp_path):
         os.environ.pop("PSDT_BUCKET_BYTES", None)
 
 
+@pytest.mark.lockcheck
 def test_fused_barrier_wider_than_default_thread_pool(tmp_path):
     """Liveness: parked fused handlers hold server threads, so a barrier
     WIDER than the old fixed 8-thread pool must still close promptly (the

@@ -25,8 +25,8 @@ final stdout is always exactly one JSON line; failures carry the
 exception text in a "note" field.
 
 Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
-(mfu | samples | pushpull | dataplane | aggregate | async | generate |
-serve | attention;
+(mfu | samples | pushpull | dataplane | aggregate | apply | async |
+generate | serve | attention;
 default mfu; serve = continuous-batching sustained tokens/s, with
 PSDT_BENCH_REQUESTS total requests),
 PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
@@ -765,6 +765,100 @@ def bench_aggregate() -> dict:
                      f"{streaming[n_max]['serves']} serves")}
 
 
+def bench_apply() -> dict:
+    """Striped barrier-close microbench (in-process, no gRPC): barrier
+    close + optimizer apply latency vs STRIPE COUNT and worker count,
+    serial (stripes=1) vs striped side by side — the ISSUE 5 acceptance
+    surface.  Shape knobs: PSDT_BENCH_PARAMS (total store size, default
+    8e6 — a multi-MB model so the sweeps dominate thread hand-off),
+    PSDT_BENCH_STRIPE_COUNTS (default "1,2,..,cores"),
+    PSDT_BENCH_WORKER_COUNTS (default "4"), PSDT_BENCH_OPT (host
+    optimizer for the apply leg, default adam — the heaviest numpy
+    sweep), PSDT_BENCH_STEPS (iterations per cell, default 5)."""
+    import numpy as np
+
+    from parameter_server_distributed_tpu.core.optimizer import make_optimizer
+    from parameter_server_distributed_tpu.core.ps_core import (
+        ParameterServerCore)
+    from parameter_server_distributed_tpu.core.stripes import usable_cores
+    from parameter_server_distributed_tpu.core.tensor import store_nbytes
+    from parameter_server_distributed_tpu.obs import stats as obs_stats
+
+    n_params = int(float(os.environ.get("PSDT_BENCH_PARAMS", "8e6")))
+    cores = usable_cores()
+    default_stripes = sorted({1, 2, cores} | (
+        {cores // 2} if cores >= 4 else set()))
+    stripe_counts = [int(x) for x in os.environ.get(
+        "PSDT_BENCH_STRIPE_COUNTS",
+        ",".join(str(s) for s in default_stripes)).split(",")]
+    worker_counts = [int(x) for x in os.environ.get(
+        "PSDT_BENCH_WORKER_COUNTS", "4").split(",")]
+    opt_name = os.environ.get("PSDT_BENCH_OPT", "adam")
+    iters = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 5
+
+    rng = np.random.default_rng(0)
+    # transformer-block-ish granularity: enough tensors that every stripe
+    # owns several, so the name partition stays balanced
+    n_tensors = 16
+    shape = (max(1, n_params // n_tensors),)
+    params = {f"layer{i:02d}/w": rng.standard_normal(shape).astype(np.float32)
+              for i in range(n_tensors)}
+    model_bytes = store_nbytes(params)
+    grads = {name: rng.standard_normal(v.shape).astype(np.float32)
+             for name, v in params.items()}
+    log(f"bench_apply: store {n_params / 1e6:.1f}M params "
+        f"({model_bytes / 1e6:.0f} MB f32) in {n_tensors} tensors, "
+        f"opt={opt_name}, stripes {stripe_counts} x workers "
+        f"{worker_counts} x {iters} iters on {cores} usable cores")
+
+    def cell(stripes: int, n_workers: int) -> dict:
+        core = ParameterServerCore(
+            total_workers=n_workers, stripes=stripes,
+            optimizer=make_optimizer(opt_name, 1e-3))
+        core.initialize_parameters(params)
+        close_times = []
+        for it in range(1, iters + 1):
+            for wid in range(n_workers - 1):
+                core.receive_gradients(wid, it, grads)
+            t0 = time.perf_counter()
+            r = core.receive_gradients(n_workers - 1, it, grads)
+            close_times.append(time.perf_counter() - t0)
+            assert r.aggregation_complete, r.message
+        out = {"barrier_close_ms": round(
+            1e3 * sorted(close_times)[len(close_times) // 2], 3)}
+        # the gauge holds the LAST striped apply's achieved parallelism —
+        # i.e. this cell's final iteration
+        par = obs_stats.REGISTRY.snapshot().get(
+            "gauges", {}).get("ps.apply.parallelism")
+        if stripes > 1 and par:
+            out["apply_parallelism"] = par
+        return out
+
+    by_stripes: dict[str, dict] = {}
+    for s in stripe_counts:
+        by_workers = {}
+        for n in worker_counts:
+            by_workers[str(n)] = cell(s, n)
+            log(f"bench_apply: stripes={s} workers={n} "
+                f"close_p50={by_workers[str(n)]['barrier_close_ms']}ms "
+                f"parallelism={by_workers[str(n)].get('apply_parallelism', '-')}")
+        by_stripes[str(s)] = by_workers
+    n_max = str(worker_counts[-1])
+    s_max = str(stripe_counts[-1])
+    serial_ms = by_stripes.get("1", by_stripes[s_max])[n_max][
+        "barrier_close_ms"]
+    striped_ms = by_stripes[s_max][n_max]["barrier_close_ms"]
+    return {"metric": f"ps_apply_close_ms_{s_max}stripes_{n_max}w",
+            "value": striped_ms, "unit": "ms",
+            "vs_baseline": (round(serial_ms / striped_ms, 3)
+                            if striped_ms else 0.0),
+            "by_stripes": by_stripes, "model_bytes": model_bytes,
+            "opt": opt_name, "usable_cores": cores,
+            "note": (f"barrier close p50 {serial_ms}ms serial -> "
+                     f"{striped_ms}ms at {s_max} stripes "
+                     f"({n_max} workers, {opt_name})")}
+
+
 def _ab_host_optimizer() -> None:
     """A/B timing (stderr): native C++ fused optimizer kernels vs the numpy
     fallback on the PS host update path — the kernels' production role
@@ -1407,6 +1501,8 @@ def child_main(mode: str) -> int:
             result = bench_dataplane()
         elif mode == "aggregate":
             result = bench_aggregate()
+        elif mode == "apply":
+            result = bench_apply()
         elif mode == "async":
             result = bench_async()
         elif mode == "generate":
@@ -1514,7 +1610,7 @@ def main() -> int:
     # Host-only benches never need the accelerator — run them on CPU
     # directly rather than risking a flaky TPU init.
     plans: list[tuple[str, float]]
-    if mode in ("pushpull", "dataplane", "aggregate"):
+    if mode in ("pushpull", "dataplane", "aggregate", "apply"):
         plans = [("cpu", cpu_timeout)]
     else:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]

@@ -38,12 +38,20 @@ LOCK_RANKS: dict[str, int] = {
     "ParameterServerCore._state_lock": 20,
     "ParameterServerCore._apply_lock": 30,
     "ParameterServerCore._params_lock": 40,
+    # ALL stripe locks share this one rank (core/stripes.py, ISSUE 5): a
+    # stripe lock is always acquired with no other lock held (striped
+    # folds reserve under _state_lock, RELEASE it, then take exactly one
+    # stripe lock), and the shared rank makes holding two stripes at once
+    # a checked violation by construction — no nested-stripe deadlocks.
+    "ParameterServerCore._stripe_lock": 44,
     # leaves: never held while acquiring anything else
     "ParameterServerCore._live_lock": 50,
     "EncodedServeCache._lock": 60,
     "ClusterAggregator._lock": 62,
     "trainer._DISPATCH_LOCK": 64,
     "native._lock": 66,
+    # single-flight creation of the shared stripe executor
+    "stripes._pool_lock": 68,
 }
 
 # Locks that exist to serialize a blocking section: the static

@@ -20,6 +20,17 @@ Both drop into `ParameterServerCore(optimizer=...)` unchanged — they satisfy
 the HostOptimizer protocol (apply/state_dict/load_state_dict) and are
 selected by name through `core.optimizer.make_optimizer`
 (``device_*`` / ``pallas_*``).
+
+They are equally valid on the SYNCHRONOUS barrier path (opt in with
+``--optimizer pallas_sgd`` etc. on the PS): the streaming close hands the
+contributor mean to ``apply`` exactly as it would a host optimizer, and
+the whole-store jit program runs the update on the accelerator.  Both
+keep ``supports_striping = False`` — a jit-compiled whole-store program
+is not name-sliceable, and splitting it into S programs would recompile
+per stripe and serialize on the device queue anyway, so the striped
+barrier close (core/ps_core.py, PSDT_STRIPES) deliberately falls back to
+this serial whole-store apply for them.  The accelerator IS the
+parallelism in that configuration.
 """
 
 from __future__ import annotations
@@ -97,6 +108,9 @@ def _adam_with_bf16_slots(b1: float, b2: float,
 
 
 class DeviceOptimizer(HostOptimizer):
+    # whole-store jit program — not name-sliceable (see module docstring)
+    supports_striping = False
+
     def __init__(self, transformation: optax.GradientTransformation,
                  learning_rate: float = 0.0):
         super().__init__(learning_rate)
@@ -191,6 +205,9 @@ class PallasOptimizer(HostOptimizer):
     One jit-compiled, buffer-donating program per rule; Adam's per-step bias
     corrections ride in as data (SMEM scalars), so stepping never
     recompiles."""
+
+    # whole-store jit program — not name-sliceable (see module docstring)
+    supports_striping = False
 
     RULES = ("sgd", "momentum", "adam")
 

@@ -11,13 +11,30 @@ device-side SPMD train path uses optax under jit instead
 Each optimizer applies its update through the fused native C++ kernels
 (native/psdt_native.cpp — the analogue of the reference's C++ hot loop at
 src/parameter_server.cpp:40-91) when the library is available, falling back
-to numpy otherwise.  The native pass is single-sweep and GIL-free; the
-numpy path materializes one temporary per sub-op.  Outputs are always fresh
-arrays — previously served parameter copies are never mutated.
+to numpy otherwise.  Both passes are in-place: the native kernel is
+single-sweep and GIL-free; the numpy path runs ``out=`` ufuncs over the
+owned optimizer slots plus ONE thread-local scratch buffer reused across
+tensors (:func:`_scratch_like`), so a step allocates exactly the output
+array per tensor instead of one temporary per sub-op.  Outputs are always
+fresh arrays — previously served parameter copies are never mutated.
+
+Striping protocol (core/stripes.py, ISSUE 5): optimizer state is keyed
+per tensor name, so an update is **name-sliceable** — the striped barrier
+close calls :meth:`HostOptimizer.tick` once per logical step and then
+:meth:`HostOptimizer.apply_shard` concurrently over disjoint name
+subsets.  ``apply_shard`` over disjoint names is thread-safe by
+construction: each tensor touches only its own slot entries (per-key dict
+writes are GIL-atomic) and the scratch buffer is thread-local.
+``apply()`` (tick + one whole-store shard) remains the serial entry
+point, bit-for-bit unchanged.  Optimizers whose apply is NOT
+name-sliceable (the device-resident jit programs,
+async_sgd/device_optimizer.py) leave ``supports_striping`` False and the
+PS falls back to the serial whole-store apply.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Mapping
 
 import numpy as np
@@ -26,15 +43,55 @@ from ..native import (adam_native, adamw_native, lib as native_lib,
                       momentum_native, sgd_native)
 from .tensor import TensorStore
 
+_scratch_tls = threading.local()
+
+# Retained-scratch ceiling: buffers up to this size are cached per thread
+# and reused across tensors/steps (the common transformer-block sizes);
+# anything larger gets a fresh allocation instead — an outlier tensor
+# (a 500 MB embedding) must not pin outlier-sized buffers on every pool
+# and handler thread for the process lifetime.
+_SCRATCH_CAP_BYTES = 64 << 20
+
+
+def _scratch_like(a: np.ndarray) -> np.ndarray:
+    """A float32 scratch view shaped like ``a``, backed by a thread-local
+    flat buffer reused across sub-ops, tensors, and steps (fresh for
+    tensors above ``_SCRATCH_CAP_BYTES``).  Thread-local so
+    stripe-parallel ``apply_shard`` calls never share a buffer."""
+    if 4 * a.size > _SCRATCH_CAP_BYTES:
+        return np.empty(a.shape, np.float32)
+    buf = getattr(_scratch_tls, "buf", None)
+    if buf is None or buf.size < a.size:
+        buf = _scratch_tls.buf = np.empty(max(1, a.size), np.float32)
+    return buf[:a.size].reshape(a.shape)
+
 
 class HostOptimizer:
     """Stateful optimizer over a named-tensor store."""
 
+    #: True when state is per-tensor-name and :meth:`apply_shard` may run
+    #: concurrently over disjoint name subsets (the striped PS hot path).
+    supports_striping = False
+
     def __init__(self, learning_rate: float = 1.0):
         self.learning_rate = learning_rate
 
-    def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
+    def tick(self) -> None:
+        """Advance per-logical-step state (Adam's bias-correction step
+        counter) ONCE per barrier apply.  The striped closer calls
+        ``tick()`` once, then ``apply_shard()`` per stripe; calling
+        :meth:`apply` does both."""
+
+    def apply_shard(self, params: TensorStore,
+                    grads: Mapping[str, np.ndarray]) -> TensorStore:
+        """Apply the update rule to a (sub)store WITHOUT advancing the
+        step counter.  Same-name slot state updates in place; returned
+        params are fresh arrays."""
         raise NotImplementedError
+
+    def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
+        self.tick()
+        return self.apply_shard(params, grads)
 
     def state_dict(self) -> dict:
         return {}
@@ -46,7 +103,10 @@ class HostOptimizer:
 class SGD(HostOptimizer):
     """param -= lr * grad — the reference's rule at lr=1.0."""
 
-    def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
+    supports_striping = True
+
+    def apply_shard(self, params: TensorStore,
+                    grads: Mapping[str, np.ndarray]) -> TensorStore:
         lr = np.float32(self.learning_rate)
         use_native = native_lib() is not None
         out: TensorStore = {}
@@ -60,17 +120,23 @@ class SGD(HostOptimizer):
                 if sgd_native(p_new, g, float(lr)):
                     out[name] = p_new
                     continue
-            out[name] = np.asarray(p, np.float32) - lr * g
+            p = np.asarray(p, np.float32)
+            scratch = _scratch_like(g)
+            np.multiply(g, lr, out=scratch)
+            out[name] = np.subtract(p, scratch)
         return out
 
 
 class Momentum(HostOptimizer):
+    supports_striping = True
+
     def __init__(self, learning_rate: float = 1.0, momentum: float = 0.9):
         super().__init__(learning_rate)
         self.momentum = momentum
         self.velocity: TensorStore = {}
 
-    def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
+    def apply_shard(self, params: TensorStore,
+                    grads: Mapping[str, np.ndarray]) -> TensorStore:
         lr = np.float32(self.learning_rate)
         mu = np.float32(self.momentum)
         use_native = native_lib() is not None
@@ -93,13 +159,23 @@ class Momentum(HostOptimizer):
                     self.velocity[name] = v_new
                     out[name] = p_new
                     continue
-            v = mu * v_prev + g if v_prev is not None else g
+            if v_prev is None:
+                # owned copy: the slot updates in place from now on and
+                # must never alias the caller's gradient array
+                v = np.array(g, np.float32)
+            else:
+                # v = mu * v + g, in place on the owned slot
+                v = _owned_f32(v_prev)
+                np.multiply(v, mu, out=v)
+                np.add(v, g, out=v)
             self.velocity[name] = v
-            out[name] = p - lr * v
+            scratch = _scratch_like(v)
+            np.multiply(v, lr, out=scratch)
+            out[name] = np.subtract(p, scratch)  # the one fresh array
         return out
 
     def state_dict(self) -> dict:
-        # deep copy — the native apply path updates velocity in place
+        # deep copy — the apply path updates velocity in place
         return {"velocity": {k: np.array(v)
                              for k, v in self.velocity.items()}}
 
@@ -119,6 +195,8 @@ def _owned_f32(a: np.ndarray) -> np.ndarray:
 
 
 class Adam(HostOptimizer):
+    supports_striping = True
+
     def __init__(self, learning_rate: float = 1e-3, b1: float = 0.9,
                  b2: float = 0.999, eps: float = 1e-8):
         super().__init__(learning_rate)
@@ -127,9 +205,29 @@ class Adam(HostOptimizer):
         self.v: TensorStore = {}
         self.step = 0
 
-    def apply(self, params: TensorStore, grads: Mapping[str, np.ndarray]) -> TensorStore:
+    def tick(self) -> None:
         self.step += 1
+
+    def _moments(self, name: str, g: np.ndarray,
+                 scratch: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """In-place EMA update of the (owned) m/v slots for one tensor:
+        m = b1*m + (1-b1)*g, v = b2*v + (1-b2)*g², via out= ufuncs and the
+        shared scratch — no full-size temporaries."""
         b1, b2 = np.float32(self.b1), np.float32(self.b2)
+        m = _owned_f32(self.m.get(name, np.zeros_like(g)))
+        v = _owned_f32(self.v.get(name, np.zeros_like(g)))
+        np.multiply(g, np.float32(1.0) - b1, out=scratch)
+        np.multiply(m, b1, out=m)
+        np.add(m, scratch, out=m)
+        np.multiply(g, g, out=scratch)
+        np.multiply(scratch, np.float32(1.0) - b2, out=scratch)
+        np.multiply(v, b2, out=v)
+        np.add(v, scratch, out=v)
+        self.m[name], self.v[name] = m, v
+        return m, v
+
+    def apply_shard(self, params: TensorStore,
+                    grads: Mapping[str, np.ndarray]) -> TensorStore:
         lr = np.float32(self.learning_rate)
         bc1 = 1.0 - self.b1 ** self.step
         bc2 = 1.0 - self.b2 ** self.step
@@ -141,24 +239,36 @@ class Adam(HostOptimizer):
                 out[name] = p
                 continue
             g = np.asarray(grads[name], np.float32)
-            m = self.m.get(name, np.zeros_like(g))
-            v = self.v.get(name, np.zeros_like(g))
             if use_native:
                 # params must NOT mutate in place (served param dicts hold
                 # references — RCU-style immutability), so the new params
                 # get a fresh buffer; m/v are private to the optimizer and
                 # update in place (state_dict deep-copies on snapshot).
+                m = _owned_f32(self.m.get(name, np.zeros_like(g)))
+                v = _owned_f32(self.v.get(name, np.zeros_like(g)))
                 p_new = np.array(p, np.float32)
-                m, v = _owned_f32(m), _owned_f32(v)
                 if adam_native(p_new, g, m, v, float(lr), self.b1,
                                self.b2, self.eps, self.step):
                     self.m[name], self.v[name] = m, v
                     out[name] = p_new
                     continue
-            m = b1 * m + (1 - b1) * g
-            v = b2 * v + (1 - b2) * (g * g)
-            self.m[name], self.v[name] = m, v
-            out[name] = p - lr * (m / bc1) / (np.sqrt(v / bc2) + self.eps)
+            scratch = _scratch_like(g)
+            m, v = self._moments(name, g, scratch)
+            # denom = sqrt(v / bc2) + eps, staged in scratch
+            np.divide(v, bc2, out=scratch)
+            np.sqrt(scratch, out=scratch)
+            np.add(scratch, self.eps, out=scratch)
+            # p - lr * (m / bc1) / denom, staged in the fresh output —
+            # lr multiplied BEFORE the denom divide, preserving the
+            # pre-in-place expression's evaluation order bit for bit
+            # (explicit empty_like: ufuncs on 0-d arrays without out=
+            # return scalars, which cannot chain as out= targets)
+            p_new = np.empty_like(p)
+            np.divide(m, bc1, out=p_new)
+            np.multiply(p_new, lr, out=p_new)
+            np.divide(p_new, scratch, out=p_new)
+            np.subtract(p, p_new, out=p_new)
+            out[name] = p_new
         return out
 
     def state_dict(self) -> dict:
@@ -189,10 +299,8 @@ class AdamW(Adam):
         super().__init__(learning_rate, **kwargs)
         self.weight_decay = weight_decay
 
-    def apply(self, params: TensorStore,
-              grads: Mapping[str, np.ndarray]) -> TensorStore:
-        self.step += 1
-        b1, b2 = np.float32(self.b1), np.float32(self.b2)
+    def apply_shard(self, params: TensorStore,
+                    grads: Mapping[str, np.ndarray]) -> TensorStore:
         lr = np.float32(self.learning_rate)
         bc1 = 1.0 - self.b1 ** self.step
         bc2 = 1.0 - self.b2 ** self.step
@@ -209,23 +317,31 @@ class AdamW(Adam):
             # bug — mask matches parallel/train_step.make_optimizer)
             wd = self.weight_decay if p.ndim >= 2 else 0.0
             g = np.asarray(grads[name], np.float32)
-            m = self.m.get(name, np.zeros_like(g))
-            v = self.v.get(name, np.zeros_like(g))
             if use_native:
                 # fresh params buffer (served dicts hold references to the
-                # old one); m/v update in place — see Adam.apply
+                # old one); m/v update in place — see Adam.apply_shard
+                m = _owned_f32(self.m.get(name, np.zeros_like(g)))
+                v = _owned_f32(self.v.get(name, np.zeros_like(g)))
                 p_new = np.array(p, np.float32)
-                m, v = _owned_f32(m), _owned_f32(v)
                 if adamw_native(p_new, g, m, v, float(lr), self.b1,
                                 self.b2, self.eps, self.step, wd):
                     self.m[name], self.v[name] = m, v
                     out[name] = p_new
                     continue
-            m = b1 * m + (1 - b1) * g
-            v = b2 * v + (1 - b2) * (g * g)
-            self.m[name], self.v[name] = m, v
-            adam_term = (m / bc1) / (np.sqrt(v / bc2) + self.eps)
-            out[name] = p - lr * (adam_term + np.float32(wd) * p)
+            scratch = _scratch_like(g)
+            m, v = self._moments(name, g, scratch)
+            np.divide(v, bc2, out=scratch)
+            np.sqrt(scratch, out=scratch)
+            np.add(scratch, self.eps, out=scratch)
+            p_new = np.empty_like(p)
+            np.divide(m, bc1, out=p_new)
+            np.divide(p_new, scratch, out=p_new)  # adam_term
+            if wd:
+                np.multiply(p, np.float32(wd), out=scratch)
+                np.add(p_new, scratch, out=p_new)
+            np.multiply(p_new, lr, out=p_new)
+            np.subtract(p, p_new, out=p_new)
+            out[name] = p_new
         return out
 
 
@@ -237,6 +353,8 @@ class Lion(HostOptimizer):
     Decoupled decay on matrices only, same mask as AdamW and the
     device-side optax menu (parallel/train_step.make_optimizer)."""
 
+    supports_striping = True
+
     def __init__(self, learning_rate: float = 1e-4, b1: float = 0.9,
                  b2: float = 0.99, weight_decay: float = 1e-4):
         super().__init__(learning_rate)
@@ -244,10 +362,11 @@ class Lion(HostOptimizer):
         self.weight_decay = weight_decay
         self.m: TensorStore = {}
 
-    def apply(self, params: TensorStore,
-              grads: Mapping[str, np.ndarray]) -> TensorStore:
+    def apply_shard(self, params: TensorStore,
+                    grads: Mapping[str, np.ndarray]) -> TensorStore:
         lr = np.float32(self.learning_rate)
         b1, b2 = np.float32(self.b1), np.float32(self.b2)
+        one = np.float32(1.0)
         out: TensorStore = {}
         for name, p in params.items():
             p = np.asarray(p, np.float32)
@@ -255,14 +374,31 @@ class Lion(HostOptimizer):
                 out[name] = p
                 continue
             g = np.asarray(grads[name], np.float32)
-            m = self.m.get(name, np.zeros_like(g))
-            update = np.sign(b1 * m + (1 - b1) * g)
+            m = _owned_f32(self.m.get(name, np.zeros_like(g)))
+            scratch = _scratch_like(g)
+            # update = sign(b1*m + (1-b1)*g), staged in the fresh output
+            # (m itself is still needed for its own EMA below)
+            p_new = np.empty_like(p)
+            np.multiply(m, b1, out=p_new)
+            np.multiply(g, one - b1, out=scratch)
+            np.add(p_new, scratch, out=p_new)
+            np.sign(p_new, out=p_new)
+            # m = b2*m + (1-b2)*g, in place on the owned slot
+            np.multiply(m, b2, out=m)
+            np.multiply(g, one - b2, out=scratch)
+            np.add(m, scratch, out=m)
+            self.m[name] = m
             wd = self.weight_decay if p.ndim >= 2 else 0.0
-            self.m[name] = b2 * m + (1 - b2) * g
-            out[name] = p - lr * (update + np.float32(wd) * p)
+            if wd:
+                np.multiply(p, np.float32(wd), out=scratch)
+                np.add(p_new, scratch, out=p_new)
+            np.multiply(p_new, lr, out=p_new)
+            np.subtract(p, p_new, out=p_new)
+            out[name] = p_new
         return out
 
     def state_dict(self) -> dict:
+        # deep copy — the apply path updates m in place
         return {"m": {k: np.array(v) for k, v in self.m.items()}}
 
     def load_state_dict(self, state: dict) -> None:
@@ -275,7 +411,10 @@ def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9,
     """PS optimizer by name.  Plain names (`sgd|momentum|adam|adamw|lion`)
     are the host-side numpy/native-C++ optimizers above; `device_*`
     selects the accelerator-resident optax path and `pallas_*` the fused
-    pallas-kernel path (async_sgd/device_optimizer.py)."""
+    pallas-kernel path (async_sgd/device_optimizer.py) — both work on the
+    synchronous barrier path too (the apply stays whole-store serial
+    there: device programs are not name-sliceable, see
+    ``supports_striping``)."""
     name = name.lower()
     if name == "sgd":
         return SGD(learning_rate)

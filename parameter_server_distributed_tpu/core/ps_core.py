@@ -50,6 +50,21 @@ Aggregation data path (PSDT_AGGREGATION, default ``streaming``):
   ``_state_lock`` (duplicate pushes are last-push-wins, the original
   semantics).  Same contributor-mean math; use it when the per-worker
   buffers themselves are wanted (debugging, exact reference timing).
+
+Striped hot path (``PSDT_STRIPES``, default = usable cores; ISSUE 5):
+the store is partitioned into S fixed stripes by tensor name
+(core/stripes.py — a stripe never splits one tensor's reduction, so
+striped results are bit-for-bit equal to serial).  Streaming folds run
+their numpy adds OUTSIDE ``_state_lock`` under per-stripe locks — the
+reservation (dedup, seal check) stays under ``_state_lock``, the O(bytes)
+``np.add`` does not, so concurrent pushes fold different stripes on
+different cores.  The barrier close seals the iteration and DRAINS
+in-flight folds (``IterationState.inflight`` over the barrier condition
+variable) before taking the accumulator, then runs the scale and the
+optimizer apply stripe-parallel (``HostOptimizer.tick`` once +
+``apply_shard`` per stripe) on the shared named executor.
+``PSDT_STRIPES=1`` bypasses every striped branch — the exact serial
+code path, timing included.
 """
 
 from __future__ import annotations
@@ -65,6 +80,7 @@ import numpy as np
 from ..analysis.lock_order import checked_lock
 from ..obs import stats as obs_stats
 from .optimizer import HostOptimizer, SGD
+from .stripes import partition_names, run_striped, stripe_count, stripe_of
 from .tensor import TensorStore, store_nbytes, tree_like
 
 AGGREGATION_MODES = ("streaming", "buffered")
@@ -73,7 +89,7 @@ AGGREGATION_MODES = ("streaming", "buffered")
 class IterationState:
     __slots__ = ("worker_gradients", "aggregated", "aggregating", "sealed",
                  "workers_at_aggregation", "accum", "counts", "folded",
-                 "contributors", "buffer_bytes")
+                 "folding", "inflight", "contributors", "buffer_bytes")
 
     def __init__(self):
         # buffered mode: whole per-worker gradient stores
@@ -87,6 +103,13 @@ class IterationState:
         # streaming dedup: worker -> tensor names already folded, so a
         # retried (replayed) push or a duplicate never double-counts
         self.folded: dict[int, set[str]] = {}
+        # striped folds: worker -> names RESERVED under _state_lock whose
+        # numpy adds are still running outside it (moved to `folded` on
+        # success, released on failure so a retry is not dropped), plus
+        # the count of fold operations currently outside the lock — the
+        # barrier close drains it to zero before taking the accumulator
+        self.folding: dict[int, set[str]] = {}
+        self.inflight = 0
         # Workers whose push COMPLETED (stream fully received) — only
         # these count toward the barrier width.  Folded VALUES from a
         # stream still in flight are already in `accum` (fold-on-arrival
@@ -192,7 +215,8 @@ class ParameterServerCore:
                  live_workers_fn: Callable[[], int] | None = None,
                  live_workers_ttl_s: float = 0.0,
                  gc_iterations: int = 64,
-                 aggregation: str | None = None):
+                 aggregation: str | None = None,
+                 stripes: int | None = None):
         mode = (aggregation or os.environ.get("PSDT_AGGREGATION")
                 or "streaming").lower()
         if mode not in AGGREGATION_MODES:
@@ -212,6 +236,20 @@ class ParameterServerCore:
         # _state_lock so pushes/polls for other iterations proceed during
         # the optimizer apply.  Never held while acquiring _state_lock.
         self._apply_lock = checked_lock("ParameterServerCore._apply_lock")
+        # Stripe partition of the hot path (PSDT_STRIPES / constructor
+        # override; 1 = exact serial behavior).  One lock per stripe, all
+        # at one shared declared rank: a stripe lock is only ever taken
+        # with no other lock held, and never two at once (core/stripes.py,
+        # analysis/lock_order.py).
+        self._stripes = stripe_count(stripes)
+        self._stripe_locks = [
+            checked_lock("ParameterServerCore._stripe_lock")
+            for _ in range(self._stripes)]
+        # striped-apply observability: per-stripe apply wall time and the
+        # achieved parallelism (sum of stripe times / wall time) of the
+        # last stripe-parallel optimizer apply
+        self._obs_stripe_ms = obs_stats.histogram("ps.apply.stripe_ms")
+        self._obs_parallelism = obs_stats.gauge("ps.apply.parallelism")
         # Barrier-completion broadcast over _state_lock: the fused data
         # plane (PushPullStream) parks here and is woken the instant an
         # aggregation fires, instead of being polled at 20 Hz like the
@@ -284,6 +322,10 @@ class ParameterServerCore:
     @property
     def aggregation_mode(self) -> str:
         return self._aggregation
+
+    @property
+    def stripes(self) -> int:
+        return self._stripes
 
     @property
     def _streaming(self) -> bool:
@@ -436,7 +478,13 @@ class ParameterServerCore:
         landed — is skipped, so retries converge to exactly one
         contribution (first-push-wins).  Chunks for an aggregated (or
         currently-aggregating) iteration are discarded; the commit reports
-        the push late."""
+        the push late.
+
+        Striped (stripes > 1): only the reservation — dedup, seal check,
+        state bookkeeping — runs under ``_state_lock``; the O(bytes)
+        numpy adds run outside it under per-stripe locks, so concurrent
+        pushes (and the stripes of ONE large chunk, fanned across the
+        shared executor) fold on multiple cores at once."""
         with self._state_lock:
             self._current_iteration = max(self._current_iteration, iteration)
             state = self._sync_state_locked(iteration)
@@ -446,31 +494,109 @@ class ParameterServerCore:
                 # is discarded (commit reports the push late or duplicate)
                 return
             folded = state.folded.setdefault(worker_id, set())
-            added = 0
-            try:
-                for name, g in gradients.items():
-                    if name in folded:
-                        continue
+            if self._stripes <= 1:
+                self._fold_into_locked(state, folded, gradients)
+                return
+            folding = state.folding.setdefault(worker_id, set())
+            todo = [(name, g) for name, g in gradients.items()
+                    if name not in folded and name not in folding]
+            if not todo:
+                return
+            # reserve: a concurrent duplicate fold of the same (worker,
+            # name) — e.g. a fast retry racing the original — sees the
+            # reservation and skips instead of double-adding
+            folding.update(name for name, _ in todo)
+            state.inflight += 1
+        self._fold_striped(state, worker_id, iteration, todo)
+
+    def _fold_into_locked(self, state: IterationState, folded: set,
+                          gradients: Mapping[str, np.ndarray]) -> None:
+        """The serial fold (caller holds _state_lock) — the exact
+        pre-stripe code path, used at stripes == 1."""
+        added = 0
+        try:
+            for name, g in gradients.items():
+                if name in folded:
+                    continue
+                acc = state.accum.get(name)
+                if acc is None:
+                    # owned f32 copy in ONE pass (convert-and-copy
+                    # fused; asarray-then-astype would sweep twice
+                    # for non-f32 wire decodes)
+                    acc = np.array(g, dtype=np.float32)
+                    state.accum[name] = acc
+                    state.counts[name] = 1
+                    added += acc.nbytes
+                else:
+                    # raises (mutating nothing) on a shape mismatch —
+                    # only THEN is the name marked folded, so a retry
+                    # of a failed fold is not silently dropped
+                    np.add(acc, np.asarray(g, np.float32), out=acc)
+                    state.counts[name] += 1
+                folded.add(name)
+        finally:
+            if added:
+                state.buffer_bytes += added
+                self._grad_buffer_note(added)
+
+    def _fold_striped(self, state: IterationState, worker_id: int,
+                      iteration: int, todo: list) -> None:
+        """Phases 2+3 of a striped fold: the numpy adds, grouped per
+        stripe under per-stripe locks OUTSIDE ``_state_lock``, then the
+        publication of what landed back under it.  The barrier close
+        seals the state and drains ``state.inflight`` before taking the
+        accumulator, so an in-flight add never races the close's scale;
+        per-stripe accounting slots (one writer each) keep this function
+        exception-safe without cross-thread counters."""
+        groups: dict[int, list] = {}
+        for name, g in todo:
+            groups.setdefault(stripe_of(name, self._stripes),
+                              []).append((name, g))
+        work = sorted(groups.items())
+        done_by: list[list[str]] = [[] for _ in work]
+        added_by = [0] * len(work)
+
+        def fold_group(idx: int, stripe: int, items: list) -> None:
+            with self._stripe_locks[stripe]:
+                for name, g in items:
                     acc = state.accum.get(name)
                     if acc is None:
-                        # owned f32 copy in ONE pass (convert-and-copy
-                        # fused; asarray-then-astype would sweep twice
-                        # for non-f32 wire decodes)
                         acc = np.array(g, dtype=np.float32)
                         state.accum[name] = acc
                         state.counts[name] = 1
-                        added += acc.nbytes
+                        added_by[idx] += acc.nbytes
                     else:
                         # raises (mutating nothing) on a shape mismatch —
-                        # only THEN is the name marked folded, so a retry
-                        # of a failed fold is not silently dropped
+                        # the name stays unpublished, so a retry of the
+                        # failed fold is not silently dropped
                         np.add(acc, np.asarray(g, np.float32), out=acc)
                         state.counts[name] += 1
-                    folded.add(name)
-            finally:
-                if added:
+                    done_by[idx].append(name)
+
+        try:
+            run_striped([
+                (lambda i=i, s=stripe, it=items: fold_group(i, s, it))
+                for i, (stripe, items) in enumerate(work)])
+        finally:
+            with self._state_lock:
+                state.inflight -= 1
+                folding = state.folding.get(worker_id)
+                if folding is not None:
+                    folding.difference_update(name for name, _ in todo)
+                # only names whose add actually landed become folded —
+                # a failed name stays retryable, exactly like the serial
+                # path's fold-then-mark ordering
+                state.folded.setdefault(worker_id, set()).update(
+                    name for names in done_by for name in names)
+                added = sum(added_by)
+                # a restore() racing this fold may have orphaned `state`;
+                # its buffer bytes then die with it — never re-note them
+                # against the reset global gauge
+                if added and self._iteration_states.get(iteration) is state:
                     state.buffer_bytes += added
                     self._grad_buffer_note(added)
+                # wake a barrier closer draining inflight folds
+                self._barrier_cv.notify_all()
 
     def _commit_push(self, worker_id: int, iteration: int) -> PushResult:
         """End-of-stream for a streaming push: mark the worker a barrier
@@ -573,9 +699,19 @@ class ParameterServerCore:
         held again on return."""
         t0 = time.perf_counter()
         state.sealed = True  # contributor set frozen, even across retries
-        state.aggregating = True
+        state.aggregating = True  # set BEFORE the drain below: the wait
+        # releases _state_lock, and a concurrent poll re-entering
+        # _maybe_aggregate_locked must see the close already in flight
         try:
             if self._streaming:
+                while state.inflight:
+                    # striped folds reserved BEFORE the seal are still
+                    # running their numpy adds outside _state_lock; their
+                    # sums belong to this aggregate — drain them before
+                    # taking the accumulator (their publish step lands
+                    # while the cv wait has the lock released and
+                    # notifies here)
+                    self._barrier_cv.wait(0.05)
                 if not self._close_streaming_locked(state):
                     # a checkpoint restore landed inside the close window:
                     # the aggregate belongs to the pre-restore world —
@@ -627,9 +763,11 @@ class ParameterServerCore:
                     if self._restore_epoch == gen:
                         # contributor mean without a per-worker sweep: one
                         # in-place O(model) scale of the running sums
-                        # (per-name counts — see IterationState.counts)
-                        for name, acc in sums.items():
-                            acc *= np.float32(1.0 / counts[name])
+                        # (per-name counts — see IterationState.counts),
+                        # stripe-parallel; a FULL scale pass completes
+                        # before the apply so the put-back semantics on an
+                        # apply failure stay exact (counts reset to 1)
+                        self._scale_striped(sums, counts)
                         scaled = True
                         self._apply_update(sums)
             finally:
@@ -732,13 +870,82 @@ class ParameterServerCore:
             self._params_version += 1
         return True
 
+    def _scale_striped(self, sums: TensorStore,
+                       counts: dict[str, int]) -> None:
+        """In-place sums -> means, fanned per stripe across the shared
+        executor (the per-tensor op is unchanged, so the result is
+        bit-for-bit the serial loop's).  Caller holds _apply_lock."""
+        if self._stripes <= 1 or len(sums) <= 1:
+            for name, acc in sums.items():
+                acc *= np.float32(1.0 / counts[name])
+            return
+
+        def scale_group(names: list[str]) -> None:
+            for name in names:
+                sums[name] *= np.float32(1.0 / counts[name])
+
+        run_striped([(lambda ns=ns: scale_group(ns))
+                     for ns in partition_names(sums, self._stripes)])
+
+    def _apply_striped_sync(self, prev: TensorStore,
+                            mean_grads: TensorStore) -> None:
+        """Stripe-parallel synchronous apply: tick the optimizer once,
+        then ``apply_shard`` per stripe on the shared executor — each
+        stripe updates its own optimizer-state slice in place and emits
+        fresh param arrays for its names; the merged store is swapped in
+        under _params_lock.  The caller serializes applies (_apply_lock
+        on the streaming close, _state_lock on the buffered path), so the
+        optimizer never sees two concurrent logical steps.  Serves during
+        the compute read the previous store at its previous version —
+        safe, because the barrier is not published until the close
+        returns, so no client can mistake the pre-apply store for the
+        post-barrier one."""
+        opt = self._optimizer
+        opt.tick()
+        name_groups = partition_names(prev, self._stripes)
+        stripe_s = [0.0] * len(name_groups)
+
+        def apply_group(idx: int, names: list[str]) -> TensorStore:
+            t1 = time.perf_counter()
+            res = opt.apply_shard(
+                {n: prev[n] for n in names},
+                {n: mean_grads[n] for n in names if n in mean_grads})
+            stripe_s[idx] = time.perf_counter() - t1
+            return res
+
+        t0 = time.perf_counter()
+        parts = run_striped([(lambda i=i, ns=ns: apply_group(i, ns))
+                             for i, ns in enumerate(name_groups)])
+        wall = time.perf_counter() - t0
+        by_name: TensorStore = {}
+        for part in parts:
+            by_name.update(part)
+        new_params = {name: by_name[name] for name in prev}  # stable order
+        for dt in stripe_s:
+            self._obs_stripe_ms.observe(1e3 * dt)
+        if wall > 0:
+            self._obs_parallelism.set(round(sum(stripe_s) / wall, 2))
+        with self._params_lock:
+            if self._params is not prev:
+                # initialize_parameters() landed during the striped
+                # compute (it takes only _params_lock; restore() is
+                # fenced separately via _restore_epoch).  The serial
+                # path's outcome for that interleaving is "apply, then
+                # the initialize wins" — keep the newer store rather
+                # than clobbering it with params derived from the
+                # pre-initialize world.
+                return
+            self._params = new_params
+            self._params_version += 1
+
     def _apply_update(self, mean_grads: TensorStore) -> None:
         """Applies are serialized by the caller: _state_lock on the
         async/buffered paths, _apply_lock on the streaming barrier close.
         Only _params_lock is taken here, and only briefly — in async mode
         the depth-bound fence on the previous in-flight apply happens
         OUTSIDE it, so concurrent serves keep reading the materialized
-        snapshot instead of queueing behind device compute."""
+        snapshot instead of queueing behind device compute; the striped
+        sync apply likewise computes outside it and swaps."""
         with self._params_lock:
             if not self._params:
                 # bootstrap quirk preserved from the reference (cpp:78-81)
@@ -760,7 +967,13 @@ class ParameterServerCore:
                 self._serving_version = self._params_version
                 self._params = new_params  # new apply is in flight
                 self._params_version += 1
+        elif (self._stripes > 1
+              and getattr(self._optimizer, "supports_striping", False)
+              and len(mean_grads) > 1):
+            self._apply_striped_sync(prev, mean_grads)
         else:
+            # serial / device-optimizer sync apply: under _params_lock,
+            # exactly the pre-stripe behavior (see analysis/baseline.json)
             with self._params_lock:
                 self._params = self._optimizer.apply(self._params,
                                                      mean_grads)

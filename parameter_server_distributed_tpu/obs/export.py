@@ -70,6 +70,14 @@ def _ps_rollup(snap: dict) -> dict:
     peak = snap.get("gauges", {}).get("ps.peak_grad_buffer_bytes", 0)
     if peak:
         out["peak_grad_buffer_bytes"] = peak
+    # striped hot path (core/ps_core.py, PSDT_STRIPES): per-stripe apply
+    # wall time + the achieved parallelism of the last striped apply
+    stripe = _hist_stats(snap, "ps.apply.stripe_ms")
+    if stripe:
+        out["apply_stripe_ms"] = stripe
+    par = snap.get("gauges", {}).get("ps.apply.parallelism", 0)
+    if par:
+        out["apply_parallelism"] = par
     return out
 
 
@@ -244,6 +252,13 @@ def render_rollup(rollup: dict) -> str:
             close = ps.get("barrier_close")
             if close:
                 parts.append(f"barrier close p50={_fmt_s(close['p50'])}")
+            stripe = ps.get("apply_stripe_ms")
+            if stripe:
+                note = (f"apply stripes p50={stripe['p50']:.2f}ms")
+                par = ps.get("apply_parallelism")
+                if par:
+                    note += f" ({par:g}x parallel)"
+                parts.append(note)
             peak = ps.get("peak_grad_buffer_bytes")
             if peak:
                 parts.append(f"peak grad buffer {_fmt_bytes(peak)}")

@@ -107,6 +107,28 @@ _ITERATION_FIELD = 1   # m.ParameterUpdate.iteration
 _READY_FIELD = 3       # m.ParameterUpdate.ready
 
 
+def encode_parameter_record_groups(
+        groups: Sequence[Sequence[m.Tensor]],
+        stripes: int | None = None) -> list[bytes]:
+    """Encode several chunk groups' ``ParameterUpdate.parameters`` bodies,
+    fanning the per-group :func:`encode_parameter_records` passes across
+    the shared stripe executor (core/stripes.py) when more than one group
+    and more than one stripe are configured.  ``stripes`` is the serving
+    core's resolved stripe count (so a ``ParameterServerCore(stripes=1)``
+    serial escape hatch is honored here too, not only via PSDT_STRIPES);
+    None falls back to the env/core-count default.  Group order is
+    preserved and each group's bytes are exactly what the serial encode
+    produces — the wire format is untouched, only WHICH thread runs each
+    group's payload casts/packs changes (the numpy casts release the GIL,
+    so a multi-chunk store encodes on multiple cores)."""
+    from ..core.stripes import run_striped, stripe_count
+
+    if len(groups) <= 1 or stripe_count(stripes) <= 1:
+        return [encode_parameter_records(group) for group in groups]
+    return run_striped([(lambda g=group: encode_parameter_records(g))
+                        for group in groups])
+
+
 def encode_parameter_records(tensors: Iterable[m.Tensor]) -> bytes:
     """Encode a group of wire Tensors ONCE into the exact bytes of
     ``ParameterUpdate.parameters`` (field 2) records — tag, length, and

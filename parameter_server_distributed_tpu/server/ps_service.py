@@ -19,6 +19,10 @@ Two server-side hot-path optimizations live here (ISSUE 3):
   runs ONE `to_wire` encode instead of N.  The version key makes
   invalidation automatic: apply/restore/initialize bump the core's store
   version and the next serve re-encodes.
+- **Stripe-parallel miss encode** (ISSUE 5): the one real encode per
+  version fans its per-chunk payload passes across the shared stripe
+  executor (core/stripes.py), so a multi-chunk store encodes on multiple
+  cores; the produced wire bytes are identical to the serial encode's.
 """
 
 from __future__ import annotations
@@ -41,7 +45,7 @@ from ..obs import stats as obs_stats
 from ..obs import trace as obs_trace
 from ..rpc import messages as m
 from ..rpc.data_plane import (PreEncodedParameterUpdate,
-                              encode_parameter_records, split_tensors,
+                              encode_parameter_record_groups, split_tensors,
                               stream_chunk_bytes)
 from ..rpc.service import bind_service, make_server
 
@@ -205,16 +209,20 @@ class ParameterServerService:
 
     def _encode_chunk_bodies(self, request_iteration: int, eff_dtype: int,
                              budget: int):
-        """One real encode pass: (lazy body iterator, store version) — the
-        single shared recipe under the cache.  Every consumer currently
-        drains it whole before touching the network (see
-        _parameter_chunks for why the fill must not be client-paced); the
-        laziness keeps peak memory at one chunk above the collected
-        bodies."""
+        """One real encode pass: (chunk bodies, store version) — the
+        single shared recipe under the cache.  The per-chunk payload
+        encodes (f32→bf16 casts, repeated-float packs) fan out across the
+        shared stripe executor (rpc/data_plane.py
+        encode_parameter_record_groups) — a version-miss encode of a
+        multi-chunk store runs on multiple cores, and every consumer
+        collects the whole body list anyway before touching the network
+        (see _parameter_chunks for why the fill must not be
+        client-paced)."""
         _, params, _, version = self.core.serve_view(request_iteration)
         tensors = to_wire(params, wire_dtype=eff_dtype)
-        bodies = (encode_parameter_records(group)
-                  for group in split_tensors(tensors, budget))
+        bodies = encode_parameter_record_groups(
+            list(split_tensors(tensors, budget)),
+            stripes=self.core.stripes)
         return bodies, version
 
     def _serve_key(self, wire_dtype: int) -> tuple:
@@ -233,7 +241,7 @@ class ParameterServerService:
             self._obs_cache_hit.add()
             return entry.bodies, True
         self._obs_cache_miss.add()
-        return list(self._encode_chunk_bodies(0, key[1], key[2])[0]), False
+        return self._encode_chunk_bodies(0, key[1], key[2])[0], False
 
     def _encoded_parameter_chunks(self, request_iteration: int,
                                   wire_dtype: int) -> list[bytes]:
@@ -252,9 +260,8 @@ class ParameterServerService:
             if builder:
                 self._obs_cache_miss.add()
                 try:
-                    body_iter, version = self._encode_chunk_bodies(
+                    bodies, version = self._encode_chunk_bodies(
                         request_iteration, key[1], key[2])
-                    bodies = list(body_iter)
                 except BaseException:
                     self._serve_cache.fail(key, entry)
                     raise

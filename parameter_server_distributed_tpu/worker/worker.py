@@ -62,6 +62,14 @@ class WorkerError(RuntimeError):
     pass
 
 
+def error_feedback_enabled() -> bool:
+    """PSDT_ERROR_FEEDBACK gates the lossy-push error-feedback residual
+    (default ON: lossy wire dtypes without it accumulate quantization
+    bias push over push).  ``0`` disables the carry — the A/B knob the
+    convergence tests and benches compare against."""
+    return os.environ.get("PSDT_ERROR_FEEDBACK", "1") not in ("0", "off")
+
+
 class Worker:
     def __init__(self, config: WorkerConfig, trainer,
                  batches: Iterator, start_heartbeat: bool = True):
@@ -346,11 +354,13 @@ class Worker:
             sum(4 * int(np.asarray(g).size) for g in grads.values()))
         push_dtype = self._wire_dtype if self._peer_packed_ok else m.WIRE_F32
         new_residual = None
-        if push_dtype in (m.WIRE_INT8, m.WIRE_TOPK):
+        if (push_dtype in (m.WIRE_INT8, m.WIRE_TOPK)
+                and error_feedback_enabled()):
             tensors, new_residual = self._compress_with_feedback(
                 grads, push_dtype)
         else:
-            tensors = to_wire(grads, push_dtype)
+            tensors = to_wire(grads, push_dtype,
+                              topk_density=self.config.topk_density)
         # actual wire footprint of the payloads (packed encodings shrink
         # it) so the --metrics compression ratio is truthful
         self._obs_push_wire.add(sum(t.encoded_size() for t in tensors))
@@ -409,7 +419,8 @@ class Worker:
         double-counting it (core/ps_core.py first-push-wins)."""
         push_dtype = self._wire_dtype if self._peer_packed_ok else m.WIRE_F32
         compress = push_dtype in (m.WIRE_INT8, m.WIRE_TOPK)
-        residual_box: dict[str, np.ndarray] | None = {} if compress else None
+        use_ef = compress and error_feedback_enabled()
+        residual_box: dict[str, np.ndarray] | None = {} if use_ef else None
 
         def tensors():
             if residual_box is not None:
@@ -420,13 +431,16 @@ class Worker:
                 g = np.asarray(g, np.float32)
                 payload += 4 * g.size
                 if compress:
-                    prev = self._ef_residual.get(name)
+                    prev = (self._ef_residual.get(name) if use_ef
+                            else None)
                     adjusted = g + prev if prev is not None else g
                     t = m.Tensor.from_array(
                         name, adjusted, wire_dtype=push_dtype,
                         topk_density=self.config.topk_density)
-                    # what the PS did NOT see carries into the next push
-                    residual_box[name] = adjusted - t.to_array()
+                    if use_ef:
+                        # what the PS did NOT see carries into the next
+                        # push
+                        residual_box[name] = adjusted - t.to_array()
                 else:
                     t = m.Tensor.from_array(name, g, wire_dtype=push_dtype)
                 wire += t.encoded_size()

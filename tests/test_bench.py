@@ -99,6 +99,24 @@ def test_bench_aggregate_contract():
 
 
 @pytest.mark.slow
+def test_bench_apply_contract():
+    """apply mode: striped barrier-close profile, serial vs striped side
+    by side with the stripe counts visible in the JSON."""
+    result = run_bench("apply", extra_env={
+        "PSDT_BENCH_PARAMS": "4e5",
+        "PSDT_BENCH_STRIPE_COUNTS": "1,2",
+        "PSDT_BENCH_WORKER_COUNTS": "2",
+        "PSDT_BENCH_STEPS": "2",
+    })
+    assert result["metric"] == "ps_apply_close_ms_2stripes_2w"
+    assert result["value"] > 0
+    assert set(result["by_stripes"]) == {"1", "2"}
+    assert result["by_stripes"]["1"]["2"]["barrier_close_ms"] > 0
+    # the striped cell reports its achieved apply parallelism
+    assert result["by_stripes"]["2"]["2"].get("apply_parallelism", 0) > 0
+
+
+@pytest.mark.slow
 def test_bench_serve_contract():
     """serve mode: continuous-batching sustained tokens/s with the int8
     stack applied; the metric must carry the kv8 suffix."""

@@ -25,8 +25,10 @@ final stdout is always exactly one JSON line; failures carry the
 exception text in a "note" field.
 
 Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
-(mfu | samples | pushpull | dataplane | aggregate | apply | async |
+(mfu | samples | pushpull | dataplane | aggregate | apply | codec | async |
 generate | serve | attention;
+codec = native-vs-Python wire-codec GB/s + same-host shm-vs-TCP fused
+step time (PSDT_NATIVE / PSDT_SHM A/B, ISSUE 6);
 default mfu; serve = continuous-batching sustained tokens/s, with
 PSDT_BENCH_REQUESTS total requests),
 PSDT_BENCH_TPU_TIMEOUT (s, default 240), PSDT_BENCH_TPU_ATTEMPTS
@@ -661,6 +663,153 @@ def bench_dataplane() -> dict:
                      f"rounds/step vs serial "
                      f"{serial['rpc_rounds_per_step']:g}; serial step "
                      f"p-mean {serial['step_ms']:g} ms")}
+
+
+def bench_codec() -> dict:
+    """Native-codec + same-host-transport microbench (ISSUE 6).
+
+    Part 1 — wire codec: encode/decode GB/s (f32-payload bytes per second
+    of wall time) through the full tensor path (``to_wire`` +
+    ``encode_parameter_records`` / ``Tensor.decode`` + ``to_array``) for
+    each packed wire dtype, native (PSDT_NATIVE) vs the pure-Python
+    oracle, same bytes by construction.  Part 2 — same-host transport:
+    fused push->barrier->pull round p50 against an in-process PS over the
+    shared-memory rings vs TCP loopback (PSDT_SHM A/B).
+
+    Knobs: PSDT_BENCH_PARAMS (total store elements, default 4e6),
+    PSDT_BENCH_STEPS (timing reps, default 5)."""
+    import numpy as np
+
+    from parameter_server_distributed_tpu import native
+    from parameter_server_distributed_tpu.core.tensor import to_wire
+    from parameter_server_distributed_tpu.rpc import messages as m
+    from parameter_server_distributed_tpu.rpc.data_plane import (
+        encode_parameter_records)
+
+    total = int(float(os.environ.get("PSDT_BENCH_PARAMS", "0")) or 4e6)
+    reps = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 5
+    rng = np.random.default_rng(0)
+    n_tensors = 16
+    store = {f"t{i:02d}": rng.standard_normal(
+        max(1, total // n_tensors)).astype(np.float32)
+        for i in range(n_tensors)}
+    payload = 4 * sum(v.size for v in store.values())
+    have_native = native.lib() is not None
+    modes = ("python", "native") if have_native else ("python",)
+
+    def timed(fn) -> float:
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # restore the PROCESS default afterwards (PSDT_NATIVE env), never a
+    # hard-coded True: PSDT_NATIVE=0 must govern Part 2 and later modes
+    default_native = os.environ.get("PSDT_NATIVE",
+                                    "1").lower() not in ("0", "false")
+    encode: dict[str, dict] = {}
+    decode: dict[str, dict] = {}
+    for label, wd in (("bf16", m.WIRE_BF16), ("int8", m.WIRE_INT8),
+                      ("topk", m.WIRE_TOPK)):
+        encode[label], decode[label] = {}, {}
+        for mode in modes:
+            native.set_enabled(mode == "native")
+            try:
+                encode[label][mode] = round(payload / timed(
+                    lambda: encode_parameter_records(
+                        to_wire(store, wire_dtype=wd))) / 1e9, 3)
+                blob = m.ParameterUpdate(
+                    iteration=1, parameters=to_wire(store, wire_dtype=wd),
+                    ready=True).encode()
+
+                def decode_all() -> None:
+                    for t in m.ParameterUpdate.decode(
+                            memoryview(blob)).parameters:
+                        t.to_array()
+
+                decode[label][mode] = round(
+                    payload / timed(decode_all) / 1e9, 3)
+            finally:
+                native.set_enabled(default_native)
+        if have_native:
+            encode[label]["ratio"] = round(
+                encode[label]["native"] / encode[label]["python"], 2)
+            decode[label]["ratio"] = round(
+                decode[label]["native"] / decode[label]["python"], 2)
+        log(f"bench_codec: {label} encode {encode[label]} "
+            f"decode {decode[label]}")
+
+    # Part 2: fused-step p50, shm rings vs TCP loopback, same store.
+    import tempfile
+
+    from parameter_server_distributed_tpu.config import (
+        ParameterServerConfig)
+    from parameter_server_distributed_tpu.obs import stats as obs_stats
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+
+    def fused_profile(use_shm: bool) -> dict:
+        os.environ["PSDT_SHM"] = "1" if use_shm else "0"
+        before = obs_stats.REGISTRY.snapshot()["counters"].get(
+            "rpc.shm.bytes", 0)
+        ps = ParameterServer(ParameterServerConfig(
+            bind_address="127.0.0.1", port=0, total_workers=1,
+            checkpoint_dir=tempfile.mkdtemp(prefix="psdt-codec-"),
+            learning_rate=0.05, autosave_period_s=3600.0))
+        port = ps.start()
+        try:
+            with PSClient(f"127.0.0.1:{port}") as client:
+                seed = client.push_gradients(m.GradientUpdate(
+                    worker_id=0, iteration=0,
+                    gradients=to_wire(store)))
+                assert seed.success, seed.message
+                times = []
+                for it in range(1, reps + 3):
+                    grads = to_wire(store)
+                    t0 = time.perf_counter()
+                    push, params = client.push_pull(0, it, grads)
+                    times.append(time.perf_counter() - t0)
+                    assert push.success and params is not None
+                active = client.shm_active
+            times = sorted(times[2:])  # drop negotiation + warm rounds
+            after = obs_stats.REGISTRY.snapshot()["counters"].get(
+                "rpc.shm.bytes", 0)
+            return {"p50_ms": round(
+                        1e3 * times[len(times) // 2], 2),
+                    "shm_active": active,
+                    "shm_bytes": after - before}
+        finally:
+            ps.stop()
+            os.environ.pop("PSDT_SHM", None)
+
+    shm = fused_profile(use_shm=True)
+    tcp = fused_profile(use_shm=False)
+    log(f"bench_codec: fused step shm {shm} tcp {tcp}")
+
+    headline_mode = "native" if have_native else "python"
+    result = {
+        "metric": f"codec_encode_gbps_{headline_mode}",
+        # headline: the int8 quantize path — the EQuARX-style fused
+        # quantize+encode this refactor exists to accelerate
+        "value": encode["int8"][headline_mode],
+        "unit": "GB/s",
+        "vs_baseline": encode["int8"].get("ratio", 1.0),
+        "encode": encode,
+        "decode": decode,
+        "same_host": {"shm": shm, "tcp": tcp,
+                      "speedup": round(tcp["p50_ms"]
+                                       / max(shm["p50_ms"], 1e-3), 2)},
+        "note": (f"native vs python encode ratios: "
+                 + ", ".join(f"{k} {v.get('ratio', 'n/a')}x"
+                             for k, v in encode.items())
+                 + f"; fused step shm {shm['p50_ms']}ms vs tcp "
+                   f"{tcp['p50_ms']}ms" if have_native else
+                 "no g++: python codec only"),
+    }
+    return result
 
 
 def bench_aggregate() -> dict:
@@ -1499,6 +1648,8 @@ def child_main(mode: str) -> int:
             result = bench_pushpull()
         elif mode == "dataplane":
             result = bench_dataplane()
+        elif mode == "codec":
+            result = bench_codec()
         elif mode == "aggregate":
             result = bench_aggregate()
         elif mode == "apply":
@@ -1610,7 +1761,7 @@ def main() -> int:
     # Host-only benches never need the accelerator — run them on CPU
     # directly rather than risking a flaky TPU init.
     plans: list[tuple[str, float]]
-    if mode in ("pushpull", "dataplane", "aggregate", "apply"):
+    if mode in ("pushpull", "dataplane", "aggregate", "apply", "codec"):
         plans = [("cpu", cpu_timeout)]
     else:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]

@@ -46,6 +46,13 @@ LOCK_RANKS: dict[str, int] = {
     "ParameterServerCore._stripe_lock": 44,
     # leaves: never held while acquiring anything else
     "ParameterServerCore._live_lock": 50,
+    # shm transport (rpc/shm_transport.py, ISSUE 6): the client-side lock
+    # serializes one fused round end to end over the SPSC rings (ring
+    # doorbell waits run under it — see BLOCKING_ALLOWED); the server-side
+    # lock guards only the connection registry.  Both are leaves: no other
+    # declared lock is ever acquired under them.
+    "ShmClientConnection._lock": 54,
+    "ShmServer._lock": 56,
     "EncodedServeCache._lock": 60,
     "ClusterAggregator._lock": 62,
     "trainer._DISPATCH_LOCK": 64,
@@ -67,6 +74,9 @@ BLOCKING_ALLOWED: frozenset[str] = frozenset({
     "trainer._DISPATCH_LOCK",
     # single-flight g++ build of the native kernels
     "native._lock",
+    # serializes one fused shm round (write frames, doorbell-wait, read
+    # frames) — the ring waits ARE the serialized blocking section
+    "ShmClientConnection._lock",
 })
 
 ENV_FLAG = "PSDT_LOCK_CHECK"

@@ -48,13 +48,30 @@ def _build() -> str | None:
         if (os.path.exists(_SO_PATH)
                 and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC)):
             return _SO_PATH
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-               "-o", _SO_PATH, _SRC]
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        base = ["g++", "-O3", "-ffp-contract=off", "-shared", "-fPIC",
+                "-std=c++17", "-o", _SO_PATH, _SRC]
+        try:
+            # -march=native lets the codec loops vectorize (the .so is
+            # built on the machine that runs it, so the ISA is known);
+            # IEEE semantics are untouched — no -ffast-math, ever, and
+            # -ffp-contract=off keeps -march from FMA-contracting the
+            # optimizer kernels away from numpy's separate mul+add
+            # rounding: the wire codec must stay bit-identical to the
+            # numpy oracle and the optimizers numpy-trajectory-equal
+            cmd = base[:1] + ["-march=native"] + base[1:]
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+        except subprocess.SubprocessError:
+            # cross/exotic toolchains may reject -march=native
+            subprocess.run(base, check=True, capture_output=True,
+                           timeout=120)
         return _SO_PATH
     except (OSError, subprocess.SubprocessError) as exc:
         log.warning("native build failed (%s); using numpy fallback", exc)
         return None
+
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
 
 
 def _bind(path: str) -> ctypes.CDLL:
@@ -69,6 +86,15 @@ def _bind(path: str) -> ctypes.CDLL:
     lib.psdt_adamw.argtypes = [_F32P, _F32P, _F32P, _F32P, i64, f32, f32,
                                f32, f32, f32, f32, f32]
     lib.psdt_mean_sgd.argtypes = [_F32P, pp, i32, i64, f32]
+    # wire-codec kernels (rpc/codec.py NativeCodec)
+    lib.psdt_copy.argtypes = [ctypes.c_void_p, ctypes.c_void_p, i64]
+    lib.psdt_pack_bf16.argtypes = [_F32P, i64, _U8P]
+    lib.psdt_unpack_bf16.argtypes = [_U8P, i64, _F32P]
+    lib.psdt_quant_int8.argtypes = [_F32P, i64, _U8P]
+    lib.psdt_dequant_int8.argtypes = [_U8P, i64, _F32P]
+    lib.psdt_topk_pack.argtypes = [_F32P, i64, i64, _U8P]
+    lib.psdt_topk_unpack.argtypes = [_U8P, i64, _F32P]
+    lib.psdt_topk_unpack.restype = ctypes.c_int32
     return lib
 
 
@@ -76,9 +102,33 @@ _enabled = os.environ.get("PSDT_NATIVE", "1").lower() not in ("0", "false")
 
 
 def set_enabled(value: bool) -> None:
-    """Enable/disable the native path at runtime (bench A/B knob)."""
-    global _enabled
+    """Enable/disable the native path at runtime (bench A/B knob).
+
+    Re-enabling also clears the build-attempted latch when no library was
+    bound, so a failure (e.g. a transiently missing compiler) is retried
+    instead of sticking for the process lifetime."""
+    global _enabled, _tried
     _enabled = bool(value)
+    if _enabled and _lib is None and _tried:
+        with _lock:
+            if _lib is None:
+                _tried = False
+
+
+def is_enabled() -> bool:
+    """Whether the native path is currently requested (it may still be
+    unavailable — ``lib()`` is the authoritative probe)."""
+    return _enabled
+
+
+def reset_for_retry() -> None:
+    """Drop the bound library and the build-attempted latch so the next
+    ``lib()`` call rebuilds/rebinds from scratch (test hook; also the
+    escape hatch after fixing a broken toolchain in a live process)."""
+    global _lib, _tried
+    with _lock:
+        _lib = None
+        _tried = False
 
 
 def lib() -> ctypes.CDLL | None:
@@ -189,6 +239,104 @@ def adam_native(param: np.ndarray, grad: np.ndarray, m: np.ndarray,
                      ctypes.c_float(1.0 - b1 ** step),
                      ctypes.c_float(1.0 - b2 ** step))
     return True
+
+
+# ---------------------------------------------------------------------------
+# Wire-codec wrappers (rpc/codec.py NativeCodec).  All of them are zero-copy:
+# sources/destinations are pointers into the caller's numpy arrays and the
+# encoder's preallocated message buffer; ctypes releases the GIL around the
+# call, so stripe-parallel encodes (core/stripes.py) really run multicore.
+# Every wrapper returns False when the native path is unavailable or the
+# inputs are unsuitable — the caller falls back to the numpy reference.
+
+
+def _u8ptr(arr: np.ndarray) -> "ctypes.POINTER":
+    return arr.ctypes.data_as(_U8P)
+
+
+def _as_u8(buf) -> np.ndarray:
+    """Zero-copy uint8 view of a bytes/memoryview/ndarray buffer."""
+    if isinstance(buf, np.ndarray):
+        return buf.view(np.uint8) if buf.dtype != np.uint8 else buf
+    return np.frombuffer(buf, np.uint8)
+
+
+def copy_fn():
+    """GIL-free bulk copy ``fn(dst_addr, src_addr, nbytes)`` (raw
+    addresses), or None without the native lib.  Used by the shm ring
+    transport so large copies overlap across threads."""
+    native = lib()
+    return native.psdt_copy if native is not None else None
+
+
+def pack_bf16_native(src: np.ndarray, dst) -> bool:
+    """f32 -> bf16 (RNE) straight into ``dst`` (2*n bytes)."""
+    native = lib()
+    if native is None or src.dtype != np.float32 \
+            or not src.flags.c_contiguous:
+        return False
+    native.psdt_pack_bf16(_fptr(src), src.size, _u8ptr(_as_u8(dst)))
+    return True
+
+
+def unpack_bf16_native(raw, out: np.ndarray) -> bool:
+    """bf16 payload -> f32 ``out`` (len(raw)//2 elements)."""
+    native = lib()
+    if native is None or out.dtype != np.float32 \
+            or not out.flags.c_contiguous:
+        return False
+    native.psdt_unpack_bf16(_u8ptr(_as_u8(raw)), out.size, _fptr(out))
+    return True
+
+
+def quant_int8_native(src: np.ndarray, dst) -> bool:
+    """f32 -> [f32 max-abs scale | int8 * n] payload into ``dst``."""
+    native = lib()
+    if native is None or src.dtype != np.float32 \
+            or not src.flags.c_contiguous:
+        return False
+    native.psdt_quant_int8(_fptr(src), src.size, _u8ptr(_as_u8(dst)))
+    return True
+
+
+def dequant_int8_native(raw, out: np.ndarray) -> bool:
+    native = lib()
+    if native is None or out.dtype != np.float32 \
+            or not out.flags.c_contiguous:
+        return False
+    native.psdt_dequant_int8(_u8ptr(_as_u8(raw)), out.size, _fptr(out))
+    return True
+
+
+def topk_pack_native(src: np.ndarray, k: int, dst) -> bool:
+    """f32 -> [u32 k | k*u32 idx | k*bf16 vals] payload into ``dst``
+    (deterministic threshold + ascending-index tie-break — the shared
+    codec contract, see psdt_native.cpp)."""
+    native = lib()
+    if native is None or src.dtype != np.float32 \
+            or not src.flags.c_contiguous:
+        return False
+    native.psdt_topk_pack(_fptr(src), src.size, int(k), _u8ptr(_as_u8(dst)))
+    return True
+
+
+def topk_unpack_native(raw, out: np.ndarray) -> bool:
+    """topk payload -> dense f32 ``out`` (zero-filled + scatter).  False on
+    a malformed payload — truncated header, a k claiming more entries
+    than the payload carries (the C++ would read past the buffer), or an
+    out-of-range index — so the Python path raises loudly instead."""
+    native = lib()
+    if native is None or out.dtype != np.float32 \
+            or not out.flags.c_contiguous:
+        return False
+    u8 = _as_u8(raw)
+    if u8.size < 4:
+        return False
+    k = int(np.frombuffer(u8[:4].tobytes(), "<u4")[0])
+    if u8.size < 4 + 6 * k:  # wire-facing input: never trust the header
+        return False
+    rc = native.psdt_topk_unpack(_u8ptr(u8), out.size, _fptr(out))
+    return rc == 0
 
 
 def adamw_native(param: np.ndarray, grad: np.ndarray, m: np.ndarray,

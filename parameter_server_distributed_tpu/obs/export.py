@@ -109,6 +109,18 @@ def worker_rollup(snap: dict) -> dict:
     ps = _ps_rollup(snap)
     if ps:
         out["ps"] = ps
+    # native data plane (ISSUE 6): which codec this process resolved
+    # (rpc.codec.native gauge) and how much of its fused traffic rode the
+    # same-host shared-memory rings vs downgraded to TCP
+    shm_bytes = snap.get("counters", {}).get("rpc.shm.bytes", 0)
+    shm_fallback = snap.get("counters", {}).get("rpc.shm.fallback", 0)
+    codec_native = snap.get("gauges", {}).get("rpc.codec.native")
+    if shm_bytes or shm_fallback or codec_native is not None:
+        out["native_plane"] = {
+            "codec_native": codec_native,
+            "shm_bytes": shm_bytes,
+            "shm_fallbacks": shm_fallback,
+        }
     payload = _sum_counters(snap, ".payload_bytes", "rpc.client.")
     if payload:
         # uncompressed (f32) size of the tensors that rode those wire
@@ -263,6 +275,21 @@ def render_rollup(rollup: dict) -> str:
             if peak:
                 parts.append(f"peak grad buffer {_fmt_bytes(peak)}")
             lines.append(f"    ps: {', '.join(parts)}")
+        native_plane = w.get("native_plane")
+        if native_plane:
+            parts = []
+            if native_plane.get("codec_native") is not None:
+                parts.append("codec="
+                             + ("native" if native_plane["codec_native"]
+                                else "python"))
+            if native_plane.get("shm_bytes"):
+                parts.append(
+                    f"shm {_fmt_bytes(native_plane['shm_bytes'])}")
+            if native_plane.get("shm_fallbacks"):
+                parts.append(
+                    f"{native_plane['shm_fallbacks']} shm fallbacks")
+            if parts:
+                lines.append(f"    data plane: {', '.join(parts)}")
         extra = (f"    bytes: {_fmt_bytes(w['bytes_sent'])} sent / "
                  f"{_fmt_bytes(w['bytes_received'])} received")
         if w.get("payload_bytes_f32"):

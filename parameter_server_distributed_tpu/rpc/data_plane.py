@@ -38,15 +38,27 @@ target, not a hard message cap.
 
 from __future__ import annotations
 
+import logging
 import os
+import time
 from typing import Iterable, Iterator, Sequence
 
 import grpc
 
+from ..obs import stats as obs_stats
 from . import messages as m
+from . import shm_transport
+# The wire payload codec (ISSUE 6): every packed tensor payload on this
+# data plane encodes/decodes through this narrow interface — PythonCodec
+# is the byte-identity oracle and fallback, NativeCodec the zero-copy C++
+# fast path selected per process via PSDT_NATIVE (see codec.py).
+from .codec import (Codec, NativeCodec, PythonCodec,  # noqa: F401 — public
+                    active_codec)
 from .service import RpcClient
 from .wire import WT_LEN, WT_VARINT, _len_delimited_size, _tag, _varint_size, \
     _Writer, encode_varint
+
+log = logging.getLogger("pst.data_plane")
 
 # Default chunk budget for streamed pushes/pulls.  Tens of MB amortizes
 # per-message overhead while keeping encode/transport/decode pipelined;
@@ -204,6 +216,7 @@ class PSClient(RpcClient):
                  methods=None, chunk_bytes: int | None = None):
         methods = dict(methods or m.PARAMETER_SERVER_METHODS)
         methods.update(m.PARAMETER_SERVER_STREAM_METHODS)
+        methods.update(shm_transport.SHM_METHODS)
         super().__init__(target, service, methods)
         self.chunk_bytes = (stream_chunk_bytes() if chunk_bytes is None
                             else chunk_bytes)
@@ -212,12 +225,81 @@ class PSClient(RpcClient):
         self._stream_ok: bool | None = None
         # same tri-state for the fused push→barrier→pull method
         self._fused_ok: bool | None = None
+        # same-host shared-memory transport (rpc/shm_transport.py): None =
+        # negotiation untried; False = permanently downgraded to TCP
+        # (UNIMPLEMENTED / refused / attach failure / transport error) —
+        # the PR-2 per-connection fallback discipline
+        self._shm_conn: shm_transport.ShmClientConnection | None = None
+        self._shm_ok: bool | None = None
+        self._obs_shm_fallback = obs_stats.counter("rpc.shm.fallback")
 
     def _streaming(self) -> bool:
         return self.chunk_bytes > 0 and self._stream_ok is not False
 
     def _fused(self) -> bool:
         return self.chunk_bytes > 0 and self._fused_ok is not False
+
+    @property
+    def shm_active(self) -> bool:
+        """True once a same-host shared-memory connection is serving the
+        fused rounds (worker logging/diagnostics)."""
+        return self._shm_conn is not None and self._shm_ok is True
+
+    def close(self) -> None:
+        self._drop_shm(permanent=False)
+        super().close()
+
+    # ------------------------------------------------------- shm transport
+    def _drop_shm(self, permanent: bool = True) -> None:
+        conn, self._shm_conn = self._shm_conn, None
+        if permanent:
+            self._shm_ok = False
+        if conn is not None:
+            conn.close()
+
+    def _shm_connection(self, timeout):
+        """The negotiated shared-memory connection, negotiating on first
+        use.  Returns None whenever the fused round should ride TCP —
+        permanently after a refusal/UNIMPLEMENTED/attach failure, or just
+        for this round when the negotiation RPC itself failed transiently."""
+        if not shm_transport.enabled() or self._shm_ok is False:
+            return None
+        if self._shm_conn is not None:
+            return self._shm_conn
+        try:
+            resp = self.call(
+                "NegotiateShm",
+                shm_transport.ShmNegotiateRequest(
+                    host_id=shm_transport.host_id(),
+                    ring_bytes=shm_transport.ring_bytes()),
+                timeout=timeout if timeout else 10.0)
+        except grpc.RpcError as exc:
+            if _status_code(exc) == grpc.StatusCode.UNIMPLEMENTED:
+                # reference PS: no such method, TCP forever
+                self._shm_ok = False
+                self._obs_shm_fallback.add()
+            return None
+        if not resp.accepted:
+            log.info("shm transport refused by %s: %s", self._target,
+                     resp.message)
+            self._shm_ok = False
+            self._obs_shm_fallback.add()
+            return None
+        try:
+            self._shm_conn = shm_transport.ShmClientConnection(
+                resp.c2s_name, resp.s2c_name, int(resp.ring_bytes),
+                doorbell_addr=resp.doorbell)
+        except (OSError, ValueError, ImportError) as exc:
+            # segments not reachable from this process (container /dev/shm
+            # isolation, permissions): same-host claim was wrong — TCP
+            log.warning("shm segment attach failed (%s); using TCP", exc)
+            self._shm_ok = False
+            self._obs_shm_fallback.add()
+            return None
+        self._shm_ok = True
+        log.info("shm transport active to %s (ring %d MB x2)",
+                 self._target, int(resp.ring_bytes) >> 20)
+        return self._shm_conn
 
     # ------------------------------------------------------------------ push
     def push_gradients(self, update: m.GradientUpdate,
@@ -295,40 +377,80 @@ class PSClient(RpcClient):
                                        iteration=iteration, gradients=[],
                                        pull_wire_dtype=pull_wire_dtype)
 
+        # Same-host fast path: the SAME chunk messages, byte-encoded into
+        # the shared-memory rings instead of the gRPC channel.  Any shm
+        # failure downgrades this connection to TCP permanently and the
+        # round is replayed below (tensors_fn is replayable by contract).
+        conn = self._shm_connection(timeout)
+        if conn is not None:
+            # a shm round IS a fused PushPullStream round, just not over
+            # gRPC: count it under the same call/latency instruments so
+            # rounds-per-step accounting stays transport-independent
+            # (payload bytes land in rpc.shm.bytes instead)
+            calls, latency, _ = self._instruments["PushPullStream"]
+            calls.add()
+            t0 = time.perf_counter()
+            try:
+                frames = conn.round_trip(
+                    (chunk.encode() for chunk in chunks()), timeout)
+                result = self._assemble_fused(
+                    (m.PushPullResponse.decode(memoryview(f))
+                     for f in frames), on_chunk)
+                # the server just proved it speaks the fused protocol
+                self._fused_ok = True
+                return result
+            except shm_transport.ShmTransportError as exc:
+                log.warning("shm fused round failed (%s); permanently "
+                            "downgrading %s to TCP", exc, self._target)
+                self._obs_shm_fallback.add()
+                self._drop_shm()
+            finally:
+                latency.observe(time.perf_counter() - t0)
+
         try:
-            push: m.PushResponse | None = None
-            merged: list[m.Tensor] = []
-            params_iteration, ready, got_params = 0, False, False
-            for frame in self.call("PushPullStream", chunks(),
-                                   timeout=timeout):
-                if frame.push is not None and push is None:
-                    push = frame.push
-                if frame.params is not None:
-                    got_params = True
-                    chunk = frame.params
-                    params_iteration, ready = chunk.iteration, chunk.ready
-                    if on_chunk is not None:
-                        on_chunk(chunk.parameters)
-                        merged.extend(
-                            m.Tensor(name=t.name,
-                                     packed_dtype=t.packed_dtype)
-                            for t in chunk.parameters)
-                    else:
-                        merged.extend(chunk.parameters)
+            result = self._assemble_fused(
+                self.call("PushPullStream", chunks(), timeout=timeout),
+                on_chunk)
             self._fused_ok = True
-            if push is None:
-                return m.PushResponse(success=False,
-                                      message="empty fused response"), None
-            if not (got_params and ready):
-                return push, None
-            return push, m.ParameterUpdate(iteration=params_iteration,
-                                           parameters=merged, ready=True)
+            return result
         except grpc.RpcError as exc:
             if _status_code(exc) != grpc.StatusCode.UNIMPLEMENTED:
                 raise
             self._fused_ok = False
             return self._push_only(worker_id, iteration, tensors_fn,
                                    timeout), None
+
+    @staticmethod
+    def _assemble_fused(frames, on_chunk) -> tuple[m.PushResponse,
+                                                   m.ParameterUpdate | None]:
+        """Fold a ``PushPullResponse`` frame stream (gRPC call or decoded
+        shm frames — identical bytes, identical semantics) into the
+        ``(push, params | None)`` result."""
+        push: m.PushResponse | None = None
+        merged: list[m.Tensor] = []
+        params_iteration, ready, got_params = 0, False, False
+        for frame in frames:
+            if frame.push is not None and push is None:
+                push = frame.push
+            if frame.params is not None:
+                got_params = True
+                chunk = frame.params
+                params_iteration, ready = chunk.iteration, chunk.ready
+                if on_chunk is not None:
+                    on_chunk(chunk.parameters)
+                    merged.extend(
+                        m.Tensor(name=t.name,
+                                 packed_dtype=t.packed_dtype)
+                        for t in chunk.parameters)
+                else:
+                    merged.extend(chunk.parameters)
+        if push is None:
+            return m.PushResponse(success=False,
+                                  message="empty fused response"), None
+        if not (got_params and ready):
+            return push, None
+        return push, m.ParameterUpdate(iteration=params_iteration,
+                                       parameters=merged, ready=True)
 
     def _push_only(self, worker_id: int, iteration: int, tensors_fn,
                    timeout) -> m.PushResponse:

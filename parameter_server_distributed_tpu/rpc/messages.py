@@ -17,6 +17,14 @@ from __future__ import annotations
 
 import numpy as np
 
+# Wire-dtype constants and the payload codec live in codec.py (the byte
+# work is implementation, not schema); they are re-exported here because
+# this module is the wire contract's public face (the analyzer manifest
+# pins their VALUES via WIRE_DTYPE_NAMES below).
+from .codec import (PACKED_WIRE_DTYPES, TOPK_DEFAULT_DENSITY, WIRE_BF16,
+                    WIRE_DTYPE_NAMES, WIRE_F32, WIRE_INT8, WIRE_RAW_F32,
+                    WIRE_TOPK, active_codec, bf16_dtype as _bf16_dtype,
+                    topk_k)
 from .wire import ArrayPayload, Field, Message
 
 # --------------------------------------------------------------------------
@@ -26,38 +34,11 @@ from .wire import ArrayPayload, Field, Message
 DTYPE_FLOAT32 = 0
 DTYPE_FLOAT64 = 1  # declared by the reference IDL, never used by its runtime
 
-# Wire encodings for Tensor payloads.  WIRE_F32 is the reference encoding
-# (packed `repeated float`, field 3).  The packed encodings are a framework
-# extension carried in fields 5/6, which reference peers skip per proto3
+# WIRE_F32 is the reference encoding (packed `repeated float`, field 3).
+# The packed encodings (see codec.py for layouts) are a framework extension
+# carried in fields 5/6, which reference peers skip per proto3
 # unknown-field rules; they are only emitted when a peer asks for them.
-WIRE_F32 = 0       # repeated float field 3 (reference-compatible, default)
-WIRE_RAW_F32 = 1   # raw little-endian float32 bytes in field 5
-WIRE_BF16 = 2      # raw bfloat16 bytes in field 5 — half the payload
-WIRE_INT8 = 3      # f32 max-abs scale + int8 bytes in field 5 — quarter
-                   # the payload (EQuARX-style quantized transport; pair
-                   # with error feedback for gradients — worker/worker.py)
-WIRE_TOPK = 4      # top-k sparsified: u32 k | k*u32 indices | k*bf16
-                   # values in field 5 (Deep-Gradient-Compression-style
-                   # transport: ~density*3/4 of the bf16 payload; pair
-                   # with error feedback so unsent mass is carried, not
-                   # dropped — worker/worker.py).  Decode rematerializes
-                   # dense, so the server aggregation path is unchanged.
-
-WIRE_DTYPE_NAMES = {"f32": WIRE_F32, "raw": WIRE_RAW_F32, "bf16": WIRE_BF16,
-                    "int8": WIRE_INT8, "topk": WIRE_TOPK}
-
-TOPK_DEFAULT_DENSITY = 0.01  # fraction of entries a topk tensor keeps
-
-
-_BF16 = None
-
-
-def _bf16_dtype():
-    global _BF16
-    if _BF16 is None:
-        import ml_dtypes  # ships with jax
-        _BF16 = ml_dtypes.bfloat16
-    return _BF16
+# WIRE_DTYPE_NAMES re-exported above — one definition, in codec.py.
 
 
 class Tensor(Message):
@@ -88,23 +69,12 @@ class Tensor(Message):
         dtype_tag = (DTYPE_FLOAT64 if src.dtype == np.float64
                      else DTYPE_FLOAT32)
         arr = src.astype(np.float32, copy=False)  # zero-copy for f32 input
-        if wire_dtype == WIRE_RAW_F32:
-            # lazy payload: the (no-op) cast-and-store happens straight into
-            # the outgoing message buffer at encode time (wire.ArrayPayload)
-            payload = ArrayPayload(np.ascontiguousarray(arr.reshape(-1)),
-                                   "<f4")
-        elif wire_dtype == WIRE_BF16:
-            # lazy payload: f32->bf16 conversion fused into the encode write
-            payload = ArrayPayload(np.ascontiguousarray(arr.reshape(-1)),
-                                   _bf16_dtype())
-        elif wire_dtype == WIRE_INT8:
-            flat = arr.reshape(-1)
-            max_abs = float(np.max(np.abs(flat))) if flat.size else 0.0
-            scale = max_abs / 127.0 if max_abs > 0 else 1.0
-            q = np.clip(np.rint(flat / scale), -127, 127).astype(np.int8)
-            payload = np.float32(scale).tobytes() + q.tobytes()
-        elif wire_dtype == WIRE_TOPK:
-            flat = arr.reshape(-1)
+        if wire_dtype not in PACKED_WIRE_DTYPES:
+            return cls(name=name, shape=list(arr.shape),
+                       data=arr.reshape(-1), dtype=dtype_tag)
+        flat = arr.reshape(-1)
+        k = 0
+        if wire_dtype == WIRE_TOPK:
             if flat.size >= 2**32:
                 # u4 wire indices would silently wrap on decode; no real
                 # tensor is 4B+ elements (16 GB+ f32), so refuse loudly
@@ -112,22 +82,14 @@ class Tensor(Message):
                 raise ValueError(
                     f"WIRE_TOPK indices are u32: tensor {name!r} has "
                     f"{flat.size} elements (>= 2**32); use bf16 wire")
-            k = min(flat.size, max(1, int(round(flat.size * topk_density)))) \
-                if flat.size else 0
-            if k:
-                # argpartition: O(n) selection of the k largest |values|
-                idx = np.argpartition(np.abs(flat), flat.size - k)[-k:]
-                idx = np.sort(idx).astype("<u4")  # sorted: cache-friendly
-                vals = flat[idx.astype(np.int64)].astype(_bf16_dtype())
-                payload = (np.uint32(k).tobytes() + idx.tobytes()
-                           + vals.tobytes())
-            else:
-                payload = np.uint32(0).tobytes()
-        else:
-            return cls(name=name, shape=list(arr.shape),
-                       data=arr.reshape(-1), dtype=dtype_tag)
+            k = topk_k(flat.size, topk_density)
+        # lazy payload for EVERY packed encoding: the cast / int8 quantize /
+        # top-k sparsify runs through the active codec (native C++ under
+        # PSDT_NATIVE) straight into the outgoing message buffer at encode
+        # time (wire.ArrayPayload.pack_into)
         return cls(name=name, shape=list(arr.shape), dtype=dtype_tag,
-                   packed=payload, packed_dtype=wire_dtype)
+                   packed=ArrayPayload(flat, wire_dtype, k),
+                   packed_dtype=wire_dtype)
 
     def to_array(self) -> np.ndarray:
         packed = self.packed
@@ -137,27 +99,12 @@ class Tensor(Message):
             # matches what a remote peer would decode (bf16 quantization
             # included)
             packed = packed.tobytes()
-        if self.packed_dtype == WIRE_BF16 and packed:
-            arr = np.frombuffer(packed, dtype=_bf16_dtype()).astype(
-                np.float32)
-        elif self.packed_dtype == WIRE_RAW_F32 and packed:
-            arr = np.frombuffer(packed, dtype="<f4").astype(
-                np.float32, copy=False)
-        elif self.packed_dtype == WIRE_INT8 and packed:
-            scale = np.frombuffer(packed, dtype="<f4", count=1)[0]
-            arr = np.frombuffer(packed, dtype=np.int8,
-                                offset=4).astype(np.float32) * scale
-        elif self.packed_dtype == WIRE_TOPK and packed:
-            k = int(np.frombuffer(packed, dtype="<u4", count=1)[0])
+        if self.packed_dtype in PACKED_WIRE_DTYPES and packed:
             # np.prod([]) == 1: an empty shape list is a 0-d SCALAR (one
             # element), not an empty tensor — empty tensors carry [0]
-            total = int(np.prod(self.shape))
-            arr = np.zeros(total, np.float32)
-            if k:
-                idx = np.frombuffer(packed, dtype="<u4", offset=4, count=k)
-                vals = np.frombuffer(packed, dtype=_bf16_dtype(),
-                                     offset=4 + 4 * k, count=k)
-                arr[idx.astype(np.int64)] = vals.astype(np.float32)
+            # (the dense total only matters to WIRE_TOPK's scatter)
+            arr = active_codec().unpack(self.packed_dtype, packed,
+                                        int(np.prod(self.shape)))
         else:
             arr = np.asarray(self.data, dtype=np.float32)
         if self.dtype == DTYPE_FLOAT64:

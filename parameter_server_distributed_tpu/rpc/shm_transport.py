@@ -1,0 +1,776 @@
+"""Shared-memory same-host transport for the fused data plane (ISSUE 6).
+
+When a worker and its PS run on the same machine, the fused
+``PushPullStream`` round still crosses the loopback TCP stack: every
+chunk is HTTP/2-framed, copied into the kernel, copied back out, and
+ACKed.  This module replaces that leg with two single-producer/
+single-consumer byte rings in ``multiprocessing.shared_memory`` segments
+— the SAME wire bytes (encoded ``GradientUpdate`` request frames one
+way, ``PushPullResponse`` frames the other), so the codec, the message
+schemas, and every aggregation semantic are untouched; only the
+transport under them changes.
+
+Negotiation (``NegotiateShm``) is an extension RPC on the parameter-
+server service.  Its messages live HERE, not in ``rpc/messages.py``:
+the wire-compat manifest pins the reference contract and must not
+change — a reference peer simply never calls this method and answers
+UNIMPLEMENTED, which the client treats exactly like the PR-2 stream
+fallbacks: a PERMANENT per-connection downgrade to TCP.  The handshake
+only succeeds when both ends report the same ``host_id`` (hostname +
+kernel boot id — two containers that share a boot id but not /dev/shm
+fail at segment attach and downgrade the same way) and the server can
+actually create segments (/dev/shm unavailable => refused => TCP).
+
+Ring protocol ("small doorbell"): each direction is a byte ring with two
+u64 cursors in the segment header — ``tail`` (bytes ever written, owned
+by the producer) and ``head`` (bytes ever read, owned by the consumer) —
+plus a u32 ``closed`` latch either side may set.  A frame is a u32
+length prefix followed by payload bytes, wrapped modulo the ring
+capacity; frames larger than the ring stream through it in pieces,
+published in ~1 MB blocks so the consumer's copy-out overlaps the
+producer's copy-in.  The DOORBELL is a 1-byte nudge on a per-connection
+AF_UNIX socket (abstract namespace — no filesystem litter): after
+advancing a cursor the mover rings it, and a waiter parks in
+``select`` — a real kernel wakeup, which matters twice: polling sleeps
+have ~1 ms granularity on HZ-bound kernels, and in-process (tests,
+colocated bench) a spinning waiter convoys the peer's copies under the
+GIL.  Cursor updates are single aligned 8-byte stores — atomic on every
+platform CPython runs on — and each cursor has exactly one writer; the
+socket carries no data, only wakeups, so a lost/skipped doorbell is a
+latency blip, never a correctness problem (waits recheck the cursors).
+
+Env knobs: ``PSDT_SHM`` (default on; 0 disables both ends),
+``PSDT_SHM_RING_BYTES`` (per-direction ring capacity, default 32 MB —
+frames larger than the ring stream through it).
+Observability: ``rpc.shm.bytes`` counts payload bytes moved through
+rings by this process; ``rpc.shm.fallback`` counts downgrades to TCP
+(refused negotiation, attach failure, or a mid-flight transport error).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+import uuid
+from typing import Callable, Iterator
+
+import numpy as np
+
+from .. import native
+from ..analysis.lock_order import checked_lock
+from ..obs import stats as obs_stats
+from .wire import Field, Message
+
+log = logging.getLogger("pst.shm")
+
+ENV_FLAG = "PSDT_SHM"
+ENV_RING_BYTES = "PSDT_SHM_RING_BYTES"
+# Frames larger than the ring stream through it in blocks, so the ring
+# only needs to be big enough to decouple the two sides — and every ring
+# page is touched at negotiation (see _pretouch), so smaller also means
+# a shorter warm-up.
+DEFAULT_RING_BYTES = 32 << 20
+
+# Segment header layout (64-byte cache line):
+#   0  u64 tail   — bytes ever written (producer-owned cursor)
+#   8  u64 head   — bytes ever read   (consumer-owned cursor)
+#   16 u32 closed — either side latches 1 to tear the connection down
+_HEADER = 64
+_OFF_TAIL = 0
+_OFF_HEAD = 8
+_OFF_CLOSED = 16
+
+_obs_bytes = obs_stats.counter("rpc.shm.bytes")
+_obs_fallback = obs_stats.counter("rpc.shm.fallback")
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "1") not in ("0", "false", "off")
+
+
+def ring_bytes() -> int:
+    return int(os.environ.get(ENV_RING_BYTES, str(DEFAULT_RING_BYTES)))
+
+
+def host_id() -> str:
+    """Same-host identity: hostname + kernel boot id.  The boot id guards
+    against same-named hosts across a fleet; /dev/shm isolation between
+    containers sharing a boot id is caught later, at segment attach."""
+    boot = ""
+    try:
+        with open("/proc/sys/kernel/random/boot_id",
+                  encoding="ascii") as fh:
+            boot = fh.read().strip()
+    except OSError:
+        boot = "no-boot-id"
+    return f"{socket.gethostname()}/{boot}"
+
+
+class ShmTransportError(RuntimeError):
+    """Any shared-memory transport failure.  The catcher downgrades the
+    connection to TCP permanently (rpc/data_plane.py PSClient)."""
+
+
+# --------------------------------------------------------------------------
+# Negotiation messages — deliberately NOT in rpc/messages.py: the analyzer's
+# wire manifest pins the reference contract, and this extension must leave
+# it untouched.  A reference server answers the method with UNIMPLEMENTED.
+# --------------------------------------------------------------------------
+
+class ShmNegotiateRequest(Message):
+    FIELDS = (
+        Field(1, "host_id", "string"),
+        Field(2, "worker_id", "int32"),
+        Field(3, "ring_bytes", "int64"),
+    )
+
+
+class ShmNegotiateResponse(Message):
+    """``accepted`` False carries the refusal reason in ``message`` (host
+    mismatch, shm unavailable, disabled) — the client downgrades to TCP
+    for the connection's lifetime either way.  ``doorbell`` is the
+    abstract AF_UNIX address of the connection's doorbell socket."""
+    FIELDS = (
+        Field(1, "accepted", "bool"),
+        Field(2, "message", "string"),
+        Field(3, "c2s_name", "string"),
+        Field(4, "s2c_name", "string"),
+        Field(5, "ring_bytes", "int64"),
+        Field(6, "host_id", "string"),
+        Field(7, "doorbell", "string"),
+    )
+
+
+# Extension method table, bound alongside the reference + stream methods on
+# the same gRPC service (server/ps_service.py).
+SHM_METHODS = {
+    "NegotiateShm": (ShmNegotiateRequest, ShmNegotiateResponse),
+}
+
+
+# Serializes the attach-side resource-tracker suppression below (the
+# monkeypatch window must not race a concurrent attach).
+_attach_lock = threading.Lock()
+
+
+class _Doorbell:
+    """1-byte wakeups over the connection's AF_UNIX socket.  Purely an
+    optimization channel: the authoritative state is the ring cursors,
+    so sends are fire-and-forget (a full socket buffer means the peer
+    already has wakeups pending) and a waiter treats any readable byte —
+    or a timeout — as "recheck the cursors"."""
+
+    __slots__ = ("_sock",)
+
+    def __init__(self, sock: socket.socket):
+        sock.setblocking(False)
+        self._sock = sock
+
+    def ring(self) -> None:
+        try:
+            self._sock.send(b"\x01")
+        except (BlockingIOError, OSError):  # buffer full / torn down
+            pass
+
+    def wait(self, timeout: float) -> None:
+        import select
+        try:
+            readable, _, _ = select.select([self._sock], [], [], timeout)
+            if readable:
+                data = self._sock.recv(4096)
+                if not data:
+                    raise ShmTransportError("doorbell socket closed by peer")
+        except BlockingIOError:  # drained by a concurrent recheck
+            pass
+        except OSError as exc:
+            raise ShmTransportError(f"doorbell socket failed: {exc}") \
+                from exc
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:  # already closed
+            pass
+
+
+def _doorbell_listener() -> tuple[socket.socket, str]:
+    """Listening doorbell socket + its wire-encodable address ("@name"
+    for the Linux abstract namespace, a filesystem path elsewhere)."""
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    name = f"psdt-db-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    try:
+        sock.bind("\0" + name)
+        addr = "@" + name
+    except OSError:
+        import tempfile
+        path = os.path.join(tempfile.gettempdir(), name)
+        sock.bind(path)
+        addr = path
+    sock.listen(1)
+    return sock, addr
+
+
+def _doorbell_connect(addr: str, timeout: float = 10.0) -> socket.socket:
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    sock.connect("\0" + addr[1:] if addr.startswith("@") else addr)
+    return sock
+
+
+class ShmRing:
+    """One direction of a connection: SPSC byte ring over a shared-memory
+    segment.  Exactly one producer process/thread calls the ``write*``
+    methods and one consumer the ``read*`` methods; the cursors make the
+    hand-off safe without any cross-process lock.  ``doorbell`` (shared
+    by both of a connection's rings at each endpoint) turns waits into
+    kernel sleeps; without one — unit tests — waits degrade to timed
+    polling."""
+
+    def __init__(self, shm, capacity: int,
+                 doorbell: _Doorbell | None = None):
+        self._shm = shm
+        self.capacity = capacity
+        self._buf = shm.buf
+        self.doorbell = doorbell
+        # Bulk copies go through the native GIL-FREE memcpy when the lib
+        # is available (native.copy_fn): a colocated producer/consumer
+        # pair then overlaps its copies, where memoryview assignment
+        # (the no-compiler fallback) convoys them under the GIL one
+        # switch-interval at a time.  The raw base address stays valid
+        # for the mmap's lifetime; teardown orders close() (latch, makes
+        # waiters raise) before the unmap, and the server side refuses
+        # to unmap under a still-running connection thread.
+        self._copy = native.copy_fn()
+        if self._copy is not None:
+            carr = (ctypes.c_ubyte * len(shm.buf)).from_buffer(shm.buf)
+            self._base = ctypes.addressof(carr)
+            del carr  # export released; the address outlives it
+        else:
+            self._base = 0
+
+    # ------------------------------------------------------------- cursors
+    def _tail(self) -> int:
+        return struct.unpack_from("<Q", self._buf, _OFF_TAIL)[0]
+
+    def _head(self) -> int:
+        return struct.unpack_from("<Q", self._buf, _OFF_HEAD)[0]
+
+    def _set_tail(self, v: int) -> None:
+        struct.pack_into("<Q", self._buf, _OFF_TAIL, v)
+
+    def _set_head(self, v: int) -> None:
+        struct.pack_into("<Q", self._buf, _OFF_HEAD, v)
+
+    @property
+    def closed(self) -> bool:
+        try:
+            return struct.unpack_from("<I", self._buf, _OFF_CLOSED)[0] != 0
+        except ValueError:  # segment memoryview released (teardown race)
+            return True
+
+    def close(self) -> None:
+        struct.pack_into("<I", self._buf, _OFF_CLOSED, 1)
+
+    # ------------------------------------------------------------ doorbell
+    def _wait(self, ready: Callable[[], int], deadline: float,
+              what: str) -> int:
+        """Park until ``ready()`` returns non-zero (bytes available /
+        free).  One immediate probe, then escalating micro-sleeps — the
+        "doorbell" is the peer's cursor store becoming visible.  NO hot
+        spinning: under the GIL a spinning waiter convoys the peer's copy
+        loop (each hand-off costs a full switch interval), so yielding
+        immediately is strictly faster in-process and costs at most one
+        ~20 us sleep cross-process."""
+        while True:
+            n = ready()
+            if n:
+                return n
+            if self.closed:
+                raise ShmTransportError(f"shm ring closed while {what}")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ShmTransportError(f"shm ring timeout while {what}")
+            if self.doorbell is not None:
+                # kernel sleep until the peer rings (capped so a closed
+                # latch set without a ring is still noticed promptly)
+                self.doorbell.wait(min(remaining, 0.05))
+            else:
+                time.sleep(min(remaining, 200e-6))
+
+    # Copies are published in blocks of this size: the consumer starts
+    # draining block 0 while the producer copies block 1, so a large frame
+    # moves at ~memcpy speed instead of write-then-read serial (and no
+    # single GIL-holding copy starves the peer for the whole frame).
+    _BLOCK = 1 << 20
+
+    # ------------------------------------------------------------- produce
+    def _copy_in(self, pos: int, view, src, src_off: int, n: int) -> None:
+        if src is not None:
+            self._copy(self._base + _HEADER + pos,
+                       src.ctypes.data + src_off, n)
+        else:
+            self._buf[_HEADER + pos:_HEADER + pos + n] = \
+                view[src_off:src_off + n]
+
+    def _write_bytes(self, data, deadline: float) -> None:
+        view = memoryview(data)
+        total = view.nbytes
+        # the local ndarray keeps the source buffer alive for the call
+        src = np.frombuffer(view, np.uint8) if self._copy is not None \
+            else None
+        cap = self.capacity
+        tail = self._tail()
+        sent = 0
+        while sent < total:
+            free = self._wait(
+                lambda: cap - (tail - self._head()), deadline, "writing")
+            n = min(free, total - sent, self._BLOCK)
+            pos = tail % cap
+            first = min(n, cap - pos)
+            self._copy_in(pos, view, src, sent, first)
+            if n > first:
+                self._copy_in(0, view, src, sent + first, n - first)
+            tail += n
+            self._set_tail(tail)
+            if self.doorbell is not None:
+                self.doorbell.ring()
+            sent += n
+
+    # End-of-stream sentinel in the length slot.  Deliberately NOT length
+    # zero: a fully-default GradientUpdate legally encodes to b"" under
+    # proto3 default elision (the sharded-topology empty barrier
+    # contribution at worker 0 / iteration 0), so zero-length DATA frames
+    # must round-trip.
+    _END = 0xFFFFFFFF
+
+    def write_frame(self, payload, deadline: float) -> None:
+        """One length-prefixed frame (zero-length payloads are legal).
+        Frames larger than the ring stream through it — the consumer
+        drains while the producer refills."""
+        try:
+            self._write_bytes(struct.pack("<I", len(payload)), deadline)
+            if len(payload):
+                self._write_bytes(payload, deadline)
+        except ValueError as exc:  # memoryview released under us
+            raise ShmTransportError(f"shm segment released: {exc}") from exc
+        _obs_bytes.add(4 + len(payload))
+
+    def write_end(self, deadline: float) -> None:
+        """End-of-stream marker for one request/response group."""
+        try:
+            self._write_bytes(struct.pack("<I", self._END), deadline)
+        except ValueError as exc:
+            raise ShmTransportError(f"shm segment released: {exc}") from exc
+        _obs_bytes.add(4)
+
+    # ------------------------------------------------------------- consume
+    def _copy_out(self, out: bytearray, dst, dst_off: int, pos: int,
+                  n: int) -> None:
+        if dst is not None:
+            self._copy(dst.ctypes.data + dst_off,
+                       self._base + _HEADER + pos, n)
+        else:
+            out[dst_off:dst_off + n] = self._buf[_HEADER + pos:
+                                                 _HEADER + pos + n]
+
+    def _read_bytes(self, n: int, deadline: float) -> bytearray:
+        out = bytearray(n)
+        dst = np.frombuffer(out, np.uint8) if self._copy is not None \
+            else None
+        done = 0
+        cap = self.capacity
+        head = self._head()
+        while done < n:
+            avail = self._wait(
+                lambda: self._tail() - head, deadline, "reading")
+            take = min(avail, n - done, self._BLOCK)
+            pos = head % cap
+            first = min(take, cap - pos)
+            self._copy_out(out, dst, done, pos, first)
+            if take > first:
+                self._copy_out(out, dst, done + first, 0, take - first)
+            head += take
+            self._set_head(head)
+            if self.doorbell is not None:
+                self.doorbell.ring()
+            done += take
+        return out
+
+    def read_frame(self, deadline: float) -> bytes | None:
+        """The next frame's payload, or None at an end-of-stream marker."""
+        try:
+            (length,) = struct.unpack("<I", self._read_bytes(4, deadline))
+            if length == self._END:
+                _obs_bytes.add(4)
+                return None
+            payload = bytes(self._read_bytes(length, deadline)) if length \
+                else b""
+        except ValueError as exc:  # memoryview released under us
+            raise ShmTransportError(f"shm segment released: {exc}") from exc
+        _obs_bytes.add(4 + length)
+        return payload
+
+
+def _pretouch(shm) -> None:
+    """Fault every page of the mapping in now (one store per 4 KB page):
+    first-touch page faults during the first ring lap otherwise dominate
+    the first few fused rounds."""
+    view = np.frombuffer(shm.buf, np.uint8)
+    view[_HEADER::4096] |= 0  # read-modify-write: faults without clobbering
+
+
+def _create_segment(name: str, size: int):
+    from multiprocessing import shared_memory
+    with _attach_lock:
+        # under the same lock as the attach-side tracker suppression: a
+        # concurrent attach must not swallow this create's registration
+        shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+    # zero the header so cursors/closed start clean (POSIX shm is
+    # zero-filled, but be explicit — the protocol depends on it)
+    shm.buf[:_HEADER] = bytes(_HEADER)
+    _pretouch(shm)
+    return shm
+
+
+def _attach_segment(name: str):
+    """Attach to a server-owned segment WITHOUT registering it with this
+    process's resource tracker: the server is the owner and unlinks it; a
+    client-side registration would double-unlink at exit (and, in the
+    same-process test topology, fight the server's own registration).
+    Python 3.13 grew ``track=False`` for exactly this; earlier versions
+    need the documented workaround of suppressing ``register`` around the
+    attach (bpo-38119)."""
+    from multiprocessing import shared_memory
+    try:
+        shm = shared_memory.SharedMemory(name=name, create=False,
+                                         track=False)
+        _pretouch(shm)
+        return shm
+    except TypeError:  # Python < 3.13: no track kwarg
+        pass
+    from multiprocessing import resource_tracker
+    with _attach_lock:
+        orig = resource_tracker.register
+        resource_tracker.register = lambda *a, **kw: None
+        try:
+            shm = shared_memory.SharedMemory(name=name, create=False)
+        finally:
+            resource_tracker.register = orig
+    _pretouch(shm)
+    return shm
+
+
+class ShmClientConnection:
+    """Worker-side endpoint of one negotiated connection: writes request
+    frames to the c2s ring, reads response frames from the s2c ring.
+    ``_lock`` serializes whole fused rounds — the rings are SPSC, so two
+    concurrent pushes on one connection would interleave frames."""
+
+    def __init__(self, c2s_name: str, s2c_name: str, capacity: int,
+                 doorbell_addr: str = ""):
+        self._c2s_shm = _attach_segment(c2s_name)
+        self._s2c_shm = _attach_segment(s2c_name)
+        self._doorbell = (_Doorbell(_doorbell_connect(doorbell_addr))
+                          if doorbell_addr else None)
+        self.c2s = ShmRing(self._c2s_shm, capacity, self._doorbell)
+        self.s2c = ShmRing(self._s2c_shm, capacity, self._doorbell)
+        # Serializes one fused round end to end; the ring waits under it
+        # are the lock's purpose (BLOCKING_ALLOWED, analysis/lock_order.py)
+        self._lock = checked_lock("ShmClientConnection._lock")
+
+    def round_trip(self, frames: Iterator[bytes],
+                   timeout: float | None) -> Iterator[bytes]:
+        """One request/response exchange: stream the request frames out,
+        then collect response frames until the server's end marker.  The
+        response is fully drained inside the lock before yielding — a
+        half-consumed iterator must not hold the connection hostage, and
+        the buffered encoded frames are the same bytes the server's
+        encode-once cache already holds per version, so peak memory
+        matches the TCP fan-out's server side (the cost is losing the
+        per-chunk decode ⊕ transport overlap the gRPC path streams;
+        acceptable against the ~2x round-time win on loopback)."""
+        deadline = time.monotonic() + (timeout if timeout else 3600.0)
+        with self._lock:
+            try:
+                for frame in frames:
+                    self.c2s.write_frame(frame, deadline)
+                self.c2s.write_end(deadline)
+                out: list[bytes] = []
+                while True:
+                    frame = self.s2c.read_frame(deadline)
+                    if frame is None:
+                        break
+                    out.append(frame)
+            except ShmTransportError:
+                raise
+            except BaseException:
+                # the FRAME SOURCE raised mid-round (lazy D2H fetch,
+                # encode validation): the stream is desynced — the server
+                # is parked mid-round and would fold the NEXT round's
+                # frames into this one.  Latch the rings closed so the
+                # server thread exits (and is reaped) and the next
+                # attempt on this connection downgrades to TCP; the
+                # original error still propagates like the gRPC path's.
+                for ring in (self.c2s, self.s2c):
+                    try:
+                        ring.close()
+                    except (ValueError, OSError):
+                        pass
+                raise
+        return iter(out)
+
+    def close(self) -> None:
+        # taking the round lock first means an in-flight fused round
+        # finishes (or times out) before the segments unmap — raw-address
+        # copies must never race the unmap
+        with self._lock:
+            for ring in (self.c2s, self.s2c):
+                try:
+                    ring.close()
+                except (ValueError, OSError):  # segment already torn down
+                    pass
+            if self._doorbell is not None:
+                self._doorbell.close()
+            for shm in (self._c2s_shm, self._s2c_shm):
+                try:
+                    shm.close()
+                except OSError:  # noqa: BLE001 — double-close at teardown
+                    pass
+
+
+class _ServerConnection:
+    """PS-side endpoint: a dedicated thread drains request frames, feeds
+    them through the fused handler, and streams the response frames
+    back.  One thread per same-host worker — they park on the barrier
+    condition variable exactly like gRPC handler threads do."""
+
+    def __init__(self, index: int, handler: Callable, capacity: int,
+                 on_exit: Callable[["_ServerConnection"], None]
+                 | None = None):
+        token = uuid.uuid4().hex[:8]
+        self._on_exit = on_exit
+        self.c2s_name = f"psdt-{os.getpid()}-{index}-{token}-c2s"
+        self.s2c_name = f"psdt-{os.getpid()}-{index}-{token}-s2c"
+        self._listener, self.doorbell_addr = _doorbell_listener()
+        self._c2s_shm = _create_segment(self.c2s_name,
+                                        _HEADER + capacity)
+        self._s2c_shm = _create_segment(self.s2c_name,
+                                        _HEADER + capacity)
+        self.c2s = ShmRing(self._c2s_shm, capacity)
+        self.s2c = ShmRing(self._s2c_shm, capacity)
+        self._doorbell: _Doorbell | None = None
+        self._handler = handler
+        self._thread = threading.Thread(
+            target=self._serve_loop, daemon=True,
+            name=f"shm-conn-{index}")
+        self._thread.start()
+
+    def _request_frames(self) -> Iterator[bytes]:
+        """Frames of ONE request (until the client's end marker); empty
+        frames are legal data (an all-default GradientUpdate)."""
+        while True:
+            frame = self.c2s.read_frame(time.monotonic() + 3600.0)
+            if frame is None:
+                return
+            yield frame
+
+    def _serve_loop(self) -> None:
+        from . import messages as m
+        try:
+            self._listener.settimeout(60.0)
+            sock, _ = self._listener.accept()
+        except OSError:
+            # client never connected its doorbell (died mid-negotiation,
+            # or teardown closed the listener): the rings are unused
+            self.close()
+            if self._on_exit is not None:
+                self._on_exit(self)
+            return
+        finally:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        self._doorbell = _Doorbell(sock)
+        self.c2s.doorbell = self._doorbell
+        self.s2c.doorbell = self._doorbell
+        try:
+            self._serve_rounds(m)
+        finally:
+            if self._on_exit is not None:
+                # client gone (orderly close or crash-latched ring):
+                # release this connection's segments NOW instead of at PS
+                # shutdown — elastic worker churn must not accrete
+                # 2x-ring-sized /dev/shm leaks per former worker
+                self._on_exit(self)
+
+    def _serve_rounds(self, m) -> None:
+        while True:
+            try:
+                # park (uncapped) for the next round's first frame, then
+                # decode chunks as they arrive so the handler's fold
+                # overlaps the client's remaining writes
+                first = self.c2s.read_frame(time.monotonic() + 2**31)
+            except ShmTransportError:
+                return  # closed / torn down
+            try:
+                if first is None:
+                    continue  # stray end marker (client retry teardown)
+                drained = [False]
+
+                def chunks() -> Iterator[m.Message]:
+                    yield m.GradientUpdate.decode(first)
+                    for frame in self._request_frames():
+                        yield m.GradientUpdate.decode(frame)
+                    drained[0] = True
+
+                deadline = time.monotonic() + 3600.0
+                for resp in self._handler(chunks(), None):
+                    self.s2c.write_frame(resp.encode(), deadline)
+                if not drained[0]:
+                    # handler returned early (e.g. the empty-store fused
+                    # refusal never reads the gradient chunks): consume the
+                    # round's remaining frames so the NEXT round's first
+                    # frame is really a first frame — and so a client
+                    # blocked writing a ring-sized push gets unstuck
+                    for _ in self._request_frames():
+                        pass
+                self.s2c.write_end(deadline)
+            except ShmTransportError:
+                return
+            except Exception:  # noqa: BLE001 — keep serving other rounds
+                log.exception("shm connection handler failed; closing")
+                self.close()
+                return
+
+    def close(self) -> None:
+        for ring in (self.c2s, self.s2c):
+            try:
+                ring.close()
+            except (ValueError, OSError):
+                pass
+        for sock in (self._doorbell, self._listener):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def unlink(self) -> None:
+        self.close()
+        self._thread.join(timeout=2.0)
+        if self._thread.is_alive():
+            # still parked inside the handler (e.g. a barrier wait):
+            # unmapping under it would turn a slow shutdown into a raw-
+            # address crash — leave the segments mapped (daemon thread +
+            # resource tracker clean up at process exit) and only unlink
+            # the names so no new attach can find them
+            log.warning("shm connection thread still running at teardown; "
+                        "deferring segment unmap")
+            for shm in (self._c2s_shm, self._s2c_shm):
+                try:
+                    shm.unlink()
+                except (OSError, FileNotFoundError):
+                    pass
+            return
+        for shm in (self._c2s_shm, self._s2c_shm):
+            try:
+                shm.close()
+                shm.unlink()
+            except (OSError, FileNotFoundError):  # already gone
+                pass
+
+
+class ShmServer:
+    """PS-side registry: answers ``NegotiateShm`` and owns the per-
+    connection segments/threads.  ``handler`` is the fused stream handler
+    (``ParameterServerService.PushPullStream`` — request-chunk iterator
+    in, response iterator out)."""
+
+    def __init__(self, handler: Callable,
+                 capacity: int | None = None):
+        self._handler = handler
+        self._capacity = capacity if capacity is not None else ring_bytes()
+        self._host_id = host_id()
+        # leaf: held only around the connection-registry dict ops
+        self._lock = checked_lock("ShmServer._lock")
+        self._conns: list[_ServerConnection] = []
+        self._next_index = 0
+        self._closed = False
+
+    def _reap(self, conn: "_ServerConnection") -> None:
+        """Called FROM a connection's serving thread as it exits (client
+        closed, crashed, or never finished the handshake): drop it from
+        the registry and release its segments immediately.  The registry
+        removal under the lock makes reap-vs-shutdown exactly-once; the
+        unmap is safe because the exiting serve thread is the segments'
+        last user."""
+        with self._lock:
+            if conn not in self._conns:
+                return  # shutdown path already owns it
+            self._conns.remove(conn)
+        conn.close()
+        for shm in (conn._c2s_shm, conn._s2c_shm):
+            try:
+                shm.close()
+                shm.unlink()
+            except (OSError, FileNotFoundError):
+                pass
+        log.info("shm connection reaped (client disconnected)")
+
+    def _refuse(self, why: str) -> ShmNegotiateResponse:
+        log.info("shm negotiation refused: %s", why)
+        return ShmNegotiateResponse(accepted=False, message=why,
+                                    host_id=self._host_id)
+
+    def negotiate(self, request: ShmNegotiateRequest) -> ShmNegotiateResponse:
+        if not enabled():
+            return self._refuse("shm transport disabled (PSDT_SHM=0)")
+        if request.host_id != self._host_id:
+            return self._refuse(
+                f"host mismatch: client {request.host_id!r} vs server "
+                f"{self._host_id!r}")
+        capacity = self._capacity
+        if request.ring_bytes:
+            capacity = min(capacity, int(request.ring_bytes))
+        with self._lock:
+            if self._closed:
+                return self._refuse("server shutting down")
+            index = self._next_index
+            self._next_index += 1
+        # segment creation + page pretouch + doorbell listen run OUTSIDE
+        # the lock (tens of ms of I/O — the lock's contract is registry
+        # dict ops only, and N workers negotiating at startup must not
+        # serialize behind each other's page-fault storms)
+        try:
+            conn = _ServerConnection(index, self._handler, capacity,
+                                     on_exit=self._reap)
+        except (OSError, ValueError, ImportError) as exc:
+            # /dev/shm unavailable, exhausted, or shared_memory
+            # missing: refuse — the client stays on TCP
+            return self._refuse(f"shared memory unavailable: {exc}")
+        with self._lock:
+            registered = not self._closed
+            if registered:
+                self._conns.append(conn)
+        if not registered:  # shutdown raced the construction
+            conn.unlink()
+            return self._refuse("server shutting down")
+        log.info("shm connection %d negotiated (worker %d, ring %d MB x2)",
+                 index, request.worker_id, capacity >> 20)
+        return ShmNegotiateResponse(
+            accepted=True, message="ok", c2s_name=conn.c2s_name,
+            s2c_name=conn.s2c_name, ring_bytes=capacity,
+            host_id=self._host_id, doorbell=conn.doorbell_addr)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns, self._conns = list(self._conns), []
+        for conn in conns:
+            conn.unlink()

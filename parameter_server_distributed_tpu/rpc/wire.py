@@ -31,6 +31,8 @@ from typing import Any, Callable
 
 import numpy as np
 
+from . import codec as _codec
+
 # Wire types
 WT_VARINT = 0
 WT_FIXED64 = 1
@@ -225,24 +227,33 @@ def _default_for(f: Field) -> Any:
 
 
 class ArrayPayload:
-    """Lazy bytes-field payload: a flat numpy source array plus the wire
-    dtype it should be sent as.  The dtype conversion happens directly into
-    the outgoing message buffer at encode time (``_Writer.write_array``) —
-    ONE fused convert-and-store pass instead of the three separate sweeps of
-    ``astype`` + ``tobytes`` + buffer write.  At config-3 scale (GBs of
-    tensor payload per push) those extra sweeps dominate encode latency.
+    """Lazy bytes-field payload: a flat float32 source array plus the
+    packed WIRE_* encoding it should be sent as.  The encode — dtype cast,
+    int8 quantization, or top-k sparsify+pack — happens directly into the
+    outgoing message buffer at encode time (``_Writer.write_array``)
+    through the active :class:`~.codec.Codec`: ONE fused pass instead of
+    separate quantize + ``tobytes`` + buffer-write sweeps.  At config-3
+    scale (GBs of tensor payload per push) those extra sweeps dominate
+    encode latency, and routing them through the codec is what lets the
+    native C++ path (``PSDT_NATIVE``) take over the byte work.
 
     Anything that needs the payload outside an encode (same-process
     ``to_array``, equality in tests) materializes via :meth:`tobytes`,
-    which reproduces the exact bytes a wire round-trip would carry.
+    which reproduces the exact bytes a wire round-trip would carry; the
+    materialization is cached so a later encode replays it as a memcpy
+    (e.g. the error-feedback residual path reads ``to_array`` before the
+    push encodes — the quantize then runs once, not twice).
     """
 
-    __slots__ = ("src", "dtype", "nbytes")
+    __slots__ = ("src", "wire_dtype", "k", "nbytes", "_cache")
 
-    def __init__(self, src: np.ndarray, dtype) -> None:
-        self.src = src.reshape(-1)
-        self.dtype = np.dtype(dtype)
-        self.nbytes = self.src.size * self.dtype.itemsize
+    def __init__(self, src: np.ndarray, wire_dtype: int, k: int = 0) -> None:
+        self.src = np.ascontiguousarray(src, np.float32).reshape(-1)
+        self.wire_dtype = int(wire_dtype)
+        self.k = int(k)
+        self.nbytes = _codec.payload_nbytes(self.wire_dtype, self.src.size,
+                                            self.k)
+        self._cache: bytes | None = None
 
     def __len__(self) -> int:
         return self.nbytes
@@ -250,8 +261,22 @@ class ArrayPayload:
     def __bool__(self) -> bool:
         return self.nbytes > 0
 
+    def pack_into(self, dst) -> None:
+        """Write the exact payload bytes into the writable buffer ``dst``
+        (length ``nbytes``) via the active codec."""
+        if self._cache is not None:
+            dst[:] = self._cache
+        else:
+            _codec.active_codec().pack_into(self.wire_dtype, self.src, dst,
+                                            self.k)
+
     def tobytes(self) -> bytes:
-        return self.src.astype(self.dtype).tobytes()
+        if self._cache is None:
+            buf = bytearray(self.nbytes)
+            _codec.active_codec().pack_into(self.wire_dtype, self.src,
+                                            memoryview(buf), self.k)
+            self._cache = bytes(buf)
+        return self._cache
 
     def __eq__(self, other: object) -> bool:
         if isinstance(other, ArrayPayload):
@@ -303,12 +328,11 @@ class _Writer:
         self.pos += n
 
     def write_array(self, payload: ArrayPayload) -> None:
-        """Fused convert-and-store of an ArrayPayload: the dtype cast writes
-        straight into the message buffer (no intermediate array/bytes)."""
+        """Fused encode-and-store of an ArrayPayload: the codec (dtype
+        cast / quantize / top-k pack) writes straight into the message
+        buffer (no intermediate array/bytes)."""
         n = payload.nbytes
-        dst = np.frombuffer(self._view[self.pos:self.pos + n],
-                            dtype=payload.dtype)
-        np.copyto(dst, payload.src, casting="unsafe")
+        payload.pack_into(self._view[self.pos:self.pos + n])
         self.pos += n
 
     def getvalue(self) -> bytes:

@@ -44,6 +44,7 @@ from ..core.tensor import from_wire, to_wire
 from ..obs import stats as obs_stats
 from ..obs import trace as obs_trace
 from ..rpc import messages as m
+from ..rpc import shm_transport
 from ..rpc.data_plane import (PreEncodedParameterUpdate,
                               encode_parameter_record_groups, split_tensors,
                               stream_chunk_bytes)
@@ -129,6 +130,15 @@ class ParameterServerService:
     def __init__(self, core: ParameterServerCore, ckpt: CheckpointManager):
         self.core = core
         self.ckpt = ckpt
+        # same-host shared-memory transport (rpc/shm_transport.py): owns
+        # the per-connection rings + serving threads; each shm round runs
+        # through the SAME PushPullStream handler below, so semantics and
+        # bytes are transport-independent.  Lazy: segments only exist
+        # once a same-host client negotiates.  The handler is looked up
+        # per round (not captured) so instance-level overrides — tests
+        # shaping a reference PS — govern the shm path too.
+        self.shm_server = shm_transport.ShmServer(
+            lambda chunks, ctx: self.PushPullStream(chunks, ctx))
         # aggregation/serve timing net of RPC plumbing (the handler-level
         # latency histograms live in rpc/service.bind_service)
         self._obs_apply = obs_stats.histogram("ps.apply_s")
@@ -406,6 +416,15 @@ class ParameterServerService:
                 yield m.PushPullResponse(params=chunk)
         self._obs_serve.observe(time.perf_counter() - t0)
 
+    # RPC (framework extension, rpc/shm_transport.py): same-host shared-
+    # memory transport negotiation for the fused data plane.  The method
+    # and its messages live OUTSIDE rpc/messages.py so the reference wire
+    # manifest is untouched; a reference PS answers UNIMPLEMENTED and the
+    # client downgrades to TCP permanently (PR-2 fallback discipline).
+    def NegotiateShm(self, request: shm_transport.ShmNegotiateRequest,
+                     context) -> shm_transport.ShmNegotiateResponse:
+        return self.shm_server.negotiate(request)
+
     # RPC: barrier poll (reference: src/parameter_server_service.cpp:85-95)
     def CheckSyncStatus(self, request: m.SyncStatusRequest, context) -> m.SyncStatusResponse:
         iteration, ready, received, total = self.core.check_sync_status(request.iteration)
@@ -508,7 +527,8 @@ class ParameterServer:
             max_workers=max(8, 2 * self.config.total_workers + 4))
         bind_service(self._server, m.PARAMETER_SERVER_SERVICE,
                      {**m.PARAMETER_SERVER_METHODS,
-                      **m.PARAMETER_SERVER_STREAM_METHODS}, self.service)
+                      **m.PARAMETER_SERVER_STREAM_METHODS,
+                      **shm_transport.SHM_METHODS}, self.service)
         addr = f"{self.config.bind_address}:{self.config.port}"
         self._port = self._server.add_insecure_port(addr)
         if self._port == 0:
@@ -526,5 +546,9 @@ class ParameterServer:
 
     def stop(self, grace: float = 1.0) -> None:
         self.ckpt.stop()
+        # tear down shm connections first: their serving threads may be
+        # parked on the barrier CV or a ring doorbell, and closing the
+        # rings unsticks both before the gRPC drain
+        self.service.shm_server.close()
         if self._server is not None:
             self._server.stop(grace).wait()

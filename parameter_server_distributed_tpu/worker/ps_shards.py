@@ -63,6 +63,13 @@ class ShardedPSClient:
     def num_shards(self) -> int:
         return len(self._clients)
 
+    @property
+    def shm_active(self) -> bool:
+        """True once ANY shard connection rides the same-host shared-
+        memory transport (each PSClient negotiates per connection, so a
+        mixed local/remote shard map uses shm exactly where it can)."""
+        return any(getattr(c, "shm_active", False) for c in self._clients)
+
     def close(self) -> None:
         for client in self._clients:
             client.close()

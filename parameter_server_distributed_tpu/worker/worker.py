@@ -120,6 +120,9 @@ class Worker:
         # they ARE what a pull at the next iteration would return, so the
         # next step skips its pull entirely.
         self._next_params: TensorStore | None = None
+        # one-shot note when the fused rounds start riding the same-host
+        # shared-memory transport (rpc/shm_transport.py) instead of TCP
+        self._shm_noted = False
         # single-slot batch prefetch: next(self.batches) runs on this
         # thread while the worker is blocked in communication
         self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
@@ -477,6 +480,12 @@ class Worker:
         with obs_trace.span("worker/fused", iteration=iteration):
             push, params, store = self.query_with_retry(attempt)
         self._obs_phase["fused"].observe(time.perf_counter() - t0)
+        if not self._shm_noted and getattr(self._ps, "shm_active", False):
+            # the PSClient negotiated the same-host shared-memory rings
+            # (rpc/shm_transport.py); every later fused round bypasses TCP
+            self._shm_noted = True
+            log.info("worker %d: fused data plane riding shared memory",
+                     self.config.worker_id)
         if residual_box is not None and push.success:
             self._ef_residual = dict(residual_box)
         if params is None:

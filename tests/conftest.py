@@ -29,6 +29,30 @@ def rng():
     return np.random.default_rng(0)
 
 
+@pytest.fixture(params=["python", "native"])
+def each_codec(request):
+    """Parametrize a data-plane test across the wire codec backends
+    (PSDT_NATIVE=0/1 — rpc/codec.py): the ``python`` leg pins the
+    pure-numpy oracle so the fallback path can never rot, the ``native``
+    leg exercises the C++ kernels (skipped cleanly when no compiler can
+    build them).  Yields the active backend name."""
+    from parameter_server_distributed_tpu import native
+
+    if request.param == "native":
+        native.set_enabled(True)
+        if native.lib() is None:
+            pytest.skip("native lib unavailable (no g++)")
+    else:
+        native.set_enabled(False)
+    try:
+        yield request.param
+    finally:
+        # restore the process default (PSDT_NATIVE env, read at import)
+        native.set_enabled(
+            os.environ.get("PSDT_NATIVE", "1").lower()
+            not in ("0", "false"))
+
+
 @pytest.fixture(autouse=True)
 def _lockcheck_env(request, monkeypatch):
     """Opt-in runtime lock-discipline checking: tests marked

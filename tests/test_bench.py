@@ -79,6 +79,28 @@ def test_bench_preflight_spaced_retry_then_fallback():
 
 
 @pytest.mark.slow
+def test_bench_codec_contract():
+    """codec mode: native-vs-Python encode/decode GB/s per packed wire
+    dtype plus the same-host shm-vs-TCP fused-step A/B, all visible in
+    the JSON."""
+    result = run_bench("codec", extra_env={
+        "PSDT_BENCH_PARAMS": "4e5",
+        "PSDT_BENCH_STEPS": "2",
+    })
+    assert result["metric"].startswith("codec_encode_gbps")
+    assert result["value"] > 0
+    for dtype in ("bf16", "int8", "topk"):
+        assert result["encode"][dtype]["python"] > 0
+        assert result["decode"][dtype]["python"] > 0
+    same_host = result["same_host"]
+    assert same_host["tcp"]["p50_ms"] > 0
+    assert same_host["shm"]["p50_ms"] > 0
+    assert same_host["shm"]["shm_active"] is True
+    assert same_host["shm"]["shm_bytes"] > 0
+    assert same_host["tcp"]["shm_active"] is False
+
+
+@pytest.mark.slow
 def test_bench_aggregate_contract():
     """aggregate mode: streaming-vs-buffered PS aggregation profile with
     the acceptance properties visible in the JSON — ~1x model peak

@@ -315,6 +315,10 @@ def test_packed_wire_renegotiated_after_ps_replacement(tmp_path):
         ps2.service.PushGradientsStream = unimplemented_stream
         ps2.service.ServeParametersStream = unimplemented_stream
         ps2.service.PushPullStream = unimplemented_stream
+        # a reference PS has no shm negotiation either: without this stub
+        # the same-host rings would carry the fused rounds right past the
+        # recording/unimplemented gRPC stubs above
+        ps2.service.NegotiateShm = unimplemented_stream
         ps2_port = ps2.start()
         ps2.ckpt.load(saved_path)
         coordinator.core.set_parameter_server_address("127.0.0.1", ps2_port)

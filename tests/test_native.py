@@ -6,6 +6,9 @@ import pytest
 from parameter_server_distributed_tpu import native
 
 
+# The sticky-failure/retry tests live in tests/test_codec.py: this
+# module's pytestmark skips EVERYTHING on no-g++ hosts, which is exactly
+# where the retry machinery matters.
 pytestmark = pytest.mark.skipif(native.lib() is None,
                                 reason="native lib unavailable (no g++)")
 

@@ -1,0 +1,418 @@
+"""Shared-memory same-host transport tests (rpc/shm_transport.py, ISSUE 6).
+
+Covers the ring protocol itself (framing, wrap, oversized frames,
+teardown), the negotiation/downgrade matrix (same host accept, host
+mismatch, PSDT_SHM=0, /dev/shm unavailable, reference server
+UNIMPLEMENTED, mid-flight failure), and the fused data plane riding the
+rings end to end — byte-tracked against the TCP path and hammered under
+PSDT_LOCK_CHECK=1.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.config import ParameterServerConfig
+from parameter_server_distributed_tpu.obs import stats as obs_stats
+from parameter_server_distributed_tpu.rpc import messages as m
+from parameter_server_distributed_tpu.rpc import shm_transport as st
+from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+from parameter_server_distributed_tpu.server.ps_service import ParameterServer
+
+
+def _ring_pair(capacity=1 << 20, doorbell=True):
+    seg = st._create_segment(f"psdt-test-{time.monotonic_ns()}",
+                             64 + capacity)
+    if doorbell:
+        a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+        prod = st.ShmRing(seg, capacity, st._Doorbell(a))
+        cons = st.ShmRing(seg, capacity, st._Doorbell(b))
+    else:
+        prod = st.ShmRing(seg, capacity)
+        cons = st.ShmRing(seg, capacity)
+    return seg, prod, cons
+
+
+def _cleanup(seg):
+    try:
+        seg.close()
+        seg.unlink()
+    except (OSError, BufferError):
+        pass
+
+
+# ---------------------------------------------------------------- ring unit
+
+@pytest.mark.parametrize("doorbell", [True, False],
+                         ids=["doorbell", "polling"])
+def test_ring_frame_roundtrip_and_wrap(doorbell):
+    """Frames round-trip exactly, including across the wrap boundary
+    (with and without the doorbell socket — the polling fallback must
+    stay correct)."""
+    seg, prod, cons = _ring_pair(capacity=8192, doorbell=doorbell)
+    try:
+        rng = np.random.default_rng(0)
+        payloads = [rng.bytes(n) for n in (1, 100, 3000, 5000, 0, 7777)]
+        got = []
+
+        def consume():
+            for _ in payloads:
+                got.append(cons.read_frame(time.monotonic() + 20))
+
+        th = threading.Thread(target=consume, daemon=True, name="t-cons")
+        th.start()
+        for p in payloads:
+            prod.write_frame(p, time.monotonic() + 20)
+        th.join(timeout=20)
+        assert not th.is_alive()
+        assert got == payloads
+    finally:
+        _cleanup(seg)
+
+
+def test_ring_frame_larger_than_capacity_streams_through():
+    """A frame bigger than the whole ring streams through in blocks —
+    the oversized-chunk case (single tensor above the chunk budget)."""
+    seg, prod, cons = _ring_pair(capacity=64 << 10)
+    try:
+        big = np.random.default_rng(1).bytes(1 << 20)
+        out = []
+        th = threading.Thread(
+            target=lambda: out.append(cons.read_frame(
+                time.monotonic() + 30)),
+            daemon=True, name="t-cons")
+        th.start()
+        prod.write_frame(big, time.monotonic() + 30)
+        th.join(timeout=30)
+        assert out and out[0] == big
+    finally:
+        _cleanup(seg)
+
+
+def test_ring_empty_data_frame_distinct_from_end_marker():
+    """A zero-length DATA frame (a fully-default GradientUpdate encodes
+    to b'' under proto3 elision) must round-trip as b'', distinct from
+    the end-of-stream marker (None)."""
+    seg, prod, cons = _ring_pair()
+    try:
+        got = []
+
+        def consume():
+            while True:
+                frame = cons.read_frame(time.monotonic() + 10)
+                got.append(frame)
+                if frame is None:
+                    return
+
+        th = threading.Thread(target=consume, daemon=True, name="t-cons")
+        th.start()
+        prod.write_frame(b"", time.monotonic() + 10)
+        prod.write_frame(b"x", time.monotonic() + 10)
+        prod.write_end(time.monotonic() + 10)
+        th.join(timeout=10)
+        assert got == [b"", b"x", None]
+    finally:
+        _cleanup(seg)
+
+
+def test_ring_close_unblocks_waiters_and_timeout_raises():
+    seg, prod, cons = _ring_pair()
+    try:
+        with pytest.raises(st.ShmTransportError, match="timeout"):
+            cons.read_frame(time.monotonic() + 0.2)
+        errs = []
+
+        def blocked_read():
+            try:
+                cons.read_frame(time.monotonic() + 30)
+            except st.ShmTransportError as exc:
+                errs.append(exc)
+
+        th = threading.Thread(target=blocked_read, daemon=True,
+                              name="t-cons")
+        th.start()
+        time.sleep(0.05)
+        prod.close()
+        th.join(timeout=5)
+        assert not th.is_alive() and errs
+    finally:
+        _cleanup(seg)
+
+
+# ------------------------------------------------------------- negotiation
+
+@pytest.fixture
+def ps(tmp_path):
+    server = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=1,
+        checkpoint_dir=str(tmp_path), learning_rate=0.5,
+        autosave_period_s=3600.0))
+    port = server.start()
+    yield server, port
+    server.stop()
+
+
+def _seed(client, n=16):
+    w0 = np.arange(n, dtype=np.float32)
+    push = client.push_gradients(m.GradientUpdate(
+        worker_id=0, iteration=0,
+        gradients=[m.Tensor.from_array("w", w0)]))
+    assert push.success, push.message
+    return w0
+
+
+def test_same_host_negotiation_and_fused_rounds(ps):
+    """Acceptance: same-host fused rounds negotiate the rings, move the
+    payload through shared memory (rpc.shm.bytes grows), and produce
+    results identical to the TCP path."""
+    _, port = ps
+    before = obs_stats.REGISTRY.snapshot()["counters"].get(
+        "rpc.shm.bytes", 0)
+    with PSClient(f"127.0.0.1:{port}") as client:
+        w0 = _seed(client)
+        grads = [m.Tensor.from_array("w", np.full(16, 0.1, np.float32))]
+        for it in (1, 2, 3):
+            push, params = client.push_pull(0, it, grads)
+            assert push.success and params is not None and params.ready
+            np.testing.assert_allclose(
+                params.parameters[0].to_array(), w0 - 0.05 * it,
+                rtol=1e-6)
+        assert client.shm_active
+        assert client._fused_ok is True
+    after = obs_stats.REGISTRY.snapshot()["counters"].get(
+        "rpc.shm.bytes", 0)
+    assert after > before
+
+
+def test_shm_and_tcp_rounds_bit_identical(tmp_path):
+    """The transport must be invisible: the same push sequence over shm
+    and over TCP (PSDT_SHM=0) yields bit-identical served parameters."""
+    import os
+
+    results = {}
+    for shm_on in (True, False):
+        os.environ["PSDT_SHM"] = "1" if shm_on else "0"
+        try:
+            server = ParameterServer(ParameterServerConfig(
+                bind_address="127.0.0.1", port=0, total_workers=1,
+                checkpoint_dir=str(tmp_path / f"shm{shm_on}"),
+                learning_rate=0.5, autosave_period_s=3600.0))
+            port = server.start()
+            try:
+                with PSClient(f"127.0.0.1:{port}") as client:
+                    _seed(client, 64)
+                    grads = [m.Tensor.from_array(
+                        "w", np.linspace(-1, 1, 64, dtype=np.float32))]
+                    push, params = client.push_pull(0, 1, grads)
+                    assert push.success and params is not None
+                    assert client.shm_active is shm_on
+                    results[shm_on] = params.parameters[0].to_array()
+            finally:
+                server.stop()
+        finally:
+            os.environ.pop("PSDT_SHM", None)
+    assert results[True].tobytes() == results[False].tobytes()
+
+
+def test_all_default_empty_push_round_over_shm(ps):
+    """The sharded-topology empty barrier contribution at worker 0 /
+    iteration 0 encodes to b'' — it must complete a fused round over the
+    rings (the END sentinel is out-of-band), not hang or desync."""
+    _, port = ps
+    with PSClient(f"127.0.0.1:{port}") as client:
+        w0 = _seed(client)
+        # establish the shm connection with a normal round first
+        push, params = client.push_pull(
+            0, 1, [m.Tensor.from_array("w", np.full(16, 0.1, np.float32))])
+        assert push.success and client.shm_active
+        # all-default chunk: worker 0, iteration 0, no tensors -> b''
+        push, params = client.push_pull(0, 0, [], timeout=20.0)
+        assert push is not None  # stale rejection is fine; hanging is not
+        assert client.shm_active  # connection survived the round
+        # and the connection still serves normal rounds afterwards
+        push, params = client.push_pull(
+            0, 2, [m.Tensor.from_array("w", np.full(16, 0.1, np.float32))])
+        assert push.success and params is not None
+        np.testing.assert_allclose(params.parameters[0].to_array(),
+                                   w0 - 0.10, rtol=1e-6)
+
+
+def test_client_disconnect_reaps_server_segments(ps):
+    """Closing the client frees the server-side segments promptly (no
+    /dev/shm accretion under elastic worker churn)."""
+    server, port = ps
+    client = PSClient(f"127.0.0.1:{port}")
+    _seed(client)
+    push, _ = client.push_pull(
+        0, 1, [m.Tensor.from_array("w", np.full(16, 0.1, np.float32))])
+    assert push.success and client.shm_active
+    assert len(server.service.shm_server._conns) == 1
+    client.close()
+    deadline = time.monotonic() + 10
+    while (server.service.shm_server._conns
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert server.service.shm_server._conns == []
+
+
+def test_host_mismatch_refused_and_downgrades(ps, monkeypatch):
+    """A client reporting a different host/boot-id is refused; the fused
+    round rides TCP and the downgrade is permanent (one fallback count,
+    no re-negotiation)."""
+    _, port = ps
+    monkeypatch.setattr(st, "host_id", lambda: "elsewhere/deadbeef")
+    with PSClient(f"127.0.0.1:{port}") as client:
+        w0 = _seed(client)
+        push, params = client.push_pull(
+            0, 1, [m.Tensor.from_array("w", np.full(16, 0.1, np.float32))])
+        assert push.success and params is not None
+        assert not client.shm_active and client._shm_ok is False
+        np.testing.assert_allclose(params.parameters[0].to_array(),
+                                   w0 - 0.05, rtol=1e-6)
+
+
+def test_psdt_shm_0_disables_both_ends(ps, monkeypatch):
+    _, port = ps
+    monkeypatch.setenv("PSDT_SHM", "0")
+    with PSClient(f"127.0.0.1:{port}") as client:
+        _seed(client)
+        push, params = client.push_pull(
+            0, 1, [m.Tensor.from_array("w", np.full(16, 0.1, np.float32))])
+        assert push.success and params is not None
+        # client-side gate: negotiation never even attempted
+        assert client._shm_ok is None and not client.shm_active
+
+
+def test_dev_shm_unavailable_refused_and_downgrades(ps, monkeypatch):
+    """Segment creation failing server-side (no /dev/shm, exhausted)
+    refuses the negotiation; the client downgrades permanently with zero
+    failed steps."""
+    _, port = ps
+
+    def boom(name, size):
+        raise OSError("No space left on device")
+
+    monkeypatch.setattr(st, "_create_segment", boom)
+    with PSClient(f"127.0.0.1:{port}") as client:
+        w0 = _seed(client)
+        push, params = client.push_pull(
+            0, 1, [m.Tensor.from_array("w", np.full(16, 0.1, np.float32))])
+        assert push.success and params is not None
+        assert client._shm_ok is False
+        np.testing.assert_allclose(params.parameters[0].to_array(),
+                                   w0 - 0.05, rtol=1e-6)
+
+
+def test_reference_server_unimplemented_downgrades(tmp_path):
+    """A reference-shaped PS (5 unary RPCs, no NegotiateShm) answers
+    UNIMPLEMENTED: permanent TCP downgrade, push still lands."""
+    from parameter_server_distributed_tpu.checkpoint.manager import (
+        CheckpointManager)
+    from parameter_server_distributed_tpu.core.ps_core import (
+        ParameterServerCore)
+    from parameter_server_distributed_tpu.rpc.service import (bind_service,
+                                                              make_server)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServerService)
+
+    core = ParameterServerCore(total_workers=1)
+    core.initialize_parameters({"w": np.ones(4, np.float32)})
+    service = ParameterServerService(
+        core, CheckpointManager(core, directory=str(tmp_path),
+                                checkpoint_interval=100,
+                                check_period_s=600.0))
+    server = make_server()
+    bind_service(server, m.PARAMETER_SERVER_SERVICE,
+                 m.PARAMETER_SERVER_METHODS, service)  # unary only
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        with PSClient(f"127.0.0.1:{port}") as client:
+            push, params = client.push_pull(
+                0, 1, [m.Tensor.from_array("w", np.full(4, 0.5,
+                                                        np.float32))])
+            assert push.success
+            assert params is None  # unary fallback: caller polls + pulls
+            assert client._shm_ok is False
+    finally:
+        server.stop(0)
+        service.shm_server.close()
+
+
+def test_midflight_shm_failure_downgrades_and_replays(ps):
+    """Killing the rings under a live connection: the NEXT fused round
+    catches the transport error, downgrades permanently, counts a
+    fallback, and replays over TCP — zero failed steps."""
+    server, port = ps
+    before = obs_stats.REGISTRY.snapshot()["counters"].get(
+        "rpc.shm.fallback", 0)
+    with PSClient(f"127.0.0.1:{port}") as client:
+        w0 = _seed(client)
+        grads = [m.Tensor.from_array("w", np.full(16, 0.1, np.float32))]
+        push, params = client.push_pull(0, 1, grads)
+        assert push.success and client.shm_active
+        # sabotage: server tears down every shm connection
+        server.service.shm_server.close()
+        push, params = client.push_pull(0, 2, grads)
+        assert push.success and params is not None
+        assert client._shm_ok is False and not client.shm_active
+        np.testing.assert_allclose(params.parameters[0].to_array(),
+                                   w0 - 0.10, rtol=1e-6)
+    after = obs_stats.REGISTRY.snapshot()["counters"].get(
+        "rpc.shm.fallback", 0)
+    assert after == before + 1
+
+
+@pytest.mark.lockcheck
+def test_concurrent_fused_rounds_over_shm_lockcheck(tmp_path):
+    """Two same-host workers close a 2-wide barrier over their own shm
+    connections while a third thread hammers unary pulls — under
+    PSDT_LOCK_CHECK=1, so any lock-order violation in the new
+    ring/registry locks raises instead of deadlocking."""
+    server = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=2,
+        checkpoint_dir=str(tmp_path), learning_rate=0.5,
+        autosave_period_s=3600.0))
+    port = server.start()
+    try:
+        server.core.initialize_parameters(
+            {"w": np.zeros(1024, np.float32)})
+        clients = [PSClient(f"127.0.0.1:{port}") for _ in range(2)]
+        errors: list = []
+
+        def run_worker(wid: int):
+            try:
+                grads = [m.Tensor.from_array(
+                    "w", np.full(1024, float(wid + 1), np.float32))]
+                for it in range(1, 6):
+                    push, params = clients[wid].push_pull(wid, it, grads)
+                    assert push.success, push.message
+                    assert params is not None and params.ready
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=run_worker, args=(wid,),
+                                    daemon=True, name=f"t-worker-{wid}")
+                   for wid in range(2)]
+        for th in threads:
+            th.start()
+        with PSClient(f"127.0.0.1:{port}") as puller:
+            for _ in range(10):
+                puller.pull_parameters(m.PullRequest(worker_id=9))
+        for th in threads:
+            th.join(timeout=60)
+            assert not th.is_alive()
+        if errors:
+            raise errors[0]
+        assert all(c.shm_active for c in clients)
+        # 5 barriers x mean(1, 2) * lr 0.5 applied from zeros
+        np.testing.assert_allclose(
+            server.core.get_parameters()["w"],
+            np.full(1024, -0.75 * 5, np.float32), rtol=1e-5)
+        for c in clients:
+            c.close()
+    finally:
+        server.stop()

@@ -135,10 +135,13 @@ def test_empty_messages():
 
 
 # ---------------------------------------------------------------------------
-# Packed-payload transport extension (Tensor fields 5/6, PullRequest field 3)
+# Packed-payload transport extension (Tensor fields 5/6, PullRequest field 3).
+# The roundtrip tests take `each_codec` (tests/conftest.py): every run covers
+# BOTH the numpy oracle (PSDT_NATIVE=0) and the native C++ codec, so the
+# fallback path can never rot.
 # ---------------------------------------------------------------------------
 
-def test_raw_f32_packed_roundtrip_exact(rng):
+def test_raw_f32_packed_roundtrip_exact(rng, each_codec):
     arr = rng.standard_normal((64, 32)).astype(np.float32)
     t = m.Tensor.from_array("x", arr, wire_dtype=m.WIRE_RAW_F32)
     rt = m.Tensor.decode(t.encode())
@@ -147,7 +150,7 @@ def test_raw_f32_packed_roundtrip_exact(rng):
     assert np.asarray(rt.data).size == 0  # payload rides in field 5 only
 
 
-def test_bf16_packed_halves_bytes_and_rounds_rne(rng):
+def test_bf16_packed_halves_bytes_and_rounds_rne(rng, each_codec):
     import ml_dtypes
 
     arr = rng.standard_normal((256, 64)).astype(np.float32)
@@ -184,7 +187,7 @@ def test_pull_request_wire_dtype_default_elided():
     assert rt.wire_dtype == m.WIRE_BF16
 
 
-def test_int8_packed_quarter_bytes_and_error_bound(rng):
+def test_int8_packed_quarter_bytes_and_error_bound(rng, each_codec):
     arr = rng.standard_normal((128, 64)).astype(np.float32) * 3.0
     f32 = m.Tensor.from_array("g", arr).encode()
     int8 = m.Tensor.from_array("g", arr, wire_dtype=m.WIRE_INT8).encode()
@@ -199,7 +202,7 @@ def test_int8_packed_quarter_bytes_and_error_bound(rng):
                                   np.zeros(16, np.float32))
 
 
-def test_topk_packed_sparse_roundtrip(rng):
+def test_topk_packed_sparse_roundtrip(rng, each_codec):
     """WIRE_TOPK keeps exactly the k largest-|value| entries (bf16-
     precision values at their original indices, zeros elsewhere) and the
     payload shrinks with the density."""

@@ -1008,6 +1008,161 @@ def bench_apply() -> dict:
                      f"({n_max} workers, {opt_name})")}
 
 
+def bench_replicate() -> dict:
+    """Replication/failover/reshard bench (real loopback gRPC between
+    in-process PS servers): barrier-close latency with replication
+    off / async / sync, failover wall-clock (primary death -> first
+    successful push against the promoted replica), and a live 2->4
+    reshard's moved bytes + wall time.  Shape knobs: PSDT_BENCH_PARAMS
+    (total store size, default 2M), PSDT_BENCH_STEPS (iterations per
+    mode, default 5)."""
+    import tempfile
+
+    import numpy as np
+
+    from parameter_server_distributed_tpu.config import (
+        CoordinatorConfig, ParameterServerConfig)
+    from parameter_server_distributed_tpu.core.tensor import (store_nbytes,
+                                                              to_wire)
+    from parameter_server_distributed_tpu.replication.failover import (
+        ShardMapClient)
+    from parameter_server_distributed_tpu.replication.resharding import (
+        ReshardController)
+    from parameter_server_distributed_tpu.rpc import messages as m
+    from parameter_server_distributed_tpu.server.coordinator_service import (
+        Coordinator)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+    from parameter_server_distributed_tpu.worker.ps_shards import (
+        ShardedPSClient)
+
+    n_params = int(float(os.environ.get("PSDT_BENCH_PARAMS", "2e6")))
+    iters = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 5
+    tmp = tempfile.mkdtemp(prefix="psdt-repl-")
+
+    rng = np.random.default_rng(0)
+    n_tensors = 12
+    shape = (max(1, n_params // n_tensors),)
+    params = {f"layer{i:02d}/w": rng.standard_normal(shape).astype(np.float32)
+              for i in range(n_tensors)}
+    model_bytes = store_nbytes(params)
+    grads = {name: rng.standard_normal(v.shape).astype(np.float32)
+             for name, v in params.items()}
+
+    def make_ps(name: str, **kw) -> tuple[ParameterServer, int]:
+        ps = ParameterServer(ParameterServerConfig(
+            bind_address="127.0.0.1", port=0, total_workers=1,
+            checkpoint_dir=os.path.join(tmp, name), learning_rate=0.1,
+            autosave_period_s=3600.0, **kw))
+        return ps, ps.start()
+
+    # -- barrier-close latency: replication off vs async vs sync ----------
+    def close_p50(mode: str) -> float:
+        backup = None
+        kw = {}
+        if mode != "off":
+            backup, bport = make_ps(f"bk-{mode}")
+            kw = {"backup_address": f"127.0.0.1:{bport}",
+                  "replication": mode}
+        primary, _ = make_ps(f"pr-{mode}", **kw)
+        primary.core.initialize_parameters(params)
+        times = []
+        for it in range(1, iters + 1):
+            t0 = time.perf_counter()
+            r = primary.core.receive_gradients(0, it, grads)
+            times.append(time.perf_counter() - t0)
+            assert r.aggregation_complete, r.message
+        if primary.replicator is not None:
+            primary.replicator.flush()
+        primary.stop(0)
+        if backup is not None:
+            backup.stop(0)
+        p50 = sorted(times)[len(times) // 2]
+        log(f"bench_replicate: close p50 {1e3 * p50:.2f}ms "
+            f"(replication={mode})")
+        return round(1e3 * p50, 3)
+
+    close_off = close_p50("off")
+    close_async = close_p50("async")
+    close_sync = close_p50("sync")
+
+    # -- failover wall-clock ----------------------------------------------
+    backup, bport = make_ps("fo-bk")
+    primary, pport = make_ps("fo-pr",
+                             backup_address=f"127.0.0.1:{bport}",
+                             replication="sync")
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+        ps_port=pport, ps_backups=(f"127.0.0.1:{bport}",),
+        reap_period_s=3600.0))
+    cport = coordinator.start()
+    shard_map = ShardMapClient(f"127.0.0.1:{cport}")
+    shard_map.refresh()
+    client = ShardedPSClient(shard_map.primaries(), shard_map=shard_map)
+    primary.core.initialize_parameters(params)
+    push = client.push_gradients(m.GradientUpdate(
+        worker_id=0, iteration=1, gradients=to_wire(grads)))
+    assert push.success, push.message
+    primary._server.stop(None)  # the kill
+    t0 = time.perf_counter()
+    push = client.push_gradients(m.GradientUpdate(
+        worker_id=0, iteration=2, gradients=to_wire(grads)))
+    failover_s = time.perf_counter() - t0
+    assert push.success, push.message
+    log(f"bench_replicate: failover wall-clock {failover_s:.3f}s "
+        f"(death -> push applied on the replica)")
+    client.close()
+    coordinator.stop()
+    backup.stop(0)
+
+    # -- live 2->4 reshard -------------------------------------------------
+    shards = [make_ps(f"rs{i}") for i in range(4)]
+    ports = [port for _, port in shards]
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+        ps_port=ports[0], ps_shards=(f"127.0.0.1:{ports[1]}",),
+        reap_period_s=3600.0))
+    cport = coordinator.start()
+    shard_map = ShardMapClient(f"127.0.0.1:{cport}")
+    shard_map.refresh()
+    client = ShardedPSClient(shard_map.primaries(), shard_map=shard_map)
+    push = client.push_gradients(m.GradientUpdate(
+        worker_id=0, iteration=0, gradients=to_wire(params)))
+    assert push.success, push.message
+    t0 = time.perf_counter()
+    stats = ReshardController(coordinator.core).reshard(
+        [f"127.0.0.1:{port}" for port in ports])
+    reshard_s = time.perf_counter() - t0
+    push = client.push_gradients(m.GradientUpdate(
+        worker_id=0, iteration=1, gradients=to_wire(grads)))
+    assert push.success, push.message
+    log(f"bench_replicate: 2->4 reshard {reshard_s:.3f}s, "
+        f"{stats['moved_bytes'] / 1e6:.1f} MB moved")
+    client.close()
+    coordinator.stop()
+    for ps, _ in shards:
+        ps.stop(0)
+
+    overhead_sync = (round((close_sync - close_off) / close_off, 3)
+                     if close_off else 0.0)
+    return {"metric": "ps_replicate_close_ms_sync", "value": close_sync,
+            "unit": "ms",
+            "vs_baseline": (round(close_off / close_sync, 3)
+                            if close_sync else 0.0),
+            "close_ms": {"off": close_off, "async": close_async,
+                         "sync": close_sync},
+            "sync_overhead_frac": overhead_sync,
+            "failover_s": round(failover_s, 3),
+            "reshard_s": round(reshard_s, 3),
+            "reshard_moved_bytes": stats["moved_bytes"],
+            "model_bytes": model_bytes,
+            "note": (f"barrier close p50 {close_off}ms off / {close_async}ms "
+                     f"async / {close_sync}ms sync replication; failover "
+                     f"{failover_s:.2f}s death->replica-applied; 2->4 "
+                     f"reshard {reshard_s:.2f}s moving "
+                     f"{stats['moved_bytes'] / 1e6:.1f} MB")}
+
+
 def _ab_host_optimizer() -> None:
     """A/B timing (stderr): native C++ fused optimizer kernels vs the numpy
     fallback on the PS host update path — the kernels' production role
@@ -1654,6 +1809,8 @@ def child_main(mode: str) -> int:
             result = bench_aggregate()
         elif mode == "apply":
             result = bench_apply()
+        elif mode == "replicate":
+            result = bench_replicate()
         elif mode == "async":
             result = bench_async()
         elif mode == "generate":
@@ -1761,7 +1918,8 @@ def main() -> int:
     # Host-only benches never need the accelerator — run them on CPU
     # directly rather than risking a flaky TPU init.
     plans: list[tuple[str, float]]
-    if mode in ("pushpull", "dataplane", "aggregate", "apply", "codec"):
+    if mode in ("pushpull", "dataplane", "aggregate", "apply", "codec",
+                "replicate"):
         plans = [("cpu", cpu_timeout)]
     else:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]

@@ -32,6 +32,15 @@ LOCK_RANKS: dict[str, int] = {
     # checkpoint writer: holds its lock across core.snapshot()/restore(),
     # so it must come before every core lock
     "CheckpointManager._lock": 10,
+    # coordinator registry + shard map (core/coordinator_core.py, ISSUE
+    # 7): leaf in the coordinator process; ranked before the PS core
+    # locks so a colocated test topology stays ordered
+    "CoordinatorCore._lock": 14,
+    # backup-side replication sink (replication/replicator.py): held
+    # across core.install_tensors (ranks 20..40), so it must come first —
+    # it serializes whole delta installs against each other and against a
+    # racing promotion
+    "ReplicaSink._lock": 16,
     # ps_core (core/ps_core.py): the documented order — _state_lock before
     # _apply_lock before _params_lock; _apply_lock is never held while
     # ACQUIRING _state_lock (the streaming closer drops it first)
@@ -44,6 +53,14 @@ LOCK_RANKS: dict[str, int] = {
     # stripe lock), and the shared rank makes holding two stripes at once
     # a checked violation by construction — no nested-stripe deadlocks.
     "ParameterServerCore._stripe_lock": 44,
+    # primary-side replicator (replication/replicator.py): _lock is the
+    # wake condition variable's lock (pending flag only, leaf); _ship_lock
+    # serializes one state ship to the backup end to end — the replication
+    # RPC under it IS the serialized blocking section, and in sync mode it
+    # is acquired while the barrier closer holds _apply_lock (30), hence
+    # the rank after the core locks
+    "Replicator._lock": 46,
+    "Replicator._ship_lock": 48,
     # leaves: never held while acquiring anything else
     "ParameterServerCore._live_lock": 50,
     # shm transport (rpc/shm_transport.py, ISSUE 6): the client-side lock
@@ -77,6 +94,9 @@ BLOCKING_ALLOWED: frozenset[str] = frozenset({
     # serializes one fused shm round (write frames, doorbell-wait, read
     # frames) — the ring waits ARE the serialized blocking section
     "ShmClientConnection._lock",
+    # serializes one replication ship (encode + PushReplicaDelta RPC +
+    # ack) to the backup — the RPC under it is the point of the lock
+    "Replicator._ship_lock",
 })
 
 ENV_FLAG = "PSDT_LOCK_CHECK"

@@ -8,10 +8,14 @@ Argv contract mirrors the reference (reference: src/coordinator_main.cpp:6-20):
     bind_addr  default 0.0.0.0:50052
     ps_addr    default 127.0.0.1:50051 (host:port split like the reference)
 
-Extension: ``--ps-shards`` lists ADDITIONAL parameter-server shard
+Extensions: ``--ps-shards`` lists ADDITIONAL parameter-server shard
 addresses beyond ps_addr — the store is then name-partitioned across all
 of them and framework workers fan pushes/pulls out per tensor owner
-(reference peers only see ps_addr).
+(reference peers only see ps_addr).  ``--ps-backups`` lists backup
+replica addresses aligned by shard index with [ps_addr, *ps-shards]
+(replication/): a shard with a backup can be hot-failed-over — workers
+report the dead primary and the coordinator promotes the backup in the
+epoch-numbered shard map.
 """
 
 from __future__ import annotations
@@ -36,9 +40,11 @@ def main(argv: list[str] | None = None) -> int:
     bind_host, bind_port = parse_host_port(bind, DEFAULT_COORDINATOR_PORT)
     ps_host, ps_port = parse_host_port(ps, DEFAULT_PS_PORT)
     shards = tuple(s for s in flags.get("ps-shards", "").split(",") if s)
+    backups = tuple(s for s in flags.get("ps-backups", "").split(",") if s)
     coordinator = Coordinator(CoordinatorConfig(
         bind_address=bind_host, port=bind_port,
-        ps_address=ps_host, ps_port=ps_port, ps_shards=shards))
+        ps_address=ps_host, ps_port=ps_port, ps_shards=shards,
+        ps_backups=backups))
     coordinator.start()
     print(f"Coordinator server listening on {bind}", flush=True)
     try:

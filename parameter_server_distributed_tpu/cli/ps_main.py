@@ -23,6 +23,11 @@ Extension flags beyond the reference:
                     --coordinator=ADDR to poll the registry)
     --ckpt-dir=D    checkpoint directory (default .)
     --keep=N        checkpoint retention
+    --backup=ADDR   backup replica PS (replication/): the post-apply
+                    store streams there after every barrier close so the
+                    coordinator can promote it on this shard's death
+    --replication=M async (default) | sync (close blocks on the backup
+                    ack) | off — also the PSDT_REPLICATION env
 """
 
 from __future__ import annotations
@@ -50,6 +55,8 @@ def build_config(argv: list[str]) -> tuple[ParameterServerConfig, str | None]:
         elastic="elastic" in flags,
         checkpoint_dir=flags.get("ckpt-dir", "."),
         checkpoint_keep=int(flags.get("keep", 0)),
+        backup_address=flags.get("backup", ""),
+        replication=flags.get("replication", ""),
     )
     return config, flags.get("coordinator")
 

@@ -44,6 +44,12 @@ class CoordinatorConfig:
     # them (classic sharded parameter server; workers fan pushes/pulls
     # out per tensor owner).  Reference topology is the empty default.
     ps_shards: tuple[str, ...] = ()
+    # Replication (replication/): backup replica addresses aligned by
+    # shard index with [ps_address:ps_port, *ps_shards].  A shard with a
+    # backup listed here can be hot-failed-over: workers report the dead
+    # primary, the coordinator promotes the backup (epoch-numbered shard
+    # map), and the same iteration retries against the replica.
+    ps_backups: tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +82,15 @@ class ParameterServerConfig:
     live_workers_ttl_s: float = 1.0  # cache TTL for the live-worker lookup
     gc_iterations: int = 64      # retain at most this many iteration states
     checkpoint_keep: int = 0     # retention: keep newest N checkpoint files (0 = keep all)
+    # Replication (replication/replicator.py): address of this shard's
+    # backup replica PS.  When set, the post-apply store streams there
+    # after every barrier close so the backup can be promoted on a
+    # primary death.  Mode via `replication` / PSDT_REPLICATION:
+    # "async" (default — close pays a CV notify, a slow backup lags) |
+    # "sync" (close blocks until the backup acks — an applied iteration
+    # can never be lost) | "off".
+    backup_address: str = ""
+    replication: str = ""
 
     @property
     def synchronous(self) -> bool:

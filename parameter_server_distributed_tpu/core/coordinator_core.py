@@ -1,4 +1,4 @@
-"""Coordinator membership registry.
+"""Coordinator membership registry + epoch-numbered PS shard map.
 
 Re-design of the reference's `CoordinatorCore`
 (reference: src/coordinator.cpp, include/coordinator.h:10-37): a
@@ -6,7 +6,13 @@ mutex-guarded map worker_id -> registry entry with heartbeat timestamps,
 stale-worker eviction, and static PS address config.  Extended with a
 `live_worker_count` used as the elastic barrier width by
 `ParameterServerCore` (the reference instead restarts the PS with a new
-TOTAL_WORKERS — scripts/scale_workers.sh:137-144).
+TOTAL_WORKERS — scripts/scale_workers.sh:137-144) and, for the
+replication subsystem, a dynamic **shard map**: one
+:class:`ShardMapEntry` per PS shard with an optional backup replica
+address, under a monotone map epoch.  `promote_shard` swaps a dead
+primary for its backup (hot failover) and `set_shard_map` replaces the
+layout wholesale (live resharding); both bump the epoch so workers can
+tell a fresh map from the one they already hold.
 """
 
 from __future__ import annotations
@@ -14,8 +20,10 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Callable
+from typing import Callable, Sequence
 
+from ..analysis.lock_order import checked_lock
+from ..obs import stats as obs_stats
 from ..rpc.messages import WorkerStatus
 
 
@@ -30,17 +38,44 @@ class WorkerRegistryEntry:
     last_heartbeat: float = 0.0
 
 
+@dataclasses.dataclass
+class ShardMapEntry:
+    """One PS shard: its serving primary, an optional backup replica
+    that can be promoted, and the map epoch at which this entry last
+    changed (replication/ subsystem)."""
+    primary: str
+    backup: str = ""
+    epoch: int = 1
+
+
 class CoordinatorCore:
     def __init__(self, ps_address: str, ps_port: int,
                  ps_shards: tuple[str, ...] = (),
+                 ps_backups: Sequence[str] = (),
                  time_fn: Callable[[], float] = time.monotonic):
         self._ps_address = ps_address
         self._ps_port = int(ps_port)
         # additional shards beyond the primary (see CoordinatorConfig)
         self._ps_shards = tuple(ps_shards)
         self._workers: dict[int, WorkerRegistryEntry] = {}
-        self._lock = threading.Lock()
+        # Guards the worker registry AND the shard map/address fields:
+        # with failover and resharding the map mutates mid-run from many
+        # handler threads, so every read/write goes through it (the
+        # pre-replication code left the _ps_address/_ps_shards accessors
+        # unguarded — benign for launch-frozen config, a torn-read race
+        # once the map is dynamic).
+        self._lock = checked_lock("CoordinatorCore._lock")
         self._time = time_fn
+        # epoch-numbered shard map (replication/): index = shard index,
+        # entry 0 = the primary PS the reference protocol sees
+        addresses = [f"{ps_address}:{int(ps_port)}", *self._ps_shards]
+        backups = list(ps_backups) + [""] * max(
+            0, len(addresses) - len(ps_backups))
+        self._shard_epoch = 1
+        self._shard_map: list[ShardMapEntry] = [
+            ShardMapEntry(primary=addr, backup=backups[i], epoch=1)
+            for i, addr in enumerate(addresses)]
+        self._obs_promotions = obs_stats.counter("ps.replica.promotions")
 
     def register_worker(self, worker_id: int, address: str, port: int,
                         hostname: str) -> int:
@@ -74,21 +109,91 @@ class CoordinatorCore:
 
     def get_parameter_server_address(self) -> tuple[str, int]:
         """Static config echo (reference: src/coordinator.cpp:46-50)."""
-        return self._ps_address, self._ps_port
+        with self._lock:
+            return self._ps_address, self._ps_port
 
     def set_parameter_server_address(self, address: str, port: int) -> None:
         """Re-point discovery (extension: the reference address is fixed at
         construction; needed for ephemeral ports and PS failover)."""
-        self._ps_address = address
-        self._ps_port = int(port)
+        with self._lock:
+            self._ps_address = address
+            self._ps_port = int(port)
+            self._shard_map[0].primary = f"{address}:{int(port)}"
+            self._shard_map[0].epoch = self._shard_epoch
 
     def get_parameter_server_shards(self) -> list[str]:
-        """All PS shard addresses, primary first.  A single-element list
-        means the unsharded (reference) topology."""
-        return [f"{self._ps_address}:{self._ps_port}", *self._ps_shards]
+        """All PS shard addresses (current map primaries), shard 0 first.
+        A single-element list means the unsharded (reference) topology."""
+        with self._lock:
+            return [e.primary for e in self._shard_map]
 
     def set_parameter_server_shards(self, shards: tuple[str, ...]) -> None:
-        self._ps_shards = tuple(shards)
+        """Replace the shards beyond the primary (legacy config surface);
+        entries whose address is unchanged keep their backup."""
+        with self._lock:
+            self._ps_shards = tuple(shards)
+            old = {e.primary: e for e in self._shard_map[1:]}
+            self._shard_epoch += 1
+            self._shard_map[1:] = [
+                old.get(addr) or ShardMapEntry(primary=addr,
+                                               epoch=self._shard_epoch)
+                for addr in shards]
+
+    # --------------------------------------------------------- shard map
+    def get_shard_map(self) -> tuple[int, list[ShardMapEntry]]:
+        """(map epoch, entry copies).  The epoch is monotone: any
+        promotion or reshard bumps it, so a worker holding entries at
+        epoch E knows a response with epoch > E supersedes them."""
+        with self._lock:
+            return self._shard_epoch, [dataclasses.replace(e)
+                                       for e in self._shard_map]
+
+    def set_shard_backups(self, backups: Sequence[str]) -> None:
+        """Attach/replace backup replica addresses by shard index."""
+        with self._lock:
+            for i, backup in enumerate(backups):
+                if i < len(self._shard_map):
+                    self._shard_map[i].backup = backup
+
+    def promote_shard(self, shard_index: int,
+                      observed_primary: str) -> tuple[int, list[ShardMapEntry]]:
+        """Hot failover: swap shard ``shard_index``'s backup in as the
+        primary.  Idempotent by construction — the promotion only fires
+        when ``observed_primary`` still IS the primary, so N workers
+        racing to report the same dead shard promote exactly once and
+        the rest just read the fresh map.  Returns the current map."""
+        with self._lock:
+            if 0 <= shard_index < len(self._shard_map):
+                entry = self._shard_map[shard_index]
+                if entry.primary == observed_primary and entry.backup:
+                    entry.primary, entry.backup = entry.backup, ""
+                    self._shard_epoch += 1
+                    entry.epoch = self._shard_epoch
+                    if shard_index == 0:
+                        host, _, port = entry.primary.rpartition(":")
+                        self._ps_address = host
+                        self._ps_port = int(port)
+                    self._obs_promotions.add()
+            return self._shard_epoch, [dataclasses.replace(e)
+                                       for e in self._shard_map]
+
+    def set_shard_map(self, entries: Sequence[ShardMapEntry]) -> int:
+        """Replace the whole layout (live resharding) and bump the epoch.
+        Returns the new epoch.  Shard 0's primary becomes the discovery
+        address reference peers see."""
+        if not entries:
+            raise ValueError("shard map must keep at least one shard")
+        with self._lock:
+            self._shard_epoch += 1
+            self._shard_map = [
+                ShardMapEntry(primary=e.primary, backup=e.backup,
+                              epoch=self._shard_epoch)
+                for e in entries]
+            host, _, port = self._shard_map[0].primary.rpartition(":")
+            self._ps_address = host
+            self._ps_port = int(port)
+            self._ps_shards = tuple(e.primary for e in self._shard_map[1:])
+            return self._shard_epoch
 
     def remove_stale_workers(self, timeout_s: float = 30.0) -> list[int]:
         """Evict workers silent for > timeout_s

@@ -79,6 +79,7 @@ import numpy as np
 
 from ..analysis.lock_order import checked_lock
 from ..obs import stats as obs_stats
+from ..replication.messages import STALE_SHARD_MAP
 from .optimizer import HostOptimizer, SGD
 from .stripes import partition_names, run_striped, stripe_count, stripe_of
 from .tensor import TensorStore, store_nbytes, tree_like
@@ -167,7 +168,8 @@ class PushSink:
     stage into a private dict and commit routes through the classic
     whole-push paths (an async apply must be atomic)."""
 
-    __slots__ = ("_core", "worker_id", "iteration", "_buffer")
+    __slots__ = ("_core", "worker_id", "iteration", "_buffer",
+                 "stale_map_epoch")
 
     def __init__(self, core: "ParameterServerCore", worker_id: int,
                  iteration: int, streaming: bool):
@@ -175,14 +177,25 @@ class PushSink:
         self.worker_id = int(worker_id)
         self.iteration = int(iteration)
         self._buffer: dict | None = None if streaming else {}
+        # set when any folded chunk touched a tensor a live reshard moved
+        # to another owner (core._retired): the commit then reports the
+        # whole push rejected with the stale-shard-map marker so the
+        # sharded client refreshes its map and replays the round
+        self.stale_map_epoch: int | None = None
 
     def fold(self, gradients: Mapping[str, np.ndarray]) -> None:
         if self._buffer is not None:
             self._buffer.update(gradients)
         else:
-            self._core._fold_chunk(self.worker_id, self.iteration, gradients)
+            stale = self._core._fold_chunk(self.worker_id, self.iteration,
+                                           gradients)
+            if stale is not None:
+                self.stale_map_epoch = stale
 
     def commit(self) -> PushResult:
+        if self.stale_map_epoch is not None:
+            return self._core._stale_map_result(self.iteration,
+                                                self.stale_map_epoch)
         if self._buffer is not None:
             return self._core.receive_gradients(self.worker_id,
                                                 self.iteration, self._buffer)
@@ -302,6 +315,19 @@ class ParameterServerCore:
         # generation to drop it instead of applying a stale mean on top of
         # the restored store (or resurrecting the watermark restore reset).
         self._restore_epoch = 0
+        # Reshard tombstones (replication/): tensor name -> shard-map
+        # epoch at which the name moved to another owner.  Pushes that
+        # touch a retired name are rejected with the stale-shard-map
+        # marker (the worker refreshes its map and repartitions); folds
+        # drop them so a half-folded push never pollutes the accumulator.
+        # Guarded by _state_lock on the fold paths.
+        self._retired: dict[str, int] = {}
+        # Replication hook (replication/replicator.py): invoked by the
+        # streaming barrier close right after the optimizer apply, while
+        # _apply_lock is still held (applies stay serialized, so the
+        # hook may read the store consistently and — in sync mode —
+        # block on the ship; _apply_lock is BLOCKING_ALLOWED).
+        self._on_apply: Callable[[], None] | None = None
         # Async non-blocking serve: device optimizers dispatch their apply
         # asynchronously (jax), so right after a push the new store is a
         # promise.  Reads must not stall on that compute — bounded
@@ -395,6 +421,13 @@ class ParameterServerCore:
         with self._params_lock:
             return bool(self._params)
 
+    @property
+    def has_retired(self) -> bool:
+        """True when a live reshard has tombstoned tensors on this shard
+        (replication/): pushes touching them answer stale-shard-map."""
+        with self._state_lock:
+            return bool(self._retired)
+
     def serve_parameters(self, iteration: int = 0) -> tuple[int, TensorStore, bool]:
         """Return (current_iteration, params copy, ready).  The iteration
         argument is accepted and ignored, matching the reference
@@ -444,7 +477,9 @@ class ParameterServerCore:
         if not self.synchronous:
             return self._receive_async(worker_id, iteration, gradients)
         if self._streaming:
-            self._fold_chunk(worker_id, iteration, gradients)
+            stale_epoch = self._fold_chunk(worker_id, iteration, gradients)
+            if stale_epoch is not None:
+                return self._stale_map_result(iteration, stale_epoch)
             return self._commit_push(worker_id, iteration)
         return self._receive_sync(worker_id, iteration, gradients)
 
@@ -470,15 +505,49 @@ class ParameterServerCore:
             self._gc_locked()
         return state
 
+    def _stale_map_result(self, iteration: int, map_epoch: int,
+                          total: int | None = None) -> PushResult:
+        """The whole-push rejection for a push that touched tensors a
+        live reshard moved to another owner: the sharded client matches
+        the marker, refreshes the shard map (waiting for the epoch to
+        advance past ``map_epoch``), repartitions, and replays the round
+        — per-(worker, tensor) dedup makes the replay idempotent.
+        ``total`` must be passed by callers holding _state_lock
+        (barrier_width may hit a remote live-worker provider)."""
+        return PushResult(
+            False,
+            f"{STALE_SHARD_MAP}: tensors moved at map epoch {map_epoch}; "
+            f"refresh the shard map and repartition",
+            iteration, False, 0,
+            total if total is not None else self.barrier_width())
+
+    def _split_retired_locked(
+            self, gradients: Mapping[str, np.ndarray]
+    ) -> tuple[Mapping[str, np.ndarray], int | None]:
+        """(still-owned gradients, stale map epoch | None).  Caller holds
+        _state_lock.  Retired (moved-away) tensors are dropped so they
+        can never pollute this shard's accumulator; the surviving subset
+        still folds — the replay under the new partition dedups it."""
+        if not self._retired:
+            return gradients, None
+        hit = [n for n in gradients if n in self._retired]
+        if not hit:
+            return gradients, None
+        stale_epoch = max(self._retired[n] for n in hit)
+        return ({n: g for n, g in gradients.items()
+                 if n not in self._retired}, stale_epoch)
+
     def _fold_chunk(self, worker_id: int, iteration: int,
-                    gradients: Mapping[str, np.ndarray]) -> None:
+                    gradients: Mapping[str, np.ndarray]) -> int | None:
         """Fold one chunk of a worker's push into the iteration's running
         accumulator (streaming sync mode).  Idempotent per (worker, tensor
         name): a replayed chunk — an RPC retry of a push that actually
         landed — is skipped, so retries converge to exactly one
         contribution (first-push-wins).  Chunks for an aggregated (or
         currently-aggregating) iteration are discarded; the commit reports
-        the push late.
+        the push late.  Returns the tombstone map epoch when the chunk
+        touched retired (reshard-moved) tensors, else None — the caller
+        turns that into a stale-shard-map push rejection.
 
         Striped (stripes > 1): only the reservation — dedup, seal check,
         state bookkeeping — runs under ``_state_lock``; the O(bytes)
@@ -487,27 +556,29 @@ class ParameterServerCore:
         shared executor) fold on multiple cores at once."""
         with self._state_lock:
             self._current_iteration = max(self._current_iteration, iteration)
+            gradients, stale_epoch = self._split_retired_locked(gradients)
             state = self._sync_state_locked(iteration)
             if (state is None or state.aggregated or state.sealed
                     or worker_id in state.contributors):
                 # late / close-attempted / already-committed worker: chunk
                 # is discarded (commit reports the push late or duplicate)
-                return
+                return stale_epoch
             folded = state.folded.setdefault(worker_id, set())
             if self._stripes <= 1:
                 self._fold_into_locked(state, folded, gradients)
-                return
+                return stale_epoch
             folding = state.folding.setdefault(worker_id, set())
             todo = [(name, g) for name, g in gradients.items()
                     if name not in folded and name not in folding]
             if not todo:
-                return
+                return stale_epoch
             # reserve: a concurrent duplicate fold of the same (worker,
             # name) — e.g. a fast retry racing the original — sees the
             # reservation and skips instead of double-adding
             folding.update(name for name, _ in todo)
             state.inflight += 1
         self._fold_striped(state, worker_id, iteration, todo)
+        return stale_epoch
 
     def _fold_into_locked(self, state: IterationState, folded: set,
                           gradients: Mapping[str, np.ndarray]) -> None:
@@ -583,12 +654,28 @@ class ParameterServerCore:
                 folding = state.folding.get(worker_id)
                 if folding is not None:
                     folding.difference_update(name for name, _ in todo)
+                added = sum(added_by)
+                if self._retired:
+                    # a reshard RETIRE landed while these adds ran outside
+                    # _state_lock: its purge could not see sums still in
+                    # flight, so drop any retired name this fold just
+                    # (re)published — otherwise a pre-fence reservation
+                    # re-inserts a moved tensor's gradient, and on a shard
+                    # the retire left empty the bootstrap rule would turn
+                    # it into a parameter
+                    for names in done_by:
+                        for name in [n for n in names
+                                     if n in self._retired]:
+                            names.remove(name)
+                            acc = state.accum.pop(name, None)
+                            if acc is not None:
+                                added -= acc.nbytes
+                            state.counts.pop(name, None)
                 # only names whose add actually landed become folded —
                 # a failed name stays retryable, exactly like the serial
                 # path's fold-then-mark ordering
                 state.folded.setdefault(worker_id, set()).update(
                     name for names in done_by for name in names)
-                added = sum(added_by)
                 # a restore() racing this fold may have orphaned `state`;
                 # its buffer bytes then die with it — never re-note them
                 # against the reset global gauge
@@ -647,6 +734,11 @@ class ParameterServerCore:
         total = self.barrier_width()
         with self._state_lock:
             self._current_iteration = max(self._current_iteration, iteration)
+            gradients, stale_epoch = self._split_retired_locked(gradients)
+            if stale_epoch is not None:
+                # buffered mode rejects the push whole (nothing buffered):
+                # last-push-wins makes the post-repartition replay exact
+                return self._stale_map_result(iteration, stale_epoch, total)
             state = self._sync_state_locked(iteration)
             if state is None:
                 return PushResult(True, "iteration already aggregated",
@@ -770,6 +862,14 @@ class ParameterServerCore:
                         self._scale_striped(sums, counts)
                         scaled = True
                         self._apply_update(sums)
+                        if self._on_apply is not None:
+                            # replication hook, still under _apply_lock
+                            # (BLOCKING_ALLOWED): sync mode ships the
+                            # post-apply state to the backup BEFORE the
+                            # barrier publishes, so a primary death after
+                            # this point can never lose an applied
+                            # iteration (replication/replicator.py)
+                            self._on_apply()
             finally:
                 # _apply_lock is released BEFORE reacquiring _state_lock
                 # (lock-order: never hold _apply_lock while taking
@@ -790,6 +890,10 @@ class ParameterServerCore:
         """Bounded-staleness apply-on-arrival (extension; no reference
         analogue — the reference protocol is strictly synchronous)."""
         with self._state_lock:
+            gradients, stale_epoch = self._split_retired_locked(gradients)
+            if stale_epoch is not None:
+                return self._stale_map_result(iteration, stale_epoch,
+                                              self._static_total_workers)
             with self._params_lock:
                 params_empty = not self._params
             if params_empty:
@@ -1107,6 +1211,205 @@ class ParameterServerCore:
             self._grad_buffer_bytes = 0
             self._aggregated_watermark = -1
             self._bootstrap_iteration = None
+
+    # ------------------------------------------------------------ replication
+    def set_replication_hook(self, hook: Callable[[], None] | None) -> None:
+        """Install the post-apply replication hook (replication/
+        Replicator.on_apply).  Invoked by the streaming barrier close
+        right after the optimizer apply with _apply_lock held — applies
+        stay serialized, so the hook reads a consistent store, and sync
+        replication may block there (the lock is BLOCKING_ALLOWED).  The
+        hook MUST NOT raise: a raise would put the accumulator back and
+        retry the close (the failed-apply path).  Buffered/async
+        aggregation modes never invoke it — the replicator's reconcile
+        loop covers them on its poll cadence."""
+        self._on_apply = hook
+
+    def replica_snapshot(self, in_close: bool = False
+                         ) -> tuple[int, int, int, TensorStore, dict]:
+        """Consistent (epoch, iteration, params_version, params copy,
+        optimizer state) for a replication ship.  ``in_close=True`` is
+        the sync-hook path: the caller is the barrier closer and already
+        holds _apply_lock (applies serialized), so only _params_lock is
+        taken — re-entering snapshot()'s _state_lock→_apply_lock order
+        from there would self-deadlock."""
+        if in_close:
+            with self._params_lock:
+                params = dict(self._params)
+                version = self._params_version
+            # _apply_lock (held by the caller) serializes every slot
+            # mutation, so the state dict read is consistent lock-free
+            return (self._epoch, self._current_iteration, version, params,
+                    self._optimizer.state_dict())
+        with self._state_lock:
+            with self._apply_lock:
+                with self._params_lock:
+                    return (self._epoch, self._current_iteration,
+                            self._params_version, dict(self._params),
+                            self._optimizer.state_dict())
+
+    def install_tensors(self, tensors: Mapping[str, np.ndarray], *,
+                        epoch: int | None = None,
+                        iteration: int | None = None,
+                        optimizer_state: dict | None = None,
+                        optimizer_merge: bool = False,
+                        mark_aggregated: bool = True,
+                        replace: bool = False) -> int:
+        """Install externally-sourced parameter state: a replication ship
+        (``replace=True`` — the store becomes exactly the primary's) or a
+        reshard stripe handoff (``replace=False`` — the tensors merge into
+        whatever this shard already owns).  Unlike :meth:`restore` this
+        does NOT clear live iteration states (a reshard target may already
+        be serving pushes for other stripes) and it advances — never
+        rewinds — ``current_iteration``.  ``mark_aggregated`` raises the
+        aggregated watermark to ``iteration`` so a worker's RETRY of an
+        iteration the dead primary already applied is answered "already
+        aggregated" instead of waiting out a barrier that can never
+        re-fire — the promoted-replica dedup that makes failover retries
+        idempotent.  Returns the new store version."""
+        store = tree_like(tensors)
+        with self._state_lock:
+            with self._apply_lock:
+                with self._params_lock:
+                    if replace:
+                        self._params = store
+                    else:
+                        merged = dict(self._params)
+                        merged.update(store)
+                        self._params = merged
+                    self._params_version += 1
+                    version = self._params_version
+                    if optimizer_state is not None and optimizer_merge:
+                        # reshard stripe handoff: the moved tensors'
+                        # slot entries join this shard's state; its own
+                        # scalars (step counts) and other names' slots
+                        # stay untouched
+                        current = self._optimizer.state_dict()
+                        for slot, value in optimizer_state.items():
+                            if isinstance(value, dict):
+                                cur = current.get(slot)
+                                if isinstance(cur, dict):
+                                    cur.update(value)
+                                else:
+                                    current[slot] = dict(value)
+                        self._optimizer.load_state_dict(current)
+                    elif optimizer_state is not None:
+                        self._optimizer.load_state_dict(optimizer_state)
+                if replace:
+                    # an in-flight streaming close must not publish a mean
+                    # computed against the pre-install world on top of the
+                    # replaced store (same fence as restore())
+                    self._restore_epoch += 1
+            if epoch is not None:
+                # a replication replace tracks the primary's epoch
+                # verbatim; a reshard merge install must never REWIND a
+                # live shard's training epoch
+                self._epoch = (int(epoch) if replace
+                               else max(self._epoch, int(epoch)))
+            if iteration is not None:
+                it = int(iteration)
+                self._current_iteration = max(self._current_iteration, it)
+                if mark_aggregated:
+                    self._aggregated_watermark = max(
+                        self._aggregated_watermark, it)
+                    # REPLACE installs only: release any LIVE iteration
+                    # state the watermark just superseded.  A worker's
+                    # failover retry can race the dead primary's final
+                    # in-flight ship — retry lands first, creates the
+                    # state, parks on the barrier; the install then
+                    # proves the iteration was already applied
+                    # cluster-wide.  The state lookup would shadow the
+                    # watermark forever (1/N contributors, no one else
+                    # will push), so drop it — the woken waiter
+                    # re-checks, finds no state, reads the watermark,
+                    # and serves the just-installed store.  A reshard
+                    # MERGE install must NOT do this: on a shard that
+                    # keeps its tensors, a live fence-iteration state
+                    # holds real partial sums whose remaining
+                    # contributors are still coming — the state's
+                    # existence (checked before the watermark) lets it
+                    # complete normally.
+                    if replace:
+                        for stale_it in [i for i in self._iteration_states
+                                         if i <= self._aggregated_watermark]:
+                            old = self._iteration_states.pop(stale_it)
+                            if old.buffer_bytes:
+                                self._grad_buffer_note(-old.buffer_bytes)
+                                old.buffer_bytes = 0
+            for name in store:
+                # a stripe can move back here on a later merge reshard
+                self._retired.pop(name, None)
+            self._serving = None
+            self._barrier_cv.notify_all()
+        return version
+
+    def retire_tensors(self, names, map_epoch: int
+                       ) -> tuple[int, int, int, TensorStore, dict]:
+        """The resharding version fence: atomically remove ``names`` from
+        the store, tombstone them at ``map_epoch``, and return the removed
+        values — all under one lock hold, so the copied stripe is exactly
+        the last state this shard ever applied to it (an in-flight barrier
+        apply completes first behind _apply_lock; pushes arriving after
+        see the tombstones and are rejected stale-shard-map).  The moved
+        names' optimizer slot entries (momentum/moments) are extracted
+        and removed too, so the new owner continues the SAME optimization
+        trajectory and a stale slot can never linger here to resurrect on
+        a later merge.  Returns (epoch, iteration, params_version, moved
+        tensors, moved optimizer slots {slot: {name: arr}})."""
+        name_set = set(names)
+        with self._state_lock:
+            with self._apply_lock:
+                with self._params_lock:
+                    moved: TensorStore = {}
+                    store = dict(self._params)
+                    for name in names:
+                        if name in store:
+                            moved[name] = store.pop(name)
+                    if moved:
+                        self._params = store
+                        self._params_version += 1
+                    version = self._params_version
+                    moved_opt: dict = {}
+                    opt_state = self._optimizer.state_dict()
+                    remaining: dict = {}
+                    for slot, value in opt_state.items():
+                        if isinstance(value, dict):
+                            taken = {n: a for n, a in value.items()
+                                     if n in name_set}
+                            if taken:
+                                moved_opt[slot] = taken
+                            remaining[slot] = {
+                                n: a for n, a in value.items()
+                                if n not in name_set}
+                        else:
+                            remaining[slot] = value
+                    if moved_opt:
+                        self._optimizer.load_state_dict(remaining)
+            for name in names:
+                self._retired[name] = int(map_epoch)
+            # Purge the retired names from every LIVE iteration state:
+            # sums folded before the fence belong to the stripe's new
+            # owner's timeline now, and — worse — on a shard left empty
+            # by the retire, a later barrier close would run the
+            # bootstrap rule and turn those folded GRADIENTS into
+            # parameters.  (Contributor sets are untouched: a worker that
+            # pushed stays counted, its still-owned tensors folded fine.)
+            for state in self._iteration_states.values():
+                freed = 0
+                for name in names:
+                    acc = state.accum.pop(name, None)
+                    if acc is not None:
+                        freed += acc.nbytes
+                    state.counts.pop(name, None)
+                    for folded in state.folded.values():
+                        folded.discard(name)
+                    for folding in state.folding.values():
+                        folding.discard(name)
+                if freed:
+                    state.buffer_bytes -= freed
+                    self._grad_buffer_note(-freed)
+            return (self._epoch, self._current_iteration, version, moved,
+                    moved_opt)
 
 
 def _mean_over_workers(worker_gradients: Mapping[int, TensorStore]) -> TensorStore:

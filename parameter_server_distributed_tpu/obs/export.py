@@ -78,6 +78,27 @@ def _ps_rollup(snap: dict) -> dict:
     par = snap.get("gauges", {}).get("ps.apply.parallelism", 0)
     if par:
         out["apply_parallelism"] = par
+    # replication / failover / resharding (replication/, ISSUE 7)
+    replica: dict = {}
+    shipped = counters.get("ps.replica.shipped_bytes", 0)
+    if shipped:
+        replica["shipped_bytes"] = shipped
+    lag = snap.get("gauges", {}).get("ps.replica.lag_bytes", 0)
+    if lag:
+        replica["lag_bytes"] = lag
+    ship = _hist_stats(snap, "ps.replica.ship_s")
+    if ship:
+        replica["ship_s"] = ship
+    for key, name in (("promotions", "ps.replica.promotions"),
+                      ("failovers", "ps.replica.failovers"),
+                      ("fallbacks", "ps.replica.fallback"),
+                      ("installed_bytes", "ps.replica.installed_bytes"),
+                      ("reshard_moved_bytes", "ps.reshard.moved_bytes")):
+        value = counters.get(name, 0)
+        if value:
+            replica[key] = value
+    if replica:
+        out["replica"] = replica
     return out
 
 
@@ -275,6 +296,31 @@ def render_rollup(rollup: dict) -> str:
             if peak:
                 parts.append(f"peak grad buffer {_fmt_bytes(peak)}")
             lines.append(f"    ps: {', '.join(parts)}")
+            replica = ps.get("replica")
+            if replica:
+                rparts = []
+                if replica.get("shipped_bytes"):
+                    note = f"shipped {_fmt_bytes(replica['shipped_bytes'])}"
+                    ship = replica.get("ship_s")
+                    if ship:
+                        note += f" (ship p50={_fmt_s(ship['p50'])})"
+                    rparts.append(note)
+                if replica.get("lag_bytes"):
+                    rparts.append(f"lag {_fmt_bytes(replica['lag_bytes'])}")
+                if replica.get("installed_bytes"):
+                    rparts.append(
+                        f"installed {_fmt_bytes(replica['installed_bytes'])}")
+                if replica.get("promotions"):
+                    rparts.append(f"{replica['promotions']} promotions")
+                if replica.get("failovers"):
+                    rparts.append(f"{replica['failovers']} failovers")
+                if replica.get("fallbacks"):
+                    rparts.append(f"{replica['fallbacks']} fallbacks")
+                if replica.get("reshard_moved_bytes"):
+                    rparts.append(
+                        "reshard moved "
+                        + _fmt_bytes(replica["reshard_moved_bytes"]))
+                lines.append(f"    replication: {', '.join(rparts)}")
         native_plane = w.get("native_plane")
         if native_plane:
             parts = []

@@ -1,0 +1,29 @@
+"""PS scale-out: primary/backup replication, hot failover, live resharding.
+
+The parameter-server tier used to be the run's single point of failure:
+every shard held the only copy of its partition and the shard map was
+frozen at launch (ROADMAP open item 2).  This subsystem makes the tier
+fault-tolerant and elastically resizable:
+
+- ``replicator.py`` — primary-side :class:`Replicator` streams post-apply
+  striped state to a backup PS after each barrier close; backup-side
+  :class:`ReplicaSink` installs it and tracks ``(iteration,
+  params_version)`` so the backup can be promoted at any instant.
+- ``failover.py`` — worker-side :class:`ShardMapClient` over the
+  coordinator's epoch-numbered shard map; ``ShardedPSClient`` uses it to
+  promote a dead shard's backup mid-push/pull and retry the same
+  iteration against the replica with zero failed steps.
+- ``resharding.py`` — coordinator-orchestrated live split/merge: moving
+  stripes are snapshotted at a version fence, copied to their new owner,
+  and the shard-map epoch bumps; workers repartition on the next
+  ``stale shard map`` rejection.
+- ``messages.py`` — the extension RPC messages.  They live HERE, not in
+  ``rpc/messages.py``: the wire-compat manifest pins the reference
+  contract and must not change; a reference peer answers these methods
+  UNIMPLEMENTED and every client downgrades permanently (the PR-2
+  fallback discipline).
+
+Knobs: ``PSDT_REPLICATION`` / ``--backup`` (docs/training.md
+"replication & failover"); metrics ``ps.replica.*`` / ``ps.reshard.*``
+(docs/observability.md).
+"""

@@ -1,0 +1,152 @@
+"""Worker-side shard-map client: hot failover + reshard repartitioning.
+
+:class:`ShardMapClient` is the worker's view of the coordinator's
+epoch-numbered shard map (core/coordinator_core.py).  ``ShardedPSClient``
+(worker/ps_shards.py) consults it twice:
+
+- **failover** — a shard RPC dies mid-push/pull (transport error, not
+  UNIMPLEMENTED): :meth:`report_failure` tells the coordinator, which
+  promotes the shard's backup idempotently (first reporter wins; everyone
+  else reads the fresh map) and the client retries the SAME iteration
+  against the replica.  The dead primary is never revisited — the PR-2
+  permanent per-connection downgrade discipline, lifted to addresses.
+- **resharding** — a push comes back with the ``stale shard map`` marker
+  (replication/messages.py): :meth:`wait_for_epoch_above` polls the
+  coordinator until the reshard controller publishes the new layout,
+  then the client rebuilds its shard connections and repartitions.
+
+A reference coordinator answers ``GetShardMap`` UNIMPLEMENTED;
+:meth:`refresh` then returns False and the worker stays on the static
+discovery topology (no failover, exactly the pre-replication behavior).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import grpc
+
+from ..obs import stats as obs_stats
+from ..rpc import messages as m
+from ..rpc.service import RpcClient
+from . import messages as rmsg
+
+log = logging.getLogger("pst.failover")
+
+
+def _status_code(exc: grpc.RpcError):
+    code = getattr(exc, "code", None)
+    return code() if callable(code) else None
+
+
+class ShardMapClient:
+    """Cached (epoch, entries) + the promotion/refresh RPCs.  Thread-safe:
+    the sharded client's fan-out threads may report failures and read the
+    map concurrently (plain lock — leaf, no other lock acquired under
+    it, and this object lives in the worker process, outside the ranked
+    PS/coordinator lock tables)."""
+
+    def __init__(self, coordinator_address: str, worker_id: int = 0,
+                 client: RpcClient | None = None):
+        self._client = client or RpcClient(
+            coordinator_address, m.COORDINATOR_SERVICE,
+            {**m.COORDINATOR_METHODS, **rmsg.REPLICATION_COORD_METHODS})
+        self.worker_id = int(worker_id)
+        self._lock = threading.Lock()
+        self.epoch = 0
+        self.entries: list[rmsg.WireShardMapEntry] = []
+        self._supported: bool | None = None
+        # failover attempts only — actual promotions are counted at the
+        # coordinator (CoordinatorCore.promote_shard), which is the one
+        # place that knows whether a report really swapped a primary (N
+        # racing reporters see the address change but only one caused it)
+        self._obs_failovers = obs_stats.counter("ps.replica.failovers")
+
+    @property
+    def supported(self) -> bool:
+        """True once the coordinator has answered ``GetShardMap`` (a
+        reference coordinator never will — permanent downgrade)."""
+        return self._supported is True
+
+    def close(self) -> None:
+        self._client.close()
+
+    def _adopt(self, resp: rmsg.ShardMapResponse) -> None:
+        with self._lock:
+            if resp.epoch >= self.epoch:
+                self.epoch = int(resp.epoch)
+                self.entries = list(resp.entries)
+
+    def refresh(self, timeout: float = 5.0) -> bool:
+        """Fetch the current map.  False = coordinator does not speak the
+        extension (reference peer; remembered) or is unreachable."""
+        if self._supported is False:
+            return False
+        try:
+            resp = self._client.call("GetShardMap", rmsg.ShardMapRequest(),
+                                     timeout=timeout)
+        except grpc.RpcError as exc:
+            if _status_code(exc) == grpc.StatusCode.UNIMPLEMENTED:
+                self._supported = False
+            return False
+        self._supported = True
+        self._adopt(resp)
+        return True
+
+    def primaries(self) -> list[str]:
+        with self._lock:
+            return [e.primary for e in self.entries]
+
+    def has_backups(self) -> bool:
+        with self._lock:
+            return any(e.backup for e in self.entries)
+
+    def report_failure(self, shard_index: int, observed_primary: str,
+                       timeout: float = 10.0) -> str | None:
+        """Report a dead primary; returns the shard's CURRENT primary
+        from the post-promotion map (None when the coordinator cannot
+        help — no extension, no backup, unreachable).  Counts a failover
+        attempt always and a promotion when the primary actually
+        changed."""
+        if self._supported is False:
+            return None
+        self._obs_failovers.add()
+        with self._lock:
+            epoch = self.epoch
+        try:
+            resp = self._client.call(
+                "ReportShardFailure",
+                rmsg.ShardFailureReport(shard_index=shard_index,
+                                        observed_primary=observed_primary,
+                                        epoch=epoch,
+                                        worker_id=self.worker_id),
+                timeout=timeout)
+        except grpc.RpcError as exc:
+            if _status_code(exc) == grpc.StatusCode.UNIMPLEMENTED:
+                self._supported = False
+            log.warning("shard-failure report for %s failed: %s",
+                        observed_primary, exc)
+            return None
+        self._supported = True
+        self._adopt(resp)
+        with self._lock:
+            if shard_index >= len(self.entries):
+                return None
+            current = self.entries[shard_index].primary
+        if current == observed_primary:
+            return None  # nothing to promote: the shard really is gone
+        return current
+
+    def wait_for_epoch_above(self, epoch: int, timeout: float = 15.0,
+                             poll_s: float = 0.1) -> bool:
+        """Poll the coordinator until the map epoch exceeds ``epoch``
+        (a reshard/promotion published) or the timeout lapses."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.refresh() and self.epoch > epoch:
+                return True
+            if time.monotonic() >= deadline:
+                return self.epoch > epoch
+            time.sleep(poll_s)

@@ -1,0 +1,163 @@
+"""Replication/failover/resharding extension RPC messages.
+
+Deliberately NOT in ``rpc/messages.py``: the analyzer's wire manifest
+pins the reference contract (field tags, method tables) and this
+subsystem must leave it byte-unchanged.  These are extra method names on
+the two existing gRPC services — a reference peer simply never calls
+them and answers UNIMPLEMENTED, which every caller treats as a permanent
+per-connection downgrade (the PR-2/PR-6 fallback discipline).
+
+Tensor payloads reuse :class:`rpc.messages.Tensor` — the PR-6 codec
+frames (``ArrayPayload`` packed encodings, native fast path included)
+carry replication traffic exactly as they carry the training data plane.
+"""
+
+from __future__ import annotations
+
+from ..rpc.messages import Tensor
+from ..rpc.wire import Field, Message
+
+# Marker the PS embeds in a push rejection when the push touched tensors
+# that a live reshard moved to another owner; ShardedPSClient matches on
+# it, refreshes the shard map (waiting for the epoch to advance), and
+# replays the round against the new partition.
+STALE_SHARD_MAP = "stale shard map"
+
+# ReplicaDeltaChunk.kind values
+DELTA_STATE = 0    # full post-apply state ship (primary -> backup): the
+                   # receiver REPLACES its store (bit-identical replica)
+DELTA_INSTALL = 1  # stripe handoff (resharding): the receiver MERGES the
+                   # tensors into its store
+
+
+# --------------------------------------------------------------------------
+# parameter-server service extensions
+# --------------------------------------------------------------------------
+
+class ReplicaDeltaChunk(Message):
+    """One chunk of a replication ship (client-streamed).  Header fields
+    ride every chunk (a handful of bytes); ``params_version`` is the
+    SENDER's store version, which the sink tracks as the replication
+    high-water mark.  Optimizer slot state rides as tensors under the
+    ``__opt__/`` name prefix (replicator.flatten_optimizer_state)."""
+    FIELDS = (
+        Field(1, "epoch", "int32"),
+        Field(2, "iteration", "int32"),
+        Field(3, "params_version", "int64"),
+        Field(4, "kind", "int32"),
+        Field(5, "tensors", "message", message_type=Tensor, repeated=True),
+    )
+
+
+class ReplicaAck(Message):
+    FIELDS = (
+        Field(1, "success", "bool"),
+        Field(2, "message", "string"),
+        Field(3, "params_version", "int64"),
+        Field(4, "iteration", "int32"),
+    )
+
+
+class ReplicaStateRequest(Message):
+    """``names`` empty = the full store."""
+    FIELDS = (Field(1, "names", "string", repeated=True),)
+
+
+class ReplicaStateChunk(Message):
+    """One chunk of a state fetch / stripe retirement (server-streamed).
+    The first chunk always goes out (header even for an empty subset);
+    ``last`` marks the final chunk."""
+    FIELDS = (
+        Field(1, "epoch", "int32"),
+        Field(2, "iteration", "int32"),
+        Field(3, "params_version", "int64"),
+        Field(4, "tensors", "message", message_type=Tensor, repeated=True),
+        Field(5, "last", "bool"),
+    )
+
+
+class RetireTensorsRequest(Message):
+    """Atomically remove ``names`` from the serving store and tombstone
+    them at ``map_epoch``: later pushes touching them are rejected with
+    the ``stale shard map`` marker.  The response streams the retired
+    tensors — snapshotted under the same lock hold as the removal, the
+    resharding version fence."""
+    FIELDS = (
+        Field(1, "names", "string", repeated=True),
+        Field(2, "map_epoch", "int32"),
+    )
+
+
+class ReplicaStatusRequest(Message):
+    FIELDS = ()
+
+
+class ReplicaStatusResponse(Message):
+    """``primary_version``/``primary_iteration`` are the replication
+    high-water mark a backup tracks (-1 = never shipped to); ``names``
+    lists the store's tensor names (the resharding controller's cheap
+    ownership census — values stay put)."""
+    FIELDS = (
+        Field(1, "iteration", "int32"),
+        Field(2, "params_version", "int64"),
+        Field(3, "primary_version", "int64"),
+        Field(4, "primary_iteration", "int32"),
+        Field(5, "names", "string", repeated=True),
+        Field(6, "epoch", "int32"),
+    )
+
+
+REPLICATION_PS_METHODS = {
+    "PushReplicaDelta": (ReplicaDeltaChunk, ReplicaAck, "stream_unary"),
+    "FetchReplicaState": (ReplicaStateRequest, ReplicaStateChunk,
+                          "unary_stream"),
+    "RetireTensors": (RetireTensorsRequest, ReplicaStateChunk,
+                      "unary_stream"),
+    "ReplicaStatus": (ReplicaStatusRequest, ReplicaStatusResponse),
+}
+
+
+# --------------------------------------------------------------------------
+# coordinator service extensions
+# --------------------------------------------------------------------------
+
+class WireShardMapEntry(Message):
+    """One shard of the epoch-numbered map (core.coordinator_core
+    ShardMapEntry on the wire)."""
+    FIELDS = (
+        Field(1, "primary", "string"),
+        Field(2, "backup", "string"),
+        Field(3, "epoch", "int32"),
+    )
+
+
+class ShardMapRequest(Message):
+    FIELDS = ()
+
+
+class ShardMapResponse(Message):
+    FIELDS = (
+        Field(1, "epoch", "int32"),
+        Field(2, "entries", "message", message_type=WireShardMapEntry,
+              repeated=True),
+    )
+
+
+class ShardFailureReport(Message):
+    """A worker observed ``observed_primary`` (shard ``shard_index``)
+    dead at map epoch ``epoch``.  The coordinator promotes the shard's
+    backup — idempotently: a report against an address that is no longer
+    the primary (another worker already promoted) is a no-op — and
+    returns the current map either way."""
+    FIELDS = (
+        Field(1, "shard_index", "int32"),
+        Field(2, "observed_primary", "string"),
+        Field(3, "epoch", "int32"),
+        Field(4, "worker_id", "int32"),
+    )
+
+
+REPLICATION_COORD_METHODS = {
+    "GetShardMap": (ShardMapRequest, ShardMapResponse),
+    "ReportShardFailure": (ShardFailureReport, ShardMapResponse),
+}

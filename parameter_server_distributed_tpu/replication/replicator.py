@@ -1,0 +1,407 @@
+"""Primary/backup replication of the PS tensor store (ISSUE 7).
+
+**Primary side** — :class:`Replicator`: after each streaming barrier
+close the core's replication hook fires (core/ps_core.py
+``set_replication_hook``) and the post-apply state ships to the backup
+over the ``PushReplicaDelta`` extension RPC as striped chunks of PR-6
+codec frames (``rpc.messages.Tensor`` payloads — lossless WIRE_RAW_F32,
+so the replica store is bit-identical to the primary's).  Two modes
+(``PSDT_REPLICATION`` / ``ParameterServerConfig.replication``):
+
+A note on "delta": a post-apply delta on a parameter server IS the full
+striped state — every barrier's optimizer apply touches every tensor, so
+"changed since the last ship" equals the whole store in steady state.
+What bounds the cost is COALESCING, not diffing: consecutive versions
+collapse to one latest-snapshot ship when the backup lags (async mode),
+and the stripe ordering keeps chunks aligned with the PS's unit of
+parallelism.
+
+- ``async`` (default): the hook just wakes the ship thread — barrier
+  close pays a condition-variable notify; consecutive versions coalesce
+  (the ship always sends the LATEST snapshot), so a slow backup lags but
+  never stalls training.  ``ps.replica.lag_bytes`` surfaces the gap.
+- ``sync``: the hook ships inline BEFORE the barrier publishes (it runs
+  under ``_apply_lock``, which is BLOCKING_ALLOWED for exactly this):
+  once a worker sees an iteration complete, the backup provably holds
+  it — a primary death can never lose an applied step, at the cost of
+  one replication round per barrier close.
+
+Downgrade discipline (PR-2/PR-6): a backup that answers UNIMPLEMENTED
+(reference PS) or rejects the delta downgrades replication PERMANENTLY
+for this process; transient transport errors retry on the reconcile
+cadence and degrade permanently after ``_MAX_TRANSIENT_FAILURES``
+consecutive failures — the primary's training hot path must never wedge
+on a dead backup.
+
+**Backup side** — :class:`ReplicaSink`: installs each delta atomically
+(core ``install_tensors``), tracks the primary's ``(iteration,
+params_version)`` high-water mark, and — after a promotion — refuses
+regressions from a zombie primary (the replica's own aggregation having
+advanced past the sink's mark is the promotion signal).
+
+Optimizer slot state rides the same stream as tensors under the
+``__opt__/`` name prefix (momentum/Adam moments survive a failover);
+scalars flatten under ``__opt__/__scalar__/``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Mapping
+
+import grpc
+import numpy as np
+
+from ..analysis.lock_order import checked_lock
+from ..core.stripes import stripe_of
+from ..core.tensor import TensorStore, from_wire, store_nbytes, to_wire
+from ..obs import stats as obs_stats
+from ..rpc import messages as m
+from ..rpc.data_plane import split_tensors, stream_chunk_bytes
+from ..rpc.service import RpcClient
+from . import messages as rmsg
+
+log = logging.getLogger("pst.replication")
+
+OPT_PREFIX = "__opt__/"
+_OPT_SCALAR = "__scalar__"
+
+# consecutive transient ship failures before replication degrades
+# permanently (an UNIMPLEMENTED/refused answer degrades immediately)
+_MAX_TRANSIENT_FAILURES = 5
+
+
+def flatten_optimizer_state(state: dict) -> TensorStore:
+    """Optimizer state dict -> flat named arrays for the wire: slot dicts
+    become ``__opt__/<slot>/<name>``, scalars ``__opt__/__scalar__/<k>``
+    (same flattening as the checkpoint sidecar, checkpoint/manager.py)."""
+    flat: TensorStore = {}
+    for slot, value in state.items():
+        if isinstance(value, dict):
+            for name, arr in value.items():
+                flat[f"{OPT_PREFIX}{slot}/{name}"] = np.asarray(arr)
+        else:
+            flat[f"{OPT_PREFIX}{_OPT_SCALAR}/{slot}"] = np.asarray(value)
+    return flat
+
+
+def split_replica_store(store: Mapping[str, np.ndarray]
+                        ) -> tuple[TensorStore, dict | None]:
+    """(parameter tensors, optimizer state dict | None) — the inverse of
+    :func:`flatten_optimizer_state` applied to a decoded delta stream."""
+    params: TensorStore = {}
+    opt: dict = {}
+    for name, arr in store.items():
+        if not name.startswith(OPT_PREFIX):
+            params[name] = arr
+            continue
+        slot, _, leaf = name[len(OPT_PREFIX):].partition("/")
+        if slot == _OPT_SCALAR:
+            value = np.asarray(arr)
+            opt[leaf] = value.item() if value.ndim == 0 else value
+        else:
+            opt.setdefault(slot, {})[leaf] = arr
+    return params, (opt or None)
+
+
+def replication_client(address: str) -> RpcClient:
+    """An RpcClient for a PS peer with the replication extension methods
+    bound alongside the reference method table."""
+    return RpcClient(address, m.PARAMETER_SERVER_SERVICE,
+                     {**m.PARAMETER_SERVER_METHODS,
+                      **rmsg.REPLICATION_PS_METHODS})
+
+
+def delta_chunks(epoch: int, iteration: int, version: int, kind: int,
+                 store: Mapping[str, np.ndarray], stripes: int = 1,
+                 chunk_bytes: int | None = None):
+    """The delta stream for one ship: tensors ordered by owning stripe
+    (core/stripes.py — the stripe partition is the replication unit, so a
+    chunk never interleaves stripes), greedily grouped to the stream
+    chunk budget, each group one :class:`rmsg.ReplicaDeltaChunk` of
+    lossless WIRE_RAW_F32 codec frames.  An empty store still yields one
+    (empty) header chunk."""
+    budget = chunk_bytes if chunk_bytes is not None \
+        else (stream_chunk_bytes() or (32 << 20))
+    ordered = sorted(store, key=lambda n: (stripe_of(n, max(1, stripes)), n))
+    tensors = to_wire({n: store[n] for n in ordered},
+                      wire_dtype=m.WIRE_RAW_F32)
+    sent = False
+    for group in split_tensors(tensors, budget):
+        sent = True
+        yield rmsg.ReplicaDeltaChunk(epoch=epoch, iteration=iteration,
+                                     params_version=version, kind=kind,
+                                     tensors=group)
+    if not sent:
+        yield rmsg.ReplicaDeltaChunk(epoch=epoch, iteration=iteration,
+                                     params_version=version, kind=kind,
+                                     tensors=[])
+
+
+def state_chunks(epoch: int, iteration: int, version: int,
+                 store: Mapping[str, np.ndarray],
+                 chunk_bytes: int | None = None):
+    """Server-streamed :class:`rmsg.ReplicaStateChunk` frames for a state
+    fetch / stripe retirement — always at least one chunk (the header
+    rides every chunk; the final one carries ``last=True``)."""
+    budget = chunk_bytes if chunk_bytes is not None \
+        else (stream_chunk_bytes() or (32 << 20))
+    tensors = to_wire(store, wire_dtype=m.WIRE_RAW_F32)
+    groups = list(split_tensors(tensors, budget)) or [[]]
+    for i, group in enumerate(groups):
+        yield rmsg.ReplicaStateChunk(epoch=epoch, iteration=iteration,
+                                     params_version=version, tensors=group,
+                                     last=(i == len(groups) - 1))
+
+
+class Replicator:
+    """Primary-side shipper.  ``on_apply`` is installed as the core's
+    replication hook; :meth:`start`/:meth:`stop` manage the reconcile
+    thread (which also covers restores/initializations and the buffered
+    aggregation mode, where the close-path hook never fires)."""
+
+    def __init__(self, core, backup_address: str, mode: str = "async",
+                 poll_s: float = 0.25, include_optimizer: bool = True,
+                 timeout_s: float = 60.0):
+        if mode not in ("async", "sync"):
+            raise ValueError(f"unknown replication mode {mode!r}; "
+                             f"options: async, sync")
+        self._core = core
+        self.backup_address = backup_address
+        self.mode = mode
+        self._poll_s = float(poll_s)
+        self._include_optimizer = include_optimizer
+        self._timeout_s = float(timeout_s)
+        self._client = replication_client(backup_address)
+        # wake flag for the reconcile thread (leaf; tiny critical
+        # sections only, so an in-flight ship never blocks the hook)
+        self._lock = checked_lock("Replicator._lock")
+        self._cv = threading.Condition(self._lock)
+        self._pending = False
+        # serializes one ship end to end (encode + RPC + ack): sync-mode
+        # ships run on barrier-closer threads, the reconcile thread runs
+        # its own — version monotonicity to the sink needs an order
+        self._ship_lock = checked_lock("Replicator._ship_lock")
+        self._last_shipped_version = -1
+        self._transient_failures = 0
+        self._degraded = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._obs_lag = obs_stats.gauge("ps.replica.lag_bytes")
+        self._obs_shipped = obs_stats.counter("ps.replica.shipped_bytes")
+        self._obs_ship_s = obs_stats.histogram("ps.replica.ship_s")
+        self._obs_fallback = obs_stats.counter("ps.replica.fallback")
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    @property
+    def last_shipped_version(self) -> int:
+        return self._last_shipped_version
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self._core.set_replication_hook(self.on_apply)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ps-replicator")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._core.set_replication_hook(None)
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._client.close()
+
+    # ------------------------------------------------------------------ hook
+    def on_apply(self) -> None:
+        """The core's post-apply hook.  MUST NOT raise: the optimizer
+        apply has already landed, so failing the close here would
+        double-apply on its retry.  Sync mode instead retries the ship
+        INLINE (bounded exponential backoff — the barrier stays
+        unpublished while it does, so workers cannot observe an
+        iteration the backup does not hold); if every retry fails,
+        replication degrades permanently — loudly, with
+        ``ps.replica.fallback`` counts — and THIS close (plus all later
+        ones) publishes unreplicated rather than wedging training on a
+        dead backup.  The sync guarantee is therefore exact up to the
+        moment of explicit degradation."""
+        if self._degraded:
+            return
+        if self.mode == "sync":
+            delay = 0.1
+            # caller holds _apply_lock: snapshot via the in-close path
+            snapshot = self._core.replica_snapshot(in_close=True)
+            while not self._degraded:
+                try:
+                    self._ship(snapshot)
+                    return
+                except Exception:  # noqa: BLE001 — retried, then degraded
+                    log.exception("sync replication ship failed; retrying")
+                    self._note_transient_failure()
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+            return
+        with self._cv:
+            self._pending = True
+            self._cv.notify_all()
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Ship the current state if the backup is behind; True when the
+        backup holds the primary's current version on return."""
+        if self._degraded:
+            return False
+        try:
+            self._ship(self._core.replica_snapshot())
+        except Exception:  # noqa: BLE001 — reported via return value
+            log.exception("replication flush failed")
+            self._note_transient_failure()
+            return False
+        return not self._degraded
+
+    # ------------------------------------------------------------- internals
+    def _note_transient_failure(self) -> None:
+        self._transient_failures += 1
+        self._obs_fallback.add()
+        if self._transient_failures >= _MAX_TRANSIENT_FAILURES:
+            log.warning(
+                "replication to %s degraded permanently after %d "
+                "consecutive failures — training continues UNREPLICATED",
+                self.backup_address, self._transient_failures)
+            self._degraded = True
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cv:
+                if not self._pending:
+                    self._cv.wait(self._poll_s)
+                self._pending = False
+            if self._stop.is_set() or self._degraded:
+                if self._degraded:
+                    return
+                continue
+            if self._core.params_version == self._last_shipped_version:
+                continue
+            try:
+                self._ship(self._core.replica_snapshot())
+            except Exception:  # noqa: BLE001 — retried next wake
+                log.exception("replication ship failed; will retry")
+                self._note_transient_failure()
+
+    def _ship(self, snapshot) -> None:
+        epoch, iteration, version, params, opt_state = snapshot
+        with self._ship_lock:
+            if version <= self._last_shipped_version or self._degraded:
+                return  # coalesced: a newer ship already covered this
+            store = dict(params)
+            if self._include_optimizer and opt_state:
+                store.update(flatten_optimizer_state(opt_state))
+            nbytes = store_nbytes(store)
+            self._obs_lag.set(nbytes)
+            t0 = time.perf_counter()
+            try:
+                ack = self._client.call(
+                    "PushReplicaDelta",
+                    delta_chunks(epoch, iteration, version,
+                                 rmsg.DELTA_STATE, store,
+                                 stripes=getattr(self._core, "stripes", 1)),
+                    timeout=self._timeout_s)
+            except grpc.RpcError as exc:
+                code = getattr(exc, "code", None)
+                if callable(code) and code() == grpc.StatusCode.UNIMPLEMENTED:
+                    # reference PS as backup: no replication, ever
+                    log.warning("backup %s does not implement replication; "
+                                "degrading permanently", self.backup_address)
+                    self._obs_fallback.add()
+                    self._degraded = True
+                    return
+                raise
+            if not ack.success:
+                # the sink refused (e.g. the replica was promoted and has
+                # advanced past us — we are the zombie): stop shipping
+                log.warning("backup %s refused delta: %s — degrading "
+                            "permanently", self.backup_address, ack.message)
+                self._obs_fallback.add()
+                self._degraded = True
+                return
+            self._obs_ship_s.observe(time.perf_counter() - t0)
+            self._obs_shipped.add(nbytes)
+            self._obs_lag.set(0)
+            self._last_shipped_version = version
+            self._transient_failures = 0
+
+
+class ReplicaSink:
+    """Backup-side installer for ``PushReplicaDelta`` streams.  One per
+    PS service; tracks the primary's high-water mark so ``ReplicaStatus``
+    and a promotion decision can read it."""
+
+    def __init__(self, core):
+        self._core = core
+        # held across core.install_tensors (ranks 20..40 — sink rank 16
+        # comes first): serializes delta installs against each other so
+        # two racing ships can never interleave their version bookkeeping
+        self._lock = checked_lock("ReplicaSink._lock")
+        self.primary_version = -1
+        self.primary_iteration = -1
+        self._installed_any = False
+        self._obs_installed = obs_stats.counter("ps.replica.installed_bytes")
+
+    def push_delta(self, chunks) -> rmsg.ReplicaAck:
+        header = None
+        wire_tensors: list = []
+        for chunk in chunks:
+            if header is None:
+                header = (int(chunk.epoch), int(chunk.iteration),
+                          int(chunk.params_version), int(chunk.kind))
+            wire_tensors.extend(chunk.tensors)
+        if header is None:
+            return rmsg.ReplicaAck(success=False,
+                                   message="empty delta stream")
+        epoch, iteration, version, kind = header
+        store = from_wire(wire_tensors)
+        params, opt_state = split_replica_store(store)
+        with self._lock:
+            if kind == rmsg.DELTA_STATE:
+                if self._installed_any and version <= self.primary_version:
+                    # an out-of-order/duplicate ship: the newer state is
+                    # already installed — idempotent success
+                    return rmsg.ReplicaAck(
+                        success=True, message="stale delta ignored",
+                        params_version=self.primary_version,
+                        iteration=self.primary_iteration)
+                if (self._installed_any
+                        and self._core.current_iteration
+                        > self.primary_iteration):
+                    # this replica has aggregated past the replication
+                    # mark on its own — it was PROMOTED; the sender is a
+                    # zombie ex-primary whose state would rewind live
+                    # training
+                    return rmsg.ReplicaAck(
+                        success=False,
+                        message="replica promoted (local aggregation "
+                                "advanced past the replication mark); "
+                                "delta refused",
+                        params_version=self.primary_version,
+                        iteration=self._core.current_iteration)
+            self._core.install_tensors(
+                params, epoch=epoch, iteration=iteration,
+                optimizer_state=opt_state,
+                # a reshard stripe handoff MERGES its slot entries into
+                # this shard's optimizer state; a replication state ship
+                # replaces it wholesale (bit-identical replica)
+                optimizer_merge=(kind == rmsg.DELTA_INSTALL),
+                mark_aggregated=True,
+                replace=(kind == rmsg.DELTA_STATE))
+            if kind == rmsg.DELTA_STATE:
+                self.primary_version = version
+                self.primary_iteration = iteration
+                self._installed_any = True
+        self._obs_installed.add(store_nbytes(params))
+        return rmsg.ReplicaAck(success=True, message="installed",
+                               params_version=version, iteration=iteration)

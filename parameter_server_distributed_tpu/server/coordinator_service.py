@@ -19,6 +19,7 @@ import json
 from ..config import CoordinatorConfig
 from ..core.coordinator_core import CoordinatorCore
 from ..obs.export import ClusterAggregator
+from ..replication import messages as rmsg
 from ..rpc import messages as m
 from ..rpc.service import bind_service, make_server
 
@@ -80,6 +81,32 @@ class CoordinatorService:
         return m.ClusterMetricsResponse(
             rollup_json=json.dumps(self.aggregator.rollup(), default=float))
 
+    # ----------------------------------------------------------- replication
+    # RPCs (framework extension, replication/): the epoch-numbered shard
+    # map.  Messages live OUTSIDE rpc/messages.py (wire manifest pinned);
+    # reference clients never call these methods.
+
+    @staticmethod
+    def _map_response(epoch, entries) -> rmsg.ShardMapResponse:
+        return rmsg.ShardMapResponse(
+            epoch=epoch,
+            entries=[rmsg.WireShardMapEntry(primary=e.primary,
+                                            backup=e.backup, epoch=e.epoch)
+                     for e in entries])
+
+    def GetShardMap(self, request: rmsg.ShardMapRequest,
+                    context) -> rmsg.ShardMapResponse:
+        return self._map_response(*self.core.get_shard_map())
+
+    def ReportShardFailure(self, request: rmsg.ShardFailureReport,
+                           context) -> rmsg.ShardMapResponse:
+        log.warning("worker %d reports shard %d (%s) dead",
+                    request.worker_id, request.shard_index,
+                    request.observed_primary)
+        epoch, entries = self.core.promote_shard(request.shard_index,
+                                                 request.observed_primary)
+        return self._map_response(epoch, entries)
+
 
 class Coordinator:
     """Process-level assembly (reference: run_coordinator_server at
@@ -88,7 +115,8 @@ class Coordinator:
     def __init__(self, config: CoordinatorConfig):
         self.config = config
         self.core = CoordinatorCore(config.ps_address, config.ps_port,
-                                    ps_shards=config.ps_shards)
+                                    ps_shards=config.ps_shards,
+                                    ps_backups=config.ps_backups)
         self.service = CoordinatorService(self.core)
         self._server: grpc.Server | None = None
         self._stop = threading.Event()
@@ -97,7 +125,8 @@ class Coordinator:
     def start(self) -> int:
         self._server = make_server()
         bind_service(self._server, m.COORDINATOR_SERVICE,
-                     {**m.COORDINATOR_METHODS, **m.COORDINATOR_EXT_METHODS},
+                     {**m.COORDINATOR_METHODS, **m.COORDINATOR_EXT_METHODS,
+                      **rmsg.REPLICATION_COORD_METHODS},
                      self.service)
         addr = f"{self.config.bind_address}:{self.config.port}"
         self._port = self._server.add_insecure_port(addr)

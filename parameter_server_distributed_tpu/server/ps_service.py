@@ -43,6 +43,9 @@ from ..core.ps_core import ParameterServerCore, PushSink
 from ..core.tensor import from_wire, to_wire
 from ..obs import stats as obs_stats
 from ..obs import trace as obs_trace
+from ..replication import messages as rmsg
+from ..replication.replicator import (ReplicaSink, Replicator,
+                                      flatten_optimizer_state, state_chunks)
 from ..rpc import messages as m
 from ..rpc import shm_transport
 from ..rpc.data_plane import (PreEncodedParameterUpdate,
@@ -151,6 +154,12 @@ class ParameterServerService:
         self._serve_cache = EncodedServeCache()
         self._obs_cache_hit = obs_stats.counter("ps.serve.cache_hit")
         self._obs_cache_miss = obs_stats.counter("ps.serve.cache_miss")
+        # replication sink (replication/replicator.py): installs
+        # primary->backup delta streams and tracks the replication
+        # high-water mark.  Always present — ANY PS can serve as a
+        # backup or a reshard target; the extension methods cost nothing
+        # until a peer calls them.
+        self.replica_sink = ReplicaSink(core)
 
     def _apply(self, worker_id: int, iteration: int, grads):
         """Decoded-gradients -> core aggregation, timed and traced (the
@@ -358,25 +367,37 @@ class ParameterServerService:
     # the fresh parameters back the instant the barrier closes — no
     # CheckSyncStatus polling, no second round.
     def PushPullStream(self, request_iterator, context):
-        if not self.core.has_parameters:
-            # A fused push must never be the store's FIRST payload: the
-            # bootstrap rule (first aggregated payload BECOMES the params
-            # — reference src/parameter_server.cpp:78-81) is reserved for
-            # the worker's deliberate init seed, which always rides the
-            # plain push path.  A fused push of real gradients can only
-            # reach an empty store when the PS restarted under a worker
-            # holding cached params — refusing makes the worker re-pull,
-            # notice the emptiness, and re-seed instead of silently
-            # turning its gradients into parameters.
-            yield m.PushPullResponse(push=m.PushResponse(
-                success=False,
-                message="parameter store empty: fused push refused "
-                        "(re-pull and seed init via the push path)",
-                iteration=self.core.current_iteration))
-            return
+        # A fused push must never be the store's FIRST payload: the
+        # bootstrap rule (first aggregated payload BECOMES the params
+        # — reference src/parameter_server.cpp:78-81) is reserved for
+        # the worker's deliberate init seed, which always rides the
+        # plain push path.  A fused push of real gradients can only
+        # reach an empty store when the PS restarted under a worker
+        # holding cached params — refusing makes the worker re-pull,
+        # notice the emptiness, and re-seed instead of silently
+        # turning its gradients into parameters.  A gradient-FREE fused
+        # push is a different animal: under the sharded topology a shard
+        # owning no tensors of the model (possible after a reshard — or
+        # a small model over many shards) still receives every worker's
+        # empty barrier contribution, and refusing those would wedge the
+        # whole barrier on a store that is legitimately empty forever.
+        # ... and a store emptied by a reshard RETIRE (tombstones
+        # present) must answer the stale-shard-map rejection — which the
+        # normal fold/commit path produces — not the restart refusal, or
+        # the pushing worker takes the re-seed recovery path instead of
+        # repartitioning.
+        empty_store = (not self.core.has_parameters
+                       and not self.core.has_retired)
         sink: PushSink | None = None
         pull_wire_dtype = 0
         for chunk in request_iterator:
+            if empty_store and chunk.gradients:
+                yield m.PushPullResponse(push=m.PushResponse(
+                    success=False,
+                    message="parameter store empty: fused push refused "
+                            "(re-pull and seed init via the push path)",
+                    iteration=self.core.current_iteration))
+                return
             if sink is None:
                 sink = self.core.begin_push(chunk.worker_id, chunk.iteration)
                 pull_wire_dtype = chunk.pull_wire_dtype
@@ -424,6 +445,59 @@ class ParameterServerService:
     def NegotiateShm(self, request: shm_transport.ShmNegotiateRequest,
                      context) -> shm_transport.ShmNegotiateResponse:
         return self.shm_server.negotiate(request)
+
+    # ----------------------------------------------------------- replication
+    # RPCs (framework extension, replication/): the messages and method
+    # names live OUTSIDE rpc/messages.py so the reference wire manifest is
+    # untouched; a reference peer answers UNIMPLEMENTED and callers
+    # downgrade permanently (replication/replicator.py, failover.py).
+
+    # RPC: primary -> backup post-apply state ship / reshard stripe install
+    def PushReplicaDelta(self, request_iterator, context) -> rmsg.ReplicaAck:
+        return self.replica_sink.push_delta(request_iterator)
+
+    # RPC: stream a consistent snapshot (full or name-filtered) — a late-
+    # joining backup's initial sync, and a debugging/verification surface.
+    # Optimizer slot state rides along __opt__/-prefixed (filtered to the
+    # requested names' entries), so a backup seeded this way and promoted
+    # before the first ship still optimizes from warm slots.
+    def FetchReplicaState(self, request: rmsg.ReplicaStateRequest, context):
+        epoch, iteration, version, params, opt = self.core.replica_snapshot()
+        names = set(request.names)
+        if names:
+            params = {n: params[n] for n in names if n in params}
+            opt = {slot: ({n: a for n, a in value.items() if n in names}
+                          if isinstance(value, dict) else value)
+                   for slot, value in opt.items()}
+        payload = dict(params)
+        if opt:
+            payload.update(flatten_optimizer_state(opt))
+        yield from state_chunks(epoch, iteration, version, payload)
+
+    # RPC: the resharding version fence — atomically remove + tombstone
+    # the moving tensors and stream their last-applied values (and their
+    # optimizer slot entries, __opt__/-prefixed) back
+    def RetireTensors(self, request: rmsg.RetireTensorsRequest, context):
+        epoch, iteration, version, moved, moved_opt = \
+            self.core.retire_tensors(list(request.names), request.map_epoch)
+        log.info("retired %d tensors at map epoch %d (reshard handoff)",
+                 len(moved), request.map_epoch)
+        payload = dict(moved)
+        if moved_opt:
+            payload.update(flatten_optimizer_state(moved_opt))
+        yield from state_chunks(epoch, iteration, version, payload)
+
+    # RPC: replication high-water mark + tensor-name census (the reshard
+    # controller's ownership listing — names only, no values)
+    def ReplicaStatus(self, request: rmsg.ReplicaStatusRequest,
+                      context) -> rmsg.ReplicaStatusResponse:
+        return rmsg.ReplicaStatusResponse(
+            iteration=self.core.current_iteration,
+            params_version=self.core.params_version,
+            primary_version=self.replica_sink.primary_version,
+            primary_iteration=self.replica_sink.primary_iteration,
+            names=sorted(self.core.get_parameters()),
+            epoch=self.core.epoch)
 
     # RPC: barrier poll (reference: src/parameter_server_service.cpp:85-95)
     def CheckSyncStatus(self, request: m.SyncStatusRequest, context) -> m.SyncStatusResponse:
@@ -508,6 +582,16 @@ class ParameterServer:
             keep=config.checkpoint_keep,
         )
         self.service = ParameterServerService(self.core, self.ckpt)
+        # primary/backup replication (replication/replicator.py): ship
+        # the post-apply state to config.backup_address after every
+        # barrier close.  PSDT_REPLICATION picks the mode (async |
+        # sync | off); constructed here, started with the server.
+        self.replicator: Replicator | None = None
+        mode = (config.replication
+                or os.environ.get("PSDT_REPLICATION", "async")).lower()
+        if config.backup_address and mode not in ("off", "0", "false"):
+            self.replicator = Replicator(self.core, config.backup_address,
+                                         mode=mode)
         self._server: grpc.Server | None = None
 
     @property
@@ -528,13 +612,18 @@ class ParameterServer:
         bind_service(self._server, m.PARAMETER_SERVER_SERVICE,
                      {**m.PARAMETER_SERVER_METHODS,
                       **m.PARAMETER_SERVER_STREAM_METHODS,
-                      **shm_transport.SHM_METHODS}, self.service)
+                      **shm_transport.SHM_METHODS,
+                      **rmsg.REPLICATION_PS_METHODS}, self.service)
         addr = f"{self.config.bind_address}:{self.config.port}"
         self._port = self._server.add_insecure_port(addr)
         if self._port == 0:
             raise RuntimeError(f"could not bind {addr}")
         self._server.start()
         self.ckpt.start()
+        if self.replicator is not None:
+            self.replicator.start()
+            log.info("replicating to backup %s (%s mode)",
+                     self.replicator.backup_address, self.replicator.mode)
         log.info("parameter server listening on %s (total_workers=%d, "
                  "checkpoint_interval=%d)", addr, self.config.total_workers,
                  self.config.checkpoint_interval)
@@ -545,6 +634,8 @@ class ParameterServer:
         self._server.wait_for_termination()
 
     def stop(self, grace: float = 1.0) -> None:
+        if self.replicator is not None:
+            self.replicator.stop()
         self.ckpt.stop()
         # tear down shm connections first: their serving threads may be
         # parked on the barrier CV or a ring doorbell, and closing the

@@ -26,6 +26,7 @@ proto has no compression at all — reference proto/parameter_server.proto:19-24
 from __future__ import annotations
 
 import socket
+import struct
 import threading
 import time
 from queue import Queue
@@ -59,6 +60,13 @@ class ThrottledRelay:
         self._byte_lock = threading.Lock()
         self.bytes_to_target = 0     # client -> backend (requests)
         self.bytes_from_target = 0   # backend -> client (responses)
+        # chaos state (replication failover tests): live relayed sockets,
+        # so drop_connections() can hard-close them all, and the refusal
+        # latch that makes subsequent connects die too — a process
+        # kill/partition without an OS-level kill in-tree
+        self._conn_lock = threading.Lock()
+        self._conns: list[socket.socket] = []
+        self._refuse = False
 
     def byte_counts(self) -> tuple[int, int]:
         """(bytes_to_target, bytes_from_target) so far."""
@@ -90,6 +98,48 @@ class ThrottledRelay:
                 self._listener.close()
             except OSError:
                 pass
+        self.drop_connections(refuse_new=True)
+
+    # --------------------------------------------------------------- chaos
+    def drop_connections(self, refuse_new: bool = True) -> int:
+        """Process-kill/partition chaos: hard-close every relayed
+        connection (both endpoints observe an abrupt stream death, like a
+        ``kill -9`` of the backend) and, with ``refuse_new`` (default),
+        make later connects die immediately too — the shard stays "dead"
+        until :meth:`restore_connections`.  Returns how many sockets were
+        severed.  The failover tests use this to sever one PS shard
+        without an OS-level kill in-tree."""
+        with self._conn_lock:
+            self._refuse = refuse_new
+            conns, self._conns = self._conns, []
+        for sock in conns:
+            try:
+                # RST, not FIN: linger-0 abort so the peer's in-flight
+                # RPC fails NOW instead of waiting out a half-open drain
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                struct.pack("ii", 1, 0))
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return len(conns)
+
+    def restore_connections(self) -> None:
+        """Lift the refusal latch set by :meth:`drop_connections`: NEW
+        connections relay normally again (severed ones stay dead)."""
+        with self._conn_lock:
+            self._refuse = False
+
+    def _register_conn(self, *socks: socket.socket) -> bool:
+        """Track sockets for the chaos teardown; False when the relay is
+        currently refusing (the caller must close them)."""
+        with self._conn_lock:
+            if self._refuse:
+                return False
+            self._conns.extend(socks)
+            return True
 
     # ------------------------------------------------------------- internals
     def _accept_loop(self) -> None:
@@ -98,10 +148,30 @@ class ThrottledRelay:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            with self._conn_lock:
+                refusing = self._refuse
+            if refusing:
+                # "dead host": accept then abort, so the client observes
+                # an immediate connection failure, not a hang
+                try:
+                    conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                    struct.pack("ii", 1, 0))
+                except OSError:
+                    pass
+                conn.close()
+                continue
             try:
                 upstream = socket.create_connection(self.target)
             except OSError:
                 conn.close()
+                continue
+            if not self._register_conn(conn, upstream):
+                # drop_connections raced the accept: sever both ends
+                for sock in (conn, upstream):
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
                 continue
             for src, dst, attr in ((conn, upstream, "bytes_to_target"),
                                    (upstream, conn, "bytes_from_target")):

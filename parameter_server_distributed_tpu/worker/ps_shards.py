@@ -19,17 +19,41 @@ pushes to EVERY shard each iteration (a shard owning no tensors of the
 current push still receives an empty gradient list), so each shard's
 barrier sees the same contributor set and iteration numbering as the
 unsharded topology.
+
+Replication extensions (ISSUE 7, replication/):
+
+- **hot failover** — built with a :class:`~..replication.failover
+  .ShardMapClient`, a shard RPC that dies with a transport error (never
+  UNIMPLEMENTED — that is the reference-peer downgrade) reports the dead
+  primary to the coordinator, which promotes the shard's backup; the
+  SAME iteration retries against the replica.  The dead address is never
+  revisited (permanent downgrade, PR-2 discipline lifted to addresses),
+  and the replica's aggregated watermark makes the retry idempotent.
+- **live resharding** — a push rejected with the ``stale shard map``
+  marker means a reshard moved tensors this client still routes by the
+  old partition: the client waits for the coordinator's map epoch to
+  advance, rebuilds its shard connections, repartitions, and replays the
+  round (per-(worker, tensor) dedup on unchanged shards absorbs the
+  replay) — zero failed steps across a 2→4 split under load.
 """
 
 from __future__ import annotations
 
+import logging
+import threading
 import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
+import grpc
+
 from ..obs import trace as obs_trace
+from ..replication.failover import ShardMapClient, _status_code
+from ..replication.messages import STALE_SHARD_MAP
 from ..rpc import messages as m
 from ..rpc.data_plane import PSClient
+
+log = logging.getLogger("pst.shards")
 
 
 def shard_owner(name: str, n_shards: int) -> int:
@@ -38,19 +62,44 @@ def shard_owner(name: str, n_shards: int) -> int:
     return zlib.crc32(name.encode("utf-8")) % n_shards
 
 
+def _is_stale_map(response) -> bool:
+    message = getattr(response, "message", "") or ""
+    return (getattr(response, "success", True) is False
+            and STALE_SHARD_MAP in message)
+
+
 class ShardedPSClient:
     """Fan-out/merge client over N parameter-server shards.  Each shard
     connection is a :class:`rpc.data_plane.PSClient`, so pushes and pulls
     ride the chunk-stream data plane per shard (with per-connection unary
-    fallback against reference servers)."""
+    fallback against reference servers).  ``shard_map`` (optional) turns
+    on hot failover and live-reshard repartitioning — see the module
+    docstring."""
+
+    # bounded replays: one reshard repartition or failover retry per
+    # round is the common case; two covers a promotion racing a reshard
+    _MAX_ROUND_REPLAYS = 3
 
     def __init__(self, addresses: Sequence[str],
                  service: str = m.PARAMETER_SERVER_SERVICE,
-                 methods=None):
+                 methods=None,
+                 shard_map: ShardMapClient | None = None):
         if not addresses:
             raise ValueError("need at least one PS shard address")
+        self._service = service
+        self._methods = methods
+        self._shard_map = shard_map
+        # guards the address/client/pool triple during a failover swap or
+        # a reshard rebuild (fan-out threads read them concurrently)
+        self._topology_lock = threading.Lock()
+        self.addresses: list[str] = []
+        self._clients: list[PSClient] = []
+        self._pool: ThreadPoolExecutor | None = None
+        self._build(list(addresses))
+
+    def _build(self, addresses: list[str]) -> None:
         self.addresses = list(addresses)
-        self._clients = [PSClient(addr, service, methods)
+        self._clients = [PSClient(addr, self._service, self._methods)
                          for addr in addresses]
         # shard RPCs are independent — issue them concurrently so the
         # fan-out latency is max(shard latencies), not their sum
@@ -58,6 +107,18 @@ class ShardedPSClient:
             max_workers=len(self._clients),
             thread_name_prefix="ps-shard") if len(self._clients) > 1
             else None)
+
+    def _rebuild(self, addresses: list[str]) -> None:
+        """Replace the whole shard topology (reshard repartition)."""
+        with self._topology_lock:
+            old_clients, old_pool = self._clients, self._pool
+            self._build(addresses)
+        for client in old_clients:
+            client.close()
+        if old_pool is not None:
+            old_pool.shutdown(wait=False)
+        log.info("shard topology rebuilt: %d shards %s",
+                 len(addresses), addresses)
 
     @property
     def num_shards(self) -> int:
@@ -75,15 +136,92 @@ class ShardedPSClient:
             client.close()
         if self._pool is not None:
             self._pool.shutdown(wait=False)
+        if self._shard_map is not None:
+            self._shard_map.close()
+
+    # -------------------------------------------------- failover / resharding
+    def _with_failover(self, index: int, fn):
+        """Run ``fn(client)`` against shard ``index``; on a transport
+        error (anything but UNIMPLEMENTED, which the PSClient fallback
+        machinery owns), report the dead primary, let the coordinator
+        promote its backup, swap the connection to the replica, and
+        retry the SAME call once.  The dead address is never revisited."""
+        with self._topology_lock:
+            client = self._clients[index]
+            address = self.addresses[index]
+        try:
+            return fn(client)
+        except grpc.RpcError as exc:
+            if (self._shard_map is None
+                    or _status_code(exc) == grpc.StatusCode.UNIMPLEMENTED):
+                raise
+            log.warning("shard %d (%s) failed mid-call (%s); requesting "
+                        "backup promotion", index, address,
+                        _status_code(exc))
+            replacement = self._shard_map.report_failure(index, address)
+            if not replacement or replacement == address:
+                raise  # no backup to promote: surface the real error
+            with self._topology_lock:
+                if self.addresses[index] == address:
+                    self._clients[index].close()
+                    self._clients[index] = PSClient(
+                        replacement, self._service, self._methods)
+                    self.addresses[index] = replacement
+                client = self._clients[index]
+            log.warning("shard %d failed over %s -> %s; retrying the "
+                        "same round against the replica", index, address,
+                        replacement)
+            return fn(client)
+
+    def refresh_topology(self, wait_for_epoch_above: int | None = None,
+                         timeout: float = 15.0) -> bool:
+        """Re-fetch the shard map (optionally waiting for its epoch to
+        pass ``wait_for_epoch_above`` — the reshard-publication park) and
+        rebuild the connections if the primaries changed.  True when the
+        topology actually changed."""
+        if self._shard_map is None:
+            return False
+        if wait_for_epoch_above is not None:
+            self._shard_map.wait_for_epoch_above(wait_for_epoch_above,
+                                                 timeout=timeout)
+        elif not self._shard_map.refresh():
+            return False
+        new = self._shard_map.primaries()
+        if new and new != self.addresses:
+            self._rebuild(new)
+            return True
+        return False
+
+    def _repartition_after_stale_map(self) -> bool:
+        """A shard rejected a push with the stale-shard-map marker: park
+        until the coordinator publishes the newer map, rebuild, and tell
+        the caller whether a replay is worth it."""
+        if self._shard_map is None:
+            return False
+        known = self._shard_map.epoch
+        changed = self.refresh_topology(wait_for_epoch_above=known)
+        if changed:
+            return True
+        # epoch advanced without an address change (e.g. promotion won a
+        # race) — still worth one replay
+        return self._shard_map.epoch > known
 
     # ------------------------------------------------------------------ call
     def call(self, method: str, request, timeout: float | None = None):
-        if self.num_shards == 1:
-            return self._clients[0].call(method, request, timeout=timeout)
-        handler = getattr(self, f"_call_{method}", None)
-        if handler is None:
-            raise ValueError(f"unsupported sharded method {method!r}")
-        return handler(request, timeout)
+        for _ in range(self._MAX_ROUND_REPLAYS):
+            if self.num_shards == 1:
+                resp = self._with_failover(
+                    0, lambda c: c.call(method, request, timeout=timeout))
+            else:
+                handler = getattr(self, f"_call_{method}", None)
+                if handler is None:
+                    raise ValueError(f"unsupported sharded method {method!r}")
+                resp = handler(request, timeout)
+            if not _is_stale_map(resp):
+                return resp
+            if not self._repartition_after_stale_map():
+                return resp
+        return resp
 
     def _submit(self, fn, *fn_args, **fn_kwargs):
         """Pool submit that carries the calling thread's span context into
@@ -98,9 +236,11 @@ class ShardedPSClient:
         return self._pool.submit(run)
 
     def _fan_out(self, method: str, requests, timeout):
-        futures = [self._submit(client.call, method, request,
-                                timeout=timeout)
-                   for client, request in zip(self._clients, requests)]
+        futures = [
+            self._submit(self._with_failover, i,
+                         lambda c, req=request: c.call(method, req,
+                                                       timeout=timeout))
+            for i, request in enumerate(requests)]
         return [f.result() for f in futures]
 
     # ------------------------------------------------------------- push path
@@ -108,9 +248,17 @@ class ShardedPSClient:
                        timeout: float | None = None) -> m.PushResponse:
         """Streaming-data-plane push (chunk streams per shard, concurrent
         fan-out).  Same merge/stale-retry semantics as the unary path."""
-        if self.num_shards == 1:
-            return self._clients[0].push_gradients(update, timeout=timeout)
-        return self._push_sharded(update, timeout, stream=True)
+        for _ in range(self._MAX_ROUND_REPLAYS):
+            if self.num_shards == 1:
+                resp = self._with_failover(
+                    0, lambda c: c.push_gradients(update, timeout=timeout))
+            else:
+                resp = self._push_sharded(update, timeout, stream=True)
+            if not _is_stale_map(resp):
+                return resp
+            if not self._repartition_after_stale_map():
+                return resp
+        return resp
 
     def _call_ReceiveGradients(self, request: m.GradientUpdate, timeout):
         return self._push_sharded(request, timeout, stream=False)
@@ -136,6 +284,13 @@ class ShardedPSClient:
             workers_received=min(r.workers_received for r in responses),
             total_workers=max(r.total_workers for r in responses))
 
+    @staticmethod
+    def _bounded_stale(response) -> bool:
+        """A bounded-staleness (async-mode) rejection — NOT the reshard
+        stale-shard-map marker, which the round-replay loop owns."""
+        return (not response.success and "stale" in response.message
+                and STALE_SHARD_MAP not in response.message)
+
     def _push_sharded(self, request: m.GradientUpdate, timeout,
                       stream: bool) -> m.PushResponse:
         def push(client, update):
@@ -148,8 +303,9 @@ class ShardedPSClient:
                                     iteration=request.iteration,
                                     gradients=tensors)
                    for tensors in per_shard]
-        futures = [self._submit(push, client, update)
-                   for client, update in zip(self._clients, updates)]
+        futures = [self._submit(self._with_failover, i,
+                                lambda c, u=update: push(c, u))
+                   for i, update in enumerate(updates)]
         responses = [f.result() for f in futures]
         # Async (bounded-staleness) partial failure: shards that accepted
         # applied the update ON ARRIVAL, so a blanket worker-level retry
@@ -161,15 +317,15 @@ class ShardedPSClient:
         # and its re-pushes overwrite idempotently.)
         for _ in range(3):
             stale = [i for i, r in enumerate(responses)
-                     if not r.success and "stale" in r.message]
+                     if self._bounded_stale(r)]
             if not stale:
                 break
             for i in stale:
-                responses[i] = push(
-                    self._clients[i],
-                    m.GradientUpdate(worker_id=request.worker_id,
-                                     iteration=responses[i].iteration,
-                                     gradients=per_shard[i]))
+                responses[i] = self._with_failover(
+                    i, lambda c, i=i: push(c, m.GradientUpdate(
+                        worker_id=request.worker_id,
+                        iteration=responses[i].iteration,
+                        gradients=per_shard[i])))
         return self._merge_pushes(responses)
 
     # ------------------------------------------------------------ fused path
@@ -184,33 +340,65 @@ class ShardedPSClient:
         topology; stale rejections re-push only the rejected shards with
         the same payload (the `_push_sharded` semantics).  The merged
         parameter update is ``None`` — caller falls back to barrier-poll +
-        pull — unless EVERY shard delivered fresh parameters."""
-        if self.num_shards == 1:
+        pull — unless EVERY shard delivered fresh parameters.
+
+        With a shard map, a stale-shard-map rejection (live reshard)
+        parks for the new epoch, rebuilds the topology, and replays the
+        WHOLE round against the new partition; a dead shard fails over to
+        its promoted backup and replays that shard's round.  Both replays
+        are idempotent (server-side per-(worker, tensor) dedup + the
+        replica's aggregated watermark), so the worker observes a normal
+        — if slower — round: zero failed steps."""
+        if self._shard_map is None and self.num_shards == 1:
+            # exact pre-replication behavior, lazy producer included
             return self._clients[0].push_pull(
                 worker_id, iteration, tensors,
                 pull_wire_dtype=pull_wire_dtype, timeout=timeout,
                 on_chunk=on_chunk)
-        # name-partitioning needs the full tensor list up front, so the
-        # sharded topology materializes the (possibly lazy) producer; the
+        # replays (failover, repartition) must re-read the tensors, so
+        # materialize the (possibly lazy) producer once up front; the
         # per-bucket D2H overlap is a single-PS refinement
-        per_shard = self._partition(tensors)
+        all_tensors = list(tensors() if callable(tensors) else tensors)
+        for _ in range(self._MAX_ROUND_REPLAYS):
+            result = self._push_pull_once(worker_id, iteration, all_tensors,
+                                          pull_wire_dtype, timeout, on_chunk)
+            if not _is_stale_map(result[0]):
+                return result
+            log.warning("worker %d: push rejected stale-shard-map at "
+                        "iteration %d; refreshing topology", worker_id,
+                        iteration)
+            if not self._repartition_after_stale_map():
+                return result
+        return result
+
+    def _push_pull_once(self, worker_id: int, iteration: int, all_tensors,
+                        pull_wire_dtype, timeout, on_chunk):
+        if self.num_shards == 1:
+            return self._with_failover(0, lambda c: c.push_pull(
+                worker_id, iteration, all_tensors,
+                pull_wire_dtype=pull_wire_dtype, timeout=timeout,
+                on_chunk=on_chunk))
+        per_shard = self._partition(all_tensors)
 
         def fused(client, shard_tensors, it):
             return client.push_pull(worker_id, it, shard_tensors,
                                     pull_wire_dtype=pull_wire_dtype,
                                     timeout=timeout, on_chunk=on_chunk)
 
-        futures = [self._submit(fused, client, shard_tensors, iteration)
-                   for client, shard_tensors in zip(self._clients, per_shard)]
+        futures = [
+            self._submit(self._with_failover, i,
+                         lambda c, t=shard_tensors: fused(c, t, iteration))
+            for i, shard_tensors in enumerate(per_shard)]
         results = [f.result() for f in futures]
         for _ in range(3):
             stale = [i for i, (push, _) in enumerate(results)
-                     if not push.success and "stale" in push.message]
+                     if self._bounded_stale(push)]
             if not stale:
                 break
             for i in stale:
-                results[i] = fused(self._clients[i], per_shard[i],
-                                   results[i][0].iteration)
+                results[i] = self._with_failover(
+                    i, lambda c, i=i: fused(c, per_shard[i],
+                                            results[i][0].iteration))
         merged_push = self._merge_pushes([push for push, _ in results])
         stores = [params for _, params in results]
         if not merged_push.success or any(s is None for s in stores):
@@ -228,11 +416,14 @@ class ShardedPSClient:
         worker's per-tensor dict insert is (tensor names are disjoint
         across shards)."""
         if self.num_shards == 1:
-            return self._clients[0].pull_parameters(request, timeout=timeout,
-                                                    on_chunk=on_chunk)
-        futures = [self._submit(client.pull_parameters, request,
-                                timeout=timeout, on_chunk=on_chunk)
-                   for client in self._clients]
+            return self._with_failover(0, lambda c: c.pull_parameters(
+                request, timeout=timeout, on_chunk=on_chunk))
+        futures = [
+            self._submit(self._with_failover, i,
+                         lambda c: c.pull_parameters(request,
+                                                     timeout=timeout,
+                                                     on_chunk=on_chunk))
+            for i in range(self.num_shards)]
         return self._merge_pulls([f.result() for f in futures])
 
     def _call_ServeParameters(self, request: m.PullRequest, timeout):

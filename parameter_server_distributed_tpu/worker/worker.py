@@ -62,6 +62,14 @@ class WorkerError(RuntimeError):
     pass
 
 
+def _is_stale_shard_map(push) -> bool:
+    """A live-reshard rejection that escaped the sharded client's own
+    repartition replay (replication/messages.py STALE_SHARD_MAP) — NOT
+    the bounded-staleness 'stale push' rejection of async mode."""
+    from ..replication.messages import STALE_SHARD_MAP
+    return STALE_SHARD_MAP in (push.message or "")
+
+
 def error_feedback_enabled() -> bool:
     """PSDT_ERROR_FEEDBACK gates the lossy-push error-feedback residual
     (default ON: lossy wire dtypes without it accumulate quantization
@@ -169,16 +177,38 @@ class Worker:
         self._ps_address = f"{resp.address}:{resp.port}"
         if self._ps is not None:
             self._ps.close()
-        if len(resp.shards) > 1:
+        # Replication extension (replication/failover.py): fetch the
+        # epoch-numbered shard map.  A reference coordinator answers
+        # UNIMPLEMENTED (shard_map.supported stays False) and the worker
+        # keeps the static discovery topology — no failover, exactly the
+        # pre-replication behavior.
+        from ..replication.failover import ShardMapClient
+        shard_map = ShardMapClient(self.config.coordinator_address,
+                                   worker_id=self.config.worker_id)
+        has_map = shard_map.refresh()
+        primaries = shard_map.primaries() if has_map else []
+        if has_map and primaries and (len(primaries) > 1
+                                      or shard_map.has_backups()):
+            # dynamic topology: the sharded client follows promotions and
+            # reshards via the map (even at one shard, for hot failover)
+            from .ps_shards import ShardedPSClient
+            self._ps = ShardedPSClient(primaries, shard_map=shard_map)
+            log.info("worker %d: %d PS shard(s) at %s (map epoch %d, "
+                     "failover %s)", self.config.worker_id, len(primaries),
+                     primaries, shard_map.epoch,
+                     "armed" if shard_map.has_backups() else "unarmed")
+        elif len(resp.shards) > 1:
             # sharded store (extension field 3): fan pushes/pulls out per
             # tensor owner across all PS shards (worker/ps_shards.py)
             from .ps_shards import ShardedPSClient
+            shard_map.close()
             self._ps = ShardedPSClient(list(resp.shards))
             log.info("worker %d: %d PS shards at %s", self.config.worker_id,
                      len(resp.shards), list(resp.shards))
         else:
             # PSClient: chunk-stream data plane with automatic unary
             # fallback against a reference PS (rpc/data_plane.py)
+            shard_map.close()
             self._ps = PSClient(self._ps_address)
             log.info("worker %d: PS at %s", self.config.worker_id,
                      self._ps_address)
@@ -513,6 +543,24 @@ class Worker:
             except RuntimeError:  # pool shut down mid-run
                 self._prefetched = None
 
+    def _refresh_topology_on_partial(self) -> bool:
+        """A partial pull may mean a live reshard moved tensors to shards
+        this client does not know yet (not a shard restart): refresh the
+        shard map if the client has one.  True when a map-backed re-pull
+        is worth attempting (the topology may have changed, or the
+        publish is moments away); False = no dynamic map, go re-seed."""
+        refresh = getattr(self._ps, "refresh_topology", None)
+        if refresh is None:
+            return False
+        try:
+            refresh()
+        except Exception:  # noqa: BLE001 — fall through to the re-seed path
+            log.warning("worker %d: topology refresh failed",
+                        self.config.worker_id, exc_info=True)
+            return False
+        shard_map = getattr(self._ps, "_shard_map", None)
+        return shard_map is not None and shard_map.supported
+
     def check_sync_ready(self, iteration: int) -> m.SyncStatusResponse:
         """reference: src/worker.cpp:274-287."""
         return self.query_with_retry(
@@ -587,6 +635,20 @@ class Worker:
                 _, params = self.pull_parameters(iteration)
             missing = (self._expected_param_names() - set(params)
                        if params else set())
+            for _ in range(3 if missing else 0):
+                # the "missing" tensors may have moved in a live reshard
+                # rather than been lost: refresh the shard map and
+                # re-pull (a few times — the handoff publishes the new
+                # map moments after the old owner stops serving) before
+                # concluding a shard restarted empty and re-seeding
+                if not self._refresh_topology_on_partial():
+                    break
+                _, params = self.pull_parameters(iteration)
+                missing = (self._expected_param_names() - set(params)
+                           if params else set())
+                if not missing:
+                    break
+                time.sleep(0.3)
             if not params or missing:
                 return self._seed_bootstrap(iteration, missing)
 
@@ -623,7 +685,20 @@ class Worker:
                     push = self.push_gradients(effective_it, grads)
                 if push.success:
                     break
-                if "stale" in push.message and attempt < 2:
+                if _is_stale_shard_map(push) and attempt < 2:
+                    # a live reshard outran the client's map AND the
+                    # client could not refresh it (coordinator
+                    # unreachable / no map support): re-discover the
+                    # topology from scratch and retry the iteration
+                    log.warning(
+                        "worker %d: shard map stale at iteration %d and "
+                        "refresh failed; re-discovering topology",
+                        self.config.worker_id, effective_it)
+                    self._discover_parameter_server()
+                    _, params = self.pull_parameters(effective_it)
+                    continue
+                if ("stale" in push.message
+                        and not _is_stale_shard_map(push) and attempt < 2):
                     # bounded-staleness rejection (async mode): fast-forward
                     # to the PS's current iteration, re-pull fresh params,
                     # recompute, retry — no reference analogue (its protocol

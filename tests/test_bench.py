@@ -121,6 +121,24 @@ def test_bench_aggregate_contract():
 
 
 @pytest.mark.slow
+def test_bench_replicate_contract():
+    """replicate mode: barrier-close overhead off/async/sync replication,
+    failover wall-clock, and the 2->4 reshard's moved bytes — all
+    visible in the JSON."""
+    result = run_bench("replicate", extra_env={
+        "PSDT_BENCH_PARAMS": "1e5",
+        "PSDT_BENCH_STEPS": "2",
+    })
+    assert result["metric"] == "ps_replicate_close_ms_sync"
+    assert result["value"] > 0
+    assert set(result["close_ms"]) == {"off", "async", "sync"}
+    assert all(v > 0 for v in result["close_ms"].values())
+    assert result["failover_s"] > 0
+    assert result["reshard_s"] > 0
+    assert result["reshard_moved_bytes"] > 0
+
+
+@pytest.mark.slow
 def test_bench_apply_contract():
     """apply mode: striped barrier-close profile, serial vs striped side
     by side with the stripe counts visible in the JSON."""

@@ -1,0 +1,688 @@
+"""Replication subsystem (ISSUE 7): primary/backup state ships, hot
+failover with zero failed steps, live 2->4 resharding under load,
+promoted-replica checkpointing, and the lock discipline of it all."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.cli.worker_main import build_worker
+from parameter_server_distributed_tpu.config import (CoordinatorConfig,
+                                                     ParameterServerConfig,
+                                                     WorkerConfig)
+from parameter_server_distributed_tpu.core.coordinator_core import (
+    CoordinatorCore, ShardMapEntry)
+from parameter_server_distributed_tpu.core.tensor import to_wire
+from parameter_server_distributed_tpu.replication import messages as rmsg
+from parameter_server_distributed_tpu.replication.failover import (
+    ShardMapClient)
+from parameter_server_distributed_tpu.replication.replicator import (
+    flatten_optimizer_state, split_replica_store)
+from parameter_server_distributed_tpu.replication.resharding import (
+    ReshardController)
+from parameter_server_distributed_tpu.rpc import messages as m
+from parameter_server_distributed_tpu.server.coordinator_service import (
+    Coordinator)
+from parameter_server_distributed_tpu.server.ps_service import ParameterServer
+from parameter_server_distributed_tpu.utils.netsim import ThrottledRelay
+from parameter_server_distributed_tpu.worker.ps_shards import (
+    ShardedPSClient, shard_owner)
+
+
+def make_ps(tmp_path, name, total_workers=1, **kw):
+    ps = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=total_workers,
+        checkpoint_dir=str(tmp_path / name), learning_rate=0.1,
+        autosave_period_s=600.0, **kw))
+    return ps, ps.start()
+
+
+def rand_store(n=8, size=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return {f"layer{i}/w": rng.standard_normal(size).astype(np.float32)
+            for i in range(n)}
+
+
+# ----------------------------------------------------------- shard map core
+
+def test_shard_map_promote_idempotent():
+    core = CoordinatorCore("10.0.0.1", 50051, ps_shards=("10.0.0.2:50051",),
+                           ps_backups=("10.0.1.1:50051", "10.0.1.2:50051"))
+    epoch0, entries = core.get_shard_map()
+    assert [e.primary for e in entries] == ["10.0.0.1:50051",
+                                           "10.0.0.2:50051"]
+    assert [e.backup for e in entries] == ["10.0.1.1:50051",
+                                           "10.0.1.2:50051"]
+    epoch1, entries = core.promote_shard(0, "10.0.0.1:50051")
+    assert epoch1 == epoch0 + 1
+    assert entries[0].primary == "10.0.1.1:50051" and not entries[0].backup
+    # discovery follows the promotion (reference peers see the replica)
+    assert core.get_parameter_server_address() == ("10.0.1.1", 50051)
+    # second report of the SAME dead primary: no-op, same map back
+    epoch2, entries2 = core.promote_shard(0, "10.0.0.1:50051")
+    assert epoch2 == epoch1
+    assert entries2[0].primary == "10.0.1.1:50051"
+    # a shard whose backup was already consumed cannot promote again
+    epoch3, entries3 = core.promote_shard(0, "10.0.1.1:50051")
+    assert epoch3 == epoch2 and entries3[0].primary == "10.0.1.1:50051"
+    assert core.get_shard_map()[0] == epoch3
+
+
+def test_set_shard_map_bumps_epoch_and_discovery():
+    core = CoordinatorCore("10.0.0.1", 50051)
+    epoch0, _ = core.get_shard_map()
+    epoch = core.set_shard_map([ShardMapEntry(primary="10.0.9.1:1"),
+                                ShardMapEntry(primary="10.0.9.2:2",
+                                              backup="10.0.9.3:3")])
+    assert epoch == epoch0 + 1
+    assert core.get_parameter_server_shards() == ["10.0.9.1:1",
+                                                  "10.0.9.2:2"]
+    assert core.get_parameter_server_address() == ("10.0.9.1", 1)
+
+
+def test_optimizer_state_flatten_roundtrip():
+    state = {"velocity": {"a": np.arange(4, dtype=np.float32),
+                          "b/c": np.ones(2, np.float32)},
+             "t": 7}
+    flat = flatten_optimizer_state(state)
+    assert all(k.startswith("__opt__/") for k in flat)
+    params, opt = split_replica_store({**flat, "w": np.zeros(3, np.float32)})
+    assert set(params) == {"w"}
+    assert opt["t"] == 7
+    np.testing.assert_array_equal(opt["velocity"]["a"], state["velocity"]["a"])
+    np.testing.assert_array_equal(opt["velocity"]["b/c"],
+                                  state["velocity"]["b/c"])
+
+
+# --------------------------------------------------------- replication ships
+
+def test_replica_store_bit_identical_after_n_iterations(tmp_path):
+    """The backup's store (and optimizer slots) must be byte-equal to the
+    primary's after N barrier closes — lossless WIRE_RAW_F32 ships."""
+    backup, bport = make_ps(tmp_path, "bk", optimizer="momentum")
+    primary, _ = make_ps(tmp_path, "pr", optimizer="momentum",
+                         backup_address=f"127.0.0.1:{bport}",
+                         replication="sync")
+    try:
+        store = rand_store()
+        primary.core.initialize_parameters(store)
+        rng = np.random.default_rng(1)
+        for it in range(1, 6):
+            grads = {k: rng.standard_normal(32).astype(np.float32)
+                     for k in store}
+            r = primary.core.receive_gradients(0, it, grads)
+            assert r.aggregation_complete, r.message
+        assert primary.replicator.flush()
+        pp, bp = primary.core.get_parameters(), backup.core.get_parameters()
+        assert set(pp) == set(bp)
+        for name in pp:
+            assert np.array_equal(pp[name], bp[name]), name
+        # momentum slots came along (a promoted replica optimizes
+        # identically, not from cold slots)
+        pv = primary.core._optimizer.state_dict()["velocity"]
+        bv = backup.core._optimizer.state_dict()["velocity"]
+        for name in pv:
+            assert np.array_equal(np.asarray(pv[name], np.float32),
+                                  np.asarray(bv[name], np.float32)), name
+        assert backup.core.current_iteration == 5
+        assert backup.service.replica_sink.primary_iteration == 5
+        # retried push of an applied iteration: answered already-aggregated
+        # (the promoted-replica dedup)
+        r = backup.core.receive_gradients(0, 5, {k: np.zeros(32, np.float32)
+                                                 for k in store})
+        assert r.success and r.aggregation_complete
+    finally:
+        primary.stop(0)
+        backup.stop(0)
+
+
+def test_zombie_primary_delta_refused_after_promotion(tmp_path):
+    """Once the replica aggregates on its own (promotion), a late ship
+    from the dead-but-still-running ex-primary must not rewind it."""
+    backup, bport = make_ps(tmp_path, "bk")
+    primary, _ = make_ps(tmp_path, "pr",
+                         backup_address=f"127.0.0.1:{bport}",
+                         replication="sync")
+    try:
+        store = rand_store()
+        primary.core.initialize_parameters(store)
+        grads = {k: np.ones(32, np.float32) for k in store}
+        assert primary.core.receive_gradients(0, 1, grads).aggregation_complete
+        # promotion: the replica aggregates iteration 2 on its own
+        assert backup.core.receive_gradients(0, 2, grads).aggregation_complete
+        promoted = backup.core.get_parameters()
+        # the zombie primary applies its own iteration 2 and ships it
+        assert primary.core.receive_gradients(0, 2, grads).aggregation_complete
+        primary.replicator.flush()
+        assert primary.replicator.degraded  # refusal = permanent downgrade
+        after = backup.core.get_parameters()
+        for name in promoted:
+            assert np.array_equal(promoted[name], after[name]), name
+    finally:
+        primary.stop(0)
+        backup.stop(0)
+
+
+# -------------------------------------------------------------- hot failover
+
+def _losses_for_cluster(tmp_path, tag, iterations, kill_after=None,
+                        base_port=15300):
+    """Coordinator + primary(+backup, sync replication) cluster; two
+    workers run ``iterations`` steps concurrently.  ``kill_after``: once
+    every worker has completed that many iterations, the relay fronting
+    the primary is hard-dropped (netsim chaos) — training must continue
+    on the promoted replica with zero failed steps."""
+    backup, bport = make_ps(tmp_path, f"{tag}-bk", total_workers=2)
+    primary, pport = make_ps(tmp_path, f"{tag}-pr", total_workers=2,
+                             backup_address=f"127.0.0.1:{bport}",
+                             replication="sync")
+    relay = ThrottledRelay(pport)
+    relay_port = relay.start()
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+        ps_port=relay_port, ps_backups=(f"127.0.0.1:{bport}",),
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    workers = [build_worker(WorkerConfig(
+        coordinator_address=f"127.0.0.1:{coord_port}", worker_id=i,
+        address="127.0.0.1", port=base_port + i, model="mnist_mlp",
+        batch_size=32, heartbeat_period_s=600.0)) for i in range(2)]
+    losses: dict[int, list[float]] = {0: [], 1: []}
+    errors: list[BaseException] = []
+    try:
+        for w in workers:
+            w.initialize()
+
+        def run(w, wid):
+            try:
+                for it in range(iterations):
+                    losses[wid].append(w.run_iteration(it))
+            except BaseException as exc:  # noqa: BLE001 — asserted below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=run, args=(w, i), daemon=True,
+                                    name=f"repl-worker-{i}")
+                   for i, w in enumerate(workers)]
+        for t in threads:
+            t.start()
+        if kill_after is not None:
+            deadline = time.monotonic() + 60
+            while (min(len(ls) for ls in losses.values()) < kill_after
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            relay.drop_connections()  # mid-barrier, mid-stream — chaos
+        for t in threads:
+            t.join(timeout=180)
+            assert not t.is_alive(), "worker wedged"
+        assert not errors, errors
+        assert all(len(ls) == iterations for ls in losses.values())
+        promoted = (kill_after is not None
+                    and backup.core.current_iteration > 0)
+        return losses, promoted
+    finally:
+        for w in workers:
+            w.shutdown()
+        coordinator.stop()
+        relay.stop()
+        primary.stop(0)
+        backup.stop(0)
+
+
+def test_kill_primary_mid_run_promotes_replica_with_matching_losses(tmp_path):
+    """THE failover acceptance: sever the primary under live 2-worker
+    training (netsim chaos), training continues on the promoted replica
+    with zero failed steps, and the loss curve tracks the no-failure
+    run's (sync replication + lossless wire => same arithmetic)."""
+    iterations = 6
+    clean, _ = _losses_for_cluster(tmp_path, "clean", iterations,
+                                   base_port=15300)
+    chaos, promoted = _losses_for_cluster(tmp_path, "chaos", iterations,
+                                          kill_after=2, base_port=15310)
+    assert promoted, "the kill never forced a promotion"
+    for wid in (0, 1):
+        # iteration 0 is the bootstrap NaN on both runs
+        np.testing.assert_allclose(chaos[wid][1:], clean[wid][1:],
+                                   rtol=1e-4, atol=1e-6,
+                                   err_msg=f"worker {wid} loss curve "
+                                           f"diverged across the failover")
+
+
+def test_failover_via_client_retries_same_iteration(tmp_path):
+    """Direct (no-netsim) failover unit: the sharded client reports the
+    dead shard, the coordinator promotes, and the SAME iteration lands on
+    the replica — idempotently even when the primary had already applied
+    and shipped it."""
+    backup, bport = make_ps(tmp_path, "bk")
+    primary, pport = make_ps(tmp_path, "pr",
+                             backup_address=f"127.0.0.1:{bport}",
+                             replication="sync")
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+        ps_port=pport, ps_backups=(f"127.0.0.1:{bport}",),
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    shard_map = ShardMapClient(f"127.0.0.1:{coord_port}")
+    assert shard_map.refresh() and shard_map.has_backups()
+    client = ShardedPSClient(shard_map.primaries(), shard_map=shard_map)
+    try:
+        store = rand_store()
+        primary.core.initialize_parameters(store)
+        grads = to_wire({k: np.ones(32, np.float32) for k in store})
+        r = client.push_gradients(m.GradientUpdate(worker_id=0, iteration=1,
+                                                   gradients=grads))
+        assert r.success and r.aggregation_complete
+        applied = primary.core.get_parameters()
+        primary._server.stop(None)  # hard kill
+        # retry of the ALREADY-APPLIED iteration 1 (the worker never saw
+        # the ack): the replica's watermark answers already-aggregated
+        r = client.push_gradients(m.GradientUpdate(worker_id=0, iteration=1,
+                                                   gradients=grads))
+        assert r.success and r.aggregation_complete
+        assert client.addresses == [f"127.0.0.1:{bport}"]
+        bp = backup.core.get_parameters()
+        for name in applied:  # replica state == what the primary applied
+            assert np.array_equal(applied[name], bp[name]), name
+        # and a FRESH iteration aggregates on the replica
+        r = client.push_gradients(m.GradientUpdate(worker_id=0, iteration=2,
+                                                   gradients=grads))
+        assert r.success and r.aggregation_complete
+        assert backup.core.current_iteration == 2
+    finally:
+        client.close()
+        coordinator.stop()
+        primary.stop(0)
+        backup.stop(0)
+
+
+def test_netsim_drop_connections_severs_and_refuses(tmp_path):
+    """The chaos helper itself: live relayed connections die abruptly and
+    new connects are refused until restore_connections()."""
+    import socket
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.bind(("127.0.0.1", 0))
+    server.listen(8)
+    backend_port = server.getsockname()[1]
+    accepted = []
+
+    def echo_loop():
+        while True:
+            try:
+                conn, _ = server.accept()
+            except OSError:
+                return
+            accepted.append(conn)
+
+    thread = threading.Thread(target=echo_loop, daemon=True,
+                              name="netsim-test-echo")
+    thread.start()
+    relay = ThrottledRelay(backend_port)
+    port = relay.start()
+    try:
+        client = socket.create_connection(("127.0.0.1", port))
+        client.sendall(b"ping")
+        time.sleep(0.2)
+        assert accepted and accepted[0].recv(16) == b"ping"
+        assert relay.drop_connections() >= 1
+        # the severed socket surfaces EOF/RST promptly
+        client.settimeout(5.0)
+        try:
+            data = client.recv(16)
+        except OSError:
+            data = b""
+        assert data == b""
+        # new connections die while refusing: either the connect itself is
+        # reset, or it lands and the first read sees an immediate close
+        try:
+            probe = socket.create_connection(("127.0.0.1", port),
+                                             timeout=5.0)
+        except OSError:
+            pass  # refused at connect — the "dead host" signature
+        else:
+            probe.settimeout(5.0)
+            try:
+                assert probe.recv(16) == b""
+            except OSError:
+                pass
+            finally:
+                probe.close()
+        # ...and relay again after restore
+        relay.restore_connections()
+        again = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        again.sendall(b"pong")
+        time.sleep(0.2)
+        assert len(accepted) >= 2
+        again.close()
+        client.close()
+    finally:
+        relay.stop()
+        server.close()
+        for conn in accepted:
+            conn.close()
+
+
+# ------------------------------------------------------------ live reshard
+
+def test_live_2_to_4_reshard_under_load_zero_failed_steps(tmp_path):
+    """THE reshard acceptance: 2->4 split while two workers push
+    concurrently — zero failed steps, exact crc32%4 partition after, and
+    the workers' clients repartition via the stale-shard-map replay."""
+    shards = [make_ps(tmp_path, f"s{i}", total_workers=2) for i in range(4)]
+    ports = [port for _, port in shards]
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+        ps_port=ports[0], ps_shards=(f"127.0.0.1:{ports[1]}",),
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    iterations = 6
+    workers = [build_worker(WorkerConfig(
+        coordinator_address=f"127.0.0.1:{coord_port}", worker_id=i,
+        address="127.0.0.1", port=15330 + i, model="mnist_mlp",
+        batch_size=32, heartbeat_period_s=600.0)) for i in range(2)]
+    losses: dict[int, list[float]] = {0: [], 1: []}
+    errors: list[BaseException] = []
+    try:
+        for w in workers:
+            w.initialize()
+            assert w._ps.num_shards == 2
+
+        def run(w, wid):
+            try:
+                for it in range(iterations):
+                    losses[wid].append(w.run_iteration(it))
+            except BaseException as exc:  # noqa: BLE001 — asserted below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=run, args=(w, i), daemon=True,
+                                    name=f"reshard-worker-{i}")
+                   for i, w in enumerate(workers)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        while (min(len(ls) for ls in losses.values()) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        stats = ReshardController(coordinator.core).reshard(
+            [f"127.0.0.1:{port}" for port in ports])
+        assert stats["moved_bytes"] > 0 and stats["new_shards"] == 4
+        for t in threads:
+            t.join(timeout=180)
+            assert not t.is_alive(), "worker wedged across the reshard"
+        assert not errors, errors
+        assert all(len(ls) == iterations for ls in losses.values())
+        for ls in losses.values():  # loss stays sane across the handoff
+            assert all(np.isfinite(v) for v in ls[1:])
+        # every shard owns exactly its crc32%4 partition, union = model
+        expected = set(workers[0].trainer.init_params(0))
+        union: set = set()
+        for i, (ps, _) in enumerate(shards):
+            names = set(ps.core.get_parameters())
+            assert names == {n for n in expected if shard_owner(n, 4) == i}
+            union |= names
+        assert union == expected
+        # the clients repartitioned live
+        assert all(w._ps.num_shards == 4 for w in workers)
+    finally:
+        for w in workers:
+            w.shutdown()
+        coordinator.stop()
+        for ps, _ in shards:
+            ps.stop(0)
+
+
+def test_retired_push_rejected_then_replayed_exactly_once(tmp_path):
+    """Unit of the reshard fence: a push touching retired tensors is
+    rejected whole with the STALE_SHARD_MAP marker, folds of the moved
+    names never pollute the accumulator, and the post-repartition replay
+    double-counts nothing."""
+    primary, _ = make_ps(tmp_path, "pr")
+    try:
+        store = rand_store(n=4)
+        primary.core.initialize_parameters(store)
+        names = sorted(store)
+        moved = names[:2]
+        epoch, iteration, _version, taken, _opt = primary.core.retire_tensors(
+            moved, map_epoch=7)
+        assert set(taken) == set(moved)
+        assert set(primary.core.get_parameters()) == set(names[2:])
+        grads = {k: np.ones(32, np.float32) for k in store}
+        r = primary.core.receive_gradients(0, 1, grads)
+        assert not r.success and rmsg.STALE_SHARD_MAP in r.message
+        # the replayed (repartitioned) push carries only owned names
+        r = primary.core.receive_gradients(
+            0, 1, {k: grads[k] for k in names[2:]})
+        assert r.success and r.aggregation_complete
+        after = primary.core.get_parameters()
+        for k in names[2:]:  # exactly ONE update landed
+            np.testing.assert_allclose(after[k], store[k] - 0.1, rtol=1e-6)
+    finally:
+        primary.stop(0)
+
+
+def test_install_releases_superseded_barrier_state(tmp_path):
+    """The failover-retry-vs-final-ship race: a worker's retried push
+    creates a live barrier state on the replica, THEN the dead primary's
+    last in-flight ship installs the same iteration (it was applied
+    cluster-wide before the death).  The parked retry must be released
+    as already-aggregated — not stranded behind a 1/N state no one else
+    will ever push to."""
+    replica, _ = make_ps(tmp_path, "rep", total_workers=2)
+    try:
+        store = rand_store()
+        replica.core.initialize_parameters(store)
+        # worker 1's retry lands first: 1/2 contributors, state parked
+        r = replica.core.receive_gradients(
+            1, 5, {k: np.ones(32, np.float32) for k in store})
+        assert r.success and not r.aggregation_complete
+        released: list = []
+
+        def waiter():
+            released.append(replica.core.wait_for_aggregation(5, timeout=30))
+
+        t = threading.Thread(target=waiter, daemon=True,
+                             name="superseded-waiter")
+        t.start()
+        time.sleep(0.2)
+        # the zombie primary's ship of the APPLIED iteration 5 arrives
+        replica.core.install_tensors(store, epoch=0, iteration=5,
+                                     replace=True)
+        t.join(timeout=10)
+        assert not t.is_alive(), "waiter stranded behind superseded state"
+        ready, _received, _total = released[0]
+        assert ready
+        # and a later poll agrees
+        _, ready, _, _ = replica.core.check_sync_status(5)
+        assert ready
+    finally:
+        replica.stop(0)
+
+
+def test_retire_moves_optimizer_slots_and_install_merges(tmp_path):
+    """A reshard handoff carries the moved tensors' optimizer slot
+    entries: the source's slots shrink, the destination's grow by exactly
+    the moved names with the SAME values — the optimization trajectory
+    survives the move."""
+    source, _ = make_ps(tmp_path, "src", optimizer="momentum")
+    target, _ = make_ps(tmp_path, "dst", optimizer="momentum")
+    try:
+        store = rand_store(n=4)
+        source.core.initialize_parameters(store)
+        grads = {k: np.ones(32, np.float32) for k in store}
+        assert source.core.receive_gradients(0, 1, grads).aggregation_complete
+        names = sorted(store)
+        moved = names[:2]
+        src_velocity = {
+            k: np.array(v) for k, v in
+            source.core._optimizer.state_dict()["velocity"].items()}
+        _e, it, _v, taken, moved_opt = source.core.retire_tensors(
+            moved, map_epoch=3)
+        assert set(moved_opt["velocity"]) == set(moved)
+        # the source's remaining slots no longer know the moved names
+        left = source.core._optimizer.state_dict()["velocity"]
+        assert set(left) == set(names[2:])
+        # install with merge on a target that has its own state
+        target.core.initialize_parameters(
+            {"other": np.zeros(8, np.float32)})
+        assert target.core.receive_gradients(
+            0, 1, {"other": np.ones(8, np.float32)}).aggregation_complete
+        target.core.install_tensors(taken, iteration=it,
+                                    optimizer_state=moved_opt,
+                                    optimizer_merge=True)
+        dst = target.core._optimizer.state_dict()["velocity"]
+        assert set(dst) == {"other", *moved}  # merged, not replaced
+        for name in moved:
+            np.testing.assert_array_equal(
+                np.asarray(dst[name], np.float32),
+                np.asarray(src_velocity[name], np.float32))
+    finally:
+        source.stop(0)
+        target.stop(0)
+
+
+# ------------------------------------------------- promoted-replica ckpt
+
+def test_checkpoint_roundtrip_through_promoted_replica(tmp_path):
+    """Save a checkpoint FROM the promoted replica, restore it into a
+    fresh PS: params and optimizer slots match the replica's exactly."""
+    backup, bport = make_ps(tmp_path, "bk", optimizer="momentum")
+    primary, pport = make_ps(tmp_path, "pr", optimizer="momentum",
+                             backup_address=f"127.0.0.1:{bport}",
+                             replication="sync")
+    coordinator = Coordinator(CoordinatorConfig(
+        bind_address="127.0.0.1", port=0, ps_address="127.0.0.1",
+        ps_port=pport, ps_backups=(f"127.0.0.1:{bport}",),
+        reap_period_s=600.0))
+    coord_port = coordinator.start()
+    shard_map = ShardMapClient(f"127.0.0.1:{coord_port}")
+    shard_map.refresh()
+    client = ShardedPSClient(shard_map.primaries(), shard_map=shard_map)
+    fresh = None
+    try:
+        store = rand_store()
+        primary.core.initialize_parameters(store)
+        rng = np.random.default_rng(3)
+        for it in range(1, 4):
+            grads = to_wire({k: rng.standard_normal(32).astype(np.float32)
+                             for k in store})
+            r = client.push_gradients(m.GradientUpdate(
+                worker_id=0, iteration=it, gradients=grads))
+            assert r.success and r.aggregation_complete
+        primary._server.stop(None)  # kill; next call fails over
+        r = client.push_gradients(m.GradientUpdate(
+            worker_id=0, iteration=4,
+            gradients=to_wire({k: np.ones(32, np.float32) for k in store})))
+        assert r.success and r.aggregation_complete
+        # checkpoint THROUGH the promoted replica
+        path = str(tmp_path / "promoted.ckpt")
+        save = client.call("SaveCheckpoint",
+                           m.SaveCheckpointRequest(epoch=1, path=path))
+        assert save.success, save.message
+        replica_params = backup.core.get_parameters()
+        replica_slots = backup.core._optimizer.state_dict()["velocity"]
+        # restore into a brand-new PS and compare
+        fresh, _fport = make_ps(tmp_path, "fresh", optimizer="momentum")
+        fresh.ckpt.load(path)
+        restored = fresh.core.get_parameters()
+        assert set(restored) == set(replica_params)
+        for name in restored:
+            assert np.array_equal(restored[name], replica_params[name]), name
+        slots = fresh.core._optimizer.state_dict()["velocity"]
+        for name in replica_slots:
+            np.testing.assert_allclose(np.asarray(slots[name], np.float32),
+                                       np.asarray(replica_slots[name],
+                                                  np.float32), rtol=1e-6)
+    finally:
+        client.close()
+        coordinator.stop()
+        if fresh is not None:
+            fresh.stop(0)
+        backup.stop(0)
+
+
+# ------------------------------------------------------------- lock checking
+
+@pytest.mark.lockcheck
+def test_lockcheck_replication_promotion_push_hammer(tmp_path):
+    """Concurrent pushes + sync replication ships + reshard retires +
+    zombie installs, all with PSDT_LOCK_CHECK=1: any ordering violation
+    in the new Replicator/ReplicaSink/CoordinatorCore/core lock chains
+    raises LockOrderError instead of deadlocking."""
+    backup, bport = make_ps(tmp_path, "bk")
+    primary, _ = make_ps(tmp_path, "pr", total_workers=4,
+                         backup_address=f"127.0.0.1:{bport}",
+                         replication="sync")
+    coord = CoordinatorCore("127.0.0.1", 1,
+                            ps_backups=(f"127.0.0.1:{bport}",))
+    errors: list[BaseException] = []
+    try:
+        store = rand_store(n=8)
+        primary.core.initialize_parameters(store)
+        stop = threading.Event()
+
+        def pusher(wid):
+            try:
+                rng = np.random.default_rng(wid)
+                for it in range(1, 9):
+                    grads = {k: rng.standard_normal(32).astype(np.float32)
+                             for k in store}
+                    primary.core.receive_gradients(wid, it, grads)
+            except BaseException as exc:  # noqa: BLE001 — asserted below
+                errors.append(exc)
+
+        def churner():
+            try:
+                while not stop.is_set():
+                    coord.promote_shard(0, "127.0.0.1:1")
+                    coord.get_shard_map()
+                    backup.service.replica_sink.push_delta(iter([
+                        rmsg.ReplicaDeltaChunk(
+                            epoch=0, iteration=0, params_version=1,
+                            kind=rmsg.DELTA_INSTALL,
+                            tensors=to_wire({"extra": np.ones(4,
+                                                              np.float32)}))]))
+                    time.sleep(0.001)
+            except BaseException as exc:  # noqa: BLE001 — asserted below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=pusher, args=(wid,), daemon=True,
+                                    name=f"hammer-push-{wid}")
+                   for wid in range(4)]
+        churn = threading.Thread(target=churner, daemon=True,
+                                 name="hammer-churn")
+        for t in threads:
+            t.start()
+        churn.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+        stop.set()
+        churn.join(timeout=10)
+        assert not errors, errors
+        assert primary.core.current_iteration == 8
+    finally:
+        primary.stop(0)
+        backup.stop(0)
+
+
+# ------------------------------------------------------------------- rollup
+
+def test_replica_metrics_surface_in_rollup():
+    from parameter_server_distributed_tpu.obs.export import (render_rollup,
+                                                             worker_rollup)
+
+    snap = {"counters": {"ps.replica.shipped_bytes": 4096,
+                         "ps.replica.promotions": 2,
+                         "ps.reshard.moved_bytes": 1024},
+            "gauges": {"ps.replica.lag_bytes": 512},
+            "histograms": {}, "t": 0.0}
+    rolled = worker_rollup(snap)
+    replica = rolled["ps"]["replica"]
+    assert replica["shipped_bytes"] == 4096
+    assert replica["promotions"] == 2
+    assert replica["reshard_moved_bytes"] == 1024
+    assert replica["lag_bytes"] == 512
+    text = render_rollup({"per_worker": {0: rolled}, "cluster": {}})
+    assert "replication:" in text
+    assert "2 promotions" in text and "reshard moved" in text

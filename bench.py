@@ -1008,6 +1008,118 @@ def bench_apply() -> dict:
                      f"({n_max} workers, {opt_name})")}
 
 
+def bench_obs() -> dict:
+    """Flight-recorder overhead bench (ISSUE 8): raw event throughput
+    into a real mmap-backed ring (events/s, ns/event), and the fused-step
+    p50 with the recorder ON vs OFF over a real loopback fused data plane
+    — the "<2% of fused-step p50" acceptance surface.  The two arms run
+    as interleaved step batches (A/B/A/B) so host-load drift cancels
+    instead of landing on one arm.  Knobs: PSDT_BENCH_PARAMS (store
+    size, default 2e5), PSDT_BENCH_STEPS (steps per batch, default 8)."""
+    import tempfile
+
+    import numpy as np
+
+    from parameter_server_distributed_tpu.config import (
+        ParameterServerConfig)
+    from parameter_server_distributed_tpu.core.tensor import (store_nbytes,
+                                                              to_wire)
+    from parameter_server_distributed_tpu.obs import flight, postmortem
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+
+    n_params = int(float(os.environ.get("PSDT_BENCH_PARAMS", "2e5")))
+    batch_steps = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 8
+    n_batches = 6  # per arm; interleaved
+
+    # ---- raw event throughput into a real ring (its own directory so
+    # the fused arms' per-step accounting below never mixes with it)
+    flight_dir = tempfile.mkdtemp(prefix="psdt-flight-bench-")
+    fused_dir = tempfile.mkdtemp(prefix="psdt-flight-fused-")
+    flight.enable(flight_dir, role="bench", records=1 << 15)
+    n_events = 200_000
+    t0 = time.perf_counter()
+    for i in range(n_events):
+        flight.record("push.commit", iteration=i, worker=0, a=i, b=2)
+    event_wall = time.perf_counter() - t0
+    flight.disable()
+    events_per_s = n_events / event_wall
+    ns_per_event = 1e9 * event_wall / n_events
+    log(f"bench_obs: {events_per_s / 1e6:.2f}M events/s "
+        f"({ns_per_event:.0f} ns/event)")
+
+    # ---- fused-step p50, recorder on vs off (same server, same client)
+    rng = np.random.default_rng(0)
+    n_tensors = 8
+    shape = (max(1, n_params // n_tensors),)
+    params = {f"layer{i:02d}/w": rng.standard_normal(shape).astype(
+        np.float32) for i in range(n_tensors)}
+    grads = {name: rng.standard_normal(v.shape).astype(np.float32)
+             for name, v in params.items()}
+    tmp = tempfile.mkdtemp(prefix="psdt-obs-bench-")
+    ps = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=1,
+        learning_rate=0.1, checkpoint_dir=tmp, autosave_period_s=600.0))
+    port = ps.start()
+    ps.core.initialize_parameters(params)
+    client = PSClient(f"127.0.0.1:{port}")
+    times: dict[bool, list] = {False: [], True: []}
+    try:
+        def tensors_fn():
+            return iter(to_wire(grads))
+
+        def run_steps(first_it: int, n: int, record: list | None) -> int:
+            it = first_it
+            for _ in range(n):
+                t1 = time.perf_counter()
+                push, update = client.push_pull(0, it, tensors_fn,
+                                                timeout=60.0)
+                dt = time.perf_counter() - t1
+                assert push.success and update is not None, push.message
+                if record is not None:
+                    record.append(dt)
+                it += 1
+            return it
+
+        it = run_steps(1, 3, None)  # warmup (connection, caches, shm)
+        for batch in range(2 * n_batches):
+            arm = bool(batch % 2)  # off, on, off, on ... interleaved
+            if arm:
+                flight.enable(fused_dir, role="bench-fused",
+                              records=1 << 15)
+            it = run_steps(it, batch_steps, times[arm])
+            if arm:
+                flight.disable()
+    finally:
+        client.close()
+        ps.stop(0)
+    p50 = {arm: sorted(ts)[len(ts) // 2] for arm, ts in times.items()}
+    overhead_pct = 100.0 * (p50[True] - p50[False]) / p50[False]
+    # events per fused step with the recorder on: every on-batch wrote
+    # its own uniquely-named ring into fused_dir — sum them and
+    # normalize by the total on-arm step count
+    rings = postmortem.load_rings(fused_dir)
+    ring_events = sum(len(r["events"]) + r["dropped"] for r in rings)
+    events_per_step = round(ring_events / (n_batches * batch_steps), 1)
+    log(f"bench_obs: fused p50 off={1e3 * p50[False]:.3f}ms "
+        f"on={1e3 * p50[True]:.3f}ms ({overhead_pct:+.2f}%)")
+    return {"metric": "obs_flight_overhead_pct",
+            "value": round(overhead_pct, 3), "unit": "%",
+            "vs_baseline": 0.0,
+            "events_per_s": round(events_per_s),
+            "ns_per_event": round(ns_per_event, 1),
+            "fused_p50_ms": {"off": round(1e3 * p50[False], 4),
+                             "on": round(1e3 * p50[True], 4)},
+            "steps_per_arm": n_batches * batch_steps,
+            "model_bytes": store_nbytes(params),
+            "events_per_fused_step": events_per_step,
+            "note": (f"recorder {overhead_pct:+.2f}% of fused-step p50 "
+                     f"({n_batches * batch_steps} steps/arm interleaved); "
+                     f"{events_per_s / 1e6:.2f}M events/s raw "
+                     f"({ns_per_event:.0f} ns/event)")}
+
+
 def bench_replicate() -> dict:
     """Replication/failover/reshard bench (real loopback gRPC between
     in-process PS servers): barrier-close latency with replication
@@ -1811,6 +1923,8 @@ def child_main(mode: str) -> int:
             result = bench_apply()
         elif mode == "replicate":
             result = bench_replicate()
+        elif mode == "obs":
+            result = bench_obs()
         elif mode == "async":
             result = bench_async()
         elif mode == "generate":
@@ -1919,7 +2033,7 @@ def main() -> int:
     # directly rather than risking a flaky TPU init.
     plans: list[tuple[str, float]]
     if mode in ("pushpull", "dataplane", "aggregate", "apply", "codec",
-                "replicate"):
+                "replicate", "obs"):
         plans = [("cpu", cpu_timeout)]
     else:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]

@@ -70,12 +70,26 @@ LOCK_RANKS: dict[str, int] = {
     # declared lock is ever acquired under them.
     "ShmClientConnection._lock": 54,
     "ShmServer._lock": 56,
+    # exactly-once shm segment release (ISSUE 8 double-reap fix): leaf,
+    # guards only the released flag — the reaper (serve thread exit) and
+    # the shutdown path (ShmServer.close -> unlink) must not both unmap
+    "_ServerConnection._release_lock": 58,
     "EncodedServeCache._lock": 60,
     "ClusterAggregator._lock": 62,
     "trainer._DISPATCH_LOCK": 64,
     "native._lock": 66,
     # single-flight creation of the shared stripe executor
     "stripes._pool_lock": 68,
+    # flight recorder (obs/flight.py, ISSUE 8): serializes only
+    # enable/disable/atexit — ring creation is file I/O, which is the
+    # lock's purpose (BLOCKING_ALLOWED).  The record() hot path is
+    # LOCK-FREE (GIL-atomic slot counter + slice stores), so flight
+    # events are legal inside _state_lock and the stripe locks; this
+    # rank is a leaf regardless.
+    "FlightRecorder._lock": 70,
+    # pst-status --watch snapshot ring (obs/stats.py): leaf, guards only
+    # the bounded deque of timestamped snapshots
+    "TimeSeriesRing._lock": 72,
 }
 
 # Locks that exist to serialize a blocking section: the static
@@ -97,6 +111,9 @@ BLOCKING_ALLOWED: frozenset[str] = frozenset({
     # serializes one replication ship (encode + PushReplicaDelta RPC +
     # ack) to the backup — the RPC under it is the point of the lock
     "Replicator._ship_lock",
+    # serializes flight-ring creation/teardown (mmap + file I/O is the
+    # lock's purpose; the record() hot path never takes it)
+    "FlightRecorder._lock",
 })
 
 ENV_FLAG = "PSDT_LOCK_CHECK"

@@ -26,6 +26,10 @@ from ..config import parse_argv
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    # tooling runs must not deposit flight rings into a cluster's
+    # PSDT_FLIGHT_DIR evidence directory (obs/flight.py)
+    from ..obs import flight
+    flight.suppress_for_tool()
     positional, flags = parse_argv(argv)
 
     from ..analysis import runner, wirecheck

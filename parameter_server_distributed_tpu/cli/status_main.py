@@ -3,7 +3,8 @@ as raw RPCs (Coordinator.ListWorkers — proto/coordinator.proto:8; PS
 CheckSyncStatus — proto/parameter_server.proto:7).
 
     python -m parameter_server_distributed_tpu.cli.status_main \
-        [coordinator_addr] [--iteration=N] [--metrics] [--metrics-json]
+        [coordinator_addr] [--iteration=N] [--metrics] [--metrics-json] \
+        [--watch[=SECONDS]] [--watch-count=N]
 
 Prints the worker registry (id/address/hostname) and the PS sync state for
 the given iteration (default: 0).  ``--metrics`` adds the cluster metric
@@ -13,24 +14,128 @@ totals (with the f32-payload compression ratio), step-phase breakdown,
 and the straggler spread.  ``--metrics-json`` emits the raw rollup JSON
 instead (for dashboards/scripts).  Degrades gracefully against a
 reference coordinator, which does not implement the extension RPC.
+
+``--watch`` (ISSUE 8) keeps polling the rollup and prints RATES between
+consecutive snapshots — steps/s and wire MB/s per worker — off a bounded
+time-series ring (obs/stats.TimeSeriesRing): the live view of cluster
+throughput the one-shot percentile rollup cannot give.  Interval defaults
+to 1 s (``--watch=5`` overrides); ``--watch-count=N`` bounds the ticks
+(scripts/tests), default unbounded (Ctrl-C exits).
 """
 
 from __future__ import annotations
 
 import sys
+import time
 
 import grpc
 
 from ..config import parse_argv
 from ..obs.export import render_rollup
+from ..obs.stats import TimeSeriesRing
 from ..rpc import messages as m
 from ..rpc.service import RpcClient
 
 
+def rollup_to_snapshot(rollup: dict, t: float | None = None) -> dict:
+    """Flatten a cluster rollup into the registry-snapshot shape
+    ``obs.stats.snapshot_rates`` diffs: monotone per-worker totals become
+    counters (step counts, wire bytes), so consecutive rollups yield
+    steps/s and MB/s."""
+    counters: dict[str, float] = {}
+    for wid, w in rollup.get("per_worker", {}).items():
+        step = w.get("step")
+        if step:
+            counters[f"worker.{wid}.steps"] = step["count"]
+        counters[f"worker.{wid}.bytes_sent"] = w.get("bytes_sent", 0)
+        counters[f"worker.{wid}.bytes_received"] = w.get(
+            "bytes_received", 0)
+    return {"t": t if t is not None else time.time(),
+            "counters": counters, "gauges": {}, "histograms": {}}
+
+
+def render_watch_line(rates: dict | None, workers: int) -> str:
+    """One ``--watch`` tick: per-worker step rate + cluster wire MB/s."""
+    if rates is None:
+        return f"watch: {workers} workers reporting (collecting baseline)"
+    counters = rates.get("counters", {})
+    steps = {name.split(".")[1]: rate for name, rate in counters.items()
+             if name.startswith("worker.") and name.endswith(".steps")}
+    sent = sum(rate for name, rate in counters.items()
+               if name.endswith(".bytes_sent"))
+    received = sum(rate for name, rate in counters.items()
+                   if name.endswith(".bytes_received"))
+    step_part = (" ".join(f"w{wid}={rate:.2f}/s"
+                          for wid, rate in sorted(steps.items()))
+                 or "no steps")
+    return (f"watch dt={rates['dt_s']:.1f}s steps: {step_part} | wire: "
+            f"{sent / 1e6:.2f} MB/s out, {received / 1e6:.2f} MB/s in")
+
+
+def _watch_loop(coordinator_addr: str, interval_s: float,
+                max_ticks: int | None) -> int:
+    ring = TimeSeriesRing(capacity=64)
+    last_counters: dict | None = None
+    ticks = 0
+    with RpcClient(coordinator_addr, m.COORDINATOR_SERVICE,
+                   {**m.COORDINATOR_METHODS,
+                    **m.COORDINATOR_EXT_METHODS}) as coord:
+        while max_ticks is None or ticks < max_ticks:
+            if ticks:
+                time.sleep(interval_s)
+            ticks += 1
+            try:
+                rollup_json = coord.call(
+                    "GetClusterMetrics", m.ClusterMetricsRequest(),
+                    timeout=5.0).rollup_json
+            except grpc.RpcError as exc:
+                code = getattr(exc, "code", lambda: None)()
+                if code == grpc.StatusCode.UNIMPLEMENTED:
+                    print("watch unavailable: coordinator does not "
+                          "implement GetClusterMetrics (reference build?)")
+                    return 1
+                print(f"watch: coordinator unreachable ({code})")
+                continue
+            import json
+
+            rollup = json.loads(rollup_json) if rollup_json else {}
+            snap = rollup_to_snapshot(rollup)
+            # rates only across CHANGED snapshots: the rollup serves
+            # CACHED heartbeat snapshots (5 s cadence by default), so a
+            # faster poll would read byte-identical rollups as 0.00/s —
+            # indistinguishable from a real stall — and then cram the
+            # whole heartbeat interval's delta into one poll period.
+            # Skipping unchanged snapshots keeps dt the true spacing of
+            # fresh data; a genuinely stalled worker still shows 0.00/s
+            # because OTHER counters (heartbeats ride wire-byte totals)
+            # advance its snapshot.
+            if snap["counters"] != last_counters:
+                last_counters = snap["counters"]
+                ring.push(snap)
+            print(render_watch_line(ring.rates(),
+                                    len(rollup.get("per_worker", {}))),
+                  flush=True)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    # a status tool run with PSDT_FLIGHT_DIR exported must not deposit
+    # its own flight ring into the cluster's evidence directory
+    from ..obs import flight
+    flight.suppress_for_tool()
     positional, flags = parse_argv(argv)
     coordinator_addr = positional[0] if positional else "127.0.0.1:50052"
+
+    if "watch" in flags:
+        # bare --watch parses as "1" (parse_argv): a 1 s default cadence
+        interval = float(flags["watch"])
+        max_ticks = (int(flags["watch-count"])
+                     if "watch-count" in flags else None)
+        try:
+            return _watch_loop(coordinator_addr, interval, max_ticks)
+        except KeyboardInterrupt:
+            return 0
 
     want_metrics = "metrics" in flags or "metrics-json" in flags
     metrics_json = None

@@ -1,0 +1,73 @@
+"""``pst-trace``: cross-process iteration postmortems from flight rings.
+
+    pst-trace <flight_dir> [--iteration=N] [--json] [--chrome=out.json]
+                           [--list]
+
+Run every cluster process with ``PSDT_FLIGHT_DIR=<dir>`` (the flight
+recorder, obs/flight.py — always on, crash-surviving), then point this
+tool at the directory after the fact — the rings of processes that died
+by ``kill -9``/SIGSEGV decode like any other:
+
+- default: process listing (who shut down clean, who DIED), the failure
+  narrative (promotions, same-iteration failover retries, permanent
+  downgrades), and the last published iteration's end-to-end timeline
+  with its critical path and per-worker straggler attribution.
+- ``--iteration=N``: postmortem that iteration instead.
+- ``--json``: the same report as machine-readable JSON.
+- ``--chrome=out.json``: write a merged Chrome trace (flight events as
+  slices/instants, plus any PSDT_TRACE_FILE span dumps in the directory)
+  for Perfetto.
+- ``--list``: just the process/iteration inventory.
+
+See docs/observability.md ("Flight recorder", "pst-trace postmortems").
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from ..config import parse_argv, require_flag_value
+from ..obs import flight, postmortem
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    # PSDT_FLIGHT_DIR may still be exported from the shell that drove
+    # the cluster: this tool's own auto-enabled ring must not pollute
+    # the directory it is about to analyze
+    flight.suppress_for_tool()
+    require_flag_value(argv, "--chrome", "--iteration",
+                       hint="e.g. --chrome=merged.json")
+    positional, flags = parse_argv(argv)
+    if not positional:
+        print("usage: pst-trace <flight_dir> [--iteration=N] [--json] "
+              "[--chrome=out.json] [--list]", file=sys.stderr)
+        return 2
+    directory = positional[0]
+    iteration = int(flags["iteration"]) if "iteration" in flags else None
+
+    chrome_out = flags.get("chrome")
+    if chrome_out:
+        path = postmortem.export_chrome_trace(directory, str(chrome_out))
+        print(f"chrome trace written: {path}")
+        if "json" not in flags and "list" not in flags and iteration is None:
+            return 0
+
+    rep = postmortem.report(directory, iteration=iteration)
+    if not rep["processes"]:
+        print(f"no flight rings under {directory} (run the cluster with "
+              f"PSDT_FLIGHT_DIR={directory})", file=sys.stderr)
+        return 1
+    if "list" in flags:
+        rep.pop("timeline", None)
+        rep.pop("critical_path", None)
+    if "json" in flags:
+        print(json.dumps(rep, default=float))
+    else:
+        print(postmortem.render_report(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,7 @@ import time
 from typing import Callable, Sequence
 
 from ..analysis.lock_order import checked_lock
+from ..obs import flight
 from ..obs import stats as obs_stats
 from ..rpc.messages import WorkerStatus
 
@@ -174,6 +175,10 @@ class CoordinatorCore:
                         self._ps_address = host
                         self._ps_port = int(port)
                     self._obs_promotions.add()
+                    # the one place that knows which racing report caused
+                    # the swap — the postmortem's PROMOTION line
+                    flight.record("failover.promote", a=shard_index,
+                                  b=self._shard_epoch, note=entry.primary)
             return self._shard_epoch, [dataclasses.replace(e)
                                        for e in self._shard_map]
 
@@ -193,6 +198,8 @@ class CoordinatorCore:
             self._ps_address = host
             self._ps_port = int(port)
             self._ps_shards = tuple(e.primary for e in self._shard_map[1:])
+            flight.record("reshard.epoch", a=self._shard_epoch,
+                          b=len(self._shard_map))
             return self._shard_epoch
 
     def remove_stale_workers(self, timeout_s: float = 30.0) -> list[int]:

@@ -78,6 +78,7 @@ from typing import Callable, Mapping
 import numpy as np
 
 from ..analysis.lock_order import checked_lock
+from ..obs import flight
 from ..obs import stats as obs_stats
 from ..replication.messages import STALE_SHARD_MAP
 from .optimizer import HostOptimizer, SGD
@@ -563,6 +564,11 @@ class ParameterServerCore:
                 # late / close-attempted / already-committed worker: chunk
                 # is discarded (commit reports the push late or duplicate)
                 return stale_epoch
+            # flight evidence (sampled: one per chunk is the hottest
+            # event class): which worker reserved which fold when — the
+            # per-chunk arrival record a postmortem orders folds by
+            flight.record("fold.reserve", iteration=iteration,
+                          worker=worker_id, a=len(gradients))
             folded = state.folded.setdefault(worker_id, set())
             if self._stripes <= 1:
                 self._fold_into_locked(state, folded, gradients)
@@ -721,6 +727,12 @@ class ParameterServerCore:
                                   iteration, False,
                                   len(state.contributors), total)
             state.contributors.add(worker_id)
+            # the (iteration, worker) commit stamp: the postmortem's
+            # straggler attribution is the spread of these across workers,
+            # and the LAST one is the event that closes the barrier
+            flight.record("push.commit", iteration=iteration,
+                          worker=worker_id, a=len(state.contributors),
+                          b=total)
             received = self._maybe_aggregate_locked(iteration, state, total)
             if state.aggregated:
                 return PushResult(True, "aggregation complete", iteration,
@@ -754,6 +766,9 @@ class ParameterServerCore:
             state.worker_gradients[worker_id] = store
             state.buffer_bytes += delta
             self._grad_buffer_note(delta)
+            flight.record("push.commit", iteration=iteration,
+                          worker=worker_id,
+                          a=len(state.worker_gradients), b=total)
             received = self._maybe_aggregate_locked(iteration, state, total)
             if state.aggregated:
                 return PushResult(True, "aggregation complete", iteration,
@@ -775,12 +790,12 @@ class ParameterServerCore:
                     else len(state.worker_gradients))
         if state.aggregating or received < total or received == 0:
             return received
-        self._close_barrier_locked(iteration, state, received)
+        self._close_barrier_locked(iteration, state, received, total)
         return (state.workers_at_aggregation if state.aggregated
                 else received)
 
     def _close_barrier_locked(self, iteration: int, state: IterationState,
-                              received: int) -> None:
+                              received: int, total: int = 0) -> None:
         """Close the barrier.  Streaming mode: take the accumulator, flag
         the iteration "aggregating", RELEASE _state_lock for the O(model)
         scale-and-apply (serialized by _apply_lock), then reacquire to
@@ -794,6 +809,9 @@ class ParameterServerCore:
         state.aggregating = True  # set BEFORE the drain below: the wait
         # releases _state_lock, and a concurrent poll re-entering
         # _maybe_aggregate_locked must see the close already in flight
+        flight.record("barrier.seal", iteration=iteration, a=received,
+                      b=total)
+        inflight_at_seal = state.inflight
         try:
             if self._streaming:
                 while state.inflight:
@@ -804,7 +822,9 @@ class ParameterServerCore:
                     # while the cv wait has the lock released and
                     # notifies here)
                     self._barrier_cv.wait(0.05)
-                if not self._close_streaming_locked(state):
+                flight.record("barrier.drain", iteration=iteration,
+                              a=inflight_at_seal)
+                if not self._close_streaming_locked(state, iteration):
                     # a checkpoint restore landed inside the close window:
                     # the aggregate belongs to the pre-restore world —
                     # drop it and leave the (already-cleared) state
@@ -812,9 +832,13 @@ class ParameterServerCore:
                     state.aggregating = False
                     return
             else:
+                ta = time.perf_counter()
+                flight.record("apply.start", iteration=iteration)
                 if not self._apply_fused_mean_sgd(state.worker_gradients):
                     mean = _mean_over_workers(state.worker_gradients)
                     self._apply_update(mean)
+                flight.record("apply.end", iteration=iteration,
+                              a=int(1e6 * (time.perf_counter() - ta)))
                 state.worker_gradients.clear()  # free memory promptly
                 self._grad_buffer_note(-state.buffer_bytes)
                 state.buffer_bytes = 0
@@ -824,15 +848,19 @@ class ParameterServerCore:
             # gradients / the restored accumulator are still in place) and
             # the next push or sync poll re-fires the aggregation
             state.aggregating = False
+            flight.record("barrier.retry", iteration=iteration, a=received)
             raise
         state.aggregating = False
         state.aggregated = True
         state.workers_at_aggregation = received
         self._aggregated_watermark = max(self._aggregated_watermark, iteration)
         self._obs_barrier_close.observe(time.perf_counter() - t0)
+        flight.record("barrier.publish", iteration=iteration, a=received,
+                      b=total)
         self._barrier_cv.notify_all()  # wake fused-RPC barrier waiters
 
-    def _close_streaming_locked(self, state: IterationState) -> bool:
+    def _close_streaming_locked(self, state: IterationState,
+                                iteration: int = -1) -> bool:
         """The streaming half of the barrier close: take the accumulator,
         run the O(model) scale-and-apply outside _state_lock (serialized
         by _apply_lock), reacquire.  Returns False when a concurrent
@@ -859,9 +887,14 @@ class ParameterServerCore:
                         # stripe-parallel; a FULL scale pass completes
                         # before the apply so the put-back semantics on an
                         # apply failure stay exact (counts reset to 1)
+                        ta = time.perf_counter()
+                        flight.record("apply.start", iteration=iteration)
                         self._scale_striped(sums, counts)
                         scaled = True
                         self._apply_update(sums)
+                        flight.record(
+                            "apply.end", iteration=iteration,
+                            a=int(1e6 * (time.perf_counter() - ta)))
                         if self._on_apply is not None:
                             # replication hook, still under _apply_lock
                             # (BLOCKING_ALLOWED): sync mode ships the
@@ -1211,6 +1244,8 @@ class ParameterServerCore:
             self._grad_buffer_bytes = 0
             self._aggregated_watermark = -1
             self._bootstrap_iteration = None
+            flight.record("ckpt.restore", iteration=int(iteration),
+                          a=int(epoch))
 
     # ------------------------------------------------------------ replication
     def set_replication_hook(self, hook: Callable[[], None] | None) -> None:
@@ -1340,6 +1375,10 @@ class ParameterServerCore:
                 # a stripe can move back here on a later merge reshard
                 self._retired.pop(name, None)
             self._serving = None
+            flight.record(
+                "repl.install" if replace else "reshard.install",
+                iteration=(int(iteration) if iteration is not None else -1),
+                a=store_nbytes(store), b=version)
             self._barrier_cv.notify_all()
         return version
 
@@ -1408,6 +1447,8 @@ class ParameterServerCore:
                 if freed:
                     state.buffer_bytes -= freed
                     self._grad_buffer_note(-freed)
+            flight.record("reshard.fence", iteration=self._current_iteration,
+                          a=len(moved), b=int(map_epoch))
             return (self._epoch, self._current_iteration, version, moved,
                     moved_opt)
 

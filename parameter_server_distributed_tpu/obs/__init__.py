@@ -14,15 +14,23 @@ prints — SURVEY.md §5):
   RPC endpoint, step phase, and serving loop reports here;
 - :mod:`~parameter_server_distributed_tpu.obs.export` — workers piggyback
   registry snapshots on heartbeats, the coordinator aggregates them
-  per-worker, and ``pst-status --metrics`` prints the cluster rollup.
+  per-worker, and ``pst-status --metrics`` prints the cluster rollup;
+- :mod:`~parameter_server_distributed_tpu.obs.flight` — the
+  crash-surviving flight recorder: an always-on mmap-backed event ring
+  per process under ``PSDT_FLIGHT_DIR``, decodable after ``kill -9``;
+- :mod:`~parameter_server_distributed_tpu.obs.postmortem` — merges the
+  rings of all processes (dead ones included) into cross-process
+  iteration postmortems with critical-path/straggler attribution; the
+  ``pst-trace`` CLI renders them.
 
 ``utils/metrics.py`` (StepTimer, MetricsLogger, profile_trace) folded in
 here; the old module re-exports for backward compatibility.
 """
 
-from . import export, stats, trace
+from . import export, flight, postmortem, stats, trace
 from .stats import (MetricsLogger, StepTimer, profile_trace,
                     samples_per_sec)
 
-__all__ = ["trace", "stats", "export", "StepTimer", "MetricsLogger",
-           "profile_trace", "samples_per_sec"]
+__all__ = ["trace", "stats", "export", "flight", "postmortem",
+           "StepTimer", "MetricsLogger", "profile_trace",
+           "samples_per_sec"]

@@ -1,0 +1,455 @@
+"""Cross-process iteration postmortems over flight rings (``pst-trace``).
+
+The flight recorder (:mod:`obs.flight`) leaves one mmap-backed ring per
+process under ``PSDT_FLIGHT_DIR`` — including for processes that died by
+``kill -9`` or SIGSEGV.  This module merges them (plus any Chrome-trace
+dumps the span layer wrote via ``PSDT_TRACE_FILE``) and reconstructs what
+actually happened:
+
+- **process listing** — every ring's role/pid, whether it shut down clean
+  or DIED (header ``clean`` flag), how much history the ring wrapped
+  away, and any faulthandler crash sidecar.
+- **iteration timeline** — all events of iteration N keyed by
+  ``(iteration, worker)``, time-ordered across processes: worker step
+  legs, per-worker push commits, the PS barrier phases
+  (seal → drain → apply → publish), replication ships/installs, failover
+  reports/promotions, reshard fences.
+- **critical path + straggler attribution** — the barrier closes when the
+  LAST worker commits; the path from that worker's step start through
+  seal/drain/apply to publish is the iteration's critical path, and the
+  commit spread across workers is the straggler attribution the elastic
+  K-of-N policy (ROADMAP item 1) needs per-worker, per-phase.
+- **failure narrative** — dead processes, failover promotions (which
+  shard, which new primary, at which epoch) and the worker-side retries
+  of the same iteration that made the failover invisible to training.
+
+Renders: text (:func:`render_report`), JSON (:func:`report`), and a
+merged Chrome trace (:func:`chrome_events` — paired ``*.start``/``*.end``
+events become duration slices, everything else instants) that loads in
+Perfetto next to the span layer's own dumps.
+
+Wall clocks: rings merge on ``time.time()`` stamps, which is exact for
+same-host postmortems (the chaos drives and tests) and as good as NTP
+across hosts — good enough to order millisecond-scale barrier phases in
+practice; the per-process ``seq`` breaks ties.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Iterable
+
+from . import flight
+
+# ------------------------------------------------------------------- loading
+
+
+def load_rings(directory: str) -> list[dict]:
+    """Decode every ``flight-*.ring`` under ``directory`` (skipping
+    unreadable/foreign files with a note instead of dying — a postmortem
+    tool must not crash on a half-written artifact) and attach any
+    ``crash-<pid>.txt`` faulthandler sidecar."""
+    rings: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(directory, "flight-*.ring"))):
+        try:
+            ring = flight.decode_ring(path)
+        except (OSError, ValueError) as exc:
+            rings.append({"path": path, "error": str(exc), "events": [],
+                          "pid": 0, "role": "?", "clean": False,
+                          "dropped": 0})
+            continue
+        # clean=0 means "no clean shutdown RECORDED" — which is also the
+        # steady state of a process still running.  A same-host liveness
+        # probe (signal 0) separates "still running" from "DIED"; rings
+        # merge same-host by design (module docstring), and a recycled
+        # pid at worst reports a dead process as running, never the
+        # reverse.
+        ring["alive"] = False
+        if not ring["clean"] and ring["pid"]:
+            try:
+                os.kill(int(ring["pid"]), 0)
+                ring["alive"] = True
+            except ProcessLookupError:
+                pass
+            except (PermissionError, OSError):
+                ring["alive"] = True  # exists, not ours
+        crash = os.path.join(directory, f"crash-{ring['pid']}.txt")
+        try:
+            if os.path.getsize(crash) > 0:
+                with open(crash, errors="replace") as fh:
+                    ring["crash"] = fh.read()
+        except OSError:
+            pass
+        rings.append(ring)
+    return rings
+
+
+def merge_events(rings: Iterable[dict]) -> list[dict]:
+    """All rings' events in one wall-clock-ordered list, each stamped
+    with its source pid/role (per-process seq breaks same-stamp ties)."""
+    merged: list[dict] = []
+    for ring in rings:
+        for ev in ring.get("events", ()):
+            ev = dict(ev)
+            ev["pid"] = ring.get("pid", 0)
+            ev["role"] = ring.get("role", "?")
+            merged.append(ev)
+    merged.sort(key=lambda e: (e["ts"], e["pid"], e["seq"]))
+    return merged
+
+
+# ------------------------------------------------------------ reconstruction
+
+
+def iterations_seen(events: Iterable[dict]) -> list[int]:
+    return sorted({e["iteration"] for e in events if e["iteration"] >= 0})
+
+
+def _pairs(events: list[dict], start: str, end: str,
+           key=lambda e: (e["pid"], e["tid"], e["iteration"],
+                          e["worker"]),
+           return_open: bool = False):
+    """Match ``start``/``end`` events into intervals per (process,
+    thread, iteration, worker) — nearest-start wins, unmatched ends
+    dropped.  A crash between start and end leaves an OPEN interval;
+    ``return_open=True`` additionally returns those unmatched starts —
+    the "in flight at death" evidence the Chrome export must not lose."""
+    open_by_key: dict[tuple, list[dict]] = {}
+    out: list[tuple[dict, dict]] = []
+    for ev in events:
+        if ev["event"] == start:
+            open_by_key.setdefault(key(ev), []).append(ev)
+        elif ev["event"] == end:
+            stack = open_by_key.get(key(ev))
+            if stack:
+                out.append((stack.pop(), ev))
+    if return_open:
+        opens = [ev for stack in open_by_key.values() for ev in stack]
+        return out, opens
+    return out
+
+
+def iteration_timeline(events: list[dict], iteration: int) -> dict:
+    """Everything that happened to ``iteration``, reconstructed across
+    processes.  Returns a JSON-able dict; see :func:`render_report` for
+    the human view."""
+    evs = [e for e in events if e["iteration"] == iteration]
+    commits = [e for e in evs if e["event"] == "push.commit"]
+    publishes = [e for e in evs if e["event"] == "barrier.publish"]
+    seals = [e for e in evs if e["event"] == "barrier.seal"]
+    drains = [e for e in evs if e["event"] == "barrier.drain"]
+    applies = _pairs(evs, "apply.start", "apply.end",
+                     key=lambda e: (e["pid"], e["iteration"]))
+    retries = [e for e in evs if e["event"] == "failover.retry"]
+    # per-worker legs: step + fused/push spans and this worker's commit.
+    # Commits are counted PER SOURCE PID: under the sharded topology a
+    # worker legitimately commits once on every shard's barrier, so
+    # "retried" means >1 commit on the SAME shard process (a replay the
+    # dedup absorbed), never the normal per-shard fan-out.
+    workers: dict[int, dict] = {}
+    commits_by_pid: dict[tuple[int, int], int] = {}
+    for ev in evs:
+        wid = ev["worker"]
+        if wid < 0:
+            continue
+        w = workers.setdefault(wid, {"events": 0})
+        w["events"] += 1
+        if ev["event"] == "step.start":
+            w["step_start"] = ev["ts"]
+        elif ev["event"] == "step.end":
+            w["step_end"] = ev["ts"]
+        elif ev["event"] == "push.commit":
+            # the LAST commit wins: a failover retry of the same
+            # iteration commits again (dedup makes it idempotent)
+            w["commit"] = ev["ts"]
+            key = (wid, ev["pid"])
+            commits_by_pid[key] = commits_by_pid.get(key, 0) + 1
+        elif ev["event"] == "failover.retry":
+            w["failover_retry"] = ev["note"]
+    for (wid, _pid), n in commits_by_pid.items():
+        w = workers[wid]
+        w["commits"] = max(w.get("commits", 0), n)
+    out: dict[str, Any] = {"iteration": iteration, "workers": workers,
+                           "events": len(evs)}
+    if commits:
+        first, last = commits[0], commits[-1]
+        out["first_commit"] = {"worker": first["worker"], "ts": first["ts"]}
+        out["last_commit"] = {"worker": last["worker"], "ts": last["ts"]}
+        out["commit_spread_s"] = last["ts"] - first["ts"]
+        out["straggler"] = last["worker"]
+    if seals:
+        out["seal_ts"] = seals[0]["ts"]
+    if drains:
+        out["drained_folds"] = drains[0]["a"]
+    if applies:
+        start, end = applies[0]
+        out["apply_s"] = end["a"] / 1e6
+        out["apply_ts"] = start["ts"]
+    if publishes:
+        pub = publishes[-1]
+        out["publish_ts"] = pub["ts"]
+        out["contributors"] = pub["a"]
+        out["barrier_width"] = pub["b"]
+    if retries:
+        out["failover_retries"] = [
+            {"worker": e["worker"], "shard": e["a"], "to": e["note"]}
+            for e in retries]
+    # replication/reshard activity attributed to this iteration
+    ships = [e for e in evs if e["event"] == "repl.ship.end"]
+    if ships:
+        out["replica_ships"] = len(ships)
+    installs = [e for e in evs if e["event"] == "repl.install"]
+    if installs:
+        out["replica_installs"] = [
+            {"role": e["role"], "bytes": e["a"], "version": e["b"]}
+            for e in installs]
+    return out
+
+
+def critical_path(events: list[dict], iteration: int,
+                  timeline: dict | None = None) -> list[dict]:
+    """The ordered chain of events that gated ``iteration``'s barrier
+    close: the straggler's step start → its push commit → seal → drain →
+    apply → publish, each with its delta to the previous link.  Empty
+    when the iteration never published.  ``timeline`` (an
+    :func:`iteration_timeline` result) avoids recomputing it."""
+    tl = timeline if timeline is not None \
+        else iteration_timeline(events, iteration)
+    if "publish_ts" not in tl or "last_commit" not in tl:
+        return []
+    straggler = tl["last_commit"]["worker"]
+    w = tl["workers"].get(straggler, {})
+    chain: list[tuple[str, float]] = []
+    if "step_start" in w:
+        chain.append((f"worker {straggler} step start", w["step_start"]))
+    chain.append((f"worker {straggler} push commit (closes barrier)",
+                  tl["last_commit"]["ts"]))
+    if "seal_ts" in tl:
+        chain.append(("barrier seal", tl["seal_ts"]))
+    if "apply_ts" in tl:
+        chain.append(("optimizer apply", tl["apply_ts"]))
+    chain.append(("barrier publish", tl["publish_ts"]))
+    chain.sort(key=lambda c: c[1])
+    out = []
+    prev_ts = chain[0][1]
+    for name, ts in chain:
+        out.append({"what": name, "ts": ts, "dt_s": ts - prev_ts})
+        prev_ts = ts
+    return out
+
+
+def failure_narrative(rings: list[dict], events: list[dict]) -> dict:
+    """Dead processes, promotions, and same-iteration failover retries —
+    the across-iterations story pst-trace leads with."""
+    dead = [{"role": r.get("role", "?"), "pid": r.get("pid", 0),
+             "path": r.get("path", ""),
+             "crash_traceback": bool(r.get("crash"))}
+            for r in rings if not r.get("clean") and not r.get("alive")
+            and not r.get("error")]
+    promotions = [{"shard": e["a"], "epoch": e["b"], "new_primary": e["note"],
+                   "ts": e["ts"], "role": e["role"]}
+                  for e in events if e["event"] == "failover.promote"]
+    reports = [{"worker": e["worker"], "shard": e["a"], "dead": e["note"]}
+               for e in events if e["event"] == "failover.report"]
+    retries = [{"worker": e["worker"], "iteration": e["iteration"],
+                "shard": e["a"], "to": e["note"]}
+               for e in events if e["event"] == "failover.retry"]
+    degrades = [{"role": e["role"], "what": e["event"], "note": e["note"]}
+                for e in events
+                if e["event"] in ("repl.degrade", "shm.downgrade")]
+    out: dict[str, Any] = {}
+    if dead:
+        out["dead_processes"] = dead
+    if promotions:
+        out["promotions"] = promotions
+    if reports:
+        out["failure_reports"] = reports
+    if retries:
+        out["failover_retries"] = retries
+    if degrades:
+        out["degrades"] = degrades
+    return out
+
+
+def report(directory: str, iteration: int | None = None) -> dict:
+    """The full postmortem as JSON-able data: process listing, failure
+    narrative, and the timeline + critical path of ``iteration``
+    (default: the last iteration that published a barrier, else the last
+    seen)."""
+    rings = load_rings(directory)
+    events = merge_events(rings)
+    published = sorted({e["iteration"] for e in events
+                        if e["event"] == "barrier.publish"})
+    seen = iterations_seen(events)
+    if iteration is None:
+        iteration = (published[-1] if published
+                     else (seen[-1] if seen else -1))
+    out = {
+        "directory": directory,
+        "processes": [{
+            "role": r.get("role", "?"), "pid": r.get("pid", 0),
+            "clean": r.get("clean", False),
+            "alive": r.get("alive", False),
+            "events": len(r.get("events", ())),
+            "dropped": r.get("dropped", 0),
+            **({"error": r["error"]} if r.get("error") else {}),
+            **({"crash": True} if r.get("crash") else {}),
+        } for r in rings],
+        "iterations": {"seen": seen[:200], "published": published[:200]},
+        "narrative": failure_narrative(rings, events),
+    }
+    if iteration >= 0:
+        out["iteration"] = iteration
+        tl = iteration_timeline(events, iteration)
+        out["timeline"] = tl
+        out["critical_path"] = critical_path(events, iteration,
+                                             timeline=tl)
+    return out
+
+
+# ------------------------------------------------------------------- renders
+
+
+def _fmt_dt(s: float) -> str:
+    return f"{s * 1e3:.2f}ms" if abs(s) < 1.0 else f"{s:.3f}s"
+
+
+def render_report(rep: dict) -> str:
+    """Human text view of :func:`report` — what pst-trace prints."""
+    lines = [f"flight postmortem: {rep['directory']}"]
+    for p in rep["processes"]:
+        if p["clean"]:
+            status = "clean exit"
+        elif p.get("alive"):
+            status = "still running"
+        else:
+            status = "DIED (no clean shutdown)"
+        extra = ""
+        if p.get("crash"):
+            extra += ", fatal-signal traceback captured"
+        if p.get("dropped"):
+            extra += f", ring wrapped ({p['dropped']} events lost)"
+        if p.get("error"):
+            status, extra = f"unreadable: {p['error']}", ""
+        lines.append(f"  {p['role']} (pid {p['pid']}): {status}, "
+                     f"{p['events']} events{extra}")
+    seen = rep["iterations"]["seen"]
+    published = rep["iterations"]["published"]
+    lines.append(f"  iterations: {len(seen)} seen, "
+                 f"{len(published)} published barriers")
+    narrative = rep.get("narrative", {})
+    for promo in narrative.get("promotions", ()):
+        lines.append(f"  PROMOTION: shard {promo['shard']} -> "
+                     f"{promo['new_primary']} at map epoch {promo['epoch']} "
+                     f"({promo['role']})")
+    for retry in narrative.get("failover_retries", ()):
+        lines.append(f"  RETRIED ITERATION: worker {retry['worker']} "
+                     f"retried iteration {retry['iteration']} against "
+                     f"{retry['to']} (shard {retry['shard']})")
+    for d in narrative.get("degrades", ()):
+        lines.append(f"  degrade: {d['what']} at {d['role']} ({d['note']})")
+    tl = rep.get("timeline")
+    if tl:
+        lines.append(f"iteration {rep['iteration']}:")
+        if "barrier_width" in tl:
+            lines.append(f"  barrier: {tl.get('contributors', '?')}/"
+                         f"{tl['barrier_width']} contributors, "
+                         f"commit spread "
+                         f"{_fmt_dt(tl.get('commit_spread_s', 0.0))}"
+                         + (f", straggler worker {tl['straggler']}"
+                            if "straggler" in tl else ""))
+        if "apply_s" in tl:
+            lines.append(f"  optimizer apply: {_fmt_dt(tl['apply_s'])}")
+        for wid in sorted(tl.get("workers", {})):
+            w = tl["workers"][wid]
+            parts = []
+            if "step_start" in w and "step_end" in w:
+                parts.append(
+                    f"step {_fmt_dt(w['step_end'] - w['step_start'])}")
+            elif "step_start" in w:
+                parts.append("step OPEN (in flight at death?)")
+            if w.get("commits", 0) > 1:
+                parts.append(f"{w['commits']} commits (retried)")
+            if "failover_retry" in w:
+                parts.append(f"failed over to {w['failover_retry']}")
+            lines.append(f"  worker {wid}: "
+                         + (", ".join(parts) if parts
+                            else f"{w['events']} events"))
+        path = rep.get("critical_path") or []
+        if path:
+            lines.append("  critical path to barrier close:")
+            for link in path:
+                lines.append(f"    +{_fmt_dt(link['dt_s'])} {link['what']}")
+    return "\n".join(lines)
+
+
+def chrome_events(events: list[dict]) -> list[dict]:
+    """Flight events as Chrome-trace events: paired ``*.start``/``*.end``
+    become ``ph="X"`` duration slices, everything else ``ph="i"``
+    instants.  pid/tid lanes match the span layer's own dumps, so the
+    merged file lines flight evidence up under the spans in Perfetto."""
+    out: list[dict] = []
+    starts = {name[:-6] for name in flight.EVENTS if name.endswith(".start")}
+    paired = {base for base in starts if f"{base}.end" in flight.EVENTS}
+    for base in paired:
+        matched, opens = _pairs(events, f"{base}.start", f"{base}.end",
+                                return_open=True)
+        for start, end in matched:
+            out.append({
+                "name": base, "ph": "X", "cat": "flight",
+                "ts": start["ts"] * 1e6,
+                "dur": max(end["ts"] - start["ts"], 1e-7) * 1e6,
+                "pid": start["pid"], "tid": start["tid"],
+                "args": {k: start[k] for k in
+                         ("iteration", "worker", "a", "b", "note")
+                         if start.get(k) not in (None, "", -1)},
+            })
+        for start in opens:
+            # an operation in flight when the process died (or when the
+            # ring was snapshotted): exactly the crash-point evidence —
+            # render as a marked instant, never drop it
+            out.append({
+                "name": f"{base} (open)", "ph": "i", "cat": "flight",
+                "s": "p", "ts": start["ts"] * 1e6,
+                "pid": start["pid"], "tid": start["tid"],
+                "args": {k: start[k] for k in
+                         ("iteration", "worker", "a", "b", "note")
+                         if start.get(k) not in (None, "", -1)},
+            })
+    instant = {f"{b}.start" for b in paired} | {f"{b}.end" for b in paired}
+    for ev in events:
+        if ev["event"] in instant:
+            continue
+        out.append({
+            "name": ev["event"], "ph": "i", "cat": "flight", "s": "p",
+            "ts": ev["ts"] * 1e6, "pid": ev["pid"], "tid": ev["tid"],
+            "args": {k: ev[k] for k in
+                     ("iteration", "worker", "a", "b", "note")
+                     if ev.get(k) not in (None, "", -1)},
+        })
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def export_chrome_trace(directory: str, out_path: str) -> str:
+    """Merged Chrome trace of the directory's flight rings PLUS any span
+    dumps (``*.json`` written by ``PSDT_TRACE_FILE``) in the same
+    directory — the one-file Perfetto view of a postmortem."""
+    events = chrome_events(merge_events(load_rings(directory)))
+    for path in sorted(glob.glob(os.path.join(directory, "*.json"))):
+        if os.path.abspath(path) == os.path.abspath(out_path):
+            continue
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+            events.extend(doc["traceEvents"] if isinstance(doc, dict)
+                          else doc)
+        except (OSError, ValueError, KeyError):
+            continue  # not a chrome trace: skip, don't die
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return out_path

@@ -183,6 +183,81 @@ class Registry:
 REGISTRY = Registry()
 
 
+class TimeSeriesRing:
+    """Bounded ring of timestamped registry snapshots, and the rate/delta
+    math between them — what ``pst-status --watch`` renders (ISSUE 8).
+
+    Snapshots are the same plain-JSON shape :meth:`Registry.snapshot`
+    emits (and that heartbeats carry), so the ring works identically over
+    a local registry or over rollup snapshots fetched from the
+    coordinator.  ``push`` stamps ``t`` if absent; :meth:`rates` derives
+    per-second counter rates, histogram observation rates, and gauge
+    values between the two most recent snapshots (or any pair)."""
+
+    def __init__(self, capacity: int = 64):
+        from collections import deque
+
+        from ..analysis.lock_order import checked_lock
+
+        # leaf (analysis/lock_order.py): guards only the deque
+        self._lock = checked_lock("TimeSeriesRing._lock")
+        self._snaps: deque = deque(maxlen=max(2, int(capacity)))
+
+    def push(self, snap: dict) -> dict:
+        snap = dict(snap)
+        snap.setdefault("t", time.time())
+        with self._lock:
+            self._snaps.append(snap)
+        return snap
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._snaps)
+
+    def last(self, n: int = 1) -> list[dict]:
+        with self._lock:
+            return list(self._snaps)[-n:]
+
+    def rates(self) -> dict | None:
+        """Deltas between the two newest snapshots, or None until two
+        exist."""
+        pair = self.last(2)
+        if len(pair) < 2:
+            return None
+        return snapshot_rates(pair[0], pair[1])
+
+
+def snapshot_rates(prev: dict, cur: dict) -> dict:
+    """Per-second rates between two registry snapshots: counters become
+    ``delta/dt``, histograms become observation rates (count delta/dt)
+    with the interval mean, gauges pass through at their current value.
+    Counters that went BACKWARD (process restart) report the current
+    value over dt — a restart reads as a burst, not a negative rate."""
+    dt = max(1e-9, float(cur.get("t", 0.0)) - float(prev.get("t", 0.0)))
+    counters = {}
+    for name, value in cur.get("counters", {}).items():
+        before = prev.get("counters", {}).get(name, 0)
+        delta = value - before if value >= before else value
+        # zero rates are kept, deliberately: a STALLED worker showing
+        # 0.00/s is exactly the signal --watch exists to surface —
+        # eliding it would be indistinguishable from the worker not
+        # being part of the cluster at all
+        counters[name] = delta / dt
+    hists = {}
+    for name, h in cur.get("histograms", {}).items():
+        count = h.get("count", 0)
+        ph = prev.get("histograms", {}).get(name, {})
+        pcount = ph.get("count", 0)
+        dcount = count - pcount if count >= pcount else count
+        if not dcount:
+            continue
+        dsum = (h.get("sum", 0.0) - ph.get("sum", 0.0)
+                if count >= pcount else h.get("sum", 0.0))
+        hists[name] = {"per_s": dcount / dt, "mean": dsum / dcount}
+    return {"dt_s": dt, "t": cur.get("t"), "counters": counters,
+            "histograms": hists, "gauges": dict(cur.get("gauges", {}))}
+
+
 def counter(name: str) -> Counter:
     return REGISTRY.counter(name)
 

@@ -28,6 +28,7 @@ import time
 
 import grpc
 
+from ..obs import flight
 from ..obs import stats as obs_stats
 from ..rpc import messages as m
 from ..rpc.service import RpcClient
@@ -113,6 +114,8 @@ class ShardMapClient:
         if self._supported is False:
             return None
         self._obs_failovers.add()
+        flight.record("failover.report", worker=self.worker_id,
+                      a=shard_index, note=observed_primary)
         with self._lock:
             epoch = self.epoch
         try:

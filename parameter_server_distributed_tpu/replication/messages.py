@@ -14,7 +14,7 @@ carry replication traffic exactly as they carry the training data plane.
 
 from __future__ import annotations
 
-from ..rpc.messages import Tensor
+from ..rpc.messages import TRACE_FIELD_NUMBER, Tensor
 from ..rpc.wire import Field, Message
 
 # Marker the PS embeds in a push rejection when the push touched tensors
@@ -46,6 +46,12 @@ class ReplicaDeltaChunk(Message):
         Field(3, "params_version", "int64"),
         Field(4, "kind", "int32"),
         Field(5, "tensors", "message", message_type=Tensor, repeated=True),
+        # span propagation (obs/trace.py): the primary's replication ship
+        # joins the barrier-close trace, so failover/replication legs
+        # render in the merged Chrome trace.  Same field number as the
+        # reference-message extension; these messages are NOT in the wire
+        # manifest (extension RPC), so adding it is compat-free.
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
     )
 
 
@@ -60,7 +66,10 @@ class ReplicaAck(Message):
 
 class ReplicaStateRequest(Message):
     """``names`` empty = the full store."""
-    FIELDS = (Field(1, "names", "string", repeated=True),)
+    FIELDS = (
+        Field(1, "names", "string", repeated=True),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
+    )
 
 
 class ReplicaStateChunk(Message):
@@ -85,11 +94,12 @@ class RetireTensorsRequest(Message):
     FIELDS = (
         Field(1, "names", "string", repeated=True),
         Field(2, "map_epoch", "int32"),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
     )
 
 
 class ReplicaStatusRequest(Message):
-    FIELDS = ()
+    FIELDS = (Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),)
 
 
 class ReplicaStatusResponse(Message):
@@ -132,7 +142,9 @@ class WireShardMapEntry(Message):
 
 
 class ShardMapRequest(Message):
-    FIELDS = ()
+    # trace context (obs/trace.py): a worker's map refresh during a
+    # failover joins the step trace that triggered it
+    FIELDS = (Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),)
 
 
 class ShardMapResponse(Message):
@@ -154,6 +166,7 @@ class ShardFailureReport(Message):
         Field(2, "observed_primary", "string"),
         Field(3, "epoch", "int32"),
         Field(4, "worker_id", "int32"),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
     )
 
 

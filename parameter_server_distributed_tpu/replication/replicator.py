@@ -57,6 +57,7 @@ import numpy as np
 from ..analysis.lock_order import checked_lock
 from ..core.stripes import stripe_of
 from ..core.tensor import TensorStore, from_wire, store_nbytes, to_wire
+from ..obs import flight
 from ..obs import stats as obs_stats
 from ..rpc import messages as m
 from ..rpc.data_plane import split_tensors, stream_chunk_bytes
@@ -273,6 +274,8 @@ class Replicator:
                 "replication to %s degraded permanently after %d "
                 "consecutive failures — training continues UNREPLICATED",
                 self.backup_address, self._transient_failures)
+            flight.record("repl.degrade", a=self._transient_failures,
+                          note="transport failures")
             self._degraded = True
 
     def _loop(self) -> None:
@@ -304,6 +307,8 @@ class Replicator:
             nbytes = store_nbytes(store)
             self._obs_lag.set(nbytes)
             t0 = time.perf_counter()
+            flight.record("repl.ship.start", iteration=iteration,
+                          a=nbytes, b=version)
             try:
                 ack = self._client.call(
                     "PushReplicaDelta",
@@ -317,6 +322,7 @@ class Replicator:
                     # reference PS as backup: no replication, ever
                     log.warning("backup %s does not implement replication; "
                                 "degrading permanently", self.backup_address)
+                    flight.record("repl.degrade", note="UNIMPLEMENTED")
                     self._obs_fallback.add()
                     self._degraded = True
                     return
@@ -326,10 +332,16 @@ class Replicator:
                 # advanced past us — we are the zombie): stop shipping
                 log.warning("backup %s refused delta: %s — degrading "
                             "permanently", self.backup_address, ack.message)
+                flight.record("repl.ack", iteration=iteration, a=0,
+                              b=version, note=ack.message)
+                flight.record("repl.degrade", note="sink refused")
                 self._obs_fallback.add()
                 self._degraded = True
                 return
             self._obs_ship_s.observe(time.perf_counter() - t0)
+            flight.record("repl.ack", iteration=iteration, a=1, b=version)
+            flight.record("repl.ship.end", iteration=iteration,
+                          a=int(1e6 * (time.perf_counter() - t0)), b=version)
             self._obs_shipped.add(nbytes)
             self._obs_lag.set(0)
             self._last_shipped_version = version
@@ -382,6 +394,8 @@ class ReplicaSink:
                     # mark on its own — it was PROMOTED; the sender is a
                     # zombie ex-primary whose state would rewind live
                     # training
+                    flight.record("repl.refuse", iteration=iteration,
+                                  b=version, note="zombie delta")
                     return rmsg.ReplicaAck(
                         success=False,
                         message="replica promoted (local aggregation "

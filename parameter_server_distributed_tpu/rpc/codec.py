@@ -40,6 +40,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import native
+from ..obs import flight
 from ..obs import stats as obs_stats
 
 # Wire encodings for Tensor payloads.  WIRE_F32 is the reference encoding
@@ -267,5 +268,8 @@ def active_codec() -> Codec:
     codec: Codec = _NATIVE if native.lib() is not None else _PYTHON
     if codec is not _last:
         _gauge.set(1.0 if codec is _NATIVE else 0.0)
+        # flight evidence: which codec this process resolved (and every
+        # flip — a mid-run native failure downgrade is a postmortem clue)
+        flight.record("codec.select", a=1 if codec is _NATIVE else 0)
         _last = codec
     return codec

@@ -45,7 +45,9 @@ from typing import Iterable, Iterator, Sequence
 
 import grpc
 
+from ..obs import flight
 from ..obs import stats as obs_stats
+from ..obs import trace as obs_trace
 from . import messages as m
 from . import shm_transport
 # The wire payload codec (ISSUE 6): every packed tensor payload on this
@@ -278,12 +280,14 @@ class PSClient(RpcClient):
                 # reference PS: no such method, TCP forever
                 self._shm_ok = False
                 self._obs_shm_fallback.add()
+                flight.record("shm.downgrade", note="UNIMPLEMENTED")
             return None
         if not resp.accepted:
             log.info("shm transport refused by %s: %s", self._target,
                      resp.message)
             self._shm_ok = False
             self._obs_shm_fallback.add()
+            flight.record("shm.downgrade", note="refused")
             return None
         try:
             self._shm_conn = shm_transport.ShmClientConnection(
@@ -295,10 +299,12 @@ class PSClient(RpcClient):
             log.warning("shm segment attach failed (%s); using TCP", exc)
             self._shm_ok = False
             self._obs_shm_fallback.add()
+            flight.record("shm.downgrade", note="attach failed")
             return None
         self._shm_ok = True
         log.info("shm transport active to %s (ring %d MB x2)",
                  self._target, int(resp.ring_bytes) >> 20)
+        flight.record("shm.attach", b=int(resp.ring_bytes))
         return self._shm_conn
 
     # ------------------------------------------------------------------ push
@@ -386,26 +392,45 @@ class PSClient(RpcClient):
             # a shm round IS a fused PushPullStream round, just not over
             # gRPC: count it under the same call/latency instruments so
             # rounds-per-step accounting stays transport-independent
-            # (payload bytes land in rpc.shm.bytes instead)
+            # (payload bytes land in rpc.shm.bytes instead), give it the
+            # same client span, and stamp the trace context on every
+            # chunk — the ring transport bypasses RpcClient.call, which
+            # is where the field-999 plumbing normally happens
             calls, latency, _ = self._instruments["PushPullStream"]
             calls.add()
             t0 = time.perf_counter()
+            flight.record("rpc.cli.start", note="PushPull/shm")
+            ok = False
             try:
-                frames = conn.round_trip(
-                    (chunk.encode() for chunk in chunks()), timeout)
-                result = self._assemble_fused(
-                    (m.PushPullResponse.decode(memoryview(f))
-                     for f in frames), on_chunk)
+                with obs_trace.span("rpc/client/PushPullStream",
+                                    target=self._target, transport="shm"):
+                    ctx = obs_trace.wire_context()
+
+                    def encoded_frames() -> Iterator[bytes]:
+                        for chunk in chunks():
+                            if ctx:
+                                chunk.trace_context = ctx
+                            yield chunk.encode()
+
+                    frames = conn.round_trip(encoded_frames(), timeout)
+                    result = self._assemble_fused(
+                        (m.PushPullResponse.decode(memoryview(f))
+                         for f in frames), on_chunk)
                 # the server just proved it speaks the fused protocol
                 self._fused_ok = True
+                ok = True
                 return result
             except shm_transport.ShmTransportError as exc:
                 log.warning("shm fused round failed (%s); permanently "
                             "downgrading %s to TCP", exc, self._target)
+                flight.record("shm.downgrade", note="round failed")
                 self._obs_shm_fallback.add()
                 self._drop_shm()
             finally:
                 latency.observe(time.perf_counter() - t0)
+                flight.record("rpc.cli.end",
+                              a=int(1e6 * (time.perf_counter() - t0)),
+                              b=1 if ok else 0, note="PushPull/shm")
 
         try:
             result = self._assemble_fused(

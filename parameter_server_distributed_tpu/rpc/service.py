@@ -30,6 +30,7 @@ from typing import Any, Callable, Iterator, Mapping
 
 import grpc
 
+from ..obs import flight
 from ..obs import stats as obs_stats
 from ..obs import trace as obs_trace
 from .wire import Message
@@ -69,10 +70,18 @@ def _instrument_handler(behavior: Callable, method: str, style: str):
     latency = obs_stats.histogram(f"rpc.server.{method}.latency_s")
     span_name = f"rpc/server/{method}"
 
+    def flight_end(t0: float) -> None:
+        # both-ends flight evidence: the handler's end stamp with its
+        # wall time — a crash mid-handler leaves the start stamp open,
+        # which is exactly the "in flight at death" witness
+        flight.record("rpc.srv.end", a=int(1e6 * (time.perf_counter() - t0)),
+                      note=method)
+
     if style == "stream_unary":
         def stream_unary(request_iterator, context):
             calls.add()
             t0 = time.perf_counter()
+            flight.record("rpc.srv.start", note=method)
             # the remote context arrives on the FIRST chunk, after the
             # handler has started — SpanHolder defers adoption
             holder = obs_trace.SpanHolder(span_name)
@@ -87,12 +96,14 @@ def _instrument_handler(behavior: Callable, method: str, style: str):
             finally:
                 holder.finish()
                 latency.observe(time.perf_counter() - t0)
+                flight_end(t0)
         return stream_unary
 
     if style == "stream_stream":
         def stream_stream(request_iterator, context):
             calls.add()
             t0 = time.perf_counter()
+            flight.record("rpc.srv.start", note=method)
             # like stream_unary, the remote context arrives on the first
             # request chunk, after the handler has started
             holder = obs_trace.SpanHolder(span_name)
@@ -108,6 +119,7 @@ def _instrument_handler(behavior: Callable, method: str, style: str):
                 finally:
                     holder.finish()
                     latency.observe(time.perf_counter() - t0)
+                    flight_end(t0)
             return stream()
         return stream_stream
 
@@ -115,6 +127,7 @@ def _instrument_handler(behavior: Callable, method: str, style: str):
         def unary_stream(request, context):
             calls.add()
             t0 = time.perf_counter()
+            flight.record("rpc.srv.start", note=method)
             ctx = getattr(request, "trace_context", b"")
 
             def stream():
@@ -123,18 +136,21 @@ def _instrument_handler(behavior: Callable, method: str, style: str):
                         yield from behavior(request, context)
                 finally:
                     latency.observe(time.perf_counter() - t0)
+                    flight_end(t0)
             return stream()
         return unary_stream
 
     def unary(request, context):
         calls.add()
         t0 = time.perf_counter()
+        flight.record("rpc.srv.start", note=method)
         try:
             with obs_trace.server_span(
                     span_name, getattr(request, "trace_context", b"")):
                 return behavior(request, context)
         finally:
             latency.observe(time.perf_counter() - t0)
+            flight_end(t0)
     return unary
 
 
@@ -241,18 +257,27 @@ class RpcClient:
         calls, latency, style = self._instruments[method]
         calls.add()
         t0 = time.perf_counter()
+        flight.record("rpc.cli.start", note=method)
+        ok = False
         try:
             if not obs_trace.enabled():
-                return self._calls[method](request, timeout=timeout)
+                resp = self._calls[method](request, timeout=timeout)
+                ok = True
+                return resp
             with obs_trace.span(f"rpc/client/{method}", target=self._target):
                 ctx = obs_trace.wire_context()
                 if style in ("stream_unary", "stream_stream"):
                     request = _inject_stream(request, ctx)
                 elif ctx and hasattr(request, "trace_context"):
                     request.trace_context = ctx
-                return self._calls[method](request, timeout=timeout)
+                resp = self._calls[method](request, timeout=timeout)
+                ok = True
+                return resp
         finally:
             latency.observe(time.perf_counter() - t0)
+            flight.record("rpc.cli.end",
+                          a=int(1e6 * (time.perf_counter() - t0)),
+                          b=1 if ok else 0, note=method)
 
     def close(self) -> None:
         self._channel.close()

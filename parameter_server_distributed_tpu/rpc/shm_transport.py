@@ -63,7 +63,9 @@ import numpy as np
 
 from .. import native
 from ..analysis.lock_order import checked_lock
+from ..obs import flight
 from ..obs import stats as obs_stats
+from ..obs import trace as obs_trace
 from .wire import Field, Message
 
 log = logging.getLogger("pst.shm")
@@ -270,11 +272,23 @@ class ShmRing:
     def closed(self) -> bool:
         try:
             return struct.unpack_from("<I", self._buf, _OFF_CLOSED)[0] != 0
-        except ValueError:  # segment memoryview released (teardown race)
+        except (ValueError, TypeError):  # memoryview released (teardown)
             return True
 
     def close(self) -> None:
-        struct.pack_into("<I", self._buf, _OFF_CLOSED, 1)
+        try:
+            struct.pack_into("<I", self._buf, _OFF_CLOSED, 1)
+        except (ValueError, TypeError):  # segment already unmapped: the
+            pass  # release latch beat this closer — nothing left to latch
+
+    def invalidate(self) -> None:
+        """Drop the native raw-address fast path BEFORE the segment
+        unmaps (ISSUE 8 shm-flake fix): a copy racing the unmap then
+        takes the memoryview path, whose released-buffer ``ValueError``
+        is caught and surfaced as :class:`ShmTransportError` — a clean
+        downgrade instead of a SIGSEGV at a stale ``_base``."""
+        self._base = 0  # zeroed FIRST: a racing block re-reads (base,
+        self._copy = None  # copy) and falls back once either is gone
 
     # ------------------------------------------------------------ doorbell
     def _wait(self, ready: Callable[[], int], deadline: float,
@@ -310,9 +324,12 @@ class ShmRing:
 
     # ------------------------------------------------------------- produce
     def _copy_in(self, pos: int, view, src, src_off: int, n: int) -> None:
-        if src is not None:
-            self._copy(self._base + _HEADER + pos,
-                       src.ctypes.data + src_off, n)
+        # re-read the native fast path per block: invalidate() may have
+        # dropped it mid-frame (teardown racing a producer), and the
+        # memoryview fallback fails CLEANLY on a released segment
+        base, copy = self._base, self._copy
+        if src is not None and copy is not None and base:
+            copy(base + _HEADER + pos, src.ctypes.data + src_off, n)
         else:
             self._buf[_HEADER + pos:_HEADER + pos + n] = \
                 view[src_off:src_off + n]
@@ -371,9 +388,9 @@ class ShmRing:
     # ------------------------------------------------------------- consume
     def _copy_out(self, out: bytearray, dst, dst_off: int, pos: int,
                   n: int) -> None:
-        if dst is not None:
-            self._copy(dst.ctypes.data + dst_off,
-                       self._base + _HEADER + pos, n)
+        base, copy = self._base, self._copy  # see _copy_in
+        if dst is not None and copy is not None and base:
+            copy(dst.ctypes.data + dst_off, base + _HEADER + pos, n)
         else:
             out[dst_off:dst_off + n] = self._buf[_HEADER + pos:
                                                  _HEADER + pos + n]
@@ -553,7 +570,15 @@ class _ServerConnection:
                  on_exit: Callable[["_ServerConnection"], None]
                  | None = None):
         token = uuid.uuid4().hex[:8]
+        self.index = index
         self._on_exit = on_exit
+        # Exactly-once segment release (ISSUE 8: the PR-7 backup-crash
+        # flake was a DOUBLE segment reap — the serve thread's exit reap
+        # racing the shutdown path's unlink, second unmap pulling the
+        # mapping out from under a native ring copy).  Every unmap now
+        # routes through release_segments(), which latches.
+        self._release_lock = checked_lock("_ServerConnection._release_lock")
+        self._released = False
         self.c2s_name = f"psdt-{os.getpid()}-{index}-{token}-c2s"
         self.s2c_name = f"psdt-{os.getpid()}-{index}-{token}-s2c"
         self._listener, self.doorbell_addr = _doorbell_listener()
@@ -622,16 +647,36 @@ class _ServerConnection:
                 if first is None:
                     continue  # stray end marker (client retry teardown)
                 drained = [False]
+                # a shm round IS a fused PushPullStream round: give it
+                # the same server-side span (adopting the caller's trace
+                # context off the chunks — the field-999 plumbing the
+                # ring transport otherwise bypasses) and the same flight
+                # start/end stamps as the gRPC handler path
+                t0 = time.perf_counter()
+                flight.record("rpc.srv.start", note="PushPull/shm")
+                holder = obs_trace.SpanHolder("rpc/server/PushPullStream",
+                                              transport="shm")
 
                 def chunks() -> Iterator[m.Message]:
-                    yield m.GradientUpdate.decode(first)
+                    chunk = m.GradientUpdate.decode(first)
+                    holder.adopt(getattr(chunk, "trace_context", b""))
+                    yield chunk
                     for frame in self._request_frames():
-                        yield m.GradientUpdate.decode(frame)
+                        chunk = m.GradientUpdate.decode(frame)
+                        holder.adopt(getattr(chunk, "trace_context", b""))
+                        yield chunk
                     drained[0] = True
 
                 deadline = time.monotonic() + 3600.0
-                for resp in self._handler(chunks(), None):
-                    self.s2c.write_frame(resp.encode(), deadline)
+                try:
+                    for resp in self._handler(chunks(), None):
+                        self.s2c.write_frame(resp.encode(), deadline)
+                finally:
+                    holder.finish()
+                    flight.record(
+                        "rpc.srv.end",
+                        a=int(1e6 * (time.perf_counter() - t0)),
+                        note="PushPull/shm")
                 if not drained[0]:
                     # handler returned early (e.g. the empty-store fused
                     # refusal never reads the gradient chunks): consume the
@@ -661,6 +706,37 @@ class _ServerConnection:
                 except OSError:
                     pass
 
+    def release_segments(self, unmap: bool = True) -> bool:
+        """Exactly-once segment release — THE fix for the PR-7 backup
+        crash flake.  Before the latch, two paths could both reach the
+        unmap for one connection (the serve thread's exit reap and the
+        shutdown path's unlink, under post-failover worker churn), and
+        the loser unmapped a segment whose ring a native copy could still
+        be dereferencing through its raw base pointer: SIGSEGV in the
+        backup PS (docs/observability.md has the decoded flight-ring
+        evidence).  Returns False on the duplicate call (recorded as
+        ``shm.reap.dup`` — the flake's witness event), True when this
+        call performed the release.  ``unmap=False`` unlinks only (the
+        deferred path when the serve thread cannot be joined)."""
+        with self._release_lock:
+            if self._released:
+                flight.record("shm.reap.dup", a=self.index)
+                return False
+            self._released = True
+        flight.record("shm.reap", a=self.index, b=1 if unmap else 0)
+        # drop the raw-address fast path BEFORE any unmap: a racing
+        # block copy falls back to the memoryview, which fails cleanly
+        for ring in (self.c2s, self.s2c):
+            ring.invalidate()
+        for shm in (self._c2s_shm, self._s2c_shm):
+            try:
+                if unmap:
+                    shm.close()
+                shm.unlink()
+            except (OSError, FileNotFoundError):  # already gone
+                pass
+        return True
+
     def unlink(self) -> None:
         self.close()
         self._thread.join(timeout=2.0)
@@ -672,18 +748,9 @@ class _ServerConnection:
             # the names so no new attach can find them
             log.warning("shm connection thread still running at teardown; "
                         "deferring segment unmap")
-            for shm in (self._c2s_shm, self._s2c_shm):
-                try:
-                    shm.unlink()
-                except (OSError, FileNotFoundError):
-                    pass
+            self.release_segments(unmap=False)
             return
-        for shm in (self._c2s_shm, self._s2c_shm):
-            try:
-                shm.close()
-                shm.unlink()
-            except (OSError, FileNotFoundError):  # already gone
-                pass
+        self.release_segments()
 
 
 class ShmServer:
@@ -715,16 +782,17 @@ class ShmServer:
                 return  # shutdown path already owns it
             self._conns.remove(conn)
         conn.close()
-        for shm in (conn._c2s_shm, conn._s2c_shm):
-            try:
-                shm.close()
-                shm.unlink()
-            except (OSError, FileNotFoundError):
-                pass
+        # exactly-once via the connection's release latch: the registry
+        # check above already dedups reap-vs-shutdown, but the latch also
+        # covers the paths that bypass the registry (a connection that
+        # never finished negotiation racing its own accept-timeout reap —
+        # the PR-7 flake's double-reap window)
+        conn.release_segments()
         log.info("shm connection reaped (client disconnected)")
 
     def _refuse(self, why: str) -> ShmNegotiateResponse:
         log.info("shm negotiation refused: %s", why)
+        flight.record("shm.refuse", note=why)
         return ShmNegotiateResponse(accepted=False, message=why,
                                     host_id=self._host_id)
 
@@ -763,6 +831,8 @@ class ShmServer:
             return self._refuse("server shutting down")
         log.info("shm connection %d negotiated (worker %d, ring %d MB x2)",
                  index, request.worker_id, capacity >> 20)
+        flight.record("shm.negotiate", worker=request.worker_id, a=index,
+                      b=capacity)
         return ShmNegotiateResponse(
             accepted=True, message="ok", c2s_name=conn.c2s_name,
             s2c_name=conn.s2c_name, ring_bytes=capacity,

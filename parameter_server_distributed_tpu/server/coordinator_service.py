@@ -18,6 +18,7 @@ import json
 
 from ..config import CoordinatorConfig
 from ..core.coordinator_core import CoordinatorCore
+from ..obs import flight
 from ..obs.export import ClusterAggregator
 from ..replication import messages as rmsg
 from ..rpc import messages as m
@@ -133,6 +134,8 @@ class Coordinator:
         if self._port == 0:
             raise RuntimeError(f"could not bind {addr}")
         self._server.start()
+        if flight.enabled():
+            flight.set_role(f"coordinator:{self._port}")
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
                                         name="coordinator-reaper")
         self._reaper.start()

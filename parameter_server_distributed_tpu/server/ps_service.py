@@ -41,6 +41,7 @@ from ..config import ParameterServerConfig
 from ..core.optimizer import make_optimizer
 from ..core.ps_core import ParameterServerCore, PushSink
 from ..core.tensor import from_wire, to_wire
+from ..obs import flight
 from ..obs import stats as obs_stats
 from ..obs import trace as obs_trace
 from ..replication import messages as rmsg
@@ -619,6 +620,10 @@ class ParameterServer:
         if self._port == 0:
             raise RuntimeError(f"could not bind {addr}")
         self._server.start()
+        if flight.enabled():
+            # label this process's flight ring for pst-trace's listing
+            # (a backup PS that never sees traffic still identifies)
+            flight.set_role(f"ps:{self.config.bind_address}:{self._port}")
         self.ckpt.start()
         if self.replicator is not None:
             self.replicator.start()

@@ -47,6 +47,7 @@ from typing import Sequence
 
 import grpc
 
+from ..obs import flight
 from ..obs import trace as obs_trace
 from ..replication.failover import ShardMapClient, _status_code
 from ..replication.messages import STALE_SHARD_MAP
@@ -95,6 +96,12 @@ class ShardedPSClient:
         self.addresses: list[str] = []
         self._clients: list[PSClient] = []
         self._pool: ThreadPoolExecutor | None = None
+        # (worker, iteration) of the round in flight, stamped by the
+        # push/pull entry points purely for flight-recorder attribution:
+        # a failover retry deep in _with_failover can then name the
+        # retried iteration in the postmortem.  One worker runs one round
+        # at a time, so a plain pair is race-benign.
+        self._round: tuple[int, int] = (-1, -1)
         self._build(list(addresses))
 
     def _build(self, addresses: list[str]) -> None:
@@ -171,6 +178,9 @@ class ShardedPSClient:
             log.warning("shard %d failed over %s -> %s; retrying the "
                         "same round against the replica", index, address,
                         replacement)
+            worker, iteration = self._round
+            flight.record("failover.retry", iteration=iteration,
+                          worker=worker, a=index, note=replacement)
             return fn(client)
 
     def refresh_topology(self, wait_for_epoch_above: int | None = None,
@@ -248,6 +258,7 @@ class ShardedPSClient:
                        timeout: float | None = None) -> m.PushResponse:
         """Streaming-data-plane push (chunk streams per shard, concurrent
         fan-out).  Same merge/stale-retry semantics as the unary path."""
+        self._round = (update.worker_id, update.iteration)
         for _ in range(self._MAX_ROUND_REPLAYS):
             if self.num_shards == 1:
                 resp = self._with_failover(
@@ -349,6 +360,7 @@ class ShardedPSClient:
         are idempotent (server-side per-(worker, tensor) dedup + the
         replica's aggregated watermark), so the worker observes a normal
         — if slower — round: zero failed steps."""
+        self._round = (worker_id, iteration)
         if self._shard_map is None and self.num_shards == 1:
             # exact pre-replication behavior, lazy producer included
             return self._clients[0].push_pull(
@@ -415,6 +427,7 @@ class ShardedPSClient:
         independently) — consumers must be thread-safe per call; the
         worker's per-tensor dict insert is (tensor names are disjoint
         across shards)."""
+        self._round = (request.worker_id, request.iteration)
         if self.num_shards == 1:
             return self._with_failover(0, lambda c: c.pull_parameters(
                 request, timeout=timeout, on_chunk=on_chunk))

@@ -47,6 +47,7 @@ import numpy as np
 
 from ..config import WorkerConfig
 from ..core.tensor import TensorStore, from_wire, to_wire
+from ..obs import flight
 from ..obs import stats as obs_stats
 from ..obs import trace as obs_trace
 from ..obs.export import snapshot_blob
@@ -138,6 +139,10 @@ class Worker:
             thread_name_prefix=f"worker-{config.worker_id}-prefetch")
         self._prefetched: concurrent.futures.Future | None = None
         self._stop = threading.Event()
+        if flight.enabled():
+            # label this process's flight ring (real multi-process runs;
+            # in-process test topologies share one ring, last label wins)
+            flight.set_role(f"worker:{config.worker_id}")
         self._heartbeat_thread: threading.Thread | None = None
         if start_heartbeat:
             self._heartbeat_thread = threading.Thread(
@@ -507,8 +512,20 @@ class Worker:
             return push, params, local
 
         t0 = time.perf_counter()
-        with obs_trace.span("worker/fused", iteration=iteration):
-            push, params, store = self.query_with_retry(attempt)
+        flight.record("fused.start", iteration=iteration,
+                      worker=self.config.worker_id)
+        try:
+            with obs_trace.span("worker/fused", iteration=iteration):
+                push, params, store = self.query_with_retry(attempt)
+        except BaseException:
+            flight.record("fused.end", iteration=iteration,
+                          worker=self.config.worker_id,
+                          a=int(1e6 * (time.perf_counter() - t0)), b=0)
+            raise
+        flight.record("fused.end", iteration=iteration,
+                      worker=self.config.worker_id,
+                      a=int(1e6 * (time.perf_counter() - t0)),
+                      b=1 if params is not None else 0)
         self._obs_phase["fused"].observe(time.perf_counter() - t0)
         if not self._shm_noted and getattr(self._ps, "shm_active", False):
             # the PSClient negotiated the same-host shared-memory rings
@@ -601,6 +618,8 @@ class Worker:
         else:
             log.info("worker %d: PS empty, pushing deterministic init",
                      self.config.worker_id)
+        flight.record("boot.seed", iteration=iteration,
+                      worker=self.config.worker_id, a=len(init))
         push = self.push_gradients(iteration, init)
         if not push.success:
             raise WorkerError(f"bootstrap push rejected: {push.message}")
@@ -629,6 +648,8 @@ class Worker:
         step_span = obs_trace.span("worker/step", iteration=iteration,
                                    worker=self.config.worker_id)
         step_span.__enter__()
+        flight.record("step.start", iteration=iteration,
+                      worker=self.config.worker_id)
         try:
             params, self._next_params = self._next_params, None
             if params is None:
@@ -738,6 +759,9 @@ class Worker:
             return loss
         finally:
             step_span.__exit__(None, None, None)
+            flight.record("step.end", iteration=iteration,
+                          worker=self.config.worker_id,
+                          a=int(1e6 * (time.perf_counter() - t_step)))
             self._obs_phase["step"].observe(time.perf_counter() - t_step)
             self.status = m.WorkerStatus.IDLE
             self.step_timer.__exit__()

@@ -139,6 +139,26 @@ def test_bench_replicate_contract():
 
 
 @pytest.mark.slow
+def test_bench_obs_contract():
+    """obs mode: flight-recorder event throughput + fused-step overhead
+    recorder-on vs -off, with both arms' p50s visible in the JSON (the
+    ISSUE 8 '<2% of fused-step p50' acceptance surface)."""
+    result = run_bench("obs", extra_env={
+        "PSDT_BENCH_PARAMS": "5e4",
+        "PSDT_BENCH_STEPS": "3",
+    })
+    assert result["metric"] == "obs_flight_overhead_pct"
+    assert result["events_per_s"] > 10_000
+    assert result["ns_per_event"] > 0
+    assert result["fused_p50_ms"]["off"] > 0
+    assert result["fused_p50_ms"]["on"] > 0
+    assert result["events_per_fused_step"] > 0
+    # the acceptance bound is generous here (tiny shapes on a loaded CI
+    # host are noise-dominated); the real BENCH row runs default shapes
+    assert abs(result["value"]) < 50.0
+
+
+@pytest.mark.slow
 def test_bench_apply_contract():
     """apply mode: striped barrier-close profile, serial vs striped side
     by side with the stripe counts visible in the JSON."""

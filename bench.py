@@ -1406,6 +1406,202 @@ def _train_target_and_draft(model, params, draft, dparams, batch: int,
     return params, dparams, np.asarray(prompts, np.int32), tloss, dloss
 
 
+def bench_tier() -> dict:
+    """Hierarchical-aggregation bench (ISSUE 9): PS ingress bytes per
+    iteration and fused-round wall time vs worker count, flat topology
+    vs two-tier reduction tree (same-host groups folding at a leaf
+    aggregator, ONE quantized upstream contribution per group).  Real
+    loopback gRPC on both topologies (shm disabled so every gradient
+    byte crosses the counted ingress path).  Shape knobs:
+    PSDT_BENCH_PARAMS (store size, default 1M f32), PSDT_BENCH_STEPS
+    (iterations, default 5), PSDT_BENCH_WORKER_COUNTS (default "2,4"),
+    PSDT_BENCH_TIER_GROUP (group size, default 2), PSDT_TIER_DTYPE
+    (upstream encoding, default int8).
+
+    Acceptance (ISSUE 9): with 4 workers in 2 same-host groups,
+    per-iteration PS ingress bytes <= ~55% of the flat topology's (2
+    quantized contributions vs 4 f32 pushes)."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from parameter_server_distributed_tpu.checkpoint.manager import (
+        CheckpointManager)
+    from parameter_server_distributed_tpu.core.ps_core import (
+        ParameterServerCore)
+    from parameter_server_distributed_tpu.core.tensor import (store_nbytes,
+                                                              to_wire)
+    from parameter_server_distributed_tpu.rpc import messages as m
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+    from parameter_server_distributed_tpu.rpc.service import (bind_service,
+                                                              make_server)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServerService)
+    from parameter_server_distributed_tpu.tiers import messages as tmsg
+    from parameter_server_distributed_tpu.tiers.leaf import LeafAggregator
+
+    # every gradient byte must cross the counted gRPC ingress path: the
+    # shm rings bypass the tally wrapper (and the two topologies should
+    # compare on the same transport)
+    os.environ["PSDT_SHM"] = "0"
+
+    n_params = int(float(os.environ.get("PSDT_BENCH_PARAMS", "1e6")))
+    worker_counts = [int(x) for x in os.environ.get(
+        "PSDT_BENCH_WORKER_COUNTS", "2,4").split(",")]
+    iters = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 5
+    group_size = int(os.environ.get("PSDT_BENCH_TIER_GROUP", "2"))
+
+    rng = np.random.default_rng(0)
+    n_tensors = 4
+    shape = (max(1, n_params // n_tensors),)
+    params = {f"w{i}": rng.standard_normal(shape).astype(np.float32)
+              for i in range(n_tensors)}
+    model_bytes = store_nbytes(params)
+
+    class IngressTally:
+        """Service wrapper counting encoded gradient bytes arriving at
+        the PS (the acceptance metric), delegating everything else."""
+
+        def __init__(self, service):
+            self._service = service
+            self.bytes = 0
+            self._lock = threading.Lock()
+
+        def _count(self, chunk):
+            n = sum(t.encoded_size() for t in chunk.gradients)
+            with self._lock:
+                self.bytes += n
+
+        def PushPullStream(self, request_iterator, context):
+            def tap():
+                for chunk in request_iterator:
+                    self._count(chunk)
+                    yield chunk
+            yield from self._service.PushPullStream(tap(), context)
+
+        def PushGradientsStream(self, request_iterator, context):
+            def tap():
+                for chunk in request_iterator:
+                    self._count(chunk)
+                    yield chunk
+            return self._service.PushGradientsStream(tap(), context)
+
+        def ReceiveGradients(self, request, context):
+            self._count(request)
+            return self._service.ReceiveGradients(request, context)
+
+        def __getattr__(self, name):
+            return getattr(self._service, name)
+
+    def run_topology(n: int, tiered: bool) -> dict:
+        core = ParameterServerCore(total_workers=n)
+        core.initialize_parameters(params)
+        service = ParameterServerService(core, CheckpointManager(
+            core, directory=tempfile.mkdtemp(prefix="psdt-tier-"),
+            checkpoint_interval=10**9, check_period_s=3600.0))
+        tally = IngressTally(service)
+        server = make_server(max_workers=2 * n + 8)
+        bind_service(server, m.PARAMETER_SERVER_SERVICE,
+                     {**m.PARAMETER_SERVER_METHODS,
+                      **m.PARAMETER_SERVER_STREAM_METHODS}, tally)
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        ps_addr = f"127.0.0.1:{port}"
+
+        leaves: list[LeafAggregator] = []
+        targets = [ps_addr] * n
+        if tiered:
+            contrib: dict = {}
+            for start in range(0, n, group_size):
+                members = list(range(start, min(start + group_size, n)))
+                if len(members) < 2:
+                    continue  # singleton: stays flat at the PS
+                leader = members[0]
+                agg = tmsg.aggregate_id_for(leader)
+                leaf = LeafAggregator(leader, ps_addr)
+                leaf.arm(len(members), agg, params)
+                leaves.append(leaf)
+                contrib[agg] = (len(members), tuple(members))
+                for wid in members:
+                    targets[wid] = leaf.address
+            core.set_contributions_fn(lambda: contrib)
+        clients = [PSClient(addr) for addr in targets]
+        grads = [{name: rng.standard_normal(v.shape).astype(np.float32)
+                  for name, v in params.items()} for _ in range(n)]
+        wire = [to_wire(g) for g in grads]
+
+        round_walls = []
+        errors: list[BaseException] = []
+
+        def one_round(wid: int, it: int) -> None:
+            try:
+                push, update = clients[wid].push_pull(
+                    wid, it, lambda: iter(wire[wid]),
+                    pull_wire_dtype=m.WIRE_BF16, timeout=120.0)
+                assert push.success, push.message
+                assert update is not None, "no fused params"
+            except BaseException as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        try:
+            for it in range(1, iters + 1):
+                t0 = time.perf_counter()
+                threads = [threading.Thread(target=one_round, args=(wid, it),
+                                            name=f"tierbench-{wid}")
+                           for wid in range(n)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=180)
+                round_walls.append(time.perf_counter() - t0)
+                if errors:
+                    raise errors[0]
+            return {
+                "ingress_bytes_per_iter": tally.bytes // iters,
+                "round_wall_ms": round(
+                    1e3 * sorted(round_walls)[len(round_walls) // 2], 3),
+            }
+        finally:
+            for client in clients:
+                client.close()
+            for leaf in leaves:
+                leaf.stop()
+            server.stop(0.5)
+
+    by_workers: dict = {}
+    for n in worker_counts:
+        flat = run_topology(n, tiered=False)
+        tier = run_topology(n, tiered=True)
+        ratio = (tier["ingress_bytes_per_iter"]
+                 / max(1, flat["ingress_bytes_per_iter"]))
+        by_workers[n] = {"flat": flat, "tier": tier,
+                         "ingress_ratio": round(ratio, 4)}
+        log(f"bench_tier: workers={n} ingress flat="
+            f"{flat['ingress_bytes_per_iter']} tier="
+            f"{tier['ingress_bytes_per_iter']} ({ratio:.1%}), round wall "
+            f"flat={flat['round_wall_ms']}ms tier={tier['round_wall_ms']}ms")
+
+    n_max = worker_counts[-1]
+    ratio = by_workers[n_max]["ingress_ratio"]
+    groups_at_max = max(1, n_max // group_size)
+    return {
+        "metric": f"ps_tier_ingress_ratio_{n_max}w",
+        "value": ratio, "unit": "ratio",
+        # acceptance orientation: flat/tier ingress, >1 is a win
+        "vs_baseline": round(1.0 / ratio, 3) if ratio else 0.0,
+        "by_workers": by_workers,
+        "model_bytes": model_bytes,
+        "group_size": group_size,
+        "note": (f"{n_max} workers in {groups_at_max} groups: tier "
+                 f"ingress {ratio:.1%} of flat "
+                 f"(acceptance <= ~55%: ingress scales with group count, "
+                 f"not worker count); round wall flat="
+                 f"{by_workers[n_max]['flat']['round_wall_ms']}ms tier="
+                 f"{by_workers[n_max]['tier']['round_wall_ms']}ms"),
+    }
+
+
 def bench_generate() -> dict:
     """KV-cached decode throughput (tokens/sec/chip) for the LM flagship.
     PSDT_BENCH_MODEL picks the registry LM (small_lm | moe_lm); batch and
@@ -1925,6 +2121,8 @@ def child_main(mode: str) -> int:
             result = bench_replicate()
         elif mode == "obs":
             result = bench_obs()
+        elif mode == "tier":
+            result = bench_tier()
         elif mode == "async":
             result = bench_async()
         elif mode == "generate":
@@ -2033,7 +2231,7 @@ def main() -> int:
     # directly rather than risking a flaky TPU init.
     plans: list[tuple[str, float]]
     if mode in ("pushpull", "dataplane", "aggregate", "apply", "codec",
-                "replicate", "obs"):
+                "replicate", "obs", "tier"):
         plans = [("cpu", cpu_timeout)]
     else:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]

@@ -63,6 +63,15 @@ LOCK_RANKS: dict[str, int] = {
     "Replicator._ship_lock": 48,
     # leaves: never held while acquiring anything else
     "ParameterServerCore._live_lock": 50,
+    # tier contribution-weight cache (core/ps_core.py, ISSUE 9): held
+    # across the topology provider call — single-flight refresh per TTL
+    # expiry, exactly the _live_lock pattern, and the provider may be a
+    # coordinator RPC (BLOCKING_ALLOWED)
+    "ParameterServerCore._tier_lock": 52,
+    # worker-side tier runtime (tiers/group_client.py, ISSUE 9): guards
+    # the topology/leaf-connection state during activation and the
+    # permanent downgrade swap; never held across an RPC
+    "TierClient._lock": 53,
     # shm transport (rpc/shm_transport.py, ISSUE 6): the client-side lock
     # serializes one fused round end to end over the SPSC rings (ring
     # doorbell waits run under it — see BLOCKING_ALLOWED); the server-side
@@ -108,6 +117,9 @@ BLOCKING_ALLOWED: frozenset[str] = frozenset({
     # serializes one fused shm round (write frames, doorbell-wait, read
     # frames) — the ring waits ARE the serialized blocking section
     "ShmClientConnection._lock",
+    # single-flight tier-topology refresh: the provider under it may be a
+    # coordinator RPC (core/ps_core.py _contribution_for, ISSUE 9)
+    "ParameterServerCore._tier_lock",
     # serializes one replication ship (encode + PushReplicaDelta RPC +
     # ack) to the backup — the RPC under it is the point of the lock
     "Replicator._ship_lock",

@@ -28,6 +28,15 @@ Extension flags beyond the reference:
                     coordinator can promote it on this shard's death
     --replication=M async (default) | sync (close blocks on the backup
                     ack) | off — also the PSDT_REPLICATION env
+    --standby=ADDR  address this PS re-arms replication toward AFTER a
+                    promotion from backup to primary (otherwise the
+                    promoted primary runs un-backed-up — surfaced as the
+                    ps.replica.unarmed gauge in pst-status --metrics)
+
+With --coordinator=ADDR and PSDT_TIERS=1 the PS also polls the
+coordinator's reduction topology (tiers/), so a leaf aggregator's ONE
+quantized upstream push counts as its whole same-host group on the
+barrier (docs/training.md "Hierarchical aggregation").
 """
 
 from __future__ import annotations
@@ -57,6 +66,7 @@ def build_config(argv: list[str]) -> tuple[ParameterServerConfig, str | None]:
         checkpoint_keep=int(flags.get("keep", 0)),
         backup_address=flags.get("backup", ""),
         replication=flags.get("replication", ""),
+        standby_address=flags.get("standby", ""),
     )
     return config, flags.get("coordinator")
 
@@ -82,7 +92,18 @@ def main(argv: list[str] | None = None) -> int:
             except Exception:  # noqa: BLE001 — registry unreachable: fall back
                 return 0
 
-    ps = ParameterServer(config, live_workers_fn=live_fn)
+    # Tier contribution weights ride the coordinator connection whenever
+    # one is configured: the ENABLE decision lives at the coordinator
+    # (the provider answers {} when tiers are off there, and latches
+    # flat on UNIMPLEMENTED), so a PS host missing the PSDT_TIERS env
+    # cannot silently mis-attribute group pushes under env skew.
+    contributions_fn = None
+    if coordinator_addr:
+        from ..tiers.topology import TierContributionProvider
+        contributions_fn = TierContributionProvider(coordinator_addr)
+
+    ps = ParameterServer(config, live_workers_fn=live_fn,
+                         contributions_fn=contributions_fn)
     ps.start()
     print(f"Parameter server listening on {config.bind_address}:{config.port}",
           flush=True)

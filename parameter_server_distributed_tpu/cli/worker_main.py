@@ -34,6 +34,12 @@ Extension flags:
     --no-fused       disable the fused PushPullStream data plane (one RPC
                      round per step, docs/training.md) and run the
                      reference-shaped serial push/poll/pull protocol
+    --tiers / --no-tiers
+                     join (or refuse) the coordinator's two-tier
+                     hierarchical-aggregation topology (tiers/): same-host
+                     workers fold locally at an elected leaf aggregator,
+                     one quantized contribution per group goes upstream.
+                     Absent = PSDT_TIERS env (default off)
 """
 
 from __future__ import annotations
@@ -99,6 +105,8 @@ def main(argv: list[str] | None = None) -> int:
            if "topk-density" in flags else {}),
         mesh=flags.get("mesh", ""),
         fused_step="no-fused" not in flags,
+        tiers=(False if "no-tiers" in flags
+               else True if "tiers" in flags else None),
     )
     worker = build_worker(config, seed=int(flags["seed"]) if "seed" in flags else None)
     worker.initialize()

@@ -91,6 +91,13 @@ class ParameterServerConfig:
     # can never be lost) | "off".
     backup_address: str = ""
     replication: str = ""
+    # Replication headroom (ISSUE 9 satellite): the address this PS
+    # re-arms its Replicator toward AFTER it is promoted from backup to
+    # primary — without it the promoted primary silently runs with no
+    # backup (surfaced as the ps.replica.unarmed gauge).  Dormant until
+    # the first barrier close proves this process is serving as a
+    # primary; ignored when backup_address is set (already armed).
+    standby_address: str = ""
 
     @property
     def synchronous(self) -> bool:
@@ -150,6 +157,18 @@ class WorkerConfig:
     # clean not-ready inside this window and the worker falls back to its
     # poll loop rather than aborting the stream.
     fused_timeout_s: float = 120.0
+    # Hierarchical aggregation (tiers/, ISSUE 9): join the coordinator's
+    # two-tier reduction topology — same-host workers fold locally at an
+    # elected leaf aggregator and ONE quantized contribution goes
+    # upstream per group.  Tri-state: None = PSDT_TIERS env (default
+    # off).  Requires the fused data plane and a single-PS topology;
+    # degrades permanently to flat on any refusal (docs/training.md
+    # "Hierarchical aggregation").
+    tiers: bool | None = None
+    # Same-host identity override for the tier grouping (tests/bench
+    # simulate multi-host groups in one process; empty = the real
+    # hostname+boot-id of rpc/shm_transport.py host_id()).
+    tier_host_id: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

@@ -26,6 +26,8 @@ from ..analysis.lock_order import checked_lock
 from ..obs import flight
 from ..obs import stats as obs_stats
 from ..rpc.messages import WorkerStatus
+from ..tiers import messages as tmsg
+from ..tiers import topology as tier_topology
 
 
 @dataclasses.dataclass
@@ -77,6 +79,26 @@ class CoordinatorCore:
             ShardMapEntry(primary=addr, backup=backups[i], epoch=1)
             for i, addr in enumerate(addresses)]
         self._obs_promotions = obs_stats.counter("ps.replica.promotions")
+        # Hierarchical aggregation registry (tiers/, ISSUE 9): worker ->
+        # (host_id, leaf address), the epoch-numbered group list the
+        # GetReductionTopology extension serves, dissolved leaf addresses
+        # (a dead leaf's group never re-forms on the same address), and
+        # workers latched permanently flat (members of a dissolved or
+        # broken group — the worker side downgraded permanently too, so
+        # re-grouping them would only produce a leaf nobody uses).
+        self._tier_workers: dict[int, tuple[str, str]] = {}
+        self._tier_groups: list[tmsg.TierGroupEntry] = []
+        self._tier_dissolved: set[str] = set()
+        self._tier_flat: set[int] = set()
+        # Leaf addresses whose group has been SERVED TO ITS LEADER at
+        # least once: the leader arms its leaf synchronously on seeing
+        # the group, so members (and the PS weight provider) are only
+        # shown confirmed groups — without this, a member's first tier
+        # round routinely races the election and eats a not-armed
+        # refusal.
+        self._tier_confirmed: set[str] = set()
+        self._tier_epoch = 0
+        self._obs_tier_groups = obs_stats.gauge("tier.groups")
 
     def register_worker(self, worker_id: int, address: str, port: int,
                         hostname: str) -> int:
@@ -202,6 +224,81 @@ class CoordinatorCore:
                           b=len(self._shard_map))
             return self._shard_epoch
 
+    # ------------------------------------------------- reduction topology
+    def tier_register(self, worker_id: int, host_id: str = "",
+                      leaf_address: str = "", dead_leaf: str = ""
+                      ) -> tuple[int, list[tmsg.TierGroupEntry], bool, int,
+                                 bool]:
+        """Register-and-query of the two-tier reduction topology
+        (tiers/messages.py GetReductionTopology).  Returns (epoch, group
+        copies, enabled, min group size, requester latched flat).
+        ``worker_id < 0`` or an empty ``host_id`` registers nothing (the
+        PS weight provider's pure read); ``dead_leaf`` dissolves the
+        named group — its members latch permanently flat, matching
+        their own worker-side downgrade (and told so, so a rebuilt
+        client stops polling)."""
+        enabled = tier_topology.tiers_enabled()
+        min_group = tier_topology.min_group_size()
+        with self._lock:
+            if dead_leaf:
+                self._tier_dissolved.add(dead_leaf)
+            if (enabled and worker_id >= 0 and host_id
+                    and worker_id not in self._tier_flat):
+                prev = self._tier_workers.get(worker_id)
+                self._tier_workers[worker_id] = (
+                    host_id, leaf_address or (prev[1] if prev else ""))
+            if enabled:
+                self._tier_regroup_locked(min_group)
+            visible = []
+            for g in self._tier_groups:
+                if int(g.leader_worker_id) == worker_id:
+                    # serving the group to its leader confirms it (the
+                    # leader arms before using the response)
+                    self._tier_confirmed.add(g.leaf_address)
+                if (g.leaf_address in self._tier_confirmed
+                        or int(g.leader_worker_id) == worker_id):
+                    visible.append(g)
+            return (self._tier_epoch, visible, enabled, min_group,
+                    worker_id in self._tier_flat)
+
+    def _tier_regroup_locked(self, min_group: int) -> None:
+        """Recompute the group list (caller holds _lock).  Pass 1:
+        members of a group that fell apart (dissolved leaf, evicted
+        member) latch permanently flat BEFORE any regrouping — their
+        worker side downgraded permanently, so a re-formed group would
+        stall on them forever.  Pass 2: new groups form only from live,
+        never-grouped workers."""
+        changed = False
+        survivors: list[tmsg.TierGroupEntry] = []
+        for entry in self._tier_groups:
+            if (entry.leaf_address in self._tier_dissolved
+                    or any(int(w) not in self._tier_workers
+                           or int(w) in self._tier_flat
+                           for w in entry.member_ids)):
+                self._tier_flat.update(int(w) for w in entry.member_ids)
+                self._tier_confirmed.discard(entry.leaf_address)
+                changed = True
+            else:
+                survivors.append(entry)
+        live = {wid: info for wid, info in self._tier_workers.items()
+                if wid not in self._tier_flat}
+        before = {g.leaf_address for g in survivors}
+        groups, formed = tier_topology.form_groups(
+            live, survivors, self._tier_dissolved, min_group)
+        if not (changed or formed):
+            return
+        self._tier_groups = groups
+        self._tier_epoch += 1
+        self._obs_tier_groups.set(len(groups))
+        for entry in groups:
+            if entry.leaf_address not in before:
+                # the coordinator-edge election record: which leader,
+                # which leaf, at which topology epoch
+                flight.record("tier.elect",
+                              worker=int(entry.leader_worker_id),
+                              a=len(entry.member_ids), b=self._tier_epoch,
+                              note=entry.leaf_address)
+
     def remove_stale_workers(self, timeout_s: float = 30.0) -> list[int]:
         """Evict workers silent for > timeout_s
         (reference: src/coordinator.cpp:52-67).  Returns evicted ids."""
@@ -212,4 +309,8 @@ class CoordinatorCore:
                 if now - self._workers[wid].last_heartbeat > timeout_s:
                     del self._workers[wid]
                     evicted.append(wid)
+            if evicted and self._tier_workers:
+                for wid in evicted:
+                    self._tier_workers.pop(wid, None)
+                self._tier_regroup_locked(tier_topology.min_group_size())
         return evicted

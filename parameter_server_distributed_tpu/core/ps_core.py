@@ -87,6 +87,16 @@ from .tensor import TensorStore, store_nbytes, tree_like
 
 AGGREGATION_MODES = ("streaming", "buffered")
 
+# Synthetic pusher-id namespace of the hierarchical-aggregation tier
+# (tiers/messages.py re-exports this as the protocol constant): a pusher
+# at or above this base is a leaf aggregator's GROUP contribution and is
+# only accepted when the contribution map names it — an unknown
+# aggregate id is rejected RETRYABLY rather than folded as a phantom
+# weight-1 worker, because folding it would double-count its members'
+# gradients the moment they replay flat.  Real worker ids must stay
+# below this base (docs/training.md).
+TIER_AGGREGATE_ID_BASE = 1 << 20
+
 
 class IterationState:
     __slots__ = ("worker_gradients", "aggregated", "aggregating", "sealed",
@@ -169,15 +179,35 @@ class PushSink:
     stage into a private dict and commit routes through the classic
     whole-push paths (an async apply must be atomic)."""
 
-    __slots__ = ("_core", "worker_id", "iteration", "_buffer",
-                 "stale_map_epoch")
+    __slots__ = ("_core", "worker_id", "iteration", "_buffer", "_group",
+                 "stale_map_epoch", "weight", "members")
 
     def __init__(self, core: "ParameterServerCore", worker_id: int,
-                 iteration: int, streaming: bool):
+                 iteration: int, streaming: bool,
+                 weight: int = 1, members: tuple[int, ...] | None = None):
         self._core = core
         self.worker_id = int(worker_id)
         self.iteration = int(iteration)
-        self._buffer: dict | None = None if streaming else {}
+        # Tier contribution (tiers/, ISSUE 9): a leaf aggregator's ONE
+        # upstream push carries its whole group — the fold weights the
+        # per-name counts by the group size (the PS mean stays a mean
+        # over WORKERS) and the commit marks every member id a barrier
+        # contributor.  (1, (worker,)) for ordinary pushes — behavior
+        # identical to pre-tier.  Group pushes STAGE their chunks and
+        # fold atomically at commit (one _state_lock hold checks member
+        # overlap, folds, and publishes the cover): a member's racing
+        # flat push — the mid-iteration downgrade recovery — then lands
+        # strictly before (group rejected, members replay flat) or
+        # strictly after (member dedups as a duplicate), never half-way
+        # into a double count.
+        self.weight = int(weight)
+        self.members = members if members is not None else (self.worker_id,)
+        # != 1 (not > 1): an EMPTY member tuple is the unknown-aggregate
+        # rejection marker — staged like a group push so the commit can
+        # bounce it whole (see _contribution_for / _commit_group_push)
+        self._group = streaming and len(self.members) != 1
+        self._buffer: dict | None = ({} if (not streaming or self._group)
+                                     else None)
         # set when any folded chunk touched a tensor a live reshard moved
         # to another owner (core._retired): the commit then reports the
         # whole push rejected with the stale-shard-map marker so the
@@ -197,6 +227,10 @@ class PushSink:
         if self.stale_map_epoch is not None:
             return self._core._stale_map_result(self.iteration,
                                                 self.stale_map_epoch)
+        if self._group:
+            return self._core._commit_group_push(
+                self.worker_id, self.iteration, self._buffer, self.weight,
+                self.members)
         if self._buffer is not None:
             return self._core.receive_gradients(self.worker_id,
                                                 self.iteration, self._buffer)
@@ -230,7 +264,11 @@ class ParameterServerCore:
                  live_workers_ttl_s: float = 0.0,
                  gc_iterations: int = 64,
                  aggregation: str | None = None,
-                 stripes: int | None = None):
+                 stripes: int | None = None,
+                 contributions_fn: Callable[
+                     [], Mapping[int, tuple[int, tuple[int, ...]]] | None]
+                 | None = None,
+                 contributions_ttl_s: float = 1.0):
         mode = (aggregation or os.environ.get("PSDT_AGGREGATION")
                 or "streaming").lower()
         if mode not in AGGREGATION_MODES:
@@ -282,6 +320,28 @@ class ParameterServerCore:
         # read the fresh value (they would have paid their own remote
         # round-trip otherwise).
         self._live_lock = checked_lock("ParameterServerCore._live_lock")
+        # Hierarchical aggregation (tiers/, ISSUE 9): provider of the
+        # {aggregate_id: (weight, member ids)} contribution map — a leaf
+        # aggregator's upstream push folds with its group's weight and
+        # covers its member ids on the barrier.  TTL-cached exactly like
+        # the live-worker count (the provider may be a coordinator RPC;
+        # _tier_lock single-flights the refresh — BLOCKING_ALLOWED).
+        # None provider / empty map = flat: every push weighs 1.
+        self._contributions_fn = contributions_fn
+        self._contrib_ttl = float(contributions_ttl_s)
+        self._contrib_cache: tuple[
+            Mapping[int, tuple[int, tuple[int, ...]]] | None, float] = \
+            (None, 0.0)
+        self._tier_lock = checked_lock("ParameterServerCore._tier_lock")
+        # Barrier relay (tiers/leaf.py, ISSUE 9): when set, the streaming
+        # barrier close hands (iteration, sums, counts) to the relay
+        # instead of running scale + optimizer apply, and installs the
+        # store the relay returns — the leaf aggregator's "apply" is one
+        # quantized upstream push whose fused response IS the fresh
+        # params its group gets served.  Runs under _apply_lock
+        # (BLOCKING_ALLOWED — same discipline as sync replication).
+        self._barrier_relay: Callable[
+            [int, TensorStore, dict[str, int]], TensorStore] | None = None
         self._optimizer = optimizer or SGD(learning_rate=1.0)
         self._staleness_bound = int(staleness_bound)
         self._gc_iterations = int(gc_iterations)
@@ -407,6 +467,63 @@ class ParameterServerCore:
     def set_total_workers(self, n: int) -> None:
         self._static_total_workers = int(n)
 
+    # ------------------------------------------------------------------ tiers
+    def set_contributions_fn(self, fn, ttl_s: float | None = None) -> None:
+        """Install (or clear) the tier contribution-map provider
+        (tiers/topology.py TierContributionProvider)."""
+        with self._tier_lock:
+            self._contributions_fn = fn
+            if ttl_s is not None:
+                self._contrib_ttl = float(ttl_s)
+            self._contrib_cache = (None, 0.0)
+
+    def set_barrier_relay(self, relay) -> None:
+        """Install the leaf-aggregator barrier relay (tiers/leaf.py): the
+        streaming close calls ``relay(iteration, sums, counts)`` under
+        _apply_lock instead of scale+apply and installs the returned
+        store.  A raise leaves the barrier retryable exactly like a
+        failed optimizer apply (the accumulator is put back, counts
+        intact — the relay must not mutate ``sums``)."""
+        self._barrier_relay = relay
+
+    def _contribution_for(self, worker_id: int
+                          ) -> tuple[int, tuple[int, ...]]:
+        """(weight, member ids) of a pusher — (1, (worker_id,)) unless
+        the tier topology maps it to a group contribution.  Called with
+        NO core lock held (the provider may RPC); the map is TTL-cached
+        under _tier_lock, single-flight per expiry like barrier_width's
+        live cache.
+
+        An AGGREGATE id (>= TIER_AGGREGATE_ID_BASE) absent from the map
+        returns ``(0, ())`` — the retryable-rejection marker — instead
+        of a phantom weight-1 contribution: the cache is force-refreshed
+        once first (a just-confirmed group is routinely fresher than the
+        TTL), and a group push the PS cannot attribute must bounce so
+        its members replay flat rather than be double-counted."""
+        wid = int(worker_id)
+        if self._contributions_fn is None:
+            return ((1, (wid,)) if wid < TIER_AGGREGATE_ID_BASE
+                    else (0, ()))
+        with self._tier_lock:
+            contrib, expiry = self._contrib_cache
+            if (time.monotonic() >= expiry
+                    or (wid >= TIER_AGGREGATE_ID_BASE
+                        and wid not in (contrib or {}))):
+                fresh = self._contributions_fn()
+                if fresh is not None:
+                    contrib = fresh
+                # a provider hiccup (None with a map already cached)
+                # keeps serving the stale map rather than flapping the
+                # weights mid-iteration
+                self._contrib_cache = (contrib,
+                                       time.monotonic() + self._contrib_ttl)
+            entry = (contrib or {}).get(wid)
+        if entry is None:
+            return ((1, (wid,)) if wid < TIER_AGGREGATE_ID_BASE
+                    else (0, ()))
+        weight, members = entry
+        return int(weight), tuple(int(m) for m in members)
+
     # ----------------------------------------------------------------- params
     def initialize_parameters(self, params: Mapping[str, np.ndarray]) -> None:
         with self._params_lock:
@@ -469,15 +586,43 @@ class ParameterServerCore:
     def begin_push(self, worker_id: int, iteration: int) -> PushSink:
         """Open a (possibly chunk-streamed) push.  The streaming handlers
         fold each decoded chunk as it arrives and commit at end-of-stream;
-        the whole-store :meth:`receive_gradients` is the one-chunk case."""
-        return PushSink(self, worker_id, iteration,
-                        streaming=self._streaming and self.synchronous)
+        the whole-store :meth:`receive_gradients` is the one-chunk case.
+        The tier contribution lookup happens HERE, outside every core
+        lock (tiers require the streaming sync path; buffered/async
+        modes keep flat weight-1 semantics)."""
+        streaming = self._streaming and self.synchronous
+        weight, members = ((1, (int(worker_id),)) if not streaming
+                           else self._contribution_for(worker_id))
+        return PushSink(self, worker_id, iteration, streaming=streaming,
+                        weight=weight, members=members)
 
     def receive_gradients(self, worker_id: int, iteration: int,
                           gradients: Mapping[str, np.ndarray]) -> PushResult:
+        if (worker_id >= TIER_AGGREGATE_ID_BASE
+                and not (self.synchronous and self._streaming)):
+            # Tier group contributions exist ONLY on the streaming sync
+            # path (weighted folds + member covers).  Under the buffered
+            # escape hatch the push would count as one phantom worker
+            # (members double-count on their flat replay), and in async
+            # mode the raw group SUM would apply immediately at
+            # group-size magnitude — reject retryably instead; the
+            # leaf's members replay flat (config-skew protection).
+            return PushResult(
+                False,
+                "tier aggregate contributions require the streaming "
+                "synchronous aggregation path; replay flat",
+                iteration, False, 0, self.barrier_width())
         if not self.synchronous:
             return self._receive_async(worker_id, iteration, gradients)
         if self._streaming:
+            weight, members = self._contribution_for(worker_id)
+            if len(members) != 1:
+                # a whole-store group contribution (the leaf's unary
+                # fallback path): atomic overlap-check + fold + cover —
+                # or, with EMPTY members, the unknown-aggregate bounce
+                return self._commit_group_push(worker_id, iteration,
+                                               dict(gradients), weight,
+                                               members)
             stale_epoch = self._fold_chunk(worker_id, iteration, gradients)
             if stale_epoch is not None:
                 return self._stale_map_result(iteration, stale_epoch)
@@ -587,7 +732,8 @@ class ParameterServerCore:
         return stale_epoch
 
     def _fold_into_locked(self, state: IterationState, folded: set,
-                          gradients: Mapping[str, np.ndarray]) -> None:
+                          gradients: Mapping[str, np.ndarray],
+                          weight: int = 1) -> None:
         """The serial fold (caller holds _state_lock) — the exact
         pre-stripe code path, used at stripes == 1."""
         added = 0
@@ -602,14 +748,14 @@ class ParameterServerCore:
                     # for non-f32 wire decodes)
                     acc = np.array(g, dtype=np.float32)
                     state.accum[name] = acc
-                    state.counts[name] = 1
+                    state.counts[name] = weight
                     added += acc.nbytes
                 else:
                     # raises (mutating nothing) on a shape mismatch —
                     # only THEN is the name marked folded, so a retry
                     # of a failed fold is not silently dropped
                     np.add(acc, np.asarray(g, np.float32), out=acc)
-                    state.counts[name] += 1
+                    state.counts[name] += weight
                 folded.add(name)
         finally:
             if added:
@@ -691,6 +837,114 @@ class ParameterServerCore:
                 # wake a barrier closer draining inflight folds
                 self._barrier_cv.notify_all()
 
+    def _commit_group_push(self, worker_id: int, iteration: int,
+                           gradients: Mapping[str, np.ndarray],
+                           weight: int, members: tuple[int, ...]
+                           ) -> PushResult:
+        """Commit a leaf aggregator's STAGED group contribution (tiers/,
+        ISSUE 9) in one ``_state_lock`` hold: overlap check, weighted
+        fold, member cover, barrier evaluation — atomic, so a member's
+        racing flat push (the mid-iteration downgrade recovery) lands
+        strictly before it (the group is rejected and its members replay
+        flat) or strictly after (the member dedups as a duplicate);
+        there is no interleaving that double-counts a gradient.
+
+        The fold increments each name's count by the GROUP SIZE — the
+        close's per-name mean stays a true mean over workers — and the
+        cover marks every member id a barrier contributor, so the
+        barrier counts CONTRIBUTIONS (groups + singletons) whose member
+        ids sum to the worker width and elastic membership composes
+        unchanged.  Idempotent: a relay retry of a landed contribution
+        answers duplicate/late exactly like a worker's."""
+        ids = tuple(int(i) for i in members)
+        total = self.barrier_width()
+        if not ids:
+            # unknown aggregate id (_contribution_for could not attribute
+            # it even after a forced topology refresh — provider absent,
+            # or the group not yet/no longer visible): bounce RETRYABLY.
+            # The leaf's relay fails, its barrier stays retryable, and
+            # either the next attempt finds the map fresh or its members
+            # give up and replay flat.
+            return PushResult(
+                False,
+                "unknown tier aggregate id: this PS cannot attribute the "
+                "group contribution (topology not visible); retry or "
+                "replay flat", iteration, False, 0, total)
+        with self._state_lock:
+            self._current_iteration = max(self._current_iteration, iteration)
+            gradients, stale_epoch = self._split_retired_locked(gradients)
+            if stale_epoch is not None:
+                # reject whole (nothing folded): the leaf refreshes via
+                # its members' repartition, same as a worker push
+                return self._stale_map_result(iteration, stale_epoch, total)
+            state = self._sync_state_locked(iteration)
+            early = self._push_guard_locked(state, ids, iteration, total)
+            if early is not None:
+                return early
+            if any(i in state.contributors or i in state.folded
+                   or i in state.folding for i in ids):
+                # the group sum overlaps a member that (also) landed
+                # individually — folding it would double-count that
+                # member's gradient.  Reject the WHOLE contribution; the
+                # leaf's relay fails, its barrier stays retryable, and
+                # the members replay flat, exactly once each.
+                return PushResult(
+                    False,
+                    "tier group contribution overlaps individual "
+                    "contributions; members must replay flat",
+                    iteration, False, len(state.contributors), total)
+            flight.record("fold.reserve", iteration=iteration,
+                          worker=worker_id, a=len(gradients))
+            self._fold_into_locked(
+                state, state.folded.setdefault(worker_id, set()),
+                gradients, weight)
+            state.contributors.update(ids)
+            flight.record("push.commit", iteration=iteration,
+                          worker=worker_id, a=len(state.contributors),
+                          b=total)
+            received = self._maybe_aggregate_locked(iteration, state, total)
+            if state.aggregated:
+                return PushResult(True, "aggregation complete", iteration,
+                                  True, received, total)
+            return PushResult(True, "gradient received", iteration,
+                              False, received, total)
+
+    def _push_guard_locked(self, state: IterationState | None,
+                           ids: tuple[int, ...], iteration: int,
+                           total: int) -> PushResult | None:
+        """Early verdict of a streaming commit against the iteration's
+        barrier state — shared by the worker and group commit paths
+        (caller holds _state_lock; None = proceed to contribute):
+
+        - GC'd state: a straggler push for an already-aggregated
+          iteration succeeds without contributing (the late-push
+          invariant holds across GC);
+        - aggregated: late push succeeds without contributing
+          (reference: src/parameter_server.cpp:28-30);
+        - sealed: a close was attempted (in flight or being retried)
+          without this pusher; the apply has NOT landed yet, so do not
+          report complete — readiness is observed via the sync poll /
+          condition variable exactly when it is real;
+        - all ids already contributed: the documented streaming
+          duplicate policy, first-push-wins (a relay retry of a landed
+          group contribution answers the same way)."""
+        if state is None:
+            return PushResult(True, "iteration already aggregated",
+                              iteration, True, total, total)
+        if state.aggregated:
+            return PushResult(True, "iteration already aggregated",
+                              iteration, True,
+                              state.workers_at_aggregation, total)
+        if state.sealed:
+            return PushResult(True, "aggregation in progress", iteration,
+                              False, len(state.contributors), total)
+        if all(i in state.contributors for i in ids):
+            return PushResult(True, "duplicate push ignored (streaming "
+                                    "aggregation is first-push-wins)",
+                              iteration, False,
+                              len(state.contributors), total)
+        return None
+
     def _commit_push(self, worker_id: int, iteration: int) -> PushResult:
         """End-of-stream for a streaming push: mark the worker a barrier
         contributor and fire the barrier if the width is reached."""
@@ -698,34 +952,10 @@ class ParameterServerCore:
         with self._state_lock:
             self._current_iteration = max(self._current_iteration, iteration)
             state = self._sync_state_locked(iteration)
-            if state is None:
-                # straggler push for a GC'd, already-aggregated iteration:
-                # succeed without contributing (late-push invariant holds
-                # across GC)
-                return PushResult(True, "iteration already aggregated",
-                                  iteration, True, total, total)
-            if state.aggregated:
-                # late push: succeed without contributing
-                # (reference: src/parameter_server.cpp:28-30)
-                return PushResult(True, "iteration already aggregated",
-                                  iteration, True,
-                                  state.workers_at_aggregation, total)
-            if state.sealed:
-                # a close was attempted (and is in flight or being
-                # retried) without this worker; the apply has NOT landed
-                # yet, so do not report complete — the worker observes
-                # readiness via the sync poll / condition variable exactly
-                # when it is real
-                return PushResult(True, "aggregation in progress", iteration,
-                                  False, len(state.contributors), total)
-            if worker_id in state.contributors:
-                # documented streaming policy: duplicate pre-barrier pushes
-                # from the same worker are first-push-wins (the buffered
-                # escape hatch keeps the original last-push-wins)
-                return PushResult(True, "duplicate push ignored (streaming "
-                                        "aggregation is first-push-wins)",
-                                  iteration, False,
-                                  len(state.contributors), total)
+            early = self._push_guard_locked(state, (worker_id,), iteration,
+                                            total)
+            if early is not None:
+                return early
             state.contributors.add(worker_id)
             # the (iteration, worker) commit stamp: the postmortem's
             # straggler attribution is the spread of these across workers,
@@ -881,17 +1111,36 @@ class ParameterServerCore:
             try:
                 with self._apply_lock:
                     if self._restore_epoch == gen:
-                        # contributor mean without a per-worker sweep: one
-                        # in-place O(model) scale of the running sums
-                        # (per-name counts — see IterationState.counts),
-                        # stripe-parallel; a FULL scale pass completes
-                        # before the apply so the put-back semantics on an
-                        # apply failure stay exact (counts reset to 1)
                         ta = time.perf_counter()
                         flight.record("apply.start", iteration=iteration)
-                        self._scale_striped(sums, counts)
-                        scaled = True
-                        self._apply_update(sums)
+                        if self._barrier_relay is not None:
+                            # leaf-aggregator close (tiers/leaf.py): the
+                            # raw per-name SUMS go upstream as ONE
+                            # quantized group contribution and the fused
+                            # response becomes this core's store — the
+                            # params its parked group gets served.  A
+                            # raise takes the ordinary failed-apply path
+                            # below: sums put back unscaled (counts
+                            # intact — the relay must not mutate them),
+                            # barrier retryable, relay retry idempotent
+                            # upstream via the PS's per-(worker, tensor)
+                            # dedup and member cover.
+                            fresh = self._barrier_relay(iteration, sums,
+                                                        counts)
+                            with self._params_lock:
+                                self._params = dict(fresh)
+                                self._params_version += 1
+                        else:
+                            # contributor mean without a per-worker
+                            # sweep: one in-place O(model) scale of the
+                            # running sums (per-name counts — see
+                            # IterationState.counts), stripe-parallel; a
+                            # FULL scale pass completes before the apply
+                            # so the put-back semantics on an apply
+                            # failure stay exact (counts reset to 1)
+                            self._scale_striped(sums, counts)
+                            scaled = True
+                            self._apply_update(sums)
                         flight.record(
                             "apply.end", iteration=iteration,
                             a=int(1e6 * (time.perf_counter() - ta)))

@@ -97,8 +97,30 @@ def _ps_rollup(snap: dict) -> dict:
         value = counters.get(name, 0)
         if value:
             replica[key] = value
+    # a promoted primary serving with NO backup (ISSUE 9 satellite):
+    # the unreplicated window the standby re-arm closes
+    if snap.get("gauges", {}).get("ps.replica.unarmed"):
+        replica["unarmed"] = True
     if replica:
         out["replica"] = replica
+    # hierarchical aggregation (tiers/, ISSUE 9): leaf relay volume +
+    # downgrade count, recorded wherever the leaf/worker runtime lives
+    tier: dict = {}
+    for key, name in (("upstream_bytes", "tier.upstream_bytes"),
+                      ("relays", "tier.relays"),
+                      ("rounds", "tier.rounds"),
+                      ("downgrades", "tier.downgrades")):
+        value = counters.get(name, 0)
+        if value:
+            tier[key] = value
+    upstream = _hist_stats(snap, "tier.upstream_s")
+    if upstream:
+        tier["upstream_s"] = upstream
+    size = snap.get("gauges", {}).get("tier.group_size", 0)
+    if size:
+        tier["group_size"] = size
+    if tier:
+        out["tier"] = tier
     return out
 
 
@@ -320,7 +342,27 @@ def render_rollup(rollup: dict) -> str:
                     rparts.append(
                         "reshard moved "
                         + _fmt_bytes(replica["reshard_moved_bytes"]))
+                if replica.get("unarmed"):
+                    rparts.append("UNARMED (promoted primary, no backup)")
                 lines.append(f"    replication: {', '.join(rparts)}")
+            tier = ps.get("tier")
+            if tier:
+                tparts = []
+                if tier.get("relays"):
+                    note = (f"{tier['relays']} relays "
+                            f"({_fmt_bytes(tier.get('upstream_bytes', 0))} "
+                            f"quantized upstream)")
+                    up = tier.get("upstream_s")
+                    if up:
+                        note += f" p50={_fmt_s(up['p50'])}"
+                    tparts.append(note)
+                if tier.get("group_size"):
+                    tparts.append(f"group of {tier['group_size']:g}")
+                if tier.get("rounds"):
+                    tparts.append(f"{tier['rounds']} tiered rounds")
+                if tier.get("downgrades"):
+                    tparts.append(f"{tier['downgrades']} downgrades")
+                lines.append(f"    tiers: {', '.join(tparts)}")
         native_plane = w.get("native_plane")
         if native_plane:
             parts = []

@@ -124,6 +124,15 @@ EVENTS: dict[str, int] = {
     # codec selection (rpc/codec.py)
     "codec.select": 70,      # a = 1 native / 0 python
     "ckpt.restore": 71,
+    # hierarchical aggregation (tiers/, ISSUE 9)
+    "tier.elect": 80,        # a = group size, b = epoch (coordinator) or
+                             # aggregate id (worker edge); note = leaf addr
+    "tier.fold": 81,         # leaf edge, sampled: member push arriving;
+                             # a = tensors, b = aggregate id
+    "tier.seal": 82,         # leaf group sealed; a = contributors,
+                             # b = group size (worker = aggregate id)
+    "tier.upstream": 83,     # a = duration_us, b = quantized wire bytes
+    "tier.downgrade": 84,    # permanent flat downgrade; note = reason
 }
 EVENT_NAMES = {code: name for name, code in EVENTS.items()}
 
@@ -132,7 +141,9 @@ EVENT_NAMES = {code: name for name, code in EVENTS.items()}
 # (the postmortem matches them into intervals), and sampling the two
 # halves independently would destroy the pairing — every RPC would
 # decode as permanently open.
-SAMPLED = frozenset({EVENTS["fold.reserve"]})
+# tier.fold is the same per-member-push class at the leaf edge — one
+# record per member stream, sampled alongside the per-chunk folds
+SAMPLED = frozenset({EVENTS["fold.reserve"], EVENTS["tier.fold"]})
 
 
 class FlightRecorder:

@@ -43,6 +43,21 @@ from typing import Any, Iterable
 
 from . import flight
 
+# Mirrors tiers/messages.py TIER_AGGREGATE_ID_BASE (asserted equal by
+# tests/test_tiers.py): a push-commit worker id at or above this base is
+# a leaf aggregator's GROUP contribution, and the postmortem names the
+# group — not a phantom worker — in timelines and the critical path.
+_TIER_ID_BASE = 1 << 20
+
+
+def _is_group(worker_id: int) -> bool:
+    return worker_id >= _TIER_ID_BASE
+
+
+def _group_label(worker_id: int) -> str:
+    return f"group[leader {worker_id - _TIER_ID_BASE}]"
+
+
 # ------------------------------------------------------------------- loading
 
 
@@ -149,10 +164,27 @@ def iteration_timeline(events: list[dict], iteration: int) -> dict:
     # "retried" means >1 commit on the SAME shard process (a replay the
     # dedup absorbed), never the normal per-shard fan-out.
     workers: dict[int, dict] = {}
+    groups: dict[int, dict] = {}
     commits_by_pid: dict[tuple[int, int], int] = {}
     for ev in evs:
         wid = ev["worker"]
         if wid < 0:
+            continue
+        if _is_group(wid):
+            # a leaf aggregator's group lane (tiers/): seal → upstream →
+            # PS commit, keyed by the synthetic aggregate id
+            g = groups.setdefault(wid, {"events": 0})
+            g["events"] += 1
+            if ev["event"] == "tier.seal":
+                g["seal_ts"] = ev["ts"]
+                g["sealed_members"] = ev["a"]
+                g["group_size"] = ev["b"]
+            elif ev["event"] == "tier.upstream":
+                g["upstream_ts"] = ev["ts"]
+                g["upstream_s"] = ev["a"] / 1e6
+                g["upstream_bytes"] = ev["b"]
+            elif ev["event"] == "push.commit":
+                g["commit"] = ev["ts"]
             continue
         w = workers.setdefault(wid, {"events": 0})
         w["events"] += 1
@@ -168,17 +200,25 @@ def iteration_timeline(events: list[dict], iteration: int) -> dict:
             commits_by_pid[key] = commits_by_pid.get(key, 0) + 1
         elif ev["event"] == "failover.retry":
             w["failover_retry"] = ev["note"]
+        elif ev["event"] == "tier.fold":
+            w["tier_folds"] = w.get("tier_folds", 0) + 1
     for (wid, _pid), n in commits_by_pid.items():
         w = workers[wid]
         w["commits"] = max(w.get("commits", 0), n)
     out: dict[str, Any] = {"iteration": iteration, "workers": workers,
                            "events": len(evs)}
+    if groups:
+        out["groups"] = groups
     if commits:
         first, last = commits[0], commits[-1]
         out["first_commit"] = {"worker": first["worker"], "ts": first["ts"]}
         out["last_commit"] = {"worker": last["worker"], "ts": last["ts"]}
         out["commit_spread_s"] = last["ts"] - first["ts"]
         out["straggler"] = last["worker"]
+        if _is_group(last["worker"]):
+            # attribution by NAME: the barrier-close critical path ran
+            # through this group's leaf hop, not a phantom worker
+            out["straggler_group"] = _group_label(last["worker"])
     if seals:
         out["seal_ts"] = seals[0]["ts"]
     if drains:
@@ -220,12 +260,31 @@ def critical_path(events: list[dict], iteration: int,
     if "publish_ts" not in tl or "last_commit" not in tl:
         return []
     straggler = tl["last_commit"]["worker"]
-    w = tl["workers"].get(straggler, {})
     chain: list[tuple[str, float]] = []
-    if "step_start" in w:
-        chain.append((f"worker {straggler} step start", w["step_start"]))
-    chain.append((f"worker {straggler} push commit (closes barrier)",
-                  tl["last_commit"]["ts"]))
+    if _is_group(straggler):
+        # the close gated on a GROUP's leaf hop (tiers/): name it, and
+        # chart the intra-group legs — seal (last member arrived at the
+        # leaf) and the quantized upstream push — so a slow group is
+        # attributable to its own phases, not just "slow"
+        label = tl.get("straggler_group") or _group_label(straggler)
+        g = tl.get("groups", {}).get(straggler, {})
+        if "seal_ts" in g:
+            chain.append((f"{label} sealed at its leaf "
+                          f"({g.get('sealed_members', '?')} members)",
+                          g["seal_ts"]))
+        if "upstream_ts" in g:
+            chain.append((f"{label} quantized upstream push "
+                          f"({g.get('upstream_bytes', 0)} B)",
+                          g["upstream_ts"]))
+        chain.append((f"{label} upstream commit (closes barrier)",
+                      tl["last_commit"]["ts"]))
+    else:
+        w = tl["workers"].get(straggler, {})
+        if "step_start" in w:
+            chain.append((f"worker {straggler} step start",
+                          w["step_start"]))
+        chain.append((f"worker {straggler} push commit (closes barrier)",
+                      tl["last_commit"]["ts"]))
     if "seal_ts" in tl:
         chain.append(("barrier seal", tl["seal_ts"]))
     if "apply_ts" in tl:
@@ -258,7 +317,8 @@ def failure_narrative(rings: list[dict], events: list[dict]) -> dict:
                for e in events if e["event"] == "failover.retry"]
     degrades = [{"role": e["role"], "what": e["event"], "note": e["note"]}
                 for e in events
-                if e["event"] in ("repl.degrade", "shm.downgrade")]
+                if e["event"] in ("repl.degrade", "shm.downgrade",
+                                  "tier.downgrade")]
     out: dict[str, Any] = {}
     if dead:
         out["dead_processes"] = dead
@@ -354,12 +414,24 @@ def render_report(rep: dict) -> str:
     if tl:
         lines.append(f"iteration {rep['iteration']}:")
         if "barrier_width" in tl:
+            straggler = ""
+            if "straggler" in tl:
+                straggler = (f", straggler {tl['straggler_group']}"
+                             if "straggler_group" in tl
+                             else f", straggler worker {tl['straggler']}")
             lines.append(f"  barrier: {tl.get('contributors', '?')}/"
                          f"{tl['barrier_width']} contributors, "
                          f"commit spread "
                          f"{_fmt_dt(tl.get('commit_spread_s', 0.0))}"
-                         + (f", straggler worker {tl['straggler']}"
-                            if "straggler" in tl else ""))
+                         + straggler)
+        for gid in sorted(tl.get("groups", {})):
+            g = tl["groups"][gid]
+            parts = [f"{g.get('sealed_members', '?')}/"
+                     f"{g.get('group_size', '?')} members sealed"]
+            if "upstream_s" in g:
+                parts.append(f"upstream {_fmt_dt(g['upstream_s'])} "
+                             f"({g.get('upstream_bytes', 0)} B quantized)")
+            lines.append(f"  {_group_label(gid)}: {', '.join(parts)}")
         if "apply_s" in tl:
             lines.append(f"  optimizer apply: {_fmt_dt(tl['apply_s'])}")
         for wid in sorted(tl.get("workers", {})):
@@ -374,6 +446,8 @@ def render_report(rep: dict) -> str:
                 parts.append(f"{w['commits']} commits (retried)")
             if "failover_retry" in w:
                 parts.append(f"failed over to {w['failover_retry']}")
+            if w.get("tier_folds"):
+                parts.append(f"{w['tier_folds']} leaf folds (tiered)")
             lines.append(f"  worker {wid}: "
                          + (", ".join(parts) if parts
                             else f"{w['events']} events"))

@@ -213,6 +213,11 @@ class PSClient(RpcClient):
     other methods are plain :meth:`RpcClient.call`.
     """
 
+    # single-PS fused topology: the hierarchical-aggregation tier
+    # (tiers/group_client.py) can interpose a same-host leaf aggregator
+    # in front of this connection; the sharded fan-out client says False
+    supports_tiers = True
+
     def __init__(self, target: str,
                  service: str = m.PARAMETER_SERVER_SERVICE,
                  methods=None, chunk_bytes: int | None = None):

@@ -23,6 +23,7 @@ from ..obs.export import ClusterAggregator
 from ..replication import messages as rmsg
 from ..rpc import messages as m
 from ..rpc.service import bind_service, make_server
+from ..tiers import messages as tmsg
 
 log = logging.getLogger("pst.coordinator")
 
@@ -108,6 +109,28 @@ class CoordinatorService:
                                                  request.observed_primary)
         return self._map_response(epoch, entries)
 
+    # ----------------------------------------------------------------- tiers
+    # RPC (framework extension, tiers/): register-and-query of the
+    # two-tier reduction topology.  Messages live OUTSIDE rpc/messages.py
+    # (wire manifest pinned); reference clients never call it.
+    def GetReductionTopology(self, request: tmsg.TierTopologyRequest,
+                             context) -> tmsg.TierTopologyResponse:
+        if request.dead_leaf:
+            log.warning("worker %d reports tier leaf %s dead",
+                        request.worker_id, request.dead_leaf)
+        epoch, groups, enabled, min_group, latched = self.core.tier_register(
+            request.worker_id, request.host_id, request.leaf_address,
+            request.dead_leaf)
+        return tmsg.TierTopologyResponse(
+            epoch=epoch, enabled=enabled, min_group_size=min_group,
+            latched_flat=latched,
+            groups=[tmsg.TierGroupEntry(
+                host_id=g.host_id,
+                leader_worker_id=g.leader_worker_id,
+                aggregate_id=g.aggregate_id,
+                leaf_address=g.leaf_address,
+                member_ids=list(g.member_ids)) for g in groups])
+
 
 class Coordinator:
     """Process-level assembly (reference: run_coordinator_server at
@@ -127,7 +150,8 @@ class Coordinator:
         self._server = make_server()
         bind_service(self._server, m.COORDINATOR_SERVICE,
                      {**m.COORDINATOR_METHODS, **m.COORDINATOR_EXT_METHODS,
-                      **rmsg.REPLICATION_COORD_METHODS},
+                      **rmsg.REPLICATION_COORD_METHODS,
+                      **tmsg.TIER_COORD_METHODS},
                      self.service)
         addr = f"{self.config.bind_address}:{self.config.port}"
         self._port = self._server.add_insecure_port(addr)

@@ -562,7 +562,8 @@ class ParameterServer:
     (reference: run_server at src/parameter_server_service.cpp:177-191)."""
 
     def __init__(self, config: ParameterServerConfig,
-                 live_workers_fn: Callable[[], int] | None = None):
+                 live_workers_fn: Callable[[], int] | None = None,
+                 contributions_fn: Callable | None = None):
         self.config = config
         optimizer = make_optimizer(config.optimizer, config.learning_rate,
                                    config.momentum, config.weight_decay)
@@ -574,6 +575,10 @@ class ParameterServer:
             live_workers_ttl_s=config.live_workers_ttl_s,
             gc_iterations=config.gc_iterations,
             aggregation=config.aggregation or None,
+            # tier contribution weights (tiers/topology.py
+            # TierContributionProvider): a leaf aggregator's ONE upstream
+            # push counts as its whole group on the barrier
+            contributions_fn=contributions_fn,
         )
         self.ckpt = CheckpointManager(
             self.core,
@@ -590,14 +595,55 @@ class ParameterServer:
         self.replicator: Replicator | None = None
         mode = (config.replication
                 or os.environ.get("PSDT_REPLICATION", "async")).lower()
-        if config.backup_address and mode not in ("off", "0", "false"):
+        replication_on = mode not in ("off", "0", "false")
+        if config.backup_address and replication_on:
             self.replicator = Replicator(self.core, config.backup_address,
                                          mode=mode)
+        # Replication headroom (ISSUE 9 satellite): a backup that gets
+        # PROMOTED starts serving barriers with no backup of its own —
+        # silently, until now.  The unarmed gauge flags that window in
+        # pst-status --metrics, and a configured --standby address
+        # re-arms the promoted primary's Replicator automatically: the
+        # standby replicator stays DORMANT until the first barrier close
+        # proves this process is a serving primary (a pure backup never
+        # closes barriers — it installs deltas), then starts shipping.
+        self._obs_unarmed = obs_stats.gauge("ps.replica.unarmed")
+        self._standby: Replicator | None = None
+        if (self.replicator is None and replication_on
+                and config.standby_address):
+            self._standby = Replicator(self.core, config.standby_address,
+                                       mode=mode)
+        if self.replicator is None:
+            self.core.set_replication_hook(self._on_primary_apply)
         self._server: grpc.Server | None = None
 
     @property
     def bound_port(self) -> int:
         return self._port
+
+    def _on_primary_apply(self) -> None:
+        """Replication hook of a PS with no armed Replicator: a barrier
+        close means this process is serving as a PRIMARY.  If it had
+        ever installed a replica delta it is a PROMOTED backup — re-arm
+        toward the standby when one is configured (this close's state
+        ships too), else surface the unreplicated window as the
+        ps.replica.unarmed gauge.  MUST NOT raise (core contract)."""
+        if self.service.replica_sink.primary_version < 0:
+            return  # never was a replica: ordinary unreplicated primary
+        standby, self._standby = self._standby, None
+        if standby is not None:
+            self.replicator = standby
+            standby.start()  # swaps the core hook to the replicator's
+            standby.on_apply()  # do not lose THIS close's ship
+            self._obs_unarmed.set(0)
+            flight.record("repl.ship.start", a=0, b=0,
+                          note=f"re-armed -> {standby.backup_address}")
+            log.warning("promoted primary re-armed replication toward "
+                        "standby %s", standby.backup_address)
+        elif not self._obs_unarmed.value:
+            self._obs_unarmed.set(1)
+            log.warning("promoted primary is serving WITHOUT a backup "
+                        "(no --standby configured) — ps.replica.unarmed")
 
     def start(self) -> int:
         """Start serving; returns the bound port (0 in config = ephemeral)."""
@@ -641,6 +687,9 @@ class ParameterServer:
     def stop(self, grace: float = 1.0) -> None:
         if self.replicator is not None:
             self.replicator.stop()
+        if self._standby is not None:
+            # dormant (never armed): just release its channel + hook
+            self._standby.stop()
         self.ckpt.stop()
         # tear down shm connections first: their serving threads may be
         # parked on the barrier CV or a ring doorbell, and closing the

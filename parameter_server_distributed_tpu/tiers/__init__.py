@@ -1,0 +1,40 @@
+"""Hierarchical quantized aggregation (ISSUE 9): two-tier reduction tree.
+
+Flat parameter-server topology pushes every worker's full-rate f32
+gradients point-to-point at the PS, so PS ingress bytes and barrier-close
+latency grow linearly with worker count — the bilinear bottleneck of the
+paper's topology.  This package adds a coordinator-assigned TWO-TIER
+reduction tree exploiting the same-host bandwidth gap (arXiv:1810.11112)
+with per-compression-point error feedback (EQuARX, arXiv:2506.17615):
+
+- the coordinator groups tier-registered workers by same-host identity
+  (the ``hostname/boot-id`` ``host_id`` of rpc/shm_transport.py) and
+  elects one **leaf aggregator** per group (:mod:`tiers.topology`,
+  served via the ``GetReductionTopology`` coordinator extension RPC —
+  messages in :mod:`tiers.messages`, OUTSIDE ``rpc/messages.py``, so the
+  reference wire manifest stays byte-unchanged);
+- group members push their gradients to the leaf over the existing fused
+  ``PushPullStream`` (same-host legs ride the PR-6 shm rings); the leaf
+  (:mod:`tiers.leaf`) reuses the streaming ``PushSink``/``begin_push``
+  fold machinery of ``core/ps_core.py`` to fold-on-arrival, and once its
+  group seals sends ONE quantized (int8/topk) upstream contribution whose
+  barrier weight is the group size — the PS mean over workers is
+  unchanged — then fans the fused parameter response back to its group;
+- both compression points (worker→leaf, if lossy, and leaf→PS) carry
+  their own error-feedback residual (:mod:`tiers.ef`, the generalization
+  of the PR-5 worker-side ``_ef_residual``), keeping convergence at
+  flat-f32 quality;
+- every leg downgrades PR-2-style: UNIMPLEMENTED / refusal / leaf death
+  permanently drops the connection back to the flat topology with zero
+  failed steps (:mod:`tiers.group_client`).
+
+Env knobs: ``PSDT_TIERS`` (default off), ``PSDT_TIER_MIN_GROUP`` (group
+size threshold, default 2), ``PSDT_TIER_DTYPE`` (leaf→PS quantization,
+default int8), ``PSDT_TIER_PUSH_DTYPE`` (worker→leaf encoding, default
+f32).  See docs/training.md "Hierarchical aggregation".
+"""
+
+from .ef import ErrorFeedback  # noqa: F401 — public
+from .messages import TIER_AGGREGATE_ID_BASE, TIER_COORD_METHODS  # noqa: F401
+from .topology import (min_group_size, tier_push_dtype,  # noqa: F401
+                       tier_wire_dtype, tiers_enabled)

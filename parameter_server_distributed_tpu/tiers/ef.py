@@ -1,0 +1,125 @@
+"""Per-tier error feedback: the generalized lossy-compression residual.
+
+PR 5 gave the worker an ``_ef_residual`` dict for its int8/topk pushes:
+send ``compress(grad + residual)``, carry the un-sent part into the next
+push, so quantization bias cancels over time (1-bit-SGD / EF-SGD /
+Deep-Gradient-Compression).  The two-tier reduction tree (ISSUE 9) has
+TWO compression points — worker→leaf (if lossy) and leaf→PS — and each
+must carry its OWN residual: a shared one would mix errors measured
+against different reference signals and re-introduce bias.  This class
+is that stage, one instance per compression point; the worker's PS-leg
+residual and ``_compress_with_feedback`` are now thin wrappers over it
+(worker/worker.py), and the leaf aggregator holds one for its upstream
+quantized contribution (tiers/leaf.py).
+
+Commit discipline (unchanged from PR 5): the staged residual of a push
+is committed only after the receiver ACCEPTS it — a rejected push's
+payload was discarded whole, so its quantization error must not leak
+into the next push — and a retry replays the same adjusted payload
+against the same committed residual, which is what lets the receiver's
+per-(worker, tensor) dedup absorb the replay.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping
+
+import numpy as np
+
+from ..rpc import messages as m
+
+ENV_FLAG = "PSDT_ERROR_FEEDBACK"
+
+
+def error_feedback_enabled() -> bool:
+    """PSDT_ERROR_FEEDBACK gates every residual carry (default ON: lossy
+    wire dtypes without it accumulate quantization bias push over push).
+    ``0`` disables — the A/B knob the convergence tests compare."""
+    return os.environ.get(ENV_FLAG, "1") not in ("0", "off")
+
+
+class ErrorFeedback:
+    """One compression point's residual stage.
+
+    ``residual`` is the COMMITTED carry (what the receiver has provably
+    not seen); ``begin``/``adjust``/``stage`` build the next push's
+    pending carry, and ``commit`` promotes it once the push is accepted.
+    Not thread-safe by itself — each instance belongs to one serialized
+    push path (the worker's step loop, the leaf's relay under its core's
+    ``_apply_lock``)."""
+
+    __slots__ = ("residual", "_pending", "enabled")
+
+    def __init__(self, enabled: bool | None = None):
+        self.residual: dict[str, np.ndarray] = {}
+        self._pending: dict[str, np.ndarray] = {}
+        # None = follow the env gate per call (the worker's behavior)
+        self.enabled = enabled
+
+    def _on(self) -> bool:
+        return error_feedback_enabled() if self.enabled is None \
+            else self.enabled
+
+    def on(self) -> bool:
+        """Whether the carry is live (instance override or env gate)."""
+        return self._on()
+
+    def pending(self) -> dict[str, np.ndarray]:
+        """The staged (uncommitted) carry of the push being built — what
+        :meth:`commit` would promote.  The worker's two-phase push path
+        reads it to commit by assignment after the PS ack."""
+        return dict(self._pending)
+
+    def __contains__(self, name: str) -> bool:
+        """``name in stage`` — was a residual staged for this tensor in
+        the push being built (the residual-box contract callers held
+        before the stage object replaced the raw dict)."""
+        return name in self._pending
+
+    # -------------------------------------------------------- lazy per-tensor
+    def begin(self) -> None:
+        """Start (or restart — a retry replays from scratch) one push's
+        pending residual."""
+        self._pending = {}
+
+    def adjust(self, name: str, grad: np.ndarray) -> np.ndarray:
+        """``grad + committed residual`` — what gets compressed."""
+        if not self._on():
+            return grad
+        prev = self.residual.get(name)
+        return grad + prev if prev is not None else grad
+
+    def stage(self, name: str, adjusted: np.ndarray, tensor) -> None:
+        """Record what the receiver did NOT see: decoding the wire tensor
+        gives exactly the receiver's view, so ``adjusted - decode`` is
+        the carry."""
+        if self._on():
+            self._pending[name] = adjusted - tensor.to_array()
+
+    def commit(self) -> None:
+        """The push was accepted: the pending carry becomes the committed
+        residual (wholesale — names absent from this push drop their
+        stale carry, matching the PR-5 worker semantics)."""
+        self.residual = dict(self._pending)
+
+    # ----------------------------------------------------------- whole-store
+    def compress(self, store: Mapping[str, np.ndarray], wire_dtype: int,
+                 topk_density: float = m.TOPK_DEFAULT_DENSITY) -> list:
+        """One-shot store compression with the residual carry staged (NOT
+        committed — call :meth:`commit` after the receiver accepts).
+        Returns the wire tensors.  With feedback disabled (or a lossless
+        ``wire_dtype``) this is a plain ``to_wire`` and commit clears the
+        carry."""
+        from ..core.tensor import to_wire
+
+        self.begin()
+        lossy = wire_dtype in (m.WIRE_INT8, m.WIRE_TOPK)
+        if not lossy or not self._on():
+            return to_wire(store, wire_dtype, topk_density=topk_density)
+        adjusted = {name: self.adjust(name, np.asarray(g, np.float32))
+                    for name, g in store.items()}
+        tensors = to_wire(adjusted, wire_dtype, topk_density=topk_density)
+        for t in tensors:
+            self.stage(t.name, adjusted[t.name], t)
+        return tensors

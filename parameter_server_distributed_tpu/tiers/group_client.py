@@ -1,0 +1,261 @@
+"""Worker-side tier runtime: registration, election, leaf rounds,
+permanent downgrade (ISSUE 9).
+
+One :class:`TierClient` per worker.  At construction it pre-binds a
+:class:`~.leaf.LeafAggregator` server (unarmed — see tiers/leaf.py), so
+the very first ``GetReductionTopology`` registration already carries the
+leaf address this worker would serve if elected; the coordinator can
+then form a group in ONE round.  :meth:`maybe_activate` is called at
+each iteration start: while ungrouped it re-registers on a rate-limited
+cadence (workers join at different times); once the coordinator assigns
+a group it arms the own leaf (leader) or connects to the leader's
+(member) and the worker's fused rounds ride the tier.
+
+Downgrade discipline (PR-2, lifted to the topology):
+
+- UNIMPLEMENTED from the coordinator (reference peer) → permanent flat,
+  never asked again.
+- a transport error on the leaf connection (leaf death) → report
+  ``dead_leaf`` to the coordinator (the group dissolves, epoch bump, so
+  the PS's contribution weights stop covering it) and permanent flat.
+- a *soft* miss — the leaf answering ``tier leaf not armed`` (election
+  race) or a leaf barrier timeout (a member of this group pushed flat
+  for this iteration, e.g. during formation) — pushes flat for THIS
+  round only and retries the tier next round; ``_SOFT_FAILURE_LIMIT``
+  consecutive misses harden into the permanent downgrade.
+
+Zero failed steps either way: a flat re-push after the group's upstream
+contribution landed dedups against the PS's member cover, and one that
+never went upstream folds normally.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import grpc
+
+from ..analysis.lock_order import checked_lock
+from ..obs import flight
+from ..obs import stats as obs_stats
+from ..rpc import messages as m
+from ..rpc import shm_transport
+from ..rpc.data_plane import PSClient
+from ..rpc.service import RpcClient
+from . import messages as tmsg
+from . import topology
+from .ef import ErrorFeedback
+from .leaf import LEAF_NOT_ARMED, LEAF_RETRY_FLAT, LeafAggregator
+
+log = logging.getLogger("pst.tiers")
+
+# consecutive soft misses (not-armed / leaf barrier timeout) before the
+# tier hardens into the permanent flat downgrade
+_SOFT_FAILURE_LIMIT = 3
+
+
+class TierClient:
+    """One worker's view of the reduction topology (see module doc)."""
+
+    def __init__(self, coordinator_address: str, worker_id: int,
+                 ps_address: str, *, host_id: str | None = None,
+                 init_params_fn=None,
+                 topk_density: float = m.TOPK_DEFAULT_DENSITY,
+                 poll_s: float = 0.5, enabled: bool | None = None):
+        self.worker_id = int(worker_id)
+        self.host_id = host_id or shm_transport.host_id()
+        self._init_params_fn = init_params_fn
+        self._poll_s = float(poll_s)
+        self._coord = RpcClient(
+            coordinator_address, m.COORDINATOR_SERVICE,
+            {**m.COORDINATOR_METHODS, **tmsg.TIER_COORD_METHODS})
+        # worker→leaf encoding + its OWN error-feedback stage (tier 1 of
+        # the per-tier EF; engaged only when the leg is lossy)
+        self.push_dtype = topology.tier_push_dtype()
+        self.push_ef = ErrorFeedback()
+        self.topk_density = float(topk_density)
+        # guards the state machine + connection swaps (never held across
+        # an RPC); ranked in analysis/lock_order.py
+        self._lock = checked_lock("TierClient._lock")
+        self._state = "pending" if topology.tiers_enabled(enabled) \
+            else "flat"
+        self._next_poll = 0.0
+        self._soft_failures = 0
+        self._group: tmsg.TierGroupEntry | None = None
+        self._client: PSClient | None = None
+        # pre-bound, unarmed until elected (tiers/leaf.py lifecycle)
+        self._leaf: LeafAggregator | None = None
+        if self._state == "pending":
+            try:
+                self._leaf = LeafAggregator(
+                    self.worker_id, ps_address,
+                    topk_density=self.topk_density)
+            except Exception:  # noqa: BLE001 — leafless workers still tier
+                log.warning("worker %d: could not pre-bind a leaf "
+                            "aggregator; this worker cannot lead",
+                            self.worker_id, exc_info=True)
+        self._obs_downgrades = obs_stats.counter("tier.downgrades")
+        self._obs_rounds = obs_stats.counter("tier.rounds")
+
+    # ------------------------------------------------------------- properties
+    @property
+    def active(self) -> bool:
+        return self._state == "active"
+
+    @property
+    def client(self) -> PSClient | None:
+        return self._client
+
+    @property
+    def group(self) -> tmsg.TierGroupEntry | None:
+        return self._group
+
+    # ------------------------------------------------------------- activation
+    def maybe_activate(self) -> bool:
+        """True when the worker's fused round should ride the tier.
+        While ungrouped, re-registers with the coordinator at most every
+        ``poll_s`` seconds."""
+        with self._lock:
+            if self._state != "pending":
+                return self._state == "active"
+            if time.monotonic() < self._next_poll:
+                return False
+            self._next_poll = time.monotonic() + self._poll_s
+            leaf_address = self._leaf.address if self._leaf else ""
+        try:
+            resp = self._coord.call(
+                "GetReductionTopology",
+                tmsg.TierTopologyRequest(worker_id=self.worker_id,
+                                         host_id=self.host_id,
+                                         leaf_address=leaf_address),
+                timeout=2.0)
+        except grpc.RpcError as exc:
+            code = getattr(exc, "code", None)
+            if callable(code) and code() == grpc.StatusCode.UNIMPLEMENTED:
+                log.info("worker %d: coordinator has no reduction "
+                         "topology; staying flat", self.worker_id)
+                self._go_flat("coordinator UNIMPLEMENTED")
+            return False
+        if not resp.enabled:
+            self._go_flat("tiers disabled at the coordinator")
+            return False
+        if resp.latched_flat:
+            # this worker's former group dissolved (it, or a peer,
+            # downgraded): the coordinator will never group it again —
+            # stop polling and release the idle leaf server
+            self._go_flat("latched permanently flat at the coordinator")
+            return False
+        mine = next((g for g in resp.groups
+                     if self.worker_id in g.member_ids), None)
+        if mine is None:
+            return False  # ungrouped (yet): poll again later
+        return self._adopt_group(mine)
+
+    def _adopt_group(self, group: tmsg.TierGroupEntry) -> bool:
+        lead = int(group.leader_worker_id) == self.worker_id
+        if lead:
+            if self._leaf is None:
+                # we were elected but could not bind a leaf: dissolve
+                self.downgrade("elected leader has no leaf server")
+                return False
+            init = {}
+            if self._init_params_fn is not None:
+                try:
+                    init = self._init_params_fn()
+                except Exception:  # noqa: BLE001 — seed store is optional
+                    log.warning("worker %d: leaf seed store unavailable",
+                                self.worker_id, exc_info=True)
+            self._leaf.arm(len(group.member_ids), int(group.aggregate_id),
+                           init)
+        client = PSClient(group.leaf_address)
+        with self._lock:
+            self._group = group
+            self._client = client
+            self._state = "active"
+        if not lead:
+            self._shutdown_own_leaf()  # not elected: free the idle server
+            flight.record("tier.elect", worker=self.worker_id,
+                          a=len(group.member_ids),
+                          b=int(group.aggregate_id),
+                          note=f"member of {group.leaf_address}")
+        log.info("worker %d: tier active — group of %d via leaf %s (%s)",
+                 self.worker_id, len(group.member_ids), group.leaf_address,
+                 "leading" if lead else "member")
+        return True
+
+    # -------------------------------------------------------------- downgrade
+    def note_success(self) -> None:
+        self._soft_failures = 0
+        self._obs_rounds.add()
+
+    def soft_failure(self, reason: str) -> bool:
+        """A recoverable miss: push flat THIS round, keep the tier.
+        Returns False (and hard-downgrades) once the misses look
+        permanent."""
+        self._soft_failures += 1
+        if self._soft_failures >= _SOFT_FAILURE_LIMIT:
+            self.downgrade(f"{reason} ({self._soft_failures} consecutive)")
+            return False
+        log.info("worker %d: tier round missed (%s); flat for this round",
+                 self.worker_id, reason)
+        return True
+
+    @staticmethod
+    def is_soft_refusal(message: str) -> bool:
+        text = message or ""
+        return LEAF_NOT_ARMED in text or LEAF_RETRY_FLAT in text
+
+    def downgrade(self, reason: str) -> None:
+        """Permanent flat downgrade; reports the dead leaf so the
+        coordinator dissolves the group (the PS's contribution weights
+        stop covering it)."""
+        with self._lock:
+            if self._state == "flat":
+                return
+            self._state = "flat"
+            group, self._group = self._group, None
+            client, self._client = self._client, None
+        self._obs_downgrades.add()
+        flight.record("tier.downgrade", worker=self.worker_id,
+                      note=reason[:48])
+        log.warning("worker %d: tier downgraded to flat topology: %s",
+                    self.worker_id, reason)
+        if client is not None:
+            client.close()
+        self._shutdown_own_leaf()
+        if group is not None and group.leaf_address:
+            try:
+                self._coord.call(
+                    "GetReductionTopology",
+                    tmsg.TierTopologyRequest(worker_id=self.worker_id,
+                                             host_id=self.host_id,
+                                             dead_leaf=group.leaf_address),
+                    timeout=2.0)
+            except grpc.RpcError:
+                # best-effort: the PS weight map self-corrects once any
+                # member's report lands or the registry reaps the group
+                log.warning("worker %d: dead-leaf report failed",
+                            self.worker_id)
+
+    def _go_flat(self, reason: str) -> None:
+        with self._lock:
+            if self._state == "flat":
+                return
+            self._state = "flat"
+        self._shutdown_own_leaf()
+        log.info("worker %d: tier inactive (%s)", self.worker_id, reason)
+
+    def _shutdown_own_leaf(self) -> None:
+        leaf, self._leaf = self._leaf, None
+        if leaf is not None:
+            leaf.stop()
+
+    def close(self) -> None:
+        with self._lock:
+            client, self._client = self._client, None
+            self._state = "flat"
+        if client is not None:
+            client.close()
+        self._shutdown_own_leaf()
+        self._coord.close()

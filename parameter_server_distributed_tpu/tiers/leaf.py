@@ -1,0 +1,281 @@
+"""Leaf aggregator: the intra-host tier of the reduction tree (ISSUE 9).
+
+The elected leader worker of a same-host group runs one of these: a
+loopback gRPC server speaking the SAME fused data plane as a parameter
+server (``PushPullStream`` + ``NegotiateShm``, so member legs ride the
+PR-6 shm rings), backed by a :class:`~..core.ps_core.ParameterServerCore`
+whose streaming ``PushSink``/``begin_push`` machinery folds member
+pushes on arrival — maximum reuse, zero new aggregation semantics.  The
+one divergence is the barrier close: instead of scale + optimizer apply,
+the core's **barrier relay** hands the raw per-name SUMS to
+:meth:`LeafAggregator._relay`, which sends them upstream as ONE
+int8/topk-quantized contribution (error-feedback corrected — its own
+:class:`~.ef.ErrorFeedback` stage) pushed under the group's synthetic
+``aggregate_id``.  The PS folds it with weight = group size (the mean
+over workers is unchanged) and covers every member id on its barrier;
+the fused response's fresh parameters become this core's store, so the
+parked member handlers fan them back through the ordinary serve path
+(encode-once cache included).
+
+Lifecycle: the server BINDS at construction (so the leaf address rides
+the worker's very first topology registration — election needs no
+publish round) but stays UNARMED until the coordinator elects this
+worker: an unarmed leaf answers pushes with a distinct retryable
+refusal, because its placeholder barrier width would otherwise close on
+the first member.  ``arm()`` installs the real group size, the synthetic
+aggregate id, and an initial store (any store — it is replaced by the
+first relay; it only exists so the fused plane's empty-store refusal
+does not fire).
+
+Failure discipline: a relay failure raises
+:class:`TierUpstreamError`, which takes the core's ordinary failed-apply
+path — accumulator put back, barrier retryable, and the retry's upstream
+re-push is idempotent (PS per-(worker, tensor) dedup + member cover).
+Members that give up instead re-push flat with their own ids; the cover
+dedups them, so the two recovery paths can never double-count.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import grpc
+
+from ..core.ps_core import ParameterServerCore
+from ..core.tensor import TensorStore, from_wire
+from ..obs import flight
+from ..obs import stats as obs_stats
+from ..rpc import messages as m
+from ..rpc import shm_transport
+from ..rpc.data_plane import PSClient
+from ..rpc.service import make_server, bind_service
+from ..server.ps_service import ParameterServerService
+from . import topology
+from .ef import ErrorFeedback
+
+log = logging.getLogger("pst.tiers")
+
+# Message marker of the unarmed-leaf refusal: the member treats it as
+# "push flat this round, retry the tier next round" — NOT a downgrade
+# (the election may be one poll away from completing on the leader).
+LEAF_NOT_ARMED = "tier leaf not armed"
+# Same soft semantics when the leaf's UPSTREAM contribution failed (PS
+# unreachable, or the PS rejected an overlapping group sum after a
+# member's downgrade recovery): the member replays flat this round and
+# keeps the tier — the leaf is alive, its upstream hiccuped.
+LEAF_RETRY_FLAT = "tier leaf upstream failed"
+
+
+class TierUpstreamError(RuntimeError):
+    """The leaf's upstream contribution failed; the leaf barrier stays
+    retryable (core failed-apply semantics)."""
+
+
+def leaf_barrier_timeout_s() -> float:
+    """Member park cap at the leaf.  Much shorter than the PS's 60 s
+    default: the common stall is a formation race (one member still
+    pushing flat for this iteration), and the member's recovery — flat
+    re-push, cover-dedup'd — is cheap."""
+    return float(os.environ.get("PSDT_TIER_BARRIER_TIMEOUT_S", "20"))
+
+
+class LeafService(ParameterServerService):
+    """The PS service surface re-hosted on a leaf core: same fused data
+    plane, same shm negotiation; checkpointing is refused (a leaf holds
+    no durable state) and pushes before :meth:`LeafAggregator.arm` are
+    refused retryably."""
+
+    def __init__(self, core: ParameterServerCore, leaf: "LeafAggregator"):
+        super().__init__(core, ckpt=None)
+        self._leaf = leaf
+
+    @staticmethod
+    def _fused_barrier_timeout_s() -> float:
+        return leaf_barrier_timeout_s()
+
+    def _not_armed(self) -> m.PushResponse:
+        return m.PushResponse(
+            success=False,
+            message=f"{LEAF_NOT_ARMED} (election pending; push flat and "
+                    f"retry the tier next round)",
+            iteration=self.core.current_iteration)
+
+    def PushPullStream(self, request_iterator, context):
+        if not self._leaf.armed:
+            yield m.PushPullResponse(push=self._not_armed())
+            return
+
+        def tap():
+            noted = False
+            for chunk in request_iterator:
+                if not noted:
+                    noted = True
+                    # the member-edge evidence pst-trace orders group
+                    # folds by (sampled class, like fold.reserve)
+                    flight.record("tier.fold", iteration=chunk.iteration,
+                                  worker=chunk.worker_id,
+                                  a=len(chunk.gradients),
+                                  b=self._leaf.aggregate_id)
+                yield chunk
+
+        try:
+            yield from super().PushPullStream(tap(), context)
+        except TierUpstreamError as exc:
+            # the relay failed on THIS member's thread (it triggered the
+            # close, or its barrier wait retried it): answer a SOFT
+            # refusal instead of aborting the stream — the member
+            # replays flat this round and keeps the tier.  If the push
+            # verdict already went out, this extra frame is ignored by
+            # the client's first-push-wins assembly and the member sees
+            # a barrier miss — the same soft path.
+            yield m.PushPullResponse(push=m.PushResponse(
+                success=False, message=f"{LEAF_RETRY_FLAT}: {exc}",
+                iteration=self.core.current_iteration))
+
+    def PushGradientsStream(self, request_iterator, context):
+        if not self._leaf.armed:
+            return self._not_armed()
+        return super().PushGradientsStream(request_iterator, context)
+
+    def ReceiveGradients(self, request, context):
+        if not self._leaf.armed:
+            return self._not_armed()
+        return super().ReceiveGradients(request, context)
+
+    # a leaf holds no durable state: checkpoint RPCs are refused
+    def SaveCheckpoint(self, request, context):
+        return m.SaveCheckpointResponse(
+            success=False, message="leaf aggregator holds no checkpoints")
+
+    def LoadCheckpoint(self, request, context):
+        return m.LoadCheckpointResponse(
+            success=False, message="leaf aggregator holds no checkpoints")
+
+
+class LeafAggregator:
+    """One group's intra-host aggregator, hosted by the leader worker."""
+
+    def __init__(self, worker_id: int, upstream_address: str,
+                 bind_address: str = "127.0.0.1",
+                 wire_dtype: int | None = None,
+                 topk_density: float = m.TOPK_DEFAULT_DENSITY,
+                 upstream_timeout_s: float = 120.0,
+                 upstream: PSClient | None = None):
+        self.worker_id = int(worker_id)
+        self.aggregate_id = -1
+        self.group_size = 0
+        self.armed = False
+        self._wire_dtype = (topology.tier_wire_dtype() if wire_dtype is None
+                            else wire_dtype)
+        self._topk_density = float(topk_density)
+        self._upstream_timeout_s = float(upstream_timeout_s)
+        # the leaf's OWN error-feedback stage (tier 2 of the per-tier EF;
+        # serialized by the core's _apply_lock around the relay)
+        self._ef = ErrorFeedback()
+        self._upstream = upstream or PSClient(upstream_address)
+        # stripes=1: groups are a handful of members and the "apply" is a
+        # network relay — the striped executor buys nothing at this tier
+        self.core = ParameterServerCore(total_workers=1, stripes=1)
+        self.core.set_barrier_relay(self._relay)
+        self.service = LeafService(self.core, self)
+        self._obs_upstream_bytes = obs_stats.counter("tier.upstream_bytes")
+        self._obs_relays = obs_stats.counter("tier.relays")
+        self._obs_upstream_s = obs_stats.histogram("tier.upstream_s")
+        self._obs_group = obs_stats.gauge("tier.group_size")
+        self._server = make_server(max_workers=8)
+        bind_service(self._server, m.PARAMETER_SERVER_SERVICE,
+                     {**m.PARAMETER_SERVER_METHODS,
+                      **m.PARAMETER_SERVER_STREAM_METHODS,
+                      **shm_transport.SHM_METHODS}, self.service)
+        self._port = self._server.add_insecure_port(f"{bind_address}:0")
+        if self._port == 0:
+            raise RuntimeError(f"leaf aggregator could not bind on "
+                               f"{bind_address}")
+        self.address = f"{bind_address}:{self._port}"
+        self._server.start()
+
+    def arm(self, group_size: int, aggregate_id: int,
+            init_params: TensorStore) -> None:
+        """Election landed: install the real barrier width, the synthetic
+        upstream pusher id, and a seed store (replaced by the first
+        relay; it only keeps the fused plane's empty-store refusal from
+        firing on the first member push)."""
+        self.group_size = int(group_size)
+        self.aggregate_id = int(aggregate_id)
+        self.core.set_total_workers(self.group_size)
+        if init_params and not self.core.has_parameters:
+            self.core.initialize_parameters(init_params)
+        self._obs_group.set(self.group_size)
+        self.armed = True
+        flight.record("tier.elect", worker=self.worker_id,
+                      a=self.group_size, b=self.aggregate_id,
+                      note=self.address)
+        log.info("leaf aggregator armed at %s: group of %d, aggregate id "
+                 "%d, upstream dtype %s", self.address, self.group_size,
+                 self.aggregate_id,
+                 {v: k for k, v in m.WIRE_DTYPE_NAMES.items()}.get(
+                     self._wire_dtype, self._wire_dtype))
+
+    # ------------------------------------------------------------------ relay
+    def _relay(self, iteration: int, sums: TensorStore,
+               counts: dict[str, int]) -> TensorStore:
+        """The leaf core's barrier close: quantize the group sums (EF
+        adjusted), push them upstream as the group's ONE contribution,
+        and return the fused response's fresh parameters as the leaf's
+        new store.  Runs under the leaf core's _apply_lock
+        (BLOCKING_ALLOWED — the same discipline as sync replication)."""
+        sealed = max(counts.values(), default=0)
+        flight.record("tier.seal", iteration=iteration,
+                      worker=self.aggregate_id, a=sealed, b=self.group_size)
+        tensors = self._ef.compress(sums, self._wire_dtype,
+                                    topk_density=self._topk_density)
+        wire_bytes = sum(t.encoded_size() for t in tensors)
+        fresh: TensorStore = {}
+
+        def on_chunk(chunk_tensors) -> None:
+            fresh.update(from_wire(chunk_tensors))
+
+        # lossless tree (f32/raw upstream) pulls lossless too, so the
+        # two-tier arithmetic is the flat topology's exactly (the chaos
+        # acceptance compares loss curves); a quantized tree pulls bf16
+        # like any lossy-push worker (re-compressing PARAMS every round
+        # would compound irrecoverable error — see worker._pull_wire_dtype)
+        pull_dtype = (m.WIRE_RAW_F32
+                      if self._wire_dtype in (m.WIRE_F32, m.WIRE_RAW_F32)
+                      else m.WIRE_BF16)
+        t0 = time.perf_counter()
+        try:
+            push, params = self._upstream.push_pull(
+                self.aggregate_id, iteration, lambda: iter(tensors),
+                pull_wire_dtype=pull_dtype,
+                timeout=self._upstream_timeout_s, on_chunk=on_chunk)
+        except grpc.RpcError as exc:
+            raise TierUpstreamError(
+                f"upstream push failed: {exc}") from exc
+        if not push.success:
+            raise TierUpstreamError(
+                f"upstream push rejected: {push.message}")
+        if params is None or not fresh:
+            # the PS barrier did not close inside the window (or the
+            # server degraded the fused round): the leaf has nothing to
+            # serve its parked group — retry the close, idempotently
+            raise TierUpstreamError("upstream round delivered no "
+                                    "parameters (PS barrier timeout?)")
+        self._ef.commit()
+        dt = time.perf_counter() - t0
+        self._obs_upstream_bytes.add(wire_bytes)
+        self._obs_relays.add()
+        self._obs_upstream_s.observe(dt)
+        flight.record("tier.upstream", iteration=iteration,
+                      worker=self.aggregate_id, a=int(1e6 * dt),
+                      b=wire_bytes)
+        return fresh
+
+    # -------------------------------------------------------------- lifecycle
+    def stop(self, grace: float = 0.5) -> None:
+        self.armed = False
+        self.service.shm_server.close()
+        self._server.stop(grace)
+        self._upstream.close()

@@ -1,0 +1,86 @@
+"""Reduction-topology extension RPC messages (ISSUE 9).
+
+Deliberately NOT in ``rpc/messages.py``: the analyzer's wire manifest
+pins the reference contract (field tags, method tables) and the tier
+subsystem must leave it byte-unchanged.  ``GetReductionTopology`` is an
+extra method name on the existing coordinator gRPC service — a reference
+coordinator never implements it and answers UNIMPLEMENTED, which the
+worker-side :class:`~.group_client.TierClient` treats as a PERMANENT
+downgrade to the flat topology (the PR-2/PR-6/PR-7 fallback discipline).
+
+One RPC serves three roles, so group formation needs no extra round
+trips:
+
+- **tier registration** — a worker reports its ``host_id`` (the
+  hostname+boot-id identity of rpc/shm_transport.py) and the address of
+  the leaf-aggregator server it pre-bound, so the coordinator can elect
+  it without a publish round;
+- **topology query** — the response carries the current epoch-numbered
+  group list (the PS's contribution-weight provider polls it with
+  ``worker_id = -1`` and an empty ``host_id``, which registers nothing);
+- **downgrade report** — ``dead_leaf`` names a leaf address the caller
+  observed dead; the coordinator dissolves that group (epoch bump) so
+  the PS's contribution weights stop covering it.
+"""
+
+from __future__ import annotations
+
+# The synthetic pusher-id namespace is OWNED by the weighted barrier
+# (core/ps_core.py — an unknown id at/above it is rejected retryably
+# there); re-exported here as the tier protocol constant.  A group's ONE
+# upstream contribution pushes as ``TIER_AGGREGATE_ID_BASE + leader
+# id``, so the PS can tell a group push (weight = group size, covering
+# every member id) from the leader's own flat push (weight 1) without
+# any wire change.  Real worker ids must stay below the base (documented
+# in docs/training.md); obs/postmortem.py mirrors the value to label
+# group lanes without importing this package.
+from ..core.ps_core import TIER_AGGREGATE_ID_BASE  # noqa: F401 — re-export
+from ..rpc.messages import TRACE_FIELD_NUMBER
+from ..rpc.wire import Field, Message
+
+
+def aggregate_id_for(leader_worker_id: int) -> int:
+    return TIER_AGGREGATE_ID_BASE + int(leader_worker_id)
+
+
+class TierGroupEntry(Message):
+    """One same-host reduction group of the epoch-numbered topology."""
+    FIELDS = (
+        Field(1, "host_id", "string"),
+        Field(2, "leader_worker_id", "int32"),
+        Field(3, "aggregate_id", "int32"),
+        Field(4, "leaf_address", "string"),
+        Field(5, "member_ids", "int32", repeated=True),
+    )
+
+
+class TierTopologyRequest(Message):
+    """Register-and-query (see module docstring).  ``worker_id = -1``
+    with an empty ``host_id`` is a pure read (the PS weight provider)."""
+    FIELDS = (
+        Field(1, "worker_id", "int32"),
+        Field(2, "host_id", "string"),
+        Field(3, "leaf_address", "string"),
+        Field(4, "dead_leaf", "string"),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
+    )
+
+
+class TierTopologyResponse(Message):
+    """``latched_flat`` answers the REQUESTING worker: its id is in the
+    coordinator's permanently-flat set (its former group dissolved), so
+    the client must stop polling and release its idle leaf server —
+    without it a rebuilt TierClient would poll at 2 Hz forever."""
+    FIELDS = (
+        Field(1, "epoch", "int32"),
+        Field(2, "enabled", "bool"),
+        Field(3, "min_group_size", "int32"),
+        Field(4, "groups", "message", message_type=TierGroupEntry,
+              repeated=True),
+        Field(5, "latched_flat", "bool"),
+    )
+
+
+TIER_COORD_METHODS = {
+    "GetReductionTopology": (TierTopologyRequest, TierTopologyResponse),
+}

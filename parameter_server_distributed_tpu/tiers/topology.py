@@ -1,0 +1,180 @@
+"""Tier topology: env knobs, deterministic grouping, weight provider.
+
+The grouping itself is a pure function so the coordinator-side state
+(:class:`~..core.coordinator_core.CoordinatorCore`) stays a thin
+registry: tier-registered workers are keyed by their ``host_id`` and a
+host with at least ``min_group_size`` UNGROUPED workers forms a group
+whose leader (and leaf aggregator) is the lowest worker id that
+published a leaf address.  Formed groups are FROZEN: later same-host
+joiners become singletons rather than resizing a live leaf barrier, and
+a dissolved group (dead leaf) never re-forms for the same leaf address —
+the permanent-downgrade discipline, lifted to topology.
+
+:class:`TierContributionProvider` is the PS side: it polls
+``GetReductionTopology`` (pure read) and hands
+``ParameterServerCore`` the ``{aggregate_id: (weight, member_ids)}``
+map its weighted barrier folds consume.  A reference coordinator answers
+UNIMPLEMENTED and the provider latches flat (returns None) forever.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import grpc
+
+from ..rpc import messages as m
+from ..rpc.service import RpcClient
+from . import messages as tmsg
+
+log = logging.getLogger("pst.tiers")
+
+ENV_FLAG = "PSDT_TIERS"
+ENV_MIN_GROUP = "PSDT_TIER_MIN_GROUP"
+ENV_DTYPE = "PSDT_TIER_DTYPE"
+ENV_PUSH_DTYPE = "PSDT_TIER_PUSH_DTYPE"
+
+
+def tiers_enabled(override: bool | None = None) -> bool:
+    """Hierarchical aggregation master switch (default OFF: the flat
+    topology is the reference behavior).  ``override`` is the
+    WorkerConfig tri-state (None = env decides)."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get(ENV_FLAG, "0").lower() in ("1", "true", "on")
+
+
+def min_group_size() -> int:
+    """Same-host workers below this count stay flat singletons — a
+    1-worker "group" would only add a hop."""
+    return max(2, int(os.environ.get(ENV_MIN_GROUP, "2")))
+
+
+def tier_wire_dtype() -> int:
+    """Leaf→PS upstream encoding (the quantized contribution).  int8 is
+    the default (quarter-size, error-feedback corrected); topk and the
+    lossless encodings are accepted for A/B runs."""
+    name = os.environ.get(ENV_DTYPE, "int8")
+    if name not in m.WIRE_DTYPE_NAMES:
+        raise ValueError(f"unknown {ENV_DTYPE} {name!r}; "
+                         f"options: {sorted(m.WIRE_DTYPE_NAMES)}")
+    return m.WIRE_DTYPE_NAMES[name]
+
+
+def tier_push_dtype() -> int:
+    """Worker→leaf encoding.  f32 by default — the leg is same-host
+    (shm rings), so bytes are nearly free and the group fold stays
+    exact; a lossy choice engages the worker's own per-tier
+    error-feedback stage (tiers/ef.py)."""
+    name = os.environ.get(ENV_PUSH_DTYPE, "f32")
+    if name not in m.WIRE_DTYPE_NAMES:
+        raise ValueError(f"unknown {ENV_PUSH_DTYPE} {name!r}; "
+                         f"options: {sorted(m.WIRE_DTYPE_NAMES)}")
+    return m.WIRE_DTYPE_NAMES[name]
+
+
+# ------------------------------------------------------------------ grouping
+
+def form_groups(tier_workers: dict[int, tuple[str, str]],
+                existing: list[tmsg.TierGroupEntry],
+                dissolved_leaves: set[str],
+                min_group: int | None = None
+                ) -> tuple[list[tmsg.TierGroupEntry], bool]:
+    """(groups, changed).  ``tier_workers``: worker_id -> (host_id,
+    leaf_address) for every live tier-registered worker.  ``existing``
+    groups survive verbatim while every member is still live and their
+    leaf is not dissolved; NEW groups form only from ungrouped workers
+    (frozen-membership rule, see module docstring).  Deterministic: for
+    a given registry the same groups come out on every call."""
+    min_group = min_group_size() if min_group is None else min_group
+    groups: list[tmsg.TierGroupEntry] = []
+    changed = False
+    grouped: set[int] = set()
+    for entry in existing:
+        members = list(entry.member_ids)
+        if (entry.leaf_address not in dissolved_leaves
+                and all(wid in tier_workers for wid in members)):
+            groups.append(entry)
+            grouped.update(members)
+        else:
+            changed = True  # dissolved or shrunk below its frozen roster
+    by_host: dict[str, list[int]] = {}
+    for wid in sorted(tier_workers):
+        if wid in grouped:
+            continue
+        host_id, _ = tier_workers[wid]
+        if host_id:
+            by_host.setdefault(host_id, []).append(wid)
+    for host_id in sorted(by_host):
+        members = by_host[host_id]
+        if len(members) < min_group:
+            continue
+        # leader = lowest id that pre-bound a leaf server; a host where
+        # nobody published a leaf address yet stays ungrouped (the next
+        # registration retries)
+        leaders = [wid for wid in members
+                   if tier_workers[wid][1]
+                   and tier_workers[wid][1] not in dissolved_leaves]
+        if not leaders:
+            continue
+        leader = leaders[0]
+        groups.append(tmsg.TierGroupEntry(
+            host_id=host_id, leader_worker_id=leader,
+            aggregate_id=tmsg.aggregate_id_for(leader),
+            leaf_address=tier_workers[leader][1],
+            member_ids=members))
+        changed = True
+    return groups, changed
+
+
+def contribution_map(groups) -> dict[int, tuple[int, tuple[int, ...]]]:
+    """Topology groups -> the ``{aggregate_id: (weight, member ids)}``
+    map ``ParameterServerCore`` folds group contributions with: the
+    weight keeps the PS per-name mean a true mean over WORKERS, and the
+    member cover marks every grouped worker a barrier contributor (so a
+    member's flat re-push after a mid-iteration downgrade dedups as a
+    duplicate instead of double-counting)."""
+    return {int(g.aggregate_id): (len(g.member_ids),
+                                  tuple(int(wid) for wid in g.member_ids))
+            for g in groups}
+
+
+class TierContributionProvider:
+    """PS-side topology poll: callable returning the contribution map
+    (None = flat / extension unsupported).  The core TTL-caches the
+    result (``contributions_ttl_s``), so this issues at most ~1 RPC/s.
+    UNIMPLEMENTED latches flat permanently — a reference coordinator is
+    never asked twice."""
+
+    def __init__(self, coordinator_address: str,
+                 client: RpcClient | None = None):
+        self._client = client or RpcClient(
+            coordinator_address, m.COORDINATOR_SERVICE,
+            {**m.COORDINATOR_METHODS, **tmsg.TIER_COORD_METHODS})
+        self._supported: bool | None = None
+
+    def close(self) -> None:
+        self._client.close()
+
+    def __call__(self) -> dict[int, tuple[int, tuple[int, ...]]] | None:
+        if self._supported is False:
+            return None
+        try:
+            resp = self._client.call(
+                "GetReductionTopology",
+                tmsg.TierTopologyRequest(worker_id=-1), timeout=2.0)
+        except grpc.RpcError as exc:
+            code = getattr(exc, "code", None)
+            if callable(code) and code() == grpc.StatusCode.UNIMPLEMENTED:
+                log.info("coordinator does not speak GetReductionTopology; "
+                         "contribution weights stay flat")
+                self._supported = False
+                return None
+            # transient: keep the core's cached map (it passes None
+            # through as "no update"; the TTL retries)
+            return None
+        self._supported = True
+        if not resp.enabled:
+            return {}
+        return contribution_map(resp.groups)

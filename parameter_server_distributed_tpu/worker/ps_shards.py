@@ -81,6 +81,14 @@ class ShardedPSClient:
     # round is the common case; two covers a promotion racing a reshard
     _MAX_ROUND_REPLAYS = 3
 
+    # The hierarchical-aggregation tier (tiers/group_client.py) does not
+    # interpose on the sharded topology: the leaf would have to sit
+    # between the per-tensor partitioner and N shard barriers, and every
+    # shard's contributor accounting would need the group cover — the
+    # flat fan-out already overlaps shards, so the tier's win is the
+    # single-PS ingress bottleneck it was built for (ISSUE 9).
+    supports_tiers = False
+
     def __init__(self, addresses: Sequence[str],
                  service: str = m.PARAMETER_SERVER_SERVICE,
                  methods=None,

@@ -54,6 +54,12 @@ from ..obs.export import snapshot_blob
 from ..rpc import messages as m
 from ..rpc.data_plane import PSClient
 from ..rpc.service import RpcClient
+# the per-tier error-feedback stage (tiers/ef.py, ISSUE 9): the PS-leg
+# residual below and the tier legs (worker→leaf, leaf→PS) are all
+# instances of the same stage — one residual per compression point.
+# error_feedback_enabled is re-exported here for back-compat (it lived
+# in this module through PR 8).
+from ..tiers.ef import ErrorFeedback, error_feedback_enabled  # noqa: F401
 from ..utils.metrics import MetricsLogger, StepTimer
 
 log = logging.getLogger("pst.worker")
@@ -69,14 +75,6 @@ def _is_stale_shard_map(push) -> bool:
     the bounded-staleness 'stale push' rejection of async mode."""
     from ..replication.messages import STALE_SHARD_MAP
     return STALE_SHARD_MAP in (push.message or "")
-
-
-def error_feedback_enabled() -> bool:
-    """PSDT_ERROR_FEEDBACK gates the lossy-push error-feedback residual
-    (default ON: lossy wire dtypes without it accumulate quantization
-    bias push over push).  ``0`` disables the carry — the A/B knob the
-    convergence tests and benches compare against."""
-    return os.environ.get("PSDT_ERROR_FEEDBACK", "1") not in ("0", "off")
 
 
 class Worker:
@@ -123,6 +121,12 @@ class Worker:
         self._ps_address: str | None = None
         self._total_workers = 0
         self._requested_wire_dtype = m.WIRE_DTYPE_NAMES[config.wire_dtype]
+        # PS-leg error-feedback stage (see _ef_residual property below);
+        # must exist before _reset_wire_negotiation resets it
+        self._push_ef = ErrorFeedback()
+        # hierarchical aggregation (tiers/group_client.py): built at
+        # discovery when enabled and the topology supports it
+        self._tier = None
         self._reset_wire_negotiation()
         self.last_bootstrap = False  # True iff the last iteration seeded the PS
         # Parameters delivered by the previous iteration's fused round —
@@ -170,6 +174,9 @@ class Worker:
         # since the last periodic beat (obs/export.py piggyback)
         self.send_heartbeat()
         self._prefetch_pool.shutdown(wait=False)
+        if self._tier is not None:
+            self._tier.close()
+            self._tier = None
         self._coordinator.close()
         if self._ps is not None:
             self._ps.close()
@@ -219,6 +226,46 @@ class Worker:
                      self._ps_address)
         self._reset_wire_negotiation()  # a new PS must re-prove packed support
         self._next_params = None  # cached params were the OLD PS's
+        self._setup_tier()
+
+    def _setup_tier(self) -> None:
+        """Build the hierarchical-aggregation runtime (tiers/, ISSUE 9)
+        when enabled and the topology supports it: single-PS fused data
+        plane only — the sharded client owns its own fan-out weighting,
+        and the tier would sit between the partitioner and the shards."""
+        from ..tiers.topology import tiers_enabled
+
+        if self._tier is not None:
+            self._tier.close()
+            self._tier = None
+        if not (tiers_enabled(getattr(self.config, "tiers", None))
+                and self.config.fused_step
+                and getattr(self._ps, "supports_tiers", False)):
+            return
+        from ..tiers.group_client import TierClient
+        trainer = self.trainer
+        self._tier = TierClient(
+            self.config.coordinator_address, self.config.worker_id,
+            self._ps_address,
+            host_id=getattr(self.config, "tier_host_id", "") or None,
+            init_params_fn=(
+                (lambda: trainer.init_params(seed=0))
+                if trainer is not None else None),
+            topk_density=self.config.topk_density,
+            # forward the config tri-state: --tiers must work without
+            # PSDT_TIERS exported in the worker's own environment
+            enabled=getattr(self.config, "tiers", None))
+
+    # The PS-leg residual dict, kept as an attribute-shaped view over the
+    # ErrorFeedback stage for back-compat (tests and older call sites
+    # poke `worker._ef_residual` directly).
+    @property
+    def _ef_residual(self) -> dict[str, np.ndarray]:
+        return self._push_ef.residual
+
+    @_ef_residual.setter
+    def _ef_residual(self, value: dict[str, np.ndarray]) -> None:
+        self._push_ef.residual = dict(value)
 
     def _reset_wire_negotiation(self) -> None:
         """Packed pushes start only after the connected PS proves it honors
@@ -230,7 +277,7 @@ class Worker:
         self._peer_packed_ok = self._wire_dtype == m.WIRE_F32
         # int8 pushes carry quantization error forward (error feedback);
         # residuals are per-PS-connection state
-        self._ef_residual: dict[str, np.ndarray] = {}
+        self._ef_residual = {}
 
     def _pull_wire_dtype(self) -> int:
         """Encoding requested for served parameters.  The lossy encodings
@@ -421,64 +468,68 @@ class Worker:
         error under int8, the whole non-top-k mass under topk — into the
         next push, so compression bias cancels over time instead of
         accumulating.  The residual is what the PS did NOT see: decoding
-        the wire tensor gives exactly the server's view."""
-        adjusted = {}
-        for name, g in grads.items():
-            g = np.asarray(g, np.float32)
-            prev = self._ef_residual.get(name)
-            adjusted[name] = g + prev if prev is not None else g
-        tensors = to_wire(adjusted, wire_dtype,
-                          topk_density=self.config.topk_density)
-        residual = {t.name: adjusted[t.name] - t.to_array() for t in tensors}
-        return tensors, residual
+        the wire tensor gives exactly the server's view.  Implemented on
+        the shared per-tier stage (tiers/ef.py) — this is the PS-leg
+        instance; the caller commits the returned carry only after the
+        PS accepts the push."""
+        tensors = self._push_ef.compress(
+            grads, wire_dtype, topk_density=self.config.topk_density)
+        return tensors, self._push_ef.pending()
 
     # -------------------------------------------------------- fused data plane
     def _use_fused(self) -> bool:
         return (self.config.fused_step and self._ps is not None
                 and hasattr(self._ps, "push_pull"))
 
-    def _wire_tensors(self, grads):
+    def _wire_tensors(self, grads, push_dtype: int | None = None,
+                      ef: ErrorFeedback | None = None):
         """Lazy wire-tensor producer for the fused push.
 
         ``grads``: a mapping OR a lazy ``(name, array)`` iterable
         (trainer.GradientBuckets — each re-iteration replays from its
-        host-side cache).  Returns ``(tensors_fn, residual_box)``:
+        host-side cache).  Returns ``(tensors_fn, ef_stage)``:
         ``tensors_fn()`` yields wire tensors one by one — compression +
         error-feedback adjustment happen per tensor AS the RPC sender
         consumes it, so D2H fetch ⊕ compress ⊕ encode ⊕ transport
-        pipeline per bucket.  ``residual_box`` (non-None under int8/topk)
-        fills with the new error-feedback residual; the caller commits it
-        only after the PS accepts the push.
+        pipeline per bucket.  ``ef_stage`` (non-None under a lossy
+        encoding with feedback on) holds the staged residual; the caller
+        ``commit()``s it only after the receiver accepts the push.
+
+        ``push_dtype``/``ef`` default to the PS-leg negotiation and the
+        PS-leg stage; the tier rounds pass their own (tiers/, ISSUE 9 —
+        one residual per compression point).
 
         Replays are payload-identical: a retry re-reads the same gradients
         (GradientBuckets' host-side cache) against the same committed
-        ``_ef_residual``, which is what lets the PS's streaming
-        aggregation dedup a retried push per (worker, tensor) instead of
-        double-counting it (core/ps_core.py first-push-wins)."""
-        push_dtype = self._wire_dtype if self._peer_packed_ok else m.WIRE_F32
+        residual, which is what lets the receiving aggregator dedup a
+        retried push per (worker, tensor) instead of double-counting it
+        (core/ps_core.py first-push-wins)."""
+        if push_dtype is None:
+            push_dtype = (self._wire_dtype if self._peer_packed_ok
+                          else m.WIRE_F32)
         compress = push_dtype in (m.WIRE_INT8, m.WIRE_TOPK)
-        use_ef = compress and error_feedback_enabled()
-        residual_box: dict[str, np.ndarray] | None = {} if use_ef else None
+        stage = ef if ef is not None else self._push_ef
+        use_ef = compress and stage.on()
+        ef_stage: ErrorFeedback | None = stage if use_ef else None
 
         def tensors():
-            if residual_box is not None:
-                residual_box.clear()  # a retry replays from scratch
+            if ef_stage is not None:
+                ef_stage.begin()  # a retry replays from scratch
             payload = wire = 0
             pairs = grads.items() if hasattr(grads, "items") else grads
             for name, g in pairs:
                 g = np.asarray(g, np.float32)
                 payload += 4 * g.size
                 if compress:
-                    prev = (self._ef_residual.get(name) if use_ef
-                            else None)
-                    adjusted = g + prev if prev is not None else g
+                    adjusted = (ef_stage.adjust(name, g) if ef_stage
+                                else g)
                     t = m.Tensor.from_array(
                         name, adjusted, wire_dtype=push_dtype,
                         topk_density=self.config.topk_density)
-                    if use_ef:
-                        # what the PS did NOT see carries into the next
-                        # push
-                        residual_box[name] = adjusted - t.to_array()
+                    if ef_stage is not None:
+                        # what the receiver did NOT see carries into the
+                        # next push
+                        ef_stage.stage(name, adjusted, t)
                 else:
                     t = m.Tensor.from_array(name, g, wire_dtype=push_dtype)
                 wire += t.encoded_size()
@@ -486,7 +537,61 @@ class Worker:
             self._obs_push_payload.add(payload)
             self._obs_push_wire.add(wire)
 
-        return tensors, residual_box
+        return tensors, ef_stage
+
+    def _tier_push_pull(self, tier, iteration: int, grads
+                        ) -> tuple[m.PushResponse, TensorStore] | None:
+        """One fused round via the group's leaf aggregator (tiers/,
+        ISSUE 9): same wire protocol, the peer is the elected same-host
+        leaf instead of the PS — this leg usually rides the shm rings.
+        Returns None when the round did not deliver (the caller replays
+        the SAME iteration on the flat path; the PS's member cover and
+        per-(worker, tensor) dedup make that replay exact): a soft miss
+        (leaf not armed yet / leaf barrier timeout) keeps the tier for
+        the next round, a transport error (leaf death) or repeated
+        misses downgrade it permanently."""
+        tensors_fn, ef_stage = self._wire_tensors(
+            grads, push_dtype=tier.push_dtype, ef=tier.push_ef)
+        local: TensorStore = {}
+
+        def convert_chunk(chunk_tensors) -> None:
+            local.update(from_wire(chunk_tensors))
+
+        t0 = time.perf_counter()
+        flight.record("fused.start", iteration=iteration,
+                      worker=self.config.worker_id)
+        push = params = None
+        try:
+            with obs_trace.span("worker/tier_fused", iteration=iteration):
+                push, params = tier.client.push_pull(
+                    self.config.worker_id, iteration, tensors_fn,
+                    pull_wire_dtype=self._pull_wire_dtype(),
+                    timeout=self.config.fused_timeout_s,
+                    on_chunk=convert_chunk)
+        except grpc.RpcError as exc:
+            tier.downgrade(f"leaf transport error: {exc.__class__.__name__}")
+            return None
+        finally:
+            flight.record("fused.end", iteration=iteration,
+                          worker=self.config.worker_id,
+                          a=int(1e6 * (time.perf_counter() - t0)),
+                          b=1 if params is not None else 0)
+        if push.success and params is not None:
+            self._obs_phase["fused"].observe(time.perf_counter() - t0)
+            tier.note_success()
+            if ef_stage is not None:
+                ef_stage.commit()
+            # deliberately NOT fed into _note_pull_tensors: the leaf
+            # proving packed support says nothing about the PS this
+            # worker would push to after a downgrade
+            return push, local
+        if not push.success and tier.is_soft_refusal(push.message):
+            tier.soft_failure((push.message or "leaf refusal")[:80])
+        elif push.success:
+            tier.soft_failure("leaf barrier timeout")
+        else:
+            tier.downgrade(f"leaf rejected push: {push.message}")
+        return None
 
     def _fused_push_pull(self, iteration: int,
                          grads) -> tuple[m.PushResponse, TensorStore | None]:
@@ -494,7 +599,17 @@ class Worker:
         plus the fresh post-aggregation parameter store, or ``None`` for
         the store when the fused round did not deliver one (reference
         server, server-side barrier timeout) — the caller then falls back
-        to the serial barrier-poll + pull."""
+        to the serial barrier-poll + pull.
+
+        With an active tier assignment the round rides the group's leaf
+        aggregator first; any miss there falls through to the flat round
+        below for the SAME iteration (``grads`` is replayable by
+        contract, and the PS-side dedup absorbs overlap)."""
+        tier = self._tier
+        if tier is not None and tier.maybe_activate():
+            result = self._tier_push_pull(tier, iteration, grads)
+            if result is not None:
+                return result
         tensors_fn, residual_box = self._wire_tensors(grads)
 
         def attempt():
@@ -534,7 +649,7 @@ class Worker:
             log.info("worker %d: fused data plane riding shared memory",
                      self.config.worker_id)
         if residual_box is not None and push.success:
-            self._ef_residual = dict(residual_box)
+            residual_box.commit()
         if params is None:
             return push, None
         self._note_pull_tensors(params.parameters)

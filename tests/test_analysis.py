@@ -369,6 +369,31 @@ def test_package_analyzes_clean():
     assert all(f.baselined_by for f in report.baselined)
 
 
+def test_tier_extension_stays_out_of_the_wire_manifest():
+    """ISSUE 9 compat gate: the hierarchical-aggregation extension
+    (tiers/messages.py) must leave the reference wire manifest
+    byte-unchanged — its messages and the GetReductionTopology method
+    must never appear in the pinned contract, and the committed golden
+    must still match the live schemas bit for bit."""
+    import json
+
+    from parameter_server_distributed_tpu.analysis import wirecheck
+    from parameter_server_distributed_tpu.tiers import messages as tmsg
+
+    with open(wirecheck.default_manifest_path()) as fh:
+        golden_bytes = fh.read()
+    golden = json.loads(golden_bytes)
+    assert wirecheck.diff_manifests(golden, wirecheck.build_manifest()) == []
+    blob = json.dumps(golden)
+    for name in ("TierGroupEntry", "TierTopologyRequest",
+                 "TierTopologyResponse", "GetReductionTopology"):
+        assert name not in blob, f"tier extension leaked: {name}"
+    # and the extension method table really is disjoint from the pinned
+    # coordinator contract
+    from parameter_server_distributed_tpu.rpc import messages as m
+    assert not set(tmsg.TIER_COORD_METHODS) & set(m.COORDINATOR_METHODS)
+
+
 def test_cli_json_output_and_exit_codes(tmp_path, capsys):
     assert analyze_main.main(["--json"]) == 0
     doc = json.loads(capsys.readouterr().out)

@@ -177,6 +177,26 @@ def test_bench_apply_contract():
 
 
 @pytest.mark.slow
+def test_bench_tier_contract():
+    """tier mode: PS ingress bytes + fused-round wall, flat vs two-tier,
+    with the ISSUE 9 acceptance visible in the JSON — at 4 workers in 2
+    groups the tier ingress ratio must be <= 0.55 of flat."""
+    result = run_bench("tier", extra_env={
+        "PSDT_BENCH_PARAMS": "2e5",
+        "PSDT_BENCH_WORKER_COUNTS": "4",
+        "PSDT_BENCH_STEPS": "2",
+    })
+    assert result["metric"] == "ps_tier_ingress_ratio_4w"
+    assert 0 < result["value"] <= 0.55, result
+    row = result["by_workers"]["4"]
+    assert row["flat"]["ingress_bytes_per_iter"] > 0
+    assert row["tier"]["ingress_bytes_per_iter"] > 0
+    assert row["flat"]["round_wall_ms"] > 0
+    assert row["tier"]["round_wall_ms"] > 0
+    assert result["group_size"] == 2
+
+
+@pytest.mark.slow
 def test_bench_serve_contract():
     """serve mode: continuous-batching sustained tokens/s with the int8
     stack applied; the metric must carry the kv8 suffix."""

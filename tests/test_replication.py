@@ -686,3 +686,90 @@ def test_replica_metrics_surface_in_rollup():
     text = render_rollup({"per_worker": {0: rolled}, "cluster": {}})
     assert "replication:" in text
     assert "2 promotions" in text and "reshard moved" in text
+
+
+# ------------------------------------------------ promoted-primary re-arm
+
+def test_promoted_primary_unarmed_gauge(tmp_path):
+    """ISSUE 9 satellite: a backup promoted to primary (it starts
+    closing barriers) with no standby configured surfaces the
+    unreplicated window as ps.replica.unarmed=1."""
+    from parameter_server_distributed_tpu.obs import stats as obs_stats
+
+    gauge = obs_stats.gauge("ps.replica.unarmed")
+    gauge.set(0)
+    backup, bport = make_ps(tmp_path, "ua-bk")
+    primary, _ = make_ps(tmp_path, "ua-pr",
+                         backup_address=f"127.0.0.1:{bport}",
+                         replication="sync")
+    try:
+        store = rand_store()
+        primary.core.initialize_parameters(store)
+        grads = {k: np.ones(32, np.float32) for k in store}
+        r = primary.core.receive_gradients(0, 1, grads)
+        assert r.aggregation_complete
+        assert backup.service.replica_sink.primary_version >= 0
+        assert gauge.value == 0  # still just a backup: not unarmed
+        # "promotion": training traffic starts landing on the ex-backup
+        r = backup.core.receive_gradients(0, 2, grads)
+        assert r.aggregation_complete
+        assert gauge.value == 1, "promoted primary did not flag unarmed"
+        rolled = __import__(
+            "parameter_server_distributed_tpu.obs.export",
+            fromlist=["worker_rollup"]).worker_rollup(
+            {"counters": {}, "gauges": {"ps.replica.unarmed": 1},
+             "histograms": {}, "t": 0.0})
+        assert rolled["ps"]["replica"]["unarmed"] is True
+    finally:
+        gauge.set(0)
+        primary.stop(0)
+        backup.stop(0)
+
+
+def test_promoted_primary_rearms_toward_standby(tmp_path):
+    """With --standby configured, the promoted primary's Replicator arms
+    itself on its FIRST barrier close as a primary — that close's state
+    ships to the standby before anything can be lost — and the unarmed
+    gauge stays down."""
+    from parameter_server_distributed_tpu.obs import stats as obs_stats
+
+    gauge = obs_stats.gauge("ps.replica.unarmed")
+    gauge.set(0)
+    standby, sport = make_ps(tmp_path, "sb-st", optimizer="momentum")
+    backup, bport = make_ps(tmp_path, "sb-bk", optimizer="momentum",
+                            standby_address=f"127.0.0.1:{sport}",
+                            replication="sync")
+    primary, _ = make_ps(tmp_path, "sb-pr", optimizer="momentum",
+                         backup_address=f"127.0.0.1:{bport}",
+                         replication="sync")
+    try:
+        assert backup.replicator is None  # dormant until promotion
+        store = rand_store()
+        primary.core.initialize_parameters(store)
+        grads = {k: np.ones(32, np.float32) for k in store}
+        assert primary.core.receive_gradients(0, 1, grads).aggregation_complete
+        assert backup.service.replica_sink.primary_version >= 0
+        # promotion: the ex-backup closes its first barrier as primary
+        r = backup.core.receive_gradients(0, 2, grads)
+        assert r.aggregation_complete
+        assert backup.replicator is not None, "standby never armed"
+        assert backup.replicator.backup_address == f"127.0.0.1:{sport}"
+        assert gauge.value == 0
+        # sync re-arm shipped THIS close: the standby is bit-identical
+        bp, sp = backup.core.get_parameters(), standby.core.get_parameters()
+        assert set(bp) == set(sp)
+        for name in bp:
+            assert np.array_equal(np.asarray(bp[name]),
+                                  np.asarray(sp[name])), name
+        # and it keeps shipping on later closes
+        assert backup.core.receive_gradients(0, 3, grads).aggregation_complete
+        sp = standby.core.get_parameters()
+        for name in bp:
+            assert np.array_equal(
+                np.asarray(backup.core.get_parameters()[name]),
+                np.asarray(sp[name])), name
+    finally:
+        gauge.set(0)
+        primary.stop(0)
+        backup.stop(0)
+        standby.stop(0)

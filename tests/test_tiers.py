@@ -1,0 +1,657 @@
+"""Hierarchical quantized aggregation units (ISSUE 9, tiers/):
+topology grouping/election, weighted barrier folds with member covers,
+the barrier relay, the per-tier error-feedback stage, the leaf
+aggregator end to end, and the lock discipline of it all."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parameter_server_distributed_tpu.core.coordinator_core import (
+    CoordinatorCore)
+from parameter_server_distributed_tpu.core.optimizer import SGD
+from parameter_server_distributed_tpu.core.ps_core import ParameterServerCore
+from parameter_server_distributed_tpu.core.tensor import to_wire
+from parameter_server_distributed_tpu.rpc import messages as m
+from parameter_server_distributed_tpu.tiers import messages as tmsg
+from parameter_server_distributed_tpu.tiers import topology
+from parameter_server_distributed_tpu.tiers.ef import ErrorFeedback
+from parameter_server_distributed_tpu.tiers.topology import (
+    contribution_map, form_groups)
+
+
+def _entry(host, leader, members, leaf="addr:1"):
+    return tmsg.TierGroupEntry(host_id=host, leader_worker_id=leader,
+                               aggregate_id=tmsg.aggregate_id_for(leader),
+                               leaf_address=leaf, member_ids=members)
+
+
+# ----------------------------------------------------------------- grouping
+
+def test_form_groups_by_host_with_threshold():
+    workers = {0: ("hostA", "a:1"), 1: ("hostA", "a:2"),
+               2: ("hostB", "b:1"),  # alone on hostB: stays flat
+               3: ("hostA", "a:3")}
+    groups, changed = form_groups(workers, [], set(), min_group=2)
+    assert changed
+    assert len(groups) == 1
+    g = groups[0]
+    assert g.host_id == "hostA"
+    assert list(g.member_ids) == [0, 1, 3]
+    assert g.leader_worker_id == 0  # lowest id with a leaf address leads
+    assert g.leaf_address == "a:1"
+    assert g.aggregate_id == tmsg.TIER_AGGREGATE_ID_BASE + 0
+    # deterministic: same registry, same groups, no spurious change
+    again, changed2 = form_groups(workers, groups, set(), min_group=2)
+    assert not changed2 and [list(x.member_ids) for x in again] == [[0, 1, 3]]
+
+
+def test_form_groups_freezes_membership():
+    """A later same-host joiner does NOT resize a formed group (the live
+    leaf barrier is armed at the original size)."""
+    workers = {0: ("hostA", "a:1"), 1: ("hostA", "a:2")}
+    groups, _ = form_groups(workers, [], set(), min_group=2)
+    workers[5] = ("hostA", "a:5")
+    after, changed = form_groups(workers, groups, set(), min_group=2)
+    assert not changed
+    assert [list(g.member_ids) for g in after] == [[0, 1]]
+
+
+def test_form_groups_dissolved_leaf_never_reforms():
+    workers = {0: ("hostA", "a:1"), 1: ("hostA", "a:2")}
+    groups, _ = form_groups(workers, [], set(), min_group=2)
+    dissolved = {groups[0].leaf_address}
+    after, changed = form_groups(workers, groups, dissolved, min_group=2)
+    assert changed
+    # worker 1 still has a live leaf address, so a NEW group may form
+    # under it at this layer; the coordinator's flat-latch (tested below)
+    # is what prevents that for real members of a dissolved group
+    assert all(g.leaf_address not in dissolved for g in after)
+
+
+def test_contribution_map_weights_and_covers():
+    groups = [_entry("hostA", 0, [0, 1, 3]), _entry("hostB", 4, [4, 5])]
+    cmap = contribution_map(groups)
+    assert cmap[tmsg.aggregate_id_for(0)] == (3, (0, 1, 3))
+    assert cmap[tmsg.aggregate_id_for(4)] == (2, (4, 5))
+
+
+def test_aggregate_id_base_matches_postmortem_mirror():
+    """obs/postmortem.py mirrors the constant (it must not import
+    tiers/); the two must never drift."""
+    from parameter_server_distributed_tpu.obs import postmortem
+    assert postmortem._TIER_ID_BASE == tmsg.TIER_AGGREGATE_ID_BASE
+
+
+# --------------------------------------------------------- coordinator core
+
+def test_coordinator_tier_register_and_confirmation(monkeypatch):
+    monkeypatch.setenv("PSDT_TIERS", "1")
+    core = CoordinatorCore("10.0.0.1", 50051)
+    # worker 1 registers first; no group yet (alone)
+    epoch0, groups, enabled, min_group, _ = core.tier_register(
+        1, "hostA", "l1:1")
+    assert enabled and min_group == 2 and not groups
+    # worker 0 registers: group forms, led by 0 — and 0 (the leader) sees
+    # it immediately...
+    _, groups, _, _, _ = core.tier_register(0, "hostA", "l0:1")
+    assert [list(g.member_ids) for g in groups] == [[0, 1]]
+    assert groups[0].leader_worker_id == 0
+    # ...which also CONFIRMS it, so members and the PS weight provider
+    # (pure read) now see it too
+    _, groups, _, _, _ = core.tier_register(-1, "")
+    assert [g.leaf_address for g in groups] == ["l0:1"]
+
+
+def test_coordinator_member_blind_until_leader_confirms(monkeypatch):
+    monkeypatch.setenv("PSDT_TIERS", "1")
+    core = CoordinatorCore("10.0.0.1", 50051)
+    core.tier_register(0, "hostA", "l0:1")
+    _, groups, _, _, _ = core.tier_register(1, "hostA", "l1:1")
+    # the group formed on this call, but worker 1 (a member) must not see
+    # it until the LEADER has been served it (the leader arms its leaf
+    # synchronously before using the response)
+    assert not groups
+    _, groups, _, _, _ = core.tier_register(0, "hostA", "l0:1")
+    assert groups  # leader sees (and confirms) it
+    _, groups, _, _, _ = core.tier_register(1, "hostA", "l1:1")
+    assert [list(g.member_ids) for g in groups] == [[0, 1]]
+
+
+def test_coordinator_dead_leaf_dissolves_and_latches_flat(monkeypatch):
+    monkeypatch.setenv("PSDT_TIERS", "1")
+    core = CoordinatorCore("10.0.0.1", 50051)
+    core.tier_register(0, "hostA", "l0:1")
+    core.tier_register(1, "hostA", "l1:1")
+    epoch1, groups, _, _, _ = core.tier_register(0, "hostA", "l0:1")
+    assert groups
+    epoch2, groups, _, _, _ = core.tier_register(1, "hostA",
+                                              dead_leaf="l0:1")
+    assert epoch2 > epoch1
+    assert not groups
+    # the ex-members are latched flat: re-registering never re-groups
+    # them (their worker side downgraded permanently too)
+    _, groups, _, _, _ = core.tier_register(0, "hostA", "l0:9")
+    assert not groups
+    _, groups, _, _, _ = core.tier_register(1, "hostA", "l1:9")
+    assert not groups
+
+
+def test_coordinator_eviction_drops_group(monkeypatch):
+    monkeypatch.setenv("PSDT_TIERS", "1")
+    now = [0.0]
+    core = CoordinatorCore("10.0.0.1", 50051, time_fn=lambda: now[0])
+    core.register_worker(0, "10.0.0.2", 1, "hostA")
+    core.register_worker(1, "10.0.0.3", 1, "hostA")
+    core.tier_register(0, "hostA", "l0:1")
+    core.tier_register(1, "hostA", "l1:1")
+    _, groups, _, _, _ = core.tier_register(0, "hostA", "l0:1")
+    assert groups
+    now[0] = 100.0
+    assert set(core.remove_stale_workers(30.0)) == {0, 1}
+    _, groups, _, _, _ = core.tier_register(-1, "")
+    assert not groups
+
+
+def test_coordinator_tiers_disabled_returns_nothing(monkeypatch):
+    monkeypatch.delenv("PSDT_TIERS", raising=False)
+    core = CoordinatorCore("10.0.0.1", 50051)
+    _, groups, enabled, _, _ = core.tier_register(0, "hostA", "l0:1")
+    assert not enabled and not groups
+
+
+def test_coordinator_tells_latched_flat_workers(monkeypatch):
+    """A worker whose group dissolved is TOLD it is latched flat, so a
+    rebuilt TierClient stops polling (and releases its idle leaf)
+    instead of re-registering at 2 Hz forever."""
+    monkeypatch.setenv("PSDT_TIERS", "1")
+    core = CoordinatorCore("10.0.0.1", 50051)
+    core.tier_register(0, "hostA", "l0:1")
+    core.tier_register(1, "hostA", "l1:1")
+    *_, latched = core.tier_register(0, "hostA", "l0:1")
+    assert not latched
+    core.tier_register(1, "hostA", dead_leaf="l0:1")
+    *_, latched = core.tier_register(0, "hostA", "l0:1")
+    assert latched
+    *_, latched = core.tier_register(1, "hostA", "l1:1")
+    assert latched
+
+
+# ------------------------------------------------- weighted folds + covers
+
+def _agg(leader=0):
+    return tmsg.aggregate_id_for(leader)
+
+
+def _weighted_core(total=3, members=(0, 1), lr=1.0, **kw):
+    cmap = {_agg(members[0]): (len(members), tuple(members))}
+    core = ParameterServerCore(total_workers=total, optimizer=SGD(lr),
+                               contributions_fn=lambda: cmap, **kw)
+    core.initialize_parameters({"w": np.zeros(8, np.float32)})
+    return core
+
+
+@pytest.mark.parametrize("stripes", [1, 2])
+def test_group_push_weights_the_mean_over_workers(stripes):
+    core = _weighted_core(stripes=stripes)
+    g01 = np.full(8, 6.0, np.float32)  # sum of workers 0 and 1
+    g2 = np.full(8, 3.0, np.float32)
+    r = core.receive_gradients(_agg(), 1, {"w": g01})
+    assert not r.aggregation_complete and r.workers_received == 2
+    r = core.receive_gradients(2, 1, {"w": g2})
+    assert r.aggregation_complete and r.workers_received == 3
+    np.testing.assert_allclose(core.get_parameters()["w"],
+                               -(g01 + g2) / 3.0, rtol=1e-6)
+
+
+def test_member_flat_repush_dedups_against_cover():
+    """The downgrade recovery invariant: after a group contribution
+    landed, a member's flat re-push of the SAME iteration is a duplicate
+    — never a double count."""
+    core = _weighted_core(total=3)
+    core.receive_gradients(_agg(), 1, {"w": np.full(8, 6.0, np.float32)})
+    r = core.receive_gradients(0, 1, {"w": np.full(8, 100.0, np.float32)})
+    assert r.success and "duplicate" in r.message
+    r = core.receive_gradients(1, 1, {"w": np.full(8, 100.0, np.float32)})
+    assert r.success and "duplicate" in r.message
+    # the real third worker still closes the barrier with the true mean
+    r = core.receive_gradients(2, 1, {"w": np.full(8, 3.0, np.float32)})
+    assert r.aggregation_complete
+    np.testing.assert_allclose(core.get_parameters()["w"],
+                               np.full(8, -3.0, np.float32), rtol=1e-6)
+
+
+def test_group_overlapping_individual_contribution_rejected():
+    """THE downgrade-race exactness guard: a member soft-fails at its
+    leaf and re-pushes flat; the leaf later seals anyway and relays the
+    group sum (which contains that member's gradient).  The PS must
+    reject the overlapping group contribution whole — the other member
+    replays flat and the mean stays exact — never fold it into a double
+    count."""
+    core = _weighted_core(total=2, members=(0, 1))
+    g0 = np.full(8, 2.0, np.float32)
+    g1 = np.full(8, 4.0, np.float32)
+    r = core.receive_gradients(0, 1, {"w": g0})  # member 0 went flat
+    assert r.success and not r.aggregation_complete
+    # the leaf's group sum (g0 + g1) overlaps member 0's contribution
+    r = core.receive_gradients(_agg(), 1, {"w": g0 + g1})
+    assert not r.success and "overlaps" in r.message
+    # member 1 replays flat: the barrier closes with the exact mean
+    r = core.receive_gradients(1, 1, {"w": g1})
+    assert r.aggregation_complete
+    np.testing.assert_allclose(core.get_parameters()["w"],
+                               -(g0 + g1) / 2.0, rtol=1e-6)
+
+
+def test_group_after_commit_member_repush_is_exact():
+    """The opposite interleaving: the group lands first, the member's
+    flat replay dedups, and the mean is the same exact value."""
+    core = _weighted_core(total=2, members=(0, 1))
+    g0 = np.full(8, 2.0, np.float32)
+    g1 = np.full(8, 4.0, np.float32)
+    r = core.receive_gradients(_agg(), 1, {"w": g0 + g1})
+    assert r.aggregation_complete  # the group IS the whole barrier here
+    r = core.receive_gradients(0, 1, {"w": g0})
+    assert r.success  # late: already aggregated
+    np.testing.assert_allclose(core.get_parameters()["w"],
+                               -(g0 + g1) / 2.0, rtol=1e-6)
+
+
+def test_group_relay_retry_is_idempotent():
+    """A leaf's re-push of an already-landed group contribution (e.g.
+    after its params leg failed) folds nothing twice and commits as a
+    duplicate."""
+    core = _weighted_core(total=3)
+    grads = {"w": np.full(8, 6.0, np.float32)}
+    core.receive_gradients(_agg(), 1, grads)
+    r = core.receive_gradients(_agg(), 1, grads)  # identical replay
+    assert r.success and "duplicate" in r.message
+    core.receive_gradients(2, 1, {"w": np.full(8, 3.0, np.float32)})
+    np.testing.assert_allclose(core.get_parameters()["w"],
+                               np.full(8, -3.0, np.float32), rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["buffered", "async"])
+def test_aggregate_push_rejected_outside_streaming_sync(mode):
+    """Config-skew guard: group contributions exist only on the
+    streaming sync path — the buffered escape hatch would count them as
+    one phantom worker (members double-count on their flat replay) and
+    async mode would apply the raw group SUM at group-size magnitude.
+    Both must bounce retryably."""
+    kw = (dict(aggregation="buffered") if mode == "buffered"
+          else dict(staleness_bound=2))
+    core = ParameterServerCore(total_workers=2, optimizer=SGD(1.0),
+                               contributions_fn=lambda: {
+                                   _agg(): (2, (0, 1))}, **kw)
+    core.initialize_parameters({"w": np.zeros(4, np.float32)})
+    before = core.get_parameters()["w"].copy()
+    r = core.receive_gradients(_agg(), 1, {"w": np.ones(4, np.float32)})
+    assert not r.success and "streaming" in r.message
+    np.testing.assert_array_equal(core.get_parameters()["w"], before)
+    # real workers are untouched by the guard
+    r = core.receive_gradients(0, 1, {"w": np.ones(4, np.float32)})
+    assert r.success
+
+
+def test_unknown_aggregate_id_bounces_instead_of_phantom_fold():
+    """The TTL-race guard: a group push whose aggregate id the PS cannot
+    attribute (map predates the group, or no provider at all) is
+    rejected RETRYABLY — folding it as a phantom weight-1 worker would
+    double-count its members the moment they replay flat.  The lookup
+    force-refreshes the cache once, so a just-confirmed group is
+    accepted on the very push that races the TTL."""
+    # no provider at all: aggregate ids always bounce, workers unaffected
+    core = ParameterServerCore(total_workers=2, optimizer=SGD(1.0))
+    core.initialize_parameters({"w": np.zeros(4, np.float32)})
+    r = core.receive_gradients(_agg(), 1, {"w": np.ones(4, np.float32)})
+    assert not r.success and "unknown tier aggregate" in r.message
+
+    # provider whose FIRST map predates the group: the unknown-aggregate
+    # lookup forces a refresh inside the TTL and the push lands
+    maps = [{}, {_agg(): (2, (0, 1))}]
+    calls = []
+
+    def provider():
+        calls.append(1)
+        return maps[0] if len(calls) == 1 else maps[1]
+
+    core = ParameterServerCore(total_workers=2, optimizer=SGD(1.0),
+                               contributions_fn=provider,
+                               contributions_ttl_s=60.0)
+    core.initialize_parameters({"w": np.zeros(4, np.float32)})
+    core.begin_push(0, 1)  # caches the empty pre-group map (call 1)
+    r = core.receive_gradients(_agg(), 1, {"w": np.full(4, 6.0, np.float32)})
+    assert r.success and r.aggregation_complete, r.message
+    assert len(calls) == 2  # the forced refresh, not a TTL expiry
+    np.testing.assert_allclose(core.get_parameters()["w"],
+                               np.full(4, -3.0, np.float32), rtol=1e-6)
+
+
+def test_contributions_ttl_cache_and_flap_protection():
+    calls = []
+
+    def provider():
+        calls.append(1)
+        return None if len(calls) > 1 else {_agg(): (2, (0, 1))}
+
+    core = ParameterServerCore(total_workers=2,
+                               contributions_fn=provider,
+                               contributions_ttl_s=0.05)
+    sink = core.begin_push(_agg(), 1)
+    assert (sink.weight, sink.members) == (2, (0, 1))
+    assert len(calls) == 1
+    # within the TTL: cached, no second provider call
+    core.begin_push(_agg(), 2)
+    assert len(calls) == 1
+    time.sleep(0.06)
+    # expired AND the provider hiccups (None): the stale map keeps
+    # serving instead of flapping weights mid-iteration
+    sink = core.begin_push(_agg(), 3)
+    assert (sink.weight, sink.members) == (2, (0, 1))
+    assert len(calls) == 2
+
+
+# ------------------------------------------------------------ barrier relay
+
+def test_barrier_relay_installs_returned_store():
+    core = ParameterServerCore(total_workers=2)
+    core.initialize_parameters({"w": np.zeros(4, np.float32)})
+    seen = {}
+
+    def relay(iteration, sums, counts):
+        seen["iteration"] = iteration
+        seen["sums"] = {k: v.copy() for k, v in sums.items()}
+        seen["counts"] = dict(counts)
+        return {"w": np.full(4, 42.0, np.float32)}
+
+    core.set_barrier_relay(relay)
+    core.receive_gradients(0, 1, {"w": np.ones(4, np.float32)})
+    r = core.receive_gradients(1, 1, {"w": np.ones(4, np.float32)})
+    assert r.aggregation_complete
+    assert seen["iteration"] == 1
+    np.testing.assert_array_equal(seen["sums"]["w"],
+                                  np.full(4, 2.0, np.float32))
+    assert seen["counts"] == {"w": 2}  # RAW sums + counts, never scaled
+    np.testing.assert_array_equal(core.get_parameters()["w"],
+                                  np.full(4, 42.0, np.float32))
+
+
+def test_barrier_relay_failure_leaves_barrier_retryable():
+    core = ParameterServerCore(total_workers=2)
+    core.initialize_parameters({"w": np.zeros(4, np.float32)})
+    attempts = []
+
+    def relay(iteration, sums, counts):
+        attempts.append(dict(counts))
+        if len(attempts) == 1:
+            raise RuntimeError("upstream blip")
+        return {"w": np.full(4, 7.0, np.float32)}
+
+    core.set_barrier_relay(relay)
+    core.receive_gradients(0, 1, {"w": np.ones(4, np.float32)})
+    with pytest.raises(RuntimeError, match="upstream blip"):
+        core.receive_gradients(1, 1, {"w": np.ones(4, np.float32)})
+    # the accumulator was put back intact (counts NOT reset — no scale
+    # ran); the next poll retries the close and the relay sees the same
+    # sums again
+    ready, received, total = core.wait_for_aggregation(1, timeout=5.0)
+    assert ready and received == 2
+    assert attempts == [{"w": 2}, {"w": 2}]
+    np.testing.assert_array_equal(core.get_parameters()["w"],
+                                  np.full(4, 7.0, np.float32))
+
+
+# ------------------------------------------------------------ error feedback
+
+def test_error_feedback_stage_two_phase_commit():
+    ef = ErrorFeedback(enabled=True)
+    g = np.linspace(-1, 1, 64, dtype=np.float32)
+    tensors = ef.compress({"w": g}, m.WIRE_INT8)
+    decoded = tensors[0].to_array()
+    pending = ef.pending()
+    np.testing.assert_allclose(pending["w"], g - decoded, atol=1e-7)
+    assert ef.residual == {}  # not committed yet
+    ef.commit()
+    np.testing.assert_array_equal(ef.residual["w"], pending["w"])
+    # next push compresses grad + residual
+    t2 = ef.compress({"w": g}, m.WIRE_INT8)
+    np.testing.assert_allclose(ef.pending()["w"],
+                               (g + pending["w"]) - t2[0].to_array(),
+                               atol=1e-7)
+
+
+def test_error_feedback_stage_disabled_is_plain_to_wire():
+    ef = ErrorFeedback(enabled=False)
+    g = np.linspace(-1, 1, 32, dtype=np.float32)
+    tensors = ef.compress({"w": g}, m.WIRE_INT8)
+    ref = to_wire({"w": g}, m.WIRE_INT8)
+    assert tensors[0].encode() == ref[0].encode()
+    ef.commit()
+    assert ef.residual == {}
+
+
+def test_error_feedback_stages_are_independent():
+    """Per-tier isolation: two compression points never share a carry."""
+    a, b = ErrorFeedback(enabled=True), ErrorFeedback(enabled=True)
+    g = np.linspace(-2, 2, 16, dtype=np.float32)
+    a.compress({"w": g}, m.WIRE_INT8)
+    a.commit()
+    assert a.residual and not b.residual
+
+
+def test_worker_ef_residual_property_back_compat():
+    """`worker._ef_residual` stayed an assignable dict view over the new
+    stage (PR-5 tests and callers poke it directly)."""
+    from parameter_server_distributed_tpu.config import WorkerConfig
+    from parameter_server_distributed_tpu.worker.worker import Worker
+
+    worker = Worker(WorkerConfig(wire_dtype="int8"), trainer=None,
+                    batches=iter(()), start_heartbeat=False)
+    worker._ef_residual = {"w": np.ones(4, np.float32)}
+    np.testing.assert_array_equal(worker._push_ef.residual["w"],
+                                  np.ones(4, np.float32))
+    tensors, residual = worker._compress_with_feedback(
+        {"w": np.zeros(4, np.float32)}, m.WIRE_INT8)
+    # the carry was applied: compress saw 0 + residual = 1
+    np.testing.assert_allclose(tensors[0].to_array(),
+                               np.ones(4, np.float32), atol=0.02)
+    worker.shutdown()
+
+
+# ---------------------------------------------------------- leaf aggregator
+
+def _leaf_setup(tmp_path, group=2, wire=m.WIRE_RAW_F32, lr=0.5):
+    from parameter_server_distributed_tpu.config import (
+        ParameterServerConfig)
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+    from parameter_server_distributed_tpu.tiers.leaf import LeafAggregator
+
+    ps = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=group,
+        learning_rate=lr, checkpoint_dir=str(tmp_path / "ck"),
+        autosave_period_s=600.0))
+    port = ps.start()
+    agg = _agg(0)
+    ps.core.set_contributions_fn(
+        lambda: {agg: (group, tuple(range(group)))})
+    init = {"w": np.zeros(8, np.float32)}
+    ps.core.initialize_parameters(init)
+    leaf = LeafAggregator(0, f"127.0.0.1:{port}", wire_dtype=wire)
+    return ps, leaf, init
+
+
+def test_leaf_refuses_until_armed(tmp_path):
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+    from parameter_server_distributed_tpu.tiers.leaf import LEAF_NOT_ARMED
+
+    ps, leaf, init = _leaf_setup(tmp_path)
+    client = PSClient(leaf.address)
+    try:
+        tensors = to_wire({"w": np.ones(8, np.float32)})
+        push, params = client.push_pull(1, 1, lambda: iter(tensors),
+                                        timeout=10.0)
+        assert not push.success and LEAF_NOT_ARMED in push.message
+        assert params is None
+    finally:
+        client.close()
+        leaf.stop()
+        ps.stop(0)
+
+
+def test_leaf_group_round_end_to_end(tmp_path):
+    """Two members push f32 to the leaf; ONE upstream contribution
+    closes the PS barrier with the exact worker mean; fresh params fan
+    back to both members."""
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+
+    ps, leaf, init = _leaf_setup(tmp_path)
+    leaf.arm(2, _agg(0), init)
+    clients = [PSClient(leaf.address) for _ in range(2)]
+    grads = [np.full(8, 1.0, np.float32), np.full(8, 3.0, np.float32)]
+    stores: list = [None, None]
+
+    def member(wid):
+        local = {}
+        tensors = to_wire({"w": grads[wid]})
+        push, params = clients[wid].push_pull(
+            wid, 1, lambda: iter(tensors),
+            pull_wire_dtype=m.WIRE_RAW_F32, timeout=30.0,
+            on_chunk=lambda ts: local.update(
+                {t.name: t.to_array() for t in ts}))
+        assert push.success, push.message
+        assert params is not None
+        stores[wid] = local
+
+    try:
+        threads = [threading.Thread(target=member, args=(i,),
+                                    name=f"tier-member-{i}")
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "member wedged"
+        expected = -0.5 * (grads[0] + grads[1]) / 2.0
+        np.testing.assert_allclose(ps.core.get_parameters()["w"], expected,
+                                   rtol=1e-6)
+        for store in stores:
+            np.testing.assert_allclose(store["w"], expected, rtol=1e-6)
+    finally:
+        for c in clients:
+            c.close()
+        leaf.stop()
+        ps.stop(0)
+
+
+def test_leaf_quantized_upstream_carries_ef(tmp_path):
+    """int8 upstream: the leaf's own EF stage carries the quantization
+    error, so two rounds of identical gradients land closer to the exact
+    trajectory than a single round's quantization error would suggest."""
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+
+    ps, leaf, init = _leaf_setup(tmp_path, wire=m.WIRE_INT8, lr=1.0)
+    leaf.arm(2, _agg(0), init)
+    clients = [PSClient(leaf.address) for _ in range(2)]
+    rng = np.random.default_rng(3)
+    grads = rng.standard_normal(8).astype(np.float32)
+    try:
+        for it in range(1, 4):
+            threads = [threading.Thread(
+                target=lambda wid=wid: clients[wid].push_pull(
+                    wid, it, lambda: iter(to_wire({"w": grads})),
+                    pull_wire_dtype=m.WIRE_BF16, timeout=30.0),
+                name=f"tm{it}-{wid}") for wid in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        # exact: w = -3 * grads; int8+EF must be close (bias cancels)
+        exact = -3.0 * grads
+        got = ps.core.get_parameters()["w"]
+        assert float(np.linalg.norm(got - exact)) < 0.15 * float(
+            np.linalg.norm(exact))
+        assert leaf._ef.residual  # the carry is live
+    finally:
+        for c in clients:
+            c.close()
+        leaf.stop()
+        ps.stop(0)
+
+
+# ------------------------------------------------------------ lock discipline
+
+@pytest.mark.lockcheck
+def test_leaf_fold_seal_downgrade_hammer():
+    """Multi-worker leaf hammer under PSDT_LOCK_CHECK=1: concurrent
+    member folds/commits across iterations, a relay that fails once per
+    iteration (seal retry path), and a mid-run relay swap (the downgrade
+    teardown shape) — no lock-order violation, exactly-once aggregation
+    per iteration."""
+    relay_calls: dict[int, int] = {}
+    relay_lock = threading.Lock()
+
+    def relay(iteration, sums, counts):
+        with relay_lock:
+            n = relay_calls[iteration] = relay_calls.get(iteration, 0) + 1
+        if n == 1:
+            raise RuntimeError("injected upstream failure")
+        return {name: np.zeros_like(v) for name, v in sums.items()}
+
+    core = ParameterServerCore(total_workers=4, stripes=2)
+    core.initialize_parameters(
+        {f"w{i}": np.zeros(64, np.float32) for i in range(8)})
+    core.set_barrier_relay(relay)
+    errors: list[BaseException] = []
+
+    def worker(wid: int):
+        rng = np.random.default_rng(wid)
+        try:
+            for it in range(1, 6):
+                grads = {f"w{i}": rng.standard_normal(64).astype(np.float32)
+                         for i in range(8)}
+                try:
+                    core.receive_gradients(wid, it, grads)
+                except RuntimeError:
+                    pass  # the injected relay failure: retried below
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    ready, _, _ = core.wait_for_aggregation(it, timeout=1.0)
+                    if ready:
+                        break
+                else:
+                    raise AssertionError(f"iteration {it} never closed")
+        except BaseException as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(wid,),
+                                name=f"hammer-{wid}") for wid in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "hammer worker wedged"
+    assert not errors, errors
+    # every iteration aggregated exactly once, each after >= 1 retry
+    assert set(relay_calls) == {1, 2, 3, 4, 5}
+    assert all(n >= 2 for n in relay_calls.values())
+    core.set_barrier_relay(None)  # the downgrade teardown shape
+
+
+def test_topology_env_knobs(monkeypatch):
+    monkeypatch.setenv("PSDT_TIERS", "1")
+    assert topology.tiers_enabled()
+    assert topology.tiers_enabled(None)
+    assert not topology.tiers_enabled(False)  # config override wins
+    monkeypatch.setenv("PSDT_TIERS", "0")
+    assert not topology.tiers_enabled()
+    assert topology.tiers_enabled(True)
+    monkeypatch.setenv("PSDT_TIER_MIN_GROUP", "1")
+    assert topology.min_group_size() == 2  # floor: a 1-group adds a hop
+    monkeypatch.setenv("PSDT_TIER_DTYPE", "topk")
+    assert topology.tier_wire_dtype() == m.WIRE_TOPK
+    monkeypatch.setenv("PSDT_TIER_DTYPE", "nope")
+    with pytest.raises(ValueError):
+        topology.tier_wire_dtype()

@@ -25,8 +25,12 @@ final stdout is always exactly one JSON line; failures carry the
 exception text in a "note" field.
 
 Env knobs: PSDT_BENCH_STEPS (default 10), PSDT_BENCH_MODE
-(mfu | samples | pushpull | dataplane | aggregate | apply | codec | async |
-generate | serve | attention;
+(mfu | samples | pushpull | dataplane | aggregate | apply | codec | delta |
+async | generate | serve | attention;
+delta = versioned delta serving (ISSUE 10): serve bytes/iter full vs
+delta-chain at varying version locality (PSDT_BENCH_DELTA_LOCALITY,
+default "1,2,4") for SGD and momentum runs, plus live weight-publication
+latency (apply -> subscriber holds the fresh version);
 codec = native-vs-Python wire-codec GB/s + same-host shm-vs-TCP fused
 step time (PSDT_NATIVE / PSDT_SHM A/B, ISSUE 6);
 default mfu; serve = continuous-batching sustained tokens/s, with
@@ -912,6 +916,168 @@ def bench_aggregate() -> dict:
                      f"{buffered[n_max]['peak_grad_buffer_x_model']}x model; "
                      f"{streaming[n_max]['serve_encodes']} encodes for "
                      f"{streaming[n_max]['serves']} serves")}
+
+
+def bench_delta() -> dict:
+    """Versioned delta serving (delta/, ISSUE 10): per-pull serve bytes
+    through the delta chain vs the full encode-once serve, at varying
+    version locality (the receiver pulls every L versions, so one pull
+    crosses an L-pair chain), for SGD and SGD+momentum runs — the
+    regime where per-step weight movement is below the bf16 wire ulp
+    for most elements, i.e. any converging run.  Plus the live
+    weight-publication loop: wall from the optimizer apply returning to
+    a WeightFollower subscriber HOLDING the fresh version (the
+    decode-fleet swap point).  Shape knobs: PSDT_BENCH_PARAMS (default
+    2M), PSDT_BENCH_STEPS (applies per locality row, default 8),
+    PSDT_BENCH_DELTA_LOCALITY (default "1,2,4"),
+    PSDT_BENCH_GRAD_SCALE (gradient stddev, default 0.1 — a
+    fine-tuning-sized step against unit-scale weights)."""
+    import tempfile
+
+    import numpy as np
+
+    from parameter_server_distributed_tpu.checkpoint.manager import (
+        CheckpointManager)
+    from parameter_server_distributed_tpu.config import (
+        ParameterServerConfig)
+    from parameter_server_distributed_tpu.core.optimizer import (SGD,
+                                                                 Momentum)
+    from parameter_server_distributed_tpu.core.ps_core import (
+        ParameterServerCore)
+    from parameter_server_distributed_tpu.core.tensor import store_nbytes
+    from parameter_server_distributed_tpu.delta import messages as dmsg
+    from parameter_server_distributed_tpu.delta.client import (
+        DeltaPullState, apply_frames)
+    from parameter_server_distributed_tpu.delta.subscriber import (
+        WeightFollower)
+    from parameter_server_distributed_tpu.rpc import messages as m
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer, ParameterServerService)
+
+    n_params = int(float(os.environ.get("PSDT_BENCH_PARAMS", "2e6")))
+    iters = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 8
+    localities = [int(x) for x in os.environ.get(
+        "PSDT_BENCH_DELTA_LOCALITY", "1,2,4").split(",")]
+    grad_scale = float(os.environ.get("PSDT_BENCH_GRAD_SCALE", "0.1"))
+    depth = max(localities)
+    os.environ["PSDT_DELTA_DEPTH"] = str(max(
+        depth, int(os.environ.get("PSDT_DELTA_DEPTH", "0") or 0)))
+
+    rng = np.random.default_rng(0)
+    n_tensors = 4
+    shape = (max(1, n_params // n_tensors),)
+    params = {f"w{i}": rng.standard_normal(shape).astype(np.float32)
+              for i in range(n_tensors)}
+    model_bytes = store_nbytes(params)
+
+    def pull(service, state, it):
+        req = dmsg.DeltaPullRequest(
+            worker_id=0, iteration=it, wire_dtype=m.WIRE_BF16,
+            held_version=max(state.version, 0))
+        frames = list(service.PullParametersDelta(req, None))
+        nbytes = sum(f.encoded_size() if hasattr(f, "encoded_size")
+                     else len(f.encode()) for f in frames)
+        decoded = [dmsg.DeltaFrame.decode(f.encode()) for f in frames]
+        return apply_frames(iter(decoded), state), nbytes
+
+    def profile(opt_name, make_opt) -> dict:
+        rows = {}
+        for locality in localities:
+            core = ParameterServerCore(total_workers=1,
+                                       optimizer=make_opt())
+            core.initialize_parameters(params)
+            service = ParameterServerService(core, CheckpointManager(
+                core, directory=tempfile.mkdtemp(prefix="psdt-delta-"),
+                checkpoint_interval=10**9, check_period_s=3600.0))
+            state = DeltaPullState()
+            _, full_bytes = pull(service, state, 0)  # the base full serve
+            g = np.random.default_rng(1)
+            # warm-up round: the first pull above ARMED the lazy chain;
+            # the first post-arm apply only seeds its retained image, so
+            # one unmeasured apply+pull gets the steady state every
+            # measured round rides
+            core.receive_gradients(0, 1, {
+                name: (g.standard_normal(shape) * grad_scale)
+                .astype(np.float32) for name in params})
+            pull(service, state, 1)
+            delta_bytes, delta_pulls, full_fallbacks = 0, 0, 0
+            it = 1
+            for _ in range(iters):
+                it += 1
+                core.receive_gradients(0, it, {
+                    name: (g.standard_normal(shape) * grad_scale)
+                    .astype(np.float32) for name in params})
+                if it % locality:
+                    continue
+                result, nbytes = pull(service, state, it)
+                if result.served_delta:
+                    delta_bytes += nbytes
+                    delta_pulls += 1
+                else:
+                    full_fallbacks += 1
+            pulls = max(1, delta_pulls + full_fallbacks)
+            per_pull = delta_bytes / max(1, delta_pulls)
+            rows[locality] = {
+                "full_serve_bytes": full_bytes,
+                "delta_bytes_per_pull": round(per_pull),
+                "delta_vs_full_ratio": round(per_pull / full_bytes, 4),
+                "delta_pulls": delta_pulls,
+                "full_fallbacks": full_fallbacks,
+                "pulls": pulls,
+            }
+            log(f"bench_delta: {opt_name} locality={locality} "
+                f"delta/pull={per_pull / 1e3:.1f}KB vs "
+                f"full={full_bytes / 1e3:.1f}KB "
+                f"(ratio {rows[locality]['delta_vs_full_ratio']})")
+        return rows
+
+    log(f"bench_delta: store {n_params / 1e6:.1f}M params "
+        f"({model_bytes / 1e6:.0f} MB f32), {iters} applies per row, "
+        f"localities {localities}, grad scale {grad_scale}")
+    sgd = profile("sgd", lambda: SGD(1e-3))
+    momentum = profile("momentum", lambda: Momentum(1e-3, momentum=0.9))
+
+    # live weight publication: apply -> the follower HOLDS the version
+    tmp = tempfile.mkdtemp(prefix="psdt-delta-pub-")
+    server = ParameterServer(ParameterServerConfig(
+        bind_address="127.0.0.1", port=0, total_workers=1,
+        checkpoint_interval=10**9, checkpoint_dir=tmp,
+        learning_rate=1e-3, autosave_period_s=600.0))
+    port = server.start()
+    server.core.initialize_parameters(params)
+    follower = WeightFollower(f"127.0.0.1:{port}", subscriber_id=1).start()
+    publish_ms = []
+    try:
+        follower.wait_for_update(30.0)  # the establishing full serve
+        g = np.random.default_rng(2)
+        for it in range(1, 6):
+            t0 = time.perf_counter()
+            server.core.receive_gradients(0, it, {
+                name: (g.standard_normal(shape) * grad_scale)
+                .astype(np.float32) for name in params})
+            fresh = follower.wait_for_update(30.0)
+            if fresh is not None:
+                publish_ms.append(1e3 * (time.perf_counter() - t0))
+    finally:
+        follower.stop()
+        server.stop()
+    publish_ms.sort()
+    publish_p50 = (round(publish_ms[len(publish_ms) // 2], 3)
+                   if publish_ms else 0.0)
+
+    tightest = localities[0]
+    ratio = sgd[tightest]["delta_vs_full_ratio"]
+    return {"metric": f"ps_delta_serve_ratio_l{tightest}",
+            "value": ratio, "unit": "x_full_bytes",
+            "vs_baseline": round(1.0 / ratio, 1) if ratio else 0.0,
+            "model_bytes": model_bytes,
+            "sgd": sgd, "momentum": momentum,
+            "publish_p50_ms": publish_p50,
+            "publish_samples": len(publish_ms),
+            "note": (f"delta serve ships {100 * ratio:.1f}% of full-pull "
+                     f"bytes at locality {tightest} (sgd); subscriber "
+                     f"holds a fresh version {publish_p50}ms after the "
+                     f"apply")}
 
 
 def bench_apply() -> dict:
@@ -2117,6 +2283,8 @@ def child_main(mode: str) -> int:
             result = bench_aggregate()
         elif mode == "apply":
             result = bench_apply()
+        elif mode == "delta":
+            result = bench_delta()
         elif mode == "replicate":
             result = bench_replicate()
         elif mode == "obs":

@@ -83,8 +83,26 @@ LOCK_RANKS: dict[str, int] = {
     # guards only the released flag — the reaper (serve thread exit) and
     # the shutdown path (ShmServer.close -> unlink) must not both unmap
     "_ServerConnection._release_lock": 58,
+    # versioned delta chain (delta/chain.py, ISSUE 10): guards the pair
+    # map + the subscriber condition variable.  The heavy wire-space
+    # encode/diff runs OUTSIDE it; inside are only dict ops and the CV
+    # notify.  Acquired under the core locks (the post-apply build hook
+    # runs inside the barrier close) and before the serve cache's.
+    "DeltaChain._lock": 59,
+    # the serve cache and its delta-frame tier (server/ps_service.py)
+    # SHARE a rank deliberately (the stripe-lock pattern): each is a leaf
+    # held only around dict ops, and the shared rank makes holding both
+    # at once a checked violation by construction
     "EncodedServeCache._lock": 60,
+    "EncodedDeltaCache._lock": 60,
+    # weight-subscription follower mailbox (delta/subscriber.py): leaf,
+    # guards only the one-slot pending store + status flags
+    "WeightFollower._lock": 61,
     "ClusterAggregator._lock": 62,
+    # live-subscription admission counter (server/ps_service.py
+    # SubscribeWeights): leaf, guards only the active-subscriber count
+    # the bounded handler pool is sized against
+    "ParameterServerService._sub_lock": 63,
     "trainer._DISPATCH_LOCK": 64,
     "native._lock": 66,
     # single-flight creation of the shared stripe executor

@@ -12,6 +12,7 @@ files, optimizer-state sidecars, and a clean stop.
 from __future__ import annotations
 
 import glob
+import json
 import os
 import re
 import threading
@@ -98,6 +99,14 @@ class CheckpointManager:
             opt_state = self._core.optimizer_state()
             if opt_state:
                 _save_optimizer_sidecar(path, opt_state)
+            # store-version meta sidecar (delta serving, ISSUE 10): the
+            # version counter at save time, so a LATER process restoring
+            # this file resumes numbering past it and a version id the
+            # saving process already served can never name different
+            # values.  Read after snapshot — a concurrent bump makes the
+            # recorded version only larger, which is the safe direction.
+            _save_meta_sidecar(path, {
+                "params_version": int(self._core.params_version)})
             self._core.epoch = epoch
             self._last_saved_epoch = max(self._last_saved_epoch, epoch)
             self._apply_retention()
@@ -113,8 +122,16 @@ class CheckpointManager:
         if not params:
             raise ValueError(f"refusing to restore empty checkpoint {path!r}")
         opt_state = _load_optimizer_sidecar(path)
+        meta = _load_meta_sidecar(path)
         with self._lock:
-            self._core.restore(epoch, iteration, params, optimizer_state=opt_state)
+            self._core.restore(
+                epoch, iteration, params, optimizer_state=opt_state,
+                # serve_version monotonicity across processes: restore
+                # resumes version numbering past the save-time counter
+                # (core.restore also bumps past everything THIS process
+                # served) — a delta receiver can never be told a version
+                # id it holds now names different values (ISSUE 10)
+                params_version=int(meta.get("params_version", 0)))
             self._last_saved_epoch = max(self._last_saved_epoch, epoch)
         return epoch, iteration
 
@@ -139,11 +156,38 @@ class CheckpointManager:
         for _, path in found[:-self._keep]:
             try:
                 os.remove(path)
-                sidecar = path + ".opt.npz"
-                if os.path.exists(sidecar):
-                    os.remove(sidecar)
+                for suffix in (".opt.npz", ".meta.json"):
+                    sidecar = path + suffix
+                    if os.path.exists(sidecar):
+                        os.remove(sidecar)
             except OSError:
                 pass
+
+
+def _save_meta_sidecar(path: str, meta: dict) -> None:
+    """Framework-only metadata next to the checkpoint (atomic, JSON).
+    Deliberately a sidecar: the .ckpt byte layout is pinned to the
+    reference (checkpoint/codec.py) and must stay loadable by it."""
+    tmp = path + ".meta.json.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    os.replace(tmp, path + ".meta.json")
+
+
+def _load_meta_sidecar(path: str) -> dict:
+    """Meta sidecar contents, values normalized ({} for reference-written
+    checkpoints).  Best-effort by contract: a missing, unparseable, or
+    wrong-typed OPTIONAL sidecar must never block restoring a valid
+    .ckpt."""
+    try:
+        with open(path + ".meta.json", encoding="utf-8") as f:
+            loaded = json.load(f)
+        if not isinstance(loaded, dict):
+            return {}
+        loaded["params_version"] = int(loaded.get("params_version") or 0)
+        return loaded
+    except (OSError, ValueError, TypeError):
+        return {}
 
 
 def _save_optimizer_sidecar(path: str, state: dict) -> None:

@@ -47,8 +47,10 @@ import logging
 import queue
 import sys
 import threading
+import time
 
 from ..config import parse_argv, require_flag_value
+from ..obs import flight
 
 KNOWN_FLAGS = frozenset({
     "model", "dtype", "scan-layers", "no-scan-layers", "seed", "ckpt",
@@ -57,6 +59,7 @@ KNOWN_FLAGS = frozenset({
     "lora-alpha", "draft-lora-alpha", "prompt-cache",
     "draft-model", "draft-ckpt", "draft-seed", "draft-len",
     "no-adaptive-draft", "draft-cost-ratio", "fused-rounds",
+    "follow", "subscriber-id",
 })
 
 
@@ -109,6 +112,10 @@ def main(argv: list[str] | None = None) -> int:
     require_flag_value(argv, "--fused-rounds",
                        hint="decode rounds per device dispatch, e.g. "
                             "--fused-rounds=8")
+    # bare --follow would silently serve boot weights forever
+    require_flag_value(argv, "--follow",
+                       hint="the training PS address to track, e.g. "
+                            "--follow=10.0.0.5:50051")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
         raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
@@ -133,9 +140,12 @@ def main(argv: list[str] | None = None) -> int:
         params, source = load_params(flags, model,
                                      int(flags.get("seed", 0)))
         params = match_layout(model, params)
+    # one binding for both weight paths — the boot params here and every
+    # follower hot swap below quantize identically or not at all
+    quantize = None
     if flags.get("quant", "") == "int8":
-        from ..models.quant import quantize_params
-        params = quantize_params(params)
+        from ..models.quant import quantize_params as quantize
+        params = quantize(params)
         source += " (int8 weights)"
     print(f"serving: {source}", file=sys.stderr)
 
@@ -171,6 +181,24 @@ def main(argv: list[str] | None = None) -> int:
             # the param-count proxy for the controller's cost model
             adaptive_draft="no-adaptive-draft" not in flags,
             draft_cost_ratio=draft_cost_ratio(flags, draft, model))
+    follower = None
+    if flags.get("follow"):
+        # live weight publication (delta/, ISSUE 10): subscribe to a
+        # training PS and hot-swap fresh weight versions between
+        # admissions.  Every failure mode degrades to serving the
+        # last-good weights — the decode process never crashes or stalls
+        # on the training side's health (delta/subscriber.py).
+        import os as _os
+
+        from ..delta.subscriber import WeightFollower
+        follower = WeightFollower(
+            flags["follow"],
+            subscriber_id=int(flags.get("subscriber-id",
+                                        str(_os.getpid() & 0x7FFF))))
+        follower.start()
+        print(f"following weights from {flags['follow']}",
+              file=sys.stderr)
+
     srv = DecodeServer(
         model, params,
         slots=int(flags.get("slots", "8")),
@@ -215,8 +243,37 @@ def main(argv: list[str] | None = None) -> int:
         _emit(done)
 
     def finish_run() -> int:
+        if follower is not None:
+            follower.stop()
+            if follower.degraded:
+                print(f"weight follower degraded: "
+                      f"{follower.degrade_reason} (kept serving version "
+                      f"{follower.version})", file=sys.stderr)
         print(f"serving stats: {json.dumps(srv.stats)}", file=sys.stderr)
         return 0
+
+    def maybe_swap() -> None:
+        """Hot-swap the newest complete weight version (if any) between
+        admissions.  A bad publication (shape/name drift after a model
+        change upstream) must never kill serving — the server keeps the
+        last-good weights and says so."""
+        if follower is None:
+            return
+        fresh = follower.poll()
+        if fresh is None:
+            return
+        store, version = fresh
+        t0 = time.perf_counter()
+        try:
+            srv.swap_params(quantize(store) if quantize else store)
+        except Exception as exc:  # noqa: BLE001 — serving boundary: keep
+            # decoding on the last-good weights whatever the feed sends
+            print(f"weight swap to version {version} failed ({exc}); "
+                  f"keeping last-good weights", file=sys.stderr)
+            return
+        flight.record("publish.swap", a=version,
+                      b=int(1e6 * (time.perf_counter() - t0)))
+        print(f"weights: swapped to version {version}", file=sys.stderr)
 
     def admit() -> None:
         while pending and srv.has_free_slot:
@@ -280,13 +337,25 @@ def main(argv: list[str] | None = None) -> int:
                     pending.append(payload)
         except queue.Empty:
             pass
+        # between admissions is the swap point: no decode round is in
+        # flight, so the next round reads the fresh weights whole
+        maybe_swap()
         admit()
         if srv.idle:
             if eof and not pending:
                 return finish_run()
             if not pending:
-                # nothing in flight: block for the next request (or EOF)
-                item = in_q.get()
+                # nothing in flight: block for the next request (or EOF).
+                # A following server wakes periodically so weight
+                # versions keep swapping in while the queue is empty —
+                # the first request after a quiet stretch must not be
+                # served stale weights.
+                try:
+                    item = in_q.get(
+                        timeout=0.5 if follower is not None else None)
+                except queue.Empty:
+                    maybe_swap()
+                    continue
                 if item is None:
                     return finish_run()
                 tag, payload = item

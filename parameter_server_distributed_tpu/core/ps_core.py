@@ -389,6 +389,15 @@ class ParameterServerCore:
         # hook may read the store consistently and — in sync mode —
         # block on the ship; _apply_lock is BLOCKING_ALLOWED).
         self._on_apply: Callable[[], None] | None = None
+        # Delta sink (delta/chain.py DeltaChain, ISSUE 10): told about
+        # every SYNCHRONOUS apply's (store, version) right after the
+        # swap — still inside the serialized apply section, so the sink
+        # reads values no later apply can be mutating — and reset()
+        # whenever the store changes outside the apply timeline
+        # (restore / replication install / reshard retire), because a
+        # delta against a pre-reset base would patch the wrong world.
+        # The sink must not raise (DeltaChain.note_apply catches).
+        self._delta_sink = None
         # Async non-blocking serve: device optimizers dispatch their apply
         # asynchronously (jax), so right after a push the new store is a
         # promise.  Reads must not stall on that compute — bounded
@@ -524,11 +533,51 @@ class ParameterServerCore:
         weight, members = entry
         return int(weight), tuple(int(m) for m in members)
 
+    # ------------------------------------------------------------------ delta
+    def set_delta_sink(self, sink, *, seed: bool = True) -> None:
+        """Install (or clear) the versioned-delta sink (delta/chain.py):
+        ``sink.note_apply(store, version)`` after every synchronous
+        apply, ``sink.reset()`` on restore/install/retire.  note_apply
+        runs inside the serialized apply section (under _apply_lock on
+        the streaming path, _state_lock on the buffered path) and MUST
+        NOT raise.
+
+        ``seed=True`` requires a quiescent core: the snapshot below
+        encodes OUTSIDE the apply serialization, so it is only safe
+        before the server starts taking traffic.  A sink installed
+        while applies may be in flight (the service's lazy arming on
+        the first dtype-compatible delta request) passes ``seed=False``
+        — the next serialized apply reseeds the retained image instead,
+        costing one extra full serve but never a torn base."""
+        self._delta_sink = sink
+        if sink is None or not seed:
+            return
+        # seed from the live store so a core initialized BEFORE the sink
+        # was installed still diffs from its very next apply (no traffic
+        # is flowing at install time — the service owns the core before
+        # the server starts — so this is effectively serialized)
+        with self._params_lock:
+            store, version = self._params, self._params_version
+        if store and _store_ready(store):
+            sink.note_apply(store, version)
+
+    def _notify_delta(self, store: TensorStore, version: int) -> None:
+        if self._delta_sink is not None:
+            self._delta_sink.note_apply(store, version)
+
+    def _reset_delta(self) -> None:
+        if self._delta_sink is not None:
+            self._delta_sink.reset()
+
     # ----------------------------------------------------------------- params
     def initialize_parameters(self, params: Mapping[str, np.ndarray]) -> None:
         with self._params_lock:
             self._params = tree_like(params)
             self._params_version += 1
+            store, version = self._params, self._params_version
+        # seed the delta chain from the init so the FIRST apply already
+        # serves a delta (outside _params_lock: the encode is O(model))
+        self._notify_delta(store, version)
 
     def get_parameters(self) -> TensorStore:
         with self._params_lock:
@@ -1130,6 +1179,9 @@ class ParameterServerCore:
                             with self._params_lock:
                                 self._params = dict(fresh)
                                 self._params_version += 1
+                                _dstore = self._params
+                                _dver = self._params_version
+                            self._notify_delta(_dstore, _dver)
                         else:
                             # contributor mean without a per-worker
                             # sweep: one in-place O(model) scale of the
@@ -1254,6 +1306,9 @@ class ParameterServerCore:
                 new_params[name] = p_new
             self._params = new_params
             self._params_version += 1
+            version = self._params_version
+        # still under _state_lock (buffered path), outside _params_lock
+        self._notify_delta(new_params, version)
         return True
 
     def _scale_striped(self, sums: TensorStore,
@@ -1323,6 +1378,10 @@ class ParameterServerCore:
                 return
             self._params = new_params
             self._params_version += 1
+            version = self._params_version
+        # delta build after the swap, outside _params_lock (the caller's
+        # _apply_lock/_state_lock still serializes applies)
+        self._notify_delta(new_params, version)
 
     def _apply_update(self, mean_grads: TensorStore) -> None:
         """Applies are serialized by the caller: _state_lock on the
@@ -1337,8 +1396,14 @@ class ParameterServerCore:
                 # bootstrap quirk preserved from the reference (cpp:78-81)
                 self._params = dict(mean_grads)
                 self._params_version += 1
-                return
-            prev = self._params
+                store, version = self._params, self._params_version
+                boot = True
+            else:
+                prev = self._params
+                boot = False
+        if boot:
+            self._notify_delta(store, version)
+            return
         if not self.synchronous:
             # Depth bound: at most ONE apply in flight — if the previous
             # apply hasn't materialized yet, fence on it now so push
@@ -1364,6 +1429,11 @@ class ParameterServerCore:
                 self._params = self._optimizer.apply(self._params,
                                                      mean_grads)
                 self._params_version += 1
+                store, version = self._params, self._params_version
+            # delta build outside _params_lock, still inside the
+            # caller's serialized apply section
+            if _store_ready(store):
+                self._notify_delta(store, version)
 
     # ------------------------------------------------------------------- sync
     def check_sync_status(self, iteration: int) -> tuple[int, bool, int, int]:
@@ -1474,12 +1544,22 @@ class ParameterServerCore:
 
     def restore(self, epoch: int, iteration: int,
                 params: Mapping[str, np.ndarray],
-                optimizer_state: dict | None = None) -> None:
+                optimizer_state: dict | None = None,
+                params_version: int | None = None) -> None:
+        """``params_version`` (checkpoint meta sidecar) is the version
+        counter AT SAVE TIME: the restored store resumes numbering past
+        both it and anything this process served since — a previously-
+        served version id must never be reused for different values,
+        because a versioned-delta receiver would silently patch against
+        the wrong base (ISSUE 10; within one process ``_params_version``
+        only ever increments, so the max ever served is bounded by it)."""
         with self._state_lock:
             with self._apply_lock:
                 with self._params_lock:
                     self._params = tree_like(params)
-                    self._params_version += 1
+                    self._params_version = max(
+                        self._params_version,
+                        int(params_version or 0)) + 1
                     if optimizer_state is not None:
                         self._optimizer.load_state_dict(optimizer_state)
                 # bumped while _apply_lock is held: an in-flight streaming
@@ -1495,6 +1575,10 @@ class ParameterServerCore:
             self._bootstrap_iteration = None
             flight.record("ckpt.restore", iteration=int(iteration),
                           a=int(epoch))
+        # the restored store is a new world: stale delta pairs must not
+        # patch receivers toward it (outside the core locks — reset is
+        # cheap but the sink has its own lock)
+        self._reset_delta()
 
     # ------------------------------------------------------------ replication
     def set_replication_hook(self, hook: Callable[[], None] | None) -> None:
@@ -1629,6 +1713,10 @@ class ParameterServerCore:
                 iteration=(int(iteration) if iteration is not None else -1),
                 a=store_nbytes(store), b=version)
             self._barrier_cv.notify_all()
+        # the store changed outside the apply timeline: stale delta pairs
+        # must not patch receivers toward the installed state (restore()
+        # discipline — outside the core locks)
+        self._reset_delta()
         return version
 
     def retire_tensors(self, names, map_epoch: int
@@ -1698,8 +1786,12 @@ class ParameterServerCore:
                     self._grad_buffer_note(-freed)
             flight.record("reshard.fence", iteration=self._current_iteration,
                           a=len(moved), b=int(map_epoch))
-            return (self._epoch, self._current_iteration, version, moved,
-                    moved_opt)
+            result = (self._epoch, self._current_iteration, version, moved,
+                      moved_opt)
+        # a retire reshapes the store: delta pairs built against the
+        # pre-fence world must not serve (restore() discipline)
+        self._reset_delta()
+        return result
 
 
 def _mean_over_workers(worker_gradients: Mapping[int, TensorStore]) -> TensorStore:

@@ -1,0 +1,36 @@
+"""Versioned delta serving + live weight publication (ISSUE 10).
+
+The encode-once broadcast cache (server/ps_service.py) made the N-worker
+serve fan-out cheap per byte, but every iteration still ships the FULL
+model to every puller, and the serving stack (models/serving.py
+DecodeServer) only ever sees new weights through a checkpoint restart.
+Per-step weight updates touch a sparse/low-magnitude slice of the model
+in WIRE space (a small SGD step moves most weights by less than a bf16
+ulp), so serving a versioned delta against what the receiver already
+holds turns the per-iteration serve cost from O(model) into O(changed
+bytes) — and the same delta stream is the train-to-production weight
+publication loop.
+
+Three pieces:
+
+- :mod:`.chain` — ``DeltaChain``: after every synchronous optimizer
+  apply the PS diffs consecutive store versions in wire space (stripe
+  parallel, ``core/stripes.py`` partition) and keeps a bounded chain of
+  ``(from_version, to_version)`` sparse pairs.
+- :mod:`.messages` — the extension RPC schemas (``PullParametersDelta``,
+  ``PushPullDeltaStream``, ``SubscribeWeights``).  Deliberately OUTSIDE
+  ``rpc/messages.py``: the analyzer's wire manifest pins the reference
+  contract and stays byte-unchanged; reference peers answer
+  UNIMPLEMENTED and callers downgrade permanently (the PR-2 fallback
+  discipline).
+- :mod:`.client` / :mod:`.subscriber` — the receiver halves: in-place
+  chain application against a cached pull (worker data plane), and the
+  ``WeightFollower`` thread a DecodeServer uses to hot-swap params
+  between admissions while tracking a live training run.
+"""
+
+from .chain import DeltaChain, delta_depth, delta_wire_dtype  # noqa: F401
+from .client import (DeltaBaseMismatch, DeltaPullState,  # noqa: F401
+                     apply_frames, store_crc)
+from .messages import DELTA_PS_METHODS, delta_enabled  # noqa: F401
+from .subscriber import WeightFollower  # noqa: F401

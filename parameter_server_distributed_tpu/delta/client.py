@@ -1,0 +1,186 @@
+"""Receiver half of the delta protocol: frame assembly + in-place apply.
+
+Shared by the worker data plane (rpc/data_plane.py ``PSClient``) and the
+serving-fleet subscriber (delta/subscriber.py): both hold a cached full
+pull (``DeltaPullState``) and advance it version by version by
+scattering each pair's wire-decoded values into the cached arrays —
+"apply the delta in place against the cached pull".
+
+Safety order: a pair's entries are buffered until its frames fully
+arrived, then applied, then the final pair's checksum is verified
+against the whole patched store (delta/chain.py checksum contract).  A
+transport error mid-stream therefore leaves the base untouched; a
+checksum mismatch AFTER apply means the base has drifted from what the
+server believes (PS restart with recycled version numbers, a missed
+reset) — the base is poisoned, so the caller drops it, re-pulls full,
+and downgrades the connection permanently (PR-2 discipline, zero failed
+steps)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..rpc import messages as m
+from .chain import decode_wire_values, store_crc
+
+TensorStore = dict
+
+
+class DeltaBaseMismatch(RuntimeError):
+    """The cached base no longer matches the server's idea of the held
+    version (checksum or version-bookkeeping failure)."""
+
+
+class DeltaPullState:
+    """The receiver's cached pull: the base store deltas patch, and the
+    store version it corresponds to (-1 = none)."""
+
+    __slots__ = ("base", "version")
+
+    def __init__(self):
+        self.base: TensorStore | None = None
+        self.version = -1
+
+    def note_full(self, store: TensorStore, version: int) -> None:
+        self.base = store
+        self.version = int(version)
+
+    def invalidate(self) -> None:
+        self.base = None
+        self.version = -1
+
+
+class DeltaRoundResult:
+    __slots__ = ("push", "store", "update", "served_delta", "to_version",
+                 "wire_bytes")
+
+    def __init__(self):
+        self.push: m.PushResponse | None = None
+        self.store: TensorStore | None = None
+        # ParameterUpdate-shaped metadata for wire negotiation — only a
+        # FULL serve carries tensors to negotiate from
+        self.update: m.ParameterUpdate | None = None
+        self.served_delta = False
+        self.to_version = -1
+        self.wire_bytes = 0
+
+
+def _apply_entry(store: TensorStore, entry, wire_dtype: int) -> None:
+    arr = store.get(entry.name)
+    if arr is None:
+        raise DeltaBaseMismatch(f"delta names unknown tensor {entry.name!r}")
+    if not (isinstance(arr, np.ndarray) and arr.flags["C_CONTIGUOUS"]
+            and arr.dtype == np.float32):
+        arr = np.ascontiguousarray(arr, np.float32)
+        store[entry.name] = arr
+    flat = arr.reshape(-1)
+    vals = decode_wire_values(entry.values, wire_dtype)
+    if entry.dense:
+        if vals.size != flat.size:
+            raise DeltaBaseMismatch(
+                f"dense delta size {vals.size} != tensor {entry.name!r} "
+                f"size {flat.size}")
+        flat[:] = vals
+        return
+    idx = np.frombuffer(entry.indices, dtype="<u4")
+    if idx.size != vals.size:
+        raise DeltaBaseMismatch(
+            f"delta index/value count mismatch on {entry.name!r}")
+    if idx.size and int(idx.max()) >= flat.size:
+        # wire-facing bound check (max, not idx[-1]: a well-formed chain
+        # builds ascending indices, but this input cannot be trusted)
+        raise DeltaBaseMismatch(
+            f"delta index out of range on {entry.name!r}")
+    flat[idx] = vals
+
+
+def apply_frames(frames, state: DeltaPullState,
+                 on_full_chunk=None) -> DeltaRoundResult:
+    """Fold a DeltaFrame stream into the round result, applying delta
+    pairs in place against ``state.base``.  ``on_full_chunk(tensors)``
+    mirrors the plain data plane's per-chunk consumer (conversion
+    overlapping transport) for full frames.
+
+    Raises :class:`DeltaBaseMismatch` when the base cannot take the
+    served chain (missing base, version gap, checksum failure) — the
+    base may then be PARTIALLY PATCHED and must be invalidated by the
+    caller."""
+    out = DeltaRoundResult()
+    local: TensorStore = {}
+    meta: list[m.Tensor] = []
+    full_iteration, full_ready, got_full = 0, False, False
+    pending: list = []          # buffered entries of the in-flight pair
+    pair_from = pair_to = -1
+    applied_any = False
+    final_crc: int | None = None
+    for frame in frames:
+        if frame.push is not None and out.push is None:
+            out.push = frame.push
+        if frame.params is not None:
+            got_full = True
+            chunk = frame.params
+            full_iteration, full_ready = chunk.iteration, chunk.ready
+            if chunk.parameters:
+                if on_full_chunk is not None:
+                    on_full_chunk(chunk.parameters)
+                local.update(
+                    {t.name: t.to_array() for t in chunk.parameters})
+                meta.extend(m.Tensor(name=t.name,
+                                     packed_dtype=t.packed_dtype)
+                            for t in chunk.parameters)
+            if frame.to_version:
+                out.to_version = frame.to_version
+        if frame.delta:
+            if pair_from < 0:
+                pair_from, pair_to = frame.from_version, frame.to_version
+            elif (frame.from_version, frame.to_version) != (pair_from,
+                                                            pair_to):
+                raise DeltaBaseMismatch("interleaved delta pairs")
+            pending.extend(frame.entries)
+            out.wire_bytes += sum(len(e.indices) + len(e.values)
+                                  for e in frame.entries)
+            if frame.last:
+                # one pair complete: apply it against the base
+                if state.base is None or state.version != pair_from:
+                    raise DeltaBaseMismatch(
+                        f"delta pair {pair_from}->{pair_to} does not "
+                        f"chain from held version {state.version}")
+                for entry in pending:
+                    try:
+                        _apply_entry(state.base, entry, frame.wire_dtype)
+                    except DeltaBaseMismatch:
+                        raise
+                    except (ValueError, IndexError, TypeError) as exc:
+                        # malformed wire bytes (truncated values, bad
+                        # index buffer) must ride the same downgrade
+                        # path as a drifted base — never a raw numpy
+                        # error escaping into the caller's step
+                        raise DeltaBaseMismatch(
+                            f"malformed delta entry for "
+                            f"{entry.name!r}: {exc}") from exc
+                applied_any = True
+                state.version = pair_to
+                out.to_version = pair_to
+                final_crc = frame.crc
+                pending, pair_from, pair_to = [], -1, -1
+    if pending:
+        raise DeltaBaseMismatch("delta stream ended mid-pair")
+    if applied_any:
+        out.served_delta = True
+        if final_crc is not None and final_crc != store_crc(state.base):
+            raise DeltaBaseMismatch(
+                f"post-apply checksum mismatch at version "
+                f"{state.version}")
+        out.store = state.base
+        return out
+    if got_full and full_ready:
+        out.store = local
+        out.update = m.ParameterUpdate(iteration=full_iteration,
+                                       parameters=meta, ready=True)
+        if out.to_version >= 0:
+            state.note_full(local, out.to_version)
+        else:
+            # a server that does not stamp versions cannot be a delta
+            # base; keep serving full rounds
+            state.invalidate()
+    return out

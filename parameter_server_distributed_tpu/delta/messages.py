@@ -1,0 +1,165 @@
+"""Delta-serving / weight-publication extension RPC messages (ISSUE 10).
+
+Deliberately NOT in ``rpc/messages.py``: the analyzer's wire manifest
+pins the reference contract (field tags, method tables) and this
+subsystem must leave it byte-unchanged (asserted in
+tests/test_analysis.py).  These are extra method names on the existing
+parameter-server gRPC service — a reference peer simply never calls
+them and answers UNIMPLEMENTED, which every caller treats as a permanent
+per-connection downgrade to the full-serve protocol (the PR-2/PR-6/PR-7
+fallback discipline, zero failed steps).
+
+Frame protocol (all three RPCs stream :class:`DeltaFrame`):
+
+- a FULL serve rides ``params`` chunks (the exact
+  ``ParameterUpdate``-shaped bytes of the ordinary pull, replayed from
+  the encode-once cache) with ``to_version`` stamped so the receiver
+  learns which store version it now holds — that version is the base
+  the next delta applies against;
+- a DELTA serve rides ``entries``: per-tensor sparse (or per-tensor
+  dense) WIRE-SPACE patches for one ``(from_version, to_version)`` pair.
+  The receiver scatters the decoded values into its cached store —
+  bit-identical to a full pull by construction, because unchanged
+  elements have unchanged wire bytes and changed elements carry exactly
+  the bytes a full pull would (delta/chain.py);
+- the last frame of a pair carries ``crc`` — crc32 over the decoded f32
+  bytes of the FULL store at ``to_version`` (names sorted) — the base-
+  mismatch detector: a receiver whose cached base drifted (PS restart,
+  missed reset) fails the check, drops its base, and downgrades this
+  connection permanently while re-pulling full (zero failed steps).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..rpc.messages import (TRACE_FIELD_NUMBER, GradientUpdate,
+                            ParameterUpdate, PushResponse)
+from ..rpc.wire import Field, Message
+
+# Bounded delta chain depth: a receiver within this many versions of the
+# store is served a delta chain; anyone further behind (or after a
+# restore/reshard reset) gets a full serve.  0 disables the subsystem on
+# both ends (build, serve, and the client's delta RPCs).
+ENV_DEPTH = "PSDT_DELTA_DEPTH"
+DEFAULT_DEPTH = 4
+
+# Wire dtype the chain is built for (delta/chain.py): deltas only engage
+# when the receiver's effective pull encoding matches.  bf16 is where
+# delta serving pays — a small optimizer step moves most weights by less
+# than a bf16 ulp, so the wire-space diff is genuinely sparse.
+ENV_DTYPE = "PSDT_DELTA_DTYPE"
+DEFAULT_DTYPE = "bf16"
+
+
+def delta_depth() -> int:
+    return int(os.environ.get(ENV_DEPTH, str(DEFAULT_DEPTH)))
+
+
+def delta_enabled() -> bool:
+    return delta_depth() > 0
+
+
+class DeltaEntry(Message):
+    """One tensor's wire-space patch within a pair.  ``indices`` is
+    packed little-endian u32 flat indices; ``values`` is the matching
+    wire-encoded elements (bf16: u16 each; f32: 4 raw bytes each).
+    ``dense=True`` means ``values`` is the tensor's WHOLE wire payload
+    (cheaper than sparse past the break-even fraction) and ``indices``
+    is empty."""
+    FIELDS = (
+        Field(1, "name", "string"),
+        Field(2, "indices", "bytes"),
+        Field(3, "values", "bytes"),
+        Field(4, "dense", "bool"),
+    )
+
+
+class DeltaFrame(Message):
+    """One frame of a delta-protocol response stream (see module doc).
+    ``push`` rides only on the fused ``PushPullDeltaStream`` (the first
+    frame, exactly like ``PushPullResponse``); ``delta`` distinguishes
+    entry frames from full ``params`` chunks; ``last`` marks the final
+    frame of one ``(from_version, to_version)`` pair (delta) or of the
+    whole full serve."""
+    FIELDS = (
+        Field(1, "push", "message", message_type=PushResponse),
+        Field(2, "params", "message", message_type=ParameterUpdate),
+        Field(3, "from_version", "int64"),
+        Field(4, "to_version", "int64"),
+        Field(5, "delta", "bool"),
+        Field(6, "entries", "message", message_type=DeltaEntry,
+              repeated=True),
+        Field(7, "crc", "int64"),
+        Field(8, "last", "bool"),
+        Field(9, "wire_dtype", "int32"),
+    )
+
+
+class DeltaPullRequest(Message):
+    """Version-aware unary pull: ``held_version`` advertises the store
+    version the caller's cached params correspond to (0 = none — the
+    response is a full serve that establishes the base)."""
+    FIELDS = (
+        Field(1, "worker_id", "int32"),
+        Field(2, "iteration", "int32"),
+        Field(3, "wire_dtype", "int32"),
+        Field(4, "held_version", "int64"),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
+    )
+
+
+class DeltaPushChunk(Message):
+    """One chunk of the version-aware fused round: the ordinary fused
+    ``GradientUpdate`` chunk wrapped with the pusher's held version
+    (read off the first chunk, like ``pull_wire_dtype``)."""
+    FIELDS = (
+        Field(1, "update", "message", message_type=GradientUpdate),
+        Field(2, "held_version", "int64"),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
+    )
+
+
+class SubscribeRequest(Message):
+    """Open a live weight subscription: the server streams a frame batch
+    for every new store version from ``held_version`` forward (full
+    first when the subscriber holds nothing or is past the chain depth),
+    until the caller cancels.  The decode fleet's train-to-production
+    feed (delta/subscriber.py WeightFollower)."""
+    FIELDS = (
+        Field(1, "subscriber_id", "int32"),
+        Field(2, "held_version", "int64"),
+        Field(3, "wire_dtype", "int32"),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
+    )
+
+
+class EncodedDeltaFrame:
+    """A :class:`DeltaFrame` whose bytes were encoded once (the delta
+    tier of the encode-once cache) and are replayed verbatim to every
+    receiver of the same (version pair, wire dtype, chunk budget) —
+    quacks like a codec Message, which is all the gRPC serializer
+    needs (the PreEncodedParameterUpdate pattern)."""
+
+    __slots__ = ("body",)
+
+    def __init__(self, body: bytes):
+        self.body = body
+
+    def encoded_size(self) -> int:
+        return len(self.body)
+
+    def encode_into(self, writer) -> None:
+        writer.write(memoryview(self.body))
+
+    def encode(self) -> bytes:
+        return self.body
+
+
+# Extra method names on the parameter-server service; kept OUT of
+# rpc/messages.py's pinned tables (see module doc).
+DELTA_PS_METHODS = {
+    "PullParametersDelta": (DeltaPullRequest, DeltaFrame, "unary_stream"),
+    "PushPullDeltaStream": (DeltaPushChunk, DeltaFrame, "stream_stream"),
+    "SubscribeWeights": (SubscribeRequest, DeltaFrame, "unary_stream"),
+}

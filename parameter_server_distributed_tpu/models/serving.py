@@ -333,10 +333,12 @@ class DecodeServer:
         self.cache_dtype = cache_dtype
         self.mesh = mesh
         from .transformer import transformer_rule
+        self._param_rule = (param_rule or transformer_rule(mesh)
+                            if mesh is not None else None)
         if mesh is not None:
-            params = _place_params(dict(params), mesh,
-                                   param_rule or transformer_rule(mesh))
+            params = _place_params(dict(params), mesh, self._param_rule)
         self.params = params
+        self._n_swaps = 0  # live weight hot-swaps (swap_params)
         self._cache = init_cache(model, slots, max_len, cache_dtype)
         if mesh is not None:
             self._cache = _shard_cache(self._cache, mesh)
@@ -471,6 +473,44 @@ class DecodeServer:
         self._rounds_since_adapt = 0
 
     # ------------------------------------------------------------- admin
+    def swap_params(self, params: Mapping[str, Any]) -> None:
+        """Hot-swap the model weights (live weight publication — a
+        follower tracking a training run feeds fresh versions through
+        here, cli/serve_main.py ``--follow``).  Call BETWEEN decode
+        rounds from the serving thread: the compiled programs take the
+        params as a traced input, so no retrace happens and the very
+        next round reads the new weights.  In-flight requests keep
+        their slots, KV rows, and sampling state — their already-emitted
+        tokens stand and their continuations decode under the new
+        weights, which is the point of tracking a live run (token
+        streams are uninterrupted, not retroactively recomputed).
+
+        The prompt cache is dropped: its prefill logits/KV rows were
+        computed under the old weights, and replaying them would splice
+        stale state next to fresh-weight decode steps.
+
+        Raises on name/shape drift against the current params (an
+        upstream model change mid-publication): the swap point is where
+        callers catch a bad publication and keep the last-good weights
+        (cli/serve_main.py maybe_swap) — without this check the mismatch
+        would surface as a crash inside a later decode round."""
+        current = {name: np.shape(arr)
+                   for name, arr in self.params.items()}
+        fresh = {name: np.shape(arr) for name, arr in params.items()}
+        if current != fresh:
+            drift = {name for name in (set(current) ^ set(fresh))} | {
+                name for name in set(current) & set(fresh)
+                if current[name] != fresh[name]}
+            raise ValueError(
+                f"published weights do not match the served model "
+                f"(name/shape drift: {sorted(drift)[:4]}...)")
+        if self.mesh is not None:
+            params = _place_params(dict(params), self.mesh,
+                                   self._param_rule)
+        self.params = params
+        self._prompt_cache.clear()
+        self._n_swaps += 1
+
     @property
     def idle(self) -> bool:
         return all(s is None for s in self._slot)
@@ -796,6 +836,8 @@ class DecodeServer:
             "requests_admitted": self._n_requests,
             "requests_completed": self._n_retired,
         }
+        if self._n_swaps:
+            out["weight_swaps"] = self._n_swaps
         if self.prompt_cache_size:
             out["prompt_cache_hits"] = self._prompt_hits
         if self.draft is not None:

@@ -64,6 +64,17 @@ def _ps_rollup(snap: dict) -> dict:
     misses = counters.get("ps.serve.cache_miss", 0)
     if hits or misses:
         out["serve_cache"] = {"hits": hits, "misses": misses}
+    # versioned delta serving (delta/, ISSUE 10): chain-hit vs full-serve
+    # fallbacks plus the actual delta wire volume served
+    delta: dict = {}
+    for key, name in (("hits", "ps.serve.delta_hit"),
+                      ("misses", "ps.serve.delta_miss"),
+                      ("bytes", "ps.serve.delta_bytes")):
+        value = counters.get(name, 0)
+        if value:
+            delta[key] = value
+    if delta:
+        out["delta"] = delta
     close = _hist_stats(snap, "ps.barrier_close_s")
     if close:
         out["barrier_close"] = close
@@ -304,6 +315,12 @@ def render_rollup(rollup: dict) -> str:
                 total = cache["hits"] + cache["misses"]
                 parts.append(f"serve cache {cache['hits']}/{total} hits "
                              f"({cache['misses']} encodes)")
+            dserve = ps.get("delta")
+            if dserve:
+                total = dserve.get("hits", 0) + dserve.get("misses", 0)
+                parts.append(
+                    f"delta serve {dserve.get('hits', 0)}/{total} hits "
+                    f"({_fmt_bytes(dserve.get('bytes', 0))} delta)")
             close = ps.get("barrier_close")
             if close:
                 parts.append(f"barrier close p50={_fmt_s(close['p50'])}")

@@ -133,6 +133,17 @@ EVENTS: dict[str, int] = {
                              # b = group size (worker = aggregate id)
     "tier.upstream": 83,     # a = duration_us, b = quantized wire bytes
     "tier.downgrade": 84,    # permanent flat downgrade; note = reason
+    # versioned delta serving + live weight publication (delta/, ISSUE 10)
+    "serve.delta.build": 90,     # a = pair delta bytes, b = to_version
+    "serve.delta.hit": 91,       # a = chain wire bytes, b = pairs served
+    "serve.delta.miss": 92,      # a = held version, b = current version;
+                                 # note = reason (no base / depth/reset /
+                                 # dtype / disabled)
+    "serve.delta.downgrade": 93,  # client-side permanent downgrade;
+                                  # note = reason (checksum/UNIMPLEMENTED)
+    "publish.subscribe": 94,     # a = held version, b = subscriber id
+    "publish.swap": 95,          # a = new version, b = duration_us
+    "publish.lag": 96,           # a = versions behind the training run
 }
 EVENT_NAMES = {code: name for name, code in EVENTS.items()}
 

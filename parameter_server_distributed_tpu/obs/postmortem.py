@@ -245,6 +245,17 @@ def iteration_timeline(events: list[dict], iteration: int) -> dict:
         out["replica_installs"] = [
             {"role": e["role"], "bytes": e["a"], "version": e["b"]}
             for e in installs]
+    # versioned delta serving (delta/, ISSUE 10): how this iteration's
+    # serve fan-out rode the delta chain vs fell back to full encodes
+    dhits = [e for e in evs if e["event"] == "serve.delta.hit"]
+    dmisses = [e for e in evs if e["event"] == "serve.delta.miss"]
+    if dhits or dmisses:
+        out["delta_serve"] = {
+            "hits": len(dhits), "misses": len(dmisses),
+            "delta_bytes": sum(e["a"] for e in dhits),
+            "miss_reasons": sorted({e["note"] for e in dmisses
+                                    if e["note"]}),
+        }
     return out
 
 
@@ -318,8 +329,23 @@ def failure_narrative(rings: list[dict], events: list[dict]) -> dict:
     degrades = [{"role": e["role"], "what": e["event"], "note": e["note"]}
                 for e in events
                 if e["event"] in ("repl.degrade", "shm.downgrade",
-                                  "tier.downgrade")]
+                                  "tier.downgrade", "serve.delta.downgrade")]
+    # live weight publication (delta/, ISSUE 10): subscriptions opened,
+    # decode-side hot swaps (last version swapped in), worst version lag
+    subs = [e for e in events if e["event"] == "publish.subscribe"]
+    swaps = [e for e in events if e["event"] == "publish.swap"]
+    lags = [e["a"] for e in events if e["event"] == "publish.lag"]
+    publish: dict[str, Any] = {}
+    if subs:
+        publish["subscriptions"] = len(subs)
+    if swaps:
+        publish["swaps"] = len(swaps)
+        publish["last_version"] = swaps[-1]["a"]
+    if lags:
+        publish["max_lag"] = max(lags)
     out: dict[str, Any] = {}
+    if publish:
+        out["publication"] = publish
     if dead:
         out["dead_processes"] = dead
     if promotions:
@@ -410,6 +436,17 @@ def render_report(rep: dict) -> str:
                      f"{retry['to']} (shard {retry['shard']})")
     for d in narrative.get("degrades", ()):
         lines.append(f"  degrade: {d['what']} at {d['role']} ({d['note']})")
+    publish = narrative.get("publication")
+    if publish:
+        parts = []
+        if publish.get("subscriptions"):
+            parts.append(f"{publish['subscriptions']} subscriptions")
+        if publish.get("swaps"):
+            parts.append(f"{publish['swaps']} weight swaps "
+                         f"(last version {publish.get('last_version', '?')})")
+        if publish.get("max_lag"):
+            parts.append(f"max lag {publish['max_lag']} versions")
+        lines.append(f"  weight publication: {', '.join(parts)}")
     tl = rep.get("timeline")
     if tl:
         lines.append(f"iteration {rep['iteration']}:")
@@ -434,6 +471,14 @@ def render_report(rep: dict) -> str:
             lines.append(f"  {_group_label(gid)}: {', '.join(parts)}")
         if "apply_s" in tl:
             lines.append(f"  optimizer apply: {_fmt_dt(tl['apply_s'])}")
+        dserve = tl.get("delta_serve")
+        if dserve:
+            note = (f"  delta serve: {dserve['hits']} chain hits "
+                    f"({dserve['delta_bytes']} B), "
+                    f"{dserve['misses']} full serves")
+            if dserve.get("miss_reasons"):
+                note += f" ({', '.join(dserve['miss_reasons'])})"
+            lines.append(note)
         for wid in sorted(tl.get("workers", {})):
             w = tl["workers"][wid]
             parts = []

@@ -32,14 +32,10 @@ from ..obs import flight
 from ..obs import stats as obs_stats
 from ..rpc import messages as m
 from ..rpc.service import RpcClient
+from ..rpc.service import status_code as _status_code
 from . import messages as rmsg
 
 log = logging.getLogger("pst.failover")
-
-
-def _status_code(exc: grpc.RpcError):
-    code = getattr(exc, "code", None)
-    return code() if callable(code) else None
 
 
 class ShardMapClient:

@@ -45,6 +45,10 @@ from typing import Iterable, Iterator, Sequence
 
 import grpc
 
+from ..delta.client import (DeltaBaseMismatch, DeltaPullState,
+                            DeltaRoundResult, apply_frames)
+from ..delta.messages import (DELTA_PS_METHODS, DeltaPullRequest,
+                              DeltaPushChunk, delta_enabled)
 from ..obs import flight
 from ..obs import stats as obs_stats
 from ..obs import trace as obs_trace
@@ -57,6 +61,7 @@ from . import shm_transport
 from .codec import (Codec, NativeCodec, PythonCodec,  # noqa: F401 — public
                     active_codec)
 from .service import RpcClient
+from .service import status_code as _status_code
 from .wire import WT_LEN, WT_VARINT, _len_delimited_size, _tag, _varint_size, \
     _Writer, encode_varint
 
@@ -66,13 +71,6 @@ log = logging.getLogger("pst.data_plane")
 # per-message overhead while keeping encode/transport/decode pipelined;
 # PSDT_STREAM_CHUNK_BYTES overrides, 0 disables streaming entirely.
 DEFAULT_CHUNK_BYTES = 32 << 20
-
-
-def _status_code(exc: grpc.RpcError):
-    """Status code of an RpcError, or None for errors that carry none
-    (e.g. fault-injection stubs raising bare grpc.RpcError)."""
-    code = getattr(exc, "code", None)
-    return code() if callable(code) else None
 
 
 def stream_chunk_bytes() -> int:
@@ -224,6 +222,7 @@ class PSClient(RpcClient):
         methods = dict(methods or m.PARAMETER_SERVER_METHODS)
         methods.update(m.PARAMETER_SERVER_STREAM_METHODS)
         methods.update(shm_transport.SHM_METHODS)
+        methods.update(DELTA_PS_METHODS)
         super().__init__(target, service, methods)
         self.chunk_bytes = (stream_chunk_bytes() if chunk_bytes is None
                             else chunk_bytes)
@@ -239,6 +238,15 @@ class PSClient(RpcClient):
         self._shm_conn: shm_transport.ShmClientConnection | None = None
         self._shm_ok: bool | None = None
         self._obs_shm_fallback = obs_stats.counter("rpc.shm.fallback")
+        # versioned delta serving (delta/, ISSUE 10): the cached pull
+        # this connection patches in place, and the same tri-state
+        # downgrade latch as the other extensions — None = untried,
+        # False = permanently full-serve (UNIMPLEMENTED / checksum
+        # mismatch / version-bookkeeping failure)
+        self._delta_state = DeltaPullState()
+        self._delta_ok: bool | None = None
+        self._obs_delta_rounds = obs_stats.counter("rpc.client.delta.rounds")
+        self._obs_delta_bytes = obs_stats.counter("rpc.client.delta.bytes")
 
     def _streaming(self) -> bool:
         return self.chunk_bytes > 0 and self._stream_ok is not False
@@ -344,6 +352,123 @@ class PSClient(RpcClient):
                 raise
             self._stream_ok = False
             return self.call("ReceiveGradients", update, timeout=timeout)
+
+    # ------------------------------------------------------------------ delta
+    def _delta(self) -> bool:
+        """Whether the version-aware delta protocol should be attempted
+        on this connection.  ``delta_enabled`` is read per round so tests
+        and operators can flip PSDT_DELTA_DEPTH without rebuilding the
+        client; the downgrade latch (UNIMPLEMENTED / checksum mismatch)
+        is permanent per connection, like every other extension."""
+        return (self.chunk_bytes > 0 and self._delta_ok is not False
+                and delta_enabled())
+
+    @property
+    def held_version(self) -> int:
+        """Store version of the cached pull deltas patch (-1 = none)."""
+        return self._delta_state.version
+
+    def _delta_downgrade(self, reason: str) -> None:
+        """Permanent per-connection downgrade to the full-serve protocol
+        (the PR-2 discipline).  The base may be partially patched after a
+        failed apply, so it is dropped unconditionally."""
+        self._delta_ok = False
+        self._delta_state.invalidate()
+        flight.record("serve.delta.downgrade", note=reason[:48])
+        log.warning("delta serving permanently downgraded for %s: %s",
+                    self._target, reason)
+
+    def _delta_result(self, frames) -> DeltaRoundResult | None:
+        """Fold a DeltaFrame stream, translating failures into the
+        downgrade discipline: None = the caller must replay via the
+        plain protocol (the PS-side per-(worker,tensor) dedup makes the
+        replay of an already-landed push exact)."""
+        try:
+            result = apply_frames(frames, self._delta_state)
+        except DeltaBaseMismatch as exc:
+            self._delta_downgrade(f"base mismatch: {exc}")
+            return None
+        self._delta_ok = True
+        self._obs_delta_rounds.add()
+        if result.served_delta:
+            self._obs_delta_bytes.add(result.wire_bytes)
+        return result
+
+    def delta_pull(self, request: m.PullRequest,
+                   timeout: float | None = None
+                   ) -> DeltaRoundResult | None:
+        """Version-aware unary pull (``PullParametersDelta``): advertises
+        the held version, applies a served delta chain in place against
+        the cached pull, and returns the round result (``result.store``
+        is the fresh full store either way).  None = use the plain pull
+        path (delta disabled or this connection downgraded)."""
+        if not self._delta():
+            return None
+        req = DeltaPullRequest(worker_id=request.worker_id,
+                               iteration=request.iteration,
+                               wire_dtype=request.wire_dtype,
+                               held_version=max(self.held_version, 0))
+        try:
+            frames = self.call("PullParametersDelta", req, timeout=timeout)
+            return self._delta_result(frames)
+        except grpc.RpcError as exc:
+            if _status_code(exc) == grpc.StatusCode.UNIMPLEMENTED:
+                self._delta_downgrade("UNIMPLEMENTED (reference PS)")
+                return None
+            raise
+
+    def delta_push_pull(self, worker_id: int, iteration: int, tensors_fn,
+                        pull_wire_dtype: int = 0,
+                        timeout: float | None = None
+                        ) -> DeltaRoundResult | None:
+        """The version-aware fused round (``PushPullDeltaStream``): the
+        ordinary fused chunk stream wrapped with the held version, the
+        response a delta chain applied in place (or a stamped full
+        serve).  None = run the plain fused round instead — delta
+        disabled/downgraded, or the connection prefers the same-host
+        shared-memory rings (the shm transport speaks PushPullStream;
+        on loopback, zero-copy beats delta byte savings and the wire is
+        not the bottleneck anyway)."""
+        if not self._delta():
+            return None
+        if shm_transport.enabled() and self._shm_ok is not False:
+            return None
+        held = max(self.held_version, 0)
+
+        def chunks() -> Iterator[DeltaPushChunk]:
+            # held_version and pull_wire_dtype ride the first chunk only
+            # (the server reads header fields off it); an empty push
+            # still sends one empty chunk (see push_gradients)
+            first = True
+            for group in split_tensors(tensors_fn(), self.chunk_bytes):
+                yield DeltaPushChunk(
+                    update=m.GradientUpdate(
+                        worker_id=worker_id, iteration=iteration,
+                        gradients=group,
+                        pull_wire_dtype=pull_wire_dtype if first else 0),
+                    held_version=held if first else 0)
+                first = False
+            if first:
+                yield DeltaPushChunk(
+                    update=m.GradientUpdate(worker_id=worker_id,
+                                            iteration=iteration,
+                                            gradients=[],
+                                            pull_wire_dtype=pull_wire_dtype),
+                    held_version=held)
+
+        try:
+            frames = self.call("PushPullDeltaStream", chunks(),
+                               timeout=timeout)
+            result = self._delta_result(frames)
+        except grpc.RpcError as exc:
+            if _status_code(exc) == grpc.StatusCode.UNIMPLEMENTED:
+                self._delta_downgrade("UNIMPLEMENTED (reference PS)")
+                return None
+            raise
+        if result is not None:
+            # the server just proved it speaks the fused protocol family
+            self._fused_ok = True
+        return result
 
     # ------------------------------------------------------------------ fused
     def push_pull(self, worker_id: int, iteration: int, tensors,

@@ -195,6 +195,13 @@ CHANNEL_OPTIONS = [
 ]
 
 
+def status_code(exc: grpc.RpcError):
+    """Status code of an RpcError, or None for errors that carry none
+    (e.g. fault-injection stubs raising bare grpc.RpcError)."""
+    code = getattr(exc, "code", None)
+    return code() if callable(code) else None
+
+
 def make_server(max_workers: int = 8) -> grpc.Server:
     return grpc.server(
         concurrent.futures.ThreadPoolExecutor(

@@ -375,6 +375,19 @@ class Worker:
                 # transport of later chunks (rpc/data_plane.py on_chunk)
                 local.update(from_wire(tensors))
 
+            # Version-aware pull (delta/, ISSUE 10): advertise the held
+            # version and let the PS answer O(changed bytes).  The
+            # client returns None whenever the plain protocol must run
+            # (disabled, reference PS, permanent downgrade).
+            delta_fn = getattr(self._ps, "delta_pull", None)
+            if delta_fn is not None:
+                result = delta_fn(
+                    m.PullRequest(worker_id=self.config.worker_id,
+                                  iteration=iteration,
+                                  wire_dtype=self._pull_wire_dtype()),
+                    timeout=30.0)
+                if result is not None and result.store is not None:
+                    return result.update, result.store
             resp = self._ps.pull_parameters(
                 m.PullRequest(worker_id=self.config.worker_id,
                               iteration=iteration,
@@ -383,8 +396,12 @@ class Worker:
             return resp, local
 
         resp, store = self.query_with_retry(attempt)
-        self._note_pull_tensors(resp.parameters)
-        return resp.iteration, store
+        if resp is not None:
+            # a delta-served round carries no wire tensors (resp is None)
+            # and leaves the proven packed negotiation untouched
+            self._note_pull_tensors(resp.parameters)
+            iteration = resp.iteration if resp.iteration else iteration
+        return iteration, store
 
     def _note_pull_tensors(self, parameters) -> None:
         """Feed one pull response's tensor metadata into the packed-wire
@@ -613,6 +630,25 @@ class Worker:
         tensors_fn, residual_box = self._wire_tensors(grads)
 
         def attempt():
+            # Version-aware fused round first (delta/, ISSUE 10): one
+            # PushPullDeltaStream round whose response is O(changed
+            # bytes) against the client's cached pull.  None = run the
+            # plain fused round (disabled, downgraded, shm-preferred);
+            # a mid-round downgrade also returns None and the plain
+            # replay below is exact (PS-side per-(worker,tensor) dedup).
+            delta_fn = getattr(self._ps, "delta_push_pull", None)
+            if delta_fn is not None:
+                result = delta_fn(
+                    self.config.worker_id, iteration, tensors_fn,
+                    pull_wire_dtype=self._pull_wire_dtype(),
+                    timeout=self.config.fused_timeout_s)
+                if result is not None:
+                    push = (result.push if result.push is not None
+                            else m.PushResponse(success=False,
+                                                message="empty fused "
+                                                        "response"))
+                    return push, result.update, result.store
+
             # fresh store per attempt, same rationale as _pull_parameters
             local: TensorStore = {}
 
@@ -624,7 +660,7 @@ class Worker:
                 pull_wire_dtype=self._pull_wire_dtype(),
                 timeout=self.config.fused_timeout_s,
                 on_chunk=convert_chunk)
-            return push, params, local
+            return push, params, (local if params is not None else None)
 
         t0 = time.perf_counter()
         flight.record("fused.start", iteration=iteration,
@@ -650,9 +686,12 @@ class Worker:
                      self.config.worker_id)
         if residual_box is not None and push.success:
             residual_box.commit()
-        if params is None:
+        if store is None:
             return push, None
-        self._note_pull_tensors(params.parameters)
+        if params is not None:
+            # a delta-served round carries no wire tensors (params is
+            # None); the proven packed negotiation stands
+            self._note_pull_tensors(params.parameters)
         return push, store
 
     # ---------------------------------------------------------- batch stream

@@ -394,6 +394,34 @@ def test_tier_extension_stays_out_of_the_wire_manifest():
     assert not set(tmsg.TIER_COORD_METHODS) & set(m.COORDINATOR_METHODS)
 
 
+def test_delta_extension_stays_out_of_the_wire_manifest():
+    """ISSUE 10 compat gate: the versioned-delta / weight-publication
+    extension (delta/messages.py) must leave the reference wire manifest
+    byte-unchanged — its messages and the SubscribeWeights /
+    PullParametersDelta / PushPullDeltaStream methods must never appear
+    in the pinned contract, and the committed golden must still match
+    the live schemas bit for bit."""
+    import json
+
+    from parameter_server_distributed_tpu.analysis import wirecheck
+    from parameter_server_distributed_tpu.delta import messages as dmsg
+
+    with open(wirecheck.default_manifest_path()) as fh:
+        golden = json.loads(fh.read())
+    assert wirecheck.diff_manifests(golden, wirecheck.build_manifest()) == []
+    blob = json.dumps(golden)
+    for name in ("DeltaFrame", "DeltaEntry", "DeltaPullRequest",
+                 "DeltaPushChunk", "SubscribeRequest", "SubscribeWeights",
+                 "PullParametersDelta", "PushPullDeltaStream"):
+        assert name not in blob, f"delta extension leaked: {name}"
+    # and the extension method table really is disjoint from the pinned
+    # parameter-server contract (unary AND stream tables)
+    from parameter_server_distributed_tpu.rpc import messages as m
+    assert not set(dmsg.DELTA_PS_METHODS) & (
+        set(m.PARAMETER_SERVER_METHODS)
+        | set(m.PARAMETER_SERVER_STREAM_METHODS))
+
+
 def test_cli_json_output_and_exit_codes(tmp_path, capsys):
     assert analyze_main.main(["--json"]) == 0
     doc = json.loads(capsys.readouterr().out)

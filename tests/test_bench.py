@@ -120,6 +120,30 @@ def test_bench_aggregate_contract():
     assert streaming["4"]["serves"] == 8
 
 
+def test_bench_delta_contract():
+    """delta mode: per-pull serve bytes through the version-delta chain
+    vs the full encode-once serve at varying version locality, for SGD
+    and momentum runs, plus the live publication latency — with the
+    ISSUE 10 acceptance bound visible in the JSON: delta bytes <= 30%
+    of the full serve at locality 1 for BOTH optimizers."""
+    result = run_bench("delta", extra_env={
+        "PSDT_BENCH_PARAMS": "2e5",
+        "PSDT_BENCH_STEPS": "4",
+        "PSDT_BENCH_DELTA_LOCALITY": "1,2",
+    })
+    assert result["metric"] == "ps_delta_serve_ratio_l1"
+    assert 0 < result["value"] <= 0.30
+    for opt in ("sgd", "momentum"):
+        rows = result[opt]
+        assert rows["1"]["delta_vs_full_ratio"] <= 0.30, (opt, rows)
+        assert rows["1"]["full_fallbacks"] == 0, (opt, rows)
+        assert rows["1"]["delta_pulls"] == 4, (opt, rows)
+        # a longer hop still beats (or matches) re-shipping the model
+        assert rows["2"]["delta_vs_full_ratio"] < 1.0, (opt, rows)
+    assert result["publish_samples"] >= 3
+    assert result["publish_p50_ms"] > 0
+
+
 @pytest.mark.slow
 def test_bench_replicate_contract():
     """replicate mode: barrier-close overhead off/async/sync replication,

@@ -100,7 +100,12 @@ def test_manager_retention_keeps_newest(tmp_path):
     for epoch in range(5):
         mgr.save(epoch=epoch)
     remaining = sorted(os.listdir(tmp_path))
-    assert remaining == [checkpoint_filename(3), checkpoint_filename(4)]
+    # each kept checkpoint rides with its version meta sidecar (delta
+    # serving monotonicity, ISSUE 10); retired epochs lose both files
+    assert remaining == [checkpoint_filename(3),
+                         checkpoint_filename(3) + ".meta.json",
+                         checkpoint_filename(4),
+                         checkpoint_filename(4) + ".meta.json"]
     assert mgr.latest().endswith(checkpoint_filename(4))
 
 

@@ -192,6 +192,11 @@ def test_bf16_worker_falls_back_against_f32_only_ps(tmp_path):
     ps.service.PushGradientsStream = unimplemented_stream
     ps.service.ServeParametersStream = unimplemented_stream
     ps.service.PushPullStream = unimplemented_stream  # no fused plane either
+    # nor the versioned-delta extension (delta/, ISSUE 10): a bf16 delta
+    # pull would mask the f32-only unary response the downgrade keys on
+    ps.service.PullParametersDelta = unimplemented_stream
+    ps.service.PushPullDeltaStream = unimplemented_stream
+    ps.service.SubscribeWeights = unimplemented_stream
     ps_port = ps.start()
     coordinator = Coordinator(CoordinatorConfig(
         bind_address="127.0.0.1", port=0,

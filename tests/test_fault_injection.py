@@ -143,10 +143,14 @@ def test_transient_rpc_failures_recovered_by_retry(tmp_path):
         assert ps.core.current_iteration == 1
         # the injection actually hit the pull and fused push→barrier→pull
         # paths (the worker's data plane rides the streaming RPCs —
-        # rpc/data_plane.py; the post-bootstrap pull is a plain stream
-        # pull, the step's communication is one fused round)
-        assert fail_counts["ServeParametersStream"] == 2
-        assert fail_counts["PushPullStream"] == 2
+        # rpc/data_plane.py; the post-bootstrap pull is one pull round —
+        # the version-aware delta pull when PSDT_DELTA_DEPTH > 0, the
+        # plain stream pull otherwise — and the step's communication is
+        # one fused round)
+        pull_faults = (fail_counts.get("ServeParametersStream", 0)
+                       + fail_counts.get("PullParametersDelta", 0))
+        assert pull_faults == 2, fail_counts
+        assert fail_counts["PushPullStream"] == 2, fail_counts
     finally:
         if w is not None:
             w.shutdown()
@@ -315,6 +319,12 @@ def test_packed_wire_renegotiated_after_ps_replacement(tmp_path):
         ps2.service.PushGradientsStream = unimplemented_stream
         ps2.service.ServeParametersStream = unimplemented_stream
         ps2.service.PushPullStream = unimplemented_stream
+        # nor the versioned-delta extension (delta/, ISSUE 10) — without
+        # these stubs the delta data plane would serve right past the
+        # recording/unimplemented reference stubs above
+        ps2.service.PullParametersDelta = unimplemented_stream
+        ps2.service.PushPullDeltaStream = unimplemented_stream
+        ps2.service.SubscribeWeights = unimplemented_stream
         # a reference PS has no shm negotiation either: without this stub
         # the same-host rings would carry the fused rounds right past the
         # recording/unimplemented gRPC stubs above

@@ -496,7 +496,8 @@ def _data_plane_counters():
     return {method: snap.get(f"rpc.client.{method}.calls", 0)
             for method in ("PushPullStream", "PushGradientsStream",
                            "ReceiveGradients", "ServeParameters",
-                           "ServeParametersStream", "CheckSyncStatus")}
+                           "ServeParametersStream", "CheckSyncStatus",
+                           "PullParametersDelta", "PushPullDeltaStream")}
 
 
 def test_fused_step_is_single_rpc_round(tmp_path):
@@ -533,7 +534,10 @@ def test_fused_step_is_single_rpc_round(tmp_path):
         delta = {k: after[k] - before[k] for k in after}
         assert delta["PushPullStream"] == 0, delta
         pushes = delta["PushGradientsStream"] + delta["ReceiveGradients"]
-        pulls = delta["ServeParameters"] + delta["ServeParametersStream"]
+        # the version-aware delta pull (delta/, ISSUE 10) is still one
+        # pull round — count it with the plain pull methods
+        pulls = (delta["ServeParameters"] + delta["ServeParametersStream"]
+                 + delta["PullParametersDelta"])
         assert pushes == 2 and pulls == 2, delta
         assert delta["CheckSyncStatus"] >= 1, delta  # >=3 rounds somewhere
     finally:

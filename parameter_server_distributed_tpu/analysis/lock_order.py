@@ -53,6 +53,15 @@ LOCK_RANKS: dict[str, int] = {
     # stripe lock), and the shared rank makes holding two stripes at once
     # a checked violation by construction — no nested-stripe deadlocks.
     "ParameterServerCore._stripe_lock": 44,
+    # accelerator-resident sharded apply (async_sgd/device_optimizer.py
+    # ShardedDeviceOptimizer, ISSUE 11): guards the per-stripe device
+    # partition table + staged slot buffers.  The stripe partitions
+    # themselves follow the rank-44 stripe discipline (disjoint name
+    # subsets, one touch per apply — no per-partition locks needed); this
+    # single lock serializes layout builds/spills and the checkpoint
+    # slot readback.  Acquired by stripe-pool apply tasks (no lock held)
+    # and by state_dict under the core lock chain 20/30/40, hence 45.
+    "ShardedDeviceOptimizer._lock": 45,
     # primary-side replicator (replication/replicator.py): _lock is the
     # wake condition variable's lock (pending flag only, leaf); _ship_lock
     # serializes one state ship to the backup end to end — the replication
@@ -138,6 +147,10 @@ BLOCKING_ALLOWED: frozenset[str] = frozenset({
     # single-flight tier-topology refresh: the provider under it may be a
     # coordinator RPC (core/ps_core.py _contribution_for, ISSUE 9)
     "ParameterServerCore._tier_lock",
+    # serializes device-partition layout builds (jit compiles) and the
+    # checkpoint slot D2H readback — device dispatch under it is the
+    # lock's purpose (ShardedDeviceOptimizer, ISSUE 11)
+    "ShardedDeviceOptimizer._lock",
     # serializes one replication ship (encode + PushReplicaDelta RPC +
     # ack) to the backup — the RPC under it is the point of the lock
     "Replicator._ship_lock",

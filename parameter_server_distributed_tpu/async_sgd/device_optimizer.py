@@ -42,6 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..analysis.lock_order import checked_lock
+from ..core import device_apply
 from ..core.optimizer import HostOptimizer
 
 
@@ -292,3 +294,338 @@ class PallasOptimizer(HostOptimizer):
         self.step = int(np.asarray(step)[0]) if step is not None else 0
         self._slots = {k: jnp.asarray(np.asarray(v, np.float32))
                        for k, v in state.items()}
+
+
+# --------------------------------------------------------------------------
+# ISSUE 11: the accelerator-resident SHARDED apply family.  Unlike the
+# whole-store optax/pallas programs above, these are name-sliceable
+# (supports_striping = True): slot state is keyed per tensor name exactly
+# like the host optimizers', so the striped barrier close runs
+# apply_shard concurrently over disjoint name subsets, each tensor's
+# update executing as a short chain of jit-compiled FUSED device stages
+# (core/device_apply.py).  Each stage obeys the no-product-into-add rule
+# that makes it bit-identical to the numpy oracle while sweeping memory
+# once instead of once per ufunc — see that module's docstring for the
+# XLA:CPU FMA-contraction story.  Retired slot buffers and intermediates
+# are DONATED through the stage chain; parameters and gradients never
+# are — ps_core keeps serving previously-returned param dicts (and the
+# PR-10 delta sink reads old stores), so old param buffers must stay
+# valid.
+# --------------------------------------------------------------------------
+
+
+class ShardedDeviceOptimizer(HostOptimizer):
+    """Device-resident, stripe-sliceable PS optimizer (ISSUE 11).
+
+    Update rules mirror core/optimizer.py's numpy sequences rounding for
+    rounding (same f32 scalars, same operation order), so a device apply
+    is bit-identical to the host apply at f32 — the oracle tests pin it.
+    State layout matches the host optimizers' ``state_dict`` exactly
+    (``velocity`` / ``m``+``v``+``step`` / ``m``), so checkpoints
+    round-trip between host and device optimizers through the existing
+    .ckpt sidecar layout bit-identically, across restore stripe counts
+    (per-name slots make the state stripe-count independent by
+    construction).
+
+    Thread-safety matches the host optimizers: ``apply_shard`` over
+    disjoint name subsets is safe by construction (each tensor touches
+    only its own slot entries; per-key dict writes are GIL-atomic), the
+    caller serializes logical steps, and ``_lock`` only fences the
+    checkpoint snapshot/restore paths, whose D2H slot readback may block
+    under it (analysis/lock_order.py: rank 45, BLOCKING_ALLOWED)."""
+
+    supports_striping = True
+    device_resident = True
+
+    RULES = ("sgd", "momentum", "adam", "adamw", "lion")
+    _RULE_SLOTS = {"sgd": (), "momentum": ("velocity",),
+                   "adam": ("m", "v"), "adamw": ("m", "v"), "lion": ("m",)}
+
+    def __init__(self, rule: str, learning_rate: float,
+                 momentum: float = 0.9, weight_decay: float = 1e-4,
+                 b1: float | None = None, b2: float | None = None,
+                 eps: float = 1e-8):
+        if rule not in self.RULES:
+            raise ValueError(
+                f"unknown sharded device rule {rule!r}; options {self.RULES}")
+        super().__init__(learning_rate)
+        self.rule = rule
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.b1 = 0.9 if b1 is None else b1
+        self.b2 = ((0.99 if rule == "lion" else 0.999) if b2 is None
+                   else b2)
+        self.eps = eps
+        self.step = 0
+        # slot: name -> device f32 array, per slot kind — the same
+        # per-name keying as the host optimizers (stripe-sliceable)
+        self._slots: dict[str, dict] = {
+            s: {} for s in self._RULE_SLOTS[rule]}
+        # retained per-tensor scratch for short-lived update
+        # intermediates (kind -> name -> device array): recycled through
+        # kernel donation every close (core/device_apply.py "scratch
+        # recycling"), the device analogue of the host optimizers'
+        # thread-local scratch.  NOT optimizer state — never
+        # checkpointed; holds garbage values between closes by design.
+        # Space cost: up to 3 extra store-sized buffers for adam/adamw,
+        # 3 for lion, 0 for sgd/momentum — the same space-for-page-fault
+        # trade the host scratch makes.
+        self._scr: dict[str, dict] = {}
+        self._bc_step = -1
+        self._bc1 = np.float32(1.0)
+        self._bc2 = np.float32(1.0)
+        # fences checkpoint snapshot/restore of the slot tables; the D2H
+        # slot readback runs under it (rank 45, BLOCKING_ALLOWED —
+        # analysis/lock_order.py).  The apply path does NOT take it:
+        # stripe applies are disjoint by name and serialized against
+        # state_dict by the core's _apply_lock, like the host optimizers.
+        self._lock = checked_lock("ShardedDeviceOptimizer._lock")
+
+    # ------------------------------------------------------------- steps
+    def tick(self) -> None:
+        if self.rule in ("adam", "adamw"):
+            self.step += 1
+
+    def _bias_corrections(self) -> tuple[np.float32, np.float32]:
+        if self._bc_step != self.step:
+            # python-float powers then ONE f32 round — exactly the numpy
+            # path's cast-on-use of `1.0 - b1 ** step`.  Benign if two
+            # stripes race here: both write identical values.
+            self._bc1 = np.float32(1.0 - self.b1 ** self.step)
+            self._bc2 = np.float32(1.0 - self.b2 ** self.step)
+            self._bc_step = self.step
+        return self._bc1, self._bc2
+
+    # ------------------------------------------------------------- apply
+    def apply_shard(self, params, grads) -> dict:
+        """One shard's update as BATCHED per-stripe device programs: the
+        shard's tensors run through each update stage as ONE jit
+        dispatch over the tensor list (lists are pytrees, so programs
+        are shape-bucketed by the shard's shape-signature — a fixed set
+        per stripe config), with per-tensor arithmetic identical to the
+        host optimizers' ufunc sequences."""
+        out: dict = {}
+        todo: list[str] = []
+        for name, p in params.items():
+            if name not in grads:
+                # pass-through, like the host optimizers' np.asarray —
+                # a device-resident value stays device-resident
+                out[name] = (p if device_apply.is_device_array(p)
+                             else np.asarray(p, np.float32))
+            else:
+                todo.append(name)
+        if todo:
+            # deterministic order => one program signature per shard
+            todo.sort()
+            ps = [device_apply.owned_f32(params[n]) for n in todo]
+            gs = [device_apply.owned_f32(grads[n]) for n in todo]
+            # validate slot shapes BEFORE any stage runs: the batched
+            # kernels DONATE slot buffers, so a shape mismatch surfacing
+            # at trace time after a donation would leave self._slots
+            # holding deleted arrays (every later step bricked) — and a
+            # broadcast-compatible mismatch would not surface at all.
+            # Raising here mirrors the host optimizers: error out with
+            # the slot tables untouched and the apply retryable.
+            for name, p, g in zip(todo, ps, gs):
+                if p.shape != g.shape:
+                    raise ValueError(
+                        f"param/gradient shape mismatch for {name!r}: "
+                        f"{p.shape} vs {g.shape}")
+            for slot, table in self._slots.items():
+                for name, g in zip(todo, gs):
+                    s = table.get(name)
+                    if s is not None and s.shape != g.shape:
+                        raise ValueError(
+                            f"slot {slot!r} shape mismatch for {name!r}: "
+                            f"{s.shape} vs gradient {g.shape}")
+            for name, newp in zip(todo, self._apply_batch(todo, ps, gs)):
+                out[name] = newp
+        return out
+
+    def _scratch_list(self, kind: str, names, gs) -> list:
+        """The retained scratch buffers for (kind, each name) — a
+        one-time zeros seed on first touch / shape change (elastic
+        reshard).  Callers stash the stage outputs back via
+        :meth:`_stash` so the buffers recycle through donation."""
+        table = self._scr.setdefault(kind, {})
+        out = []
+        for name, g in zip(names, gs):
+            s = table.get(name)
+            if s is None or s.shape != g.shape:
+                s = _zeros_f32(g.shape)
+            out.append(s)
+        return out
+
+    def _stash(self, kind: str, names, arrs) -> None:
+        table = self._scr[kind]
+        for name, arr in zip(names, arrs):
+            table[name] = arr
+
+    def _apply_batch(self, names: list[str], ps: list, gs: list) -> list:
+        k = device_apply.k
+        false = np.bool_(False)  # runtime pred: XLA cannot fold the select
+        lr = np.float32(self.learning_rate)
+        if self.rule == "sgd":
+            # us = g*lr are the close's fresh buffers; b_psub donates
+            # them and their buffers leave as the new params
+            return k("b_psub")(ps, k("b_mul")(gs, lr))
+        if self.rule == "momentum":
+            return self._momentum_batch(names, ps, gs, lr)
+        if self.rule == "lion":
+            return self._lion_batch(names, ps, gs, lr, false)
+        return self._adam_batch(names, ps, gs, lr, false)
+
+    def _momentum_batch(self, names, ps, gs, lr) -> list:
+        k = device_apply.k
+        slots = self._slots["velocity"]
+        out: list = [None] * len(names)
+        seed = [i for i, n in enumerate(names) if n not in slots]
+        upd = [i for i, n in enumerate(names) if n in slots]
+        if seed:
+            # first touch: v = g (a bit-copy, the numpy `np.array(g)`
+            # seed — a FRESH buffer, because the slot is donated on the
+            # next step), step = v * lr (not donated: v2 is the slot)
+            v2s = [device_apply.owned_copy(gs[i]) for i in seed]
+            news = k("b_psub")([ps[i] for i in seed],
+                               k("b_mul")(v2s, lr))
+            for j, i in enumerate(seed):
+                slots[names[i]] = v2s[j]
+                out[i] = news[j]
+        if upd:
+            # v2 = mu*v + g and step = v2*lr in two fused stages; the
+            # old slot buffers are donated into the products
+            ts = k("b_mul_d0")([slots[names[i]] for i in upd],
+                               np.float32(self.momentum))
+            v2s, steps = k("b_mom_pair")(ts, [gs[i] for i in upd], lr)
+            news = k("b_psub")([ps[i] for i in upd], steps)
+            for j, i in enumerate(upd):
+                slots[names[i]] = v2s[j]
+                out[i] = news[j]
+        return out
+
+    def _lion_batch(self, names, ps, gs, lr, false) -> list:
+        k = device_apply.k
+        b1 = np.float32(self.b1)
+        b2 = np.float32(self.b2)
+        one = np.float32(1.0)
+        slots = self._slots["m"]
+        ms = [slots.get(n) for n in names]
+        ms = [m if m is not None else _zeros_f32(g.shape)
+              for m, g in zip(ms, gs)]
+        t1s, t2s, t3s, t4s = k("b_lion_mul4")(
+            ms, gs, b1, one - b1, b2, one - b2,
+            self._scratch_list("t2", names, gs),
+            self._scratch_list("t4", names, gs), false)
+        self._stash("t2", names, t2s)
+        self._stash("t4", names, t4s)
+        us = k("b_sign_add")(t1s, t2s)
+        for name, m2 in zip(names, k("b_add_d0")(t3s, t4s)):
+            slots[name] = m2
+        # decoupled decay on matrices only (the host mask): split the
+        # shard into the decayed and plain lanes, each one batch
+        wd = np.float32(self.weight_decay)
+        dec = [i for i, p in enumerate(ps)
+               if self.weight_decay and getattr(p, "ndim", 0) >= 2]
+        plain = [i for i in range(len(ps)) if i not in dec]
+        if dec:
+            dnames = [names[i] for i in dec]
+            dgs = [gs[i] for i in dec]
+            ts = k("b_wd_mul")([ps[i] for i in dec], wd,
+                               self._scratch_list("wd", dnames, dgs),
+                               false)
+            self._stash("wd", dnames, ts)
+            for j, u in zip(dec, k("b_addmul")([us[i] for i in dec],
+                                               ts, lr)):
+                us[j] = u
+        if plain:
+            for j, u in zip(plain,
+                            k("b_mul_d0")([us[i] for i in plain], lr)):
+                us[j] = u
+        return k("b_psub")(ps, us)
+
+    def _adam_batch(self, names, ps, gs, lr, false) -> list:
+        k = device_apply.k
+        b1 = np.float32(self.b1)
+        b2 = np.float32(self.b2)
+        one = np.float32(1.0)
+        ms_t, vs_t = self._slots["m"], self._slots["v"]
+        ms = [ms_t.get(n) for n in names]
+        ms = [m if m is not None else _zeros_f32(g.shape)
+              for m, g in zip(ms, gs)]
+        vs = [vs_t.get(n) for n in names]
+        vs = [v if v is not None else _zeros_f32(g.shape)
+              for v, g in zip(vs, gs)]
+        t1s, t2s, t3s, t4s = k("b_adam_mul4")(
+            ms, vs, gs, b1, one - b1, b2, one - b2,
+            self._scratch_list("t2", names, gs),
+            self._scratch_list("t4", names, gs), false)
+        self._stash("t2", names, t2s)
+        self._stash("t4", names, t4s)
+        m2s, v2s = k("b_add2")(t1s, t2s, t3s, t4s)
+        for name, m2, v2 in zip(names, m2s, v2s):
+            ms_t[name], vs_t[name] = m2, v2
+        bc1, bc2 = self._bias_corrections()
+        eps = np.float32(self.eps)
+        if self.rule == "adam":
+            # single-sweep tail (see b_adam_fin1): no den/mh
+            # materialization, the output is the fresh params buffer
+            return k("b_adam_fin1")(ps, m2s, v2s, bc1, bc2, eps, lr)
+        # adamw: decoupled decay from the PRE-update param, matrices
+        # only (the host mask), lr LAST
+        dens, mhs = k("b_adamw_den_mh")(
+            v2s, bc2, eps, m2s, bc1,
+            self._scratch_list("den", names, gs), false)
+        self._stash("den", names, dens)
+        us: list = [None] * len(names)
+        dec = [i for i, p in enumerate(ps)
+               if self.weight_decay and getattr(p, "ndim", 0) >= 2]
+        plain = [i for i in range(len(ps)) if i not in dec]
+        if dec:
+            dnames = [names[i] for i in dec]
+            dgs = [gs[i] for i in dec]
+            ts = k("b_wd_mul")([ps[i] for i in dec],
+                               np.float32(self.weight_decay),
+                               self._scratch_list("wd", dnames, dgs),
+                               false)
+            self._stash("wd", dnames, ts)
+            for j, u in zip(dec, k("b_adamw_fin_wd")(
+                    [mhs[i] for i in dec], [dens[i] for i in dec],
+                    ts, lr)):
+                us[j] = u
+        if plain:
+            for j, u in zip(plain, k("b_adamw_fin")(
+                    [mhs[i] for i in plain],
+                    [dens[i] for i in plain], lr)):
+                us[j] = u
+        return k("b_psub")(ps, us)
+
+    # ------------------------------------------------------- checkpoint
+    def state_dict(self) -> dict:
+        with self._lock:
+            out: dict = {
+                slot: {name: np.array(np.asarray(arr))
+                       for name, arr in table.items()}
+                for slot, table in self._slots.items()}
+        if self.rule in ("adam", "adamw"):
+            out["step"] = self.step
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        import jax.numpy as jnp
+
+        state = dict(state or {})
+        with self._lock:
+            for slot in self._RULE_SLOTS[self.rule]:
+                self._slots[slot] = {
+                    name: jnp.asarray(
+                        np.ascontiguousarray(arr, np.float32))
+                    for name, arr in (state.get(slot) or {}).items()}
+        if self.rule in ("adam", "adamw"):
+            self.step = int(state.get("step", 0))
+            self._bc_step = -1
+
+
+def _zeros_f32(shape):
+    import jax.numpy as jnp
+
+    return jnp.zeros(shape, jnp.float32)

@@ -35,6 +35,14 @@ _U64 = struct.Struct("<Q")
 
 
 def dumps(epoch: int, iteration: int, params: Mapping[str, np.ndarray]) -> bytes:
+    # Device-resident stores (PSDT_DEVICE_APPLY, ISSUE 11): start every
+    # tensor's D2H copy before the serial np.asarray sweep below, so the
+    # transfers overlap instead of serializing one tensor at a time.
+    # The on-disk bytes are identical either way — np.asarray of a jax
+    # f32 Array yields the same f32 host bytes the numpy store holds.
+    from ..core.device_apply import readback_async
+
+    readback_async(params)
     out = bytearray()
     out += _I32.pack(int(epoch))
     out += _I32.pack(int(iteration))

@@ -26,14 +26,20 @@ subsets.  ``apply_shard`` over disjoint names is thread-safe by
 construction: each tensor touches only its own slot entries (per-key dict
 writes are GIL-atomic) and the scratch buffer is thread-local.
 ``apply()`` (tick + one whole-store shard) remains the serial entry
-point, bit-for-bit unchanged.  Optimizers whose apply is NOT
-name-sliceable (the device-resident jit programs,
-async_sgd/device_optimizer.py) leave ``supports_striping`` False and the
-PS falls back to the serial whole-store apply.
+point, bit-for-bit unchanged.  The whole-store device-resident jit
+programs (DeviceOptimizer/PallasOptimizer,
+async_sgd/device_optimizer.py) are NOT name-sliceable and leave
+``supports_striping`` False — the PS falls back to the serial
+whole-store apply for them; the sharded device family
+(ShardedDeviceOptimizer, ISSUE 11) IS name-sliceable and takes the
+striped close like the host optimizers, with each stripe's update
+running as jit-compiled device programs over that stripe's
+device-resident partition.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Mapping
 
@@ -42,6 +48,8 @@ import numpy as np
 from ..native import (adam_native, adamw_native, lib as native_lib,
                       momentum_native, sgd_native)
 from .tensor import TensorStore
+
+log = logging.getLogger("pst.optimizer")
 
 _scratch_tls = threading.local()
 
@@ -406,15 +414,81 @@ class Lion(HostOptimizer):
                   for k, v in state.get("m", {}).items()}
 
 
+def _host_optimizer_for_rule(rule: str, learning_rate: float,
+                             momentum: float,
+                             weight_decay: float) -> HostOptimizer | None:
+    """The host optimizer matching a device-family update rule — the
+    downgrade target when accelerator selection fails (``adamw_bf16``
+    maps to plain AdamW: the bf16 slots were an HBM optimization, not a
+    different rule).  None for a rule no host optimizer implements."""
+    if rule == "sgd":
+        return SGD(learning_rate)
+    if rule == "momentum":
+        return Momentum(learning_rate, momentum)
+    if rule == "adam":
+        return Adam(learning_rate)
+    if rule in ("adamw", "adamw_bf16"):
+        return AdamW(learning_rate, weight_decay)
+    if rule == "lion":
+        return Lion(learning_rate, weight_decay=weight_decay)
+    return None
+
+
+def _make_accelerator_optimizer(kind: str, rule: str, learning_rate: float,
+                                momentum: float,
+                                weight_decay: float) -> HostOptimizer | None:
+    """Construct a ``device_*`` / ``pallas_*`` / ``sharded_*`` optimizer;
+    None for a rule the family does not implement (the caller raises the
+    unknown-optimizer error — a config typo must not silently train with
+    a different rule)."""
+    from ..async_sgd.device_optimizer import (DeviceOptimizer,
+                                              PallasOptimizer,
+                                              ShardedDeviceOptimizer)
+    if kind == "sharded":
+        if rule not in ShardedDeviceOptimizer.RULES:
+            return None
+        return ShardedDeviceOptimizer(rule, learning_rate,
+                                      momentum=momentum,
+                                      weight_decay=weight_decay)
+    if kind == "pallas":
+        if rule not in PallasOptimizer.RULES:
+            return None  # unknown-rule typo must RAISE, not degrade
+        return PallasOptimizer(rule, learning_rate, momentum)
+    if rule == "sgd":
+        return DeviceOptimizer.sgd(learning_rate)
+    if rule == "momentum":
+        return DeviceOptimizer.momentum(learning_rate, momentum)
+    if rule == "adamw":
+        return DeviceOptimizer.adamw(learning_rate, weight_decay)
+    if rule == "adamw_bf16":
+        # bf16 moment slots: half the optimizer-state HBM
+        return DeviceOptimizer.adamw_bf16(learning_rate, weight_decay)
+    if rule == "adam":
+        return DeviceOptimizer.adam(learning_rate)
+    return None
+
+
 def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9,
                    weight_decay: float = 1e-4) -> HostOptimizer:
     """PS optimizer by name.  Plain names (`sgd|momentum|adam|adamw|lion`)
     are the host-side numpy/native-C++ optimizers above; `device_*`
-    selects the accelerator-resident optax path and `pallas_*` the fused
-    pallas-kernel path (async_sgd/device_optimizer.py) — both work on the
-    synchronous barrier path too (the apply stays whole-store serial
-    there: device programs are not name-sliceable, see
-    ``supports_striping``)."""
+    selects the accelerator-resident optax path, `pallas_*` the fused
+    pallas-kernel path, and `sharded_*` the stripe-sliceable
+    device-resident family (async_sgd/device_optimizer.py
+    ShardedDeviceOptimizer — ``supports_striping=True``, so the striped
+    barrier close runs it stripe-parallel; ISSUE 11).  With
+    ``PSDT_DEVICE_APPLY=1`` a ``device_<rule>`` name the sharded family
+    implements resolves to it, so existing configs pick up the
+    accelerator-resident apply without renaming (flag off: exactly the
+    pre-existing optax family, whole-store serial).
+
+    Accelerator selection failures — no jax backend, no device, an
+    import error — degrade to the MATCHING host optimizer (same rule,
+    same hyperparameters, ``adamw_bf16`` → AdamW) with a logged
+    ``ps.apply.device_fallback`` counter instead of raising at PS boot:
+    a mis-provisioned host must come up training, just slower.  An
+    unknown RULE still raises — a typo must never silently train with a
+    different update rule."""
     name = name.lower()
     if name == "sgd":
         return SGD(learning_rate)
@@ -426,20 +500,42 @@ def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9,
         return AdamW(learning_rate, weight_decay)
     if name == "lion":
         return Lion(learning_rate, weight_decay=weight_decay)
-    if name.startswith("device_") or name.startswith("pallas_"):
-        kind, _, rule = name.partition("_")
-        from ..async_sgd.device_optimizer import DeviceOptimizer, PallasOptimizer
-        if kind == "pallas":
-            return PallasOptimizer(rule, learning_rate, momentum)
-        if rule == "sgd":
-            return DeviceOptimizer.sgd(learning_rate)
-        if rule == "momentum":
-            return DeviceOptimizer.momentum(learning_rate, momentum)
-        if rule == "adamw":
-            return DeviceOptimizer.adamw(learning_rate, weight_decay)
-        if rule == "adamw_bf16":
-            # bf16 moment slots: half the optimizer-state HBM
-            return DeviceOptimizer.adamw_bf16(learning_rate, weight_decay)
-        if rule == "adam":
-            return DeviceOptimizer.adam(learning_rate)
+    kind, _, rule = name.partition("_")
+    if rule and kind in ("device", "pallas", "sharded"):
+        from . import device_apply
+
+        reason = None
+        if not device_apply.available():
+            reason = "no jax backend/device"
+        else:
+            try:
+                # inside the try: on a host without jax/optax this
+                # import itself raises, and that is a selection failure
+                # to degrade from, not a boot error
+                if kind == "device" and device_apply.enabled():
+                    from ..async_sgd.device_optimizer import (
+                        ShardedDeviceOptimizer)
+                    if rule in ShardedDeviceOptimizer.RULES:
+                        kind = "sharded"
+                opt = _make_accelerator_optimizer(kind, rule, learning_rate,
+                                                  momentum, weight_decay)
+                if opt is not None:
+                    return opt
+            except Exception as exc:  # noqa: BLE001 — any construction
+                # failure (backend init, pallas/optax import) means
+                # "degrade", not "refuse to boot the parameter server"
+                reason = f"{type(exc).__name__}: {exc}"
+        if reason is not None:
+            host = _host_optimizer_for_rule(rule, learning_rate, momentum,
+                                            weight_decay)
+            if host is not None:
+                from ..obs import flight
+                from ..obs import stats as obs_stats
+
+                obs_stats.counter("ps.apply.device_fallback").add()
+                flight.record("apply.device.fallback", note=reason[:48])
+                log.warning(
+                    "optimizer %r unavailable (%s); degrading to host %s",
+                    name, reason, type(host).__name__)
+                return host
     raise ValueError(f"unknown optimizer {name!r}")

@@ -65,6 +65,20 @@ optimizer apply stripe-parallel (``HostOptimizer.tick`` once +
 ``apply_shard`` per stripe) on the shared named executor.
 ``PSDT_STRIPES=1`` bypasses every striped branch — the exact serial
 code path, timing included.
+
+Accelerator-resident apply (``PSDT_DEVICE_APPLY=1``; ISSUE 11): with a
+device-resident sharded optimizer selected
+(async_sgd/device_optimizer.ShardedDeviceOptimizer), fold chunks land
+as DEVICE buffers — quantized payloads dequantize on device
+(rpc/data_plane.decode_gradients → core/device_apply) — the
+accumulator holds device sums (:func:`_fold_one` is type-driven), the
+contributor-mean scale and the striped optimizer apply run as
+jit-compiled device programs, and the fresh store's D2H readback
+starts asynchronously right after the swap so a serve-side encode
+never stalls on the transfer (:meth:`ParameterServerCore.
+_note_device_apply`).  Flag off (the default): every path above is
+byte-identical to the pre-existing host-numpy behavior, wire bytes
+included.
 """
 
 from __future__ import annotations
@@ -81,6 +95,7 @@ from ..analysis.lock_order import checked_lock
 from ..obs import flight
 from ..obs import stats as obs_stats
 from ..replication.messages import STALE_SHARD_MAP
+from . import device_apply
 from .optimizer import HostOptimizer, SGD
 from .stripes import partition_names, run_striped, stripe_count, stripe_of
 from .tensor import TensorStore, store_nbytes, tree_like
@@ -237,6 +252,49 @@ class PushSink:
         return self._core._commit_push(self.worker_id, self.iteration)
 
 
+def _fold_one(accum: "TensorStore", counts: dict[str, int], name: str, g,
+              weight: int) -> int:
+    """Fold one tensor into the running accumulator — type-driven
+    (ISSUE 11): numpy gradients keep the exact pre-existing
+    np.array/np.add sequence (byte-identical with the device path off);
+    device-decoded gradients (rpc/data_plane.decode_gradients) seed an
+    owned device array and accumulate via the correctly-rounded device
+    add, so a leaf aggregator's member folds run as device reductions
+    and the sharded device apply consumes the sums with no host
+    round-trip.  Returns bytes newly resident (the seeding copy), 0 for
+    an accumulate.  Raises (mutating nothing, the name unmarked) on a
+    shape mismatch — the fold-retry contract on both paths (the device
+    add's shape check happens at trace time, before its donation)."""
+    acc = accum.get(name)
+    if acc is None:
+        if device_apply.is_device_array(g):
+            # FORCED-OWNED copy, not an adoption (the numpy branch's
+            # np.array seed, on device): decoded wire buffers can be
+            # zero-copy views of host memory, and donating such a
+            # buffer makes every later fold_add fall back to a fresh
+            # allocation INSIDE the barrier close — the copy here runs
+            # at ingress time, overlapped with the arriving stream
+            acc = device_apply.owned_copy(g)
+        else:
+            # owned f32 copy in ONE pass (convert-and-copy fused;
+            # asarray-then-astype would sweep twice for non-f32 decodes)
+            # — the exact pre-existing path for numpy AND for duck-typed
+            # array-likes that only implement __array__
+            acc = np.array(g, dtype=np.float32)
+        accum[name] = acc
+        counts[name] = weight
+        return int(acc.nbytes)
+    if isinstance(acc, np.ndarray):
+        # a mixed stream (legacy repeated-float chunks decode host-side
+        # even when packed chunks land on device) converges to the
+        # accumulator's residence
+        np.add(acc, np.asarray(g, np.float32), out=acc)
+    else:
+        accum[name] = device_apply.fold_add(acc, g)
+    counts[name] += weight
+    return 0
+
+
 def _store_ready(store: "TensorStore") -> bool:
     """True iff every array is materialized.  numpy arrays always are;
     jax Arrays expose non-blocking ``is_ready()`` (False while the async
@@ -302,6 +360,11 @@ class ParameterServerCore:
         # last stripe-parallel optimizer apply
         self._obs_stripe_ms = obs_stats.histogram("ps.apply.stripe_ms")
         self._obs_parallelism = obs_stats.gauge("ps.apply.parallelism")
+        # accelerator-resident applies (ISSUE 11): count of barrier
+        # closes whose fresh store is device-resident (the pst-status
+        # "device apply" rollup line reads this next to the
+        # ps.apply.device_fallback selection-downgrade counter)
+        self._obs_device_applies = obs_stats.counter("ps.apply.device")
         # Barrier-completion broadcast over _state_lock: the fused data
         # plane (PushPullStream) parks here and is woken the instant an
         # aggregation fires, instead of being polled at 20 Hz like the
@@ -426,6 +489,39 @@ class ParameterServerCore:
     @property
     def _streaming(self) -> bool:
         return self._aggregation == "streaming"
+
+    @property
+    def device_fold(self) -> bool:
+        """True when push chunks should decode to DEVICE buffers
+        (rpc/data_plane.decode_gradients, ISSUE 11): the accelerator-
+        resident apply is enabled (``PSDT_DEVICE_APPLY``) and this core
+        either applies on device (the sharded device optimizer family)
+        or is a leaf aggregator whose member folds should run as device
+        reductions (the PR-9 in-process intra-host tier).  Streaming
+        sync mode only — the buffered escape hatch and async mode stage
+        and apply host-side, unchanged."""
+        if not (self._streaming and self.synchronous
+                and device_apply.enabled()):
+            return False
+        return ((device_apply.wants_device_fold(self._optimizer)
+                 or self._barrier_relay is not None)
+                and device_apply.available())
+
+    def _note_device_apply(self, store: TensorStore, t0: float) -> None:
+        """Post-swap bookkeeping of a device-resident apply: start the
+        async D2H readback of every fresh device value — so a serve-side
+        encode (behind the encode-once cache) finds the host bytes
+        already in flight instead of stalling on the transfer — and
+        record the apply.device flight code + counter.  No-op for
+        host-numpy stores, so every pre-existing path is untouched."""
+        if not device_apply.is_device_store(store):
+            return
+        device_apply.readback_async(store)
+        flight.record("apply.readback", a=len(store))
+        self._obs_device_applies.add()
+        flight.record("apply.device",
+                      a=int(1e6 * (time.perf_counter() - t0)),
+                      b=self._stripes)
 
     @property
     def current_iteration(self) -> int:
@@ -790,21 +886,11 @@ class ParameterServerCore:
             for name, g in gradients.items():
                 if name in folded:
                     continue
-                acc = state.accum.get(name)
-                if acc is None:
-                    # owned f32 copy in ONE pass (convert-and-copy
-                    # fused; asarray-then-astype would sweep twice
-                    # for non-f32 wire decodes)
-                    acc = np.array(g, dtype=np.float32)
-                    state.accum[name] = acc
-                    state.counts[name] = weight
-                    added += acc.nbytes
-                else:
-                    # raises (mutating nothing) on a shape mismatch —
-                    # only THEN is the name marked folded, so a retry
-                    # of a failed fold is not silently dropped
-                    np.add(acc, np.asarray(g, np.float32), out=acc)
-                    state.counts[name] += weight
+                # _fold_one raises (mutating nothing) on a shape
+                # mismatch — only THEN is the name marked folded, so a
+                # retry of a failed fold is not silently dropped
+                added += _fold_one(state.accum, state.counts, name, g,
+                                   weight)
                 folded.add(name)
         finally:
             if added:
@@ -831,24 +917,28 @@ class ParameterServerCore:
         def fold_group(idx: int, stripe: int, items: list) -> None:
             with self._stripe_locks[stripe]:
                 for name, g in items:
-                    acc = state.accum.get(name)
-                    if acc is None:
-                        acc = np.array(g, dtype=np.float32)
-                        state.accum[name] = acc
-                        state.counts[name] = 1
-                        added_by[idx] += acc.nbytes
-                    else:
-                        # raises (mutating nothing) on a shape mismatch —
-                        # the name stays unpublished, so a retry of the
-                        # failed fold is not silently dropped
-                        np.add(acc, np.asarray(g, np.float32), out=acc)
-                        state.counts[name] += 1
+                    # _fold_one raises (mutating nothing) on a shape
+                    # mismatch — the name stays unpublished, so a retry
+                    # of the failed fold is not silently dropped
+                    added_by[idx] += _fold_one(state.accum, state.counts,
+                                               name, g, 1)
                     done_by[idx].append(name)
 
         try:
-            run_striped([
+            thunks = [
                 (lambda i=i, s=stripe, it=items: fold_group(i, s, it))
-                for i, (stripe, items) in enumerate(work)])
+                for i, (stripe, items) in enumerate(work)]
+            todo_view = dict(todo)
+            if (device_apply.is_device_store(todo_view)
+                    and not device_apply.stripe_dispatch(todo_view)):
+                # large device tensors: dispatch the folds from THIS
+                # thread — the adds data-parallelize inside the XLA
+                # runtime, and executor fan-out only contends with the
+                # intra-op pool (same policy as the device apply/scale)
+                for thunk in thunks:
+                    thunk()
+            else:
+                run_striped(thunks)
         finally:
             with self._state_lock:
                 state.inflight -= 1
@@ -1174,6 +1264,27 @@ class ParameterServerCore:
                             # barrier retryable, relay retry idempotent
                             # upstream via the PS's per-(worker, tensor)
                             # dedup and member cover.
+                            if device_apply.is_device_store(sums):
+                                # leaf with device member folds (PR-9
+                                # intra-host tier): start every D2H,
+                                # then materialize HOST sums for the
+                                # relay — the EF residual math and the
+                                # native quantize kernels are numpy, and
+                                # the device adds that built these sums
+                                # are correctly rounded, so the bytes
+                                # match a numpy-folded leaf exactly.
+                                # (A relay raise puts back the HOST
+                                # sums; later member folds re-seed the
+                                # device residence on the next fold.
+                                # np.array, not np.asarray: asarray of
+                                # a jax CPU array is a READ-ONLY view,
+                                # and a put-back accumulator must stay
+                                # foldable in place for replayed member
+                                # pushes.)
+                                device_apply.readback_async(sums)
+                                sums = {name: np.array(
+                                            np.asarray(v), np.float32)
+                                        for name, v in sums.items()}
                             fresh = self._barrier_relay(iteration, sums,
                                                         counts)
                             with self._params_lock:
@@ -1316,14 +1427,30 @@ class ParameterServerCore:
         """In-place sums -> means, fanned per stripe across the shared
         executor (the per-tensor op is unchanged, so the result is
         bit-for-bit the serial loop's).  Caller holds _apply_lock."""
-        if self._stripes <= 1 or len(sums) <= 1:
-            for name, acc in sums.items():
+        def scale_one(name: str) -> None:
+            acc = sums[name]
+            if isinstance(acc, np.ndarray):
                 acc *= np.float32(1.0 / counts[name])
+            else:
+                # device accumulator (jax arrays are immutable): the
+                # scaled array rebinds; scale_mean donates the sum
+                # buffer and uses the SAME f32 scalar as the numpy path
+                sums[name] = device_apply.scale_mean(acc, counts[name])
+
+        if (self._stripes <= 1 or len(sums) <= 1
+                or (device_apply.is_device_store(sums)
+                    and not device_apply.stripe_dispatch(sums))):
+            # large device sums scale from ONE dispatcher for the same
+            # reason the device apply does (see _apply_update): big
+            # kernels parallelize inside XLA, and stripe-thread
+            # dispatch only contends
+            for name in sums:
+                scale_one(name)
             return
 
         def scale_group(names: list[str]) -> None:
             for name in names:
-                sums[name] *= np.float32(1.0 / counts[name])
+                scale_one(name)
 
         run_striped([(lambda ns=ns: scale_group(ns))
                      for ns in partition_names(sums, self._stripes)])
@@ -1379,8 +1506,11 @@ class ParameterServerCore:
             self._params = new_params
             self._params_version += 1
             version = self._params_version
-        # delta build after the swap, outside _params_lock (the caller's
-        # _apply_lock/_state_lock still serializes applies)
+        # readback first, then the delta build, both after the swap and
+        # outside _params_lock (the caller's _apply_lock/_state_lock
+        # still serializes applies) — the sink's encode then overlaps
+        # the D2H copies already in flight
+        self._note_device_apply(new_params, t0)
         self._notify_delta(new_params, version)
 
     def _apply_update(self, mean_grads: TensorStore) -> None:
@@ -1391,6 +1521,7 @@ class ParameterServerCore:
         OUTSIDE it, so concurrent serves keep reading the materialized
         snapshot instead of queueing behind device compute; the striped
         sync apply likewise computes outside it and swaps."""
+        t0 = time.perf_counter()
         with self._params_lock:
             if not self._params:
                 # bootstrap quirk preserved from the reference (cpp:78-81)
@@ -1402,6 +1533,7 @@ class ParameterServerCore:
                 prev = self._params
                 boot = False
         if boot:
+            self._note_device_apply(store, t0)
             self._notify_delta(store, version)
             return
         if not self.synchronous:
@@ -1418,9 +1550,21 @@ class ParameterServerCore:
                 self._serving_version = self._params_version
                 self._params = new_params  # new apply is in flight
                 self._params_version += 1
+            self._note_device_apply(new_params, t0)
         elif (self._stripes > 1
               and getattr(self._optimizer, "supports_striping", False)
+              and (not device_apply.wants_device_fold(self._optimizer)
+                   or device_apply.stripe_dispatch(mean_grads))
               and len(mean_grads) > 1):
+            # Host optimizers always fan the apply across stripe
+            # threads (real multi-core numpy sweeps).  A device-resident
+            # optimizer fans out only while tensors are SMALL
+            # (dispatch-bound regime); past device_apply's mean-size
+            # bound its kernels data-parallelize inside the XLA runtime
+            # and a second dispatcher only contends with the intra-op
+            # pool, so the close dispatches from one thread (the serial
+            # branch below — stripes still partition fold ingress and
+            # the store either way).
             self._apply_striped_sync(prev, mean_grads)
         else:
             # serial / device-optimizer sync apply: under _params_lock,
@@ -1430,8 +1574,9 @@ class ParameterServerCore:
                                                      mean_grads)
                 self._params_version += 1
                 store, version = self._params, self._params_version
-            # delta build outside _params_lock, still inside the
-            # caller's serialized apply section
+            # readback + delta build outside _params_lock, still inside
+            # the caller's serialized apply section
+            self._note_device_apply(store, t0)
             if _store_ready(store):
                 self._notify_delta(store, version)
 

@@ -75,6 +75,16 @@ def _ps_rollup(snap: dict) -> dict:
             delta[key] = value
     if delta:
         out["delta"] = delta
+    # accelerator-resident apply (core/device_apply.py, ISSUE 11):
+    # device-resident barrier closes next to the selection downgrades
+    device: dict = {}
+    for key, name in (("applies", "ps.apply.device"),
+                      ("fallbacks", "ps.apply.device_fallback")):
+        value = counters.get(name, 0)
+        if value:
+            device[key] = value
+    if device:
+        out["device_apply"] = device
     close = _hist_stats(snap, "ps.barrier_close_s")
     if close:
         out["barrier_close"] = close
@@ -321,6 +331,12 @@ def render_rollup(rollup: dict) -> str:
                 parts.append(
                     f"delta serve {dserve.get('hits', 0)}/{total} hits "
                     f"({_fmt_bytes(dserve.get('bytes', 0))} delta)")
+            dapply = ps.get("device_apply")
+            if dapply:
+                note = f"device apply {dapply.get('applies', 0)} closes"
+                if dapply.get("fallbacks"):
+                    note += f" ({dapply['fallbacks']} fallbacks)"
+                parts.append(note)
             close = ps.get("barrier_close")
             if close:
                 parts.append(f"barrier close p50={_fmt_s(close['p50'])}")

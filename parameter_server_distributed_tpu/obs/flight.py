@@ -144,6 +144,13 @@ EVENTS: dict[str, int] = {
     "publish.subscribe": 94,     # a = held version, b = subscriber id
     "publish.swap": 95,          # a = new version, b = duration_us
     "publish.lag": 96,           # a = versions behind the training run
+    # accelerator-resident sharded apply (core/device_apply.py, ISSUE 11)
+    "apply.device": 100,          # device-resident barrier apply swapped
+                                  # in; a = duration_us, b = stripes
+    "apply.device.fallback": 101,  # device optimizer selection degraded
+                                   # to the host family; note = reason
+    "apply.readback": 102,        # async D2H readback of the fresh store
+                                  # started; a = tensors
 }
 EVENT_NAMES = {code: name for name, code in EVENTS.items()}
 

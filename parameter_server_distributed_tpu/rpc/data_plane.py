@@ -89,6 +89,26 @@ def bucket_bytes() -> int:
     return stream_chunk_bytes()
 
 
+def decode_gradients(tensors: Iterable[m.Tensor],
+                     device: bool = False) -> dict:
+    """Decode one push chunk's wire Tensors into fold-ready arrays.
+
+    ``device=False`` (the default, and the only behavior before
+    ISSUE 11): host numpy via ``Tensor.to_array`` — byte-identical to
+    the pre-existing fold input.  ``device=True`` (the serving core
+    asked for device folds — ``ParameterServerCore.device_fold``): each
+    packed payload lands as a jax device buffer with the dequantize
+    running ON DEVICE (core/device_apply.tensor_to_device — int8 wire
+    bytes cross the host boundary at a quarter of the f32 volume, bf16
+    at half), so the accumulator sums and the sharded optimizer apply
+    never round-trip through host numpy."""
+    if device:
+        from ..core import device_apply
+
+        return {t.name: device_apply.tensor_to_device(t) for t in tensors}
+    return {t.name: t.to_array() for t in tensors}
+
+
 def _tensor_nbytes(t: m.Tensor) -> int:
     if t.packed:
         return len(t.packed)

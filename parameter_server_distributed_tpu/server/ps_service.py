@@ -52,7 +52,7 @@ from ..replication.replicator import (ReplicaSink, Replicator,
                                       flatten_optimizer_state, state_chunks)
 from ..rpc import messages as m
 from ..rpc import shm_transport
-from ..rpc.data_plane import (PreEncodedParameterUpdate,
+from ..rpc.data_plane import (PreEncodedParameterUpdate, decode_gradients,
                               encode_parameter_record_groups, split_tensors,
                               stream_chunk_bytes)
 from ..rpc.service import bind_service, make_server
@@ -423,11 +423,15 @@ class ParameterServerService:
     # end-of-stream commit).
     def PushGradientsStream(self, request_iterator, context) -> m.PushResponse:
         sink: PushSink | None = None
+        device = False
         for chunk in request_iterator:
             if sink is None:
                 sink = self.core.begin_push(chunk.worker_id, chunk.iteration)
+                # read once per stream: device folds (ISSUE 11) decode
+                # each chunk straight to device buffers
+                device = self.core.device_fold
             if chunk.gradients:
-                sink.fold({t.name: t.to_array() for t in chunk.gradients})
+                sink.fold(decode_gradients(chunk.gradients, device))
         if sink is None:
             return m.PushResponse(success=False, message="empty push stream")
         return self._push_result_response(self._commit(sink))
@@ -500,6 +504,7 @@ class ParameterServerService:
                        and not self.core.has_retired)
         sink: PushSink | None = None
         pull_wire_dtype = 0
+        device = False
         for chunk in request_iterator:
             if empty_store and chunk.gradients:
                 yield m.PushPullResponse(push=m.PushResponse(
@@ -511,8 +516,9 @@ class ParameterServerService:
             if sink is None:
                 sink = self.core.begin_push(chunk.worker_id, chunk.iteration)
                 pull_wire_dtype = chunk.pull_wire_dtype
+                device = self.core.device_fold  # see PushGradientsStream
             if chunk.gradients:
-                sink.fold({t.name: t.to_array() for t in chunk.gradients})
+                sink.fold(decode_gradients(chunk.gradients, device))
         if sink is None:
             yield m.PushPullResponse(push=m.PushResponse(
                 success=False, message="empty push stream"))
@@ -661,6 +667,7 @@ class ParameterServerService:
         sink: PushSink | None = None
         pull_wire_dtype = 0
         held_version = 0
+        device = False
         for dchunk in request_iterator:
             chunk = dchunk.update
             if chunk is None:
@@ -678,8 +685,9 @@ class ParameterServerService:
                                             chunk.iteration)
                 pull_wire_dtype = chunk.pull_wire_dtype
                 held_version = int(dchunk.held_version)
+                device = self.core.device_fold  # see PushGradientsStream
             if chunk.gradients:
-                sink.fold({t.name: t.to_array() for t in chunk.gradients})
+                sink.fold(decode_gradients(chunk.gradients, device))
         if sink is None:
             yield dmsg.DeltaFrame(push=m.PushResponse(
                 success=False, message="empty push stream"))

@@ -185,12 +185,17 @@ def test_bench_obs_contract():
 @pytest.mark.slow
 def test_bench_apply_contract():
     """apply mode: striped barrier-close profile, serial vs striped side
-    by side with the stripe counts visible in the JSON."""
+    by side with the stripe counts visible in the JSON, plus the
+    ISSUE 11 device-vs-numpy sweep rows (tiny store here — the real
+    32/128/512 MB rows run at default shapes)."""
     result = run_bench("apply", extra_env={
         "PSDT_BENCH_PARAMS": "4e5",
         "PSDT_BENCH_STRIPE_COUNTS": "1,2",
         "PSDT_BENCH_WORKER_COUNTS": "2",
         "PSDT_BENCH_STEPS": "2",
+        "PSDT_BENCH_DEVICE_MB": "2",
+        "PSDT_BENCH_DEVICE_OPTS": "sgd",
+        "PSDT_BENCH_DEVICE_STRIPES": "1,2",
     })
     assert result["metric"] == "ps_apply_close_ms_2stripes_2w"
     assert result["value"] > 0
@@ -198,6 +203,19 @@ def test_bench_apply_contract():
     assert result["by_stripes"]["1"]["2"]["barrier_close_ms"] > 0
     # the striped cell reports its achieved apply parallelism
     assert result["by_stripes"]["2"]["2"].get("apply_parallelism", 0) > 0
+    # device-vs-numpy rows: every (size, opt, stripes) cell carries both
+    # arms' close p50 and the ratio; the best-of-stripes summary keys
+    # follow the "<mb>mb_<opt>" convention
+    sweep = result["device_vs_numpy"]
+    rows = sweep["rows"]
+    assert len(rows) == 2  # 1 size x 1 opt x 2 stripe counts
+    for row in rows:
+        assert row["store_mb"] == 2 and row["opt"] == "sgd"
+        assert row["numpy_close_ms"] > 0
+        assert row["device_close_ms"] > 0
+        assert row["device_vs_numpy"] > 0
+    assert "2mb_sgd" in sweep["best_ratio"]
+    assert "cpu-jax" in sweep["backend"]
 
 
 @pytest.mark.slow

@@ -918,6 +918,149 @@ def bench_aggregate() -> dict:
                      f"{streaming[n_max]['serves']} serves")}
 
 
+def bench_elastic() -> dict:
+    """Elastic quorum barriers (elastic/, ISSUE 13): per-iteration wall
+    p50 of a HEALTHY worker, all-of-N vs K-of-N quorum, with one
+    netsim-delayed straggler behind a ThrottledRelay — the number the
+    quorum exists to move: all-of-N pays the straggler's full delay on
+    every barrier, K-of-N pays only the grace window.
+
+    Knobs: PSDT_BENCH_PARAMS (store size, default 2e5),
+    PSDT_BENCH_STEPS (iterations, default 6), PSDT_BENCH_WORKERS
+    (default 4), PSDT_BENCH_STRAGGLER_MS (one-way x2 injected delay,
+    default 300), PSDT_BENCH_QUORUM (default 0.75),
+    PSDT_BENCH_GRACE_MS (default 100)."""
+    import threading
+
+    import numpy as np
+
+    from parameter_server_distributed_tpu.config import ParameterServerConfig
+    from parameter_server_distributed_tpu.core.tensor import to_wire
+    from parameter_server_distributed_tpu.obs import stats as obs_stats
+    from parameter_server_distributed_tpu.rpc import messages as m
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+    from parameter_server_distributed_tpu.utils.netsim import ThrottledRelay
+
+    workers_n = int(os.environ.get("PSDT_BENCH_WORKERS", "0")) or 4
+    n_params = int(float(os.environ.get("PSDT_BENCH_PARAMS", "2e5")))
+    iters = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 6
+    delay_ms = float(os.environ.get("PSDT_BENCH_STRAGGLER_MS", "300"))
+    quorum = float(os.environ.get("PSDT_BENCH_QUORUM", "0.75"))
+    grace_ms = float(os.environ.get("PSDT_BENCH_GRACE_MS", "100"))
+    # the straggler's delay is injected at the TCP layer: the same-host
+    # shm rings would negotiate past the relay after round 1 and erase it
+    os.environ["PSDT_SHM"] = "0"
+    # arms are configured EXPLICITLY per profile(): an exported
+    # PSDT_QUORUM (the verify-skill drive shell) would silently turn the
+    # all-of-N baseline arm into a second quorum arm
+    os.environ.pop("PSDT_QUORUM", None)
+    os.environ.pop("PSDT_STALENESS_BETA", None)
+
+    rng = np.random.default_rng(0)
+    shape = (max(1, n_params // 4),)
+    params = {f"w{i}": rng.standard_normal(shape).astype(np.float32)
+              for i in range(4)}
+    grads = {name: rng.standard_normal(v.shape).astype(np.float32)
+             for name, v in params.items()}
+
+    def profile(arm_quorum: float) -> dict:
+        ps = ParameterServer(ParameterServerConfig(
+            bind_address="127.0.0.1", port=0, total_workers=workers_n,
+            autosave_period_s=3600.0, checkpoint_dir="/tmp",
+            quorum=arm_quorum, quorum_grace_ms=grace_ms))
+        port = ps.start()
+        ps.core.initialize_parameters(params)
+        relay = ThrottledRelay(port, delay_ms=delay_ms / 2.0)
+        relay_port = relay.start()
+        # the LAST worker rides the netsim relay — the straggler
+        clients = {wid: PSClient(
+            f"127.0.0.1:{relay_port if wid == workers_n - 1 else port}")
+            for wid in range(workers_n)}
+        walls: list[float] = []
+        errors: list = []
+        before = obs_stats.REGISTRY.snapshot()["counters"]
+
+        def loop(wid: int) -> None:
+            try:
+                client = clients[wid]
+                for it in range(1, iters + 1):
+                    t0 = time.perf_counter()
+                    push, update = client.push_pull(
+                        wid, it,
+                        lambda: iter(to_wire(grads, m.WIRE_RAW_F32)),
+                        pull_wire_dtype=m.WIRE_RAW_F32, timeout=120.0)
+                    assert push.success, push.message
+                    if update is None:
+                        # server barrier timeout — poll until released
+                        # (should not happen; counted as a stall)
+                        while not ps.core.check_sync_status(it)[1]:
+                            time.sleep(0.02)
+                    if wid == 0:
+                        walls.append(time.perf_counter() - t0)
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append((wid, repr(exc)))
+
+        threads = [threading.Thread(target=loop, args=(wid,),
+                                    name=f"bench-elastic-w{wid}",
+                                    daemon=True)
+                   for wid in range(workers_n)]
+        t_run = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        hung = [t.name for t in threads if t.is_alive()]
+        run_wall = time.perf_counter() - t_run
+        after = obs_stats.REGISTRY.snapshot()["counters"]
+        for c in clients.values():
+            c.close()
+        relay.stop()
+        ps.stop()
+        if errors:
+            raise RuntimeError(f"bench_elastic arm failed: {errors}")
+        if hung or len(walls) < iters:
+            # a wedged arm must fail LOUDLY, not report a p50 over
+            # partial samples (or IndexError on an empty list)
+            raise RuntimeError(
+                f"bench_elastic arm incomplete: {len(walls)}/{iters} "
+                f"measured iterations, hung threads {hung}")
+        walls.sort()
+        return {
+            "iter_wall_p50_ms": round(1e3 * walls[len(walls) // 2], 2),
+            "iter_wall_max_ms": round(1e3 * walls[-1], 2),
+            "run_wall_s": round(run_wall, 3),
+            "quorum_closes": (after.get("ps.barrier.quorum_closes", 0)
+                              - before.get("ps.barrier.quorum_closes", 0)),
+            "stale_folds": (after.get("ps.stale.folds", 0)
+                            - before.get("ps.stale.folds", 0)),
+        }
+
+    log(f"bench_elastic: {workers_n} workers ({n_params / 1e3:.0f}k params), "
+        f"straggler +{delay_ms:g}ms via netsim, quorum {quorum:g} "
+        f"grace {grace_ms:g}ms, {iters} iterations")
+    all_of_n = profile(0.0)
+    k_of_n = profile(quorum)
+    log(f"bench_elastic: all-of-N p50 {all_of_n['iter_wall_p50_ms']}ms vs "
+        f"K-of-N {k_of_n['iter_wall_p50_ms']}ms "
+        f"({k_of_n['quorum_closes']} quorum closes, "
+        f"{k_of_n['stale_folds']} stale folds)")
+    p50 = k_of_n["iter_wall_p50_ms"]
+    base = all_of_n["iter_wall_p50_ms"]
+    return {"metric": "ps_elastic_iter_wall_p50_ms_quorum",
+            "value": p50, "unit": "ms",
+            "vs_baseline": round(base / p50, 3) if p50 else 0.0,
+            "all_of_n": all_of_n, "quorum": k_of_n,
+            "workers": workers_n, "straggler_delay_ms": delay_ms,
+            "quorum_fraction": quorum, "grace_ms": grace_ms,
+            "note": (f"healthy-worker iteration wall p50 {p50}ms under "
+                     f"quorum {quorum:g} vs {base}ms all-of-N with a "
+                     f"+{delay_ms:g}ms netsim straggler; "
+                     f"{k_of_n['quorum_closes']} quorum closes, "
+                     f"{k_of_n['stale_folds']} stale folds")}
+
+
 def bench_delta() -> dict:
     """Versioned delta serving (delta/, ISSUE 10): per-pull serve bytes
     through the delta chain vs the full encode-once serve, at varying
@@ -2442,6 +2585,8 @@ def child_main(mode: str) -> int:
             result = bench_apply()
         elif mode == "delta":
             result = bench_delta()
+        elif mode == "elastic":
+            result = bench_elastic()
         elif mode == "replicate":
             result = bench_replicate()
         elif mode == "obs":
@@ -2556,7 +2701,7 @@ def main() -> int:
     # directly rather than risking a flaky TPU init.
     plans: list[tuple[str, float]]
     if mode in ("pushpull", "dataplane", "aggregate", "apply", "codec",
-                "replicate", "obs", "tier"):
+                "replicate", "obs", "tier", "elastic"):
         plans = [("cpu", cpu_timeout)]
     else:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]

@@ -72,6 +72,12 @@ LOCK_RANKS: dict[str, int] = {
     "Replicator._ship_lock": 48,
     # leaves: never held while acquiring anything else
     "ParameterServerCore._live_lock": 50,
+    # membership-backed barrier-width provider (elastic/membership.py,
+    # ISSUE 13): single-flights the UpdateMembership poll and guards the
+    # last-seen membership epoch.  barrier_width() calls the provider
+    # while holding _live_lock (50), hence 51; the RPC under it is the
+    # lock's purpose (BLOCKING_ALLOWED).
+    "MembershipWidthProvider._lock": 51,
     # tier contribution-weight cache (core/ps_core.py, ISSUE 9): held
     # across the topology provider call — single-flight refresh per TTL
     # expiry, exactly the _live_lock pattern, and the provider may be a
@@ -147,6 +153,9 @@ BLOCKING_ALLOWED: frozenset[str] = frozenset({
     # single-flight tier-topology refresh: the provider under it may be a
     # coordinator RPC (core/ps_core.py _contribution_for, ISSUE 9)
     "ParameterServerCore._tier_lock",
+    # single-flight membership poll: the UpdateMembership RPC under it
+    # is the point of the lock (elastic/membership.py, ISSUE 13)
+    "MembershipWidthProvider._lock",
     # serializes device-partition layout builds (jit compiles) and the
     # checkpoint slot D2H readback — device dispatch under it is the
     # lock's purpose (ShardedDeviceOptimizer, ISSUE 11)

@@ -1,0 +1,82 @@
+"""Staleness-aware learning-rate damping — the shared policy (ISSUE 13).
+
+A stale gradient was computed against parameters the store has since
+moved past; applying it at full strength drags the trajectory backward
+(the classic async-SGD divergence mode).  The standard fix is geometric
+damping: a contribution ``s`` iterations stale applies at
+``lr * beta ** s`` (beta in (0, 1]).  Implemented here as a gradient
+pre-scale — scaling the gradient by ``beta ** s`` before the optimizer
+sees it is exactly a per-contribution learning-rate damp for every
+linear-in-lr optimizer step, without threading per-contribution scales
+through the optimizer protocol.
+
+Two consumers, one policy:
+
+- **K-of-N quorum barriers** (``PSDT_QUORUM``, core/ps_core.py): a
+  straggler push landing after the seal folds into the NEXT iteration's
+  accumulator damped by its staleness (always on there — quorum is
+  opt-in, and an undamped stale fold would weight old gradients equal
+  to fresh ones).
+- **Bounded-staleness async mode** (``staleness_bound > 0``): an
+  accepted stale push applies damped.  OFF unless
+  ``PSDT_STALENESS_BETA`` is explicitly set, so pre-existing async runs
+  stay byte-identical.
+
+``PSDT_STALENESS_BETA`` overrides the beta for both (default 0.5).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping
+
+import numpy as np
+
+ENV_BETA = "PSDT_STALENESS_BETA"
+DEFAULT_BETA = 0.5
+
+
+class StalenessDamping:
+    """``scale(s) = beta ** s`` with the shared env override."""
+
+    def __init__(self, beta: float | None = None):
+        raw = os.environ.get(ENV_BETA, "")
+        if beta is not None:
+            self.beta = float(beta)
+        elif raw:
+            self.beta = float(raw)
+        else:
+            self.beta = DEFAULT_BETA
+        if not 0.0 < self.beta <= 1.0:
+            raise ValueError(f"staleness damping beta must be in (0, 1], "
+                             f"got {self.beta}")
+
+    def scale(self, staleness: int) -> float:
+        """The multiplier for a contribution ``staleness`` iterations
+        old.  Fresh (staleness <= 0) contributions pass through at 1."""
+        if staleness <= 0:
+            return 1.0
+        return float(self.beta ** int(staleness))
+
+    def damp(self, gradients: Mapping[str, np.ndarray],
+             staleness: int) -> dict[str, np.ndarray]:
+        """A damped f32 copy of ``gradients`` (never mutates the input —
+        a retried push replays the same payload).  The f32 scalar
+        multiply matches the fold path's arithmetic exactly, so a
+        staleness-0 damp is bit-identical to no damp."""
+        s = self.scale(staleness)
+        if s == 1.0:
+            return {name: np.asarray(g, np.float32)
+                    for name, g in gradients.items()}
+        f = np.float32(s)
+        return {name: np.asarray(g, np.float32) * f
+                for name, g in gradients.items()}
+
+
+def async_damping() -> StalenessDamping | None:
+    """The bounded-staleness async-mode instance: armed ONLY by an
+    explicit ``PSDT_STALENESS_BETA`` (pre-existing async runs must stay
+    byte-identical without it)."""
+    if not os.environ.get(ENV_BETA, ""):
+        return None
+    return StalenessDamping()

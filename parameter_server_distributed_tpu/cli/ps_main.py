@@ -32,6 +32,15 @@ Extension flags beyond the reference:
                     promotion from backup to primary (otherwise the
                     promoted primary runs un-backed-up — surfaced as the
                     ps.replica.unarmed gauge in pst-status --metrics)
+    --quorum=F      K-of-N barrier close (elastic/, docs/training.md
+                    "Elastic membership & quorum barriers"): seal once
+                    ceil(F * live width) contributors committed and the
+                    grace window elapsed; stragglers fold forward
+                    lr-damped.  Also the PSDT_QUORUM env; default off
+                    (all-of-N, byte-identical)
+    --quorum-grace-ms=N
+                    grace window past the K-th commit (default 250;
+                    also PSDT_QUORUM_GRACE_MS)
 
 With --coordinator=ADDR and PSDT_TIERS=1 the PS also polls the
 coordinator's reduction topology (tiers/), so a leaf aggregator's ONE
@@ -67,6 +76,8 @@ def build_config(argv: list[str]) -> tuple[ParameterServerConfig, str | None]:
         backup_address=flags.get("backup", ""),
         replication=flags.get("replication", ""),
         standby_address=flags.get("standby", ""),
+        quorum=float(flags.get("quorum", 0.0)),
+        quorum_grace_ms=float(flags.get("quorum-grace-ms", -1.0)),
     )
     return config, flags.get("coordinator")
 
@@ -79,18 +90,13 @@ def main(argv: list[str] | None = None) -> int:
 
     live_fn = None
     if config.elastic and coordinator_addr:
-        from ..rpc import messages as m
-        from ..rpc.service import RpcClient
-        client = RpcClient(coordinator_addr, m.COORDINATOR_SERVICE,
-                           m.COORDINATOR_METHODS)
-
-        def live_fn() -> int:
-            try:
-                resp = client.call("ListWorkers", m.ListWorkersRequest(),
-                                   timeout=2.0)
-                return resp.total_workers
-            except Exception:  # noqa: BLE001 — registry unreachable: fall back
-                return 0
+        # Membership-backed width provider (elastic/, ISSUE 13): counts
+        # every non-GONE member and carries the membership epoch as its
+        # generation, so a drain/leave/reap narrows the barrier at the
+        # next width read.  Degrades internally to the classic
+        # ListWorkers count against a reference coordinator.
+        from ..elastic.membership import MembershipWidthProvider
+        live_fn = MembershipWidthProvider(coordinator_addr)
 
     # Tier contribution weights ride the coordinator connection whenever
     # one is configured: the ENABLE decision lives at the coordinator

@@ -31,7 +31,7 @@ import time
 import grpc
 
 from ..config import parse_argv
-from ..obs.export import render_rollup
+from ..obs.export import render_membership, render_rollup
 from ..obs.stats import TimeSeriesRing
 from ..rpc import messages as m
 from ..rpc.service import RpcClient
@@ -54,22 +54,35 @@ def rollup_to_snapshot(rollup: dict, t: float | None = None) -> dict:
             "counters": counters, "gauges": {}, "histograms": {}}
 
 
-def render_watch_line(rates: dict | None, workers: int) -> str:
-    """One ``--watch`` tick: per-worker step rate + cluster wire MB/s."""
+def render_watch_line(rates: dict | None, workers: int,
+                      rollup: dict | None = None) -> str:
+    """One ``--watch`` tick: per-worker step rate + cluster wire MB/s,
+    plus — when the coordinator serves the elastic membership rollup
+    (ISSUE 13) — a live/draining/stale-folded membership line."""
     if rates is None:
-        return f"watch: {workers} workers reporting (collecting baseline)"
-    counters = rates.get("counters", {})
-    steps = {name.split(".")[1]: rate for name, rate in counters.items()
-             if name.startswith("worker.") and name.endswith(".steps")}
-    sent = sum(rate for name, rate in counters.items()
-               if name.endswith(".bytes_sent"))
-    received = sum(rate for name, rate in counters.items()
-                   if name.endswith(".bytes_received"))
-    step_part = (" ".join(f"w{wid}={rate:.2f}/s"
-                          for wid, rate in sorted(steps.items()))
-                 or "no steps")
-    return (f"watch dt={rates['dt_s']:.1f}s steps: {step_part} | wire: "
-            f"{sent / 1e6:.2f} MB/s out, {received / 1e6:.2f} MB/s in")
+        line = f"watch: {workers} workers reporting (collecting baseline)"
+    else:
+        counters = rates.get("counters", {})
+        steps = {name.split(".")[1]: rate for name, rate in counters.items()
+                 if name.startswith("worker.") and name.endswith(".steps")}
+        sent = sum(rate for name, rate in counters.items()
+                   if name.endswith(".bytes_sent"))
+        received = sum(rate for name, rate in counters.items()
+                       if name.endswith(".bytes_received"))
+        step_part = (" ".join(f"w{wid}={rate:.2f}/s"
+                              for wid, rate in sorted(steps.items()))
+                     or "no steps")
+        line = (f"watch dt={rates['dt_s']:.1f}s steps: {step_part} | wire: "
+                f"{sent / 1e6:.2f} MB/s out, "
+                f"{received / 1e6:.2f} MB/s in")
+    membership = (rollup or {}).get("membership")
+    if membership:
+        stale_folds = sum(
+            w.get("ps", {}).get("stale_folds", 0)
+            for w in (rollup or {}).get("per_worker", {}).values())
+        extra = f"; {stale_folds} stale folds" if stale_folds else ""
+        line += f"\n  membership: {render_membership(membership)}{extra}"
+    return line
 
 
 def _watch_loop(coordinator_addr: str, interval_s: float,
@@ -113,7 +126,8 @@ def _watch_loop(coordinator_addr: str, interval_s: float,
                 last_counters = snap["counters"]
                 ring.push(snap)
             print(render_watch_line(ring.rates(),
-                                    len(rollup.get("per_worker", {}))),
+                                    len(rollup.get("per_worker", {})),
+                                    rollup=rollup),
                   flush=True)
     return 0
 

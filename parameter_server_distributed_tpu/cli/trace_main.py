@@ -1,7 +1,7 @@
 """``pst-trace``: cross-process iteration postmortems from flight rings.
 
     pst-trace <flight_dir> [--iteration=N] [--json] [--chrome=out.json]
-                           [--list]
+                           [--list] [--stalled=SECONDS]
 
 Run every cluster process with ``PSDT_FLIGHT_DIR=<dir>`` (the flight
 recorder, obs/flight.py — always on, crash-surviving), then point this
@@ -18,6 +18,11 @@ by ``kill -9``/SIGSEGV decode like any other:
   slices/instants, plus any PSDT_TRACE_FILE span dumps in the directory)
   for Perfetto.
 - ``--list``: just the process/iteration inventory.
+- ``--stalled=SECONDS``: audit every iteration for a stalled barrier
+  (never published, or the seal waited longer than SECONDS past the
+  last commit) — the elastic-quorum acceptance check (exit 1 when any
+  iteration stalled; see docs/training.md "Elastic membership & quorum
+  barriers").
 
 See docs/observability.md ("Flight recorder", "pst-trace postmortems").
 """
@@ -37,7 +42,7 @@ def main(argv: list[str] | None = None) -> int:
     # the cluster: this tool's own auto-enabled ring must not pollute
     # the directory it is about to analyze
     flight.suppress_for_tool()
-    require_flag_value(argv, "--chrome", "--iteration",
+    require_flag_value(argv, "--chrome", "--iteration", "--stalled",
                        hint="e.g. --chrome=merged.json")
     positional, flags = parse_argv(argv)
     if not positional:
@@ -53,6 +58,24 @@ def main(argv: list[str] | None = None) -> int:
         print(f"chrome trace written: {path}")
         if "json" not in flags and "list" not in flags and iteration is None:
             return 0
+
+    if "stalled" in flags:
+        stall_s = float(flags["stalled"])
+        rings = postmortem.load_rings(directory)
+        if not rings:
+            print(f"no flight rings under {directory}", file=sys.stderr)
+            return 1
+        stalled = postmortem.stalled_iterations(
+            postmortem.merge_events(rings), stall_s)
+        if "json" in flags:
+            print(json.dumps({"stall_s": stall_s, "stalled": stalled},
+                             default=float))
+        elif stalled:
+            for s in stalled:
+                print(f"STALLED iteration {s['iteration']}: {s['reason']}")
+        else:
+            print(f"zero stalled iterations (threshold {stall_s:g}s)")
+        return 1 if stalled else 0
 
     rep = postmortem.report(directory, iteration=iteration)
     if not rep["processes"]:

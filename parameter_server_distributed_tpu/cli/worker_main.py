@@ -45,6 +45,7 @@ Extension flags:
 from __future__ import annotations
 
 import logging
+import signal
 import sys
 
 from ..config import WorkerConfig, parse_argv
@@ -118,8 +119,35 @@ def main(argv: list[str] | None = None) -> int:
         except Exception as exc:  # noqa: BLE001
             logging.warning("checkpoint restore failed (continuing): %s", exc)
 
+    # Graceful preemption (elastic/, ISSUE 13): the FIRST SIGTERM
+    # latches a drain instead of killing the process mid-stream — the
+    # in-flight iteration completes, the loop below stops, and
+    # shutdown() deregisters so the barrier narrows at the next width
+    # refresh.  A SECOND SIGTERM escalates: a worker wedged
+    # mid-iteration (unreachable PS, barrier timeout) must still be
+    # killable without resorting to kill -9.  (Replaces — does not
+    # chain — any earlier handler: both exits run through the normal
+    # path/atexit, which stamps the flight ring clean.)
+    def _on_sigterm(_signum, _frame):
+        if worker.drain_requested:
+            logging.warning("worker %d: second SIGTERM — exiting now",
+                            config.worker_id)
+            raise SystemExit(143)
+        logging.warning("worker %d: SIGTERM — draining after the "
+                        "in-flight iteration", config.worker_id)
+        worker.request_drain()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded use)
+
     try:
         for i in range(config.iterations):
+            if worker.drain_requested:
+                print(f"Worker {config.worker_id} draining: deregistering "
+                      f"after iteration {worker.iteration}", flush=True)
+                break
             it = max(i, worker.iteration + 1)
             loss = worker.run_iteration(it)
             desc = "bootstrap: seeded PS init" if worker.last_bootstrap \

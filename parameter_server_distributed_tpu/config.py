@@ -91,6 +91,16 @@ class ParameterServerConfig:
     # can never be lost) | "off".
     backup_address: str = ""
     replication: str = ""
+    # K-of-N quorum barriers (elastic/quorum.py, ISSUE 13): close the
+    # synchronous barrier once ceil(quorum * live width) contributors
+    # committed AND quorum_grace_ms past the K-th commit elapsed;
+    # stragglers sealed out fold forward into the next iteration damped
+    # by PSDT_STALENESS_BETA^staleness.  0.0 = PSDT_QUORUM env, which
+    # defaults off (today's all-of-N, byte-identical); 1.0 == off too.
+    quorum: float = 0.0
+    # Grace window in ms past the K-th commit before a quorum close
+    # fires (-1 = PSDT_QUORUM_GRACE_MS env, default 250).
+    quorum_grace_ms: float = -1.0
     # Replication headroom (ISSUE 9 satellite): the address this PS
     # re-arms its Replicator toward AFTER it is promoted from backup to
     # primary — without it the promoted primary silently runs with no

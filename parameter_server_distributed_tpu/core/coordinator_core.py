@@ -23,6 +23,7 @@ import time
 from typing import Callable, Sequence
 
 from ..analysis.lock_order import checked_lock
+from ..elastic import messages as emsg
 from ..obs import flight
 from ..obs import stats as obs_stats
 from ..rpc.messages import WorkerStatus
@@ -99,16 +100,38 @@ class CoordinatorCore:
         self._tier_confirmed: set[str] = set()
         self._tier_epoch = 0
         self._obs_tier_groups = obs_stats.gauge("tier.groups")
+        # Elastic membership (elastic/, ISSUE 13): worker id -> state
+        # (JOINING/ACTIVE/DRAINING/GONE) under a monotone membership
+        # epoch bumped on EVERY transition, plus a registry generation
+        # bumped whenever the live set changes (register of a new
+        # worker, graceful leave, reap eviction) — the PS barrier-width
+        # TTL cache invalidates on generation movement instead of
+        # waiting out the TTL (core/ps_core.py barrier_width).
+        self._member_states: dict[int, int] = {}
+        self._member_epochs: dict[int, int] = {}
+        self._membership_epoch = 0
+        self._registry_generation = 0
+        self._obs_members_live = obs_stats.gauge("coord.members.live")
 
     def register_worker(self, worker_id: int, address: str, port: int,
                         hostname: str) -> int:
         """Upsert + heartbeat stamp (reference: src/coordinator.cpp:7-17).
-        Returns the total registered worker count."""
+        Returns the total registered worker count.  A worker NEW to the
+        registry (first join, or a rejoin after GONE) enters the
+        membership table as JOINING and bumps the registry generation —
+        a legacy worker without the membership extension simply stays
+        JOINING (advisory; the live count is unchanged)."""
         now = self._time()
         with self._lock:
+            fresh = worker_id not in self._workers
             self._workers[worker_id] = WorkerRegistryEntry(
                 worker_id=worker_id, address=address, port=int(port),
                 hostname=hostname, status=WorkerStatus.IDLE, last_heartbeat=now)
+            if fresh:
+                self._registry_generation += 1
+            if self._member_states.get(worker_id) in (None, emsg.MEMBER_GONE):
+                self._member_transition_locked(worker_id,
+                                               emsg.MEMBER_JOINING)
             return len(self._workers)
 
     def update_heartbeat(self, worker_id: int, status: int) -> bool:
@@ -224,6 +247,102 @@ class CoordinatorCore:
                           b=len(self._shard_map))
             return self._shard_epoch
 
+    # --------------------------------------------------------- membership
+    def _member_transition_locked(self, worker_id: int, state: int) -> bool:
+        """Move ``worker_id`` to ``state``, bumping the membership epoch
+        iff it actually changed (caller holds _lock).  Returns whether a
+        transition happened."""
+        wid = int(worker_id)
+        if self._member_states.get(wid) == state:
+            return False
+        self._member_states[wid] = state
+        self._membership_epoch += 1
+        self._member_epochs[wid] = self._membership_epoch
+        self._obs_members_live.set(sum(
+            1 for s in self._member_states.values()
+            if s != emsg.MEMBER_GONE))
+        return True
+
+    def registry_generation(self) -> int:
+        """Monotone counter of live-set changes (register/leave/evict) —
+        the PS barrier-width cache invalidator (elastic/, ISSUE 13)."""
+        with self._lock:
+            return self._registry_generation
+
+    def membership(self) -> tuple[int, list[tuple[int, int, int]]]:
+        """(membership epoch, [(worker id, state, transition epoch)])
+        sorted by worker id — the ``UpdateMembership`` response body."""
+        with self._lock:
+            return self._membership_epoch, [
+                (wid, self._member_states[wid],
+                 self._member_epochs.get(wid, 0))
+                for wid in sorted(self._member_states)]
+
+    def member_state(self, worker_id: int) -> int | None:
+        with self._lock:
+            return self._member_states.get(int(worker_id))
+
+    def member_join(self, worker_id: int) -> int:
+        """The worker's post-registration join announce: JOINING (or a
+        re-join after GONE) -> ACTIVE.  Returns the membership epoch."""
+        with self._lock:
+            if self._member_transition_locked(worker_id,
+                                              emsg.MEMBER_ACTIVE):
+                flight.record("elastic.join", worker=int(worker_id),
+                              a=self._membership_epoch)
+            return self._membership_epoch
+
+    def drain_worker(self, worker_id: int, reason: str = "ctl") -> bool:
+        """Mark ``worker_id`` DRAINING (``pst-ctl drain``): it keeps its
+        registry entry — and its barrier slot — until it finishes the
+        in-flight iteration and announces leave.  False when the worker
+        is unknown or already gone."""
+        with self._lock:
+            wid = int(worker_id)
+            state = self._member_states.get(wid)
+            if wid not in self._workers and state in (None,
+                                                      emsg.MEMBER_GONE):
+                return False
+            if self._member_transition_locked(wid, emsg.MEMBER_DRAINING):
+                flight.record("elastic.drain", worker=wid,
+                              a=self._membership_epoch, note=reason[:48])
+            return True
+
+    def deregister_worker(self, worker_id: int) -> bool:
+        """Graceful leave (drain completion / SIGTERM shutdown): drop
+        the registry entry NOW — the barrier narrows at the next width
+        refresh (the generation bump makes that immediate for
+        generation-aware providers) instead of a stale-heartbeat reap —
+        and mark the member GONE."""
+        with self._lock:
+            wid = int(worker_id)
+            removed = self._workers.pop(wid, None) is not None
+            if removed:
+                self._registry_generation += 1
+                if self._tier_workers.pop(wid, None) is not None:
+                    self._tier_regroup_locked(tier_topology.min_group_size())
+            if self._member_transition_locked(wid, emsg.MEMBER_GONE):
+                flight.record("elastic.drain", worker=wid,
+                              a=self._membership_epoch, note="leave")
+            return removed
+
+    def width_provider(self):
+        """An in-process ``live_workers_fn`` with the ``generation``
+        attribute ``ParameterServerCore.barrier_width`` invalidates on —
+        the zero-RPC analogue of
+        :class:`~..elastic.membership.MembershipWidthProvider` for
+        colocated topologies (tests, bench, single-process demos)."""
+        core = self
+
+        class _Provider:
+            def __call__(self) -> int:
+                return core.live_worker_count()
+
+            def generation(self) -> int:
+                return core.registry_generation()
+
+        return _Provider()
+
     # ------------------------------------------------- reduction topology
     def tier_register(self, worker_id: int, host_id: str = "",
                       leaf_address: str = "", dead_leaf: str = ""
@@ -309,6 +428,17 @@ class CoordinatorCore:
                 if now - self._workers[wid].last_heartbeat > timeout_s:
                     del self._workers[wid]
                     evicted.append(wid)
+            if evicted:
+                # the live set shrank: generation-aware width providers
+                # (elastic/, ISSUE 13) see the narrowed barrier at their
+                # next width read instead of a TTL lapse, and the
+                # membership table marks the member GONE (epoch bump)
+                self._registry_generation += 1
+                for wid in evicted:
+                    if self._member_transition_locked(wid,
+                                                      emsg.MEMBER_GONE):
+                        flight.record("elastic.evict", worker=wid,
+                                      a=self._membership_epoch)
             if evicted and self._tier_workers:
                 for wid in evicted:
                     self._tier_workers.pop(wid, None)

@@ -92,6 +92,8 @@ from typing import Callable, Mapping
 import numpy as np
 
 from ..analysis.lock_order import checked_lock
+from ..async_sgd.damping import StalenessDamping, async_damping
+from ..elastic import quorum as equorum
 from ..obs import flight
 from ..obs import stats as obs_stats
 from ..replication.messages import STALE_SHARD_MAP
@@ -116,7 +118,8 @@ TIER_AGGREGATE_ID_BASE = 1 << 20
 class IterationState:
     __slots__ = ("worker_gradients", "aggregated", "aggregating", "sealed",
                  "workers_at_aggregation", "accum", "counts", "folded",
-                 "folding", "inflight", "contributors", "buffer_bytes")
+                 "folding", "inflight", "contributors", "buffer_bytes",
+                 "quorum_at")
 
     def __init__(self):
         # buffered mode: whole per-worker gradient stores
@@ -163,6 +166,12 @@ class IterationState:
         self.sealed = False
         self.workers_at_aggregation = 0
         self.buffer_bytes = 0
+        # K-of-N quorum close (elastic/quorum.py, ISSUE 13): monotonic
+        # stamp of the moment the contributor count first reached the
+        # quorum threshold — the grace window counts from here.  Reset
+        # to None if an elastic width change lifts the threshold back
+        # above the count.  None while quorum is off or unreached.
+        self.quorum_at: float | None = None
 
 
 class PushResult:
@@ -195,7 +204,7 @@ class PushSink:
     whole-push paths (an async apply must be atomic)."""
 
     __slots__ = ("_core", "worker_id", "iteration", "_buffer", "_group",
-                 "stale_map_epoch", "weight", "members")
+                 "stale_map_epoch", "weight", "members", "stale_redirect")
 
     def __init__(self, core: "ParameterServerCore", worker_id: int,
                  iteration: int, streaming: bool,
@@ -228,15 +237,25 @@ class PushSink:
         # whole push rejected with the stale-shard-map marker so the
         # sharded client refreshes its map and replays the round
         self.stale_map_epoch: int | None = None
+        # set when chunks arrived after the iteration's (quorum) seal
+        # and were folded FORWARD into a later iteration's accumulator
+        # (elastic/, ISSUE 13): (target iteration, staleness).  The
+        # commit then marks the worker a contributor of the TARGET
+        # instead of reporting a bare late push.
+        self.stale_redirect: tuple[int, int] | None = None
 
     def fold(self, gradients: Mapping[str, np.ndarray]) -> None:
         if self._buffer is not None:
             self._buffer.update(gradients)
         else:
-            stale = self._core._fold_chunk(self.worker_id, self.iteration,
-                                           gradients)
+            stale, redirect = self._core._fold_chunk(
+                self.worker_id, self.iteration, gradients)
             if stale is not None:
                 self.stale_map_epoch = stale
+            if redirect is not None and (
+                    self.stale_redirect is None
+                    or redirect[0] > self.stale_redirect[0]):
+                self.stale_redirect = redirect
 
     def commit(self) -> PushResult:
         if self.stale_map_epoch is not None:
@@ -249,6 +268,9 @@ class PushSink:
         if self._buffer is not None:
             return self._core.receive_gradients(self.worker_id,
                                                 self.iteration, self._buffer)
+        if self.stale_redirect is not None:
+            return self._core._commit_stale_push(
+                self.worker_id, self.iteration, *self.stale_redirect)
         return self._core._commit_push(self.worker_id, self.iteration)
 
 
@@ -326,7 +348,9 @@ class ParameterServerCore:
                  contributions_fn: Callable[
                      [], Mapping[int, tuple[int, tuple[int, ...]]] | None]
                  | None = None,
-                 contributions_ttl_s: float = 1.0):
+                 contributions_ttl_s: float = 1.0,
+                 quorum: float | None = None,
+                 quorum_grace_ms: float | None = None):
         mode = (aggregation or os.environ.get("PSDT_AGGREGATION")
                 or "streaming").lower()
         if mode not in AGGREGATION_MODES:
@@ -375,6 +399,15 @@ class ParameterServerCore:
         self._live_workers_fn = live_workers_fn
         self._live_ttl = float(live_workers_ttl_s)
         self._live_cache: tuple[int, float] = (0, 0.0)  # (value, expiry)
+        # Registry-generation invalidation (elastic/, ISSUE 13): a
+        # provider exposing a cheap ``generation()`` (the coordinator's
+        # registry generation / membership epoch) lets barrier_width()
+        # refresh the TTL cache the instant the live set changed — a
+        # reaped worker shrinks the barrier at the next width read
+        # instead of a TTL lapse.  None for plain callables: exactly the
+        # pre-existing TTL behavior.
+        self._live_gen_fn = getattr(live_workers_fn, "generation", None)
+        self._live_gen: int | None = None
         # Guards _live_cache: barrier_width() is called from many handler
         # threads at once, and an unguarded expiry race both issues
         # redundant remote registry calls and can publish a torn
@@ -407,6 +440,25 @@ class ParameterServerCore:
             [int, TensorStore, dict[str, int]], TensorStore] | None = None
         self._optimizer = optimizer or SGD(learning_rate=1.0)
         self._staleness_bound = int(staleness_bound)
+        # K-of-N quorum barriers (elastic/quorum.py, ISSUE 13): 0.0 =
+        # off, the default — every pre-existing path byte-identical.
+        # Armed (PSDT_QUORUM / constructor), the streaming sync barrier
+        # seals once ceil(quorum * width) contributors committed AND the
+        # grace window past the K-th commit elapsed; stragglers sealed
+        # out fold forward into the next iteration's accumulator damped
+        # by beta^staleness (async_sgd/damping.py — the shared policy),
+        # bounded by max(1, staleness_bound).
+        self._quorum = equorum.quorum_fraction(quorum)
+        self._quorum_grace_s = equorum.grace_s(quorum_grace_ms)
+        self._damping = StalenessDamping() if self._quorum else None
+        # bounded-staleness async damping: armed ONLY by an explicit
+        # PSDT_STALENESS_BETA (pre-existing async runs stay
+        # byte-identical without it)
+        self._async_damping = (async_damping()
+                               if self._staleness_bound > 0 else None)
+        self._obs_quorum_closes = obs_stats.counter(
+            "ps.barrier.quorum_closes")
+        self._obs_stale_folds = obs_stats.counter("ps.stale.folds")
         self._gc_iterations = int(gc_iterations)
         self._current_iteration = 0
         self._epoch = 0
@@ -557,14 +609,21 @@ class ParameterServerCore:
         if self._live_workers_fn is not None:
             with self._live_lock:
                 live, expiry = self._live_cache
-                if self._live_ttl <= 0 or time.monotonic() >= expiry:
+                gen = (self._live_gen_fn()
+                       if self._live_gen_fn is not None else None)
+                if (self._live_ttl <= 0 or time.monotonic() >= expiry
+                        or (gen is not None and gen != self._live_gen)):
                     # TTL cache: the provider may be a remote registry RPC;
                     # the barrier width is read on every push and 20 Hz
                     # sync poll, so don't issue hot-path I/O for a value
                     # that changes in seconds.  One refresher per expiry
-                    # (see _live_lock above).
+                    # (see _live_lock above).  A registry GENERATION move
+                    # (cheap local read — elastic/, ISSUE 13) invalidates
+                    # early: a reaped or drained worker narrows the
+                    # barrier at the next width read, not a TTL lapse.
                     live = int(self._live_workers_fn())
                     self._live_cache = (live, time.monotonic() + self._live_ttl)
+                    self._live_gen = gen
             if live > 0:
                 return live
         return self._static_total_workers
@@ -768,9 +827,13 @@ class ParameterServerCore:
                 return self._commit_group_push(worker_id, iteration,
                                                dict(gradients), weight,
                                                members)
-            stale_epoch = self._fold_chunk(worker_id, iteration, gradients)
+            stale_epoch, redirect = self._fold_chunk(worker_id, iteration,
+                                                     gradients)
             if stale_epoch is not None:
                 return self._stale_map_result(iteration, stale_epoch)
+            if redirect is not None:
+                return self._commit_stale_push(worker_id, iteration,
+                                               *redirect)
             return self._commit_push(worker_id, iteration)
         return self._receive_sync(worker_id, iteration, gradients)
 
@@ -829,16 +892,22 @@ class ParameterServerCore:
                  if n not in self._retired}, stale_epoch)
 
     def _fold_chunk(self, worker_id: int, iteration: int,
-                    gradients: Mapping[str, np.ndarray]) -> int | None:
+                    gradients: Mapping[str, np.ndarray]
+                    ) -> tuple[int | None, tuple[int, int] | None]:
         """Fold one chunk of a worker's push into the iteration's running
         accumulator (streaming sync mode).  Idempotent per (worker, tensor
         name): a replayed chunk — an RPC retry of a push that actually
         landed — is skipped, so retries converge to exactly one
         contribution (first-push-wins).  Chunks for an aggregated (or
-        currently-aggregating) iteration are discarded; the commit reports
-        the push late.  Returns the tombstone map epoch when the chunk
-        touched retired (reshard-moved) tensors, else None — the caller
-        turns that into a stale-shard-map push rejection.
+        currently-aggregating) iteration are discarded — except under an
+        armed quorum (ISSUE 13), where a straggler sealed out of its
+        iteration folds FORWARD into the next open iteration's
+        accumulator as a damped staleness-tagged contribution
+        (:meth:`_stale_fold_locked`).  Returns ``(stale map epoch | None,
+        stale redirect | None)``: the first when the chunk touched
+        retired (reshard-moved) tensors — the caller turns that into a
+        stale-shard-map push rejection — and the second as the
+        ``(target iteration, staleness)`` of a forward fold.
 
         Striped (stripes > 1): only the reservation — dedup, seal check,
         state bookkeeping — runs under ``_state_lock``; the O(bytes)
@@ -853,7 +922,16 @@ class ParameterServerCore:
                     or worker_id in state.contributors):
                 # late / close-attempted / already-committed worker: chunk
                 # is discarded (commit reports the push late or duplicate)
-                return stale_epoch
+                # — unless the quorum sealed this worker out, in which
+                # case the gradient folds forward damped
+                redirect = None
+                if (self._quorum_on() and gradients
+                        and worker_id < TIER_AGGREGATE_ID_BASE
+                        and (state is None
+                             or worker_id not in state.contributors)):
+                    redirect = self._stale_fold_locked(worker_id, iteration,
+                                                       gradients)
+                return stale_epoch, redirect
             # flight evidence (sampled: one per chunk is the hottest
             # event class): which worker reserved which fold when — the
             # per-chunk arrival record a postmortem orders folds by
@@ -862,19 +940,100 @@ class ParameterServerCore:
             folded = state.folded.setdefault(worker_id, set())
             if self._stripes <= 1:
                 self._fold_into_locked(state, folded, gradients)
-                return stale_epoch
+                return stale_epoch, None
             folding = state.folding.setdefault(worker_id, set())
             todo = [(name, g) for name, g in gradients.items()
                     if name not in folded and name not in folding]
             if not todo:
-                return stale_epoch
+                return stale_epoch, None
             # reserve: a concurrent duplicate fold of the same (worker,
             # name) — e.g. a fast retry racing the original — sees the
             # reservation and skips instead of double-adding
             folding.update(name for name, _ in todo)
             state.inflight += 1
         self._fold_striped(state, worker_id, iteration, todo)
-        return stale_epoch
+        return stale_epoch, None
+
+    def _stale_fold_locked(self, worker_id: int, iteration: int,
+                           gradients: Mapping[str, np.ndarray]
+                           ) -> tuple[int, int] | None:
+        """Quorum straggler fold (ISSUE 13; caller holds _state_lock):
+        fold a push sealed out of ``iteration`` into the next OPEN
+        iteration's accumulator, damped by ``beta ** staleness``
+        (async_sgd/damping.py), bounded by ``max(1, staleness_bound)``.
+        Returns ``(target iteration, staleness)`` or None when every
+        in-bound target is already sealed/aggregated (the push degrades
+        to the pre-existing late-push no-op).
+
+        Dedup is the TARGET iteration's per-(worker, tensor) set: a
+        retried stale push replays into the same names and folds
+        nothing twice, and the worker's own REAL push for the target
+        later dedups as a duplicate instead of double-counting — the
+        straggler's carried gradient IS its contribution to that
+        barrier.  The fold runs serial under _state_lock (the stale
+        path is rare by construction — one straggler per quorum close)."""
+        if (self._bootstrap_iteration is not None
+                and iteration <= self._bootstrap_iteration):
+            # the seed iteration: a slow worker's duplicate init push is
+            # init-magnitude VALUES, not a gradient — folding it forward
+            # would poison the next mean.  Plain late-push no-op; the
+            # worker pulls the seeded store and proceeds.
+            return None
+        bound = max(1, self._staleness_bound)
+        base = max(iteration + 1, self._aggregated_watermark + 1)
+        for target in range(base, iteration + bound + 1):
+            st = self._sync_state_locked(target)
+            if st is None or st.aggregated or st.sealed:
+                continue
+            staleness = target - iteration
+            folded = st.folded.setdefault(worker_id, set())
+            reserved = st.folding.get(worker_id, ())
+            todo = {name: g for name, g in gradients.items()
+                    if name not in folded and name not in reserved}
+            if todo:
+                self._fold_into_locked(
+                    st, folded, self._damping.damp(todo, staleness))
+                self._obs_stale_folds.add()
+                flight.record("stale.fold", iteration=target,
+                              worker=worker_id, a=staleness, b=len(todo))
+            return target, staleness
+        return None
+
+    def _commit_stale_push(self, worker_id: int, iteration: int,
+                           target: int, staleness: int) -> PushResult:
+        """End-of-stream for a push whose chunks folded FORWARD
+        (:meth:`_stale_fold_locked`): mark the worker a contributor of
+        the TARGET iteration — its carried gradient counts toward that
+        barrier, so no later barrier waits on a straggler that already
+        contributed — and answer for the ORIGINAL iteration (complete
+        once its apply published, in-progress while the close is still
+        in flight, so the worker observes readiness exactly when it is
+        real)."""
+        total = self.barrier_width()
+        with self._state_lock:
+            orig = self._iteration_states.get(iteration)
+            complete = orig is None or orig.aggregated
+            if orig is None:
+                received = total  # GC'd: the late-push convention
+            elif orig.aggregated:
+                received = orig.workers_at_aggregation
+            else:
+                # close still in flight: report the true contributor
+                # count, the _push_guard_locked sealed-case convention
+                received = len(orig.contributors)
+            st = self._iteration_states.get(target)
+            if st is not None and not st.aggregated and not st.sealed:
+                if worker_id not in st.contributors:
+                    st.contributors.add(worker_id)
+                    flight.record("push.commit", iteration=target,
+                                  worker=worker_id,
+                                  a=len(st.contributors), b=total)
+                self._maybe_aggregate_locked(target, st, total)
+            return PushResult(
+                True,
+                f"stale push folded into iteration {target} "
+                f"(staleness {staleness}, lr damped)",
+                iteration, complete, received, total)
 
     def _fold_into_locked(self, state: IterationState, folded: set,
                           gradients: Mapping[str, np.ndarray],
@@ -1146,19 +1305,62 @@ class ParameterServerCore:
                               False, received, total)
 
     # ---------------------------------------------------------- barrier close
+    @property
+    def quorum(self) -> float:
+        """The armed quorum fraction (0.0 = off, all-of-N)."""
+        return self._quorum
+
+    def _quorum_on(self) -> bool:
+        """Quorum applies only to the streaming synchronous barrier —
+        the buffered escape hatch and async mode are untouched (the
+        same scoping as the tier weighted folds)."""
+        return self._quorum > 0 and self._streaming and self.synchronous
+
+    def _quorum_ready_locked(self, state: IterationState, received: int,
+                             total: int) -> bool:
+        """True when the K-of-N close may fire NOW: the contributor
+        count reached ``K = ceil(quorum * total)`` and the grace window
+        past the K-th commit elapsed.  Stamps/clears
+        ``state.quorum_at`` as the count crosses the (possibly elastic)
+        threshold; callers on the poll/CV cadence re-evaluate the grace.
+        Caller holds _state_lock."""
+        k = equorum.threshold(self._quorum, total)
+        if received < k:
+            state.quorum_at = None  # width grew past the old quorum
+            return False
+        now = time.monotonic()
+        if state.quorum_at is None:
+            state.quorum_at = now
+        return now - state.quorum_at >= self._quorum_grace_s
+
     def _maybe_aggregate_locked(self, iteration: int, state: IterationState,
                                 total: int) -> int:
         """Fire the barrier if the contributor count has reached the current
-        width.  Called from push AND from sync-status polls / CV waits so
-        that an elastic barrier shrink (worker evicted mid-iteration)
-        releases already-buffered iterations instead of stranding them.
+        width — or, with the quorum armed (PSDT_QUORUM, ISSUE 13), the
+        K-of-N threshold with its grace window elapsed.  Called from push
+        AND from sync-status polls / CV waits so that an elastic barrier
+        shrink (worker evicted mid-iteration) releases already-buffered
+        iterations instead of stranding them, and so the quorum grace
+        window is re-evaluated on the poll cadence without any push.
         Caller holds _state_lock.  Returns the contributor count."""
         if state.aggregated:
             return state.workers_at_aggregation
         received = (len(state.contributors) if self._streaming
                     else len(state.worker_gradients))
-        if state.aggregating or received < total or received == 0:
+        if state.aggregating or received == 0:
             return received
+        if received < total:
+            if not (self._quorum_on()
+                    and self._quorum_ready_locked(state, received, total)):
+                return received
+            # K-of-N close: seal over the contributors we have — the
+            # mean stays a mean over contributors (per-name counts);
+            # stragglers landing after this seal fold forward damped
+            self._obs_quorum_closes.add()
+            flight.record(
+                "quorum.seal", iteration=iteration, a=received, b=total,
+                note=",".join(str(w) for w in
+                              sorted(state.contributors)[:12]))
         self._close_barrier_locked(iteration, state, received, total)
         return (state.workers_at_aggregation if state.aggregated
                 else received)
@@ -1174,6 +1376,20 @@ class ParameterServerCore:
         semantics and timing exactly).  Caller holds _state_lock; it is
         held again on return."""
         t0 = time.perf_counter()
+        # remember whether THIS close is the bootstrap (store empty →
+        # the aggregated payload becomes the parameters): a straggler's
+        # late replay of the seed push must then be a plain late-push
+        # no-op, never a forward stale fold — its payload is
+        # init-magnitude VALUES, not a gradient (see _stale_fold_locked)
+        if self._streaming and self._quorum_on() \
+                and self._bootstrap_iteration is None:
+            with self._params_lock:
+                if not self._params:
+                    # stamped AT SEAL, not after publish: the straggler's
+                    # seed replay typically lands exactly while the
+                    # bootstrap close runs outside _state_lock, and the
+                    # _stale_fold_locked guard must already see it
+                    self._bootstrap_iteration = iteration
         state.sealed = True  # contributor set frozen, even across retries
         state.aggregating = True  # set BEFORE the drain below: the wait
         # releases _state_lock, and a concurrent poll re-entering
@@ -1365,6 +1581,13 @@ class ParameterServerCore:
                                   f"{staleness} behind bound {self._staleness_bound}",
                                   self._current_iteration, False, 0,
                                   self.barrier_width())
+            if self._async_damping is not None and staleness > 0:
+                # staleness-aware lr damping (async_sgd/damping.py,
+                # ISSUE 13): an accepted stale push applies at
+                # lr * beta^staleness — armed only by an explicit
+                # PSDT_STALENESS_BETA, so default async runs are
+                # byte-identical
+                gradients = self._async_damping.damp(gradients, staleness)
             self._apply_update(tree_like(gradients))
             self._applied_updates += 1
             # current_iteration stays the monotone max of worker iterations
@@ -1636,8 +1859,18 @@ class ParameterServerCore:
                 if remaining <= 0:
                     return False, received, total
                 # 250 ms cap: elastic width changes have no notification
-                # of their own, so re-evaluate on a short heartbeat
-                self._barrier_cv.wait(min(remaining, 0.25))
+                # of their own, so re-evaluate on a short heartbeat.
+                # With a quorum grace window running (ISSUE 13) the wake
+                # tightens to its expiry, so a K-of-N close fires within
+                # grace instead of a heartbeat later.
+                cap = 0.25
+                if (state is not None and state.quorum_at is not None
+                        and not state.sealed):
+                    cap = min(cap, max(
+                        0.005,
+                        state.quorum_at + self._quorum_grace_s
+                        - time.monotonic()) + 0.002)
+                self._barrier_cv.wait(min(remaining, cap))
 
     # --------------------------------------------------------------------- gc
     def _gc_locked(self) -> None:

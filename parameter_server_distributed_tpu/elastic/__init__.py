@@ -1,0 +1,24 @@
+"""Elastic membership + K-of-N quorum barriers (ISSUE 13).
+
+The subsystem that makes membership a first-class, epoch-numbered
+object and the synchronous barrier a quorum:
+
+- :mod:`.messages` — the ``UpdateMembership`` coordinator extension RPC
+  (OUTSIDE ``rpc/messages.py``: the wire manifest stays byte-unchanged;
+  reference coordinators answer UNIMPLEMENTED => permanent static
+  membership);
+- :mod:`.membership` — the worker-side join/leave/drain announce client
+  and the PS-side width provider whose ``generation`` (the membership
+  epoch) invalidates the barrier-width TTL cache the instant a member
+  transitions;
+- :mod:`.quorum` — the ``PSDT_QUORUM`` / ``PSDT_QUORUM_GRACE_MS``
+  policy consumed by ``core/ps_core.py``: close at K of N once a grace
+  window past the K-th commit elapses, fold stragglers forward damped
+  (:mod:`..async_sgd.damping`).
+
+Kept import-light deliberately (like the sibling extension packages):
+``core/`` imports :mod:`.messages` and :mod:`.quorum`, which must not
+drag the gRPC client stack in through this ``__init__``.
+
+See docs/training.md "Elastic membership & quorum barriers".
+"""

@@ -1,0 +1,175 @@
+"""Membership runtime: worker announce/poll client + PS width provider.
+
+The coordinator owns the epoch-numbered membership table
+(:class:`~..core.coordinator_core.CoordinatorCore`); this module is the
+two remote consumers:
+
+- :class:`MembershipClient` — the worker (and ``pst-ctl``) side of the
+  ``UpdateMembership`` extension RPC: announce join after registration,
+  announce leave at graceful shutdown (drain/SIGTERM), and poll own
+  state at heartbeat cadence so a coordinator-side ``pst-ctl drain``
+  reaches the worker without any wire-manifest change.  A reference
+  coordinator answers UNIMPLEMENTED and the client latches unsupported
+  forever — membership degrades to today's static behavior.
+- :class:`MembershipWidthProvider` — the PS side: a live-worker
+  provider (drop-in ``live_workers_fn``) that reads the membership
+  table, counting every non-GONE member, and exposes the membership
+  epoch as its ``generation`` so
+  :meth:`~..core.ps_core.ParameterServerCore.barrier_width` invalidates
+  its TTL cache the instant the epoch moves (a reap marks GONE and
+  bumps the epoch — the shrink lands at the next epoch poll instead of
+  a TTL lapse).  Falls back to the classic ``ListWorkers`` count when
+  the coordinator lacks the extension.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import grpc
+
+from ..analysis.lock_order import checked_lock
+from ..rpc import messages as m
+from ..rpc.service import RpcClient
+from . import messages as emsg
+
+log = logging.getLogger("pst.elastic")
+
+
+class MembershipClient:
+    """Worker/ctl-side membership announcements over the coordinator
+    connection.  Every method degrades to ``None`` (unsupported /
+    unreachable) instead of raising — membership is advisory and must
+    never fail a training step."""
+
+    def __init__(self, coordinator_address: str, worker_id: int = -1,
+                 client: RpcClient | None = None):
+        self.worker_id = int(worker_id)
+        self._client = client or RpcClient(
+            coordinator_address, m.COORDINATOR_SERVICE,
+            {**m.COORDINATOR_METHODS, **emsg.ELASTIC_COORD_METHODS})
+        self._supported: bool | None = None
+
+    def close(self) -> None:
+        self._client.close()
+
+    @property
+    def supported(self) -> bool | None:
+        """True/False once proven; None before the first call."""
+        return self._supported
+
+    def _call(self, action: int, target: int = -1,
+              timeout: float = 5.0) -> emsg.MembershipResponse | None:
+        if self._supported is False:
+            return None
+        try:
+            resp = self._client.call(
+                "UpdateMembership",
+                emsg.MembershipRequest(worker_id=self.worker_id,
+                                       action=action,
+                                       target_worker_id=target),
+                timeout=timeout)
+        except grpc.RpcError as exc:
+            code = getattr(exc, "code", None)
+            if callable(code) and code() == grpc.StatusCode.UNIMPLEMENTED:
+                log.info("coordinator does not speak UpdateMembership; "
+                         "membership stays static")
+                self._supported = False
+            return None
+        self._supported = True
+        return resp
+
+    def join(self) -> emsg.MembershipResponse | None:
+        return self._call(emsg.MEMBER_JOIN)
+
+    def leave(self) -> emsg.MembershipResponse | None:
+        return self._call(emsg.MEMBER_LEAVE)
+
+    def poll_state(self) -> int | None:
+        """Own membership state (the drain signal), or None when the
+        extension is unsupported/unreachable."""
+        resp = self._call(emsg.MEMBER_QUERY)
+        if resp is None:
+            return None
+        return int(resp.self_state)
+
+    def drain(self, target_worker_id: int
+              ) -> emsg.MembershipResponse | None:
+        """``pst-ctl drain``: ask the coordinator to mark ``target``
+        DRAINING; the worker notices at its next heartbeat-cadence
+        poll."""
+        return self._call(emsg.MEMBER_DRAIN, target=int(target_worker_id))
+
+    def query(self, timeout: float = 5.0
+              ) -> emsg.MembershipResponse | None:
+        return self._call(emsg.MEMBER_QUERY, timeout=timeout)
+
+
+def live_member_count(entries) -> int:
+    """Barrier-width view of a membership table: every non-GONE member
+    counts — DRAINING workers are still finishing an in-flight
+    iteration and must keep their barrier slot until they leave."""
+    return sum(1 for e in entries
+               if int(e.state) != emsg.MEMBER_GONE)
+
+
+class MembershipWidthProvider:
+    """Drop-in ``live_workers_fn`` for ``ParameterServerCore`` backed by
+    the membership table, with the membership epoch as ``generation``.
+
+    The core's ``barrier_width()`` TTL cache refreshes when the TTL
+    lapses OR when ``generation()`` moved — so an in-process topology
+    (tests, colocated bench) sees an eviction immediately, and a remote
+    PS sees it at the next epoch poll.  ``generation()`` itself must be
+    cheap: it returns the LAST SEEN epoch (updated by every ``__call__``)
+    rather than issuing its own RPC — the epoch rides the same response
+    as the width."""
+
+    def __init__(self, coordinator_address: str,
+                 client: RpcClient | None = None):
+        self._address = coordinator_address
+        self._client = MembershipClient(coordinator_address, worker_id=-1,
+                                        client=client)
+        # held across the membership RPC — single-flight per refresh,
+        # the barrier_width _live_lock (rank 50) is already held by the
+        # caller, hence rank 51 and BLOCKING_ALLOWED
+        # (analysis/lock_order.py)
+        self._lock = checked_lock("MembershipWidthProvider._lock")
+        self._epoch = 0
+        self._fallback: RpcClient | None = None
+
+    def close(self) -> None:
+        self._client.close()
+        if self._fallback is not None:
+            self._fallback.close()
+
+    def generation(self) -> int:
+        """Last-seen membership epoch (no RPC — see class docstring)."""
+        with self._lock:
+            return self._epoch
+
+    def _list_workers_count(self) -> int:
+        """Classic registry count — the downgrade path for reference
+        coordinators without the membership extension."""
+        if self._fallback is None:
+            self._fallback = RpcClient(self._address,
+                                       m.COORDINATOR_SERVICE,
+                                       m.COORDINATOR_METHODS)
+        try:
+            resp = self._fallback.call("ListWorkers",
+                                       m.ListWorkersRequest(), timeout=2.0)
+            return int(resp.total_workers)
+        except Exception:  # noqa: BLE001 — registry unreachable: fall back
+            return 0
+
+    def __call__(self) -> int:
+        with self._lock:
+            # short timeout: this runs under the barrier-width locks —
+            # against a partitioned coordinator every push/poll would
+            # otherwise queue behind a multi-second refresh (the 2 s
+            # budget of the classic ListWorkers live_fn this replaced)
+            resp = self._client.query(timeout=2.0)
+            if resp is None:
+                return self._list_workers_count()
+            self._epoch = int(resp.epoch)
+            return live_member_count(resp.entries)

@@ -1,0 +1,97 @@
+"""Membership extension RPC messages (ISSUE 13).
+
+Deliberately NOT in ``rpc/messages.py``: the analyzer's wire manifest
+pins the reference contract (field tags, method tables) and the elastic
+subsystem must leave it byte-unchanged.  ``UpdateMembership`` is an
+extra method name on the existing coordinator gRPC service — a reference
+coordinator never implements it and answers UNIMPLEMENTED, which every
+caller (:class:`~.membership.MembershipClient`, the PS width provider)
+treats as a PERMANENT downgrade to today's static membership (the
+PR-2/PR-6/PR-7/PR-9 fallback discipline).
+
+One RPC serves four roles, so the membership protocol needs no extra
+round trips:
+
+- **join announce** — a worker reports itself ACTIVE after registering
+  (``action = MEMBER_JOIN``); until then a registered worker sits in
+  JOINING (a legacy worker without the extension simply stays there —
+  membership is advisory for it, the live count is unchanged);
+- **leave announce** — graceful deregistration (``MEMBER_LEAVE``): the
+  worker finished its in-flight iteration, the registry drops it NOW
+  and the barrier narrows at the next width refresh instead of a
+  stale-heartbeat reap 30 s later;
+- **drain request** — ``pst-ctl drain <worker>`` (``MEMBER_DRAIN`` with
+  ``target_worker_id``) marks the target DRAINING; the worker sees its
+  own state on its next heartbeat-cadence poll, finishes the in-flight
+  iteration, and leaves;
+- **membership query** — the response carries the epoch-numbered state
+  table (``action = MEMBER_QUERY`` registers nothing; the PS width
+  provider and ``pst-ctl members`` are pure reads).
+
+Every state transition bumps the membership epoch, so a poller holding
+epoch E knows a response with epoch > E supersedes its view.
+"""
+
+from __future__ import annotations
+
+from ..rpc.messages import TRACE_FIELD_NUMBER
+from ..rpc.wire import Field, Message
+
+# Membership states (MembershipEntry.state).  Append-only: the values
+# ride the wire and pst-trace notes.
+MEMBER_JOINING = 0   # registered, join not yet announced (or legacy worker)
+MEMBER_ACTIVE = 1    # announced via UpdateMembership(MEMBER_JOIN)
+MEMBER_DRAINING = 2  # preemption requested; finishing in-flight iteration
+MEMBER_GONE = 3      # left gracefully or reaped; never counts live again
+
+STATE_NAMES = {MEMBER_JOINING: "joining", MEMBER_ACTIVE: "active",
+               MEMBER_DRAINING: "draining", MEMBER_GONE: "gone"}
+
+# MembershipRequest.action values.
+MEMBER_QUERY = 0
+MEMBER_JOIN = 1
+MEMBER_LEAVE = 2
+MEMBER_DRAIN = 3
+
+
+class MembershipEntry(Message):
+    """One worker's membership row: state + the epoch at which it last
+    transitioned."""
+    FIELDS = (
+        Field(1, "worker_id", "int32"),
+        Field(2, "state", "int32"),
+        Field(3, "epoch", "int32"),
+    )
+
+
+class MembershipRequest(Message):
+    """Announce-and-query (see module docstring).  ``target_worker_id``
+    is only read for ``MEMBER_DRAIN`` (the ``pst-ctl`` path drains a
+    worker other than the caller); every other action acts on
+    ``worker_id``.  ``worker_id = -1`` with ``MEMBER_QUERY`` is a pure
+    read (the PS width provider, ``pst-ctl members``)."""
+    FIELDS = (
+        Field(1, "worker_id", "int32"),
+        Field(2, "action", "int32"),
+        Field(3, "target_worker_id", "int32"),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
+    )
+
+
+class MembershipResponse(Message):
+    """``self_state`` answers the REQUESTING worker directly (its row is
+    also in ``entries``): the heartbeat-cadence drain poll only needs
+    this one field.  -1 when the caller is unknown to the table."""
+    FIELDS = (
+        Field(1, "epoch", "int32"),
+        Field(2, "success", "bool"),
+        Field(3, "message", "string"),
+        Field(4, "entries", "message", message_type=MembershipEntry,
+              repeated=True),
+        Field(5, "self_state", "int32"),
+    )
+
+
+ELASTIC_COORD_METHODS = {
+    "UpdateMembership": (MembershipRequest, MembershipResponse),
+}

@@ -1,0 +1,67 @@
+"""K-of-N quorum barrier policy (ISSUE 13).
+
+The synchronous barrier historically closes at **all of N**: one lost or
+slow worker stalls every healthy peer until the fused-barrier timeout.
+``PSDT_QUORUM`` (a fraction of the live width, e.g. ``0.75``) arms the
+K-of-N close in :class:`~..core.ps_core.ParameterServerCore`: once
+``K = ceil(quorum * width)`` contributors have committed AND a grace
+window (``PSDT_QUORUM_GRACE_MS``, default 250) past the K-th commit has
+elapsed, the barrier seals and applies over the contributors it has —
+the mean stays a mean over *contributors* (per-name counts, exactly the
+machinery disjoint-subset sharded pushes already use).  Stragglers whose
+push lands after the seal are not rejected: they fold into the NEXT
+iteration's accumulator as a staleness-tagged, learning-rate-damped
+contribution (:mod:`..async_sgd.damping`).
+
+Unset (the default) the policy is OFF and every barrier is today's
+all-of-N, byte-identical.  ``PSDT_QUORUM=1.0`` is likewise all-of-N and
+treated as off.  The grace window exists so a quorum reached moments
+before the last stragglers' commits does not cut them off: the common
+case (everyone healthy) still closes at full width, and only a worker
+slower than grace is folded forward.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+ENV_QUORUM = "PSDT_QUORUM"
+ENV_GRACE_MS = "PSDT_QUORUM_GRACE_MS"
+DEFAULT_GRACE_MS = 250.0
+
+
+def quorum_fraction(override: float | None = None) -> float:
+    """The armed quorum fraction in (0, 1), or 0.0 = off (all-of-N).
+    ``override`` is the config value (0/None = env decides)."""
+    if override is not None and override > 0:
+        value = float(override)
+    else:
+        raw = os.environ.get(ENV_QUORUM, "")
+        if not raw:
+            return 0.0
+        value = float(raw)
+    if not 0.0 < value <= 1.0:
+        raise ValueError(f"{ENV_QUORUM} must be a fraction in (0, 1], "
+                         f"got {value}")
+    # 1.0 == all-of-N == the pre-existing barrier: treat as off so the
+    # default path stays byte-identical
+    return value if value < 1.0 else 0.0
+
+
+def grace_s(override_ms: float | None = None) -> float:
+    """The post-K-th-commit grace window, in seconds."""
+    if override_ms is not None and override_ms >= 0:
+        ms = float(override_ms)
+    else:
+        ms = float(os.environ.get(ENV_GRACE_MS, str(DEFAULT_GRACE_MS)))
+    return max(0.0, ms) / 1e3
+
+
+def threshold(quorum: float, width: int) -> int:
+    """K for a barrier of ``width``: ``ceil(quorum * width)``, clamped
+    to [1, width] — a quorum can never be satisfied by zero contributors
+    and never demands more than the (possibly elastic) width."""
+    if width <= 0:
+        return 1
+    return min(width, max(1, math.ceil(quorum * width - 1e-9)))

@@ -85,6 +85,14 @@ def _ps_rollup(snap: dict) -> dict:
             device[key] = value
     if device:
         out["device_apply"] = device
+    # elastic quorum barriers (elastic/, ISSUE 13): K-of-N closes and
+    # straggler gradients folded forward damped
+    quorum = counters.get("ps.barrier.quorum_closes", 0)
+    if quorum:
+        out["quorum_closes"] = quorum
+    stale = counters.get("ps.stale.folds", 0)
+    if stale:
+        out["stale_folds"] = stale
     close = _hist_stats(snap, "ps.barrier_close_s")
     if close:
         out["barrier_close"] = close
@@ -287,6 +295,18 @@ def _fmt_bytes(n: int) -> str:
     return f"{n}B"
 
 
+def render_membership(membership: dict) -> str:
+    """One-line view of the coordinator's membership rollup (elastic/,
+    ISSUE 13): ``"3 active, 1 draining, 2 gone (epoch 7)"``."""
+    states = membership.get("states", {})
+    order = ("active", "joining", "draining", "gone")
+    parts = [f"{states[k]} {k}" for k in order if states.get(k)]
+    parts += [f"{v} {k}" for k, v in sorted(states.items())
+              if k not in order and v]
+    return (", ".join(parts) if parts else "no members") + \
+        f" (epoch {membership.get('epoch', 0)})"
+
+
 def render_rollup(rollup: dict) -> str:
     """Human view of :meth:`ClusterAggregator.rollup` for pst-status."""
     lines: list[str] = []
@@ -303,6 +323,10 @@ def render_rollup(rollup: dict) -> str:
     lines.append(f"  wire bytes: {_fmt_bytes(cluster.get('bytes_sent', 0))} "
                  f"sent / {_fmt_bytes(cluster.get('bytes_received', 0))} "
                  f"received (client-side totals)")
+    membership = rollup.get("membership")
+    if membership:
+        lines.append("  membership: "
+                     + render_membership(membership))
     for method, stats in sorted(cluster.get("slowest_rpc", {}).items()):
         lines.append(f"  slowest {method}: p95 {_fmt_s(stats['p95'])} "
                      f"(worker {stats['worker']})")
@@ -337,6 +361,10 @@ def render_rollup(rollup: dict) -> str:
                 if dapply.get("fallbacks"):
                     note += f" ({dapply['fallbacks']} fallbacks)"
                 parts.append(note)
+            if ps.get("quorum_closes"):
+                parts.append(f"{ps['quorum_closes']} quorum closes")
+            if ps.get("stale_folds"):
+                parts.append(f"{ps['stale_folds']} stale folds")
             close = ps.get("barrier_close")
             if close:
                 parts.append(f"barrier close p50={_fmt_s(close['p50'])}")

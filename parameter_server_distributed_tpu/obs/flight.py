@@ -151,6 +151,18 @@ EVENTS: dict[str, int] = {
                                    # to the host family; note = reason
     "apply.readback": 102,        # async D2H readback of the fresh store
                                   # started; a = tensors
+    # elastic membership + quorum barriers (elastic/, ISSUE 13)
+    "elastic.join": 110,          # member ACTIVE; a = membership epoch
+    "elastic.drain": 111,         # DRAINING (ctl/SIGTERM) or graceful
+                                  # leave; a = epoch; note = reason
+    "elastic.evict": 112,         # coordinator reap marked GONE;
+                                  # a = epoch
+    "quorum.seal": 113,           # barrier closed at K of N; a =
+                                  # contributors, b = width; note =
+                                  # contributor ids (comma list)
+    "stale.fold": 114,            # straggler folded forward into
+                                  # `iteration`; a = staleness,
+                                  # b = tensors folded
 }
 EVENT_NAMES = {code: name for name, code in EVENTS.items()}
 

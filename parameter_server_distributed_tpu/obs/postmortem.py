@@ -209,6 +209,35 @@ def iteration_timeline(events: list[dict], iteration: int) -> dict:
                            "events": len(evs)}
     if groups:
         out["groups"] = groups
+    # K-of-N quorum close (elastic/, ISSUE 13): name the workers left
+    # OUTSIDE the close — every worker that actually RAN this iteration
+    # (a step/fused start or commit FOR it) but had no commit before the
+    # quorum seal.  Scoped to this iteration's events deliberately: a
+    # gracefully drained member has no step here and must not be named
+    # a straggler of closes it was legitimately not part of.  The seal
+    # note carries the contributor ids too (belt and braces for wrapped
+    # rings).
+    quorum_seals = [e for e in evs if e["event"] == "quorum.seal"]
+    if quorum_seals:
+        q = quorum_seals[0]
+        inside = {e["worker"] for e in commits
+                  if e["ts"] <= q["ts"] and not _is_group(e["worker"])}
+        for tok in (q.get("note") or "").split(","):
+            if tok.strip().lstrip("-").isdigit():
+                inside.add(int(tok))
+        ran_here = {e["worker"] for e in evs
+                    if 0 <= e["worker"] < _TIER_ID_BASE
+                    and e["event"] in ("push.commit", "step.start",
+                                       "fused.start")}
+        out["quorum"] = {
+            "contributors": q["a"], "width": q["b"],
+            "outside": sorted(ran_here - inside),
+        }
+    stale_folds = [e for e in evs if e["event"] == "stale.fold"]
+    if stale_folds:
+        # folds INTO this iteration: a straggler's carried gradient
+        out["stale_folds"] = [{"worker": e["worker"], "staleness": e["a"],
+                               "tensors": e["b"]} for e in stale_folds]
     if commits:
         first, last = commits[0], commits[-1]
         out["first_commit"] = {"worker": first["worker"], "ts": first["ts"]}
@@ -310,6 +339,44 @@ def critical_path(events: list[dict], iteration: int,
     return out
 
 
+def stalled_iterations(events: list[dict], stall_s: float) -> list[dict]:
+    """Iterations whose barrier STALLED (elastic/, ISSUE 13 acceptance:
+    under an armed quorum no barrier may wait past grace on a gone or
+    slow worker).  An iteration counts as stalled when a worker actually
+    ran it (a step/fused start exists — pure forward-fold target
+    iterations have no step of their own) and either
+
+    - it never published a barrier, or
+    - its seal came more than ``stall_s`` after the last pre-seal commit
+      (the barrier sat waiting on someone who never arrived).
+
+    Returns ``[{iteration, reason, waited_s?}]`` — empty is the
+    acceptance condition pst-trace verifies for the preemption-chaos
+    drives."""
+    out: list[dict] = []
+    for it in iterations_seen(events):
+        evs = [e for e in events if e["iteration"] == it]
+        if not any(e["event"] in ("step.start", "fused.start")
+                   for e in evs):
+            continue
+        pubs = [e for e in evs if e["event"] == "barrier.publish"]
+        if not pubs:
+            out.append({"iteration": it, "reason": "never published"})
+            continue
+        seals = [e for e in evs if e["event"] == "barrier.seal"]
+        commits = [e["ts"] for e in evs if e["event"] == "push.commit"]
+        if seals and commits:
+            pre = [ts for ts in commits if ts <= seals[0]["ts"]]
+            if pre:
+                waited = seals[0]["ts"] - max(pre)
+                if waited > stall_s:
+                    out.append({"iteration": it,
+                                "reason": f"seal waited {waited:.3f}s "
+                                          f"after the last commit",
+                                "waited_s": waited})
+    return out
+
+
 def failure_narrative(rings: list[dict], events: list[dict]) -> dict:
     """Dead processes, promotions, and same-iteration failover retries —
     the across-iterations story pst-trace leads with."""
@@ -343,7 +410,27 @@ def failure_narrative(rings: list[dict], events: list[dict]) -> dict:
         publish["last_version"] = swaps[-1]["a"]
     if lags:
         publish["max_lag"] = max(lags)
+    # elastic membership transitions (elastic/, ISSUE 13): who drained
+    # (ctl/SIGTERM/leave), who the reaper marked GONE, and how many
+    # quorum closes / forward folds the run saw
+    drains = [{"worker": e["worker"], "note": e["note"], "role": e["role"]}
+              for e in events if e["event"] == "elastic.drain"]
+    evicts = [{"worker": e["worker"]}
+              for e in events if e["event"] == "elastic.evict"]
+    quorum_closes = sum(1 for e in events if e["event"] == "quorum.seal")
+    stale_count = sum(1 for e in events if e["event"] == "stale.fold")
+    elastic: dict[str, Any] = {}
+    if drains:
+        elastic["drains"] = drains
+    if evicts:
+        elastic["evictions"] = evicts
+    if quorum_closes:
+        elastic["quorum_closes"] = quorum_closes
+    if stale_count:
+        elastic["stale_folds"] = stale_count
     out: dict[str, Any] = {}
+    if elastic:
+        out["membership"] = elastic
     if publish:
         out["publication"] = publish
     if dead:
@@ -436,6 +523,19 @@ def render_report(rep: dict) -> str:
                      f"{retry['to']} (shard {retry['shard']})")
     for d in narrative.get("degrades", ()):
         lines.append(f"  degrade: {d['what']} at {d['role']} ({d['note']})")
+    elastic = narrative.get("membership")
+    if elastic:
+        parts = []
+        for d in elastic.get("drains", ()):
+            parts.append(f"worker {d['worker']} drained"
+                         + (f" ({d['note']})" if d.get("note") else ""))
+        for e in elastic.get("evictions", ()):
+            parts.append(f"worker {e['worker']} evicted (reap)")
+        if elastic.get("quorum_closes"):
+            parts.append(f"{elastic['quorum_closes']} quorum closes")
+        if elastic.get("stale_folds"):
+            parts.append(f"{elastic['stale_folds']} stale folds")
+        lines.append(f"  membership: {', '.join(parts)}")
     publish = narrative.get("publication")
     if publish:
         parts = []
@@ -469,6 +569,19 @@ def render_report(rep: dict) -> str:
                 parts.append(f"upstream {_fmt_dt(g['upstream_s'])} "
                              f"({g.get('upstream_bytes', 0)} B quantized)")
             lines.append(f"  {_group_label(gid)}: {', '.join(parts)}")
+        quorum = tl.get("quorum")
+        if quorum:
+            outside = quorum.get("outside")
+            lines.append(
+                f"  QUORUM close: {quorum['contributors']}/"
+                f"{quorum['width']} contributors"
+                + (", left outside: "
+                   + ", ".join(f"worker {w}" for w in outside)
+                   if outside else ""))
+        for fold in tl.get("stale_folds", ()):
+            lines.append(f"  stale fold: worker {fold['worker']} carried "
+                         f"in at staleness {fold['staleness']} "
+                         f"({fold['tensors']} tensors, lr damped)")
         if "apply_s" in tl:
             lines.append(f"  optimizer apply: {_fmt_dt(tl['apply_s'])}")
         dserve = tl.get("delta_serve")

@@ -18,6 +18,7 @@ import json
 
 from ..config import CoordinatorConfig
 from ..core.coordinator_core import CoordinatorCore
+from ..elastic import messages as emsg
 from ..obs import flight
 from ..obs.export import ClusterAggregator
 from ..replication import messages as rmsg
@@ -80,8 +81,19 @@ class CoordinatorService:
     # call it (extra method name on the same service).
     def GetClusterMetrics(self, request: m.ClusterMetricsRequest,
                           context) -> m.ClusterMetricsResponse:
+        rollup = self.aggregator.rollup()
+        # membership rollup (elastic/, ISSUE 13): the epoch-numbered
+        # state table rides the same response, so pst-status --metrics
+        # and --watch render live/draining/gone without a second RPC
+        epoch, entries = self.core.membership()
+        if entries:
+            states: dict[str, int] = {}
+            for _wid, state, _ep in entries:
+                name = emsg.STATE_NAMES.get(state, f"state{state}")
+                states[name] = states.get(name, 0) + 1
+            rollup["membership"] = {"epoch": epoch, "states": states}
         return m.ClusterMetricsResponse(
-            rollup_json=json.dumps(self.aggregator.rollup(), default=float))
+            rollup_json=json.dumps(rollup, default=float))
 
     # ----------------------------------------------------------- replication
     # RPCs (framework extension, replication/): the epoch-numbered shard
@@ -108,6 +120,37 @@ class CoordinatorService:
         epoch, entries = self.core.promote_shard(request.shard_index,
                                                  request.observed_primary)
         return self._map_response(epoch, entries)
+
+    # ------------------------------------------------------------ membership
+    # RPC (framework extension, elastic/): announce-and-query of the
+    # epoch-numbered membership table.  Messages live OUTSIDE
+    # rpc/messages.py (wire manifest pinned); reference clients never
+    # call it.
+    def UpdateMembership(self, request: emsg.MembershipRequest,
+                         context) -> emsg.MembershipResponse:
+        ok, message = True, "ok"
+        wid = int(request.worker_id)
+        if request.action == emsg.MEMBER_JOIN:
+            self.core.member_join(wid)
+            log.info("worker %d membership: ACTIVE", wid)
+        elif request.action == emsg.MEMBER_LEAVE:
+            self.core.deregister_worker(wid)
+            log.info("worker %d membership: left (GONE)", wid)
+        elif request.action == emsg.MEMBER_DRAIN:
+            target = int(request.target_worker_id)
+            if target < 0:
+                target = wid
+            ok = self.core.drain_worker(target)
+            message = (f"worker {target} draining" if ok
+                       else f"worker {target} unknown or already gone")
+            log.warning("drain request for worker %d: %s", target, message)
+        epoch, entries = self.core.membership()
+        self_state = self.core.member_state(wid)
+        return emsg.MembershipResponse(
+            epoch=epoch, success=ok, message=message,
+            self_state=self_state if self_state is not None else -1,
+            entries=[emsg.MembershipEntry(worker_id=w, state=s, epoch=e)
+                     for w, s, e in entries])
 
     # ----------------------------------------------------------------- tiers
     # RPC (framework extension, tiers/): register-and-query of the
@@ -151,7 +194,8 @@ class Coordinator:
         bind_service(self._server, m.COORDINATOR_SERVICE,
                      {**m.COORDINATOR_METHODS, **m.COORDINATOR_EXT_METHODS,
                       **rmsg.REPLICATION_COORD_METHODS,
-                      **tmsg.TIER_COORD_METHODS},
+                      **tmsg.TIER_COORD_METHODS,
+                      **emsg.ELASTIC_COORD_METHODS},
                      self.service)
         addr = f"{self.config.bind_address}:{self.config.port}"
         self._port = self._server.add_insecure_port(addr)

@@ -942,6 +942,11 @@ class ParameterServer:
             # TierContributionProvider): a leaf aggregator's ONE upstream
             # push counts as its whole group on the barrier
             contributions_fn=contributions_fn,
+            # K-of-N quorum close (elastic/quorum.py, ISSUE 13); 0/-1
+            # defer to the PSDT_QUORUM / PSDT_QUORUM_GRACE_MS env
+            quorum=config.quorum or None,
+            quorum_grace_ms=(config.quorum_grace_ms
+                             if config.quorum_grace_ms >= 0 else None),
         )
         self.ckpt = CheckpointManager(
             self.core,

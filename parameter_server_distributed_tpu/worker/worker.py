@@ -143,6 +143,16 @@ class Worker:
             thread_name_prefix=f"worker-{config.worker_id}-prefetch")
         self._prefetched: concurrent.futures.Future | None = None
         self._stop = threading.Event()
+        # Elastic membership (elastic/, ISSUE 13): announce join after
+        # registration, poll own state at heartbeat cadence (a
+        # coordinator-side `pst-ctl drain` flips it to DRAINING), and
+        # announce leave at shutdown so the barrier narrows immediately
+        # instead of waiting out a stale-heartbeat reap.  None until
+        # discovery; a reference coordinator latches it unsupported.
+        self._membership = None
+        # graceful-preemption latch (SIGTERM handler / drain poll): the
+        # run loop finishes the in-flight iteration, then stops
+        self._drain = threading.Event()
         if flight.enabled():
             # label this process's flight ring (real multi-process runs;
             # in-process test topologies share one ring, last label wins)
@@ -164,7 +174,26 @@ class Worker:
         """reference: src/worker.cpp:124-127."""
         self.initialize()
 
+    def request_drain(self) -> None:
+        """Graceful-preemption request (SIGTERM handler, or the
+        coordinator's DRAINING state seen by the heartbeat poll): finish
+        the in-flight iteration, then stop.  Safe from any thread."""
+        if not self._drain.is_set():
+            self._drain.set()
+            flight.record("elastic.drain", worker=self.config.worker_id,
+                          note="worker")
+
+    @property
+    def drain_requested(self) -> bool:
+        return self._drain.is_set()
+
     def shutdown(self) -> None:
+        if self._stop.is_set():
+            # idempotent: drain flows (graceful preemption) shut a
+            # worker down as soon as it leaves, and the owning harness
+            # routinely shuts everything down again on exit — a second
+            # call must not touch the already-closed channels
+            return
         self._stop.set()
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=2.0)
@@ -173,6 +202,16 @@ class Worker:
         # runs would leave the coordinator's rollup missing the tail
         # since the last periodic beat (obs/export.py piggyback)
         self.send_heartbeat()
+        if self._membership is not None:
+            # graceful deregistration: the registry drops us NOW and the
+            # elastic barrier narrows at the next width refresh (the
+            # membership generation bump makes that immediate) instead
+            # of a 30 s stale-heartbeat reap
+            try:
+                self._membership.leave()
+            finally:
+                self._membership.close()
+                self._membership = None
         self._prefetch_pool.shutdown(wait=False)
         if self._tier is not None:
             self._tier.close()
@@ -301,6 +340,33 @@ class Worker:
         self._total_workers = resp.total_workers
         log.info("worker %d registered (%d total)", self.config.worker_id,
                  resp.total_workers)
+        self._announce_join()
+
+    def _announce_join(self) -> None:
+        """Membership join announce (elastic/, ISSUE 13): JOINING ->
+        ACTIVE at the coordinator.  Builds the client lazily; a
+        reference coordinator answers UNIMPLEMENTED and the client
+        latches unsupported — membership stays advisory."""
+        if self._membership is None:
+            from ..elastic.membership import MembershipClient
+            self._membership = MembershipClient(
+                self.config.coordinator_address, self.config.worker_id)
+        self._membership.join()
+
+    def _poll_drain(self) -> None:
+        """Heartbeat-cadence membership poll: a coordinator-side
+        ``pst-ctl drain`` marked us DRAINING — latch the graceful
+        preemption so the run loop stops after the in-flight
+        iteration."""
+        if self._membership is None or self._membership.supported is False \
+                or self._drain.is_set():
+            return
+        from ..elastic import messages as emsg
+        state = self._membership.poll_state()
+        if state == emsg.MEMBER_DRAINING:
+            log.warning("worker %d: coordinator requested drain",
+                        self.config.worker_id)
+            self.request_drain()
 
     # -------------------------------------------------------------- retries
     def query_with_retry(self, fn: Callable, attempts: int | None = None):
@@ -327,6 +393,7 @@ class Worker:
         again — the reference never calls its own reconnect()."""
         while not self._stop.wait(self.config.heartbeat_period_s):
             ok = self.send_heartbeat()
+            self._poll_drain()
             if ok is False and self._total_workers > 0:
                 log.warning("worker %d: heartbeat rejected, re-registering",
                             self.config.worker_id)
@@ -958,9 +1025,18 @@ class Worker:
         return f"{resp.workers_received}/{resp.total_workers} received"
 
     def run(self, iterations: int | None = None) -> None:
-        """Full training run (reference: src/worker_main.cpp:40-43)."""
+        """Full training run (reference: src/worker_main.cpp:40-43).
+        A drain request (SIGTERM / ``pst-ctl drain``) stops the loop
+        BETWEEN iterations: the in-flight iteration completes — its
+        barrier contribution is never abandoned half-streamed — and the
+        caller's shutdown() deregisters so the barrier narrows."""
         total = iterations if iterations is not None else self.config.iterations
         for i in range(total):
+            if self._drain.is_set():
+                log.warning("worker %d: draining — stopping after "
+                            "iteration %d", self.config.worker_id,
+                            self.iteration)
+                break
             # async fast-forwards may skip numbers; never re-push a completed
             # iteration
             it = max(i, self.iteration + 1)

@@ -422,6 +422,30 @@ def test_delta_extension_stays_out_of_the_wire_manifest():
         | set(m.PARAMETER_SERVER_STREAM_METHODS))
 
 
+def test_elastic_extension_stays_out_of_the_wire_manifest():
+    """ISSUE 13 compat gate: the elastic-membership extension
+    (elastic/messages.py) must leave the reference wire manifest
+    byte-unchanged — its messages and the UpdateMembership method must
+    never appear in the pinned contract, and the committed golden must
+    still match the live schemas bit for bit."""
+    import json
+
+    from parameter_server_distributed_tpu.analysis import wirecheck
+    from parameter_server_distributed_tpu.elastic import messages as emsg
+
+    with open(wirecheck.default_manifest_path()) as fh:
+        golden = json.loads(fh.read())
+    assert wirecheck.diff_manifests(golden, wirecheck.build_manifest()) == []
+    blob = json.dumps(golden)
+    for name in ("MembershipEntry", "MembershipRequest",
+                 "MembershipResponse", "UpdateMembership"):
+        assert name not in blob, f"elastic extension leaked: {name}"
+    # and the extension method table really is disjoint from the pinned
+    # coordinator contract
+    from parameter_server_distributed_tpu.rpc import messages as m
+    assert not set(emsg.ELASTIC_COORD_METHODS) & set(m.COORDINATOR_METHODS)
+
+
 def test_cli_json_output_and_exit_codes(tmp_path, capsys):
     assert analyze_main.main(["--json"]) == 0
     doc = json.loads(capsys.readouterr().out)

@@ -144,6 +144,29 @@ def test_bench_delta_contract():
     assert result["publish_p50_ms"] > 0
 
 
+def test_bench_elastic_contract():
+    """elastic mode (ISSUE 13): healthy-worker iteration wall p50 under
+    a K-of-N quorum vs all-of-N with one netsim-delayed straggler — the
+    quorum arm must actually quorum-close (and fold the straggler
+    forward), and its p50 must beat the all-of-N arm, which pays the
+    straggler's injected delay on every barrier."""
+    result = run_bench("elastic", extra_env={
+        "PSDT_BENCH_PARAMS": "1e5",
+        "PSDT_BENCH_STEPS": "5",
+        "PSDT_BENCH_STRAGGLER_MS": "250",
+        "PSDT_BENCH_GRACE_MS": "80",
+    })
+    assert result["metric"] == "ps_elastic_iter_wall_p50_ms_quorum"
+    assert result["value"] > 0
+    assert result["quorum"]["quorum_closes"] > 0
+    assert result["quorum"]["stale_folds"] > 0
+    assert result["all_of_n"]["quorum_closes"] == 0
+    # the quorum exists to cut the straggler's delay out of the healthy
+    # workers' iteration wall: K-of-N p50 strictly under all-of-N p50
+    assert (result["quorum"]["iter_wall_p50_ms"]
+            < result["all_of_n"]["iter_wall_p50_ms"]), result["note"]
+
+
 @pytest.mark.slow
 def test_bench_replicate_contract():
     """replicate mode: barrier-close overhead off/async/sync replication,

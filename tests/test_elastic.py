@@ -66,11 +66,14 @@ def test_scale_down_without_ps_restart(elastic_cluster):
         params_before = ps.core.get_parameters()
         assert params_before  # PS holds state
 
-        # worker 1 leaves; coordinator evicts it; w0 continues ALONE at the
-        # same PS (barrier shrank 2 -> 1, params preserved)
+        # worker 1 leaves; since ISSUE 13 its shutdown announces a
+        # graceful membership LEAVE, so it is deregistered immediately —
+        # the reap finds nothing of it to evict; w0 continues ALONE at
+        # the same PS (barrier shrank 2 -> 1, params preserved)
         w1.shutdown()
+        assert coordinator.core.live_worker_count() == 1
         evicted = coordinator.core.remove_stale_workers(timeout_s=-1)
-        assert 1 in evicted
+        assert 1 not in evicted  # already gone via the leave announce
         coordinator.core.register_worker(0, "127.0.0.1", 50080, "h0")
         for it in range(3, 5):
             w0.run_iteration(it)
@@ -198,6 +201,209 @@ def test_grow_mid_barrier_parks_until_all_new_workers_push():
     r2 = core.receive_gradients(2, 1, {"w": np.array([3.0], np.float32)})
     assert r2.aggregation_complete and r2.workers_received == 3
     np.testing.assert_allclose(core.get_parameters()["w"], [1.0])
+
+
+def test_reap_generation_invalidates_width_cache_immediately():
+    """ISSUE 13 satellite: a reaped worker used to shrink the barrier
+    only when live_workers_ttl_s lapsed.  A generation-aware provider
+    (``.generation`` attribute) invalidates the single-flight TTL cache
+    the instant the registry generation moves."""
+
+    class GenRegistry:
+        def __init__(self):
+            self.live = 2
+            self.gen = 0
+            self.calls = 0
+
+        def __call__(self):
+            self.calls += 1
+            return self.live
+
+        def generation(self):
+            return self.gen
+
+    from parameter_server_distributed_tpu.core.optimizer import SGD
+    from parameter_server_distributed_tpu.core.ps_core import (
+        ParameterServerCore)
+
+    reg = GenRegistry()
+    core = ParameterServerCore(total_workers=99, optimizer=SGD(1.0),
+                               live_workers_fn=reg,
+                               live_workers_ttl_s=3600.0)
+    assert core.barrier_width() == 2
+    for _ in range(20):
+        core.barrier_width()
+    assert reg.calls == 1  # TTL cache, same generation
+    # eviction: generation bump makes the NEXT width read refresh —
+    # no TTL lapse, no manual cache poke
+    reg.live = 1
+    reg.gen += 1
+    assert core.barrier_width() == 1
+    assert reg.calls == 2
+
+
+def test_coordinator_width_provider_reflects_eviction_without_ttl():
+    """CoordinatorCore.width_provider(): the in-process generation-aware
+    provider — a reap narrows a long-TTL barrier immediately."""
+    from parameter_server_distributed_tpu.core.coordinator_core import (
+        CoordinatorCore)
+
+    coord = CoordinatorCore("127.0.0.1", 1)
+    coord.register_worker(0, "127.0.0.1", 50080, "h0")
+    coord.register_worker(1, "127.0.0.1", 50081, "h1")
+    core = _core(coord.width_provider(), ttl=3600.0)
+    assert core.barrier_width() == 2
+    coord.register_worker(0, "127.0.0.1", 50080, "h0")  # heartbeat upsert
+    assert core.barrier_width() == 2  # re-registration: no live change
+    evicted = coord.remove_stale_workers(timeout_s=-1)
+    assert sorted(evicted) == [0, 1]
+    assert core.barrier_width() == 99  # live 0 -> static fallback, NOW
+    coord.register_worker(2, "127.0.0.1", 50082, "h2")
+    assert core.barrier_width() == 1
+
+
+def test_membership_epoch_transitions():
+    """Membership is epoch-numbered: every JOINING/ACTIVE/DRAINING/GONE
+    transition bumps the epoch; no-op announces do not."""
+    from parameter_server_distributed_tpu.core.coordinator_core import (
+        CoordinatorCore)
+    from parameter_server_distributed_tpu.elastic import messages as emsg
+
+    coord = CoordinatorCore("127.0.0.1", 1)
+    epoch0, entries = coord.membership()
+    assert entries == []
+    coord.register_worker(0, "127.0.0.1", 50080, "h0")
+    assert coord.member_state(0) == emsg.MEMBER_JOINING
+    e1, _ = coord.membership()
+    assert e1 == epoch0 + 1
+    coord.member_join(0)
+    assert coord.member_state(0) == emsg.MEMBER_ACTIVE
+    e2, _ = coord.membership()
+    assert e2 == e1 + 1
+    coord.member_join(0)  # duplicate announce: no transition
+    assert coord.membership()[0] == e2
+    assert coord.drain_worker(0)
+    assert coord.member_state(0) == emsg.MEMBER_DRAINING
+    # DRAINING keeps the registry entry — the in-flight iteration's
+    # barrier slot survives until the worker leaves
+    assert coord.live_worker_count() == 1
+    assert coord.deregister_worker(0)
+    assert coord.member_state(0) == emsg.MEMBER_GONE
+    assert coord.live_worker_count() == 0
+    # draining an unknown/gone worker is refused
+    assert not coord.drain_worker(0)
+    assert not coord.drain_worker(42)
+    # rejoin after GONE: back through JOINING
+    coord.register_worker(0, "127.0.0.1", 50080, "h0")
+    assert coord.member_state(0) == emsg.MEMBER_JOINING
+
+
+def test_reap_marks_member_gone():
+    from parameter_server_distributed_tpu.core.coordinator_core import (
+        CoordinatorCore)
+    from parameter_server_distributed_tpu.elastic import messages as emsg
+
+    coord = CoordinatorCore("127.0.0.1", 1)
+    coord.register_worker(0, "127.0.0.1", 50080, "h0")
+    coord.member_join(0)
+    gen = coord.registry_generation()
+    assert coord.remove_stale_workers(timeout_s=-1) == [0]
+    assert coord.member_state(0) == emsg.MEMBER_GONE
+    assert coord.registry_generation() == gen + 1
+
+
+def test_membership_rpc_roundtrip_and_ctl_drain(elastic_cluster):
+    """UpdateMembership over real gRPC: join announce, pst-ctl drain
+    visible to the worker's poll, graceful leave narrowing the live
+    count immediately (no reap, no TTL)."""
+    from parameter_server_distributed_tpu.elastic import messages as emsg
+    from parameter_server_distributed_tpu.elastic.membership import (
+        MembershipClient)
+
+    ps, coordinator, coord_port = elastic_cluster
+    addr = f"127.0.0.1:{coord_port}"
+    coordinator.core.register_worker(7, "127.0.0.1", 50087, "h7")
+    client = MembershipClient(addr, worker_id=7)
+    try:
+        resp = client.join()
+        assert resp is not None and client.supported
+        assert resp.self_state == emsg.MEMBER_ACTIVE
+        assert [(e.worker_id, e.state) for e in resp.entries] == [
+            (7, emsg.MEMBER_ACTIVE)]
+
+        # pst-ctl path: a second client drains worker 7
+        ctl = MembershipClient(addr)
+        try:
+            dresp = ctl.drain(7)
+            assert dresp is not None and dresp.success
+        finally:
+            ctl.close()
+        assert client.poll_state() == emsg.MEMBER_DRAINING
+        assert coordinator.core.live_worker_count() == 1
+
+        # graceful leave: registry narrows NOW
+        lresp = client.leave()
+        assert lresp is not None
+        assert coordinator.core.live_worker_count() == 0
+        assert coordinator.core.member_state(7) == emsg.MEMBER_GONE
+    finally:
+        client.close()
+
+
+def test_ctl_main_drain_and_members(elastic_cluster, capsys):
+    from parameter_server_distributed_tpu.cli.ctl_main import main as ctl_main
+
+    _ps, coordinator, coord_port = elastic_cluster
+    addr = f"127.0.0.1:{coord_port}"
+    coordinator.core.register_worker(3, "127.0.0.1", 50083, "h3")
+    coordinator.core.member_join(3)
+    assert ctl_main(["members", addr]) == 0
+    out = capsys.readouterr().out
+    assert "worker 3: active" in out
+    assert ctl_main(["drain", "3", addr]) == 0
+    out = capsys.readouterr().out
+    assert "draining" in out
+    assert ctl_main(["drain", "99", addr]) == 1  # unknown worker
+    assert ctl_main([]) == 2
+
+
+def test_worker_drain_and_leave_shrinks_barrier(elastic_cluster):
+    """Graceful preemption end to end: request_drain() stops the run
+    loop between iterations, shutdown() announces leave, and the next
+    barrier closes at the narrowed width with no reap involved."""
+    ps, coordinator, coord_port = elastic_cluster
+    w0, w1 = _worker(coord_port, 0), _worker(coord_port, 1)
+    try:
+        done = []
+
+        def loop(w):
+            for it in range(2):
+                w.run_iteration(it)
+            done.append(w.config.worker_id)
+
+        threads = [threading.Thread(target=loop, args=(w,)) for w in (w0, w1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert sorted(done) == [0, 1]
+
+        # drain worker 1: the run loop would stop before the next
+        # iteration; shutdown() announces leave (deregistration)
+        w1.request_drain()
+        assert w1.drain_requested
+        w1.run(iterations=5)  # drain latched: runs ZERO iterations
+        assert w1.iteration == 1
+        w1.shutdown()
+        assert coordinator.core.live_worker_count() == 1
+        from parameter_server_distributed_tpu.elastic import messages as emsg
+        assert coordinator.core.member_state(1) == emsg.MEMBER_GONE
+        # w0 continues alone at the same PS: barrier narrowed 2 -> 1
+        for it in range(2, 4):
+            w0.run_iteration(it)
+        assert ps.core.current_iteration == 3
+    finally:
+        w0.shutdown()
 
 
 def test_churn_register_evict_reregister_with_ttl():

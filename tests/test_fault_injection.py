@@ -82,7 +82,14 @@ def test_worker_crash_mid_barrier_releases_survivor(tmp_path):
         push = w0.push_gradients(1, grads)
         assert not push.aggregation_complete and push.workers_received == 1
 
-        # CRASH: worker 1 dies without pushing; reaper evicts it
+        # CRASH: worker 1 dies without pushing; reaper evicts it.  A
+        # crash never announces the graceful membership LEAVE that a
+        # clean shutdown() sends since ISSUE 13 — silence it so this
+        # stays the reap-release path (the leave path is covered in
+        # tests/test_elastic.py)
+        if w1._membership is not None:
+            w1._membership.close()
+            w1._membership = None
         w1.shutdown()
         w1 = None
         evicted = coordinator.core.remove_stale_workers(timeout_s=-1)

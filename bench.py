@@ -2419,6 +2419,201 @@ def bench_serve() -> dict:
             "vs_baseline": vs_baseline}
 
 
+def bench_fleet() -> dict:
+    """Decode fleet scaling (fleet/, ISSUE 14): sustained streams/s and
+    p99 time-to-first-token vs fleet size under a synthetic OPEN-LOOP
+    load generator — arrivals fire on a fixed schedule regardless of
+    service progress (the router queues what the fleet cannot absorb),
+    every stream rides loopback gRPC through the FleetRouter, and each
+    fleet size gets its own coordinator + servers + router.
+
+    Each decode server is a real ``pst-serve --serve-port`` SUBPROCESS
+    (its own interpreter and jax runtime): colocated in-process servers
+    would share one GIL + dispatch lock and could never scale, and the
+    subprocess shape is exactly the production deployment.
+
+    PSDT_BENCH_FLEET_SIZES (default "1,2"), PSDT_BENCH_SLOTS (4),
+    PSDT_BENCH_STEPS = tokens per stream (8), PSDT_BENCH_REQUESTS =
+    streams per size (3x slots x size), PSDT_BENCH_ARRIVAL_HZ (default
+    sized to oversubscribe one server), PSDT_BENCH_MODEL (tiny_lm)."""
+    import threading
+
+    import numpy as np
+
+    from parameter_server_distributed_tpu.config import CoordinatorConfig
+    from parameter_server_distributed_tpu.fleet import messages as fmsg
+    from parameter_server_distributed_tpu.fleet.router import FleetRouter
+    from parameter_server_distributed_tpu.models.registry import (
+        get_model_and_batches)
+    from parameter_server_distributed_tpu.rpc.service import RpcClient
+    from parameter_server_distributed_tpu.server.coordinator_service \
+        import Coordinator
+
+    name = os.environ.get("PSDT_BENCH_MODEL", "tiny_lm")
+    slots = int(os.environ.get("PSDT_BENCH_SLOTS", "4"))
+    per_req = int(os.environ.get("PSDT_BENCH_STEPS", "8"))
+    sizes = [int(s) for s in os.environ.get(
+        "PSDT_BENCH_FLEET_SIZES", "1,2").split(",") if s]
+    model, _ = get_model_and_batches(name, slots)
+    vocab = model.config.vocab
+    rng = np.random.default_rng(0)
+    rows: dict[str, dict] = {}
+    child_env = dict(os.environ)
+    child_env["JAX_PLATFORMS"] = "cpu"  # fleet is a host-only bench
+    # Synthetic per-round service time (netsim-style, the elastic
+    # bench's straggler-delay trick): per-server capacity becomes
+    # sleep-bound, so the CONTROL PLANE's scaling shows even when every
+    # decode subprocess shares this host's few cores.
+    # PSDT_BENCH_ROUND_DELAY_MS=0 measures raw host decode instead.
+    round_delay_ms = os.environ.get("PSDT_BENCH_ROUND_DELAY_MS", "20")
+    child_env["PSDT_DECODE_ROUND_DELAY_MS"] = round_delay_ms
+    # one arrival schedule for EVERY fleet size (calibrated on the first
+    # size's warmup stream): the open-loop offered load is the constant,
+    # fleet size the variable — recalibrating per size would let warm
+    # compile caches inflate the bigger fleets' offered rate
+    arrival_hz = float(os.environ.get("PSDT_BENCH_ARRIVAL_HZ", "0"))
+
+    for size in sizes:
+        n_req = int(os.environ.get("PSDT_BENCH_REQUESTS",
+                                   str(3 * slots * size)))
+        coordinator = Coordinator(CoordinatorConfig(
+            bind_address="127.0.0.1", port=0))
+        cport = coordinator.start()
+        caddr = f"127.0.0.1:{cport}"
+        servers = [subprocess.Popen(
+            [sys.executable, "-m",
+             "parameter_server_distributed_tpu.cli.serve_main",
+             f"--model={name}", f"--slots={slots}", "--max-len=128",
+             "--prompt-cache=4", "--serve-port=0",
+             f"--coordinator={caddr}", f"--server-id={sid}"],
+            env=child_env, stdin=subprocess.DEVNULL,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            for sid in range(size)]
+        deadline = time.time() + 180.0
+        while time.time() < deadline:
+            _e, table, _t = coordinator.core.fleet_table()
+            if sum(1 for f in table
+                   if f.state == fmsg.MEMBER_ACTIVE) == size:
+                break
+            time.sleep(0.2)
+        else:
+            raise RuntimeError(f"fleet of {size} never registered")
+        router = FleetRouter(caddr, poll_s=0.1)
+        rport = router.start()
+        client = RpcClient(f"127.0.0.1:{rport}", fmsg.DECODE_SERVICE,
+                           fmsg.DECODE_METHODS)
+        prompts = [rng.integers(1, vocab, 8).tolist()
+                   for _ in range(n_req)]
+        ttfts: list[float] = []
+        failures: list[str] = []
+        lock = threading.Lock()
+
+        def drive(prompt):
+            t0 = time.perf_counter()
+            first = None
+            try:
+                for chunk in client.call(
+                        "SubmitStream",
+                        fmsg.DecodeRequest(tokens=prompt,
+                                           max_new=per_req,
+                                           temperature=-1.0),
+                        timeout=None):
+                    if first is None and not chunk.done:
+                        first = time.perf_counter() - t0
+                    if chunk.error:
+                        with lock:
+                            failures.append(chunk.error)
+                        return
+            except Exception as exc:  # noqa: BLE001 — a failed stream is
+                # this bench's signal, not its crash
+                with lock:
+                    failures.append(repr(exc))
+                return
+            with lock:
+                ttfts.append(first if first is not None else 0.0)
+
+        # warmup: 2x size CONCURRENT streams so the router's claim
+        # spreading touches EVERY server — each pays its jit compiles
+        # outside the measurement (a single warmup stream would warm
+        # only the best-scoring server and the others would compile on
+        # their first measured request)
+        warm = [threading.Thread(target=drive,
+                                 args=(rng.integers(1, vocab, 8).tolist(),),
+                                 daemon=True, name=f"fleet-warm-{i}")
+                for i in range(2 * size)]
+        for thread in warm:
+            thread.start()
+        for thread in warm:
+            thread.join(timeout=180.0)
+        ttfts.clear()
+        failures.clear()
+        # the FIRST size also calibrates the shared arrival rate: one
+        # server's sustained capacity is ~slots/service_time (slots
+        # streams in flight, each holding a slot for ~service_time), so
+        # 1.5x the LARGEST fleet's aggregate capacity oversubscribes
+        # every size — the small fleets are service-limited (the
+        # streams/s scaling signal) and the big ones show the queueing
+        # p99 TTFT collapse
+        t0 = time.perf_counter()
+        drive(prompts[0])
+        service_s = max(1e-3, time.perf_counter() - t0)
+        ttfts.clear()
+        failures.clear()  # calibration/warmup outcomes are unmeasured
+        if arrival_hz <= 0:
+            arrival_hz = 1.5 * max(sizes) * slots / service_s
+        threads = []
+        wall0 = time.perf_counter()
+        for i, prompt in enumerate(prompts[1:]):
+            target = wall0 + i / arrival_hz
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            thread = threading.Thread(target=drive, args=(prompt,),
+                                      daemon=True,
+                                      name=f"fleet-bench-{i}")
+            thread.start()
+            threads.append(thread)
+        for thread in threads:
+            thread.join(timeout=120.0)
+        wall = time.perf_counter() - wall0
+        completed = len(ttfts)
+        rows[str(size)] = {
+            "servers": size,
+            "streams": completed,
+            "failed": len(failures),
+            "streams_per_s": round(completed / wall, 2) if wall else 0.0,
+            "ttft_p50_ms": round(1e3 * float(np.percentile(ttfts, 50)), 1)
+            if ttfts else 0.0,
+            "ttft_p99_ms": round(1e3 * float(np.percentile(ttfts, 99)), 1)
+            if ttfts else 0.0,
+            "arrival_hz": round(arrival_hz, 2),
+        }
+        log(f"bench_fleet size {size}: {rows[str(size)]}")
+        client.close()
+        router.stop()
+        for server in servers:
+            server.terminate()  # SIGTERM = graceful drain-and-exit
+        for server in servers:
+            try:
+                server.wait(timeout=20.0)
+            except subprocess.TimeoutExpired:
+                server.kill()
+        coordinator.stop()
+
+    biggest = rows[str(sizes[-1])]
+    smallest = rows[str(sizes[0])]
+    scaling = (biggest["streams_per_s"] / smallest["streams_per_s"]
+               if smallest["streams_per_s"] else 0.0)
+    return {"metric": f"fleet_streams_per_s_x{sizes[-1]}",
+            "value": biggest["streams_per_s"], "unit": "streams/sec",
+            "vs_baseline": round(scaling, 3),
+            "sizes": rows,
+            "note": f"streams/s scaling {scaling:.2f}x from fleet size "
+                    f"{sizes[0]} to {sizes[-1]} "
+                    f"({smallest['streams_per_s']} -> "
+                    f"{biggest['streams_per_s']})"}
+
+
 def bench_async() -> dict:
     """End-to-end async/bounded-staleness throughput: real PS + coordinator
     over localhost gRPC, N worker threads training a real model on the
@@ -2599,6 +2794,8 @@ def child_main(mode: str) -> int:
             result = bench_generate()
         elif mode == "serve":
             result = bench_serve()
+        elif mode == "fleet":
+            result = bench_fleet()
         elif mode == "attention":
             result = bench_attention()
         else:
@@ -2701,7 +2898,7 @@ def main() -> int:
     # directly rather than risking a flaky TPU init.
     plans: list[tuple[str, float]]
     if mode in ("pushpull", "dataplane", "aggregate", "apply", "codec",
-                "replicate", "obs", "tier", "elastic"):
+                "replicate", "obs", "tier", "elastic", "fleet"):
         plans = [("cpu", cpu_timeout)]
     else:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]

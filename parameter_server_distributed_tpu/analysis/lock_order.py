@@ -119,6 +119,12 @@ LOCK_RANKS: dict[str, int] = {
     # the bounded handler pool is sized against
     "ParameterServerService._sub_lock": 63,
     "trainer._DISPATCH_LOCK": 64,
+    # colocated decode servers' jax-dispatch serializer (fleet/decode.py,
+    # ISSUE 14): the serving twin of trainer._DISPATCH_LOCK — concurrent
+    # dispatch deadlocks the CPU client when several FleetDecodeServers
+    # share a process (tests, bench); uncontended one-per-process in
+    # production.  Leaf; the dispatch under it is its purpose.
+    "decode._DISPATCH_LOCK": 65,
     "native._lock": 66,
     # single-flight creation of the shared stripe executor
     "stripes._pool_lock": 68,
@@ -132,6 +138,15 @@ LOCK_RANKS: dict[str, int] = {
     # pst-status --watch snapshot ring (obs/stats.py): leaf, guards only
     # the bounded deque of timestamped snapshots
     "TimeSeriesRing._lock": 72,
+    # decode fleet control plane (fleet/, ISSUE 14).  The fleet server's
+    # lock guards its version store / rollback pin / stream bookkeeping
+    # (leaf — dict ops only; swaps run on the decode thread with NO lock
+    # held).  The router's lock guards its backend table / claims /
+    # client cache AND the poll-in-flight flag (leaf: the UpdateFleet
+    # poll itself runs with no lock held — admissions route on the
+    # stale table instead of queueing behind a coordinator RPC).
+    "FleetDecodeServer._lock": 74,
+    "FleetRouter._lock": 75,
 }
 
 # Locks that exist to serialize a blocking section: the static
@@ -166,6 +181,9 @@ BLOCKING_ALLOWED: frozenset[str] = frozenset({
     # serializes flight-ring creation/teardown (mmap + file I/O is the
     # lock's purpose; the record() hot path never takes it)
     "FlightRecorder._lock",
+    # serializes jax dispatch across colocated decode servers — the
+    # dispatch under it IS the serialized section (fleet/decode.py)
+    "decode._DISPATCH_LOCK",
 })
 
 ENV_FLAG = "PSDT_LOCK_CHECK"

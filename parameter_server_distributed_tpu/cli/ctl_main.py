@@ -1,7 +1,11 @@
-"""``pst-ctl``: cluster membership control (elastic/, ISSUE 13).
+"""``pst-ctl``: cluster membership + decode fleet control (elastic/,
+ISSUE 13; fleet/, ISSUE 14).
 
     pst-ctl drain <worker_id> [coordinator_addr]
     pst-ctl members [coordinator_addr]
+    pst-ctl fleet [coordinator_addr]
+    pst-ctl fleet-drain <server_id> [coordinator_addr]
+    pst-ctl scale <n> [coordinator_addr]
 
 ``drain`` asks the coordinator to mark the worker DRAINING: the worker
 sees its own state on its next heartbeat-cadence membership poll,
@@ -12,20 +16,70 @@ failed steps, no SSH to the worker host needed.
 ``members`` prints the epoch-numbered membership table
 (joining/active/draining/gone per worker).
 
-Degrades gracefully against a reference coordinator, which does not
-implement the ``UpdateMembership`` extension RPC.
+``fleet`` prints the decode fleet table (state, slots free/total, queue
+depth, serving weight version per server — the rows the router scores
+on); ``fleet-drain`` is the serving twin of ``drain`` (the server stops
+admitting, finishes its in-flight streams, and leaves — scale-in's
+drain-before-stop step); ``scale <n>`` sets the manual fleet-size
+target (0 hands control back to the autoscaler's watermarks).
+
+Degrades gracefully against a reference coordinator, which implements
+neither extension RPC.
 """
 
 from __future__ import annotations
 
 import sys
 
+import grpc
+
 from ..config import parse_argv
 from ..elastic import messages as emsg
 from ..elastic.membership import MembershipClient
+from ..fleet import messages as fmsg
+from ..rpc import messages as m
+from ..rpc.service import RpcClient
+from ..rpc.service import status_code as _status_code
 
 USAGE = ("usage: pst-ctl drain <worker_id> [coordinator_addr]\n"
-         "       pst-ctl members [coordinator_addr]")
+         "       pst-ctl members [coordinator_addr]\n"
+         "       pst-ctl fleet [coordinator_addr]\n"
+         "       pst-ctl fleet-drain <server_id> [coordinator_addr]\n"
+         "       pst-ctl scale <n> [coordinator_addr]")
+
+
+def _fleet_call(coordinator: str,
+                request: fmsg.FleetRequest) -> fmsg.FleetResponse | None:
+    """One UpdateFleet round trip; None (after printing the downgrade
+    message every fleet subcommand shares) when the coordinator lacks
+    the extension (reference build)."""
+    client = RpcClient(coordinator, m.COORDINATOR_SERVICE,
+                       fmsg.FLEET_COORD_METHODS)
+    try:
+        return client.call("UpdateFleet", request, timeout=5.0)
+    except grpc.RpcError as exc:
+        if _status_code(exc) == grpc.StatusCode.UNIMPLEMENTED:
+            print("fleet unavailable: coordinator does not implement "
+                  "UpdateFleet (reference build?)", file=sys.stderr)
+            return None
+        raise
+    finally:
+        client.close()
+
+
+def _print_fleet(resp: fmsg.FleetResponse) -> None:
+    target = (f", target {resp.scale_target}" if resp.scale_target
+              else ", autoscale")
+    print(f"fleet epoch {resp.epoch} ({len(resp.entries)} servers"
+          f"{target})")
+    for entry in resp.entries:
+        state = fmsg.STATE_NAMES.get(int(entry.state),
+                                     f"state{entry.state}")
+        print(f"  server {entry.server_id} [{entry.address}]: {state}, "
+              f"{entry.free_slots}/{entry.slots} slots free, "
+              f"queue {entry.queue_depth}, "
+              f"version {entry.weight_version}, "
+              f"{entry.active_streams} streams")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -78,6 +132,45 @@ def main(argv: list[str] | None = None) -> int:
             print(f"  worker {entry.worker_id}: {state} "
                   f"(since epoch {entry.epoch})")
         return 0
+
+    if command == "fleet":
+        coordinator = positional[1] if len(positional) > 1 \
+            else "127.0.0.1:50052"
+        resp = _fleet_call(coordinator, fmsg.FleetRequest(
+            server_id=-1, action=fmsg.FLEET_QUERY))
+        if resp is None:
+            return 1
+        _print_fleet(resp)
+        return 0
+
+    if command == "fleet-drain":
+        if len(positional) < 2:
+            print(USAGE, file=sys.stderr)
+            return 2
+        target = int(positional[1])
+        coordinator = positional[2] if len(positional) > 2 \
+            else "127.0.0.1:50052"
+        resp = _fleet_call(coordinator, fmsg.FleetRequest(
+            server_id=-1, action=fmsg.FLEET_DRAIN,
+            target_server_id=target))
+        if resp is None:
+            return 1
+        print(f"{resp.message} (fleet epoch {resp.epoch})")
+        return 0 if resp.success else 1
+
+    if command == "scale":
+        if len(positional) < 2:
+            print(USAGE, file=sys.stderr)
+            return 2
+        target = int(positional[1])
+        coordinator = positional[2] if len(positional) > 2 \
+            else "127.0.0.1:50052"
+        resp = _fleet_call(coordinator, fmsg.FleetRequest(
+            server_id=-1, action=fmsg.FLEET_SCALE, scale_target=target))
+        if resp is None:
+            return 1
+        print(f"{resp.message} (fleet epoch {resp.epoch})")
+        return 0 if resp.success else 1
 
     print(USAGE, file=sys.stderr)
     return 2

@@ -1,0 +1,66 @@
+"""``pst-route``: the decode fleet's front-door stream router (fleet/,
+ISSUE 14).
+
+    pst-route --coordinator=HOST:PORT [--port=50060] [--poll-s=0.5]
+
+Speaks the same ``psdt_fleet.Decode`` gRPC service the decode servers
+speak, so clients cannot tell a router from a single server: each
+incoming ``SubmitStream`` is admitted to the best ACTIVE backend by
+free-slot/queue-depth score (fleet table polled from the coordinator's
+``UpdateFleet`` extension) and PINNED there for its lifetime — a
+mid-stream rolling weight update swaps versions under the stream
+(PR 10 semantics) and never re-routes a live continuation.
+
+Downgrade matrix: no router deployed => point clients at the single
+``pst-serve --serve-port`` process directly, byte-identical service.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from ..config import parse_argv, require_flag_value
+
+KNOWN_FLAGS = frozenset({"coordinator", "port", "poll-s"})
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s "
+                               "%(message)s")
+    _, flags = parse_argv(argv)
+    if "help" in flags:
+        print(__doc__)
+        return 0
+    require_flag_value(argv, "--coordinator", "--port", "--poll-s",
+                       hint="e.g. --coordinator=10.0.0.5:50052 "
+                            "--port=50060")
+    unknown = set(flags) - KNOWN_FLAGS
+    if unknown:
+        raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
+                         f"--help lists the accepted flags")
+    if not flags.get("coordinator"):
+        raise SystemExit("pst-route needs --coordinator=HOST:PORT "
+                         "(the fleet table lives there)")
+
+    from ..fleet.router import FleetRouter
+    router = FleetRouter(flags["coordinator"],
+                         port=int(flags.get("port", "0")),
+                         bind_address="0.0.0.0",
+                         poll_s=float(flags.get("poll-s", "0.5")))
+    port = router.start()
+    print(f"fleet router on port {port} "
+          f"(coordinator {flags['coordinator']})", file=sys.stderr)
+    try:
+        router.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,10 @@ KNOWN_FLAGS = frozenset({
     "draft-model", "draft-ckpt", "draft-seed", "draft-len",
     "no-adaptive-draft", "draft-cost-ratio", "fused-rounds",
     "follow", "subscriber-id",
+    # decode fleet mode (fleet/, ISSUE 14): serve the psdt_fleet.Decode
+    # gRPC service instead of the stdin/stdout line protocol, and
+    # (optionally) register with a coordinator for routing/autoscaling
+    "serve-port", "coordinator", "server-id",
 })
 
 
@@ -116,6 +120,12 @@ def main(argv: list[str] | None = None) -> int:
     require_flag_value(argv, "--follow",
                        hint="the training PS address to track, e.g. "
                             "--follow=10.0.0.5:50051")
+    # bare --serve-port parses as 1 and binds an arbitrary low port;
+    # bare --coordinator would register against localhost silently
+    require_flag_value(argv, "--serve-port", "--coordinator",
+                       "--server-id",
+                       hint="fleet mode, e.g. --serve-port=50070 "
+                            "--coordinator=10.0.0.5:50052 --server-id=0")
     unknown = set(flags) - KNOWN_FLAGS
     if unknown:
         raise SystemExit(f"unknown flag(s): {', '.join(sorted(unknown))}; "
@@ -214,6 +224,38 @@ def main(argv: list[str] | None = None) -> int:
         prompt_cache=int(flags.get("prompt-cache", "0")),
         seed=int(flags.get("seed", 0)), **spec_kwargs)
     default_max_new = int(flags.get("default-max-new", "64"))
+
+    if flags.get("serve-port") is not None or flags.get("coordinator"):
+        # ---- decode fleet mode (fleet/, ISSUE 14): gRPC service +
+        # coordinator registration instead of the line protocol.  The
+        # line-protocol path below is byte-unchanged without these flags
+        # (the downgrade matrix: no router => single-server pst-serve).
+        import signal
+
+        from ..fleet.decode import FleetDecodeServer
+        fds = FleetDecodeServer(
+            srv,
+            server_id=int(flags.get("server-id", "0")),
+            port=int(flags.get("serve-port", "0")),
+            coordinator=flags.get("coordinator") or None,
+            follower=follower, transform=quantize)
+        port = fds.start()
+        print(f"decode fleet server {fds.server_id} on port {port}"
+              + (f", registered with {flags['coordinator']}"
+                 if flags.get("coordinator") else " (standalone)"),
+              file=sys.stderr)
+        # graceful preemption: SIGTERM drains (in-flight streams finish,
+        # then the server leaves the fleet) — the scale-in path
+        signal.signal(signal.SIGTERM, lambda *_: fds.drain())
+        try:
+            while not fds.wait_drained(0.5):
+                pass
+        except KeyboardInterrupt:
+            fds.drain()
+            fds.wait_drained(10.0)
+        fds.stop()
+        print(f"serving stats: {json.dumps(srv.stats)}", file=sys.stderr)
+        return 0
 
     in_q: "queue.Queue[dict | None]" = queue.Queue()
     threading.Thread(target=_reader, args=(in_q,), daemon=True,

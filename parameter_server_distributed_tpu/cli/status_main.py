@@ -31,7 +31,7 @@ import time
 import grpc
 
 from ..config import parse_argv
-from ..obs.export import render_membership, render_rollup
+from ..obs.export import render_fleet, render_membership, render_rollup
 from ..obs.stats import TimeSeriesRing
 from ..rpc import messages as m
 from ..rpc.service import RpcClient
@@ -82,6 +82,9 @@ def render_watch_line(rates: dict | None, workers: int,
             for w in (rollup or {}).get("per_worker", {}).values())
         extra = f"; {stale_folds} stale folds" if stale_folds else ""
         line += f"\n  membership: {render_membership(membership)}{extra}"
+    fleet = (rollup or {}).get("fleet")
+    if fleet:
+        line += f"\n  fleet: {render_fleet(fleet)}"
     return line
 
 

@@ -43,6 +43,24 @@ class WorkerRegistryEntry:
 
 
 @dataclasses.dataclass
+class FleetMember:
+    """One decode server in the serving fleet (fleet/, ISSUE 14):
+    identity + capacity + the load signals the router scores on.
+    ``state`` reuses the elastic membership constants — scale-in is the
+    PR 13 drain-before-stop path applied to serving processes."""
+    server_id: int
+    address: str
+    slots: int
+    free_slots: int = 0
+    queue_depth: int = 0
+    weight_version: int = 0
+    active_streams: int = 0
+    state: int = emsg.MEMBER_JOINING
+    epoch: int = 0            # fleet epoch at the last state transition
+    last_heartbeat: float = 0.0
+
+
+@dataclasses.dataclass
 class ShardMapEntry:
     """One PS shard: its serving primary, an optional backup replica
     that can be promoted, and the map epoch at which this entry last
@@ -112,6 +130,17 @@ class CoordinatorCore:
         self._membership_epoch = 0
         self._registry_generation = 0
         self._obs_members_live = obs_stats.gauge("coord.members.live")
+        # Decode fleet registry (fleet/, ISSUE 14): server id -> row
+        # under a monotone fleet epoch bumped on every STATE transition
+        # (heartbeat load refreshes don't bump — the router polls the
+        # table anyway and an epoch that moved on every heartbeat would
+        # carry no information).  ``_fleet_target`` is the manual scale
+        # target (``pst-ctl scale``); 0 = the autoscaler's watermarks
+        # decide.
+        self._fleet: dict[int, FleetMember] = {}
+        self._fleet_epoch = 0
+        self._fleet_target = 0
+        self._obs_fleet_active = obs_stats.gauge("fleet.servers.active")
 
     def register_worker(self, worker_id: int, address: str, port: int,
                         hostname: str) -> int:
@@ -326,6 +355,126 @@ class CoordinatorCore:
                               a=self._membership_epoch, note="leave")
             return removed
 
+    # --------------------------------------------------------- decode fleet
+    def _fleet_transition_locked(self, member: FleetMember,
+                                 state: int) -> bool:
+        """Move ``member`` to ``state``, bumping the fleet epoch iff it
+        actually changed (caller holds _lock)."""
+        if member.state == state:
+            return False
+        member.state = state
+        self._fleet_epoch += 1
+        member.epoch = self._fleet_epoch
+        self._obs_fleet_active.set(sum(
+            1 for m in self._fleet.values()
+            if m.state == emsg.MEMBER_ACTIVE))
+        return True
+
+    def fleet_register(self, server_id: int, address: str,
+                       slots: int) -> int:
+        """A decode server announces itself (or re-announces after GONE):
+        straight to ACTIVE — serving has no barrier to join, a registered
+        server is routable the moment it heartbeats capacity.  Returns
+        the fleet epoch."""
+        now = self._time()
+        with self._lock:
+            sid = int(server_id)
+            member = self._fleet.get(sid)
+            if member is None or member.state == emsg.MEMBER_GONE:
+                member = FleetMember(server_id=sid, address=address,
+                                     slots=int(slots),
+                                     free_slots=int(slots))
+                self._fleet[sid] = member
+            member.address = address
+            member.slots = int(slots)
+            member.last_heartbeat = now
+            if self._fleet_transition_locked(member, emsg.MEMBER_ACTIVE):
+                flight.record("fleet.register", worker=sid,
+                              a=int(slots), b=self._fleet_epoch,
+                              note=address[:48])
+            return self._fleet_epoch
+
+    def fleet_heartbeat(self, server_id: int, free_slots: int,
+                        queue_depth: int, weight_version: int,
+                        active_streams: int) -> int | None:
+        """Load refresh; returns the server's own state (the drain
+        signal) or None for an unknown/GONE server — the decode process
+        re-registers on None."""
+        now = self._time()
+        with self._lock:
+            member = self._fleet.get(int(server_id))
+            if member is None or member.state == emsg.MEMBER_GONE:
+                return None
+            member.last_heartbeat = now
+            member.free_slots = int(free_slots)
+            member.queue_depth = int(queue_depth)
+            member.weight_version = int(weight_version)
+            member.active_streams = int(active_streams)
+            return member.state
+
+    def fleet_drain(self, server_id: int) -> bool:
+        """Mark a decode server DRAINING (scale-in / ``pst-ctl``): it
+        stops admitting new streams, finishes the in-flight ones, and
+        leaves.  False when unknown or already gone."""
+        with self._lock:
+            member = self._fleet.get(int(server_id))
+            if member is None or member.state == emsg.MEMBER_GONE:
+                return False
+            if self._fleet_transition_locked(member, emsg.MEMBER_DRAINING):
+                flight.record("fleet.drain", worker=int(server_id),
+                              a=self._fleet_epoch)
+            return True
+
+    def fleet_leave(self, server_id: int) -> bool:
+        """Graceful leave: the row goes GONE now (it stays in the table
+        as history — ids are operator-chosen and a rejoin reuses it)."""
+        with self._lock:
+            member = self._fleet.get(int(server_id))
+            if member is None:
+                return False
+            return self._fleet_transition_locked(member, emsg.MEMBER_GONE)
+
+    def fleet_table(self) -> tuple[int, list[FleetMember], int]:
+        """(fleet epoch, row copies sorted by server id, scale target)."""
+        with self._lock:
+            return (self._fleet_epoch,
+                    [dataclasses.replace(self._fleet[sid])
+                     for sid in sorted(self._fleet)],
+                    self._fleet_target)
+
+    def fleet_state(self, server_id: int) -> int | None:
+        with self._lock:
+            member = self._fleet.get(int(server_id))
+            return None if member is None else member.state
+
+    def set_fleet_target(self, n: int) -> int:
+        """Manual scale target (``pst-ctl scale <n>``; 0 = hand control
+        back to the autoscaler's watermarks).  Returns the fleet epoch."""
+        with self._lock:
+            self._fleet_target = max(0, int(n))
+            self._fleet_epoch += 1
+            flight.record("fleet.scale", a=self._fleet_target,
+                          b=self._fleet_epoch)
+            return self._fleet_epoch
+
+    def remove_stale_fleet(self, timeout_s: float = 30.0) -> list[int]:
+        """Mark decode servers silent for > timeout_s GONE (the serving
+        reap — run by the coordinator's reaper thread next to the worker
+        reap).  Returns the newly-gone ids."""
+        now = self._time()
+        evicted: list[int] = []
+        with self._lock:
+            for member in self._fleet.values():
+                if (member.state not in (emsg.MEMBER_GONE,)
+                        and now - member.last_heartbeat > timeout_s):
+                    if self._fleet_transition_locked(member,
+                                                     emsg.MEMBER_GONE):
+                        evicted.append(member.server_id)
+                        flight.record("fleet.evict",
+                                      worker=member.server_id,
+                                      a=self._fleet_epoch)
+        return evicted
+
     def width_provider(self):
         """An in-process ``live_workers_fn`` with the ``generation``
         attribute ``ParameterServerCore.barrier_width`` invalidates on —
@@ -341,7 +490,26 @@ class CoordinatorCore:
             def generation(self) -> int:
                 return core.registry_generation()
 
+            def draining(self) -> tuple[int, ...]:
+                # DRAINING workers still hold a barrier slot but are
+                # leaving: the quorum threshold pre-shrinks by their
+                # count so a graceful drain never costs a grace window,
+                # and the IDS let the close verify the absentees really
+                # are the drains (elastic/quorum.py + ps_core
+                # _quorum_ready_locked, ISSUE 14 satellite)
+                return core.draining_worker_ids()
+
         return _Provider()
+
+    def draining_worker_ids(self) -> tuple[int, ...]:
+        """Registered workers currently marked DRAINING — the quorum
+        pre-shrink input (a DRAINING worker counts toward the barrier
+        width until it leaves, but the K-of-N close must not wait a
+        grace window for a contribution it knows is not coming)."""
+        with self._lock:
+            return tuple(wid for wid in self._workers
+                         if self._member_states.get(wid)
+                         == emsg.MEMBER_DRAINING)
 
     # ------------------------------------------------- reduction topology
     def tier_register(self, worker_id: int, host_id: str = "",

@@ -408,6 +408,16 @@ class ParameterServerCore:
         # pre-existing TTL behavior.
         self._live_gen_fn = getattr(live_workers_fn, "generation", None)
         self._live_gen: int | None = None
+        # DRAINING ids ride the same refresh (fleet/, ISSUE 14
+        # satellite — the PR 13 leftover): a provider exposing
+        # ``draining()`` (an iterable of worker ids) lets the K-of-N
+        # quorum threshold pre-shrink by the announced drains, and lets
+        # the close skip the grace window only when the absentees
+        # really ARE the drains (see _quorum_ready_locked).  Providers
+        # without it (plain callables, pre-elastic topologies) leave it
+        # empty — byte-identical thresholds.
+        self._live_draining_fn = getattr(live_workers_fn, "draining", None)
+        self._live_draining_ids: frozenset[int] = frozenset()
         # Guards _live_cache: barrier_width() is called from many handler
         # threads at once, and an unguarded expiry race both issues
         # redundant remote registry calls and can publish a torn
@@ -624,6 +634,12 @@ class ParameterServerCore:
                     live = int(self._live_workers_fn())
                     self._live_cache = (live, time.monotonic() + self._live_ttl)
                     self._live_gen = gen
+                    if self._live_draining_fn is not None:
+                        # last-seen drain ids, refreshed with the width
+                        # (the provider answers from the same membership
+                        # response — no extra RPC)
+                        self._live_draining_ids = frozenset(
+                            int(w) for w in self._live_draining_fn())
             if live > 0:
                 return live
         return self._static_total_workers
@@ -1319,18 +1335,37 @@ class ParameterServerCore:
     def _quorum_ready_locked(self, state: IterationState, received: int,
                              total: int) -> bool:
         """True when the K-of-N close may fire NOW: the contributor
-        count reached ``K = ceil(quorum * total)`` and the grace window
-        past the K-th commit elapsed.  Stamps/clears
-        ``state.quorum_at`` as the count crosses the (possibly elastic)
-        threshold; callers on the poll/CV cadence re-evaluate the grace.
-        Caller holds _state_lock."""
-        k = equorum.threshold(self._quorum, total)
+        count reached ``K = ceil(quorum * total)`` — pre-shrunk by the
+        announced DRAINING count (elastic/quorum.py, ISSUE 14
+        satellite) — and the grace window past the K-th commit elapsed.
+        When every NON-draining member has committed, the grace is
+        skipped outright: the only absentees are workers that announced
+        they are leaving, and waiting a grace window for a commit that
+        is not coming is exactly the cost the drain announcement exists
+        to remove.  The check counts only commits from workers NOT in
+        the draining set — a draining worker finishing its last
+        in-flight iteration must not let the close cut off a healthy
+        worker that was milliseconds behind (the grace window exists
+        for exactly that worker).  Stamps/clears ``state.quorum_at`` as
+        the count crosses the (possibly elastic) threshold; callers on
+        the poll/CV cadence re-evaluate the grace.  Caller holds
+        _state_lock."""
+        draining_ids = self._live_draining_ids
+        draining = len(draining_ids)
+        k = equorum.threshold(self._quorum, total, draining)
         if received < k:
             state.quorum_at = None  # width grew past the old quorum
             return False
         now = time.monotonic()
         if state.quorum_at is None:
             state.quorum_at = now
+        if draining > 0:
+            healthy_received = received - len(state.contributors
+                                              & draining_ids)
+            if healthy_received >= total - draining:
+                return True  # every still-staying member is in: the
+                #              absent set is exactly (a subset of) the
+                #              announced drains — no grace to pay
         return now - state.quorum_at >= self._quorum_grace_s
 
     def _maybe_aggregate_locked(self, iteration: int, state: IterationState,

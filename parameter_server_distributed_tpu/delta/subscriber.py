@@ -24,6 +24,7 @@ the training side's health):
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 
@@ -59,6 +60,16 @@ class WeightFollower:
         self.wire_dtype = int(wire_dtype)
         self._attempts = int(reconnect_attempts)
         self._backoff = float(reconnect_backoff_s)
+        # Decorrelated-jitter reconnect backoff (ISSUE 14 satellite): a
+        # FLEET of followers losing one restarted PS must not thundering-
+        # herd it back down — the old deterministic base*2^n schedule
+        # made every follower retry in the same instant.  Each sleep
+        # draws uniform in [base, min(cap, 3*previous sleep)] (cap =
+        # base*8, the old schedule's ceiling), seeded per subscriber id
+        # so a fleet decorrelates AND a given follower is reproducible.
+        self._backoff_cap = self._backoff * 8.0
+        self._prev_backoff = self._backoff
+        self._jitter_rng = random.Random(0x9E3779B9 ^ self.subscriber_id)
         self._state = DeltaPullState()
         # one-slot mailbox (pending newest version) + status flags
         self._lock = checked_lock("WeightFollower._lock")
@@ -125,6 +136,17 @@ class WeightFollower:
         with self._lock:
             return self._state.version
 
+    def _next_backoff(self) -> float:
+        """One decorrelated-jitter draw (see the constructor comment):
+        uniform in [base, min(cap, 3 * previous sleep)], remembered as
+        the next draw's upper-bound seed.  Bounds are the unit-test
+        contract: every sleep is >= base and <= cap."""
+        hi = max(self._backoff, min(self._backoff_cap,
+                                    self._prev_backoff * 3.0))
+        sleep = self._jitter_rng.uniform(self._backoff, hi)
+        self._prev_backoff = sleep
+        return sleep
+
     # -------------------------------------------------------------- thread
     def _publish(self) -> None:
         store = {name: np.array(arr, np.float32, copy=True)
@@ -169,6 +191,7 @@ class WeightFollower:
                     if self._state.base is not None:
                         self._publish()
                         failures = 0
+                        self._prev_backoff = self._backoff  # healthy again
                 if self._stop.is_set():
                     return
                 failures += 1  # server ended the stream (PS shutdown)
@@ -202,7 +225,7 @@ class WeightFollower:
             if failures > self._attempts:
                 self._degrade(f"subscription lost after {failures} attempts")
                 return
-            if self._stop.wait(self._backoff * min(8, 2 ** failures)):
+            if self._stop.wait(self._next_backoff()):
                 return
 
 

@@ -113,6 +113,17 @@ def live_member_count(entries) -> int:
                if int(e.state) != emsg.MEMBER_GONE)
 
 
+def draining_member_ids(entries) -> tuple[int, ...]:
+    """Worker ids that announced they are leaving (DRAINING): the
+    K-of-N quorum threshold pre-shrinks by their count, and the
+    skip-the-grace close needs the IDS — only commits from NON-draining
+    workers may satisfy "everyone still staying has committed"
+    (elastic/quorum.py + ps_core._quorum_ready_locked, ISSUE 14
+    satellite)."""
+    return tuple(int(e.worker_id) for e in entries
+                 if int(e.state) == emsg.MEMBER_DRAINING)
+
+
 class MembershipWidthProvider:
     """Drop-in ``live_workers_fn`` for ``ParameterServerCore`` backed by
     the membership table, with the membership epoch as ``generation``.
@@ -136,6 +147,7 @@ class MembershipWidthProvider:
         # (analysis/lock_order.py)
         self._lock = checked_lock("MembershipWidthProvider._lock")
         self._epoch = 0
+        self._draining: tuple[int, ...] = ()
         self._fallback: RpcClient | None = None
 
     def close(self) -> None:
@@ -147,6 +159,15 @@ class MembershipWidthProvider:
         """Last-seen membership epoch (no RPC — see class docstring)."""
         with self._lock:
             return self._epoch
+
+    def draining(self) -> tuple[int, ...]:
+        """Last-seen DRAINING worker IDS, refreshed by every
+        ``__call__`` from the same membership response as the width (no
+        RPC) — the quorum-threshold pre-shrink input, and the identity
+        evidence the skip-the-grace close needs
+        (``ParameterServerCore._quorum_ready_locked``)."""
+        with self._lock:
+            return self._draining
 
     def _list_workers_count(self) -> int:
         """Classic registry count — the downgrade path for reference
@@ -172,4 +193,5 @@ class MembershipWidthProvider:
             if resp is None:
                 return self._list_workers_count()
             self._epoch = int(resp.epoch)
+            self._draining = draining_member_ids(resp.entries)
             return live_member_count(resp.entries)

@@ -58,10 +58,23 @@ def grace_s(override_ms: float | None = None) -> float:
     return max(0.0, ms) / 1e3
 
 
-def threshold(quorum: float, width: int) -> int:
+def threshold(quorum: float, width: int, draining: int = 0) -> int:
     """K for a barrier of ``width``: ``ceil(quorum * width)``, clamped
     to [1, width] — a quorum can never be satisfied by zero contributors
-    and never demands more than the (possibly elastic) width."""
+    and never demands more than the (possibly elastic) width.
+
+    ``draining`` PRE-SHRINKS the threshold (ISSUE 14 satellite, the
+    PR 13 leftover): a DRAINING worker still holds its barrier slot —
+    it may be finishing an in-flight iteration — but it is leaving, so
+    the close must never *demand* its commit.  K is additionally capped
+    at ``width - draining`` (floor 1): with the drain announced, the
+    healthy workers alone satisfy the quorum, and a graceful drain
+    costs zero grace windows instead of one per barrier until the
+    leave lands (see ``_quorum_ready_locked`` for the matching
+    skip-the-grace rule when every non-draining worker committed)."""
     if width <= 0:
         return 1
-    return min(width, max(1, math.ceil(quorum * width - 1e-9)))
+    k = min(width, max(1, math.ceil(quorum * width - 1e-9)))
+    if draining > 0:
+        k = min(k, max(1, width - int(draining)))
+    return k

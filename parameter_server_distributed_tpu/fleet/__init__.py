@@ -1,0 +1,18 @@
+"""Decode fleet control plane (ISSUE 14).
+
+The serving analogue of what elastic/ (PR 13) and replication/ (PR 7)
+built for training: DecodeServers register with the coordinator over the
+``UpdateFleet`` extension RPC, a front-door :class:`~.router.FleetRouter`
+(``pst-route``) admits and load-balances token streams across them by
+free-slot/queue-depth score (pinning each stream to its server for its
+lifetime), and a :class:`~.controller.FleetController` scales decode
+processes out/in under slot-occupancy watermarks (scale-in drains before
+stopping — the PR 13 DRAINING path) and drives rolling weight updates /
+rollbacks across the fleet with streams pinned mid-rollout.
+
+Downgrade matrix: without a router, single-server ``pst-serve`` is
+byte-unchanged; against a reference coordinator (no ``UpdateFleet``),
+registration degrades to standalone serving.
+"""
+
+from . import messages  # noqa: F401

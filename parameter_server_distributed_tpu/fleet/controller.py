@@ -1,0 +1,253 @@
+"""Coordinator-side fleet controller: autoscaling + rolling updates.
+
+Runs next to :class:`~..core.coordinator_core.CoordinatorCore` (it holds
+the core directly — no RPC to itself) and manages the decode fleet
+through two mechanisms:
+
+- **Autoscaling** — :func:`scale_decision` is the pure policy: scale out
+  one server when fleet-wide slot occupancy (busy slots / total slots,
+  admission queues counted as busy demand) sits above the high
+  watermark, scale in one when below the low watermark, clamped to
+  [min, max]; a manual target (``pst-ctl scale <n>``) overrides the
+  watermarks entirely until reset to 0.  The loop acts through a
+  ``spawner`` (spawn one decode process / stop a drained one) so the
+  same controller drives subprocess fleets and in-process test fleets.
+  **Scale-in is drain-before-stop**: the victim is marked DRAINING in
+  the fleet table (the PR 13 path), it finishes its in-flight streams
+  and LEAVES, and only a GONE server is handed to ``spawner.stop`` —
+  a scale-in can never drop a stream.
+
+- **Rolling update / rollback** — :meth:`FleetController.rolling_update`
+  walks the ACTIVE servers one at a time and ``Control(SWAP)``s each to
+  the target version, confirming the swap before touching the next
+  server (streams stay pinned to their server throughout — PR 10
+  swap-under-stream semantics make the rollout invisible to them);
+  :meth:`rollback` pins every server back to a held version, after
+  which no server may serve a newer-version continuation until
+  unpinned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import threading
+
+import grpc
+
+from ..obs import flight
+from ..rpc.service import RpcClient
+from . import messages as fmsg
+
+log = logging.getLogger("pst.fleet.controller")
+
+
+@dataclasses.dataclass
+class ScalePolicy:
+    """Watermark knobs (fractions of total slots occupied)."""
+    low: float = 0.3
+    high: float = 0.8
+    min_servers: int = 1
+    max_servers: int = 8
+
+
+def occupancy(entries) -> float:
+    """Fleet-wide demand fraction: (busy slots + queued admissions) over
+    total slots across non-GONE, non-DRAINING servers.  Queued requests
+    count — a fleet with full queues and full slots is at 1.0+, which is
+    exactly the scale-out signal."""
+    live = [e for e in entries
+            if int(e.state) == fmsg.MEMBER_ACTIVE]
+    total = sum(int(e.slots) for e in live)
+    if total <= 0:
+        return 0.0
+    busy = sum(int(e.slots) - int(e.free_slots) for e in live)
+    queued = sum(int(e.queue_depth) for e in live)
+    return (busy + queued) / total
+
+
+def scale_decision(entries, policy: ScalePolicy,
+                   manual_target: int = 0) -> int:
+    """Desired fleet size given the current table.  Manual target wins;
+    otherwise one step in the watermark's direction (never a jump — each
+    new server changes the occupancy the next decision sees)."""
+    current = sum(1 for e in entries
+                  if int(e.state) in (fmsg.MEMBER_ACTIVE,
+                                      fmsg.MEMBER_JOINING))
+    if manual_target > 0:
+        return max(policy.min_servers,
+                   min(policy.max_servers, manual_target))
+    occ = occupancy(entries)
+    if occ > policy.high and current < policy.max_servers:
+        return current + 1
+    if occ < policy.low and current > policy.min_servers:
+        return current - 1
+    return max(policy.min_servers, min(policy.max_servers, current))
+
+
+class FleetController:
+    """See module docstring.  ``spawner`` implements ``spawn() -> None``
+    (launch one decode process that will register itself) and
+    ``stop(server_id) -> None`` (reap a GONE process)."""
+
+    def __init__(self, core, *, policy: ScalePolicy | None = None,
+                 spawner=None, interval_s: float = 0.5):
+        self.core = core
+        self.policy = policy or ScalePolicy()
+        self.spawner = spawner
+        self._interval = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # server ids this controller marked DRAINING and still owes a
+        # spawner.stop once they reach GONE (decode loop thread only)
+        self._stopping: set[int] = set()
+        self._clients: dict[str, RpcClient] = {}
+
+    # ------------------------------------------------------------- clients
+    def _control(self, address: str, action: int,
+                 version: int = -1,
+                 timeout: float = 30.0) -> fmsg.DecodeControlResponse:
+        client = self._clients.get(address)
+        if client is None:
+            client = RpcClient(address, fmsg.DECODE_SERVICE,
+                               fmsg.DECODE_METHODS)
+            self._clients[address] = client
+        return client.call(
+            "Control",
+            fmsg.DecodeControlRequest(action=action, version=version),
+            timeout=timeout)
+
+    def close(self) -> None:
+        self.stop_autoscaler()
+        clients, self._clients = dict(self._clients), {}
+        for client in clients.values():
+            client.close()
+
+    # ------------------------------------------------------ rolling update
+    def _active_servers(self):
+        _epoch, entries, _target = self.core.fleet_table()
+        return [e for e in entries if e.state == fmsg.MEMBER_ACTIVE]
+
+    def rolling_update(self, version: int = -1,
+                       timeout: float = 30.0) -> dict[int, bool]:
+        """Swap every ACTIVE server to ``version`` (-1 = each server's
+        newest held), ONE SERVER AT A TIME — a swap must confirm before
+        the next server is touched, so at most one server is mid-swap at
+        any moment and every pinned stream keeps flowing (the swap
+        itself lands between decode rounds).  Returns {server_id: ok}."""
+        results: dict[int, bool] = {}
+        for member in self._active_servers():
+            flight.record("fleet.rollout", a=version,
+                          b=member.server_id, note="swap")
+            try:
+                resp = self._control(member.address, fmsg.CTRL_SWAP,
+                                     version, timeout=timeout)
+                results[member.server_id] = bool(resp.success)
+                if not resp.success:
+                    log.warning("rollout: server %d refused version %d "
+                                "(%s)", member.server_id, version,
+                                resp.message)
+            except grpc.RpcError as exc:
+                log.warning("rollout: server %d unreachable (%s)",
+                            member.server_id, exc)
+                results[member.server_id] = False
+        return results
+
+    def rollback(self, version: int,
+                 timeout: float = 30.0) -> dict[int, bool]:
+        """Pin the whole fleet back to ``version``: each server swaps to
+        it AND refuses anything newer until unpinned — after this
+        returns, no continuation anywhere in the fleet decodes under a
+        newer version."""
+        results: dict[int, bool] = {}
+        for member in self._active_servers():
+            flight.record("fleet.rollout", a=version,
+                          b=member.server_id, note="rollback")
+            try:
+                resp = self._control(member.address, fmsg.CTRL_ROLLBACK,
+                                     version, timeout=timeout)
+                results[member.server_id] = bool(resp.success)
+            except grpc.RpcError:
+                results[member.server_id] = False
+        return results
+
+    def unpin(self) -> None:
+        for member in self._active_servers():
+            try:
+                self._control(member.address, fmsg.CTRL_UNPIN)
+            except grpc.RpcError:
+                pass  # unreachable server re-pins nothing
+
+    # ---------------------------------------------------------- autoscaler
+    def scale_step(self) -> int:
+        """One autoscale decision + action.  Returns the desired size.
+        Scale-out spawns immediately; scale-in DRAINS the youngest
+        ACTIVE server and stops it only after the fleet table shows it
+        GONE (drain-before-stop — the in-flight streams finish first)."""
+        _epoch, entries, manual = self.core.fleet_table()
+        # finish any pending drain-stops first: a drained server has
+        # left the table (GONE) and can now be reaped
+        for entry in entries:
+            if (entry.server_id in self._stopping
+                    and entry.state == fmsg.MEMBER_GONE):
+                self._stopping.discard(entry.server_id)
+                if self.spawner is not None:
+                    self.spawner.stop(entry.server_id)
+        desired = scale_decision(entries, self.policy, manual)
+        current = [e for e in entries if e.state == fmsg.MEMBER_ACTIVE]
+        draining = sum(1 for e in entries
+                       if e.state == fmsg.MEMBER_DRAINING)
+        if desired > len(current) + draining and self.spawner is not None:
+            flight.record("fleet.scale", a=desired, b=len(current),
+                          note="scale-out")
+            log.info("fleet scale-out: %d -> %d", len(current), desired)
+            self.spawner.spawn()
+        elif desired < len(current) and draining == 0:
+            # one drain in flight at a time: the next decision sees the
+            # narrowed fleet and re-evaluates before picking another
+            # victim.  Youngest first — the longest-lived server has the
+            # warmest caches and the most history.
+            victim = max(current, key=lambda e: e.server_id)
+            flight.record("fleet.scale", a=desired,
+                          b=victim.server_id, note="scale-in-drain")
+            log.info("fleet scale-in: draining server %d",
+                     victim.server_id)
+            self.core.fleet_drain(victim.server_id)
+            self._stopping.add(victim.server_id)
+        return desired
+
+    def start_autoscaler(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._autoscale_loop,
+                                        daemon=True,
+                                        name="fleet-autoscaler")
+        self._thread.start()
+
+    def stop_autoscaler(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def _autoscale_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.scale_step()
+            except Exception:  # noqa: BLE001 — the autoscaler must keep
+                # ticking through a transient RPC/spawn failure; the next
+                # interval retries with a fresh table
+                log.exception("autoscale step failed")
+
+
+def expected_servers(streams_per_s: float, tokens_per_stream: float,
+                     tokens_per_s_per_slot: float, slots: int) -> int:
+    """Little's-law sizing helper for operators: the fleet size at which
+    offered load occupies ~70%% of slots."""
+    if tokens_per_s_per_slot <= 0 or slots <= 0:
+        return 1
+    demand_slots = (streams_per_s * tokens_per_stream
+                    / tokens_per_s_per_slot)
+    return max(1, math.ceil(demand_slots / (0.7 * slots)))

@@ -185,6 +185,65 @@ def _splice_runner(model: Transformer, bucket: int, cache_dtype: str):
     return _cached_runner(key, build)
 
 
+def _extend_runner(model: Transformer, pbucket: int, sbucket: int,
+                   cache_dtype: str):
+    """Jitted per (model, prefix bucket, suffix bucket): extend a cached
+    prefix row by forwarding ONLY the suffix tokens against it — the
+    shared-prefix half of the prompt cache.  The suffix runs through the
+    same ragged ``decode_block`` a decode round uses (a [1, sbucket]
+    block against a single-row cache seeded with the prefix K/V), so the
+    suffix's K/V and logits are exactly what submitting the prefix and
+    then decoding forward would have computed; pad positions past the
+    real suffix write garbage beyond the frontier, masked and
+    overwritten exactly like prefill pad positions.  Returns the last
+    REAL suffix position's logits and the combined (prefix + suffix)
+    K/V row, ready for the ordinary slot splice."""
+    key = (_model_key(model), "serve_extend", pbucket, sbucket,
+           cache_dtype)
+    total = pbucket + sbucket
+
+    def build():
+        @jax.jit
+        def run(params, row, padded_suffix, prefix_len, suffix_len):
+            if cache_dtype == "int8":
+                k8, v8, ks, vs = row
+                layers, _, heads, dim = k8.shape
+                cache = QuantKVCache(
+                    k=jnp.zeros((layers, 1, total, heads, dim),
+                                jnp.int8).at[:, 0, :pbucket].set(k8),
+                    v=jnp.zeros((layers, 1, total, heads, dim),
+                                jnp.int8).at[:, 0, :pbucket].set(v8),
+                    k_scale=jnp.ones((layers, 1, total, heads),
+                                     jnp.float32)
+                    .at[:, 0, :pbucket].set(ks),
+                    v_scale=jnp.ones((layers, 1, total, heads),
+                                     jnp.float32)
+                    .at[:, 0, :pbucket].set(vs),
+                    length=jnp.zeros((), jnp.int32))
+            else:
+                k, v = row
+                layers, _, heads, dim = k.shape
+                dtype = model.config.dtype
+                cache = KVCache(
+                    k=jnp.zeros((layers, 1, total, heads, dim), dtype)
+                    .at[:, 0, :pbucket].set(k.astype(dtype)),
+                    v=jnp.zeros((layers, 1, total, heads, dim), dtype)
+                    .at[:, 0, :pbucket].set(v.astype(dtype)),
+                    length=jnp.zeros((), jnp.int32))
+            logits, cache = decode_block(model, params, padded_suffix,
+                                         cache,
+                                         lengths=prefix_len[None])
+            last = logits[0, suffix_len - 1]
+            if cache_dtype == "int8":
+                return last, (cache.k[:, 0], cache.v[:, 0],
+                              cache.k_scale[:, 0], cache.v_scale[:, 0])
+            return last, (cache.k[:, 0], cache.v[:, 0])
+
+        return run
+
+    return _cached_runner(key, build)
+
+
 def _step_runner(model: Transformer, slots: int,
                  top_k: int, top_p: float, cache_dtype: str):
     """Jitted once per (model, B, truncation config): one ragged decode
@@ -367,6 +426,16 @@ class DecodeServer:
         self.prompt_cache_size = prompt_cache
         self._prompt_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._prompt_hits = 0
+        # shared-PREFIX reuse across requests (fleet/, ISSUE 14): a miss
+        # whose prompt extends a cached prompt forwards only the suffix
+        # (_extend_runner); plain mode only — speculative admissions also
+        # need a draft row, which the extension does not produce
+        self._prefix_hits = 0
+        self._obs_prefix = obs_stats.counter("serve.prefix_hits")
+        # params version tag (fleet/ version-skew bookkeeping): 0 = boot
+        # weights; swap_params(version=...) stamps the published version
+        # every subsequently decoded token is attributed to
+        self.params_version = 0
         self._rng = jax.random.key(seed)
         self._step = _step_runner(model, slots, top_k, top_p, cache_dtype)
         self._temperature = temperature
@@ -473,7 +542,8 @@ class DecodeServer:
         self._rounds_since_adapt = 0
 
     # ------------------------------------------------------------- admin
-    def swap_params(self, params: Mapping[str, Any]) -> None:
+    def swap_params(self, params: Mapping[str, Any], *,
+                    version: int | None = None) -> None:
         """Hot-swap the model weights (live weight publication — a
         follower tracking a training run feeds fresh versions through
         here, cli/serve_main.py ``--follow``).  Call BETWEEN decode
@@ -510,6 +580,8 @@ class DecodeServer:
         self.params = params
         self._prompt_cache.clear()
         self._n_swaps += 1
+        if version is not None:
+            self.params_version = int(version)
 
     @property
     def idle(self) -> bool:
@@ -529,6 +601,39 @@ class DecodeServer:
             if s is None:
                 return i
         return None
+
+    def _prefix_extend(self, prompt: np.ndarray, real_len: int):
+        """Shared-prefix half of the prompt cache: find the LONGEST
+        cached prompt that is a proper prefix of ``prompt`` and forward
+        only the suffix against its K/V row (_extend_runner).  Returns
+        (last logits, combined row, splice bucket) or None (no usable
+        prefix / combined row would not fit the cache).  The suffix
+        math is a ragged decode_block — exactly what decoding those
+        tokens one round at a time would compute — so the continuation
+        is decode-path-consistent by construction."""
+        best = None
+        for key in self._prompt_cache:
+            n = len(key)
+            if (n < real_len and (best is None or n > len(best))
+                    and tuple(int(t) for t in prompt[:n]) == key):
+                best = key
+        if best is None:
+            return None
+        _last, pre_row, _d = self._prompt_cache[best]
+        plen = len(best)
+        pbucket = int(pre_row[0].shape[1])
+        slen = real_len - plen
+        sbucket = _bucket(slen)
+        if pbucket + sbucket > self.max_len:
+            return None  # combined row would overflow the slot cache
+        padded = np.zeros((1, sbucket), np.int32)
+        padded[0, :slen] = prompt[plen:]
+        last, row = _extend_runner(self.model, pbucket, sbucket,
+                                   self.cache_dtype)(
+            self.params, pre_row, jnp.asarray(padded),
+            jnp.asarray(plen, jnp.int32), jnp.asarray(slen, jnp.int32))
+        self._prompt_cache.move_to_end(best)  # prefix reuse is a touch
+        return last, row, pbucket + sbucket
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt, max_new_tokens: int = 64, *,
@@ -599,22 +704,33 @@ class DecodeServer:
                     jnp.asarray(real_len, jnp.int32))
                 self._prompt_cache[pkey] = (last, row, d_row)
         else:
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :real_len] = prompt
-            last, row = _prefill_runner(self.model, bucket,
-                                        self.cache_dtype)(
-                self.params, jnp.asarray(padded),
-                jnp.asarray(real_len, jnp.int32))
-            d_row = None
-            if self.draft is not None and self._k > 0:
-                # k=0 (controller disabled speculation): the draft cache
-                # is not read while disabled, so skip its prefill +
-                # splice; a later re-probe backfills via the cache-hit
-                # repair above
-                _, d_row = _prefill_runner(self.draft, bucket,
-                                           self.cache_dtype)(
-                    self.draft_params, jnp.asarray(padded),
+            extended = (self._prefix_extend(prompt, real_len)
+                        if self.prompt_cache_size and self.draft is None
+                        else None)
+            if extended is not None:
+                # shared-prefix hit: only the suffix ran a forward; the
+                # combined row splices below under its own (wider) bucket
+                last, row, bucket = extended
+                d_row = None
+                self._prefix_hits += 1
+                self._obs_prefix.add()
+            else:
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :real_len] = prompt
+                last, row = _prefill_runner(self.model, bucket,
+                                            self.cache_dtype)(
+                    self.params, jnp.asarray(padded),
                     jnp.asarray(real_len, jnp.int32))
+                d_row = None
+                if self.draft is not None and self._k > 0:
+                    # k=0 (controller disabled speculation): the draft
+                    # cache is not read while disabled, so skip its
+                    # prefill + splice; a later re-probe backfills via
+                    # the cache-hit repair above
+                    _, d_row = _prefill_runner(self.draft, bucket,
+                                               self.cache_dtype)(
+                        self.draft_params, jnp.asarray(padded),
+                        jnp.asarray(real_len, jnp.int32))
             if self.prompt_cache_size:
                 self._prompt_cache[pkey] = (last, row, d_row)
                 while len(self._prompt_cache) > self.prompt_cache_size:
@@ -816,6 +932,18 @@ class DecodeServer:
                 or (self.eos_id is not None and token == self.eos_id)
                 or token in entry.stop)
 
+    def cancel(self, request_id: int) -> bool:
+        """Free an in-flight request's slot WITHOUT recording a result —
+        the abandoned-stream reap (fleet/decode.py: the client is gone,
+        so decoding its remaining budget would burn a slot into a queue
+        nobody reads).  The lane decodes garbage until reused, exactly
+        like a retired lane.  False when the id is not in flight."""
+        for i, entry in enumerate(self._slot):
+            if entry is not None and entry.request_id == request_id:
+                self._slot[i] = None
+                return True
+        return False
+
     def _retire(self, slot: int) -> None:
         entry = self._slot[slot]
         entry.done = True
@@ -840,6 +968,7 @@ class DecodeServer:
             out["weight_swaps"] = self._n_swaps
         if self.prompt_cache_size:
             out["prompt_cache_hits"] = self._prompt_hits
+            out["prefix_hits"] = self._prefix_hits
         if self.draft is not None:
             out["draft_accept_rate"] = (
                 self._spec_accepted / self._spec_proposed

@@ -307,6 +307,27 @@ def render_membership(membership: dict) -> str:
         f" (epoch {membership.get('epoch', 0)})"
 
 
+def render_fleet(fleet: dict) -> str:
+    """One-line view of the coordinator's decode-fleet rollup (fleet/,
+    ISSUE 14): ``"4 active (27/32 slots free, queue 3), versions
+    v3..v4, target 4 (epoch 9)"``."""
+    states = fleet.get("states", {})
+    order = ("active", "joining", "draining", "gone")
+    parts = [f"{states[k]} {k}" for k in order if states.get(k)]
+    parts += [f"{v} {k}" for k, v in sorted(states.items())
+              if k not in order and v]
+    line = ", ".join(parts) if parts else "no servers"
+    line += (f" ({fleet.get('free_slots', 0)}/{fleet.get('slots', 0)} "
+             f"slots free, queue {fleet.get('queue_depth', 0)})")
+    versions = fleet.get("versions") or []
+    if versions:
+        line += (f", version v{versions[0]}" if len(versions) == 1 else
+                 f", versions v{versions[0]}..v{versions[-1]}")
+    target = fleet.get("target", 0)
+    line += f", target {target}" if target else ", autoscale"
+    return line + f" (epoch {fleet.get('epoch', 0)})"
+
+
 def render_rollup(rollup: dict) -> str:
     """Human view of :meth:`ClusterAggregator.rollup` for pst-status."""
     lines: list[str] = []
@@ -327,6 +348,9 @@ def render_rollup(rollup: dict) -> str:
     if membership:
         lines.append("  membership: "
                      + render_membership(membership))
+    fleet = rollup.get("fleet")
+    if fleet:
+        lines.append("  fleet: " + render_fleet(fleet))
     for method, stats in sorted(cluster.get("slowest_rpc", {}).items()):
         lines.append(f"  slowest {method}: p95 {_fmt_s(stats['p95'])} "
                      f"(worker {stats['worker']})")

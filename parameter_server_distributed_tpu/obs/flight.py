@@ -163,6 +163,22 @@ EVENTS: dict[str, int] = {
     "stale.fold": 114,            # straggler folded forward into
                                   # `iteration`; a = staleness,
                                   # b = tensors folded
+    # decode fleet control plane (fleet/, ISSUE 14)
+    "fleet.register": 120,        # decode server ACTIVE; a = slots,
+                                  # b = fleet epoch; note = address
+    "fleet.drain": 121,           # server DRAINING (scale-in / ctl);
+                                  # a = fleet epoch
+    "fleet.evict": 122,           # coordinator reap marked GONE;
+                                  # a = fleet epoch
+    "fleet.route": 123,           # router pinned a stream; a = request
+                                  # id, b = server id; note = address
+    "fleet.scale": 124,           # scale decision/target; a = target,
+                                  # b = fleet epoch (coordinator) or
+                                  # current size (autoscaler edge)
+    "fleet.rollout": 125,         # rolling update step; a = version,
+                                  # b = server id; note = phase
+    "fleet.swap": 126,            # decode server swapped its serving
+                                  # version; a = version, b = server id
 }
 EVENT_NAMES = {code: name for name, code in EVENTS.items()}
 

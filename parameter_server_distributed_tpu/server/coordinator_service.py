@@ -19,6 +19,7 @@ import json
 from ..config import CoordinatorConfig
 from ..core.coordinator_core import CoordinatorCore
 from ..elastic import messages as emsg
+from ..fleet import messages as fmsg
 from ..obs import flight
 from ..obs.export import ClusterAggregator
 from ..replication import messages as rmsg
@@ -92,6 +93,24 @@ class CoordinatorService:
                 name = emsg.STATE_NAMES.get(state, f"state{state}")
                 states[name] = states.get(name, 0) + 1
             rollup["membership"] = {"epoch": epoch, "states": states}
+        # decode-fleet rollup (fleet/, ISSUE 14): capacity, load, and the
+        # version spread ride the same response, so pst-status --metrics
+        # renders the serving plane without a second RPC
+        fepoch, fleet, target = self.core.fleet_table()
+        if fleet:
+            fstates: dict[str, int] = {}
+            for member in fleet:
+                name = fmsg.STATE_NAMES.get(member.state,
+                                            f"state{member.state}")
+                fstates[name] = fstates.get(name, 0) + 1
+            live = [f for f in fleet if f.state != fmsg.MEMBER_GONE]
+            rollup["fleet"] = {
+                "epoch": fepoch, "states": fstates, "target": target,
+                "slots": sum(f.slots for f in live),
+                "free_slots": sum(f.free_slots for f in live),
+                "queue_depth": sum(f.queue_depth for f in live),
+                "versions": sorted({f.weight_version for f in live}),
+            }
         return m.ClusterMetricsResponse(
             rollup_json=json.dumps(rollup, default=float))
 
@@ -152,6 +171,51 @@ class CoordinatorService:
             entries=[emsg.MembershipEntry(worker_id=w, state=s, epoch=e)
                      for w, s, e in entries])
 
+    # ----------------------------------------------------------------- fleet
+    # RPC (framework extension, fleet/): register-heartbeat-query of the
+    # decode fleet table.  Messages live OUTSIDE rpc/messages.py (wire
+    # manifest pinned); reference clients never call it.
+    def UpdateFleet(self, request: fmsg.FleetRequest,
+                    context) -> fmsg.FleetResponse:
+        ok, message = True, "ok"
+        sid = int(request.server_id)
+        if request.action == fmsg.FLEET_REGISTER:
+            self.core.fleet_register(sid, request.address, request.slots)
+            log.info("decode server %d registered (%s, %d slots)",
+                     sid, request.address, request.slots)
+        elif request.action == fmsg.FLEET_HEARTBEAT:
+            state = self.core.fleet_heartbeat(
+                sid, request.free_slots, request.queue_depth,
+                request.weight_version, request.active_streams)
+            if state is None:
+                ok, message = False, f"server {sid} unknown (re-register)"
+        elif request.action == fmsg.FLEET_LEAVE:
+            self.core.fleet_leave(sid)
+            log.info("decode server %d left the fleet", sid)
+        elif request.action == fmsg.FLEET_DRAIN:
+            target = int(request.target_server_id)
+            ok = self.core.fleet_drain(target)
+            message = (f"server {target} draining" if ok
+                       else f"server {target} unknown or already gone")
+            log.warning("fleet drain request for server %d: %s",
+                        target, message)
+        elif request.action == fmsg.FLEET_SCALE:
+            self.core.set_fleet_target(int(request.scale_target))
+            message = f"scale target {int(request.scale_target)}"
+            log.info("fleet %s", message)
+        epoch, fleet, target = self.core.fleet_table()
+        self_state = self.core.fleet_state(sid)
+        return fmsg.FleetResponse(
+            epoch=epoch, success=ok, message=message,
+            self_state=self_state if self_state is not None else -1,
+            scale_target=target,
+            entries=[fmsg.FleetEntry(
+                server_id=f.server_id, address=f.address, slots=f.slots,
+                free_slots=f.free_slots, queue_depth=f.queue_depth,
+                weight_version=f.weight_version, state=f.state,
+                epoch=f.epoch, active_streams=f.active_streams)
+                for f in fleet])
+
     # ----------------------------------------------------------------- tiers
     # RPC (framework extension, tiers/): register-and-query of the
     # two-tier reduction topology.  Messages live OUTSIDE rpc/messages.py
@@ -195,7 +259,8 @@ class Coordinator:
                      {**m.COORDINATOR_METHODS, **m.COORDINATOR_EXT_METHODS,
                       **rmsg.REPLICATION_COORD_METHODS,
                       **tmsg.TIER_COORD_METHODS,
-                      **emsg.ELASTIC_COORD_METHODS},
+                      **emsg.ELASTIC_COORD_METHODS,
+                      **fmsg.FLEET_COORD_METHODS},
                      self.service)
         addr = f"{self.config.bind_address}:{self.config.port}"
         self._port = self._server.add_insecure_port(addr)
@@ -216,6 +281,9 @@ class Coordinator:
             evicted = self.core.remove_stale_workers(self.config.stale_timeout_s)
             for wid in evicted:
                 log.warning("evicted stale worker %d", wid)
+            for sid in self.core.remove_stale_fleet(
+                    self.config.stale_timeout_s):
+                log.warning("evicted stale decode server %d", sid)
 
     def wait(self) -> None:
         assert self._server is not None

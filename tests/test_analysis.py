@@ -394,6 +394,33 @@ def test_tier_extension_stays_out_of_the_wire_manifest():
     assert not set(tmsg.TIER_COORD_METHODS) & set(m.COORDINATOR_METHODS)
 
 
+def test_fleet_extension_stays_out_of_the_wire_manifest():
+    """ISSUE 14 compat gate: the decode-fleet extension
+    (fleet/messages.py) must leave the reference wire manifest
+    byte-unchanged — its messages, the UpdateFleet coordinator method,
+    and the whole psdt_fleet.Decode service must never appear in the
+    pinned contract, and the committed golden must still match the live
+    schemas bit for bit."""
+    import json
+
+    from parameter_server_distributed_tpu.analysis import wirecheck
+    from parameter_server_distributed_tpu.fleet import messages as fmsg
+
+    with open(wirecheck.default_manifest_path()) as fh:
+        golden = json.loads(fh.read())
+    assert wirecheck.diff_manifests(golden, wirecheck.build_manifest()) == []
+    blob = json.dumps(golden)
+    for name in ("FleetEntry", "FleetRequest", "FleetResponse",
+                 "UpdateFleet", "DecodeRequest", "DecodeChunk",
+                 "DecodeControlRequest", "DecodeControlResponse",
+                 "SubmitStream", "psdt_fleet"):
+        assert name not in blob, f"fleet extension leaked: {name}"
+    # and the extension method table really is disjoint from the pinned
+    # coordinator contract
+    from parameter_server_distributed_tpu.rpc import messages as m
+    assert not set(fmsg.FLEET_COORD_METHODS) & set(m.COORDINATOR_METHODS)
+
+
 def test_delta_extension_stays_out_of_the_wire_manifest():
     """ISSUE 10 compat gate: the versioned-delta / weight-publication
     extension (delta/messages.py) must leave the reference wire manifest

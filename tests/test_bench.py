@@ -168,6 +168,30 @@ def test_bench_elastic_contract():
 
 
 @pytest.mark.slow
+def test_bench_fleet_contract():
+    """fleet mode (ISSUE 14): streams/s + p99 TTFT vs fleet size under
+    an open-loop load generator, each decode server a real pst-serve
+    subprocess over loopback gRPC.  Capacity is pinned sleep-bound
+    (PSDT_BENCH_ROUND_DELAY_MS) so the control plane's scaling shows
+    even on a small CI host: 2 servers must sustain materially more
+    streams/s than 1 against the same arrival schedule, with zero
+    failed streams either way."""
+    result = run_bench("fleet", extra_env={
+        "PSDT_BENCH_STEPS": "6",
+        "PSDT_BENCH_REQUESTS": "16",
+        "PSDT_BENCH_FLEET_SIZES": "1,2",
+        "PSDT_BENCH_ROUND_DELAY_MS": "25",
+    }, timeout=420.0)
+    assert result["metric"].startswith("fleet_streams_per_s")
+    assert result["value"] > 0
+    one, two = result["sizes"]["1"], result["sizes"]["2"]
+    assert one["failed"] == 0 and two["failed"] == 0
+    assert one["streams"] > 0 and two["streams"] > 0
+    assert two["streams_per_s"] > 1.25 * one["streams_per_s"], \
+        result["note"]
+
+
+@pytest.mark.slow
 def test_bench_replicate_contract():
     """replicate mode: barrier-close overhead off/async/sync replication,
     failover wall-clock, and the 2->4 reshard's moved bytes — all

@@ -588,6 +588,38 @@ def test_subscribe_weights_streams_full_then_deltas(monkeypatch):
     assert len(versions) == 4
 
 
+def test_follower_backoff_decorrelated_jitter_bounds():
+    """ISSUE 14 satellite: the reconnect backoff is decorrelated jitter
+    with PINNED bounds — every sleep in [base, cap=8*base] and never
+    above 3x the previous sleep — so a fleet of followers losing one
+    restarted PS re-spreads instead of thundering-herding it."""
+    from parameter_server_distributed_tpu.delta.subscriber import (
+        WeightFollower)
+    base = 0.5
+    follower = WeightFollower("127.0.0.1:1", subscriber_id=3,
+                              reconnect_backoff_s=base)  # never started
+    cap = base * 8.0
+    prev = base
+    sleeps = [follower._next_backoff() for _ in range(64)]
+    for sleep in sleeps:
+        assert base <= sleep <= cap + 1e-9
+        assert sleep <= max(base, prev * 3.0) + 1e-9
+        prev = sleep
+    # the walk actually moves (a constant schedule is the herd)
+    assert len({round(s, 6) for s in sleeps}) > 10
+    # different subscriber ids draw DIFFERENT schedules...
+    other = WeightFollower("127.0.0.1:1", subscriber_id=4,
+                           reconnect_backoff_s=base)
+    assert [other._next_backoff() for _ in range(8)] != sleeps[:8]
+    # ...while the same id reproduces (debuggability)
+    replay = WeightFollower("127.0.0.1:1", subscriber_id=3,
+                            reconnect_backoff_s=base)
+    assert [replay._next_backoff() for _ in range(8)] == sleeps[:8]
+    # a successful publish resets the walk to the base
+    follower._prev_backoff = follower._backoff
+    assert follower._next_backoff() <= 3.0 * base
+
+
 def test_follower_wait_for_update_blocks_and_wakes():
     """wait_for_update parks on the mailbox CV (no busy-poll): a publish
     wakes the waiter with the pending version, and a degrade wakes it

@@ -69,8 +69,9 @@ def _pyproject():
 
 def test_console_scripts_resolve():
     scripts = _pyproject()["project"]["scripts"]
-    assert len(scripts) == 11  # ps/coordinator/worker + train/status/
-    #                            generate/serve/eval/analyze/trace/ctl
+    assert len(scripts) == 12  # ps/coordinator/worker + train/status/
+    #                            generate/serve/eval/analyze/trace/ctl/
+    #                            route
     for name, target in scripts.items():
         module, _, attr = target.partition(":")
         fn = getattr(importlib.import_module(module), attr)

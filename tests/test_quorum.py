@@ -50,6 +50,88 @@ def test_quorum_threshold_math():
     assert equorum.threshold(0.5, 0) == 1  # degenerate width
 
 
+def test_draining_preshrinks_threshold_hand_computed():
+    """ISSUE 14 satellite (the PR 13 leftover): DRAINING caps K at
+    width - draining, hand-computed before/after the drain."""
+    # before any drain: ceil(q * width) as ever
+    assert equorum.threshold(0.9, 4) == 4
+    assert equorum.threshold(0.75, 8) == 6
+    # one drain announced: K = min(ceil(0.9*4)=4, 4-1=3) = 3
+    assert equorum.threshold(0.9, 4, draining=1) == 3
+    # three drains: K = min(ceil(0.75*8)=6, 8-3=5) = 5
+    assert equorum.threshold(0.75, 8, draining=3) == 5
+    # the cap only ever SHRINKS K: ceil(0.5*4)=2 < 4-1=3 stays 2
+    assert equorum.threshold(0.5, 4, draining=1) == 2
+    # floor: a fully-draining barrier still needs one contributor
+    assert equorum.threshold(0.9, 2, draining=2) == 1
+    assert equorum.threshold(0.9, 4, draining=9) == 1
+
+
+def test_graceful_drain_costs_zero_grace_windows():
+    """With one member DRAINING, the close fires the moment every
+    NON-draining member has committed — no grace window, even one set
+    to 60 s (the pre-shrink satellite's end-to-end contract)."""
+    class Reg:
+        live = 4
+        drain = ()
+
+        def __call__(self):
+            return self.live
+
+        def draining(self):
+            return self.drain
+
+    reg = Reg()
+    core = ParameterServerCore(total_workers=99, optimizer=SGD(1.0),
+                               live_workers_fn=reg,
+                               live_workers_ttl_s=0.0,
+                               quorum=0.75, quorum_grace_ms=60_000.0)
+    core.initialize_parameters({"w": np.full(4, 4.0, np.float32)})
+    for worker in range(3):
+        core.receive_gradients(worker, 1, _grad(1))
+    # K = ceil(0.75*4) = 3 reached, but the 60 s grace gates the close
+    _, ready, _, _ = core.check_sync_status(1)
+    assert not ready
+    # worker 3 announces its drain: the same three commits now close
+    # IMMEDIATELY (every non-draining member is in), zero grace paid
+    reg.drain = (3,)
+    _, ready, received, total = core.check_sync_status(1)
+    assert ready and received == 3 and total == 4
+
+
+def test_drain_skip_never_cuts_off_a_healthy_worker():
+    """The skip-the-grace close counts only NON-draining commits: a
+    DRAINING worker finishing its last in-flight iteration must not
+    let the close fire while a healthy worker is the absentee — the
+    grace window exists for exactly that worker."""
+    class Reg:
+        live = 4
+        drain = (3,)
+
+        def __call__(self):
+            return self.live
+
+        def draining(self):
+            return self.drain
+
+    core = ParameterServerCore(total_workers=99, optimizer=SGD(1.0),
+                               live_workers_fn=Reg(),
+                               live_workers_ttl_s=0.0,
+                               quorum=0.75, quorum_grace_ms=60_000.0)
+    core.initialize_parameters({"w": np.full(4, 4.0, np.float32)})
+    # the DRAINING worker (3) commits its last iteration + two healthy
+    # peers: received = 3 = K, but only 2 of the 3 NON-draining members
+    # are in — the grace must still gate the close
+    for worker in (0, 1, 3):
+        core.receive_gradients(worker, 1, _grad(1))
+    _, ready, _, _ = core.check_sync_status(1)
+    assert not ready
+    # the last healthy worker lands: full barrier, immediate close
+    core.receive_gradients(2, 1, _grad(1))
+    _, ready, received, total = core.check_sync_status(1)
+    assert ready and received == 4 and total == 4
+
+
 def test_quorum_fraction_parsing(monkeypatch):
     monkeypatch.delenv(equorum.ENV_QUORUM, raising=False)
     assert equorum.quorum_fraction() == 0.0          # default off

@@ -134,6 +134,80 @@ def test_prompt_cache_token_exact_and_lru(rng):
         DecodeServer(model, params, slots=2, max_len=64, prompt_cache=-1)
 
 
+@pytest.mark.parametrize("cache_dtype", ["native", "int8"])
+def test_prefix_cache_extension_token_exact(rng, cache_dtype):
+    """Shared-prefix reuse (fleet/, ISSUE 14): a miss whose prompt
+    extends a cached prompt forwards ONLY the suffix, and the resulting
+    generation matches standalone generate exactly — and matches what a
+    fully-prefilled submission of the same prompt produces."""
+    model = tiny()
+    params = model.init_params(0)
+    base = list(rng.integers(0, 96, 7))
+    ext = base + list(rng.integers(0, 96, 4))
+    longer = ext + list(rng.integers(0, 96, 3))
+    srv = DecodeServer(model, params, slots=4, max_len=96,
+                       prompt_cache=4, cache_dtype=cache_dtype)
+    rid = srv.submit(base, max_new_tokens=5)
+    assert srv.run_to_completion()[rid] == reference(model, params,
+                                                     base, 5)
+    rid = srv.submit(ext, max_new_tokens=5)
+    assert srv.run_to_completion()[rid] == reference(model, params,
+                                                     ext, 5)
+    assert srv.stats["prefix_hits"] == 1
+    # the extended prompt is itself cached: the LONGEST prefix wins
+    # (ext, not base) when a further extension arrives
+    rid = srv.submit(longer, max_new_tokens=5)
+    assert srv.run_to_completion()[rid] == reference(model, params,
+                                                     longer, 5)
+    assert srv.stats["prefix_hits"] == 2
+    # and an exact resubmission is a WHOLE-prompt hit, not an extension
+    rid = srv.submit(ext, max_new_tokens=5)
+    assert srv.run_to_completion()[rid] == reference(model, params,
+                                                     ext, 5)
+    assert srv.stats["prompt_cache_hits"] == 1
+    assert srv.stats["prefix_hits"] == 2
+
+
+def test_prefix_cache_overflow_falls_back_to_full_prefill(rng):
+    """A combined prefix+suffix row that would overflow max_len must
+    fall back to the ordinary full prefill (still token-exact)."""
+    model = tiny()
+    params = model.init_params(0)
+    base = list(rng.integers(0, 96, 30))     # bucket 32
+    ext = base + list(rng.integers(0, 96, 10))  # suffix bucket 16: 48>40
+    srv = DecodeServer(model, params, slots=2, max_len=46,
+                       prompt_cache=4)
+    srv.submit(base, max_new_tokens=3)
+    srv.run_to_completion()
+    rid = srv.submit(ext, max_new_tokens=3)
+    assert srv.run_to_completion()[rid] == reference(model, params,
+                                                     ext, 3)
+    assert srv.stats["prefix_hits"] == 0  # fell back, correctly
+
+
+def test_prefix_cache_not_used_in_speculative_mode(rng):
+    """Speculative admissions also need a draft K/V row, which the
+    suffix extension does not produce — prefix reuse stays off there
+    (whole-prompt hits still work)."""
+    model = tiny()
+    params = model.init_params(0)
+    draft = tiny(n_layers=1)
+    dparams = draft.init_params(1)
+    base = list(rng.integers(0, 96, 6))
+    ext = base + list(rng.integers(0, 96, 3))
+    srv = DecodeServer(model, params, slots=2, max_len=96,
+                       prompt_cache=4, draft=draft, draft_params=dparams,
+                       draft_len=2)
+    srv.submit(base, max_new_tokens=4)
+    srv.run_to_completion()
+    rid = srv.submit(ext, max_new_tokens=4)
+    plain = DecodeServer(model, params, slots=2, max_len=96)
+    prid = plain.submit(ext, max_new_tokens=4)
+    assert (srv.run_to_completion()[rid]
+            == plain.run_to_completion()[prid])
+    assert srv.stats["prefix_hits"] == 0
+
+
 def test_prompt_cache_speculative_and_int8(rng):
     """The cache composes with speculative mode (draft row cached too)
     and the int8 KV cache — hits stay token-exact in both."""

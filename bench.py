@@ -1318,6 +1318,9 @@ def bench_apply() -> dict:
     device = _bench_apply_device_sweep(iters)
     if device is not None:
         out["device_vs_numpy"] = device
+    flat = _bench_apply_flat_sweep(iters)
+    if flat is not None:
+        out["flat_arena"] = flat
     return out
 
 
@@ -1465,6 +1468,215 @@ def _bench_apply_device_sweep(iters: int) -> dict | None:
     return {"rows": rows, "best_ratio": best,
             "backend": "cpu-jax (TPU-less host: these rows are the "
                        "signal, per the ROADMAP bench note)"}
+
+
+def _bench_apply_flat_sweep(iters: int) -> dict | None:
+    """Flat-arena vs per-tensor device barrier close (ISSUE 15,
+    core/arena.py): the PSDT_ARENA mega-array layout against the PR 11
+    per-tensor batched-stage path it is bit-identical to, over BOTH the
+    many-small-tensor store the arena exists for (default 512 tensors x
+    64 KB — the transformer/moe dispatch-floor scenario) and a
+    big-tensor control (16 tensors, PSDT_BENCH_FLAT_BIG_MB total,
+    default 128) where dispatch never dominated and the flat arm must
+    simply hold parity.  Arms INTERLEAVED per iteration (A/B/A/B) like
+    the device sweep so host drift cancels.
+
+    Each row also carries a jit-lowering-probe dispatch profile of the
+    timed close: ``stage_calls`` counts the kernel-library invocations
+    (fold scatters excluded — they are ingress work), and ``operands``
+    counts the ARRAY operands those calls flatten, which is what scales
+    O(tensors) on the per-tensor path (each stage's pytree carries every
+    tensor of the stripe) and O(1) on the flat path (one slab per
+    role).  The flat arm's stage_calls must stay <= the documented
+    stages x stripes budget (core/arena.py STAGE_BUDGET; asserted by
+    test_bench).  Knobs: PSDT_BENCH_FLAT_TENSORS (default 512; "" or 0
+    skips), PSDT_BENCH_FLAT_KB (64), PSDT_BENCH_FLAT_BIG_MB (128),
+    PSDT_BENCH_FLAT_OPTS ("adam"), PSDT_BENCH_FLAT_STRIPES ("1,2")."""
+    import numpy as np
+
+    from parameter_server_distributed_tpu import native
+    from parameter_server_distributed_tpu.core import arena
+    from parameter_server_distributed_tpu.core import device_apply
+    from parameter_server_distributed_tpu.core.ps_core import (
+        ParameterServerCore)
+
+    raw = os.environ.get("PSDT_BENCH_FLAT_TENSORS", "512").strip()
+    n_small = int(raw) if raw else 0
+    if not n_small:
+        return None
+    if not device_apply.available():
+        return {"skipped": "no jax backend/device"}
+    from parameter_server_distributed_tpu.core.stripes import usable_cores
+
+    kb = int(os.environ.get("PSDT_BENCH_FLAT_KB", "64"))
+    big_mb = int(os.environ.get("PSDT_BENCH_FLAT_BIG_MB", "128"))
+    opts = [x.strip() for x in os.environ.get(
+        "PSDT_BENCH_FLAT_OPTS", "adam").split(",") if x.strip()]
+    # default stripe sweep includes the production default (usable
+    # cores, capped): on XLA:CPU's thunk runtime a fused sweep is ONE
+    # thunk — one core — so the arena's parallelism axis is the stripe
+    # count (a real accelerator saturates on one fused sweep instead)
+    default_stripes = sorted({1, 2, min(8, usable_cores())})
+    stripes_list = [int(x) for x in os.environ.get(
+        "PSDT_BENCH_FLAT_STRIPES",
+        ",".join(str(s) for s in default_stripes)).split(",")
+        if x.strip()]
+    n_workers = 2
+    rng = np.random.default_rng(15)
+    rows: list[dict] = []
+
+    def probe_close(core, wid, it, staged):
+        """Time one barrier close with the kernel-library probe armed:
+        (elapsed_s, stage_calls, array_operands).  Scatter lanes are
+        ingress (fold) work and excluded from the close profile."""
+        import jax
+
+        real_k = device_apply.k
+        calls = {"n": 0, "ops": 0}
+
+        def counting_k(name, _rk=real_k):
+            fn = _rk(name)
+            if name.startswith("a_scatter"):
+                return fn
+
+            def wrapped(*args, **kw):
+                calls["n"] += 1
+                calls["ops"] += sum(
+                    1 for leaf in jax.tree_util.tree_leaves(args)
+                    if getattr(leaf, "ndim", 0) > 0)
+                return fn(*args, **kw)
+            return wrapped
+
+        device_apply.k = counting_k
+        try:
+            t0 = time.perf_counter()
+            r = core.receive_gradients(wid, it, staged)
+            with core._params_lock:
+                store = core._params
+            device_apply.block_on_store(store)
+            for v in store.values():
+                # both arms must deliver HOST bytes — what the serve
+                # encode consumes.  The flat arm already paid its one
+                # contiguous per-stripe readback inside the close (the
+                # store values are numpy views); the per-tensor arm
+                # pays its per-tensor D2H here, exactly where a serve
+                # encode would.
+                np.asarray(v)
+            dt = time.perf_counter() - t0
+        finally:
+            device_apply.k = real_k
+        assert r.aggregation_complete, r.message
+        return dt, calls["n"], calls["ops"]
+
+    def run_pair(n_tensors: int, per_kb: int, opt_name: str,
+                 stripes: int) -> dict:
+        from parameter_server_distributed_tpu.async_sgd import (
+            device_optimizer)
+        import jax.numpy as jnp
+
+        per = max(1, (per_kb << 10) // 4)
+        params = {f"blk{i:03d}/w": rng.standard_normal(per).astype(
+            np.float32) for i in range(n_tensors)}
+        grads = {name: rng.standard_normal(per).astype(np.float32)
+                 for name in params}
+        cores = {}
+        arena_was = os.environ.get(arena.ENV_ARENA)
+        for arm in ("per_tensor", "flat"):
+            # the arena gate is read at core construction
+            if arm == "flat":
+                os.environ[arena.ENV_ARENA] = "1"
+            else:
+                os.environ.pop(arena.ENV_ARENA, None)
+            try:
+                cores[arm] = ParameterServerCore(
+                    total_workers=n_workers, stripes=stripes,
+                    optimizer=device_optimizer.ShardedDeviceOptimizer(
+                        opt_name, 1e-3))
+            finally:
+                if arena_was is None:
+                    os.environ.pop(arena.ENV_ARENA, None)
+                else:
+                    os.environ[arena.ENV_ARENA] = arena_was
+            cores[arm].initialize_parameters(params)
+        closes = {"per_tensor": [], "flat": []}
+        profile = {}
+        native_was = native.is_enabled()
+        native.set_enabled(False)
+        try:
+            for it in range(1, iters + 2):  # +1 warmup (jit compiles)
+                for arm in ("per_tensor", "flat"):
+                    core = cores[arm]
+                    staged = [{k: jnp.asarray(g)
+                               for k, g in grads.items()}
+                              for _ in range(n_workers)]
+                    for wid in range(n_workers - 1):
+                        core.receive_gradients(wid, it, staged[wid])
+                    state = core._iteration_states.get(it)
+                    if state is not None:
+                        device_apply.block_on_store(state.accum)
+                    dt, n_calls, n_ops = probe_close(
+                        core, n_workers - 1, it, staged[-1])
+                    closes[arm].append(dt)
+                    if it > 1:
+                        profile[arm] = {"stage_calls": n_calls,
+                                        "operands": n_ops}
+        finally:
+            native.set_enabled(native_was)
+
+        def p50(arm: str) -> float:
+            xs = sorted(closes[arm][1:])
+            return round(1e3 * xs[len(xs) // 2], 3)
+
+        pt, fl = p50("per_tensor"), p50("flat")
+        mgr = cores["flat"]._arena
+        return {"tensors": n_tensors, "tensor_kb": per_kb,
+                "opt": opt_name, "stripes": stripes,
+                "per_tensor_close_ms": pt, "flat_close_ms": fl,
+                "flat_vs_per_tensor": round(fl / pt, 3) if pt else 0.0,
+                "flat_budget": arena.close_dispatch_budget(opt_name,
+                                                           stripes),
+                # True = the mean-tensor-size regime bound kept this
+                # store on the per-tensor path (core/arena.py
+                # DEFAULT_MAX_TENSOR_BYTES): parity by construction,
+                # the dispatch story lives in the small-store rows
+                "flat_regime_gated": bool(mgr is not None and mgr.gated),
+                "flat_profile": profile.get("flat"),
+                "per_tensor_profile": profile.get("per_tensor")}
+
+    big_kb = max(1, (big_mb << 10) // 16)
+    for n_tensors, per_kb, label in ((n_small, kb, "small"),
+                                     (16, big_kb, "big")):
+        for opt_name in opts:
+            for stripes in stripes_list:
+                row = run_pair(n_tensors, per_kb, opt_name, stripes)
+                row["store"] = label
+                rows.append(row)
+                log(f"bench_apply[flat]: {label} {n_tensors}x{per_kb}KB "
+                    f"{opt_name} stripes={stripes} "
+                    f"per_tensor={row['per_tensor_close_ms']}ms "
+                    f"flat={row['flat_close_ms']}ms "
+                    f"ratio={row['flat_vs_per_tensor']} "
+                    f"calls={row['flat_profile']['stage_calls']}"
+                    f"/{row['flat_budget']} "
+                    f"ops={row['flat_profile']['operands']} vs "
+                    f"{row['per_tensor_profile']['operands']}")
+    # best-of-stripes summary per store (the configuration a tuned
+    # deployment runs — the device sweep's discipline)
+    best: dict[str, float] = {}
+    for label in ("small", "big"):
+        for opt_name in opts:
+            cells = [r for r in rows
+                     if r["store"] == label and r["opt"] == opt_name]
+            if not cells:
+                continue
+            pt = min(r["per_tensor_close_ms"] for r in cells)
+            fl = min(r["flat_close_ms"] for r in cells)
+            best[f"{label}_{opt_name}"] = round(fl / pt, 3) if pt else 0.0
+    return {"rows": rows, "best_ratio": best,
+            "backend": "cpu-jax (TPU-less host: these rows are the "
+                       "signal, per the ROADMAP bench note; thunk-"
+                       "runtime caveat: one fused sweep = one core, so "
+                       "flat big-store parity needs stripes ~ cores)"}
 
 
 def bench_obs() -> dict:

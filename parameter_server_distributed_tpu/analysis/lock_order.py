@@ -70,6 +70,14 @@ LOCK_RANKS: dict[str, int] = {
     # the rank after the core locks
     "Replicator._lock": 46,
     "Replicator._ship_lock": 48,
+    # flat arena apply (core/arena.py, ISSUE 15): serializes packing-
+    # table builds and param-slab packs/adoption.  Acquired under
+    # _state_lock (20, the fold-side table check), the stripe locks
+    # (44), and _apply_lock (30, the close-side pack) — never the other
+    # way; the fold hot path reads only the published table reference
+    # (a GIL-atomic attribute load).  Device dispatch (H2D packing)
+    # under it is its purpose (BLOCKING_ALLOWED).
+    "ArenaManager._lock": 49,
     # leaves: never held while acquiring anything else
     "ParameterServerCore._live_lock": 50,
     # membership-backed barrier-width provider (elastic/membership.py,
@@ -175,6 +183,9 @@ BLOCKING_ALLOWED: frozenset[str] = frozenset({
     # checkpoint slot D2H readback — device dispatch under it is the
     # lock's purpose (ShardedDeviceOptimizer, ISSUE 11)
     "ShardedDeviceOptimizer._lock",
+    # serializes arena packing-table builds + param-slab packs: the H2D
+    # uploads under it are the point of the lock (core/arena.py, ISSUE 15)
+    "ArenaManager._lock",
     # serializes one replication ship (encode + PushReplicaDelta RPC +
     # ack) to the backup — the RPC under it is the point of the lock
     "Replicator._ship_lock",

@@ -336,6 +336,10 @@ class ShardedDeviceOptimizer(HostOptimizer):
 
     supports_striping = True
     device_resident = True
+    # flat-arena apply (core/arena.py, ISSUE 15): the five rules also run
+    # as ONE fused kernel per stage per stripe over per-stripe mega-array
+    # slabs when the core arms PSDT_ARENA — see apply_arena below
+    supports_arena = True
 
     RULES = ("sgd", "momentum", "adam", "adamw", "lion")
     _RULE_SLOTS = {"sgd": (), "momentum": ("velocity",),
@@ -374,6 +378,18 @@ class ShardedDeviceOptimizer(HostOptimizer):
         self._bc_step = -1
         self._bc1 = np.float32(1.0)
         self._bc2 = np.float32(1.0)
+        # flat-arena slot state (core/arena.py, ISSUE 15): when the core
+        # runs the arena close, each slot kind lives as ONE flat device
+        # slab per stripe instead of the per-name tables above —
+        # `_arena_slots[kind][stripe]`, packed for `_arena_table`'s
+        # epoch.  The per-name `_slots` tables then hold STALE entries;
+        # every per-tensor consumer (apply_shard fallback closes,
+        # checkpoint snapshots) goes through _spill_arena_locked /
+        # _arena_state_dict first, so the slabs are always the single
+        # source of truth while they exist.
+        self._arena_slots: dict[str, dict[int, object]] = {}
+        self._arena_table = None
+        self._arena_scr: dict[tuple, object] = {}  # (kind, stripe) slabs
         # fences checkpoint snapshot/restore of the slot tables; the D2H
         # slot readback runs under it (rank 45, BLOCKING_ALLOWED —
         # analysis/lock_order.py).  The apply path does NOT take it:
@@ -404,6 +420,12 @@ class ShardedDeviceOptimizer(HostOptimizer):
         are shape-bucketed by the shard's shape-signature — a fixed set
         per stripe config), with per-tensor arithmetic identical to the
         host optimizers' ufunc sequences."""
+        if self._arena_slots:
+            # a per-tensor apply while arena slot slabs are live (a
+            # fallback close, a mode flip): the slabs are the source of
+            # truth — spill them back into the per-name tables first
+            with self._lock:
+                self._spill_arena_locked()
         out: dict = {}
         todo: list[str] = []
         for name, p in params.items():
@@ -599,15 +621,262 @@ class ShardedDeviceOptimizer(HostOptimizer):
                 us[j] = u
         return k("b_psub")(ps, us)
 
+    # ------------------------------------------------------------ arena
+    # Flat-arena apply (core/arena.py, ISSUE 15): the same five update
+    # rules over per-stripe mega-array slabs — one fused kernel per
+    # STAGE per STRIPE regardless of tensor count, reusing the batched
+    # stage kernels above with single-slab operand lists (plus the
+    # masked a_* tails for the AdamW/Lion decay lanes).  Per-element
+    # arithmetic is untouched, so the numpy oracle holds bit for bit.
+
+    def arena_ready(self, table) -> bool:
+        """True when this optimizer can run ``table`` flat.  Only
+        Momentum can refuse: its first-touch slot seed is a BIT COPY of
+        the gradient (not ``mu*0 + g`` — that flips -0.0), so a MIXED
+        velocity table (some names seeded, some not — reshard merges)
+        cannot flatten and takes the per-tensor close instead.  Slabs
+        short-circuit the check only at the SAME table epoch: slabs
+        packed for an older layout (the store grew) spill back to
+        per-name first, so the new name's missing velocity is seen —
+        repacking it as zeros would break the copy-seed contract."""
+        if self.rule != "momentum":
+            return True
+        if self._arena_slots:
+            if (self._arena_table is not None
+                    and self._arena_table.epoch == table.epoch):
+                return True
+            with self._lock:
+                self._spill_arena_locked()
+        have = set(self._slots["velocity"]) & set(table.entries)
+        return not have or have == set(table.entries)
+
+    def apply_arena(self, table, param_slabs: Mapping[int, object],
+                    grad_slabs: Mapping[int, object]) -> dict:
+        """One logical step over flat slabs: per stripe, the rule's
+        stage chain as fused kernels over the whole slab.  Slot slabs
+        update in place (donated through the chain exactly like the
+        per-tensor slot buffers); param and gradient slabs are never
+        donated (serves alias old stores, failed applies put sums
+        back).  Returns the fresh param slabs.  Caller serializes
+        logical steps (the core's _apply_lock) and has proven full
+        gradient coverage and :meth:`arena_ready`."""
+        from ..core.stripes import run_striped
+
+        self._ensure_arena_slots(table)
+        lr = np.float32(self.learning_rate)
+        false = np.bool_(False)
+        stripes = sorted(param_slabs)
+        if len(stripes) <= 1:
+            return {s: self._arena_stripe(table, s, param_slabs[s],
+                                          grad_slabs[s], lr, false)
+                    for s in stripes}
+        # fan the per-stripe chains across the stripe executor: each
+        # chain is a handful of dispatches over disjoint slabs (disjoint
+        # slot/scratch keys, GIL-atomic dict writes), so concurrent
+        # dispatch costs nothing when XLA parallelizes internally and
+        # recovers the multi-core sweeps when the runtime executes a
+        # call synchronously (the default thunk runtime)
+        results = run_striped([
+            (lambda s=s: (s, self._arena_stripe(
+                table, s, param_slabs[s], grad_slabs[s], lr, false)))
+            for s in stripes])
+        return dict(results)
+
+    def _arena_stripe(self, table, stripe, p, g, lr, false):
+        k = device_apply.k
+        if self.rule == "sgd":
+            return k("b_psub")([p], k("b_mul")([g], lr))[0]
+        if self.rule == "momentum":
+            slots = self._arena_slots["velocity"]
+            v = slots.get(stripe)
+            if v is None:
+                # unseeded stripe: the host's copy-seed, flat — a bit
+                # copy into a FRESH buffer (the sums slab must survive
+                # for put-back; the slot is donated next step)
+                v2 = k("a_copy")(g, false)
+                slots[stripe] = v2
+                return k("b_psub")([p], k("b_mul")([v2], lr))[0]
+            ts = k("b_mul_d0")([v], np.float32(self.momentum))
+            v2s, steps = k("b_mom_pair")(ts, [g], lr)
+            slots[stripe] = v2s[0]
+            return k("b_psub")([p], steps)[0]
+        if self.rule == "lion":
+            return self._arena_lion(table, stripe, p, g, lr, false)
+        return self._arena_adam(table, stripe, p, g, lr, false)
+
+    def _arena_scratch(self, kind: str, stripe: int, g):
+        s = self._arena_scr.get((kind, stripe))
+        if s is None or s.shape != g.shape:
+            s = _zeros_f32(g.shape)
+        return s
+
+    def _arena_adam(self, table, stripe, p, g, lr, false):
+        k = device_apply.k
+        b1, b2 = np.float32(self.b1), np.float32(self.b2)
+        one = np.float32(1.0)
+        ms, vs = self._arena_slots["m"], self._arena_slots["v"]
+        m = ms.get(stripe)
+        v = vs.get(stripe)
+        if m is None:
+            m = _zeros_f32(g.shape)   # the host zeros-seed, flat
+        if v is None:
+            v = _zeros_f32(g.shape)
+        t1s, t2s, t3s, t4s = k("b_adam_mul4")(
+            [m], [v], [g], b1, one - b1, b2, one - b2,
+            [self._arena_scratch("t2", stripe, g)],
+            [self._arena_scratch("t4", stripe, g)], false)
+        self._arena_scr[("t2", stripe)] = t2s[0]
+        self._arena_scr[("t4", stripe)] = t4s[0]
+        m2s, v2s = k("b_add2")(t1s, t2s, t3s, t4s)
+        ms[stripe], vs[stripe] = m2s[0], v2s[0]
+        bc1, bc2 = self._bias_corrections()
+        eps = np.float32(self.eps)
+        if self.rule == "adam":
+            return k("b_adam_fin1")([p], m2s, v2s, bc1, bc2, eps, lr)[0]
+        dens, mhs = k("b_adamw_den_mh")(
+            v2s, bc2, eps, m2s, bc1,
+            [self._arena_scratch("den", stripe, g)], false)
+        self._arena_scr[("den", stripe)] = dens[0]
+        if not self.weight_decay:
+            us = k("b_adamw_fin")(mhs, dens, lr)
+            return k("b_psub")([p], us)[0]
+        mask = table.decay_mask(stripe)
+        t = k("a_wd_mul")(p, np.float32(self.weight_decay), mask,
+                          self._arena_scratch("wd", stripe, g), false)
+        self._arena_scr[("wd", stripe)] = t
+        u = k("a_adamw_fin")(mhs[0], dens[0], t, mask, lr)
+        return k("b_psub")([p], [u])[0]
+
+    def _arena_lion(self, table, stripe, p, g, lr, false):
+        k = device_apply.k
+        b1, b2 = np.float32(self.b1), np.float32(self.b2)
+        one = np.float32(1.0)
+        slots = self._arena_slots["m"]
+        m = slots.get(stripe)
+        if m is None:
+            m = _zeros_f32(g.shape)
+        t1s, t2s, t3s, t4s = k("b_lion_mul4")(
+            [m], [g], b1, one - b1, b2, one - b2,
+            [self._arena_scratch("t2", stripe, g)],
+            [self._arena_scratch("t4", stripe, g)], false)
+        self._arena_scr[("t2", stripe)] = t2s[0]
+        self._arena_scr[("t4", stripe)] = t4s[0]
+        us = k("b_sign_add")(t1s, t2s)
+        slots[stripe] = k("b_add_d0")(t3s, t4s)[0]
+        if not self.weight_decay:
+            return k("b_psub")([p], k("b_mul_d0")(us, lr))[0]
+        mask = table.decay_mask(stripe)
+        t = k("a_wd_mul")(p, np.float32(self.weight_decay), mask,
+                          self._arena_scratch("wd", stripe, g), false)
+        self._arena_scr[("wd", stripe)] = t
+        u = k("a_lion_fin")(us[0], t, mask, lr)
+        return k("b_psub")([p], [u])[0]
+
+    # ------------------------------------------- arena slot slab sync
+    def _ensure_arena_slots(self, table) -> None:
+        """Pack the per-name slot tables into per-stripe slabs for
+        ``table``'s epoch (one host concat + one H2D per (kind, stripe);
+        missing names pack as zeros — exactly the host seed for every
+        rule but Momentum, whose mixed case :meth:`arena_ready`
+        excluded).  No-op when the slabs already match the epoch."""
+        if (self._arena_table is not None
+                and self._arena_table.epoch == table.epoch):
+            self._arena_table = table
+            return
+        import jax.numpy as jnp
+
+        with self._lock:
+            if (self._arena_table is not None
+                    and self._arena_table.epoch == table.epoch):
+                self._arena_table = table
+                return
+            if self._arena_slots:
+                # a REPACK (table epoch moved): spill the old slabs back
+                # to per-name entries first so the new layout packs the
+                # live values, not stale ones
+                self._spill_arena_locked()
+            slots: dict[str, dict[int, object]] = {}
+            for kind in self._RULE_SLOTS[self.rule]:
+                by_name = self._slots[kind]
+                if self.rule == "momentum" and not by_name:
+                    # unseeded: stripes seed lazily via the copy-seed
+                    slots[kind] = {}
+                    continue
+                per_stripe: dict[int, object] = {}
+                for stripe in range(table.stripes):
+                    size = table.stripe_sizes[stripe]
+                    if not size:
+                        continue
+                    host = np.zeros(size, np.float32)
+                    for name in table.stripe_names[stripe]:
+                        arr = by_name.get(name)
+                        if arr is not None:
+                            e = table.entries[name]
+                            host[e.offset:e.offset + e.length] = (
+                                np.asarray(np.asarray(arr),
+                                           np.float32).reshape(-1))
+                    per_stripe[stripe] = jnp.asarray(host)
+                slots[kind] = per_stripe
+                self._slots[kind] = {}
+            self._arena_slots = slots
+            self._arena_table = table
+            self._arena_scr = {}
+
+    def _spill_arena_locked(self) -> None:
+        """Materialize the slot slabs back into the per-name tables
+        (one D2H per slab, per-name device re-uploads) and drop them —
+        the per-tensor consumers' escape hatch.  Caller holds _lock."""
+        import jax.numpy as jnp
+
+        table = self._arena_table
+        if table is None or not self._arena_slots:
+            self._arena_slots = {}
+            self._arena_table = None
+            return
+        for kind, per_stripe in self._arena_slots.items():
+            by_name = self._slots.setdefault(kind, {})
+            for stripe, slab in per_stripe.items():
+                host = np.asarray(slab)
+                for name in table.stripe_names[stripe]:
+                    e = table.entries[name]
+                    by_name[name] = jnp.asarray(np.ascontiguousarray(
+                        host[e.offset:e.offset + e.length])).reshape(
+                            e.shape)
+        self._arena_slots = {}
+        self._arena_table = None
+        self._arena_scr = {}
+
     # ------------------------------------------------------- checkpoint
     def state_dict(self) -> dict:
         with self._lock:
-            out: dict = {
-                slot: {name: np.array(np.asarray(arr))
-                       for name, arr in table.items()}
-                for slot, table in self._slots.items()}
+            if self._arena_slots:
+                out = self._arena_state_dict_locked()
+            else:
+                out = {
+                    slot: {name: np.array(np.asarray(arr))
+                           for name, arr in table.items()}
+                    for slot, table in self._slots.items()}
         if self.rule in ("adam", "adamw"):
             out["step"] = self.step
+        return out
+
+    def _arena_state_dict_locked(self) -> dict:
+        """Per-name snapshot straight from the slot slabs (one D2H per
+        slab, per-name np copies of the table views) — the checkpoint
+        layout is the host optimizers', bit for bit, so .ckpt files
+        round-trip across PSDT_ARENA on/off unchanged."""
+        table = self._arena_table
+        out: dict = {}
+        for kind, per_stripe in self._arena_slots.items():
+            by_name: dict = {}
+            for stripe, slab in per_stripe.items():
+                host = np.asarray(slab)
+                for name in table.stripe_names[stripe]:
+                    e = table.entries[name]
+                    by_name[name] = np.array(
+                        host[e.offset:e.offset + e.length],
+                        np.float32).reshape(e.shape)
+            out[kind] = by_name
         return out
 
     def load_state_dict(self, state: dict) -> None:
@@ -615,6 +884,11 @@ class ShardedDeviceOptimizer(HostOptimizer):
 
         state = dict(state or {})
         with self._lock:
+            # restored state supersedes any packed slabs (and their
+            # scratch): the next arena close repacks from these tables
+            self._arena_slots = {}
+            self._arena_table = None
+            self._arena_scr = {}
             for slot in self._RULE_SLOTS[self.rule]:
                 self._slots[slot] = {
                     name: jnp.asarray(

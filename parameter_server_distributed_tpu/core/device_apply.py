@@ -311,6 +311,47 @@ def _build_kernel(name: str):
         return jax.jit(lambda us, ts, lr:
                        [(u + t) * lr for u, t in zip(us, ts)],
                        donate_argnums=(0,))
+    # ---- flat-arena stages (core/arena.py, ISSUE 15) ----
+    # Per-stripe mega-array operands: one flat f32 slab per (stripe,
+    # role) regardless of tensor count.  Same fusion rules as above —
+    # flattening changes which buffer an element lives in, never the
+    # operation sequence applied to it.  The AdamW/Lion matrices-only
+    # decay mask becomes a per-element boolean operand and a branch
+    # SELECT: both lanes are the existing per-tensor expressions, and a
+    # select preserves the taken branch's bits (a wd=0 multiply-through
+    # would not: `x + p*0` flips -0.0 to +0.0 and keeps NaN params in
+    # the plain lane).
+    if name == "a_copy":
+        # momentum's copy-seed on a slab: select of identical branches
+        # is a bit copy into a FRESH buffer (no donation) — the slot
+        # must not alias the put-back-able sums slab
+        return jax.jit(lambda x, pred: jnp.where(pred, x, x))
+    if name == "a_wd_mul":
+        # t = p*wd on the decay lane, 0 elsewhere — the product formed
+        # ALONE (the next program consumes t as an operand, so no
+        # product ever feeds an add in one program); scratch-recycled
+        # via the outer runtime-false select like b_wd_mul
+        return jax.jit(
+            lambda p, wd, mask, s, pred:
+            jnp.where(pred, s,
+                      jnp.where(mask, p * wd, jnp.float32(0.0))),
+            donate_argnums=(3,))
+    if name == "a_adamw_fin":
+        # u = ((mh/den)+t)*lr decayed / (mh/den)*lr plain, per element:
+        # the divide is CSE'd once, the add consumes a QUOTIENT and an
+        # operand (no contraction), the mul consumes the select.  mh is
+        # a retiring intermediate — donated.
+        return jax.jit(
+            lambda mhs, dens, ts, mask, lr:
+            jnp.where(mask, (mhs / dens) + ts, mhs / dens) * lr,
+            donate_argnums=(0,))
+    if name == "a_lion_fin":
+        # u = (s+t)*lr decayed / s*lr plain — s is the sign result from
+        # the prior program (donated), t the decay product operand
+        return jax.jit(
+            lambda ss, ts, mask, lr:
+            jnp.where(mask, ss + ts, ss) * lr,
+            donate_argnums=(0,))
     if name == "b_sign_add":
         # sign(t1+t2) with numpy sign semantics: ±0 -> +0.0, denormals
         # nonzero, NaN propagates (jnp.sign flushes denormals to 0 and
@@ -334,6 +375,104 @@ def k(name: str):
     fn = _kernels.get(name)
     if fn is None:
         fn = _kernels[name] = _build_kernel(name)
+    return fn
+
+
+def slab_update(ranges: tuple, mode: str, flat: bool):
+    """One jit program folding a chunk's tensors into a stripe slab at
+    STATIC (offset, length) ranges (core/arena.py, ISSUE 15) — the one
+    device op per (chunk, stripe, lane).  Static slices lower to plain
+    slice/concat updates instead of gather-scatter over index arrays,
+    so the fold runs at elementwise-add speed; compile count is one per
+    distinct range tuple, and chunk boundaries are stable across
+    iterations.  ``mode='set'`` is the exact BIT-COPY seed of a fresh
+    name (the host oracle's first-touch ``np.array(g)`` — zeros+add
+    would flip -0.0); ``mode='add'`` the correctly-rounded f32
+    accumulate, elementwise ``np.add`` exactly.  ``flat=True`` takes ONE
+    pre-concatenated host upload split by the static ranges inside the
+    program (numpy payloads cross H2D once per lane); ``flat=False``
+    takes the per-tensor device arrays as a pytree.  The slab is
+    donated and updates land in place."""
+    key = ("a_slab", mode, flat, ranges)
+    fn = _kernels.get(key)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        segments = _merge_ranges(ranges)
+
+        # The updated slab is rebuilt as ONE interleaved concatenation:
+        # per merged segment, the folded values (plus the slab's own
+        # elements on the add lane); between segments, the untouched
+        # slab slices.  One read of the slab + one write of the result
+        # — the same memory traffic as the per-tensor in-place adds —
+        # where a chain of per-tensor dynamic-update-slices costs a
+        # slab copy EACH on XLA:CPU.  adds are correctly-rounded f32
+        # (elementwise np.add exactly); sets are bit copies.
+        def run(slab, vals):
+            total = slab.shape[0]
+            pieces = []
+            pos = voff = 0
+            for dst, idxs, seglen in segments:
+                if dst > pos:
+                    pieces.append(slab[pos:dst])
+                if flat:
+                    v = vals[0][voff:voff + seglen]
+                    voff += seglen
+                else:
+                    parts = [vals[i].astype(jnp.float32).reshape(-1)
+                             for i in idxs]
+                    v = parts[0] if len(parts) == 1 else \
+                        jnp.concatenate(parts)
+                pieces.append(v if mode == "set"
+                              else slab[dst:dst + seglen] + v)
+                pos = dst + seglen
+            if pos < total:
+                pieces.append(slab[pos:total])
+            return (pieces[0] if len(pieces) == 1
+                    else jnp.concatenate(pieces))
+
+        fn = _kernels[key] = jax.jit(run, donate_argnums=(0,))
+    return fn
+
+
+def _merge_ranges(ranges: tuple) -> list:
+    """Merge ABUTTING (offset, length) ranges (sorted by offset) into
+    (offset, [input indices], total length) segments — a whole-store
+    push over an unpadded stripe collapses to one segment."""
+    segments: list[tuple[int, list[int], int]] = []
+    for i, (off, ln) in enumerate(ranges):
+        if segments and segments[-1][0] + segments[-1][2] == off:
+            segments[-1] = (segments[-1][0], segments[-1][1] + [i],
+                            segments[-1][2] + ln)
+        else:
+            segments.append((off, [i], ln))
+    return segments
+
+
+def slab_full_cover(ranges: tuple, size: int) -> bool:
+    """True when ``ranges`` tile [0, size) exactly — a set-lane fold
+    then needs no existing slab at all (the assembled values ARE the
+    slab, skipping the zeros seed and its memset)."""
+    merged = _merge_ranges(ranges)
+    return len(merged) == 1 and merged[0][0] == 0 and merged[0][2] == size
+
+
+def slab_assemble(ranges: tuple):
+    """The no-prior-slab seed: concatenate the per-tensor device values
+    into the stripe slab (bit copies, one kernel)."""
+    key = ("a_assemble", ranges)
+    fn = _kernels.get(key)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def run(vals):
+            parts = [v.astype(jnp.float32).reshape(-1) for v in vals]
+            return parts[0] if len(parts) == 1 else \
+                jnp.concatenate(parts)
+
+        fn = _kernels[key] = jax.jit(run)
     return fn
 
 

@@ -97,6 +97,7 @@ from ..elastic import quorum as equorum
 from ..obs import flight
 from ..obs import stats as obs_stats
 from ..replication.messages import STALE_SHARD_MAP
+from . import arena as arena_mod
 from . import device_apply
 from .optimizer import HostOptimizer, SGD
 from .stripes import partition_names, run_striped, stripe_count, stripe_of
@@ -450,6 +451,22 @@ class ParameterServerCore:
             [int, TensorStore, dict[str, int]], TensorStore] | None = None
         self._optimizer = optimizer or SGD(learning_rate=1.0)
         self._staleness_bound = int(staleness_bound)
+        # Flat arena apply (core/arena.py, ISSUE 15): per-stripe
+        # mega-array layout for fold, close, readback, and encode.
+        # Armed by PSDT_ARENA for streaming-sync cores whose optimizer
+        # speaks the flat-slab stage family (ShardedDeviceOptimizer);
+        # default off = the PR 11 per-tensor path, byte-identical.  Any
+        # shape the flat layout cannot represent exactly downgrades the
+        # affected CLOSE to the per-tensor path (counter + flight code),
+        # and a packing exception latches the arena off — never a boot
+        # or close failure.
+        self._arena = (
+            arena_mod.ArenaManager(self._stripes)
+            if (arena_mod.enabled()
+                and self._streaming and self._staleness_bound == 0
+                and getattr(self._optimizer, "supports_arena", False)
+                and device_apply.available())
+            else None)
         # K-of-N quorum barriers (elastic/quorum.py, ISSUE 13): 0.0 =
         # off, the default — every pre-existing path byte-identical.
         # Armed (PSDT_QUORUM / constructor), the streaming sync barrier
@@ -953,6 +970,8 @@ class ParameterServerCore:
             # per-chunk arrival record a postmortem orders folds by
             flight.record("fold.reserve", iteration=iteration,
                           worker=worker_id, a=len(gradients))
+            if gradients:
+                self._maybe_arena_accum_locked(state)
             folded = state.folded.setdefault(worker_id, set())
             if self._stripes <= 1:
                 self._fold_into_locked(state, folded, gradients)
@@ -1007,6 +1026,7 @@ class ParameterServerCore:
             todo = {name: g for name, g in gradients.items()
                     if name not in folded and name not in reserved}
             if todo:
+                self._maybe_arena_accum_locked(st)
                 self._fold_into_locked(
                     st, folded, self._damping.damp(todo, staleness))
                 self._obs_stale_folds.add()
@@ -1051,11 +1071,77 @@ class ParameterServerCore:
                 f"(staleness {staleness}, lr damped)",
                 iteration, complete, received, total)
 
+    def _maybe_arena_accum_locked(self, state: IterationState) -> None:
+        """Decide a fresh iteration state's accumulator residence
+        (caller holds _state_lock): with the flat arena armed and a
+        packing table available for the live store, the running sums
+        live as per-stripe flat device slabs (core/arena.py ArenaAccum)
+        from the first fold on.  Residency is fixed at first fold — a
+        state that already accumulated per-tensor stays per-tensor."""
+        if self._arena is None or not self._arena.active:
+            return
+        if isinstance(state.accum, arena_mod.ArenaAccum):
+            return
+        if state.accum or state.counts:
+            return
+        with self._params_lock:
+            store = self._params
+        table = self._arena.ensure_table(store)
+        if table is not None:
+            state.accum = self._arena.new_accum(table)
+
+    def _arena_fold(self, state: IterationState, folded: set,
+                    gradients: Mapping[str, np.ndarray],
+                    weight: int) -> int:
+        """Fold into the arena accumulator: one scatter per (chunk,
+        stripe, lane), index ranges precomputed from the packing table.
+        Names the table cannot represent exactly (unknown, or the host
+        fold's legal broadcast-up) take the pre-existing per-tensor
+        ``_fold_one`` path into the accumulator's overflow dict — their
+        presence downgrades the close to the per-tensor apply.  Returns
+        bytes newly resident; marks folded names as their fold lands.
+        Caller holds the lock covering the touched stripes (_state_lock
+        on the serial path, the stripe lock on the striped path)."""
+        accum: arena_mod.ArenaAccum = state.accum
+        table = accum.table
+        added = 0
+        by_stripe: dict[int, list] = {}
+        for name, g in gradients.items():
+            if name in folded:
+                continue
+            if (table.compatible(name, g) and name not in accum.overflow
+                    and name not in accum.popped):
+                by_stripe.setdefault(table.entries[name].stripe,
+                                     []).append((name, g))
+            else:
+                # a name the slab cannot take (unknown, the host fold's
+                # legal broadcast-up, or already converged per-tensor):
+                # its running sum must live in exactly ONE place, so a
+                # slab-resident partial sum is EVICTED into overflow
+                # first — otherwise the fallback close would divide by
+                # a count covering contributions it cannot see
+                accum.evict_to_overflow(name)
+                added += _fold_one(accum.overflow, state.counts, name, g,
+                                   weight)
+                folded.add(name)
+        for stripe in sorted(by_stripe):
+            items = by_stripe[stripe]
+            added += accum.fold_group(stripe, items, state.counts,
+                                      weight)
+            folded.update(name for name, _ in items)
+        return added
+
     def _fold_into_locked(self, state: IterationState, folded: set,
                           gradients: Mapping[str, np.ndarray],
                           weight: int = 1) -> None:
         """The serial fold (caller holds _state_lock) — the exact
         pre-stripe code path, used at stripes == 1."""
+        if isinstance(state.accum, arena_mod.ArenaAccum):
+            added = self._arena_fold(state, folded, gradients, weight)
+            if added:
+                state.buffer_bytes += added
+                self._grad_buffer_note(added)
+            return
         added = 0
         try:
             for name, g in gradients.items():
@@ -1091,6 +1177,15 @@ class ParameterServerCore:
 
         def fold_group(idx: int, stripe: int, items: list) -> None:
             with self._stripe_locks[stripe]:
+                if isinstance(state.accum, arena_mod.ArenaAccum):
+                    # arena residence: one scatter per lane over the
+                    # stripe's slab (the reservation already filtered
+                    # duplicates, so a local folded set suffices)
+                    local: set[str] = set()
+                    added_by[idx] += self._arena_fold(
+                        state, local, dict(items), 1)
+                    done_by[idx].extend(local)
+                    return
                 for name, g in items:
                     # _fold_one raises (mutating nothing) on a shape
                     # mismatch — the name stays unpublished, so a retry
@@ -1209,6 +1304,8 @@ class ParameterServerCore:
                     iteration, False, len(state.contributors), total)
             flight.record("fold.reserve", iteration=iteration,
                           worker=worker_id, a=len(gradients))
+            if gradients:
+                self._maybe_arena_accum_locked(state)
             self._fold_into_locked(
                 state, state.folded.setdefault(worker_id, set()),
                 gradients, weight)
@@ -1515,7 +1612,13 @@ class ParameterServerCore:
                             # barrier retryable, relay retry idempotent
                             # upstream via the PS's per-(worker, tensor)
                             # dedup and member cover.
-                            if device_apply.is_device_store(sums):
+                            if isinstance(sums, arena_mod.ArenaAccum):
+                                # arena-resident leaf sums: one readback
+                                # per stripe, then writable per-name
+                                # host copies (same relay contract as
+                                # the per-tensor device branch below)
+                                sums = sums.to_host_dict()
+                            elif device_apply.is_device_store(sums):
                                 # leaf with device member folds (PR-9
                                 # intra-host tier): start every D2H,
                                 # then materialize HOST sums for the
@@ -1545,16 +1648,39 @@ class ParameterServerCore:
                                 _dver = self._params_version
                             self._notify_delta(_dstore, _dver)
                         else:
-                            # contributor mean without a per-worker
-                            # sweep: one in-place O(model) scale of the
-                            # running sums (per-name counts — see
-                            # IterationState.counts), stripe-parallel; a
-                            # FULL scale pass completes before the apply
-                            # so the put-back semantics on an apply
-                            # failure stay exact (counts reset to 1)
-                            self._scale_striped(sums, counts)
-                            scaled = True
-                            self._apply_update(sums)
+                            if isinstance(sums, arena_mod.ArenaAccum):
+                                # flat arena close (ISSUE 15): anything
+                                # the flat layout cannot represent
+                                # exactly converts to the per-tensor
+                                # path for THIS close (counter + flight
+                                # code), never fails
+                                reason = self._arena_fallback_reason(
+                                    sums, counts)
+                                if reason is not None:
+                                    self._arena.fallback(reason,
+                                                         iteration)
+                                    sums = sums.to_tensor_dict()
+                            if isinstance(sums, arena_mod.ArenaAccum):
+                                # contributor-mean scale as ONE kernel
+                                # per stripe (counts proven uniform —
+                                # the same f32 scalar as the per-tensor
+                                # scale), then the fused flat apply
+                                sums.scale_uniform(
+                                    next(iter(counts.values())))
+                                scaled = True
+                                self._apply_arena_sync(sums, iteration)
+                            else:
+                                # contributor mean without a per-worker
+                                # sweep: one in-place O(model) scale of
+                                # the running sums (per-name counts —
+                                # see IterationState.counts), stripe-
+                                # parallel; a FULL scale pass completes
+                                # before the apply so the put-back
+                                # semantics on an apply failure stay
+                                # exact (counts reset to 1)
+                                self._scale_striped(sums, counts)
+                                scaled = True
+                                self._apply_update(sums)
                         flight.record(
                             "apply.end", iteration=iteration,
                             a=int(1e6 * (time.perf_counter() - ta)))
@@ -1712,6 +1838,99 @@ class ParameterServerCore:
 
         run_striped([(lambda ns=ns: scale_group(ns))
                      for ns in partition_names(sums, self._stripes)])
+
+    # ------------------------------------------------------ arena close
+    def _arena_fallback_reason(self, sums: "arena_mod.ArenaAccum",
+                               counts: dict[str, int]) -> str | None:
+        """None when the flat close may run; otherwise the reason the
+        per-tensor path must take this close (core/arena.py downgrade
+        matrix).  Caller holds _apply_lock, so the store and table are
+        stable for the rest of the close."""
+        if self._arena is None or not self._arena.active:
+            return "disabled"
+        table = sums.table
+        with self._params_lock:
+            store = self._params
+        live = self._arena.ensure_table(store)
+        if live is None or live.epoch != table.epoch:
+            # the store's shape moved under the open accumulator (the
+            # epoch fence) — or the table build latched off
+            return "epoch"
+        if not sums.full_coverage():
+            # pass-through names, retired (popped) names, or overflow
+            # folds the table could not represent
+            return "coverage"
+        values = iter(counts.values())
+        first = next(values, None)
+        if first is None or any(c != first for c in values):
+            # non-uniform per-name contributor counts (quorum straggler
+            # folds, sharded disjoint-subset pushes): the flat scale is
+            # one scalar per stripe, so these keep the per-name path
+            return "counts"
+        ready = getattr(self._optimizer, "arena_ready", None)
+        if ready is None or not ready(table):
+            return "slots"  # mixed momentum seeding (reshard merges)
+        return None
+
+    def _apply_arena_sync(self, sums: "arena_mod.ArenaAccum",
+                          iteration: int) -> None:
+        """The flat barrier close (ISSUE 15; caller holds _apply_lock,
+        ``sums`` already scaled to contributor means): every optimizer
+        stage runs as ONE fused kernel per stripe over the flat slabs,
+        the D2H readback is ONE contiguous transfer per stripe, and the
+        published store is an ArenaStore of zero-copy numpy views the
+        serve encode / delta build / checkpoint slice by table offset.
+        A packing failure latches the arena off and completes THIS close
+        on the per-tensor path — the close never fails for arena
+        reasons (optimizer-stage exceptions keep the ordinary put-back/
+        retry contract)."""
+        t0 = time.perf_counter()
+        table = sums.table
+        with self._params_lock:
+            prev = self._params
+        try:
+            param_slabs = self._arena.ensure_param_slabs(prev, table,
+                                                         iteration)
+        except Exception as exc:  # noqa: BLE001 — packing must never
+            # fail a close; the per-tensor device path is always correct
+            self._arena.latch_off(f"{type(exc).__name__}: {exc}")
+            self._apply_update(sums.to_tensor_dict())
+            return
+        opt = self._optimizer
+        opt.tick()
+        td = time.perf_counter()
+        new_slabs = opt.apply_arena(table, param_slabs, sums.slabs)
+        dispatch_us = int(1e6 * (time.perf_counter() - td))
+        # ONE contiguous D2H per stripe: start every transfer, then
+        # materialize the host slabs the per-tensor views slice
+        tr = time.perf_counter()
+        device_apply.readback_async(new_slabs)
+        host_slabs = {s: np.asarray(a) for s, a in new_slabs.items()}
+        readback_us = int(1e6 * (time.perf_counter() - tr))
+        per_stripe = {s: table.views(s, h) for s, h in host_slabs.items()}
+        views: TensorStore = {}
+        for name in prev:
+            # the store's key order is preserved, so serve chunking and
+            # wire bytes are identical to the per-tensor path's
+            views[name] = per_stripe[table.entries[name].stripe][name]
+        store = arena_mod.ArenaStore(views, table, host_slabs)
+        with self._params_lock:
+            if self._params is not prev:
+                # initialize_parameters() landed during the close: the
+                # newer store wins (the _apply_striped_sync rule)
+                return
+            self._params = store
+            self._params_version += 1
+            version = self._params_version
+        self._arena.adopt(store, new_slabs)
+        self._arena.note_close()
+        self._obs_device_applies.add()
+        flight.record("apply.arena", iteration=iteration, a=dispatch_us,
+                      b=readback_us)
+        flight.record("apply.device",
+                      a=int(1e6 * (time.perf_counter() - t0)),
+                      b=self._stripes)
+        self._notify_delta(store, version)
 
     def _apply_striped_sync(self, prev: TensorStore,
                             mean_grads: TensorStore) -> None:
@@ -1990,8 +2209,11 @@ class ParameterServerCore:
                           a=int(epoch))
         # the restored store is a new world: stale delta pairs must not
         # patch receivers toward it (outside the core locks — reset is
-        # cheap but the sink has its own lock)
+        # cheap but the sink has its own lock), and the arena's adopted
+        # param slabs no longer describe the live store
         self._reset_delta()
+        if self._arena is not None:
+            self._arena.invalidate()
 
     # ------------------------------------------------------------ replication
     def set_replication_hook(self, hook: Callable[[], None] | None) -> None:
@@ -2128,8 +2350,11 @@ class ParameterServerCore:
             self._barrier_cv.notify_all()
         # the store changed outside the apply timeline: stale delta pairs
         # must not patch receivers toward the installed state (restore()
-        # discipline — outside the core locks)
+        # discipline — outside the core locks); the arena re-proves its
+        # table and repacks param slabs at next use
         self._reset_delta()
+        if self._arena is not None:
+            self._arena.invalidate()
         return version
 
     def retire_tensors(self, names, map_epoch: int
@@ -2202,8 +2427,12 @@ class ParameterServerCore:
             result = (self._epoch, self._current_iteration, version, moved,
                       moved_opt)
         # a retire reshapes the store: delta pairs built against the
-        # pre-fence world must not serve (restore() discipline)
+        # pre-fence world must not serve (restore() discipline), and the
+        # packing table rebuilds without the tombstoned names — they
+        # vacate their slab at the next epoch (core/arena.py)
         self._reset_delta()
+        if self._arena is not None:
+            self._arena.invalidate()
         return result
 
 

@@ -176,6 +176,15 @@ class DeltaChain:
         # previous version's wire image (one writer: the serialized
         # apply hook) + its generation fence against a concurrent reset
         self._wire_prev: dict[str, np.ndarray] | None = None
+        # flat-arena stores (core/arena.py ArenaStore, ISSUE 15) also
+        # retain the previous image as whole per-stripe wire SLABS —
+        # (packing table, {stripe: wire slab}) — so the next build's
+        # bitwise diff is one vector compare per stripe slab split per
+        # tensor by table offset, instead of a compare per tensor.  The
+        # per-name views above stay populated (they alias the slabs),
+        # so a residency flip mid-chain degrades to the per-name diff,
+        # never to a missed pair.
+        self._prev_slabs: tuple | None = None
         self._prev_version = -1
         self._gen = 0
         self._obs_build_ms = obs_stats.histogram("ps.serve.delta_build_ms")
@@ -196,6 +205,19 @@ class DeltaChain:
                           version)
             self.reset()
 
+    @staticmethod
+    def _diff_entry(name: str, prev_bits, new_bits, wire: np.ndarray,
+                    itemsize: int) -> tuple:
+        """One tensor's pair entry from its (bitwise) changed-index set
+        — shared by the per-name and slab diffs, so their bytes are
+        identical by construction."""
+        idx_changed = np.flatnonzero(prev_bits != new_bits)
+        n, total = int(idx_changed.size), int(wire.size)
+        if n * (4 + itemsize) < total * itemsize:
+            return (name, idx_changed.astype("<u4").tobytes(),
+                    wire[idx_changed].tobytes(), False, n)
+        return (name, b"", wire.tobytes(), True, n)
+
     def _note_apply(self, store: Mapping[str, np.ndarray],
                     version: int) -> None:
         t0 = time.perf_counter()
@@ -203,10 +225,62 @@ class DeltaChain:
             gen = self._gen
             prev = self._wire_prev
             prev_version = self._prev_version
+            prev_slabs = self._prev_slabs
         diffable = (prev is not None and version == prev_version + 1
                     and set(prev) == set(store))
         itemsize = _ELEMENTWISE[self.wire_dtype]
         names = sorted(store)
+        layout = getattr(store, "layout", None)
+        slabs = getattr(store, "slabs", None)
+        new_slabs: tuple | None = None
+        if layout is not None and slabs is not None:
+            # flat-arena store (ISSUE 15): encode + diff whole stripe
+            # SLABS — the contiguous layout makes the bitwise diff a
+            # straight vector compare over each slab, split per tensor
+            # by table offset; entry bytes are identical to the
+            # per-name path's by construction (_diff_entry)
+            merged, wire_slabs = self._build_arena(
+                store, layout, slabs, diffable, prev, prev_slabs,
+                itemsize)
+            new_slabs = (layout, wire_slabs)
+        else:
+            merged = self._build_per_name(store, names, diffable, prev,
+                                          itemsize)
+        wires = {name: merged[name][0] for name in names}
+        crc = fold_crcs({name: merged[name][1] for name in names})
+        pair = None
+        if diffable and all(merged[n][2] is not None for n in names):
+            entries = [merged[n][2][:4] for n in names]
+            nbytes = sum(len(e[1]) + len(e[2]) for e in entries)
+            changed = sum(merged[n][2][4] for n in names)
+            total = sum(int(w.size) for w in wires.values())
+            pair = DeltaPair(prev_version, version, entries, nbytes, crc,
+                             changed, total)
+        with self._lock:
+            if self._gen != gen:
+                return  # a reset landed mid-build: this image is stale
+            self._wire_prev = wires
+            self._prev_slabs = new_slabs
+            self._prev_version = version
+            if pair is not None:
+                self._pairs[pair.from_version] = pair
+                while len(self._pairs) > self.depth:
+                    self._pairs.popitem(last=False)
+                self._obs_pair_bytes.set(pair.nbytes)
+                flight.record("serve.delta.build", a=pair.nbytes,
+                              b=version)
+            else:
+                # version gap / shape change: older pairs can no longer
+                # chain to the current version — drop them
+                self._pairs.clear()
+            self._cv.notify_all()
+        self._obs_build_ms.observe(1e3 * (time.perf_counter() - t0))
+
+    def _build_per_name(self, store: Mapping[str, np.ndarray],
+                        names: list[str], diffable: bool,
+                        prev: dict | None, itemsize: int) -> dict:
+        """The per-tensor encode + diff (the pre-arena path): one wire
+        encode and one bitwise compare per tensor, stripe-parallel."""
         groups = (partition_names(names, self._stripes)
                   if len(names) > 1 else [list(names)])
         results: list[dict] = [{} for _ in groups]
@@ -227,50 +301,101 @@ class DeltaChain:
                     else:
                         prev_bits = prev[name].view("<u4")
                         new_bits = wire.view("<u4")
-                    idx_changed = np.flatnonzero(prev_bits != new_bits)
-                    n, total = int(idx_changed.size), int(wire.size)
-                    if n * (4 + itemsize) < total * itemsize:
-                        entry = (name,
-                                 idx_changed.astype("<u4").tobytes(),
-                                 wire[idx_changed].tobytes(), False, n)
-                    else:
-                        entry = (name, b"", wire.tobytes(), True, n)
+                    entry = self._diff_entry(name, prev_bits, new_bits,
+                                             wire, itemsize)
                 out[name] = (wire, crc, entry)
 
         run_striped([(lambda i=i, g=g: build_group(i, g))
                      for i, g in enumerate(groups)])
-
         merged: dict[str, tuple] = {}
         for out in results:
             merged.update(out)
-        wires = {name: merged[name][0] for name in names}
-        crc = fold_crcs({name: merged[name][1] for name in names})
-        pair = None
-        if diffable and all(merged[n][2] is not None for n in names):
-            entries = [merged[n][2][:4] for n in names]
-            nbytes = sum(len(e[1]) + len(e[2]) for e in entries)
-            changed = sum(merged[n][2][4] for n in names)
-            total = sum(int(w.size) for w in wires.values())
-            pair = DeltaPair(prev_version, version, entries, nbytes, crc,
-                             changed, total)
-        with self._lock:
-            if self._gen != gen:
-                return  # a reset landed mid-build: this image is stale
-            self._wire_prev = wires
-            self._prev_version = version
-            if pair is not None:
-                self._pairs[pair.from_version] = pair
-                while len(self._pairs) > self.depth:
-                    self._pairs.popitem(last=False)
-                self._obs_pair_bytes.set(pair.nbytes)
-                flight.record("serve.delta.build", a=pair.nbytes,
-                              b=version)
-            else:
-                # version gap / shape change: older pairs can no longer
-                # chain to the current version — drop them
-                self._pairs.clear()
-            self._cv.notify_all()
-        self._obs_build_ms.observe(1e3 * (time.perf_counter() - t0))
+        return merged
+
+    def _build_arena(self, store: Mapping[str, np.ndarray], layout,
+                     slabs: Mapping[int, np.ndarray], diffable: bool,
+                     prev: dict | None, prev_slabs: tuple | None,
+                     itemsize: int) -> tuple[dict, dict]:
+        """The slab encode + diff for a flat-arena store: per stripe,
+        ONE wire-space encode of the whole host slab and — when the
+        previous image was retained under the SAME packing-table epoch —
+        ONE bitwise vector compare over it, with the changed-index set
+        split per tensor by table offset (searchsorted).  Per-tensor
+        wire views slice the slab encoding, so entry bytes, crcs, and
+        the sparse/dense decision are identical to the per-name path's.
+        Falls to the per-name diff per tensor when the previous image
+        predates the arena (a residency flip mid-chain)."""
+        slab_prev = None
+        if (diffable and prev_slabs is not None
+                and prev_slabs[0].epoch == layout.epoch):
+            slab_prev = prev_slabs[1]
+        merged: dict[str, tuple] = {}
+        wire_slabs: dict[int, np.ndarray] = {}
+        stripes = sorted(slabs)
+        results: list[tuple] = [None] * len(stripes)
+
+        def build_stripe(idx: int, stripe: int) -> None:
+            host = slabs[stripe]
+            wire_slab = encode_wire(
+                np.asarray(host, np.float32).reshape(-1),
+                self.wire_dtype)
+            changed = None
+            if slab_prev is not None and stripe in slab_prev \
+                    and slab_prev[stripe].size == wire_slab.size:
+                if self.wire_dtype == WIRE_BF16:
+                    prev_bits, new_bits = slab_prev[stripe], wire_slab
+                else:
+                    prev_bits = slab_prev[stripe].view("<u4")
+                    new_bits = wire_slab.view("<u4")
+                # the slab diff: one vector compare over the whole
+                # contiguous stripe (padding elements never change)
+                changed = np.flatnonzero(prev_bits != new_bits)
+            out: dict[str, tuple] = {}
+            for name in layout.stripe_names[stripe]:
+                e = layout.entries[name]
+                wire = wire_slab[e.offset:e.offset + e.length]
+                crc = tensor_crc(decoded_f32(wire, self.wire_dtype))
+                entry = None
+                if changed is not None:
+                    lo, hi = np.searchsorted(
+                        changed, (e.offset, e.offset + e.length))
+                    local = (changed[lo:hi] - e.offset).astype("<u4")
+                    n, total = int(local.size), int(wire.size)
+                    if n * (4 + itemsize) < total * itemsize:
+                        entry = (name, local.tobytes(),
+                                 wire_slab[changed[lo:hi]].tobytes(),
+                                 False, n)
+                    else:
+                        entry = (name, b"", wire.tobytes(), True, n)
+                elif diffable and prev is not None \
+                        and prev[name].size == wire.size:
+                    # previous image predates the arena: per-name diff
+                    if self.wire_dtype == WIRE_BF16:
+                        prev_bits, new_bits = prev[name], wire
+                    else:
+                        prev_bits = prev[name].view("<u4")
+                        new_bits = wire.view("<u4")
+                    entry = self._diff_entry(name, prev_bits, new_bits,
+                                             wire, itemsize)
+                out[name] = (wire, crc, entry)
+            results[idx] = (wire_slab, out)
+
+        run_striped([(lambda i=i, s=s: build_stripe(i, s))
+                     for i, s in enumerate(stripes)])
+        for idx, stripe in enumerate(stripes):
+            wire_slab, out = results[idx]
+            wire_slabs[stripe] = wire_slab
+            merged.update(out)
+        # names outside the slabs cannot occur (an ArenaStore's views
+        # cover exactly the table), but stay defensive: encode any
+        # stragglers per name so the image is complete
+        for name in store:
+            if name not in merged:
+                flat = np.asarray(store[name], np.float32).reshape(-1)
+                wire = encode_wire(flat, self.wire_dtype)
+                merged[name] = (wire, tensor_crc(
+                    decoded_f32(wire, self.wire_dtype)), None)
+        return merged, wire_slabs
 
     def reset(self) -> None:
         """Invalidate everything (restore / replication install /
@@ -280,6 +405,7 @@ class DeltaChain:
             self._gen += 1
             self._pairs.clear()
             self._wire_prev = None
+            self._prev_slabs = None
             self._prev_version = -1
             self._cv.notify_all()
 

@@ -428,8 +428,11 @@ class DecodeServer:
         self._prompt_hits = 0
         # shared-PREFIX reuse across requests (fleet/, ISSUE 14): a miss
         # whose prompt extends a cached prompt forwards only the suffix
-        # (_extend_runner); plain mode only — speculative admissions also
-        # need a draft row, which the extension does not produce
+        # (_extend_runner).  Plain mode, and speculative mode while the
+        # depth controller has speculation disabled (k == 0 — no draft
+        # row would be seeded anyway, ISSUE 15 satellite); active
+        # speculative admissions need a draft row the extension does
+        # not produce and keep the full prefill.
         self._prefix_hits = 0
         self._obs_prefix = obs_stats.counter("serve.prefix_hits")
         # params version tag (fleet/ version-skew bookkeeping): 0 = boot
@@ -704,8 +707,19 @@ class DecodeServer:
                     jnp.asarray(real_len, jnp.int32))
                 self._prompt_cache[pkey] = (last, row, d_row)
         else:
+            # Shared-prefix extension serves the prompt phase whenever a
+            # draft K/V row would NOT be seeded anyway: plain mode, and
+            # speculative mode while the depth controller has
+            # speculation off (k == 0 skips the draft prefill below, so
+            # the extension gives up nothing — speculative fleets stop
+            # paying full prefill on every extending miss).  With k > 0
+            # the admission needs a draft row the extension cannot
+            # produce, so it stays on the full-prefill path; a later
+            # re-probe backfills cached entries via the d_row repair
+            # above, exactly like any other k==0-era entry.
             extended = (self._prefix_extend(prompt, real_len)
-                        if self.prompt_cache_size and self.draft is None
+                        if self.prompt_cache_size
+                        and (self.draft is None or self._k == 0)
                         else None)
             if extended is not None:
                 # shared-prefix hit: only the suffix ran a forward; the

@@ -85,6 +85,20 @@ def _ps_rollup(snap: dict) -> dict:
             device[key] = value
     if device:
         out["device_apply"] = device
+    # flat arena apply (core/arena.py, ISSUE 15): mega-array closes,
+    # per-close downgrades to the per-tensor path, and the packing
+    # padding overhead (the PSDT_ARENA_ALIGN cost)
+    arena: dict = {}
+    for key, name in (("applies", "ps.apply.arena"),
+                      ("fallbacks", "ps.apply.arena_fallback")):
+        value = counters.get(name, 0)
+        if value:
+            arena[key] = value
+    pad = snap.get("gauges", {}).get("ps.apply.arena_pad")
+    if arena and pad is not None:
+        arena["pad"] = pad
+    if arena:
+        out["arena"] = arena
     # elastic quorum barriers (elastic/, ISSUE 13): K-of-N closes and
     # straggler gradients folded forward damped
     quorum = counters.get("ps.barrier.quorum_closes", 0)
@@ -384,6 +398,17 @@ def render_rollup(rollup: dict) -> str:
                 note = f"device apply {dapply.get('applies', 0)} closes"
                 if dapply.get("fallbacks"):
                     note += f" ({dapply['fallbacks']} fallbacks)"
+                parts.append(note)
+            arena = ps.get("arena")
+            if arena:
+                note = f"arena {arena.get('applies', 0)} flat closes"
+                extras = []
+                if arena.get("fallbacks"):
+                    extras.append(f"{arena['fallbacks']} fallbacks")
+                if arena.get("pad"):
+                    extras.append(f"pad {100 * arena['pad']:.1f}%")
+                if extras:
+                    note += f" ({', '.join(extras)})"
                 parts.append(note)
             if ps.get("quorum_closes"):
                 parts.append(f"{ps['quorum_closes']} quorum closes")

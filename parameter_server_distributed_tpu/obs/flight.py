@@ -179,6 +179,16 @@ EVENTS: dict[str, int] = {
                                   # b = server id; note = phase
     "fleet.swap": 126,            # decode server swapped its serving
                                   # version; a = version, b = server id
+    # flat arena apply (core/arena.py, ISSUE 15)
+    "apply.arena.pack": 130,      # packing table built / param slabs
+                                  # packed; a = duration_us, b = stripes
+    "apply.arena.repack": 131,    # table REBUILT on a store-shape
+                                  # change (epoch bump); a = duration_us
+    "apply.arena.fallback": 132,  # a close downgraded to the per-tensor
+                                  # path; note = reason (coverage /
+                                  # counts / epoch / slots / latched)
+    "apply.arena": 133,           # flat close published; a =
+                                  # dispatch_us, b = readback_us
 }
 EVENT_NAMES = {code: name for name, code in EVENTS.items()}
 

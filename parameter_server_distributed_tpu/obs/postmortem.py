@@ -256,6 +256,27 @@ def iteration_timeline(events: list[dict], iteration: int) -> dict:
         start, end = applies[0]
         out["apply_s"] = end["a"] / 1e6
         out["apply_ts"] = start["ts"]
+    # flat arena apply (core/arena.py, ISSUE 15): the close's arena
+    # phases — slab pack(s) attributed to this iteration, the fused
+    # stage dispatch, and the contiguous per-stripe readback — rendered
+    # as an "arena:" line next to the apply phases
+    arena_closes = [e for e in evs if e["event"] == "apply.arena"]
+    if arena_closes:
+        a = arena_closes[-1]
+        arena: dict[str, Any] = {"dispatch_s": a["a"] / 1e6,
+                                 "readback_s": a["b"] / 1e6}
+        packs = [e for e in evs
+                 if e["event"] in ("apply.arena.pack",
+                                   "apply.arena.repack")]
+        if packs:
+            arena["pack_s"] = sum(e["a"] for e in packs) / 1e6
+            arena["repacked"] = any(e["event"] == "apply.arena.repack"
+                                    for e in packs)
+        out["arena"] = arena
+    arena_fallbacks = [e for e in evs
+                       if e["event"] == "apply.arena.fallback"]
+    if arena_fallbacks:
+        out["arena_fallback"] = arena_fallbacks[-1].get("note", "")
     if publishes:
         pub = publishes[-1]
         out["publish_ts"] = pub["ts"]
@@ -584,6 +605,19 @@ def render_report(rep: dict) -> str:
                          f"({fold['tensors']} tensors, lr damped)")
         if "apply_s" in tl:
             lines.append(f"  optimizer apply: {_fmt_dt(tl['apply_s'])}")
+        arena = tl.get("arena")
+        if arena:
+            parts = []
+            if "pack_s" in arena:
+                parts.append(
+                    ("repack " if arena.get("repacked") else "pack ")
+                    + _fmt_dt(arena["pack_s"]))
+            parts.append(f"dispatch {_fmt_dt(arena['dispatch_s'])}")
+            parts.append(f"readback {_fmt_dt(arena['readback_s'])}")
+            lines.append("  arena: " + " + ".join(parts))
+        if tl.get("arena_fallback") is not None and "arena" not in tl:
+            lines.append("  arena: FELL BACK to per-tensor "
+                         f"({tl['arena_fallback'] or 'unknown'})")
         dserve = tl.get("delta_serve")
         if dserve:
             note = (f"  delta serve: {dserve['hits']} chain hits "

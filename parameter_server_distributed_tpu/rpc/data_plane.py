@@ -152,7 +152,15 @@ def encode_parameter_record_groups(
     preserved and each group's bytes are exactly what the serial encode
     produces — the wire format is untouched, only WHICH thread runs each
     group's payload casts/packs changes (the numpy casts release the GIL,
-    so a multi-chunk store encodes on multiple cores)."""
+    so a multi-chunk store encodes on multiple cores).
+
+    Flat-arena stores (core/arena.py ArenaStore, ISSUE 15) feed this
+    fan-out ZERO-COPY by construction: their tensor values are numpy
+    views slicing the per-stripe readback slab by packing-table offset,
+    so the payload casts/packs here read the slab directly instead of
+    re-gathering per-tensor device buffers — and because view identity
+    never changes the f32 values, the encoded bytes are byte-identical
+    to the per-tensor path's."""
     from ..core.stripes import run_striped, stripe_count
 
     if len(groups) <= 1 or stripe_count(stripes) <= 1:

@@ -53,6 +53,23 @@ def each_codec(request):
             not in ("0", "false"))
 
 
+@pytest.fixture(params=["0", "1"])
+def each_arena(request, monkeypatch):
+    """Parametrize a device-apply test across the flat-arena layout
+    (PSDT_ARENA=0/1 — core/arena.py, ISSUE 15): the ``0`` leg pins the
+    PR 11 per-tensor device path, the ``1`` leg runs the same closes
+    through the per-stripe mega-array layout (skipped cleanly when no
+    jax backend owns a device).  Yields the flag value; cores read it
+    at construction, so construct the core inside the test body."""
+    if request.param == "1":
+        from parameter_server_distributed_tpu.core import device_apply
+
+        if not device_apply.available():
+            pytest.skip("no jax backend/device for the arena leg")
+    monkeypatch.setenv("PSDT_ARENA", request.param)
+    yield request.param
+
+
 @pytest.fixture(autouse=True)
 def _lockcheck_env(request, monkeypatch):
     """Opt-in runtime lock-discipline checking: tests marked

@@ -449,6 +449,29 @@ def test_delta_extension_stays_out_of_the_wire_manifest():
         | set(m.PARAMETER_SERVER_STREAM_METHODS))
 
 
+def test_arena_introduces_no_wire_drift_and_declares_its_lock():
+    """ISSUE 15 compat gate: the flat arena (core/arena.py) is a RUNTIME
+    layout, never a wire or disk format — the committed golden manifest
+    must still match the live schemas bit for bit, nothing arena-named
+    may appear in the pinned contract, and the ArenaManager lock must
+    carry a declared rank (with its H2D packing blessed as the blocking
+    section it serializes)."""
+    import json
+
+    from parameter_server_distributed_tpu.analysis import wirecheck
+    from parameter_server_distributed_tpu.analysis.lock_order import (
+        BLOCKING_ALLOWED, LOCK_RANKS)
+
+    with open(wirecheck.default_manifest_path()) as fh:
+        golden = json.loads(fh.read())
+    assert wirecheck.diff_manifests(golden, wirecheck.build_manifest()) == []
+    blob = json.dumps(golden)
+    for name in ("Arena", "ArenaStore", "PackingTable", "PSDT_ARENA"):
+        assert name not in blob, f"arena leaked into the manifest: {name}"
+    assert "ArenaManager._lock" in LOCK_RANKS
+    assert "ArenaManager._lock" in BLOCKING_ALLOWED
+
+
 def test_elastic_extension_stays_out_of_the_wire_manifest():
     """ISSUE 13 compat gate: the elastic-membership extension
     (elastic/messages.py) must leave the reference wire manifest

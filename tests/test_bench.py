@@ -243,6 +243,7 @@ def test_bench_apply_contract():
         "PSDT_BENCH_DEVICE_MB": "2",
         "PSDT_BENCH_DEVICE_OPTS": "sgd",
         "PSDT_BENCH_DEVICE_STRIPES": "1,2",
+        "PSDT_BENCH_FLAT_TENSORS": "0",  # flat sweep: its own contract
     })
     assert result["metric"] == "ps_apply_close_ms_2stripes_2w"
     assert result["value"] > 0
@@ -263,6 +264,53 @@ def test_bench_apply_contract():
         assert row["device_vs_numpy"] > 0
     assert "2mb_sgd" in sweep["best_ratio"]
     assert "cpu-jax" in sweep["backend"]
+
+
+def test_bench_apply_flat_contract():
+    """apply mode, flat-arena sweep (ISSUE 15): flat-vs-per-tensor rows
+    over a many-small-tensor store, with the acceptance visible in the
+    JSON — the flat arm's close dispatches at most stages x stripes
+    kernel-library calls (counted by the jit-lowering probe, NOT wall
+    clock) while the per-tensor arm's operand count scales O(tensors)."""
+    from parameter_server_distributed_tpu.core import arena
+
+    result = run_bench("apply", extra_env={
+        "PSDT_BENCH_PARAMS": "1e5",
+        "PSDT_BENCH_STRIPE_COUNTS": "1",
+        "PSDT_BENCH_WORKER_COUNTS": "2",
+        "PSDT_BENCH_STEPS": "2",
+        "PSDT_BENCH_DEVICE_MB": "",          # device sweep off
+        "PSDT_BENCH_FLAT_TENSORS": "48",
+        "PSDT_BENCH_FLAT_KB": "4",
+        "PSDT_BENCH_FLAT_BIG_MB": "8",
+        "PSDT_BENCH_FLAT_OPTS": "adam",
+        "PSDT_BENCH_FLAT_STRIPES": "1,2",
+        # shrink the regime bound so the tiny big-store control (8 MB)
+        # still exercises the gate row the real sweep sees at 128 MB
+        "PSDT_ARENA_MAX_TENSOR_BYTES": "65536",
+    })
+    sweep = result["flat_arena"]
+    rows = sweep["rows"]
+    assert len(rows) == 4  # (small, big) x 2 stripe counts
+    small = [r for r in rows if r["store"] == "small"]
+    assert len(small) == 2
+    for row in small:
+        assert row["tensors"] == 48 and row["opt"] == "adam"
+        assert row["per_tensor_close_ms"] > 0
+        assert row["flat_close_ms"] > 0
+        assert not row["flat_regime_gated"]
+        # THE bound: one kernel per stage per stripe, tensor count
+        # notwithstanding (48 tensors here)
+        budget = arena.close_dispatch_budget("adam", row["stripes"])
+        assert 0 < row["flat_profile"]["stage_calls"] <= budget
+        # ... while the per-tensor path's stage operands scale O(tensors)
+        assert row["per_tensor_profile"]["operands"] >= row["tensors"]
+        assert row["flat_profile"]["operands"] < budget * 4
+    big = [r for r in rows if r["store"] == "big"]
+    # the big-tensor control rides the mean-tensor-size regime gate
+    # (bandwidth-bound: the per-tensor path is the right regime there)
+    assert all(r["flat_regime_gated"] for r in big)
+    assert "small_adam" in sweep["best_ratio"]
 
 
 @pytest.mark.slow

@@ -91,11 +91,13 @@ def test_optimizer_oracle_bit_identical(rule, numpy_oracle, rng):
 @pytest.mark.parametrize("rule", ["sgd", "momentum", "adam"])
 @pytest.mark.parametrize("device_grads", [False, True])
 def test_core_close_oracle_across_stripes(rule, stripes, device_grads,
-                                          numpy_oracle, rng):
+                                          numpy_oracle, each_arena, rng):
     """Full barrier closes through ParameterServerCore: the device
     optimizer's store is byte-identical to the numpy optimizer's at
     every stripe count, with folds arriving as numpy arrays AND as
-    device buffers (the decode-on-device residence)."""
+    device buffers (the decode-on-device residence) — and across
+    PSDT_ARENA=0/1 (the flat mega-array layout must reproduce the same
+    bytes; ISSUE 15)."""
     jnp = _jnp()
     shapes = _shapes()
     params = {k: rng.standard_normal(s).astype(np.float32)

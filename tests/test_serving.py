@@ -208,6 +208,41 @@ def test_prefix_cache_not_used_in_speculative_mode(rng):
     assert srv.stats["prefix_hits"] == 0
 
 
+def test_prefix_extension_when_speculation_disabled(rng):
+    """ISSUE 15 satellite (the PR 14 leftover's smallest edge): a
+    speculative server whose depth controller has speculation OFF
+    (k == 0 — no draft row would be seeded anyway) falls back to
+    plain-mode shared-prefix extension for the prompt phase, token-exact
+    vs standalone generate; re-arming speculation later still works
+    because the extension entries carry the same d_row=None the k==0
+    full-prefill path caches."""
+    model = tiny()
+    params = model.init_params(0)
+    draft = tiny(n_layers=1)
+    dparams = draft.init_params(1)
+    base = list(rng.integers(0, 96, 6))
+    ext = base + list(rng.integers(0, 96, 3))
+    srv = DecodeServer(model, params, slots=2, max_len=96,
+                       prompt_cache=4, draft=draft, draft_params=dparams,
+                       draft_len=2)
+    srv._k = 0  # the adaptive controller concluded the draft cannot pay
+    rid = srv.submit(base, max_new_tokens=4)
+    assert srv.run_to_completion()[rid] == reference(model, params,
+                                                     base, 4)
+    rid = srv.submit(ext, max_new_tokens=4)
+    assert srv.run_to_completion()[rid] == reference(model, params,
+                                                     ext, 4)
+    assert srv.stats["prefix_hits"] == 1
+    # re-arm: the next admission takes the ordinary speculative path
+    # (full prefill + draft row) and stays token-exact
+    srv._k = 2
+    longer = ext + list(rng.integers(0, 96, 3))
+    rid = srv.submit(longer, max_new_tokens=4)
+    assert srv.run_to_completion()[rid] == reference(model, params,
+                                                     longer, 4)
+    assert srv.stats["prefix_hits"] == 1  # k>0 keeps full prefill
+
+
 def test_prompt_cache_speculative_and_int8(rng):
     """The cache composes with speculative mode (draft row cached too)
     and the int8 KV cache — hits stay token-exact in both."""

@@ -1061,6 +1061,199 @@ def bench_elastic() -> dict:
                      f"{k_of_n['stale_folds']} stale folds")}
 
 
+def bench_freerun() -> dict:
+    """Free-running barrier-free training (freerun/, ISSUE 16): steps/s
+    and time-to-target-loss, free-run vs K-of-N quorum vs all-of-N,
+    under a heterogeneous-speed netsim profile (per-worker injected
+    delay spread linearly from 0 to PSDT_BENCH_STRAGGLER_MS round-trip)
+    — the regime free-run exists for: a barrier pins EVERY worker to
+    the slowest, a quorum pays the grace window, free-run lets each
+    worker step at its own pace with staleness damping absorbing the
+    spread.  The convergence job is a shared quadratic (loss =
+    0.5*||w||^2, each worker's gradient is its pulled view of w), so
+    time-to-target is exact and cheap to monitor from the PS store.
+
+    Knobs: PSDT_BENCH_PARAMS (store size, default 2e5),
+    PSDT_BENCH_STEPS (per-worker iterations, default 8),
+    PSDT_BENCH_WORKERS (default 4), PSDT_BENCH_STRAGGLER_MS (slowest
+    worker's round-trip delay, default 200), PSDT_BENCH_QUORUM (default
+    0.75), PSDT_BENCH_GRACE_MS (default 100), PSDT_BENCH_TARGET
+    (loss-ratio target, default 0.25)."""
+    import threading
+
+    import numpy as np
+
+    from parameter_server_distributed_tpu.config import ParameterServerConfig
+    from parameter_server_distributed_tpu.core.tensor import to_wire
+    from parameter_server_distributed_tpu.obs import stats as obs_stats
+    from parameter_server_distributed_tpu.rpc import messages as m
+    from parameter_server_distributed_tpu.rpc.data_plane import PSClient
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+    from parameter_server_distributed_tpu.utils.netsim import ThrottledRelay
+
+    workers_n = int(os.environ.get("PSDT_BENCH_WORKERS", "0")) or 4
+    n_params = int(float(os.environ.get("PSDT_BENCH_PARAMS", "2e5")))
+    iters = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 8
+    delay_ms = float(os.environ.get("PSDT_BENCH_STRAGGLER_MS", "200"))
+    quorum = float(os.environ.get("PSDT_BENCH_QUORUM", "0.75"))
+    grace_ms = float(os.environ.get("PSDT_BENCH_GRACE_MS", "100"))
+    target_ratio = float(os.environ.get("PSDT_BENCH_TARGET", "0.25"))
+    # delays are injected at the TCP layer (same rationale as
+    # bench_elastic: shm would negotiate past the relay); arm configs
+    # are explicit, so ambient mode env must not leak in
+    os.environ["PSDT_SHM"] = "0"
+    for knob in ("PSDT_QUORUM", "PSDT_STALENESS_BETA", "PSDT_FREERUN",
+                 "PSDT_FREERUN_ADAPTIVE", "PSDT_DAMP_FLOOR"):
+        os.environ.pop(knob, None)
+
+    rng = np.random.default_rng(0)
+    shape = (max(1, n_params // 4),)
+    params = {f"w{i}": rng.standard_normal(shape).astype(np.float32)
+              for i in range(4)}
+    init_loss = 0.5 * sum(float(np.square(v).sum()) for v in params.values())
+    target_loss = target_ratio * init_loss
+    lr = 0.3  # stable for the quadratic even under stale gradients
+
+    def profile(arm: str) -> dict:
+        ps = ParameterServer(ParameterServerConfig(
+            bind_address="127.0.0.1", port=0, total_workers=workers_n,
+            autosave_period_s=3600.0, checkpoint_dir="/tmp",
+            learning_rate=lr,
+            freerun=arm == "freerun",
+            quorum=quorum if arm == "quorum" else 0.0,
+            quorum_grace_ms=grace_ms))
+        port = ps.start()
+        ps.core.initialize_parameters(params)
+        # heterogeneous speed: worker i's round-trip delay is
+        # i/(n-1) * delay_ms — worker 0 direct, the last the straggler
+        relays: list[ThrottledRelay] = []
+        ports = []
+        for wid in range(workers_n):
+            one_way = delay_ms * wid / max(1, workers_n - 1) / 2.0
+            if one_way <= 0:
+                ports.append(port)
+                continue
+            relay = ThrottledRelay(port, delay_ms=one_way)
+            relays.append(relay)
+            ports.append(relay.start())
+        clients = {wid: PSClient(f"127.0.0.1:{ports[wid]}")
+                   for wid in range(workers_n)}
+        steps_done = [0] * workers_n
+        errors: list = []
+        tt: list[float] = []
+        stop_mon = threading.Event()
+        before = obs_stats.REGISTRY.snapshot()["counters"]
+        t_run = time.perf_counter()
+
+        def monitor() -> None:
+            # time-to-target sampled at the PS store itself: the ground
+            # truth every arm shares, independent of publication cadence
+            while not stop_mon.is_set():
+                p = ps.core.get_parameters()
+                loss = 0.5 * sum(float(np.square(v).sum())
+                                 for v in p.values())
+                if loss <= target_loss:
+                    tt.append(time.perf_counter() - t_run)
+                    return
+                time.sleep(0.005)
+
+        def loop(wid: int) -> None:
+            try:
+                from parameter_server_distributed_tpu.core.tensor import (
+                    from_wire)
+                client = clients[wid]
+                view = {name: v.copy() for name, v in params.items()}
+                for it in range(1, iters + 1):
+                    grads = dict(view)  # d(0.5||w||^2)/dw at the pulled view
+                    fresh: dict = {}
+                    push, update = client.push_pull(
+                        wid, it,
+                        lambda: iter(to_wire(grads, m.WIRE_RAW_F32)),
+                        pull_wire_dtype=m.WIRE_RAW_F32, timeout=120.0,
+                        on_chunk=lambda ts: fresh.update(from_wire(ts)))
+                    assert push.success, push.message
+                    if update is None:
+                        # barriered arms only: server-side barrier
+                        # timeout — poll until released, then pull
+                        while not ps.core.check_sync_status(it)[1]:
+                            time.sleep(0.02)
+                    if fresh:
+                        view = fresh
+                    steps_done[wid] += 1
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append((wid, repr(exc)))
+
+        mon = threading.Thread(target=monitor, name="bench-freerun-monitor",
+                               daemon=True)
+        threads = [threading.Thread(target=loop, args=(wid,),
+                                    name=f"bench-freerun-w{wid}",
+                                    daemon=True)
+                   for wid in range(workers_n)]
+        mon.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        hung = [t.name for t in threads if t.is_alive()]
+        run_wall = time.perf_counter() - t_run
+        # let the monitor catch a target crossed by the last pushes
+        mon.join(timeout=1.0)
+        stop_mon.set()
+        mon.join(timeout=1.0)
+        after = obs_stats.REGISTRY.snapshot()["counters"]
+        final = ps.core.get_parameters()
+        final_loss = 0.5 * sum(float(np.square(v).sum())
+                               for v in final.values())
+        for c in clients.values():
+            c.close()
+        for relay in relays:
+            relay.stop()
+        ps.stop()
+        if errors:
+            raise RuntimeError(f"bench_freerun {arm} arm failed: {errors}")
+        if hung or sum(steps_done) < workers_n * iters:
+            raise RuntimeError(
+                f"bench_freerun {arm} arm incomplete: "
+                f"{sum(steps_done)}/{workers_n * iters} steps, "
+                f"hung threads {hung}")
+        delta = {name: after.get(name, 0) - before.get(name, 0)
+                 for name in ("ps.freerun.applies", "ps.freerun.publishes",
+                              "ps.barrier.quorum_closes")}
+        return {
+            "steps_per_s": round(sum(steps_done) / run_wall, 2),
+            "run_wall_s": round(run_wall, 3),
+            "time_to_target_ms": (round(1e3 * tt[0], 1) if tt else None),
+            "final_loss_ratio": round(final_loss / init_loss, 4),
+            "freerun_applies": delta["ps.freerun.applies"],
+            "freerun_publishes": delta["ps.freerun.publishes"],
+            "quorum_closes": delta["ps.barrier.quorum_closes"],
+        }
+
+    log(f"bench_freerun: {workers_n} workers ({n_params / 1e3:.0f}k "
+        f"params), delays 0..{delay_ms:g}ms, {iters} iterations/worker, "
+        f"target {target_ratio:g}x initial loss")
+    arms = {arm: profile(arm) for arm in ("all_of_n", "quorum", "freerun")}
+    for arm, r in arms.items():
+        log(f"bench_freerun: {arm}: {r['steps_per_s']} steps/s, "
+            f"target in {r['time_to_target_ms']}ms, final loss ratio "
+            f"{r['final_loss_ratio']}")
+    rate = arms["freerun"]["steps_per_s"]
+    base = arms["all_of_n"]["steps_per_s"]
+    return {"metric": "ps_freerun_steps_per_s",
+            "value": rate, "unit": "steps/s",
+            "vs_baseline": round(rate / base, 3) if base else 0.0,
+            **arms,
+            "workers": workers_n, "straggler_delay_ms": delay_ms,
+            "quorum_fraction": quorum, "target_ratio": target_ratio,
+            "note": (f"free-run {rate} steps/s vs {base} all-of-N "
+                     f"({arms['quorum']['steps_per_s']} K-of-N) with "
+                     f"0..{delay_ms:g}ms heterogeneous netsim delays; "
+                     f"time-to-{target_ratio:g}x-loss "
+                     f"{arms['freerun']['time_to_target_ms']}ms vs "
+                     f"{arms['all_of_n']['time_to_target_ms']}ms")}
+
+
 def bench_delta() -> dict:
     """Versioned delta serving (delta/, ISSUE 10): per-pull serve bytes
     through the delta chain vs the full encode-once serve, at varying
@@ -2994,6 +3187,8 @@ def child_main(mode: str) -> int:
             result = bench_delta()
         elif mode == "elastic":
             result = bench_elastic()
+        elif mode == "freerun":
+            result = bench_freerun()
         elif mode == "replicate":
             result = bench_replicate()
         elif mode == "obs":
@@ -3110,7 +3305,7 @@ def main() -> int:
     # directly rather than risking a flaky TPU init.
     plans: list[tuple[str, float]]
     if mode in ("pushpull", "dataplane", "aggregate", "apply", "codec",
-                "replicate", "obs", "tier", "elastic", "fleet"):
+                "replicate", "obs", "tier", "elastic", "fleet", "freerun"):
         plans = [("cpu", cpu_timeout)]
     else:
         plans = [("tpu", tpu_timeout)] * tpu_attempts + [("cpu", cpu_timeout)]

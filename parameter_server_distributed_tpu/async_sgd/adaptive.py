@@ -1,0 +1,102 @@
+"""Adaptive staleness damping schedule (free-running mode, ISSUE 16).
+
+The fixed policy (:mod:`.damping`) damps a contribution ``s``
+iterations stale by ``beta ** s`` — calibrated implicitly for fleets
+whose typical staleness is ~1.  Under free-running barrier-free
+training the TYPICAL staleness is a property of the fleet (worker
+count, speed heterogeneity, push cadence), not of the algorithm: on a
+16-worker fleet where the *median* push is 8 steps stale, ``beta ** 8``
+damps the median contribution to noise and the run crawls; on a
+2-worker fleet the same beta is fine.
+
+The adaptive schedule normalizes the exponent by the live staleness
+EWMA::
+
+    scale(s) = beta ** (s / max(1, ewma))
+
+so a contribution at the fleet's TYPICAL staleness always damps by
+exactly ``beta``, and only unusually-stale contributions (relative to
+the fleet's own distribution) damp harder.  The fixed-beta path is the
+ORACLE: with the EWMA flat at <= 1 — a fleet whose pushes are at most
+one step stale, i.e. the regime the fixed policy was calibrated for —
+the schedule is ``beta ** s`` exactly, and the unit tests pin that
+equivalence (tests/test_freerun.py).
+
+The EWMA can be SEEDED from measured commit-spread data — the
+``pst-trace`` straggler table's per-iteration commit spread (the gap,
+in iterations, between the fastest and slowest worker's commits) is
+exactly an a-priori estimate of typical staleness — via
+``PSDT_FREERUN_SPREAD`` or the constructor, so a restarted run starts
+at its fleet's known operating point instead of re-learning it.
+
+Armed ONLY by ``PSDT_FREERUN_ADAPTIVE`` (freerun/__init__.py); the
+default free-run damp is the fixed-beta oracle.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .damping import DEFAULT_BETA, ENV_BETA, clamp_staleness
+
+# EWMA seed: typical staleness measured offline (pst-trace commit
+# spread).  Unset = start at 0.0 (the oracle-equivalent regime) and
+# learn from live observations.
+ENV_SPREAD = "PSDT_FREERUN_SPREAD"
+# EWMA smoothing factor: small enough to ride out bursts, large enough
+# to track a real fleet-speed change within ~tens of pushes
+ALPHA = 0.05
+
+
+class AdaptiveDamping:
+    """``beta ** (s / max(1, ewma))`` with a live staleness EWMA."""
+
+    def __init__(self, beta: float | None = None,
+                 alpha: float = ALPHA,
+                 seed: float | None = None):
+        raw = os.environ.get(ENV_BETA, "")
+        if beta is not None:
+            self.beta = float(beta)
+        elif raw:
+            self.beta = float(raw)
+        else:
+            self.beta = DEFAULT_BETA
+        if not 0.0 < self.beta <= 1.0:
+            raise ValueError(f"staleness damping beta must be in (0, 1], "
+                             f"got {self.beta}")
+        self.alpha = float(alpha)
+        if not 0.0 < self.alpha <= 1.0:
+            raise ValueError(f"EWMA alpha must be in (0, 1], "
+                             f"got {self.alpha}")
+        raw_seed = os.environ.get(ENV_SPREAD, "")
+        if seed is not None:
+            self.ewma = float(seed)
+        elif raw_seed:
+            self.ewma = float(raw_seed)
+        else:
+            self.ewma = 0.0
+        if self.ewma < 0.0:
+            raise ValueError(f"staleness EWMA seed must be >= 0, "
+                             f"got {self.ewma}")
+
+    def observe(self, staleness: int) -> None:
+        """Fold one observed staleness into the EWMA.  Callers observe
+        BEFORE scaling (the contribution's own staleness is evidence of
+        the fleet's operating point, whether or not it gets damped)."""
+        s = clamp_staleness(staleness)
+        self.ewma += self.alpha * (s - self.ewma)
+
+    def scale(self, staleness: int) -> float:
+        """The damp multiplier — EWMA-normalized exponent, clamped input
+        (:func:`.damping.clamp_staleness`).  Equals the fixed oracle's
+        ``beta ** s`` whenever the EWMA is <= 1."""
+        s = clamp_staleness(staleness)
+        if s <= 0:
+            return 1.0
+        return float(self.beta ** (s / max(1.0, self.ewma)))
+
+    @property
+    def effective_beta(self) -> float:
+        """The per-unit-staleness damp factor the schedule currently
+        applies — ``scale(1)`` — the ``pst-status --watch`` gauge."""
+        return float(self.beta ** (1.0 / max(1.0, self.ewma)))

@@ -22,7 +22,21 @@ Two consumers, one policy:
   ``PSDT_STALENESS_BETA`` is explicitly set, so pre-existing async runs
   stay byte-identical.
 
-``PSDT_STALENESS_BETA`` overrides the beta for both (default 0.5).
+A third consumer arrived with ISSUE 16: **free-running barrier-free
+mode** (``PSDT_FREERUN``, freerun/engine.py) damps every apply-on-
+arrival by the same policy — fixed ``beta ** s`` by default, or the
+adaptive EWMA-normalized schedule (:mod:`.adaptive`) when explicitly
+armed.
+
+``PSDT_STALENESS_BETA`` overrides the beta for all (default 0.5).
+``PSDT_DAMP_FLOOR`` (default 0 = off) is the observability floor: a
+contribution whose damp scale lands below it is effectively dropped —
+silent gradient loss — so crossing it records a ``damp.floor`` flight
+event (obs/flight.py) the postmortem can attribute.  Staleness inputs
+are clamped defensively into ``[0, MAX_STALENESS]``: callers compute
+staleness from iteration counters that can run backward transiently
+(restore rewinds, racing bootstrap), and a negative or absurd exponent
+must damp sanely rather than AMPLIFY the gradient or overflow.
 """
 
 from __future__ import annotations
@@ -32,14 +46,28 @@ from typing import Mapping
 
 import numpy as np
 
+from ..obs import flight
+
 ENV_BETA = "PSDT_STALENESS_BETA"
 DEFAULT_BETA = 0.5
+ENV_FLOOR = "PSDT_DAMP_FLOOR"
+# clamp bound for the damp exponent: far past any plausible real
+# staleness, small enough that beta ** MAX_STALENESS stays an exact
+# float 0.0 underflow rather than an overflow anywhere
+MAX_STALENESS = 1 << 20
+
+
+def clamp_staleness(staleness) -> int:
+    """Defensive staleness clamp into ``[0, MAX_STALENESS]`` (non-int
+    inputs truncate like the pre-existing ``int(staleness)``)."""
+    return min(max(int(staleness), 0), MAX_STALENESS)
 
 
 class StalenessDamping:
     """``scale(s) = beta ** s`` with the shared env override."""
 
-    def __init__(self, beta: float | None = None):
+    def __init__(self, beta: float | None = None,
+                 floor: float | None = None):
         raw = os.environ.get(ENV_BETA, "")
         if beta is not None:
             self.beta = float(beta)
@@ -50,13 +78,43 @@ class StalenessDamping:
         if not 0.0 < self.beta <= 1.0:
             raise ValueError(f"staleness damping beta must be in (0, 1], "
                              f"got {self.beta}")
+        raw_floor = os.environ.get(ENV_FLOOR, "")
+        if floor is not None:
+            self.floor = float(floor)
+        elif raw_floor:
+            self.floor = float(raw_floor)
+        else:
+            self.floor = 0.0
+        if not 0.0 <= self.floor < 1.0:
+            raise ValueError(f"damp floor must be in [0, 1), "
+                             f"got {self.floor}")
 
-    def scale(self, staleness: int) -> float:
+    def floored(self, value: float, *, worker: int = -1,
+                iteration: int = -1, staleness: int = 0) -> bool:
+        """True when ``value`` fell below the armed floor — the
+        contribution is effectively dropped.  Records the ``damp.floor``
+        flight event so the loss is observable (the satellite fix: a
+        scale of 1e-9 is a silently discarded gradient)."""
+        if self.floor <= 0.0 or value >= self.floor:
+            return False
+        flight.record("damp.floor", iteration=iteration, worker=worker,
+                      a=clamp_staleness(staleness),
+                      b=int(min(value, 1.0) * 1e9))
+        return True
+
+    def scale(self, staleness: int, *, worker: int = -1,
+              iteration: int = -1) -> float:
         """The multiplier for a contribution ``staleness`` iterations
-        old.  Fresh (staleness <= 0) contributions pass through at 1."""
-        if staleness <= 0:
+        old.  Fresh (staleness <= 0) contributions pass through at 1.
+        Staleness is clamped defensively (see :func:`clamp_staleness`);
+        a result below the armed floor records ``damp.floor``."""
+        s = clamp_staleness(staleness)
+        if s <= 0:
             return 1.0
-        return float(self.beta ** int(staleness))
+        value = float(self.beta ** s)
+        self.floored(value, worker=worker, iteration=iteration,
+                     staleness=s)
+        return value
 
     def damp(self, gradients: Mapping[str, np.ndarray],
              staleness: int) -> dict[str, np.ndarray]:

@@ -41,6 +41,11 @@ Extension flags beyond the reference:
     --quorum-grace-ms=N
                     grace window past the K-th commit (default 250;
                     also PSDT_QUORUM_GRACE_MS)
+    --freerun       free-running barrier-free training (freerun/,
+                    docs/training.md "Free-running async training"):
+                    every push applies on arrival damped by
+                    PSDT_STALENESS_BETA^staleness; no barrier, no seal.
+                    Also the PSDT_FREERUN env; default off
 
 With --coordinator=ADDR and PSDT_TIERS=1 the PS also polls the
 coordinator's reduction topology (tiers/), so a leaf aggregator's ONE
@@ -78,6 +83,7 @@ def build_config(argv: list[str]) -> tuple[ParameterServerConfig, str | None]:
         standby_address=flags.get("standby", ""),
         quorum=float(flags.get("quorum", 0.0)),
         quorum_grace_ms=float(flags.get("quorum-grace-ms", -1.0)),
+        freerun="freerun" in flags,
     )
     return config, flags.get("coordinator")
 

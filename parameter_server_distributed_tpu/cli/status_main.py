@@ -85,6 +85,27 @@ def render_watch_line(rates: dict | None, workers: int,
     fleet = (rollup or {}).get("fleet")
     if fleet:
         line += f"\n  fleet: {render_fleet(fleet)}"
+    # free-running mode (freerun/, ISSUE 16): the colocated-PS snapshot
+    # carries the staleness distribution and the per-unit damp the
+    # schedule currently applies — the live health view of a barrier-free
+    # run (a growing p95 means the damp is about to bite harder)
+    for w in (rollup or {}).get("per_worker", {}).values():
+        fr = w.get("ps", {}).get("freerun")
+        if not fr:
+            continue
+        part = f"\n  freerun: {fr.get('applies', 0)} applies"
+        if fr.get("duplicates"):
+            part += f", {fr['duplicates']} dups"
+        if fr.get("floor_drops"):
+            part += f", {fr['floor_drops']} floor drops"
+        stl = fr.get("staleness")
+        if stl:
+            part += (f" | staleness p50={stl['p50']:.1f} "
+                     f"p95={stl['p95']:.1f}")
+        if fr.get("effective_beta") is not None:
+            part += f" | eff beta {fr['effective_beta']:.4f}"
+        line += part
+        break  # one PS rollup is the whole free-run story
     return line
 
 

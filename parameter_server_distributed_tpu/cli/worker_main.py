@@ -40,6 +40,11 @@ Extension flags:
                      workers fold locally at an elected leaf aggregator,
                      one quantized contribution per group goes upstream.
                      Absent = PSDT_TIERS env (default off)
+    --freerun        free-running barrier-free loop (freerun/,
+                     docs/training.md "Free-running async training"):
+                     push, pull whatever version the PS has published,
+                     step again — never polls a barrier.  Pair with a
+                     --freerun PS.  Absent = PSDT_FREERUN env
 """
 
 from __future__ import annotations
@@ -48,6 +53,7 @@ import logging
 import signal
 import sys
 
+from .. import freerun as freerun_mod
 from ..config import WorkerConfig, parse_argv
 from ..models.registry import get_model_and_batches
 from ..worker.trainer import Trainer
@@ -108,6 +114,7 @@ def main(argv: list[str] | None = None) -> int:
         fused_step="no-fused" not in flags,
         tiers=(False if "no-tiers" in flags
                else True if "tiers" in flags else None),
+        freerun="freerun" in flags or freerun_mod.enabled(),
     )
     worker = build_worker(config, seed=int(flags["seed"]) if "seed" in flags else None)
     worker.initialize()

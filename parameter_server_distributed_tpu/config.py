@@ -108,6 +108,13 @@ class ParameterServerConfig:
     # the first barrier close proves this process is serving as a
     # primary; ignored when backup_address is set (already armed).
     standby_address: str = ""
+    # Free-running barrier-free training (freerun/, ISSUE 16): every
+    # push applies on arrival under beta^staleness damping; no barrier,
+    # no seal, no grace window.  False = PSDT_FREERUN env (default off,
+    # byte-identical paths).  Mutually exclusive with buffered
+    # aggregation, bounded-staleness async, and K-of-N quorum — see the
+    # downgrade matrix in docs/training.md.
+    freerun: bool = False
 
     @property
     def synchronous(self) -> bool:
@@ -179,6 +186,12 @@ class WorkerConfig:
     # simulate multi-host groups in one process; empty = the real
     # hostname+boot-id of rpc/shm_transport.py host_id()).
     tier_host_id: str = ""
+    # Free-running loop (freerun/, ISSUE 16): skip the barrier entirely
+    # — push, pull whatever version is published, step again.  Pair
+    # with a PS running --freerun (a barriered PS would still answer
+    # every push complete=False and the loop would spin on stale
+    # params).  False = PSDT_FREERUN env.
+    freerun: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -83,6 +83,7 @@ included.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -102,6 +103,8 @@ from . import device_apply
 from .optimizer import HostOptimizer, SGD
 from .stripes import partition_names, run_striped, stripe_count, stripe_of
 from .tensor import TensorStore, store_nbytes, tree_like
+
+log = logging.getLogger("pst.core")
 
 AGGREGATION_MODES = ("streaming", "buffered")
 
@@ -351,7 +354,8 @@ class ParameterServerCore:
                  | None = None,
                  contributions_ttl_s: float = 1.0,
                  quorum: float | None = None,
-                 quorum_grace_ms: float | None = None):
+                 quorum_grace_ms: float | None = None,
+                 freerun: bool | None = None):
         mode = (aggregation or os.environ.get("PSDT_AGGREGATION")
                 or "streaming").lower()
         if mode not in AGGREGATION_MODES:
@@ -451,6 +455,31 @@ class ParameterServerCore:
             [int, TensorStore, dict[str, int]], TensorStore] | None = None
         self._optimizer = optimizer or SGD(learning_rate=1.0)
         self._staleness_bound = int(staleness_bound)
+        # Free-running barrier-free training (freerun/, ISSUE 16): armed
+        # by PSDT_FREERUN / the constructor, default off = every
+        # existing path byte-identical.  Every push applies on arrival
+        # damped by beta^staleness, dedup'd by a per-(worker, step)
+        # version vector, served through a coalesced publication
+        # (FreeRunEngine).  Downgrade matrix (docs/training.md): the
+        # buffered escape hatch and bounded-staleness async mode both
+        # win over free-run — the first because free-run reuses the
+        # streaming fold machinery, the second because it is the
+        # narrower contract; an armed quorum is force-disabled below.
+        # (lazy import: freerun/engine.py imports back into this module)
+        from .. import freerun as freerun_mod
+        self._freerun = None
+        if freerun_mod.enabled(freerun):
+            reason = None
+            if not self._streaming:
+                reason = "buffered aggregation is armed"
+            elif self._staleness_bound > 0:
+                reason = "bounded-staleness async mode is armed"
+            if reason is not None:
+                log.warning("PSDT_FREERUN requested but %s; free-run "
+                            "disabled (downgrade matrix, docs/training.md)",
+                            reason)
+            else:
+                self._freerun = freerun_mod.FreeRunEngine(self)
         # Flat arena apply (core/arena.py, ISSUE 15): per-stripe
         # mega-array layout for fold, close, readback, and encode.
         # Armed by PSDT_ARENA for streaming-sync cores whose optimizer
@@ -464,6 +493,7 @@ class ParameterServerCore:
             arena_mod.ArenaManager(self._stripes)
             if (arena_mod.enabled()
                 and self._streaming and self._staleness_bound == 0
+                and self._freerun is None
                 and getattr(self._optimizer, "supports_arena", False)
                 and device_apply.available())
             else None)
@@ -477,6 +507,12 @@ class ParameterServerCore:
         # bounded by max(1, staleness_bound).
         self._quorum = equorum.quorum_fraction(quorum)
         self._quorum_grace_s = equorum.grace_s(quorum_grace_ms)
+        if self._freerun is not None and self._quorum:
+            # mutual exclusion (docs/training.md downgrade matrix):
+            # free-run has no barrier for a K-of-N quorum to close
+            log.warning("PSDT_QUORUM ignored: free-run mode has no "
+                        "barrier to close")
+            self._quorum = 0.0
         self._damping = StalenessDamping() if self._quorum else None
         # bounded-staleness async damping: armed ONLY by an explicit
         # PSDT_STALENESS_BETA (pre-existing async runs stay
@@ -577,9 +613,10 @@ class ParameterServerCore:
         either applies on device (the sharded device optimizer family)
         or is a leaf aggregator whose member folds should run as device
         reductions (the PR-9 in-process intra-host tier).  Streaming
-        sync mode only — the buffered escape hatch and async mode stage
-        and apply host-side, unchanged."""
-        if not (self._streaming and self.synchronous
+        sync mode only — the buffered escape hatch, async mode, and
+        free-run mode stage and apply host-side, unchanged."""
+        if self._freerun is not None or not (
+                self._streaming and self.synchronous
                 and device_apply.enabled()):
             return False
         return ((device_apply.wants_device_fold(self._optimizer)
@@ -750,10 +787,20 @@ class ParameterServerCore:
             sink.note_apply(store, version)
 
     def _notify_delta(self, store: TensorStore, version: int) -> None:
+        if self._freerun is not None:
+            # free-run coalesces publication (freerun/engine.py): the
+            # engine notes the sink at each coalesced publish, so a
+            # per-push raw-version advance never rebuilds a delta pair
+            # or wakes subscribers per push
+            return
         if self._delta_sink is not None:
             self._delta_sink.note_apply(store, version)
 
     def _reset_delta(self) -> None:
+        if self._freerun is not None:
+            # restore/install/retire: published snapshot + version
+            # vector belong to the pre-reset world
+            self._freerun.reset()
         if self._delta_sink is not None:
             self._delta_sink.reset()
 
@@ -799,7 +846,12 @@ class ParameterServerCore:
         the previous (materialized) version is served — one extra step of
         staleness, which bounded-staleness mode tolerates by definition.
         Sync mode always serves ``_params`` itself: barrier clients must
-        observe exactly the post-aggregation values they were promised."""
+        observe exactly the post-aggregation values they were promised.
+        Free-run mode serves the engine's coalesced published snapshot
+        (freerun/engine.py), so the served version advances at the
+        publication cadence rather than per push."""
+        if self._freerun is not None:
+            return self._freerun.serve_view()
         with self._params_lock:
             if self._serving is not None:
                 if _store_ready(self._params):
@@ -814,6 +866,8 @@ class ParameterServerCore:
         """The version :meth:`serve_view` would serve right now, WITHOUT
         copying the store — the cache-hit fast path: a serve whose encoded
         bytes are already cached never touches the parameters at all."""
+        if self._freerun is not None:
+            return self._freerun.serve_version()
         with self._params_lock:
             if self._serving is not None and not _store_ready(self._params):
                 return self._serving_version
@@ -827,6 +881,11 @@ class ParameterServerCore:
         The tier contribution lookup happens HERE, outside every core
         lock (tiers require the streaming sync path; buffered/async
         modes keep flat weight-1 semantics)."""
+        if self._freerun is not None:
+            # free-run (ISSUE 16): a private-accumulator sink — folds
+            # run with no core lock at all, the commit applies on
+            # arrival (freerun/engine.py)
+            return self._freerun.begin_push(worker_id, iteration)
         streaming = self._streaming and self.synchronous
         weight, members = ((1, (int(worker_id),)) if not streaming
                            else self._contribution_for(worker_id))
@@ -835,6 +894,12 @@ class ParameterServerCore:
 
     def receive_gradients(self, worker_id: int, iteration: int,
                           gradients: Mapping[str, np.ndarray]) -> PushResult:
+        if self._freerun is not None:
+            # the one-chunk case of the free-run sink (tier aggregate
+            # ids are rejected retryably inside the commit)
+            sink = self._freerun.begin_push(worker_id, iteration)
+            sink.fold(gradients)
+            return sink.commit()
         if (worker_id >= TIER_AGGREGATE_ID_BASE
                 and not (self.synchronous and self._streaming)):
             # Tier group contributions exist ONLY on the streaming sync
@@ -2062,7 +2127,9 @@ class ParameterServerCore:
         """Returns (iteration, ready, workers_received, total_workers)
         (reference: src/parameter_server.cpp:99-110)."""
         total = self.barrier_width()
-        if not self.synchronous:
+        if self._freerun is not None or not self.synchronous:
+            # free-run: no per-iteration barrier state exists — a poll
+            # must never create one (the async-mode convention)
             return iteration, True, 1, total
         with self._state_lock:
             state = self._iteration_states.get(iteration)
@@ -2091,7 +2158,8 @@ class ParameterServerCore:
         bounded cadence regardless, re-reading the (possibly elastic)
         barrier width so a mid-iteration shrink releases a fully-buffered
         iteration exactly as the polled path does."""
-        if not self.synchronous:
+        if self._freerun is not None or not self.synchronous:
+            # free-run never barriers: every push already applied
             return True, 1, self.barrier_width()
         deadline = time.monotonic() + timeout
         while True:

@@ -63,6 +63,48 @@ log = logging.getLogger("pst.delta")
 # wire encodings the chain supports: elementwise, fixed bytes/element
 _ELEMENTWISE = {WIRE_F32: 4, WIRE_RAW_F32: 4, WIRE_BF16: 2}
 
+# Publication coalescing under continuous versions (free-running mode,
+# freerun/engine.py, ISSUE 16): with barriers gone, EVERY push bumps the
+# raw store version, and notifying the chain per push would rebuild a
+# delta pair, wake every SubscribeWeights parker, and churn the
+# encode-once serve cache on every single push — while exhausting
+# PSDT_DELTA_DEPTH in one barrier-width's worth of pushes.  The free-run
+# engine therefore PUBLISHES (snapshots + notes a new served version) at
+# most once per PSDT_PUBLISH_MIN_VERSIONS applies, with
+# PSDT_PUBLISH_MAX_LAG_MS bounding how long an apply may sit
+# unpublished.  Barriered and async modes never coalesce — their apply
+# cadence IS the version cadence, byte-identical with these unset.
+ENV_PUBLISH_MIN_VERSIONS = "PSDT_PUBLISH_MIN_VERSIONS"
+ENV_PUBLISH_MAX_LAG_MS = "PSDT_PUBLISH_MAX_LAG_MS"
+DEFAULT_PUBLISH_MAX_LAG_MS = 100.0
+
+
+def publish_min_versions(override: int | None = None) -> int:
+    """Applies coalesced per publication.  0 (the default) = auto: the
+    free-run engine substitutes its current worker-fleet size, so one
+    publication lands per fleet-wide round of pushes — the barriered
+    modes' natural version cadence."""
+    raw = (override if override is not None
+           else os.environ.get(ENV_PUBLISH_MIN_VERSIONS, "0"))
+    value = int(raw)
+    if value < 0:
+        raise ValueError(
+            f"{ENV_PUBLISH_MIN_VERSIONS} must be >= 0 (0 = auto), "
+            f"got {value}")
+    return value
+
+
+def publish_max_lag_s(override_ms: float | None = None) -> float:
+    """Upper bound (seconds) an applied update may wait unpublished —
+    the coalescing window's freshness backstop."""
+    raw = (override_ms if override_ms is not None
+           else os.environ.get(ENV_PUBLISH_MAX_LAG_MS, ""))
+    ms = float(raw) if raw != "" else DEFAULT_PUBLISH_MAX_LAG_MS
+    if ms < 0:
+        raise ValueError(
+            f"{ENV_PUBLISH_MAX_LAG_MS} must be >= 0, got {ms}")
+    return ms / 1e3
+
 
 def delta_wire_dtype() -> int:
     name = os.environ.get(ENV_DTYPE, DEFAULT_DTYPE)

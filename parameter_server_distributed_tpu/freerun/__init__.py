@@ -1,0 +1,63 @@
+"""Free-running barrier-free training (ISSUE 16).
+
+The fourth training-mode axis, after all-of-N barriers, K-of-N quorum
+barriers (``PSDT_QUORUM``, ISSUE 13), and bounded-staleness async mode
+(``staleness_bound > 0``): armed by ``PSDT_FREERUN`` (or ``--freerun``),
+every worker push applies to the store THE MOMENT it arrives, damped by
+``beta ** staleness`` (:mod:`..async_sgd.damping` — the shared policy),
+and workers pull whenever they want.  There is no seal, no grace
+window, and no per-iteration barrier state at all — the elastic
+membership epochs (ISSUE 13) let workers join and leave with zero
+coordination cost, and a departed worker's in-flight push still applies
+damped (arXiv:2204.03211's elastic-aggregation workload).
+
+Off (the default) every existing path is byte-identical.  Downgrade
+matrix (mutual exclusions, logged loudly at core construction —
+docs/training.md "Free-running async training"):
+
+- buffered aggregation (``PSDT_AGGREGATION=buffered``) wins: free-run
+  reuses the streaming fold machinery;
+- bounded-staleness async mode (``staleness_bound > 0``) wins: it is
+  the narrower contract;
+- an armed K-of-N quorum is force-disabled: there is no barrier to
+  close;
+- tier aggregate contributions are rejected retryably (members replay
+  flat), exactly like the other non-streaming-sync modes.
+
+Per-push dedup is a version vector over (worker, worker_step) —
+:class:`FreeRunEngine` — replacing the per-iteration barrier dedup, so
+an RPC retry of a push that landed stays idempotent.  ``serve_version``
+advances continuously but publication is COALESCED
+(``PSDT_PUBLISH_MIN_VERSIONS`` / ``PSDT_PUBLISH_MAX_LAG_MS``,
+delta/chain.py) so per-push version advance cannot thrash the
+encode-once serve cache or exhaust ``PSDT_DELTA_DEPTH``.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_FREERUN = "PSDT_FREERUN"
+# The adaptive staleness schedule (async_sgd/adaptive.py): damping
+# exponent normalized by a live staleness EWMA instead of the fixed
+# beta ** s.  Armed ONLY by this explicit env — the fixed-beta path is
+# the oracle the adaptive schedule is tested against.
+ENV_ADAPTIVE = "PSDT_FREERUN_ADAPTIVE"
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def enabled(override: bool | None = None) -> bool:
+    """Whether the free-run engine should arm.  ``override`` is the
+    config value (None = env decides; config ``freerun=False`` passes
+    None so ``PSDT_FREERUN`` alone can arm it, the quorum idiom)."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get(ENV_FREERUN, "").lower() in _TRUTHY
+
+
+def adaptive_enabled() -> bool:
+    return os.environ.get(ENV_ADAPTIVE, "").lower() in _TRUTHY
+
+
+from .engine import FreeRunEngine, FreeRunSink  # noqa: E402,F401

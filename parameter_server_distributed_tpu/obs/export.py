@@ -99,6 +99,26 @@ def _ps_rollup(snap: dict) -> dict:
         arena["pad"] = pad
     if arena:
         out["arena"] = arena
+    # free-running barrier-free training (freerun/, ISSUE 16):
+    # apply-on-arrival volume, version-vector dedups, floor drops,
+    # coalesced publications, the live staleness distribution, and the
+    # per-unit-staleness damp the schedule currently applies
+    freerun: dict = {}
+    for key, name in (("applies", "ps.freerun.applies"),
+                      ("duplicates", "ps.freerun.duplicates"),
+                      ("floor_drops", "ps.freerun.floor_drops"),
+                      ("publishes", "ps.freerun.publishes")):
+        value = counters.get(name, 0)
+        if value:
+            freerun[key] = value
+    staleness = _hist_stats(snap, "ps.freerun.staleness")
+    if staleness:
+        freerun["staleness"] = staleness
+    beta = snap.get("gauges", {}).get("ps.freerun.effective_beta")
+    if freerun and beta is not None:
+        freerun["effective_beta"] = beta
+    if freerun:
+        out["freerun"] = freerun
     # elastic quorum barriers (elastic/, ISSUE 13): K-of-N closes and
     # straggler gradients folded forward damped
     quorum = counters.get("ps.barrier.quorum_closes", 0)
@@ -409,6 +429,25 @@ def render_rollup(rollup: dict) -> str:
                     extras.append(f"pad {100 * arena['pad']:.1f}%")
                 if extras:
                     note += f" ({', '.join(extras)})"
+                parts.append(note)
+            fr = ps.get("freerun")
+            if fr:
+                note = f"freerun {fr.get('applies', 0)} applies"
+                extras = []
+                if fr.get("duplicates"):
+                    extras.append(f"{fr['duplicates']} dups")
+                if fr.get("floor_drops"):
+                    extras.append(f"{fr['floor_drops']} floor drops")
+                if fr.get("publishes"):
+                    extras.append(f"{fr['publishes']} publishes")
+                if extras:
+                    note += f" ({', '.join(extras)})"
+                stl = fr.get("staleness")
+                if stl:
+                    note += (f", staleness p50={stl['p50']:.1f} "
+                             f"p95={stl['p95']:.1f}")
+                if fr.get("effective_beta") is not None:
+                    note += f", eff beta {fr['effective_beta']:.4f}"
                 parts.append(note)
             if ps.get("quorum_closes"):
                 parts.append(f"{ps['quorum_closes']} quorum closes")

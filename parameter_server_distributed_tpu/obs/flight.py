@@ -189,6 +189,17 @@ EVENTS: dict[str, int] = {
                                   # counts / epoch / slots / latched)
     "apply.arena": 133,           # flat close published; a =
                                   # dispatch_us, b = readback_us
+    # free-running barrier-free training (freerun/, ISSUE 16)
+    "freerun.apply": 140,         # apply-on-arrival landed; a =
+                                  # staleness, b = damp scale in ppm
+    "freerun.dup": 141,           # version-vector dedup dropped an RPC
+                                  # replay; a = last applied worker step
+    "freerun.publish": 142,       # coalesced publication; a = published
+                                  # version, b = applies coalesced
+    "damp.floor": 143,            # a contribution damped below
+                                  # PSDT_DAMP_FLOOR (effectively
+                                  # dropped); a = staleness, b = scale
+                                  # in ppb
 }
 EVENT_NAMES = {code: name for name, code in EVENTS.items()}
 

@@ -947,6 +947,9 @@ class ParameterServer:
             quorum=config.quorum or None,
             quorum_grace_ms=(config.quorum_grace_ms
                              if config.quorum_grace_ms >= 0 else None),
+            # free-running barrier-free mode (freerun/, ISSUE 16);
+            # False defers to the PSDT_FREERUN env
+            freerun=config.freerun or None,
         )
         self.ckpt = CheckpointManager(
             self.core,

@@ -851,6 +851,78 @@ class Worker:
         return float("nan")
 
     # ------------------------------------------------------------ train loop
+    def run_freerun_iteration(self, iteration: int) -> float:
+        """One free-running step (freerun/, ISSUE 16): take whatever
+        parameters the previous round delivered (or pull the published
+        snapshot), compute, push — and never wait.  The free-run PS
+        applies every push on arrival damped by ``beta^staleness`` and
+        answers it ``aggregation_complete=True`` (a version-vector
+        deduped RPC retry answers success too), so there is NO barrier
+        to poll and deliberately no fallback to one: a worker here is
+        bounded only by its own compute plus one RPC round.  The fused
+        data plane still collapses push + pull into one round, but its
+        legs are independent — the response parameters are simply the
+        PS's current published version, not a post-barrier promise."""
+        self.status = m.WorkerStatus.TRAINING
+        self.step_timer.__enter__()
+        self.last_bootstrap = False
+        t_step = time.perf_counter()
+        step_span = obs_trace.span("worker/step", iteration=iteration,
+                                   worker=self.config.worker_id)
+        step_span.__enter__()
+        flight.record("step.start", iteration=iteration,
+                      worker=self.config.worker_id)
+        try:
+            params, self._next_params = self._next_params, None
+            if params is None:
+                _, params = self.pull_parameters(iteration)
+            missing = (self._expected_param_names() - set(params)
+                       if params else set())
+            if not params or missing:
+                # rides the plain push; the free-run PS answers it
+                # complete=True so no barrier poll runs inside
+                return self._seed_bootstrap(iteration, missing)
+
+            t0 = time.perf_counter()
+            batch = self._next_batch()
+            t1 = time.perf_counter()
+            self._obs_phase["data"].observe(t1 - t0)
+            fused = self._use_fused()
+            incremental = fused and hasattr(self.trainer,
+                                            "compute_gradient_buckets")
+            with obs_trace.span("worker/compute", iteration=iteration):
+                if incremental:
+                    grads = self.trainer.compute_gradient_buckets(params,
+                                                                  batch)
+                    loss = grads.loss
+                else:
+                    grads, loss = self.trainer.compute_gradients(params,
+                                                                 batch)
+            self._obs_phase["compute"].observe(time.perf_counter() - t1)
+            self.last_loss = loss
+            self._start_batch_prefetch()
+
+            if fused:
+                push, fresh = self._fused_push_pull(iteration, grads)
+                if fresh is not None:
+                    self._next_params = fresh
+            else:
+                push = self.push_gradients(iteration, grads)
+            if not push.success:
+                raise WorkerError(f"push rejected: {push.message}")
+            self.iteration = max(self.iteration, iteration)
+            return loss
+        finally:
+            step_span.__exit__(None, None, None)
+            flight.record("step.end", iteration=iteration,
+                          worker=self.config.worker_id,
+                          a=int(1e6 * (time.perf_counter() - t_step)))
+            self._obs_phase["step"].observe(time.perf_counter() - t_step)
+            self.status = m.WorkerStatus.IDLE
+            self.step_timer.__exit__()
+            self.metrics.log(step=self.iteration, loss=self.last_loss,
+                             step_time_s=self.step_timer.summary().get("last_s"))
+
     def run_iteration(self, iteration: int) -> float:
         """One synchronous training step (reference: src/worker.cpp:331-406
         is pull -> compute -> push -> 50 ms barrier polls).  Returns the
@@ -858,7 +930,12 @@ class Worker:
         PushPullStream round whose response both closes the barrier and
         delivers the next iteration's parameters (cached, so the next
         step's pull is free); against a reference PS every leg degrades to
-        the serial unary protocol."""
+        the serial unary protocol.  Under ``config.freerun`` the step is
+        the barrier-free loop above instead — routed here so every
+        caller (run(), the CLI main, tests) picks the mode up from the
+        config alone."""
+        if getattr(self.config, "freerun", False):
+            return self.run_freerun_iteration(iteration)
         self.status = m.WorkerStatus.TRAINING
         self.step_timer.__enter__()
         self.last_bootstrap = False

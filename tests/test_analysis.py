@@ -472,6 +472,31 @@ def test_arena_introduces_no_wire_drift_and_declares_its_lock():
     assert "ArenaManager._lock" in BLOCKING_ALLOWED
 
 
+def test_freerun_introduces_no_wire_drift_and_no_new_locks():
+    """ISSUE 16 compat gate: free-running mode (freerun/) is a SERVER
+    apply policy riding the pinned PushGradients/ServeParameters
+    contract — no new messages, no new methods, so the committed golden
+    manifest must still match the live schemas bit for bit and nothing
+    freerun-named may appear in the pinned contract.  The engine also
+    deliberately adds ZERO locks (version vector + EWMA live under
+    core._state_lock, publication state under core._apply_lock), so no
+    FreeRun rank may ever show up in the declared order — a new lock
+    here means the design changed and needs a declared rank + review."""
+    import json
+
+    from parameter_server_distributed_tpu.analysis import wirecheck
+    from parameter_server_distributed_tpu.analysis.lock_order import (
+        LOCK_RANKS)
+
+    with open(wirecheck.default_manifest_path()) as fh:
+        golden = json.loads(fh.read())
+    assert wirecheck.diff_manifests(golden, wirecheck.build_manifest()) == []
+    blob = json.dumps(golden)
+    for name in ("FreeRun", "Freerun", "PSDT_FREERUN", "staleness_beta"):
+        assert name not in blob, f"freerun leaked into the manifest: {name}"
+    assert not [k for k in LOCK_RANKS if "FreeRun" in k or "freerun" in k]
+
+
 def test_elastic_extension_stays_out_of_the_wire_manifest():
     """ISSUE 13 compat gate: the elastic-membership extension
     (elastic/messages.py) must leave the reference wire manifest

@@ -167,6 +167,32 @@ def test_bench_elastic_contract():
             < result["all_of_n"]["iter_wall_p50_ms"]), result["note"]
 
 
+def test_bench_freerun_contract():
+    """freerun mode (ISSUE 16): steps/s and time-to-target-loss for the
+    barrier-free apply-on-arrival arm vs K-of-N quorum vs all-of-N under
+    a heterogeneous-speed netsim profile.  The free-run arm must
+    actually run free (applies land, the barriered arms record none)
+    and out-rate the all-of-N arm, which pays the slowest worker's
+    injected delay on every barrier."""
+    result = run_bench("freerun", extra_env={
+        "PSDT_BENCH_PARAMS": "1e5",
+        "PSDT_BENCH_STEPS": "5",
+        "PSDT_BENCH_STRAGGLER_MS": "150",
+        "PSDT_BENCH_GRACE_MS": "80",
+    })
+    assert result["metric"] == "ps_freerun_steps_per_s"
+    assert result["value"] > 0
+    assert result["freerun"]["freerun_applies"] > 0
+    assert result["freerun"]["freerun_publishes"] > 0
+    assert result["all_of_n"]["freerun_applies"] == 0
+    assert result["quorum"]["freerun_applies"] == 0
+    # barrier-free pushes never wait for the straggler: the free-run
+    # steps/s rate must beat the all-of-N barrier's
+    assert (result["freerun"]["steps_per_s"]
+            > result["all_of_n"]["steps_per_s"]), result
+    assert result["freerun"]["time_to_target_ms"] is not None
+
+
 @pytest.mark.slow
 def test_bench_fleet_contract():
     """fleet mode (ISSUE 14): streams/s + p99 TTFT vs fleet size under

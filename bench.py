@@ -1984,14 +1984,157 @@ def bench_obs() -> dict:
                      f"({ns_per_event:.0f} ns/event)")}
 
 
+def bench_replicate_sharded(tmp: str) -> dict:
+    """Cross-replica sharded update sweep (ISSUE 18): barrier-close p50
+    and replication bytes/iteration at 1/2/4 replicas over a many-tensor
+    store — flat ship vs sharded raw vs sharded quantized exchange
+    (replication/sharded_update.py).  Bytes are TRUE wire bytes: the
+    client-side request+response byte counters over the PushReplicaDelta
+    / ShardedApplySlices / InstallSlabSlices legs, measured after one
+    warmup close (the first close always flat-ships so the backups learn
+    the base version).  Shape knobs: PSDT_BENCH_SHARDED_TENSORS (store
+    tensor count, default 512; per-tensor size follows from
+    PSDT_BENCH_PARAMS), PSDT_BENCH_REPLICA_COUNTS (default "1,2,4"),
+    PSDT_BENCH_SHARDED_DTYPE (the quantized arm's wire dtype, default
+    int8), PSDT_BENCH_STEPS."""
+    import numpy as np
+
+    from parameter_server_distributed_tpu.config import ParameterServerConfig
+    from parameter_server_distributed_tpu.core import device_apply
+    from parameter_server_distributed_tpu.core.tensor import store_nbytes
+    from parameter_server_distributed_tpu.obs import stats as obs_stats
+    from parameter_server_distributed_tpu.server.ps_service import (
+        ParameterServer)
+
+    if not device_apply.available():
+        log("bench_replicate: sharded sweep skipped (no arena backend)")
+        return {"skipped": "no jax backend/device for the arena close"}
+
+    n_params = int(float(os.environ.get("PSDT_BENCH_PARAMS", "2e6")))
+    n_tensors = int(os.environ.get("PSDT_BENCH_SHARDED_TENSORS", "") or 512)
+    counts = sorted(int(c) for c in os.environ.get(
+        "PSDT_BENCH_REPLICA_COUNTS", "1,2,4").split(",") if c.strip())
+    iters = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 5
+    quant = os.environ.get("PSDT_BENCH_SHARDED_DTYPE", "int8")
+
+    rng = np.random.default_rng(7)
+    elems = max(1, n_params // n_tensors)
+    params = {f"layer{i:03d}/w": rng.standard_normal(elems).astype(np.float32)
+              for i in range(n_tensors)}
+    model_bytes = store_nbytes(params)
+    grads = {k: rng.standard_normal(elems).astype(np.float32) for k in params}
+
+    wire_methods = ("PushReplicaDelta", "ShardedApplySlices",
+                    "InstallSlabSlices")
+
+    def wire_bytes() -> int:
+        counters = obs_stats.REGISTRY.snapshot().get("counters", {})
+        return sum(int(counters.get(f"rpc.client.{method}.{leg}", 0))
+                   for method in wire_methods
+                   for leg in ("request_bytes", "response_bytes"))
+
+    def sharded_counts() -> tuple[int, int]:
+        counters = obs_stats.REGISTRY.snapshot().get("counters", {})
+        return (int(counters.get("ps.apply.sharded", 0)),
+                int(counters.get("ps.apply.sharded_fallback", 0)))
+
+    def make_ps(name: str, **kw) -> tuple[ParameterServer, int]:
+        ps = ParameterServer(ParameterServerConfig(
+            bind_address="127.0.0.1", port=0, total_workers=1,
+            checkpoint_dir=os.path.join(tmp, name), learning_rate=0.1,
+            autosave_period_s=3600.0, optimizer="sharded_adam", **kw))
+        return ps, ps.start()
+
+    def cell(replicas: int, arm: str) -> dict:
+        backups = [make_ps(f"sh-{arm}-{replicas}r-bk{i}")
+                   for i in range(replicas - 1)]
+        kw = {}
+        if backups:
+            kw = {"backup_address": ",".join(
+                      f"127.0.0.1:{port}" for _, port in backups),
+                  "replication": "sync"}
+            if arm != "flat":
+                kw["sharded_update"] = "1"
+                if arm == "sharded_quant":
+                    kw["sharded_update_dtype"] = quant
+        primary, _ = make_ps(f"sh-{arm}-{replicas}r-pr", **kw)
+        try:
+            primary.core.initialize_parameters(params)
+            # warmup close: the backups learn the init version through
+            # its flat ship, so every MEASURED close can shard
+            r = primary.core.receive_gradients(0, 1, grads)
+            assert r.aggregation_complete, r.message
+            b0, (s0, f0) = wire_bytes(), sharded_counts()
+            times = []
+            for it in range(2, iters + 2):
+                t0 = time.perf_counter()
+                r = primary.core.receive_gradients(0, it, grads)
+                times.append(time.perf_counter() - t0)
+                assert r.aggregation_complete, r.message
+            b1, (s1, f1) = wire_bytes(), sharded_counts()
+        finally:
+            primary.stop(0)
+            for bk, _port in backups:
+                bk.stop(0)
+        p50 = sorted(times)[len(times) // 2]
+        row = {"replicas": replicas, "arm": arm,
+               "close_p50_ms": round(1e3 * p50, 3),
+               "bytes_per_iter": int(round((b1 - b0) / iters)),
+               "sharded_closes": s1 - s0, "sharded_fallbacks": f1 - f0}
+        log(f"bench_replicate: sharded sweep {arm} x{replicas}: close p50 "
+            f"{row['close_p50_ms']}ms, {row['bytes_per_iter'] / 1e6:.2f} "
+            f"MB/iter, {row['sharded_closes']}/{iters} closes sharded")
+        return row
+
+    # all arms (including flat ship) run the same flat-arena close and
+    # the same device optimizer: the ONLY variable is the replication
+    # strategy.  At 1 replica every arm degenerates to the local apply,
+    # so the sweep keeps a single baseline cell there.
+    prior_arena = os.environ.get("PSDT_ARENA")
+    os.environ["PSDT_ARENA"] = "1"
+    try:
+        rows = [cell(replicas, arm)
+                for replicas in counts
+                for arm in (("flat",) if replicas < 2 else
+                            ("flat", "sharded_raw", "sharded_quant"))]
+    finally:
+        if prior_arena is None:
+            os.environ.pop("PSDT_ARENA", None)
+        else:
+            os.environ["PSDT_ARENA"] = prior_arena
+
+    by = {(row["replicas"], row["arm"]): row for row in rows}
+    bytes_ratio: dict = {}
+    close_ratio: dict = {}
+    for replicas in counts:
+        flat = by.get((replicas, "flat"))
+        if replicas < 2 or flat is None or not flat["bytes_per_iter"]:
+            continue
+        for arm in ("sharded_raw", "sharded_quant"):
+            row = by.get((replicas, arm))
+            if row is None:
+                continue
+            bytes_ratio.setdefault(str(replicas), {})[arm] = round(
+                row["bytes_per_iter"] / flat["bytes_per_iter"], 3)
+            close_ratio.setdefault(str(replicas), {})[arm] = round(
+                row["close_p50_ms"] / flat["close_p50_ms"], 3)
+    return {"tensors": n_tensors, "tensor_elems": elems,
+            "model_bytes": model_bytes, "steps": iters, "opt": "adam",
+            "quant_dtype": quant, "rows": rows,
+            "bytes_per_iter_vs_flat": bytes_ratio,
+            "close_p50_vs_flat": close_ratio}
+
+
 def bench_replicate() -> dict:
     """Replication/failover/reshard bench (real loopback gRPC between
     in-process PS servers): barrier-close latency with replication
     off / async / sync, failover wall-clock (primary death -> first
-    successful push against the promoted replica), and a live 2->4
-    reshard's moved bytes + wall time.  Shape knobs: PSDT_BENCH_PARAMS
-    (total store size, default 2M), PSDT_BENCH_STEPS (iterations per
-    mode, default 5)."""
+    successful push against the promoted replica), a live 2->4
+    reshard's moved bytes + wall time, and the ISSUE 18 sharded-update
+    sweep (PSDT_BENCH_SHARDED=0 skips it; PSDT_BENCH_SHARDED_ONLY=1
+    runs ONLY it and returns its focused metric).  Shape knobs:
+    PSDT_BENCH_PARAMS (total store size, default 2M), PSDT_BENCH_STEPS
+    (iterations per mode, default 5)."""
     import tempfile
 
     import numpy as np
@@ -2015,6 +2158,23 @@ def bench_replicate() -> dict:
     n_params = int(float(os.environ.get("PSDT_BENCH_PARAMS", "2e6")))
     iters = int(os.environ.get("PSDT_BENCH_STEPS", "0")) or 5
     tmp = tempfile.mkdtemp(prefix="psdt-repl-")
+
+    run_sharded = os.environ.get("PSDT_BENCH_SHARDED", "1") != "0"
+    if os.environ.get("PSDT_BENCH_SHARDED_ONLY") == "1":
+        sweep = bench_replicate_sharded(tmp)
+        ratios = sweep.get("bytes_per_iter_vs_flat", {})
+        top = max((int(k) for k in ratios), default=0)
+        value = ratios[str(top)].get("sharded_raw", 0.0) if top else 0.0
+        quant_ratio = (ratios[str(top)].get("sharded_quant", 0.0)
+                       if top else 0.0)
+        return {"metric": f"ps_replicate_sharded_bytes_ratio_{top}r",
+                "value": value, "unit": "x_vs_flat_ship",
+                "vs_baseline": value, "issue": 18, "sharded": sweep,
+                "note": (f"cross-replica sharded update: replication wire "
+                         f"bytes/iteration at {top} replicas, raw exchange "
+                         f"{value}x the flat ship ({quant_ratio}x quantized "
+                         f"{sweep.get('quant_dtype')}); rows carry close "
+                         f"p50 + bytes/iter per (replicas, arm)")}
 
     rng = np.random.default_rng(0)
     n_tensors = 12
@@ -2119,6 +2279,8 @@ def bench_replicate() -> dict:
     for ps, _ in shards:
         ps.stop(0)
 
+    sharded = bench_replicate_sharded(tmp) if run_sharded else None
+
     overhead_sync = (round((close_sync - close_off) / close_off, 3)
                      if close_off else 0.0)
     return {"metric": "ps_replicate_close_ms_sync", "value": close_sync,
@@ -2132,6 +2294,7 @@ def bench_replicate() -> dict:
             "reshard_s": round(reshard_s, 3),
             "reshard_moved_bytes": stats["moved_bytes"],
             "model_bytes": model_bytes,
+            "sharded": sharded,
             "note": (f"barrier close p50 {close_off}ms off / {close_async}ms "
                      f"async / {close_sync}ms sync replication; failover "
                      f"{failover_s:.2f}s death->replica-applied; 2->4 "

@@ -36,6 +36,12 @@ LOCK_RANKS: dict[str, int] = {
     # 7): leaf in the coordinator process; ranked before the PS core
     # locks so a colocated test topology stays ordered
     "CoordinatorCore._lock": 14,
+    # backup-side sharded-update sink (replication/sharded_update.py,
+    # ISSUE 18): held across the owned-slice range applies (device
+    # dispatch) and core.install_sharded_close (ranks 20..40), and it
+    # advances the replica sink's high-water mark inside (rank 16) — so
+    # it must come before both
+    "ShardedUpdateSink._lock": 15,
     # backup-side replication sink (replication/replicator.py): held
     # across core.install_tensors (ranks 20..40), so it must come first —
     # it serializes whole delta installs against each other and against a
@@ -69,6 +75,13 @@ LOCK_RANKS: dict[str, int] = {
     # is acquired while the barrier closer holds _apply_lock (30), hence
     # the rank after the core locks
     "Replicator._lock": 46,
+    # primary-side sharded-update driver (replication/sharded_update.py,
+    # ISSUE 18): fences the lazily-built per-peer clients and the
+    # permanent-downgrade set against stop().  Acquired on the barrier
+    # closer under _apply_lock (30) and by the per-peer exchange
+    # threads; client construction under it may touch the channel
+    # (BLOCKING_ALLOWED).
+    "ShardedUpdater._lock": 47,
     "Replicator._ship_lock": 48,
     # flat arena apply (core/arena.py, ISSUE 15): serializes packing-
     # table builds and param-slab packs/adoption.  Acquired under
@@ -189,6 +202,13 @@ BLOCKING_ALLOWED: frozenset[str] = frozenset({
     # serializes one replication ship (encode + PushReplicaDelta RPC +
     # ack) to the backup — the RPC under it is the point of the lock
     "Replicator._ship_lock",
+    # backup-side sharded close: the owned-slice device applies and the
+    # store install under it are the lock's purpose (replication/
+    # sharded_update.py, ISSUE 18)
+    "ShardedUpdateSink._lock",
+    # primary-side sharded-update driver: gRPC client construction under
+    # it may touch the channel (replication/sharded_update.py)
+    "ShardedUpdater._lock",
     # serializes flight-ring creation/teardown (mmap + file I/O is the
     # lock's purpose; the record() hot path never takes it)
     "FlightRecorder._lock",

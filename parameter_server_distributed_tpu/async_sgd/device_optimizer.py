@@ -683,6 +683,12 @@ class ShardedDeviceOptimizer(HostOptimizer):
         return dict(results)
 
     def _arena_stripe(self, table, stripe, p, g, lr, false):
+        chunk = device_apply.stage_chunk_elems()
+        if chunk > 0:
+            size = int(table.stripe_sizes[stripe])
+            if size > chunk:
+                return self._arena_stripe_chunked(table, stripe, p, g, lr,
+                                                  false, chunk, size)
         k = device_apply.k
         if self.rule == "sgd":
             return k("b_psub")([p], k("b_mul")([g], lr))[0]
@@ -771,6 +777,192 @@ class ShardedDeviceOptimizer(HostOptimizer):
         self._arena_scr[("wd", stripe)] = t
         u = k("a_lion_fin")(us[0], t, mask, lr)
         return k("b_psub")([p], [u])[0]
+
+    # --------------------------------------- arena range apply (pure)
+    # Per-[lo, hi) slices of the per-stripe stage chain: the shared
+    # machinery behind intra-host stage chunking (PSDT_DEVICE_STAGE_CHUNK)
+    # and the cross-replica sharded update (replication/sharded_update.py),
+    # where each replica runs only its owned slices.  Every stage is
+    # elementwise, so a slice-of-apply is bit-identical to the
+    # apply-of-slice — pinned by tests/test_sharded_update.py.
+
+    def _arena_stripe_chunked(self, table, stripe, p, g, lr, false,
+                              chunk, size):
+        """The whole-stripe apply as ceil(size/chunk) independent range
+        programs (sub-chunked stage programs, ISSUE 15 leftover).  Slot
+        reads all happen against the pre-close slabs (the range apply is
+        pure); the fresh slot slices commit at the end, exactly like the
+        one-shot path's in-place donation semantics."""
+        import jax.numpy as jnp
+
+        pieces = []
+        slot_pieces: dict[str, list] = {
+            kind: [] for kind in self._RULE_SLOTS[self.rule]}
+        for lo in range(0, size, chunk):
+            hi = min(lo + chunk, size)
+            new_p, slots = self.apply_arena_range(
+                table, stripe, p[lo:hi], g[lo:hi], lo, hi, false=false)
+            pieces.append(new_p)
+            for kind, arr in slots.items():
+                slot_pieces[kind].append((lo, hi, arr))
+        self.commit_arena_ranges(
+            table, stripe, {k: v for k, v in slot_pieces.items() if v})
+        return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+    def apply_arena_range(self, table, stripe, p, g, lo, hi, false=None):
+        """PURE per-range arena apply: run the rule's fused stage chain
+        over one contiguous ``[lo, hi)`` slice of stripe ``stripe`` and
+        return ``(new_param_slice, {slot_kind: new_slot_slice})``
+        WITHOUT touching the arena slot slabs — the caller commits the
+        slot slices via :meth:`commit_arena_ranges` once its close
+        passes the point of no return (a degraded sharded close must be
+        able to fall back to the full local apply against unmodified
+        slots, and a backup whose install leg never arrives must drop
+        the slices without trace).
+
+        ``p``/``g`` are f32 slices of the param and fold-sum slabs
+        (device or host); slot state is read as SLICES of the live
+        slabs — fresh buffers, so the stage kernels' donation consumes
+        the slices, never the slabs.  Caller has run
+        :meth:`ensure_arena_slots` and serializes logical steps."""
+        k = device_apply.k
+        if false is None:
+            false = np.bool_(False)
+        lr = np.float32(self.learning_rate)
+        p = device_apply.owned_f32(p)
+        g = device_apply.owned_f32(g)
+        if self.rule == "sgd":
+            return k("b_psub")([p], k("b_mul")([g], lr))[0], {}
+        if self.rule == "momentum":
+            slab = self._arena_slots.get("velocity", {}).get(stripe)
+            if slab is None:
+                # unseeded stripe: the copy-seed, per slice (a bit copy,
+                # so concatenated slices == the whole-slab a_copy)
+                v2 = k("a_copy")(g, false)
+                return (k("b_psub")([p], k("b_mul")([v2], lr))[0],
+                        {"velocity": v2})
+            ts = k("b_mul_d0")([slab[lo:hi]], np.float32(self.momentum))
+            v2s, steps = k("b_mom_pair")(ts, [g], lr)
+            return k("b_psub")([p], steps)[0], {"velocity": v2s[0]}
+        if self.rule == "lion":
+            return self._arena_lion_range(table, stripe, p, g, lo, hi,
+                                          lr, false)
+        return self._arena_adam_range(table, stripe, p, g, lo, hi, lr,
+                                      false)
+
+    def _range_scratch(self, kind: str, stripe: int, lo: int, hi: int, g):
+        s = self._arena_scr.get((kind, stripe, lo, hi))
+        if s is None or s.shape != g.shape:
+            s = _zeros_f32(g.shape)
+        return s
+
+    def _arena_adam_range(self, table, stripe, p, g, lo, hi, lr, false):
+        k = device_apply.k
+        b1, b2 = np.float32(self.b1), np.float32(self.b2)
+        one = np.float32(1.0)
+        m_slab = self._arena_slots.get("m", {}).get(stripe)
+        v_slab = self._arena_slots.get("v", {}).get(stripe)
+        m = _zeros_f32(g.shape) if m_slab is None else m_slab[lo:hi]
+        v = _zeros_f32(g.shape) if v_slab is None else v_slab[lo:hi]
+        t1s, t2s, t3s, t4s = k("b_adam_mul4")(
+            [m], [v], [g], b1, one - b1, b2, one - b2,
+            [self._range_scratch("t2", stripe, lo, hi, g)],
+            [self._range_scratch("t4", stripe, lo, hi, g)], false)
+        self._arena_scr[("t2", stripe, lo, hi)] = t2s[0]
+        self._arena_scr[("t4", stripe, lo, hi)] = t4s[0]
+        m2s, v2s = k("b_add2")(t1s, t2s, t3s, t4s)
+        out_slots = {"m": m2s[0], "v": v2s[0]}
+        bc1, bc2 = self._bias_corrections()
+        eps = np.float32(self.eps)
+        if self.rule == "adam":
+            return (k("b_adam_fin1")([p], m2s, v2s, bc1, bc2, eps,
+                                     lr)[0], out_slots)
+        dens, mhs = k("b_adamw_den_mh")(
+            v2s, bc2, eps, m2s, bc1,
+            [self._range_scratch("den", stripe, lo, hi, g)], false)
+        self._arena_scr[("den", stripe, lo, hi)] = dens[0]
+        if not self.weight_decay:
+            us = k("b_adamw_fin")(mhs, dens, lr)
+            return k("b_psub")([p], us)[0], out_slots
+        mask = table.decay_mask(stripe)[lo:hi]
+        t = k("a_wd_mul")(p, np.float32(self.weight_decay), mask,
+                          self._range_scratch("wd", stripe, lo, hi, g),
+                          false)
+        self._arena_scr[("wd", stripe, lo, hi)] = t
+        u = k("a_adamw_fin")(mhs[0], dens[0], t, mask, lr)
+        return k("b_psub")([p], [u])[0], out_slots
+
+    def _arena_lion_range(self, table, stripe, p, g, lo, hi, lr, false):
+        k = device_apply.k
+        b1, b2 = np.float32(self.b1), np.float32(self.b2)
+        one = np.float32(1.0)
+        m_slab = self._arena_slots.get("m", {}).get(stripe)
+        m = _zeros_f32(g.shape) if m_slab is None else m_slab[lo:hi]
+        t1s, t2s, t3s, t4s = k("b_lion_mul4")(
+            [m], [g], b1, one - b1, b2, one - b2,
+            [self._range_scratch("t2", stripe, lo, hi, g)],
+            [self._range_scratch("t4", stripe, lo, hi, g)], false)
+        self._arena_scr[("t2", stripe, lo, hi)] = t2s[0]
+        self._arena_scr[("t4", stripe, lo, hi)] = t4s[0]
+        us = k("b_sign_add")(t1s, t2s)
+        out_slots = {"m": k("b_add_d0")(t3s, t4s)[0]}
+        if not self.weight_decay:
+            return (k("b_psub")([p], k("b_mul_d0")(us, lr))[0],
+                    out_slots)
+        mask = table.decay_mask(stripe)[lo:hi]
+        t = k("a_wd_mul")(p, np.float32(self.weight_decay), mask,
+                          self._range_scratch("wd", stripe, lo, hi, g),
+                          false)
+        self._arena_scr[("wd", stripe, lo, hi)] = t
+        u = k("a_lion_fin")(us[0], t, mask, lr)
+        return k("b_psub")([p], [u])[0], out_slots
+
+    def ensure_arena_slots(self, table) -> None:
+        """Public face of the slot-slab pack for the range-apply
+        callers (the sharded-update exchange runs it before slicing)."""
+        self._ensure_arena_slots(table)
+
+    def arena_slot_kinds(self) -> tuple:
+        return self._RULE_SLOTS[self.rule]
+
+    def arena_slot_slab(self, kind: str, stripe: int):
+        """The live slot slab for (kind, stripe), or None (unseeded
+        momentum / no slabs packed)."""
+        return self._arena_slots.get(kind, {}).get(stripe)
+
+    def commit_arena_ranges(self, table, stripe: int,
+                            slot_pieces: Mapping[str, list]) -> None:
+        """Write fresh slot slices into the arena slot slabs — the
+        deferred other half of :meth:`apply_arena_range`, run only once
+        a close commits.  ``slot_pieces`` maps slot kind to a list of
+        ``(lo, hi, values)``; full contiguous coverage rebinds the slab
+        as one concatenation (no read of the old slab), partial
+        coverage scatters into the existing slab (a sharded backup
+        commits only its OWNED ranges — its non-owned slot elements go
+        stale by design, healed by the next flat state ship)."""
+        import jax.numpy as jnp
+
+        for kind, pieces in slot_pieces.items():
+            if not pieces:
+                continue
+            per_stripe = self._arena_slots.setdefault(kind, {})
+            pieces = sorted(pieces, key=lambda t: t[0])
+            size = int(table.stripe_sizes[stripe])
+            full = (pieces[0][0] == 0 and pieces[-1][1] == size
+                    and all(pieces[i][1] == pieces[i + 1][0]
+                            for i in range(len(pieces) - 1)))
+            if full:
+                vals = [device_apply.owned_f32(a) for _, _, a in pieces]
+                per_stripe[stripe] = (vals[0] if len(vals) == 1
+                                      else jnp.concatenate(vals))
+                continue
+            slab = per_stripe.get(stripe)
+            if slab is None:
+                slab = _zeros_f32((size,))
+            for piece_lo, piece_hi, arr in pieces:
+                slab = slab.at[piece_lo:piece_hi].set(
+                    device_apply.owned_f32(arr))
+            per_stripe[stripe] = slab
 
     # ------------------------------------------- arena slot slab sync
     def _ensure_arena_slots(self, table) -> None:

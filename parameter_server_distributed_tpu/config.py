@@ -91,6 +91,17 @@ class ParameterServerConfig:
     # can never be lost) | "off".
     backup_address: str = ""
     replication: str = ""
+    # Cross-replica sharded update (replication/sharded_update.py,
+    # ISSUE 18): partition each arena close across the replica set and
+    # all-gather the fresh slabs instead of shipping full post-apply
+    # state.  Requires sync replication + PSDT_ARENA.  Tri-state: ""
+    # defers to the PSDT_SHARDED_UPDATE env (default off), "1"/"0"
+    # force.  Exchange dtype for the sums/param legs via
+    # `sharded_update_dtype` / PSDT_SHARDED_UPDATE_DTYPE: "raw"
+    # (default — bit-exact f32) | "bf16" | "int8" (EQuARX-style
+    # quantized exchange with sums-leg error feedback).
+    sharded_update: str = ""
+    sharded_update_dtype: str = ""
     # K-of-N quorum barriers (elastic/quorum.py, ISSUE 13): close the
     # synchronous barrier once ceil(quorum * live width) contributors
     # committed AND quorum_grace_ms past the K-th commit elapsed;

@@ -138,6 +138,25 @@ def stripe_dispatch(store: Mapping) -> bool:
     return total // len(store) < bound
 
 
+# Elements per sub-chunk of an arena stage program (0 = whole-slab
+# stages, the default).  When set, the fused per-stripe update sweep
+# runs as ceil(size/chunk) independent [lo, hi) range programs instead
+# of one slab-sized program — the intra-host parallelization hook for
+# one stripe-slice's sweep (every stage is elementwise, so the chunked
+# program is bit-identical to the unchunked one; pinned by
+# tests/test_sharded_update.py).  The same per-range programs are what
+# the cross-replica sharded update runs over its owned slices.
+ENV_STAGE_CHUNK = "PSDT_DEVICE_STAGE_CHUNK"
+
+
+def stage_chunk_elems() -> int:
+    """Arena stage sub-chunk size in ELEMENTS (0 = off)."""
+    try:
+        return max(0, int(os.environ.get(ENV_STAGE_CHUNK, "0")))
+    except ValueError:
+        return 0
+
+
 # --------------------------------------------------------------- kernels
 # One lazily-compiled jit program per stage name (jax caches compiled
 # code per operand shape).  Donating variants are used ONLY on

@@ -567,6 +567,12 @@ class ParameterServerCore:
         # hook may read the store consistently and — in sync mode —
         # block on the ship; _apply_lock is BLOCKING_ALLOWED).
         self._on_apply: Callable[[], None] | None = None
+        # Cross-replica sharded update (replication/sharded_update.py):
+        # when armed, the arena close offers the primary's fold sums to
+        # the updater, which partitions the stage sweep across the
+        # replica set and all-gathers the fresh slabs — replication
+        # bandwidth becomes the collective.  None = every close is local.
+        self._sharded_updater = None
         # Delta sink (delta/chain.py DeltaChain, ISSUE 10): told about
         # every SYNCHRONOUS apply's (store, version) right after the
         # swap — still inside the serialized apply section, so the sink
@@ -1964,14 +1970,29 @@ class ParameterServerCore:
         opt = self._optimizer
         opt.tick()
         td = time.perf_counter()
-        new_slabs = opt.apply_arena(table, param_slabs, sums.slabs)
-        dispatch_us = int(1e6 * (time.perf_counter() - td))
-        # ONE contiguous D2H per stripe: start every transfer, then
-        # materialize the host slabs the per-tensor views slice
-        tr = time.perf_counter()
-        device_apply.readback_async(new_slabs)
-        host_slabs = {s: np.asarray(a) for s, a in new_slabs.items()}
-        readback_us = int(1e6 * (time.perf_counter() - tr))
+        sharded = None
+        if self._sharded_updater is not None:
+            # cross-replica sharded close: each replica applies only its
+            # owned stripe slices and the fresh slabs all-gather back.
+            # try_close never raises; None means this close runs local
+            # (no in-sync peers, a mid-exchange death, a refusal) — the
+            # slot slabs and sums are untouched on that path, so the
+            # local apply below is bit-identical to an unsharded close.
+            sharded = self._sharded_updater.try_close(
+                prev, table, param_slabs, sums, iteration)
+        if sharded is not None:
+            new_slabs, host_slabs = sharded
+            dispatch_us = int(1e6 * (time.perf_counter() - td))
+            readback_us = 0
+        else:
+            new_slabs = opt.apply_arena(table, param_slabs, sums.slabs)
+            dispatch_us = int(1e6 * (time.perf_counter() - td))
+            # ONE contiguous D2H per stripe: start every transfer, then
+            # materialize the host slabs the per-tensor views slice
+            tr = time.perf_counter()
+            device_apply.readback_async(new_slabs)
+            host_slabs = {s: np.asarray(a) for s, a in new_slabs.items()}
+            readback_us = int(1e6 * (time.perf_counter() - tr))
         per_stripe = {s: table.views(s, h) for s, h in host_slabs.items()}
         views: TensorStore = {}
         for name in prev:
@@ -2295,6 +2316,57 @@ class ParameterServerCore:
         aggregation modes never invoke it — the replicator's reconcile
         loop covers them on its poll cadence."""
         self._on_apply = hook
+
+    def set_sharded_updater(self, updater) -> None:
+        """Install (or clear) the cross-replica sharded-update driver
+        (replication/sharded_update.ShardedUpdater).  Its ``try_close``
+        is offered every arena close from under _apply_lock; it must
+        never raise (return None to decline — the close then runs the
+        ordinary local apply against untouched slots and sums)."""
+        self._sharded_updater = updater
+
+    def install_sharded_close(self, store, *, epoch: int,
+                              iteration: int) -> int:
+        """Adopt one cross-replica sharded close on a BACKUP: ``store``
+        is the primary's next version, assembled from this replica's own
+        freshly-applied slices plus the gathered ones
+        (replication/sharded_update.ShardedUpdateSink).
+
+        Unlike :meth:`install_tensors` this is an IN-TIMELINE advance —
+        the replica co-computed the same optimizer step the primary is
+        publishing — so the restore fence does NOT bump (an in-flight
+        local close on a promoted replica is a different, refused world)
+        and the arena manager is left alone (the sink owns the backup's
+        slab cache; the optimizer slot slabs were advanced by the sink's
+        range commits).  Iteration bookkeeping matches a replication
+        replace: the aggregated watermark advances and superseded
+        iteration states drop, so failover retries of an applied
+        iteration stay idempotent."""
+        with self._state_lock:
+            with self._apply_lock:
+                with self._params_lock:
+                    self._params = store
+                    self._params_version += 1
+                    version = self._params_version
+            self._epoch = int(epoch)
+            it = int(iteration)
+            self._current_iteration = max(self._current_iteration, it)
+            self._aggregated_watermark = max(self._aggregated_watermark,
+                                             it)
+            for stale_it in [i for i in self._iteration_states
+                             if i <= self._aggregated_watermark]:
+                old = self._iteration_states.pop(stale_it)
+                if old.buffer_bytes:
+                    self._grad_buffer_note(-old.buffer_bytes)
+                    old.buffer_bytes = 0
+            self._serving = None
+            flight.record("shard.install", iteration=it,
+                          a=store_nbytes(store), b=version)
+            self._barrier_cv.notify_all()
+        # stale delta pairs must not patch receivers across a version
+        # they did not watch being built (restore() discipline)
+        self._reset_delta()
+        return version
 
     def replica_snapshot(self, in_close: bool = False
                          ) -> tuple[int, int, int, TensorStore, dict]:

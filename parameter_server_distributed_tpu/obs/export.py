@@ -160,6 +160,21 @@ def _ps_rollup(snap: dict) -> dict:
         value = counters.get(name, 0)
         if value:
             replica[key] = value
+    # cross-replica sharded update (replication/sharded_update.py,
+    # ISSUE 18): sharded closes vs local fallbacks on the primary, the
+    # exchange payload volume, and the backup-side slice applies
+    for key, name in (("sharded_closes", "ps.apply.sharded"),
+                      ("sharded_fallbacks", "ps.apply.sharded_fallback"),
+                      ("sharded_bytes", "ps.replica.sharded_bytes"),
+                      ("sharded_applies", "ps.replica.sharded_applies")):
+        value = counters.get(name, 0)
+        if value:
+            replica[key] = value
+    # 1 while this backup replicates by flat SHIPPING only (its
+    # accelerator idle through every close), cleared by the first
+    # sharded slice apply
+    if snap.get("gauges", {}).get("ps.replica.idle_accelerator"):
+        replica["idle_accelerator"] = True
     # a promoted primary serving with NO backup (ISSUE 9 satellite):
     # the unreplicated window the standby re-arm closes
     if snap.get("gauges", {}).get("ps.replica.unarmed"):
@@ -491,6 +506,19 @@ def render_rollup(rollup: dict) -> str:
                     rparts.append(
                         "reshard moved "
                         + _fmt_bytes(replica["reshard_moved_bytes"]))
+                if replica.get("sharded_closes"):
+                    rparts.append(
+                        f"{replica['sharded_closes']} sharded closes "
+                        f"({_fmt_bytes(replica.get('sharded_bytes', 0))} "
+                        f"exchanged)")
+                if replica.get("sharded_fallbacks"):
+                    rparts.append(f"{replica['sharded_fallbacks']} "
+                                  f"sharded fallbacks")
+                if replica.get("sharded_applies"):
+                    rparts.append(f"{replica['sharded_applies']} "
+                                  f"sharded slice applies")
+                if replica.get("idle_accelerator"):
+                    rparts.append("idle accelerator (flat-ship replica)")
                 if replica.get("unarmed"):
                     rparts.append("UNARMED (promoted primary, no backup)")
                 lines.append(f"    replication: {', '.join(rparts)}")

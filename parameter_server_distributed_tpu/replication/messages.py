@@ -128,6 +128,77 @@ REPLICATION_PS_METHODS = {
 
 
 # --------------------------------------------------------------------------
+# cross-replica sharded update (arXiv:2004.13336 over the replication link)
+# --------------------------------------------------------------------------
+
+# ShardedSliceChunk.kind values
+SLICE_SUMS = 0    # mirrored fold sums for the receiver's owned slice
+SLICE_PARAMS = 1  # fresh parameter slab slice (post-apply)
+SLICE_SLOT = 2    # fresh optimizer slot slab slice (always raw f32)
+
+
+class ShardedSliceChunk(Message):
+    """One slab-slice segment of a sharded arena close.
+
+    The same message rides both exchange legs: the primary streams
+    ``SLICE_SUMS`` chunks for a peer's owned ``[lo, hi)`` ranges and the
+    peer answers with ``SLICE_PARAMS``/``SLICE_SLOT`` chunks for the
+    freshly applied slices (``ShardedApplySlices``, stream-stream); the
+    primary then broadcasts every peer's missing param slices plus the
+    commit header (``InstallSlabSlices``, stream-unary).
+
+    Header fields ride every chunk.  ``plan_epoch`` is the PackingTable
+    epoch both sides must agree on (the slice-assignment fence, like the
+    shard-map epoch); ``base_version``/``new_version`` pin the store
+    version the apply starts from and the one the close commits.
+    ``payload`` is a single Tensor whose flat f32 payload is one
+    contiguous segment of the slab slice — ``index`` orders segments
+    inside a (kind, stripe, slot, lo, hi) slice when it exceeds the
+    stream chunk budget.  A non-empty ``error`` aborts the exchange (the
+    receiver's refusal reason); the sender degrades that close to the
+    local full apply."""
+    FIELDS = (
+        Field(1, "plan_epoch", "int32"),
+        Field(2, "epoch", "int32"),
+        Field(3, "iteration", "int32"),
+        Field(4, "base_version", "int64"),
+        Field(5, "new_version", "int64"),
+        Field(6, "kind", "int32"),
+        Field(7, "stripe", "int32"),
+        Field(8, "slot", "string"),
+        Field(9, "lo", "int64"),
+        Field(10, "hi", "int64"),
+        Field(11, "payload", "message", message_type=Tensor),
+        Field(12, "last", "bool"),
+        Field(13, "step", "int64"),
+        Field(14, "index", "int32"),
+        Field(15, "replicas", "int32"),
+        Field(16, "stripes", "int32"),
+        Field(17, "error", "string"),
+        Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
+    )
+
+
+class ShardedSliceAck(Message):
+    FIELDS = (
+        Field(1, "success", "bool"),
+        Field(2, "message", "string"),
+        Field(3, "params_version", "int64"),
+    )
+
+
+# Extra method names on the parameter-server service, merged into the
+# extension table at bind time.  UNIMPLEMENTED from an older peer is a
+# permanent per-connection downgrade to the flat-ship path.
+SHARDED_UPDATE_PS_METHODS = {
+    "ShardedApplySlices": (ShardedSliceChunk, ShardedSliceChunk,
+                           "stream_stream"),
+    "InstallSlabSlices": (ShardedSliceChunk, ShardedSliceAck,
+                          "stream_unary"),
+}
+
+
+# --------------------------------------------------------------------------
 # coordinator service extensions
 # --------------------------------------------------------------------------
 

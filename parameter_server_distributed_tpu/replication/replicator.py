@@ -157,11 +157,36 @@ def state_chunks(epoch: int, iteration: int, version: int,
                                      last=(i == len(groups) - 1))
 
 
+class _Peer:
+    """Per-backup ship state (one Replicator may fan out to several
+    backups — ``backup_address`` is a comma-separated list)."""
+
+    __slots__ = ("address", "client", "shipped_version",
+                 "transient_failures", "degraded")
+
+    def __init__(self, address: str):
+        self.address = address
+        self.client = replication_client(address)
+        self.shipped_version = -1
+        self.transient_failures = 0
+        self.degraded = False
+
+
+class _ShipIncomplete(RuntimeError):
+    """A ship left at least one live backup behind (transient failure):
+    the caller retries on its own cadence."""
+
+
 class Replicator:
     """Primary-side shipper.  ``on_apply`` is installed as the core's
     replication hook; :meth:`start`/:meth:`stop` manage the reconcile
     thread (which also covers restores/initializations and the buffered
-    aggregation mode, where the close-path hook never fires)."""
+    aggregation mode, where the close-path hook never fires).
+
+    ``backup_address`` may name SEVERAL backups (comma-separated): each
+    gets its own client, ship watermark, and downgrade state, so one
+    dead backup never stalls or degrades the others.  ``degraded`` means
+    every backup is gone."""
 
     def __init__(self, core, backup_address: str, mode: str = "async",
                  poll_s: float = 0.25, include_optimizer: bool = True,
@@ -169,13 +194,18 @@ class Replicator:
         if mode not in ("async", "sync"):
             raise ValueError(f"unknown replication mode {mode!r}; "
                              f"options: async, sync")
+        addresses = [a.strip() for a in backup_address.split(",")
+                     if a.strip()]
+        if not addresses:
+            raise ValueError("Replicator needs at least one backup address")
         self._core = core
         self.backup_address = backup_address
+        self.addresses = tuple(addresses)
         self.mode = mode
         self._poll_s = float(poll_s)
         self._include_optimizer = include_optimizer
         self._timeout_s = float(timeout_s)
-        self._client = replication_client(backup_address)
+        self._peers = {a: _Peer(a) for a in addresses}
         # wake flag for the reconcile thread (leaf; tiny critical
         # sections only, so an in-flight ship never blocks the hook)
         self._lock = checked_lock("Replicator._lock")
@@ -185,9 +215,6 @@ class Replicator:
         # ships run on barrier-closer threads, the reconcile thread runs
         # its own — version monotonicity to the sink needs an order
         self._ship_lock = checked_lock("Replicator._ship_lock")
-        self._last_shipped_version = -1
-        self._transient_failures = 0
-        self._degraded = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._obs_lag = obs_stats.gauge("ps.replica.lag_bytes")
@@ -197,11 +224,41 @@ class Replicator:
 
     @property
     def degraded(self) -> bool:
-        return self._degraded
+        return all(p.degraded for p in self._peers.values())
 
     @property
     def last_shipped_version(self) -> int:
-        return self._last_shipped_version
+        """The version every LIVE backup provably holds (the sync-mode
+        guarantee floor); with no live backup, the high-water mark of
+        whatever was ever shipped."""
+        live = [p.shipped_version for p in self._peers.values()
+                if not p.degraded]
+        if live:
+            return min(live)
+        return max((p.shipped_version for p in self._peers.values()),
+                   default=-1)
+
+    # ----------------------------------------- sharded-update interface
+    def live_addresses(self) -> tuple:
+        """Backups still in the replication set, in configured order."""
+        return tuple(a for a in self.addresses
+                     if not self._peers[a].degraded)
+
+    def shipped_version(self, address: str) -> int:
+        peer = self._peers.get(address)
+        return -1 if peer is None else peer.shipped_version
+
+    def note_shipped(self, version: int, addresses) -> None:
+        """Advance per-backup watermarks for state delivered OUTSIDE the
+        flat ship (the sharded-update exchange IS the replication for a
+        close): the next flat ship coalesces for those backups, which is
+        exactly where the bandwidth win lands."""
+        with self._ship_lock:
+            for address in addresses:
+                peer = self._peers.get(address)
+                if peer is not None and version > peer.shipped_version:
+                    peer.shipped_version = version
+                    peer.transient_failures = 0
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -217,7 +274,8 @@ class Replicator:
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
-        self._client.close()
+        for peer in self._peers.values():
+            peer.client.close()
 
     # ------------------------------------------------------------------ hook
     def on_apply(self) -> None:
@@ -232,19 +290,18 @@ class Replicator:
         ones) publishes unreplicated rather than wedging training on a
         dead backup.  The sync guarantee is therefore exact up to the
         moment of explicit degradation."""
-        if self._degraded:
+        if self.degraded:
             return
         if self.mode == "sync":
             delay = 0.1
             # caller holds _apply_lock: snapshot via the in-close path
             snapshot = self._core.replica_snapshot(in_close=True)
-            while not self._degraded:
+            while not self.degraded:
                 try:
                     self._ship(snapshot)
                     return
                 except Exception:  # noqa: BLE001 — retried, then degraded
                     log.exception("sync replication ship failed; retrying")
-                    self._note_transient_failure()
                     time.sleep(delay)
                     delay = min(delay * 2, 2.0)
             return
@@ -253,30 +310,43 @@ class Replicator:
             self._cv.notify_all()
 
     def flush(self, timeout: float = 30.0) -> bool:
-        """Ship the current state if the backup is behind; True when the
-        backup holds the primary's current version on return."""
-        if self._degraded:
+        """Ship the current state to every live backup that is behind;
+        True when they all hold the primary's current version on
+        return."""
+        if self.degraded:
             return False
         try:
             self._ship(self._core.replica_snapshot())
         except Exception:  # noqa: BLE001 — reported via return value
             log.exception("replication flush failed")
-            self._note_transient_failure()
             return False
-        return not self._degraded
+        return not self.degraded
 
     # ------------------------------------------------------------- internals
-    def _note_transient_failure(self) -> None:
-        self._transient_failures += 1
+    def _note_peer_failure(self, peer: _Peer) -> None:
+        peer.transient_failures += 1
         self._obs_fallback.add()
-        if self._transient_failures >= _MAX_TRANSIENT_FAILURES:
+        if peer.transient_failures >= _MAX_TRANSIENT_FAILURES:
             log.warning(
                 "replication to %s degraded permanently after %d "
-                "consecutive failures — training continues UNREPLICATED",
-                self.backup_address, self._transient_failures)
-            flight.record("repl.degrade", a=self._transient_failures,
+                "consecutive failures%s",
+                peer.address, peer.transient_failures,
+                "" if any(not p.degraded and p is not peer
+                          for p in self._peers.values())
+                else " — training continues UNREPLICATED")
+            flight.record("repl.degrade", a=peer.transient_failures,
                           note="transport failures")
-            self._degraded = True
+            peer.degraded = True
+
+    def _degrade_peer(self, peer: _Peer, note: str) -> None:
+        flight.record("repl.degrade", note=note)
+        self._obs_fallback.add()
+        peer.degraded = True
+
+    def _behind(self) -> bool:
+        version = self._core.params_version
+        return any(not p.degraded and p.shipped_version < version
+                   for p in self._peers.values())
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -284,68 +354,93 @@ class Replicator:
                 if not self._pending:
                     self._cv.wait(self._poll_s)
                 self._pending = False
-            if self._stop.is_set() or self._degraded:
-                if self._degraded:
+            if self._stop.is_set() or self.degraded:
+                if self.degraded:
                     return
                 continue
-            if self._core.params_version == self._last_shipped_version:
+            if not self._behind():
                 continue
             try:
                 self._ship(self._core.replica_snapshot())
             except Exception:  # noqa: BLE001 — retried next wake
                 log.exception("replication ship failed; will retry")
-                self._note_transient_failure()
 
     def _ship(self, snapshot) -> None:
+        """One coalesced ship round: encode once, push to every live
+        backup whose watermark is behind ``snapshot``'s version.  Peer
+        failures are contained per peer (transient counting, permanent
+        downgrade on UNIMPLEMENTED/refusal); raises
+        :class:`_ShipIncomplete` when a live backup is still behind on
+        return so callers retry on their own cadence."""
         epoch, iteration, version, params, opt_state = snapshot
         with self._ship_lock:
-            if version <= self._last_shipped_version or self._degraded:
-                return  # coalesced: a newer ship already covered this
+            todo = [p for p in self._peers.values()
+                    if not p.degraded and version > p.shipped_version]
+            if not todo:
+                return  # coalesced: newer ships already covered this
             store = dict(params)
             if self._include_optimizer and opt_state:
                 store.update(flatten_optimizer_state(opt_state))
             nbytes = store_nbytes(store)
-            self._obs_lag.set(nbytes)
+            self._obs_lag.set(nbytes * len(todo))
             t0 = time.perf_counter()
             flight.record("repl.ship.start", iteration=iteration,
                           a=nbytes, b=version)
-            try:
-                ack = self._client.call(
-                    "PushReplicaDelta",
-                    delta_chunks(epoch, iteration, version,
-                                 rmsg.DELTA_STATE, store,
-                                 stripes=getattr(self._core, "stripes", 1)),
-                    timeout=self._timeout_s)
-            except grpc.RpcError as exc:
-                code = getattr(exc, "code", None)
-                if callable(code) and code() == grpc.StatusCode.UNIMPLEMENTED:
-                    # reference PS as backup: no replication, ever
-                    log.warning("backup %s does not implement replication; "
-                                "degrading permanently", self.backup_address)
-                    flight.record("repl.degrade", note="UNIMPLEMENTED")
-                    self._obs_fallback.add()
-                    self._degraded = True
-                    return
-                raise
-            if not ack.success:
-                # the sink refused (e.g. the replica was promoted and has
-                # advanced past us — we are the zombie): stop shipping
-                log.warning("backup %s refused delta: %s — degrading "
-                            "permanently", self.backup_address, ack.message)
-                flight.record("repl.ack", iteration=iteration, a=0,
-                              b=version, note=ack.message)
-                flight.record("repl.degrade", note="sink refused")
-                self._obs_fallback.add()
-                self._degraded = True
-                return
+            stripes = getattr(self._core, "stripes", 1)
+            incomplete = False
+            for peer in todo:
+                try:
+                    ack = peer.client.call(
+                        "PushReplicaDelta",
+                        delta_chunks(epoch, iteration, version,
+                                     rmsg.DELTA_STATE, store,
+                                     stripes=stripes),
+                        timeout=self._timeout_s)
+                except grpc.RpcError as exc:
+                    code = getattr(exc, "code", None)
+                    if (callable(code)
+                            and code() == grpc.StatusCode.UNIMPLEMENTED):
+                        # reference PS as backup: no replication, ever
+                        log.warning(
+                            "backup %s does not implement replication; "
+                            "degrading permanently", peer.address)
+                        self._degrade_peer(peer, "UNIMPLEMENTED")
+                        continue
+                    log.exception("replication ship to %s failed",
+                                  peer.address)
+                    self._note_peer_failure(peer)
+                    incomplete = True
+                    continue
+                except Exception:  # noqa: BLE001 — contained per peer
+                    log.exception("replication ship to %s failed",
+                                  peer.address)
+                    self._note_peer_failure(peer)
+                    incomplete = True
+                    continue
+                if not ack.success:
+                    # the sink refused (e.g. the replica was promoted and
+                    # has advanced past us — we are the zombie): stop
+                    # shipping to it
+                    log.warning("backup %s refused delta: %s — degrading "
+                                "permanently", peer.address, ack.message)
+                    flight.record("repl.ack", iteration=iteration, a=0,
+                                  b=version, note=ack.message)
+                    self._degrade_peer(peer, "sink refused")
+                    continue
+                flight.record("repl.ack", iteration=iteration, a=1,
+                              b=version)
+                self._obs_shipped.add(nbytes)
+                peer.shipped_version = version
+                peer.transient_failures = 0
             self._obs_ship_s.observe(time.perf_counter() - t0)
-            flight.record("repl.ack", iteration=iteration, a=1, b=version)
             flight.record("repl.ship.end", iteration=iteration,
                           a=int(1e6 * (time.perf_counter() - t0)), b=version)
-            self._obs_shipped.add(nbytes)
-            self._obs_lag.set(0)
-            self._last_shipped_version = version
-            self._transient_failures = 0
+            if not incomplete:
+                self._obs_lag.set(0)
+            if incomplete and not self.degraded:
+                raise _ShipIncomplete(
+                    f"replication ship v{version} left a live backup "
+                    f"behind")
 
 
 class ReplicaSink:
@@ -363,6 +458,11 @@ class ReplicaSink:
         self.primary_iteration = -1
         self._installed_any = False
         self._obs_installed = obs_stats.counter("ps.replica.installed_bytes")
+        # 1 while this backup replicates by flat SHIPPING — its
+        # accelerator idle through every close; the sharded-update sink
+        # (replication/sharded_update.py) zeroes it when the backup
+        # starts computing close slices
+        self._obs_idle = obs_stats.gauge("ps.replica.idle_accelerator")
 
     def push_delta(self, chunks) -> rmsg.ReplicaAck:
         header = None
@@ -416,6 +516,7 @@ class ReplicaSink:
                 self.primary_version = version
                 self.primary_iteration = iteration
                 self._installed_any = True
+                self._obs_idle.set(1)
         self._obs_installed.add(store_nbytes(params))
         return rmsg.ReplicaAck(success=True, message="installed",
                                params_version=version, iteration=iteration)

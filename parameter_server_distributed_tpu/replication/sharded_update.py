@@ -1,0 +1,910 @@
+"""Cross-replica sharded arena close (ISSUE 18): partition the close,
+all-gather the fresh slabs.
+
+PR 17's free-running pipeline left the barrier close itself replicated
+by SHIPPING: the primary runs every fused arena stage (core/arena.py)
+over the whole store, then sync replication pushes the full post-apply
+state — params plus every optimizer slot — to each backup.  With R
+replicas the primary both COMPUTES R times the work it needs to and
+SENDS O(R * state) bytes per close while R-1 accelerators sit idle.
+
+``PSDT_SHARDED_UPDATE=1`` turns the replica set into a compute surface
+instead (the reducer-sharding shape of arXiv:2004.13336, run over the
+replication RPC channel rather than a collective fabric): the primary
+and every in-sync backup agree on a deterministic slice assignment over
+the PackingTable stripe slabs — replica ``r`` of ``R`` owns
+``[size*r//R, size*(r+1)//R)`` of every stripe, epoch-fenced by the
+table's ``plan_epoch`` — the primary streams each peer the fold SUMS
+for its owned slices (``ShardedApplySlices``), every replica runs the
+fused per-stage arena kernels ONLY over its own slices
+(device_optimizer.apply_arena_range — elementwise stages, so a
+slice-of-apply is bit-identical to the apply-of-slice), and the fresh
+param/slot slices all-gather back: peers answer with their slices, the
+primary assembles the full slabs and broadcasts each peer the slices it
+does NOT own (``InstallSlabSlices``).  Per close the wire then carries
+sums out plus params/slots back — ~(2..3)/R of the state per peer —
+instead of the full optimizer state per peer, and every accelerator
+computes ~1/R of the close.
+
+Exchange dtype (``PSDT_SHARDED_UPDATE_DTYPE``): ``raw`` (default)
+moves exact f32 bits everywhere — the sharded close is then
+BIT-IDENTICAL to the single-node arena close.  ``bf16``/``int8``
+quantize the sums and param legs through the PR-6 codec (EQuARX-style:
+each replica's OWN slices stay full precision end to end), with PR-9
+error feedback accumulating the sums-leg quantization residual per
+(peer, slice) so the lossy leg's error stays bounded instead of
+compounding; optimizer slot slices always ride raw (they never
+re-enter a lossy path and their bits ARE the next close's state).
+
+Downgrade matrix (the close NEVER fails for sharding reasons):
+
+- one replica / no in-sync peer / replication degraded -> local full
+  apply (``ps.apply.sharded_fallback`` + ``shard.update.degrade``);
+- any peer failure or refusal mid-exchange (death, zombie refusal,
+  version skew) -> the WHOLE sharded close aborts and the local full
+  apply runs against the untouched sums and slot slabs — the range
+  apply is pure (slot commits are deferred to the point of no return),
+  so the retry is bit-exact;
+- an install-leg failure for one peer commits everywhere else: that
+  peer just misses ``note_shipped`` and heals through the ordinary
+  flat state ship;
+- UNIMPLEMENTED (an older peer) downgrades that address permanently.
+
+Both ends must run the same ``PSDT_ARENA_ALIGN`` (the packing table is
+rebuilt independently per replica from the signature — alignment skew
+would shear the slice offsets; the per-slice length checks catch the
+gross cases loudly).
+
+Backup caveat: a sharded close advances a backup's params and its OWN
+slot slices; slot ranges owned by OTHER replicas go stale on it by
+design (they are re-sharded fresh every close).  A promoted backup
+therefore runs its first local closes from exact params but
+possibly-stale foreign slot ranges — the same staleness window a
+mid-flight async ship already leaves, healed by the next flat ship.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+import grpc
+import numpy as np
+
+from ..analysis.lock_order import checked_lock
+from ..core import arena as arena_mod
+from ..obs import flight
+from ..obs import stats as obs_stats
+from ..rpc import messages as m
+from ..rpc.data_plane import stream_chunk_bytes
+from ..rpc.service import RpcClient
+from ..tiers.ef import ErrorFeedback
+from . import messages as rmsg
+
+log = logging.getLogger("pst.sharded_update")
+
+ENV_SHARDED = "PSDT_SHARDED_UPDATE"
+ENV_DTYPE = "PSDT_SHARDED_UPDATE_DTYPE"
+
+EXCHANGE_DTYPES = {"raw": m.WIRE_RAW_F32, "bf16": m.WIRE_BF16,
+                   "int8": m.WIRE_INT8}
+_WIRE_BYTES = {m.WIRE_RAW_F32: 4.0, m.WIRE_BF16: 2.0, m.WIRE_INT8: 1.0}
+
+
+def enabled() -> bool:
+    """Process-level opt-in; default off (every replication path is
+    byte-identical with the flag unset)."""
+    return os.environ.get(ENV_SHARDED, "") not in ("", "0")
+
+
+def exchange_wire_dtype(name: str | None = None) -> int:
+    """The exchange encoding for the sums and param legs (slots are
+    always raw f32)."""
+    key = (name if name is not None
+           else os.environ.get(ENV_DTYPE, "raw") or "raw").lower()
+    if key not in EXCHANGE_DTYPES:
+        raise ValueError(
+            f"unknown sharded-update dtype {key!r}; options: "
+            f"{sorted(EXCHANGE_DTYPES)}")
+    return EXCHANGE_DTYPES[key]
+
+
+def slice_ranges(size: int, replicas: int) -> list[tuple[int, int]]:
+    """Replica ``r``'s owned ``[lo, hi)`` of one stripe slab: contiguous
+    near-equal ranges, deterministic on both ends (index 0 is the
+    primary).  ``size*r//R`` keeps every element owned exactly once for
+    any (size, R), including R > size (empty ranges)."""
+    return [(size * r // replicas, size * (r + 1) // replicas)
+            for r in range(replicas)]
+
+
+def sharded_client(address: str) -> RpcClient:
+    """A PS-peer client with the replication AND sharded-update
+    extension methods bound alongside the reference table."""
+    return RpcClient(address, m.PARAMETER_SERVER_SERVICE,
+                     {**m.PARAMETER_SERVER_METHODS,
+                      **rmsg.REPLICATION_PS_METHODS,
+                      **rmsg.SHARDED_UPDATE_PS_METHODS})
+
+
+# --------------------------------------------------------------- segments
+def _segment_elems() -> int:
+    """Elements per wire segment: the data-plane stream chunk budget in
+    f32 elements (a slice larger than the budget rides as ordered
+    ``index`` segments of one logical slice)."""
+    budget = stream_chunk_bytes() or (32 << 20)
+    return max(1, budget // 4)
+
+
+def _slice_segments(arr: np.ndarray):
+    """(index, segment) pairs for one flat f32 slice."""
+    seg = _segment_elems()
+    for i, lo in enumerate(range(0, len(arr), seg)):
+        yield i, arr[lo:lo + seg]
+
+
+class _DecodedConcat:
+    """``tensor``-shaped shim for ErrorFeedback.stage: ``to_array``
+    materializes the value the RECEIVER decodes — the concatenation of
+    the slice's per-segment wire decodes — so the staged residual is
+    exactly (sent - received)."""
+
+    __slots__ = ("_tensors",)
+
+    def __init__(self, tensors: list):
+        self._tensors = tensors
+
+    def to_array(self) -> np.ndarray:
+        if len(self._tensors) == 1:
+            return self._tensors[0].to_array()
+        return np.concatenate([t.to_array() for t in self._tensors])
+
+
+def _assemble_parts(parts: dict) -> np.ndarray:
+    """Ordered segment decode + concat for one received slice."""
+    tensors = [parts[i] for i in sorted(parts)]
+    if len(tensors) == 1:
+        return np.asarray(tensors[0].to_array(), np.float32).reshape(-1)
+    return np.concatenate([
+        np.asarray(t.to_array(), np.float32).reshape(-1)
+        for t in tensors])
+
+
+def _full_cover(ranges, size: int) -> bool:
+    """True when sorted ``[lo, hi)`` ranges tile ``[0, size)``."""
+    spans = sorted(r for r in ranges if r[1] > r[0])
+    if not spans:
+        return size == 0
+    if spans[0][0] != 0 or spans[-1][1] != size:
+        return False
+    return all(spans[i][1] == spans[i + 1][0]
+               for i in range(len(spans) - 1))
+
+
+class _PeerRefused(RuntimeError):
+    """The peer answered an in-band refusal (``error`` chunk)."""
+
+
+# ==========================================================================
+# primary side
+# ==========================================================================
+
+class ShardedUpdater:
+    """Primary-side driver, installed via ``core.set_sharded_updater``.
+    ``try_close`` runs from the barrier closer under ``_apply_lock`` —
+    blocking RPC is legal there (the sync-replication precedent) and
+    applies stay serialized.  It NEVER raises and returns None to
+    decline, leaving the sums and slot slabs untouched so the caller's
+    local full apply is bit-identical to an unsharded close."""
+
+    def __init__(self, core, replicator, *, dtype: str | None = None,
+                 timeout_s: float = 60.0):
+        self._core = core
+        self._replicator = replicator
+        self._wire_dtype = exchange_wire_dtype(dtype)
+        self._timeout_s = float(timeout_s)
+        # rank 47 (analysis/lock_order.py, BLOCKING_ALLOWED): fences the
+        # lazily-built per-address clients and the downgrade set against
+        # stop(); the exchange itself runs on the closer thread plus
+        # short-lived per-peer threads that touch only local state
+        self._lock = checked_lock("ShardedUpdater._lock")
+        self._clients: dict[str, RpcClient] = {}
+        self._downgraded: set[str] = set()
+        # PR-9 error feedback, sums leg only (the one lossy leg that
+        # enters the training dynamics): one instance per peer address,
+        # keys "stripe:lo:hi" — residuals for ranges orphaned by a
+        # replica-count change linger unread, bounded by the range
+        # vocabulary
+        self._ef: dict[str, ErrorFeedback] = {}
+        self._stopped = False
+        self._obs_sharded = obs_stats.counter("ps.apply.sharded")
+        self._obs_fallback = obs_stats.counter("ps.apply.sharded_fallback")
+        self._obs_bytes = obs_stats.counter("ps.replica.sharded_bytes")
+
+    # ------------------------------------------------------------ lifecycle
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            clients, self._clients = dict(self._clients), {}
+        for client in clients.values():
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                log.exception("sharded-update client close failed")
+
+    def _client(self, address: str) -> RpcClient | None:
+        with self._lock:
+            if self._stopped:
+                return None
+            client = self._clients.get(address)
+            if client is None:
+                client = self._clients[address] = sharded_client(address)
+            return client
+
+    def _decline(self, reason: str, iteration: int) -> None:
+        self._obs_fallback.add()
+        flight.record("shard.update.degrade", iteration=iteration,
+                      note=reason[:48])
+
+    # ------------------------------------------------------------- close
+    def try_close(self, prev, table, param_slabs, sums, iteration: int):
+        """Attempt one sharded close; ``(new_slabs, host_slabs)`` on
+        success, None to decline.  Caller holds ``_apply_lock``; sums
+        are already contributor-means; ``opt.tick()`` has run."""
+        try:
+            return self._try_close(prev, table, param_slabs, sums,
+                                   iteration)
+        except Exception as exc:  # noqa: BLE001 — the close must never
+            # fail for sharding reasons; the local apply is always right
+            log.exception("sharded close aborted; applying locally")
+            self._decline(f"{type(exc).__name__}: {exc}", iteration)
+            return None
+
+    def _try_close(self, prev, table, param_slabs, sums, iteration: int):
+        import jax.numpy as jnp
+
+        core = self._core
+        opt = core._optimizer
+        if not hasattr(opt, "apply_arena_range"):
+            self._decline("optimizer lacks range apply", iteration)
+            return None
+        repl = self._replicator
+        base_version = core.params_version
+        peers = [a for a in repl.live_addresses()
+                 if a not in self._downgraded
+                 and repl.shipped_version(a) == base_version]
+        if not peers:
+            self._decline("no in-sync peer", iteration)
+            return None
+        stripes = sorted(param_slabs)
+        if any(s not in sums.slabs for s in stripes):
+            self._decline("sums missing a stripe", iteration)
+            return None
+        R = 1 + len(peers)
+        plan = {s: slice_ranges(int(table.stripe_sizes[s]), R)
+                for s in stripes}
+        opt.ensure_arena_slots(table)
+        new_version = base_version + 1
+        epoch = core.epoch
+        step = int(getattr(opt, "step", 0))
+        t0 = time.perf_counter()
+
+        # ---- peer exchange threads: stream sums out, gather slices back
+        results: dict[str, dict] = {}
+        errors: dict[str, BaseException] = {}
+
+        def exchange(address: str, rindex: int) -> None:
+            try:
+                results[address] = self._exchange_with_peer(
+                    address, rindex, table, plan, sums, iteration,
+                    base_version, new_version, epoch, step, R)
+            except BaseException as exc:  # noqa: BLE001 — joined below
+                errors[address] = exc
+
+        threads = [threading.Thread(
+            target=exchange, args=(address, r), daemon=True,
+            name=f"ps-shard-xchg-{r}")
+            for r, address in enumerate(peers, start=1)]
+        for t in threads:
+            t.start()
+
+        # ---- own slices on the closer thread, overlapping the RPCs.
+        # apply_arena_range is PURE (slices in, slices out; slot slabs
+        # untouched), so an abort below leaves the local-apply world
+        # unmodified.
+        own_params: dict[int, object] = {}
+        own_slots: dict[int, dict] = {}
+        for s in stripes:
+            lo, hi = plan[s][0]
+            if lo == hi:
+                continue
+            new_p, slots = opt.apply_arena_range(
+                table, s, param_slabs[s][lo:hi], sums.slabs[s][lo:hi],
+                lo, hi)
+            own_params[s] = new_p
+            own_slots[s] = slots
+        for t in threads:
+            t.join(timeout=self._timeout_s + 5.0)
+        alive = [t for t in threads if t.is_alive()]
+        if alive or errors or len(results) != len(peers):
+            for address, exc in errors.items():
+                self._note_peer_error(address, exc)
+            if alive:
+                self._decline("exchange timeout", iteration)
+            elif errors:
+                self._decline("peer exchange failed", iteration)
+            else:
+                self._decline("exchange incomplete", iteration)
+            return None
+
+        # ---- point of no return: assemble full slabs, commit slots
+        host_slabs: dict[int, np.ndarray] = {}
+        new_slabs: dict[int, object] = {}
+        slot_kinds = tuple(opt.arena_slot_kinds())
+        for s in stripes:
+            size = int(table.stripe_sizes[s])
+            host = np.empty(size, np.float32)
+            lo, hi = plan[s][0]
+            if lo < hi:
+                host[lo:hi] = np.asarray(own_params[s])
+            for r, address in enumerate(peers, start=1):
+                lo, hi = plan[s][r]
+                if lo < hi:
+                    host[lo:hi] = results[address]["params"][(s, lo, hi)]
+            pieces: dict[str, list] = {k: [] for k in slot_kinds}
+            for kind, arr in own_slots.get(s, {}).items():
+                plo, phi = plan[s][0]
+                pieces[kind].append((plo, phi, arr))
+            for r, address in enumerate(peers, start=1):
+                lo, hi = plan[s][r]
+                for kind, arr in results[address]["slots"].get(
+                        (s, lo, hi), {}).items():
+                    pieces[kind].append((lo, hi, arr))
+            opt.commit_arena_ranges(
+                table, s, {k: v for k, v in pieces.items() if v})
+            host_slabs[s] = host
+            new_slabs[s] = jnp.asarray(host)
+
+        # ---- install leg: each peer gets every slice it does NOT own;
+        # a failure here is per-peer (the close is already committed) —
+        # the peer misses note_shipped and heals via the flat ship
+        shipped = []
+        for r, address in enumerate(peers, start=1):
+            if self._install_to_peer(address, r, table, plan, host_slabs,
+                                     stripes, iteration, base_version,
+                                     new_version, epoch, step, R):
+                shipped.append(address)
+            else:
+                self._obs_fallback.add()
+                flight.record("shard.update.degrade", iteration=iteration,
+                              note="install leg failed")
+        repl.note_shipped(new_version, shipped)
+        for address in shipped:
+            ef = self._ef.get(address)
+            if ef is not None:
+                ef.commit()
+
+        wire_bytes = self._exchange_bytes(table, plan, stripes,
+                                          slot_kinds, peers, shipped)
+        self._obs_sharded.add()
+        self._obs_bytes.add(wire_bytes)
+        flight.record("apply.sharded", iteration=iteration, a=R,
+                      b=wire_bytes,
+                      note=f"{int(1e6 * (time.perf_counter() - t0))}us")
+        return new_slabs, host_slabs
+
+    # --------------------------------------------------------- peer legs
+    def _exchange_with_peer(self, address: str, rindex: int, table, plan,
+                            sums, iteration: int, base_version: int,
+                            new_version: int, epoch: int, step: int,
+                            replicas: int) -> dict:
+        client = self._client(address)
+        if client is None:
+            raise RuntimeError("updater stopped")
+        lossy = self._wire_dtype != m.WIRE_RAW_F32
+        ef = None
+        if lossy:
+            ef = self._ef.get(address)
+            if ef is None:
+                ef = self._ef[address] = ErrorFeedback()
+            ef.begin()
+
+        def header(**kw):
+            return rmsg.ShardedSliceChunk(
+                plan_epoch=table.epoch, epoch=epoch, iteration=iteration,
+                base_version=base_version, new_version=new_version,
+                step=step, replicas=replicas, stripes=table.stripes, **kw)
+
+        def request_chunks():
+            for s in sorted(plan):
+                lo, hi = plan[s][rindex]
+                if lo == hi:
+                    continue
+                sums_host = np.asarray(sums.slabs[s][lo:hi])
+                if lossy and ef.on:
+                    key = f"{s}:{lo}:{hi}"
+                    adjusted = ef.adjust(key, sums_host)
+                    tensors = [
+                        m.Tensor.from_array(f"{key}#{i}", seg,
+                                            wire_dtype=self._wire_dtype)
+                        for i, seg in _slice_segments(adjusted)]
+                    ef.stage(key, adjusted, _DecodedConcat(tensors))
+                    segments = list(enumerate(tensors))
+                else:
+                    segments = [
+                        (i, m.Tensor.from_array(f"{s}:{lo}:{hi}#{i}", seg,
+                                                wire_dtype=self._wire_dtype))
+                        for i, seg in _slice_segments(sums_host)]
+                for i, tensor in segments:
+                    yield header(kind=rmsg.SLICE_SUMS, stripe=s, lo=lo,
+                                 hi=hi, index=i, payload=tensor)
+            # trailer: marks end of the sums leg (and covers the
+            # degenerate no-owned-range assignment)
+            yield header(kind=rmsg.SLICE_SUMS, last=True)
+
+        try:
+            responses = client.call("ShardedApplySlices", request_chunks(),
+                                    timeout=self._timeout_s)
+            params: dict[tuple, dict] = {}
+            slots: dict[tuple, dict] = {}
+            for resp in responses:
+                if resp.error:
+                    raise _PeerRefused(f"{address}: {resp.error}")
+                key = (int(resp.stripe), int(resp.lo), int(resp.hi))
+                if resp.payload is not None and resp.hi > resp.lo:
+                    if resp.kind == rmsg.SLICE_PARAMS:
+                        params.setdefault(key, {})[int(resp.index)] = \
+                            resp.payload
+                    elif resp.kind == rmsg.SLICE_SLOT:
+                        slots.setdefault(key, {}).setdefault(
+                            str(resp.slot), {})[int(resp.index)] = \
+                            resp.payload
+                if resp.last:
+                    break
+        except grpc.RpcError as exc:
+            code = getattr(exc, "code", None)
+            if callable(code) and code() == grpc.StatusCode.UNIMPLEMENTED:
+                raise _PeerRefused("UNIMPLEMENTED") from exc
+            raise
+        out_params = {}
+        for key, parts in params.items():
+            arr = _assemble_parts(parts)
+            if len(arr) != key[2] - key[1]:
+                raise _PeerRefused(
+                    f"{address}: param slice {key} length {len(arr)}")
+            out_params[key] = arr
+        out_slots: dict[tuple, dict] = {}
+        for key, by_kind in slots.items():
+            out_slots[key] = {}
+            for kind, parts in by_kind.items():
+                arr = _assemble_parts(parts)
+                if len(arr) != key[2] - key[1]:
+                    raise _PeerRefused(
+                        f"{address}: slot slice {key}/{kind} length "
+                        f"{len(arr)}")
+                out_slots[key][kind] = arr
+        # every owned non-empty range must have come back
+        for s in sorted(plan):
+            lo, hi = plan[s][rindex]
+            if lo < hi and (s, lo, hi) not in out_params:
+                raise _PeerRefused(
+                    f"{address}: missing param slice ({s}, {lo}, {hi})")
+        return {"params": out_params, "slots": out_slots}
+
+    def _install_to_peer(self, address: str, rindex: int, table, plan,
+                         host_slabs, stripes, iteration: int,
+                         base_version: int, new_version: int, epoch: int,
+                         step: int, replicas: int) -> bool:
+        client = self._client(address)
+        if client is None:
+            return False
+
+        def header(**kw):
+            return rmsg.ShardedSliceChunk(
+                plan_epoch=table.epoch, epoch=epoch, iteration=iteration,
+                base_version=base_version, new_version=new_version,
+                step=step, replicas=replicas, stripes=table.stripes, **kw)
+
+        def install_chunks():
+            for s in stripes:
+                for r in range(replicas):
+                    if r == rindex:
+                        continue  # the peer's own slices: already exact
+                    lo, hi = plan[s][r]
+                    if lo == hi:
+                        continue
+                    # param leg: quantized without error feedback — the
+                    # slices never re-enter an update (each replica
+                    # applies only its own full-precision ranges)
+                    for i, seg in _slice_segments(host_slabs[s][lo:hi]):
+                        yield header(kind=rmsg.SLICE_PARAMS, stripe=s,
+                                     lo=lo, hi=hi, index=i,
+                                     payload=m.Tensor.from_array(
+                                         f"{s}:{lo}:{hi}#{i}", seg,
+                                         wire_dtype=self._wire_dtype))
+            yield header(kind=rmsg.SLICE_PARAMS, last=True)
+
+        try:
+            ack = client.call("InstallSlabSlices", install_chunks(),
+                              timeout=self._timeout_s)
+        except grpc.RpcError as exc:
+            code = getattr(exc, "code", None)
+            if callable(code) and code() == grpc.StatusCode.UNIMPLEMENTED:
+                self._note_peer_error(address, _PeerRefused("UNIMPLEMENTED"))
+                return False
+            log.exception("sharded install to %s failed", address)
+            return False
+        except Exception:  # noqa: BLE001 — per-peer containment
+            log.exception("sharded install to %s failed", address)
+            return False
+        if not ack.success:
+            log.warning("backup %s refused sharded install: %s", address,
+                        ack.message)
+            return False
+        return True
+
+    def _note_peer_error(self, address: str, exc: BaseException) -> None:
+        if isinstance(exc, _PeerRefused) and "UNIMPLEMENTED" in str(exc):
+            log.warning("peer %s does not implement the sharded update; "
+                        "downgrading that address permanently", address)
+            with self._lock:
+                self._downgraded.add(address)
+        else:
+            log.warning("sharded exchange with %s failed: %s", address, exc)
+
+    def _exchange_bytes(self, table, plan, stripes, slot_kinds, peers,
+                        shipped) -> int:
+        """Approximate exchange payload bytes for the rollup counter
+        (true wire bytes live in the rpc.client.* counters): sums out +
+        params back at the exchange dtype, slots back raw, install legs
+        at the exchange dtype."""
+        per = _WIRE_BYTES[self._wire_dtype]
+        total = 0.0
+        R = 1 + len(peers)
+        for s in stripes:
+            for r in range(1, R):
+                lo, hi = plan[s][r]
+                n = hi - lo
+                total += n * per            # sums out
+                total += n * per            # params back
+                total += n * 4.0 * len(slot_kinds)  # slots back, raw
+        for address in shipped:
+            for s in stripes:
+                size = int(table.stripe_sizes[s])
+                r = peers.index(address) + 1
+                lo, hi = plan[s][r]
+                total += (size - (hi - lo)) * per   # install leg
+        return int(total)
+
+
+# ==========================================================================
+# backup side
+# ==========================================================================
+
+class ShardedUpdateSink:
+    """Backup-side handlers for the two sharded-update RPCs, bound on
+    the PS service next to :class:`replication.replicator.ReplicaSink`
+    (whose high-water bookkeeping this sink advances — rank 15 so the
+    sink lock may take the replica sink's rank-16 lock inside)."""
+
+    def __init__(self, core, replica_sink):
+        self._core = core
+        self._replica_sink = replica_sink
+        # rank 15, BLOCKING_ALLOWED: held across the range applies
+        # (device dispatch) and the install (core locks 20.. nest
+        # inside); serializes sharded closes against each other
+        self._lock = checked_lock("ShardedUpdateSink._lock")
+        self._table = None
+        # FULL host param slabs at `_slabs_version` (the primary's
+        # version this replica provably holds) — rebuilt from the live
+        # store after any flat install, advanced in place by each
+        # sharded install
+        self._host_slabs: dict[int, np.ndarray] | None = None
+        self._slabs_version = -2
+        self._pending: dict | None = None
+        # satellite: 1 while this backup replicates by flat SHIPPING
+        # (its accelerator idle through every close), 0 once it computes
+        # sharded close slices
+        self._obs_idle = obs_stats.gauge("ps.replica.idle_accelerator")
+        self._obs_applies = obs_stats.counter("ps.replica.sharded_applies")
+
+    # ------------------------------------------------------------- helpers
+    def _refuse(self, reason: str):
+        flight.record("shard.update.degrade", note=reason[:48])
+        return rmsg.ShardedSliceChunk(error=reason, last=True)
+
+    def _ensure_table(self, params, stripes: int, plan_epoch: int):
+        """The slice-assignment table, built locally from the replica's
+        own (bit-identical) store — deterministic given the signature,
+        the stripe count, and PSDT_ARENA_ALIGN, which both ends must
+        share."""
+        table = self._table
+        sig = arena_mod.store_signature(params)
+        if (table is None or table.stripes != stripes
+                or table.epoch != plan_epoch or table.signature != sig):
+            table = arena_mod.PackingTable(params, stripes, plan_epoch)
+            self._table = table
+        return table
+
+    def _ensure_base_slabs(self, params, table, base_version: int) -> bool:
+        """Host param slabs for the base store; False when they cannot
+        be built (empty store)."""
+        if self._slabs_version == base_version \
+                and self._host_slabs is not None:
+            return True
+        if (isinstance(params, arena_mod.ArenaStore)
+                and params.layout.stripes == table.stripes
+                and params.layout.signature == table.signature):
+            # a previous sharded install published an ArenaStore whose
+            # slabs ARE the full host slabs under the same layout
+            self._host_slabs = {s: np.asarray(h, np.float32)
+                                for s, h in params.slabs.items()}
+            self._slabs_version = base_version
+            return True
+        slabs: dict[int, np.ndarray] = {}
+        for stripe in range(table.stripes):
+            size = int(table.stripe_sizes[stripe])
+            if not size:
+                continue
+            host = np.zeros(size, np.float32)
+            for name in table.stripe_names[stripe]:
+                e = table.entries[name]
+                host[e.offset:e.offset + e.length] = np.asarray(
+                    np.asarray(params[name]), np.float32).reshape(-1)
+            slabs[stripe] = host
+        if not slabs:
+            return False
+        self._host_slabs = slabs
+        self._slabs_version = base_version
+        return True
+
+    def _store_params(self):
+        with self._core._params_lock:
+            return self._core._params
+
+    # ---------------------------------------------------------- apply leg
+    def apply_slices(self, chunks, context=None):
+        """``ShardedApplySlices`` handler (stream_stream): consume the
+        sums leg, run the fused range applies over the owned slices,
+        stream the fresh param/slot slices back, and hold the results
+        pending the install leg."""
+        header = None
+        parts: dict[tuple, dict] = {}
+        for c in chunks:
+            if header is None:
+                header = c
+            if (c.kind == rmsg.SLICE_SUMS and c.payload is not None
+                    and int(c.hi) > int(c.lo)):
+                parts.setdefault(
+                    (int(c.stripe), int(c.lo), int(c.hi)),
+                    {})[int(c.index)] = c.payload
+        if header is None:
+            yield self._refuse("empty sharded stream")
+            return
+        core = self._core
+        opt = core._optimizer
+        if not hasattr(opt, "apply_arena_range"):
+            yield self._refuse("optimizer lacks range apply")
+            return
+        base_version = int(header.base_version)
+        iteration = int(header.iteration)
+        with self._lock:
+            rs = self._replica_sink
+            with rs._lock:
+                primary_version = rs.primary_version
+                primary_iteration = rs.primary_iteration
+                installed_any = rs._installed_any
+            if installed_any and core.current_iteration > primary_iteration:
+                # promoted: local aggregation moved past the replication
+                # mark — the sender is a zombie ex-primary
+                yield self._refuse("replica promoted; sharded apply "
+                                   "refused")
+                return
+            if primary_version != base_version:
+                yield self._refuse(
+                    f"base version skew: hold v{primary_version}, "
+                    f"primary closes from v{base_version}")
+                return
+            params = self._store_params()
+            if not params:
+                yield self._refuse("replica store empty")
+                return
+            try:
+                table = self._ensure_table(params, int(header.stripes),
+                                           int(header.plan_epoch))
+            except Exception as exc:  # noqa: BLE001 — refuse, not raise
+                yield self._refuse(f"table build failed: {exc}")
+                return
+            if not self._ensure_base_slabs(params, table, base_version):
+                yield self._refuse("no packable base slabs")
+                return
+            for (stripe, lo, hi) in parts:
+                if stripe >= table.stripes \
+                        or hi > int(table.stripe_sizes[stripe]):
+                    yield self._refuse(
+                        f"slice ({stripe}, {lo}, {hi}) outside the "
+                        f"local layout (PSDT_ARENA_ALIGN skew?)")
+                    return
+            wire_dtype = m.WIRE_RAW_F32
+            for seg in parts.values():
+                t = next(iter(seg.values()))
+                wire_dtype = int(getattr(t, "packed_dtype", 0)) \
+                    or m.WIRE_F32
+                break
+            try:
+                responses = self._apply_owned(
+                    opt, table, header, parts, wire_dtype, iteration,
+                    base_version)
+            except Exception as exc:  # noqa: BLE001 — refuse, not raise
+                log.exception("sharded range apply failed")
+                yield self._refuse(f"range apply failed: {exc}")
+                return
+            self._obs_idle.set(0)
+            self._obs_applies.add()
+        for resp in responses:
+            yield resp
+
+    def _apply_owned(self, opt, table, header, parts, wire_dtype: int,
+                     iteration: int, base_version: int) -> list:
+        import jax.numpy as jnp
+
+        opt.ensure_arena_slots(table)
+        # mirror the primary's post-tick logical step (Adam/AdamW bias
+        # corrections must agree bit-for-bit)
+        opt.step = int(header.step)
+        own_params: dict[tuple, np.ndarray] = {}
+        own_slots: dict[tuple, dict] = {}
+        responses: list = []
+
+        def reply(**kw):
+            return rmsg.ShardedSliceChunk(
+                plan_epoch=table.epoch, epoch=int(header.epoch),
+                iteration=iteration, base_version=base_version,
+                new_version=int(header.new_version),
+                stripes=table.stripes, replicas=int(header.replicas),
+                **kw)
+
+        for (stripe, lo, hi) in sorted(parts):
+            g_host = _assemble_parts(parts[(stripe, lo, hi)])
+            if len(g_host) != hi - lo:
+                raise ValueError(
+                    f"sums slice ({stripe}, {lo}, {hi}) decoded to "
+                    f"{len(g_host)} elements")
+            p = jnp.asarray(self._host_slabs[stripe][lo:hi])
+            g = jnp.asarray(g_host)
+            new_p, slots = opt.apply_arena_range(table, stripe, p, g,
+                                                 lo, hi)
+            host_p = np.asarray(new_p, np.float32).reshape(-1)
+            own_params[(stripe, lo, hi)] = host_p
+            own_slots[(stripe, lo, hi)] = {
+                kind: np.asarray(arr, np.float32).reshape(-1)
+                for kind, arr in slots.items()}
+            for i, seg in _slice_segments(host_p):
+                responses.append(reply(
+                    kind=rmsg.SLICE_PARAMS, stripe=stripe, lo=lo, hi=hi,
+                    index=i, payload=m.Tensor.from_array(
+                        f"{stripe}:{lo}:{hi}#{i}", seg,
+                        wire_dtype=wire_dtype)))
+            for kind, host_s in own_slots[(stripe, lo, hi)].items():
+                for i, seg in _slice_segments(host_s):
+                    responses.append(reply(
+                        kind=rmsg.SLICE_SLOT, stripe=stripe, slot=kind,
+                        lo=lo, hi=hi, index=i,
+                        payload=m.Tensor.from_array(
+                            f"{stripe}:{lo}:{hi}/{kind}#{i}", seg,
+                            wire_dtype=m.WIRE_RAW_F32)))
+        responses.append(reply(kind=rmsg.SLICE_PARAMS, last=True))
+        # latest-only pending: a newer exchange supersedes one whose
+        # install leg never arrived (that close healed via flat ship)
+        self._pending = {
+            "iteration": iteration,
+            "new_version": int(header.new_version),
+            "base_version": base_version,
+            "epoch": int(header.epoch),
+            "table": table,
+            "params": own_params,
+            "slots": own_slots,
+        }
+        return responses
+
+    # -------------------------------------------------------- install leg
+    def install_slices(self, chunks, context=None) -> rmsg.ShardedSliceAck:
+        """``InstallSlabSlices`` handler (stream_unary): assemble the
+        full fresh slabs from this replica's own pending slices plus the
+        gathered ones, swap them in as the store's next version, and
+        commit the OWN slot ranges."""
+        header = None
+        parts: dict[tuple, dict] = {}
+        for c in chunks:
+            if header is None:
+                header = c
+            if (c.kind == rmsg.SLICE_PARAMS and c.payload is not None
+                    and int(c.hi) > int(c.lo)):
+                parts.setdefault(
+                    (int(c.stripe), int(c.lo), int(c.hi)),
+                    {})[int(c.index)] = c.payload
+        if header is None:
+            return rmsg.ShardedSliceAck(success=False,
+                                        message="empty install stream")
+        with self._lock:
+            pending = self._pending
+            if (pending is None
+                    or pending["new_version"] != int(header.new_version)
+                    or pending["iteration"] != int(header.iteration)):
+                return rmsg.ShardedSliceAck(
+                    success=False,
+                    message="no matching pending sharded apply")
+            table = pending["table"]
+            received: dict[tuple, np.ndarray] = {}
+            for key, seg in parts.items():
+                arr = _assemble_parts(seg)
+                if len(arr) != key[2] - key[1]:
+                    return rmsg.ShardedSliceAck(
+                        success=False,
+                        message=f"param slice {key} decoded to "
+                                f"{len(arr)} elements")
+                received[key] = arr
+            # coverage: own + received ranges must tile every stripe
+            by_stripe: dict[int, list] = {}
+            for (stripe, lo, hi) in list(pending["params"]) \
+                    + list(received):
+                by_stripe.setdefault(stripe, []).append((lo, hi))
+            new_host: dict[int, np.ndarray] = {}
+            for stripe, base in self._host_slabs.items():
+                size = int(table.stripe_sizes[stripe])
+                if not _full_cover(by_stripe.get(stripe, []), size):
+                    return rmsg.ShardedSliceAck(
+                        success=False,
+                        message=f"stripe {stripe} slice coverage "
+                                f"incomplete")
+                new_host[stripe] = np.empty(size, np.float32)
+            for (stripe, lo, hi), arr in pending["params"].items():
+                new_host[stripe][lo:hi] = arr
+            for (stripe, lo, hi), arr in received.items():
+                new_host[stripe][lo:hi] = arr
+            per_stripe = {s: table.views(s, h)
+                          for s, h in new_host.items()}
+            values = {}
+            for stripe in range(table.stripes):
+                for name in table.stripe_names[stripe]:
+                    values[name] = per_stripe[stripe][name]
+            store = arena_mod.ArenaStore(values, table, new_host)
+            # the replica-sink lock is held ACROSS the core install (the
+            # push_delta discipline — rank 16 before core ranks 20..40):
+            # a concurrent flat ship must never observe the advanced
+            # core.current_iteration before primary_iteration catches
+            # up, or its zombie check misreads the window as a promotion
+            rs = self._replica_sink
+            with rs._lock:
+                version = self._core.install_sharded_close(
+                    store, epoch=pending["epoch"],
+                    iteration=pending["iteration"])
+                # own slot ranges only: foreign slot ranges are
+                # re-sharded fresh every close and go stale here by
+                # design (the promoted-backup caveat in the module
+                # docstring)
+                opt = self._core._optimizer
+                by_stripe_slots: dict[int, dict] = {}
+                for (stripe, lo, hi), by_kind in pending["slots"].items():
+                    for kind, arr in by_kind.items():
+                        by_stripe_slots.setdefault(
+                            stripe, {}).setdefault(
+                            kind, []).append((lo, hi, arr))
+                for stripe, pieces in by_stripe_slots.items():
+                    opt.commit_arena_ranges(table, stripe, pieces)
+                self._host_slabs = new_host
+                self._slabs_version = pending["new_version"]
+                self._pending = None
+                rs.primary_version = pending["new_version"]
+                # monotone: a flat ship may already have recorded the
+                # primary's max-SEEN worker iteration (which runs ahead
+                # of its closes under racing pushers) — regressing the
+                # mark to this close's iteration would misread the gap
+                # as a promotion and zombie-refuse the next exchange
+                rs.primary_iteration = max(rs.primary_iteration,
+                                           pending["iteration"])
+                rs._installed_any = True
+        return rmsg.ShardedSliceAck(success=True, message="installed",
+                                    params_version=version)

@@ -48,8 +48,10 @@ from ..obs import flight
 from ..obs import stats as obs_stats
 from ..obs import trace as obs_trace
 from ..replication import messages as rmsg
+from ..replication import sharded_update as sharded_mod
 from ..replication.replicator import (ReplicaSink, Replicator,
                                       flatten_optimizer_state, state_chunks)
+from ..replication.sharded_update import ShardedUpdater, ShardedUpdateSink
 from ..rpc import messages as m
 from ..rpc import shm_transport
 from ..rpc.data_plane import (PreEncodedParameterUpdate, decode_gradients,
@@ -260,6 +262,12 @@ class ParameterServerService:
         # backup or a reshard target; the extension methods cost nothing
         # until a peer calls them.
         self.replica_sink = ReplicaSink(core)
+        # cross-replica sharded-update sink (replication/
+        # sharded_update.py, ISSUE 18): runs the fused arena stages over
+        # this replica's owned stripe slices when the primary shards a
+        # close across the replica set.  Always present for the same
+        # reason as the replica sink.
+        self.sharded_sink = ShardedUpdateSink(core, self.replica_sink)
 
     def _apply(self, worker_id: int, iteration: int, grads):
         """Decoded-gradients -> core aggregation, timed and traced (the
@@ -851,6 +859,18 @@ class ParameterServerService:
             payload.update(flatten_optimizer_state(moved_opt))
         yield from state_chunks(epoch, iteration, version, payload)
 
+    # RPC: cross-replica sharded close, apply leg (ISSUE 18) — the
+    # primary streams the fold sums for this replica's owned stripe
+    # slices; the fresh param/slot slices stream back
+    def ShardedApplySlices(self, request_iterator, context):
+        yield from self.sharded_sink.apply_slices(request_iterator, context)
+
+    # RPC: cross-replica sharded close, install leg — the slices this
+    # replica does NOT own arrive and the assembled store commits
+    def InstallSlabSlices(self, request_iterator,
+                          context) -> rmsg.ShardedSliceAck:
+        return self.sharded_sink.install_slices(request_iterator, context)
+
     # RPC: replication high-water mark + tensor-name census (the reshard
     # controller's ownership listing — names only, no values)
     def ReplicaStatus(self, request: rmsg.ReplicaStatusRequest,
@@ -970,6 +990,25 @@ class ParameterServer:
         if config.backup_address and replication_on:
             self.replicator = Replicator(self.core, config.backup_address,
                                          mode=mode)
+        # Cross-replica sharded update (replication/sharded_update.py,
+        # ISSUE 18): partition each arena close across the replica set.
+        # Requires a sync-mode Replicator (the exchange IS the
+        # replication for a close, so the backup must provably hold the
+        # base before the barrier publishes) — any other mode leaves the
+        # flag inert.  Config forces; "" defers to PSDT_SHARDED_UPDATE.
+        self.sharded_updater: ShardedUpdater | None = None
+        sharded_on = (config.sharded_update not in ("", "0", "false")
+                      if config.sharded_update
+                      else sharded_mod.enabled())
+        if sharded_on and self.replicator is not None and mode == "sync":
+            self.sharded_updater = ShardedUpdater(
+                self.core, self.replicator,
+                dtype=config.sharded_update_dtype or None)
+            self.core.set_sharded_updater(self.sharded_updater)
+        elif sharded_on:
+            log.warning("PSDT_SHARDED_UPDATE set but replication is not "
+                        "sync-mode with a backup; sharded update stays "
+                        "disarmed")
         # Replication headroom (ISSUE 9 satellite): a backup that gets
         # PROMOTED starts serving barriers with no backup of its own —
         # silently, until now.  The unarmed gauge flags that window in
@@ -1037,6 +1076,7 @@ class ParameterServer:
                       **m.PARAMETER_SERVER_STREAM_METHODS,
                       **shm_transport.SHM_METHODS,
                       **rmsg.REPLICATION_PS_METHODS,
+                      **rmsg.SHARDED_UPDATE_PS_METHODS,
                       **dmsg.DELTA_PS_METHODS}, self.service)
         addr = f"{self.config.bind_address}:{self.config.port}"
         self._port = self._server.add_insecure_port(addr)
@@ -1062,6 +1102,9 @@ class ParameterServer:
         self._server.wait_for_termination()
 
     def stop(self, grace: float = 1.0) -> None:
+        if self.sharded_updater is not None:
+            self.core.set_sharded_updater(None)
+            self.sharded_updater.stop()
         if self.replicator is not None:
             self.replicator.stop()
         if self._standby is not None:

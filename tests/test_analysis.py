@@ -497,6 +497,45 @@ def test_freerun_introduces_no_wire_drift_and_no_new_locks():
     assert not [k for k in LOCK_RANKS if "FreeRun" in k or "freerun" in k]
 
 
+def test_sharded_update_extension_stays_out_of_the_wire_manifest():
+    """ISSUE 18 compat gate: the cross-replica sharded-update extension
+    (replication/messages.py ShardedSliceChunk / ShardedSliceAck and
+    the ShardedApplySlices / InstallSlabSlices methods) must leave the
+    reference wire manifest byte-unchanged, its method table must stay
+    disjoint from the pinned PS contract AND the replication extension
+    table it rides alongside, and both new locks must carry declared
+    ranks with their blocking sections blessed."""
+    import json
+
+    from parameter_server_distributed_tpu.analysis import wirecheck
+    from parameter_server_distributed_tpu.analysis.lock_order import (
+        BLOCKING_ALLOWED, LOCK_RANKS)
+    from parameter_server_distributed_tpu.replication import (
+        messages as repmsg)
+
+    with open(wirecheck.default_manifest_path()) as fh:
+        golden = json.loads(fh.read())
+    assert wirecheck.diff_manifests(golden, wirecheck.build_manifest()) == []
+    blob = json.dumps(golden)
+    for name in ("ShardedSliceChunk", "ShardedSliceAck",
+                 "ShardedApplySlices", "InstallSlabSlices",
+                 "PSDT_SHARDED_UPDATE"):
+        assert name not in blob, f"sharded update leaked: {name}"
+    from parameter_server_distributed_tpu.rpc import messages as m
+    assert not set(repmsg.SHARDED_UPDATE_PS_METHODS) & (
+        set(m.PARAMETER_SERVER_METHODS)
+        | set(m.PARAMETER_SERVER_STREAM_METHODS))
+    assert not set(repmsg.SHARDED_UPDATE_PS_METHODS) & set(
+        repmsg.REPLICATION_PS_METHODS)
+    for lock in ("ShardedUpdateSink._lock", "ShardedUpdater._lock"):
+        assert lock in LOCK_RANKS, lock
+        assert lock in BLOCKING_ALLOWED, lock
+    # the sink's rank precedes the replica sink's: a sharded install
+    # advances the flat-ship bookkeeping INSIDE its critical section
+    assert (LOCK_RANKS["ShardedUpdateSink._lock"]
+            < LOCK_RANKS["ReplicaSink._lock"])
+
+
 def test_elastic_extension_stays_out_of_the_wire_manifest():
     """ISSUE 13 compat gate: the elastic-membership extension
     (elastic/messages.py) must leave the reference wire manifest

@@ -235,6 +235,43 @@ def test_bench_replicate_contract():
     assert result["reshard_moved_bytes"] > 0
 
 
+def test_bench_replicate_sharded_contract():
+    """replicate mode, sharded-update sweep (ISSUE 18): close p50 and
+    TRUE replication wire bytes/iteration (client-side request+response
+    byte counters over the PushReplicaDelta / ShardedApplySlices /
+    InstallSlabSlices legs), flat ship vs sharded raw vs sharded
+    quantized — with the acceptance visible in the JSON: the measured
+    closes really sharded, and both sharded arms move fewer bytes per
+    iteration than the flat ship at 2 replicas without a slower close."""
+    result = run_bench("replicate", extra_env={
+        "PSDT_BENCH_PARAMS": "1e5",
+        "PSDT_BENCH_STEPS": "3",
+        "PSDT_BENCH_SHARDED_ONLY": "1",
+        "PSDT_BENCH_SHARDED_TENSORS": "32",
+        "PSDT_BENCH_REPLICA_COUNTS": "1,2",
+    })
+    assert result["metric"] == "ps_replicate_sharded_bytes_ratio_2r"
+    assert 0 < result["value"] < 1.0
+    sweep = result["sharded"]
+    rows = {(r["replicas"], r["arm"]): r for r in sweep["rows"]}
+    # single-replica baseline: no replication traffic at all
+    assert rows[(1, "flat")]["bytes_per_iter"] == 0
+    flat = rows[(2, "flat")]
+    assert flat["bytes_per_iter"] > 0 and flat["sharded_closes"] == 0
+    for arm in ("sharded_raw", "sharded_quant"):
+        row = rows[(2, arm)]
+        # every measured close sharded (the warmup close absorbed the
+        # backup's catch-up flat ship)
+        assert row["sharded_closes"] == sweep["steps"], row
+        assert row["sharded_fallbacks"] == 0, row
+        assert 0 < row["bytes_per_iter"] < flat["bytes_per_iter"], row
+        # close p50 no worse than the flat ship (generous envelope: tiny
+        # shapes on a loaded CI host are noise-dominated)
+        assert row["close_p50_ms"] < 2.0 * flat["close_p50_ms"], row
+    ratios = sweep["bytes_per_iter_vs_flat"]["2"]
+    assert ratios["sharded_quant"] < ratios["sharded_raw"] < 1.0
+
+
 @pytest.mark.slow
 def test_bench_obs_contract():
     """obs mode: flight-recorder event throughput + fused-step overhead

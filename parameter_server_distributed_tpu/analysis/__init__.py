@@ -20,6 +20,22 @@ used to live only in comments.  This subsystem *checks* them:
   that swallows errors) and thread-hygiene pass (every
   ``threading.Thread`` must be named and ``daemon=True``; every
   ``ThreadPoolExecutor`` must set ``thread_name_prefix``).
+- :mod:`extcheck` — extension-protocol pass: auto-discovers every
+  ``*/messages.py`` extension module (replication, tiers, elastic,
+  delta, fleet), diffs each against the committed per-extension golden
+  (``analysis/ext_manifests.json``) and statically checks cross-extension
+  collisions (duplicate RPC method names per service, duplicate message
+  registrations, field tags colliding with core messages, the reserved
+  trace tag 999).
+- :mod:`knobcheck` — knob-registry pass: scans every ``PSDT_*``
+  environment read, emits a generated registry
+  (``analysis/knob_registry.json``), and flags conflicting defaults,
+  dead doc-table rows, and undocumented knobs.
+- :mod:`eventcheck` — flight-event pass: rebuilds the event-code
+  registry from ``obs/flight.py`` and asserts code uniqueness,
+  ``.start``/``.end`` pairing, sampling discipline, record-site
+  validity, and that ``obs/postmortem.py``'s decode tables cover every
+  registered code.
 - :mod:`lock_order` — the single declared lock-order table, shared by the
   static pass and the runtime mode: under ``PSDT_LOCK_CHECK=1`` the known
   locks are wrapped in an order-asserting proxy that records per-thread
@@ -39,8 +55,8 @@ AST passes (or anything beyond stdlib) at import time.
 
 from __future__ import annotations
 
-__all__ = ["findings", "hygiene", "lock_order", "lockcheck", "runner",
-           "wirecheck"]
+__all__ = ["eventcheck", "extcheck", "findings", "hygiene", "knobcheck",
+           "lock_order", "lockcheck", "runner", "wirecheck"]
 
 
 def __getattr__(name):

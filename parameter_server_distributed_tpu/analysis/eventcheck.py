@@ -1,0 +1,289 @@
+"""Flight-event pass: the event-code registry, statically verified.
+
+``obs/flight.py`` owns the append-only event-code table (``EVENTS``) the
+binary flight-ring format is defined by; ``obs/postmortem.py`` decodes
+and renders those rings **without importing the package** (it mirrors
+what it needs).  Until now the mirrors were hand-"drift-asserted" in
+scattered tests.  This pass rebuilds the registry from the AST and
+checks, per run:
+
+- **code uniqueness** — two names sharing a code silently alias in every
+  decoded ring (``EVENT_NAMES`` keeps one arbitrarily);
+- **code range** — codes are a u16 on the wire;
+- **paired families** — every ``X.start`` has an ``X.end`` and vice
+  versa (interval reconstruction depends on it);
+- **sampling discipline** — ``SAMPLED`` members exist and are never
+  paired events (sampling one side of a pair destroys its intervals);
+- **record sites** — every literal ``flight.record("name", ...)`` /
+  ``record_event("name")`` in the tree names a registered event (a typo
+  otherwise raises KeyError only when that code path finally runs), and
+  every registered event is recorded somewhere (dead code in an
+  append-only namespace is permanent);
+- **postmortem decode coverage** — ``postmortem.EVENT_DECODE`` has a row
+  for every registered event and no stale rows, every event-shaped
+  string literal in postmortem.py is a registered name, and the
+  ``_TIER_ID_BASE`` mirror still equals the core's
+  ``TIER_AGGREGATE_ID_BASE`` (replacing the hand-written asserts).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import FLIGHT_EVENT, Finding
+
+_EVENT_SHAPE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_.]+)+$")
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _finding(path: str, line: int, symbol: str, message: str,
+             slug: str = "") -> Finding:
+    return Finding(pass_id=FLIGHT_EVENT, path=path, line=line,
+                   symbol=symbol, message=message, slug=slug)
+
+
+def _const_int(node: ast.AST) -> int | None:
+    """Small constant-expression folder: enough for ``1 << 20`` style
+    mirror constants."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        left, right = _const_int(node.left), _const_int(node.right)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.LShift):
+            return left << right
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+    return None
+
+
+def _module_assign(tree: ast.Module, name: str) -> ast.AST | None:
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == name:
+            return stmt.value
+        if isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name) and \
+                stmt.target.id == name and stmt.value is not None:
+            return stmt.value
+    return None
+
+
+def _parse_file(path: str) -> ast.Module | None:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return ast.parse(fh.read(), filename=path)
+
+
+def extract_events(tree: ast.Module) -> dict[str, tuple[int, int]]:
+    """EVENTS as ``name -> (code, lineno)``."""
+    value = _module_assign(tree, "EVENTS")
+    out: dict[str, tuple[int, int]] = {}
+    if isinstance(value, ast.Dict):
+        for k, v in zip(value.keys, value.values):
+            code = _const_int(v)
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and code is not None:
+                out[k.value] = (code, k.lineno)
+    return out
+
+
+def extract_sampled(tree: ast.Module) -> list[tuple[str, int]]:
+    """Names inside ``SAMPLED = frozenset({EVENTS["x"], ...})``."""
+    value = _module_assign(tree, "SAMPLED")
+    names: list[tuple[str, int]] = []
+    if value is not None:
+        for node in ast.walk(value):
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                names.append((node.slice.value, node.lineno))
+    return names
+
+
+def record_sites(root: str) -> list[tuple[str, str, int]]:
+    """(event name, rel path, line) for every literal record call."""
+    sites: list[tuple[str, str, int]] = []
+    repo_prefix = os.path.dirname(os.path.abspath(root))
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("build", "__pycache__"))
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, repo_prefix).replace(os.sep, "/")
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read(), filename=rel)
+            except (SyntaxError, ValueError):
+                continue
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("record", "record_event")
+                        and node.args):
+                    for name in _literal_names(node.args[0]):
+                        sites.append((name, rel, node.lineno))
+    return sites
+
+
+def _literal_names(node: ast.AST) -> list[str]:
+    """String literals an event-name argument can evaluate to — a plain
+    constant or either branch of a ``"a" if cond else "b"`` selection."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _literal_names(node.body) + _literal_names(node.orelse)
+    return []
+
+
+def run(root: str | None = None) -> list[Finding]:
+    root = os.path.abspath(root or _package_root())
+    pkg = os.path.basename(root)
+    flight_rel = f"{pkg}/obs/flight.py"
+    pm_rel = f"{pkg}/obs/postmortem.py"
+    flight_tree = _parse_file(os.path.join(root, "obs", "flight.py"))
+    if flight_tree is None:
+        return []  # tree has no flight recorder — nothing to check
+    findings: list[Finding] = []
+    events = extract_events(flight_tree)
+
+    # ---- uniqueness + range
+    by_code: dict[int, str] = {}
+    for name, (code, line) in events.items():
+        if code in by_code:
+            findings.append(_finding(
+                flight_rel, line, name,
+                f"event code {code} of {name!r} already taken by "
+                f"{by_code[code]!r} — decoded rings alias the two",
+                slug=f"dup-code:{code}"))
+        by_code.setdefault(code, name)
+        if not 0 < code <= 0xFFFF:
+            findings.append(_finding(
+                flight_rel, line, name,
+                f"event code {code} of {name!r} outside the u16 wire "
+                f"range (1..65535)", slug="code-range"))
+
+    # ---- paired families
+    for name, (code, line) in sorted(events.items()):
+        for suffix, other in ((".start", ".end"), (".end", ".start")):
+            if name.endswith(suffix):
+                sibling = name[: -len(suffix)] + other
+                if sibling not in events:
+                    findings.append(_finding(
+                        flight_rel, line, name,
+                        f"paired event family incomplete: {name!r} has no "
+                        f"{sibling!r} — intervals cannot reconstruct",
+                        slug="unpaired"))
+
+    # ---- sampling discipline
+    for name, line in extract_sampled(flight_tree):
+        if name not in events:
+            findings.append(_finding(
+                flight_rel, line, name,
+                f"SAMPLED names unregistered event {name!r}",
+                slug="sampled-unknown"))
+        elif name.endswith((".start", ".end")):
+            findings.append(_finding(
+                flight_rel, line, name,
+                f"SAMPLED contains paired event {name!r} — sampling one "
+                f"side of a pair destroys interval reconstruction",
+                slug="sampled-paired"))
+
+    # ---- record sites
+    recorded: set[str] = set()
+    for name, rel, line in record_sites(root):
+        recorded.add(name)
+        if name not in events:
+            findings.append(_finding(
+                rel, line, name,
+                f"record of unregistered event {name!r} — raises "
+                f"KeyError the first time this path runs",
+                slug="unregistered-record"))
+    for name, (code, line) in sorted(events.items()):
+        if name not in recorded:
+            findings.append(_finding(
+                flight_rel, line, name,
+                f"event {name!r} (code {code}) is registered but never "
+                f"recorded anywhere in the tree — dead code in an "
+                f"append-only namespace",
+                slug="never-recorded"))
+
+    # ---- postmortem decode/render coverage
+    pm_tree = _parse_file(os.path.join(root, "obs", "postmortem.py"))
+    if pm_tree is None:
+        return findings
+    decode_value = _module_assign(pm_tree, "EVENT_DECODE")
+    decode: dict[str, int] = {}
+    if isinstance(decode_value, ast.Dict):
+        for k in decode_value.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                decode[k.value] = k.lineno
+    if decode_value is None:
+        findings.append(_finding(
+            pm_rel, 0, "EVENT_DECODE",
+            "postmortem.py has no EVENT_DECODE table — the renderer "
+            "cannot prove it covers every recorded code",
+            slug="no-decode-table"))
+    else:
+        for name, (code, _) in sorted(events.items()):
+            if name not in decode:
+                findings.append(_finding(
+                    pm_rel, 0, name,
+                    f"EVENT_DECODE has no row for {name!r} (code {code}) "
+                    f"— postmortem cannot describe it",
+                    slug="decode-missing"))
+        for name, line in sorted(decode.items()):
+            if name not in events:
+                findings.append(_finding(
+                    pm_rel, line, name,
+                    f"EVENT_DECODE row {name!r} matches no registered "
+                    f"event — stale decode table",
+                    slug="decode-stale"))
+
+    # event-shaped string literals in postmortem must name real events
+    # (a renamed event leaves dead render branches behind)
+    namespaces = {n.split(".", 1)[0] for n in events}
+    seen: set[str] = set()
+    for node in ast.walk(pm_tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            text = node.value
+            if text in events or text in seen:
+                continue
+            if _EVENT_SHAPE.match(text) and \
+                    text.split(".", 1)[0] in namespaces:
+                seen.add(text)
+                findings.append(_finding(
+                    pm_rel, node.lineno, text,
+                    f"postmortem references {text!r}, which is not a "
+                    f"registered flight event — stale render branch",
+                    slug="stale-reference"))
+
+    # ---- the _TIER_ID_BASE mirror (formerly a hand-written test assert)
+    pm_base_node = _module_assign(pm_tree, "_TIER_ID_BASE")
+    core_tree = _parse_file(os.path.join(root, "core", "ps_core.py"))
+    if pm_base_node is not None and core_tree is not None:
+        core_node = _module_assign(core_tree, "TIER_AGGREGATE_ID_BASE")
+        pm_base = _const_int(pm_base_node)
+        core_base = _const_int(core_node) if core_node is not None else None
+        if core_base is not None and pm_base != core_base:
+            findings.append(_finding(
+                pm_rel, pm_base_node.lineno, "_TIER_ID_BASE",
+                f"postmortem._TIER_ID_BASE ({pm_base}) no longer mirrors "
+                f"core.ps_core.TIER_AGGREGATE_ID_BASE ({core_base}) — "
+                f"group lanes will mislabel",
+                slug="tier-base-mirror"))
+    return findings

@@ -1,0 +1,405 @@
+"""Extension-protocol pass: every ``*/messages.py`` under one golden.
+
+The wire manifest (:mod:`wirecheck`) pins the *reference* contract in
+``rpc/messages.py`` — but the system has since grown extension RPC
+modules (``replication/``, ``tiers/``, ``elastic/``, ``delta/``,
+``fleet/`` ``messages.py``) that deliberately live outside it.  They are
+wire contracts all the same: their field tags ride the network and their
+method names share gRPC services with the reference tables and with each
+other.  This pass
+
+1. **auto-discovers** every extension ``messages.py`` (any ``*/messages.py``
+   except ``rpc/messages.py``) and extracts per-extension manifests —
+   message field specs keyed by tag, method tables attributed to their
+   gRPC service — purely from the AST (no imports: ``tiers/messages.py``
+   pulls in the whole core, and fixture trees must analyze too);
+2. **diffs** them against the committed golden
+   ``analysis/ext_manifests.json`` with the same structural-diff gate as
+   the core manifest (``pst-analyze --write-ext-manifests`` regenerates);
+3. **checks cross-extension collisions** statically: duplicate method
+   names registered on the same gRPC service, duplicate message-type
+   definitions across modules, field tags colliding with the core
+   definition of a same-named message, duplicate tags within a message,
+   and the reserved trace tag — field 999 is ``trace_context``/``bytes``
+   everywhere, and nothing else may claim it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .findings import EXT_PROTOCOL, Finding
+from .wirecheck import _diff_tree
+
+MANIFEST_VERSION = 1
+
+# Mirrors rpc.messages.TRACE_FIELD_NUMBER; _core_constants() re-reads the
+# authoritative value from source when the analyzed tree has one.
+TRACE_FIELD_NUMBER = 999
+TRACE_FIELD_NAME = "trace_context"
+
+# Core service names (rpc/messages.py); extension tables are attributed by
+# table-name convention, see _table_service().
+_PS_SERVICE = "parameter_server.ParameterServer"
+_COORD_SERVICE = "coordinator.Coordinator"
+
+
+def default_manifest_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "ext_manifests.json")
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------- AST extraction
+
+def _module_constants(tree: ast.Module) -> dict[str, object]:
+    """Module-level ``NAME = <int|str literal>`` assignments."""
+    consts: dict[str, object] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, (int, str))):
+            consts[stmt.targets[0].id] = stmt.value.value
+    return consts
+
+
+def _const(node: ast.AST, consts: dict[str, object]):
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _field_from_call(call: ast.Call, consts: dict[str, object]) -> dict | None:
+    """``Field(number, name, kind, message_type=..., repeated=...)`` as a
+    manifest spec dict (with ``number``), or None when it isn't one."""
+    fn = call.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    if name != "Field" or len(call.args) < 3:
+        return None
+    number = _const(call.args[0], consts)
+    fname = _const(call.args[1], consts)
+    kind = _const(call.args[2], consts)
+    if not isinstance(number, int) or not isinstance(fname, str):
+        return None
+    spec: dict = {"number": number, "name": fname, "kind": kind,
+                  "repeated": False}
+    for kw in call.keywords:
+        if kw.arg == "repeated" and isinstance(kw.value, ast.Constant):
+            spec["repeated"] = bool(kw.value.value)
+        elif kw.arg == "message_type" and isinstance(kw.value, ast.Name):
+            spec["message_type"] = kw.value.id
+    return spec
+
+
+def _message_classes(tree: ast.Module,
+                     consts: dict[str, object]) -> dict[str, list[dict]]:
+    """Every class with a ``FIELDS = (Field(...), ...)`` tuple — the
+    declarative wire-message convention of rpc/wire.py."""
+    out: dict[str, list[dict]] = {}
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.ClassDef):
+            continue
+        for node in stmt.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "FIELDS"):
+                continue
+            fields: list[dict] = []
+            if isinstance(node.value, ast.Tuple):
+                for elem in node.value.elts:
+                    if isinstance(elem, ast.Call):
+                        spec = _field_from_call(elem, consts)
+                        if spec is not None:
+                            fields.append(spec)
+            out[stmt.name] = fields
+    return out
+
+
+def _method_tables(tree: ast.Module) -> dict[str, dict[str, dict]]:
+    """Module-level ``X_METHODS = {"Name": (Req, Resp[, "style"])}``."""
+    out: dict[str, dict[str, dict]] = {}
+    for stmt in tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id.endswith("_METHODS")
+                and isinstance(stmt.value, ast.Dict)):
+            continue
+        table: dict[str, dict] = {}
+        for key, value in zip(stmt.value.keys, stmt.value.values):
+            if not (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and isinstance(value, ast.Tuple)
+                    and len(value.elts) >= 2):
+                continue
+            names = [e.id if isinstance(e, ast.Name) else None
+                     for e in value.elts[:2]]
+            style = "unary"
+            if (len(value.elts) > 2
+                    and isinstance(value.elts[2], ast.Constant)):
+                style = value.elts[2].value
+            table[key.value] = {"request": names[0], "response": names[1],
+                                "style": style}
+        out[stmt.targets[0].id] = table
+    return out
+
+
+def _table_service(table_name: str, consts: dict[str, object]) -> str | None:
+    """gRPC service a method table registers on, by the naming convention
+    the extension modules follow (``*_PS_METHODS`` / ``*_COORD_METHODS``),
+    the core table names, or a sibling ``<BASE>_SERVICE`` constant
+    (``DECODE_METHODS`` -> ``DECODE_SERVICE``)."""
+    if table_name.endswith("_PS_METHODS") or \
+            table_name.startswith("PARAMETER_SERVER"):
+        return _PS_SERVICE
+    if table_name.endswith("_COORD_METHODS") or \
+            table_name.startswith("COORDINATOR"):
+        return _COORD_SERVICE
+    svc = consts.get(table_name.removesuffix("_METHODS") + "_SERVICE")
+    return svc if isinstance(svc, str) else None
+
+
+# ------------------------------------------------------------- discovery
+
+def discover(root: str | None = None) -> list[tuple[str, str]]:
+    """``(manifest_key, abs_path)`` for every extension messages.py under
+    ``root`` — any ``*/messages.py`` except the reference ``rpc/`` one."""
+    root = os.path.abspath(root or _package_root())
+    found: list[tuple[str, str]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("build", "__pycache__"))
+        if "messages.py" in filenames and dirpath != root:
+            rel = os.path.relpath(os.path.join(dirpath, "messages.py"),
+                                  root).replace(os.sep, "/")
+            if rel != "rpc/messages.py":
+                found.append((rel, os.path.join(dirpath, "messages.py")))
+    return sorted(found)
+
+
+def _parse(path: str) -> tuple[ast.Module, dict[str, object]]:
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    return tree, _module_constants(tree)
+
+
+def _core_extract(root: str) -> tuple[dict[str, list[dict]],
+                                      dict[str, dict[str, dict]], int]:
+    """(messages, method tables, trace tag) of ``rpc/messages.py`` under
+    ``root`` — empty when the tree has none (fixture dirs)."""
+    core_path = os.path.join(root, "rpc", "messages.py")
+    if not os.path.exists(core_path):
+        return {}, {}, TRACE_FIELD_NUMBER
+    tree, consts = _parse(core_path)
+    trace = consts.get("TRACE_FIELD_NUMBER", TRACE_FIELD_NUMBER)
+    consts.setdefault("TRACE_FIELD_NUMBER", trace)
+    return (_message_classes(tree, consts), _method_tables(tree),
+            int(trace))
+
+
+def build_manifests(root: str | None = None) -> dict:
+    """Per-extension manifests, extracted statically (see module doc)."""
+    root = os.path.abspath(root or _package_root())
+    _, _, trace = _core_extract(root)
+    extensions: dict = {}
+    for rel, path in discover(root):
+        tree, consts = _parse(path)
+        consts.setdefault("TRACE_FIELD_NUMBER", trace)
+        messages = {
+            name: {"fields": {str(f["number"]):
+                              {k: v for k, v in f.items() if k != "number"}
+                              for f in fields}}
+            for name, fields in _message_classes(tree, consts).items()}
+        tables = {}
+        for tname, table in _method_tables(tree).items():
+            tables[tname] = {
+                "service": _table_service(tname, consts),
+                "methods": table,
+            }
+        extensions[rel] = {"messages": messages, "method_tables": tables}
+    return {"version": MANIFEST_VERSION, "extensions": extensions}
+
+
+def write_manifests(path: str | None = None,
+                    root: str | None = None) -> str:
+    import json
+    path = path or default_manifest_path()
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(build_manifests(root), fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_manifests(path: str | None = None) -> dict | None:
+    import json
+    path = path or default_manifest_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# ----------------------------------------------------------------- checks
+
+def _finding(path: str, symbol: str, message: str, slug: str) -> Finding:
+    return Finding(pass_id=EXT_PROTOCOL, path=path, line=0, symbol=symbol,
+                   message=message, slug=slug)
+
+
+def _pkg_rel(root: str, rel: str) -> str:
+    """Finding path in the repo-relative convention of the runner."""
+    return f"{os.path.basename(os.path.abspath(root))}/{rel}"
+
+
+def check_collisions(root: str | None = None) -> list[Finding]:
+    root = os.path.abspath(root or _package_root())
+    core_messages, core_tables, trace = _core_extract(root)
+    core_rel = _pkg_rel(root, "rpc/messages.py")
+    out: list[Finding] = []
+
+    # (service, method) -> first registration site; seeded with the core
+    # tables so an extension colliding with the reference contract reports
+    # against the extension, not the core.
+    methods_seen: dict[tuple[str, str], str] = {}
+    for tname, table in core_tables.items():
+        svc = _table_service(tname, {})
+        for method in table:
+            methods_seen.setdefault((svc, method), f"{core_rel}:{tname}")
+    # message name -> defining module (core first, same reasoning)
+    defined: dict[str, str] = {name: core_rel for name in core_messages}
+
+    def check_fields(rel_path: str, msg: str, fields: list[dict]) -> None:
+        by_tag: dict[int, str] = {}
+        for f in fields:
+            tag, name = f["number"], f["name"]
+            if tag in by_tag:
+                out.append(_finding(
+                    rel_path, msg,
+                    f"duplicate field tag {tag} in {msg}: "
+                    f"{by_tag[tag]!r} and {name!r} — the decoder keeps one "
+                    f"and silently drops the other",
+                    slug=f"dup-tag:{tag}"))
+            by_tag.setdefault(tag, name)
+            if tag == trace and (name != TRACE_FIELD_NAME
+                                 or f.get("kind") != "bytes"):
+                out.append(_finding(
+                    rel_path, msg,
+                    f"field tag {trace} is reserved for "
+                    f"{TRACE_FIELD_NAME!r} (bytes) everywhere; {msg} "
+                    f"declares it as {name!r} ({f.get('kind')})",
+                    slug=f"trace-tag:{name}"))
+            if name == TRACE_FIELD_NAME and tag != trace:
+                out.append(_finding(
+                    rel_path, msg,
+                    f"{TRACE_FIELD_NAME!r} must always be tag {trace} "
+                    f"(the cross-service trace span convention); {msg} "
+                    f"numbers it {tag}",
+                    slug=f"trace-num:{tag}"))
+
+    for name, fields in core_messages.items():
+        check_fields(core_rel, name, fields)
+
+    for rel, path in discover(root):
+        rel_path = _pkg_rel(root, rel)
+        tree, consts = _parse(path)
+        consts.setdefault("TRACE_FIELD_NUMBER", trace)
+        messages = _message_classes(tree, consts)
+        for msg, fields in messages.items():
+            check_fields(rel_path, msg, fields)
+            if msg in defined:
+                # duplicate message-type registration; when it shadows a
+                # core message, also diff the tags so the report names the
+                # colliding field numbers
+                out.append(_finding(
+                    rel_path, msg,
+                    f"message type {msg} already defined in "
+                    f"{defined[msg]} — two decoders for one name cannot "
+                    f"agree on the wire",
+                    slug="dup-message"))
+                core_def = core_messages.get(msg)
+                if core_def is not None:
+                    core_tags = {f["number"]: f["name"] for f in core_def}
+                    for f in fields:
+                        have = core_tags.get(f["number"])
+                        if have is not None and have != f["name"]:
+                            out.append(_finding(
+                                rel_path, msg,
+                                f"field tag {f['number']} of {msg} "
+                                f"collides with the core definition "
+                                f"({have!r} there, {f['name']!r} here)",
+                                slug=f"core-tag:{f['number']}"))
+            else:
+                defined[msg] = rel_path
+        for tname, table in _method_tables(tree).items():
+            svc = _table_service(tname, consts)
+            if svc is None:
+                out.append(_finding(
+                    rel_path, tname,
+                    f"method table {tname} cannot be attributed to a gRPC "
+                    f"service — name it *_PS_METHODS / *_COORD_METHODS or "
+                    f"declare a sibling "
+                    f"{tname.removesuffix('_METHODS')}_SERVICE constant",
+                    slug="unattributed-service"))
+                continue
+            for method in table:
+                prev = methods_seen.get((svc, method))
+                if prev is not None:
+                    out.append(_finding(
+                        rel_path, tname,
+                        f"RPC method {method!r} on service {svc} already "
+                        f"registered by {prev} — a server binding both "
+                        f"tables would dispatch one arbitrarily",
+                        slug=f"dup-method:{method}"))
+                else:
+                    methods_seen[(svc, method)] = f"{rel_path}:{tname}"
+    return out
+
+
+def run(manifest_path: str | None = None, root: str | None = None,
+        check_golden: bool = True) -> list[Finding]:
+    """The pass: collision checks plus the golden-manifest diff gate."""
+    root = os.path.abspath(root or _package_root())
+    findings = check_collisions(root)
+    if not check_golden:
+        return findings
+    golden = load_manifests(manifest_path)
+    if golden is None:
+        findings.append(_finding(
+            _pkg_rel(root, "analysis/ext_manifests.json"), "manifest",
+            "golden extension manifests missing — run "
+            "pst-analyze --write-ext-manifests and commit the result",
+            slug="missing"))
+        return findings
+    current = build_manifests(root)
+    if golden.get("version") != current.get("version"):
+        findings.append(_finding(
+            _pkg_rel(root, "analysis/ext_manifests.json"), "manifest",
+            f"ext manifest version drift: golden {golden.get('version')} "
+            f"vs current {current.get('version')}", slug="version"))
+    gold_ext = golden.get("extensions", {})
+    cur_ext = current.get("extensions", {})
+    for rel in sorted(set(gold_ext) | set(cur_ext)):
+        rel_path = _pkg_rel(root, rel)
+        if rel not in cur_ext:
+            findings.append(_finding(
+                rel_path, rel,
+                f"extension module {rel} removed but still in the golden "
+                f"ext manifests — regenerate (--write-ext-manifests) if "
+                f"deliberate", slug="removed"))
+        elif rel not in gold_ext:
+            findings.append(_finding(
+                rel_path, rel,
+                f"extension module {rel} not in the golden ext manifests "
+                f"— regenerate (--write-ext-manifests) to pin its "
+                f"contract", slug="added"))
+        else:
+            _diff_tree(gold_ext[rel], cur_ext[rel], rel_path, rel,
+                       findings, pass_id=EXT_PROTOCOL,
+                       regen="pst-analyze --write-ext-manifests")
+    return findings

@@ -22,9 +22,16 @@ LOCK_BLOCKING = "lock-blocking"    # blocking call while holding a lock
 EXCEPT_HYGIENE = "except-hygiene"  # bare/overbroad except that swallows
 THREAD_HYGIENE = "thread-hygiene"  # unnamed / non-daemon helper thread
 WIRE_COMPAT = "wire-compat"        # drift against the golden wire manifest
+EXT_PROTOCOL = "ext-protocol"      # extension messages.py manifest drift /
+#                                    cross-extension protocol collisions
+KNOB_REGISTRY = "knob-registry"    # PSDT_* knob registry drift / doc drift /
+#                                    conflicting parse defaults
+FLIGHT_EVENT = "flight-event"      # flight event-code registry: uniqueness,
+#                                    pairing, postmortem decode coverage
 
 ALL_PASSES = (LOCK_ORDER, LOCK_RAW_ACQUIRE, LOCK_BLOCKING, EXCEPT_HYGIENE,
-              THREAD_HYGIENE, WIRE_COMPAT)
+              THREAD_HYGIENE, WIRE_COMPAT, EXT_PROTOCOL, KNOB_REGISTRY,
+              FLIGHT_EVENT)
 
 
 @dataclass

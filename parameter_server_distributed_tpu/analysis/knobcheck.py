@@ -1,0 +1,372 @@
+"""Knob-registry pass: every ``PSDT_*`` environment knob, machine-checked.
+
+The package steers ~60 behaviors through ``PSDT_*`` environment variables
+read at scattered call sites (``os.environ.get``, ``os.getenv``, constant
+indirections like ``ENV_FLAG = "PSDT_SHM"``).  The hand-maintained knob
+tables in ``docs/training.md`` / ``docs/observability.md`` /
+``docs/serving.md`` drift silently.  This pass
+
+1. **scans** the analyzed tree's AST for every ``PSDT_*`` read, resolving
+   module-level name constants and literal defaults (including the
+   ``environ.get(X) or "128"`` idiom and ``str(CONST)`` defaults), and
+   inferring the parse type from the consuming expression (``int(...)``,
+   ``float(...)``, membership tests -> ``flag``, else ``str``);
+2. **emits a generated registry** — knob name -> read sites (paths, no
+   line numbers, so the golden survives unrelated edits), defaults, parse
+   types — diffed against the committed ``analysis/knob_registry.json``
+   (``pst-analyze --write-knob-registry`` regenerates);
+3. **flags**: a knob parsed with *conflicting defaults* at different
+   sites (two readers disagree on what "unset" means), knobs documented
+   in a ``docs/*.md`` knob table but never read (*dead docs*), and knobs
+   read but absent from every doc table (*doc drift*).
+
+A "knob table row" is a markdown table row whose first cell is exactly a
+knob name (optionally with a `` / `--flag` `` alias) — rows quoting knobs
+mid-sentence (``PSDT_QUORUM unset``) are prose, not documentation of
+record.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+from .findings import KNOB_REGISTRY, Finding
+from .wirecheck import _diff_tree
+
+REGISTRY_VERSION = 1
+
+_KNOB = re.compile(r"^PSDT_[A-Z0-9_]+$")
+# first table cell is a (backticked) knob name, optionally "/ `--alias`"
+_DOC_ROW = re.compile(
+    r"^\|\s*`?(PSDT_[A-Z0-9_]+)`?\s*(?:/\s*`?--[\w-]+`?\s*)?\|")
+
+_ENV_CALLS = ("os.environ.get", "os.getenv", "environ.get", "getenv")
+
+
+def default_registry_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "knob_registry.json")
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@dataclass
+class ReadSite:
+    knob: str
+    path: str
+    line: int
+    default: str | None   # resolved literal default; None = no default
+    dynamic_default: bool  # a default exists but could not be resolved
+    parse: str            # "int" | "float" | "flag" | "str"
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _module_env_constants(tree: ast.Module) -> dict[str, str]:
+    """``ENV_X = "PSDT_..."`` and plain literal constants usable in
+    ``str(CONST)`` defaults (ints/floats kept as their str())."""
+    consts: dict[str, str] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, (str, int, float))):
+            consts[stmt.targets[0].id] = str(stmt.value.value)
+    return consts
+
+
+def _resolve_default(node: ast.AST,
+                     consts: dict[str, str]) -> tuple[str | None, bool]:
+    """(value, dynamic): the literal default an expression resolves to,
+    or (None, True) when a default exists but is not statically known."""
+    if isinstance(node, ast.Constant):
+        return (str(node.value) if node.value is not None else None), False
+    if isinstance(node, ast.Name):
+        value = consts.get(node.id)
+        return (value, value is None)
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "str" and len(node.args) == 1):
+        return _resolve_default(node.args[0], consts)
+    return None, True
+
+
+def _parse_type(node: ast.AST, parents: dict[ast.AST, ast.AST]) -> str:
+    """Climb the expression the env read feeds into: ``int()``/``float()``
+    wrappers, membership tests (``in``/``not in`` -> a flag)."""
+    cur = node
+    for _ in range(6):
+        parent = parents.get(cur)
+        if parent is None:
+            break
+        if isinstance(parent, ast.Call) and isinstance(parent.func,
+                                                       ast.Name):
+            if parent.func.id == "int":
+                return "int"
+            if parent.func.id == "float":
+                return "float"
+        if isinstance(parent, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in parent.ops):
+            return "flag"
+        if not isinstance(parent, (ast.Attribute, ast.Call, ast.BoolOp,
+                                   ast.UnaryOp, ast.BinOp)):
+            break
+        cur = parent
+    return "str"
+
+
+def _import_targets(tree: ast.Module, rel: str) -> list[tuple[str, str,
+                                                              str]]:
+    """(local name, source module rel path, source name) per
+    ``from .x import Y [as Z]`` — used to resolve knob-name constants
+    defined in a sibling module (``ENV_DTYPE = "PSDT_DELTA_DTYPE"`` in
+    ``delta/messages.py``, read from ``delta/chain.py``)."""
+    parts = rel.split("/")
+    pkg = parts[0] if parts else ""
+    out: list[tuple[str, str, str]] = []
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.ImportFrom):
+            continue
+        if stmt.level > 0:
+            base = parts[:-stmt.level] if stmt.level <= len(parts) else []
+        elif stmt.module and stmt.module.split(".")[0] == pkg:
+            base = []
+        else:
+            continue
+        mod_parts = stmt.module.split(".") if stmt.module else []
+        target = "/".join(base + mod_parts)
+        for alias in stmt.names:
+            out.append((alias.asname or alias.name, target, alias.name))
+    return out
+
+
+def scan_source(source: str, rel: str,
+                tree: ast.Module | None = None,
+                module_consts: dict[str, dict[str, str]] | None = None,
+                ) -> list[ReadSite]:
+    if tree is None:
+        tree = ast.parse(source, filename=rel)
+    consts = _module_env_constants(tree)
+    if module_consts:
+        for local, mod, name in _import_targets(tree, rel):
+            src = module_consts.get(f"{mod}.py") or \
+                module_consts.get(f"{mod}/__init__.py")
+            if src and name in src:
+                consts.setdefault(local, src[name])
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    sites: list[ReadSite] = []
+
+    def note(node: ast.AST, name_node: ast.AST,
+             default_node: ast.AST | None) -> None:
+        name = None
+        if isinstance(name_node, ast.Constant) and \
+                isinstance(name_node.value, str):
+            name = name_node.value
+        elif isinstance(name_node, ast.Name):
+            name = consts.get(name_node.id)
+        if name is None or not _KNOB.match(name):
+            return
+        if default_node is not None:
+            default, dynamic = _resolve_default(default_node, consts)
+        else:
+            default, dynamic = None, False
+            # the `environ.get(X) or "fallback"` idiom
+            parent = parents.get(node)
+            if (isinstance(parent, ast.BoolOp)
+                    and isinstance(parent.op, ast.Or)
+                    and parent.values and parent.values[0] is node
+                    and isinstance(parent.values[-1], ast.Constant)
+                    and parent.values[-1].value is not None):
+                default = str(parent.values[-1].value)
+        sites.append(ReadSite(
+            knob=name, path=rel, line=getattr(node, "lineno", 0),
+            default=default, dynamic_default=dynamic,
+            parse=_parse_type(node, parents)))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if dotted in _ENV_CALLS and node.args:
+                note(node, node.args[0],
+                     node.args[1] if len(node.args) > 1 else None)
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load):
+            dotted = _dotted(node.value)
+            if dotted in ("os.environ", "environ"):
+                note(node, node.slice, None)
+    return sites
+
+
+def scan_tree(root: str) -> list[ReadSite]:
+    # two phases: parse everything first so the second phase can resolve
+    # cross-module knob-name constants through `from .x import Y`
+    trees: dict[str, ast.Module] = {}
+    repo_prefix = os.path.dirname(os.path.abspath(root))
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("build", "__pycache__"))
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, repo_prefix).replace(os.sep, "/")
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    trees[rel] = ast.parse(fh.read(), filename=rel)
+            except (SyntaxError, ValueError):
+                continue  # the runner reports unparseable files itself
+    module_consts = {rel: _module_env_constants(tree)
+                     for rel, tree in trees.items()}
+    sites: list[ReadSite] = []
+    for rel, tree in sorted(trees.items()):
+        sites += scan_source("", rel, tree=tree,
+                             module_consts=module_consts)
+    return sites
+
+
+# ----------------------------------------------------------- doc tables
+
+def documented_knobs(docs_dir: str) -> dict[str, str]:
+    """knob -> "docs/<file>.md" for every knob-table row (see module
+    doc for what counts as one)."""
+    out: dict[str, str] = {}
+    if not os.path.isdir(docs_dir):
+        return out
+    base = os.path.basename(os.path.abspath(docs_dir))
+    for fname in sorted(os.listdir(docs_dir)):
+        if not fname.endswith(".md"):
+            continue
+        with open(os.path.join(docs_dir, fname), encoding="utf-8") as fh:
+            for line in fh:
+                m = _DOC_ROW.match(line.strip())
+                if m:
+                    out.setdefault(m.group(1), f"{base}/{fname}")
+    return out
+
+
+# ------------------------------------------------------------- registry
+
+def build_registry(root: str | None = None) -> dict:
+    root = os.path.abspath(root or _package_root())
+    sites = scan_tree(root)
+    knobs: dict[str, dict] = {}
+    for s in sites:
+        entry = knobs.setdefault(s.knob, {"reads": set(), "defaults": set(),
+                                          "parse": set()})
+        entry["reads"].add(s.path)
+        if s.default is not None:
+            entry["defaults"].add(s.default)
+        entry["parse"].add(s.parse)
+    return {"version": REGISTRY_VERSION,
+            "knobs": {name: {"reads": sorted(e["reads"]),
+                             "defaults": sorted(e["defaults"]),
+                             "parse": sorted(e["parse"])}
+                      for name, e in sorted(knobs.items())}}
+
+
+def write_registry(path: str | None = None, root: str | None = None) -> str:
+    import json
+    path = path or default_registry_path()
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(build_registry(root), fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_registry(path: str | None = None) -> dict | None:
+    import json
+    path = path or default_registry_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# ----------------------------------------------------------------- pass
+
+def _finding(path: str, line: int, symbol: str, message: str,
+             slug: str) -> Finding:
+    return Finding(pass_id=KNOB_REGISTRY, path=path, line=line,
+                   symbol=symbol, message=message, slug=slug)
+
+
+def run(root: str | None = None, registry_path: str | None = None,
+        docs_dir: str | None = None,
+        check_registry: bool = True) -> list[Finding]:
+    root = os.path.abspath(root or _package_root())
+    if docs_dir is None:
+        docs_dir = os.path.join(os.path.dirname(root), "docs")
+    sites = scan_tree(root)
+    findings: list[Finding] = []
+
+    by_knob: dict[str, list[ReadSite]] = {}
+    for s in sites:
+        by_knob.setdefault(s.knob, []).append(s)
+
+    # conflicting defaults: two parse-with-default sites disagree on what
+    # an unset knob means (dynamic defaults are exempt — they are usually
+    # a shared computed constant the resolver cannot fold)
+    for knob, reads in sorted(by_knob.items()):
+        defaults = sorted({s.default for s in reads
+                           if s.default is not None})
+        if len(defaults) > 1:
+            first = min(reads, key=lambda s: (s.path, s.line))
+            where = ", ".join(sorted({f"{s.path}:{s.line}={s.default!r}"
+                                      for s in reads
+                                      if s.default is not None}))
+            findings.append(_finding(
+                first.path, first.line, knob,
+                f"{knob} read with conflicting defaults ({where}) — an "
+                f"unset knob silently behaves differently per subsystem",
+                slug="conflicting-default"))
+
+    docs = documented_knobs(docs_dir)
+    for knob, where in sorted(docs.items()):
+        if knob not in by_knob:
+            findings.append(_finding(
+                where, 0, knob,
+                f"{knob} documented in a {where} knob table but never "
+                f"read by the analyzed tree — dead documentation",
+                slug="dead-doc"))
+    if os.path.isdir(docs_dir):
+        for knob, reads in sorted(by_knob.items()):
+            if knob not in docs:
+                first = min(reads, key=lambda s: (s.path, s.line))
+                findings.append(_finding(
+                    first.path, first.line, knob,
+                    f"{knob} is read but appears in no docs/*.md knob "
+                    f"table — document it (doc drift)",
+                    slug="undocumented"))
+
+    if check_registry:
+        golden = load_registry(registry_path)
+        reg_rel = (f"{os.path.basename(root)}/analysis/"
+                   f"knob_registry.json")
+        if golden is None:
+            findings.append(_finding(
+                reg_rel, 0, "registry",
+                "golden knob registry missing — run "
+                "pst-analyze --write-knob-registry and commit the result",
+                slug="missing"))
+        else:
+            current = build_registry(root)
+            _diff_tree(golden.get("knobs", {}), current.get("knobs", {}),
+                       reg_rel, "knobs", findings,
+                       pass_id=KNOB_REGISTRY,
+                       regen="pst-analyze --write-knob-registry")
+    return findings

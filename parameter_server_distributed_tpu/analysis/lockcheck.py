@@ -82,6 +82,30 @@ class Edge:
     path: str
     line: int
     symbol: str
+    via: str = ""   # interprocedural edges: the call chain that acquires
+
+
+@dataclass
+class CallSite:
+    name: str                 # dotted callee expression as written
+    held: tuple[str, ...]     # lock quals held at the call
+    line: int
+
+
+@dataclass
+class FnSummary:
+    """Per-function facts the interprocedural pass propagates: what the
+    function acquires, where it blocks, and whom it calls under what."""
+    symbol: str
+    path: str
+    cls: str | None
+    name: str
+    acquires: list[tuple[str, int]] = field(default_factory=list)
+    # (call name, line, cv lock qual when the call is a cv.wait — the
+    # interprocedural pass applies the CV hand-off legality with it)
+    blocking: list[tuple[str, int, str | None]] = field(
+        default_factory=list)
+    calls: list[CallSite] = field(default_factory=list)
 
 
 @dataclass
@@ -176,6 +200,10 @@ class _FunctionSim:
         self.cls = cls
         self.symbol = f"{cls}.{func.name}" if cls else func.name
         self.held: list[_Held] = []
+        self.summary = FnSummary(symbol=self.symbol, path=pass_state.path,
+                                 cls=cls, name=func.name)
+        if pass_state.summaries is not None:
+            pass_state.summaries.append(self.summary)
         doc = ast.get_docstring(func) or ""
         for attr in _CALLER_HOLDS.findall(doc):
             decl = self._resolve_attr(attr)
@@ -213,6 +241,8 @@ class _FunctionSim:
 
     def _acquire(self, decl: LockDecl, node: ast.AST, via_with: bool) -> None:
         eff = self._effective(decl)
+        self.summary.acquires.append((eff.qual,
+                                      getattr(node, "lineno", 0)))
         for h in self.held:
             if h.decl.qual == eff.qual and not eff.reentrant:
                 self.st.finding(LOCK_ORDER, node, self.symbol,
@@ -239,8 +269,6 @@ class _FunctionSim:
                 return
 
     def _check_blocking(self, node: ast.Call) -> None:
-        if not self.held:
-            return
         name = _dotted(node.func)
         if name is None:
             return
@@ -249,7 +277,31 @@ class _FunctionSim:
                     or name.startswith(BLOCKING_PREFIX)
                     or any(name.endswith(s) for s in BLOCKING_SUFFIX)
                     or terminal in BLOCKING_METHODS)
-        if not blocking:
+        if terminal == "join" and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Constant):
+            blocking = False  # "sep".join(...) — string, not a thread
+        if blocking:
+            # cv.wait()s carry their CV's lock qual so the
+            # interprocedural pass can apply the same hand-off legality
+            # (wait is fine when the caller holds exactly that lock)
+            cv_qual = None
+            if terminal in ("wait", "wait_for") and \
+                    isinstance(node.func, ast.Attribute):
+                cv_decl = self._resolve_expr(node.func.value)
+                if cv_decl is not None and cv_decl.cv_of is not None:
+                    cv_qual = self._effective(cv_decl).qual
+            self.summary.blocking.append((name,
+                                          getattr(node, "lineno", 0),
+                                          cv_qual))
+        else:
+            # not itself blocking -> a candidate call edge for the
+            # interprocedural pass (which checks what the callee may
+            # acquire or do while the caller's locks stay held)
+            self.summary.calls.append(CallSite(
+                name=name,
+                held=tuple(h.decl.qual for h in self.held),
+                line=getattr(node, "lineno", 0)))
+        if not blocking or not self.held:
             return
         if terminal in ("wait", "wait_for") and isinstance(node.func,
                                                            ast.Attribute):
@@ -261,9 +313,6 @@ class _FunctionSim:
                 if (len(self.held) == 1
                         and self.held[0].decl.qual == eff.qual):
                     return
-        if terminal == "join" and isinstance(node.func, ast.Attribute) \
-                and isinstance(node.func.value, ast.Constant):
-            return  # "sep".join(...) — string, not a thread
         offenders = [h.decl.qual for h in self.held
                      if h.decl.qual not in lock_order.BLOCKING_ALLOWED]
         if not offenders:
@@ -340,11 +389,13 @@ class _FunctionSim:
 
 
 class _PassState:
-    def __init__(self, path: str, locks: ModuleLocks):
+    def __init__(self, path: str, locks: ModuleLocks,
+                 summaries: list[FnSummary] | None = None):
         self.path = path
         self.locks = locks
         self.findings: list[Finding] = []
         self.edges: list[Edge] = []
+        self.summaries = summaries
 
     def finding(self, pass_id: str, node: ast.AST, symbol: str,
                 message: str, slug: str) -> None:
@@ -366,11 +417,14 @@ class _PassState:
 
 def analyze_module(source: str, path: str,
                    modbase: str | None = None,
-                   tree: ast.Module | None = None) -> tuple[list[Finding],
-                                                            list[Edge]]:
+                   tree: ast.Module | None = None,
+                   summaries: list[FnSummary] | None = None
+                   ) -> tuple[list[Finding], list[Edge]]:
     """Run the lock pass over one module.  Returns (findings, edges);
     edge ordering is checked by :func:`check_edges` once all modules have
-    contributed (cycles can span functions)."""
+    contributed (cycles can span functions).  When ``summaries`` is a
+    list, per-function :class:`FnSummary` records are appended to it for
+    :func:`interprocedural`."""
     if modbase is None:
         parts = path.replace("\\", "/").split("/")
         modbase = parts[-1].removesuffix(".py")
@@ -378,7 +432,7 @@ def analyze_module(source: str, path: str,
             modbase = parts[-2]  # package/__init__.py locks are "package.X"
     if tree is None:
         tree = ast.parse(source, filename=path)
-    st = _PassState(path, _discover(tree, modbase))
+    st = _PassState(path, _discover(tree, modbase), summaries=summaries)
     for stmt in tree.body:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
             st.function(None, stmt)
@@ -401,13 +455,14 @@ def check_edges(edges: list[Edge]) -> list[Finding]:
         r_acq = lock_order.LOCK_RANKS.get(e.acquired)
         if r_held is not None and r_acq is not None:
             if r_held >= r_acq:
+                via = f" via call chain {e.via}" if e.via else ""
                 findings.append(Finding(
                     pass_id=LOCK_ORDER, path=e.path, line=e.line,
                     symbol=e.symbol,
                     message=f"lock-order inversion: {e.acquired} "
                             f"(rank {r_acq}) acquired while holding "
-                            f"{e.held} (rank {r_held}); declared order: "
-                            f"analysis/lock_order.py",
+                            f"{e.held} (rank {r_held}){via}; declared "
+                            f"order: analysis/lock_order.py",
                     slug=f"{e.held}->{e.acquired}"))
             continue
         graph.setdefault(e.held, set()).add(e.acquired)
@@ -441,3 +496,169 @@ def check_edges(edges: list[Edge]) -> list[Finding]:
                         f"analysis/lock_order.py",
                 slug=f"cycle:{e.held}<->{e.acquired}"))
     return findings
+
+
+# ------------------------------------------------------- interprocedural
+
+# Terminal method names never resolved to package functions: too common
+# (every container/stream has one) for name-based resolution to be sound.
+_RESOLVE_SKIP = frozenset({
+    "get", "set", "put", "pop", "append", "extend", "close", "open",
+    "start", "stop", "run", "join", "items", "keys", "values", "copy",
+    "update", "add", "remove", "discard", "clear", "flush", "read",
+    "write", "send", "recv", "encode", "decode", "submit", "shutdown",
+    "register", "main", "next", "sort", "sorted", "len", "str", "int",
+    "log", "info", "debug", "warning", "error", "record",
+    "float", "bool", "list", "dict", "tuple", "setdefault",
+    "acquire", "release", "locked", "notify", "notify_all",
+})
+
+
+class _CallGraph:
+    """Bounded-depth, cycle-safe propagation of lock effects through the
+    package call graph.  Resolution is deliberately conservative: a call
+    binds only when its target is unambiguous — ``self.m()`` to the one
+    method ``m`` of the enclosing class, a bare ``f()`` to the one
+    module-level ``f`` of the same file, and ``anything.m()`` to ``m``
+    only when exactly one function of that name exists in the whole
+    tree (and the name is not a ubiquitous container/stream verb)."""
+
+    def __init__(self, summaries: list[FnSummary], max_depth: int = 4):
+        self.summaries = summaries
+        self.max_depth = max_depth
+        self.by_name: dict[str, list[FnSummary]] = {}
+        self.by_method: dict[tuple[str, str, str], FnSummary] = {}
+        self.by_module_fn: dict[tuple[str, str], list[FnSummary]] = {}
+        for s in summaries:
+            self.by_name.setdefault(s.name, []).append(s)
+            if s.cls is not None:
+                self.by_method[(s.path, s.cls, s.name)] = s
+            else:
+                self.by_module_fn.setdefault((s.path, s.name),
+                                             []).append(s)
+        # transitive effects, built to fixpoint (bounded rounds = bounded
+        # chain depth; revisiting a cycle adds nothing new and converges)
+        self.acq: dict[int, dict[str, str]] = {}     # qual -> via chain
+        # per function: up to one unconditional blocking call and one
+        # cv.wait (whose legality depends on the caller's held set)
+        self.blk: dict[int, list[tuple[str, str, str | None]]] = {}
+        for s in summaries:
+            self.acq[id(s)] = {qual: "" for qual, _ in s.acquires}
+            self.blk[id(s)] = []
+            for call, _, cv_qual in s.blocking:
+                self._add_blk(id(s), call, "", cv_qual)
+        for _ in range(max_depth):
+            if not self._propagate_once():
+                break
+
+    def _add_blk(self, sid: int, call: str, chain: str,
+                 cv_qual: str | None) -> bool:
+        entries = self.blk[sid]
+        for _, _, existing_cv in entries:
+            if (existing_cv is None) == (cv_qual is None):
+                return False  # that class already represented
+        entries.append((call, chain, cv_qual))
+        return True
+
+    def resolve(self, caller: FnSummary, name: str) -> FnSummary | None:
+        parts = name.split(".")
+        terminal = parts[-1]
+        if terminal.startswith("__") or terminal in _RESOLVE_SKIP:
+            return None
+        if parts[0] == "self" and len(parts) == 2 and caller.cls:
+            return self.by_method.get((caller.path, caller.cls, terminal))
+        if len(parts) == 1:
+            local = self.by_module_fn.get((caller.path, terminal), [])
+            if len(local) == 1:
+                return local[0]
+            return None
+        candidates = self.by_name.get(terminal, [])
+        if len(candidates) == 1:
+            return candidates[0]
+        return None
+
+    def _propagate_once(self) -> bool:
+        changed = False
+        for s in self.summaries:
+            sid = id(s)
+            for call in s.calls:
+                callee = self.resolve(s, call.name)
+                if callee is None or callee is s:
+                    continue
+                cid = id(callee)
+                for qual, chain in self.acq[cid].items():
+                    if qual not in self.acq[sid]:
+                        self.acq[sid][qual] = (
+                            callee.symbol + (f" -> {chain}" if chain
+                                             else ""))
+                        changed = True
+                for blocked, chain, cv_qual in list(self.blk[cid]):
+                    newchain = callee.symbol + (f" -> {chain}"
+                                                if chain else "")
+                    if self._add_blk(sid, blocked, newchain, cv_qual):
+                        changed = True
+        return changed
+
+
+def interprocedural(summaries: list[FnSummary],
+                    max_depth: int = 4) -> tuple[list[Edge],
+                                                 list[Finding]]:
+    """The package-level pass: at every call made with locks held, fold
+    the callee's transitive acquisitions into the edge graph (rank and
+    cycle checking happens in :func:`check_edges` with everything else)
+    and flag callees that may block.  Returns (edges, blocking
+    findings), both deduplicated by (caller, held, effect)."""
+    graph = _CallGraph(summaries, max_depth=max_depth)
+    edges: list[Edge] = []
+    findings: list[Finding] = []
+    seen_edges: set[tuple[str, str, str]] = set()
+    seen_blocks: set[tuple[str, str]] = set()
+    for s in summaries:
+        for call in s.calls:
+            if not call.held:
+                continue
+            callee = graph.resolve(s, call.name)
+            if callee is None or callee is s:
+                continue
+            cid = id(callee)
+            for qual, chain in graph.acq[cid].items():
+                if qual in call.held:
+                    continue  # re-entry is the runtime checker's call
+                for held in call.held:
+                    key = (held, qual, s.symbol)
+                    if key in seen_edges:
+                        continue
+                    seen_edges.add(key)
+                    via = callee.symbol + (f" -> {chain}" if chain
+                                           else "")
+                    edges.append(Edge(held=held, acquired=qual,
+                                      path=s.path, line=call.line,
+                                      symbol=s.symbol, via=via))
+            blocked = None
+            for bcall, bchain, cv_qual in graph.blk[cid]:
+                if cv_qual is not None and call.held == (cv_qual,):
+                    continue  # the CV hand-off: wait parks its own lock
+                blocked = (bcall, bchain)
+                break
+            if blocked is None:
+                continue
+            offenders = [h for h in call.held
+                         if h not in lock_order.BLOCKING_ALLOWED]
+            if not offenders:
+                continue
+            blocked_call, chain = blocked
+            via = callee.symbol + (f" -> {chain}" if chain else "")
+            slug = f"call:{callee.name}:{offenders[-1]}"
+            key = (s.symbol, slug)
+            if key in seen_blocks:
+                continue
+            seen_blocks.add(key)
+            findings.append(Finding(
+                pass_id=LOCK_BLOCKING, path=s.path, line=call.line,
+                symbol=s.symbol,
+                message=f"call {call.name}() may block while holding "
+                        f"{', '.join(offenders)} — {blocked_call}() "
+                        f"reached via {via}; move the call outside the "
+                        f"lock or justify in the baseline",
+                slug=slug))
+    return edges, findings

@@ -16,7 +16,8 @@ import json
 import os
 from dataclasses import dataclass, field
 
-from . import hygiene, lockcheck, wirecheck
+from . import (eventcheck, extcheck, hygiene, knobcheck, lockcheck,
+               wirecheck)
 from .findings import (BaselineEntry, Finding, apply_baseline,
                        load_baseline)
 
@@ -79,21 +80,24 @@ def _iter_sources(root: str):
                 yield os.path.join(dirpath, fname)
 
 
-def analyze_file(path: str, rel: str) -> tuple[list[Finding],
-                                               list[lockcheck.Edge]]:
+def analyze_file(path: str, rel: str,
+                 summaries: list | None = None) -> tuple[
+                     list[Finding], list[lockcheck.Edge]]:
     """All AST passes over one file (shared by the runner and the fixture
     tests, which feed synthetic sources through the same entry points)."""
     with open(path, "r", encoding="utf-8") as fh:
         source = fh.read()
-    return analyze_source(source, rel)
+    return analyze_source(source, rel, summaries=summaries)
 
 
-def analyze_source(source: str, rel: str) -> tuple[list[Finding],
-                                                   list[lockcheck.Edge]]:
+def analyze_source(source: str, rel: str,
+                   summaries: list | None = None) -> tuple[
+                       list[Finding], list[lockcheck.Edge]]:
     # one parse + one symbol map, shared by all three AST passes
     tree = ast.parse(source, filename=rel)
     symbols = hygiene._enclosing_symbols(tree)
-    findings, edges = lockcheck.analyze_module(source, rel, tree=tree)
+    findings, edges = lockcheck.analyze_module(source, rel, tree=tree,
+                                               summaries=summaries)
     findings += hygiene.check_excepts(source, rel, tree=tree,
                                       symbols=symbols)
     findings += hygiene.check_threads(source, rel, tree=tree,
@@ -104,25 +108,45 @@ def analyze_source(source: str, rel: str) -> tuple[list[Finding],
 def run(root: str | None = None,
         baseline_path: str | None = None,
         manifest_path: str | None = None,
-        wire: bool = True) -> Report:
+        wire: bool = True,
+        ext: bool = True,
+        knobs: bool = True,
+        events: bool = True,
+        interproc: bool = True,
+        ext_manifest_path: str | None = None,
+        knob_registry_path: str | None = None) -> Report:
+    explicit_root = root is not None
     root = os.path.abspath(root or package_root())
     report = Report(root=root)
     if not os.path.isdir(root):
         report.errors.append(f"analysis root {root} is not a directory")
         return report
+    # golden comparisons (ext manifests, knob registry) only bind when we
+    # analyze the package itself or the caller pointed at goldens — an
+    # arbitrary fixture root has no committed goldens to diff against
+    pinned = (root == package_root()
+              or ext_manifest_path is not None
+              or knob_registry_path is not None
+              or not explicit_root)
     findings: list[Finding] = []
     edges: list[lockcheck.Edge] = []
+    summaries: list[lockcheck.FnSummary] = []
     repo_prefix = os.path.dirname(root)
     for path in _iter_sources(root):
         rel = os.path.relpath(path, repo_prefix).replace(os.sep, "/")
         report.files += 1
         try:
-            file_findings, file_edges = analyze_file(path, rel)
+            file_findings, file_edges = analyze_file(
+                path, rel, summaries=summaries)
         except (SyntaxError, ValueError) as exc:
             report.errors.append(f"{rel}: {exc}")
             continue
         findings += file_findings
         edges += file_edges
+    if interproc:
+        ip_edges, ip_findings = lockcheck.interprocedural(summaries)
+        edges += ip_edges
+        findings += ip_findings
     findings += lockcheck.check_edges(edges)
     if wire:
         try:
@@ -130,6 +154,25 @@ def run(root: str | None = None,
         except Exception as exc:  # noqa: BLE001 — analyzer must report,
             # not crash: a broken rpc import IS the finding
             report.errors.append(f"wire-compat pass failed: {exc}")
+    if ext:
+        try:
+            findings += extcheck.run(manifest_path=ext_manifest_path,
+                                     root=root,
+                                     check_golden=pinned)
+        except Exception as exc:  # noqa: BLE001
+            report.errors.append(f"ext-protocol pass failed: {exc}")
+    if knobs:
+        try:
+            findings += knobcheck.run(root=root,
+                                      registry_path=knob_registry_path,
+                                      check_registry=pinned)
+        except Exception as exc:  # noqa: BLE001
+            report.errors.append(f"knob-registry pass failed: {exc}")
+    if events:
+        try:
+            findings += eventcheck.run(root=root)
+        except Exception as exc:  # noqa: BLE001
+            report.errors.append(f"flight-event pass failed: {exc}")
     findings.sort(key=lambda f: (f.path, f.line, f.pass_id, f.slug))
     try:
         entries = load_baseline(baseline_path)

@@ -125,35 +125,38 @@ def _finding(path: str, symbol: str, message: str, slug: str) -> Finding:
 
 
 def _diff_tree(golden, current, path: str, symbol: str,
-               out: list[Finding]) -> None:
+               out: list[Finding], pass_id: str = WIRE_COMPAT,
+               regen: str = "pst-analyze --write-wire-manifest") -> None:
     """Structural diff of nested dict/scalar manifest sections.  Each leaf
     difference is its own finding so one renumbered field reads as exactly
-    that, not as a wall of JSON."""
+    that, not as a wall of JSON.  Shared with the other golden-manifest
+    passes (extcheck, knobcheck) via ``pass_id``/``regen``."""
+    def emit(sym: str, message: str, slug: str) -> None:
+        out.append(Finding(pass_id=pass_id, path=path, line=0, symbol=sym,
+                           message=message, slug=slug))
+
     if isinstance(golden, dict) and isinstance(current, dict):
         for key in golden:
             if key not in current:
-                out.append(_finding(
-                    path, symbol,
-                    f"{symbol}.{key} removed (golden manifest has it) — a "
-                    f"reference peer still sends/expects it",
-                    slug=f"{symbol}.{key}:removed"))
+                emit(symbol,
+                     f"{symbol}.{key} removed (golden manifest has it) — a "
+                     f"reference peer still sends/expects it",
+                     slug=f"{symbol}.{key}:removed")
             else:
                 _diff_tree(golden[key], current[key], path,
-                           f"{symbol}.{key}", out)
+                           f"{symbol}.{key}", out, pass_id, regen)
         for key in current:
             if key not in golden:
-                out.append(_finding(
-                    path, symbol,
-                    f"{symbol}.{key} added but not in the golden manifest "
-                    f"— regenerate it (pst-analyze --write-wire-manifest) "
-                    f"if the addition is deliberate",
-                    slug=f"{symbol}.{key}:added"))
+                emit(symbol,
+                     f"{symbol}.{key} added but not in the golden manifest "
+                     f"— regenerate it ({regen}) "
+                     f"if the addition is deliberate",
+                     slug=f"{symbol}.{key}:added")
         return
     if golden != current:
-        out.append(_finding(
-            path, symbol,
-            f"{symbol} changed: golden {golden!r} -> current {current!r}",
-            slug=f"{symbol}:changed"))
+        emit(symbol,
+             f"{symbol} changed: golden {golden!r} -> current {current!r}",
+             slug=f"{symbol}:changed")
 
 
 def diff_manifests(golden: dict, current: dict) -> list[Finding]:

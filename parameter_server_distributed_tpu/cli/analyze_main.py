@@ -2,19 +2,26 @@
 
     python -m parameter_server_distributed_tpu.cli.analyze_main \
         [root_dir] [--json] [--baseline=PATH] [--manifest=PATH] \
-        [--no-wire] [--write-wire-manifest]
+        [--no-wire] [--no-ext] [--no-knobs] [--no-events] \
+        [--no-interproc] [--write-wire-manifest] \
+        [--write-ext-manifests] [--write-knob-registry]
 
-Runs the static passes (lock discipline, exception hygiene, thread
-hygiene) over the package source and diffs the live wire contract against
-the golden manifest (analysis/wire_manifest.json).  Exit 0 when every
-finding is covered by the reviewed baseline (analysis/baseline.json),
-1 otherwise — wire this into CI next to the tier-1 tests
-(scripts/analyze.sh).  See docs/analysis.md for the pass catalogue, the
-declared lock-order table, and the baseline / manifest workflows.
+Runs the static passes (lock discipline — including the interprocedural
+held-set propagation, exception hygiene, thread hygiene, extension
+protocol, knob registry, flight events) over the package source and
+diffs the live wire contract against the golden manifest
+(analysis/wire_manifest.json).  Exit 0 when every finding is covered by
+the reviewed baseline (analysis/baseline.json), 1 otherwise — wire this
+into CI next to the tier-1 tests (scripts/analyze.sh).  See
+docs/analysis.md for the pass catalogue, the declared lock-order table,
+and the baseline / manifest / registry workflows.
 
-``--write-wire-manifest`` regenerates the golden manifest from the
-current schemas and exits — run it (and commit the result) as part of any
-deliberate protocol change.
+``--write-wire-manifest`` regenerates the golden wire manifest from the
+current schemas and exits; ``--write-ext-manifests`` does the same for
+the per-extension protocol manifests (analysis/ext_manifests.json) and
+``--write-knob-registry`` for the PSDT_* knob registry
+(analysis/knob_registry.json) — run the matching writer (and commit the
+result) as part of any deliberate protocol / knob change.
 """
 
 from __future__ import annotations
@@ -32,12 +39,24 @@ def main(argv: list[str] | None = None) -> int:
     flight.suppress_for_tool()
     positional, flags = parse_argv(argv)
 
-    from ..analysis import runner, wirecheck
+    from ..analysis import extcheck, knobcheck, runner, wirecheck
 
     manifest_path = flags.get("manifest") or None
     if "write-wire-manifest" in flags:
         path = wirecheck.write_manifest(manifest_path)
         print(f"wire manifest written: {path}")
+        return 0
+    if "write-ext-manifests" in flags:
+        path = extcheck.write_manifests(
+            flags.get("ext-manifest") or None,
+            root=positional[0] if positional else None)
+        print(f"extension manifests written: {path}")
+        return 0
+    if "write-knob-registry" in flags:
+        path = knobcheck.write_registry(
+            flags.get("knob-registry") or None,
+            root=positional[0] if positional else None)
+        print(f"knob registry written: {path}")
         return 0
 
     report = runner.run(
@@ -45,6 +64,12 @@ def main(argv: list[str] | None = None) -> int:
         baseline_path=flags.get("baseline") or None,
         manifest_path=manifest_path,
         wire="no-wire" not in flags,
+        ext="no-ext" not in flags,
+        knobs="no-knobs" not in flags,
+        events="no-events" not in flags,
+        interproc="no-interproc" not in flags,
+        ext_manifest_path=flags.get("ext-manifest") or None,
+        knob_registry_path=flags.get("knob-registry") or None,
     )
     if "json" in flags:
         print(runner.to_json_str(report))

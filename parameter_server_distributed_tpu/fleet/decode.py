@@ -59,7 +59,7 @@ log = logging.getLogger("pst.fleet.decode")
 # concurrent dispatch deadlocks the CPU client — the same hazard
 # worker/trainer.py's _DISPATCH_LOCK guards).  Uncontended when each
 # server runs in its own process, which is the production shape.
-_DISPATCH_LOCK = threading.Lock()
+_DISPATCH_LOCK = checked_lock("decode._DISPATCH_LOCK")
 
 
 class _Stream:

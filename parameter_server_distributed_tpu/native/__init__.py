@@ -22,9 +22,10 @@ import ctypes
 import logging
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from ..analysis.lock_order import checked_lock
 
 log = logging.getLogger("pst.native")
 
@@ -32,7 +33,7 @@ _SRC = os.path.join(os.path.dirname(__file__), "psdt_native.cpp")
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libpsdt_native.so")
 
-_lock = threading.Lock()
+_lock = checked_lock("native._lock")
 _lib: ctypes.CDLL | None = None
 _tried = False
 

@@ -200,6 +200,14 @@ EVENTS: dict[str, int] = {
                                   # PSDT_DAMP_FLOOR (effectively
                                   # dropped); a = staleness, b = scale
                                   # in ppb
+    # cross-replica sharded update (replication/sharded_update.py)
+    "shard.install": 150,         # partition shard installed into the
+                                  # store; a = bytes, b = params_version
+    "shard.update.degrade": 151,  # sharded close degraded to the
+                                  # replicated path; note = reason
+    "apply.sharded": 152,         # sharded close published; a =
+                                  # replica count, b = wire bytes;
+                                  # note = duration
 }
 EVENT_NAMES = {code: name for name, code in EVENTS.items()}
 

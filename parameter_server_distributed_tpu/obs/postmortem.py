@@ -58,6 +58,113 @@ def _group_label(worker_id: int) -> str:
     return f"group[leader {worker_id - _TIER_ID_BASE}]"
 
 
+# One human-readable row per registered flight event: what it marks and
+# what the a/b/note payload fields carry.  Keys must cover flight.EVENTS
+# exactly — pst-analyze's flight-event pass diffs the two tables, so a
+# new event without a decode row (or a stale row) fails the analyzer.
+EVENT_DECODE: dict[str, str] = {
+    "proc.start": "process came up (role in note)",
+    "proc.exit": "clean shutdown recorded",
+    "proc.sigterm": "SIGTERM received",
+    "rpc.cli.start": "client RPC issued (note = method)",
+    "rpc.cli.end": "client RPC done; a=duration_us b=1 ok/0 error",
+    "rpc.srv.start": "server handler entered (note = method)",
+    "rpc.srv.end": "server handler done; a=duration_us",
+    "step.start": "worker step began",
+    "step.end": "worker step done; a=duration_us",
+    "fused.start": "fused push+pull issued",
+    "fused.end": "fused push+pull done; a=duration_us b=1 ok/0 degraded",
+    "boot.seed": "worker seeded an empty store",
+    "fold.reserve": "gradient chunk fold reserved (sampled); a=tensors",
+    "push.commit": "worker push committed; a=contributors b=width",
+    "barrier.seal": "barrier sealed; a=contributors",
+    "barrier.drain": "in-flight folds drained; a=folds",
+    "apply.start": "optimizer apply began",
+    "apply.end": "optimizer apply done; a=duration_us",
+    "barrier.publish": "new params published; a=contributors b=width",
+    "barrier.retry": "failed close left the barrier retryable",
+    "repl.ship.start": "replica snapshot ship began; a=bytes b=version",
+    "repl.ship.end": "replica ship done; a=duration_us b=version",
+    "repl.ack": "replica acked a ship; a=1 ok/0 refused b=version",
+    "repl.install": "replica installed a shipped store; a=bytes "
+                    "b=version",
+    "repl.refuse": "replica refused a ship (note = reason)",
+    "repl.degrade": "replication permanently degraded",
+    "failover.report": "dead primary reported; a=shard (note = address)",
+    "failover.promote": "replica promoted; a=shard b=new epoch",
+    "failover.retry": "worker retried onto replacement; a=shard",
+    "reshard.fence": "reshard fence; a=tensors retired b=map epoch",
+    "reshard.install": "resharded store installed; a=bytes b=epoch",
+    "reshard.epoch": "shard map advanced; a=new epoch b=shard count",
+    "shm.negotiate": "shm ring negotiated; a=connection b=ring bytes",
+    "shm.refuse": "shm refused (note = reason)",
+    "shm.attach": "client attached shm ring; b=ring bytes",
+    "shm.downgrade": "shm downgraded to TCP (note = reason)",
+    "shm.reap": "shm connection reaped; a=connection",
+    "shm.reap.dup": "second shm release attempt hit the latch",
+    "codec.select": "wire codec chosen; a=1 native/0 python",
+    "ckpt.restore": "checkpoint restored",
+    "tier.elect": "tier topology elected; a=group size b=epoch/agg id",
+    "tier.fold": "member push folded at leaf (sampled); a=tensors "
+                 "b=aggregate id",
+    "tier.seal": "leaf group sealed; a=contributors b=group size",
+    "tier.upstream": "group aggregate shipped upstream; a=duration_us "
+                     "b=wire bytes",
+    "tier.downgrade": "permanent flat downgrade (note = reason)",
+    "serve.delta.build": "serve delta built; a=bytes b=to_version",
+    "serve.delta.hit": "delta chain served; a=wire bytes b=pairs",
+    "serve.delta.miss": "delta miss, full store served; a=held "
+                        "b=current (note = reason)",
+    "serve.delta.downgrade": "client permanently downgraded deltas "
+                             "(note = reason)",
+    "publish.subscribe": "weight subscriber joined; a=held version "
+                         "b=subscriber id",
+    "publish.swap": "subscriber swapped weights; a=version "
+                    "b=duration_us",
+    "publish.lag": "subscriber lag sample; a=versions behind",
+    "apply.device": "device-resident apply; a=duration_us b=stripes",
+    "apply.device.fallback": "device apply degraded to host "
+                             "(note = reason)",
+    "apply.readback": "async D2H readback started; a=tensors",
+    "elastic.join": "member ACTIVE; a=membership epoch",
+    "elastic.drain": "member DRAINING; a=epoch (note = reason)",
+    "elastic.evict": "coordinator reap marked member GONE; a=epoch",
+    "quorum.seal": "barrier closed at K of N; a=contributors b=width",
+    "stale.fold": "straggler folded forward; a=staleness b=tensors",
+    "fleet.register": "decode server ACTIVE; a=slots b=fleet epoch",
+    "fleet.drain": "decode server DRAINING; a=fleet epoch",
+    "fleet.evict": "coordinator reap marked server GONE; a=fleet epoch",
+    "fleet.route": "router pinned a stream; a=request b=server",
+    "fleet.scale": "scale decision; a=target b=epoch/current size",
+    "fleet.rollout": "rolling update step; a=version b=server",
+    "fleet.swap": "decode server swapped serving version; a=version "
+                  "b=server",
+    "apply.arena.pack": "arena packing table built; a=duration_us "
+                        "b=stripes",
+    "apply.arena.repack": "arena table rebuilt on shape change; "
+                          "a=duration_us",
+    "apply.arena.fallback": "arena close downgraded to per-tensor "
+                            "(note = reason)",
+    "apply.arena": "flat arena close published; a=dispatch_us "
+                   "b=readback_us",
+    "freerun.apply": "apply-on-arrival landed; a=staleness b=damp ppm",
+    "freerun.dup": "version-vector dedup dropped a replay; a=last step",
+    "freerun.publish": "coalesced publication; a=version b=applies",
+    "damp.floor": "contribution damped below the floor; a=staleness "
+                  "b=scale ppb",
+    "shard.install": "partition shard installed; a=bytes b=version",
+    "shard.update.degrade": "sharded close degraded to replicated path "
+                            "(note = reason)",
+    "apply.sharded": "sharded close published; a=replicas b=wire bytes",
+}
+
+
+def describe_event(name: str) -> str:
+    """One-line decode of a flight event name (the name itself when the
+    table has no row — old rings can carry codes newer than this build)."""
+    return EVENT_DECODE.get(name, name)
+
+
 # ------------------------------------------------------------------- loading
 
 
@@ -688,12 +795,14 @@ def chrome_events(events: list[dict]) -> list[dict]:
     for ev in events:
         if ev["event"] in instant:
             continue
+        args = {k: ev[k] for k in
+                ("iteration", "worker", "a", "b", "note")
+                if ev.get(k) not in (None, "", -1)}
+        args["decode"] = describe_event(ev["event"])
         out.append({
             "name": ev["event"], "ph": "i", "cat": "flight", "s": "p",
             "ts": ev["ts"] * 1e6, "pid": ev["pid"], "tid": ev["tid"],
-            "args": {k: ev[k] for k in
-                     ("iteration", "worker", "a", "b", "note")
-                     if ev.get(k) not in (None, "", -1)},
+            "args": args,
         })
     out.sort(key=lambda e: e["ts"])
     return out

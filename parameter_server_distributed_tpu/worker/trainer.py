@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.lock_order import checked_lock
 from ..core.tensor import TensorStore
 
 # One dispatch at a time per process: trainer-originated XLA work (step
@@ -42,7 +43,7 @@ from ..core.tensor import TensorStore
 # worker per process, dispatch is microseconds) and removes the overlap
 # the client cannot handle.  D2H/compute overlap is unaffected: the lock
 # covers launching work, and async copies still complete in parallel.
-_DISPATCH_LOCK = threading.Lock()
+_DISPATCH_LOCK = checked_lock("trainer._DISPATCH_LOCK")
 
 
 class GradientBuckets:

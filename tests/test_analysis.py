@@ -18,14 +18,18 @@ Three layers:
 from __future__ import annotations
 
 import json
+import os
+import re
 import textwrap
 
 import numpy as np
 import pytest
 
-from parameter_server_distributed_tpu.analysis import (findings as F,
-                                                       lock_order, lockcheck,
-                                                       runner, wirecheck)
+from parameter_server_distributed_tpu.analysis import (eventcheck, extcheck,
+                                                       findings as F,
+                                                       knobcheck, lock_order,
+                                                       lockcheck, runner,
+                                                       wirecheck)
 from parameter_server_distributed_tpu.cli import analyze_main
 
 
@@ -679,3 +683,339 @@ def test_runtime_condition_variable_wait_through_proxy():
     t.join(timeout=5.0)
     assert not t.is_alive()
     assert woke and woke[0][0] is True
+
+
+# ------------------------------------------------- extension protocol pass
+
+def _write(tmp_path, rel, src):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    return path
+
+
+_EXT_CORE = """
+    TRACE_FIELD_NUMBER = 999
+
+    class PushGradients:
+        FIELDS = (
+            Field(1, "worker_id", "varint"),
+            Field(999, "trace_context", "bytes"),
+        )
+
+    PARAMETER_SERVER_METHODS = {
+        "PushGradients": (PushGradients, PushGradients),
+    }
+    """
+
+
+def test_ext_tag_and_method_collisions_detected(tmp_path):
+    """A synthetic extension that (a) redefines a core message with a
+    renamed field on a core tag, (b) claims the reserved trace tag,
+    (c) duplicates a tag within one message, and (d) re-registers a core
+    RPC method must produce one finding per sin."""
+    _write(tmp_path, "rpc/messages.py", _EXT_CORE)
+    _write(tmp_path, "foo/messages.py", """
+        class PushGradients:
+            FIELDS = (
+                Field(1, "shard_id", "varint"),
+            )
+
+        class ShardHello:
+            FIELDS = (
+                Field(999, "shard_id", "varint"),
+                Field(3, "epoch", "varint"),
+                Field(3, "round", "varint"),
+            )
+
+        FOO_PS_METHODS = {
+            "PushGradients": (PushGradients, PushGradients),
+        }
+        """)
+    found = extcheck.check_collisions(str(tmp_path))
+    assert all(f.pass_id == F.EXT_PROTOCOL for f in found)
+    slugs = {f.slug for f in found}
+    assert {"dup-message", "core-tag:1", "trace-tag:shard_id",
+            "dup-tag:3", "dup-method:PushGradients"} <= slugs
+
+
+def test_ext_manifest_drift_detected(tmp_path):
+    """The golden gate: a pinned extension contract diffs clean against
+    itself, then any tag renumbering shows up as ext-protocol drift."""
+    _write(tmp_path, "rpc/messages.py", _EXT_CORE)
+    ext = _write(tmp_path, "foo/messages.py", """
+        class ShardHello:
+            FIELDS = (
+                Field(1, "shard_id", "varint"),
+            )
+
+        FOO_PS_METHODS = {
+            "ShardHello": (ShardHello, ShardHello),
+        }
+        """)
+    golden = tmp_path / "ext_manifests.json"
+    extcheck.write_manifests(str(golden), root=str(tmp_path))
+    assert extcheck.run(manifest_path=str(golden),
+                        root=str(tmp_path)) == []
+    ext.write_text(ext.read_text().replace('Field(1,', 'Field(2,'))
+    found = extcheck.run(manifest_path=str(golden), root=str(tmp_path))
+    assert found, "tag renumbering must not pass the golden gate"
+    assert all(f.pass_id == F.EXT_PROTOCOL for f in found)
+    assert any("write-ext-manifests" in f.message for f in found)
+
+
+def test_committed_ext_manifests_current():
+    """Currency gate: analysis/ext_manifests.json must match a fresh
+    extraction bit for bit (pst-analyze --write-ext-manifests)."""
+    golden = extcheck.load_manifests()
+    assert golden is not None
+    assert golden == extcheck.build_manifests()
+
+
+# ----------------------------------------------------- knob registry pass
+
+def test_knob_conflicting_default_detected(tmp_path):
+    """Two subsystems reading one knob with different literal defaults is
+    exactly the silent-divergence bug the pass exists for."""
+    pkg = tmp_path / "pkg"
+    _write(pkg, "a.py", """
+        import os
+        CHUNK = int(os.environ.get("PSDT_FIXTURE_CHUNK", "4"))
+        """)
+    _write(pkg, "b.py", """
+        import os
+        CHUNK = int(os.environ.get("PSDT_FIXTURE_CHUNK", "8"))
+        """)
+    found = knobcheck.run(root=str(pkg), check_registry=False)
+    assert [f.slug for f in found] == ["conflicting-default"]
+    assert found[0].symbol == "PSDT_FIXTURE_CHUNK"
+    assert found[0].pass_id == F.KNOB_REGISTRY
+
+
+def test_knob_doc_drift_detected(tmp_path):
+    """An undocumented read and a documented-but-never-read knob each
+    produce a doc-drift finding against the knob tables."""
+    pkg = tmp_path / "pkg"
+    _write(pkg, "a.py", """
+        import os
+        A = os.environ.get("PSDT_FIXTURE_A", "1")
+        B = os.environ.get("PSDT_FIXTURE_B", "1")
+        """)
+    _write(tmp_path, "docs/knobs.md", """
+        | knob | default | meaning |
+        | --- | --- | --- |
+        | `PSDT_FIXTURE_A` | 1 | documented and read |
+        | `PSDT_FIXTURE_C` | 1 | stale row, nothing reads it |
+        """)
+    found = knobcheck.run(root=str(pkg),
+                          docs_dir=str(tmp_path / "docs"),
+                          check_registry=False)
+    slugs = {(f.slug, f.symbol) for f in found}
+    assert ("undocumented", "PSDT_FIXTURE_B") in slugs
+    assert ("dead-doc", "PSDT_FIXTURE_C") in slugs
+    assert ("undocumented", "PSDT_FIXTURE_A") not in slugs
+
+
+def test_knob_cross_module_constant_default_resolves(tmp_path):
+    """A knob read through a constant imported from a sibling module must
+    resolve to that module's literal (the ENV_DTYPE pattern) — no
+    conflicting-default false positive, and the registry records it."""
+    pkg = tmp_path / "pkg"
+    _write(pkg, "messages.py", """
+        import os
+        ENV_DTYPE = "PSDT_FIXTURE_DTYPE"
+        KIND = os.environ.get(ENV_DTYPE, "bf16")
+        """)
+    _write(pkg, "chain.py", """
+        import os
+
+        from .messages import ENV_DTYPE
+
+        KIND = os.environ.get(ENV_DTYPE, "bf16")
+        """)
+    found = knobcheck.run(root=str(pkg), check_registry=False)
+    assert found == []
+    reg = knobcheck.build_registry(str(pkg))
+    assert reg["knobs"]["PSDT_FIXTURE_DTYPE"]["defaults"] == ["bf16"]
+
+
+def test_committed_knob_registry_current():
+    """Currency gate: analysis/knob_registry.json must match a fresh scan
+    bit for bit (pst-analyze --write-knob-registry)."""
+    golden = knobcheck.load_registry()
+    assert golden is not None
+    assert golden == knobcheck.build_registry()
+
+
+# ------------------------------------------------------ flight event pass
+
+def test_event_unpaired_and_duplicate_code_detected(tmp_path):
+    """An .start with no .end, two names on one code, and events that no
+    code path ever records each produce a flight-event finding."""
+    _write(tmp_path, "obs/flight.py", """
+        EVENTS = {
+            "fixture.go.start": 1,
+            "fixture.tick": 1,
+        }
+        """)
+    found = eventcheck.run(root=str(tmp_path))
+    assert all(f.pass_id == F.FLIGHT_EVENT for f in found)
+    slugs = {f.slug for f in found}
+    assert "unpaired" in slugs
+    assert "dup-code:1" in slugs
+    assert "never-recorded" in slugs
+
+
+def test_event_conditional_record_site_counts(tmp_path):
+    """Both arms of a ``record("a" if cond else "b")`` selection count as
+    record sites — neither event is dead, and an unregistered name in
+    either arm is still caught."""
+    _write(tmp_path, "obs/flight.py", """
+        EVENTS = {
+            "fixture.warm": 10,
+            "fixture.cold": 11,
+        }
+        """)
+    _write(tmp_path, "svc.py", """
+        def touch(flight, warm):
+            flight.record("fixture.warm" if warm else "fixture.cold")
+            flight.record("fixture.ghost")
+        """)
+    found = eventcheck.run(root=str(tmp_path))
+    slugs = {(f.slug, f.symbol) for f in found}
+    assert ("unregistered-record", "fixture.ghost") in slugs
+    assert not any(slug == "never-recorded" for slug, _ in slugs)
+
+
+# ------------------------------------------- interprocedural lock passes
+
+def test_interproc_cross_function_inversion():
+    """Each function is clean in isolation; only the call edge from the
+    params-lock holder into the state-lock acquirer inverts the declared
+    order — the whole point of the interprocedural pass."""
+    summaries: list[lockcheck.FnSummary] = []
+    found, edges = runner.analyze_source(textwrap.dedent("""
+        import threading
+
+        class ParameterServerCore:
+            def __init__(self):
+                self._state_lock = threading.Lock()
+                self._params_lock = threading.Lock()
+
+            def outer(self):
+                with self._params_lock:
+                    self._refresh()
+
+            def _refresh(self):
+                with self._state_lock:
+                    pass
+        """), "fixture/mod.py", summaries=summaries)
+    assert by_pass(found + lockcheck.check_edges(edges), F.LOCK_ORDER) == []
+    ip_edges, _ = lockcheck.interprocedural(summaries)
+    inversions = by_pass(lockcheck.check_edges(edges + ip_edges),
+                         F.LOCK_ORDER)
+    assert len(inversions) == 1
+    assert "_refresh" in inversions[0].message  # names the call chain
+    assert "ParameterServerCore._state_lock" in inversions[0].message
+
+
+def test_interproc_blocking_through_helper():
+    """Blocking two calls deep while holding a lock that does not allow
+    it: the finding names the callee AND the blocking primitive it
+    reaches."""
+    summaries: list[lockcheck.FnSummary] = []
+    _, _ = runner.analyze_source(textwrap.dedent("""
+        import threading
+        import time
+
+        class ParameterServerCore:
+            def __init__(self):
+                self._state_lock = threading.Lock()
+
+            def outer(self):
+                with self._state_lock:
+                    self._drain()
+
+            def _drain(self):
+                time.sleep(0.1)
+        """), "fixture/mod.py", summaries=summaries)
+    _, ip_findings = lockcheck.interprocedural(summaries)
+    blocking = by_pass(ip_findings, F.LOCK_BLOCKING)
+    assert len(blocking) == 1
+    assert blocking[0].symbol == "ParameterServerCore.outer"
+    assert blocking[0].slug == "call:_drain:ParameterServerCore._state_lock"
+    assert "time.sleep" in blocking[0].message
+
+
+def test_interproc_cv_wait_handoff_is_legal():
+    """Calling a helper whose only blocking act is waiting on the CV of
+    the one lock the caller holds is the legal barrier hand-off, not a
+    blocking-while-holding violation."""
+    summaries: list[lockcheck.FnSummary] = []
+    runner.analyze_source(textwrap.dedent("""
+        import threading
+
+        class ParameterServerCore:
+            def __init__(self):
+                self._state_lock = threading.Lock()
+                self._cv = threading.Condition(self._state_lock)
+
+            def outer(self):
+                with self._state_lock:
+                    self._park()
+
+            def _park(self):
+                self._cv.wait(timeout=1.0)
+        """), "fixture/mod.py", summaries=summaries)
+    _, ip_findings = lockcheck.interprocedural(summaries)
+    assert by_pass(ip_findings, F.LOCK_BLOCKING) == []
+
+
+# --------------------------------------------------- ranked-lock coverage
+
+def test_every_ranked_lock_constructed_through_checked_lock():
+    """Satellite gate: every LOCK_RANKS slot must be built through
+    checked_lock("<name>") somewhere in the package, so PSDT_LOCK_CHECK=1
+    arms ALL declared ranks — a rank with no checked construction site is
+    discipline the runtime checker never enforces.  The reverse inclusion
+    is free (checked_lock raises on undeclared names), but scanning both
+    ways keeps the table and the call sites in one-to-one correspondence.
+    analysis/ is excluded: the analyzer's own sources mention the pattern
+    in docstrings, they construct no product locks."""
+    root = runner.package_root()
+    constructed: set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("build", "__pycache__", "analysis")]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fname),
+                      encoding="utf-8") as fh:
+                src = fh.read()
+            constructed |= {m.group(1) for m in re.finditer(
+                r'checked_lock\(\s*"([^"]+)"', src)}
+    ranked = set(lock_order.LOCK_RANKS)
+    assert ranked - constructed == set(), (
+        f"ranked locks never built through checked_lock: "
+        f"{sorted(ranked - constructed)}")
+    assert constructed - ranked == set(), (
+        f"checked_lock sites with no declared rank: "
+        f"{sorted(constructed - ranked)}")
+
+
+@pytest.mark.lockcheck
+def test_runtime_every_ranked_lock_order_checked():
+    """With the runtime checker armed, constructing ANY declared slot
+    yields an order-asserting proxy, and the proxies enforce the table:
+    a deliberate inversion across two arbitrary ranks raises."""
+    for name in lock_order.LOCK_RANKS:
+        assert isinstance(lock_order.checked_lock(name),
+                          lock_order.CheckedLock), name
+    low = lock_order.checked_lock("ParameterServerCore._params_lock")
+    high = lock_order.checked_lock("FleetRouter._lock")
+    with pytest.raises(lock_order.LockOrderError, match="lock-order"):
+        with high:
+            with low:
+                pass
+    assert lock_order.held_locks() == ()

@@ -95,10 +95,12 @@ def test_native_source_shipped_as_package_data():
 
 
 def test_analysis_goldens_shipped_as_package_data():
-    # pst-analyze needs the golden wire manifest + reviewed baseline from
-    # an installed copy, not just a checkout
+    # pst-analyze needs the golden wire manifest, the per-extension
+    # protocol manifests, the knob registry, and the reviewed baseline
+    # from an installed copy, not just a checkout
     data = _pyproject()["tool"]["setuptools"]["package-data"]
     assert "*.json" in data["parameter_server_distributed_tpu.analysis"]
-    for fname in ("wire_manifest.json", "baseline.json"):
+    for fname in ("wire_manifest.json", "ext_manifests.json",
+                  "knob_registry.json", "baseline.json"):
         assert os.path.exists(os.path.join(
             REPO, "parameter_server_distributed_tpu", "analysis", fname))

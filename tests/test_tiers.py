@@ -80,7 +80,9 @@ def test_contribution_map_weights_and_covers():
 
 def test_aggregate_id_base_matches_postmortem_mirror():
     """obs/postmortem.py mirrors the constant (it must not import
-    tiers/); the two must never drift."""
+    tiers/); pst-analyze's flight-event pass is the primary drift gate
+    (slug ``tier-base-mirror``) — this keeps the one-line runtime check
+    close to the tier tests that depend on the labeling."""
     from parameter_server_distributed_tpu.obs import postmortem
     assert postmortem._TIER_ID_BASE == tmsg.TIER_AGGREGATE_ID_BASE
 

@@ -3000,6 +3000,13 @@ def bench_fleet() -> dict:
     would share one GIL + dispatch lock and could never scale, and the
     subprocess shape is exactly the production deployment.
 
+    After the size sweep, a **high-prefix-share arm** (ISSUE 20) rides
+    the same harness at the largest size with every prompt opening on
+    one shared 48-token system prompt: its row adds the fleet-wide
+    prefill-token ratio (prefill tokens forwarded / prompt tokens
+    submitted, from each server's Control STATUS counters), the direct
+    measure of how much prefill the radix prefix cache absorbed.
+
     PSDT_BENCH_FLEET_SIZES (default "1,2"), PSDT_BENCH_SLOTS (4),
     PSDT_BENCH_STEPS = tokens per stream (8), PSDT_BENCH_REQUESTS =
     streams per size (3x slots x size), PSDT_BENCH_ARRIVAL_HZ (default
@@ -3041,9 +3048,14 @@ def bench_fleet() -> dict:
     # compile caches inflate the bigger fleets' offered rate
     arrival_hz = float(os.environ.get("PSDT_BENCH_ARRIVAL_HZ", "0"))
 
-    for size in sizes:
-        n_req = int(os.environ.get("PSDT_BENCH_REQUESTS",
-                                   str(3 * slots * size)))
+    def run_arm(size: int, prompts: list, make_prompt) -> dict:
+        """One coordinator + size pst-serve subprocesses + router under
+        the shared open-loop arrival schedule; returns the measured row
+        including the fleet-wide prefill-token ratio (prefill tokens
+        actually forwarded / prompt tokens submitted, via each server's
+        Control STATUS counters — 1.0 means every prompt token ran a
+        prefill, lower means the radix cache absorbed the rest)."""
+        nonlocal arrival_hz
         coordinator = Coordinator(CoordinatorConfig(
             bind_address="127.0.0.1", port=0))
         cport = coordinator.start()
@@ -3070,8 +3082,29 @@ def bench_fleet() -> dict:
         rport = router.start()
         client = RpcClient(f"127.0.0.1:{rport}", fmsg.DECODE_SERVICE,
                            fmsg.DECODE_METHODS)
-        prompts = [rng.integers(1, vocab, 8).tolist()
-                   for _ in range(n_req)]
+
+        def poll_token_counters() -> tuple[int, int]:
+            """Fleet-wide (prefill_tokens, prompt_tokens) summed over
+            every ACTIVE server's Control STATUS (0/0 from pre-radix
+            servers — the ratio then reads 0 rather than lying)."""
+            total_prefill = total_prompt = 0
+            _e, table, _t = coordinator.core.fleet_table()
+            for member in table:
+                if member.state != fmsg.MEMBER_ACTIVE:
+                    continue
+                probe = RpcClient(member.address, fmsg.DECODE_SERVICE,
+                                  fmsg.DECODE_METHODS)
+                try:
+                    resp = probe.call(
+                        "Control",
+                        fmsg.DecodeControlRequest(action=fmsg.CTRL_STATUS),
+                        timeout=10.0)
+                    total_prefill += int(resp.prefill_tokens)
+                    total_prompt += int(resp.prompt_tokens)
+                finally:
+                    probe.close()
+            return total_prefill, total_prompt
+
         ttfts: list[float] = []
         failures: list[str] = []
         lock = threading.Lock()
@@ -3104,9 +3137,12 @@ def bench_fleet() -> dict:
         # spreading touches EVERY server — each pays its jit compiles
         # outside the measurement (a single warmup stream would warm
         # only the best-scoring server and the others would compile on
-        # their first measured request)
-        warm = [threading.Thread(target=drive,
-                                 args=(rng.integers(1, vocab, 8).tolist(),),
+        # their first measured request).  Warmup prompts come from the
+        # MEASURED distribution (make_prompt): the prefix-share arm
+        # must compile its extension runners — and seed every server's
+        # radix cache + fingerprint — before the clock starts, exactly
+        # as a steady-state fleet would be.
+        warm = [threading.Thread(target=drive, args=(make_prompt(),),
                                  daemon=True, name=f"fleet-warm-{i}")
                 for i in range(2 * size)]
         for thread in warm:
@@ -3129,6 +3165,7 @@ def bench_fleet() -> dict:
         failures.clear()  # calibration/warmup outcomes are unmeasured
         if arrival_hz <= 0:
             arrival_hz = 1.5 * max(sizes) * slots / service_s
+        prefill0, prompt0 = poll_token_counters()
         threads = []
         wall0 = time.perf_counter()
         for i, prompt in enumerate(prompts[1:]):
@@ -3145,7 +3182,9 @@ def bench_fleet() -> dict:
             thread.join(timeout=120.0)
         wall = time.perf_counter() - wall0
         completed = len(ttfts)
-        rows[str(size)] = {
+        prefill1, prompt1 = poll_token_counters()
+        submitted = prompt1 - prompt0
+        row = {
             "servers": size,
             "streams": completed,
             "failed": len(failures),
@@ -3155,8 +3194,11 @@ def bench_fleet() -> dict:
             "ttft_p99_ms": round(1e3 * float(np.percentile(ttfts, 99)), 1)
             if ttfts else 0.0,
             "arrival_hz": round(arrival_hz, 2),
+            "prompt_tokens": submitted,
+            "prefill_tokens": prefill1 - prefill0,
+            "prefill_token_ratio": round((prefill1 - prefill0) / submitted,
+                                         3) if submitted else 0.0,
         }
-        log(f"bench_fleet size {size}: {rows[str(size)]}")
         client.close()
         router.stop()
         for server in servers:
@@ -3167,6 +3209,36 @@ def bench_fleet() -> dict:
             except subprocess.TimeoutExpired:
                 server.kill()
         coordinator.stop()
+        return row
+
+    for size in sizes:
+        n_req = int(os.environ.get("PSDT_BENCH_REQUESTS",
+                                   str(3 * slots * size)))
+        prompts = [rng.integers(1, vocab, 8).tolist()
+                   for _ in range(n_req)]
+        rows[str(size)] = run_arm(
+            size, prompts, lambda: rng.integers(1, vocab, 8).tolist())
+        log(f"bench_fleet size {size}: {rows[str(size)]}")
+
+    # High-prefix-share arm (ISSUE 20): the motivating fleet workload —
+    # every stream opens with the SAME system prompt (3 fingerprint
+    # blocks of it) plus a short unique tail, at the largest fleet size
+    # under the same calibrated arrival schedule.  The radix cache
+    # should absorb the shared prefix after its first prefill
+    # (prefill_token_ratio ~ tail/total) and prefix-aware routing
+    # should keep the shared blocks pinned where they are warm; compare
+    # streams/s and p99 TTFT against the uniform-prompt row above.
+    big = sizes[-1]
+    n_req = int(os.environ.get("PSDT_BENCH_REQUESTS",
+                               str(3 * slots * big)))
+    system_prompt = rng.integers(1, vocab, 48).tolist()
+    prompts = [system_prompt + rng.integers(1, vocab, 6).tolist()
+               for _ in range(n_req)]
+    prefix_row = run_arm(
+        big, prompts,
+        lambda: system_prompt + rng.integers(1, vocab, 6).tolist())
+    rows[f"prefix_share_x{big}"] = prefix_row
+    log(f"bench_fleet prefix-share x{big}: {prefix_row}")
 
     biggest = rows[str(sizes[-1])]
     smallest = rows[str(sizes[0])]
@@ -3179,7 +3251,11 @@ def bench_fleet() -> dict:
             "note": f"streams/s scaling {scaling:.2f}x from fleet size "
                     f"{sizes[0]} to {sizes[-1]} "
                     f"({smallest['streams_per_s']} -> "
-                    f"{biggest['streams_per_s']})"}
+                    f"{biggest['streams_per_s']}); prefix-share arm "
+                    f"{prefix_row['streams_per_s']} streams/s, p99 TTFT "
+                    f"{prefix_row['ttft_p99_ms']}ms, prefill ratio "
+                    f"{prefix_row['prefill_token_ratio']} "
+                    f"(uniform {biggest['prefill_token_ratio']})"}
 
 
 def bench_async() -> dict:

@@ -58,6 +58,9 @@ class FleetMember:
     state: int = emsg.MEMBER_JOINING
     epoch: int = 0            # fleet epoch at the last state transition
     last_heartbeat: float = 0.0
+    # radix prefix-cache fingerprint (ISSUE 20): opaque packed block
+    # hashes the router scores prompt overlap against; empty = no cache
+    prefix_fp: bytes = b""
 
 
 @dataclasses.dataclass
@@ -396,10 +399,14 @@ class CoordinatorCore:
 
     def fleet_heartbeat(self, server_id: int, free_slots: int,
                         queue_depth: int, weight_version: int,
-                        active_streams: int) -> int | None:
+                        active_streams: int,
+                        prefix_fp: bytes = b"") -> int | None:
         """Load refresh; returns the server's own state (the drain
         signal) or None for an unknown/GONE server — the decode process
-        re-registers on None."""
+        re-registers on None.  ``prefix_fp`` rides every beat (the
+        cache churns continuously, so the row always carries the
+        latest snapshot; heartbeats deliberately do not bump the
+        epoch)."""
         now = self._time()
         with self._lock:
             member = self._fleet.get(int(server_id))
@@ -410,6 +417,7 @@ class CoordinatorCore:
             member.queue_depth = int(queue_depth)
             member.weight_version = int(weight_version)
             member.active_streams = int(active_streams)
+            member.prefix_fp = bytes(prefix_fp)
             return member.state
 
     def fleet_drain(self, server_id: int) -> bool:

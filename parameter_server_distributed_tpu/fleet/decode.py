@@ -235,6 +235,10 @@ class FleetDecodeServer:
     def weight_version(self) -> int:
         return int(getattr(self.server, "params_version", 0))
 
+    def prefix_fingerprint(self) -> bytes:
+        fn = getattr(self.server, "prefix_fingerprint", None)
+        return fn() if fn is not None else b""
+
     def publish_version(self, store: dict, version: int) -> None:
         """Hold a weight version in the bounded store (newest-kept LRU);
         auto-advancing servers also queue the swap.  A version at or
@@ -351,7 +355,10 @@ class FleetDecodeServer:
             state=state, slots=self.server.slots,
             free_slots=self.free_slots(), queue_depth=self.queue_depth(),
             weight_version=self.weight_version(), pinned_version=pinned,
-            versions_held=held, streams_served=self.streams_served)
+            versions_held=held, streams_served=self.streams_served,
+            prefill_tokens=int(getattr(self.server,
+                                       "_prefill_tokens", 0)),
+            prompt_tokens=int(getattr(self.server, "_prompt_tokens", 0)))
 
     def _run_command(self, command: tuple,
                      timeout: float = 30.0) -> tuple[bool, str]:
@@ -535,7 +542,13 @@ class FleetDecodeServer:
                     free_slots=self.free_slots(),
                     queue_depth=self.queue_depth(),
                     weight_version=self.weight_version(),
-                    active_streams=len(self._live)), timeout=5.0)
+                    active_streams=len(self._live),
+                    # radix prefix-cache fingerprint (ISSUE 20): an
+                    # immutable snapshot the decode thread swaps in, so
+                    # this cross-thread read needs no lock; empty when
+                    # the cache is off (router overlap term degrades
+                    # to zero)
+                    prefix_fp=self.prefix_fingerprint()), timeout=5.0)
                 if not resp.success:
                     # fell out of the table (reap after a stall):
                     # re-register — the row is the router's only view

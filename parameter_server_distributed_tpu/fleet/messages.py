@@ -52,7 +52,12 @@ CTRL_DRAIN = 4       # stop admitting, finish in-flight streams, leave
 
 class FleetEntry(Message):
     """One decode server's fleet row: identity, capacity, the load
-    signals the router scores on, and the weight version it serves."""
+    signals the router scores on, and the weight version it serves.
+    ``prefix_fp`` is the server's radix prefix-cache fingerprint
+    (packed chained-CRC32 block hashes — models/prefix_tree.py); empty
+    from servers without a prefix cache (or older builds), in which
+    case the router's overlap term is zero and scoring degrades to the
+    PR 14 free-slot/queue-depth order."""
     FIELDS = (
         Field(1, "server_id", "int32"),
         Field(2, "address", "string"),
@@ -63,6 +68,7 @@ class FleetEntry(Message):
         Field(7, "state", "int32"),
         Field(8, "epoch", "int32"),
         Field(9, "active_streams", "int32"),
+        Field(10, "prefix_fp", "bytes"),
     )
 
 
@@ -81,6 +87,7 @@ class FleetRequest(Message):
         Field(8, "active_streams", "int32"),
         Field(9, "target_server_id", "int32"),
         Field(10, "scale_target", "int32"),
+        Field(11, "prefix_fp", "bytes"),
         Field(TRACE_FIELD_NUMBER, "trace_context", "bytes"),
     )
 
@@ -153,6 +160,12 @@ class DecodeControlResponse(Message):
         Field(9, "pinned_version", "int32"),
         Field(10, "versions_held", "int32", repeated=True),
         Field(11, "streams_served", "int32"),
+        # prompt-phase reuse accounting (ISSUE 20): tokens the prompt
+        # phase actually forwarded vs prompt tokens admitted — the
+        # fleet bench's prefill-computed/prompt ratio numerator and
+        # denominator (0/0 from pre-radix builds)
+        Field(12, "prefill_tokens", "int64"),
+        Field(13, "prompt_tokens", "int64"),
     )
 
 

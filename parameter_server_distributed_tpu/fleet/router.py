@@ -6,12 +6,17 @@ downgrade matrix: no router => point ``pst-serve`` clients at the one
 server, byte-unchanged.
 
 Admission: each incoming ``SubmitStream`` picks the best ACTIVE backend
-by **free-slot / queue-depth score** (most free slots first, shortest
+by **free-slot / queue-depth score plus cached-prefix overlap** (free
+slots plus ``PSDT_ROUTE_OVERLAP_WEIGHT`` per leading prompt block
+already in the backend's radix prefix cache — fingerprints ride the
+``UpdateFleet`` heartbeats, models/prefix_tree.py — then shortest
 queue tie-break, server id as the stable final tie-break) from the
 coordinator's fleet table (TTL-polled over ``UpdateFleet``; the router
 additionally debits a claim per stream it routed since the last poll,
 so a burst between polls spreads instead of dogpiling the
-momentarily-best server).  The stream is then **pinned**: every chunk of
+momentarily-best server).  Backends without a fingerprint (cache off,
+pre-radix builds) score zero overlap, so the order degrades to exactly
+the PR 14 free-slot score.  The stream is then **pinned**: every chunk of
 its lifetime relays from that one backend — a mid-stream weight rollout
 on the backend swaps the version under the stream (PR 10 semantics, the
 tokens keep flowing), and the router never re-routes a live
@@ -30,7 +35,10 @@ import time
 
 import grpc
 
+import os
+
 from ..analysis.lock_order import checked_lock
+from ..models.prefix_tree import block_hashes, overlap_blocks, unpack_fp
 from ..obs import flight
 from ..obs import stats as obs_stats
 from ..rpc import messages as m
@@ -41,16 +49,40 @@ from . import messages as fmsg
 log = logging.getLogger("pst.fleet.router")
 
 
-def score_backends(entries, claims: dict[int, int] | None = None
-                   ) -> list:
-    """ACTIVE backends ordered best-first: most free slots (minus the
-    router's own un-heartbeaten claims), then shortest queue, then
-    server id.  Pure — the unit-testable policy."""
+def overlap_weight() -> float:
+    """Free-slot-equivalents one reusable prefix block is worth in the
+    routing score (``PSDT_ROUTE_OVERLAP_WEIGHT``): cache affinity may
+    outbid up to ``weight * blocks`` free slots, never an infinite
+    amount — a backend with a hot prefix but a long queue still loses
+    to an idle one eventually.  0 disables prefix-aware routing."""
+    return float(os.environ.get("PSDT_ROUTE_OVERLAP_WEIGHT", "1.0"))
+
+
+def score_backends(entries, claims: dict[int, int] | None = None,
+                   prompt_hashes=None, weight: float = 1.0) -> list:
+    """ACTIVE backends ordered best-first: free slots (minus the
+    router's own un-heartbeaten claims) PLUS cached-prefix overlap —
+    each leading block of the prompt already in a backend's radix cache
+    (``prompt_hashes`` vs the entry's heartbeated ``prefix_fp``) counts
+    as ``weight`` free slots — then shortest queue, then server id.
+    Pure — the unit-testable policy.  Without prompt hashes, or against
+    entries with no fingerprint (cache off, pre-radix builds), every
+    overlap is zero and the order is exactly the PR 14 free-slot/
+    queue-depth score (the downgrade matrix)."""
     claims = claims or {}
     live = [e for e in entries if int(e.state) == fmsg.MEMBER_ACTIVE]
+
+    def affinity(e) -> float:
+        fp = bytes(getattr(e, "prefix_fp", b""))
+        if not prompt_hashes or not fp or not weight:
+            return 0.0
+        return weight * overlap_blocks(prompt_hashes, unpack_fp(fp))
+
     return sorted(
         live,
-        key=lambda e: (-(int(e.free_slots) - claims.get(int(e.server_id), 0)),
+        key=lambda e: (-(int(e.free_slots)
+                         - claims.get(int(e.server_id), 0)
+                         + affinity(e)),
                        int(e.queue_depth), int(e.server_id)))
 
 
@@ -83,6 +115,9 @@ class FleetRouter:
         self._obs_routed = obs_stats.counter("fleet.routed")
         self._obs_rejected = obs_stats.counter("fleet.route_rejected")
         self._obs_backends = obs_stats.gauge("fleet.route_backends")
+        # prefix blocks of the last routed prompt already cached on the
+        # chosen backend (0 = no reusable prefix / fingerprints absent)
+        self._obs_overlap = obs_stats.gauge("fleet.route_overlap")
         self._coord = RpcClient(coordinator, m.COORDINATOR_SERVICE,
                                 fmsg.FLEET_COORD_METHODS)
         self._grpc = None
@@ -149,22 +184,33 @@ class FleetRouter:
                 1 for e in self._entries
                 if int(e.state) == fmsg.MEMBER_ACTIVE))
 
-    def _pick_backend(self):
+    def _pick_backend(self, prompt_tokens=None):
         """Best backend entry or None.  Debits a claim so concurrent
         admissions between polls spread across the fleet.  An empty
         view retries briefly (force-polling, yielding to a poll already
         in flight) before rejecting — a cold router's second concurrent
         admission must not bounce just because the first one's table
-        poll has not landed yet."""
+        poll has not landed yet.  ``prompt_tokens`` turns on
+        prefix-aware placement: the prompt's block hashes are scored
+        against each backend's heartbeated radix fingerprint, so
+        streams sharing a system prompt pin to the backend already
+        holding it."""
+        hashes = block_hashes(prompt_tokens) if prompt_tokens else None
+        weight = overlap_weight()
         self._refresh_table()
         deadline = time.monotonic() + 2.0
         while True:
             with self._lock:
-                ranked = score_backends(self._entries, self._claims)
+                ranked = score_backends(self._entries, self._claims,
+                                        hashes, weight)
                 if ranked:
                     best = ranked[0]
                     sid = int(best.server_id)
                     self._claims[sid] = self._claims.get(sid, 0) + 1
+                    if hashes:
+                        self._obs_overlap.set(overlap_blocks(
+                            hashes, unpack_fp(bytes(
+                                getattr(best, "prefix_fp", b"")))))
                     return best
             if time.monotonic() >= deadline:
                 return None
@@ -182,7 +228,7 @@ class FleetRouter:
 
     # ---------------------------------------------------------------- gRPC
     def SubmitStream(self, request: fmsg.DecodeRequest, context):
-        backend = self._pick_backend()
+        backend = self._pick_backend([int(t) for t in request.tokens])
         if backend is None:
             self._obs_rejected.add()
             yield fmsg.DecodeChunk(error="no decode servers available",

@@ -32,8 +32,8 @@ weight-quantized ``params`` store works unchanged.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
-from collections import OrderedDict
 from functools import partial
 from typing import Any, Mapping
 
@@ -41,11 +41,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import flight
 from ..obs import stats as obs_stats
 from .generation import (KVCache, QuantKVCache, _cached_runner,
                          _kv_quantize, _model_key, _spec_round_runner,
                          check_position_budget, decode_block, init_cache,
                          sample_token, sample_token_rowwise)
+from .prefix_tree import PrefixTree, RowRef
 from .transformer import Transformer
 
 Array = jax.Array
@@ -66,6 +68,13 @@ def _bucket(n: int, lo: int = 16) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _row_nbytes(row) -> int:
+    """Device bytes pinned by one cached K/V row (native: (k, v); int8:
+    (k8, v8, k_scale, v_scale)) — what the radix tree's byte-accounted
+    LRU charges against PSDT_PREFIX_CACHE_BYTES."""
+    return sum(int(leaf.nbytes) for leaf in row)
 
 
 def _place_params(params, mesh, rule):
@@ -337,7 +346,8 @@ class DecodeServer:
                  mesh=None, param_rule=None,
                  draft: Transformer | None = None, draft_params=None,
                  draft_len: int = 4, adaptive_draft: bool = True,
-                 draft_cost_ratio: float = 0.5, prompt_cache: int = 0):
+                 draft_cost_ratio: float = 0.5, prompt_cache: int = 0,
+                 prefix_cache_bytes: int | None = None):
         """``mesh`` turns on multi-chip serving: params are placed under
         ``param_rule`` (default: models.transformer.transformer_rule —
         Megatron TP columns/rows + fsdp) and the slot cache is sharded
@@ -370,18 +380,22 @@ class DecodeServer:
         (speculative commits are exact at ANY depth).
         ``adaptive_draft=False`` pins k = draft_len.
 
-        ``prompt_cache`` > 0 keeps the prefill results (final-position
-        logits + the prompt's K/V row, and the draft's row in
-        speculative mode) of the last N distinct prompts.  The key is
-        the EXACT full prompt — an identical resubmission (a retry, a
-        repeated canned query, a fixed prompt fanned out over sampling
-        settings) skips the prefill forward entirely and only splices;
-        a shared prefix with a different suffix is a MISS (this is
-        whole-prompt caching, not vLLM-style prefix reuse).
-        Token-exact: the cached row is exactly what the prefill would
-        recompute (params are fixed for the server's lifetime), and the
-        first token is re-sampled per request, so per-request
-        temperature still applies.  Entries pin device memory."""
+        ``prompt_cache`` > 0 turns on the radix-tree prefix cache
+        (models/prefix_tree.py): admitted prompts' prefill results
+        (final-position logits + the prompt's K/V row, and the draft's
+        row in speculative mode) are indexed token-by-token, so an
+        identical resubmission skips the prefill entirely and only
+        splices, while a prompt sharing ANY cached prefix — including
+        the interior of a longer cached prompt — forwards only its
+        suffix (vLLM-style prefix reuse, _extend_runner).  Token-exact:
+        a cached row is exactly what the prefill would recompute
+        (causal attention — see prefix_tree.py on handle sharing), and
+        the first token is re-sampled per request, so per-request
+        temperature still applies.  Cached rows pin device memory,
+        bounded by byte-accounted LRU over tree nodes:
+        ``prefix_cache_bytes`` (default env ``PSDT_PREFIX_CACHE_BYTES``,
+        256 MiB) — a hit touches the whole ancestor path, so a hot
+        shared prefix outlives its descendants' churn."""
         if prompt_cache < 0:
             raise ValueError(f"prompt_cache must be >= 0, "
                              f"got {prompt_cache}")
@@ -421,20 +435,30 @@ class DecodeServer:
         self._obs_active = obs_stats.gauge("serve.active_slots")
         self._obs_rate = obs_stats.gauge("serve.tokens_per_s")
         self._obs_accept = obs_stats.gauge("serve.accept_rate")
-        # prompt -> (last_logits, kv_row, draft_row|None), LRU-bounded;
-        # entries pin device memory, so the cap is the knob
+        # radix-tree prefix cache (ISSUE 20): token-level index over
+        # cached K/V rows — exact hits replay, any shared prefix seeds
+        # a suffix-only extension, byte-accounted LRU eviction.
+        # prompt_cache_size > 0 stays the enable switch (the PR 14 flag
+        # surface); the budget is bytes now, not entries.
         self.prompt_cache_size = prompt_cache
-        self._prompt_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        budget = (int(prefix_cache_bytes) if prefix_cache_bytes is not None
+                  else int(os.environ.get("PSDT_PREFIX_CACHE_BYTES",
+                                          "268435456")))
+        self._prefix_tree = PrefixTree(budget) if prompt_cache else None
         self._prompt_hits = 0
-        # shared-PREFIX reuse across requests (fleet/, ISSUE 14): a miss
-        # whose prompt extends a cached prompt forwards only the suffix
-        # (_extend_runner).  Plain mode, and speculative mode while the
-        # depth controller has speculation disabled (k == 0 — no draft
-        # row would be seeded anyway, ISSUE 15 satellite); active
-        # speculative admissions need a draft row the extension does
-        # not produce and keep the full prefill.
+        # shared-PREFIX reuse: a miss whose prompt shares a cached
+        # prefix forwards only the suffix (_extend_runner).  Speculative
+        # mode extends the DRAFT row from the same tree node alongside
+        # the target row (ISSUE 20 satellite — the PR 14 plain-mode-only
+        # restriction is gone); a k==0-era ancestor without a draft row
+        # falls back to a full draft prefill for the draft side only.
         self._prefix_hits = 0
         self._obs_prefix = obs_stats.counter("serve.prefix_hits")
+        # prompt-phase accounting for the fleet bench's reuse ratio:
+        # tokens actually forwarded in a prompt phase vs prompt tokens
+        # admitted (exact hit: 0, extension: the suffix, miss: all)
+        self._prefill_tokens = 0
+        self._prompt_tokens = 0
         # params version tag (fleet/ version-skew bookkeeping): 0 = boot
         # weights; swap_params(version=...) stamps the published version
         # every subsequently decoded token is attributed to
@@ -558,7 +582,7 @@ class DecodeServer:
         weights, which is the point of tracking a live run (token
         streams are uninterrupted, not retroactively recomputed).
 
-        The prompt cache is dropped: its prefill logits/KV rows were
+        The prefix cache is dropped: its prefill logits/KV rows were
         computed under the old weights, and replaying them would splice
         stale state next to fresh-weight decode steps.
 
@@ -581,7 +605,8 @@ class DecodeServer:
             params = _place_params(dict(params), self.mesh,
                                    self._param_rule)
         self.params = params
-        self._prompt_cache.clear()
+        if self._prefix_tree is not None:
+            self._prefix_tree.clear()
         self._n_swaps += 1
         if version is not None:
             self.params_version = int(version)
@@ -605,25 +630,39 @@ class DecodeServer:
                 return i
         return None
 
-    def _prefix_extend(self, prompt: np.ndarray, real_len: int):
-        """Shared-prefix half of the prompt cache: find the LONGEST
-        cached prompt that is a proper prefix of ``prompt`` and forward
-        only the suffix against its K/V row (_extend_runner).  Returns
-        (last logits, combined row, splice bucket) or None (no usable
-        prefix / combined row would not fit the cache).  The suffix
-        math is a ragged decode_block — exactly what decoding those
-        tokens one round at a time would compute — so the continuation
-        is decode-path-consistent by construction."""
-        best = None
-        for key in self._prompt_cache:
-            n = len(key)
-            if (n < real_len and (best is None or n > len(best))
-                    and tuple(int(t) for t in prompt[:n]) == key):
-                best = key
-        if best is None:
+    def prefix_fingerprint(self) -> bytes:
+        """Compact prefix fingerprint of the radix cache (packed chained
+        CRC32 block hashes — prefix_tree.block_hashes) for the fleet
+        heartbeat.  Safe to call from the heartbeat thread: it reads one
+        immutable bytes snapshot the decode thread swaps in after each
+        tree mutation.  Empty when the cache is off — the router's
+        overlap term degrades to zero and PR 14 scoring stands."""
+        tree = self._prefix_tree
+        return tree.fingerprint if tree is not None else b""
+
+    def _radix_extend(self, prompt: np.ndarray, real_len: int,
+                      node, matched: int):
+        """Shared-prefix extension from the deepest cached ancestor:
+        forward only the suffix past the ``matched``-token tree prefix
+        against the covering node's K/V row (_extend_runner).  Returns
+        (last logits, combined row, draft row | None) or None (no
+        usable prefix / combined row would not fit the slot cache —
+        the caller full-prefills).  The suffix math is a ragged
+        decode_block — exactly what decoding those tokens one round at
+        a time would compute — so the continuation is decode-path-
+        consistent by construction.  A prompt that IS a cached path
+        (an interior split node with no replayable logits) caps the
+        prefix at real_len - 1 and extends a single token.
+
+        Speculative mode extends the draft row from the same node's
+        draft handle; an ancestor admitted while the depth controller
+        had speculation off carries no draft row, so the draft side
+        (only) falls back to a full prefill — the target row still
+        rides the suffix-only path."""
+        plen = min(matched, real_len - 1)
+        if plen <= 0 or node.handle is None:
             return None
-        _last, pre_row, _d = self._prompt_cache[best]
-        plen = len(best)
+        pre_row = node.handle.row
         pbucket = int(pre_row[0].shape[1])
         slen = real_len - plen
         sbucket = _bucket(slen)
@@ -631,12 +670,47 @@ class DecodeServer:
             return None  # combined row would overflow the slot cache
         padded = np.zeros((1, sbucket), np.int32)
         padded[0, :slen] = prompt[plen:]
+        suffix = jnp.asarray(padded)
+        plen_j = jnp.asarray(plen, jnp.int32)
+        slen_j = jnp.asarray(slen, jnp.int32)
         last, row = _extend_runner(self.model, pbucket, sbucket,
                                    self.cache_dtype)(
-            self.params, pre_row, jnp.asarray(padded),
-            jnp.asarray(plen, jnp.int32), jnp.asarray(slen, jnp.int32))
-        self._prompt_cache.move_to_end(best)  # prefix reuse is a touch
-        return last, row, pbucket + sbucket
+            self.params, pre_row, suffix, plen_j, slen_j)
+        d_row = None
+        if self.draft is not None and self._k > 0:
+            dpre = node.dhandle.row if node.dhandle is not None else None
+            dbucket = int(dpre[0].shape[1]) if dpre is not None else 0
+            if dpre is not None and dbucket + sbucket <= self.max_len:
+                _, d_row = _extend_runner(self.draft, dbucket, sbucket,
+                                          self.cache_dtype)(
+                    self.draft_params, dpre, suffix, plen_j, slen_j)
+            else:
+                dbucket = min(_bucket(real_len), self.max_len)
+                dpadded = np.zeros((1, dbucket), np.int32)
+                dpadded[0, :real_len] = prompt
+                _, d_row = _prefill_runner(self.draft, dbucket,
+                                           self.cache_dtype)(
+                    self.draft_params, jnp.asarray(dpadded),
+                    jnp.asarray(real_len, jnp.int32))
+        self._prefix_tree.touch(node)  # the whole ancestor path is hot
+        self._prefill_tokens += slen
+        return last, row, d_row
+
+    def _admit_to_tree(self, pkey: tuple, last, row, d_row) -> None:
+        """Insert an admitted prompt's rows into the radix tree (an
+        edge split shares the descendant's handles — no device copy)
+        and run the byte-budget LRU eviction pass."""
+        tree = self._prefix_tree
+        splits = tree.splits
+        node = tree.insert(pkey, last, RowRef(row, _row_nbytes(row)),
+                           RowRef(d_row, _row_nbytes(d_row))
+                           if d_row is not None else None)
+        if tree.splits != splits:
+            flight.record("serve.prefix.split", a=node.depth,
+                          b=tree.nodes)
+        evicted = tree.evict_over_budget()
+        if evicted:
+            flight.record("serve.prefix.evict", a=evicted, b=tree.bytes)
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt, max_new_tokens: int = 64, *,
@@ -685,49 +759,53 @@ class DecodeServer:
         if self.draft is not None:
             check_position_budget(self.draft, real_len,
                                   max_new_tokens + slack)
-        pkey = (tuple(int(t) for t in prompt)
-                if self.prompt_cache_size else None)
-        hit = (self._prompt_cache.get(pkey)
-               if self.prompt_cache_size else None)
+        tree = self._prefix_tree
+        pkey = tuple(int(t) for t in prompt) if tree is not None else None
+        hit = None
+        anc, matched = None, 0
+        if tree is not None:
+            anc, matched, partial = tree.lookup(pkey)
+            if (matched == real_len and not partial
+                    and anc.last is not None):
+                hit = anc  # whole-prompt node: replayable logits + row
         if hit is not None:
-            self._prompt_cache.move_to_end(pkey)  # LRU touch
+            tree.touch(hit)  # the whole ancestor path, not one entry
             self._prompt_hits += 1
-            last, row, d_row = hit
+            self._prompt_tokens += real_len
+            last = hit.last
+            row = hit.handle.row
+            d_row = hit.dhandle.row if hit.dhandle is not None else None
             if self.draft is not None and self._k > 0 and d_row is None:
-                # entry was cached while the controller had speculation
+                # node was cached while the controller had speculation
                 # off (k=0 skips the draft prefill below); replaying it
                 # as-is after a re-probe re-armed k would skip the draft
                 # splice and leave this slot's _d_lengths/_prev stale —
-                # backfill the draft half and repair the cached entry
+                # backfill the draft half and attach it to the node
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :real_len] = prompt
                 _, d_row = _prefill_runner(self.draft, bucket,
                                            self.cache_dtype)(
                     self.draft_params, jnp.asarray(padded),
                     jnp.asarray(real_len, jnp.int32))
-                self._prompt_cache[pkey] = (last, row, d_row)
+                self._admit_to_tree(pkey, last, row, d_row)
         else:
-            # Shared-prefix extension serves the prompt phase whenever a
-            # draft K/V row would NOT be seeded anyway: plain mode, and
-            # speculative mode while the depth controller has
-            # speculation off (k == 0 skips the draft prefill below, so
-            # the extension gives up nothing — speculative fleets stop
-            # paying full prefill on every extending miss).  With k > 0
-            # the admission needs a draft row the extension cannot
-            # produce, so it stays on the full-prefill path; a later
-            # re-probe backfills cached entries via the d_row repair
-            # above, exactly like any other k==0-era entry.
-            extended = (self._prefix_extend(prompt, real_len)
-                        if self.prompt_cache_size
-                        and (self.draft is None or self._k == 0)
-                        else None)
+            # Shared-prefix extension serves the prompt phase whenever
+            # the tree holds ANY prefix of this prompt — including the
+            # interior of a longer cached prompt (the radix point) —
+            # and in speculative mode the draft row extends alongside
+            # the target row (_radix_extend), so spec admissions no
+            # longer fall back to full prefill (ISSUE 20 satellite).
+            extended = (self._radix_extend(prompt, real_len, anc, matched)
+                        if tree is not None else None)
             if extended is not None:
-                # shared-prefix hit: only the suffix ran a forward; the
-                # combined row splices below under its own (wider) bucket
-                last, row, bucket = extended
-                d_row = None
+                # only the suffix ran a forward; the combined row
+                # splices below under its own (wider) width
+                last, row, d_row = extended
                 self._prefix_hits += 1
                 self._obs_prefix.add()
+                flight.record("serve.prefix.hit",
+                              a=min(matched, real_len - 1),
+                              b=real_len - min(matched, real_len - 1))
             else:
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :real_len] = prompt
@@ -736,6 +814,7 @@ class DecodeServer:
                     self.params, jnp.asarray(padded),
                     jnp.asarray(real_len, jnp.int32))
                 d_row = None
+                self._prefill_tokens += real_len
                 if self.draft is not None and self._k > 0:
                     # k=0 (controller disabled speculation): the draft
                     # cache is not read while disabled, so skip its
@@ -745,18 +824,23 @@ class DecodeServer:
                                                self.cache_dtype)(
                         self.draft_params, jnp.asarray(padded),
                         jnp.asarray(real_len, jnp.int32))
-            if self.prompt_cache_size:
-                self._prompt_cache[pkey] = (last, row, d_row)
-                while len(self._prompt_cache) > self.prompt_cache_size:
-                    self._prompt_cache.popitem(last=False)
+            self._prompt_tokens += real_len
+            if tree is not None:
+                self._admit_to_tree(pkey, last, row, d_row)
         req_temp = self._temperature if temperature is None else temperature
         self._rng, sub = jax.random.split(self._rng)
         first = int(sample_token(last[None], sub, req_temp,
                                  self._top_k, self._top_p)[0])
-        self._cache = _splice_runner(self.model, bucket, self.cache_dtype)(
+        # splice widths come from the rows themselves: a radix-served
+        # row is prefix-bucket + suffix-bucket wide, and the target and
+        # draft rows may differ (each extended from its own ancestor
+        # width)
+        self._cache = _splice_runner(self.model, int(row[0].shape[1]),
+                                     self.cache_dtype)(
             self._cache, row, jnp.asarray(slot, jnp.int32))
         if self.draft is not None and d_row is not None:
-            self._d_cache = _splice_runner(self.draft, bucket,
+            self._d_cache = _splice_runner(self.draft,
+                                           int(d_row[0].shape[1]),
                                            self.cache_dtype)(
                 self._d_cache, d_row, jnp.asarray(slot, jnp.int32))
             self._d_lengths[slot] = real_len
@@ -983,6 +1067,13 @@ class DecodeServer:
         if self.prompt_cache_size:
             out["prompt_cache_hits"] = self._prompt_hits
             out["prefix_hits"] = self._prefix_hits
+            out["prefix_cache_nodes"] = self._prefix_tree.nodes
+            out["prefix_cache_bytes"] = self._prefix_tree.bytes
+            out["prefix_evictions"] = self._prefix_tree.evictions
+        # prompt-phase reuse ratio inputs (fleet bench): tokens the
+        # prompt phase actually forwarded vs prompt tokens admitted
+        out["prefill_tokens"] = self._prefill_tokens
+        out["prompt_tokens"] = self._prompt_tokens
         if self.draft is not None:
             out["draft_accept_rate"] = (
                 self._spec_accepted / self._spec_proposed

@@ -208,6 +208,14 @@ EVENTS: dict[str, int] = {
     "apply.sharded": 152,         # sharded close published; a =
                                   # replica count, b = wire bytes;
                                   # note = duration
+    # radix-tree prefix cache (models/prefix_tree.py, ISSUE 20)
+    "serve.prefix.hit": 160,      # suffix-only admission; a = prefix
+                                  # tokens reused, b = suffix tokens
+                                  # forwarded
+    "serve.prefix.evict": 161,    # byte-budget LRU pass; a = nodes
+                                  # evicted, b = bytes pinned after
+    "serve.prefix.split": 162,    # edge split at a divergence point;
+                                  # a = split-node depth, b = tree nodes
 }
 EVENT_NAMES = {code: name for name, code in EVENTS.items()}
 

@@ -156,6 +156,11 @@ EVENT_DECODE: dict[str, str] = {
     "shard.update.degrade": "sharded close degraded to replicated path "
                             "(note = reason)",
     "apply.sharded": "sharded close published; a=replicas b=wire bytes",
+    "serve.prefix.hit": "radix prefix reuse; a=prefix tokens reused "
+                        "b=suffix tokens forwarded",
+    "serve.prefix.evict": "prefix-cache LRU pass; a=nodes evicted "
+                          "b=bytes pinned after",
+    "serve.prefix.split": "radix edge split; a=split depth b=tree nodes",
 }
 
 

@@ -186,7 +186,8 @@ class CoordinatorService:
         elif request.action == fmsg.FLEET_HEARTBEAT:
             state = self.core.fleet_heartbeat(
                 sid, request.free_slots, request.queue_depth,
-                request.weight_version, request.active_streams)
+                request.weight_version, request.active_streams,
+                prefix_fp=bytes(request.prefix_fp))
             if state is None:
                 ok, message = False, f"server {sid} unknown (re-register)"
         elif request.action == fmsg.FLEET_LEAVE:
@@ -213,7 +214,8 @@ class CoordinatorService:
                 server_id=f.server_id, address=f.address, slots=f.slots,
                 free_slots=f.free_slots, queue_depth=f.queue_depth,
                 weight_version=f.weight_version, state=f.state,
-                epoch=f.epoch, active_streams=f.active_streams)
+                epoch=f.epoch, active_streams=f.active_streams,
+                prefix_fp=f.prefix_fp)
                 for f in fleet])
 
     # ----------------------------------------------------------------- tiers

@@ -201,13 +201,15 @@ def test_bench_fleet_contract():
     (PSDT_BENCH_ROUND_DELAY_MS) so the control plane's scaling shows
     even on a small CI host: 2 servers must sustain materially more
     streams/s than 1 against the same arrival schedule, with zero
-    failed streams either way."""
+    failed streams either way.  The high-prefix-share arm (ISSUE 20)
+    must show the radix cache absorbing the shared system prompt: its
+    fleet-wide prefill-token ratio well under the uniform arm's."""
     result = run_bench("fleet", extra_env={
         "PSDT_BENCH_STEPS": "6",
         "PSDT_BENCH_REQUESTS": "16",
         "PSDT_BENCH_FLEET_SIZES": "1,2",
         "PSDT_BENCH_ROUND_DELAY_MS": "25",
-    }, timeout=420.0)
+    }, timeout=540.0)
     assert result["metric"].startswith("fleet_streams_per_s")
     assert result["value"] > 0
     one, two = result["sizes"]["1"], result["sizes"]["2"]
@@ -215,6 +217,14 @@ def test_bench_fleet_contract():
     assert one["streams"] > 0 and two["streams"] > 0
     assert two["streams_per_s"] > 1.25 * one["streams_per_s"], \
         result["note"]
+    prefix = result["sizes"]["prefix_share_x2"]
+    assert prefix["failed"] == 0 and prefix["streams"] > 0
+    # shared prefixes must not be re-prefilled: most prompt tokens are
+    # the 48-token system prompt, forwarded once then served from the
+    # radix cache — the ratio collapses vs the unique-prompt arm
+    assert prefix["prefill_token_ratio"] < 0.5, result["note"]
+    assert (prefix["prefill_token_ratio"]
+            < two["prefill_token_ratio"]), result["note"]
 
 
 @pytest.mark.slow

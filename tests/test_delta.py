@@ -812,9 +812,11 @@ def test_swap_params_drops_prompt_cache_and_counts(rng=None):
     srv = DecodeServer(model, params, slots=2, max_len=64, prompt_cache=2)
     rid = srv.submit([1, 2, 3, 4], max_new_tokens=4)
     srv.run_to_completion()
-    assert srv._prompt_cache  # warmed
+    assert srv._prefix_tree.nodes  # warmed
     srv.swap_params(model.init_params(1))
-    assert not srv._prompt_cache  # stale prefill state dropped
+    assert not srv._prefix_tree.nodes  # stale prefill state dropped
+    assert srv._prefix_tree.bytes == 0
+    assert srv.prefix_fingerprint() == b""
     rid2 = srv.submit([1, 2, 3, 4], max_new_tokens=4)
     out = srv.run_to_completion()
     assert len(out[rid2]) == 4

@@ -59,11 +59,11 @@ def reference(prompt, n):
 
 
 def entry(sid, state=fmsg.MEMBER_ACTIVE, free=4, queue=0, slots=4,
-          version=0):
+          version=0, fp=b""):
     return fmsg.FleetEntry(server_id=sid, address=f"h:{5000 + sid}",
                            slots=slots, free_slots=free,
                            queue_depth=queue, weight_version=version,
-                           state=state)
+                           state=state, prefix_fp=fp)
 
 
 # --------------------------------------------------------------- registry
@@ -128,6 +128,75 @@ def test_router_scoring_prefers_free_slots_then_queue():
     # claims debit capacity the table has not yet heartbeaten
     ranked = score_backends(entries, claims={1: 3})
     assert [e.server_id for e in ranked] == [2, 0, 1]
+
+
+def test_router_scoring_prefix_overlap_affinity():
+    """ISSUE 20: cached-prefix overlap counts as weight free slots —
+    a backend already holding the prompt's leading blocks outranks an
+    equally-free one; with no fingerprints, no prompt hashes, or weight
+    0 the order is EXACTLY the PR 14 free-slot score (the downgrade)."""
+    from parameter_server_distributed_tpu.models.prefix_tree import (
+        pack_fp)
+    hashes = [111, 222]
+    entries = [entry(0, free=2), entry(1, free=2),
+               entry(2, free=2, fp=pack_fp([111, 222, 333]))]
+    # overlap 2 on server 2 beats the sid tie-break
+    ranked = score_backends(entries, prompt_hashes=hashes, weight=1.0)
+    assert [e.server_id for e in ranked] == [2, 0, 1]
+    # one-block overlap loses to one extra free slot at weight 1.0 ...
+    entries = [entry(0, free=3), entry(1, free=2, fp=pack_fp([111]))]
+    ranked = score_backends(entries, prompt_hashes=hashes, weight=1.0)
+    assert [e.server_id for e in ranked] == [0, 1]
+    # ... and wins at weight 2.0
+    ranked = score_backends(entries, prompt_hashes=hashes, weight=2.0)
+    assert [e.server_id for e in ranked] == [1, 0]
+    # downgrades: weight 0 / no hashes / fingerprint-free entries all
+    # reproduce the PR 14 ordering
+    entries = [entry(0, free=1), entry(1, free=3),
+               entry(2, free=3, queue=2, fp=pack_fp([111, 222]))]
+    assert [e.server_id for e in
+            score_backends(entries, prompt_hashes=hashes,
+                           weight=0.0)] == [1, 2, 0]
+    assert [e.server_id for e in
+            score_backends(entries, prompt_hashes=None)] == [1, 2, 0]
+    # a diverging prompt (no leading-block match) scores zero overlap
+    assert [e.server_id for e in
+            score_backends(entries, prompt_hashes=[999],
+                           weight=5.0)] == [1, 2, 0]
+
+
+def test_heartbeat_carries_prefix_fingerprint():
+    """The fingerprint rides the heartbeat into the fleet table and
+    back out of UpdateFleet QUERY — pre-radix heartbeats (no field)
+    leave it empty rather than erroring."""
+    core = CoordinatorCore("127.0.0.1", 1234)
+    core.fleet_register(7, "h:1", 4)
+    core.fleet_heartbeat(7, 4, 0, 0, 0, prefix_fp=b"\x01\x02\x03\x04")
+    _epoch, table, _t = core.fleet_table()
+    assert table[0].prefix_fp == b"\x01\x02\x03\x04"
+    core.fleet_heartbeat(7, 4, 0, 0, 0)  # positional legacy caller
+    assert core.fleet_table()[1][0].prefix_fp == b""
+
+
+def test_heartbeat_fingerprint_rpc_roundtrip():
+    coordinator = Coordinator(CoordinatorConfig(bind_address="127.0.0.1",
+                                                port=0))
+    cport = coordinator.start()
+    coordinator.core.fleet_register(0, "h:1", 4)
+    client = RpcClient(f"127.0.0.1:{cport}", "coordinator.Coordinator",
+                       fmsg.FLEET_COORD_METHODS)
+    try:
+        client.call("UpdateFleet", fmsg.FleetRequest(
+            server_id=0, action=fmsg.FLEET_HEARTBEAT, free_slots=4,
+            prefix_fp=b"\xaa\xbb\xcc\xdd"), timeout=5.0)
+        resp = client.call("UpdateFleet", fmsg.FleetRequest(
+            server_id=-1, action=fmsg.FLEET_QUERY), timeout=5.0)
+    finally:
+        client.close()
+        coordinator.stop()
+    by_sid = {int(e.server_id): bytes(e.prefix_fp)
+              for e in resp.entries}
+    assert by_sid[0] == b"\xaa\xbb\xcc\xdd"
 
 
 def test_scale_decision_watermarks_and_manual():
@@ -242,6 +311,97 @@ def test_router_spreads_streams_and_pins(fleet2, rng):
     served = [s.streams_served for s in fleet2.servers]
     assert sum(served) == 6
     assert all(n > 0 for n in served), f"one server idle: {served}"
+
+
+def test_router_prefers_backend_with_cached_prefix(rng):
+    """End-to-end prefix-aware placement (ISSUE 20): after one stream
+    warms a backend's radix cache with a long shared prefix, a second
+    stream sharing that prefix routes to the SAME backend (overlap
+    outbids the sid tie-break) and rides its suffix-only path — and
+    stays token-exact through the router."""
+    fleet = _Fleet(2, prompt_cache=8)
+    try:
+        shared = [int(t) for t in rng.integers(1, VOCAB, 18)]
+        first = shared + [int(t) for t in rng.integers(1, VOCAB, 3)]
+        tokens, _v, error = fleet.stream(first, max_new=4)
+        assert not error and tokens == reference(first, 4)
+        warm = next(s for s in fleet.servers if s.streams_served == 1)
+        # wait for the fingerprint heartbeat to land in the fleet table
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            _e, table, _t = fleet.coordinator.core.fleet_table()
+            if any(m.server_id == warm.server_id and m.prefix_fp
+                   for m in table):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("prefix fingerprint never heartbeaten")
+        second = shared + [int(t) for t in rng.integers(1, VOCAB, 4)]
+        tokens, _v, error = fleet.stream(second, max_new=4)
+        assert not error and tokens == reference(second, 4)
+        assert warm.streams_served == 2, "router ignored the warm cache"
+        assert warm.server.stats["prefix_hits"] == 1  # suffix-only path
+    finally:
+        fleet.close()
+
+
+@pytest.mark.lockcheck
+def test_lockcheck_concurrent_prefix_admit_extend_evict_swap(rng):
+    """Radix cache under the real thread mix, PSDT_LOCK_CHECK=1: gRPC
+    streams sharing prefixes (admit / extend / byte-bound evict on the
+    decode loop), mid-hammer weight swaps (tree clear), and the
+    heartbeat thread reading the fingerprint snapshot — every stream
+    token-exact, no lock-order assertion."""
+    server = FleetDecodeServer(
+        DecodeServer(_MODEL, _PARAMS, slots=4, max_len=160,
+                     prompt_cache=8, prefix_cache_bytes=1 << 16),
+        server_id=0, heartbeat_s=0.02)
+    server.start()
+    shared = [int(t) for t in rng.integers(1, VOCAB, 14)]
+    prompts = [shared[:6 + 4 * (i % 3)]
+               + [int(t) for t in rng.integers(1, VOCAB, 3)]
+               for i in range(12)]
+    results = []
+    lock = threading.Lock()
+
+    def drive(worker):
+        client = RpcClient(server.address, fmsg.DECODE_SERVICE,
+                           fmsg.DECODE_METHODS)
+        try:
+            for prompt in prompts[worker::4]:
+                chunks = list(client.call(
+                    "SubmitStream",
+                    fmsg.DecodeRequest(tokens=prompt, max_new=4,
+                                       temperature=-1.0), timeout=None))
+                with lock:
+                    results.append(
+                        (prompt,
+                         [int(c.token) for c in chunks if not c.done],
+                         chunks[-1].error))
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=drive, args=(i,), daemon=True,
+                                name=f"prefix-hammer-{i}")
+               for i in range(4)]
+    for thread in threads:
+        thread.start()
+    store = {name: np.array(arr) for name, arr in _PARAMS.items()}
+    for version in (1, 2, 3):  # same values: swaps stay token-exact
+        server.publish_version(store, version)
+        resp = server.Control(fmsg.DecodeControlRequest(
+            action=fmsg.CTRL_SWAP, version=version), None)
+        assert resp.success, resp.message
+        time.sleep(0.05)
+    for thread in threads:
+        thread.join(timeout=120.0)
+    try:
+        assert len(results) == 12
+        assert all(not err for _p, _t, err in results)
+        for prompt, tokens, _err in results:
+            assert tokens == reference(prompt, 4)
+    finally:
+        server.stop()
 
 
 def test_empty_fleet_rejects_instead_of_hanging():
@@ -605,8 +765,12 @@ def test_fleet_messages_wire_roundtrip():
     req = fmsg.FleetRequest(server_id=3, action=fmsg.FLEET_HEARTBEAT,
                             address="h:1", slots=8, free_slots=2,
                             queue_depth=5, weight_version=7,
-                            active_streams=6)
+                            active_streams=6,
+                            prefix_fp=b"\x01\x00\x00\x00\x02\x00\x00\x00")
     assert fmsg.FleetRequest.decode(req.encode()) == req
+    ent = fmsg.FleetEntry(server_id=1, address="h:2", slots=4,
+                          prefix_fp=b"\xff\xee\xdd\xcc")
+    assert fmsg.FleetEntry.decode(ent.encode()) == ent
     resp = fmsg.FleetResponse(epoch=4, success=True, message="ok",
                               self_state=1, scale_target=2,
                               entries=[fmsg.FleetEntry(server_id=1,

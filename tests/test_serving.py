@@ -102,12 +102,16 @@ def test_eos_frees_slot_early(rng):
 
 
 def test_prompt_cache_token_exact_and_lru(rng):
-    """A repeated prompt served from the prompt cache decodes EXACTLY the
-    tokens of an uncached server; distinct prompts evict LRU-style; the
+    """A repeated prompt served from the radix cache decodes EXACTLY the
+    tokens of an uncached server; the byte budget evicts LRU-style; the
     hit counter surfaces in stats; a negative cap is rejected."""
     model = tiny()
     params = model.init_params(0)
-    prompts = [list(rng.integers(0, 96, n)) for n in (6, 9, 13)]
+    # distinct first tokens: three independent root edges, so each
+    # admission pins exactly one 16-bucket K/V row and the byte-budget
+    # arithmetic below is row-exact
+    prompts = [[i * 7 + 1] + list(rng.integers(0, 96, n))
+               for i, n in enumerate((5, 8, 12))]
     plain = DecodeServer(model, params, slots=2, max_len=64)
     expect = {}
     for i, p in enumerate(prompts):
@@ -115,17 +119,26 @@ def test_prompt_cache_token_exact_and_lru(rng):
         expect[i] = plain.run_to_completion()[rid]
 
     srv = DecodeServer(model, params, slots=2, max_len=64, prompt_cache=2)
+    srv.submit(prompts[0], max_new_tokens=5)
+    srv.run_to_completion()
+    row_bytes = srv._prefix_tree.bytes   # one 16-bucket row
+    assert row_bytes > 0
+    srv._prefix_tree.budget_bytes = 2 * row_bytes   # hold exactly 2 rows
     # each prompt twice: second submit of each must hit the cache
-    for i, p in enumerate(prompts[:2]):
-        for _ in range(2):
-            rid = srv.submit(p, max_new_tokens=5)
-            assert srv.run_to_completion()[rid] == expect[i]
+    rid = srv.submit(prompts[0], max_new_tokens=5)
+    assert srv.run_to_completion()[rid] == expect[0]
+    for _ in range(2):
+        rid = srv.submit(prompts[1], max_new_tokens=5)
+        assert srv.run_to_completion()[rid] == expect[1]
     assert srv.stats["prompt_cache_hits"] == 2
-    # cap 2: admitting a 3rd distinct prompt evicts the LRU entry
+    # byte budget = 2 rows: admitting a 3rd distinct prompt evicts the
+    # least-recently-touched node (prompts[0])
     rid = srv.submit(prompts[2], max_new_tokens=5)
     assert srv.run_to_completion()[rid] == expect[2]
-    assert len(srv._prompt_cache) == 2
-    # the evicted prompt (prompts[0] — least recently used) misses again
+    assert srv._prefix_tree.nodes == 2
+    assert srv._prefix_tree.bytes <= srv._prefix_tree.budget_bytes
+    assert srv.stats["prefix_evictions"] == 1
+    # the evicted prompt misses again (and re-evicts to stay in budget)
     hits_before = srv._prompt_hits
     rid = srv.submit(prompts[0], max_new_tokens=5)
     assert srv.run_to_completion()[rid] == expect[0]
@@ -185,10 +198,119 @@ def test_prefix_cache_overflow_falls_back_to_full_prefill(rng):
     assert srv.stats["prefix_hits"] == 0  # fell back, correctly
 
 
-def test_prefix_cache_not_used_in_speculative_mode(rng):
-    """Speculative admissions also need a draft K/V row, which the
-    suffix extension does not produce — prefix reuse stays off there
-    (whole-prompt hits still work)."""
+@pytest.mark.parametrize("cache_dtype", ["native", "int8"])
+def test_radix_interior_prefix_reuse(rng, cache_dtype):
+    """The radix point (ISSUE 20): a prompt sharing a prefix with the
+    INTERIOR of a longer cached prompt — a prefix that was never
+    admitted as a complete prompt — still rides the suffix-only path
+    (the PR 14 whole-prompt scan missed exactly this), splitting the
+    cached edge at the divergence token.  Token-exact vs generate."""
+    model = tiny()
+    params = model.init_params(0)
+    long_prompt = list(rng.integers(0, 96, 20))
+    fork = long_prompt[:13] + list(rng.integers(0, 96, 6))
+    assert fork[13] != long_prompt[13] or fork.__setitem__(
+        13, (long_prompt[13] + 1) % 96) or True
+    srv = DecodeServer(model, params, slots=2, max_len=96,
+                       prompt_cache=8, cache_dtype=cache_dtype)
+    srv.submit(long_prompt, max_new_tokens=4)
+    srv.run_to_completion()
+    rid = srv.submit(fork, max_new_tokens=4)
+    assert srv.run_to_completion()[rid] == reference(model, params,
+                                                     fork, 4)
+    assert srv.stats["prefix_hits"] == 1
+    assert srv._prefix_tree.splits == 1  # edge split at token 13
+    # the split shares the long prompt's row — no extra device bytes
+    # beyond the two admitted rows
+    assert srv._prefix_tree.nodes == 3
+
+
+@pytest.mark.parametrize("cache_dtype", ["native", "int8"])
+def test_radix_multi_hop_extension_token_exact(rng, cache_dtype):
+    """Multi-hop chaining: each admission extends from the DEEPEST
+    cached ancestor, whose row is itself extension-built — prefix
+    buckets compound (16, 32, 48, 64) and every generation stays
+    token-exact vs standalone generate."""
+    model = tiny()
+    params = model.init_params(0)
+    prompt = list(rng.integers(0, 96, 7))
+    srv = DecodeServer(model, params, slots=2, max_len=128,
+                       prompt_cache=8, cache_dtype=cache_dtype)
+    for hop, extra in enumerate((0, 4, 5, 3)):
+        prompt = prompt + list(rng.integers(0, 96, extra))
+        rid = srv.submit(prompt, max_new_tokens=4)
+        assert srv.run_to_completion()[rid] == reference(model, params,
+                                                         prompt, 4)
+        assert srv.stats["prefix_hits"] == hop
+    # each hop's combined row is one suffix bucket wider
+    node, matched, partial = srv._prefix_tree.lookup(
+        tuple(int(t) for t in prompt))
+    assert matched == len(prompt) and not partial
+    assert int(node.handle.row[0].shape[1]) == 64  # 16+16+16+16
+
+
+def test_radix_deepest_common_ancestor_wins(rng):
+    """With several cached prefixes of the same prompt, extension seeds
+    from the DEEPEST one (most reuse, shortest suffix forward)."""
+    model = tiny()
+    params = model.init_params(0)
+    base = list(rng.integers(0, 96, 6))
+    mid = base + list(rng.integers(0, 96, 5))
+    srv = DecodeServer(model, params, slots=2, max_len=128,
+                       prompt_cache=8)
+    for p in (base, mid):
+        srv.submit(p, max_new_tokens=3)
+        srv.run_to_completion()
+    before = srv._prefill_tokens
+    longer = mid + list(rng.integers(0, 96, 4))
+    rid = srv.submit(longer, max_new_tokens=3)
+    assert srv.run_to_completion()[rid] == reference(model, params,
+                                                     longer, 3)
+    # only the 4-token suffix past `mid` ran a forward — not the
+    # 9-token suffix past `base`
+    assert srv._prefill_tokens - before == len(longer) - len(mid)
+    assert srv.stats["prefix_hits"] == 2  # mid extended base, longer mid
+
+
+def test_radix_ancestor_path_touch_protects_shared_prefix(rng):
+    """ISSUE 20 satellite: a hit through a descendant touches the WHOLE
+    ancestor path, so a hot shared prefix is never the LRU victim while
+    its descendants live — the PR 14 cache touched only the source
+    entry."""
+    model = tiny()
+    params = model.init_params(0)
+    shared = list(rng.integers(0, 96, 6))
+    a = shared + list(rng.integers(0, 96, 4))
+    b = shared + [(a[6] + 1) % 96] + list(rng.integers(0, 96, 3))
+    other = [(shared[0] + 1) % 96] + list(rng.integers(0, 96, 8))
+    srv = DecodeServer(model, params, slots=2, max_len=96,
+                       prompt_cache=8)
+    for p in (shared, other, a, b):
+        srv.submit(p, max_new_tokens=3)
+        srv.run_to_completion()
+    tree = srv._prefix_tree
+    # shared's node is tick-fresher than `other` despite being admitted
+    # earlier: a and b both touched their ancestor path through it
+    snode, sm, _ = tree.lookup(tuple(shared))
+    onode, om, _ = tree.lookup(tuple(other))
+    assert sm == len(shared) and om == len(other)
+    assert snode.tick > onode.tick
+    # evict down to just over two rows: `other` (stale) must go before
+    # the shared prefix every descendant rides on
+    tree.budget_bytes = tree.bytes - 1
+    tree.evict_over_budget()
+    onode2, om2, _ = tree.lookup(tuple(other))
+    assert om2 < len(other)          # the cold entry was the victim
+    snode2, sm2, _ = tree.lookup(tuple(shared))
+    assert sm2 == len(shared) and snode2.last is not None
+
+
+def test_prefix_reuse_in_speculative_mode(rng):
+    """ISSUE 20 satellite (the PR 14 leftover closed): a speculative
+    admission sharing a cached prefix extends BOTH the target and the
+    draft K/V row from the tree node (draft rows are cached alongside),
+    so it no longer falls back to full prefill — and greedy speculative
+    decode stays token-exact vs the plain greedy server."""
     model = tiny()
     params = model.init_params(0)
     draft = tiny(n_layers=1)
@@ -198,6 +320,7 @@ def test_prefix_cache_not_used_in_speculative_mode(rng):
     srv = DecodeServer(model, params, slots=2, max_len=96,
                        prompt_cache=4, draft=draft, draft_params=dparams,
                        draft_len=2)
+    assert srv._k > 0  # speculation armed: the old code full-prefilled
     srv.submit(base, max_new_tokens=4)
     srv.run_to_completion()
     rid = srv.submit(ext, max_new_tokens=4)
@@ -205,7 +328,7 @@ def test_prefix_cache_not_used_in_speculative_mode(rng):
     prid = plain.submit(ext, max_new_tokens=4)
     assert (srv.run_to_completion()[rid]
             == plain.run_to_completion()[prid])
-    assert srv.stats["prefix_hits"] == 0
+    assert srv.stats["prefix_hits"] == 1  # suffix-only, both models
 
 
 def test_prefix_extension_when_speculation_disabled(rng):
@@ -213,9 +336,9 @@ def test_prefix_extension_when_speculation_disabled(rng):
     speculative server whose depth controller has speculation OFF
     (k == 0 — no draft row would be seeded anyway) falls back to
     plain-mode shared-prefix extension for the prompt phase, token-exact
-    vs standalone generate; re-arming speculation later still works
-    because the extension entries carry the same d_row=None the k==0
-    full-prefill path caches."""
+    vs standalone generate; re-arming speculation later still works —
+    the k==0-era tree nodes carry no draft row, and the radix path
+    backfills the draft side with a full draft prefill."""
     model = tiny()
     params = model.init_params(0)
     draft = tiny(n_layers=1)
@@ -233,14 +356,16 @@ def test_prefix_extension_when_speculation_disabled(rng):
     assert srv.run_to_completion()[rid] == reference(model, params,
                                                      ext, 4)
     assert srv.stats["prefix_hits"] == 1
-    # re-arm: the next admission takes the ordinary speculative path
-    # (full prefill + draft row) and stays token-exact
+    # re-arm: the next extending admission still rides the radix path —
+    # the k==0-era ancestor carries no draft row, so the draft side
+    # (only) full-prefills while the target row suffix-extends
+    # (ISSUE 20: the k>0 full-prefill fallback is gone)
     srv._k = 2
     longer = ext + list(rng.integers(0, 96, 3))
     rid = srv.submit(longer, max_new_tokens=4)
     assert srv.run_to_completion()[rid] == reference(model, params,
                                                      longer, 4)
-    assert srv.stats["prefix_hits"] == 1  # k>0 keeps full prefill
+    assert srv.stats["prefix_hits"] == 2
 
 
 def test_prompt_cache_speculative_and_int8(rng):
